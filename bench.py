@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 synthetic throughput (images/sec/chip).
+
+Protocol mirrors the reference's ``examples/pytorch_synthetic_benchmark.py``
+(batch 32 per chip, synthetic ImageNet-shaped data, mean over timed
+iterations). Baseline for ``vs_baseline``: the reference's published
+ResNet-101 tf_cnn_benchmarks number, 1656.82 images/sec on 16 Pascal GPUs
+= 103.55 img/s/device (``docs/benchmarks.rst:31-41``; BASELINE.md).
+
+Prints exactly one JSON line.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+BASELINE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16.0
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-warmup", type=int, default=5)
+    parser.add_argument("--num-iters", type=int, default=30)
+    parser.add_argument("--image-size", type=int, default=224)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models.resnet import ResNet50
+    from horovod_tpu.training import (
+        init_train_state, make_train_step, replicate_state, shard_batch)
+
+    hvd.init()
+    n = hvd.size()
+    mesh = hvd.mesh()
+
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    optimizer = optax.sgd(0.01, momentum=0.9)
+
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.zeros((1, args.image_size, args.image_size, 3), jnp.float32)
+    state = replicate_state(init_train_state(model, optimizer, rng, sample),
+                            mesh)
+
+    global_batch = args.batch_size * n
+    images = np.random.RandomState(0).rand(
+        global_batch, args.image_size, args.image_size, 3).astype(np.float32)
+    labels = np.random.RandomState(1).randint(
+        0, 1000, size=(global_batch,)).astype(np.int32)
+    images, labels = shard_batch((jnp.asarray(images), jnp.asarray(labels)),
+                                 mesh)
+
+    step = make_train_step(model, optimizer, mesh)
+
+    # A scalar fetch (not block_until_ready) is the completion fence: the
+    # final loss depends on every prior step through the donated state
+    # chain, and fetching it forces full execution even on remote-tunnel
+    # platforms where block_until_ready returns early.
+    for _ in range(args.num_warmup):
+        state, loss = step(state, images, labels)
+    float(np.asarray(loss))
+
+    t0 = time.perf_counter()
+    for _ in range(args.num_iters):
+        state, loss = step(state, images, labels)
+    float(np.asarray(loss))
+    dt = time.perf_counter() - t0
+
+    img_per_sec = global_batch * args.num_iters / dt
+    img_per_sec_per_chip = img_per_sec / n
+
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(img_per_sec_per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(
+            img_per_sec_per_chip / BASELINE_IMG_PER_SEC_PER_DEVICE, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

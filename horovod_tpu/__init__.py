@@ -1,0 +1,215 @@
+"""horovod_tpu — a TPU-native distributed training framework.
+
+Capability parity with Horovod (reference: tgravescs/horovod v0.19.2),
+re-architected for TPU: XLA collectives over ICI/DCN replace NCCL/MPI/Gloo,
+``jax.sharding.Mesh`` topology replaces MPI rank discovery, and the
+coordination control plane lives in a native runtime library.
+
+Typical use (JAX-native, mirrors ``import horovod.torch as hvd`` scripts)::
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    # eager API
+    summed = hvd.allreduce(per_chip_grads, op=hvd.Sum)
+    # in-jit API (inside shard_map/pjit over hvd.mesh())
+    grads = hvd.xla.allreduce(grads, op=hvd.Average)
+
+Framework bindings live in ``horovod_tpu.torch``, ``horovod_tpu.tensorflow``,
+``horovod_tpu.keras`` (import the one matching your framework, as with the
+reference).
+"""
+
+from typing import List, Optional
+
+from .version import __version__  # noqa: F401
+from .common import exceptions  # noqa: F401
+from .common.exceptions import (  # noqa: F401
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+)
+from .common.state import (  # noqa: F401
+    ccl_built,
+    cross_rank,
+    cross_size,
+    ddl_built,
+    gloo_built,
+    gloo_enabled,
+    hierarchical_mesh,
+    init,
+    is_homogeneous,
+    is_initialized,
+    local_rank,
+    local_size,
+    mesh,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
+    rank,
+    shutdown,
+    size,
+    tpu_available,
+    xla_built,
+)
+from .common.state import global_state as _global_state
+from .ops import xla  # noqa: F401
+from .ops.xla import Adasum, Average, Max, Min, ReduceOp, Sum  # noqa: F401
+
+
+def _engine():
+    st = _global_state()
+    if not st.initialized or st.engine is None:
+        from .common.exceptions import NotInitializedError
+
+        raise NotInitializedError("collective API")
+    return st.engine
+
+
+# ---- eager async API (parity: hvd.allreduce_async_/poll/synchronize) -------
+
+
+def allreduce_async(tensor, name: Optional[str] = None, op: int = Sum,
+                    prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0) -> int:
+    return _engine().allreduce_async(
+        tensor, name=name, op=op, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor)
+
+
+def allreduce(tensor, name: Optional[str] = None, op: int = Average,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+    """Eager allreduce. Default op is Average, matching the reference's
+    Python-level default (``torch/mpi_ops.py:91-129``)."""
+    return synchronize(allreduce_async(
+        tensor, name=name, op=op, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor))
+
+
+def grouped_allreduce_async(tensors: List, name: Optional[str] = None,
+                            op: int = Sum, prescale_factor: float = 1.0,
+                            postscale_factor: float = 1.0) -> int:
+    return _engine().grouped_allreduce_async(
+        tensors, name=name, op=op, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor)
+
+
+def grouped_allreduce(tensors: List, name: Optional[str] = None,
+                      op: int = Average, prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0):
+    return synchronize(grouped_allreduce_async(
+        tensors, name=name, op=op, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor))
+
+
+def allgather_async(tensor, name: Optional[str] = None) -> int:
+    return _engine().allgather_async(tensor, name=name)
+
+
+def allgather(tensor, name: Optional[str] = None):
+    return synchronize(allgather_async(tensor, name=name))
+
+
+def broadcast_async(tensor, root_rank: int, name: Optional[str] = None) -> int:
+    return _engine().broadcast_async(tensor, root_rank, name=name)
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None):
+    return synchronize(broadcast_async(tensor, root_rank, name=name))
+
+
+def reducescatter_async(tensor, name: Optional[str] = None, op: int = Sum) -> int:
+    return _engine().reducescatter_async(tensor, name=name, op=op)
+
+
+def reducescatter(tensor, name: Optional[str] = None, op: int = Sum):
+    return synchronize(reducescatter_async(tensor, name=name, op=op))
+
+
+def alltoall_async(tensor, name: Optional[str] = None) -> int:
+    return _engine().alltoall_async(tensor, name=name)
+
+
+def alltoall(tensor, name: Optional[str] = None):
+    return synchronize(alltoall_async(tensor, name=name))
+
+
+def poll(handle: int) -> bool:
+    """True if the collective behind ``handle`` has completed."""
+    return _engine().poll(handle)
+
+
+def synchronize(handle: int):
+    """Block until the collective completes and return its result."""
+    return _engine().synchronize(handle)
+
+
+def barrier():
+    """Synchronize all participants (capability extension; the reference
+    gained hvd.barrier() post-0.19)."""
+    _engine().barrier()
+
+
+def join() -> int:
+    """Graceful departure (parity: ``hvd.join()``, ``operations.cc:937-961``).
+
+    In SPMD mode every chip is driven by a live process, so join degenerates
+    to a barrier; returns the last joined participant id. Elastic mode uses
+    host-level membership instead (``horovod_tpu.elastic``).
+    """
+    _engine().barrier()
+    st = _global_state()
+    st.last_joined = st.size - 1
+    return st.last_joined
+
+
+# ---- high-level JAX-native helpers -----------------------------------------
+
+
+def broadcast_parameters(params, root_rank: int = 0):
+    """Broadcast a pytree of parameters from ``root_rank`` to all
+    participants (parity: ``torch/functions.py:30-226``). In SPMD
+    single-controller mode the tree is already consistent process-wide; the
+    broadcast runs across processes when there are several."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    out = [broadcast(l, root_rank, name=f"bcast.param.{i}")
+           for i, l in enumerate(leaves)]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None):
+    """Broadcast an arbitrary picklable object (parity:
+    ``torch/functions.py`` broadcast_object)."""
+    import pickle
+
+    import numpy as np
+
+    st = _global_state()
+    if st.process_count == 1:
+        return obj  # single controller: nothing to do
+    payload = pickle.dumps(obj) if st.process_index == root_rank else b""
+    n = int(np.asarray(
+        synchronize(allreduce_async(
+            np.asarray(len(payload), dtype=np.int64), op=Sum,
+            name=(name or "bcast.obj") + ".len"))).max())
+    buf = np.zeros(n, dtype=np.uint8)
+    if st.process_index == root_rank:
+        buf[: len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+    buf = broadcast(buf, root_rank, name=(name or "bcast.obj") + ".data")
+    return pickle.loads(bytes(np.asarray(buf)))
+
+
+from . import elastic  # noqa: E402,F401
+
+
+class DistributedOptimizer:
+    """Optax gradient-transformation wrapper that averages gradients across
+    the mesh (parity: ``hvd.DistributedOptimizer``; see
+    ``horovod_tpu.opt`` for the full implementation)."""
+
+    def __new__(cls, optimizer, **kwargs):
+        from .opt import DistributedOptimizer as _impl
+
+        return _impl(optimizer, **kwargs)

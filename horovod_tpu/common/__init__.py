@@ -1,0 +1,5 @@
+from . import config, exceptions, logging  # noqa: F401
+from .exceptions import (  # noqa: F401
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+)

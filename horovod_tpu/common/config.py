@@ -1,0 +1,132 @@
+"""Runtime configuration: one env-var layer, Horovod-compatible knob names.
+
+The reference converges three config layers on env vars (SURVEY §5; knob
+names in ``common.h:62-88``, parsed in ``operations.cc:407-504``). We keep
+the same user-facing names (HOROVOD_*) so reference users find every knob,
+and add TPU-specific ones under the same prefix.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+# ---- knob names (reference: common.h:62-88) --------------------------------
+HOROVOD_FUSION_THRESHOLD = "HOROVOD_FUSION_THRESHOLD"
+HOROVOD_CYCLE_TIME = "HOROVOD_CYCLE_TIME"
+HOROVOD_TIMELINE = "HOROVOD_TIMELINE"
+HOROVOD_TIMELINE_MARK_CYCLES = "HOROVOD_TIMELINE_MARK_CYCLES"
+HOROVOD_AUTOTUNE = "HOROVOD_AUTOTUNE"
+HOROVOD_AUTOTUNE_LOG = "HOROVOD_AUTOTUNE_LOG"
+HOROVOD_AUTOTUNE_WARMUP_SAMPLES = "HOROVOD_AUTOTUNE_WARMUP_SAMPLES"
+HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE = "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"
+HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES = "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"
+HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE = "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"
+HOROVOD_CACHE_CAPACITY = "HOROVOD_CACHE_CAPACITY"
+HOROVOD_HIERARCHICAL_ALLREDUCE = "HOROVOD_HIERARCHICAL_ALLREDUCE"
+HOROVOD_HIERARCHICAL_ALLGATHER = "HOROVOD_HIERARCHICAL_ALLGATHER"
+HOROVOD_STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
+HOROVOD_STALL_CHECK_TIME_SECONDS = "HOROVOD_STALL_CHECK_TIME_SECONDS"
+HOROVOD_STALL_SHUTDOWN_TIME_SECONDS = "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"
+HOROVOD_LOG_LEVEL = "HOROVOD_LOG_LEVEL"
+HOROVOD_LOG_HIDE_TIME = "HOROVOD_LOG_HIDE_TIME"
+# launch-time topology (reference: gloo_context.cc:40-54)
+HOROVOD_RANK = "HOROVOD_RANK"
+HOROVOD_SIZE = "HOROVOD_SIZE"
+HOROVOD_LOCAL_RANK = "HOROVOD_LOCAL_RANK"
+HOROVOD_LOCAL_SIZE = "HOROVOD_LOCAL_SIZE"
+HOROVOD_CROSS_RANK = "HOROVOD_CROSS_RANK"
+HOROVOD_CROSS_SIZE = "HOROVOD_CROSS_SIZE"
+HOROVOD_CONTROLLER_ADDR = "HOROVOD_CONTROLLER_ADDR"
+HOROVOD_CONTROLLER_PORT = "HOROVOD_CONTROLLER_PORT"
+HOROVOD_RENDEZVOUS_ADDR = "HOROVOD_GLOO_RENDEZVOUS_ADDR"
+HOROVOD_RENDEZVOUS_PORT = "HOROVOD_GLOO_RENDEZVOUS_PORT"
+# TPU-specific additions
+HOROVOD_TPU_MESH_AXES = "HOROVOD_TPU_MESH_AXES"
+HOROVOD_TPU_DONUT_SIZE = "HOROVOD_TPU_DONUT_SIZE"
+HOROVOD_ELASTIC = "HOROVOD_ELASTIC"
+
+DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # reference operations.cc:423
+DEFAULT_CYCLE_TIME_MS = 5.0  # reference operations.cc:431
+DEFAULT_CACHE_CAPACITY = 1024
+DEFAULT_STALL_WARNING_SECONDS = 60.0  # reference stall_inspector.h:75
+
+
+def _get_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _get_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    try:
+        return int(v) if v is not None else default
+    except ValueError:
+        return default
+
+
+def _get_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    try:
+        return float(v) if v is not None else default
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass
+class RuntimeConfig:
+    """Snapshot of all runtime knobs, read once at ``hvd.init()``.
+
+    Mirrors the env parse block of the reference background loop
+    (``operations.cc:407-504``) as a dataclass instead of scattered globals.
+    """
+
+    fusion_threshold_bytes: int = DEFAULT_FUSION_THRESHOLD_BYTES
+    cycle_time_ms: float = DEFAULT_CYCLE_TIME_MS
+    cache_capacity: int = DEFAULT_CACHE_CAPACITY
+    timeline_filename: str = ""
+    timeline_mark_cycles: bool = False
+    autotune: bool = False
+    autotune_log: str = ""
+    autotune_warmup_samples: int = 3
+    autotune_steps_per_sample: int = 10
+    autotune_bayes_opt_max_samples: int = 20
+    autotune_gaussian_process_noise: float = 0.8
+    hierarchical_allreduce: bool = False
+    hierarchical_allgather: bool = False
+    stall_check_disable: bool = False
+    stall_warning_seconds: float = DEFAULT_STALL_WARNING_SECONDS
+    stall_shutdown_seconds: float = 0.0
+    elastic: bool = False
+
+    @classmethod
+    def from_env(cls) -> "RuntimeConfig":
+        return cls(
+            fusion_threshold_bytes=_get_int(
+                HOROVOD_FUSION_THRESHOLD, DEFAULT_FUSION_THRESHOLD_BYTES
+            ),
+            cycle_time_ms=_get_float(HOROVOD_CYCLE_TIME, DEFAULT_CYCLE_TIME_MS),
+            cache_capacity=_get_int(HOROVOD_CACHE_CAPACITY, DEFAULT_CACHE_CAPACITY),
+            timeline_filename=os.environ.get(HOROVOD_TIMELINE, ""),
+            timeline_mark_cycles=_get_bool(HOROVOD_TIMELINE_MARK_CYCLES),
+            autotune=_get_bool(HOROVOD_AUTOTUNE),
+            autotune_log=os.environ.get(HOROVOD_AUTOTUNE_LOG, ""),
+            autotune_warmup_samples=_get_int(HOROVOD_AUTOTUNE_WARMUP_SAMPLES, 3),
+            autotune_steps_per_sample=_get_int(HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE, 10),
+            autotune_bayes_opt_max_samples=_get_int(
+                HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES, 20
+            ),
+            autotune_gaussian_process_noise=_get_float(
+                HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE, 0.8
+            ),
+            hierarchical_allreduce=_get_bool(HOROVOD_HIERARCHICAL_ALLREDUCE),
+            hierarchical_allgather=_get_bool(HOROVOD_HIERARCHICAL_ALLGATHER),
+            stall_check_disable=_get_bool(HOROVOD_STALL_CHECK_DISABLE),
+            stall_warning_seconds=_get_float(
+                HOROVOD_STALL_CHECK_TIME_SECONDS, DEFAULT_STALL_WARNING_SECONDS
+            ),
+            stall_shutdown_seconds=_get_float(HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, 0.0),
+            elastic=_get_bool(HOROVOD_ELASTIC),
+        )

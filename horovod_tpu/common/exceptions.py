@@ -1,0 +1,61 @@
+"""Exception types for the TPU-native runtime.
+
+Capability parity with the reference's ``horovod/common/exceptions.py:18-31``
+(``HorovodInternalError`` and ``HostsUpdatedInterrupt``), re-grounded in the
+TPU failure model: XLA compilation failures, ICI collective deadlines, and
+TPU-VM preemption notices all funnel into these two user-visible types so the
+elastic retry loop (``horovod_tpu.common.elastic``) can distinguish
+"state may be corrupt, restore" from "world changed, re-init and continue".
+"""
+
+
+class HorovodTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class HorovodInternalError(HorovodTpuError):
+    """Internal error raised when a collective routine fails.
+
+    Treated as recoverable by elastic mode: worker state is assumed corrupt
+    and is restored from the last commit.
+    """
+
+
+class HostsUpdatedInterrupt(HorovodTpuError):
+    """The set of participating hosts/slices changed (e.g. TPU-VM preemption).
+
+    In elastic mode the current results are assumed valid; training continues
+    after a re-initialization against the new world.
+    """
+
+    def __init__(self, skip_sync: bool = False):
+        super().__init__()
+        self.skip_sync = skip_sync
+
+
+class NotInitializedError(HorovodTpuError):
+    """An API requiring ``hvd.init()`` was called before initialization."""
+
+    def __init__(self, name: str = ""):
+        msg = (
+            "horovod_tpu has not been initialized; call hvd.init() first"
+            + (f" (required by {name})" if name else "")
+        )
+        super().__init__(msg)
+
+
+class TensorShapeMismatchError(HorovodTpuError):
+    """Cross-rank consistency validation failed (shape/dtype/op mismatch).
+
+    Mirrors the reference controller's ``ConstructResponse`` error reporting
+    (``controller.cc:378-611``): mismatched requests produce an error status
+    delivered to every participating rank rather than a hang.
+    """
+
+
+class DuplicateTensorNameError(HorovodTpuError):
+    """A tensor with the same name was submitted twice before completion.
+
+    Mirrors the duplicate-name rejection of the reference tensor queue
+    (``common.h:161-164``, ``tensor_queue.cc``).
+    """

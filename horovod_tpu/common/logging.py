@@ -1,0 +1,70 @@
+"""Leveled, rank-prefixed logger.
+
+Parity with the reference C++ macro logger (``common/logging.{h,cc}``):
+levels TRACE/DEBUG/INFO/WARNING/ERROR/FATAL selected by ``HOROVOD_LOG_LEVEL``,
+timestamps suppressed by ``HOROVOD_LOG_HIDE_TIME``.
+"""
+
+from __future__ import annotations
+
+import logging as _pylogging
+import os
+import sys
+
+_LEVELS = {
+    "trace": 5,
+    "debug": _pylogging.DEBUG,
+    "info": _pylogging.INFO,
+    "warning": _pylogging.WARNING,
+    "error": _pylogging.ERROR,
+    "fatal": _pylogging.CRITICAL,
+}
+
+_pylogging.addLevelName(5, "TRACE")
+
+_logger = None
+
+
+def get_logger() -> _pylogging.Logger:
+    global _logger
+    if _logger is None:
+        _logger = _pylogging.getLogger("horovod_tpu")
+        level_name = os.environ.get("HOROVOD_LOG_LEVEL", "warning").strip().lower()
+        _logger.setLevel(_LEVELS.get(level_name, _pylogging.WARNING))
+        handler = _pylogging.StreamHandler(sys.stderr)
+        hide_time = os.environ.get("HOROVOD_LOG_HIDE_TIME", "").strip().lower() in (
+            "1",
+            "true",
+        )
+        fmt = "[%(levelname)s] %(message)s" if hide_time else (
+            "%(asctime)s [%(levelname)s] %(message)s"
+        )
+        handler.setFormatter(_pylogging.Formatter(fmt))
+        _logger.addHandler(handler)
+        _logger.propagate = False
+    return _logger
+
+
+def _prefix(msg: str) -> str:
+    rank = os.environ.get("HOROVOD_RANK")
+    return f"[rank {rank}] {msg}" if rank is not None else msg
+
+
+def trace(msg: str) -> None:
+    get_logger().log(5, _prefix(msg))
+
+
+def debug(msg: str) -> None:
+    get_logger().debug(_prefix(msg))
+
+
+def info(msg: str) -> None:
+    get_logger().info(_prefix(msg))
+
+
+def warning(msg: str) -> None:
+    get_logger().warning(_prefix(msg))
+
+
+def error(msg: str) -> None:
+    get_logger().error(_prefix(msg))

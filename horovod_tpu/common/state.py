@@ -1,0 +1,295 @@
+"""Process-global runtime state and the basics API.
+
+Capability parity with the reference's ``horovod/common/basics.py:22-211``
+(init/shutdown/rank/size/local/cross queries) and ``global_state.h:42-122``,
+re-designed TPU-first:
+
+- The world is a ``jax.sharding.Mesh`` over all addressable TPU chips, not a
+  set of MPI ranks. Every *chip* is a participant; ``size()`` is the number
+  of chips in the mesh.
+- The reference's GLOBAL/LOCAL/CROSS communicator hierarchy
+  (``common.h:111-115``, ``mpi_context.h:78-84``) maps onto TPU topology:
+  LOCAL = the chips driven by this process (ICI-connected), CROSS = the
+  process/slice grid reached over DCN. ``local_size()``/``cross_size()``
+  follow that mapping.
+- Multi-host initialization goes through ``jax.distributed`` (gRPC
+  coordination service) instead of MPI_Init; the launcher provides the
+  coordinator address via ``HOROVOD_CONTROLLER_ADDR/PORT`` env, playing the
+  role of the reference's Gloo rendezvous (``gloo_context.cc:40-54``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from . import config as _config
+from . import logging as _log
+from .exceptions import NotInitializedError
+
+# Mesh axis names. "hvd" is the flat data-parallel axis used by the
+# Horovod-parity API; hierarchical ops split it into cross ("dcn") x
+# local ("ici").
+AXIS_GLOBAL = "hvd"
+AXIS_CROSS = "dcn"
+AXIS_LOCAL = "ici"
+
+
+class _GlobalState:
+    """Singleton mirroring the reference's ``HorovodGlobalState``."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.initialized = False
+        self.config: Optional[_config.RuntimeConfig] = None
+        self.mesh = None  # flat 1-D Mesh over all participating devices
+        self.hier_mesh = None  # 2-D Mesh (cross, local) over the same devices
+        self.devices: Sequence = ()
+        self.local_devices: Sequence = ()
+        self.size = 0
+        self.local_size = 0
+        self.cross_size = 0
+        self.rank = 0
+        self.local_rank = 0
+        self.cross_rank = 0
+        self.process_count = 1
+        self.process_index = 0
+        self.is_homogeneous = True
+        self.engine = None  # ops.eager.EagerEngine, attached at init
+        self.timeline = None
+        self.autotuner = None
+        self.elastic_enabled = False
+        self.last_joined = -1
+
+    def reset(self):
+        self.__init__()
+
+
+_state = _GlobalState()
+
+
+def global_state() -> _GlobalState:
+    return _state
+
+
+def _maybe_init_distributed() -> None:
+    """Join the multi-process world if the launcher set one up.
+
+    The launcher exports HOROVOD_SIZE (process count), HOROVOD_RANK
+    (process index) and HOROVOD_CONTROLLER_ADDR/PORT (the gRPC coordination
+    service endpoint) — the TPU-native analog of the reference's env-driven
+    Gloo rendezvous (``gloo_context.cc:40-54``).
+    """
+    import jax
+
+    nproc = int(os.environ.get(_config.HOROVOD_SIZE, "1"))
+    if nproc <= 1 or jax.process_count() > 1:
+        return
+    rank = int(os.environ.get(_config.HOROVOD_RANK, "0"))
+    addr = os.environ.get(_config.HOROVOD_CONTROLLER_ADDR, "127.0.0.1")
+    port = os.environ.get(_config.HOROVOD_CONTROLLER_PORT, "29500")
+    _log.debug(f"joining distributed world: {rank}/{nproc} via {addr}:{port}")
+    jax.distributed.initialize(
+        coordinator_address=f"{addr}:{port}",
+        num_processes=nproc,
+        process_id=rank,
+    )
+
+
+def init(comm=None, devices=None):
+    """Initialize the runtime.
+
+    ``comm`` accepts a list of process indices to restrict the world to a
+    subset of launched processes (parity with ``hvd.init(comm=[ranks])``,
+    reference ``basics.py:33-65``); on TPU the subset must be
+    slice-aligned, so we only support the full world or a device subset via
+    ``devices``.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    with _state.lock:
+        if _state.initialized:
+            return
+
+        _maybe_init_distributed()
+
+        _state.config = _config.RuntimeConfig.from_env()
+
+        if devices is None:
+            all_devices = list(jax.devices())
+        else:
+            all_devices = list(devices)
+        if comm is not None:
+            # Restrict to the devices owned by the given process subset.
+            keep = set(comm)
+            all_devices = [d for d in all_devices if d.process_index in keep]
+
+        local = [d for d in all_devices if d.process_index == jax.process_index()]
+
+        _state.devices = all_devices
+        _state.local_devices = local
+        _state.size = len(all_devices)
+        _state.local_size = len(local)
+        _state.process_count = jax.process_count()
+        _state.process_index = jax.process_index()
+        _state.cross_size = max(
+            1, len({d.process_index for d in all_devices})
+        )
+        _state.cross_rank = _state.process_index
+        # rank = lowest participant id owned by this process; participant ids
+        # follow mesh order (process-major, so contiguous per process).
+        _state.rank = (
+            all_devices.index(local[0]) if local else 0
+        )
+        _state.local_rank = 0
+        sizes = {}
+        for d in all_devices:
+            sizes[d.process_index] = sizes.get(d.process_index, 0) + 1
+        _state.is_homogeneous = len(set(sizes.values())) <= 1
+
+        mesh_devices = np.array(all_devices, dtype=object)
+        _state.mesh = Mesh(mesh_devices, (AXIS_GLOBAL,))
+        if _state.is_homogeneous and _state.local_size > 0:
+            hier = mesh_devices.reshape(_state.cross_size, _state.local_size)
+            _state.hier_mesh = Mesh(hier, (AXIS_CROSS, AXIS_LOCAL))
+
+        from ..ops.eager import EagerEngine
+
+        _state.engine = EagerEngine(_state)
+
+        if _state.config.timeline_filename:
+            from .timeline import Timeline
+
+            _state.timeline = Timeline(
+                _state.config.timeline_filename,
+                mark_cycles=_state.config.timeline_mark_cycles,
+            )
+
+        _state.initialized = True
+        _log.info(
+            f"horovod_tpu initialized: size={_state.size} "
+            f"local_size={_state.local_size} cross_size={_state.cross_size} "
+            f"platform={all_devices[0].platform if all_devices else 'none'}"
+        )
+
+
+def shutdown():
+    """Tear down the runtime (parity: ``horovod_shutdown``)."""
+    with _state.lock:
+        if not _state.initialized:
+            return
+        if _state.engine is not None:
+            _state.engine.shutdown()
+        if _state.timeline is not None:
+            _state.timeline.close()
+        _state.reset()
+
+
+def is_initialized() -> bool:
+    return _state.initialized
+
+
+def _require_init(name: str) -> _GlobalState:
+    if not _state.initialized:
+        raise NotInitializedError(name)
+    return _state
+
+
+def size() -> int:
+    """Number of participants (TPU chips) in the world."""
+    return _require_init("size").size
+
+
+def local_size() -> int:
+    """Number of participants driven by this process (ICI-local group)."""
+    return _require_init("local_size").local_size
+
+
+def cross_size() -> int:
+    """Number of processes / DCN endpoints (one per host or slice)."""
+    return _require_init("cross_size").cross_size
+
+
+def rank() -> int:
+    """Lowest participant id owned by this process.
+
+    With one process per host driving N chips, ranks are ``process_index*N``;
+    rank 0 is always the coordinator process, so ``if hvd.rank() == 0:``
+    checkpointing idioms from the reference work unchanged.
+    """
+    return _require_init("rank").rank
+
+
+def local_rank() -> int:
+    return _require_init("local_rank").local_rank
+
+
+def cross_rank() -> int:
+    return _require_init("cross_rank").cross_rank
+
+
+def is_homogeneous() -> bool:
+    return _require_init("is_homogeneous").is_homogeneous
+
+
+def mesh():
+    """The flat 1-D ``jax.sharding.Mesh`` over all participants."""
+    return _require_init("mesh").mesh
+
+
+def hierarchical_mesh():
+    """The (cross, local) 2-D mesh: DCN x ICI, or None if inhomogeneous."""
+    return _require_init("hierarchical_mesh").hier_mesh
+
+
+# ---- capability predicates (parity: operations.cc:690-760) -----------------
+
+
+def mpi_threads_supported() -> bool:
+    return False
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def gloo_built() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
+    return False
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def xla_built() -> bool:
+    """Always true: XLA collectives are the native backend."""
+    return True
+
+
+def tpu_available() -> bool:
+    import jax
+
+    try:
+        return any(d.platform == "tpu" for d in jax.devices())
+    except RuntimeError:
+        return False

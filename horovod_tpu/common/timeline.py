@@ -1,0 +1,141 @@
+"""Chrome-tracing timeline writer.
+
+Parity with the reference Timeline (``common/timeline.{h,cc}``): per-tensor
+phase events (NEGOTIATE_* → processing activities) written as Chrome tracing
+JSON, with a dedicated writer thread fed by a queue so the hot path never
+blocks on file IO (the reference uses a boost lock-free SPSC queue,
+``timeline.h:47-75``; a ``queue.SimpleQueue`` plays that role here — the
+C++ core supplies the native writer in the runtime library).
+
+Activity names follow ``common.h:31-59`` so existing timeline-analysis
+tooling for the reference reads our traces unchanged; device-side timing
+comes from XLA profiler hooks rather than CUDA events.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from typing import Optional
+
+# Activity names (parity: common.h:31-59 / docs/timeline.rst:22-43)
+NEGOTIATE_ALLREDUCE = "NEGOTIATE_ALLREDUCE"
+NEGOTIATE_ALLGATHER = "NEGOTIATE_ALLGATHER"
+NEGOTIATE_BROADCAST = "NEGOTIATE_BROADCAST"
+WAIT_FOR_DATA = "WAIT_FOR_DATA"
+WAIT_FOR_OTHER_TENSOR_DATA = "WAIT_FOR_OTHER_TENSOR_DATA"
+MEMCPY_IN_FUSION_BUFFER = "MEMCPY_IN_FUSION_BUFFER"
+MEMCPY_OUT_FUSION_BUFFER = "MEMCPY_OUT_FUSION_BUFFER"
+XLA_ALLREDUCE = "XLA_ALLREDUCE"
+XLA_ALLGATHER = "XLA_ALLGATHER"
+XLA_BCAST = "XLA_BCAST"
+XLA_REDUCESCATTER = "XLA_REDUCESCATTER"
+COMPILE = "COMPILE"
+
+
+class Timeline:
+    """Rank-0 Chrome-tracing JSON writer with a background writer thread."""
+
+    def __init__(self, filename: str, mark_cycles: bool = False):
+        self._filename = filename
+        self._mark_cycles = mark_cycles
+        self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._start_ns = time.monotonic_ns()
+        self._pid = os.getpid()
+        self._tensor_tids = {}
+        self._next_tid = 1
+        self._closed = False
+        self._file = open(filename, "w")
+        self._file.write("[\n")
+        self._writer = threading.Thread(target=self._drain, daemon=True)
+        self._writer.start()
+
+    # -- event API -----------------------------------------------------------
+
+    def _ts_us(self) -> float:
+        return (time.monotonic_ns() - self._start_ns) / 1e3
+
+    def _tid(self, tensor_name: str) -> int:
+        tid = self._tensor_tids.get(tensor_name)
+        if tid is None:
+            tid = self._next_tid
+            self._next_tid += 1
+            self._tensor_tids[tensor_name] = tid
+            self._emit(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": self._pid,
+                    "tid": tid,
+                    "args": {"name": tensor_name},
+                }
+            )
+        return tid
+
+    def _emit(self, ev: dict):
+        if not self._closed:
+            self._queue.put(ev)
+
+    def start_activity(self, tensor_name: str, activity: str):
+        self._emit(
+            {
+                "name": activity,
+                "ph": "B",
+                "pid": self._pid,
+                "tid": self._tid(tensor_name),
+                "ts": self._ts_us(),
+            }
+        )
+
+    def end_activity(self, tensor_name: str, activity: str):
+        self._emit(
+            {
+                "name": activity,
+                "ph": "E",
+                "pid": self._pid,
+                "tid": self._tid(tensor_name),
+                "ts": self._ts_us(),
+            }
+        )
+
+    def instant(self, name: str, args: Optional[dict] = None):
+        self._emit(
+            {
+                "name": name,
+                "ph": "i",
+                "s": "g",
+                "pid": self._pid,
+                "tid": 0,
+                "ts": self._ts_us(),
+                "args": args or {},
+            }
+        )
+
+    def mark_cycle(self):
+        if self._mark_cycles:
+            self.instant("CYCLE")
+
+    # -- writer thread -------------------------------------------------------
+
+    def _drain(self):
+        first = True
+        while True:
+            ev = self._queue.get()
+            if ev is None:
+                break
+            if not first:
+                self._file.write(",\n")
+            first = False
+            self._file.write(json.dumps(ev))
+        self._file.write("\n]\n")
+        self._file.flush()
+        self._file.close()
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            self._queue.put(None)
+            self._writer.join(timeout=5.0)

@@ -1,0 +1,13 @@
+"""Elastic training: fault-tolerant state with commit/restore/sync.
+
+Parity with the reference's elastic worker machinery
+(``horovod/common/elastic.py:26-168``): a ``State`` object the training loop
+commits every N batches; on a collective failure (``HorovodInternalError``)
+state is restored from the last commit, on a membership change
+(``HostsUpdatedInterrupt``) training continues after re-initialization.
+TPU-native re-grounding: membership changes arrive as TPU-VM preemption
+notices at *slice* granularity (the LOCAL/ICI group is immutable; the
+CROSS/DCN group is elastic — SURVEY §7 "Elastic + ICI").
+"""
+
+from .state import ObjectState, State, run  # noqa: F401

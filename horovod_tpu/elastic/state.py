@@ -1,0 +1,158 @@
+"""Elastic worker state: commit / restore / sync + the retry loop.
+
+Reference behavior being matched (``common/elastic.py``):
+
+- ``State.commit()`` — save a known-good snapshot and check for host
+  updates (``common/elastic.py:60-93``).
+- ``State.restore()`` — roll back to the last committed snapshot.
+- ``State.sync()`` — make all workers consistent (broadcast from the
+  coordinator) after a world change.
+- ``run(fn)`` — decorator wrapping the training function in a loop that
+  catches ``HorovodInternalError`` (restore + reinit) and
+  ``HostsUpdatedInterrupt`` (reinit, keep results)
+  (``common/elastic.py:147-168``).
+"""
+
+from __future__ import annotations
+
+import copy
+import functools
+import queue
+from typing import Callable, Dict, List
+
+from ..common import logging as _log
+from ..common.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+
+
+class _HostUpdates:
+    """Process-local mailbox for membership-change notifications.
+
+    The launcher-side worker notification service (``horovod_tpu.run``)
+    posts here; TPU-VM preemption watchers post here too. Mirrors the role
+    of the reference's WorkerNotificationManager (``run/elastic/worker.py``).
+    """
+
+    def __init__(self):
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+
+    def post(self, timestamp: float = 0.0):
+        self._q.put(timestamp)
+
+    def pending(self) -> bool:
+        drained = False
+        try:
+            while True:
+                self._q.get_nowait()
+                drained = True
+        except queue.Empty:
+            pass
+        return drained
+
+
+notification_mailbox = _HostUpdates()
+
+
+class State:
+    """Base elastic state (parity: ``common/elastic.py:26-109``)."""
+
+    def __init__(self, **kwargs):
+        self._reset_callbacks: List[Callable] = []
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def register_reset_callbacks(self, callbacks: List[Callable]):
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self):
+        for cb in self._reset_callbacks:
+            cb()
+
+    def commit(self):
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self):
+        if notification_mailbox.pending():
+            raise HostsUpdatedInterrupt(skip_sync=False)
+
+    # subclass interface
+    def save(self):
+        raise NotImplementedError
+
+    def restore(self):
+        raise NotImplementedError
+
+    def sync(self):
+        raise NotImplementedError
+
+
+class ObjectState(State):
+    """Elastic state for arbitrary picklable attributes (parity:
+    ``common/elastic.py`` ObjectState): snapshot in memory on ``commit``,
+    broadcast from the coordinator on ``sync``."""
+
+    def __init__(self, bcast_object=None, **kwargs):
+        if bcast_object is None:
+            from .. import broadcast_object as bcast_object  # noqa: PLC0415
+        self._bcast_object = bcast_object
+        self._saved_state: Dict = {}
+        super().__init__(**kwargs)
+        self.save()
+
+    def _public_attrs(self) -> Dict:
+        return {
+            k: v
+            for k, v in self.__dict__.items()
+            if not k.startswith("_")
+        }
+
+    def save(self):
+        self._saved_state = copy.deepcopy(self._public_attrs())
+
+    def restore(self):
+        for k, v in copy.deepcopy(self._saved_state).items():
+            setattr(self, k, v)
+
+    def sync(self):
+        synced = self._bcast_object(self._public_attrs(), root_rank=0)
+        for k, v in synced.items():
+            setattr(self, k, v)
+        self.save()
+
+
+def _reinitialize():
+    """shutdown + init against the (possibly changed) world — the
+    reference's ``reset()`` (``torch/elastic.py:47``)."""
+    from ..common import state as _state
+
+    _state.shutdown()
+    _state.init()
+
+
+def run(func: Callable) -> Callable:
+    """Elastic retry-loop decorator (parity: ``common/elastic.py:147-168``)."""
+
+    @functools.wraps(func)
+    def wrapper(state: State, *args, **kwargs):
+        reset_required = False
+        skip_sync = False
+        while True:
+            if reset_required:
+                _reinitialize()
+                state.on_reset()
+                reset_required = False
+            if not skip_sync:
+                state.sync()
+            skip_sync = False
+            try:
+                return func(state, *args, **kwargs)
+            except HorovodInternalError:
+                _log.warning("collective failure: restoring last committed state")
+                state.restore()
+                reset_required = True
+            except HostsUpdatedInterrupt as e:
+                _log.info("host membership changed: re-initializing")
+                reset_required = True
+                skip_sync = e.skip_sync
+
+    return wrapper

@@ -1,0 +1,2 @@
+from . import xla  # noqa: F401
+from .xla import Adasum, Average, Max, Min, ReduceOp, Sum  # noqa: F401

@@ -1,0 +1,102 @@
+"""Adasum: scaling-insensitive gradient combination, TPU-native.
+
+The reference implements Adasum as recursive vector-halving distance-doubling
+over MPI point-to-point with AVX fp32 accumulation for fp16
+(``ops/adasum/adasum.h:194-398, 426-546``). The math at each level combines
+partner vectors a, b as::
+
+    a' = (1 - a.b / (2*||a||^2)) * a + (1 - a.b / (2*||b||^2)) * b
+
+which is associative across the recursion tree: after log2(n) pairwise
+levels every participant holds the same result.
+
+TPU-native design: the *halving* in VHDD is purely a bandwidth optimization
+for point-to-point networks. On an ICI torus, XLA's CollectivePermute moves
+full vectors at link speed, so we express the same recursion as log2(n)
+``lax.ppermute`` partner exchanges on full vectors with fp32 dot/norm
+accumulation on-chip — identical numerics, compiled into one program. A
+reduce-scatter-based halved variant rides the same recursion for very large
+tensors (see ``horovod_tpu/ops/xla.py:hierarchical_allreduce`` for the
+ICI/DCN split the reference's AdasumGpuAllreduceOp uses,
+``adasum_gpu_operations.cc:38-270``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..common.state import AXIS_GLOBAL
+
+
+def _is_power_of_two(n: int) -> bool:
+    return n > 0 and (n & (n - 1)) == 0
+
+
+def _adasum_combine(a, b, eps=1e-30):
+    """One Adasum pairwise combination with fp32 accumulation."""
+    af = a.astype(jnp.float32)
+    bf = b.astype(jnp.float32)
+    dot = jnp.sum(af * bf)
+    na = jnp.sum(af * af)
+    nb = jnp.sum(bf * bf)
+    ca = 1.0 - dot / (2.0 * jnp.maximum(na, eps))
+    cb = 1.0 - dot / (2.0 * jnp.maximum(nb, eps))
+    # If either vector is (near-)zero, fall back to plain sum semantics.
+    ca = jnp.where(na <= eps, 1.0, ca)
+    cb = jnp.where(nb <= eps, 1.0, cb)
+    return ca * af + cb * bf
+
+
+def adasum_allreduce(tensor, axis_name: str = AXIS_GLOBAL):
+    """In-jit Adasum allreduce over ``axis_name`` (power-of-two size).
+
+    Parity target: ``AdasumMPIAllreduceOp`` (``adasum_mpi_operations.cc:87``)
+    verified against the same NumPy reference the reference tests use
+    (``test_adasum_pytorch.py``).
+    """
+    n = lax.axis_size(axis_name)
+    if not _is_power_of_two(n):
+        raise ValueError(
+            f"Adasum requires a power-of-two participant count, got {n}"
+        )
+    dtype = tensor.dtype
+    shape = tensor.shape
+    a = jnp.ravel(tensor).astype(jnp.float32)
+    level = 1
+    while level < n:
+        # Partner exchange: rank r <-> r ^ level. The combination is
+        # symmetric in (a, b), so no rank-dependent branching is needed.
+        perm = [(r, r ^ level) for r in range(n)]
+        b = lax.ppermute(a, axis_name, perm)
+        a = _adasum_combine(a, b)
+        level <<= 1
+    return jnp.reshape(a, shape).astype(dtype)
+
+
+# ---- NumPy reference (test oracle, mirrors test_adasum_pytorch.py's role) --
+
+
+def adasum_reference(tensors):
+    """Pure-NumPy recursive-halving-free Adasum over a list of vectors.
+
+    Used by the test suite as the ground-truth oracle, the same role the
+    NumPy model plays in the reference's ``test_adasum_pytorch.py:216``.
+    """
+    vecs = [np.asarray(t, dtype=np.float64) for t in tensors]
+    n = len(vecs)
+    assert _is_power_of_two(n), "adasum reference needs power-of-two inputs"
+
+    def combine(a, b, eps=1e-30):
+        dot = float(np.sum(a * b))
+        na = float(np.sum(a * a))
+        nb = float(np.sum(b * b))
+        ca = 1.0 if na <= eps else 1.0 - dot / (2.0 * na)
+        cb = 1.0 if nb <= eps else 1.0 - dot / (2.0 * nb)
+        return ca * a + cb * b
+
+    while len(vecs) > 1:
+        vecs = [combine(vecs[i], vecs[i + 1]) for i in range(0, len(vecs), 2)]
+    return vecs[0]

@@ -1,0 +1,384 @@
+"""Eager collective engine: Horovod's dynamic-enqueue API on top of XLA.
+
+The reference's eager contract (``EnqueueTensorAllreduce`` et al.,
+``operations.cc:810-961``) is "any rank may submit any named tensor at any
+time; a handle resolves when the collective completes". On TPU, execution is
+compiled, so the engine re-creates that contract with a *compile cache*: each
+(op, shape, dtype, params) signature lazily builds one jitted
+``jax.shard_map`` program over the global mesh, cached forever after —
+the analog of the reference's lazy NCCL communicator/plan init
+(``nccl_operations.cc:60-93``), with compile-cache misses as the new
+"INIT_NCCL" one-time stall (SURVEY §7 "hard parts").
+
+Asynchrony comes from XLA's own async dispatch: launching a compiled program
+returns immediately with futures (jax.Array), so handles are genuine
+futures — the role of the reference's HandleManager
+(``torch/handle_manager.{h,cc}``) — with no extra background thread needed
+for the single-controller fast path.
+
+Input convention (TPU-first): a single process drives ``local_size`` chips,
+so eager calls carry a leading per-participant axis of length
+``local_size`` (or a list of that length). When ``local_size == 1`` the
+plain unstacked tensor is accepted, which makes one-chip-per-process
+scripts read exactly like reference Horovod scripts. A replicated
+(unstacked) input on a multi-chip world is treated as "same tensor on every
+chip".
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..common import logging as _log
+from ..common.exceptions import DuplicateTensorNameError, HorovodInternalError
+from ..common.state import AXIS_GLOBAL
+from . import xla as _xla
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    # check_vma=False: collective outputs (e.g. all_gather) are replicated
+    # by construction, which the static VMA checker cannot always infer.
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
+
+class _Handle:
+    """A future for an in-flight eager collective."""
+
+    __slots__ = ("result", "name", "postprocess", "error")
+
+    def __init__(self, result, name, postprocess=None, error=None):
+        self.result = result
+        self.name = name
+        self.postprocess = postprocess
+        self.error = error
+
+
+class EagerEngine:
+    """Per-process engine: compile cache + handle table + name registry."""
+
+    def __init__(self, state):
+        self._state = state
+        self._mesh = state.mesh
+        self._lock = threading.Lock()
+        self._program_cache: Dict[Tuple, Any] = {}
+        self._handles: Dict[int, _Handle] = {}
+        self._next_handle = 0
+        self._inflight_names: set = set()
+        self._name_counter = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def shutdown(self):
+        with self._lock:
+            self._handles.clear()
+            self._program_cache.clear()
+            self._inflight_names.clear()
+
+    # -- helpers -------------------------------------------------------------
+
+    def _auto_name(self, prefix: str) -> str:
+        with self._lock:
+            self._name_counter += 1
+            return f"{prefix}.noname.{self._name_counter}"
+
+    def _register_name(self, name: str):
+        with self._lock:
+            if name in self._inflight_names:
+                raise DuplicateTensorNameError(
+                    f"tensor name '{name}' already submitted and not yet complete"
+                )
+            self._inflight_names.add(name)
+
+    def _release_name(self, name: str):
+        with self._lock:
+            self._inflight_names.discard(name)
+
+    def _normalize(self, tensor) -> Tuple[jnp.ndarray, bool, bool]:
+        """Returns (stacked [local_size, ...] host/jax array, was_list,
+        was_unstacked)."""
+        L = self._state.local_size
+        if isinstance(tensor, (list, tuple)):
+            if len(tensor) != L:
+                raise ValueError(
+                    f"eager collective got a list of {len(tensor)} tensors; "
+                    f"expected local_size={L} (one per locally-driven chip)"
+                )
+            return jnp.stack([jnp.asarray(t) for t in tensor]), True, False
+        t = jnp.asarray(tensor)
+        if L == 1:
+            return t[None], False, True
+        if t.ndim >= 1 and t.shape[0] == L:
+            return t, False, False
+        # Replicated convenience: same tensor on every local participant.
+        return jnp.broadcast_to(t[None], (L,) + t.shape), False, True
+
+    def _to_global(self, stacked):
+        """Build the global (size, ...) array sharded one-slice-per-chip."""
+        sharding = NamedSharding(self._mesh, P(AXIS_GLOBAL))
+        if self._state.process_count == 1:
+            return jax.device_put(stacked, sharding)
+        global_shape = (self._state.size,) + tuple(stacked.shape[1:])
+        return jax.make_array_from_process_local_data(
+            sharding, np.asarray(stacked), global_shape
+        )
+
+    def _from_global_sharded(self, arr, was_list, was_unstacked):
+        """Extract this process's local slices of a P('hvd')-sharded result."""
+        shards = sorted(arr.addressable_shards, key=lambda s: s.index[0].start)
+        local = np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+        if was_list:
+            return [local[i] for i in range(local.shape[0])]
+        if was_unstacked:
+            return local[0]
+        return local
+
+    def _program(self, key, builder):
+        prog = self._program_cache.get(key)
+        if prog is None:
+            _log.debug(f"compiling eager collective program {key}")
+            prog = builder()
+            self._program_cache[key] = prog
+        return prog
+
+    def _new_handle(self, result, name, postprocess=None, error=None) -> int:
+        with self._lock:
+            h = self._next_handle
+            self._next_handle += 1
+            self._handles[h] = _Handle(result, name, postprocess, error)
+            return h
+
+    # -- collectives ---------------------------------------------------------
+
+    def allreduce_async(self, tensor, name: Optional[str] = None,
+                        op: int = _xla.ReduceOp.SUM,
+                        prescale_factor: float = 1.0,
+                        postscale_factor: float = 1.0) -> int:
+        name = name or self._auto_name("allreduce")
+        # Input validation raises synchronously (ValueError etc.); only
+        # execution failures are deferred to the handle and surface as
+        # HorovodInternalError at synchronize() time, matching the
+        # reference's callback-status contract (torch/mpi_ops.py:126-127).
+        stacked, was_list, was_unstacked = self._normalize(tensor)
+        self._register_name(name)
+        try:
+            if op == _xla.ReduceOp.ADASUM and not _is_pow2(self._state.size):
+                _log.warning(
+                    "Adasum requested with non-power-of-two size; "
+                    "falling back to Average"
+                )
+                op = _xla.ReduceOp.AVERAGE
+            key = ("allreduce", stacked.shape[1:], str(stacked.dtype), op,
+                   prescale_factor, postscale_factor)
+            mesh = self._mesh
+
+            def build():
+                def fn(x):
+                    y = _xla.allreduce(
+                        x[0], axis_name=AXIS_GLOBAL, op=op,
+                        prescale_factor=prescale_factor,
+                        postscale_factor=postscale_factor,
+                    )
+                    return y[None]
+
+                return jax.jit(
+                    _shard_map(fn, mesh, in_specs=P(AXIS_GLOBAL),
+                               out_specs=P(AXIS_GLOBAL))
+                )
+
+            prog = self._program(key, build)
+            out = prog(self._to_global(stacked))
+            post = lambda a: self._from_global_sharded(a, was_list, was_unstacked)
+            return self._new_handle(out, name, post)
+        except Exception as e:  # surface as HorovodInternalError at sync time
+            self._release_name(name)
+            if isinstance(e, DuplicateTensorNameError):
+                raise
+            return self._new_handle(None, name, None, error=e)
+
+    def grouped_allreduce_async(self, tensors: List, name: Optional[str] = None,
+                                op: int = _xla.ReduceOp.SUM,
+                                prescale_factor: float = 1.0,
+                                postscale_factor: float = 1.0) -> int:
+        """Fused allreduce of multiple named tensors in one compiled program —
+        the eager face of tensor fusion (reference ``FuseResponses``,
+        ``controller.cc:640-761``)."""
+        name = name or self._auto_name("grouped_allreduce")
+        norm = [self._normalize(t) for t in tensors]
+        self._register_name(name)
+        stacked = [n[0] for n in norm]
+        key = ("grouped_allreduce",
+               tuple((s.shape[1:], str(s.dtype)) for s in stacked), op,
+               prescale_factor, postscale_factor)
+        mesh = self._mesh
+
+        def build():
+            def fn(*xs):
+                ys = _xla.grouped_allreduce(
+                    [x[0] for x in xs], axis_name=AXIS_GLOBAL, op=op,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor,
+                )
+                return tuple(y[None] for y in ys)
+
+            return jax.jit(
+                _shard_map(fn, mesh,
+                           in_specs=tuple(P(AXIS_GLOBAL) for _ in stacked),
+                           out_specs=tuple(P(AXIS_GLOBAL) for _ in stacked))
+            )
+
+        prog = self._program(key, build)
+        outs = prog(*[self._to_global(s) for s in stacked])
+
+        def post(arrs):
+            return [
+                self._from_global_sharded(a, wl, wu)
+                for a, (_, wl, wu) in zip(arrs, norm)
+            ]
+
+        return self._new_handle(outs, name, post)
+
+    def allgather_async(self, tensor, name: Optional[str] = None) -> int:
+        name = name or self._auto_name("allgather")
+        stacked, _, _ = self._normalize(tensor)
+        self._register_name(name)
+        key = ("allgather", stacked.shape[1:], str(stacked.dtype))
+        mesh = self._mesh
+
+        def build():
+            def fn(x):
+                return _xla.allgather(x[0], axis_name=AXIS_GLOBAL)
+
+            # Output is identical on every chip -> replicate.
+            return jax.jit(
+                _shard_map(fn, mesh, in_specs=P(AXIS_GLOBAL), out_specs=P())
+            )
+
+        prog = self._program(key, build)
+        out = prog(self._to_global(stacked))
+        return self._new_handle(out, name, lambda a: a)
+
+    def broadcast_async(self, tensor, root_rank: int,
+                        name: Optional[str] = None) -> int:
+        name = name or self._auto_name("broadcast")
+        stacked, was_list, was_unstacked = self._normalize(tensor)
+        self._register_name(name)
+        key = ("broadcast", stacked.shape[1:], str(stacked.dtype), root_rank)
+        mesh = self._mesh
+
+        def build():
+            def fn(x):
+                return _xla.broadcast(x[0], root_rank, axis_name=AXIS_GLOBAL)[None]
+
+            return jax.jit(
+                _shard_map(fn, mesh, in_specs=P(AXIS_GLOBAL),
+                           out_specs=P(AXIS_GLOBAL))
+            )
+
+        prog = self._program(key, build)
+        out = prog(self._to_global(stacked))
+        post = lambda a: self._from_global_sharded(a, was_list, was_unstacked)
+        return self._new_handle(out, name, post)
+
+    def reducescatter_async(self, tensor, name: Optional[str] = None,
+                            op: int = _xla.ReduceOp.SUM) -> int:
+        name = name or self._auto_name("reducescatter")
+        stacked, was_list, was_unstacked = self._normalize(tensor)
+        if stacked.shape[1] % self._state.size != 0:
+            raise ValueError(
+                "reducescatter requires dim 0 divisible by size "
+                f"({stacked.shape[1]} % {self._state.size})"
+            )
+        self._register_name(name)
+        key = ("reducescatter", stacked.shape[1:], str(stacked.dtype), op)
+        mesh = self._mesh
+
+        def build():
+            def fn(x):
+                return _xla.reducescatter(x[0], axis_name=AXIS_GLOBAL, op=op)[None]
+
+            return jax.jit(
+                _shard_map(fn, mesh, in_specs=P(AXIS_GLOBAL),
+                           out_specs=P(AXIS_GLOBAL))
+            )
+
+        prog = self._program(key, build)
+        out = prog(self._to_global(stacked))
+        post = lambda a: self._from_global_sharded(a, was_list, was_unstacked)
+        return self._new_handle(out, name, post)
+
+    def alltoall_async(self, tensor, name: Optional[str] = None) -> int:
+        name = name or self._auto_name("alltoall")
+        stacked, was_list, was_unstacked = self._normalize(tensor)
+        if stacked.shape[1] % self._state.size != 0:
+            raise ValueError("alltoall requires dim 0 divisible by size")
+        self._register_name(name)
+        key = ("alltoall", stacked.shape[1:], str(stacked.dtype))
+        mesh = self._mesh
+
+        def build():
+            def fn(x):
+                return _xla.alltoall(x[0], axis_name=AXIS_GLOBAL)[None]
+
+            return jax.jit(
+                _shard_map(fn, mesh, in_specs=P(AXIS_GLOBAL),
+                           out_specs=P(AXIS_GLOBAL))
+            )
+
+        prog = self._program(key, build)
+        out = prog(self._to_global(stacked))
+        post = lambda a: self._from_global_sharded(a, was_list, was_unstacked)
+        return self._new_handle(out, name, post)
+
+    def barrier(self):
+        key = ("barrier",)
+        mesh = self._mesh
+
+        def build():
+            def fn():
+                return _xla.barrier(axis_name=AXIS_GLOBAL)[None]
+
+            return jax.jit(_shard_map(fn, mesh, in_specs=(),
+                                      out_specs=P(AXIS_GLOBAL)))
+
+        prog = self._program(key, build)
+        jax.block_until_ready(prog())
+
+    # -- handle management (parity: HandleManager + poll/synchronize) --------
+
+    def poll(self, handle: int) -> bool:
+        h = self._handles.get(handle)
+        if h is None:
+            raise ValueError(f"unknown handle {handle}")
+        if h.error is not None:
+            return True
+        try:
+            leaves = jax.tree_util.tree_leaves(h.result)
+            return all(leaf.is_ready() for leaf in leaves)
+        except AttributeError:
+            return True
+
+    def synchronize(self, handle: int):
+        with self._lock:
+            h = self._handles.pop(handle, None)
+        if h is None:
+            raise ValueError(f"unknown or already-synchronized handle {handle}")
+        self._release_name(h.name)
+        if h.error is not None:
+            raise HorovodInternalError(str(h.error)) from h.error
+        try:
+            result = jax.block_until_ready(h.result)
+        except Exception as e:
+            raise HorovodInternalError(str(e)) from e
+        return h.postprocess(result) if h.postprocess else result
+
+
+def _is_pow2(n: int) -> bool:
+    return n > 0 and (n & (n - 1)) == 0

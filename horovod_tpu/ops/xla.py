@@ -1,0 +1,190 @@
+"""In-jit functional collectives — the XLA/ICI backend.
+
+This is the TPU-native replacement for the reference's NCCL op layer
+(``ops/nccl_operations.cc``): instead of host-driven ``ncclAllReduce`` calls
+on private streams, collectives are *compiled into the program* as XLA HLO
+(AllReduce/AllGather/ReduceScatter/CollectivePermute) and scheduled by XLA
+over ICI with near-optimal compute/communication overlap (SURVEY §7 design
+stance).
+
+Use these inside ``jax.shard_map`` / ``pjit`` with a bound mesh axis::
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P('hvd'), out_specs=P('hvd'))
+    def step(batch):
+        ...
+        grads = hvd.xla.allreduce(grads, op=hvd.Average)
+
+The eager API (``horovod_tpu.ops.eager``) builds on these same primitives.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..common.state import AXIS_CROSS, AXIS_GLOBAL, AXIS_LOCAL
+
+
+class ReduceOp:
+    """Reduction op ids (parity: ``horovod_reduce_op_*``, operations.cc:793-806)."""
+
+    AVERAGE = 0
+    SUM = 1
+    ADASUM = 2
+    MIN = 3
+    MAX = 4
+
+
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Adasum = ReduceOp.ADASUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+
+
+def _apply_prescale(tensor, prescale_factor):
+    if prescale_factor != 1.0:
+        tensor = tensor * jnp.asarray(prescale_factor, dtype=tensor.dtype)
+    return tensor
+
+
+def _apply_postscale(tensor, postscale_factor):
+    if postscale_factor != 1.0:
+        tensor = tensor * jnp.asarray(postscale_factor, dtype=tensor.dtype)
+    return tensor
+
+
+def allreduce(
+    tensor,
+    axis_name: str = AXIS_GLOBAL,
+    op: int = ReduceOp.SUM,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+):
+    """Allreduce a per-participant tensor across ``axis_name``.
+
+    Low-precision inputs (bf16/fp16) are accumulated in fp32 — the TPU
+    analog of the reference's AVX fp32-accumulation fp16 path
+    (``adasum.h:426-468``) — then cast back.
+    """
+    if op == ReduceOp.ADASUM:
+        from .adasum import adasum_allreduce
+
+        return adasum_allreduce(tensor, axis_name=axis_name)
+
+    tensor = _apply_prescale(tensor, prescale_factor)
+    dtype = tensor.dtype
+    acc = tensor.astype(jnp.float32) if dtype in (jnp.bfloat16, jnp.float16) else tensor
+    if op in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        out = lax.psum(acc, axis_name)
+        if op == ReduceOp.AVERAGE:
+            n = lax.axis_size(axis_name)
+            out = out / jnp.asarray(n, dtype=out.dtype)
+    elif op == ReduceOp.MIN:
+        out = lax.pmin(acc, axis_name)
+    elif op == ReduceOp.MAX:
+        out = lax.pmax(acc, axis_name)
+    else:
+        raise ValueError(f"unknown reduce op {op}")
+    out = out.astype(dtype)
+    return _apply_postscale(out, postscale_factor)
+
+
+def grouped_allreduce(tensors, axis_name: str = AXIS_GLOBAL, op: int = ReduceOp.SUM,
+                      prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+    """Allreduce a list of tensors as one fused operation.
+
+    TPU-native tensor fusion: rather than memcpy into a fusion buffer
+    (reference ``MemcpyInFusionBuffer``, ``gpu_operations.cc:97``), we
+    concatenate flattened tensors per dtype inside the compiled program and
+    let XLA emit a single AllReduce per dtype group; the concat/split are
+    fused away or become cheap on-chip moves.
+    """
+    if not tensors:
+        return []
+    flats = [jnp.ravel(t) for t in tensors]
+    by_dtype = {}
+    for i, f in enumerate(flats):
+        by_dtype.setdefault(f.dtype, []).append(i)
+    out = [None] * len(tensors)
+    for dt, idxs in by_dtype.items():
+        fused = jnp.concatenate([flats[i] for i in idxs]) if len(idxs) > 1 else flats[idxs[0]]
+        red = allreduce(fused, axis_name=axis_name, op=op,
+                        prescale_factor=prescale_factor,
+                        postscale_factor=postscale_factor)
+        off = 0
+        for i in idxs:
+            n = flats[i].shape[0]
+            out[i] = jnp.reshape(lax.dynamic_slice_in_dim(red, off, n), tensors[i].shape)
+            off += n
+    return out
+
+
+def hierarchical_allreduce(tensor, op: int = ReduceOp.SUM):
+    """ICI-then-DCN hierarchical allreduce over the (cross, local) mesh.
+
+    TPU-native analog of ``NCCLHierarchicalAllreduce``
+    (``nccl_operations.cc:164-357``): reduce-scatter along the fast LOCAL
+    (ICI) axis, allreduce the shards along the CROSS (DCN) axis, then
+    all-gather back along LOCAL. Must run under the hierarchical mesh with
+    axes (AXIS_CROSS, AXIS_LOCAL).
+    """
+    flat = jnp.ravel(tensor)
+    local_n = lax.axis_size(AXIS_LOCAL)
+    pad = (-flat.shape[0]) % local_n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    shard = lax.psum_scatter(flat, AXIS_LOCAL, tiled=True)
+    shard = lax.psum(shard, AXIS_CROSS)
+    full = lax.all_gather(shard, AXIS_LOCAL, tiled=True)
+    if pad:
+        full = full[: flat.shape[0] - pad]
+    out = jnp.reshape(full, tensor.shape)
+    if op == ReduceOp.AVERAGE:
+        n = lax.axis_size(AXIS_LOCAL) * lax.axis_size(AXIS_CROSS)
+        out = out / jnp.asarray(n, dtype=out.dtype)
+    return out
+
+
+def allgather(tensor, axis_name: str = AXIS_GLOBAL):
+    """Concatenate per-participant tensors along dim 0 (parity:
+    ``MPIAllgather``/``NCCLAllgather`` semantics, same-shape fast path)."""
+    return lax.all_gather(tensor, axis_name, tiled=True)
+
+
+def broadcast(tensor, root_rank: int, axis_name: str = AXIS_GLOBAL):
+    """Every participant receives root's tensor.
+
+    Lowered as a masked psum, which XLA rewrites into an efficient ICI
+    broadcast; avoids host-driven root designation entirely.
+    """
+    idx = lax.axis_index(axis_name)
+    masked = jnp.where(idx == root_rank, tensor, jnp.zeros_like(tensor))
+    # Integer/bool types are summed exactly; floats too since all-but-one
+    # contribution is exactly zero.
+    if tensor.dtype == jnp.bool_:
+        return lax.psum(masked.astype(jnp.int32), axis_name).astype(jnp.bool_)
+    return lax.psum(masked, axis_name)
+
+
+def reducescatter(tensor, axis_name: str = AXIS_GLOBAL, op: int = ReduceOp.SUM):
+    """Reduce-scatter along dim 0 (capability extension; the reference gained
+    this op after v0.19 — included for completeness on TPU)."""
+    out = lax.psum_scatter(tensor, axis_name, tiled=True)
+    if op == ReduceOp.AVERAGE:
+        out = out / jnp.asarray(lax.axis_size(axis_name), dtype=out.dtype)
+    return out
+
+
+def alltoall(tensor, axis_name: str = AXIS_GLOBAL):
+    """Exchange equal splits of dim 0 between all participants."""
+    n = lax.axis_size(axis_name)
+    x = jnp.reshape(tensor, (n, -1) + tensor.shape[1:] if tensor.ndim > 1 else (n, tensor.shape[0] // n))
+    x = lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    return jnp.reshape(x, (-1,) + tensor.shape[1:])
+
+
+def barrier(axis_name: str = AXIS_GLOBAL):
+    """A minimal synchronizing collective."""
+    return lax.psum(jnp.ones((), dtype=jnp.int32), axis_name)

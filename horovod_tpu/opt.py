@@ -1,0 +1,118 @@
+"""DistributedOptimizer for the JAX-native API.
+
+Parity target: ``hvd.DistributedOptimizer`` (reference
+``torch/optimizer.py:31-195``, ``tensorflow/__init__.py:383-444``), rebuilt
+for the JAX/optax idiom: instead of hooking per-parameter gradient
+accumulators, we wrap the optax ``GradientTransformation`` so that
+``update()`` allreduces the gradient pytree across the mesh axis before the
+inner optimizer sees it. Inside ``jit``/``shard_map`` the allreduce compiles
+to a single fused XLA AllReduce per dtype over ICI — tensor fusion falls out
+of compilation rather than a background fusion buffer.
+
+``backward_passes_per_step`` (gradient accumulation before communication,
+reference ``torch/optimizer.py:46``) is supported via
+``optax.MultiSteps``-style accumulation handled by the caller or the
+``accumulate`` knob here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import optax
+
+from .common.state import AXIS_GLOBAL
+from .ops import xla as _xla
+
+
+class DistributedState(NamedTuple):
+    inner_state: Any
+    accum: Any
+    step: Any
+
+
+def DistributedOptimizer(
+    optimizer: optax.GradientTransformation,
+    op: int = _xla.ReduceOp.AVERAGE,
+    axis_name: str = AXIS_GLOBAL,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    backward_passes_per_step: int = 1,
+    compression=None,
+) -> optax.GradientTransformation:
+    """Wrap ``optimizer`` so updates are computed from mesh-reduced grads.
+
+    Must be used inside a program where ``axis_name`` is bound (shard_map /
+    pjit over ``hvd.mesh()``); single-device programs may simply not bind
+    the axis and pass ``axis_name=None`` to skip communication.
+    """
+    import jax.numpy as jnp
+
+    def reduce_grads(grads):
+        if axis_name is None:
+            return grads
+        if compression is not None:
+            grads = jax.tree_util.tree_map(compression.compress, grads)
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        reduced = _xla.grouped_allreduce(
+            leaves, axis_name=axis_name, op=op,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+        )
+        out = jax.tree_util.tree_unflatten(treedef, reduced)
+        if compression is not None:
+            out = jax.tree_util.tree_map(compression.decompress, out)
+        return out
+
+    if backward_passes_per_step <= 1:
+
+        def init_fn(params):
+            return DistributedState(optimizer.init(params), None, None)
+
+        def update_fn(grads, state, params=None, **extra):
+            grads = reduce_grads(grads)
+            updates, inner = optimizer.update(grads, state.inner_state, params,
+                                              **extra)
+            return updates, DistributedState(inner, None, None)
+
+        return optax.GradientTransformation(init_fn, update_fn)
+
+    # Gradient accumulation: communicate only every k-th step (parity:
+    # backward_passes_per_step, reference torch/optimizer.py:46,119-135).
+    k = backward_passes_per_step
+
+    def init_fn(params):
+        accum = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return DistributedState(optimizer.init(params), accum,
+                                jnp.zeros((), dtype=jnp.int32))
+
+    def update_fn(grads, state, params=None, **extra):
+        accum = jax.tree_util.tree_map(lambda a, g: a + g, state.accum, grads)
+        step = state.step + 1
+        do_comm = step >= k
+
+        def comm_branch(operand):
+            accum, inner_state = operand
+            mean = jax.tree_util.tree_map(lambda a: a / k, accum)
+            reduced = reduce_grads(mean)
+            updates, inner = optimizer.update(reduced, inner_state, params,
+                                              **extra)
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, accum)
+            return updates, inner, zeros, jnp.zeros((), dtype=jnp.int32)
+
+        def skip_branch(operand):
+            accum, inner_state = operand
+            updates = jax.tree_util.tree_map(jnp.zeros_like, accum)
+            return updates, inner_state, accum, step
+
+        updates, inner, accum, step = jax.lax.cond(
+            do_comm, comm_branch, skip_branch, (accum, state.inner_state))
+        return updates, DistributedState(inner, accum, step)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def DistributedGradientTransformation(*args, **kwargs):
+    """Alias matching JAX naming conventions."""
+    return DistributedOptimizer(*args, **kwargs)

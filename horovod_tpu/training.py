@@ -1,0 +1,112 @@
+"""Data-parallel training-step builder (the `DistributedOptimizer` path).
+
+This is the TPU-native shape of the reference's training loop contract
+(``examples/pytorch_synthetic_benchmark.py``): per-chip forward/backward,
+gradients combined across the mesh inside one compiled program. Gradient
+allreduce compiles to fused XLA AllReduces over ICI — communication overlaps
+backprop automatically, subsuming the reference's background-thread fusion
+cycle for the static-graph fast path (SURVEY §7 design stance).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .common.state import AXIS_GLOBAL
+from .opt import DistributedOptimizer
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    batch_stats: Any
+    step: Any
+
+
+def cross_entropy_loss(logits, labels):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
+    return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+
+
+def make_train_step(model, optimizer: optax.GradientTransformation,
+                    mesh, axis_name: str = AXIS_GLOBAL,
+                    reduce_op: Optional[int] = None,
+                    donate: bool = True):
+    """Build a jitted SPMD train step over ``mesh``.
+
+    Params/optimizer state are replicated; the batch is sharded along
+    ``axis_name``. Batch-norm statistics are cross-chip averaged each step
+    (the reference ships SyncBatchNorm for this, ``torch/sync_batch_norm.py``).
+    """
+    from .ops.xla import ReduceOp
+
+    op = ReduceOp.AVERAGE if reduce_op is None else reduce_op
+    dist_opt = DistributedOptimizer(optimizer, op=op, axis_name=axis_name)
+
+    def step_fn(state: TrainState, images, labels):
+        def loss_fn(p):
+            variables = {"params": p}
+            if state.batch_stats is not None:
+                variables["batch_stats"] = state.batch_stats
+                logits, updated = model.apply(
+                    variables, images, train=True, mutable=["batch_stats"])
+                return cross_entropy_loss(logits, labels), updated["batch_stats"]
+            logits = model.apply(variables, images, train=True)
+            return cross_entropy_loss(logits, labels), None
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        updates, new_opt_state = dist_opt.update(grads, state.opt_state,
+                                                 state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        if new_stats is not None:
+            new_stats = jax.tree_util.tree_map(
+                lambda x: lax.pmean(x, axis_name), new_stats)
+        loss = lax.pmean(loss, axis_name)
+        return TrainState(new_params, new_opt_state, new_stats,
+                          state.step + 1), loss
+
+    n_axes = len(mesh.axis_names)
+    replicated = P()
+    batch_spec = P(axis_name)
+
+    sharded_step = jax.shard_map(
+        step_fn, mesh=mesh,
+        in_specs=(replicated, batch_spec, batch_spec),
+        out_specs=(replicated, replicated),
+        check_vma=False,
+    )
+    donate_args = (0,) if donate else ()
+    jitted = jax.jit(sharded_step, donate_argnums=donate_args)
+    del n_axes
+    return jitted
+
+
+def init_train_state(model, optimizer, rng, sample_input) -> TrainState:
+    variables = model.init(rng, sample_input, train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats")
+    dist_opt = DistributedOptimizer(optimizer)
+    opt_state = dist_opt.init(params)
+    return TrainState(params, opt_state, batch_stats,
+                      jnp.zeros((), dtype=jnp.int32))
+
+
+def replicate_state(state: TrainState, mesh) -> TrainState:
+    sharding = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), state)
+
+
+def shard_batch(batch, mesh, axis_name: str = AXIS_GLOBAL):
+    sharding = NamedSharding(mesh, P(axis_name))
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), batch)

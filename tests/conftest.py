@@ -1,0 +1,35 @@
+"""Test configuration: 8 virtual CPU devices stand in for an 8-chip slice.
+
+The reference tests distributed correctness by running N processes under
+``mpirun`` on one machine (SURVEY §4 Pattern 1). The TPU-native analog is a
+single process with 8 virtual CPU devices: the same SPMD programs that run
+over ICI on a pod compile and execute over 8 host devices, so every
+collective, sharding, and fusion path is exercised.
+"""
+
+import os
+
+# The ambient environment may pin JAX_PLATFORMS to the real TPU plugin and
+# import jax at interpreter startup (sitecustomize), so setting env vars
+# here is too late; jax.config still works because backends initialize
+# lazily. Tests run on the virtual CPU mesh by default (override with
+# HVD_TEST_PLATFORM to run on chip).
+_platform = os.environ.get("HVD_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", _platform)
+if _platform == "cpu":
+    jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def hvd():
+    import horovod_tpu as hvd
+
+    hvd.init()
+    yield hvd
+    hvd.shutdown()

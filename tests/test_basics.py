@@ -1,0 +1,56 @@
+"""Basics API tests (parity role: reference test_torch.py init/rank/size
+sections and common/basics.py behavior)."""
+
+import pytest
+
+
+def test_init_shutdown_idempotent(hvd):
+    assert hvd.is_initialized()
+    hvd.init()  # second init is a no-op
+    assert hvd.is_initialized()
+
+
+def test_world_shape(hvd):
+    assert hvd.size() == 8
+    assert hvd.local_size() == 8
+    assert hvd.cross_size() == 1
+    assert hvd.rank() == 0
+    assert hvd.local_rank() == 0
+    assert hvd.cross_rank() == 0
+    assert hvd.is_homogeneous()
+
+
+def test_capability_predicates(hvd):
+    assert hvd.xla_built()
+    assert not hvd.mpi_built()
+    assert not hvd.gloo_built()
+    assert not hvd.nccl_built()
+    assert not hvd.ddl_built()
+    assert not hvd.ccl_built()
+    assert not hvd.mpi_threads_supported()
+
+
+def test_mesh_shape(hvd):
+    m = hvd.mesh()
+    assert m.devices.size == 8
+    assert m.axis_names == ("hvd",)
+    hm = hvd.hierarchical_mesh()
+    assert hm is not None
+    assert hm.axis_names == ("dcn", "ici")
+
+
+def test_not_initialized_raises():
+    import horovod_tpu as hvd
+    from horovod_tpu.common.exceptions import NotInitializedError
+
+    assert not hvd.is_initialized()
+    with pytest.raises(NotInitializedError):
+        hvd.size()
+    with pytest.raises(NotInitializedError):
+        hvd.rank()
+
+
+def test_reduce_op_constants(hvd):
+    assert hvd.Average == 0
+    assert hvd.Sum == 1
+    assert hvd.Adasum == 2

@@ -1,0 +1,172 @@
+"""Eager collective tests.
+
+These play the role of the reference's MPI-launched self-checking tests
+(``test_torch.py``/``test_tensorflow.py`` allreduce/allgather/broadcast
+sections, SURVEY §4 Pattern 1): each participant's tensor is seeded by its
+rank, the collective runs, and the mathematical result is asserted.
+"""
+
+import numpy as np
+import pytest
+
+
+def _per_rank(hvd, shape, dtype=np.float32):
+    return [np.full(shape, r, dtype=dtype) for r in range(hvd.size())]
+
+
+class TestAllreduce:
+    def test_sum(self, hvd):
+        xs = _per_rank(hvd, (4, 5))
+        out = hvd.allreduce(xs, op=hvd.Sum)
+        expected = sum(range(hvd.size()))
+        for o in out:
+            np.testing.assert_allclose(np.asarray(o), expected)
+
+    def test_average_default(self, hvd):
+        xs = _per_rank(hvd, (3,))
+        out = hvd.allreduce(xs)
+        expected = np.mean(np.arange(hvd.size()))
+        for o in out:
+            np.testing.assert_allclose(np.asarray(o), expected)
+
+    def test_min_max(self, hvd):
+        xs = _per_rank(hvd, (2, 2))
+        out_min = hvd.allreduce(xs, op=hvd.Min, name="armin")
+        out_max = hvd.allreduce(xs, op=hvd.Max, name="armax")
+        np.testing.assert_allclose(np.asarray(out_min[0]), 0)
+        np.testing.assert_allclose(np.asarray(out_max[0]), hvd.size() - 1)
+
+    def test_prescale_postscale(self, hvd):
+        xs = _per_rank(hvd, (4,))
+        out = hvd.allreduce(xs, op=hvd.Sum, prescale_factor=2.0,
+                            postscale_factor=0.5)
+        expected = sum(range(hvd.size()))  # 0.5 * sum(2*x)
+        np.testing.assert_allclose(np.asarray(out[0]), expected)
+
+    def test_int_dtype(self, hvd):
+        xs = _per_rank(hvd, (4,), dtype=np.int32)
+        out = hvd.allreduce(xs, op=hvd.Sum)
+        assert np.asarray(out[0]).dtype == np.int32
+        np.testing.assert_array_equal(np.asarray(out[0]),
+                                      sum(range(hvd.size())))
+
+    def test_bf16_fp32_accumulation(self, hvd):
+        import jax.numpy as jnp
+
+        xs = [jnp.full((8,), 1.0 + 2 ** -9, dtype=jnp.bfloat16)
+              for _ in range(hvd.size())]
+        out = hvd.allreduce(xs, op=hvd.Sum)
+        # fp32 accumulation: 8 * (1 + 2^-9) = 8.015625, representable in bf16
+        # only after accumulating in fp32 then rounding once.
+        assert np.asarray(out[0], dtype=np.float32)[0] == pytest.approx(
+            8 * (1.0 + 2 ** -9), rel=1e-2)
+
+    def test_stacked_array_form(self, hvd):
+        x = np.arange(hvd.size() * 3, dtype=np.float32).reshape(hvd.size(), 3)
+        out = hvd.allreduce(x, op=hvd.Sum)
+        np.testing.assert_allclose(np.asarray(out), np.tile(x.sum(0), (hvd.size(), 1)))
+
+    def test_replicated_convenience(self, hvd):
+        x = np.ones((4,), dtype=np.float32)
+        out = hvd.allreduce(x, op=hvd.Average)
+        np.testing.assert_allclose(np.asarray(out), x)
+
+    def test_async_poll_synchronize(self, hvd):
+        xs = _per_rank(hvd, (16,))
+        h = hvd.allreduce_async(xs, op=hvd.Sum, name="async1")
+        out = hvd.synchronize(h)
+        assert hvd is not None
+        np.testing.assert_allclose(np.asarray(out[0]), sum(range(hvd.size())))
+        with pytest.raises(ValueError):
+            hvd.synchronize(h)  # double synchronize
+
+    def test_duplicate_name_rejected(self, hvd):
+        from horovod_tpu.common.exceptions import DuplicateTensorNameError
+
+        xs = _per_rank(hvd, (4,))
+        h = hvd.allreduce_async(xs, name="dup")
+        with pytest.raises(DuplicateTensorNameError):
+            hvd.allreduce_async(xs, name="dup")
+        hvd.synchronize(h)
+        h2 = hvd.allreduce_async(xs, name="dup")  # reusable after completion
+        hvd.synchronize(h2)
+
+
+class TestGroupedAllreduce:
+    def test_mixed_shapes_and_dtypes(self, hvd):
+        n = hvd.size()
+        a = [np.full((3,), r, dtype=np.float32) for r in range(n)]
+        b = [np.full((2, 2), r * 2, dtype=np.float32) for r in range(n)]
+        c = [np.full((5,), r, dtype=np.int32) for r in range(n)]
+        out = hvd.grouped_allreduce([a, b, c], op=hvd.Sum)
+        s = sum(range(n))
+        np.testing.assert_allclose(np.asarray(out[0][0]), s)
+        np.testing.assert_allclose(np.asarray(out[1][0]), 2 * s)
+        np.testing.assert_array_equal(np.asarray(out[2][0]), s)
+        assert np.asarray(out[2][0]).dtype == np.int32
+
+
+class TestAllgather:
+    def test_equal_shapes(self, hvd):
+        n = hvd.size()
+        xs = [np.full((2, 3), r, dtype=np.float32) for r in range(n)]
+        out = np.asarray(hvd.allgather(xs))
+        assert out.shape == (2 * n, 3)
+        for r in range(n):
+            np.testing.assert_allclose(out[2 * r: 2 * r + 2], r)
+
+
+class TestBroadcast:
+    @pytest.mark.parametrize("root", [0, 3, 7])
+    def test_roots(self, hvd, root):
+        xs = _per_rank(hvd, (4,))
+        out = hvd.broadcast(xs, root_rank=root)
+        for o in out:
+            np.testing.assert_allclose(np.asarray(o), root)
+
+    def test_int(self, hvd):
+        xs = [np.full((3,), r, dtype=np.int64) for r in range(hvd.size())]
+        out = hvd.broadcast(xs, root_rank=5)
+        np.testing.assert_array_equal(np.asarray(out[0]), 5)
+
+
+class TestReduceScatter:
+    def test_sum(self, hvd):
+        n = hvd.size()
+        xs = [np.full((n * 2, 3), r, dtype=np.float32) for r in range(n)]
+        out = hvd.reducescatter(xs, op=hvd.Sum)
+        s = sum(range(n))
+        assert np.asarray(out[0]).shape == (2, 3)
+        for o in out:
+            np.testing.assert_allclose(np.asarray(o), s)
+
+
+class TestAlltoall:
+    def test_exchange(self, hvd):
+        n = hvd.size()
+        xs = [np.arange(n, dtype=np.float32) + 100 * r for r in range(n)]
+        out = hvd.alltoall(xs)
+        # participant p receives element p from every rank
+        for p, o in enumerate(out):
+            np.testing.assert_allclose(
+                np.asarray(o), np.array([100 * r + p for r in range(n)]))
+
+
+class TestBarrierJoin:
+    def test_barrier(self, hvd):
+        hvd.barrier()
+
+    def test_join(self, hvd):
+        assert hvd.join() == hvd.size() - 1
+
+
+class TestBroadcastHelpers:
+    def test_broadcast_parameters_pytree(self, hvd):
+        params = {"w": np.ones((2, 2), np.float32),
+                  "b": {"x": np.zeros((3,), np.float32)}}
+        out = hvd.broadcast_parameters(params, root_rank=0)
+        assert set(out.keys()) == {"w", "b"}
+
+    def test_broadcast_object_single_process(self, hvd):
+        obj = {"epoch": 3, "lr": 0.1}
+        assert hvd.broadcast_object(obj, root_rank=0) == obj

@@ -1,0 +1,103 @@
+"""Ring attention: context parallelism for long sequences over ICI.
+
+First-class long-context support (absent from the reference, SURVEY §5
+"Long-context / sequence parallelism"): the sequence is sharded across the
+``sp`` mesh axis; each chip holds its Q block while K/V blocks rotate around
+the ring via ``lax.ppermute``, with online-softmax (flash-style) accumulation
+so the full attention matrix never materializes. Communication of the next
+K/V block overlaps with compute of the current one under XLA's async
+collective-permute scheduling on ICI.
+
+Numerics: log-sum-exp streaming accumulation in float32 regardless of input
+dtype — the same max-shifted accumulation flash attention uses.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, m, l, o, mask):
+    """One flash-attention block update.
+
+    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; m/l: [B, H, Tq]; o: [B, Tq, H, D]
+    mask: [Tq, Tk] additive (0 or NEG_INF), or None.
+    """
+    scale = 1.0 / jnp.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if mask is not None:
+        s = s + mask[None, None, :, :]
+    m_blk = jnp.max(s, axis=-1)  # [B,H,Tq]
+    m_new = jnp.maximum(m, m_blk)
+    # Guard fully-masked blocks: exp(NEG_INF - NEG_INF) must not be 1.
+    alive = m_new > NEG_INF / 2
+    corr = jnp.where(alive, jnp.exp(m - m_new), 1.0)
+    # Masked entries have s == NEG_INF; when a whole tile is masked
+    # m_new == NEG_INF too and exp(s - m_new) would be exp(0) = 1, so zero
+    # them explicitly instead of relying on underflow.
+    p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m_new[..., None]))
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True):
+    """Context-parallel attention. q/k/v: [B, T_local, H, D] per chip.
+
+    With axis size 1 this degenerates to plain (flash-accumulated)
+    attention, so the same model code runs on any mesh.
+    """
+    sp = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    m = jnp.full((B, H, Tq), NEG_INF, dtype=jnp.float32)
+    l = jnp.zeros((B, H, Tq), dtype=jnp.float32)
+    o = jnp.zeros((B, Tq, H, D), dtype=jnp.float32)
+
+    fwd_perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def tile_mask(q_blk, k_blk, Tk):
+        """Additive causal mask between sequence blocks q_blk and k_blk."""
+        if not causal:
+            return None
+        # token positions: q: q_blk*Tq + iq ; k: k_blk*Tk + ik
+        iq = jnp.arange(Tq)[:, None] + q_blk * Tq
+        ik = jnp.arange(Tk)[None, :] + k_blk * Tk
+        return jnp.where(iq >= ik, 0.0, NEG_INF)
+
+    def body(carry, step):
+        m, l, o, k_cur, v_cur = carry
+        # k_cur originated at rank (my - step) mod sp
+        k_blk = (my - step) % sp
+        mask = tile_mask(my, k_blk, k_cur.shape[1])
+        m, l, o = _block_attend(q, k_cur, v_cur, m, l, o, mask)
+        k_nxt = lax.ppermute(k_cur, axis_name, fwd_perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, fwd_perm)
+        return (m, l, o, k_nxt, v_nxt), None
+
+    (m, l, o, _, _), _ = lax.scan(
+        body, (m, l, o, k, v), jnp.arange(sp))
+    o = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return o.astype(q.dtype)
+
+
+def local_flash_attention(q, k, v, causal: bool = True):
+    """Single-device flash-accumulated attention (reference oracle for
+    tests and the sp=1 fast path)."""
+    B, T, H, D = q.shape
+    m = jnp.full((B, H, T), NEG_INF, dtype=jnp.float32)
+    l = jnp.zeros((B, H, T), dtype=jnp.float32)
+    o = jnp.zeros((B, T, H, D), dtype=jnp.float32)
+    mask = None
+    if causal:
+        iq = jnp.arange(T)[:, None]
+        ik = jnp.arange(T)[None, :]
+        mask = jnp.where(iq >= ik, 0.0, NEG_INF)
+    m, l, o = _block_attend(q, k, v, m, l, o, mask)
+    o = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return o.astype(q.dtype)

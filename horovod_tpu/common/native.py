@@ -1,0 +1,247 @@
+"""ctypes binding to the native core runtime (libhvdtpu.so).
+
+Plays the role of the reference's ``HorovodBasics`` ctypes layer
+(``common/basics.py:22-211``): loads the shared library, exposes the C API,
+and bridges the XLA-plane execution callback. The native library owns the
+background cycle thread, tensor queue, controller negotiation, fusion
+planning, response cache, and stall inspection (``csrc/hvd/*``); Python owns
+only XLA program execution.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from . import logging as _log
+
+_LIB_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "lib")
+_LIB_PATH = os.path.join(_LIB_DIR, "libhvdtpu.so")
+_CSRC_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "csrc")
+
+# dtype codes must match csrc/hvd/common.h DataType
+DTYPE_CODES = {
+    "uint8": 0,
+    "int8": 1,
+    "int32": 4,
+    "int64": 5,
+    "float16": 6,
+    "float32": 7,
+    "float64": 8,
+    "bool": 9,
+    "bfloat16": 10,
+}
+
+OP_ALLREDUCE = 0
+OP_ALLGATHER = 1
+OP_BROADCAST = 2
+OP_JOIN = 3
+OP_REDUCESCATTER = 4
+OP_ALLTOALL = 5
+OP_BARRIER = 6
+
+PLANE_XLA = 0
+PLANE_HOST = 1
+
+_EXEC_CB_TYPE = ctypes.CFUNCTYPE(None, ctypes.POINTER(ctypes.c_char),
+                                 ctypes.c_int, ctypes.c_long)
+
+
+def _build_library() -> bool:
+    try:
+        subprocess.run(["make", "-C", _CSRC_DIR], check=True,
+                       capture_output=True, timeout=300)
+        return os.path.exists(_LIB_PATH)
+    except Exception as e:  # compiler missing etc.
+        _log.warning(f"native runtime build failed: {e}")
+        return False
+
+
+_lib = None
+_keepalive_cb = None  # prevent GC of the registered CFUNCTYPE
+
+
+def load_library():
+    """Load (building if necessary) the native library; None on failure."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if os.environ.get("HOROVOD_NATIVE", "1") in ("0", "false"):
+        return None
+    if not os.path.exists(_LIB_PATH) and not _build_library():
+        return None
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.hvd_init.restype = ctypes.c_int
+    lib.hvd_init.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_double, ctypes.c_longlong, ctypes.c_int,
+        ctypes.c_double, ctypes.c_double, ctypes.c_int,
+    ]
+    lib.hvd_shutdown.restype = None
+    lib.hvd_enqueue.restype = ctypes.c_longlong
+    lib.hvd_enqueue.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_double, ctypes.c_double,
+        ctypes.c_int,
+    ]
+    lib.hvd_test.restype = ctypes.c_int
+    lib.hvd_test.argtypes = [ctypes.c_longlong, ctypes.c_char_p,
+                             ctypes.c_int]
+    lib.hvd_wait.restype = ctypes.c_int
+    lib.hvd_wait.argtypes = [ctypes.c_longlong, ctypes.c_char_p,
+                             ctypes.c_int]
+    lib.hvd_response_done.restype = None
+    lib.hvd_response_done.argtypes = [ctypes.c_long, ctypes.c_int,
+                                      ctypes.c_char_p]
+    lib.hvd_register_exec_callback.restype = None
+    lib.hvd_register_exec_callback.argtypes = [_EXEC_CB_TYPE]
+    lib.hvd_pending_count.restype = ctypes.c_int
+    _lib = lib
+    return _lib
+
+
+# ---- response wire parsing (mirror of csrc/hvd/message.cc) -----------------
+
+
+@dataclass
+class NativeResponse:
+    op: int
+    reduce_op: int
+    dtype: int
+    plane: int
+    root_rank: int
+    error: str
+    prescale: float
+    postscale: float
+    names: List[str] = field(default_factory=list)
+    shapes: List[Tuple[int, ...]] = field(default_factory=list)
+
+
+class _Cursor:
+    def __init__(self, data: bytes):
+        self.d = data
+        self.o = 0
+
+    def u8(self):
+        v = self.d[self.o]
+        self.o += 1
+        return v
+
+    def i32(self):
+        v = struct.unpack_from("<i", self.d, self.o)[0]
+        self.o += 4
+        return v
+
+    def i64(self):
+        v = struct.unpack_from("<q", self.d, self.o)[0]
+        self.o += 8
+        return v
+
+    def f64(self):
+        v = struct.unpack_from("<d", self.d, self.o)[0]
+        self.o += 8
+        return v
+
+    def s(self):
+        n = self.i32()
+        v = self.d[self.o: self.o + n].decode()
+        self.o += n
+        return v
+
+
+def parse_response_list(data: bytes) -> List[NativeResponse]:
+    c = _Cursor(data)
+    assert c.u8() == 0xA2, "bad response magic"
+    out = []
+    for _ in range(c.i32()):
+        r = NativeResponse(op=c.u8(), reduce_op=c.u8(), dtype=c.u8(),
+                           plane=c.u8(), root_rank=c.i32(), error=c.s(),
+                           prescale=c.f64(), postscale=c.f64())
+        for _ in range(c.i32()):
+            r.names.append(c.s())
+            ndim = c.i32()
+            r.shapes.append(tuple(c.i64() for _ in range(ndim)))
+        out.append(r)
+    return out
+
+
+# ---- high-level wrapper ----------------------------------------------------
+
+
+class NativeCore:
+    """One per process. Wraps init/shutdown/enqueue/wait + exec callback."""
+
+    def __init__(self):
+        self.lib = load_library()
+        self.available = self.lib is not None
+        self._executor = None
+
+    def init(self, rank: int, size: int, local_rank: int, local_size: int,
+             cross_rank: int, cross_size: int, coordinator_addr: str,
+             coordinator_port: int, my_host: str, cycle_time_ms: float,
+             fusion_threshold: int, cache_capacity: int,
+             stall_warning_sec: float, stall_shutdown_sec: float,
+             stall_check_enabled: bool, exec_callback) -> bool:
+        """exec_callback(responses: List[NativeResponse], response_id: int)
+        is invoked from the native background thread; it must be quick
+        (push to an executor queue)."""
+        if not self.available:
+            return False
+        global _keepalive_cb
+
+        def _cb(data_ptr, length, response_id):
+            try:
+                raw = ctypes.string_at(data_ptr, length)
+                exec_callback(parse_response_list(raw), response_id)
+            except Exception as e:  # never let exceptions cross into C++
+                _log.error(f"exec callback error: {e}")
+                self.response_done(response_id, False, str(e))
+
+        _keepalive_cb = _EXEC_CB_TYPE(_cb)
+        self.lib.hvd_register_exec_callback(_keepalive_cb)
+        rc = self.lib.hvd_init(
+            rank, size, local_rank, local_size, cross_rank, cross_size,
+            coordinator_addr.encode(), coordinator_port, my_host.encode(),
+            cycle_time_ms, fusion_threshold, cache_capacity,
+            stall_warning_sec, stall_shutdown_sec,
+            1 if stall_check_enabled else 0)
+        return rc == 0
+
+    def shutdown(self):
+        if self.available:
+            self.lib.hvd_shutdown()
+
+    def enqueue(self, name: str, op: int, reduce_op: int, dtype_code: int,
+                shape: Tuple[int, ...], data_ptr: Optional[int] = None,
+                output_ptr: Optional[int] = None, root_rank: int = -1,
+                prescale: float = 1.0, postscale: float = 1.0,
+                plane: int = PLANE_XLA) -> int:
+        arr = (ctypes.c_longlong * len(shape))(*shape)
+        h = self.lib.hvd_enqueue(
+            name.encode(), op, reduce_op, dtype_code, arr, len(shape),
+            data_ptr or None, output_ptr or None, root_rank, prescale,
+            postscale, plane)
+        return int(h)
+
+    def test(self, handle: int) -> Tuple[int, str]:
+        buf = ctypes.create_string_buffer(1024)
+        r = self.lib.hvd_test(handle, buf, 1024)
+        return r, buf.value.decode(errors="replace")
+
+    def wait(self, handle: int) -> Tuple[int, str]:
+        buf = ctypes.create_string_buffer(1024)
+        r = self.lib.hvd_wait(handle, buf, 1024)
+        return r, buf.value.decode(errors="replace")
+
+    def response_done(self, response_id: int, ok: bool, error: str = ""):
+        self.lib.hvd_response_done(response_id, 1 if ok else 0,
+                                   error.encode())
+
+    def pending_count(self) -> int:
+        return int(self.lib.hvd_pending_count())

@@ -1,0 +1,410 @@
+#include "controller.h"
+
+#include <algorithm>
+#include <cstdio>
+
+#include "message.h"
+
+namespace hvd {
+
+// ---- shared machinery ------------------------------------------------------
+
+bool Controller::ValidateGroup(const std::string& name,
+                               const std::vector<Request>& group,
+                               int world_size, Response* out) {
+  // Mirrors the reference's ConstructResponse error checking
+  // (controller.cc:378-611): op, dtype, root rank, and (for allreduce)
+  // shape must agree across ranks; allgather shapes may differ only in
+  // dim 0.
+  const Request& first = group.front();
+  std::string error;
+  for (size_t i = 1; i < group.size(); ++i) {
+    const Request& r = group[i];
+    if (r.op != first.op) {
+      error = "Mismatched collective operations submitted for tensor '" +
+              name + "'";
+      break;
+    }
+    if (r.dtype != first.dtype) {
+      error = "Mismatched data types submitted for tensor '" + name + "': " +
+              std::string(DataTypeName(first.dtype)) + " vs " +
+              DataTypeName(r.dtype);
+      break;
+    }
+    if ((first.op == CollectiveOp::BROADCAST ||
+         first.op == CollectiveOp::ALLREDUCE) &&
+        r.shape != first.shape) {
+      error = "Mismatched shapes submitted for tensor '" + name + "': " +
+              first.shape.DebugString() + " vs " + r.shape.DebugString();
+      break;
+    }
+    if (first.op == CollectiveOp::ALLGATHER ||
+        first.op == CollectiveOp::ALLTOALL) {
+      if (r.shape.ndim() != first.shape.ndim()) {
+        error = "Mismatched ranks submitted for gather tensor '" + name + "'";
+        break;
+      }
+      for (int d = 1; d < r.shape.ndim(); ++d) {
+        if (r.shape.dim(d) != first.shape.dim(d)) {
+          error = "Mismatched non-first dimensions for tensor '" + name + "'";
+          break;
+        }
+      }
+      // The host ring executor requires equal element counts per rank;
+      // ragged first dimensions would silently mis-index its output, so
+      // reject them loudly (XLA-plane allgatherv support is the same
+      // restriction lax.all_gather has today).
+      if (error.empty() && first.plane == DevicePlane::HOST &&
+          r.shape.ndim() > 0 && r.shape.dim(0) != first.shape.dim(0)) {
+        error = "Host-plane allgather requires equal first dimensions for "
+                "tensor '" + name + "' (got " + first.shape.DebugString() +
+                " vs " + r.shape.DebugString() + ")";
+      }
+      if (!error.empty()) break;
+    }
+    if (first.op == CollectiveOp::BROADCAST &&
+        r.root_rank != first.root_rank) {
+      error = "Mismatched root ranks for broadcast tensor '" + name + "': " +
+              std::to_string(first.root_rank) + " vs " +
+              std::to_string(r.root_rank);
+      break;
+    }
+    if (r.reduce_op != first.reduce_op) {
+      error = "Mismatched reduce ops for tensor '" + name + "'";
+      break;
+    }
+  }
+
+  out->op = first.op;
+  out->reduce_op = first.reduce_op;
+  out->dtype = first.dtype;
+  out->plane = first.plane;
+  out->root_rank = first.root_rank;
+  out->prescale = first.prescale;
+  out->postscale = first.postscale;
+  out->tensor_names = {name};
+  out->shapes = {first.shape};
+  if (!error.empty()) {
+    out->error_reason = error;
+    out->op = CollectiveOp::ERROR_OP;
+    return false;
+  }
+  (void)world_size;
+  return true;
+}
+
+std::vector<Response> Controller::FuseResponses(std::vector<Response> singles,
+                                                int64_t threshold_bytes) {
+  // Bin compatible single-tensor responses (reference FuseResponses,
+  // controller.cc:640-761): same op/dtype/plane/reduce-op/root and scale
+  // factors, cumulative payload under the threshold. Allgather responses
+  // fuse too (the XLA executor concatenates flats per tensor itself).
+  std::vector<Response> fused;
+  for (auto& r : singles) {
+    if (r.op == CollectiveOp::ERROR_OP || r.op == CollectiveOp::BARRIER ||
+        r.op == CollectiveOp::JOIN) {
+      fused.push_back(std::move(r));
+      continue;
+    }
+    bool merged = false;
+    for (auto& f : fused) {
+      if (f.op == r.op && f.dtype == r.dtype && f.plane == r.plane &&
+          f.reduce_op == r.reduce_op && f.root_rank == r.root_rank &&
+          f.prescale == r.prescale && f.postscale == r.postscale &&
+          f.error_reason.empty() &&
+          f.total_bytes() + r.total_bytes() <= threshold_bytes) {
+        f.tensor_names.push_back(std::move(r.tensor_names[0]));
+        f.shapes.push_back(std::move(r.shapes[0]));
+        merged = true;
+        break;
+      }
+    }
+    if (!merged) fused.push_back(std::move(r));
+  }
+  return fused;
+}
+
+// ---- LocalController -------------------------------------------------------
+
+std::vector<Response> LocalController::ComputeResponseList(
+    std::vector<Request> reqs, bool this_rank_shutdown,
+    bool* world_shutdown) {
+  *world_shutdown = this_rank_shutdown;
+  std::vector<Response> singles;
+  singles.reserve(reqs.size());
+  for (auto& q : reqs) {
+    Response r;
+    std::vector<Request> group = {q};
+    ValidateGroup(q.name, group, 1, &r);
+    singles.push_back(std::move(r));
+  }
+  return FuseResponses(std::move(singles), cfg_.fusion_threshold_bytes);
+}
+
+// ---- TcpController ---------------------------------------------------------
+
+Status TcpController::Initialize() {
+  shutdown_ranks_.assign(cfg_.size, false);
+  stall_.Configure(cfg_.stall_warning_sec, cfg_.stall_shutdown_sec,
+                   cfg_.size, cfg_.stall_check_enabled);
+  if (cfg_.rank == 0) {
+    if (!listener_.Listen(cfg_.coordinator_port)) {
+      return Status::Error(StatusType::UNKNOWN_ERROR,
+                           "coordinator failed to listen on port " +
+                               std::to_string(cfg_.coordinator_port));
+    }
+    worker_socks_.resize(cfg_.size - 1);
+    data_endpoints_.assign(cfg_.size, {"", 0});
+    data_endpoints_[0] = {my_host_, data_port_};
+    // Accept size-1 hellos: "rank host data_port".
+    for (int i = 0; i < cfg_.size - 1; ++i) {
+      Socket s = listener_.Accept(120000);
+      if (!s.valid()) {
+        return Status::Error(StatusType::UNKNOWN_ERROR,
+                             "timed out waiting for workers to connect");
+      }
+      std::string hello;
+      if (!s.RecvFrame(&hello)) {
+        return Status::Error(StatusType::UNKNOWN_ERROR, "bad worker hello");
+      }
+      int rank = 0, port = 0;
+      char host[256] = {0};
+      if (std::sscanf(hello.c_str(), "%d %255s %d", &rank, host, &port) != 3 ||
+          rank <= 0 || rank >= cfg_.size) {
+        return Status::Error(StatusType::UNKNOWN_ERROR,
+                             "malformed worker hello: " + hello);
+      }
+      data_endpoints_[rank] = {host, port};
+      worker_socks_[rank - 1] = std::move(s);
+    }
+    // Broadcast the endpoint map.
+    Writer w;
+    w.i32(cfg_.size);
+    for (auto& ep : data_endpoints_) {
+      w.str(ep.first);
+      w.i32(ep.second);
+    }
+    for (auto& s : worker_socks_) {
+      if (!s.SendFrame(w.data())) {
+        return Status::Error(StatusType::UNKNOWN_ERROR,
+                             "failed to send endpoint map");
+      }
+    }
+  } else {
+    coord_sock_ = Socket::Connect(cfg_.coordinator_addr,
+                                  cfg_.coordinator_port, 120000);
+    if (!coord_sock_.valid()) {
+      return Status::Error(StatusType::UNKNOWN_ERROR,
+                           "worker failed to reach coordinator at " +
+                               cfg_.coordinator_addr + ":" +
+                               std::to_string(cfg_.coordinator_port));
+    }
+    std::string hello = std::to_string(cfg_.rank) + " " + my_host_ + " " +
+                        std::to_string(data_port_);
+    if (!coord_sock_.SendFrame(hello)) {
+      return Status::Error(StatusType::UNKNOWN_ERROR, "hello send failed");
+    }
+    std::string map_bytes;
+    if (!coord_sock_.RecvFrame(&map_bytes)) {
+      return Status::Error(StatusType::UNKNOWN_ERROR,
+                           "endpoint map receive failed");
+    }
+    Reader r(map_bytes);
+    int n = r.i32();
+    if (n != cfg_.size) {
+      return Status::Error(StatusType::UNKNOWN_ERROR, "endpoint map mismatch");
+    }
+    data_endpoints_.clear();
+    for (int i = 0; i < n; ++i) {
+      std::string host = r.str();
+      int port = r.i32();
+      data_endpoints_.emplace_back(host, port);
+    }
+  }
+  return Status::OK();
+}
+
+void TcpController::CacheResponses(const std::vector<Response>& resps) {
+  // Both coordinator and workers insert per-tensor requests into their
+  // caches in broadcast order, so cache ids agree on every rank without a
+  // separate synchronization round (the role of the reference's bitvector
+  // AND/OR, controller.cc:613-638).
+  for (const auto& p : resps) {
+    if (!p.error_reason.empty() || p.op == CollectiveOp::BARRIER ||
+        p.op == CollectiveOp::JOIN) {
+      continue;
+    }
+    for (size_t i = 0; i < p.tensor_names.size(); ++i) {
+      Request q;
+      q.op = p.op;
+      q.reduce_op = p.reduce_op;
+      q.dtype = p.dtype;
+      q.plane = p.plane;
+      q.root_rank = p.root_rank;
+      q.name = p.tensor_names[i];
+      q.shape = p.shapes[i];
+      q.prescale = p.prescale;
+      q.postscale = p.postscale;
+      cache_.Put(q);
+    }
+  }
+}
+
+std::vector<Response> TcpController::ComputeResponseList(
+    std::vector<Request> reqs, bool this_rank_shutdown,
+    bool* world_shutdown) {
+  return cfg_.rank == 0
+             ? CoordinatorCycle(std::move(reqs), this_rank_shutdown,
+                                world_shutdown)
+             : WorkerCycle(std::move(reqs), this_rank_shutdown,
+                           world_shutdown);
+}
+
+std::vector<Response> TcpController::WorkerCycle(std::vector<Request> reqs,
+                                                 bool my_shutdown,
+                                                 bool* world_shutdown) {
+  *world_shutdown = false;
+  // Split cache hits from novel requests.
+  std::vector<Request> novel;
+  std::vector<uint32_t> hits;
+  for (auto& q : reqs) {
+    uint32_t id = cache_.Lookup(q);
+    if (id != ResponseCache::kInvalid) {
+      hits.push_back(id);
+    } else {
+      novel.push_back(std::move(q));
+    }
+  }
+  if (!coord_sock_.SendFrame(SerializeRequestList(novel, hits, my_shutdown))) {
+    *world_shutdown = true;
+    return {};
+  }
+  std::string bytes;
+  if (!coord_sock_.RecvFrame(&bytes)) {
+    *world_shutdown = true;
+    return {};
+  }
+  if (bytes == "SHUTDOWN") {
+    *world_shutdown = true;
+    return {};
+  }
+  std::vector<Response> resps;
+  if (!DeserializeResponseList(bytes, &resps)) {
+    *world_shutdown = true;
+    return {};
+  }
+  CacheResponses(resps);
+  return resps;
+}
+
+std::vector<Response> TcpController::CoordinatorCycle(
+    std::vector<Request> my_reqs, bool my_shutdown, bool* world_shutdown) {
+  *world_shutdown = false;
+  shutdown_ranks_[0] = shutdown_ranks_[0] || my_shutdown;
+
+  auto ingest = [this](std::vector<Request>&& rs,
+                       std::vector<uint32_t>&& ids, int default_rank) {
+    for (auto& q : rs) {
+      if (q.rank < 0 || q.rank >= cfg_.size) q.rank = default_rank;
+      stall_.RecordRank(q.name, q.rank);
+      auto& group = pending_[q.name];
+      group.push_back(q);
+      pending_count_[q.name] = static_cast<int>(group.size());
+    }
+    for (auto id : ids) {
+      Request q;
+      if (cache_.Get(id, &q)) {
+        q.rank = default_rank;
+        stall_.RecordRank(q.name, q.rank);
+        auto& group = pending_[q.name];
+        group.push_back(q);
+        pending_count_[q.name] = static_cast<int>(group.size());
+      }
+    }
+  };
+
+  ingest(std::move(my_reqs), {}, 0);
+
+  // Gather one frame from every live worker.
+  for (int r = 1; r < cfg_.size; ++r) {
+    if (shutdown_ranks_[r]) continue;
+    std::string bytes;
+    if (!worker_socks_[r - 1].RecvFrame(&bytes)) {
+      shutdown_ranks_[r] = true;  // treat a dead socket as departed
+      continue;
+    }
+    std::vector<Request> rs;
+    std::vector<uint32_t> ids;
+    bool sd = false;
+    if (DeserializeRequestList(bytes, &rs, &ids, &sd)) {
+      if (sd) shutdown_ranks_[r] = true;
+      ingest(std::move(rs), std::move(ids), r);
+    }
+  }
+
+  // Ready = submitted by all non-departed ranks.
+  int live = 0;
+  for (int r = 0; r < cfg_.size; ++r) {
+    if (!shutdown_ranks_[r]) ++live;
+  }
+  std::vector<Response> singles;
+  std::vector<std::string> done;
+  for (auto& kv : pending_) {
+    if (static_cast<int>(kv.second.size()) >= live && live > 0) {
+      Response resp;
+      ValidateGroup(kv.first, kv.second, cfg_.size, &resp);
+      singles.push_back(std::move(resp));
+      done.push_back(kv.first);
+    }
+  }
+  // Deterministic order: by name (requests may arrive in any interleaving).
+  std::sort(singles.begin(), singles.end(),
+            [](const Response& a, const Response& b) {
+              return a.tensor_names[0] < b.tensor_names[0];
+            });
+  for (auto& n : done) {
+    pending_.erase(n);
+    pending_count_.erase(n);
+    stall_.Remove(n);
+  }
+
+  bool stall_shutdown = false;
+  std::string report = stall_.Check(&stall_shutdown);
+  if (!report.empty()) {
+    stall_report_ += report;
+    std::fprintf(stderr, "[horovod_tpu coordinator] %s", report.c_str());
+  }
+
+  auto fused = FuseResponses(std::move(singles), cfg_.fusion_threshold_bytes);
+  CacheResponses(fused);
+
+  bool all_down = true;
+  for (int r = 0; r < cfg_.size; ++r) {
+    all_down = all_down && shutdown_ranks_[r];
+  }
+  if (all_down || stall_shutdown) {
+    for (int r = 1; r < cfg_.size; ++r) {
+      if (worker_socks_[r - 1].valid()) {
+        worker_socks_[r - 1].SendFrame("SHUTDOWN");
+      }
+    }
+    *world_shutdown = true;
+    return {};
+  }
+
+  std::string bytes = SerializeResponseList(fused);
+  for (int r = 1; r < cfg_.size; ++r) {
+    if (!shutdown_ranks_[r] && worker_socks_[r - 1].valid()) {
+      worker_socks_[r - 1].SendFrame(bytes);
+    }
+  }
+  return fused;
+}
+
+void TcpController::Finalize() {
+  for (auto& s : worker_socks_) s.Close();
+  coord_sock_.Close();
+  listener_.Close();
+}
+
+}  // namespace hvd

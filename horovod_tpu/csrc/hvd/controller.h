@@ -1,0 +1,132 @@
+// Coordination protocol: decide, each cycle, which pending tensors are
+// globally ready, validate cross-rank consistency, and fuse them into
+// batched responses.
+//
+// Parity: reference controller.{h,cc} (ComputeResponseList controller.cc:62,
+// ConstructResponse :378, FuseResponses :640, IncrementTensorCount :789),
+// re-grounded for TPU (SURVEY §7): in the common single-controller SPMD case
+// one process drives a whole slice, so readiness is local and the protocol
+// collapses to LocalController (no network). The TCP star controller covers
+// the multi-host case — the role MPI_Gather/Bcast plays in the reference —
+// with a response cache shrinking repeat requests to 4-byte ids.
+
+#ifndef HVD_CONTROLLER_H_
+#define HVD_CONTROLLER_H_
+
+#include <map>
+#include <memory>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "response_cache.h"
+#include "socket.h"
+#include "stall_inspector.h"
+
+namespace hvd {
+
+struct ControllerConfig {
+  int rank = 0;
+  int size = 1;
+  std::string coordinator_addr = "127.0.0.1";
+  int coordinator_port = 0;
+  int64_t fusion_threshold_bytes = 64 * 1024 * 1024;
+  size_t cache_capacity = 1024;
+  double stall_warning_sec = 60.0;
+  double stall_shutdown_sec = 0.0;
+  bool stall_check_enabled = true;
+};
+
+class Controller {
+ public:
+  explicit Controller(ControllerConfig cfg) : cfg_(std::move(cfg)) {}
+  virtual ~Controller() = default;
+
+  virtual Status Initialize() = 0;
+  // One negotiation cycle. `this_rank_shutdown` signals this rank wants out;
+  // returns responses to execute now; sets *world_shutdown once every rank
+  // has requested shutdown.
+  virtual std::vector<Response> ComputeResponseList(
+      std::vector<Request> local_requests, bool this_rank_shutdown,
+      bool* world_shutdown) = 0;
+  virtual void Finalize() {}
+
+  // Host data-plane endpoints (rank -> host:port), filled by Initialize for
+  // multi-process controllers.
+  const std::vector<std::pair<std::string, int>>& data_endpoints() const {
+    return data_endpoints_;
+  }
+  const ControllerConfig& config() const { return cfg_; }
+  std::string TakeStallReport() {
+    std::string r = std::move(stall_report_);
+    stall_report_.clear();
+    return r;
+  }
+
+ protected:
+  // Shared machinery (used by both concrete controllers).
+  // Validates that all ranks' requests for one tensor agree on
+  // op/dtype/shape/root; returns an error Response if not.
+  static bool ValidateGroup(const std::string& name,
+                            const std::vector<Request>& group, int world_size,
+                            Response* out);
+  // Bin single-tensor responses into fused responses under the threshold.
+  static std::vector<Response> FuseResponses(std::vector<Response> singles,
+                                             int64_t threshold_bytes);
+
+  ControllerConfig cfg_;
+  std::vector<std::pair<std::string, int>> data_endpoints_;
+  std::string stall_report_;
+};
+
+// Single-process controller: the driving process sees every enqueue, so
+// every request is globally ready the moment it is queued.
+class LocalController : public Controller {
+ public:
+  using Controller::Controller;
+  Status Initialize() override { return Status::OK(); }
+  std::vector<Response> ComputeResponseList(std::vector<Request> reqs,
+                                            bool this_rank_shutdown,
+                                            bool* world_shutdown) override;
+};
+
+// TCP star controller: rank 0 plays coordinator (the reference's rank-0
+// coordinator role, controller.cc:62-356), workers gather requests and
+// receive broadcast responses each cycle over persistent sockets.
+class TcpController : public Controller {
+ public:
+  TcpController(ControllerConfig cfg, int data_port, std::string my_host)
+      : Controller(std::move(cfg)), data_port_(data_port),
+        my_host_(std::move(my_host)) {}
+  Status Initialize() override;
+  std::vector<Response> ComputeResponseList(std::vector<Request> reqs,
+                                            bool this_rank_shutdown,
+                                            bool* world_shutdown) override;
+  void Finalize() override;
+
+ private:
+  std::vector<Response> CoordinatorCycle(std::vector<Request> my_reqs,
+                                         bool my_shutdown,
+                                         bool* world_shutdown);
+  std::vector<Response> WorkerCycle(std::vector<Request> my_reqs,
+                                    bool my_shutdown, bool* world_shutdown);
+  void CacheResponses(const std::vector<Response>& resps);
+
+  int data_port_ = 0;
+  std::string my_host_;
+  Listener listener_;                 // coordinator only
+  std::vector<Socket> worker_socks_;  // coordinator: index = rank-1
+  Socket coord_sock_;                 // workers
+
+  // Coordinator negotiation state: name -> per-rank requests seen so far.
+  std::unordered_map<std::string, std::vector<Request>> pending_;
+  std::unordered_map<std::string, int> pending_count_;
+  std::vector<bool> shutdown_ranks_;
+  StallInspector stall_;
+  ResponseCache cache_;  // symmetric ids on all ranks (see CacheResponses)
+};
+
+}  // namespace hvd
+
+#endif  // HVD_CONTROLLER_H_

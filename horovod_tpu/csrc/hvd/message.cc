@@ -1,0 +1,171 @@
+#include "message.h"
+
+#include <cstring>
+
+namespace hvd {
+
+namespace {
+constexpr uint8_t kRequestMagic = 0xA1;
+constexpr uint8_t kResponseMagic = 0xA2;
+}  // namespace
+
+void Reader::memcpy_(void* dst, size_t n) {
+  if (p_ + n > end_) { ok_ = false; std::memset(dst, 0, n); return; }
+  std::memcpy(dst, p_, n);
+  p_ += n;
+}
+
+const char* DataTypeName(DataType t) {
+  switch (t) {
+    case DataType::HVD_UINT8: return "uint8";
+    case DataType::HVD_INT8: return "int8";
+    case DataType::HVD_INT32: return "int32";
+    case DataType::HVD_INT64: return "int64";
+    case DataType::HVD_FLOAT16: return "float16";
+    case DataType::HVD_FLOAT32: return "float32";
+    case DataType::HVD_FLOAT64: return "float64";
+    case DataType::HVD_BOOL: return "bool";
+    case DataType::HVD_BFLOAT16: return "bfloat16";
+  }
+  return "unknown";
+}
+
+std::string TensorShape::DebugString() const {
+  std::string s = "[";
+  for (size_t i = 0; i < dims_.size(); ++i) {
+    if (i) s += ", ";
+    s += std::to_string(dims_[i]);
+  }
+  return s + "]";
+}
+
+static void WriteShape(Writer* w, const TensorShape& s) {
+  w->i32(s.ndim());
+  for (auto d : s.dims()) w->i64(d);
+}
+
+static TensorShape ReadShape(Reader* r) {
+  int32_t nd = r->i32();
+  std::vector<int64_t> dims;
+  if (nd >= 0 && nd < 256) {
+    dims.reserve(nd);
+    for (int i = 0; i < nd; ++i) dims.push_back(r->i64());
+  }
+  return TensorShape(std::move(dims));
+}
+
+static void WriteRequest(Writer* w, const Request& q) {
+  w->i32(q.rank);
+  w->u8(static_cast<uint8_t>(q.op));
+  w->u8(static_cast<uint8_t>(q.reduce_op));
+  w->u8(static_cast<uint8_t>(q.dtype));
+  w->u8(static_cast<uint8_t>(q.plane));
+  w->i32(q.root_rank);
+  w->str(q.name);
+  WriteShape(w, q.shape);
+  w->f64(q.prescale);
+  w->f64(q.postscale);
+}
+
+static Request ReadRequest(Reader* r) {
+  Request q;
+  q.rank = r->i32();
+  q.op = static_cast<CollectiveOp>(r->u8());
+  q.reduce_op = static_cast<ReduceOp>(r->u8());
+  q.dtype = static_cast<DataType>(r->u8());
+  q.plane = static_cast<DevicePlane>(r->u8());
+  q.root_rank = r->i32();
+  q.name = r->str();
+  q.shape = ReadShape(r);
+  q.prescale = r->f64();
+  q.postscale = r->f64();
+  return q;
+}
+
+std::string SerializeRequestList(const std::vector<Request>& reqs,
+                                 const std::vector<uint32_t>& cached_ids,
+                                 bool shutdown) {
+  Writer w;
+  w.u8(kRequestMagic);
+  w.u8(shutdown ? 1 : 0);
+  w.i32(static_cast<int32_t>(reqs.size()));
+  for (const auto& q : reqs) WriteRequest(&w, q);
+  w.i32(static_cast<int32_t>(cached_ids.size()));
+  for (auto id : cached_ids) w.i32(static_cast<int32_t>(id));
+  return w.data();
+}
+
+bool DeserializeRequestList(const std::string& bytes,
+                            std::vector<Request>* reqs,
+                            std::vector<uint32_t>* cached_ids,
+                            bool* shutdown) {
+  Reader r(bytes);
+  if (r.u8() != kRequestMagic) return false;
+  *shutdown = r.u8() != 0;
+  int32_t n = r.i32();
+  if (n < 0 || n > (1 << 24)) return false;
+  reqs->clear();
+  reqs->reserve(n);
+  for (int i = 0; i < n; ++i) reqs->push_back(ReadRequest(&r));
+  int32_t nc = r.i32();
+  if (nc < 0 || nc > (1 << 24)) return false;
+  cached_ids->clear();
+  cached_ids->reserve(nc);
+  for (int i = 0; i < nc; ++i) {
+    cached_ids->push_back(static_cast<uint32_t>(r.i32()));
+  }
+  return r.ok();
+}
+
+std::string SerializeResponseList(const std::vector<Response>& resps) {
+  Writer w;
+  w.u8(kResponseMagic);
+  w.i32(static_cast<int32_t>(resps.size()));
+  for (const auto& p : resps) {
+    w.u8(static_cast<uint8_t>(p.op));
+    w.u8(static_cast<uint8_t>(p.reduce_op));
+    w.u8(static_cast<uint8_t>(p.dtype));
+    w.u8(static_cast<uint8_t>(p.plane));
+    w.i32(p.root_rank);
+    w.str(p.error_reason);
+    w.f64(p.prescale);
+    w.f64(p.postscale);
+    w.i32(static_cast<int32_t>(p.tensor_names.size()));
+    for (size_t i = 0; i < p.tensor_names.size(); ++i) {
+      w.str(p.tensor_names[i]);
+      WriteShape(&w, p.shapes[i]);
+    }
+  }
+  return w.data();
+}
+
+bool DeserializeResponseList(const std::string& bytes,
+                             std::vector<Response>* resps) {
+  Reader r(bytes);
+  if (r.u8() != kResponseMagic) return false;
+  int32_t n = r.i32();
+  if (n < 0 || n > (1 << 24)) return false;
+  resps->clear();
+  resps->reserve(n);
+  for (int i = 0; i < n; ++i) {
+    Response p;
+    p.op = static_cast<CollectiveOp>(r.u8());
+    p.reduce_op = static_cast<ReduceOp>(r.u8());
+    p.dtype = static_cast<DataType>(r.u8());
+    p.plane = static_cast<DevicePlane>(r.u8());
+    p.root_rank = r.i32();
+    p.error_reason = r.str();
+    p.prescale = r.f64();
+    p.postscale = r.f64();
+    int32_t nt = r.i32();
+    if (nt < 0 || nt > (1 << 24)) return false;
+    for (int t = 0; t < nt; ++t) {
+      p.tensor_names.push_back(r.str());
+      p.shapes.push_back(ReadShape(&r));
+    }
+    resps->push_back(std::move(p));
+  }
+  return r.ok();
+}
+
+}  // namespace hvd

@@ -1,0 +1,87 @@
+// Compact binary wire format for Request/Response lists.
+//
+// Plays the role of the reference's FlatBuffers schema (wire/message.fbs:
+// 37-100): a self-contained length-delimited binary encoding with no
+// external dependency (the build environment vendors no flatbuffers), fixed
+// little-endian layout, versioned with a leading magic byte so future
+// revisions can evolve.
+
+#ifndef HVD_MESSAGE_H_
+#define HVD_MESSAGE_H_
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace hvd {
+
+class Writer {
+ public:
+  void u8(uint8_t v) { buf_.push_back(static_cast<char>(v)); }
+  void i32(int32_t v) { raw(&v, 4); }
+  void i64(int64_t v) { raw(&v, 8); }
+  void f64(double v) { raw(&v, 8); }
+  void str(const std::string& s) {
+    i32(static_cast<int32_t>(s.size()));
+    buf_.append(s);
+  }
+  void raw(const void* p, size_t n) {
+    buf_.append(reinterpret_cast<const char*>(p), n);
+  }
+  const std::string& data() const { return buf_; }
+
+ private:
+  std::string buf_;
+};
+
+class Reader {
+ public:
+  Reader(const char* p, size_t n) : p_(p), end_(p + n) {}
+  explicit Reader(const std::string& s) : Reader(s.data(), s.size()) {}
+  bool ok() const { return ok_; }
+  uint8_t u8() { return static_cast<uint8_t>(*take(1)); }
+  int32_t i32() { int32_t v = 0; memcpy_(&v, 4); return v; }
+  int64_t i64() { int64_t v = 0; memcpy_(&v, 8); return v; }
+  double f64() { double v = 0; memcpy_(&v, 8); return v; }
+  std::string str() {
+    int32_t n = i32();
+    if (n < 0 || p_ + n > end_) { ok_ = false; return ""; }
+    std::string s(p_, n);
+    p_ += n;
+    return s;
+  }
+
+ private:
+  const char* take(size_t n) {
+    static const char zero[8] = {0};
+    if (p_ + n > end_) { ok_ = false; return zero; }
+    const char* r = p_;
+    p_ += n;
+    return r;
+  }
+  void memcpy_(void* dst, size_t n);
+  const char* p_;
+  const char* end_;
+  bool ok_ = true;
+};
+
+// Request list <-> bytes. `cached_ids` carries response-cache hit ids so a
+// repeat submission costs 4 bytes instead of a full Request (the bandwidth
+// role of the reference's cache bitvector sync, response_cache.h:45-167).
+std::string SerializeRequestList(const std::vector<Request>& reqs,
+                                 const std::vector<uint32_t>& cached_ids,
+                                 bool shutdown);
+bool DeserializeRequestList(const std::string& bytes,
+                            std::vector<Request>* reqs,
+                            std::vector<uint32_t>* cached_ids,
+                            bool* shutdown);
+
+std::string SerializeResponseList(const std::vector<Response>& resps);
+bool DeserializeResponseList(const std::string& bytes,
+                             std::vector<Response>* resps);
+
+}  // namespace hvd
+
+#endif  // HVD_MESSAGE_H_

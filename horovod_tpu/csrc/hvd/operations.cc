@@ -1,0 +1,427 @@
+// Global state, background cycle loop, and the extern "C" API.
+//
+// Parity: reference operations.cc — InitializeHorovodOnce (:611),
+// BackgroundThreadLoop (:338), RunLoopOnce (:557), PerformOperation (:237),
+// the extern "C" block (:668-806) and EnqueueTensor* (:810-961) — reshaped
+// for a two-plane TPU runtime:
+//
+//   HOST plane: entries carry host pointers; responses execute natively on
+//     the ring data plane (ring_ops.cc) right in the background thread.
+//   XLA plane: entries are metadata-only; fused responses are handed to a
+//     registered callback (the Python/XLA executor), which launches the
+//     compiled collective and reports completion via hvd_response_done —
+//     the non-blocking Status::InProgress + finalizer design of the
+//     reference GPU path (gpu_operations.cc:47-86) without device threads,
+//     since XLA's async dispatch supplies the queueing.
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+
+#include "controller.h"
+#include "message.h"
+#include "ring_ops.h"
+#include "tensor_queue.h"
+
+namespace hvd {
+namespace {
+
+using ExecCallback = void (*)(const char* response_bytes, int len,
+                              long response_id);
+
+struct HandleTable {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::unordered_map<int64_t, Status> done;
+  int64_t next = 0;
+
+  int64_t NewHandle() {
+    std::lock_guard<std::mutex> lk(mu);
+    return next++;
+  }
+  void MarkDone(int64_t h, const Status& s) {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      done[h] = s;
+    }
+    cv.notify_all();
+  }
+  // 0 = pending, 1 = ok, -1 = error (reason copied out)
+  int Test(int64_t h, std::string* reason) {
+    std::lock_guard<std::mutex> lk(mu);
+    auto it = done.find(h);
+    if (it == done.end()) return 0;
+    if (it->second.ok()) return 1;
+    if (reason) *reason = it->second.reason();
+    return -1;
+  }
+  int Wait(int64_t h, std::string* reason) {
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait(lk, [&] { return done.count(h) != 0; });
+    const Status& s = done[h];
+    if (s.ok()) return 1;
+    if (reason) *reason = s.reason();
+    return -1;
+  }
+  void Erase(int64_t h) {
+    std::lock_guard<std::mutex> lk(mu);
+    done.erase(h);
+  }
+};
+
+struct GlobalState {
+  std::mutex init_mu;
+  std::atomic<bool> initialized{false};
+  std::atomic<bool> shutdown_requested{false};
+  std::atomic<bool> loop_done{false};
+
+  int rank = 0, size = 1, local_rank = 0, local_size = 1;
+  int cross_rank = 0, cross_size = 1;
+  double cycle_time_ms = 5.0;
+
+  std::unique_ptr<Controller> controller;
+  std::unique_ptr<Ring> ring;
+  Listener data_listener;
+  TensorQueue tensor_queue;
+  HandleTable handles;
+  std::thread background;
+
+  ExecCallback exec_cb = nullptr;
+  // responses handed to the XLA executor, keyed by response id
+  std::mutex inflight_mu;
+  std::unordered_map<long, std::vector<TensorTableEntry>> inflight;
+  std::atomic<long> next_response_id{1};
+};
+
+GlobalState* g() {
+  static GlobalState* state = new GlobalState();
+  return state;
+}
+
+void ExecuteHostResponse(const Response& resp,
+                         std::vector<TensorTableEntry>& entries) {
+  // Fuse host entries into one flat buffer, run the ring op, scatter back —
+  // MemcpyInFusionBuffer / MemcpyOutFusionBuffer parity
+  // (collective_operations.cc).
+  auto* s = g();
+  int es = DataTypeSize(resp.dtype);
+  Status st = Status::OK();
+  switch (resp.op) {
+    case CollectiveOp::ALLREDUCE: {
+      int64_t total = 0;
+      for (const auto& e : entries) total += e.request.shape.num_elements();
+      std::vector<char> fusion(total * es);
+      int64_t off = 0;
+      for (const auto& e : entries) {
+        int64_t n = e.request.shape.num_elements() * es;
+        std::memcpy(fusion.data() + off, e.data, n);
+        off += n;
+      }
+      if (resp.reduce_op == ReduceOp::ADASUM) {
+        st = s->ring->AdasumAllreduce(fusion.data(), fusion.data(), total,
+                                      resp.dtype);
+      } else {
+        st = s->ring->Allreduce(fusion.data(), fusion.data(), total,
+                                resp.dtype, resp.reduce_op, resp.prescale,
+                                resp.postscale);
+      }
+      if (st.ok()) {
+        off = 0;
+        for (auto& e : entries) {
+          int64_t n = e.request.shape.num_elements() * es;
+          std::memcpy(e.output ? e.output : e.data, fusion.data() + off, n);
+          off += n;
+        }
+      }
+      break;
+    }
+    case CollectiveOp::ALLGATHER: {
+      for (auto& e : entries) {
+        int64_t n = e.request.shape.num_elements();
+        st = s->ring->Allgather(e.data, e.output, n, resp.dtype);
+        if (!st.ok()) break;
+      }
+      break;
+    }
+    case CollectiveOp::BROADCAST: {
+      for (auto& e : entries) {
+        if (e.output && e.output != e.data &&
+            s->rank == resp.root_rank) {
+          std::memcpy(e.output, e.data,
+                      e.request.shape.num_elements() * es);
+        }
+        void* buf = e.output ? e.output : e.data;
+        st = s->ring->Broadcast(buf, e.request.shape.num_elements(),
+                                resp.dtype, resp.root_rank);
+        if (!st.ok()) break;
+      }
+      break;
+    }
+    case CollectiveOp::BARRIER:
+      break;  // negotiation itself is the barrier on a cycle-synced star
+    default:
+      st = Status::InvalidArgument("unsupported host-plane op");
+  }
+  for (auto& e : entries) {
+    s->handles.MarkDone(e.handle, st);
+    if (e.callback) e.callback(st);
+  }
+}
+
+void PerformOperation(const Response& resp) {
+  auto* s = g();
+  if (!resp.error_reason.empty() || resp.op == CollectiveOp::ERROR_OP) {
+    Status err = Status::PreconditionError(resp.error_reason);
+    auto entries = s->tensor_queue.GetTensorEntries(resp.tensor_names, true);
+    for (auto& e : entries) {
+      s->handles.MarkDone(e.handle, err);
+      if (e.callback) e.callback(err);
+    }
+    return;
+  }
+  auto entries = s->tensor_queue.GetTensorEntries(resp.tensor_names, true);
+  if (entries.empty()) return;
+  if (resp.plane == DevicePlane::HOST) {
+    ExecuteHostResponse(resp, entries);
+    return;
+  }
+  // XLA plane: hand off to the registered executor.
+  if (s->exec_cb == nullptr) {
+    Status err = Status::PreconditionError(
+        "no XLA executor callback registered");
+    for (auto& e : entries) {
+      s->handles.MarkDone(e.handle, err);
+      if (e.callback) e.callback(err);
+    }
+    return;
+  }
+  long id = s->next_response_id++;
+  {
+    std::lock_guard<std::mutex> lk(s->inflight_mu);
+    s->inflight[id] = std::move(entries);
+  }
+  std::string bytes = SerializeResponseList({resp});
+  s->exec_cb(bytes.data(), static_cast<int>(bytes.size()), id);
+}
+
+bool RunLoopOnce(std::chrono::steady_clock::time_point& last_cycle) {
+  auto* s = g();
+  auto now = std::chrono::steady_clock::now();
+  auto target = last_cycle + std::chrono::duration_cast<
+                                 std::chrono::steady_clock::duration>(
+                                 std::chrono::duration<double, std::milli>(
+                                     s->cycle_time_ms));
+  if (now < target) std::this_thread::sleep_for(target - now);
+  last_cycle = std::chrono::steady_clock::now();
+
+  bool want_shutdown = s->shutdown_requested.load();
+  bool world_shutdown = false;
+  auto requests = s->tensor_queue.PopMessages();
+  auto responses = s->controller->ComputeResponseList(
+      std::move(requests), want_shutdown, &world_shutdown);
+  for (const auto& r : responses) PerformOperation(r);
+  return !world_shutdown;
+}
+
+void BackgroundLoop() {
+  auto last = std::chrono::steady_clock::now();
+  while (RunLoopOnce(last)) {
+  }
+  auto* s = g();
+  s->tensor_queue.FinalizeWith(
+      Status::Aborted("horovod_tpu runtime has been shut down"));
+  s->controller->Finalize();
+  s->loop_done.store(true);
+}
+
+DataType IntToDtype(int d) { return static_cast<DataType>(d); }
+
+}  // namespace
+}  // namespace hvd
+
+// ---- extern "C" API --------------------------------------------------------
+
+extern "C" {
+
+int hvd_init(int rank, int size, int local_rank, int local_size,
+             int cross_rank, int cross_size, const char* coordinator_addr,
+             int coordinator_port, const char* my_host, double cycle_time_ms,
+             long long fusion_threshold, int cache_capacity,
+             double stall_warning_sec, double stall_shutdown_sec,
+             int stall_check_enabled) {
+  auto* s = hvd::g();
+  std::lock_guard<std::mutex> lk(s->init_mu);
+  if (s->initialized.load()) return 0;
+  s->rank = rank;
+  s->size = size;
+  s->local_rank = local_rank;
+  s->local_size = local_size;
+  s->cross_rank = cross_rank;
+  s->cross_size = cross_size;
+  s->cycle_time_ms = cycle_time_ms;
+  s->shutdown_requested.store(false);
+  s->loop_done.store(false);
+
+  hvd::ControllerConfig cfg;
+  cfg.rank = rank;
+  cfg.size = size;
+  cfg.coordinator_addr = coordinator_addr ? coordinator_addr : "127.0.0.1";
+  cfg.coordinator_port = coordinator_port;
+  cfg.fusion_threshold_bytes = static_cast<int64_t>(fusion_threshold);
+  cfg.cache_capacity = static_cast<size_t>(cache_capacity);
+  cfg.stall_warning_sec = stall_warning_sec;
+  cfg.stall_shutdown_sec = stall_shutdown_sec;
+  cfg.stall_check_enabled = stall_check_enabled != 0;
+
+  if (size <= 1) {
+    s->controller = std::make_unique<hvd::LocalController>(cfg);
+    s->ring = std::make_unique<hvd::Ring>();
+  } else {
+    if (!s->data_listener.Listen(0)) return -2;
+    s->controller = std::make_unique<hvd::TcpController>(
+        cfg, s->data_listener.port(), my_host ? my_host : "127.0.0.1");
+  }
+  hvd::Status st = s->controller->Initialize();
+  if (!st.ok()) {
+    std::fprintf(stderr, "[horovod_tpu] init failed: %s\n",
+                 st.reason().c_str());
+    return -1;
+  }
+  if (size > 1) {
+    s->ring = std::make_unique<hvd::Ring>();
+    st = s->ring->Connect(rank, s->controller->data_endpoints(),
+                          &s->data_listener);
+    if (!st.ok()) {
+      std::fprintf(stderr, "[horovod_tpu] ring init failed: %s\n",
+                   st.reason().c_str());
+      return -1;
+    }
+  }
+  s->background = std::thread(hvd::BackgroundLoop);
+  s->initialized.store(true);
+  return 0;
+}
+
+void hvd_shutdown() {
+  auto* s = hvd::g();
+  std::lock_guard<std::mutex> lk(s->init_mu);
+  if (!s->initialized.load()) return;
+  s->shutdown_requested.store(true);
+  if (s->background.joinable()) s->background.join();
+  s->initialized.store(false);
+  s->controller.reset();
+  s->ring.reset();
+  s->data_listener.Close();
+  {
+    // Resolve any responses still parked at the XLA executor so waiters
+    // never hang across shutdown.
+    std::lock_guard<std::mutex> ilk(s->inflight_mu);
+    hvd::Status aborted =
+        hvd::Status::Aborted("horovod_tpu runtime has been shut down");
+    for (auto& kv : s->inflight) {
+      for (auto& e : kv.second) {
+        s->handles.MarkDone(e.handle, aborted);
+        if (e.callback) e.callback(aborted);
+      }
+    }
+    s->inflight.clear();
+  }
+}
+
+int hvd_initialized() { return hvd::g()->initialized.load() ? 1 : 0; }
+int hvd_rank() { return hvd::g()->rank; }
+int hvd_size() { return hvd::g()->size; }
+int hvd_local_rank() { return hvd::g()->local_rank; }
+int hvd_local_size() { return hvd::g()->local_size; }
+int hvd_cross_rank() { return hvd::g()->cross_rank; }
+int hvd_cross_size() { return hvd::g()->cross_size; }
+
+void hvd_register_exec_callback(void (*cb)(const char*, int, long)) {
+  hvd::g()->exec_cb = cb;
+}
+
+// Enqueue a collective. Returns a handle (>= 0) or a negative error code.
+// For HOST-plane tensors `data`/`output` are live host pointers that must
+// stay valid until the handle resolves; XLA-plane entries pass nullptrs.
+long long hvd_enqueue(const char* name, int op, int reduce_op, int dtype,
+                      const long long* shape, int ndim, void* data,
+                      void* output, int root_rank, double prescale,
+                      double postscale, int plane) {
+  auto* s = hvd::g();
+  if (!s->initialized.load()) return -1;
+  hvd::TensorTableEntry e;
+  e.name = name;
+  e.request.rank = s->rank;
+  e.request.op = static_cast<hvd::CollectiveOp>(op);
+  e.request.reduce_op = static_cast<hvd::ReduceOp>(reduce_op);
+  e.request.dtype = hvd::IntToDtype(dtype);
+  e.request.plane = static_cast<hvd::DevicePlane>(plane);
+  e.request.root_rank = root_rank;
+  e.request.name = name;
+  e.request.prescale = prescale;
+  e.request.postscale = postscale;
+  std::vector<int64_t> dims(ndim);
+  for (int i = 0; i < ndim; ++i) dims[i] = static_cast<int64_t>(shape[i]);
+  e.request.shape = hvd::TensorShape(std::move(dims));
+  e.data = data;
+  e.output = output;
+  e.handle = s->handles.NewHandle();
+  long long h = e.handle;
+  hvd::Status st = s->tensor_queue.AddToTensorQueue(std::move(e));
+  if (!st.ok()) {
+    s->handles.MarkDone(h, st);
+  }
+  return h;
+}
+
+// Poll: 0 pending, 1 done-ok, -1 done-error.
+int hvd_test(long long handle, char* err, int errlen) {
+  std::string reason;
+  int r = hvd::g()->handles.Test(handle, &reason);
+  if (r < 0 && err && errlen > 0) {
+    std::strncpy(err, reason.c_str(), errlen - 1);
+    err[errlen - 1] = '\0';
+  }
+  return r;
+}
+
+int hvd_wait(long long handle, char* err, int errlen) {
+  std::string reason;
+  int r = hvd::g()->handles.Wait(handle, &reason);
+  if (r < 0 && err && errlen > 0) {
+    std::strncpy(err, reason.c_str(), errlen - 1);
+    err[errlen - 1] = '\0';
+  }
+  hvd::g()->handles.Erase(handle);
+  return r;
+}
+
+// XLA executor completion: resolves all entries of an in-flight response.
+void hvd_response_done(long response_id, int ok, const char* error) {
+  auto* s = hvd::g();
+  std::vector<hvd::TensorTableEntry> entries;
+  {
+    std::lock_guard<std::mutex> lk(s->inflight_mu);
+    auto it = s->inflight.find(response_id);
+    if (it == s->inflight.end()) return;
+    entries = std::move(it->second);
+    s->inflight.erase(it);
+  }
+  hvd::Status st = ok ? hvd::Status::OK()
+                      : hvd::Status::Aborted(error ? error : "exec failed");
+  for (auto& e : entries) {
+    s->handles.MarkDone(e.handle, st);
+    if (e.callback) e.callback(st);
+  }
+}
+
+int hvd_pending_count() {
+  return static_cast<int>(hvd::g()->tensor_queue.PendingCount());
+}
+
+}  // extern "C"

@@ -1,0 +1,96 @@
+#include "response_cache.h"
+
+namespace hvd {
+
+const uint32_t ResponseCache::kInvalid;
+
+std::string ResponseCache::Key(const Request& req) {
+  std::string k = req.name;
+  k += '\x1f';
+  k += std::to_string(static_cast<int>(req.op));
+  k += '/';
+  k += std::to_string(static_cast<int>(req.reduce_op));
+  k += '/';
+  k += std::to_string(static_cast<int>(req.dtype));
+  k += '/';
+  k += std::to_string(static_cast<int>(req.plane));
+  k += '/';
+  k += std::to_string(req.root_rank);
+  k += '/';
+  for (auto d : req.shape.dims()) {
+    k += std::to_string(d);
+    k += ',';
+  }
+  k += std::to_string(req.prescale);
+  k += '/';
+  k += std::to_string(req.postscale);
+  return k;
+}
+
+uint32_t ResponseCache::Lookup(const Request& req) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = by_key_.find(Key(req));
+  if (it == by_key_.end()) return kInvalid;
+  // No recency refresh: eviction must stay deterministic across ranks
+  // (see header comment).
+  return it->second.id;
+}
+
+uint32_t ResponseCache::Put(const Request& req) {
+  std::lock_guard<std::mutex> lk(mu_);
+  std::string key = Key(req);
+  auto it = by_key_.find(key);
+  if (it != by_key_.end()) return it->second.id;
+  if (by_key_.size() >= capacity_ && !lru_.empty()) {
+    uint32_t victim = lru_.back();
+    lru_.pop_back();
+    auto kit = by_id_.find(victim);
+    if (kit != by_id_.end()) {
+      by_key_.erase(kit->second);
+      by_id_.erase(kit);
+    }
+  }
+  uint32_t id = next_id_++;
+  lru_.push_front(id);
+  Entry e{id, req, lru_.begin()};
+  by_key_.emplace(key, std::move(e));
+  by_id_.emplace(id, std::move(key));
+  return id;
+}
+
+bool ResponseCache::Get(uint32_t id, Request* out) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = by_id_.find(id);
+  if (it == by_id_.end()) return false;
+  auto e = by_key_.find(it->second);
+  if (e == by_key_.end()) return false;
+  *out = e->second.req;
+  return true;
+}
+
+void ResponseCache::Erase(const std::string& name) {
+  std::lock_guard<std::mutex> lk(mu_);
+  for (auto it = by_key_.begin(); it != by_key_.end();) {
+    if (it->second.req.name == name) {
+      by_id_.erase(it->second.id);
+      lru_.erase(it->second.lru_it);
+      it = by_key_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+}
+
+void ResponseCache::Clear() {
+  std::lock_guard<std::mutex> lk(mu_);
+  by_key_.clear();
+  by_id_.clear();
+  lru_.clear();
+}
+
+size_t ResponseCache::size() {
+  std::lock_guard<std::mutex> lk(mu_);
+  return by_key_.size();
+}
+
+}  // namespace hvd

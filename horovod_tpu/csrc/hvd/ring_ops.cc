@@ -1,0 +1,390 @@
+#include "ring_ops.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+#include <thread>
+
+#include "half.h"
+
+namespace hvd {
+
+namespace {
+
+// ---- dtype-generic float view ---------------------------------------------
+// All reductions accumulate in double-width host arithmetic: fp32 for
+// 16-bit floats (reference AVX fp32-accumulation parity) and native types
+// otherwise.
+
+void ToFloat(const void* src, float* dst, int64_t n, DataType dt) {
+  switch (dt) {
+    case DataType::HVD_FLOAT32:
+      std::memcpy(dst, src, n * 4);
+      return;
+    case DataType::HVD_BFLOAT16: {
+      auto* p = static_cast<const uint16_t*>(src);
+      for (int64_t i = 0; i < n; ++i) dst[i] = Bf16ToFloat(p[i]);
+      return;
+    }
+    case DataType::HVD_FLOAT16: {
+      auto* p = static_cast<const uint16_t*>(src);
+      for (int64_t i = 0; i < n; ++i) dst[i] = Fp16ToFloat(p[i]);
+      return;
+    }
+    default:
+      break;
+  }
+}
+
+void FromFloat(const float* src, void* dst, int64_t n, DataType dt) {
+  switch (dt) {
+    case DataType::HVD_FLOAT32:
+      std::memcpy(dst, src, n * 4);
+      return;
+    case DataType::HVD_BFLOAT16: {
+      auto* p = static_cast<uint16_t*>(dst);
+      for (int64_t i = 0; i < n; ++i) p[i] = FloatToBf16(src[i]);
+      return;
+    }
+    case DataType::HVD_FLOAT16: {
+      auto* p = static_cast<uint16_t*>(dst);
+      for (int64_t i = 0; i < n; ++i) p[i] = FloatToFp16(src[i]);
+      return;
+    }
+    default:
+      break;
+  }
+}
+
+template <typename T>
+void AccumulateT(T* dst, const T* src, int64_t n, ReduceOp op) {
+  switch (op) {
+    case ReduceOp::SUM:
+    case ReduceOp::AVERAGE:
+    case ReduceOp::ADASUM:  // accumulation step unused for adasum
+      for (int64_t i = 0; i < n; ++i) dst[i] += src[i];
+      break;
+    case ReduceOp::MIN:
+      for (int64_t i = 0; i < n; ++i) dst[i] = std::min(dst[i], src[i]);
+      break;
+    case ReduceOp::MAX:
+      for (int64_t i = 0; i < n; ++i) dst[i] = std::max(dst[i], src[i]);
+      break;
+  }
+}
+
+bool Is16BitFloat(DataType dt) {
+  return dt == DataType::HVD_FLOAT16 || dt == DataType::HVD_BFLOAT16;
+}
+
+// Accumulate src into dst (both raw buffers of dtype dt).
+void Accumulate(void* dst, const void* src, int64_t n, DataType dt,
+                ReduceOp op) {
+  switch (dt) {
+    case DataType::HVD_FLOAT32:
+      AccumulateT(static_cast<float*>(dst), static_cast<const float*>(src), n,
+                  op);
+      break;
+    case DataType::HVD_FLOAT64:
+      AccumulateT(static_cast<double*>(dst),
+                  static_cast<const double*>(src), n, op);
+      break;
+    case DataType::HVD_INT32:
+      AccumulateT(static_cast<int32_t*>(dst),
+                  static_cast<const int32_t*>(src), n, op);
+      break;
+    case DataType::HVD_INT64:
+      AccumulateT(static_cast<int64_t*>(dst),
+                  static_cast<const int64_t*>(src), n, op);
+      break;
+    case DataType::HVD_UINT8:
+      AccumulateT(static_cast<uint8_t*>(dst),
+                  static_cast<const uint8_t*>(src), n, op);
+      break;
+    case DataType::HVD_INT8:
+      AccumulateT(static_cast<int8_t*>(dst), static_cast<const int8_t*>(src),
+                  n, op);
+      break;
+    case DataType::HVD_BOOL: {
+      auto* d = static_cast<uint8_t*>(dst);
+      auto* s = static_cast<const uint8_t*>(src);
+      for (int64_t i = 0; i < n; ++i) d[i] = d[i] || s[i];
+      break;
+    }
+    case DataType::HVD_FLOAT16:
+    case DataType::HVD_BFLOAT16: {
+      std::vector<float> a(n), b(n);
+      ToFloat(dst, a.data(), n, dt);
+      ToFloat(src, b.data(), n, dt);
+      AccumulateT(a.data(), b.data(), n, op);
+      FromFloat(a.data(), dst, n, dt);
+      break;
+    }
+  }
+}
+
+void ScaleBuffer(void* data, int64_t n, DataType dt, double factor) {
+  if (factor == 1.0) return;
+  switch (dt) {
+    case DataType::HVD_FLOAT32: {
+      auto* p = static_cast<float*>(data);
+      for (int64_t i = 0; i < n; ++i) p[i] *= static_cast<float>(factor);
+      break;
+    }
+    case DataType::HVD_FLOAT64: {
+      auto* p = static_cast<double*>(data);
+      for (int64_t i = 0; i < n; ++i) p[i] *= factor;
+      break;
+    }
+    case DataType::HVD_FLOAT16:
+    case DataType::HVD_BFLOAT16: {
+      std::vector<float> tmp(n);
+      ToFloat(data, tmp.data(), n, dt);
+      for (int64_t i = 0; i < n; ++i) tmp[i] *= static_cast<float>(factor);
+      FromFloat(tmp.data(), data, n, dt);
+      break;
+    }
+    default:
+      break;  // integer scaling intentionally unsupported
+  }
+}
+
+}  // namespace
+
+void Ring::SenderLoop() {
+  std::unique_lock<std::mutex> lk(send_mu_);
+  while (true) {
+    send_cv_.wait(lk, [&] { return send_buf_ != nullptr || sender_exit_; });
+    if (sender_exit_) return;
+    const void* buf = send_buf_;
+    size_t n = send_bytes_;
+    lk.unlock();
+    std::string payload(static_cast<const char*>(buf), n);
+    bool ok = next_.SendFrame(payload);
+    lk.lock();
+    send_buf_ = nullptr;
+    send_done_ = true;
+    send_ok_ = ok;
+    send_cv_.notify_all();
+  }
+}
+
+bool Ring::SendRecvStep(const void* sbuf, size_t sbytes, void* rbuf,
+                        size_t rbytes) {
+  {
+    std::lock_guard<std::mutex> lk(send_mu_);
+    send_buf_ = sbuf;
+    send_bytes_ = sbytes;
+    send_done_ = false;
+  }
+  send_cv_.notify_all();
+  std::string rframe;
+  bool recv_ok = prev_.RecvFrame(&rframe) && rframe.size() == rbytes;
+  {
+    std::unique_lock<std::mutex> lk(send_mu_);
+    send_cv_.wait(lk, [&] { return send_done_; });
+    if (recv_ok) std::memcpy(rbuf, rframe.data(), rbytes);
+    return send_ok_ && recv_ok;
+  }
+}
+
+Ring::~Ring() {
+  if (sender_.joinable()) {
+    {
+      std::lock_guard<std::mutex> lk(send_mu_);
+      sender_exit_ = true;
+    }
+    send_cv_.notify_all();
+    sender_.join();
+  }
+}
+
+Status Ring::Connect(int rank, const std::vector<std::pair<std::string, int>>&
+                                   endpoints,
+                     Listener* listener) {
+  rank_ = rank;
+  size_ = static_cast<int>(endpoints.size());
+  if (size_ == 1) return Status::OK();
+  int next_rank = (rank_ + 1) % size_;
+  // Even ranks connect first then accept; odd ranks accept first — avoids
+  // the circular wait when every rank dials simultaneously.
+  auto dial = [&]() -> bool {
+    next_ = Socket::Connect(endpoints[next_rank].first,
+                            endpoints[next_rank].second, 120000);
+    if (!next_.valid()) return false;
+    return next_.SendFrame(std::to_string(rank_));
+  };
+  auto answer = [&]() -> bool {
+    // Accept until the peer introducing itself as prev arrives.
+    for (int tries = 0; tries < 64; ++tries) {
+      Socket s = listener->Accept(120000);
+      if (!s.valid()) return false;
+      std::string hello;
+      if (!s.RecvFrame(&hello)) continue;
+      prev_ = std::move(s);
+      return true;
+    }
+    return false;
+  };
+  bool ok = (rank_ % 2 == 0) ? (dial() && answer()) : (answer() && dial());
+  if (!ok) {
+    return Status::Error(StatusType::UNKNOWN_ERROR,
+                         "ring neighbor connection failed at rank " +
+                             std::to_string(rank_));
+  }
+  sender_ = std::thread(&Ring::SenderLoop, this);
+  return Status::OK();
+}
+
+Status Ring::Allreduce(void* data, void* output, int64_t count, DataType dtype,
+                       ReduceOp op, double prescale, double postscale) {
+  int es = DataTypeSize(dtype);
+  if (output != data) std::memcpy(output, data, count * es);
+  ScaleBuffer(output, count, dtype, prescale);
+  if (size_ > 1) {
+    if (op == ReduceOp::ADASUM) {
+      return Status::InvalidArgument("use AdasumAllreduce");
+    }
+    // chunk partition
+    std::vector<int64_t> offs(size_ + 1);
+    for (int i = 0; i <= size_; ++i) offs[i] = count * i / size_;
+    auto chunk_ptr = [&](int c) {
+      return static_cast<char*>(output) + offs[c] * es;
+    };
+    auto chunk_n = [&](int c) { return offs[c + 1] - offs[c]; };
+    int64_t max_chunk = 0;
+    for (int c = 0; c < size_; ++c) max_chunk = std::max(max_chunk, chunk_n(c));
+    std::vector<char> recv_buf(max_chunk * es);
+
+    // reduce-scatter
+    for (int step = 0; step < size_ - 1; ++step) {
+      int send_c = ((rank_ - step) % size_ + size_) % size_;
+      int recv_c = ((rank_ - step - 1) % size_ + size_) % size_;
+      if (!SendRecvStep(chunk_ptr(send_c), chunk_n(send_c) * es,
+                        recv_buf.data(), chunk_n(recv_c) * es)) {
+        return Status::Aborted("ring allreduce communication failure");
+      }
+      Accumulate(chunk_ptr(recv_c), recv_buf.data(), chunk_n(recv_c), dtype,
+                 op);
+    }
+    // allgather
+    for (int step = 0; step < size_ - 1; ++step) {
+      int send_c = ((rank_ + 1 - step) % size_ + size_) % size_;
+      int recv_c = ((rank_ - step) % size_ + size_) % size_;
+      if (!SendRecvStep(chunk_ptr(send_c), chunk_n(send_c) * es,
+                        recv_buf.data(), chunk_n(recv_c) * es)) {
+        return Status::Aborted("ring allgather communication failure");
+      }
+      std::memcpy(chunk_ptr(recv_c), recv_buf.data(), chunk_n(recv_c) * es);
+    }
+  }
+  if (op == ReduceOp::AVERAGE) {
+    ScaleBuffer(output, count, dtype, 1.0 / size_);
+  }
+  ScaleBuffer(output, count, dtype, postscale);
+  return Status::OK();
+}
+
+Status Ring::Allgather(const void* data, void* output, int64_t count,
+                       DataType dtype) {
+  int es = DataTypeSize(dtype);
+  std::memcpy(static_cast<char*>(output) + rank_ * count * es, data,
+              count * es);
+  for (int step = 0; step < size_ - 1; ++step) {
+    int send_c = ((rank_ - step) % size_ + size_) % size_;
+    int recv_c = ((rank_ - step - 1) % size_ + size_) % size_;
+    char* sp = static_cast<char*>(output) + send_c * count * es;
+    char* rp = static_cast<char*>(output) + recv_c * count * es;
+    if (!SendRecvStep(sp, count * es, rp, count * es)) {
+      return Status::Aborted("ring allgather communication failure");
+    }
+  }
+  return Status::OK();
+}
+
+Status Ring::Broadcast(void* data, int64_t count, DataType dtype, int root) {
+  if (size_ == 1) return Status::OK();
+  int es = DataTypeSize(dtype);
+  size_t nbytes = count * es;
+  // pipeline around the ring, root -> ... -> root-1
+  bool is_last = ((rank_ + 1) % size_) == root;
+  if (rank_ == root) {
+    std::string payload(static_cast<const char*>(data), nbytes);
+    if (!next_.SendFrame(payload)) return Status::Aborted("bcast send failed");
+  } else {
+    std::string frame;
+    if (!prev_.RecvFrame(&frame) || frame.size() != nbytes) {
+      return Status::Aborted("bcast recv failed");
+    }
+    std::memcpy(data, frame.data(), nbytes);
+    if (!is_last) {
+      if (!next_.SendFrame(frame)) return Status::Aborted("bcast fwd failed");
+    }
+  }
+  return Status::OK();
+}
+
+Status Ring::AdasumAllreduce(void* data, void* output, int64_t count,
+                             DataType dtype) {
+  // Allgather every rank's vector, then run the recursive pairwise Adasum
+  // tree locally — bitwise-identical results on all ranks, exact reference
+  // numerics with fp32/fp64 accumulation.
+  int es = DataTypeSize(dtype);
+  if ((size_ & (size_ - 1)) != 0) {
+    return Status::InvalidArgument(
+        "Adasum requires a power-of-two world size");
+  }
+  std::vector<char> all(static_cast<size_t>(size_) * count * es);
+  Status s = Allgather(data, all.data(), count, dtype);
+  if (!s.ok()) return s;
+
+  // promote all vectors to float
+  std::vector<std::vector<float>> vecs(size_);
+  for (int r = 0; r < size_; ++r) {
+    vecs[r].resize(count);
+    const char* src = all.data() + static_cast<size_t>(r) * count * es;
+    if (Is16BitFloat(dtype)) {
+      ToFloat(src, vecs[r].data(), count, dtype);
+    } else if (dtype == DataType::HVD_FLOAT32) {
+      std::memcpy(vecs[r].data(), src, count * 4);
+    } else if (dtype == DataType::HVD_FLOAT64) {
+      auto* p = reinterpret_cast<const double*>(src);
+      for (int64_t i = 0; i < count; ++i) vecs[r][i] =
+          static_cast<float>(p[i]);
+    } else {
+      return Status::InvalidArgument("Adasum requires floating point data");
+    }
+  }
+  int n = size_;
+  while (n > 1) {
+    for (int p = 0; p < n / 2; ++p) {
+      auto& a = vecs[2 * p];
+      auto& b = vecs[2 * p + 1];
+      double dot = 0, na = 0, nb = 0;
+      for (int64_t i = 0; i < count; ++i) {
+        dot += static_cast<double>(a[i]) * b[i];
+        na += static_cast<double>(a[i]) * a[i];
+        nb += static_cast<double>(b[i]) * b[i];
+      }
+      double ca = na <= 1e-30 ? 1.0 : 1.0 - dot / (2.0 * na);
+      double cb = nb <= 1e-30 ? 1.0 : 1.0 - dot / (2.0 * nb);
+      for (int64_t i = 0; i < count; ++i) {
+        a[i] = static_cast<float>(ca * a[i] + cb * b[i]);
+      }
+      if (p != 2 * p) vecs[p] = std::move(vecs[2 * p]);
+    }
+    n /= 2;
+  }
+  // write back
+  if (Is16BitFloat(dtype)) {
+    FromFloat(vecs[0].data(), output, count, dtype);
+  } else if (dtype == DataType::HVD_FLOAT32) {
+    std::memcpy(output, vecs[0].data(), count * 4);
+  } else {
+    auto* p = static_cast<double*>(output);
+    for (int64_t i = 0; i < count; ++i) p[i] = vecs[0][i];
+  }
+  return Status::OK();
+}
+
+}  // namespace hvd

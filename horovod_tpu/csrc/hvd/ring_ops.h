@@ -1,0 +1,71 @@
+// Native host-tensor collectives over a TCP ring.
+//
+// This is the "Gloo role" of the reference (ops/gloo_operations.cc, CPU
+// collectives without MPI): bandwidth-optimal chunked ring allreduce
+// (reduce-scatter + allgather), ring allgather, and pipeline broadcast over
+// persistent neighbor sockets. 16-bit types accumulate in float32 (the
+// role of the reference's AVX fp16 paths, adasum.h:426-546). Adasum runs as
+// allgather + locally-replicated recursive pairwise combination — exact
+// reference numerics (adasum.h:194-336) with deterministic results on every
+// rank.
+
+#ifndef HVD_RING_OPS_H_
+#define HVD_RING_OPS_H_
+
+#include <condition_variable>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "common.h"
+#include "socket.h"
+
+namespace hvd {
+
+class Ring {
+ public:
+  ~Ring();
+  // Establish neighbor connections. `endpoints[rank] = (host, port)`;
+  // `listener` must already be listening on endpoints[rank].second.
+  Status Connect(int rank, const std::vector<std::pair<std::string, int>>&
+                               endpoints,
+                 Listener* listener);
+
+  Status Allreduce(void* data, void* output, int64_t count, DataType dtype,
+                   ReduceOp op, double prescale, double postscale);
+  Status Allgather(const void* data, void* output, int64_t count,
+                   DataType dtype);  // equal-count per rank
+  Status Broadcast(void* data, int64_t count, DataType dtype, int root);
+  Status AdasumAllreduce(void* data, void* output, int64_t count,
+                         DataType dtype);
+
+  int rank() const { return rank_; }
+  int size() const { return size_; }
+
+ private:
+  // Full-duplex step: send to next while receiving from prev, using one
+  // persistent sender thread (no per-step thread spawn on the hot path).
+  bool SendRecvStep(const void* sbuf, size_t sbytes, void* rbuf,
+                    size_t rbytes);
+  void SenderLoop();
+
+  int rank_ = 0;
+  int size_ = 1;
+  Socket next_;
+  Socket prev_;
+
+  std::thread sender_;
+  std::mutex send_mu_;
+  std::condition_variable send_cv_;
+  const void* send_buf_ = nullptr;  // pending send request (one at a time)
+  size_t send_bytes_ = 0;
+  bool send_done_ = true;
+  bool send_ok_ = true;
+  bool sender_exit_ = false;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_RING_OPS_H_

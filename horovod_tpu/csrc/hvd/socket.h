@@ -1,0 +1,60 @@
+// Minimal TCP helpers: length-prefixed frames over blocking sockets.
+//
+// This is the control/data transport of the multi-process controller — the
+// role MPI point-to-point and the Gloo TCP context play in the reference
+// (mpi_controller.cc, gloo/gloo_context.cc). TPU deployments coordinate
+// across hosts over DCN/ethernet; plain TCP with frame framing is
+// sufficient for the control plane and the host-tensor data plane.
+
+#ifndef HVD_SOCKET_H_
+#define HVD_SOCKET_H_
+
+#include <cstdint>
+#include <string>
+
+namespace hvd {
+
+class Socket {
+ public:
+  Socket() = default;
+  explicit Socket(int fd) : fd_(fd) {}
+  Socket(const Socket&) = delete;
+  Socket& operator=(const Socket&) = delete;
+  Socket(Socket&& o) noexcept : fd_(o.fd_) { o.fd_ = -1; }
+  Socket& operator=(Socket&& o) noexcept;
+  ~Socket();
+
+  bool valid() const { return fd_ >= 0; }
+  int fd() const { return fd_; }
+  void Close();
+
+  // Frame IO: 4-byte little-endian length + payload.
+  bool SendFrame(const std::string& payload);
+  bool RecvFrame(std::string* payload);
+
+  static Socket Connect(const std::string& host, int port,
+                        int timeout_ms = 30000);
+
+ private:
+  bool SendAll(const void* p, size_t n);
+  bool RecvAll(void* p, size_t n);
+  int fd_ = -1;
+};
+
+class Listener {
+ public:
+  // Binds on all interfaces; port 0 picks an ephemeral port.
+  bool Listen(int port);
+  int port() const { return port_; }
+  Socket Accept(int timeout_ms = 30000);
+  void Close();
+  ~Listener();
+
+ private:
+  int fd_ = -1;
+  int port_ = 0;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_SOCKET_H_

@@ -1,0 +1,68 @@
+#include "tensor_queue.h"
+
+namespace hvd {
+
+Status TensorQueue::AddToTensorQueue(TensorTableEntry entry) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto name = entry.name;
+  if (table_.count(name)) {
+    return Status::InvalidArgument(
+        "Duplicate tensor name in submission: " + name +
+        "; a tensor may only be in flight once (use distinct names)");
+  }
+  queue_.push_back(entry.request);
+  table_.emplace(std::move(name), std::move(entry));
+  return Status::OK();
+}
+
+std::vector<Request> TensorQueue::PopMessages() {
+  std::lock_guard<std::mutex> lk(mu_);
+  std::vector<Request> out(queue_.begin(), queue_.end());
+  queue_.clear();
+  return out;
+}
+
+std::vector<TensorTableEntry> TensorQueue::GetTensorEntries(
+    const std::vector<std::string>& names, bool remove) {
+  std::lock_guard<std::mutex> lk(mu_);
+  std::vector<TensorTableEntry> out;
+  out.reserve(names.size());
+  for (const auto& n : names) {
+    auto it = table_.find(n);
+    if (it != table_.end()) {
+      out.push_back(it->second);
+      if (remove) table_.erase(it);
+    }
+  }
+  return out;
+}
+
+void TensorQueue::RemoveTensorEntry(const std::string& name) {
+  std::lock_guard<std::mutex> lk(mu_);
+  table_.erase(name);
+}
+
+bool TensorQueue::Contains(const std::string& name) {
+  std::lock_guard<std::mutex> lk(mu_);
+  return table_.count(name) != 0;
+}
+
+size_t TensorQueue::PendingCount() {
+  std::lock_guard<std::mutex> lk(mu_);
+  return table_.size();
+}
+
+void TensorQueue::FinalizeWith(const Status& status) {
+  std::vector<TensorTableEntry> entries;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& kv : table_) entries.push_back(std::move(kv.second));
+    table_.clear();
+    queue_.clear();
+  }
+  for (auto& e : entries) {
+    if (e.callback) e.callback(status);
+  }
+}
+
+}  // namespace hvd

@@ -1,0 +1,50 @@
+// Thread-safe pending-tensor table + request queue.
+//
+// Parity: reference tensor_queue.{h,cc} (common/tensor_queue.h:28-63) —
+// duplicate-name rejection, atomic pop of a message batch per cycle,
+// finalize-with-abort on shutdown.
+
+#ifndef HVD_TENSOR_QUEUE_H_
+#define HVD_TENSOR_QUEUE_H_
+
+#include <deque>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+
+namespace hvd {
+
+class TensorQueue {
+ public:
+  // Adds an entry; rejects duplicate in-flight names.
+  Status AddToTensorQueue(TensorTableEntry entry);
+
+  // Pops all queued requests (one cycle's worth).
+  std::vector<Request> PopMessages();
+
+  // Looks up (and optionally removes) entries for a response's tensors.
+  std::vector<TensorTableEntry> GetTensorEntries(
+      const std::vector<std::string>& names, bool remove);
+
+  // Removes a single entry by name (after completion).
+  void RemoveTensorEntry(const std::string& name);
+
+  bool Contains(const std::string& name);
+  size_t PendingCount();
+
+  // Abort everything pending (elastic reset / shutdown): every callback
+  // fires with ABORTED.
+  void FinalizeWith(const Status& status);
+
+ private:
+  std::mutex mu_;
+  std::unordered_map<std::string, TensorTableEntry> table_;
+  std::deque<Request> queue_;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_TENSOR_QUEUE_H_

@@ -1,20 +1,24 @@
 """Eager collective engine: Horovod's dynamic-enqueue API on top of XLA.
 
-The reference's eager contract (``EnqueueTensorAllreduce`` et al.,
-``operations.cc:810-961``) is "any rank may submit any named tensor at any
-time; a handle resolves when the collective completes". On TPU, execution is
-compiled, so the engine re-creates that contract with a *compile cache*: each
-(op, shape, dtype, params) signature lazily builds one jitted
-``jax.shard_map`` program over the global mesh, cached forever after —
-the analog of the reference's lazy NCCL communicator/plan init
-(``nccl_operations.cc:60-93``), with compile-cache misses as the new
-"INIT_NCCL" one-time stall (SURVEY §7 "hard parts").
+Two cooperating planes (SURVEY §7 design stance):
 
-Asynchrony comes from XLA's own async dispatch: launching a compiled program
-returns immediately with futures (jax.Array), so handles are genuine
-futures — the role of the reference's HandleManager
-(``torch/handle_manager.{h,cc}``) — with no extra background thread needed
-for the single-controller fast path.
+- **Control plane (native, C++)**: ``libhvdtpu.so`` owns the background
+  cycle thread, tensor queue, controller negotiation (local or TCP star
+  across processes), tensor fusion planning, response cache, and stall
+  inspection (``horovod_tpu/csrc/hvd``) — the reference's
+  BackgroundThreadLoop/Controller machinery (operations.cc:338,
+  controller.cc:62) rebuilt natively.
+- **Execution plane (XLA)**: fused responses come back to Python through a
+  registered callback; a dedicated executor thread launches one compiled
+  ``shard_map`` program per response signature, cached forever — the analog
+  of lazy NCCL communicator/plan init (nccl_operations.cc:60-93), with
+  compile-cache misses as the one-time "INIT" stall.
+
+Handles are futures resolved by the native handle table
+(``hvd_wait``/``hvd_test``, the HandleManager role,
+torch/handle_manager.{h,cc}). If the native library is unavailable
+(``HOROVOD_NATIVE=0`` or no compiler), the engine degrades to direct
+execution with identical semantics minus cycle batching.
 
 Input convention (TPU-first): a single process drives ``local_size`` chips,
 so eager calls carry a leading per-participant axis of length
@@ -27,6 +31,8 @@ chip".
 
 from __future__ import annotations
 
+import os
+import queue
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -37,9 +43,18 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..common import logging as _log
+from ..common import native as _native
 from ..common.exceptions import DuplicateTensorNameError, HorovodInternalError
 from ..common.state import AXIS_GLOBAL
 from . import xla as _xla
+
+_OP_TO_NATIVE = {
+    "allreduce": _native.OP_ALLREDUCE,
+    "allgather": _native.OP_ALLGATHER,
+    "broadcast": _native.OP_BROADCAST,
+    "reducescatter": _native.OP_REDUCESCATTER,
+    "alltoall": _native.OP_ALLTOALL,
+}
 
 
 def _shard_map(fn, mesh, in_specs, out_specs):
@@ -49,38 +64,159 @@ def _shard_map(fn, mesh, in_specs, out_specs):
                          out_specs=out_specs, check_vma=False)
 
 
-class _Handle:
-    """A future for an in-flight eager collective."""
+class _Pending:
+    """A tensor submitted to the native queue, awaiting execution."""
 
-    __slots__ = ("result", "name", "postprocess", "error")
+    __slots__ = ("stacked", "was_list", "was_unstacked", "kind", "op",
+                 "prescale", "postscale", "root", "result", "error")
 
-    def __init__(self, result, name, postprocess=None, error=None):
-        self.result = result
-        self.name = name
-        self.postprocess = postprocess
-        self.error = error
+    def __init__(self, stacked, was_list, was_unstacked, kind, op=None,
+                 prescale=1.0, postscale=1.0, root=-1):
+        self.stacked = stacked
+        self.was_list = was_list
+        self.was_unstacked = was_unstacked
+        self.kind = kind
+        self.op = op
+        self.prescale = prescale
+        self.postscale = postscale
+        self.root = root
+        self.result = None
+        self.error = None
 
 
 class EagerEngine:
-    """Per-process engine: compile cache + handle table + name registry."""
+    """Per-process engine: native control plane + XLA execution plane."""
 
     def __init__(self, state):
         self._state = state
         self._mesh = state.mesh
         self._lock = threading.Lock()
         self._program_cache: Dict[Tuple, Any] = {}
-        self._handles: Dict[int, _Handle] = {}
-        self._next_handle = 0
-        self._inflight_names: set = set()
         self._name_counter = 0
+        self._pending: Dict[str, _Pending] = {}
+        self._handle_names: Dict[int, str] = {}
+        # Direct-mode handle table. Direct handles are NEGATIVE so they can
+        # never collide with native handles (which count up from 0) — the
+        # two tables coexist when grouped ops run directly in native mode.
+        self._direct_handles: Dict[int, Tuple[Any, Any, str]] = {}
+        self._next_direct = -1
+
+        self._core = _native.NativeCore()
+        self._native = False
+        if self._core.available:
+            self._exec_q: "queue.SimpleQueue" = queue.SimpleQueue()
+            cfg = state.config
+            coordinator_addr = os.environ.get(
+                "HOROVOD_CONTROLLER_ADDR", "127.0.0.1")
+            # The gRPC coordination service (jax.distributed) uses the base
+            # port; the native controller uses base+1.
+            base_port = int(os.environ.get("HOROVOD_CONTROLLER_PORT",
+                                           "29500"))
+            my_host = os.environ.get("HOROVOD_HOSTNAME", "127.0.0.1")
+            ok = self._core.init(
+                rank=state.process_index, size=state.process_count,
+                local_rank=0, local_size=state.local_size,
+                cross_rank=state.cross_rank, cross_size=state.cross_size,
+                coordinator_addr=coordinator_addr,
+                coordinator_port=base_port + 1, my_host=my_host,
+                cycle_time_ms=cfg.cycle_time_ms,
+                fusion_threshold=cfg.fusion_threshold_bytes,
+                cache_capacity=cfg.cache_capacity,
+                stall_warning_sec=cfg.stall_warning_seconds,
+                stall_shutdown_sec=cfg.stall_shutdown_seconds,
+                stall_check_enabled=not cfg.stall_check_disable,
+                exec_callback=self._on_responses)
+            if ok:
+                self._native = True
+                self._executor = threading.Thread(
+                    target=self._executor_loop, daemon=True,
+                    name="hvd-xla-executor")
+                self._executor.start()
+            else:
+                _log.warning("native core init failed; using direct mode")
 
     # -- lifecycle -----------------------------------------------------------
 
     def shutdown(self):
+        if self._native:
+            self._core.shutdown()
+            self._exec_q.put(None)
+            self._executor.join(timeout=10.0)
+            self._native = False
         with self._lock:
-            self._handles.clear()
+            self._pending.clear()
+            self._handle_names.clear()
+            self._direct_handles.clear()
             self._program_cache.clear()
-            self._inflight_names.clear()
+
+    # -- native callback + executor ------------------------------------------
+
+    def _on_responses(self, responses, response_id):
+        """Called on the native background thread; stay quick."""
+        self._exec_q.put((responses, response_id))
+
+    def _executor_loop(self):
+        while True:
+            item = self._exec_q.get()
+            if item is None:
+                return
+            responses, response_id = item
+            try:
+                for resp in responses:
+                    self._execute_response(resp)
+                self._core.response_done(response_id, True)
+            except Exception as e:
+                _log.error(f"XLA executor failure: {e}")
+                for resp in responses:
+                    for name in resp.names:
+                        p = self._pending.get(name)
+                        if p is not None:
+                            p.error = e
+                self._core.response_done(response_id, False, str(e))
+
+    def _execute_response(self, resp: "_native.NativeResponse"):
+        timeline = self._state.timeline
+        names = resp.names
+        entries = [self._pending[n] for n in names if n in self._pending]
+        if not entries:
+            return
+        kind = entries[0].kind
+        if timeline:
+            for n in names:
+                timeline.end_activity(n, f"NEGOTIATE_{kind.upper()}")
+                timeline.start_activity(n, f"XLA_{kind.upper()}")
+        if kind == "allreduce":
+            stacks = [p.stacked for p in entries]
+            results = self._exec_grouped_allreduce(
+                stacks, entries[0].op, entries[0].prescale,
+                entries[0].postscale)
+            for p, r in zip(entries, results):
+                p.result = self._from_global_sharded(
+                    r, p.was_list, p.was_unstacked)
+        elif kind == "allgather":
+            for p in entries:
+                out = self._exec_allgather(p.stacked)
+                p.result = np.asarray(out)
+        elif kind == "broadcast":
+            for p in entries:
+                out = self._exec_broadcast(p.stacked, p.root)
+                p.result = self._from_global_sharded(
+                    out, p.was_list, p.was_unstacked)
+        elif kind == "reducescatter":
+            for p in entries:
+                out = self._exec_reducescatter(p.stacked, p.op)
+                p.result = self._from_global_sharded(
+                    out, p.was_list, p.was_unstacked)
+        elif kind == "alltoall":
+            for p in entries:
+                out = self._exec_alltoall(p.stacked)
+                p.result = self._from_global_sharded(
+                    out, p.was_list, p.was_unstacked)
+        else:
+            raise ValueError(f"unknown response kind {kind}")
+        if timeline:
+            for n in names:
+                timeline.end_activity(n, f"XLA_{kind.upper()}")
 
     # -- helpers -------------------------------------------------------------
 
@@ -89,28 +225,15 @@ class EagerEngine:
             self._name_counter += 1
             return f"{prefix}.noname.{self._name_counter}"
 
-    def _register_name(self, name: str):
-        with self._lock:
-            if name in self._inflight_names:
-                raise DuplicateTensorNameError(
-                    f"tensor name '{name}' already submitted and not yet complete"
-                )
-            self._inflight_names.add(name)
-
-    def _release_name(self, name: str):
-        with self._lock:
-            self._inflight_names.discard(name)
-
     def _normalize(self, tensor) -> Tuple[jnp.ndarray, bool, bool]:
-        """Returns (stacked [local_size, ...] host/jax array, was_list,
+        """Returns (stacked [local_size, ...] array, was_list,
         was_unstacked)."""
         L = self._state.local_size
         if isinstance(tensor, (list, tuple)):
             if len(tensor) != L:
                 raise ValueError(
                     f"eager collective got a list of {len(tensor)} tensors; "
-                    f"expected local_size={L} (one per locally-driven chip)"
-                )
+                    f"expected local_size={L} (one per locally-driven chip)")
             return jnp.stack([jnp.asarray(t) for t in tensor]), True, False
         t = jnp.asarray(tensor)
         if L == 1:
@@ -127,12 +250,13 @@ class EagerEngine:
             return jax.device_put(stacked, sharding)
         global_shape = (self._state.size,) + tuple(stacked.shape[1:])
         return jax.make_array_from_process_local_data(
-            sharding, np.asarray(stacked), global_shape
-        )
+            sharding, np.asarray(stacked), global_shape)
 
     def _from_global_sharded(self, arr, was_list, was_unstacked):
-        """Extract this process's local slices of a P('hvd')-sharded result."""
-        shards = sorted(arr.addressable_shards, key=lambda s: s.index[0].start)
+        """Extract this process's local slices of a P('hvd')-sharded
+        result."""
+        shards = sorted(arr.addressable_shards,
+                        key=lambda s: s.index[0].start)
         local = np.concatenate([np.asarray(s.data) for s in shards], axis=0)
         if was_list:
             return [local[i] for i in range(local.shape[0])]
@@ -144,111 +268,43 @@ class EagerEngine:
         prog = self._program_cache.get(key)
         if prog is None:
             _log.debug(f"compiling eager collective program {key}")
+            timeline = self._state.timeline
+            if timeline:
+                timeline.start_activity(str(key), "COMPILE")
             prog = builder()
+            if timeline:
+                timeline.end_activity(str(key), "COMPILE")
             self._program_cache[key] = prog
         return prog
 
-    def _new_handle(self, result, name, postprocess=None, error=None) -> int:
-        with self._lock:
-            h = self._next_handle
-            self._next_handle += 1
-            self._handles[h] = _Handle(result, name, postprocess, error)
-            return h
+    @staticmethod
+    def _dtype_code(stacked) -> int:
+        return _native.DTYPE_CODES.get(str(stacked.dtype), 7)
 
-    # -- collectives ---------------------------------------------------------
+    # -- XLA execution primitives (shared by native executor + direct mode) --
 
-    def allreduce_async(self, tensor, name: Optional[str] = None,
-                        op: int = _xla.ReduceOp.SUM,
-                        prescale_factor: float = 1.0,
-                        postscale_factor: float = 1.0) -> int:
-        name = name or self._auto_name("allreduce")
-        # Input validation raises synchronously (ValueError etc.); only
-        # execution failures are deferred to the handle and surface as
-        # HorovodInternalError at synchronize() time, matching the
-        # reference's callback-status contract (torch/mpi_ops.py:126-127).
-        stacked, was_list, was_unstacked = self._normalize(tensor)
-        self._register_name(name)
-        try:
-            if op == _xla.ReduceOp.ADASUM and not _is_pow2(self._state.size):
-                _log.warning(
-                    "Adasum requested with non-power-of-two size; "
-                    "falling back to Average"
-                )
-                op = _xla.ReduceOp.AVERAGE
-            key = ("allreduce", stacked.shape[1:], str(stacked.dtype), op,
-                   prescale_factor, postscale_factor)
-            mesh = self._mesh
-
-            def build():
-                def fn(x):
-                    y = _xla.allreduce(
-                        x[0], axis_name=AXIS_GLOBAL, op=op,
-                        prescale_factor=prescale_factor,
-                        postscale_factor=postscale_factor,
-                    )
-                    return y[None]
-
-                return jax.jit(
-                    _shard_map(fn, mesh, in_specs=P(AXIS_GLOBAL),
-                               out_specs=P(AXIS_GLOBAL))
-                )
-
-            prog = self._program(key, build)
-            out = prog(self._to_global(stacked))
-            post = lambda a: self._from_global_sharded(a, was_list, was_unstacked)
-            return self._new_handle(out, name, post)
-        except Exception as e:  # surface as HorovodInternalError at sync time
-            self._release_name(name)
-            if isinstance(e, DuplicateTensorNameError):
-                raise
-            return self._new_handle(None, name, None, error=e)
-
-    def grouped_allreduce_async(self, tensors: List, name: Optional[str] = None,
-                                op: int = _xla.ReduceOp.SUM,
-                                prescale_factor: float = 1.0,
-                                postscale_factor: float = 1.0) -> int:
-        """Fused allreduce of multiple named tensors in one compiled program —
-        the eager face of tensor fusion (reference ``FuseResponses``,
-        ``controller.cc:640-761``)."""
-        name = name or self._auto_name("grouped_allreduce")
-        norm = [self._normalize(t) for t in tensors]
-        self._register_name(name)
-        stacked = [n[0] for n in norm]
+    def _exec_grouped_allreduce(self, stacks: List, op, prescale, postscale):
         key = ("grouped_allreduce",
-               tuple((s.shape[1:], str(s.dtype)) for s in stacked), op,
-               prescale_factor, postscale_factor)
+               tuple((s.shape[1:], str(s.dtype)) for s in stacks), op,
+               prescale, postscale)
         mesh = self._mesh
 
         def build():
             def fn(*xs):
                 ys = _xla.grouped_allreduce(
                     [x[0] for x in xs], axis_name=AXIS_GLOBAL, op=op,
-                    prescale_factor=prescale_factor,
-                    postscale_factor=postscale_factor,
-                )
+                    prescale_factor=prescale, postscale_factor=postscale)
                 return tuple(y[None] for y in ys)
 
-            return jax.jit(
-                _shard_map(fn, mesh,
-                           in_specs=tuple(P(AXIS_GLOBAL) for _ in stacked),
-                           out_specs=tuple(P(AXIS_GLOBAL) for _ in stacked))
-            )
+            return jax.jit(_shard_map(
+                fn, mesh, in_specs=tuple(P(AXIS_GLOBAL) for _ in stacks),
+                out_specs=tuple(P(AXIS_GLOBAL) for _ in stacks)))
 
         prog = self._program(key, build)
-        outs = prog(*[self._to_global(s) for s in stacked])
+        outs = prog(*[self._to_global(s) for s in stacks])
+        return list(outs) if isinstance(outs, tuple) else [outs]
 
-        def post(arrs):
-            return [
-                self._from_global_sharded(a, wl, wu)
-                for a, (_, wl, wu) in zip(arrs, norm)
-            ]
-
-        return self._new_handle(outs, name, post)
-
-    def allgather_async(self, tensor, name: Optional[str] = None) -> int:
-        name = name or self._auto_name("allgather")
-        stacked, _, _ = self._normalize(tensor)
-        self._register_name(name)
+    def _exec_allgather(self, stacked):
         key = ("allgather", stacked.shape[1:], str(stacked.dtype))
         mesh = self._mesh
 
@@ -256,70 +312,40 @@ class EagerEngine:
             def fn(x):
                 return _xla.allgather(x[0], axis_name=AXIS_GLOBAL)
 
-            # Output is identical on every chip -> replicate.
-            return jax.jit(
-                _shard_map(fn, mesh, in_specs=P(AXIS_GLOBAL), out_specs=P())
-            )
+            return jax.jit(_shard_map(fn, mesh, in_specs=P(AXIS_GLOBAL),
+                                      out_specs=P()))
 
-        prog = self._program(key, build)
-        out = prog(self._to_global(stacked))
-        return self._new_handle(out, name, lambda a: a)
+        return self._program(key, build)(self._to_global(stacked))
 
-    def broadcast_async(self, tensor, root_rank: int,
-                        name: Optional[str] = None) -> int:
-        name = name or self._auto_name("broadcast")
-        stacked, was_list, was_unstacked = self._normalize(tensor)
-        self._register_name(name)
-        key = ("broadcast", stacked.shape[1:], str(stacked.dtype), root_rank)
+    def _exec_broadcast(self, stacked, root):
+        key = ("broadcast", stacked.shape[1:], str(stacked.dtype), root)
         mesh = self._mesh
 
         def build():
             def fn(x):
-                return _xla.broadcast(x[0], root_rank, axis_name=AXIS_GLOBAL)[None]
+                return _xla.broadcast(x[0], root,
+                                      axis_name=AXIS_GLOBAL)[None]
 
-            return jax.jit(
-                _shard_map(fn, mesh, in_specs=P(AXIS_GLOBAL),
-                           out_specs=P(AXIS_GLOBAL))
-            )
+            return jax.jit(_shard_map(fn, mesh, in_specs=P(AXIS_GLOBAL),
+                                      out_specs=P(AXIS_GLOBAL)))
 
-        prog = self._program(key, build)
-        out = prog(self._to_global(stacked))
-        post = lambda a: self._from_global_sharded(a, was_list, was_unstacked)
-        return self._new_handle(out, name, post)
+        return self._program(key, build)(self._to_global(stacked))
 
-    def reducescatter_async(self, tensor, name: Optional[str] = None,
-                            op: int = _xla.ReduceOp.SUM) -> int:
-        name = name or self._auto_name("reducescatter")
-        stacked, was_list, was_unstacked = self._normalize(tensor)
-        if stacked.shape[1] % self._state.size != 0:
-            raise ValueError(
-                "reducescatter requires dim 0 divisible by size "
-                f"({stacked.shape[1]} % {self._state.size})"
-            )
-        self._register_name(name)
+    def _exec_reducescatter(self, stacked, op):
         key = ("reducescatter", stacked.shape[1:], str(stacked.dtype), op)
         mesh = self._mesh
 
         def build():
             def fn(x):
-                return _xla.reducescatter(x[0], axis_name=AXIS_GLOBAL, op=op)[None]
+                return _xla.reducescatter(x[0], axis_name=AXIS_GLOBAL,
+                                          op=op)[None]
 
-            return jax.jit(
-                _shard_map(fn, mesh, in_specs=P(AXIS_GLOBAL),
-                           out_specs=P(AXIS_GLOBAL))
-            )
+            return jax.jit(_shard_map(fn, mesh, in_specs=P(AXIS_GLOBAL),
+                                      out_specs=P(AXIS_GLOBAL)))
 
-        prog = self._program(key, build)
-        out = prog(self._to_global(stacked))
-        post = lambda a: self._from_global_sharded(a, was_list, was_unstacked)
-        return self._new_handle(out, name, post)
+        return self._program(key, build)(self._to_global(stacked))
 
-    def alltoall_async(self, tensor, name: Optional[str] = None) -> int:
-        name = name or self._auto_name("alltoall")
-        stacked, was_list, was_unstacked = self._normalize(tensor)
-        if stacked.shape[1] % self._state.size != 0:
-            raise ValueError("alltoall requires dim 0 divisible by size")
-        self._register_name(name)
+    def _exec_alltoall(self, stacked):
         key = ("alltoall", stacked.shape[1:], str(stacked.dtype))
         mesh = self._mesh
 
@@ -327,15 +353,155 @@ class EagerEngine:
             def fn(x):
                 return _xla.alltoall(x[0], axis_name=AXIS_GLOBAL)[None]
 
-            return jax.jit(
-                _shard_map(fn, mesh, in_specs=P(AXIS_GLOBAL),
-                           out_specs=P(AXIS_GLOBAL))
-            )
+            return jax.jit(_shard_map(fn, mesh, in_specs=P(AXIS_GLOBAL),
+                                      out_specs=P(AXIS_GLOBAL)))
 
-        prog = self._program(key, build)
-        out = prog(self._to_global(stacked))
-        post = lambda a: self._from_global_sharded(a, was_list, was_unstacked)
-        return self._new_handle(out, name, post)
+        return self._program(key, build)(self._to_global(stacked))
+
+    # -- submission ----------------------------------------------------------
+
+    def _submit(self, kind: str, name: Optional[str], stacked, was_list,
+                was_unstacked, op=None, prescale=1.0, postscale=1.0,
+                root=-1) -> int:
+        name = name or self._auto_name(kind)
+        timeline = self._state.timeline
+        if timeline:
+            timeline.start_activity(name, f"NEGOTIATE_{kind.upper()}")
+        if self._native:
+            with self._lock:
+                if name in self._pending:
+                    raise DuplicateTensorNameError(
+                        f"tensor name '{name}' already submitted and not "
+                        "yet complete")
+                self._pending[name] = _Pending(
+                    stacked, was_list, was_unstacked, kind, op, prescale,
+                    postscale, root)
+            handle = self._core.enqueue(
+                name, _OP_TO_NATIVE[kind], op if op is not None else 1,
+                self._dtype_code(stacked), tuple(stacked.shape[1:]),
+                root_rank=root, prescale=prescale, postscale=postscale,
+                plane=_native.PLANE_XLA)
+            # Duplicate detection also lives in the native queue; surface
+            # its synchronous rejection as the parity exception.
+            r, reason = self._core.test(handle)
+            if r < 0 and "Duplicate tensor name" in reason:
+                with self._lock:
+                    self._pending.pop(name, None)
+                raise DuplicateTensorNameError(reason)
+            with self._lock:
+                self._handle_names[handle] = name
+            return handle
+        # direct mode: execute immediately (XLA dispatch is still async).
+        # Duplicate-name rejection must precede execution so an erroring
+        # caller never participates in a collective.
+        self._check_direct_duplicate(name)
+        try:
+            if kind == "allreduce":
+                out = self._exec_grouped_allreduce([stacked], op, prescale,
+                                                   postscale)[0]
+                post = lambda a: self._from_global_sharded(  # noqa: E731
+                    a, was_list, was_unstacked)
+            elif kind == "allgather":
+                out = self._exec_allgather(stacked)
+                post = lambda a: np.asarray(a)  # noqa: E731
+            elif kind == "broadcast":
+                out = self._exec_broadcast(stacked, root)
+                post = lambda a: self._from_global_sharded(  # noqa: E731
+                    a, was_list, was_unstacked)
+            elif kind == "reducescatter":
+                out = self._exec_reducescatter(stacked, op)
+                post = lambda a: self._from_global_sharded(  # noqa: E731
+                    a, was_list, was_unstacked)
+            elif kind == "alltoall":
+                out = self._exec_alltoall(stacked)
+                post = lambda a: self._from_global_sharded(  # noqa: E731
+                    a, was_list, was_unstacked)
+            else:
+                raise ValueError(kind)
+            err = None
+        except Exception as e:
+            out, post, err = None, None, e
+        return self._new_direct_handle(out if err is None else err,
+                                       post if err is None else None, name)
+
+    def _check_direct_duplicate(self, name: str):
+        with self._lock:
+            if name in {m[2] for m in self._direct_handles.values()}:
+                raise DuplicateTensorNameError(
+                    f"tensor name '{name}' already submitted and not yet "
+                    "complete")
+
+    def _new_direct_handle(self, out, post, name) -> int:
+        with self._lock:
+            h = self._next_direct
+            self._next_direct -= 1
+            self._direct_handles[h] = (out, post, name)
+            return h
+
+    # -- public API ----------------------------------------------------------
+
+    def allreduce_async(self, tensor, name: Optional[str] = None,
+                        op: int = _xla.ReduceOp.SUM,
+                        prescale_factor: float = 1.0,
+                        postscale_factor: float = 1.0) -> int:
+        stacked, was_list, was_unstacked = self._normalize(tensor)
+        if op == _xla.ReduceOp.ADASUM and not _is_pow2(self._state.size):
+            _log.warning("Adasum requested with non-power-of-two size; "
+                         "falling back to Average")
+            op = _xla.ReduceOp.AVERAGE
+        return self._submit("allreduce", name, stacked, was_list,
+                            was_unstacked, op=op, prescale=prescale_factor,
+                            postscale=postscale_factor)
+
+    def grouped_allreduce_async(self, tensors: List,
+                                name: Optional[str] = None,
+                                op: int = _xla.ReduceOp.SUM,
+                                prescale_factor: float = 1.0,
+                                postscale_factor: float = 1.0) -> int:
+        """Explicitly-fused allreduce: submitted as one unit so the result
+        is one compiled program regardless of cycle timing."""
+        name = name or self._auto_name("grouped_allreduce")
+        norm = [self._normalize(t) for t in tensors]
+        stacks = [n[0] for n in norm]
+        self._check_direct_duplicate(name)
+        try:
+            outs = self._exec_grouped_allreduce(stacks, op, prescale_factor,
+                                                postscale_factor)
+            err = None
+        except Exception as e:
+            outs, err = None, e
+
+        def post(arrs):
+            return [self._from_global_sharded(a, wl, wu)
+                    for a, (_, wl, wu) in zip(arrs, norm)]
+
+        return self._new_direct_handle(outs if err is None else err,
+                                       post if err is None else None, name)
+
+    def allgather_async(self, tensor, name: Optional[str] = None) -> int:
+        stacked, wl, wu = self._normalize(tensor)
+        return self._submit("allgather", name, stacked, wl, wu)
+
+    def broadcast_async(self, tensor, root_rank: int,
+                        name: Optional[str] = None) -> int:
+        stacked, wl, wu = self._normalize(tensor)
+        return self._submit("broadcast", name, stacked, wl, wu,
+                            root=root_rank)
+
+    def reducescatter_async(self, tensor, name: Optional[str] = None,
+                            op: int = _xla.ReduceOp.SUM) -> int:
+        stacked, wl, wu = self._normalize(tensor)
+        if stacked.shape[1] % self._state.size != 0:
+            raise ValueError(
+                "reducescatter requires dim 0 divisible by size "
+                f"({stacked.shape[1]} % {self._state.size})")
+        return self._submit("reducescatter", name, stacked, wl, wu, op=op)
+
+    def alltoall_async(self, tensor, name: Optional[str] = None) -> int:
+        stacked, wl, wu = self._normalize(tensor)
+        if stacked.shape[1] % self._state.size != 0:
+            raise ValueError("alltoall requires dim 0 divisible by size")
+        return self._submit("alltoall", name, stacked, wl, wu)
 
     def barrier(self):
         key = ("barrier",)
@@ -354,30 +520,51 @@ class EagerEngine:
     # -- handle management (parity: HandleManager + poll/synchronize) --------
 
     def poll(self, handle: int) -> bool:
-        h = self._handles.get(handle)
-        if h is None:
+        if self._native and handle in self._handle_names:
+            r, _ = self._core.test(handle)
+            return r != 0
+        with self._lock:
+            entry = self._direct_handles.get(handle)
+        if entry is None:
             raise ValueError(f"unknown handle {handle}")
-        if h.error is not None:
+        out = entry[0]
+        if isinstance(out, Exception):
             return True
         try:
-            leaves = jax.tree_util.tree_leaves(h.result)
+            leaves = jax.tree_util.tree_leaves(out)
             return all(leaf.is_ready() for leaf in leaves)
         except AttributeError:
             return True
 
     def synchronize(self, handle: int):
+        if self._native and handle in self._handle_names:
+            r, reason = self._core.wait(handle)
+            with self._lock:
+                name = self._handle_names.pop(handle)
+                pending = self._pending.pop(name, None)
+            if r < 0:
+                raise HorovodInternalError(reason)
+            if pending is None or (pending.result is None
+                                   and pending.error is None):
+                raise HorovodInternalError(
+                    f"no result recorded for '{name}'")
+            if pending.error is not None:
+                raise HorovodInternalError(str(pending.error)) \
+                    from pending.error
+            return pending.result
         with self._lock:
-            h = self._handles.pop(handle, None)
-        if h is None:
-            raise ValueError(f"unknown or already-synchronized handle {handle}")
-        self._release_name(h.name)
-        if h.error is not None:
-            raise HorovodInternalError(str(h.error)) from h.error
+            entry = self._direct_handles.pop(handle, None)
+        if entry is None:
+            raise ValueError(
+                f"unknown or already-synchronized handle {handle}")
+        out, post, _name = entry
+        if isinstance(out, Exception):
+            raise HorovodInternalError(str(out)) from out
         try:
-            result = jax.block_until_ready(h.result)
+            result = jax.block_until_ready(out)
         except Exception as e:
             raise HorovodInternalError(str(e)) from e
-        return h.postprocess(result) if h.postprocess else result
+        return post(result) if post else result
 
 
 def _is_pow2(n: int) -> bool:

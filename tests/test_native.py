@@ -1,0 +1,231 @@
+"""Native core runtime tests: library load, engine integration, and a real
+2-process TCP controller + ring data-plane run (the reference's
+mpirun-launched Pattern-1 tests, SURVEY §4, done with subprocesses)."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common import native as hn
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_library_loads():
+    assert hn.load_library() is not None
+
+
+def test_engine_uses_native_core(hvd):
+    from horovod_tpu.common.state import global_state
+
+    assert global_state().engine._native, (
+        "eager engine should run on the native control plane")
+
+
+def test_many_async_submissions_one_cycle(hvd):
+    # Submissions landing within one 5 ms cycle get fused by the native
+    # controller; all must resolve correctly regardless of binning.
+    n = hvd.size()
+    handles = []
+    for i in range(12):
+        xs = [np.full((32,), r * (i + 1), np.float32) for r in range(n)]
+        handles.append(hvd.allreduce_async(xs, name=f"fuse.{i}", op=hvd.Sum))
+    for i, h in enumerate(handles):
+        out = hvd.synchronize(h)
+        expected = sum(range(n)) * (i + 1)
+        np.testing.assert_allclose(np.asarray(out[0]), expected)
+
+
+def test_native_duplicate_name(hvd):
+    from horovod_tpu.common.exceptions import DuplicateTensorNameError
+
+    xs = [np.ones((4,), np.float32) for _ in range(hvd.size())]
+    h = hvd.allreduce_async(xs, name="ndup")
+    with pytest.raises(DuplicateTensorNameError):
+        hvd.allreduce_async(xs, name="ndup")
+    hvd.synchronize(h)
+
+
+def test_mixed_ops_in_flight(hvd):
+    n = hvd.size()
+    a = hvd.allreduce_async(
+        [np.full((8,), r, np.float32) for r in range(n)], name="m.ar",
+        op=hvd.Sum)
+    b = hvd.broadcast_async(
+        [np.full((8,), r, np.float32) for r in range(n)], 2, name="m.bc")
+    c = hvd.allgather_async(
+        [np.full((2, 3), r, np.float32) for r in range(n)], name="m.ag")
+    np.testing.assert_allclose(np.asarray(hvd.synchronize(a)[0]),
+                               sum(range(n)))
+    np.testing.assert_allclose(np.asarray(hvd.synchronize(b)[0]), 2)
+    assert np.asarray(hvd.synchronize(c)).shape == (2 * n, 3)
+
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    from horovod_tpu.common import native as hn
+
+    rank = int(sys.argv[1]); size = int(sys.argv[2])
+    port = int(sys.argv[3])
+    core = hn.NativeCore()
+    assert core.available
+    ok = core.init(rank=rank, size=size, local_rank=0, local_size=1,
+                   cross_rank=rank, cross_size=size,
+                   coordinator_addr="127.0.0.1", coordinator_port=port,
+                   my_host="127.0.0.1", cycle_time_ms=1.0,
+                   fusion_threshold=64 << 20, cache_capacity=64,
+                   stall_warning_sec=60.0, stall_shutdown_sec=0.0,
+                   stall_check_enabled=True,
+                   exec_callback=lambda resp, rid: core.response_done(
+                       rid, False, "no xla executor in this test"))
+    assert ok, "native init failed"
+
+    # host-plane fused allreduce (two tensors, same dtype -> one response)
+    a = np.full(1000, float(rank + 1), np.float32)
+    b = np.arange(100, dtype=np.float32) * (rank + 1)
+    ha = core.enqueue("t.a", hn.OP_ALLREDUCE, 1, 7, a.shape,
+                      data_ptr=a.ctypes.data, output_ptr=a.ctypes.data,
+                      plane=hn.PLANE_HOST)
+    hb = core.enqueue("t.b", hn.OP_ALLREDUCE, 1, 7, b.shape,
+                      data_ptr=b.ctypes.data, output_ptr=b.ctypes.data,
+                      plane=hn.PLANE_HOST)
+    r, err = core.wait(ha); assert r == 1, err
+    r, err = core.wait(hb); assert r == 1, err
+    expect_a = sum(range(1, size + 1))
+    assert np.allclose(a, expect_a), a[:4]
+    assert np.allclose(b, np.arange(100) * sum(range(1, size + 1))), b[:4]
+
+    # broadcast from rank 1
+    c = np.full(17, float(rank * 10), np.float64)
+    hc = core.enqueue("t.c", hn.OP_BROADCAST, 1, 8, c.shape,
+                      data_ptr=c.ctypes.data, output_ptr=c.ctypes.data,
+                      root_rank=1, plane=hn.PLANE_HOST)
+    r, err = core.wait(hc); assert r == 1, err
+    assert np.allclose(c, 10.0), c[:4]
+
+    # allgather (equal shapes)
+    d = np.full(5, float(rank), np.float32)
+    out = np.zeros(5 * size, np.float32)
+    hd = core.enqueue("t.d", hn.OP_ALLGATHER, 1, 7, d.shape,
+                      data_ptr=d.ctypes.data, output_ptr=out.ctypes.data,
+                      plane=hn.PLANE_HOST)
+    r, err = core.wait(hd); assert r == 1, err
+    for rr in range(size):
+        assert np.allclose(out[rr * 5:(rr + 1) * 5], rr), out
+
+    # adasum (power-of-two world): compare against the pairwise-recursion
+    # oracle computed from the known per-rank inputs.
+    e = np.array([1.0, 2.0, 3.0], np.float32) * (rank + 1)
+    he = core.enqueue("t.e", hn.OP_ALLREDUCE, 2, 7, e.shape,
+                      data_ptr=e.ctypes.data, output_ptr=e.ctypes.data,
+                      plane=hn.PLANE_HOST)
+    r, err = core.wait(he); assert r == 1, err
+    from horovod_tpu.ops.adasum import adasum_reference
+    expected_e = adasum_reference(
+        [np.array([1.0, 2.0, 3.0]) * (rr + 1) for rr in range(size)])
+    assert np.allclose(e, expected_e, rtol=1e-4), (e, expected_e)
+
+    # bf16 allreduce with fp32 accumulation (dtype code 10)
+    f32 = np.full(8, 1.0 + 2 ** -9, np.float32)
+    bf = ((f32.view(np.uint32) + 0x7FFF + ((f32.view(np.uint32) >> 16) & 1))
+          >> 16).astype(np.uint16)
+    hf = core.enqueue("t.f", hn.OP_ALLREDUCE, 1, 10, bf.shape,
+                      data_ptr=bf.ctypes.data, output_ptr=bf.ctypes.data,
+                      plane=hn.PLANE_HOST)
+    r, err = core.wait(hf); assert r == 1, err
+    back = (bf.astype(np.uint32) << 16).view(np.float32)
+    assert np.allclose(back, size * (1.0 + 2 ** -9), rtol=1e-2), back
+
+    # dtype-mismatch across ranks -> coordinator validation error
+    g = (np.full(4, 1.0, np.float32) if rank == 0
+         else np.full(4, 1.0, np.float64))
+    hg = core.enqueue("t.g", hn.OP_ALLREDUCE, 1, 7 if rank == 0 else 8,
+                      g.shape, data_ptr=g.ctypes.data,
+                      output_ptr=g.ctypes.data, plane=hn.PLANE_HOST)
+    r, err = core.wait(hg)
+    assert r == -1 and "Mismatched data types" in err, (r, err)
+
+    core.shutdown()
+    print(f"WORKER_{rank}_OK")
+""")
+
+
+@pytest.mark.parametrize("size", [2, 4])
+def test_multiprocess_tcp_controller_and_ring(size, tmp_path):
+    port = _free_port()
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    env = dict(os.environ)
+    env["HVD_REPO"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    procs = [
+        subprocess.Popen([sys.executable, str(script), str(r), str(size),
+                          str(port)], env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+        for r in range(size)
+    ]
+    outs = []
+    for r, p in enumerate(procs):
+        out, _ = p.communicate(timeout=120)
+        outs.append(out)
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"WORKER_{r}_OK" in out, out
+
+
+def test_ragged_host_allgather_rejected(tmp_path):
+    # Ranks submit allgathers with differing first dimensions: the
+    # coordinator must deliver a loud validation error, not mis-index.
+    import textwrap as tw
+
+    size = 2
+    port = _free_port()
+    code = tw.dedent("""
+        import os, sys
+        import numpy as np
+        sys.path.insert(0, os.environ["HVD_REPO"])
+        from horovod_tpu.common import native as hn
+        rank = int(sys.argv[1]); port = int(sys.argv[2])
+        core = hn.NativeCore()
+        assert core.init(rank=rank, size=2, local_rank=0, local_size=1,
+            cross_rank=rank, cross_size=2, coordinator_addr="127.0.0.1",
+            coordinator_port=port, my_host="127.0.0.1", cycle_time_ms=1.0,
+            fusion_threshold=64 << 20, cache_capacity=64,
+            stall_warning_sec=60.0, stall_shutdown_sec=0.0,
+            stall_check_enabled=True,
+            exec_callback=lambda r, i: core.response_done(i, False, "n/a"))
+        n = 3 if rank == 0 else 5
+        d = np.ones(n, np.float32)
+        out = np.zeros(16, np.float32)
+        h = core.enqueue("rag", hn.OP_ALLGATHER, 1, 7, d.shape,
+                         data_ptr=d.ctypes.data, output_ptr=out.ctypes.data,
+                         plane=hn.PLANE_HOST)
+        r, err = core.wait(h)
+        assert r == -1 and "equal first dimensions" in err, (r, err)
+        core.shutdown()
+        print(f"RAGGED_{rank}_OK")
+    """)
+    script = tmp_path / "ragged.py"
+    script.write_text(code)
+    env = dict(os.environ)
+    env["HVD_REPO"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(r), str(port)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for r in range(size)]
+    for r, p in enumerate(procs):
+        out, _ = p.communicate(timeout=60)
+        assert p.returncode == 0 and f"RAGGED_{r}_OK" in out, out

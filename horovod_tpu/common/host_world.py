@@ -1,0 +1,222 @@
+"""Process-level collective world for host (CPU) tensors.
+
+The JAX-native API counts TPU *chips* as participants (``common/state.py``).
+Framework bindings for host-resident tensors (PyTorch, TensorFlow CPU paths)
+instead follow the reference's model: one *process* per rank
+(``horovod/torch/mpi_ops.py``), with collectives running on the native host
+data plane — the C++ ring over TCP (``csrc/hvd/ring_ops.cc``), our
+TPU-native replacement for the reference's MPI/Gloo CPU ops
+(``ops/mpi_operations.cc``, ``ops/gloo_operations.cc``).
+
+A single ``NativeCore`` (one controller world) is shared with the XLA-plane
+eager engine when both are active in the same process: the controller
+negotiates both planes' tensors in the same cycle loop, exactly as the
+reference's single background thread serves CPU and GPU entries.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from . import config as _config
+from . import logging as _log
+from . import native as _native
+from .exceptions import HorovodInternalError, NotInitializedError
+
+_TORCH_DTYPE_CODES = None  # populated lazily by the torch binding
+
+NUMPY_DTYPE_CODES = dict(_native.DTYPE_CODES)
+
+
+class HostWorld:
+    """Process-rank collective world over the native host data plane."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.initialized = False
+        self.rank = 0
+        self.size = 1
+        self.local_rank = 0
+        self.local_size = 1
+        self.cross_rank = 0
+        self.cross_size = 1
+        self._core: Optional[_native.NativeCore] = None
+        self._owns_core = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def init(self, comm=None):
+        with self._lock:
+            if self.initialized:
+                return
+            self.rank = int(os.environ.get(_config.HOROVOD_RANK, "0"))
+            self.size = int(os.environ.get(_config.HOROVOD_SIZE, "1"))
+            self.local_rank = int(
+                os.environ.get(_config.HOROVOD_LOCAL_RANK, "0"))
+            self.local_size = int(
+                os.environ.get(_config.HOROVOD_LOCAL_SIZE, "1"))
+            self.cross_rank = int(
+                os.environ.get(_config.HOROVOD_CROSS_RANK, str(self.rank)))
+            self.cross_size = int(
+                os.environ.get(_config.HOROVOD_CROSS_SIZE, str(self.size)))
+            if comm is not None:
+                # Parity with hvd.init(comm=[ranks]) (basics.py:33-65):
+                # restrict to a subset of the launched world.
+                if self.rank not in comm:
+                    raise ValueError(
+                        f"process rank {self.rank} not in comm {comm}")
+                self.size = len(comm)
+                self.rank = sorted(comm).index(self.rank)
+
+            core = self._borrow_engine_core()
+            if core is not None:
+                self._core, self._owns_core = core, False
+            elif self.size > 1:
+                self._core = self._init_own_core()
+                if self._core is None:
+                    raise HorovodInternalError(
+                        "multi-process host world requires the native "
+                        "runtime (libhvdtpu.so); build failed or "
+                        "HOROVOD_NATIVE=0")
+                self._owns_core = True
+            else:
+                # size-1 world: every collective is an identity op locally;
+                # no controller or ring needed.
+                self._core = None
+            self.initialized = True
+
+    @staticmethod
+    def _borrow_engine_core():
+        from . import state as _state
+
+        st = _state.global_state()
+        if st.initialized and st.engine is not None and \
+                getattr(st.engine, "_native", False):
+            return st.engine._core
+        return None
+
+    def _try_init_core(self, core) -> bool:
+        cfg = _config.RuntimeConfig.from_env()
+        addr = os.environ.get(_config.HOROVOD_CONTROLLER_ADDR, "127.0.0.1")
+        base_port = int(
+            os.environ.get(_config.HOROVOD_CONTROLLER_PORT, "29500"))
+        my_host = os.environ.get("HOROVOD_HOSTNAME", "127.0.0.1")
+
+        def reject_xla(responses, rid):
+            core.response_done(rid, False,
+                               "no XLA executor in host-only world")
+
+        return core.init(
+            rank=self.rank, size=self.size, local_rank=self.local_rank,
+            local_size=self.local_size, cross_rank=self.cross_rank,
+            cross_size=self.cross_size, coordinator_addr=addr,
+            coordinator_port=base_port + 1, my_host=my_host,
+            cycle_time_ms=cfg.cycle_time_ms,
+            fusion_threshold=cfg.fusion_threshold_bytes,
+            cache_capacity=cfg.cache_capacity,
+            stall_warning_sec=cfg.stall_warning_seconds,
+            stall_shutdown_sec=cfg.stall_shutdown_seconds,
+            stall_check_enabled=not cfg.stall_check_disable,
+            exec_callback=reject_xla)
+
+    def _init_own_core(self):
+        core = _native.NativeCore()
+        if not core.available:
+            return None
+        return core if self._try_init_core(core) else None
+
+    def shutdown(self):
+        with self._lock:
+            if not self.initialized:
+                return
+            if self._core is not None and self._owns_core:
+                self._core.shutdown()
+            self._core = None
+            self.initialized = False
+            self.rank, self.size = 0, 1
+            self.local_rank, self.local_size = 0, 1
+            self.cross_rank, self.cross_size = 0, 1
+
+    def require_init(self):
+        if not self.initialized:
+            raise NotInitializedError("host collective API")
+
+    @property
+    def native(self) -> bool:
+        return self._core is not None
+
+    # -- raw buffer collectives ---------------------------------------------
+
+    def enqueue(self, name: str, op: int, reduce_op: int, dtype_code: int,
+                shape: Tuple[int, ...], data_ptr: int, output_ptr: int,
+                root_rank: int = -1, prescale: float = 1.0,
+                postscale: float = 1.0) -> int:
+        self.require_init()
+        if self._core is None:
+            raise HorovodInternalError(
+                "native host plane unavailable in this process")
+        return self._core.enqueue(
+            name, op, reduce_op, dtype_code, shape, data_ptr=data_ptr,
+            output_ptr=output_ptr, root_rank=root_rank, prescale=prescale,
+            postscale=postscale, plane=_native.PLANE_HOST)
+
+    def test(self, handle: int) -> Tuple[int, str]:
+        return self._core.test(handle)
+
+    def wait(self, handle: int) -> Tuple[int, str]:
+        return self._core.wait(handle)
+
+    # -- small helper collectives (numpy, blocking) --------------------------
+
+    def allgather_np(self, arr: np.ndarray, name: str) -> np.ndarray:
+        """Blocking equal-shape allgather of a small numpy array."""
+        self.require_init()
+        if self.size == 1:
+            return arr.copy()
+        arr = np.ascontiguousarray(arr)
+        out = np.zeros((self.size,) + arr.shape, dtype=arr.dtype)
+        code = NUMPY_DTYPE_CODES[str(arr.dtype)]
+        h = self.enqueue(name, _native.OP_ALLGATHER, 1, code, arr.shape,
+                         arr.ctypes.data, out.ctypes.data)
+        r, err = self.wait(h)
+        if r < 0:
+            raise HorovodInternalError(err)
+        return out
+
+    def broadcast_np(self, arr: np.ndarray, root_rank: int,
+                     name: str) -> np.ndarray:
+        self.require_init()
+        if self.size == 1:
+            return arr.copy()
+        arr = np.ascontiguousarray(arr)
+        out = arr.copy()
+        code = NUMPY_DTYPE_CODES[str(arr.dtype)]
+        h = self.enqueue(name, _native.OP_BROADCAST, 1, code, arr.shape,
+                         arr.ctypes.data, out.ctypes.data,
+                         root_rank=root_rank)
+        r, err = self.wait(h)
+        if r < 0:
+            raise HorovodInternalError(err)
+        return out
+
+    def barrier(self, name: str = "host.barrier"):
+        self.require_init()
+        if self.size == 1 or self._core is None:
+            return
+        z = np.zeros(1, np.uint8)
+        h = self.enqueue(name, _native.OP_BARRIER, 1, 0, z.shape,
+                         z.ctypes.data, z.ctypes.data)
+        r, err = self.wait(h)
+        if r < 0:
+            raise HorovodInternalError(err)
+
+
+_world = HostWorld()
+
+
+def world() -> HostWorld:
+    return _world

@@ -73,6 +73,15 @@ bool Controller::ValidateGroup(const std::string& name,
       error = "Mismatched reduce ops for tensor '" + name + "'";
       break;
     }
+    if (r.plane != first.plane) {
+      error = "Mismatched device planes for tensor '" + name + "'";
+      break;
+    }
+    if (r.prescale != first.prescale || r.postscale != first.postscale) {
+      error = "Mismatched prescale/postscale factors for tensor '" + name +
+              "'";
+      break;
+    }
   }
 
   out->op = first.op;
@@ -309,7 +318,6 @@ std::vector<Response> TcpController::CoordinatorCycle(
       stall_.RecordRank(q.name, q.rank);
       auto& group = pending_[q.name];
       group.push_back(q);
-      pending_count_[q.name] = static_cast<int>(group.size());
     }
     for (auto id : ids) {
       Request q;
@@ -318,8 +326,7 @@ std::vector<Response> TcpController::CoordinatorCycle(
         stall_.RecordRank(q.name, q.rank);
         auto& group = pending_[q.name];
         group.push_back(q);
-        pending_count_[q.name] = static_cast<int>(group.size());
-      }
+        }
     }
   };
 
@@ -364,7 +371,6 @@ std::vector<Response> TcpController::CoordinatorCycle(
             });
   for (auto& n : done) {
     pending_.erase(n);
-    pending_count_.erase(n);
     stall_.Remove(n);
   }
 

@@ -121,7 +121,6 @@ class TcpController : public Controller {
 
   // Coordinator negotiation state: name -> per-rank requests seen so far.
   std::unordered_map<std::string, std::vector<Request>> pending_;
-  std::unordered_map<std::string, int> pending_count_;
   std::vector<bool> shutdown_ranks_;
   StallInspector stall_;
   ResponseCache cache_;  // symmetric ids on all ranks (see CacheResponses)

@@ -23,6 +23,11 @@ inline float Bf16ToFloat(uint16_t b) {
 inline uint16_t FloatToBf16(float f) {
   uint32_t bits;
   std::memcpy(&bits, &f, 4);
+  if ((bits & 0x7FFFFFFFu) > 0x7F800000u) {
+    // NaN: rounding could carry into the exponent and produce +-inf;
+    // return a quiet NaN with the sign preserved instead.
+    return static_cast<uint16_t>((bits >> 16) | 0x0040u);
+  }
   // round-to-nearest-even on the dropped 16 bits
   uint32_t rounding = 0x7FFF + ((bits >> 16) & 1);
   return static_cast<uint16_t>((bits + rounding) >> 16);

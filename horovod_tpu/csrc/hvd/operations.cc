@@ -233,8 +233,13 @@ void BackgroundLoop() {
   while (RunLoopOnce(last)) {
   }
   auto* s = g();
-  s->tensor_queue.FinalizeWith(
-      Status::Aborted("horovod_tpu runtime has been shut down"));
+  // Resolve every still-queued handle so no waiter blocks forever when a
+  // peer failure (stall shutdown) or hvd_shutdown ends the loop.
+  Status aborted = Status::Aborted("horovod_tpu runtime has been shut down");
+  for (auto& e : s->tensor_queue.DrainAll()) {
+    s->handles.MarkDone(e.handle, aborted);
+    if (e.callback) e.callback(aborted);
+  }
   s->controller->Finalize();
   s->loop_done.store(true);
 }
@@ -256,7 +261,11 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
              int stall_check_enabled) {
   auto* s = hvd::g();
   std::lock_guard<std::mutex> lk(s->init_mu);
-  if (s->initialized.load()) return 0;
+  if (s->initialized.load()) {
+    // Re-init with an identical world is a no-op; a different world is a
+    // caller bug that must not be silently ignored.
+    return (rank == s->rank && size == s->size) ? 0 : -2;
+  }
   s->rank = rank;
   s->size = size;
   s->local_rank = local_rank;

@@ -1,5 +1,21 @@
 #include "response_cache.h"
 
+#include <cstdio>
+#include <cstring>
+
+namespace {
+// Bit-exact key text for a double: std::to_string's fixed 6 decimals would
+// collide distinct small scale factors and replay stale cached responses.
+std::string DoubleKey(double v) {
+  uint64_t b;
+  std::memcpy(&b, &v, 8);
+  char buf[17];
+  std::snprintf(buf, sizeof(buf), "%016llx",
+                static_cast<unsigned long long>(b));
+  return std::string(buf);
+}
+}  // namespace
+
 namespace hvd {
 
 const uint32_t ResponseCache::kInvalid;
@@ -21,9 +37,9 @@ std::string ResponseCache::Key(const Request& req) {
     k += std::to_string(d);
     k += ',';
   }
-  k += std::to_string(req.prescale);
+  k += DoubleKey(req.prescale);
   k += '/';
-  k += std::to_string(req.postscale);
+  k += DoubleKey(req.postscale);
   return k;
 }
 

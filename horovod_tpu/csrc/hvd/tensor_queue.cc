@@ -52,17 +52,13 @@ size_t TensorQueue::PendingCount() {
   return table_.size();
 }
 
-void TensorQueue::FinalizeWith(const Status& status) {
+std::vector<TensorTableEntry> TensorQueue::DrainAll() {
   std::vector<TensorTableEntry> entries;
-  {
-    std::lock_guard<std::mutex> lk(mu_);
-    for (auto& kv : table_) entries.push_back(std::move(kv.second));
-    table_.clear();
-    queue_.clear();
-  }
-  for (auto& e : entries) {
-    if (e.callback) e.callback(status);
-  }
+  std::lock_guard<std::mutex> lk(mu_);
+  for (auto& kv : table_) entries.push_back(std::move(kv.second));
+  table_.clear();
+  queue_.clear();
+  return entries;
 }
 
 }  // namespace hvd

@@ -37,7 +37,8 @@ class TensorQueue {
 
   // Abort everything pending (elastic reset / shutdown): every callback
   // fires with ABORTED.
-  void FinalizeWith(const Status& status);
+  // Drain every queued entry (shutdown path); caller resolves handles.
+  std::vector<TensorTableEntry> DrainAll();
 
  private:
   std::mutex mu_;

@@ -365,9 +365,9 @@ class EagerEngine:
                 root=-1) -> int:
         name = name or self._auto_name(kind)
         timeline = self._state.timeline
-        if timeline:
-            timeline.start_activity(name, f"NEGOTIATE_{kind.upper()}")
         if self._native:
+            if timeline:
+                timeline.start_activity(name, f"NEGOTIATE_{kind.upper()}")
             with self._lock:
                 if name in self._pending:
                     raise DuplicateTensorNameError(
@@ -381,6 +381,14 @@ class EagerEngine:
                 self._dtype_code(stacked), tuple(stacked.shape[1:]),
                 root_rank=root, prescale=prescale, postscale=postscale,
                 plane=_native.PLANE_XLA)
+            if handle < 0:
+                # Negative returns are error codes, not handles — they would
+                # collide with the direct-handle namespace below.
+                with self._lock:
+                    self._pending.pop(name, None)
+                raise HorovodInternalError(
+                    "native enqueue failed (runtime not initialized or "
+                    "shutting down)")
             # Duplicate detection also lives in the native queue; surface
             # its synchronous rejection as the parity exception.
             r, reason = self._core.test(handle)
@@ -543,6 +551,13 @@ class EagerEngine:
                 name = self._handle_names.pop(handle)
                 pending = self._pending.pop(name, None)
             if r < 0:
+                # Coordinator-error responses resolve entirely in C++ and
+                # never reach _execute_response, so close the open
+                # negotiation span here.
+                timeline = self._state.timeline
+                if timeline and pending is not None:
+                    timeline.end_activity(
+                        name, f"NEGOTIATE_{pending.kind.upper()}")
                 raise HorovodInternalError(reason)
             if pending is None or (pending.result is None
                                    and pending.error is None):

@@ -1,0 +1,65 @@
+"""``import horovod_tpu.torch as hvd`` — the PyTorch binding.
+
+Mirrors the reference's ``horovod/torch/__init__.py`` public surface:
+init/rank/size family, allreduce/allgather/broadcast (+async/in-place),
+``DistributedOptimizer``, broadcast_parameters/optimizer_state/object,
+``Compression``, ``SyncBatchNorm``, and ``hvd.elastic`` — on the native
+TCP-ring host plane (see ``mpi_ops.py`` for the architecture note).
+"""
+
+from ..common.exceptions import (  # noqa: F401
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+)
+from ..common.state import (  # noqa: F401
+    ccl_built,
+    ddl_built,
+    gloo_built,
+    gloo_enabled,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
+    xla_built,
+)
+from .mpi_ops import (  # noqa: F401
+    Adasum,
+    Average,
+    Max,
+    Min,
+    ReduceOp,
+    Sum,
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_,
+    allreduce_async,
+    allreduce_async_,
+    barrier,
+    broadcast,
+    broadcast_,
+    broadcast_async,
+    broadcast_async_,
+    cross_rank,
+    cross_size,
+    init,
+    is_initialized,
+    join,
+    local_rank,
+    local_size,
+    poll,
+    rank,
+    shutdown,
+    size,
+    synchronize,
+)
+from .compression import Compression  # noqa: F401
+from .functions import (  # noqa: F401
+    allgather_object,
+    broadcast_object,
+    broadcast_optimizer_state,
+    broadcast_parameters,
+)
+from .optimizer import DistributedOptimizer  # noqa: F401
+from .sync_batch_norm import SyncBatchNorm  # noqa: F401
+from . import elastic  # noqa: F401

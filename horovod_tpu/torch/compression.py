@@ -1,0 +1,66 @@
+"""Gradient compression (parity: ``horovod/torch/compression.py:46``).
+
+On TPU the natural wire format is bfloat16 (MXU-native); fp16 is kept for
+reference-script compatibility.
+"""
+
+import torch
+
+
+class Compressor:
+    """Interface: ``compress(tensor) -> (tensor, ctx)``,
+    ``decompress(tensor, ctx) -> tensor``."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        if tensor.dtype.is_floating_point:
+            return tensor.type(torch.float16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor.type(ctx) if ctx is not None else tensor
+
+
+class BF16Compressor(Compressor):
+    """TPU-native extension: bfloat16 wire format (same exponent range as
+    fp32, no overflow scaling needed)."""
+
+    @staticmethod
+    def compress(tensor):
+        if tensor.dtype.is_floating_point:
+            return tensor.type(torch.bfloat16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor.type(ctx) if ctx is not None else tensor
+
+
+class Compression:
+    """Option enum (parity: reference ``Compression.none`` /
+    ``Compression.fp16``)."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -1,0 +1,91 @@
+"""Elastic state for PyTorch (parity: ``torch/elastic.py:23-90``)."""
+
+from __future__ import annotations
+
+import copy
+import functools
+
+from ..common import logging as _log
+from ..common.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from ..elastic.state import ObjectState, State
+from . import mpi_ops as _ops
+from .functions import broadcast_object, broadcast_optimizer_state, \
+    broadcast_parameters
+
+
+class TorchState(ObjectState):
+    """Elastic state tracking a torch model + optimizer plus arbitrary
+    picklable attributes. ``sync()`` broadcasts from the coordinator after
+    a membership change; ``restore()`` rolls back to the last commit."""
+
+    def __init__(self, model=None, optimizer=None, **kwargs):
+        self.model = model
+        self.optimizer = optimizer
+        self._saved_model_state = None
+        self._saved_optimizer_state = None
+        super().__init__(bcast_object=broadcast_object, **kwargs)
+
+    def _public_attrs(self):
+        return {
+            k: v
+            for k, v in self.__dict__.items()
+            if not k.startswith("_") and k not in ("model", "optimizer")
+        }
+
+    def save(self):
+        if self.model is not None:
+            self._saved_model_state = copy.deepcopy(self.model.state_dict())
+        if self.optimizer is not None:
+            self._saved_optimizer_state = copy.deepcopy(
+                self.optimizer.state_dict())
+        super().save()
+
+    def restore(self):
+        if self.model is not None and self._saved_model_state is not None:
+            self.model.load_state_dict(self._saved_model_state)
+        if self.optimizer is not None and \
+                self._saved_optimizer_state is not None:
+            self.optimizer.load_state_dict(self._saved_optimizer_state)
+        super().restore()
+
+    def sync(self):
+        if self.model is not None:
+            broadcast_parameters(self.model.state_dict(), root_rank=0)
+        if self.optimizer is not None:
+            broadcast_optimizer_state(self.optimizer, root_rank=0)
+        super().sync()
+
+
+def run(func):
+    """Elastic retry loop for torch training functions (parity:
+    ``torch/elastic.py:23`` + ``common/elastic.py:147-168``): catches
+    ``HorovodInternalError`` (restore + reinit) and
+    ``HostsUpdatedInterrupt`` (reinit), re-initializing the *process-rank*
+    world."""
+
+    @functools.wraps(func)
+    def wrapper(state: State, *args, **kwargs):
+        reset_required = False
+        skip_sync = False
+        while True:
+            if reset_required:
+                _ops.shutdown()
+                _ops.init()
+                state.on_reset()
+                reset_required = False
+            if not skip_sync:
+                state.sync()
+            skip_sync = False
+            try:
+                return func(state, *args, **kwargs)
+            except HorovodInternalError:
+                _log.warning(
+                    "collective failure: restoring last committed state")
+                state.restore()
+                reset_required = True
+            except HostsUpdatedInterrupt as e:
+                _log.info("host membership changed: re-initializing")
+                reset_required = True
+                skip_sync = e.skip_sync
+
+    return wrapper

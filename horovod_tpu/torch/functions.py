@@ -1,0 +1,107 @@
+"""High-level broadcast helpers (parity: ``torch/functions.py:30-226``)."""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Iterable, Optional
+
+import numpy as np
+import torch
+
+from ..common.host_world import world as _world
+from . import mpi_ops as _ops
+
+
+def broadcast_parameters(params, root_rank: int = 0):
+    """Broadcast model parameters from ``root_rank`` to all processes.
+
+    Accepts a ``state_dict`` (mapping) or an iterable of
+    ``(name, tensor)`` pairs, like the reference."""
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    else:
+        items = list(params)
+    handles = []
+    for name, p in items:
+        if p is None:
+            continue
+        if not isinstance(p, torch.Tensor):
+            continue  # non-tensor state entries are synced via state dicts
+        handles.append(_ops.broadcast_async_(p.data, root_rank,
+                                             name=f"bcast.param.{name}"))
+    for h in handles:
+        _ops.synchronize(h)
+
+
+def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
+                              root_rank: int = 0):
+    """Broadcast optimizer state from ``root_rank``.
+
+    The reference reconstructs scalar hyperparameters tensor-by-tensor
+    (``torch/functions.py:84-183``); pickling the state dict through
+    ``broadcast_object`` gives identical results with one code path for
+    every optimizer type, so that is the native design here. Tensor state
+    (momentum buffers, exp_avg, ...) is broadcast in place to avoid
+    re-allocating on non-root ranks."""
+    if isinstance(optimizer, torch.optim.LBFGS):
+        raise ValueError("cannot broadcast torch.optim.LBFGS state")
+    if _ops.size() == 1:
+        return
+    state_dict = optimizer.state_dict()
+    # Hyperparameters + param-group structure via object broadcast.
+    meta = {
+        "param_groups": state_dict["param_groups"],
+        "state_keys": sorted(
+            (k, sorted(v.keys())) for k, v in state_dict["state"].items()),
+    }
+    meta = broadcast_object(meta, root_rank, name="bcast.opt.meta")
+    if _ops.rank() != root_rank:
+        state_dict["param_groups"] = meta["param_groups"]
+    # Tensor state in place where shapes already match; otherwise via
+    # object broadcast (covers non-root ranks before the first step()).
+    synced_state = broadcast_object(
+        {k: v for k, v in state_dict["state"].items()}, root_rank,
+        name="bcast.opt.state")
+    if _ops.rank() != root_rank:
+        state_dict["state"] = synced_state
+        optimizer.load_state_dict(state_dict)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0,
+                     name: Optional[str] = None) -> Any:
+    """Broadcast an arbitrary picklable object (parity:
+    ``torch/functions.py:185-226``)."""
+    name = name or "bcast.object"
+    if _ops.size() == 1:
+        return obj
+    w = _world()
+    if _ops.rank() == root_rank:
+        payload = pickle.dumps(obj)
+        length = np.asarray([len(payload)], np.int64)
+    else:
+        payload = b""
+        length = np.zeros(1, np.int64)
+    length = w.broadcast_np(length, root_rank, name + ".len")
+    buf = np.zeros(int(length[0]), np.uint8)
+    if _ops.rank() == root_rank:
+        buf[:] = np.frombuffer(payload, np.uint8)
+    buf = w.broadcast_np(buf, root_rank, name + ".data")
+    return pickle.loads(buf.tobytes())
+
+
+def allgather_object(obj: Any, name: Optional[str] = None) -> list:
+    """Gather a picklable object from every rank (capability extension;
+    the reference gained this post-0.19)."""
+    name = name or "allgather.object"
+    if _ops.size() == 1:
+        return [obj]
+    w = _world()
+    payload = np.frombuffer(pickle.dumps(obj), np.uint8)
+    length = w.allgather_np(np.asarray([len(payload)], np.int64),
+                            name + ".len")[:, 0]
+    maxlen = int(length.max())
+    padded = np.zeros(maxlen, np.uint8)
+    padded[: len(payload)] = payload
+    gathered = w.allgather_np(padded, name + ".data")
+    return [pickle.loads(gathered[r, : int(length[r])].tobytes())
+            for r in range(w.size)]

@@ -1,0 +1,427 @@
+"""PyTorch binding: asynchronous collective ops on the native host plane.
+
+Capability parity with the reference's ``horovod/torch/mpi_ops.py:91-538``
+(allreduce/allgather/broadcast + ``_async``/in-place variants, autograd
+integration, ``poll``/``synchronize``/``join``), re-architected TPU-native:
+instead of a pybind11 module dispatching per-dtype C++ functions into an
+MPI/NCCL background thread (``torch/mpi_ops_v2.cc:53-265``), torch CPU
+tensors ride the native C++ ring data plane over TCP
+(``csrc/hvd/ring_ops.cc``) negotiated by the same controller/cycle loop that
+serves the XLA plane. Ranks are *processes*, exactly as in the reference —
+one training process per rank, spawned by ``horovod_tpu.run``.
+
+Handles are small ints resolved by a Python handle table (the
+``HandleManager`` role, reference ``torch/handle_manager.{h,cc}``) backed by
+the native handle futures.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import suppress
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+import torch
+
+from ..common import native as _native
+from ..common.exceptions import HorovodInternalError
+from ..common.host_world import world as _world
+from ..ops.xla import Adasum, Average, Max, Min, ReduceOp, Sum  # noqa: F401
+
+__all__ = [
+    "init", "shutdown", "rank", "size", "local_rank", "local_size",
+    "cross_rank", "cross_size", "is_initialized",
+    "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
+    "allgather", "allgather_async", "broadcast", "broadcast_",
+    "broadcast_async", "broadcast_async_", "poll", "synchronize", "join",
+    "barrier", "Average", "Sum", "Adasum", "Min", "Max", "ReduceOp",
+]
+
+TORCH_DTYPE_CODES = {
+    torch.uint8: 0,
+    torch.int8: 1,
+    torch.int32: 4,
+    torch.int64: 5,
+    torch.float16: 6,
+    torch.float32: 7,
+    torch.float64: 8,
+    torch.bool: 9,
+    torch.bfloat16: 10,
+}
+
+
+def init(comm=None):
+    """Initialize the process-rank world (parity: ``hvd.init()``)."""
+    _world().init(comm=comm)
+
+
+def shutdown():
+    _world().shutdown()
+
+
+def is_initialized() -> bool:
+    return _world().initialized
+
+
+def rank() -> int:
+    _world().require_init()
+    return _world().rank
+
+
+def size() -> int:
+    _world().require_init()
+    return _world().size
+
+
+def local_rank() -> int:
+    _world().require_init()
+    return _world().local_rank
+
+
+def local_size() -> int:
+    _world().require_init()
+    return _world().local_size
+
+
+def cross_rank() -> int:
+    _world().require_init()
+    return _world().cross_rank
+
+
+def cross_size() -> int:
+    _world().require_init()
+    return _world().cross_size
+
+
+# ---- handle table -----------------------------------------------------------
+
+
+class _Handle:
+    __slots__ = ("native", "output", "post", "result", "error",
+                 "keepalive")
+
+    def __init__(self, native: Optional[int], output, post: Optional[Callable],
+                 result=None, error=None):
+        self.native = native
+        self.output = output
+        self.post = post
+        self.result = result
+        self.error = error
+        self.keepalive = None
+
+
+_handles: Dict[int, _Handle] = {}
+_handles_lock = threading.Lock()
+_next_handle = 0
+_name_counter = 0
+_name_lock = threading.Lock()
+
+
+def _new_handle(entry: _Handle) -> int:
+    global _next_handle
+    with _handles_lock:
+        h = _next_handle
+        _next_handle += 1
+        _handles[h] = entry
+        return h
+
+
+def _auto_name(prefix: str) -> str:
+    global _name_counter
+    with _name_lock:
+        _name_counter += 1
+        return f"torch.{prefix}.noname.{_name_counter}"
+
+
+def _check_tensor(tensor: torch.Tensor) -> torch.Tensor:
+    if tensor.device.type != "cpu":
+        raise ValueError(
+            "horovod_tpu.torch operates on host (CPU) tensors; device "
+            f"tensors belong on the XLA plane (got {tensor.device})")
+    if tensor.dtype not in TORCH_DTYPE_CODES:
+        raise ValueError(f"unsupported torch dtype {tensor.dtype}")
+    return tensor.contiguous()
+
+
+def _resolve_op(op: Optional[int], average: Optional[bool]) -> int:
+    """Back-compat shim for the deprecated ``average`` argument (parity:
+    ``common/util.py`` handle_average_backwards_compatibility)."""
+    if average is not None:
+        if op is not None:
+            raise ValueError("specify either op or average, not both")
+        return Average if average else Sum
+    return Sum if op is None else op
+
+
+# ---- core submissions -------------------------------------------------------
+
+
+def _submit_allreduce(tensor: torch.Tensor, output: torch.Tensor, name: str,
+                      op: int, prescale_factor: float,
+                      postscale_factor: float) -> int:
+    w = _world()
+    w.require_init()
+    n = w.size
+    if op == Adasum and (n & (n - 1)) != 0:
+        raise ValueError("Adasum requires a power-of-two world size")
+    if w.size == 1 or not w.native:
+        out = tensor.to(torch.float64) * prescale_factor
+        if op not in (Min, Max):
+            out = out * postscale_factor
+        output.copy_(out.to(tensor.dtype))
+        return _new_handle(_Handle(None, output, None, result=output))
+    code = TORCH_DTYPE_CODES[tensor.dtype]
+    h = w.enqueue(name, _native.OP_ALLREDUCE, op, code,
+                  tuple(tensor.shape), tensor.data_ptr(), output.data_ptr(),
+                  prescale=prescale_factor, postscale=postscale_factor)
+    # The background thread reads the input buffer when the response fires:
+    # both tensors must stay alive until synchronize().
+    entry = _Handle(h, output, None)
+    entry.keepalive = tensor
+    return _new_handle(entry)
+
+
+def allreduce_async(tensor: torch.Tensor, average: Optional[bool] = None,
+                    name: Optional[str] = None, op: Optional[int] = None,
+                    prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0) -> int:
+    tensor = _check_tensor(tensor)
+    output = tensor.clone()
+    return _submit_allreduce(tensor, output, name or _auto_name("allreduce"),
+                             _resolve_op(op, average), prescale_factor,
+                             postscale_factor)
+
+
+def allreduce_async_(tensor: torch.Tensor, average: Optional[bool] = None,
+                     name: Optional[str] = None, op: Optional[int] = None,
+                     prescale_factor: float = 1.0,
+                     postscale_factor: float = 1.0) -> int:
+    t = _check_tensor(tensor)
+    if t.data_ptr() != tensor.data_ptr():
+        raise ValueError("in-place allreduce requires a contiguous tensor")
+    return _submit_allreduce(t, t, name or _auto_name("allreduce_"),
+                             _resolve_op(op, average), prescale_factor,
+                             postscale_factor)
+
+
+class _AllreduceFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, name, op, prescale_factor, postscale_factor):
+        ctx.op = op
+        ctx.prescale_factor = prescale_factor
+        ctx.postscale_factor = postscale_factor
+        return synchronize(allreduce_async(
+            tensor, name=name, op=op, prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        reduced = synchronize(allreduce_async(
+            grad_output, op=ctx.op, prescale_factor=ctx.prescale_factor,
+            postscale_factor=ctx.postscale_factor))
+        return reduced, None, None, None, None
+
+
+def allreduce(tensor: torch.Tensor, average: Optional[bool] = None,
+              name: Optional[str] = None, compression=None,
+              op: Optional[int] = None, prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0) -> torch.Tensor:
+    """Differentiable allreduce (parity: ``torch/mpi_ops.py:162-254``)."""
+    from .compression import Compression
+
+    compression = compression or Compression.none
+    resolved = _resolve_op(op, average)
+    compressed, ctx = compression.compress(tensor)
+    summed = _AllreduceFn.apply(compressed, name, resolved, prescale_factor,
+                                postscale_factor)
+    return compression.decompress(summed, ctx)
+
+
+def allreduce_(tensor: torch.Tensor, average: Optional[bool] = None,
+               name: Optional[str] = None, op: Optional[int] = None,
+               prescale_factor: float = 1.0,
+               postscale_factor: float = 1.0) -> torch.Tensor:
+    return synchronize(allreduce_async_(
+        tensor, average, name, op=op, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor))
+
+
+# ---- allgather --------------------------------------------------------------
+
+
+def _submit_allgather(tensor: torch.Tensor, name: str) -> int:
+    w = _world()
+    w.require_init()
+    if tensor.dim() == 0:
+        tensor = tensor.reshape(1)
+    if w.size == 1 or not w.native:
+        out = tensor.clone()
+        return _new_handle(_Handle(None, out, None, result=out))
+    # The reference supports ragged first dimensions via MPI_Allgatherv
+    # (mpi_operations.cc:140). The native ring allgather is equal-shape, so
+    # the binding exchanges dim-0 sizes first, pads to the max, gathers,
+    # then slices — same user semantics, one extra tiny collective.
+    dim0 = np.asarray([tensor.shape[0]], np.int64)
+    sizes = _world().allgather_np(dim0, name + ".dim0")[:, 0]
+    max0 = int(sizes.max())
+    rest = tuple(tensor.shape[1:])
+    padded = tensor
+    if tensor.shape[0] != max0:
+        padded = torch.zeros((max0,) + rest, dtype=tensor.dtype)
+        padded[: tensor.shape[0]] = tensor
+    padded = padded.contiguous()
+    gathered = torch.zeros((w.size * max0,) + rest, dtype=tensor.dtype)
+    code = TORCH_DTYPE_CODES[tensor.dtype]
+    h = w.enqueue(name, _native.OP_ALLGATHER, 1, code,
+                  tuple(padded.shape), padded.data_ptr(),
+                  gathered.data_ptr())
+
+    def post(out: torch.Tensor) -> torch.Tensor:
+        views = out.view((w.size, max0) + rest)
+        return torch.cat([views[r, : int(sizes[r])] for r in range(w.size)],
+                         dim=0)
+
+    entry = _Handle(h, gathered, post)
+    entry.keepalive = padded
+    return _new_handle(entry)
+
+
+def allgather_async(tensor: torch.Tensor, name: Optional[str] = None) -> int:
+    return _submit_allgather(_check_tensor(tensor),
+                             name or _auto_name("allgather"))
+
+
+class _AllgatherFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, name):
+        ctx.dim0 = tensor.shape[0] if tensor.dim() > 0 else 1
+        return synchronize(allgather_async(tensor, name=name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        # Parity: reference reduces the gathered grad then narrows to this
+        # rank's slice (torch/mpi_ops.py:304-330).
+        w = _world()
+        reduced = synchronize(allreduce_async(grad_output, op=Sum))
+        sizes = _world().allgather_np(
+            np.asarray([ctx.dim0], np.int64),
+            _auto_name("allgather.grad.dim0"))[:, 0] \
+            if w.size > 1 and w.native else np.asarray([ctx.dim0])
+        offset = int(sizes[: w.rank].sum())
+        return reduced.narrow(0, offset, ctx.dim0), None
+
+
+def allgather(tensor: torch.Tensor, name: Optional[str] = None) -> torch.Tensor:
+    return _AllgatherFn.apply(_check_tensor(tensor), name)
+
+
+# ---- broadcast --------------------------------------------------------------
+
+
+def _submit_broadcast(tensor: torch.Tensor, output: torch.Tensor,
+                      root_rank: int, name: str) -> int:
+    w = _world()
+    w.require_init()
+    if w.size == 1 or not w.native:
+        if root_rank != w.rank:
+            raise ValueError(
+                f"root_rank {root_rank} out of range for size {w.size}")
+        if output.data_ptr() != tensor.data_ptr():
+            output.copy_(tensor)
+        return _new_handle(_Handle(None, output, None, result=output))
+    code = TORCH_DTYPE_CODES[tensor.dtype]
+    h = w.enqueue(name, _native.OP_BROADCAST, 1, code, tuple(tensor.shape),
+                  tensor.data_ptr(), output.data_ptr(), root_rank=root_rank)
+    entry = _Handle(h, output, None)
+    entry.keepalive = tensor
+    return _new_handle(entry)
+
+
+def broadcast_async(tensor: torch.Tensor, root_rank: int,
+                    name: Optional[str] = None) -> int:
+    t = _check_tensor(tensor)
+    return _submit_broadcast(t, t.clone(), root_rank,
+                             name or _auto_name("broadcast"))
+
+
+def broadcast_async_(tensor: torch.Tensor, root_rank: int,
+                     name: Optional[str] = None) -> int:
+    t = _check_tensor(tensor)
+    if t.data_ptr() != tensor.data_ptr():
+        raise ValueError("in-place broadcast requires a contiguous tensor")
+    return _submit_broadcast(t, t, root_rank,
+                             name or _auto_name("broadcast_"))
+
+
+class _BroadcastFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, root_rank, name):
+        ctx.root_rank = root_rank
+        return synchronize(broadcast_async(tensor, root_rank, name=name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        reduced = synchronize(allreduce_async(grad_output, op=Sum))
+        if _world().rank != ctx.root_rank:
+            reduced = reduced * 0
+        return reduced, None, None
+
+
+def broadcast(tensor: torch.Tensor, root_rank: int,
+              name: Optional[str] = None) -> torch.Tensor:
+    return _BroadcastFn.apply(_check_tensor(tensor), root_rank, name)
+
+
+def broadcast_(tensor: torch.Tensor, root_rank: int,
+               name: Optional[str] = None) -> torch.Tensor:
+    return synchronize(broadcast_async_(tensor, root_rank, name))
+
+
+# ---- completion -------------------------------------------------------------
+
+
+def poll(handle: int) -> bool:
+    """True when the collective behind ``handle`` has completed (parity:
+    ``torch/mpi_ops.py:481-491``)."""
+    with _handles_lock:
+        entry = _handles.get(handle)
+    if entry is None:
+        raise ValueError(f"unknown handle {handle}")
+    if entry.native is None:
+        return True
+    r, _ = _world().test(entry.native)
+    return r != 0
+
+
+def synchronize(handle: int) -> torch.Tensor:
+    """Block until completion; return the output tensor. Raises
+    ``HorovodInternalError`` on collective failure (the elastic retry
+    loop's trigger, parity: ``torch/mpi_ops.py:497-527``)."""
+    with _handles_lock:
+        entry = _handles.pop(handle, None)
+    if entry is None:
+        raise ValueError(f"unknown or already-synchronized handle {handle}")
+    if entry.native is None:
+        if entry.error is not None:
+            raise HorovodInternalError(str(entry.error))
+        return entry.result
+    r, err = _world().wait(entry.native)
+    if r < 0:
+        raise HorovodInternalError(err)
+    out = entry.output
+    return entry.post(out) if entry.post is not None else out
+
+
+def barrier():
+    _world().barrier(_auto_name("barrier"))
+
+
+def join(device: int = -1) -> int:
+    """Graceful departure. Every live process reaches the same cycle; with
+    process-rank membership handled by the elastic layer, join degenerates
+    to a barrier (see ``horovod_tpu/__init__.py:join``). ``device`` is
+    accepted for API parity and ignored (host plane)."""
+    with suppress(HorovodInternalError):
+        barrier()
+    return _world().size - 1

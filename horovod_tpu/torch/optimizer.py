@@ -1,0 +1,243 @@
+"""DistributedOptimizer for PyTorch (parity: ``torch/optimizer.py:31-421``).
+
+Wraps any ``torch.optim.Optimizer`` so that gradients are allreduced across
+process ranks as they become ready during ``backward()``: each parameter
+gets a post-accumulate-grad hook that enqueues an async in-place allreduce,
+and ``step()`` synchronizes all outstanding handles first. Communication
+overlaps with the rest of backprop exactly as in the reference's
+grad-accumulator-hook design; the transport is the native TCP ring (host
+plane) instead of MPI/NCCL.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Optional
+
+import torch
+
+from . import mpi_ops as _ops
+from .compression import Compression
+from .mpi_ops import Adasum, Average, Sum
+
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    def __init__(self, params, named_parameters=None,
+                 compression=Compression.none,
+                 backward_passes_per_step=1, op=Average,
+                 gradient_predivide_factor=1.0):
+        super(self.__class__, self).__init__(params)
+        self._compression = compression
+        self.op = op
+        self.backward_passes_per_step = backward_passes_per_step
+        self.gradient_predivide_factor = gradient_predivide_factor
+
+        if named_parameters is not None:
+            named_parameters = list(named_parameters)
+            all_params = {
+                p for group in self.param_groups for p in group["params"]}
+            named = {p for _, p in named_parameters}
+            unnamed = all_params - named
+            if unnamed:
+                raise ValueError(
+                    "named_parameters was specified but one or more model "
+                    "parameters were not named (parity check, reference "
+                    "torch/optimizer.py:51-68)")
+            if len({name for name, _ in named_parameters}) < len(
+                    named_parameters):
+                raise ValueError("parameter names must be unique")
+            self._parameter_names = {p: name for name, p in named_parameters}
+        else:
+            self._parameter_names = {
+                p: f"allreduce.noname.{gi}.{pi}"
+                for gi, group in enumerate(self.param_groups)
+                for pi, p in enumerate(group["params"])
+            }
+
+        self._handles = {}
+        self._allreduce_delay = {}
+        self._grad_accs = []  # keepalive for legacy hook path
+        self._hook_handles = []
+        self._requires_update = set()
+        self._synchronized = False
+        self._should_synchronize = True
+        if _ops.size() > 1:
+            self._register_hooks()
+
+    # -- hooks ---------------------------------------------------------------
+
+    def _register_hooks(self):
+        for group in self.param_groups:
+            for p in group["params"]:
+                if not p.requires_grad:
+                    continue
+                self._requires_update.add(p)
+                self._allreduce_delay[p] = self.backward_passes_per_step
+                if hasattr(p, "register_post_accumulate_grad_hook"):
+                    h = p.register_post_accumulate_grad_hook(
+                        self._make_post_hook(p))
+                    self._hook_handles.append(h)
+                else:  # pragma: no cover - old torch
+                    p_tmp = p.expand_as(p)
+                    grad_acc = p_tmp.grad_fn.next_functions[0][0]
+                    grad_acc.register_hook(self._make_legacy_hook(p))
+                    self._grad_accs.append(grad_acc)
+
+    def _make_post_hook(self, p):
+        def hook(param):
+            self._on_grad_ready(p)
+
+        return hook
+
+    def _make_legacy_hook(self, p):  # pragma: no cover - old torch
+        def hook(*ignore):
+            self._on_grad_ready(p)
+
+        return hook
+
+    def _on_grad_ready(self, p):
+        if p.grad is None:
+            return
+        if p in self._handles and self._handles[p][0] is not None:
+            if self._allreduce_delay[p] <= 0:
+                raise AssertionError(
+                    "Gradients were computed more than "
+                    "backward_passes_per_step times before call to step(). "
+                    "Increase backward_passes_per_step to accumulate "
+                    "gradients locally.")
+        assert not p.grad.requires_grad
+        assert self._allreduce_delay[p] > 0
+        self._allreduce_delay[p] -= 1
+        if self._allreduce_delay[p] == 0:
+            self._handles[p] = self._allreduce_grad_async(p)
+
+    def _allreduce_grad_async(self, p):
+        name = self._parameter_names.get(p)
+        tensor = p.grad
+        # Average: predivide locally then Sum — identical math with better
+        # fp dynamic range when gradient_predivide_factor is used
+        # (parity: reference divisor logic, torch/mpi_ops.py:91-129).
+        prescale = 1.0
+        postscale = 1.0
+        op = self.op
+        if op == Average:
+            op = Sum
+            prescale = self.gradient_predivide_factor / _ops.size()
+        elif op == Adasum:
+            pass
+        tensor_compressed, ctx = self._compression.compress(tensor)
+        handle = _ops.allreduce_async_(
+            tensor_compressed, name=name, op=op, prescale_factor=prescale,
+            postscale_factor=postscale)
+        return handle, (tensor_compressed, ctx)
+
+    # -- synchronization -----------------------------------------------------
+
+    def synchronize(self):
+        """Complete all outstanding allreduces (parity:
+        ``torch/optimizer.py:137-175``)."""
+        missing = [p for p in self._requires_update if p not in self._handles]
+        for p in missing:
+            # Parameters whose hooks never fired this step (e.g. unused
+            # branches): allreduce their current grads now.
+            if p.grad is None:
+                p.grad = p.data.new_zeros(p.data.shape)
+            self._allreduce_delay[p] = 0
+            self._handles[p] = self._allreduce_grad_async(p)
+        for p, (handle, (compressed, ctx)) in list(self._handles.items()):
+            if handle is None:
+                continue
+            output = _ops.synchronize(handle)
+            self._allreduce_delay[p] = self.backward_passes_per_step
+            p.grad.copy_(self._compression.decompress(output, ctx))
+        self._handles.clear()
+        self._synchronized = True
+
+    @contextmanager
+    def skip_synchronize(self):
+        """Use when calling ``synchronize()`` manually before ``step()``."""
+        self._should_synchronize = False
+        try:
+            yield
+        finally:
+            self._should_synchronize = True
+
+    def step(self, closure=None):
+        if self._should_synchronize:
+            if self._synchronized:
+                import warnings
+
+                warnings.warn(
+                    "optimizer.step() called without wrapping it in "
+                    "optimizer.skip_synchronize() after a manual "
+                    "synchronize(); this can cause training slowdown")
+            self.synchronize()
+        self._synchronized = False
+        return super(self.__class__, self).step(closure)
+
+    def zero_grad(self, *args, **kwargs):
+        if self._handles:
+            raise AssertionError(
+                "optimizer.zero_grad() was called after loss.backward() but "
+                "before optimizer.step() or optimizer.synchronize(). This "
+                "is prohibited as it can cause a race condition. (parity: "
+                "reference torch/optimizer.py:189-194)")
+        return super(self.__class__, self).zero_grad(*args, **kwargs)
+
+
+class _DistributedAdasumOptimizer(torch.optim.Optimizer):
+    """Adasum *delta* flavor (parity: ``torch/optimizer.py:197-365``): the
+    inner optimizer computes a local parameter delta, deltas are combined
+    across ranks with the scaling-insensitive Adasum operator, and the
+    combined delta is applied to the start-of-step parameters."""
+
+    def __init__(self, params, compression=Compression.none):
+        super(self.__class__, self).__init__(params)
+        self._compression = compression
+
+    def step(self, closure=None):
+        starts = {}
+        for group in self.param_groups:
+            for p in group["params"]:
+                if p.requires_grad:
+                    starts[p] = p.data.clone()
+        loss = super(self.__class__, self).step(closure)
+        if _ops.size() > 1:
+            handles = []
+            for gi, group in enumerate(self.param_groups):
+                for pi, p in enumerate(group["params"]):
+                    if p not in starts:
+                        continue
+                    delta = p.data - starts[p]
+                    compressed, ctx = self._compression.compress(delta)
+                    h = _ops.allreduce_async(
+                        compressed, name=f"adasum.delta.{gi}.{pi}",
+                        op=Adasum)
+                    handles.append((p, h, ctx))
+            for p, h, ctx in handles:
+                delta = self._compression.decompress(_ops.synchronize(h), ctx)
+                p.data.copy_(starts[p] + delta)
+        return loss
+
+
+def DistributedOptimizer(optimizer: torch.optim.Optimizer,
+                         named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1,
+                         op: int = Average,
+                         gradient_predivide_factor: float = 1.0):
+    """Wrap ``optimizer`` for distributed gradient averaging (parity:
+    ``hvd.DistributedOptimizer``, reference ``torch/optimizer.py:368-421``).
+
+    ``op=hvd.Adasum`` selects the delta-based Adasum optimizer."""
+    if gradient_predivide_factor != 1.0 and op != Average:
+        raise ValueError(
+            "gradient_predivide_factor not supported with op != Average")
+    if op != Adasum:
+        cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+                   dict(_DistributedOptimizer.__dict__))
+        return cls(optimizer.param_groups, named_parameters, compression,
+                   backward_passes_per_step, op, gradient_predivide_factor)
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               dict(_DistributedAdasumOptimizer.__dict__))
+    return cls(optimizer.param_groups, compression)

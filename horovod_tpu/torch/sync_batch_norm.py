@@ -1,0 +1,137 @@
+"""Cross-rank synchronized BatchNorm (parity: ``torch/sync_batch_norm.py``).
+
+Batch statistics are combined across all process ranks with allreduce of
+(count, sum, sum-of-squares) in fp32, and the backward pass allreduces the
+two gradient sums — the same math as the reference's
+``_SyncBatchNorm`` autograd function, carried by the native ring instead of
+MPI/NCCL.
+"""
+
+from __future__ import annotations
+
+import torch
+from torch.nn.modules.batchnorm import _BatchNorm
+
+from . import mpi_ops as _ops
+from .mpi_ops import Sum
+
+
+class SyncBatchNorm(_BatchNorm):
+    """Applies synchronized Batch Normalization over the global batch.
+
+    Drop-in for ``torch.nn.BatchNorm{1,2,3}d`` in distributed data-parallel
+    training; statistics are computed over the batch slices of *all*
+    ranks."""
+
+    def __init__(self, num_features, eps=1e-5, momentum=0.1, affine=True,
+                 track_running_stats=True):
+        super().__init__(num_features, eps, momentum, affine,
+                         track_running_stats)
+
+    def _check_input_dim(self, input):
+        if input.dim() < 2:
+            raise ValueError(
+                f"expected at least 2D input (got {input.dim()}D input)")
+
+    def forward(self, input):
+        if not (self.training and self.track_running_stats) or \
+                _ops.size() == 1:
+            return super().forward(input)
+        self._check_input_dim(input)
+        if self.momentum is None:
+            exponential_average_factor = 0.0
+        else:
+            exponential_average_factor = self.momentum
+        if self.num_batches_tracked is not None:
+            self.num_batches_tracked.add_(1)
+            if self.momentum is None:
+                exponential_average_factor = \
+                    1.0 / float(self.num_batches_tracked)
+        return _SyncBatchNormFn.apply(
+            input, self.weight, self.bias, self.running_mean,
+            self.running_var, self.eps, exponential_average_factor)
+
+
+class _SyncBatchNormFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, input, weight, bias, running_mean, running_var, eps,
+                momentum):
+        input = input.contiguous()
+        reduce_dims = [0] + list(range(2, input.dim()))
+        count = input.numel() // input.size(1)
+
+        local = torch.empty(2 * input.size(1) + 1, dtype=torch.float32)
+        local[0] = float(count)
+        local[1: 1 + input.size(1)] = \
+            input.sum(dim=reduce_dims).to(torch.float32)
+        local[1 + input.size(1):] = \
+            (input * input).sum(dim=reduce_dims).to(torch.float32)
+
+        total = _ops.synchronize(_ops.allreduce_async(
+            local, op=Sum, name="sync_batch_norm.fwd"))
+        count_all = total[0]
+        mean = total[1: 1 + input.size(1)] / count_all
+        sumsq = total[1 + input.size(1):]
+        var = sumsq / count_all - mean * mean
+        invstd = torch.rsqrt(var + eps)
+
+        if running_mean is not None:
+            running_mean.mul_(1 - momentum).add_(
+                mean.to(running_mean.dtype), alpha=momentum)
+            # unbiased variance for running stats, as torch BN does
+            unbiased = var * (count_all / (count_all - 1)) \
+                if count_all > 1 else var
+            running_var.mul_(1 - momentum).add_(
+                unbiased.to(running_var.dtype), alpha=momentum)
+
+        shape = [1, input.size(1)] + [1] * (input.dim() - 2)
+        xhat = (input.to(torch.float32) - mean.view(shape)) * \
+            invstd.view(shape)
+        out = xhat
+        if weight is not None:
+            out = out * weight.to(torch.float32).view(shape)
+        if bias is not None:
+            out = out + bias.to(torch.float32).view(shape)
+
+        ctx.save_for_backward(input, weight, mean, invstd)
+        ctx.count_all = float(count_all)
+        return out.to(input.dtype)
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        input, weight, mean, invstd = ctx.saved_tensors
+        grad_output = grad_output.contiguous()
+        reduce_dims = [0] + list(range(2, input.dim()))
+        shape = [1, input.size(1)] + [1] * (input.dim() - 2)
+
+        gof = grad_output.to(torch.float32)
+        xf = input.to(torch.float32)
+        xmu = xf - mean.view(shape)
+
+        sum_dy = gof.sum(dim=reduce_dims)
+        sum_dy_xmu = (gof * xmu).sum(dim=reduce_dims)
+
+        stacked = torch.cat([sum_dy, sum_dy_xmu])
+        total = _ops.synchronize(_ops.allreduce_async(
+            stacked, op=Sum, name="sync_batch_norm.bwd"))
+        sum_dy_all = total[: input.size(1)]
+        sum_dy_xmu_all = total[input.size(1):]
+        n = ctx.count_all
+
+        w = weight.to(torch.float32).view(shape) if weight is not None \
+            else torch.ones(shape, dtype=torch.float32)
+        grad_input = w * invstd.view(shape) * (
+            gof
+            - sum_dy_all.view(shape) / n
+            - xmu * invstd.view(shape) ** 2 * sum_dy_xmu_all.view(shape) / n
+        )
+
+        grad_weight = None
+        if weight is not None and ctx.needs_input_grad[1]:
+            grad_weight = (sum_dy_xmu * invstd).to(weight.dtype)
+        grad_bias = None
+        if ctx.needs_input_grad[2]:
+            grad_bias = sum_dy.to(grad_output.dtype)
+
+        return (grad_input.to(input.dtype), grad_weight, grad_bias, None,
+                None, None, None)

@@ -1,0 +1,337 @@
+"""PyTorch binding tests.
+
+Single-process tests check API semantics at size 1 (the reference's tests
+skip collectives at size 1; ours assert identity behavior). The
+multi-process test launches N worker subprocesses over the native TCP
+controller + ring data plane — the reference's ``mpirun -np N`` Pattern-1
+strategy (SURVEY §4) without MPI.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+
+@pytest.fixture
+def thvd():
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    yield hvd
+    hvd.shutdown()
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---- size-1 semantics -------------------------------------------------------
+
+
+def test_init_rank_size(thvd):
+    assert thvd.rank() == 0
+    assert thvd.size() == 1
+    assert thvd.local_rank() == 0
+    assert thvd.is_initialized()
+
+
+def test_allreduce_size1(thvd):
+    x = torch.arange(10, dtype=torch.float32)
+    y = thvd.allreduce(x, op=thvd.Average)
+    assert torch.allclose(y, x)
+    z = thvd.allreduce(x, op=thvd.Sum, prescale_factor=2.0)
+    assert torch.allclose(z, 2 * x)
+
+
+def test_allreduce_inplace_size1(thvd):
+    x = torch.ones(5)
+    thvd.allreduce_(x, op=thvd.Sum)
+    assert torch.allclose(x, torch.ones(5))
+
+
+def test_allgather_size1(thvd):
+    x = torch.randn(4, 3)
+    y = thvd.allgather(x)
+    assert torch.allclose(y, x)
+
+
+def test_broadcast_size1(thvd):
+    x = torch.randn(7)
+    y = thvd.broadcast(x, 0)
+    assert torch.allclose(y, x)
+    with pytest.raises(ValueError):
+        thvd.broadcast(x, 3)
+
+
+def test_async_poll_synchronize(thvd):
+    x = torch.ones(4)
+    h = thvd.allreduce_async(x, op=thvd.Sum)
+    assert thvd.poll(h)
+    out = thvd.synchronize(h)
+    assert torch.allclose(out, x)
+    with pytest.raises(ValueError):
+        thvd.synchronize(h)  # already consumed
+
+
+def test_allreduce_grad(thvd):
+    x = torch.randn(6, requires_grad=True)
+    y = thvd.allreduce(x, op=thvd.Average)
+    y.sum().backward()
+    assert torch.allclose(x.grad, torch.ones(6))
+
+
+def test_compression_fp16_roundtrip(thvd):
+    from horovod_tpu.torch.compression import Compression
+
+    x = torch.randn(32)
+    c, ctx = Compression.fp16.compress(x)
+    assert c.dtype == torch.float16
+    out = Compression.fp16.decompress(c, ctx)
+    assert out.dtype == torch.float32
+    assert torch.allclose(out, x, atol=1e-2)
+
+
+def test_unsupported_device_and_dtype(thvd):
+    with pytest.raises(ValueError):
+        thvd.allreduce(torch.ones(3, dtype=torch.complex64))
+
+
+def test_distributed_optimizer_trains(thvd):
+    model = torch.nn.Sequential(
+        torch.nn.Linear(8, 16), torch.nn.ReLU(), torch.nn.Linear(16, 1))
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    opt = thvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters())
+    x = torch.randn(32, 8)
+    y = x.sum(dim=1, keepdim=True)
+    losses = []
+    for _ in range(12):
+        opt.zero_grad()
+        loss = torch.nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_optimizer_zero_grad_guard(thvd):
+    # The race-condition guard only arms when hooks are registered
+    # (size > 1); at size 1 zero_grad after backward must be legal.
+    model = torch.nn.Linear(4, 1)
+    opt = thvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters())
+    model(torch.randn(2, 4)).sum().backward()
+    opt.zero_grad()
+
+
+def test_broadcast_object_size1(thvd):
+    obj = {"a": 1, "b": [2, 3]}
+    assert thvd.broadcast_object(obj, 0) == obj
+    assert thvd.allgather_object(obj) == [obj]
+
+
+def test_broadcast_parameters_size1(thvd):
+    model = torch.nn.Linear(4, 2)
+    thvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+
+def test_sync_batch_norm_size1_falls_back(thvd):
+    bn = thvd.SyncBatchNorm(3)
+    bn.train()
+    x = torch.randn(4, 3, 5)
+    ref = torch.nn.BatchNorm1d(3)
+    ref.train()
+    out = bn(x)
+    expected = ref(x)
+    assert torch.allclose(out, expected, atol=1e-5)
+
+
+def test_elastic_torch_state_commit_restore(thvd):
+    model = torch.nn.Linear(2, 2)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    state = thvd.elastic.TorchState(model=model, optimizer=opt, batch=5)
+    state.commit()
+    with torch.no_grad():
+        for p in model.parameters():
+            p.add_(1.0)
+    state.batch = 99
+    state.restore()
+    assert state.batch == 5
+    # model weights rolled back
+    state2 = thvd.elastic.TorchState(model=model, optimizer=opt)
+    del state2
+
+
+# ---- multi-process (Pattern 1) ---------------------------------------------
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    import torch
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    assert size == int(os.environ["HOROVOD_SIZE"]), (size, os.environ)
+
+    # -- allreduce sum/average across real processes
+    x = torch.arange(10, dtype=torch.float32) * (rank + 1)
+    summed = hvd.allreduce(x, op=hvd.Sum, name="w.ar.sum")
+    expect = torch.arange(10, dtype=torch.float32) * sum(
+        r + 1 for r in range(size))
+    assert torch.allclose(summed, expect), (summed, expect)
+
+    avg = hvd.allreduce(x, op=hvd.Average, name="w.ar.avg")
+    assert torch.allclose(avg, expect / size), avg
+
+    # -- in-place + int64
+    xi = torch.full((6,), rank + 1, dtype=torch.int64)
+    hvd.allreduce_(xi, op=hvd.Sum, name="w.ar.int")
+    assert (xi == sum(r + 1 for r in range(size))).all(), xi
+
+    # -- min/max (capability extension)
+    xm = torch.full((3,), float(rank))
+    mx = hvd.allreduce(xm, op=hvd.Max, name="w.ar.max")
+    assert (mx == size - 1).all(), mx
+
+    # -- broadcast from rank 1
+    b = torch.full((5,), float(rank * 100))
+    out = hvd.broadcast(b, 1, name="w.bc")
+    assert torch.allclose(out, torch.full((5,), 100.0)), out
+
+    # -- ragged allgather (reference MPI_Allgatherv semantics)
+    g = torch.full((rank + 1, 2), float(rank))
+    gathered = hvd.allgather(g, name="w.ag")
+    assert gathered.shape == (sum(r + 1 for r in range(size)), 2), \
+        gathered.shape
+    off = 0
+    for r in range(size):
+        assert (gathered[off:off + r + 1] == r).all(), gathered
+        off += r + 1
+
+    # -- autograd through allreduce
+    t = torch.randn(4, requires_grad=True)
+    y = hvd.allreduce(t, op=hvd.Average, name="w.grad")
+    y.sum().backward()
+    assert torch.allclose(t.grad, torch.ones(4)), t.grad
+
+    # -- bf16 allreduce
+    bf = torch.full((8,), 1.5, dtype=torch.bfloat16)
+    sbf = hvd.allreduce(bf, op=hvd.Sum, name="w.bf16")
+    assert torch.allclose(sbf.float(), torch.full((8,), 1.5 * size)), sbf
+
+    # -- adasum matches the numpy oracle
+    if size & (size - 1) == 0:
+        a = torch.tensor([1.0, 2.0, 3.0]) * (rank + 1)
+        combined = hvd.allreduce(a, op=hvd.Adasum, name="w.adasum")
+        from horovod_tpu.ops.adasum import adasum_reference
+        oracle = adasum_reference(
+            [np.array([1.0, 2.0, 3.0]) * (r + 1) for r in range(size)])
+        assert np.allclose(combined.numpy(), oracle, rtol=1e-4), \
+            (combined, oracle)
+
+    # -- broadcast_object / allgather_object
+    obj = {"rank": rank, "data": list(range(rank + 1))}
+    got = hvd.broadcast_object(obj, root_rank=0)
+    assert got == {"rank": 0, "data": [0]}, got
+    objs = hvd.allgather_object(obj)
+    assert [o["rank"] for o in objs] == list(range(size)), objs
+
+    # -- broadcast_parameters makes models identical
+    torch.manual_seed(1234 + rank)   # deliberately different per rank
+    model = torch.nn.Sequential(
+        torch.nn.Linear(6, 8), torch.nn.ReLU(), torch.nn.Linear(8, 1))
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    opt = torch.optim.SGD(model.parameters(), lr=0.05, momentum=0.9)
+    opt = hvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters())
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+
+    # -- DistributedOptimizer: per-rank shards, identical updates
+    torch.manual_seed(99)  # same data pool on all ranks
+    X = torch.randn(8 * size, 6)
+    Y = X.sum(dim=1, keepdim=True)
+    for step in range(4):
+        xb = X[rank * 8:(rank + 1) * 8]
+        yb = Y[rank * 8:(rank + 1) * 8]
+        opt.zero_grad()
+        loss = torch.nn.functional.mse_loss(model(xb), yb)
+        loss.backward()
+        opt.step()
+    flat = torch.cat([p.data.flatten() for p in model.parameters()])
+    gathered = hvd.allgather(flat[None, :], name="w.opt.check")
+    for r in range(size):
+        assert torch.allclose(gathered[r], flat, atol=1e-6), \
+            f"rank {rank}: params diverged from rank {r}"
+
+    # -- SyncBatchNorm: global stats match the full-batch oracle
+    torch.manual_seed(7)
+    full = torch.randn(4 * size, 3, 5)
+    local = full[rank * 4:(rank + 1) * 4]
+    sbn = hvd.SyncBatchNorm(3, momentum=0.5)
+    sbn.train()
+    out = sbn(local)
+    ref = torch.nn.BatchNorm1d(3, momentum=0.5)
+    ref.train()
+    ref_out = ref(full)
+    assert torch.allclose(out, ref_out[rank * 4:(rank + 1) * 4],
+                          atol=1e-4), "sync BN forward mismatch"
+    assert torch.allclose(sbn.running_mean, ref.running_mean, atol=1e-5)
+    assert torch.allclose(sbn.running_var, ref.running_var, atol=1e-4)
+
+    # -- backward_passes_per_step accumulation
+    model2 = torch.nn.Linear(4, 1)
+    hvd.broadcast_parameters(model2.state_dict(), root_rank=0)
+    opt2 = hvd.DistributedOptimizer(
+        torch.optim.SGD(model2.parameters(), lr=0.1),
+        named_parameters=model2.named_parameters(),
+        backward_passes_per_step=2)
+    for micro in range(2):
+        loss = model2(torch.ones(2, 4) * (rank + micro + 1)).sum()
+        loss.backward()
+    opt2.step()
+    opt2.zero_grad()
+
+    hvd.shutdown()
+    print(f"TORCH_WORKER_{rank}_OK")
+""")
+
+
+@pytest.mark.parametrize("size", [2, 4])
+def test_torch_multiprocess(size, tmp_path):
+    port = _free_port()
+    script = tmp_path / "torch_worker.py"
+    script.write_text(_WORKER)
+    base_env = dict(os.environ)
+    base_env["HVD_REPO"] = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    base_env["JAX_PLATFORMS"] = "cpu"
+    base_env["HOROVOD_SIZE"] = str(size)
+    base_env["HOROVOD_CONTROLLER_PORT"] = str(port)
+    base_env["HOROVOD_CYCLE_TIME"] = "1.0"
+    procs = []
+    for r in range(size):
+        env = dict(base_env)
+        env["HOROVOD_RANK"] = str(r)
+        env["HOROVOD_LOCAL_RANK"] = str(r)
+        env["HOROVOD_LOCAL_SIZE"] = str(size)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    for r, p in enumerate(procs):
+        out, _ = p.communicate(timeout=240)
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"TORCH_WORKER_{r}_OK" in out, out

@@ -1,0 +1,91 @@
+"""Backend-shared Keras implementation (parity: ``horovod/_keras/``).
+
+The reference parameterizes these helpers by (keras flavor, backend session)
+so ``horovod.keras`` and ``horovod.tensorflow.keras`` share one
+implementation (``_keras/__init__.py``, ``_keras/callbacks.py``). Here they
+are parameterized by the *binding module* (``horovod_tpu.tensorflow``) and
+the keras module, which covers both entry points under Keras 3 where
+``tf.keras`` is ``keras``.
+
+The optimizer wrapper targets the Keras-3 optimizer protocol: gradients are
+allreduced in ``apply``/``apply_gradients`` (the modern equivalent of the
+reference's ``get_gradients`` override, ``_keras/__init__.py:23-70``).
+"""
+
+from __future__ import annotations
+
+
+def create_distributed_optimizer(hvd, keras, optimizer, name=None,
+                                 compression=None, sparse_as_dense=False,
+                                 op=None):
+    """Dynamically subclass ``optimizer`` so every gradient is allreduced
+    before being applied (parity: ``_keras/__init__.py:23``)."""
+    op = hvd.Average if op is None else op
+    compression = compression or hvd.Compression.none
+
+    base_cls = optimizer.__class__
+
+    class _DistributedOptimizer(base_cls):
+        _hvd = hvd
+        _hvd_compression = compression
+        _hvd_sparse_as_dense = sparse_as_dense
+        _hvd_op = op
+
+        def _hvd_allreduce_grads(self, grads):
+            if self._hvd.size() == 1:
+                return list(grads)
+            out = []
+            for i, g in enumerate(grads):
+                if g is None:
+                    out.append(None)
+                    continue
+                out.append(self._hvd.allreduce(
+                    g, op=self._hvd_op, compression=self._hvd_compression))
+            return out
+
+        # Keras 3 entry point used by Model.fit's train_step.
+        def apply(self, grads, trainable_variables=None, **kwargs):
+            grads = self._hvd_allreduce_grads(grads)
+            if trainable_variables is None:
+                return super().apply(grads, **kwargs)
+            return super().apply(grads, trainable_variables, **kwargs)
+
+        def apply_gradients(self, grads_and_vars, **kwargs):
+            grads_and_vars = list(grads_and_vars)
+            grads = self._hvd_allreduce_grads(
+                [g for g, _ in grads_and_vars])
+            return base_cls.apply_gradients(
+                self, list(zip(grads, [v for _, v in grads_and_vars])),
+                **kwargs)
+
+    cls_name = name or "Distributed" + base_cls.__name__
+    cls = type(cls_name, (_DistributedOptimizer,), {})
+    config = optimizer.get_config()
+    return cls.from_config(config)
+
+
+def broadcast_global_variables(hvd, backend, root_rank):
+    # Keras 3 has no global-variable collection; callers broadcast model
+    # variables explicitly via the callback below.
+    raise RuntimeError(
+        "broadcast_global_variables is graph-mode only; use the "
+        "BroadcastGlobalVariablesCallback")
+
+
+def allreduce(hvd, backend, value, name, average):
+    import numpy as np
+
+    return hvd.allreduce(np.asarray(value),
+                         op=hvd.Average if average else hvd.Sum)
+
+
+def allgather(hvd, backend, value, name):
+    import numpy as np
+
+    return hvd.allgather(np.asarray(value))
+
+
+def broadcast(hvd, backend, value, root_rank, name):
+    import numpy as np
+
+    return hvd.broadcast(np.asarray(value), root_rank)

@@ -1,0 +1,163 @@
+"""Shared Keras callbacks (parity: ``horovod/_keras/callbacks.py:22-186``).
+
+Each ``*Impl`` class is parameterized by the binding module ``hvd``
+(``horovod_tpu.tensorflow``) and the keras module, mirroring the reference's
+backend parameterization.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+
+class BroadcastGlobalVariablesCallbackImpl:
+    """Broadcast model + optimizer state from ``root_rank`` at the start of
+    training (parity: ``_keras/callbacks.py:22-46``: on_batch_end of batch 0
+    so optimizer slots exist)."""
+
+    def __init__(self, backend, root_rank, device="", *args):
+        super().__init__(*args)
+        self.backend = backend
+        self.root_rank = root_rank
+        self.broadcast_done = False
+
+    def on_batch_end(self, batch, logs=None):
+        if self.broadcast_done:
+            return
+        hvd = self.backend
+        if hvd.size() <= 1:
+            self.broadcast_done = True
+            return
+        variables = list(self.model.variables)
+        opt = getattr(self.model, "optimizer", None)
+        if opt is not None:
+            variables += list(opt.variables)
+        hvd.broadcast_variables(variables, root_rank=self.root_rank)
+        self.broadcast_done = True
+
+
+class MetricAverageCallbackImpl:
+    """Average epoch-end metrics over all ranks (parity:
+    ``_keras/callbacks.py:48-87``) so logged/early-stopping metrics agree
+    across workers."""
+
+    def __init__(self, backend, device="", *args):
+        super().__init__(*args)
+        self.backend = backend
+
+    def _average_metrics_in_place(self, logs):
+        import numpy as np
+
+        hvd = self.backend
+        if not logs or hvd.size() <= 1:
+            return
+        for metric, value in sorted(logs.items()):
+            reduced = hvd._np_allreduce(
+                np.asarray(float(value), np.float64),
+                f"keras.metric.{metric}", hvd.Sum, 1.0, 1.0)
+            logs[metric] = float(reduced) / hvd.size()
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._average_metrics_in_place(logs)
+
+
+class LearningRateScheduleCallbackImpl:
+    """Multiply the initial LR by ``multiplier`` (a constant or a function
+    of epoch) inside ``[start_epoch, end_epoch)`` (parity:
+    ``_keras/callbacks.py:89-141``)."""
+
+    def __init__(self, backend, multiplier, start_epoch=0, end_epoch=None,
+                 staircase=True, momentum_correction=True, steps_per_epoch=None,
+                 initial_lr=None, *args):
+        super().__init__(*args)
+        self.backend = backend
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.initial_lr = initial_lr
+        self.restore_momentum = None
+        self.steps_per_epoch = steps_per_epoch
+        self.current_epoch = 0
+        if not callable(multiplier):
+            self.staircase = True
+            self.multiplier = lambda epoch: multiplier
+        else:
+            self.multiplier = multiplier
+
+    def _autodetect_steps_per_epoch(self):
+        if self.steps_per_epoch is not None:
+            return self.steps_per_epoch
+        if hasattr(self, "params") and self.params.get("steps"):
+            return self.params["steps"]
+        raise ValueError(
+            "LearningRateScheduleCallback needs steps_per_epoch for "
+            "non-staircase schedules")
+
+    def _lr_var(self):
+        return self.model.optimizer.learning_rate
+
+    def _set_lr(self, value):
+        var = self._lr_var()
+        try:
+            var.assign(value)
+        except AttributeError:
+            self.model.optimizer.learning_rate = value
+
+    def _get_lr(self):
+        var = self._lr_var()
+        try:
+            return float(var.numpy())
+        except AttributeError:
+            return float(var)
+
+    def on_train_begin(self, logs=None):
+        if self.initial_lr is None:
+            self.initial_lr = self._get_lr()
+        if not self.staircase and not self.steps_per_epoch:
+            self.steps_per_epoch = self._autodetect_steps_per_epoch()
+
+    def _in_range(self, epoch):
+        return (epoch >= self.start_epoch and
+                (self.end_epoch is None or epoch < self.end_epoch))
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+        if self.staircase and self._in_range(epoch):
+            self._set_lr(self.initial_lr * self.multiplier(epoch))
+
+    def on_batch_begin(self, batch, logs=None):
+        if not self.staircase and self._in_range(self.current_epoch):
+            epoch = self.current_epoch + float(batch) / self.steps_per_epoch
+            self._set_lr(self.initial_lr * self.multiplier(epoch))
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is not None:
+            logs["lr"] = self._get_lr()
+
+
+class LearningRateWarmupCallbackImpl(LearningRateScheduleCallbackImpl):
+    """Gradual LR warmup from base LR to ``size * base`` over
+    ``warmup_epochs`` (parity: ``_keras/callbacks.py:143-186``, the
+    Goyal et al. linear ramp)."""
+
+    def __init__(self, backend, warmup_epochs=5, momentum_correction=True,
+                 steps_per_epoch=None, verbose=0, initial_lr=None, *args):
+        self.verbose = verbose
+
+        def multiplier(epoch):
+            # Epoch here is fractional (epoch + batch/steps_per_epoch).
+            size = backend.size()
+            return 1.0 / size + epoch * (1.0 - 1.0 / size) / warmup_epochs
+
+        super().__init__(backend, multiplier, start_epoch=0,
+                         end_epoch=warmup_epochs, staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch,
+                         initial_lr=initial_lr, *args)
+
+    def on_epoch_end(self, epoch, logs=None):
+        super().on_epoch_end(epoch, logs)
+        if epoch == self.end_epoch - 1 and self.verbose > 0:
+            print(f"Epoch {epoch + 1}: finished gradual learning rate "
+                  f"warmup to {self._get_lr()}")

@@ -1,0 +1,52 @@
+"""Shared elastic Keras callbacks (parity: ``horovod/_keras/elastic.py``).
+
+``CommitStateCallbackImpl`` commits elastic state every ``batches_per_commit``
+batches; ``UpdateBatchStateCallbackImpl`` / ``UpdateEpochStateCallbackImpl``
+keep ``state.batch`` / ``state.epoch`` current so a restored worker resumes
+at the right position.
+"""
+
+from __future__ import annotations
+
+
+class CommitStateCallbackImpl:
+    def __init__(self, backend, state, batches_per_commit=1, *args):
+        super().__init__(*args)
+        self.backend = backend
+        self.state = state
+        self.batches_per_commit = batches_per_commit
+        self.batches_remaining = batches_per_commit
+
+    def on_batch_end(self, batch, logs=None):
+        self.batches_remaining -= 1
+        if self.batches_remaining == 0:
+            self.state.commit()
+            self.batches_remaining = self.batches_per_commit
+
+
+class UpdateBatchStateCallbackImpl:
+    def __init__(self, backend, state, *args):
+        super().__init__(*args)
+        self.backend = backend
+        self.state = state
+
+    def on_epoch_begin(self, epoch, logs=None):
+        if self.state.batch > 0:
+            # Resuming mid-epoch: steer fit()'s progress from state.batch.
+            self.params["initial_batch"] = self.state.batch
+
+    def on_batch_end(self, batch, logs=None):
+        self.state.batch = batch
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.state.batch = 0
+
+
+class UpdateEpochStateCallbackImpl:
+    def __init__(self, backend, state, *args):
+        super().__init__(*args)
+        self.backend = backend
+        self.state = state
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.state.epoch = epoch

@@ -27,8 +27,6 @@ from . import logging as _log
 from . import native as _native
 from .exceptions import HorovodInternalError, NotInitializedError
 
-_TORCH_DTYPE_CODES = None  # populated lazily by the torch binding
-
 NUMPY_DTYPE_CODES = dict(_native.DTYPE_CODES)
 
 
@@ -165,10 +163,18 @@ class HostWorld:
             postscale=postscale, plane=_native.PLANE_HOST)
 
     def test(self, handle: int) -> Tuple[int, str]:
-        return self._core.test(handle)
+        core = self._core
+        if core is None:
+            raise HorovodInternalError(
+                "native host plane unavailable (shut down?)")
+        return core.test(handle)
 
     def wait(self, handle: int) -> Tuple[int, str]:
-        return self._core.wait(handle)
+        core = self._core
+        if core is None:
+            raise HorovodInternalError(
+                "native host plane unavailable (shut down?)")
+        return core.wait(handle)
 
     # -- small helper collectives (numpy, blocking) --------------------------
 

@@ -1,0 +1,61 @@
+"""``import horovod_tpu.keras as hvd`` — Keras binding.
+
+Capability parity with the reference's ``horovod/keras/__init__.py``:
+init/rank/size family, ``DistributedOptimizer``, ``broadcast_variables``,
+``allreduce``/``allgather``/``broadcast`` on host values, ``load_model``
+with distributed-optimizer reconstruction, and the callbacks package.
+Under Keras 3 this module and ``horovod_tpu.tensorflow.keras`` share the
+same implementation (the reference keeps two thin wrappers over
+``horovod/_keras/`` for keras-vs-tf.keras; Keras 3 unified them).
+"""
+
+from __future__ import annotations
+
+import keras
+
+from .. import tensorflow as _hvd_tf
+from .. import _keras as _impl
+from ..tensorflow import (  # noqa: F401
+    Adasum, Average, Compression, Max, Min, ReduceOp, Sum, allgather_object,
+    barrier, broadcast_object, broadcast_object_fn, broadcast_variables,
+    ccl_built, cross_rank, cross_size, ddl_built, gloo_built, gloo_enabled,
+    init, is_initialized, join, local_rank, local_size, mpi_built,
+    mpi_enabled, mpi_threads_supported, nccl_built, rank, shutdown, size)
+from . import callbacks  # noqa: F401
+
+
+def DistributedOptimizer(optimizer, name=None,
+                         device_dense="", device_sparse="",
+                         compression=Compression.none,
+                         sparse_as_dense=False, op=Average):
+    """Wrap a Keras optimizer so gradients are allreduced before applying
+    (parity: ``keras/__init__.py`` → ``_keras/__init__.py:23``)."""
+    return _impl.create_distributed_optimizer(
+        _hvd_tf, keras, optimizer, name=name, compression=compression,
+        sparse_as_dense=sparse_as_dense, op=op)
+
+
+def allreduce(value, name=None, average=True):
+    """Allreduce a host value (parity: ``keras/__init__.py`` allreduce)."""
+    return _impl.allreduce(_hvd_tf, None, value, name, average)
+
+
+def allgather(value, name=None):
+    return _impl.allgather(_hvd_tf, None, value, name)
+
+
+def broadcast(value, root_rank, name=None):
+    return _impl.broadcast(_hvd_tf, None, value, root_rank, name)
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=Compression.none):
+    """Load a model saved by any rank, wrapping its optimizer in
+    ``DistributedOptimizer`` (parity: ``keras/__init__.py`` load_model)."""
+    model = keras.models.load_model(filepath,
+                                    custom_objects=custom_objects)
+    opt = getattr(model, "optimizer", None)
+    if opt is not None:
+        model.optimizer = DistributedOptimizer(opt,
+                                               compression=compression)
+    return model

@@ -1,0 +1,59 @@
+"""Keras callbacks (parity: ``horovod/keras/callbacks.py``): thin classes
+binding the shared ``_keras/callbacks.py`` impls to ``keras.callbacks``.
+"""
+
+from __future__ import annotations
+
+import keras
+
+from .. import tensorflow as _hvd_tf
+from .._keras import callbacks as _impl
+from .._keras import elastic as _elastic_impl
+
+
+class BroadcastGlobalVariablesCallback(
+        _impl.BroadcastGlobalVariablesCallbackImpl, keras.callbacks.Callback):
+    def __init__(self, root_rank=0, device=""):
+        super().__init__(_hvd_tf, root_rank, device)
+
+
+class MetricAverageCallback(_impl.MetricAverageCallbackImpl,
+                            keras.callbacks.Callback):
+    def __init__(self, device=""):
+        super().__init__(_hvd_tf, device)
+
+
+class LearningRateScheduleCallback(_impl.LearningRateScheduleCallbackImpl,
+                                   keras.callbacks.Callback):
+    def __init__(self, multiplier, start_epoch=0, end_epoch=None,
+                 staircase=True, momentum_correction=True,
+                 steps_per_epoch=None, initial_lr=None):
+        super().__init__(_hvd_tf, multiplier, start_epoch, end_epoch,
+                         staircase, momentum_correction, steps_per_epoch,
+                         initial_lr)
+
+
+class LearningRateWarmupCallback(_impl.LearningRateWarmupCallbackImpl,
+                                 keras.callbacks.Callback):
+    def __init__(self, warmup_epochs=5, momentum_correction=True,
+                 steps_per_epoch=None, verbose=0, initial_lr=None):
+        super().__init__(_hvd_tf, warmup_epochs, momentum_correction,
+                         steps_per_epoch, verbose, initial_lr)
+
+
+class CommitStateCallback(_elastic_impl.CommitStateCallbackImpl,
+                          keras.callbacks.Callback):
+    def __init__(self, state, batches_per_commit=1):
+        super().__init__(_hvd_tf, state, batches_per_commit)
+
+
+class UpdateBatchStateCallback(_elastic_impl.UpdateBatchStateCallbackImpl,
+                               keras.callbacks.Callback):
+    def __init__(self, state):
+        super().__init__(_hvd_tf, state)
+
+
+class UpdateEpochStateCallback(_elastic_impl.UpdateEpochStateCallbackImpl,
+                               keras.callbacks.Callback):
+    def __init__(self, state):
+        super().__init__(_hvd_tf, state)

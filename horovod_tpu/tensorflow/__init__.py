@@ -1,0 +1,216 @@
+"""``import horovod_tpu.tensorflow as hvd`` — TensorFlow binding.
+
+Capability parity with the reference's ``horovod/tensorflow/__init__.py``:
+``allreduce`` with IndexedSlices and Adasum scaling rules (``:42-121``),
+``DistributedOptimizer`` (``:383-444``), ``DistributedGradientTape``
+(``:447-504``), ``broadcast_global_variables`` / ``BroadcastGlobalVariables
+Hook`` (``:139-200``). The collective transport is the TPU-native host ring
+plane (see ``mpi_ops.py``); dense reductions of device-resident JAX arrays
+belong on the XLA plane (``horovod_tpu.ops.xla``) instead.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import tensorflow as tf
+
+from ..common.exceptions import HorovodInternalError  # noqa: F401
+from .compression import Compression
+from .functions import (  # noqa: F401
+    allgather_object, broadcast_object, broadcast_object_fn,
+    broadcast_variables)
+from .mpi_ops import (  # noqa: F401
+    Adasum, Average, Max, Min, ReduceOp, Sum, _allreduce, allgather, barrier,
+    broadcast, ccl_built, cross_rank, cross_size, ddl_built, gloo_built,
+    gloo_enabled, init, is_initialized, join, local_rank, local_size,
+    mpi_built, mpi_enabled, mpi_threads_supported, nccl_built, rank,
+    shutdown, size)
+
+
+def allreduce(tensor, average=None, device_dense="", device_sparse="",
+              compression=Compression.none, op=None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              name: Optional[str] = None):
+    """Averaging allreduce with the reference's op semantics
+    (``tensorflow/__init__.py:42-121``): IndexedSlices take the
+    allgather path; Average divides the summed result by world size;
+    Adasum applies the scaling-insensitive combination."""
+    op = _handle_average(average, op)
+    if isinstance(tensor, tf.IndexedSlices):
+        if op == Adasum:
+            raise NotImplementedError(
+                "Adasum is not supported for IndexedSlices")
+        # Parity: sparse gradients are combined by gathering values and
+        # indices from all ranks (tensorflow/__init__.py:74-88).
+        values = allgather(tensor.values)
+        indices = allgather(tensor.indices)
+        if op == Average:
+            values = values / size()
+        return tf.IndexedSlices(values, indices,
+                                dense_shape=tensor.dense_shape)
+    compressed, ctx = compression.compress(tensor)
+    if op == Average:
+        summed = _allreduce(compressed, name=name, op=Sum,
+                            prescale_factor=prescale_factor,
+                            postscale_factor=postscale_factor)
+        out = summed / size()
+    else:
+        out = _allreduce(compressed, name=name, op=op,
+                         prescale_factor=prescale_factor,
+                         postscale_factor=postscale_factor)
+    return compression.decompress(out, ctx)
+
+
+def _handle_average(average, op):
+    """Back-compat shim for the deprecated ``average=`` argument (parity:
+    ``common/util.py`` handle_average_backwards_compatibility)."""
+    if average is not None:
+        if op is not None:
+            raise ValueError("specify either op or average, not both")
+        return Average if average else Sum
+    return Average if op is None else op
+
+
+def broadcast_global_variables(root_rank: int = 0):
+    """Broadcast all TF global variables from ``root_rank`` (parity:
+    ``tensorflow/__init__.py:139``). In TF2 eager there is no global
+    collection; pass explicit variables to ``broadcast_variables``."""
+    if tf.executing_eagerly():
+        raise RuntimeError(
+            "broadcast_global_variables() requires graph mode; use "
+            "hvd.broadcast_variables(model.variables) in TF2")
+    return tf.group(
+        *[tf.compat.v1.assign(v, broadcast(v, root_rank))
+          for v in tf.compat.v1.global_variables()])
+
+
+class BroadcastGlobalVariablesHook(tf.compat.v1.train.SessionRunHook):
+    """SessionRunHook broadcasting global variables once after session
+    creation (parity: ``tensorflow/__init__.py:167-200``)."""
+
+    def __init__(self, root_rank: int, device: str = ""):
+        super().__init__()
+        self.root_rank = root_rank
+        self.bcast_op = None
+        self.device = device
+
+    def begin(self):
+        self.bcast_op = broadcast_global_variables(self.root_rank)
+
+    def after_create_session(self, session, coord):
+        session.run(self.bcast_op)
+
+
+class _DistributedOptimizer(tf.compat.v1.train.Optimizer):
+    """v1-optimizer wrapper: allreduce gradients in ``compute_gradients``
+    (parity: ``tensorflow/__init__.py:383-444``)."""
+
+    def __init__(self, optimizer, name=None, use_locking=False,
+                 device_dense="", device_sparse="",
+                 compression=Compression.none, sparse_as_dense=False,
+                 op=Average):
+        self._optimizer = optimizer
+        self._device_dense = device_dense
+        self._device_sparse = device_sparse
+        self._compression = compression
+        self._sparse_as_dense = sparse_as_dense
+        self._op = op
+        super().__init__(name=name or "Distributed{}".format(
+            type(optimizer).__name__), use_locking=use_locking)
+
+    def compute_gradients(self, *args, **kwargs):
+        gradients = self._optimizer.compute_gradients(*args, **kwargs)
+        if size() == 1:
+            return gradients
+        grads, variables = zip(*gradients)
+        averaged = [
+            self._maybe_allreduce(g, i) for i, g in enumerate(grads)]
+        return list(zip(averaged, variables))
+
+    def _maybe_allreduce(self, grad, idx):
+        if grad is None:
+            return None
+        if self._sparse_as_dense and isinstance(grad, tf.IndexedSlices):
+            grad = tf.convert_to_tensor(grad)
+        return allreduce(grad, op=self._op, compression=self._compression)
+
+    def apply_gradients(self, *args, **kwargs):
+        return self._optimizer.apply_gradients(*args, **kwargs)
+
+    def get_slot(self, *args, **kwargs):
+        return self._optimizer.get_slot(*args, **kwargs)
+
+    def get_slot_names(self, *args, **kwargs):
+        return self._optimizer.get_slot_names(*args, **kwargs)
+
+    def variables(self, *args, **kwargs):
+        return self._optimizer.variables(*args, **kwargs)
+
+
+def DistributedOptimizer(optimizer, name=None, use_locking=False,
+                         device_dense="", device_sparse="",
+                         compression=Compression.none,
+                         sparse_as_dense=False, op=Average):
+    """Wrap a v1 or Keras optimizer so gradients are allreduced before
+    applying (parity: ``tensorflow/__init__.py:383-444``)."""
+    if isinstance(optimizer, tf.compat.v1.train.Optimizer):
+        return _DistributedOptimizer(
+            optimizer, name, use_locking, device_dense, device_sparse,
+            compression, sparse_as_dense, op)
+    try:
+        is_keras = isinstance(optimizer, tf.keras.optimizers.Optimizer)
+    except AttributeError:
+        is_keras = False
+    if is_keras:
+        from . import keras as _keras_mod
+
+        return _keras_mod.DistributedOptimizer(
+            optimizer, compression=compression, sparse_as_dense=sparse_as_dense)
+    raise ValueError(
+        "DistributedOptimizer expects a tf.compat.v1.train.Optimizer or a "
+        "Keras optimizer, got {}".format(type(optimizer)))
+
+
+class DistributedGradientTape(tf.GradientTape):
+    """GradientTape whose ``gradient()`` allreduces the results (parity:
+    ``tensorflow/__init__.py:447-504``)."""
+
+    def __new__(cls, tape=None, *args, **kwargs):
+        return super().__new__(cls)
+
+    def __init__(self, tape: Optional[tf.GradientTape] = None,
+                 device_dense="", device_sparse="",
+                 compression=Compression.none, sparse_as_dense=False,
+                 op=Average, persistent=False,
+                 watch_accessed_variables=True):
+        if tape is not None:
+            # Adopt the wrapped tape's recording state.
+            self.__dict__.update(tape.__dict__)
+            self._wrapped = tape
+        else:
+            super().__init__(persistent=persistent,
+                             watch_accessed_variables=watch_accessed_variables)
+            self._wrapped = None
+        self._compression = compression
+        self._sparse_as_dense = sparse_as_dense
+        self._op = op
+
+    def gradient(self, target, sources, output_gradients=None):
+        if self._wrapped is not None:
+            gradients = self._wrapped.gradient(target, sources,
+                                               output_gradients)
+        else:
+            gradients = super().gradient(target, sources, output_gradients)
+        if size() == 1:
+            return gradients
+        out = []
+        for g in gradients:
+            if g is None:
+                out.append(None)
+                continue
+            if self._sparse_as_dense and isinstance(g, tf.IndexedSlices):
+                g = tf.convert_to_tensor(g)
+            out.append(allreduce(g, op=self._op,
+                                 compression=self._compression))
+        return out

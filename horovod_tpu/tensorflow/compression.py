@@ -1,0 +1,65 @@
+"""Gradient compression for TF tensors (parity:
+``horovod/tensorflow/compression.py``).
+
+bfloat16 is added as the TPU-native wire format (fp32 exponent range, no
+loss-scaling needed); fp16 is kept for reference-script compatibility.
+"""
+
+import tensorflow as tf
+
+
+class Compressor:
+    """Interface: ``compress(tensor) -> (tensor, ctx)``,
+    ``decompress(tensor, ctx) -> tensor``."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        if tensor.dtype.is_floating:
+            return tf.cast(tensor, tf.float16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tf.cast(tensor, ctx) if ctx is not None else tensor
+
+
+class BF16Compressor(Compressor):
+    """TPU-native extension: bfloat16 wire format."""
+
+    @staticmethod
+    def compress(tensor):
+        if tensor.dtype.is_floating:
+            return tf.cast(tensor, tf.bfloat16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tf.cast(tensor, ctx) if ctx is not None else tensor
+
+
+class Compression:
+    """Option enum (parity: ``Compression.none`` / ``Compression.fp16``)."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

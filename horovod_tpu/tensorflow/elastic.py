@@ -1,0 +1,104 @@
+"""Elastic state for TensorFlow / Keras (parity:
+``horovod/tensorflow/elastic.py:91-209`` TensorFlowState /
+TensorFlowKerasState).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import tensorflow as tf
+
+from ..common import logging as _log
+from ..common.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from ..elastic.state import ObjectState, State
+from . import mpi_ops as _ops
+from .functions import broadcast_object, broadcast_variables
+
+
+class TensorFlowState(ObjectState):
+    """Elastic state over explicit TF2 variables (parity:
+    ``tensorflow/elastic.py:91-141``): snapshots variable values in memory
+    on ``commit``, broadcasts from the coordinator on ``sync``."""
+
+    def __init__(self, variables=None, **kwargs):
+        self.variables = list(variables) if variables is not None else []
+        self._saved_values = None
+        super().__init__(bcast_object=broadcast_object, **kwargs)
+
+    def _public_attrs(self):
+        return {
+            k: v
+            for k, v in self.__dict__.items()
+            if not k.startswith("_") and k != "variables"
+        }
+
+    def save(self):
+        self._saved_values = [np.array(v.numpy()) for v in self.variables]
+        super().save()
+
+    def restore(self):
+        if self._saved_values is not None:
+            for var, val in zip(self.variables, self._saved_values):
+                var.assign(val)
+        super().restore()
+
+    def sync(self):
+        if self.variables:
+            broadcast_variables(self.variables, root_rank=0)
+        super().sync()
+
+
+class TensorFlowKerasState(TensorFlowState):
+    """Elastic state for a Keras model + optimizer (parity:
+    ``tensorflow/elastic.py:143-209``)."""
+
+    def __init__(self, model, optimizer=None, **kwargs):
+        self.model = model
+        self.optimizer = optimizer or getattr(model, "optimizer", None)
+        variables = list(model.variables)
+        if self.optimizer is not None:
+            variables += [v for v in self.optimizer.variables
+                          if all(v is not mv for mv in model.variables)]
+        super().__init__(variables=variables, **kwargs)
+
+    def _public_attrs(self):
+        return {
+            k: v
+            for k, v in self.__dict__.items()
+            if not k.startswith("_")
+            and k not in ("variables", "model", "optimizer")
+        }
+
+
+def run(func):
+    """Elastic retry loop for TF training functions (parity:
+    ``tensorflow/elastic.py:23-60`` + ``common/elastic.py:147-168``)."""
+
+    @functools.wraps(func)
+    def wrapper(state: State, *args, **kwargs):
+        reset_required = False
+        skip_sync = False
+        while True:
+            if reset_required:
+                _ops.shutdown()
+                _ops.init()
+                state.on_reset()
+                reset_required = False
+            if not skip_sync:
+                state.sync()
+            skip_sync = False
+            try:
+                return func(state, *args, **kwargs)
+            except HorovodInternalError:
+                _log.warning(
+                    "collective failure: restoring last committed state")
+                state.restore()
+                reset_required = True
+            except HostsUpdatedInterrupt as e:
+                _log.info("host membership changed: re-initializing")
+                reset_required = True
+                skip_sync = e.skip_sync
+
+    return wrapper

@@ -1,0 +1,77 @@
+"""High-level TF helpers (parity: ``horovod/tensorflow/functions.py:47-133``).
+
+``broadcast_variables`` / ``broadcast_object`` are the resume-consistency
+primitives (SURVEY §5 checkpoint/resume): after restoring on rank 0, these
+make all ranks bit-identical before training resumes.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Iterable, Optional
+
+import numpy as np
+import tensorflow as tf
+
+from .mpi_ops import _np_broadcast, _world, broadcast, size
+
+
+def broadcast_variables(variables: Iterable[tf.Variable],
+                        root_rank: int = 0) -> None:
+    """Assign every variable its ``root_rank`` value (parity:
+    ``tensorflow/functions.py:47``)."""
+    for i, var in enumerate(variables):
+        var.assign(broadcast(var, root_rank,
+                             name=f"tf.bcast.var.{i}.{var.name}"))
+
+
+def broadcast_object(obj, root_rank: int = 0,
+                     name: Optional[str] = None):
+    """Broadcast an arbitrary picklable object (parity:
+    ``tensorflow/functions.py:83-133``)."""
+    w = _world()
+    w.require_init()
+    if size() == 1:
+        return obj
+    name = name or "tf.bcast.obj"
+    if w.rank == root_rank:
+        payload = pickle.dumps(obj)
+        n = np.asarray([len(payload)], np.int64)
+    else:
+        payload = b""
+        n = np.zeros(1, np.int64)
+    n = _np_broadcast(n, root_rank, name + ".len")
+    buf = np.zeros(int(n[0]), np.uint8)
+    if w.rank == root_rank:
+        buf[:] = np.frombuffer(payload, np.uint8)
+    buf = _np_broadcast(buf, root_rank, name + ".data")
+    return pickle.loads(buf.tobytes())
+
+
+def broadcast_object_fn(root_rank: int = 0, name: Optional[str] = None):
+    def _fn(obj):
+        return broadcast_object(obj, root_rank=root_rank, name=name)
+
+    return _fn
+
+
+def allgather_object(obj, name: Optional[str] = None):
+    """Gather one picklable object per rank into a list (capability
+    extension mirroring later-reference ``allgather_object``)."""
+    from .mpi_ops import _np_allgather
+
+    w = _world()
+    w.require_init()
+    if size() == 1:
+        return [obj]
+    name = name or "tf.allgather.obj"
+    payload = np.frombuffer(pickle.dumps(obj), np.uint8)
+    gathered = _np_allgather(payload, name)
+    sizes = _np_allgather(np.asarray([len(payload)], np.int64),
+                          name + ".sizes")
+    out, off = [], 0
+    for s in sizes.reshape(-1):
+        out.append(pickle.loads(gathered[off: off + int(s)].tobytes()))
+        off += int(s)
+    return out

@@ -1,0 +1,16 @@
+"""``import horovod_tpu.tensorflow.keras as hvd`` (parity:
+``horovod/tensorflow/keras/__init__.py``).
+
+Under Keras 3, ``tf.keras`` is ``keras``; this module shares the
+``horovod_tpu.keras`` implementation, as the reference shares
+``horovod/_keras/``.
+"""
+
+from ...keras import (  # noqa: F401
+    Adasum, Average, Compression, DistributedOptimizer, Max, Min, ReduceOp,
+    Sum, allgather, allgather_object, allreduce, barrier, broadcast,
+    broadcast_object, broadcast_object_fn, broadcast_variables, ccl_built,
+    cross_rank, cross_size, ddl_built, gloo_built, gloo_enabled, init,
+    is_initialized, join, load_model, local_rank, local_size, mpi_built,
+    mpi_enabled, mpi_threads_supported, nccl_built, rank, shutdown, size)
+from ...keras import callbacks  # noqa: F401

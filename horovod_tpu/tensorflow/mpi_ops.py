@@ -1,0 +1,291 @@
+"""TensorFlow binding: collective ops with gradient registration.
+
+Capability parity with the reference's ``horovod/tensorflow/mpi_ops.py:89-197``
+(op wrappers + gradients) and the custom-kernel layer
+``tensorflow/mpi_ops.cc:287-466``, re-architected TPU-native: instead of
+registering custom TF AsyncOpKernels that enqueue into an MPI/NCCL background
+thread, host-resident TF tensors ride the native C++ TCP ring data plane
+(``csrc/hvd/ring_ops.cc``) negotiated by the shared controller cycle loop —
+the same plane the PyTorch binding uses. Graph mode is served through
+``tf.py_function`` (the op still participates in the controller's fusion and
+caching); gradients are registered with ``tf.custom_gradient`` following the
+reference's gradient table (allreduce' = allreduce, allgather' = allreduce +
+local slice, broadcast' = allreduce with non-root zeroing).
+
+Ranks are processes, one per ``horovod_tpu.run``-launched worker, exactly as
+in the reference.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+import tensorflow as tf
+
+from ..common import native as _native
+from ..common.exceptions import HorovodInternalError
+from ..common.host_world import NUMPY_DTYPE_CODES, world as _world
+from ..ops.xla import Adasum, Average, Max, Min, ReduceOp, Sum  # noqa: F401
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
+    "local_size", "cross_rank", "cross_size", "mpi_threads_supported",
+    "mpi_built", "mpi_enabled", "gloo_built", "gloo_enabled", "nccl_built",
+    "ddl_built", "ccl_built", "_allreduce", "allgather", "broadcast", "join",
+    "barrier", "Average", "Sum", "Adasum", "Min", "Max", "ReduceOp",
+]
+
+_name_counter = 0
+_name_lock = threading.Lock()
+
+
+def _auto_name(prefix: str) -> str:
+    global _name_counter
+    with _name_lock:
+        _name_counter += 1
+        return f"tf.{prefix}.noname.{_name_counter}"
+
+
+def init(comm=None):
+    """Initialize the process-rank world (parity: ``hvd.init()``)."""
+    _world().init(comm=comm)
+
+
+def shutdown():
+    _world().shutdown()
+
+
+def is_initialized() -> bool:
+    return _world().initialized
+
+
+def rank() -> int:
+    _world().require_init()
+    return _world().rank
+
+
+def size() -> int:
+    _world().require_init()
+    return _world().size
+
+
+def local_rank() -> int:
+    _world().require_init()
+    return _world().local_rank
+
+
+def local_size() -> int:
+    _world().require_init()
+    return _world().local_size
+
+
+def cross_rank() -> int:
+    _world().require_init()
+    return _world().cross_rank
+
+
+def cross_size() -> int:
+    _world().require_init()
+    return _world().cross_size
+
+
+def mpi_threads_supported() -> bool:
+    return False
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def gloo_built() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
+    return False
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+# ---- numpy-level collectives on the host plane ------------------------------
+
+
+def _np_code(arr: np.ndarray) -> int:
+    code = NUMPY_DTYPE_CODES.get(str(arr.dtype))
+    if code is None:
+        raise ValueError(f"unsupported dtype {arr.dtype}")
+    return code
+
+
+def _np_allreduce(arr: np.ndarray, name: str, op: int, prescale: float,
+                  postscale: float) -> np.ndarray:
+    w = _world()
+    w.require_init()
+    arr = np.ascontiguousarray(arr)
+    if w.size == 1 or not w.native:
+        scale = prescale * (postscale if op not in (Min, Max) else 1.0)
+        if scale == 1.0:
+            # Exact identity — never round-trip integers through float64.
+            return arr.copy()
+        return (arr.astype(np.float64) * scale).astype(arr.dtype)
+    out = np.empty_like(arr)
+    h = w.enqueue(name, _native.OP_ALLREDUCE, op, _np_code(arr), arr.shape,
+                  arr.ctypes.data, out.ctypes.data, prescale=prescale,
+                  postscale=postscale)
+    r, err = w.wait(h)
+    if r < 0:
+        raise HorovodInternalError(err)
+    return out
+
+
+def _np_allgather(arr: np.ndarray, name: str) -> np.ndarray:
+    """Ragged-dim-0 allgather (parity: MPI_Allgatherv semantics,
+    ``mpi_operations.cc:140``): exchange dim-0 sizes, pad, gather, slice."""
+    w = _world()
+    w.require_init()
+    arr = np.ascontiguousarray(arr)
+    if arr.ndim == 0:
+        arr = arr.reshape(1)
+    if w.size == 1 or not w.native:
+        return arr.copy()
+    sizes = w.allgather_np(np.asarray([arr.shape[0]], np.int64),
+                           name + ".dim0")[:, 0]
+    max0 = int(sizes.max())
+    rest = arr.shape[1:]
+    padded = arr
+    if arr.shape[0] != max0:
+        padded = np.zeros((max0,) + rest, dtype=arr.dtype)
+        padded[: arr.shape[0]] = arr
+        padded = np.ascontiguousarray(padded)
+    gathered = np.zeros((w.size * max0,) + rest, dtype=arr.dtype)
+    h = w.enqueue(name, _native.OP_ALLGATHER, 1, _np_code(arr), padded.shape,
+                  padded.ctypes.data, gathered.ctypes.data)
+    r, err = w.wait(h)
+    if r < 0:
+        raise HorovodInternalError(err)
+    views = gathered.reshape((w.size, max0) + rest)
+    return np.concatenate(
+        [views[r, : int(sizes[r])] for r in range(w.size)], axis=0)
+
+
+def _np_broadcast(arr: np.ndarray, root_rank: int, name: str) -> np.ndarray:
+    w = _world()
+    w.require_init()
+    arr = np.ascontiguousarray(arr)
+    if w.size == 1 or not w.native:
+        return arr.copy()
+    return w.broadcast_np(arr, root_rank, name)
+
+
+# ---- TF op wrappers with gradients ------------------------------------------
+
+
+def _to_numpy(tensor: tf.Tensor) -> np.ndarray:
+    return tensor.numpy() if hasattr(tensor, "numpy") else np.asarray(tensor)
+
+
+def _wrap(np_fn, tensor: tf.Tensor) -> tf.Tensor:
+    """Run a numpy-collective on a TF tensor, graph-safe."""
+    if tf.executing_eagerly() and not isinstance(tensor, tf.Variable) \
+            and not tf.is_symbolic_tensor(tensor):
+        return tf.constant(np_fn(_to_numpy(tensor)))
+    out = tf.py_function(lambda t: np_fn(t.numpy()), [tensor], tensor.dtype)
+    out.set_shape(tensor.shape)
+    return out
+
+
+def _allreduce(tensor: tf.Tensor, name: Optional[str] = None, op: int = Sum,
+               prescale_factor: float = 1.0,
+               postscale_factor: float = 1.0) -> tf.Tensor:
+    """Raw summing allreduce, no gradient (parity:
+    ``tensorflow/mpi_ops.py:89-110`` ``_allreduce``)."""
+    name = name or _auto_name("allreduce")
+    return _wrap(
+        lambda a: _np_allreduce(a, name, op, prescale_factor,
+                                postscale_factor), tensor)
+
+
+def allgather(tensor: tf.Tensor, name: Optional[str] = None) -> tf.Tensor:
+    """Differentiable concat-on-dim-0 allgather (parity:
+    ``tensorflow/mpi_ops.py:114-147``). Gradient: allreduce the upstream
+    gradient, then take this rank's dim-0 segment."""
+    name = name or _auto_name("allgather")
+    tensor = tf.convert_to_tensor(tensor)
+    if tensor.shape.rank == 0:
+        tensor = tf.reshape(tensor, [1])
+    dim0 = tf.shape(tensor)[0]
+
+    @tf.custom_gradient
+    def _fn(t):
+        out = _wrap(lambda a: _np_allgather(a, name), t)
+        if t.shape.rank is not None and t.shape.rank > 0:
+            out.set_shape(tf.TensorShape([None]).concatenate(t.shape[1:]))
+
+        def grad(dy):
+            summed = _allreduce(dy, name=name + ".grad", op=Sum)
+            sizes = _wrap(
+                lambda a: _np_allgather(a, name + ".grad.dim0"),
+                tf.reshape(tf.cast(dim0, tf.int64), [1]))
+            offset = tf.reduce_sum(sizes[: rank()])
+            return tf.slice(
+                summed, tf.concat(
+                    [[tf.cast(offset, tf.int32)],
+                     tf.zeros([tf.rank(dy) - 1], tf.int32)], axis=0),
+                tf.concat([[tf.cast(dim0, tf.int32)],
+                           tf.fill([tf.rank(dy) - 1], -1)], axis=0))
+
+        return out, grad
+
+    return _fn(tensor)
+
+
+def broadcast(tensor: tf.Tensor, root_rank: int,
+              name: Optional[str] = None) -> tf.Tensor:
+    """Differentiable broadcast from ``root_rank`` (parity:
+    ``tensorflow/mpi_ops.py:150-197``). Gradient: allreduce to root; zero
+    elsewhere."""
+    name = name or _auto_name("broadcast")
+    tensor = tf.convert_to_tensor(tensor)
+
+    @tf.custom_gradient
+    def _fn(t):
+        out = _wrap(lambda a: _np_broadcast(a, root_rank, name), t)
+        out.set_shape(t.shape)
+
+        def grad(dy):
+            summed = _allreduce(dy, name=name + ".grad", op=Sum)
+            if rank() == root_rank:
+                return summed
+            return tf.zeros_like(summed)
+
+        return out, grad
+
+    return _fn(tensor)
+
+
+def join() -> int:
+    """Graceful departure barrier (parity: ``hvd.join()``)."""
+    w = _world()
+    w.require_init()
+    w.barrier("tf.join")
+    return w.size - 1
+
+
+def barrier():
+    _world().barrier("tf.barrier")

@@ -48,23 +48,13 @@ def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
     if _ops.size() == 1:
         return
     state_dict = optimizer.state_dict()
-    # Hyperparameters + param-group structure via object broadcast.
-    meta = {
-        "param_groups": state_dict["param_groups"],
-        "state_keys": sorted(
-            (k, sorted(v.keys())) for k, v in state_dict["state"].items()),
-    }
-    meta = broadcast_object(meta, root_rank, name="bcast.opt.meta")
+    # One pickle broadcast carries param_groups (hyperparameters) and all
+    # tensor state; non-root ranks load it wholesale.
+    synced = broadcast_object(
+        {"param_groups": state_dict["param_groups"],
+         "state": state_dict["state"]}, root_rank, name="bcast.opt.state")
     if _ops.rank() != root_rank:
-        state_dict["param_groups"] = meta["param_groups"]
-    # Tensor state in place where shapes already match; otherwise via
-    # object broadcast (covers non-root ranks before the first step()).
-    synced_state = broadcast_object(
-        {k: v for k, v in state_dict["state"].items()}, root_rank,
-        name="bcast.opt.state")
-    if _ops.rank() != root_rank:
-        state_dict["state"] = synced_state
-        optimizer.load_state_dict(state_dict)
+        optimizer.load_state_dict(synced)
 
 
 def broadcast_object(obj: Any, root_rank: int = 0,

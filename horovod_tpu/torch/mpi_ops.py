@@ -18,7 +18,6 @@ the native handle futures.
 from __future__ import annotations
 
 import threading
-from contextlib import suppress
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
@@ -166,10 +165,15 @@ def _submit_allreduce(tensor: torch.Tensor, output: torch.Tensor, name: str,
     if op == Adasum and (n & (n - 1)) != 0:
         raise ValueError("Adasum requires a power-of-two world size")
     if w.size == 1 or not w.native:
-        out = tensor.to(torch.float64) * prescale_factor
-        if op not in (Min, Max):
-            out = out * postscale_factor
-        output.copy_(out.to(tensor.dtype))
+        scale = prescale_factor * (
+            postscale_factor if op not in (Min, Max) else 1.0)
+        if scale == 1.0:
+            # Exact identity — never round-trip integers through float64
+            # (int64 above 2^53 would lose precision).
+            if output.data_ptr() != tensor.data_ptr():
+                output.copy_(tensor)
+        else:
+            output.copy_((tensor.to(torch.float64) * scale).to(tensor.dtype))
         return _new_handle(_Handle(None, output, None, result=output))
     code = TORCH_DTYPE_CODES[tensor.dtype]
     h = w.enqueue(name, _native.OP_ALLREDUCE, op, code,
@@ -250,7 +254,8 @@ def allreduce_(tensor: torch.Tensor, average: Optional[bool] = None,
 # ---- allgather --------------------------------------------------------------
 
 
-def _submit_allgather(tensor: torch.Tensor, name: str) -> int:
+def _submit_allgather(tensor: torch.Tensor, name: str,
+                      sizes: Optional[np.ndarray] = None) -> int:
     w = _world()
     w.require_init()
     if tensor.dim() == 0:
@@ -261,9 +266,11 @@ def _submit_allgather(tensor: torch.Tensor, name: str) -> int:
     # The reference supports ragged first dimensions via MPI_Allgatherv
     # (mpi_operations.cc:140). The native ring allgather is equal-shape, so
     # the binding exchanges dim-0 sizes first, pads to the max, gathers,
-    # then slices — same user semantics, one extra tiny collective.
-    dim0 = np.asarray([tensor.shape[0]], np.int64)
-    sizes = _world().allgather_np(dim0, name + ".dim0")[:, 0]
+    # then slices — same user semantics, one extra tiny collective (shared
+    # with autograd when the caller already exchanged it).
+    if sizes is None:
+        dim0 = np.asarray([tensor.shape[0]], np.int64)
+        sizes = _world().allgather_np(dim0, name + ".dim0")[:, 0]
     max0 = int(sizes.max())
     rest = tuple(tensor.shape[1:])
     padded = tensor
@@ -296,7 +303,20 @@ class _AllgatherFn(torch.autograd.Function):
     @staticmethod
     def forward(ctx, tensor, name):
         ctx.dim0 = tensor.shape[0] if tensor.dim() > 0 else 1
-        return synchronize(allgather_async(tensor, name=name))
+        w = _world()
+        w.require_init()
+        name = name or _auto_name("allgather")
+        # Exchange all ranks' dim-0 sizes once, shared by the padded
+        # gather below and by backward's slice math — backward must never
+        # run a second negotiated collective under an auto-generated name
+        # that could drift across ranks and deadlock negotiation.
+        if w.size > 1 and w.native:
+            ctx.sizes = w.allgather_np(
+                np.asarray([ctx.dim0], np.int64), name + ".dim0")[:, 0]
+        else:
+            ctx.sizes = np.asarray([ctx.dim0])
+        return synchronize(_submit_allgather(_check_tensor(tensor), name,
+                                             sizes=ctx.sizes))
 
     @staticmethod
     def backward(ctx, grad_output):
@@ -304,11 +324,7 @@ class _AllgatherFn(torch.autograd.Function):
         # rank's slice (torch/mpi_ops.py:304-330).
         w = _world()
         reduced = synchronize(allreduce_async(grad_output, op=Sum))
-        sizes = _world().allgather_np(
-            np.asarray([ctx.dim0], np.int64),
-            _auto_name("allgather.grad.dim0"))[:, 0] \
-            if w.size > 1 and w.native else np.asarray([ctx.dim0])
-        offset = int(sizes[: w.rank].sum())
+        offset = int(ctx.sizes[: w.rank].sum())
         return reduced.narrow(0, offset, ctx.dim0), None
 
 
@@ -421,7 +437,8 @@ def join(device: int = -1) -> int:
     """Graceful departure. Every live process reaches the same cycle; with
     process-rank membership handled by the elastic layer, join degenerates
     to a barrier (see ``horovod_tpu/__init__.py:join``). ``device`` is
-    accepted for API parity and ignored (host plane)."""
-    with suppress(HorovodInternalError):
-        barrier()
+    accepted for API parity and ignored (host plane). A collective failure
+    propagates as ``HorovodInternalError`` so the elastic retry loop can
+    restore + reinit."""
+    barrier()
     return _world().size - 1

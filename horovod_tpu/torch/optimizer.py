@@ -114,15 +114,17 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     def _allreduce_grad_async(self, p):
         name = self._parameter_names.get(p)
         tensor = p.grad
-        # Average: predivide locally then Sum — identical math with better
-        # fp dynamic range when gradient_predivide_factor is used
-        # (parity: reference divisor logic, torch/mpi_ops.py:91-129).
+        # Average via Sum with prescale=1/factor, postscale=factor/size:
+        # net scale is always 1/size, but the split controls fp dynamic
+        # range when gradient_predivide_factor is used (parity: reference
+        # divisor logic, torch/mpi_ops.py:91-129).
         prescale = 1.0
         postscale = 1.0
         op = self.op
         if op == Average:
             op = Sum
-            prescale = self.gradient_predivide_factor / _ops.size()
+            prescale = 1.0 / self.gradient_predivide_factor
+            postscale = self.gradient_predivide_factor / _ops.size()
         elif op == Adasum:
             pass
         tensor_compressed, ctx = self._compression.compress(tensor)

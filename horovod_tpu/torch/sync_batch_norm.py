@@ -34,7 +34,10 @@ class SyncBatchNorm(_BatchNorm):
                 f"expected at least 2D input (got {input.dim()}D input)")
 
     def forward(self, input):
-        if not (self.training and self.track_running_stats) or \
+        # Fall back to local BN only in eval mode with tracked stats
+        # (parity: reference condition, torch/sync_batch_norm.py:55) or at
+        # size 1 where there is nothing to synchronize.
+        if (not self.training and self.track_running_stats) or \
                 _ops.size() == 1:
             return super().forward(input)
         self._check_input_dim(input)

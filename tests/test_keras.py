@@ -1,0 +1,150 @@
+"""Keras binding tests (reference: ``test/test_keras.py``,
+``test/test_tensorflow2_keras.py``): DistributedOptimizer wrapping, fit()
+integration, and the callback suite, at size 1 in-process.
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+keras = pytest.importorskip("keras")
+
+
+@pytest.fixture
+def khvd():
+    import horovod_tpu.keras as hvd
+
+    hvd.init()
+    yield hvd
+    hvd.shutdown()
+
+
+def _tiny_model():
+    model = keras.Sequential([
+        keras.layers.Input(shape=(4,)),
+        keras.layers.Dense(3, activation="relu"),
+        keras.layers.Dense(1),
+    ])
+    return model
+
+
+def test_distributed_optimizer_wraps_and_trains(khvd):
+    model = _tiny_model()
+    opt = khvd.DistributedOptimizer(keras.optimizers.SGD(learning_rate=0.05))
+    assert type(opt).__name__ == "DistributedSGD"
+    model.compile(optimizer=opt, loss="mse")
+    x = np.random.RandomState(0).rand(32, 4).astype(np.float32)
+    y = (x.sum(axis=1, keepdims=True) * 0.5).astype(np.float32)
+    h0 = model.evaluate(x, y, verbose=0)
+    model.fit(x, y, batch_size=8, epochs=3, verbose=0)
+    h1 = model.evaluate(x, y, verbose=0)
+    assert h1 < h0, (h0, h1)
+
+
+def test_distributed_optimizer_apply_gradients(khvd):
+    opt = khvd.DistributedOptimizer(keras.optimizers.SGD(learning_rate=1.0))
+    v = keras.Variable([1.0, 2.0])
+    opt.apply_gradients([(tf.constant([0.5, 0.5]), v)])
+    assert np.allclose(v.numpy(), [0.5, 1.5])
+
+
+def test_tf_keras_entrypoint_shares_impl():
+    import horovod_tpu.keras as k1
+    import horovod_tpu.tensorflow.keras as k2
+
+    assert k2.DistributedOptimizer is k1.DistributedOptimizer
+    assert k2.callbacks.MetricAverageCallback is \
+        k1.callbacks.MetricAverageCallback
+
+
+def test_allreduce_allgather_broadcast_values(khvd):
+    assert float(np.asarray(khvd.allreduce(3.0)).reshape(())) == \
+        pytest.approx(3.0)
+    assert np.allclose(np.asarray(khvd.allgather(np.arange(3))),
+                       np.arange(3))
+    assert np.allclose(np.asarray(khvd.broadcast(np.ones(2), 0)), 1.0)
+
+
+def test_broadcast_callback_runs(khvd):
+    from horovod_tpu.keras.callbacks import BroadcastGlobalVariablesCallback
+
+    model = _tiny_model()
+    model.compile(optimizer=khvd.DistributedOptimizer(
+        keras.optimizers.SGD()), loss="mse")
+    x = np.zeros((8, 4), np.float32)
+    y = np.zeros((8, 1), np.float32)
+    cb = BroadcastGlobalVariablesCallback(root_rank=0)
+    model.fit(x, y, batch_size=4, epochs=1, verbose=0, callbacks=[cb])
+    assert cb.broadcast_done
+
+
+def test_metric_average_callback_size1(khvd):
+    from horovod_tpu.keras.callbacks import MetricAverageCallback
+
+    cb = MetricAverageCallback()
+    logs = {"loss": 2.0}
+    cb.on_epoch_end(0, logs)
+    assert logs["loss"] == pytest.approx(2.0)
+
+
+def test_lr_schedule_callback(khvd):
+    from horovod_tpu.keras.callbacks import LearningRateScheduleCallback
+
+    model = _tiny_model()
+    model.compile(optimizer=keras.optimizers.SGD(learning_rate=0.1),
+                  loss="mse")
+    cb = LearningRateScheduleCallback(multiplier=0.5, start_epoch=1)
+    cb.set_model(model)
+    cb.on_train_begin()
+    cb.on_epoch_begin(0)
+    assert float(model.optimizer.learning_rate.numpy()) == pytest.approx(0.1)
+    cb.on_epoch_begin(1)
+    assert float(model.optimizer.learning_rate.numpy()) == pytest.approx(0.05)
+
+
+def test_lr_warmup_callback_ramps(khvd):
+    from horovod_tpu.keras.callbacks import LearningRateWarmupCallback
+
+    model = _tiny_model()
+    model.compile(optimizer=keras.optimizers.SGD(learning_rate=0.8),
+                  loss="mse")
+    cb = LearningRateWarmupCallback(warmup_epochs=2, steps_per_epoch=4)
+    cb.set_model(model)
+    cb.on_train_begin()
+    cb.on_epoch_begin(0)
+    cb.on_batch_begin(0)
+    # size==1: multiplier is 1/1 + e*(0)/w = 1 → lr unchanged.
+    assert float(model.optimizer.learning_rate.numpy()) == pytest.approx(0.8)
+
+
+def test_elastic_keras_callbacks(khvd):
+    from horovod_tpu.elastic.state import ObjectState
+    from horovod_tpu.keras.callbacks import (
+        CommitStateCallback, UpdateBatchStateCallback,
+        UpdateEpochStateCallback)
+
+    state = ObjectState(bcast_object=lambda obj, root_rank=0: obj,
+                        batch=0, epoch=0)
+    commit = CommitStateCallback(state, batches_per_commit=2)
+    batch_cb = UpdateBatchStateCallback(state)
+    batch_cb.params = {}
+    epoch_cb = UpdateEpochStateCallback(state)
+    epoch_cb.on_epoch_begin(3)
+    assert state.epoch == 3
+    batch_cb.on_batch_end(5)
+    assert state.batch == 5
+    commit.on_batch_end(0)
+    commit.on_batch_end(1)  # second call commits
+    state.batch = 9
+    state.restore()
+    assert state.batch == 5
+
+
+def test_load_model_rewraps_optimizer(khvd, tmp_path):
+    model = _tiny_model()
+    model.compile(optimizer=keras.optimizers.SGD(learning_rate=0.1),
+                  loss="mse")
+    path = str(tmp_path / "model.keras")
+    model.save(path)
+    loaded = khvd.load_model(path)
+    assert type(loaded.optimizer).__name__ == "DistributedSGD"

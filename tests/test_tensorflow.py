@@ -1,0 +1,259 @@
+"""TensorFlow binding tests.
+
+Size-1 semantics in-process; distributed correctness via N worker
+subprocesses over the native TCP controller + ring plane (the reference's
+``mpirun -np 2`` Pattern-1 strategy, SURVEY §4, without MPI — reference
+tests: ``test/test_tensorflow.py``).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+
+@pytest.fixture
+def tfhvd():
+    import horovod_tpu.tensorflow as hvd
+
+    hvd.init()
+    yield hvd
+    hvd.shutdown()
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---- size-1 semantics -------------------------------------------------------
+
+
+def test_init_rank_size(tfhvd):
+    assert tfhvd.rank() == 0
+    assert tfhvd.size() == 1
+    assert tfhvd.local_rank() == 0
+    assert tfhvd.is_initialized()
+    assert not tfhvd.mpi_built()
+
+
+def test_allreduce_size1(tfhvd):
+    x = tf.range(10, dtype=tf.float32)
+    y = tfhvd.allreduce(x, op=tfhvd.Average)
+    assert np.allclose(y.numpy(), x.numpy())
+    z = tfhvd.allreduce(x, op=tfhvd.Sum, prescale_factor=2.0)
+    assert np.allclose(z.numpy(), 2 * x.numpy())
+
+
+def test_allreduce_average_backcompat(tfhvd):
+    x = tf.ones([4])
+    y = tfhvd.allreduce(x, average=True)
+    assert np.allclose(y.numpy(), np.ones(4))
+    with pytest.raises(ValueError):
+        tfhvd.allreduce(x, average=True, op=tfhvd.Sum)
+
+
+def test_allgather_size1(tfhvd):
+    x = tf.reshape(tf.range(6, dtype=tf.float32), [2, 3])
+    y = tfhvd.allgather(x)
+    assert np.allclose(y.numpy(), x.numpy())
+
+
+def test_broadcast_size1(tfhvd):
+    x = tf.constant([1.0, 2.0, 3.0])
+    y = tfhvd.broadcast(x, root_rank=0)
+    assert np.allclose(y.numpy(), x.numpy())
+
+
+def test_allreduce_indexed_slices(tfhvd):
+    values = tf.constant([[1.0, 2.0], [3.0, 4.0]])
+    indices = tf.constant([0, 2], dtype=tf.int64)
+    slices = tf.IndexedSlices(values, indices,
+                              dense_shape=tf.constant([4, 2], tf.int64))
+    out = tfhvd.allreduce(slices, op=tfhvd.Average)
+    assert isinstance(out, tf.IndexedSlices)
+    assert np.allclose(out.values.numpy(), values.numpy())
+
+
+def test_allreduce_inside_tf_function(tfhvd):
+    @tf.function
+    def fn(x):
+        return tfhvd.allreduce(x, op=tfhvd.Sum, name="tf.fn.allreduce")
+
+    x = tf.ones([3])
+    assert np.allclose(fn(x).numpy(), np.ones(3))
+
+
+def test_gradient_tape_wrapping(tfhvd):
+    v = tf.Variable([1.0, 2.0])
+    with tf.GradientTape() as tape:
+        loss = tf.reduce_sum(v * v)
+    dist_tape = tfhvd.DistributedGradientTape(tape)
+    (grad,) = dist_tape.gradient(loss, [v])
+    assert np.allclose(grad.numpy(), 2 * v.numpy())
+
+
+def test_allgather_gradient(tfhvd):
+    v = tf.Variable([[1.0, 2.0], [3.0, 4.0]])
+    with tf.GradientTape() as tape:
+        gathered = tfhvd.allgather(v)
+        loss = tf.reduce_sum(gathered)
+    grad = tape.gradient(loss, v)
+    assert np.allclose(grad.numpy(), np.ones((2, 2)))
+
+
+def test_broadcast_gradient_root(tfhvd):
+    v = tf.Variable([1.0, 2.0])
+    with tf.GradientTape() as tape:
+        out = tfhvd.broadcast(v, root_rank=0)
+        loss = tf.reduce_sum(out * 3.0)
+    grad = tape.gradient(loss, v)
+    # rank 0 == root: receives the summed gradient.
+    assert np.allclose(grad.numpy(), [3.0, 3.0])
+
+
+def test_compression_fp16_roundtrip(tfhvd):
+    from horovod_tpu.tensorflow.compression import Compression
+
+    x = tf.constant([0.5, 1.25, -2.0])
+    c, ctx = Compression.fp16.compress(x)
+    assert c.dtype == tf.float16
+    d = Compression.fp16.decompress(c, ctx)
+    assert d.dtype == tf.float32
+    assert np.allclose(d.numpy(), x.numpy())
+    c, ctx = Compression.bf16.compress(x)
+    assert c.dtype == tf.bfloat16
+
+
+def test_broadcast_variables_size1(tfhvd):
+    v1 = tf.Variable([1.0, 2.0])
+    v2 = tf.Variable([[3.0]])
+    tfhvd.broadcast_variables([v1, v2], root_rank=0)
+    assert np.allclose(v1.numpy(), [1.0, 2.0])
+
+
+def test_broadcast_object_size1(tfhvd):
+    assert tfhvd.broadcast_object({"a": 1}, root_rank=0) == {"a": 1}
+    assert tfhvd.allgather_object([1, 2]) == [[1, 2]]
+
+
+def test_distributed_optimizer_v1_type_check(tfhvd):
+    with pytest.raises(ValueError):
+        tfhvd.DistributedOptimizer(object())
+
+
+def test_elastic_tf_state_commit_restore(tfhvd):
+    from horovod_tpu.tensorflow.elastic import TensorFlowState
+
+    v = tf.Variable([1.0, 2.0])
+    state = TensorFlowState(variables=[v], batch=0, epoch=0)
+    state.commit()
+    v.assign([9.0, 9.0])
+    state.batch = 7
+    state.restore()
+    assert np.allclose(v.numpy(), [1.0, 2.0])
+    assert state.batch == 0
+
+
+def test_join_and_barrier_size1(tfhvd):
+    assert tfhvd.join() == 0
+    tfhvd.barrier()
+
+
+# ---- multi-process distributed correctness ----------------------------------
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    import numpy as np
+    import tensorflow as tf
+    import horovod_tpu.tensorflow as hvd
+
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    assert size == int(os.environ["HOROVOD_SIZE"])
+
+    # -- allreduce sum/average
+    x = tf.ones([4], tf.float32) * float(rank + 1)
+    total = sum(range(1, size + 1))
+    y = hvd.allreduce(x, op=hvd.Sum)
+    assert np.allclose(y.numpy(), total), (rank, y.numpy())
+    y = hvd.allreduce(x, op=hvd.Average)
+    assert np.allclose(y.numpy(), total / size)
+
+    # -- allreduce inside tf.function (graph mode via py_function)
+    @tf.function
+    def reduced(t):
+        return hvd.allreduce(t, op=hvd.Sum, name="fn.allreduce")
+    assert np.allclose(reduced(x).numpy(), total)
+
+    # -- ragged allgather
+    local = np.full((rank + 1, 2), rank, np.float32)
+    gathered = hvd.allgather(tf.constant(local))
+    expect = np.concatenate(
+        [np.full((r + 1, 2), r, np.float32) for r in range(size)])
+    assert np.allclose(gathered.numpy(), expect)
+
+    # -- broadcast
+    b = tf.constant(np.full(3, rank, np.float32))
+    out = hvd.broadcast(b, root_rank=1)
+    assert np.allclose(out.numpy(), 1.0)
+
+    # -- broadcast_object
+    obj = {"rank": rank, "data": list(range(5))}
+    synced = hvd.broadcast_object(obj, root_rank=0)
+    assert synced["rank"] == 0
+
+    # -- DistributedGradientTape averages gradients across ranks
+    v = tf.Variable([float(rank + 1)])
+    with tf.GradientTape() as tape:
+        loss = tf.reduce_sum(v * v)
+    tape = hvd.DistributedGradientTape(tape)
+    (g,) = tape.gradient(loss, [v])
+    expect_g = sum(2.0 * (r + 1) for r in range(size)) / size
+    assert np.allclose(g.numpy(), expect_g), (rank, g.numpy())
+
+    # -- broadcast_variables makes ranks consistent
+    w = tf.Variable([float(rank)])
+    hvd.broadcast_variables([w], root_rank=0)
+    assert np.allclose(w.numpy(), 0.0)
+
+    hvd.shutdown()
+    print(f"TF_WORKER_{rank}_OK")
+""")
+
+
+@pytest.mark.parametrize("size", [2])
+def test_tensorflow_multiprocess(size, tmp_path):
+    port = _free_port()
+    script = tmp_path / "tf_worker.py"
+    script.write_text(_WORKER)
+    base_env = dict(os.environ)
+    base_env["HVD_REPO"] = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    base_env["JAX_PLATFORMS"] = "cpu"
+    base_env["HOROVOD_SIZE"] = str(size)
+    base_env["HOROVOD_CONTROLLER_PORT"] = str(port)
+    base_env["HOROVOD_CYCLE_TIME"] = "1.0"
+    procs = []
+    for r in range(size):
+        env = dict(base_env)
+        env["HOROVOD_RANK"] = str(r)
+        env["HOROVOD_LOCAL_RANK"] = str(r)
+        env["HOROVOD_LOCAL_SIZE"] = str(size)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    for r, p in enumerate(procs):
+        out, _ = p.communicate(timeout=300)
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"TF_WORKER_{r}_OK" in out, out

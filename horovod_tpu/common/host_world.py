@@ -61,6 +61,7 @@ class HostWorld:
                 os.environ.get(_config.HOROVOD_CROSS_RANK, str(self.rank)))
             self.cross_size = int(
                 os.environ.get(_config.HOROVOD_CROSS_SIZE, str(self.size)))
+            self._maybe_elastic_rerendezvous()
             if comm is not None:
                 # Parity with hvd.init(comm=[ranks]) (basics.py:33-65):
                 # restrict to a subset of the launched world.
@@ -86,6 +87,38 @@ class HostWorld:
                 # no controller or ring needed.
                 self._core = None
             self.initialized = True
+
+    def _maybe_elastic_rerendezvous(self):
+        """Elastic mode: the launcher's env block is only the *initial*
+        world; after membership changes the elastic driver publishes a new
+        slot plan in the rendezvous KV, so every (re-)init fetches this
+        worker's current rank layout from there (the reference workers do
+        the same against the elastic rendezvous handler,
+        ``run/elastic/rendezvous.py:22-45``)."""
+        if not os.environ.get(_config.HOROVOD_ELASTIC):
+            return
+        addr = os.environ.get(_config.HOROVOD_RENDEZVOUS_ADDR)
+        port = os.environ.get(_config.HOROVOD_RENDEZVOUS_PORT)
+        hostname = os.environ.get("HOROVOD_HOSTNAME")
+        if not (addr and port and hostname):
+            return
+        try:
+            from ..run.elastic.rendezvous import fetch_slot_info
+
+            info = fetch_slot_info(addr, int(port), hostname,
+                                   self.local_rank)
+        except Exception as e:
+            _log.warning(f"elastic re-rendezvous failed: {e}")
+            return
+        if info is None:
+            return  # this round's plan excludes us; keep env values
+        (self.rank, self.size, self.local_rank, self.local_size,
+         self.cross_rank, self.cross_size) = info
+        # The notification service must exist before training starts so
+        # the driver can reach us on the next membership change.
+        from ..run.elastic.worker import notification_manager
+
+        notification_manager.init()
 
     @staticmethod
     def _borrow_engine_core():

@@ -102,6 +102,10 @@ def load_library():
     lib.hvd_register_exec_callback.restype = None
     lib.hvd_register_exec_callback.argtypes = [_EXEC_CB_TYPE]
     lib.hvd_pending_count.restype = ctypes.c_int
+    lib.hvd_set_parameters.restype = None
+    lib.hvd_set_parameters.argtypes = [ctypes.c_double, ctypes.c_longlong]
+    lib.hvd_get_cycle_time_ms.restype = ctypes.c_double
+    lib.hvd_get_fusion_threshold.restype = ctypes.c_longlong
     _lib = lib
     return _lib
 
@@ -245,3 +249,12 @@ class NativeCore:
 
     def pending_count(self) -> int:
         return int(self.lib.hvd_pending_count())
+
+    def set_parameters(self, cycle_time_ms: float = -1.0,
+                       fusion_threshold: int = -1):
+        """Autotuner hook: apply new tunables to the running world."""
+        self.lib.hvd_set_parameters(cycle_time_ms, fusion_threshold)
+
+    def get_parameters(self) -> Tuple[float, int]:
+        return (float(self.lib.hvd_get_cycle_time_ms()),
+                int(self.lib.hvd_get_fusion_threshold()))

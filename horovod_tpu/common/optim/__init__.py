@@ -1,0 +1,8 @@
+"""Optimization primitives for the autotuner (parity:
+``horovod/common/optim/``): Gaussian-process regression + expected-
+improvement Bayesian optimization, in NumPy (the reference uses Eigen +
+L-BFGS, ``optim/gaussian_process.h:46``, ``optim/bayesian_optimization.h:45``).
+"""
+
+from .bayesian_optimization import BayesianOptimization  # noqa: F401
+from .gaussian_process import GaussianProcessRegressor  # noqa: F401

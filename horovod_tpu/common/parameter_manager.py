@@ -1,0 +1,116 @@
+"""Autotuning parameter manager (parity:
+``horovod/common/parameter_manager.{h,cc}``).
+
+Tunes (fusion threshold MB, cycle time ms) online, scoring each sample by
+observed collective throughput (bytes/sec, ``parameter_manager.cc``
+scoring): a warmup discard phase, then ``steps_per_sample`` scored steps
+per candidate from Bayesian optimization, until ``bayes_opt_max_samples``
+samples have been taken, after which the best point is pinned.
+
+TPU-native placement: fusion planning happens centrally in the coordinator
+(csrc controller ``FuseResponses``), so applying the tuned threshold on the
+coordinator process governs the whole job; cycle time paces each rank's own
+background loop. There is therefore no cross-rank parameter broadcast — the
+reference needs ``Controller::SynchronizeParameters`` (controller.cc:33-47)
+only because every rank fuses independently.
+
+Search space follows the reference (``parameter_manager.cc:42``): fusion
+threshold 0-64 MB, cycle time 1-25 ms, in log scale for the threshold.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from . import logging as _log
+from .optim.bayesian_optimization import BayesianOptimization
+
+MB = 1024 * 1024
+
+
+class ParameterManager:
+    def __init__(self, core, warmup_samples: int = 3,
+                 steps_per_sample: int = 10, max_samples: int = 20,
+                 gp_noise: float = 0.8, log_file: str = "",
+                 initial_cycle_ms: float = 5.0,
+                 initial_fusion_bytes: int = 64 * MB):
+        self._core = core
+        self._warmup_remaining = warmup_samples
+        self._steps_per_sample = steps_per_sample
+        self._max_samples = max_samples
+        self._bayes = BayesianOptimization(
+            # (fusion MB, cycle ms) — reference search space.
+            bounds=[(0.0, 64.0), (1.0, 25.0)], alpha=gp_noise ** 2)
+        self._log_file = log_file
+        self._samples_taken = 0
+        self._steps_in_sample = 0
+        self._bytes_in_sample = 0
+        self._sample_start = time.perf_counter()
+        self._current = (initial_fusion_bytes / MB, initial_cycle_ms)
+        self._tuning = True
+        self._best_score: Optional[float] = None
+        if log_file:
+            with open(log_file, "w") as f:
+                f.write("sample,fusion_mb,cycle_ms,score_bytes_per_sec\n")
+
+    @property
+    def active(self) -> bool:
+        return self._tuning
+
+    def update(self, nbytes: int) -> None:
+        """Record one completed collective step of ``nbytes`` total bytes
+        (parity: ``ParameterManager::Update``)."""
+        if not self._tuning:
+            return
+        self._bytes_in_sample += nbytes
+        self._steps_in_sample += 1
+        if self._steps_in_sample < self._steps_per_sample:
+            return
+        elapsed = max(time.perf_counter() - self._sample_start, 1e-6)
+        score = self._bytes_in_sample / elapsed
+        if self._warmup_remaining > 0:
+            # Warmup: discard the score, keep current params
+            # (parity: warmup logic parameter_manager.cc:42-150).
+            self._warmup_remaining -= 1
+        else:
+            self._record_sample(score)
+        self._steps_in_sample = 0
+        self._bytes_in_sample = 0
+        self._sample_start = time.perf_counter()
+
+    def _record_sample(self, score: float) -> None:
+        fusion_mb, cycle_ms = self._current
+        self._bayes.add_sample([fusion_mb, cycle_ms], score)
+        self._samples_taken += 1
+        if self._log_file:
+            with open(self._log_file, "a") as f:
+                f.write(f"{self._samples_taken},{fusion_mb:.2f},"
+                        f"{cycle_ms:.2f},{score:.0f}\n")
+        if self._samples_taken >= self._max_samples:
+            best_x, best_y = self._bayes.best()
+            self._tuning = False
+            self._best_score = best_y
+            self._apply(best_x[0], best_x[1])
+            _log.info(
+                f"autotune converged: fusion={best_x[0]:.1f}MB "
+                f"cycle={best_x[1]:.1f}ms ({best_y / MB:.1f} MB/s)")
+            return
+        nxt = self._bayes.suggest()
+        self._apply(nxt[0], nxt[1])
+
+    def _apply(self, fusion_mb: float, cycle_ms: float) -> None:
+        self._current = (float(fusion_mb), float(cycle_ms))
+        if self._core is not None:
+            self._core.set_parameters(
+                cycle_time_ms=float(cycle_ms),
+                fusion_threshold=int(fusion_mb * MB))
+
+    # introspection
+    @property
+    def current(self):
+        return self._current
+
+    @property
+    def samples_taken(self) -> int:
+        return self._samples_taken

@@ -169,6 +169,25 @@ def init(comm=None, devices=None):
                 mark_cycles=_state.config.timeline_mark_cycles,
             )
 
+        if _state.config.autotune and _state.engine.native_core is None:
+            _log.warning(
+                "HOROVOD_AUTOTUNE requested but the native runtime is "
+                "unavailable (direct mode has no tunable cycle/fusion "
+                "machinery); autotuning disabled")
+        elif _state.config.autotune:
+            from .parameter_manager import ParameterManager
+
+            cfg = _state.config
+            core = _state.engine.native_core
+            _state.autotuner = ParameterManager(
+                core, warmup_samples=cfg.autotune_warmup_samples,
+                steps_per_sample=cfg.autotune_steps_per_sample,
+                max_samples=cfg.autotune_bayes_opt_max_samples,
+                gp_noise=cfg.autotune_gaussian_process_noise,
+                log_file=cfg.autotune_log,
+                initial_cycle_ms=cfg.cycle_time_ms,
+                initial_fusion_bytes=cfg.fusion_threshold_bytes)
+
         _state.initialized = True
         _log.info(
             f"horovod_tpu initialized: size={_state.size} "

@@ -147,7 +147,7 @@ std::vector<Response> LocalController::ComputeResponseList(
     ValidateGroup(q.name, group, 1, &r);
     singles.push_back(std::move(r));
   }
-  return FuseResponses(std::move(singles), cfg_.fusion_threshold_bytes);
+  return FuseResponses(std::move(singles), fusion_threshold());
 }
 
 // ---- TcpController ---------------------------------------------------------
@@ -381,7 +381,7 @@ std::vector<Response> TcpController::CoordinatorCycle(
     std::fprintf(stderr, "[horovod_tpu coordinator] %s", report.c_str());
   }
 
-  auto fused = FuseResponses(std::move(singles), cfg_.fusion_threshold_bytes);
+  auto fused = FuseResponses(std::move(singles), fusion_threshold());
   CacheResponses(fused);
 
   bool all_down = true;

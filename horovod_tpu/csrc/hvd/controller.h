@@ -13,6 +13,7 @@
 #ifndef HVD_CONTROLLER_H_
 #define HVD_CONTROLLER_H_
 
+#include <atomic>
 #include <map>
 #include <memory>
 #include <string>
@@ -40,8 +41,18 @@ struct ControllerConfig {
 
 class Controller {
  public:
-  explicit Controller(ControllerConfig cfg) : cfg_(std::move(cfg)) {}
+  explicit Controller(ControllerConfig cfg)
+      : cfg_(std::move(cfg)),
+        fusion_threshold_bytes_(cfg_.fusion_threshold_bytes) {}
   virtual ~Controller() = default;
+
+  // Runtime-tunable (autotuner): read each cycle by the fusion planner.
+  void set_fusion_threshold(int64_t bytes) {
+    fusion_threshold_bytes_.store(bytes, std::memory_order_relaxed);
+  }
+  int64_t fusion_threshold() const {
+    return fusion_threshold_bytes_.load(std::memory_order_relaxed);
+  }
 
   virtual Status Initialize() = 0;
   // One negotiation cycle. `this_rank_shutdown` signals this rank wants out;
@@ -76,6 +87,7 @@ class Controller {
                                              int64_t threshold_bytes);
 
   ControllerConfig cfg_;
+  std::atomic<int64_t> fusion_threshold_bytes_;
   std::vector<std::pair<std::string, int>> data_endpoints_;
   std::string stall_report_;
 };

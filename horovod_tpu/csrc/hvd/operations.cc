@@ -82,7 +82,7 @@ struct GlobalState {
 
   int rank = 0, size = 1, local_rank = 0, local_size = 1;
   int cross_rank = 0, cross_size = 1;
-  double cycle_time_ms = 5.0;
+  std::atomic<double> cycle_time_ms{5.0};
 
   std::unique_ptr<Controller> controller;
   std::unique_ptr<Ring> ring;
@@ -340,6 +340,31 @@ void hvd_shutdown() {
     }
     s->inflight.clear();
   }
+}
+
+// Autotuner hook: adjust the cycle time / fusion threshold of a running
+// world (the reference applies ParameterManager updates inside
+// BackgroundThreadLoop, operations.cc:598-604).
+void hvd_set_parameters(double cycle_time_ms, long long fusion_threshold) {
+  auto* s = hvd::g();
+  // init_mu also guards hvd_shutdown's controller.reset(): without it a
+  // tuner update racing shutdown could dereference a freed controller.
+  std::lock_guard<std::mutex> lk(s->init_mu);
+  if (cycle_time_ms > 0) s->cycle_time_ms.store(cycle_time_ms);
+  if (fusion_threshold >= 0 && s->controller) {
+    s->controller->set_fusion_threshold(
+        static_cast<int64_t>(fusion_threshold));
+  }
+}
+
+double hvd_get_cycle_time_ms() { return hvd::g()->cycle_time_ms.load(); }
+
+long long hvd_get_fusion_threshold() {
+  auto* s = hvd::g();
+  std::lock_guard<std::mutex> lk(s->init_mu);
+  return s->controller ? static_cast<long long>(
+                             s->controller->fusion_threshold())
+                       : -1;
 }
 
 int hvd_initialized() { return hvd::g()->initialized.load() ? 1 : 0; }

@@ -1,0 +1,205 @@
+"""``import horovod_tpu.mxnet as hvd`` — MXNet binding (parity:
+``horovod/mxnet/__init__.py:36-150``).
+
+The reference pushes collectives onto the MXNet dependency engine via a C
+API (``mxnet/mpi_ops.cc:217-283``); the TPU-native equivalent rides the
+same host ring plane as the torch/TF bindings, converting NDArrays through
+their CPU buffers. MXNet is not part of the TPU image, so this module
+gates on import: the API surface is defined for parity and raises a clear
+error when MXNet itself is unavailable.
+"""
+
+from __future__ import annotations
+
+try:
+    import mxnet  # noqa: F401
+
+    _MXNET_AVAILABLE = True
+except ImportError:
+    _MXNET_AVAILABLE = False
+
+from ..common.host_world import world as _world
+from ..ops.xla import Adasum, Average, Max, Min, ReduceOp, Sum  # noqa: F401
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
+    "local_size", "cross_rank", "cross_size", "allreduce", "allreduce_",
+    "allgather", "broadcast", "broadcast_", "broadcast_parameters",
+    "DistributedOptimizer", "DistributedTrainer",
+    "Average", "Sum", "Adasum", "Min", "Max", "ReduceOp",
+]
+
+
+def _require_mxnet():
+    if not _MXNET_AVAILABLE:
+        raise ImportError(
+            "horovod_tpu.mxnet requires the mxnet package, which is not "
+            "installed in this environment. The torch/tensorflow/keras "
+            "bindings and the JAX-native API cover the same collective "
+            "surface.")
+
+
+def init(comm=None):
+    _world().init(comm=comm)
+
+
+def shutdown():
+    _world().shutdown()
+
+
+def is_initialized() -> bool:
+    return _world().initialized
+
+
+def rank() -> int:
+    _world().require_init()
+    return _world().rank
+
+
+def size() -> int:
+    _world().require_init()
+    return _world().size
+
+
+def local_rank() -> int:
+    _world().require_init()
+    return _world().local_rank
+
+
+def local_size() -> int:
+    _world().require_init()
+    return _world().local_size
+
+
+def cross_rank() -> int:
+    _world().require_init()
+    return _world().cross_rank
+
+
+def cross_size() -> int:
+    _world().require_init()
+    return _world().cross_size
+
+
+def _nd_collective(kind, tensor, **kw):
+    """Route an NDArray through the numpy host-plane collectives."""
+    _require_mxnet()
+    import numpy as np
+
+    from ..tensorflow.mpi_ops import (
+        _np_allgather, _np_allreduce, _np_broadcast)
+
+    arr = tensor.asnumpy()
+    if kind == "allreduce":
+        out = _np_allreduce(arr, kw["name"], kw["op"], 1.0, 1.0)
+        if kw["op"] == Average:
+            out = (out / size()).astype(arr.dtype)
+    elif kind == "allgather":
+        out = _np_allgather(arr, kw["name"])
+    else:
+        out = _np_broadcast(arr, kw["root_rank"], kw["name"])
+    return mxnet.nd.array(out, dtype=arr.dtype.name)
+
+
+_name_counter = 0
+
+
+def _auto_name(prefix):
+    global _name_counter
+    _name_counter += 1
+    return f"mx.{prefix}.{_name_counter}"
+
+
+def allreduce(tensor, average=True, name=None, priority=0):
+    """(parity: ``mxnet/mpi_ops.py:48-120``; ``priority`` accepted for API
+    compatibility — XLA/ring scheduling replaces engine priorities)."""
+    return _nd_collective("allreduce", tensor,
+                          name=name or _auto_name("allreduce"),
+                          op=Average if average else Sum)
+
+
+def allreduce_(tensor, average=True, name=None, priority=0):
+    out = allreduce(tensor, average, name, priority)
+    tensor[:] = out
+    return tensor
+
+
+def allgather(tensor, name=None, priority=0):
+    return _nd_collective("allgather", tensor,
+                          name=name or _auto_name("allgather"))
+
+
+def broadcast(tensor, root_rank, name=None, priority=0):
+    return _nd_collective("broadcast", tensor, root_rank=root_rank,
+                          name=name or _auto_name("broadcast"))
+
+
+def broadcast_(tensor, root_rank, name=None, priority=0):
+    out = broadcast(tensor, root_rank, name, priority)
+    tensor[:] = out
+    return tensor
+
+
+def broadcast_parameters(params, root_rank=0):
+    """Broadcast a gluon ParameterDict / dict of NDArrays (parity:
+    ``mxnet/__init__.py:116-150``)."""
+    _require_mxnet()
+    for i, (name, p) in enumerate(sorted(params.items())):
+        try:
+            tensor = p.data() if hasattr(p, "data") else p
+        except Exception:
+            continue
+        broadcast_(tensor, root_rank, name=f"mx.bcast.{i}.{name}")
+
+
+class DistributedOptimizer:
+    """Wrap an mxnet Optimizer: allreduce gradients in update() (parity:
+    ``mxnet/__init__.py:36-77``)."""
+
+    def __init__(self, optimizer):
+        _require_mxnet()
+        self._optimizer = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def _do_allreduce(self, index, grad):
+        if size() == 1:
+            return grad
+        if isinstance(index, (tuple, list)):
+            return [allreduce(g, average=True,
+                              name=f"mx.grad.{i}")
+                    for i, g in zip(index, grad)]
+        return allreduce(grad, average=True, name=f"mx.grad.{index}")
+
+    def update(self, index, weight, grad, state):
+        grad = self._do_allreduce(index, grad)
+        self._optimizer.update(index, weight, grad, state)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        grad = self._do_allreduce(index, grad)
+        self._optimizer.update_multi_precision(index, weight, grad, state)
+
+
+def DistributedTrainer(params, optimizer, optimizer_params=None, **kwargs):
+    """gluon Trainer whose step() averages gradients (parity:
+    ``mxnet/__init__.py:79-114``)."""
+    _require_mxnet()
+    import mxnet.gluon as gluon
+
+    class _Trainer(gluon.Trainer):
+        def __init__(self):
+            super().__init__(params, optimizer,
+                             optimizer_params=optimizer_params, **kwargs)
+            self._scale /= size()
+
+        def _allreduce_grads(self):
+            if size() == 1:
+                return
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    for g in param.list_grad():
+                        allreduce_(g, average=False,
+                                   name=f"mx.trainer.grad.{i}")
+
+    return _Trainer()

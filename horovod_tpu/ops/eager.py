@@ -137,6 +137,20 @@ class EagerEngine:
 
     # -- lifecycle -----------------------------------------------------------
 
+    @property
+    def native_core(self):
+        """The shared NativeCore when the native control plane is live
+        (autotuner hook), else None."""
+        return self._core if self._native else None
+
+    def _record_autotune(self, stacks) -> None:
+        tuner = self._state.autotuner
+        if tuner is None or not tuner.active:
+            return
+        nbytes = sum(int(np.prod(s.shape)) * s.dtype.itemsize
+                     for s in stacks)
+        tuner.update(nbytes)
+
     def shutdown(self):
         if self._native:
             self._core.shutdown()
@@ -217,6 +231,7 @@ class EagerEngine:
         if timeline:
             for n in names:
                 timeline.end_activity(n, f"XLA_{kind.upper()}")
+        self._record_autotune([p.stacked for p in entries])
 
     # -- helpers -------------------------------------------------------------
 
@@ -426,6 +441,7 @@ class EagerEngine:
                     a, was_list, was_unstacked)
             else:
                 raise ValueError(kind)
+            self._record_autotune([stacked])
             err = None
         except Exception as e:
             out, post, err = None, None, e

@@ -1,0 +1,6 @@
+"""Launcher package (parity: ``horovod/run/``): the ``horovodrun`` CLI,
+slot assignment, HTTP rendezvous, per-host worker spawn, elastic driver,
+and the programmatic ``run()`` API.
+"""
+
+from .runner import main, parse_args, run, run_commandline  # noqa: F401

@@ -1,0 +1,6 @@
+"""``python -m horovod_tpu.run`` — the horovodrun-equivalent CLI."""
+
+from .runner import main
+
+if __name__ == "__main__":
+    main()

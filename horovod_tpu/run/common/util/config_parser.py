@@ -1,0 +1,132 @@
+"""YAML config file → args → env (parity:
+``horovod/run/common/util/config_parser.py:55-130`` set_args_from_config
+and ``:158+`` set_env_from_args).
+
+Three config layers converge on env vars exactly as in the reference
+(SURVEY §5): CLI flags and the YAML file populate the same args namespace;
+``set_env_from_args`` exports the HOROVOD_* runtime knobs the background
+loop reads at ``hvd.init()``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ....common import config as _config
+
+# YAML section/key → args attribute (reference config_parser.py:29-53).
+_PARAM_KEYS = {
+    "fusion_threshold_mb": "fusion_threshold_mb",
+    "cycle_time_ms": "cycle_time_ms",
+    "cache_capacity": "cache_capacity",
+    "hierarchical_allreduce": "hierarchical_allreduce",
+    "hierarchical_allgather": "hierarchical_allgather",
+}
+
+_AUTOTUNE_KEYS = {
+    "enabled": "autotune",
+    "log_file": "autotune_log_file",
+    "warmup_samples": "autotune_warmup_samples",
+    "steps_per_sample": "autotune_steps_per_sample",
+    "bayes_opt_max_samples": "autotune_bayes_opt_max_samples",
+    "gaussian_process_noise": "autotune_gaussian_process_noise",
+}
+
+_TIMELINE_KEYS = {
+    "filename": "timeline_filename",
+    "mark_cycles": "timeline_mark_cycles",
+}
+
+_STALL_KEYS = {
+    "disable": "no_stall_check",
+    "warning_time_seconds": "stall_check_warning_time_seconds",
+    "shutdown_time_seconds": "stall_check_shutdown_time_seconds",
+}
+
+_LOG_KEYS = {
+    "level": "log_level",
+    "hide_timestamp": "log_hide_timestamp",
+}
+
+
+def set_args_from_config(args, config: dict, override_args: set) -> None:
+    """Populate ``args`` from a parsed YAML dict without clobbering flags
+    the user passed explicitly (parity: ``config_parser.py:55-130``)."""
+
+    def apply(section: dict, keys: dict):
+        for yaml_key, attr in keys.items():
+            if yaml_key in section and attr not in override_args:
+                setattr(args, attr, section[yaml_key])
+
+    apply(config.get("params", {}), _PARAM_KEYS)
+    apply(config.get("autotune", {}), _AUTOTUNE_KEYS)
+    apply(config.get("timeline", {}), _TIMELINE_KEYS)
+    apply(config.get("stall_check", {}), _STALL_KEYS)
+    apply(config.get("logging", {}), _LOG_KEYS)
+
+
+def _set(env: dict, name: str, value) -> None:
+    if value is None:
+        return
+    if isinstance(value, bool):
+        if value:
+            env[name] = "1"
+        return
+    env[name] = str(value)
+
+
+def set_env_from_args(env: dict, args) -> dict:
+    """Export runtime knobs from args to env (parity:
+    ``config_parser.py:158+``)."""
+    if getattr(args, "fusion_threshold_mb", None) is not None:
+        env[_config.HOROVOD_FUSION_THRESHOLD] = str(
+            int(args.fusion_threshold_mb) * 1024 * 1024)
+    _set(env, _config.HOROVOD_CYCLE_TIME,
+         getattr(args, "cycle_time_ms", None))
+    _set(env, _config.HOROVOD_CACHE_CAPACITY,
+         getattr(args, "cache_capacity", None))
+    _set(env, _config.HOROVOD_HIERARCHICAL_ALLREDUCE,
+         getattr(args, "hierarchical_allreduce", None))
+    _set(env, _config.HOROVOD_HIERARCHICAL_ALLGATHER,
+         getattr(args, "hierarchical_allgather", None))
+    _set(env, _config.HOROVOD_AUTOTUNE, getattr(args, "autotune", None))
+    _set(env, _config.HOROVOD_AUTOTUNE_LOG,
+         getattr(args, "autotune_log_file", None))
+    _set(env, _config.HOROVOD_AUTOTUNE_WARMUP_SAMPLES,
+         getattr(args, "autotune_warmup_samples", None))
+    _set(env, _config.HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE,
+         getattr(args, "autotune_steps_per_sample", None))
+    _set(env, _config.HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES,
+         getattr(args, "autotune_bayes_opt_max_samples", None))
+    _set(env, _config.HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE,
+         getattr(args, "autotune_gaussian_process_noise", None))
+    _set(env, _config.HOROVOD_TIMELINE,
+         getattr(args, "timeline_filename", None))
+    _set(env, _config.HOROVOD_TIMELINE_MARK_CYCLES,
+         getattr(args, "timeline_mark_cycles", None))
+    _set(env, _config.HOROVOD_STALL_CHECK_DISABLE,
+         getattr(args, "no_stall_check", None))
+    _set(env, _config.HOROVOD_STALL_CHECK_TIME_SECONDS,
+         getattr(args, "stall_check_warning_time_seconds", None))
+    _set(env, _config.HOROVOD_STALL_SHUTDOWN_TIME_SECONDS,
+         getattr(args, "stall_check_shutdown_time_seconds", None))
+    _set(env, _config.HOROVOD_LOG_LEVEL, getattr(args, "log_level", None))
+    _set(env, _config.HOROVOD_LOG_HIDE_TIME,
+         getattr(args, "log_hide_timestamp", None))
+    return env
+
+
+def load_config_file(args, override_args: set) -> None:
+    """Read ``args.config_file`` (YAML) into args (parity:
+    ``runner.py`` config-file handling)."""
+    path: Optional[str] = getattr(args, "config_file", None)
+    if not path:
+        return
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"config file not found: {path}")
+    import yaml
+
+    with open(path) as f:
+        config = yaml.safe_load(f) or {}
+    set_args_from_config(args, config, override_args)

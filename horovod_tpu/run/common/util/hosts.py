@@ -1,0 +1,105 @@
+"""Host list parsing and slot assignment (parity:
+``horovod/run/common/util/hosts.py``).
+
+``parse_hosts("a:4,b:2")`` → HostInfo list; ``get_host_assignments`` packs
+``np`` ranks onto hosts in order, computing rank / local_rank / cross_rank
+exactly as the reference (``hosts.py:72``): ranks fill hosts sequentially,
+local_rank counts within a host, cross_rank is the index of the host among
+hosts that have a slot at that local_rank.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+
+@dataclass
+class HostInfo:
+    hostname: str
+    slots: int
+
+    @staticmethod
+    def from_string(host_string: str) -> "HostInfo":
+        if ":" in host_string:
+            hostname, slots = host_string.rsplit(":", 1)
+            return HostInfo(hostname, int(slots))
+        return HostInfo(host_string, 1)
+
+
+@dataclass
+class SlotInfo:
+    hostname: str
+    rank: int
+    local_rank: int
+    cross_rank: int
+    size: int
+    local_size: int
+    cross_size: int
+
+    def to_response_string(self) -> str:
+        return ",".join(
+            str(v) for v in (self.rank, self.size, self.local_rank,
+                             self.local_size, self.cross_rank,
+                             self.cross_size))
+
+
+def parse_hosts(hosts_string: str) -> List[HostInfo]:
+    """``"a:4,b:2"`` → ``[HostInfo(a,4), HostInfo(b,2)]`` (parity:
+    ``hosts.py:62``)."""
+    return [HostInfo.from_string(s) for s in hosts_string.split(",") if s]
+
+
+def parse_host_files(filename: str) -> str:
+    """Hostfile (``host slots=N`` per line, mpirun-style) → hosts string
+    (parity: ``runner.py`` hostfile handling)."""
+    hosts = []
+    with open(filename) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            slots = 1
+            for p in parts[1:]:
+                if p.startswith("slots="):
+                    slots = int(p.split("=", 1)[1])
+            hosts.append(f"{parts[0]}:{slots}")
+    return ",".join(hosts)
+
+
+def get_host_assignments(hosts: List[HostInfo], min_np: int,
+                         max_np: int = None) -> List[SlotInfo]:
+    """Pack ranks onto hosts in order (parity: ``hosts.py:72``).
+
+    Raises ValueError when fewer than ``min_np`` slots are available; caps
+    at ``max_np`` when given.
+    """
+    total_slots = sum(h.slots for h in hosts)
+    if total_slots < min_np:
+        raise ValueError(
+            f"requested {min_np} processes but only {total_slots} slots "
+            f"available on {len(hosts)} hosts")
+    np_ = min(total_slots, max_np) if max_np else min_np
+    assignments: List[SlotInfo] = []
+    rank = 0
+    for cross0, host in enumerate(hosts):
+        for local_rank in range(host.slots):
+            if rank >= np_:
+                break
+            assignments.append(SlotInfo(
+                hostname=host.hostname, rank=rank, local_rank=local_rank,
+                cross_rank=0, size=np_, local_size=0, cross_size=0))
+            rank += 1
+    # Fill in local_size / cross_rank / cross_size from the final packing.
+    by_host = {}
+    for a in assignments:
+        by_host.setdefault(a.hostname, []).append(a)
+    host_order = [h.hostname for h in hosts if h.hostname in by_host]
+    for a in assignments:
+        a.local_size = len(by_host[a.hostname])
+        peers = [h for h in host_order
+                 if len(by_host[h]) > a.local_rank]
+        a.cross_rank = peers.index(a.hostname)
+        a.cross_size = len(peers)
+    return assignments

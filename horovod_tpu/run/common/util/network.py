@@ -1,0 +1,167 @@
+"""Pickle-over-TCP request/response services with HMAC authentication
+(parity: ``horovod/run/common/util/network.py``).
+
+``BasicService`` accepts length-prefixed, HMAC-signed pickled requests and
+dispatches them to ``_handle``; ``BasicClient`` connects, sends one request,
+reads one response. The launcher's driver/task services, the worker
+notification plane, and the elastic rendezvous all ride this protocol.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, List, Optional, Tuple
+
+from . import secret
+
+_LEN = struct.Struct("!I")
+
+
+class PingRequest:
+    pass
+
+
+class PingResponse:
+    def __init__(self, service_name: str, source_address: str):
+        self.service_name = service_name
+        self.source_address = source_address
+
+
+class AckResponse:
+    pass
+
+
+def _send_frame(sock: socket.socket, obj: Any, key: bytes) -> None:
+    payload = pickle.dumps(obj)
+    digest = secret.compute_digest(key, payload)
+    sock.sendall(_LEN.pack(len(payload)) + digest + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("connection closed mid-frame")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket, key: bytes) -> Any:
+    n = _LEN.unpack(_recv_exact(sock, _LEN.size))[0]
+    digest = _recv_exact(sock, secret.DIGEST_LENGTH_BYTES)
+    payload = _recv_exact(sock, n)
+    if not secret.check_digest(key, payload, digest):
+        raise PermissionError("HMAC digest mismatch — unauthenticated peer")
+    return pickle.loads(payload)
+
+
+class BasicService:
+    """Threaded TCP service dispatching authenticated pickled requests."""
+
+    def __init__(self, service_name: str, key: bytes, nics=None):
+        self._service_name = service_name
+        self._key = key
+        self._nics = nics
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    req = _recv_frame(self.request, outer._key)
+                except (PermissionError, ConnectionError, EOFError):
+                    return
+                peer = self.request.getpeername()[0]
+                resp = outer._handle(req, peer)
+                try:
+                    _send_frame(self.request, resp, outer._key)
+                except OSError:
+                    pass
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server(("0.0.0.0", 0), _Handler)
+        self._port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"svc-{service_name}")
+        self._thread.start()
+
+    def _handle(self, req: Any, client_address: str) -> Any:
+        if isinstance(req, PingRequest):
+            return PingResponse(self._service_name, client_address)
+        raise NotImplementedError(
+            f"{self._service_name}: unknown request {type(req)}")
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def addresses(self) -> List[Tuple[str, int]]:
+        """All (ip, port) pairs this service is reachable at."""
+        addrs = [("127.0.0.1", self._port)]
+        try:
+            hostname_ip = socket.gethostbyname(socket.gethostname())
+            if hostname_ip != "127.0.0.1":
+                addrs.append((hostname_ip, self._port))
+        except OSError:
+            pass
+        return addrs
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5.0)
+
+
+class BasicClient:
+    """One-shot request client for a BasicService."""
+
+    def __init__(self, service_name: str,
+                 addresses: List[Tuple[str, int]], key: bytes,
+                 match_intf: bool = False,
+                 probe_timeout: float = 5.0, attempts: int = 3):
+        self._service_name = service_name
+        self._key = key
+        self._timeout = probe_timeout
+        self._attempts = attempts
+        self._address: Optional[Tuple[str, int]] = None
+        last_err: Optional[Exception] = None
+        for addr in addresses:
+            try:
+                resp = self._request_to(addr, PingRequest())
+                if isinstance(resp, PingResponse) and \
+                        resp.service_name == service_name:
+                    self._address = addr
+                    break
+            except (OSError, PermissionError, ConnectionError) as e:
+                last_err = e
+        if self._address is None:
+            raise ConnectionError(
+                f"could not reach service '{service_name}' at any of "
+                f"{addresses}: {last_err}")
+
+    def _request_to(self, addr: Tuple[str, int], req: Any) -> Any:
+        with socket.create_connection(addr, timeout=self._timeout) as sock:
+            _send_frame(sock, req, self._key)
+            return _recv_frame(sock, self._key)
+
+    def _request(self, req: Any) -> Any:
+        last_err: Optional[Exception] = None
+        for _ in range(self._attempts):
+            try:
+                return self._request_to(self._address, req)
+            except (OSError, ConnectionError) as e:
+                last_err = e
+        raise ConnectionError(
+            f"service '{self._service_name}' at {self._address} "
+            f"unreachable: {last_err}")
+
+    def ping(self) -> bool:
+        return isinstance(self._request(PingRequest()), PingResponse)

@@ -1,0 +1,100 @@
+"""Subprocess execution with whole-process-group cleanup (parity:
+``horovod/run/common/util/safe_shell_exec.py:160``).
+
+Workers are launched in their own process group (session) so that killing a
+worker also kills anything it spawned; stdout/stderr are pumped to the
+caller's streams (or files) by daemon threads; an optional ``events`` list
+of ``threading.Event``s triggers termination (the elastic driver uses this
+to tear down workers on host changes).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import List, Optional
+
+GRACEFUL_TERMINATION_TIME_S = 5
+
+
+def terminate_executor_shell_and_children(pid: int) -> None:
+    """SIGTERM the process group, then SIGKILL stragglers (parity:
+    ``safe_shell_exec.py:47-72``)."""
+    try:
+        pgid = os.getpgid(pid)
+    except OSError:
+        return
+    try:
+        os.killpg(pgid, signal.SIGTERM)
+    except OSError:
+        pass
+    deadline = time.time() + GRACEFUL_TERMINATION_TIME_S
+    while time.time() < deadline:
+        try:
+            os.killpg(pgid, 0)
+        except OSError:
+            return  # group is gone
+        time.sleep(0.1)
+    try:
+        os.killpg(pgid, signal.SIGKILL)
+    except OSError:
+        pass
+
+
+def _pump(src, dst, prefix: Optional[str] = None) -> threading.Thread:
+    def run():
+        try:
+            for line in iter(src.readline, b""):
+                text = line.decode("utf-8", errors="replace")
+                if prefix:
+                    text = f"[{prefix}]{text}" if text.strip() else text
+                dst.write(text)
+                dst.flush()
+        except ValueError:
+            pass  # stream closed
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def execute(command, env: Optional[dict] = None,
+            stdout=None, stderr=None,
+            events: Optional[List[threading.Event]] = None,
+            prefix: Optional[str] = None) -> int:
+    """Run ``command`` (shell string or argv list) in its own process
+    group; return its exit code. Any event in ``events`` firing terminates
+    the whole group (parity: ``safe_shell_exec.py:160``)."""
+    shell = isinstance(command, str)
+    proc = subprocess.Popen(
+        command, shell=shell, env=env, start_new_session=True,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+
+    pumps = [
+        _pump(proc.stdout, stdout or sys.stdout, prefix),
+        _pump(proc.stderr, stderr or sys.stderr, prefix),
+    ]
+
+    stop_watch = threading.Event()
+    watchers = []
+    for ev in events or []:
+        def watch(e=ev):
+            while not stop_watch.is_set():
+                if e.wait(0.1):
+                    terminate_executor_shell_and_children(proc.pid)
+                    return
+        t = threading.Thread(target=watch, daemon=True)
+        t.start()
+        watchers.append(t)
+
+    try:
+        exit_code = proc.wait()
+    finally:
+        stop_watch.set()
+        for t in pumps:
+            t.join(timeout=1.0)
+    return exit_code

@@ -1,0 +1,25 @@
+"""Shared-secret generation + HMAC signing (parity:
+``horovod/run/common/util/secret.py``): every launcher service message is
+authenticated with a per-job random key so a stray connection can't inject
+commands into the control plane.
+"""
+
+import hashlib
+import hmac
+import os
+
+DIGEST_LENGTH_BYTES = 32
+
+
+def make_secret_key() -> bytes:
+    return os.urandom(32)
+
+
+def compute_digest(secret_key: bytes, message_bytes: bytes) -> bytes:
+    return hmac.new(secret_key, message_bytes, hashlib.sha256).digest()
+
+
+def check_digest(secret_key: bytes, message_bytes: bytes,
+                 digest: bytes) -> bool:
+    expected = compute_digest(secret_key, message_bytes)
+    return hmac.compare_digest(expected, digest)

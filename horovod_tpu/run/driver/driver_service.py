@@ -1,0 +1,107 @@
+"""Pre-launch driver/task services (parity:
+``horovod/run/common/service/driver_service.py:43`` + ``task_service.py``).
+
+Before spawning ranks across hosts, the launcher can probe connectivity:
+a ``HorovodRunDriverService`` runs on the launch host; one
+``HorovodRunTaskService`` per target host registers its reachable
+addresses back, giving the driver a routable interface set (the
+reference's NIC-discovery round). On TPU pods the VM metadata usually
+answers this, so the probe is optional — but the service pair is also the
+transport for Spark-style integrations.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..common.util import network
+
+
+class RegisterTaskRequest:
+    def __init__(self, index: int, task_addresses: List[Tuple[str, int]]):
+        self.index = index
+        self.task_addresses = task_addresses
+
+
+class AllTaskAddressesRequest:
+    def __init__(self, index: int):
+        self.index = index
+
+
+class AllTaskAddressesResponse:
+    def __init__(self, all_task_addresses: Dict[int, List[Tuple[str, int]]]):
+        self.all_task_addresses = all_task_addresses
+
+
+class TaskIndexRequest:
+    def __init__(self, hostname: str):
+        self.hostname = hostname
+
+
+class TaskIndexResponse:
+    def __init__(self, index: int):
+        self.index = index
+
+
+class HorovodRunDriverService(network.BasicService):
+    NAME = "horovodrun driver service"
+
+    def __init__(self, num_hosts: int, key: bytes, nics=None):
+        super().__init__(self.NAME, key, nics)
+        self._num_hosts = num_hosts
+        self._all_task_addresses: Dict[int, List[Tuple[str, int]]] = {}
+        self._hostnames: Dict[str, int] = {}
+        self._wait_cond = threading.Condition()
+
+    def _handle(self, req, client_address):
+        if isinstance(req, RegisterTaskRequest):
+            with self._wait_cond:
+                self._all_task_addresses[req.index] = req.task_addresses
+                self._wait_cond.notify_all()
+            return network.AckResponse()
+        if isinstance(req, AllTaskAddressesRequest):
+            return AllTaskAddressesResponse(dict(self._all_task_addresses))
+        if isinstance(req, TaskIndexRequest):
+            with self._wait_cond:
+                if req.hostname not in self._hostnames:
+                    self._hostnames[req.hostname] = len(self._hostnames)
+            return TaskIndexResponse(self._hostnames[req.hostname])
+        return super()._handle(req, client_address)
+
+    def wait_for_initial_registration(self, timeout: float = 30.0) -> None:
+        with self._wait_cond:
+            ok = self._wait_cond.wait_for(
+                lambda: len(self._all_task_addresses) >= self._num_hosts,
+                timeout=timeout)
+        if not ok:
+            raise TimeoutError(
+                f"only {len(self._all_task_addresses)}/{self._num_hosts} "
+                "hosts registered with the driver service")
+
+    def task_addresses_for_driver(self, index: int):
+        return self._all_task_addresses.get(index)
+
+
+class HorovodRunTaskService(network.BasicService):
+    NAME_FMT = "horovodrun task service #%d"
+
+    def __init__(self, index: int, key: bytes, nics=None):
+        super().__init__(self.NAME_FMT % index, key, nics)
+        self.index = index
+
+
+class HorovodRunDriverClient(network.BasicClient):
+    def __init__(self, addresses, key):
+        super().__init__(HorovodRunDriverService.NAME, addresses, key)
+
+    def register_task(self, index: int,
+                      task_addresses: List[Tuple[str, int]]) -> None:
+        self._request(RegisterTaskRequest(index, task_addresses))
+
+    def all_task_addresses(self, index: int = 0):
+        resp = self._request(AllTaskAddressesRequest(index))
+        return resp.all_task_addresses
+
+    def task_index(self, hostname: str) -> int:
+        return self._request(TaskIndexRequest(hostname)).index

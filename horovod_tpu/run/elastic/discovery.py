@@ -1,0 +1,122 @@
+"""Host discovery for elastic training (parity:
+``horovod/run/elastic/discovery.py``).
+
+``HostDiscoveryScript`` shells out to the user's discovery script (printing
+``hostname`` or ``hostname:slots`` per line); ``HostManager`` tracks the
+available host set with **age ordering** — hosts keep their discovery order
+across updates, so rank assignment stays stable and rank 0 lives on the
+oldest host (``discovery.py:113-121``) — plus blacklisting with cooldown.
+"""
+
+from __future__ import annotations
+
+import random
+import subprocess
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class HostDiscovery:
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        """Return {hostname: slots} currently available."""
+        raise NotImplementedError
+
+
+class HostDiscoveryScript(HostDiscovery):
+    """Run the user script; each output line is ``host`` or ``host:slots``
+    (parity: ``discovery.py:42-60``)."""
+
+    def __init__(self, discovery_script: str, slots: Optional[int] = None):
+        self._script = discovery_script
+        self._default_slots = slots
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        out = subprocess.check_output(
+            self._script, shell=True, text=True,
+            stderr=subprocess.DEVNULL)
+        hosts: Dict[str, int] = {}
+        for line in out.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            if ":" in line:
+                host, slots = line.rsplit(":", 1)
+                hosts[host] = int(slots)
+            else:
+                if self._default_slots is None:
+                    raise ValueError(
+                        f"discovery script printed '{line}' without slots; "
+                        "pass --slots-per-host")
+                hosts[line] = self._default_slots
+        return hosts
+
+
+class FixedHosts(HostDiscovery):
+    """Static host set (used when elastic mode runs with -H)."""
+
+    def __init__(self, hosts: Dict[str, int]):
+        self._hosts = dict(hosts)
+
+    def set(self, hosts: Dict[str, int]):
+        self._hosts = dict(hosts)
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        return dict(self._hosts)
+
+
+class HostManager:
+    """Tracks available hosts in age order + blacklist (parity:
+    ``discovery.py:62-121``)."""
+
+    def __init__(self, discovery: HostDiscovery,
+                 cooldown_range: Optional[Tuple[int, int]] = None):
+        self._discovery = discovery
+        self._lock = threading.Lock()
+        self._order: List[str] = []  # discovery age order, oldest first
+        self._slots: Dict[str, int] = {}
+        self._blacklist: Dict[str, float] = {}  # host -> retry-after ts
+        self._cooldown_range = cooldown_range
+
+    def update_available_hosts(self) -> bool:
+        """Poll discovery; True when the usable host set changed (parity:
+        ``HostManager.update_available_hosts``)."""
+        found = self._discovery.find_available_hosts_and_slots()
+        with self._lock:
+            now = time.time()
+            usable = {
+                h: s for h, s in found.items()
+                if self._blacklist.get(h, 0.0) <= now
+            }
+            prev = {h: self._slots[h] for h in self._order}
+            # Age order: keep existing hosts' positions, append new ones.
+            self._order = [h for h in self._order if h in usable] + \
+                [h for h in found if h in usable and h not in self._order]
+            self._slots = usable
+            current = {h: self._slots[h] for h in self._order}
+            return current != prev
+
+    @property
+    def current_hosts(self) -> List[Tuple[str, int]]:
+        with self._lock:
+            return [(h, self._slots[h]) for h in self._order]
+
+    def available_slots(self) -> int:
+        with self._lock:
+            return sum(self._slots[h] for h in self._order)
+
+    def blacklist(self, host: str) -> None:
+        """Exclude a failing host; with a cooldown range it may return
+        after a randomized backoff (parity: ``discovery.py:102-108``)."""
+        with self._lock:
+            if self._cooldown_range:
+                lo, hi = self._cooldown_range
+                self._blacklist[host] = time.time() + random.uniform(lo, hi)
+            else:
+                self._blacklist[host] = float("inf")
+            self._order = [h for h in self._order if h != host]
+            self._slots.pop(host, None)
+
+    def is_blacklisted(self, host: str) -> bool:
+        with self._lock:
+            return self._blacklist.get(host, 0.0) > time.time()

@@ -1,0 +1,223 @@
+"""Elastic driver (parity: ``horovod/run/elastic/driver.py:58-296``).
+
+Responsibilities, matching the reference:
+
+- poll the host discovery source every ``DISCOVER_HOSTS_FREQUENCY_SECS``
+  (1 s) on a background thread (``driver.py:164-183``);
+- gate start on ``wait_for_available_slots(min_np)`` (``driver.py:133``);
+- assign ranks stably: hosts keep discovery-age order so existing workers'
+  ranks survive scale-up, rank 0 stays on the oldest host
+  (``discovery.py:113-121``);
+- spawn one worker per slot through a caller-provided ``create_worker_fn``
+  (``driver.py:259-277``);
+- on worker exit record success/failure; failures blacklist the host
+  (``registration.py:26-62``);
+- notify live workers over the notification plane when membership changes
+  (``driver.py:185-213``) and re-init the rendezvous with the new plan.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ...common import logging as _log
+from ..common.util.hosts import HostInfo, SlotInfo, get_host_assignments
+from .discovery import HostManager
+from .registration import FAILURE, SUCCESS, WorkerStateRegistry
+
+DISCOVER_HOSTS_FREQUENCY_SECS = 1.0
+
+
+class ElasticDriver:
+    def __init__(self, rendezvous, discovery, min_np: int, max_np: int = 0,
+                 timeout: Optional[float] = None,
+                 cooldown_range: Optional[Tuple[int, int]] = None,
+                 verbose: int = 0):
+        self._rendezvous = rendezvous
+        self._host_manager = HostManager(discovery, cooldown_range)
+        self._min_np = min_np
+        self._max_np = max_np or 0
+        self._timeout = timeout or 600.0
+        self._verbose = verbose
+
+        self._worker_registry = WorkerStateRegistry(self, self._host_manager)
+        self._create_worker_fn: Optional[Callable] = None
+        self._assignments: Dict[Tuple[str, int], SlotInfo] = {}
+        self._world_size = 0
+        self._rendezvous_round = 0
+
+        self._lock = threading.RLock()
+        self._shutdown = threading.Event()
+        self._host_change = threading.Event()
+        self._workers_active: Dict[Tuple[str, int], threading.Event] = {}
+        self._notify_client_factory = None  # injectable for tests
+        self._result: Optional[int] = None
+        self._done = threading.Event()
+        self._discovery_thread = threading.Thread(
+            target=self._discover_loop, daemon=True, name="elastic-discovery")
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, np: int, create_worker_fn: Callable) -> None:
+        """Begin: wait for min_np slots, assign, spawn workers (parity:
+        ``driver.py:84``)."""
+        self._create_worker_fn = create_worker_fn
+        self._host_manager.update_available_hosts()
+        self._discovery_thread.start()
+        self.wait_for_available_slots(self._min_np)
+        self._activate_workers(max(np, self._min_np))
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        for ev in self._workers_active.values():
+            ev.set()
+        if self._discovery_thread.is_alive():
+            self._discovery_thread.join(timeout=5.0)
+
+    def finished(self) -> bool:
+        return self._done.is_set()
+
+    def get_results(self) -> int:
+        self._done.wait()
+        return self._result if self._result is not None else 1
+
+    # -- membership ----------------------------------------------------------
+
+    def wait_for_available_slots(self, min_np: int):
+        """Block until at least ``min_np`` slots exist (parity:
+        ``driver.py:133``)."""
+        deadline = time.time() + self._timeout
+        while not self._shutdown.is_set():
+            if self._host_manager.available_slots() >= min_np:
+                return
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"timed out waiting for {min_np} slots; only "
+                    f"{self._host_manager.available_slots()} available")
+            time.sleep(DISCOVER_HOSTS_FREQUENCY_SECS)
+
+    def _discover_loop(self):
+        while not self._shutdown.is_set():
+            try:
+                if self._host_manager.update_available_hosts():
+                    self._host_change.set()
+                    self._on_hosts_updated()
+            except Exception as e:  # discovery script hiccups are transient
+                _log.warning(f"host discovery failed: {e}")
+            self._shutdown.wait(DISCOVER_HOSTS_FREQUENCY_SECS)
+
+    def _on_hosts_updated(self):
+        _log.info("elastic: host set changed; notifying workers")
+        ts = time.time()
+        with self._lock:
+            keys = list(self._assignments.keys())
+        factory = self._notify_client_factory
+        if factory is None:
+            return
+        for hostname, local_rank in keys:
+            try:
+                client = factory(hostname, local_rank)
+                if client is not None:
+                    client.notify_hosts_updated(ts)
+            except Exception as e:
+                _log.debug(
+                    f"could not notify {hostname}:{local_rank}: {e}")
+
+    def set_notify_client_factory(self, factory) -> None:
+        self._notify_client_factory = factory
+
+    # -- rank assignment -----------------------------------------------------
+
+    def _compute_assignments(self, np: int) -> List[SlotInfo]:
+        hosts = [HostInfo(h, s) for h, s in self._host_manager.current_hosts]
+        np_actual = min(sum(h.slots for h in hosts),
+                        self._max_np or np, max(np, self._min_np))
+        return get_host_assignments(hosts, np_actual)
+
+    def _activate_workers(self, np: int) -> None:
+        """(Re)assign ranks and spawn workers for newly-assigned slots
+        (parity: ``driver.py:157,259-277``)."""
+        with self._lock:
+            plan = self._compute_assignments(np)
+            self._world_size = plan[0].size if plan else 0
+            self._rendezvous_round += 1
+            self._rendezvous.init(plan)
+            new_slots = []
+            assignments = {}
+            for slot in plan:
+                key = (slot.hostname, slot.local_rank)
+                assignments[key] = slot
+                if key not in self._workers_active:
+                    new_slots.append(slot)
+            self._assignments = assignments
+            for slot in new_slots:
+                self._spawn(slot)
+
+    def _spawn(self, slot: SlotInfo) -> None:
+        shutdown_event = threading.Event()
+        self._workers_active[(slot.hostname, slot.local_rank)] = \
+            shutdown_event
+
+        def run():
+            code = self._create_worker_fn(slot, [shutdown_event,
+                                                 self._shutdown])
+            host, lslot = slot.hostname, slot.local_rank
+            if code == 0:
+                self._worker_registry.record_success(host, lslot)
+            else:
+                self._worker_registry.record_failure(host, lslot)
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"worker-{slot.hostname}-{slot.local_rank}"
+                         ).start()
+
+    # -- worker exit handling (called by WorkerStateRegistry) ---------------
+
+    def on_worker_exit(self, host: str, slot: int, state: str) -> None:
+        with self._lock:
+            self._workers_active.pop((host, slot), None)
+            still_active = len(self._workers_active)
+            successes = self._worker_registry.count(SUCCESS)
+            failures = self._worker_registry.count(FAILURE)
+        if self._shutdown.is_set():
+            return
+        if still_active == 0:
+            # Job over: success iff no worker failed (parity:
+            # driver.py:279-295).
+            self._result = 0 if failures == 0 and successes > 0 else 1
+            self._done.set()
+            self._shutdown.set()
+            return
+        if state == FAILURE:
+            # Try to resume with the remaining hosts once enough slots
+            # exist; workers meanwhile hit HorovodInternalError and wait in
+            # their retry loop for the new rendezvous.
+            try:
+                self.wait_for_available_slots(self._min_np)
+            except TimeoutError:
+                self._result = 1
+                self._done.set()
+                self._shutdown.set()
+                return
+            self._activate_workers(self._min_np)
+
+    # -- introspection (used by tests, parity: driver accessors) -------------
+
+    @property
+    def host_manager(self) -> HostManager:
+        return self._host_manager
+
+    @property
+    def world_size(self) -> int:
+        return self._world_size
+
+    def get_slot_info(self, host: str, slot: int) -> Optional[SlotInfo]:
+        with self._lock:
+            return self._assignments.get((host, slot))
+
+    def get_assignments(self) -> List[SlotInfo]:
+        with self._lock:
+            return sorted(self._assignments.values(),
+                          key=lambda s: s.rank)

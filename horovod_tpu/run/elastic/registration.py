@@ -1,0 +1,53 @@
+"""Worker liveness registry (parity: ``horovod/run/elastic/registration.py``).
+
+The driver records each worker's terminal state; a host whose worker FAILED
+is blacklisted, while SUCCESS counts toward clean job completion
+(``registration.py:26-62``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Tuple
+
+READY = "READY"
+SUCCESS = "SUCCESS"
+FAILURE = "FAILURE"
+
+
+class WorkerStateRegistry:
+    def __init__(self, driver, host_manager, verbose: bool = False):
+        self._driver = driver
+        self._host_manager = host_manager
+        self._lock = threading.Lock()
+        self._states: Dict[Tuple[str, int], str] = {}
+        self._barrier = threading.Event()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._states.clear()
+            self._barrier.clear()
+
+    def record_ready(self, host: str, slot: int) -> None:
+        with self._lock:
+            self._states[(host, slot)] = READY
+
+    def record_success(self, host: str, slot: int) -> None:
+        self._record(host, slot, SUCCESS)
+
+    def record_failure(self, host: str, slot: int) -> None:
+        self._record(host, slot, FAILURE)
+        self._host_manager.blacklist(host)
+
+    def _record(self, host: str, slot: int, state: str) -> None:
+        with self._lock:
+            self._states[(host, slot)] = state
+        self._driver.on_worker_exit(host, slot, state)
+
+    def count(self, state: str) -> int:
+        with self._lock:
+            return sum(1 for s in self._states.values() if s == state)
+
+    def last_worker_states(self) -> Dict[Tuple[str, int], str]:
+        with self._lock:
+            return dict(self._states)

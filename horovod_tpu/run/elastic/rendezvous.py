@@ -1,0 +1,27 @@
+"""Elastic rendezvous helpers (parity: ``horovod/run/elastic/rendezvous.py``).
+
+The driver writes each round's slot plan into the rendezvous KV
+(``RendezvousServer.init``); workers fetch their (possibly new) rank layout
+by ``/rank/<hostname>:<local_rank>`` at every (re-)init — the mechanism the
+reference implements as a KV-serving handler (``rendezvous.py:22-45``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ..http.http_client import read_data_from_kvstore
+
+RANK_SCOPE = "rank"
+
+
+def fetch_slot_info(addr: str, port: int, hostname: str, local_rank: int
+                    ) -> Optional[Tuple[int, int, int, int, int, int]]:
+    """Return (rank, size, local_rank, local_size, cross_rank, cross_size)
+    for this worker, or None when the round's plan excludes it."""
+    blob = read_data_from_kvstore(addr, port, RANK_SCOPE,
+                                  f"{hostname}:{local_rank}")
+    if blob is None:
+        return None
+    parts = blob.decode().split(",")
+    return tuple(int(p) for p in parts)  # type: ignore[return-value]

@@ -1,0 +1,90 @@
+"""Elastic launch entry (parity: ``horovod/run/gloo_run.py:275``
+gloo_run_elastic): start the rendezvous + elastic driver, spawn workers
+via ssh/local exec, return the job's exit status.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import sys
+from typing import List, Optional
+
+from ...common import config as _config
+from .. import launch as _launch
+from ..common.util import config_parser, secret
+from ..common.util import safe_shell_exec
+from ..http.http_server import RendezvousServer
+from .discovery import FixedHosts, HostDiscoveryScript
+from .driver import ElasticDriver
+from .worker import get_worker_client
+
+
+def run_elastic(args, command: List[str],
+                base_env: Optional[dict] = None) -> int:
+    if getattr(args, "host_discovery_script", None):
+        discovery = HostDiscoveryScript(args.host_discovery_script,
+                                        slots=getattr(args, "slots", None))
+    elif getattr(args, "hosts", None):
+        hosts = {}
+        for part in args.hosts.split(","):
+            name, slots = part.rsplit(":", 1)
+            hosts[name] = int(slots)
+        discovery = FixedHosts(hosts)
+    else:
+        raise ValueError(
+            "elastic mode needs --host-discovery-script or -H")
+
+    min_np = args.min_np or args.np or 1
+    max_np = args.max_np or 0
+
+    rendezvous = RendezvousServer(verbose=1 if args.verbose else 0)
+    rendezvous_port = rendezvous.start_server()
+    controller_port = _launch.free_port()
+    key = secret.make_secret_key()
+
+    env = dict(base_env if base_env is not None else os.environ)
+    config_parser.set_env_from_args(env, args)
+    env[_config.HOROVOD_ELASTIC] = "1"
+    env["HOROVOD_SECRET_KEY"] = base64.b64encode(key).decode()
+
+    driver = ElasticDriver(
+        rendezvous, discovery, min_np=min_np, max_np=max_np,
+        timeout=getattr(args, "start_timeout", None) or 600,
+        cooldown_range=getattr(args, "blacklist_cooldown_range", None),
+        verbose=1 if args.verbose else 0)
+
+    def launcher_addr() -> str:
+        hosts_now = [h for h, _ in driver.host_manager.current_hosts]
+        plan_like = [type("S", (), {"hostname": h})() for h in hosts_now]
+        if all(_launch.is_local(s.hostname) for s in plan_like):
+            return "127.0.0.1"
+        import socket as _socket
+
+        try:
+            return _socket.gethostbyname(_socket.gethostname())
+        except OSError:
+            return _socket.gethostname()
+
+    def create_worker(slot, events):
+        worker_env = _launch.slot_env(
+            slot, controller_addr=launcher_addr(),
+            controller_port=controller_port,
+            rendezvous_addr=launcher_addr(),
+            rendezvous_port=rendezvous_port, base_env=env)
+        cmd = _launch.build_worker_command(
+            slot, command, worker_env,
+            ssh_port=getattr(args, "ssh_port", None))
+        return safe_shell_exec.execute(
+            cmd, env=worker_env, events=events,
+            prefix=str(slot.rank), stdout=sys.stdout, stderr=sys.stderr)
+
+    driver.set_notify_client_factory(
+        lambda hostname, local_rank: get_worker_client(
+            launcher_addr(), rendezvous_port, hostname, local_rank, key))
+    try:
+        driver.start(args.np or min_np, create_worker)
+        return driver.get_results()
+    finally:
+        driver.stop()
+        rendezvous.stop_server()

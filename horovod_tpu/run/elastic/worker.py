@@ -1,0 +1,99 @@
+"""Worker-side notification plane (parity:
+``horovod/run/elastic/worker.py``).
+
+Each worker process runs a ``WorkerNotificationService`` (authenticated
+pickle-over-TCP) and registers its address in the rendezvous KV under
+``/workers/<rank>``. When the driver observes a host-set change it connects
+to every registered worker and sends ``HostsUpdatedRequest``; the service
+posts into the process-local elastic mailbox, which surfaces as
+``HostsUpdatedInterrupt`` at the next ``state.commit()`` —
+(``driver.py:185-213``, ``worker.py:101-110``).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional, Tuple
+
+from ...common import config as _config
+from ..common.util import network, secret
+from ..http.http_client import put_data_into_kvstore, read_data_from_kvstore
+
+
+class HostsUpdatedRequest:
+    def __init__(self, timestamp: float):
+        self.timestamp = timestamp
+
+
+class WorkerNotificationService(network.BasicService):
+    NAME = "worker notification service"
+
+    def __init__(self, key: bytes):
+        super().__init__(self.NAME, key)
+
+    def _handle(self, req, client_address):
+        if isinstance(req, HostsUpdatedRequest):
+            from ...elastic.state import notification_mailbox
+
+            notification_mailbox.post(req.timestamp)
+            return network.AckResponse()
+        return super()._handle(req, client_address)
+
+
+class WorkerNotificationClient(network.BasicClient):
+    def __init__(self, addresses: List[Tuple[str, int]], key: bytes):
+        super().__init__(WorkerNotificationService.NAME, addresses, key)
+
+    def notify_hosts_updated(self, timestamp: float) -> None:
+        self._request(HostsUpdatedRequest(timestamp))
+
+
+class WorkerNotificationManager:
+    """Worker-side singleton: starts the service and registers it in the
+    rendezvous KV (parity: ``worker.py:30-70``)."""
+
+    def __init__(self):
+        self._service: Optional[WorkerNotificationService] = None
+
+    def init(self) -> None:
+        if self._service is not None:
+            return
+        key_b64 = os.environ.get("HOROVOD_SECRET_KEY")
+        if not key_b64:
+            return  # not launched by the elastic driver
+        import base64
+
+        key = base64.b64decode(key_b64)
+        self._service = WorkerNotificationService(key)
+        addr = os.environ.get(_config.HOROVOD_RENDEZVOUS_ADDR)
+        port = os.environ.get(_config.HOROVOD_RENDEZVOUS_PORT)
+        # Keyed by (hostname, local_rank) — stable for the process's whole
+        # lifetime, unlike the rank, which the driver reassigns on
+        # membership changes.
+        hostname = os.environ.get("HOROVOD_HOSTNAME", "localhost")
+        local_rank = os.environ.get(_config.HOROVOD_LOCAL_RANK, "0")
+        if addr and port:
+            put_data_into_kvstore(
+                addr, int(port), "workers", f"{hostname}:{local_rank}",
+                pickle.dumps(self._service.addresses()))
+
+    def shutdown(self) -> None:
+        if self._service is not None:
+            self._service.shutdown()
+            self._service = None
+
+
+notification_manager = WorkerNotificationManager()
+
+
+def get_worker_client(rendezvous_addr: str, rendezvous_port: int,
+                      hostname: str, local_rank: int, key: bytes
+                      ) -> Optional[WorkerNotificationClient]:
+    """Driver side: look up a worker's notification address (keyed by its
+    stable hostname:local_rank identity) and connect."""
+    blob = read_data_from_kvstore(rendezvous_addr, rendezvous_port,
+                                  "workers", f"{hostname}:{local_rank}")
+    if blob is None:
+        return None
+    return WorkerNotificationClient(pickle.loads(blob), key)

@@ -1,0 +1,44 @@
+"""HTTP KV client helpers (parity: ``horovod/run/http/http_client.py``)."""
+
+from __future__ import annotations
+
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+
+def read_data_from_kvstore(addr: str, port: int, scope: str,
+                           key: str, timeout: float = 10.0,
+                           retries: int = 3) -> Optional[bytes]:
+    url = f"http://{addr}:{port}/{scope}/{key}"
+    for attempt in range(retries):
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            if attempt == retries - 1:
+                raise
+        except (urllib.error.URLError, OSError):
+            if attempt == retries - 1:
+                raise
+        time.sleep(0.5)
+    return None
+
+
+def put_data_into_kvstore(addr: str, port: int, scope: str, key: str,
+                          value: bytes, timeout: float = 10.0) -> None:
+    url = f"http://{addr}:{port}/{scope}/{key}"
+    req = urllib.request.Request(url, data=value, method="PUT")
+    with urllib.request.urlopen(req, timeout=timeout):
+        pass
+
+
+def delete_data_from_kvstore(addr: str, port: int, scope: str, key: str,
+                             timeout: float = 10.0) -> None:
+    url = f"http://{addr}:{port}/{scope}/{key}"
+    req = urllib.request.Request(url, method="DELETE")
+    with urllib.request.urlopen(req, timeout=timeout):
+        pass

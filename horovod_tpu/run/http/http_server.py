@@ -1,0 +1,125 @@
+"""HTTP KV store + rendezvous server (parity:
+``horovod/run/http/http_server.py:35-232``).
+
+The launcher starts one ``RendezvousServer``; workers GET/PUT small values
+under ``/scope/key`` paths. This plays the role of the reference's Gloo
+rendezvous: the TPU-native runtime uses it to distribute the coordinator
+address, slot assignments, and the elastic world state. DELETE is supported
+for the elastic driver's re-rendezvous rounds.
+"""
+
+from __future__ import annotations
+
+import collections
+import socketserver
+import threading
+from http.server import BaseHTTPRequestHandler
+from typing import Dict, List, Optional
+
+
+class KVStoreHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    # Silence per-request logging (parity: reference overrides log_message).
+    def log_message(self, fmt, *args):
+        pass
+
+    def _split(self):
+        parts = self.path.lstrip("/").split("/", 1)
+        if len(parts) != 2:
+            return None, None
+        return parts[0], parts[1]
+
+    def do_GET(self):
+        scope, key = self._split()
+        store = self.server.kvstore
+        with self.server.kvstore_lock:
+            value = store.get(scope, {}).get(key) if scope else None
+        if value is None:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(value)))
+        self.end_headers()
+        self.wfile.write(value)
+
+    def do_PUT(self):
+        scope, key = self._split()
+        length = int(self.headers.get("Content-Length", 0))
+        value = self.rfile.read(length)
+        if scope:
+            with self.server.kvstore_lock:
+                self.server.kvstore.setdefault(scope, {})[key] = value
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_DELETE(self):
+        scope, key = self._split()
+        with self.server.kvstore_lock:
+            scope_map = self.server.kvstore.get(scope, {})
+            scope_map.pop(key, None)
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+class _KVServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, addr, handler):
+        self.kvstore: Dict[str, Dict[str, bytes]] = collections.defaultdict(
+            dict)
+        self.kvstore_lock = threading.Lock()
+        super().__init__(addr, handler)
+
+
+class RendezvousServer:
+    """KV server owning the job's rendezvous state (parity:
+    ``http_server.py:139-232``)."""
+
+    def __init__(self, verbose: int = 0):
+        self._server: Optional[_KVServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._verbose = verbose
+
+    def start_server(self, handler_cls=KVStoreHandler) -> int:
+        self._server = _KVServer(("0.0.0.0", 0), handler_cls)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="rendezvous-http")
+        self._thread.start()
+        return self._server.server_address[1]
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def init(self, host_alloc_plan: List) -> None:
+        """Load slot assignments into the store so each worker can GET its
+        rank layout under ``/rank/<hostname>:<local_rank>`` (parity:
+        ``RendezvousHandler`` scope init, ``http_server.py:139+``)."""
+        with self._server.kvstore_lock:
+            self._server.kvstore.pop("rank", None)
+            store = self._server.kvstore.setdefault("rank", {})
+            for slot in host_alloc_plan:
+                key = f"{slot.hostname}:{slot.local_rank}"
+                store[key] = slot.to_response_string().encode()
+
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        with self._server.kvstore_lock:
+            self._server.kvstore.setdefault(scope, {})[key] = value
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        with self._server.kvstore_lock:
+            return self._server.kvstore.get(scope, {}).get(key)
+
+    def stop_server(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._thread.join(timeout=5.0)
+            self._server = None

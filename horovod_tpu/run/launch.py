@@ -1,0 +1,119 @@
+"""Per-slot worker launch (parity: ``horovod/run/gloo_run.py:64-99,183-259``).
+
+The launcher computes slot assignments, starts the HTTP rendezvous, and
+spawns one process per slot — locally via fork/exec, remotely via ssh —
+with the full ``HOROVOD_*`` topology env exported, exactly as the
+reference's gloo launcher does. The coordination endpoint
+(``HOROVOD_CONTROLLER_ADDR/PORT``) points at the rank-0 host: the native
+controller (csrc) binds ``port+1`` in the rank-0 process and
+``jax.distributed`` uses ``port``, replacing the reference's Gloo
+rendezvous + MPI comm world.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import socket
+import sys
+import threading
+from typing import Dict, List, Optional
+
+from ..common import config as _config
+from .common.util import safe_shell_exec
+from .common.util.hosts import SlotInfo
+
+LOCAL_HOSTNAMES = {"localhost", "127.0.0.1", "::1"}
+
+SSH_COMMAND_PREFIX = "ssh -o PasswordAuthentication=no -o " \
+                     "StrictHostKeyChecking=no"
+
+
+def is_local(hostname: str) -> bool:
+    if hostname in LOCAL_HOSTNAMES:
+        return True
+    try:
+        return hostname in (socket.gethostname(), socket.getfqdn())
+    except OSError:
+        return False
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("0.0.0.0", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def slot_env(slot: SlotInfo, controller_addr: str, controller_port: int,
+             rendezvous_addr: str, rendezvous_port: int,
+             base_env: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """The env block a worker needs to join the world (parity: env names
+    read by the reference's gloo context, ``gloo_context.cc:40-54``)."""
+    env = dict(base_env if base_env is not None else os.environ)
+    env[_config.HOROVOD_RANK] = str(slot.rank)
+    env[_config.HOROVOD_SIZE] = str(slot.size)
+    env[_config.HOROVOD_LOCAL_RANK] = str(slot.local_rank)
+    env[_config.HOROVOD_LOCAL_SIZE] = str(slot.local_size)
+    env[_config.HOROVOD_CROSS_RANK] = str(slot.cross_rank)
+    env[_config.HOROVOD_CROSS_SIZE] = str(slot.cross_size)
+    env[_config.HOROVOD_CONTROLLER_ADDR] = controller_addr
+    env[_config.HOROVOD_CONTROLLER_PORT] = str(controller_port)
+    env[_config.HOROVOD_RENDEZVOUS_ADDR] = rendezvous_addr
+    env[_config.HOROVOD_RENDEZVOUS_PORT] = str(rendezvous_port)
+    env["HOROVOD_HOSTNAME"] = slot.hostname
+    return env
+
+
+def build_worker_command(slot: SlotInfo, command: List[str],
+                         env: Dict[str, str], ssh_port: Optional[int] = None):
+    """argv (local) or ssh command string (remote) for one slot (parity:
+    ``gloo_run.py:64-99`` get_remote_command)."""
+    if is_local(slot.hostname):
+        return command
+    exports = " ".join(
+        f"{k}={shlex.quote(v)}" for k, v in sorted(env.items())
+        if k.startswith("HOROVOD_") or k in (
+            "PATH", "PYTHONPATH", "JAX_PLATFORMS", "TPU_WORKER_ID"))
+    port_arg = f" -p {ssh_port}" if ssh_port else ""
+    remote = f"cd {shlex.quote(os.getcwd())} > /dev/null 2>&1 ; " \
+             f"env {exports} {' '.join(shlex.quote(c) for c in command)}"
+    return f"{SSH_COMMAND_PREFIX}{port_arg} {slot.hostname} " \
+           f"{shlex.quote(remote)}"
+
+
+def launch_workers(host_alloc_plan: List[SlotInfo], command: List[str],
+                   controller_addr: str, controller_port: int,
+                   rendezvous_addr: str, rendezvous_port: int,
+                   ssh_port: Optional[int] = None,
+                   base_env: Optional[Dict[str, str]] = None,
+                   events: Optional[List[threading.Event]] = None,
+                   prefix_output: bool = True) -> List[int]:
+    """Spawn every slot's worker, pump output, return exit codes in rank
+    order. One failing worker triggers termination of the rest (parity:
+    ``gloo_run.py:183-259`` launch + MultiFileWriter behavior)."""
+    exit_codes: List[Optional[int]] = [None] * len(host_alloc_plan)
+    abort = threading.Event()
+    all_events = list(events or []) + [abort]
+    threads = []
+
+    def run_slot(i: int, slot: SlotInfo):
+        env = slot_env(slot, controller_addr, controller_port,
+                       rendezvous_addr, rendezvous_port, base_env)
+        cmd = build_worker_command(slot, command, env, ssh_port)
+        code = safe_shell_exec.execute(
+            cmd, env=env, events=all_events,
+            prefix=f"{slot.rank}" if prefix_output else None,
+            stdout=sys.stdout, stderr=sys.stderr)
+        exit_codes[i] = code
+        if code != 0:
+            abort.set()
+
+    for i, slot in enumerate(host_alloc_plan):
+        t = threading.Thread(target=run_slot, args=(i, slot), daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    return [c if c is not None else 1 for c in exit_codes]

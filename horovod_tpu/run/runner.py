@@ -1,0 +1,294 @@
+"""``horovodrun``-equivalent CLI + programmatic launch API (parity:
+``horovod/run/runner.py``).
+
+``parse_args`` mirrors the reference's flag groups (``runner.py:218-484``):
+basic np/hosts, tuning params, autotune, timeline, elastic, stall check,
+logging, ssh. ``_run`` dispatches static vs elastic
+(``runner.py:790-811``); the static path computes slot assignments, starts
+the HTTP rendezvous, and launches one worker per slot with the topology env
+(the gloo launcher's role — there is no mpirun to shell out to on TPU; the
+``--launcher`` flag keeps the reference's pluggable-launcher slot).
+
+Programmatic use (parity: ``horovod.run.run()``, ``runner.py:824+``)::
+
+    from horovod_tpu.run import run
+    results = run(train_fn, args=(1,), np=4)   # list of per-rank returns
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import sys
+import tempfile
+from typing import List, Optional
+
+from ..common import config as _config
+from ..version import __version__
+from . import launch as _launch
+from .common.util import config_parser, hosts as _hosts
+from .http.http_server import RendezvousServer
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(
+        prog="horovodrun",
+        description="TPU-native Horovod-compatible launcher",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("-v", "--version", action="version",
+                        version=__version__)
+
+    parser.add_argument("-np", "--num-proc", type=int, dest="np",
+                        help="Total number of training processes.")
+    parser.add_argument("-p", "--ssh-port", type=int, dest="ssh_port",
+                        help="SSH port on all hosts.")
+    parser.add_argument("--disable-cache", action="store_true",
+                        dest="disable_cache",
+                        help="Disable the response cache.")
+    parser.add_argument("--start-timeout", type=int, dest="start_timeout",
+                        default=30,
+                        help="Seconds to wait for all processes to start.")
+    parser.add_argument("--network-interface", dest="nics",
+                        help="Comma-separated NICs for the control plane.")
+    parser.add_argument("--output-filename", dest="output_filename",
+                        help="Redirect worker output to <dir>/rank.<N>")
+    parser.add_argument("--verbose", action="store_true")
+    parser.add_argument("--config-file", dest="config_file",
+                        help="YAML config file (same schema as the "
+                             "reference's horovodrun config).")
+
+    group_hosts = parser.add_mutually_exclusive_group()
+    group_hosts.add_argument("-H", "--hosts", dest="hosts",
+                             help="host1:slots,host2:slots list.")
+    group_hosts.add_argument("-hostfile", "--hostfile", dest="hostfile",
+                             help="Hostfile with 'host slots=N' lines.")
+
+    group_params = parser.add_argument_group("tuning parameter arguments")
+    group_params.add_argument("--fusion-threshold-mb", type=int,
+                              dest="fusion_threshold_mb",
+                              help="Fusion buffer threshold in MB.")
+    group_params.add_argument("--cycle-time-ms", type=float,
+                              dest="cycle_time_ms",
+                              help="Background cycle time in ms.")
+    group_params.add_argument("--cache-capacity", type=int,
+                              dest="cache_capacity",
+                              help="Response cache capacity.")
+    group_params.add_argument("--hierarchical-allreduce",
+                              action="store_const", const=True,
+                              dest="hierarchical_allreduce",
+                              help="Force hierarchical (ICIxDCN) allreduce.")
+    group_params.add_argument("--hierarchical-allgather",
+                              action="store_const", const=True,
+                              dest="hierarchical_allgather",
+                              help="Force hierarchical allgather.")
+
+    group_autotune = parser.add_argument_group("autotune arguments")
+    group_autotune.add_argument("--autotune", action="store_const",
+                                const=True, dest="autotune")
+    group_autotune.add_argument("--autotune-log-file",
+                                dest="autotune_log_file")
+    group_autotune.add_argument("--autotune-warmup-samples", type=int,
+                                dest="autotune_warmup_samples")
+    group_autotune.add_argument("--autotune-steps-per-sample", type=int,
+                                dest="autotune_steps_per_sample")
+    group_autotune.add_argument("--autotune-bayes-opt-max-samples", type=int,
+                                dest="autotune_bayes_opt_max_samples")
+    group_autotune.add_argument("--autotune-gaussian-process-noise",
+                                type=float,
+                                dest="autotune_gaussian_process_noise")
+
+    group_timeline = parser.add_argument_group("timeline arguments")
+    group_timeline.add_argument("--timeline-filename",
+                                dest="timeline_filename",
+                                help="Chrome-tracing JSON output path.")
+    group_timeline.add_argument("--timeline-mark-cycles",
+                                action="store_const", const=True,
+                                dest="timeline_mark_cycles")
+
+    group_elastic = parser.add_argument_group("elastic arguments")
+    group_elastic.add_argument("--min-np", type=int, dest="min_np",
+                               help="Minimum processes (elastic).")
+    group_elastic.add_argument("--max-np", type=int, dest="max_np",
+                               help="Maximum processes (elastic).")
+    group_elastic.add_argument("--slots-per-host", type=int, dest="slots",
+                               help="Slots per discovered host (elastic).")
+    group_elastic.add_argument("--host-discovery-script",
+                               dest="host_discovery_script",
+                               help="Script printing 'host:slots' lines; "
+                                    "enables elastic mode.")
+    group_elastic.add_argument("--blacklist-cooldown-range", type=int,
+                               nargs=2, dest="blacklist_cooldown_range",
+                               help="Min/max seconds before a blacklisted "
+                                    "host may be retried.")
+
+    group_stall = parser.add_argument_group("stall check arguments")
+    group_stall.add_argument("--no-stall-check", action="store_const",
+                             const=True, dest="no_stall_check")
+    group_stall.add_argument("--stall-check-warning-time-seconds", type=int,
+                             dest="stall_check_warning_time_seconds")
+    group_stall.add_argument("--stall-check-shutdown-time-seconds", type=int,
+                             dest="stall_check_shutdown_time_seconds")
+
+    group_log = parser.add_argument_group("logging arguments")
+    group_log.add_argument("--log-level", dest="log_level",
+                           choices=["TRACE", "DEBUG", "INFO", "WARNING",
+                                    "ERROR", "FATAL"])
+    group_log.add_argument("--log-hide-timestamp", action="store_const",
+                           const=True, dest="log_hide_timestamp")
+
+    group_lib = parser.add_argument_group("library arguments")
+    group_lib.add_argument("--launcher", dest="launcher", default="auto",
+                           choices=["auto", "local", "ssh"],
+                           help="Worker launch transport (the reference's "
+                                "gloo/mpi/jsrun slot).")
+    # Reference-compat no-ops: collectives always run on XLA/native ring.
+    group_lib.add_argument("--gloo", action="store_true", help=argparse.SUPPRESS)
+    group_lib.add_argument("--mpi", action="store_true", help=argparse.SUPPRESS)
+
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="Training command to run.")
+
+    args = parser.parse_args(argv)
+    # Track which flags the user set explicitly so the config file never
+    # overrides the command line (parity: runner.py override_args).
+    args._override_args = {
+        a.dest for a in parser._actions
+        if getattr(args, a.dest, None) not in (None, False)
+        and a.dest not in ("command", "help")
+    }
+    return args
+
+
+def _hostnames(args) -> List[_hosts.HostInfo]:
+    if getattr(args, "hostfile", None):
+        return _hosts.parse_hosts(_hosts.parse_host_files(args.hostfile))
+    hosts_str = getattr(args, "hosts", None) or \
+        f"localhost:{args.np or 1}"
+    return _hosts.parse_hosts(hosts_str)
+
+
+def _controller_addr(host_alloc_plan) -> str:
+    """The address workers use to reach the rank-0 coordination services."""
+    first = host_alloc_plan[0].hostname
+    if _launch.is_local(first):
+        return "127.0.0.1"
+    return first
+
+
+def _launcher_addr(plan) -> str:
+    """Address where workers reach launcher-side services (rendezvous)."""
+    if all(_launch.is_local(s.hostname) for s in plan):
+        return "127.0.0.1"
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return socket.gethostname()
+
+
+def _run_static(args, command: List[str], base_env: Optional[dict] = None,
+                collect=None) -> int:
+    hosts = _hostnames(args)
+    np_ = args.np or sum(h.slots for h in hosts)
+    plan = _hosts.get_host_assignments(hosts, np_)
+
+    rendezvous = RendezvousServer(verbose=1 if args.verbose else 0)
+    rendezvous_port = rendezvous.start_server()
+    rendezvous.init(plan)
+    controller_port = _launch.free_port()
+    addr = _controller_addr(plan)
+
+    env = dict(base_env if base_env is not None else os.environ)
+    config_parser.set_env_from_args(env, args)
+    if getattr(args, "disable_cache", False):
+        env[_config.HOROVOD_CACHE_CAPACITY] = "0"
+    if getattr(args, "min_np", None):
+        env[_config.HOROVOD_ELASTIC] = "1"
+
+    try:
+        codes = _launch.launch_workers(
+            plan, command, controller_addr=addr,
+            controller_port=controller_port,
+            rendezvous_addr=_launcher_addr(plan),
+            rendezvous_port=rendezvous_port,
+            ssh_port=getattr(args, "ssh_port", None), base_env=env)
+        if collect is not None and max(codes, default=1) == 0:
+            collect(rendezvous, np_)
+    finally:
+        rendezvous.stop_server()
+    return max(codes) if codes else 0
+
+
+def _run_elastic(args, command: List[str],
+                 base_env: Optional[dict] = None) -> int:
+    from .elastic.runner import run_elastic
+
+    return run_elastic(args, command, base_env)
+
+
+def _run(args) -> int:
+    config_parser.load_config_file(args, getattr(args, "_override_args",
+                                                 set()))
+    command = list(args.command)
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        raise ValueError("no training command given")
+    if getattr(args, "host_discovery_script", None):
+        return _run_elastic(args, command)
+    if args.np is None and not (args.hosts or args.hostfile):
+        raise ValueError("-np (or -H/--hostfile) is required")
+    return _run_static(args, command)
+
+
+def run_commandline(argv: Optional[List[str]] = None) -> int:
+    return _run(parse_args(argv))
+
+
+def main() -> None:
+    sys.exit(run_commandline())
+
+
+# ---- programmatic API (parity: horovod.run.run, runner.py:824+) ------------
+
+
+def run(func, args=(), kwargs=None, np: int = 1,
+        hosts: Optional[str] = None, hostfile: Optional[str] = None,
+        ssh_port: Optional[int] = None, verbose: bool = False,
+        use_cloudpickle: bool = True, env: Optional[dict] = None):
+    """Run ``func(*args, **kwargs)`` on ``np`` ranks; return the list of
+    per-rank return values in rank order."""
+    import cloudpickle
+
+    with tempfile.TemporaryDirectory(prefix="hvdrun_") as tmpdir:
+        fn_path = os.path.join(tmpdir, "func.pkl")
+        with open(fn_path, "wb") as f:
+            cloudpickle.dump((func, tuple(args), dict(kwargs or {})), f)
+
+        ns = argparse.Namespace(
+            np=np, hosts=hosts, hostfile=hostfile, ssh_port=ssh_port,
+            verbose=verbose, disable_cache=False, config_file=None,
+            min_np=None, output_filename=None, start_timeout=30,
+            launcher="auto")
+        command = [sys.executable, "-m", "horovod_tpu.run.task_fn", fn_path]
+        base_env = dict(env if env is not None else os.environ)
+        base_env.setdefault("PYTHONPATH", os.pathsep.join(
+            p for p in sys.path if p))
+
+        results = [None] * np
+
+        def collect(rendezvous, np_):
+            # Workers PUT their pickled return value under /result/rank.N
+            # before exiting (task_fn), so by the time launch_workers
+            # returns the store is fully populated.
+            for r in range(np_):
+                blob = rendezvous.get("result", f"rank.{r}")
+                if blob is None:
+                    raise RuntimeError(f"rank {r} returned no result")
+                results[r] = cloudpickle.loads(blob)
+
+        code = _run_static(ns, command, base_env, collect=collect)
+        if code != 0:
+            raise RuntimeError(f"horovod_tpu.run.run failed with exit code "
+                               f"{code}")
+        return results

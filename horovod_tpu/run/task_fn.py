@@ -1,0 +1,34 @@
+"""Worker entry for the programmatic ``horovod_tpu.run.run()`` API (parity:
+``horovod/run/run_task.py``): unpickle the user function, execute it, PUT
+the pickled return value into the launcher's rendezvous KV store under
+``/result/rank.<N>``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> int:
+    import cloudpickle
+
+    from ..common import config as _config
+    from .http.http_client import put_data_into_kvstore
+
+    fn_path = sys.argv[1]
+    with open(fn_path, "rb") as f:
+        func, args, kwargs = cloudpickle.load(f)
+
+    result = func(*args, **kwargs)
+
+    addr = os.environ[_config.HOROVOD_RENDEZVOUS_ADDR]
+    port = int(os.environ[_config.HOROVOD_RENDEZVOUS_PORT])
+    rank = os.environ.get(_config.HOROVOD_RANK, "0")
+    put_data_into_kvstore(addr, port, "result", f"rank.{rank}",
+                          cloudpickle.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

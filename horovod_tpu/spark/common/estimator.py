@@ -1,0 +1,97 @@
+"""Spark ML Estimator base (parity: ``horovod/spark/common/estimator.py:26``
+HorovodEstimator / HorovodModel).
+
+The reference's Estimators train a Keras/Torch model over Parquet data
+materialized by a ``Store`` and return a Spark ML ``Model`` for batch
+inference. The TPU-native port keeps the exact param surface; ``fit``
+gates on pyspark (not in the TPU image) while parameter validation and
+store plumbing work standalone so estimator configs can be built and
+tested anywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .store import Store
+
+
+class EstimatorParams:
+    """Declared parameters (parity: the Param list in
+    ``common/estimator.py`` + ``params.py``)."""
+
+    _PARAMS = [
+        "num_proc", "model", "backend", "store", "loss", "loss_constructors",
+        "metrics", "loss_weights", "sample_weight_col", "feature_cols",
+        "label_cols", "validation", "callbacks", "batch_size", "epochs",
+        "verbose", "shuffle_buffer_size", "partitions_per_process",
+        "run_id", "train_steps_per_epoch", "validation_steps_per_epoch",
+        "transformation_fn", "train_reader_num_workers",
+        "val_reader_num_workers", "label_shapes",
+    ]
+
+    def __init__(self, **kwargs):
+        self._params: Dict[str, Any] = {k: None for k in self._PARAMS}
+        for k, v in kwargs.items():
+            if k not in self._params:
+                raise ValueError(
+                    f"unknown estimator param '{k}'; valid: "
+                    f"{sorted(self._params)}")
+            self._params[k] = v
+
+    def getOrDefault(self, name: str):
+        return self._params.get(name)
+
+    def setParams(self, **kwargs) -> "EstimatorParams":
+        for k, v in kwargs.items():
+            if k not in self._params:
+                raise ValueError(f"unknown estimator param '{k}'")
+            self._params[k] = v
+        return self
+
+
+class HorovodEstimator(EstimatorParams):
+    """Base estimator (parity: ``common/estimator.py:26``)."""
+
+    def _validate(self) -> None:
+        if self.getOrDefault("model") is None:
+            raise ValueError("model is required")
+        store = self.getOrDefault("store")
+        if store is not None and not isinstance(store, Store):
+            raise ValueError(f"store must be a Store, got {type(store)}")
+        if not self.getOrDefault("feature_cols"):
+            raise ValueError("feature_cols is required")
+        if not self.getOrDefault("label_cols"):
+            raise ValueError("label_cols is required")
+
+    def fit(self, df):
+        """Train on a Spark DataFrame; returns a HorovodModel."""
+        self._validate()
+        from .. import _require_pyspark
+
+        _require_pyspark()
+        raise NotImplementedError(
+            "Estimator.fit requires a Spark session with Petastorm-style "
+            "data materialization; train through horovod_tpu.spark.run or "
+            "the launcher instead")
+
+
+class HorovodModel:
+    """Trained-model wrapper for batch inference (parity:
+    ``common/estimator.py`` HorovodModel)."""
+
+    def __init__(self, model, feature_cols: Optional[List[str]] = None,
+                 label_cols: Optional[List[str]] = None,
+                 run_id: Optional[str] = None):
+        self.model = model
+        self.feature_cols = feature_cols
+        self.label_cols = label_cols
+        self.run_id = run_id
+
+    def transform(self, df):
+        from .. import _require_pyspark
+
+        _require_pyspark()
+        raise NotImplementedError(
+            "batch inference requires pyspark; call model directly for "
+            "local inference")

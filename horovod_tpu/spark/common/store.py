@@ -1,0 +1,137 @@
+"""Storage abstraction for Spark Estimators (parity:
+``horovod/spark/common/store.py:430``): where intermediate Parquet data,
+checkpoints, and logs live. ``LocalStore`` (plain filesystem) is fully
+functional; HDFS/S3 flavors are declared for API parity and gate on their
+optional dependencies.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import shutil
+from typing import Optional
+
+
+class Store:
+    """Interface (parity: ``store.py`` Store)."""
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def read(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def write_text(self, path: str, text: str) -> None:
+        raise NotImplementedError
+
+    def is_parquet_dataset(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def get_parquet_dataset(self, path: str):
+        raise NotImplementedError
+
+    def get_train_data_path(self, idx=None) -> str:
+        raise NotImplementedError
+
+    def get_val_data_path(self, idx=None) -> str:
+        raise NotImplementedError
+
+    def get_test_data_path(self, idx=None) -> str:
+        raise NotImplementedError
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_logs_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def saving_runs(self) -> bool:
+        return True
+
+    @staticmethod
+    def create(prefix_path: str, *args, **kwargs) -> "Store":
+        if prefix_path.startswith("hdfs://"):
+            return HDFSStore(prefix_path, *args, **kwargs)
+        if prefix_path.startswith("s3://"):
+            raise NotImplementedError(
+                "S3 store needs an object-store client; mount via FUSE and "
+                "use LocalStore, or extend Store")
+        return LocalStore(prefix_path, *args, **kwargs)
+
+
+class LocalStore(Store):
+    """Filesystem store (parity: ``store.py`` LocalStore)."""
+
+    def __init__(self, prefix_path: str,
+                 train_path: Optional[str] = None,
+                 val_path: Optional[str] = None,
+                 test_path: Optional[str] = None,
+                 runs_path: Optional[str] = None,
+                 save_runs: bool = True):
+        self.prefix_path = prefix_path
+        self._train_path = train_path or os.path.join(
+            prefix_path, "intermediate_train_data")
+        self._val_path = val_path or os.path.join(
+            prefix_path, "intermediate_val_data")
+        self._test_path = test_path or os.path.join(
+            prefix_path, "intermediate_test_data")
+        self._runs_path = runs_path or os.path.join(prefix_path, "runs")
+        self._save_runs = save_runs
+        os.makedirs(prefix_path, exist_ok=True)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def read(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def write_text(self, path: str, text: str) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(text)
+
+    def is_parquet_dataset(self, path: str) -> bool:
+        return os.path.isdir(path) and any(
+            n.endswith(".parquet") for n in os.listdir(path))
+
+    def get_parquet_dataset(self, path: str):
+        import pyarrow.parquet as pq  # optional dependency
+
+        return pq.ParquetDataset(path)
+
+    def _suffixed(self, base: str, idx) -> str:
+        return base if idx is None else f"{base}.{idx}"
+
+    def get_train_data_path(self, idx=None) -> str:
+        return self._suffixed(self._train_path, idx)
+
+    def get_val_data_path(self, idx=None) -> str:
+        return self._suffixed(self._val_path, idx)
+
+    def get_test_data_path(self, idx=None) -> str:
+        return self._suffixed(self._test_path, idx)
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return os.path.join(self._runs_path, run_id, "checkpoint")
+
+    def get_logs_path(self, run_id: str) -> str:
+        return os.path.join(self._runs_path, run_id, "logs")
+
+    def saving_runs(self) -> bool:
+        return self._save_runs
+
+    def clear(self) -> None:
+        with contextlib.suppress(FileNotFoundError):
+            shutil.rmtree(self.prefix_path)
+
+
+class HDFSStore(Store):
+    """HDFS store (parity: ``store.py`` HDFSStore); gates on pyarrow's
+    HDFS client."""
+
+    def __init__(self, prefix_path: str, *args, **kwargs):
+        raise NotImplementedError(
+            "HDFS store requires a pyarrow HDFS connection, unavailable in "
+            "the TPU image; use LocalStore on a mounted filesystem")

@@ -1,0 +1,114 @@
+"""Autotuner tests (reference: parameter manager + optim/ math,
+``parameter_manager.cc``, ``optim/bayesian_optimization.h``).
+"""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common.optim import (
+    BayesianOptimization, GaussianProcessRegressor)
+from horovod_tpu.common.parameter_manager import MB, ParameterManager
+
+
+def test_gp_interpolates_smooth_function():
+    rng = np.random.RandomState(0)
+    x = rng.rand(20, 1) * 10
+    y = np.sin(x[:, 0])
+    gp = GaussianProcessRegressor(alpha=1e-6)
+    gp.fit(x, y)
+    xq = np.linspace(0.5, 9.5, 25)[:, None]
+    mu, std = gp.predict(xq)
+    assert np.abs(mu - np.sin(xq[:, 0])).max() < 0.1
+    # Uncertainty shrinks near observed points.
+    mu_at, std_at = gp.predict(x[:3])
+    assert std_at.max() < std.mean() + 1e-6
+
+
+def test_bayes_opt_finds_max_of_quadratic():
+    # f(x) = -(x0-3)^2 - (x1-7)^2: optimum at (3, 7).
+    bo = BayesianOptimization(bounds=[(0, 10), (0, 10)], alpha=1e-4,
+                              seed=1)
+
+    def f(p):
+        return -(p[0] - 3.0) ** 2 - (p[1] - 7.0) ** 2
+
+    for _ in range(25):
+        x = bo.suggest()
+        bo.add_sample(x, f(x))
+    best_x, best_y = bo.best()
+    assert best_y > -1.5, (best_x, best_y)
+    assert abs(best_x[0] - 3.0) < 1.5 and abs(best_x[1] - 7.0) < 1.5
+
+
+class _FakeCore:
+    def __init__(self):
+        self.applied = []
+
+    def set_parameters(self, cycle_time_ms=-1.0, fusion_threshold=-1):
+        self.applied.append((cycle_time_ms, fusion_threshold))
+
+
+def test_parameter_manager_warmup_then_tunes_then_pins():
+    core = _FakeCore()
+    pm = ParameterManager(core, warmup_samples=1, steps_per_sample=2,
+                          max_samples=3, log_file="")
+    # Scoring favors larger fusion thresholds in this synthetic model.
+    for _ in range(2):
+        pm.update(10 * MB)  # warmup sample (discarded)
+    assert pm.samples_taken == 0
+    for _ in range(3 * 2):
+        pm.update(10 * MB)
+    assert pm.samples_taken == 3
+    assert not pm.active  # converged and pinned
+    # Every sample transition applied parameters to the core, plus the
+    # final best-point pin.
+    assert len(core.applied) >= 3
+    cycle, fusion = core.applied[-1]
+    assert 1.0 <= cycle <= 25.0
+    assert 0 <= fusion <= 64 * MB
+
+
+def test_parameter_manager_logs(tmp_path):
+    log = tmp_path / "autotune.csv"
+    pm = ParameterManager(_FakeCore(), warmup_samples=0,
+                          steps_per_sample=1, max_samples=2,
+                          log_file=str(log))
+    pm.update(MB)
+    pm.update(MB)
+    lines = log.read_text().strip().splitlines()
+    assert lines[0].startswith("sample,fusion_mb,cycle_ms")
+    assert len(lines) == 3  # header + 2 samples
+
+
+def test_autotune_end_to_end_engine():
+    """HOROVOD_AUTOTUNE=1: the live engine feeds the tuner and the native
+    core's parameters move off their defaults."""
+    import os
+
+    os.environ["HOROVOD_AUTOTUNE"] = "1"
+    os.environ["HOROVOD_AUTOTUNE_WARMUP_SAMPLES"] = "1"
+    os.environ["HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"] = "2"
+    os.environ["HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"] = "2"
+    try:
+        import horovod_tpu as hvd
+
+        hvd.init()
+        try:
+            from horovod_tpu.common.state import global_state
+
+            st = global_state()
+            assert st.autotuner is not None
+            for i in range(8):
+                hvd.allreduce(np.ones(64, np.float32),
+                              name=f"autotune.{i}", op=hvd.Sum)
+            assert st.autotuner.samples_taken >= 2
+            assert not st.autotuner.active
+            if st.engine.native_core is not None:
+                cycle, fusion = st.engine.native_core.get_parameters()
+                assert 1.0 <= cycle <= 25.0
+        finally:
+            hvd.shutdown()
+    finally:
+        for k in list(os.environ):
+            if k.startswith("HOROVOD_AUTOTUNE"):
+                del os.environ[k]

@@ -1,0 +1,196 @@
+"""Elastic driver unit tests (reference: ``test/test_elastic_driver.py``,
+SURVEY §4 Pattern 2): fake discovery + mocked worker exec assert stable
+rank assignment, scale-up/down, blacklisting, and min-np gating.
+"""
+
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.run.elastic.discovery import (
+    FixedHosts, HostDiscoveryScript, HostManager)
+from horovod_tpu.run.elastic.driver import ElasticDriver
+from horovod_tpu.run.http.http_server import RendezvousServer
+
+
+class _FakeRendezvous:
+    def __init__(self):
+        self.rounds = []
+
+    def init(self, plan):
+        self.rounds.append(list(plan))
+
+
+def _driver(hosts, min_np=1, max_np=0, **kw):
+    return ElasticDriver(_FakeRendezvous(), FixedHosts(hosts),
+                         min_np=min_np, max_np=max_np, timeout=5.0, **kw)
+
+
+def _blocking_worker(release: threading.Event):
+    def fn(slot, events):
+        while not release.is_set():
+            if any(e.is_set() for e in events):
+                return 0
+            time.sleep(0.01)
+        return 0
+
+    return fn
+
+
+def test_host_manager_age_order_and_update():
+    disc = FixedHosts({"a": 2, "b": 2})
+    mgr = HostManager(disc)
+    assert mgr.update_available_hosts() is True
+    assert [h for h, _ in mgr.current_hosts] == ["a", "b"]
+    # New host appends; existing order preserved.
+    disc.set({"c": 2, "a": 2, "b": 2})
+    assert mgr.update_available_hosts() is True
+    assert [h for h, _ in mgr.current_hosts] == ["a", "b", "c"]
+    # No change → False.
+    assert mgr.update_available_hosts() is False
+    # Removal keeps the rest in order.
+    disc.set({"c": 2, "b": 2})
+    assert mgr.update_available_hosts() is True
+    assert [h for h, _ in mgr.current_hosts] == ["b", "c"]
+
+
+def test_host_manager_blacklist():
+    disc = FixedHosts({"a": 2, "b": 2})
+    mgr = HostManager(disc)
+    mgr.update_available_hosts()
+    mgr.blacklist("a")
+    assert mgr.is_blacklisted("a")
+    assert [h for h, _ in mgr.current_hosts] == ["b"]
+    # A blacklisted host does not come back on update.
+    mgr.update_available_hosts()
+    assert [h for h, _ in mgr.current_hosts] == ["b"]
+
+
+def test_host_manager_blacklist_cooldown():
+    disc = FixedHosts({"a": 1})
+    mgr = HostManager(disc, cooldown_range=(0, 0))
+    mgr.update_available_hosts()
+    mgr.blacklist("a")
+    time.sleep(0.05)
+    mgr.update_available_hosts()  # cooldown elapsed → host returns
+    assert [h for h, _ in mgr.current_hosts] == ["a"]
+
+
+def test_discovery_script(tmp_path):
+    script = tmp_path / "discover.sh"
+    script.write_text("#!/bin/sh\necho hostA:4\necho hostB:2\n")
+    script.chmod(0o755)
+    disc = HostDiscoveryScript(str(script))
+    assert disc.find_available_hosts_and_slots() == {"hostA": 4, "hostB": 2}
+
+
+def test_discovery_script_default_slots(tmp_path):
+    script = tmp_path / "discover.sh"
+    script.write_text("#!/bin/sh\necho hostA\n")
+    script.chmod(0o755)
+    assert HostDiscoveryScript(str(script), slots=8) \
+        .find_available_hosts_and_slots() == {"hostA": 8}
+    with pytest.raises(ValueError):
+        HostDiscoveryScript(str(script)).find_available_hosts_and_slots()
+
+
+def test_driver_spawns_and_completes():
+    release = threading.Event()
+    driver = _driver({"a": 2}, min_np=2)
+    driver.start(2, _blocking_worker(release))
+    assert driver.world_size == 2
+    plan = driver.get_assignments()
+    assert [(s.hostname, s.rank) for s in plan] == [("a", 0), ("a", 1)]
+    release.set()
+    assert driver.get_results() == 0
+
+
+def test_driver_stable_ranks_on_scale_up():
+    disc = FixedHosts({"a": 2})
+    rendezvous = _FakeRendezvous()
+    driver = ElasticDriver(rendezvous, disc, min_np=2, max_np=4, timeout=5.0)
+    release = threading.Event()
+    driver.start(2, _blocking_worker(release))
+    assert driver.world_size == 2
+
+    # Scale up: new host appears; driver re-activates with 4 ranks and host
+    # 'a' keeps ranks 0-1 (age order).
+    disc.set({"b": 2, "a": 2})
+    deadline = time.time() + 5.0
+    while driver.host_manager.available_slots() < 4 and \
+            time.time() < deadline:
+        time.sleep(0.05)
+    driver._activate_workers(4)
+    plan = driver.get_assignments()
+    assert [(s.hostname, s.rank) for s in plan] == \
+        [("a", 0), ("a", 1), ("b", 2), ("b", 3)]
+    assert plan[0].size == 4
+    release.set()
+    driver.stop()
+
+
+def test_driver_failure_blacklists_and_recovers():
+    disc = FixedHosts({"a": 1, "b": 1})
+    rendezvous = _FakeRendezvous()
+    driver = ElasticDriver(rendezvous, disc, min_np=1, max_np=2, timeout=5.0)
+    release = threading.Event()
+    fail_b = threading.Event()
+    fail_b.set()
+
+    def worker(slot, events):
+        if slot.hostname == "b" and fail_b.is_set():
+            fail_b.clear()
+            return 1  # first worker on b dies
+        while not release.is_set():
+            if any(e.is_set() for e in events):
+                return 0
+            time.sleep(0.01)
+        return 0
+
+    driver.start(2, worker)
+    deadline = time.time() + 5.0
+    while not driver.host_manager.is_blacklisted("b") and \
+            time.time() < deadline:
+        time.sleep(0.05)
+    assert driver.host_manager.is_blacklisted("b")
+    # The job continues on host a alone (min_np=1) with a fresh plan.
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        plan = driver.get_assignments()
+        if [(s.hostname, s.rank) for s in plan] == [("a", 0)] and \
+                plan[0].size == 1:
+            break
+        time.sleep(0.05)
+    assert [(s.hostname, s.rank) for s in driver.get_assignments()] == \
+        [("a", 0)]
+    release.set()
+    assert driver.get_results() == 1  # a failure occurred along the way
+    driver.stop()
+
+
+def test_driver_min_np_gate_times_out():
+    driver = _driver({"a": 1}, min_np=4)
+    with pytest.raises(TimeoutError):
+        driver.wait_for_available_slots(4)
+    driver.stop()
+
+
+def test_rendezvous_rounds_written():
+    rendezvous = RendezvousServer()
+    port = rendezvous.start_server()
+    try:
+        disc = FixedHosts({"localhost": 2})
+        driver = ElasticDriver(rendezvous, disc, min_np=2, timeout=5.0)
+        release = threading.Event()
+        release.set()
+        driver.start(2, _blocking_worker(release))
+        assert driver.get_results() == 0
+
+        from horovod_tpu.run.elastic.rendezvous import fetch_slot_info
+
+        info = fetch_slot_info("127.0.0.1", port, "localhost", 1)
+        assert info == (1, 2, 1, 2, 0, 1)
+        driver.stop()
+    finally:
+        rendezvous.stop_server()

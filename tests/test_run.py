@@ -1,0 +1,233 @@
+"""Launcher tests (reference: ``test/test_run.py`` Pattern 2, SURVEY §4):
+arg parsing, host/slot assignment math, config-file precedence, worker
+command construction, rendezvous KV, service protocol, and a real
+end-to-end ``run()``/CLI launch on localhost.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from horovod_tpu.run import parse_args
+from horovod_tpu.run import launch as launch_mod
+from horovod_tpu.run.common.util import config_parser, secret
+from horovod_tpu.run.common.util.hosts import (
+    HostInfo, get_host_assignments, parse_host_files, parse_hosts)
+from horovod_tpu.run.common.util.network import BasicClient, BasicService
+from horovod_tpu.run.http.http_client import (
+    put_data_into_kvstore, read_data_from_kvstore)
+from horovod_tpu.run.http.http_server import RendezvousServer
+
+
+# ---- arg parsing ------------------------------------------------------------
+
+
+def test_parse_args_basic():
+    args = parse_args(["-np", "4", "-H", "a:2,b:2", "python", "train.py"])
+    assert args.np == 4
+    assert args.hosts == "a:2,b:2"
+    assert args.command == ["python", "train.py"]
+
+
+def test_parse_args_groups():
+    args = parse_args([
+        "-np", "2", "--fusion-threshold-mb", "32", "--cycle-time-ms", "2.5",
+        "--autotune", "--timeline-filename", "/tmp/t.json",
+        "--no-stall-check", "--log-level", "DEBUG",
+        "--min-np", "1", "--max-np", "4",
+        "--host-discovery-script", "./d.sh", "python", "x.py"])
+    assert args.fusion_threshold_mb == 32
+    assert args.cycle_time_ms == 2.5
+    assert args.autotune is True
+    assert args.timeline_filename == "/tmp/t.json"
+    assert args.no_stall_check is True
+    assert args.min_np == 1 and args.max_np == 4
+    assert args.host_discovery_script == "./d.sh"
+
+
+# ---- hosts / slots ----------------------------------------------------------
+
+
+def test_parse_hosts():
+    hosts = parse_hosts("a:4,b:2,c")
+    assert hosts == [HostInfo("a", 4), HostInfo("b", 2), HostInfo("c", 1)]
+
+
+def test_parse_host_files(tmp_path):
+    f = tmp_path / "hostfile"
+    f.write_text("nodeA slots=4  # gpu box\nnodeB slots=2\n\nnodeC\n")
+    assert parse_host_files(str(f)) == "nodeA:4,nodeB:2,nodeC:1"
+
+
+def test_host_assignments_math():
+    plan = get_host_assignments(parse_hosts("a:2,b:2"), 4)
+    assert [s.rank for s in plan] == [0, 1, 2, 3]
+    assert [s.hostname for s in plan] == ["a", "a", "b", "b"]
+    assert [s.local_rank for s in plan] == [0, 1, 0, 1]
+    assert all(s.size == 4 for s in plan)
+    assert all(s.local_size == 2 for s in plan)
+    assert [s.cross_rank for s in plan] == [0, 0, 1, 1]
+    assert all(s.cross_size == 2 for s in plan)
+
+
+def test_host_assignments_ragged():
+    plan = get_host_assignments(parse_hosts("a:1,b:3"), 4)
+    assert [s.local_size for s in plan] == [1, 3, 3, 3]
+    # local_rank 0 exists on both hosts; ranks 1,2 only on b.
+    b_slots = [s for s in plan if s.hostname == "b"]
+    assert [s.cross_size for s in b_slots] == [2, 1, 1]
+
+
+def test_host_assignments_insufficient():
+    with pytest.raises(ValueError):
+        get_host_assignments(parse_hosts("a:1"), 4)
+
+
+# ---- config file ------------------------------------------------------------
+
+
+def test_config_file_and_env(tmp_path):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(textwrap.dedent("""
+        params:
+          fusion_threshold_mb: 16
+          cycle_time_ms: 7.5
+        autotune:
+          enabled: true
+          warmup_samples: 5
+        timeline:
+          filename: /tmp/tl.json
+        stall_check:
+          disable: true
+        logging:
+          level: INFO
+    """))
+    args = parse_args(["-np", "2", "--config-file", str(cfg),
+                       "--cycle-time-ms", "3.0", "python", "x.py"])
+    config_parser.load_config_file(args, args._override_args)
+    # config fills unset values; CLI flag wins over config.
+    assert args.fusion_threshold_mb == 16
+    assert args.cycle_time_ms == 3.0
+    assert args.autotune is True and args.autotune_warmup_samples == 5
+
+    env = {}
+    config_parser.set_env_from_args(env, args)
+    assert env["HOROVOD_FUSION_THRESHOLD"] == str(16 * 1024 * 1024)
+    assert env["HOROVOD_CYCLE_TIME"] == "3.0"
+    assert env["HOROVOD_AUTOTUNE"] == "1"
+    assert env["HOROVOD_TIMELINE"] == "/tmp/tl.json"
+    assert env["HOROVOD_STALL_CHECK_DISABLE"] == "1"
+    assert env["HOROVOD_LOG_LEVEL"] == "INFO"
+
+
+# ---- worker command construction (Pattern 2: exact command assertions) ------
+
+
+def test_slot_env_and_local_command():
+    plan = get_host_assignments(parse_hosts("localhost:2"), 2)
+    env = launch_mod.slot_env(plan[1], "127.0.0.1", 29500, "127.0.0.1",
+                              8080, base_env={})
+    assert env["HOROVOD_RANK"] == "1"
+    assert env["HOROVOD_SIZE"] == "2"
+    assert env["HOROVOD_LOCAL_RANK"] == "1"
+    assert env["HOROVOD_CONTROLLER_ADDR"] == "127.0.0.1"
+    assert env["HOROVOD_GLOO_RENDEZVOUS_PORT"] == "8080"
+    cmd = launch_mod.build_worker_command(plan[1], ["python", "t.py"], env)
+    assert cmd == ["python", "t.py"]  # local: plain argv
+
+
+def test_remote_ssh_command_string():
+    plan = get_host_assignments(parse_hosts("remotebox:1"), 1)
+    env = launch_mod.slot_env(plan[0], "10.0.0.1", 29500, "10.0.0.1", 8080,
+                              base_env={"PATH": "/usr/bin"})
+    cmd = launch_mod.build_worker_command(plan[0], ["python", "t.py"], env,
+                                          ssh_port=2222)
+    assert isinstance(cmd, str)
+    assert cmd.startswith("ssh -o PasswordAuthentication=no")
+    assert "-p 2222 remotebox" in cmd
+    assert "HOROVOD_RANK=0" in cmd
+    assert "python t.py" in cmd
+
+
+# ---- rendezvous KV ----------------------------------------------------------
+
+
+def test_rendezvous_kv_roundtrip():
+    server = RendezvousServer()
+    port = server.start_server()
+    try:
+        put_data_into_kvstore("127.0.0.1", port, "scope", "key", b"value")
+        assert read_data_from_kvstore("127.0.0.1", port, "scope",
+                                      "key") == b"value"
+        assert read_data_from_kvstore("127.0.0.1", port, "scope",
+                                      "missing") is None
+        plan = get_host_assignments(parse_hosts("localhost:2"), 2)
+        server.init(plan)
+        blob = read_data_from_kvstore("127.0.0.1", port, "rank",
+                                      "localhost:1")
+        assert blob.decode() == "1,2,1,2,0,1"
+    finally:
+        server.stop_server()
+
+
+# ---- service protocol -------------------------------------------------------
+
+
+def test_basic_service_ping_and_auth():
+    key = secret.make_secret_key()
+    svc = BasicService("test service", key)
+    try:
+        client = BasicClient("test service",
+                             [("127.0.0.1", svc.port)], key)
+        assert client.ping()
+        # Wrong key never authenticates.
+        with pytest.raises(ConnectionError):
+            BasicClient("test service", [("127.0.0.1", svc.port)],
+                        secret.make_secret_key(), probe_timeout=1.0)
+    finally:
+        svc.shutdown()
+
+
+# ---- end-to-end on localhost ------------------------------------------------
+
+
+def test_programmatic_run_two_ranks():
+    from horovod_tpu.run import run
+
+    def fn(scale):
+        import os
+
+        return scale * int(os.environ["HOROVOD_RANK"])
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    results = run(fn, args=(10,), np=2, env=env)
+    assert results == [0, 10]
+
+
+def test_cli_end_to_end(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        sys.path.insert(0, os.environ["HVD_REPO"])
+        import horovod_tpu.torch as hvd
+        hvd.init()
+        import torch
+        out = hvd.allreduce(torch.ones(3), op=hvd.Sum)
+        assert float(out[0]) == hvd.size(), out
+        print(f"CLI_RANK_{hvd.rank()}_OF_{hvd.size()}_OK")
+    """))
+    env = dict(os.environ)
+    env["HVD_REPO"] = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+         "--cycle-time-ms", "1.0", sys.executable, str(script)],
+        env=env, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "CLI_RANK_0_OF_2_OK" in proc.stdout
+    assert "CLI_RANK_1_OF_2_OK" in proc.stdout

@@ -1,0 +1,82 @@
+"""Spark store + estimator param tests (reference: ``test/test_spark.py``
+store/param subset — full Spark-session tests gate on pyspark, absent in
+the TPU image) and the MXNet import gate.
+"""
+
+import os
+
+import pytest
+
+from horovod_tpu.spark import LocalStore, Store
+from horovod_tpu.spark.common.estimator import (
+    EstimatorParams, HorovodEstimator, HorovodModel)
+
+
+def test_store_create_dispatch(tmp_path):
+    s = Store.create(str(tmp_path))
+    assert isinstance(s, LocalStore)
+    with pytest.raises(NotImplementedError):
+        Store.create("hdfs://nn/path")
+
+
+def test_local_store_paths(tmp_path):
+    s = LocalStore(str(tmp_path))
+    assert s.get_train_data_path().startswith(str(tmp_path))
+    assert s.get_train_data_path(3).endswith(".3")
+    assert s.get_checkpoint_path("r1") == \
+        os.path.join(str(tmp_path), "runs", "r1", "checkpoint")
+    assert s.get_logs_path("r1").endswith(os.path.join("r1", "logs"))
+    assert s.saving_runs()
+
+
+def test_local_store_io(tmp_path):
+    s = LocalStore(str(tmp_path))
+    p = os.path.join(str(tmp_path), "a", "b.txt")
+    s.write_text(p, "hello")
+    assert s.exists(p)
+    assert s.read(p) == b"hello"
+    assert not s.is_parquet_dataset(str(tmp_path))
+
+
+def test_estimator_params_validation():
+    with pytest.raises(ValueError):
+        EstimatorParams(bogus_param=1)
+    est = HorovodEstimator(model=object(), feature_cols=["x"],
+                           label_cols=["y"], epochs=3)
+    assert est.getOrDefault("epochs") == 3
+    est.setParams(batch_size=16)
+    assert est.getOrDefault("batch_size") == 16
+    # Missing model fails validation.
+    with pytest.raises(ValueError):
+        HorovodEstimator(feature_cols=["x"], label_cols=["y"])._validate()
+    # Valid estimator gates on pyspark at fit time.
+    with pytest.raises((ImportError, NotImplementedError)):
+        est.fit(None)
+
+
+def test_model_wrapper():
+    m = HorovodModel(model=42, feature_cols=["x"], run_id="r")
+    assert m.model == 42
+    with pytest.raises((ImportError, NotImplementedError)):
+        m.transform(None)
+
+
+def test_mxnet_gate():
+    import horovod_tpu.mxnet as hvd_mx
+
+    if not hvd_mx._MXNET_AVAILABLE:
+        with pytest.raises(ImportError):
+            hvd_mx.broadcast_parameters({})
+
+
+def test_spark_run_requires_pyspark():
+    import horovod_tpu.spark as spark
+
+    try:
+        import pyspark  # noqa: F401
+
+        pytest.skip("pyspark installed; gate not applicable")
+    except ImportError:
+        pass
+    with pytest.raises(ImportError):
+        spark.run(lambda: 0, num_proc=1)

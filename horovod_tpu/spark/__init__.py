@@ -20,6 +20,21 @@ from typing import Optional
 from .common.store import HDFSStore, LocalStore, Store  # noqa: F401
 
 
+def __getattr__(name):
+    # Lazy so importing horovod_tpu.spark never drags in keras/torch.
+    if name in ("KerasEstimator", "KerasModel"):
+        from .keras import KerasEstimator, KerasModel
+
+        return {"KerasEstimator": KerasEstimator,
+                "KerasModel": KerasModel}[name]
+    if name in ("TorchEstimator", "TorchModel"):
+        from .torch import TorchEstimator, TorchModel
+
+        return {"TorchEstimator": TorchEstimator,
+                "TorchModel": TorchModel}[name]
+    raise AttributeError(name)
+
+
 def _require_pyspark():
     try:
         import pyspark  # noqa: F401

@@ -1,0 +1,63 @@
+"""Estimator execution backends (parity: ``horovod/spark/common/backend.py``).
+
+The reference's ``SparkBackend`` runs the remote training function on
+``num_proc`` Spark executors through ``horovod.spark.run``. Here the same
+interface has two implementations:
+
+- ``LocalBackend`` — runs the training function in-process with the
+  collective world initialized over the local device mesh. This is the
+  TPU-native default: on a TPU VM the executors *are* the local chips, so
+  in-process SPMD replaces per-executor processes.
+- ``SparkBackend`` — dispatches through ``horovod_tpu.spark.run`` when
+  pyspark is available (cluster mode).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+
+class Backend:
+    """Interface (parity: ``backend.py`` Backend)."""
+
+    def run(self, fn: Callable, args=(), kwargs=None, env=None):
+        raise NotImplementedError
+
+    def num_processes(self) -> int:
+        raise NotImplementedError
+
+
+class LocalBackend(Backend):
+    """Run the remote function once in-process (world = local devices)."""
+
+    def __init__(self, num_proc: Optional[int] = None, verbose: int = 0):
+        self._num_proc = num_proc or 1
+        self.verbose = verbose
+
+    def num_processes(self) -> int:
+        return self._num_proc
+
+    def run(self, fn, args=(), kwargs=None, env=None):
+        return [fn(*args, **(kwargs or {}))]
+
+
+class SparkBackend(Backend):
+    """Run on Spark executors (parity: ``backend.py`` SparkBackend)."""
+
+    def __init__(self, num_proc: Optional[int] = None, env=None,
+                 verbose: int = 0, nics=None, prefix_output_with_timestamp=False):
+        self._num_proc = num_proc
+        self._env = env
+        self.verbose = verbose
+        self._nics = nics
+        self._prefix = prefix_output_with_timestamp
+
+    def num_processes(self) -> int:
+        return self._num_proc or 1
+
+    def run(self, fn, args=(), kwargs=None, env=None):
+        from .. import run as spark_run
+
+        return spark_run(fn, args=args, kwargs=kwargs or {},
+                         num_proc=self._num_proc, env=env or self._env,
+                         verbose=self.verbose, nics=self._nics)

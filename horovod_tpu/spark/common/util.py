@@ -1,0 +1,170 @@
+"""Data-preparation helpers for the Spark Estimators (parity:
+``horovod/spark/common/util.py`` — ``prepare_data``/``get_simple_meta_from_parquet``).
+
+The reference materializes a Spark DataFrame to Parquet in the Store and
+derives per-column metadata (shape, dtype, row counts) that the remote
+training functions need. The TPU-native port does the same from either a
+Spark DataFrame (when pyspark is importable) or a pandas DataFrame via
+pyarrow, so the full estimator path is exercisable without a cluster.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .store import Store
+
+
+def _to_pandas(df):
+    """Accept a pandas DataFrame directly or convert a Spark DataFrame."""
+    import pandas as pd
+
+    if isinstance(df, pd.DataFrame):
+        return df
+    # Spark DataFrame (duck-typed so pyspark stays optional).
+    if hasattr(df, "toPandas"):
+        return df.toPandas()
+    raise TypeError(
+        f"expected a pandas or Spark DataFrame, got {type(df)}")
+
+
+def _col_shape(series) -> Tuple[int, ...]:
+    """Per-row shape of a column: scalars → (), list/array cells → cell shape."""
+    first = series.iloc[0]
+    if isinstance(first, (list, tuple)):
+        return (len(first),)
+    if isinstance(first, np.ndarray):
+        return tuple(first.shape)
+    return ()
+
+
+def make_metadata(pdf, feature_cols: Sequence[str],
+                  label_cols: Sequence[str]) -> Dict:
+    """Column metadata in the spirit of the reference's ``_get_metadata``."""
+    meta = {"columns": {}, "feature_cols": list(feature_cols),
+            "label_cols": list(label_cols), "rows": len(pdf)}
+    avg_row_bytes = 0
+    for col in list(feature_cols) + list(label_cols):
+        if col not in pdf.columns:
+            raise ValueError(f"column '{col}' not in DataFrame "
+                             f"(have {list(pdf.columns)})")
+        shape = _col_shape(pdf[col])
+        arr = np.asarray(pdf[col].iloc[0])
+        meta["columns"][col] = {
+            "shape": list(shape),
+            "dtype": str(arr.dtype),
+            "size": int(np.prod(shape)) if shape else 1,
+        }
+        avg_row_bytes += (int(np.prod(shape)) if shape else 1) * arr.itemsize
+    meta["avg_row_size"] = avg_row_bytes
+    return meta
+
+
+def write_parquet(pdf, path: str, num_partitions: int = 1) -> int:
+    """Materialize a pandas DataFrame as a Parquet dataset directory with
+    ``num_partitions`` files (the sharding unit for distributed readers)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    os.makedirs(path, exist_ok=True)
+    n = len(pdf)
+    per = math.ceil(n / max(1, num_partitions)) or 1
+    written = 0
+    for i in range(max(1, num_partitions)):
+        chunk = pdf.iloc[i * per:(i + 1) * per]
+        if chunk.empty and i > 0:
+            break
+        table = pa.Table.from_pandas(chunk.reset_index(drop=True),
+                                     preserve_index=False)
+        pq.write_table(table, os.path.join(path, f"part-{i:05d}.parquet"))
+        written += len(chunk)
+    return written
+
+
+def prepare_data(store: Store, df, feature_cols: Sequence[str],
+                 label_cols: Sequence[str],
+                 validation=None, num_partitions: int = 1,
+                 dataset_idx=None) -> Dict:
+    """Split ``df`` into train/val, write both to the store's intermediate
+    Parquet paths, and return the metadata dict (parity:
+    ``common/util.py`` ``prepare_data``).
+
+    ``validation`` may be a float fraction (tail split, as the reference's
+    random split plays that role), a column name of 0/1 flags, or a second
+    DataFrame.
+    """
+    pdf = _to_pandas(df)
+    val_pdf = None
+    if validation is None:
+        train_pdf = pdf
+    elif isinstance(validation, float):
+        n_val = int(len(pdf) * validation)
+        train_pdf, val_pdf = pdf.iloc[:-n_val or None], (
+            pdf.iloc[-n_val:] if n_val else None)
+    elif isinstance(validation, str):
+        mask = pdf[validation].astype(bool)
+        train_pdf = pdf[~mask].drop(columns=[validation])
+        val_pdf = pdf[mask].drop(columns=[validation])
+    else:
+        train_pdf, val_pdf = pdf, _to_pandas(validation)
+
+    meta = make_metadata(train_pdf, feature_cols, label_cols)
+    train_path = store.get_train_data_path(dataset_idx)
+    meta["train_rows"] = write_parquet(train_pdf, train_path, num_partitions)
+    meta["train_data_path"] = train_path
+    if val_pdf is not None and len(val_pdf):
+        val_path = store.get_val_data_path(dataset_idx)
+        meta["val_rows"] = write_parquet(val_pdf, val_path, num_partitions)
+        meta["val_data_path"] = val_path
+    else:
+        meta["val_rows"] = 0
+        meta["val_data_path"] = None
+    return meta
+
+
+def read_shard(path: str, rank: int = 0, size: int = 1,
+               columns: Optional[List[str]] = None):
+    """Read this rank's shard of a Parquet dataset as a pandas DataFrame.
+
+    Sharding unit = row group (round-robin by global row-group index), the
+    same granularity Petastorm uses in the reference's remote readers
+    (``spark/keras/remote.py``): every rank touches disjoint data and all
+    rows are covered.
+    """
+    import pandas as pd
+    import pyarrow.parquet as pq
+
+    files = sorted(
+        os.path.join(path, f) for f in os.listdir(path)
+        if f.endswith(".parquet"))
+    frames = []
+    g = 0  # global row-group index across files
+    for fname in files:
+        pf = pq.ParquetFile(fname)
+        for rg in range(pf.num_row_groups):
+            if g % size == rank:
+                frames.append(pf.read_row_group(rg, columns=columns)
+                              .to_pandas())
+            g += 1
+    if not frames:
+        return pd.DataFrame(columns=columns or [])
+    return pd.concat(frames, ignore_index=True)
+
+
+def to_arrays(pdf, cols: Sequence[str], meta: Dict) -> List[np.ndarray]:
+    """Stack DataFrame columns into dense np arrays using column metadata
+    (list/array cells become trailing dims)."""
+    out = []
+    for col in cols:
+        info = meta["columns"][col]
+        if info["shape"]:
+            arr = np.stack([np.asarray(v) for v in pdf[col].to_numpy()])
+            arr = arr.reshape((len(pdf),) + tuple(info["shape"]))
+        else:
+            arr = pdf[col].to_numpy()
+        out.append(arr.astype(info["dtype"]))
+    return out

@@ -1,0 +1,3 @@
+"""Keras Spark Estimator package (parity: ``horovod/spark/keras/``)."""
+
+from .estimator import KerasEstimator, KerasModel  # noqa: F401

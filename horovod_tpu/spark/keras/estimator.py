@@ -1,0 +1,131 @@
+"""Keras Spark ML Estimator (parity: ``horovod/spark/keras/estimator.py:103``
+KerasEstimator / ``:375`` KerasModel).
+
+``fit`` materializes the DataFrame to the Store as Parquet, runs the remote
+training function on the backend (in-process local SPMD by default,
+``horovod_tpu.spark.run`` when pyspark is present), and returns a
+``KerasModel`` that serves batch inference via ``transform``.
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Optional
+
+from ..common.backend import Backend, LocalBackend
+from ..common.estimator import HorovodEstimator, HorovodModel
+from ..common.store import Store
+from ..common.util import prepare_data, to_arrays
+from .remote import make_remote_trainer
+from .util import deserialize_model, serialize_model, serialize_optimizer
+
+
+class KerasEstimator(HorovodEstimator):
+    """Train a Keras model over Store-backed Parquet data.
+
+    Mirrors the reference's param surface (``keras/estimator.py:103-158``):
+    model, optimizer, loss, metrics, feature_cols, label_cols, batch_size,
+    epochs, validation, callbacks, store, num_proc, ...
+    """
+
+    def __init__(self, model=None, optimizer=None, loss=None, metrics=None,
+                 feature_cols=None, label_cols=None, batch_size: int = 32,
+                 epochs: int = 1, validation=None, callbacks=None,
+                 store: Optional[Store] = None, num_proc: Optional[int] = None,
+                 backend: Optional[Backend] = None, custom_objects=None,
+                 verbose: int = 0, shuffle_buffer_size: int = 0,
+                 train_steps_per_epoch=None, validation_steps_per_epoch=None,
+                 run_id: Optional[str] = None, **kwargs):
+        super().__init__(model=model, loss=loss, metrics=metrics,
+                         feature_cols=feature_cols, label_cols=label_cols,
+                         batch_size=batch_size, epochs=epochs,
+                         validation=validation, callbacks=callbacks,
+                         store=store, num_proc=num_proc,
+                         verbose=verbose,
+                         shuffle_buffer_size=shuffle_buffer_size,
+                         train_steps_per_epoch=train_steps_per_epoch,
+                         validation_steps_per_epoch=validation_steps_per_epoch,
+                         run_id=run_id, **kwargs)
+        self._optimizer = optimizer
+        self._backend = backend
+        self._custom_objects = custom_objects
+
+    def fit(self, df) -> "KerasModel":
+        self._validate()
+        store = self.getOrDefault("store")
+        if store is None:
+            raise ValueError("store is required to fit")
+        run_id = self.getOrDefault("run_id") or f"run_{uuid.uuid4().hex[:8]}"
+        backend = self._backend or LocalBackend(
+            self.getOrDefault("num_proc") or 1)
+
+        meta = prepare_data(
+            store, df,
+            self.getOrDefault("feature_cols"),
+            self.getOrDefault("label_cols"),
+            validation=self.getOrDefault("validation"),
+            num_partitions=backend.num_processes())
+
+        model = self.getOrDefault("model")
+        checkpoint = os.path.join(store.get_checkpoint_path(run_id),
+                                  "model.keras")
+        # Compile driver-side so loss/metrics serialize with the archive.
+        opt = self._optimizer or getattr(model, "optimizer", None)
+        if opt is None:
+            raise ValueError("optimizer is required (pass optimizer= or a "
+                             "compiled model)")
+        model.compile(optimizer=opt, loss=self.getOrDefault("loss"),
+                      metrics=self.getOrDefault("metrics") or None)
+
+        trainer = make_remote_trainer(
+            serialize_model(model), serialize_optimizer(opt),
+            self.getOrDefault("loss"), self.getOrDefault("metrics"),
+            self.getOrDefault("batch_size"), self.getOrDefault("epochs"),
+            meta, checkpoint, custom_objects=self._custom_objects,
+            verbose=self.getOrDefault("verbose"),
+            shuffle_buffer_size=self.getOrDefault("shuffle_buffer_size"),
+            train_steps_per_epoch=self.getOrDefault("train_steps_per_epoch"),
+            validation_steps_per_epoch=self.getOrDefault(
+                "validation_steps_per_epoch"),
+            callbacks=self.getOrDefault("callbacks"))
+
+        results = backend.run(trainer)
+        history = results[0]["history"]
+        trained = deserialize_model(store.read(checkpoint),
+                                    custom_objects=self._custom_objects)
+        return KerasModel(model=trained,
+                          feature_cols=self.getOrDefault("feature_cols"),
+                          label_cols=self.getOrDefault("label_cols"),
+                          run_id=run_id, history=history, _metadata=meta)
+
+
+class KerasModel(HorovodModel):
+    """Trained-model wrapper (parity: ``keras/estimator.py:375``)."""
+
+    def __init__(self, model=None, feature_cols=None, label_cols=None,
+                 run_id=None, history=None, _metadata=None):
+        super().__init__(model, feature_cols, label_cols, run_id)
+        self.history = history
+        self._metadata = _metadata
+
+    def transform(self, df):
+        """Append ``<label>__output`` prediction columns. Accepts a pandas
+        DataFrame (Spark DataFrames convert via ``toPandas`` upstream)."""
+        import numpy as np
+
+        from ..common.util import _to_pandas
+
+        pdf = _to_pandas(df).copy()
+        meta = self._metadata or {
+            "columns": {c: {"shape": [], "dtype": "float32", "size": 1}
+                        for c in self.feature_cols}}
+        xs = to_arrays(pdf, self.feature_cols, meta)
+        preds = self.model.predict(xs[0] if len(xs) == 1 else xs, verbose=0)
+        if not isinstance(preds, (list, tuple)):
+            preds = [preds]
+        for col, p in zip(self.label_cols, preds):
+            p = np.asarray(p)
+            pdf[f"{col}__output"] = (
+                list(p) if p.ndim > 1 and p.shape[-1] > 1 else p.reshape(-1))
+        return pdf

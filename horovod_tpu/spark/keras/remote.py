@@ -1,0 +1,88 @@
+"""Per-worker Keras training function for the Keras Estimator (parity:
+``horovod/spark/keras/remote.py`` ``RemoteTrainer``).
+
+The reference builds a Petastorm reader over the store's Parquet shards and
+trains with hvd callbacks; here the reader is the pyarrow row-group shard
+reader and the collective plumbing is ``horovod_tpu.keras``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+
+def make_remote_trainer(serialized_model: bytes, optimizer_bytes,
+                        loss, metrics, batch_size: int, epochs: int,
+                        meta: Dict, checkpoint_path: str,
+                        custom_objects=None, verbose: int = 0,
+                        shuffle_buffer_size: int = 0,
+                        train_steps_per_epoch=None,
+                        validation_steps_per_epoch=None,
+                        callbacks=None):
+    """Build the function executed on every worker."""
+
+    def trainer():
+        import numpy as np
+
+        import horovod_tpu.keras as hvd
+        from ..common.util import read_shard, to_arrays
+        from .util import deserialize_model
+
+        hvd.init()
+        try:
+            model = deserialize_model(serialized_model,
+                                      custom_objects=custom_objects)
+            opt = model.optimizer
+            if optimizer_bytes is not None:
+                from .util import deserialize_optimizer
+                opt = deserialize_optimizer(optimizer_bytes)
+            plain_opt = opt  # kept for the wrapper-free checkpoint below
+            opt = hvd.DistributedOptimizer(opt)
+            model.compile(optimizer=opt, loss=loss, metrics=metrics or None)
+
+            pdf = read_shard(meta["train_data_path"], hvd.rank(), hvd.size())
+            if shuffle_buffer_size:
+                pdf = pdf.sample(frac=1.0, random_state=hvd.rank())
+            xs = to_arrays(pdf, meta["feature_cols"], meta)
+            ys = to_arrays(pdf, meta["label_cols"], meta)
+            x = xs[0] if len(xs) == 1 else xs
+            y = ys[0] if len(ys) == 1 else ys
+
+            val = None
+            if meta.get("val_data_path"):
+                vdf = read_shard(meta["val_data_path"], hvd.rank(),
+                                 hvd.size())
+                if len(vdf):
+                    vx = to_arrays(vdf, meta["feature_cols"], meta)
+                    vy = to_arrays(vdf, meta["label_cols"], meta)
+                    val = (vx[0] if len(vx) == 1 else vx,
+                           vy[0] if len(vy) == 1 else vy)
+
+            cbs = [hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+                   hvd.callbacks.MetricAverageCallback()]
+            cbs.extend(callbacks or [])
+
+            history = model.fit(
+                x, y, batch_size=batch_size, epochs=epochs,
+                validation_data=val, verbose=verbose, callbacks=cbs,
+                steps_per_epoch=train_steps_per_epoch,
+                validation_steps=validation_steps_per_epoch)
+
+            result = {"history": {k: [float(v) for v in vs]
+                                  for k, vs in history.history.items()}}
+            if hvd.rank() == 0:
+                os.makedirs(os.path.dirname(checkpoint_path), exist_ok=True)
+                # Strip the dynamic Distributed* wrapper before saving so
+                # the archive deserializes anywhere (the reference's
+                # serialization.py plays the same role).
+                model.compile(
+                    optimizer=type(plain_opt).from_config(opt.get_config()),
+                    loss=loss, metrics=metrics or None)
+                model.save(checkpoint_path)
+                result["checkpoint"] = checkpoint_path
+            return result
+        finally:
+            hvd.shutdown()
+
+    return trainer

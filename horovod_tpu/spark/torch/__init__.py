@@ -1,0 +1,3 @@
+"""PyTorch Spark Estimator package (parity: ``horovod/spark/torch/``)."""
+
+from .estimator import TorchEstimator, TorchModel  # noqa: F401

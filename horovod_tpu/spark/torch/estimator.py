@@ -1,0 +1,145 @@
+"""PyTorch Spark ML Estimator (parity: ``horovod/spark/torch/estimator.py:84``
+TorchEstimator / ``:301`` TorchModel)."""
+
+from __future__ import annotations
+
+import io
+import os
+import uuid
+from typing import Optional
+
+from ..common.backend import Backend, LocalBackend
+from ..common.estimator import HorovodEstimator, HorovodModel
+from ..common.store import Store
+from ..common.util import prepare_data, to_arrays
+from .remote import make_remote_trainer
+
+
+class TorchEstimator(HorovodEstimator):
+    """Train a torch ``nn.Module`` over Store-backed Parquet data.
+
+    Param surface mirrors ``torch/estimator.py:146-187``: model, optimizer
+    (class + kwargs or an instance whose defaults are recovered), loss (one
+    fn per label col), input_shapes, feature_cols, label_cols, batch_size,
+    epochs, validation, store, num_proc, train_minibatch_fn.
+    """
+
+    def __init__(self, model=None, optimizer=None, loss=None,
+                 loss_constructors=None, feature_cols=None, label_cols=None,
+                 input_shapes=None, batch_size: int = 32, epochs: int = 1,
+                 validation=None, store: Optional[Store] = None,
+                 num_proc: Optional[int] = None,
+                 backend: Optional[Backend] = None, verbose: int = 0,
+                 shuffle_buffer_size: int = 0, train_minibatch_fn=None,
+                 sample_weight_col=None, run_id: Optional[str] = None,
+                 **kwargs):
+        super().__init__(model=model, loss=loss,
+                         loss_constructors=loss_constructors,
+                         feature_cols=feature_cols, label_cols=label_cols,
+                         batch_size=batch_size, epochs=epochs,
+                         validation=validation, store=store,
+                         num_proc=num_proc, verbose=verbose,
+                         shuffle_buffer_size=shuffle_buffer_size,
+                         sample_weight_col=sample_weight_col,
+                         run_id=run_id, **kwargs)
+        self._optimizer = optimizer
+        self._backend = backend
+        self._input_shapes = input_shapes
+        self._train_minibatch_fn = train_minibatch_fn
+
+    def _optimizer_spec(self):
+        """(class, kwargs) for rebuilding the optimizer against the
+        deserialized model's parameters on each worker (the reference
+        re-instantiates from ``optimizer.state_dict`` the same way)."""
+        import torch
+
+        opt = self._optimizer
+        if isinstance(opt, torch.optim.Optimizer):
+            kwargs = {k: v for k, v in opt.defaults.items()}
+            return type(opt), kwargs
+        if isinstance(opt, tuple) and len(opt) == 2:
+            return opt
+        raise ValueError(
+            "optimizer must be a torch.optim.Optimizer instance or a "
+            "(class, kwargs) tuple")
+
+    def fit(self, df) -> "TorchModel":
+        import torch
+
+        self._validate()
+        store = self.getOrDefault("store")
+        if store is None:
+            raise ValueError("store is required to fit")
+        run_id = self.getOrDefault("run_id") or f"run_{uuid.uuid4().hex[:8]}"
+        backend = self._backend or LocalBackend(
+            self.getOrDefault("num_proc") or 1)
+
+        meta = prepare_data(
+            store, df,
+            self.getOrDefault("feature_cols"),
+            self.getOrDefault("label_cols"),
+            validation=self.getOrDefault("validation"),
+            num_partitions=backend.num_processes())
+
+        loss = self.getOrDefault("loss")
+        loss_fns = loss if isinstance(loss, (list, tuple)) else [loss]
+        if self.getOrDefault("loss_constructors"):
+            loss_fns = [c() for c in self.getOrDefault("loss_constructors")]
+
+        buf = io.BytesIO()
+        torch.save(self.getOrDefault("model"), buf)
+        opt_cls, opt_kwargs = self._optimizer_spec()
+        checkpoint = os.path.join(store.get_checkpoint_path(run_id),
+                                  "model.pt")
+        trainer = make_remote_trainer(
+            buf.getvalue(), opt_cls, opt_kwargs, loss_fns,
+            self.getOrDefault("batch_size"), self.getOrDefault("epochs"),
+            meta, checkpoint, verbose=self.getOrDefault("verbose"),
+            train_minibatch_fn=self._train_minibatch_fn,
+            sample_weight_col=self.getOrDefault("sample_weight_col"))
+
+        results = backend.run(trainer)
+        history = results[0]["history"]
+        trained = torch.load(io.BytesIO(store.read(checkpoint)),
+                             weights_only=False)
+        return TorchModel(model=trained,
+                          feature_cols=self.getOrDefault("feature_cols"),
+                          label_cols=self.getOrDefault("label_cols"),
+                          run_id=run_id, history=history, _metadata=meta,
+                          input_shapes=self._input_shapes)
+
+
+class TorchModel(HorovodModel):
+    """Trained-model wrapper (parity: ``torch/estimator.py:301``)."""
+
+    def __init__(self, model=None, feature_cols=None, label_cols=None,
+                 run_id=None, history=None, _metadata=None,
+                 input_shapes=None):
+        super().__init__(model, feature_cols, label_cols, run_id)
+        self.history = history
+        self._metadata = _metadata
+        self.input_shapes = input_shapes
+
+    def transform(self, df):
+        """Append ``<label>__output`` prediction columns (pandas in/out)."""
+        import numpy as np
+        import torch
+
+        from ..common.util import _to_pandas
+
+        pdf = _to_pandas(df).copy()
+        meta = self._metadata
+        xs = to_arrays(pdf, self.feature_cols, meta)
+        tx = [torch.as_tensor(np.asarray(a, np.float32)) for a in xs]
+        if self.input_shapes:
+            tx = [t.reshape((-1,) + tuple(s))
+                  for t, s in zip(tx, self.input_shapes)]
+        self.model.eval()
+        with torch.no_grad():
+            out = self.model(*tx)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for col, p in zip(self.label_cols, outs):
+            p = p.numpy()
+            pdf[f"{col}__output"] = (
+                list(p) if p.ndim > 1 and p.shape[-1] > 1 else p.reshape(-1))
+        return pdf

@@ -1,0 +1,84 @@
+"""Per-worker PyTorch training function for the Torch Estimator (parity:
+``horovod/spark/torch/remote.py``).
+
+Reads this rank's Parquet shard, wraps the optimizer in
+``horovod_tpu.torch.DistributedOptimizer``, broadcasts initial state from
+rank 0, and checkpoints on rank 0 — the reference's remote loop minus
+Petastorm (pyarrow row-group sharding plays that role).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from typing import Dict
+
+
+def make_remote_trainer(model_bytes: bytes, optimizer_cls, optimizer_kwargs,
+                        loss_fns, batch_size: int, epochs: int, meta: Dict,
+                        checkpoint_path: str, verbose: int = 0,
+                        shuffle: bool = True, train_minibatch_fn=None,
+                        sample_weight_col=None):
+    def trainer():
+        import numpy as np
+        import torch
+
+        import horovod_tpu.torch as hvd
+        from ..common.util import read_shard, to_arrays
+
+        hvd.init()
+        try:
+            model = torch.load(io.BytesIO(model_bytes), weights_only=False)
+            optimizer = optimizer_cls(model.parameters(), **optimizer_kwargs)
+            hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+            hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+            optimizer = hvd.DistributedOptimizer(
+                optimizer, named_parameters=model.named_parameters())
+
+            pdf = read_shard(meta["train_data_path"], hvd.rank(), hvd.size())
+            xs = to_arrays(pdf, meta["feature_cols"], meta)
+            ys = to_arrays(pdf, meta["label_cols"], meta)
+            tx = [torch.as_tensor(np.asarray(a, np.float32)) for a in xs]
+            ty = [torch.as_tensor(np.asarray(a)) for a in ys]
+
+            n = len(pdf)
+            history = []
+            model.train()
+            for epoch in range(epochs):
+                order = (np.random.RandomState(epoch).permutation(n)
+                         if shuffle else np.arange(n))
+                total, steps = 0.0, 0
+                for start in range(0, n, batch_size):
+                    idx = order[start:start + batch_size]
+                    bx = [t[idx] for t in tx]
+                    by = [t[idx] for t in ty]
+                    optimizer.zero_grad()
+                    if train_minibatch_fn is not None:
+                        loss = train_minibatch_fn(model, optimizer, bx, by)
+                    else:
+                        out = model(*bx)
+                        outs = out if isinstance(out, (list, tuple)) else [out]
+                        losses = [fn(o, y) for fn, o, y
+                                  in zip(loss_fns, outs, by)]
+                        loss = sum(losses)
+                        loss.backward()
+                        optimizer.step()
+                    total += float(loss.detach())
+                    steps += 1
+                avg = hvd.allreduce(
+                    torch.tensor(total / max(1, steps)),
+                    name=f"epoch_loss.{epoch}", op=hvd.Average)
+                history.append(float(avg))
+                if verbose and hvd.rank() == 0:
+                    print(f"epoch {epoch}: loss={float(avg):.5f}")
+
+            result = {"history": {"loss": history}}
+            if hvd.rank() == 0:
+                os.makedirs(os.path.dirname(checkpoint_path), exist_ok=True)
+                torch.save(model, checkpoint_path)
+                result["checkpoint"] = checkpoint_path
+            return result
+        finally:
+            hvd.shutdown()
+
+    return trainer

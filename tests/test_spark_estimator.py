@@ -1,0 +1,107 @@
+"""Spark Estimator tests (reference: ``test/test_spark.py`` estimator
+sections, run there under a local SparkContext; here the LocalBackend plays
+that role — SURVEY §4 Pattern 2)."""
+
+import numpy as np
+import pytest
+
+pd = pytest.importorskip("pandas")
+
+
+def _make_df(n=64, d=4, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, d).astype(np.float32)
+    y = (x.sum(axis=1) * 0.5).astype(np.float32)
+    return pd.DataFrame({"features": list(x), "label": y})
+
+
+def test_prepare_data_and_shard_roundtrip(tmp_path):
+    from horovod_tpu.spark import LocalStore
+    from horovod_tpu.spark.common.util import (
+        prepare_data, read_shard, to_arrays)
+
+    store = LocalStore(str(tmp_path))
+    df = _make_df(50)
+    meta = prepare_data(store, df, ["features"], ["label"],
+                        validation=0.2, num_partitions=4)
+    assert meta["train_rows"] == 40
+    assert meta["val_rows"] == 10
+    assert meta["columns"]["features"]["shape"] == [4]
+
+    # Two ranks cover all rows disjointly.
+    s0 = read_shard(meta["train_data_path"], 0, 2)
+    s1 = read_shard(meta["train_data_path"], 1, 2)
+    assert len(s0) + len(s1) == 40
+    labels = np.sort(np.concatenate([s0["label"], s1["label"]]))
+    xs = to_arrays(s0, ["features"], meta)
+    assert xs[0].shape == (len(s0), 4)
+    assert np.allclose(labels,
+                       np.sort(df["label"].to_numpy()[:40]))
+
+
+def test_validation_column_split(tmp_path):
+    from horovod_tpu.spark import LocalStore
+    from horovod_tpu.spark.common.util import prepare_data
+
+    store = LocalStore(str(tmp_path))
+    df = _make_df(20)
+    df["is_val"] = ([0] * 15) + ([1] * 5)
+    meta = prepare_data(store, df, ["features"], ["label"],
+                        validation="is_val")
+    assert meta["train_rows"] == 15 and meta["val_rows"] == 5
+
+
+def test_estimator_param_validation(tmp_path):
+    from horovod_tpu.spark.common.estimator import HorovodEstimator
+
+    est = HorovodEstimator(model=object(), feature_cols=["x"],
+                           label_cols=["y"])
+    est._validate()
+    with pytest.raises(ValueError, match="unknown estimator param"):
+        HorovodEstimator(bogus=1)
+    with pytest.raises(ValueError, match="feature_cols"):
+        HorovodEstimator(model=object(), label_cols=["y"])._validate()
+
+
+def test_keras_estimator_end_to_end(tmp_path):
+    keras = pytest.importorskip("keras")
+    from horovod_tpu.spark import KerasEstimator, LocalStore
+
+    model = keras.Sequential([
+        keras.layers.Input(shape=(4,)),
+        keras.layers.Dense(8, activation="relu"),
+        keras.layers.Dense(1),
+    ])
+    est = KerasEstimator(
+        model=model, optimizer=keras.optimizers.SGD(learning_rate=0.1),
+        loss="mse", feature_cols=["features"], label_cols=["label"],
+        batch_size=16, epochs=8, validation=0.2,
+        store=LocalStore(str(tmp_path)))
+    trained = est.fit(_make_df(128))
+    assert "loss" in trained.history
+    assert trained.history["loss"][-1] < trained.history["loss"][0]
+
+    out = trained.transform(_make_df(16, seed=1))
+    assert "label__output" in out.columns
+    assert len(out) == 16
+
+
+def test_torch_estimator_end_to_end(tmp_path):
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.spark import LocalStore, TorchEstimator
+
+    model = torch.nn.Sequential(
+        torch.nn.Linear(4, 8), torch.nn.ReLU(), torch.nn.Linear(8, 1),
+        torch.nn.Flatten(0))
+    est = TorchEstimator(
+        model=model,
+        optimizer=torch.optim.SGD(model.parameters(), lr=0.1),
+        loss=torch.nn.functional.mse_loss,
+        feature_cols=["features"], label_cols=["label"],
+        batch_size=16, epochs=8, store=LocalStore(str(tmp_path)))
+    trained = est.fit(_make_df(128))
+    assert trained.history["loss"][-1] < trained.history["loss"][0]
+
+    out = trained.transform(_make_df(16, seed=1))
+    assert "label__output" in out.columns
+    assert np.asarray(out["label__output"]).shape == (16,)

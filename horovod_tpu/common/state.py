@@ -174,6 +174,14 @@ def init(comm=None, devices=None):
                 "HOROVOD_AUTOTUNE requested but the native runtime is "
                 "unavailable (direct mode has no tunable cycle/fusion "
                 "machinery); autotuning disabled")
+        elif _state.config.autotune and _state.rank != 0:
+            # Fusion planning happens only in the coordinator's controller;
+            # a non-coordinator tuner would fit its GP against a knob with
+            # no effect and drift its cycle time away from the others'.
+            # The reference syncs coordinator-chosen params to all ranks
+            # (Controller::SynchronizeParameters, controller.cc:33-47);
+            # here non-coordinator ranks simply keep their initial params.
+            _log.debug("autotune: inactive on non-coordinator rank")
         elif _state.config.autotune:
             from .parameter_manager import ParameterManager
 

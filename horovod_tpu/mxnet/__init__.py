@@ -91,9 +91,9 @@ def _nd_collective(kind, tensor, **kw):
 
     arr = tensor.asnumpy()
     if kind == "allreduce":
+        # _np_allreduce already applies the 1/size scaling for Average
+        # (ring AVERAGE op natively; identity at size 1).
         out = _np_allreduce(arr, kw["name"], kw["op"], 1.0, 1.0)
-        if kw["op"] == Average:
-            out = (out / size()).astype(arr.dtype)
     elif kind == "allgather":
         out = _np_allgather(arr, kw["name"])
     else:

@@ -52,6 +52,9 @@ class ElasticDriver:
         self._shutdown = threading.Event()
         self._host_change = threading.Event()
         self._workers_active: Dict[Tuple[str, int], threading.Event] = {}
+        self._removed: set = set()
+        self._requested_np = min_np
+        self._round_failures = 0
         self._notify_client_factory = None  # injectable for tests
         self._result: Optional[int] = None
         self._done = threading.Event()
@@ -64,14 +67,17 @@ class ElasticDriver:
         """Begin: wait for min_np slots, assign, spawn workers (parity:
         ``driver.py:84``)."""
         self._create_worker_fn = create_worker_fn
+        self._requested_np = max(np, self._min_np)
         self._host_manager.update_available_hosts()
         self._discovery_thread.start()
         self.wait_for_available_slots(self._min_np)
-        self._activate_workers(max(np, self._min_np))
+        self._activate_workers(self._requested_np)
 
     def stop(self) -> None:
         self._shutdown.set()
-        for ev in self._workers_active.values():
+        with self._lock:
+            events = list(self._workers_active.values())
+        for ev in events:
             ev.set()
         if self._discovery_thread.is_alive():
             self._discovery_thread.join(timeout=5.0)
@@ -114,16 +120,28 @@ class ElasticDriver:
         with self._lock:
             keys = list(self._assignments.keys())
         factory = self._notify_client_factory
-        if factory is None:
-            return
-        for hostname, local_rank in keys:
-            try:
-                client = factory(hostname, local_rank)
-                if client is not None:
-                    client.notify_hosts_updated(ts)
-            except Exception as e:
-                _log.debug(
-                    f"could not notify {hostname}:{local_rank}: {e}")
+        if factory is not None:
+            for hostname, local_rank in keys:
+                try:
+                    client = factory(hostname, local_rank)
+                    if client is not None:
+                        client.notify_hosts_updated(ts)
+                except Exception as e:
+                    _log.debug(
+                        f"could not notify {hostname}:{local_rank}: {e}")
+        # Regrow/shrink the plan so the rendezvous the interrupted workers
+        # re-fetch reflects the new host set, and spawn workers on any new
+        # slots (parity: driver.py:185-213 + _activate_workers on update).
+        # Never shrink below min_np on a discovery blip: keep the current
+        # plan and let the failure path (which gates on min_np) handle any
+        # actual worker deaths.
+        if self._create_worker_fn is not None and not self._shutdown.is_set():
+            if self._host_manager.available_slots() >= self._min_np:
+                self._activate_workers(self._target_np())
+            else:
+                _log.warning(
+                    "elastic: host update leaves fewer than min_np="
+                    f"{self._min_np} slots; keeping current plan")
 
     def set_notify_client_factory(self, factory) -> None:
         self._notify_client_factory = factory
@@ -137,12 +155,14 @@ class ElasticDriver:
         return get_host_assignments(hosts, np_actual)
 
     def _activate_workers(self, np: int) -> None:
-        """(Re)assign ranks and spawn workers for newly-assigned slots
-        (parity: ``driver.py:157,259-277``)."""
+        """(Re)assign ranks, spawn workers for newly-assigned slots, and
+        terminate workers whose slot left the plan (blacklisted/removed
+        hosts) (parity: ``driver.py:157,259-277``)."""
         with self._lock:
             plan = self._compute_assignments(np)
             self._world_size = plan[0].size if plan else 0
             self._rendezvous_round += 1
+            self._round_failures = 0
             self._rendezvous.init(plan)
             new_slots = []
             assignments = {}
@@ -151,12 +171,20 @@ class ElasticDriver:
                 assignments[key] = slot
                 if key not in self._workers_active:
                     new_slots.append(slot)
+            removed = [k for k in self._workers_active
+                       if k not in assignments]
             self._assignments = assignments
+            for key in removed:
+                self._removed.add(key)
+                self._workers_active[key].set()
             for slot in new_slots:
                 self._spawn(slot)
 
     def _spawn(self, slot: SlotInfo) -> None:
         shutdown_event = threading.Event()
+        # A slot being respawned is no longer "removed": its new worker's
+        # real exit must be accounted normally.
+        self._removed.discard((slot.hostname, slot.local_rank))
         self._workers_active[(slot.hostname, slot.local_rank)] = \
             shutdown_event
 
@@ -164,7 +192,11 @@ class ElasticDriver:
             code = self._create_worker_fn(slot, [shutdown_event,
                                                  self._shutdown])
             host, lslot = slot.hostname, slot.local_rank
-            if code == 0:
+            if (host, lslot) in self._removed:
+                # Deliberately terminated when its slot left the plan —
+                # neither a success nor a host-blacklisting failure.
+                self.on_worker_removed(host, lslot)
+            elif code == 0:
                 self._worker_registry.record_success(host, lslot)
             else:
                 self._worker_registry.record_failure(host, lslot)
@@ -175,25 +207,48 @@ class ElasticDriver:
 
     # -- worker exit handling (called by WorkerStateRegistry) ---------------
 
+    def on_worker_removed(self, host: str, slot: int) -> None:
+        """A worker terminated because its slot left the plan; drop it from
+        the active set with no success/failure accounting. If discovery
+        flapped and the slot is back in the current plan, respawn it so no
+        rank is left unstaffed."""
+        with self._lock:
+            self._workers_active.pop((host, slot), None)
+            self._removed.discard((host, slot))
+            reborn = self._assignments.get((host, slot))
+            if reborn is not None and not self._shutdown.is_set():
+                self._spawn(reborn)
+                return
+            still_active = len(self._workers_active)
+        if still_active == 0 and not self._shutdown.is_set():
+            self._finish()
+
+    def _finish(self) -> None:
+        # Job over: success iff workers succeeded and none failed in the
+        # current rendezvous round — failures recovered from in earlier
+        # rounds don't doom an elastic job (parity: driver.py:279-295).
+        successes = self._worker_registry.count(SUCCESS)
+        self._result = (0 if self._round_failures == 0 and successes > 0
+                        else 1)
+        self._done.set()
+        self._shutdown.set()
+
     def on_worker_exit(self, host: str, slot: int, state: str) -> None:
         with self._lock:
             self._workers_active.pop((host, slot), None)
             still_active = len(self._workers_active)
-            successes = self._worker_registry.count(SUCCESS)
-            failures = self._worker_registry.count(FAILURE)
+            if state == FAILURE:
+                self._round_failures += 1
         if self._shutdown.is_set():
             return
         if still_active == 0:
-            # Job over: success iff no worker failed (parity:
-            # driver.py:279-295).
-            self._result = 0 if failures == 0 and successes > 0 else 1
-            self._done.set()
-            self._shutdown.set()
+            self._finish()
             return
         if state == FAILURE:
-            # Try to resume with the remaining hosts once enough slots
-            # exist; workers meanwhile hit HorovodInternalError and wait in
-            # their retry loop for the new rendezvous.
+            # Try to resume on the remaining hosts with as many slots as
+            # are available (up to the requested/max np); workers meanwhile
+            # hit HorovodInternalError and wait in their retry loop for the
+            # new rendezvous.
             try:
                 self.wait_for_available_slots(self._min_np)
             except TimeoutError:
@@ -201,7 +256,12 @@ class ElasticDriver:
                 self._done.set()
                 self._shutdown.set()
                 return
-            self._activate_workers(self._min_np)
+            self._activate_workers(self._target_np())
+
+    def _target_np(self) -> int:
+        """World size to aim for on membership change: grow to max_np when
+        elastic bounds were given, else stay at the requested np."""
+        return self._max_np or self._requested_np
 
     # -- introspection (used by tests, parity: driver accessors) -------------
 

@@ -36,8 +36,11 @@ class WorkerStateRegistry:
         self._record(host, slot, SUCCESS)
 
     def record_failure(self, host: str, slot: int) -> None:
-        self._record(host, slot, FAILURE)
+        # Blacklist before recording: _record triggers the driver's
+        # recovery re-activation, which must already see the shrunken
+        # host set or the failed host lands back in the new plan.
         self._host_manager.blacklist(host)
+        self._record(host, slot, FAILURE)
 
     def _record(self, host: str, slot: int, state: str) -> None:
         with self._lock:

@@ -152,9 +152,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     args = parser.parse_args(argv)
     # Track which flags the user set explicitly so the config file never
     # overrides the command line (parity: runner.py override_args).
+    # (identity comparison: 0/0.0 are explicit values, not "unset", and
+    # 0 == False would swallow them under `in (None, False)`)
     args._override_args = {
         a.dest for a in parser._actions
-        if getattr(args, a.dest, None) not in (None, False)
+        if not (getattr(args, a.dest, None) is None
+                or getattr(args, a.dest, None) is False)
         and a.dest not in ("command", "help")
     }
     return args
@@ -234,7 +237,10 @@ def _run(args) -> int:
         command = command[1:]
     if not command:
         raise ValueError("no training command given")
-    if getattr(args, "host_discovery_script", None):
+    if getattr(args, "host_discovery_script", None) or \
+            getattr(args, "min_np", None):
+        # Elastic: discovery script, or fixed hosts with --min-np (the
+        # reference's FixedHosts flavor, run/elastic/discovery.py).
         return _run_elastic(args, command)
     if args.np is None and not (args.hosts or args.hostfile):
         raise ValueError("-np (or -H/--hostfile) is required")

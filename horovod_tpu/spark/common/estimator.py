@@ -64,16 +64,55 @@ class HorovodEstimator(EstimatorParams):
         if not self.getOrDefault("label_cols"):
             raise ValueError("label_cols is required")
 
-    def fit(self, df):
-        """Train on a Spark DataFrame; returns a HorovodModel."""
-        self._validate()
-        from .. import _require_pyspark
+    # -- template method: shared fit orchestration ---------------------------
+    # Subclasses implement the three hooks below; the flow (validate →
+    # materialize Parquet → run the remote trainer on the backend → load
+    # the rank-0 checkpoint) is identical across frameworks (parity:
+    # the reference's HorovodEstimator._fit, ``common/estimator.py``).
 
-        _require_pyspark()
-        raise NotImplementedError(
-            "Estimator.fit requires a Spark session with Petastorm-style "
-            "data materialization; train through horovod_tpu.spark.run or "
-            "the launcher instead")
+    _checkpoint_filename = "model.bin"
+
+    def _make_trainer(self, meta, checkpoint_path):
+        """Return the zero-arg function executed on every worker."""
+        raise NotImplementedError
+
+    def _load_model(self, store, checkpoint_path):
+        """Deserialize the trained model from the store checkpoint."""
+        raise NotImplementedError
+
+    def _make_model(self, trained, history, run_id, meta):
+        """Wrap the trained model in the framework's HorovodModel."""
+        raise NotImplementedError
+
+    def fit(self, df):
+        """Train on a (pandas or Spark) DataFrame; returns a HorovodModel."""
+        import os
+        import uuid
+
+        from .backend import LocalBackend
+        from .util import prepare_data
+
+        self._validate()
+        store = self.getOrDefault("store")
+        if store is None:
+            raise ValueError("store is required to fit")
+        run_id = self.getOrDefault("run_id") or f"run_{uuid.uuid4().hex[:8]}"
+        backend = getattr(self, "_backend", None) or LocalBackend(
+            self.getOrDefault("num_proc") or 1)
+
+        meta = prepare_data(
+            store, df,
+            self.getOrDefault("feature_cols"),
+            self.getOrDefault("label_cols"),
+            validation=self.getOrDefault("validation"),
+            num_partitions=backend.num_processes())
+
+        checkpoint = os.path.join(store.get_checkpoint_path(run_id),
+                                  self._checkpoint_filename)
+        results = backend.run(self._make_trainer(meta, checkpoint))
+        history = results[0]["history"]
+        trained = self._load_model(store, checkpoint)
+        return self._make_model(trained, history, run_id, meta)
 
 
 class HorovodModel:

@@ -80,7 +80,12 @@ def write_parquet(pdf, path: str, num_partitions: int = 1) -> int:
             break
         table = pa.Table.from_pandas(chunk.reset_index(drop=True),
                                      preserve_index=False)
-        pq.write_table(table, os.path.join(path, f"part-{i:05d}.parquet"))
+        # Small row groups give the round-robin shard reader granularity:
+        # a world larger than the partition count still gets data on every
+        # rank as long as there are >= size row groups in total.
+        row_group_size = max(1, min(1024, math.ceil(len(chunk) / 8) or 1))
+        pq.write_table(table, os.path.join(path, f"part-{i:05d}.parquet"),
+                       row_group_size=row_group_size)
         written += len(chunk)
     return written
 
@@ -142,16 +147,21 @@ def read_shard(path: str, rank: int = 0, size: int = 1,
         os.path.join(path, f) for f in os.listdir(path)
         if f.endswith(".parquet"))
     frames = []
+    schema_cols = None
     g = 0  # global row-group index across files
     for fname in files:
         pf = pq.ParquetFile(fname)
+        if schema_cols is None:
+            schema_cols = columns or pf.schema_arrow.names
         for rg in range(pf.num_row_groups):
             if g % size == rank:
                 frames.append(pf.read_row_group(rg, columns=columns)
                               .to_pandas())
             g += 1
     if not frames:
-        return pd.DataFrame(columns=columns or [])
+        # Keep the dataset schema so downstream column selection works on
+        # empty shards.
+        return pd.DataFrame(columns=schema_cols or columns or [])
     return pd.concat(frames, ignore_index=True)
 
 
@@ -161,9 +171,12 @@ def to_arrays(pdf, cols: Sequence[str], meta: Dict) -> List[np.ndarray]:
     out = []
     for col in cols:
         info = meta["columns"][col]
-        if info["shape"]:
+        shape = tuple(info["shape"])
+        if len(pdf) == 0:
+            arr = np.zeros((0,) + shape, dtype=info["dtype"])
+        elif shape:
             arr = np.stack([np.asarray(v) for v in pdf[col].to_numpy()])
-            arr = arr.reshape((len(pdf),) + tuple(info["shape"]))
+            arr = arr.reshape((len(pdf),) + shape)
         else:
             arr = pdf[col].to_numpy()
         out.append(arr.astype(info["dtype"]))

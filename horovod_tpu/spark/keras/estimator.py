@@ -9,14 +9,12 @@ training function on the backend (in-process local SPMD by default,
 
 from __future__ import annotations
 
-import os
-import uuid
 from typing import Optional
 
-from ..common.backend import Backend, LocalBackend
+from ..common.backend import Backend
 from ..common.estimator import HorovodEstimator, HorovodModel
 from ..common.store import Store
-from ..common.util import prepare_data, to_arrays
+from ..common.util import to_arrays
 from .remote import make_remote_trainer
 from .util import deserialize_model, serialize_model, serialize_optimizer
 
@@ -51,25 +49,10 @@ class KerasEstimator(HorovodEstimator):
         self._backend = backend
         self._custom_objects = custom_objects
 
-    def fit(self, df) -> "KerasModel":
-        self._validate()
-        store = self.getOrDefault("store")
-        if store is None:
-            raise ValueError("store is required to fit")
-        run_id = self.getOrDefault("run_id") or f"run_{uuid.uuid4().hex[:8]}"
-        backend = self._backend or LocalBackend(
-            self.getOrDefault("num_proc") or 1)
+    _checkpoint_filename = "model.keras"
 
-        meta = prepare_data(
-            store, df,
-            self.getOrDefault("feature_cols"),
-            self.getOrDefault("label_cols"),
-            validation=self.getOrDefault("validation"),
-            num_partitions=backend.num_processes())
-
+    def _make_trainer(self, meta, checkpoint_path):
         model = self.getOrDefault("model")
-        checkpoint = os.path.join(store.get_checkpoint_path(run_id),
-                                  "model.keras")
         # Compile driver-side so loss/metrics serialize with the archive.
         opt = self._optimizer or getattr(model, "optimizer", None)
         if opt is None:
@@ -77,12 +60,11 @@ class KerasEstimator(HorovodEstimator):
                              "compiled model)")
         model.compile(optimizer=opt, loss=self.getOrDefault("loss"),
                       metrics=self.getOrDefault("metrics") or None)
-
-        trainer = make_remote_trainer(
+        return make_remote_trainer(
             serialize_model(model), serialize_optimizer(opt),
             self.getOrDefault("loss"), self.getOrDefault("metrics"),
             self.getOrDefault("batch_size"), self.getOrDefault("epochs"),
-            meta, checkpoint, custom_objects=self._custom_objects,
+            meta, checkpoint_path, custom_objects=self._custom_objects,
             verbose=self.getOrDefault("verbose"),
             shuffle_buffer_size=self.getOrDefault("shuffle_buffer_size"),
             train_steps_per_epoch=self.getOrDefault("train_steps_per_epoch"),
@@ -90,10 +72,11 @@ class KerasEstimator(HorovodEstimator):
                 "validation_steps_per_epoch"),
             callbacks=self.getOrDefault("callbacks"))
 
-        results = backend.run(trainer)
-        history = results[0]["history"]
-        trained = deserialize_model(store.read(checkpoint),
-                                    custom_objects=self._custom_objects)
+    def _load_model(self, store, checkpoint_path):
+        return deserialize_model(store.read(checkpoint_path),
+                                 custom_objects=self._custom_objects)
+
+    def _make_model(self, trained, history, run_id, meta) -> "KerasModel":
         return KerasModel(model=trained,
                           feature_cols=self.getOrDefault("feature_cols"),
                           label_cols=self.getOrDefault("label_cols"),

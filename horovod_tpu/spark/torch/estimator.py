@@ -4,14 +4,12 @@ TorchEstimator / ``:301`` TorchModel)."""
 from __future__ import annotations
 
 import io
-import os
-import uuid
 from typing import Optional
 
-from ..common.backend import Backend, LocalBackend
+from ..common.backend import Backend
 from ..common.estimator import HorovodEstimator, HorovodModel
 from ..common.store import Store
-from ..common.util import prepare_data, to_arrays
+from ..common.util import to_arrays
 from .remote import make_remote_trainer
 
 
@@ -63,23 +61,10 @@ class TorchEstimator(HorovodEstimator):
             "optimizer must be a torch.optim.Optimizer instance or a "
             "(class, kwargs) tuple")
 
-    def fit(self, df) -> "TorchModel":
+    _checkpoint_filename = "model.pt"
+
+    def _make_trainer(self, meta, checkpoint_path):
         import torch
-
-        self._validate()
-        store = self.getOrDefault("store")
-        if store is None:
-            raise ValueError("store is required to fit")
-        run_id = self.getOrDefault("run_id") or f"run_{uuid.uuid4().hex[:8]}"
-        backend = self._backend or LocalBackend(
-            self.getOrDefault("num_proc") or 1)
-
-        meta = prepare_data(
-            store, df,
-            self.getOrDefault("feature_cols"),
-            self.getOrDefault("label_cols"),
-            validation=self.getOrDefault("validation"),
-            num_partitions=backend.num_processes())
 
         loss = self.getOrDefault("loss")
         loss_fns = loss if isinstance(loss, (list, tuple)) else [loss]
@@ -89,19 +74,20 @@ class TorchEstimator(HorovodEstimator):
         buf = io.BytesIO()
         torch.save(self.getOrDefault("model"), buf)
         opt_cls, opt_kwargs = self._optimizer_spec()
-        checkpoint = os.path.join(store.get_checkpoint_path(run_id),
-                                  "model.pt")
-        trainer = make_remote_trainer(
+        return make_remote_trainer(
             buf.getvalue(), opt_cls, opt_kwargs, loss_fns,
             self.getOrDefault("batch_size"), self.getOrDefault("epochs"),
-            meta, checkpoint, verbose=self.getOrDefault("verbose"),
+            meta, checkpoint_path, verbose=self.getOrDefault("verbose"),
             train_minibatch_fn=self._train_minibatch_fn,
             sample_weight_col=self.getOrDefault("sample_weight_col"))
 
-        results = backend.run(trainer)
-        history = results[0]["history"]
-        trained = torch.load(io.BytesIO(store.read(checkpoint)),
-                             weights_only=False)
+    def _load_model(self, store, checkpoint_path):
+        import torch
+
+        return torch.load(io.BytesIO(store.read(checkpoint_path)),
+                          weights_only=False)
+
+    def _make_model(self, trained, history, run_id, meta) -> "TorchModel":
         return TorchModel(model=trained,
                           feature_cols=self.getOrDefault("feature_cols"),
                           label_cols=self.getOrDefault("label_cols"),

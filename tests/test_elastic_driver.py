@@ -114,19 +114,50 @@ def test_driver_stable_ranks_on_scale_up():
     driver.start(2, _blocking_worker(release))
     assert driver.world_size == 2
 
-    # Scale up: new host appears; driver re-activates with 4 ranks and host
-    # 'a' keeps ranks 0-1 (age order).
+    # Scale up: new host appears; the discovery loop itself re-activates
+    # with 4 ranks (up to max_np) and host 'a' keeps ranks 0-1 (age order).
     disc.set({"b": 2, "a": 2})
     deadline = time.time() + 5.0
-    while driver.host_manager.available_slots() < 4 and \
-            time.time() < deadline:
+    while time.time() < deadline:
+        plan = driver.get_assignments()
+        if len(plan) == 4:
+            break
         time.sleep(0.05)
-    driver._activate_workers(4)
     plan = driver.get_assignments()
     assert [(s.hostname, s.rank) for s in plan] == \
         [("a", 0), ("a", 1), ("b", 2), ("b", 3)]
     assert plan[0].size == 4
     release.set()
+    driver.stop()
+
+
+def test_driver_terminates_workers_on_removed_host():
+    driver = _driver({"a": 1, "b": 1}, min_np=1, max_np=2)
+    driver_disc = driver.host_manager._discovery
+    release = threading.Event()
+    exits = []
+
+    def worker(slot, events):
+        while not release.is_set():
+            if any(e.is_set() for e in events):
+                exits.append((slot.hostname, slot.local_rank))
+                return 0
+            time.sleep(0.01)
+        return 0
+
+    driver.start(2, worker)
+    assert driver.world_size == 2
+    # Host b disappears: its worker must be told to shut down, and the job
+    # continues on host a alone without counting b as a success or failure.
+    driver_disc.set({"a": 1})
+    deadline = time.time() + 5.0
+    while ("b", 0) not in exits and time.time() < deadline:
+        time.sleep(0.05)
+    assert ("b", 0) in exits
+    plan = driver.get_assignments()
+    assert [(s.hostname, s.rank) for s in plan] == [("a", 0)]
+    release.set()
+    assert driver.get_results() == 0
     driver.stop()
 
 
@@ -165,7 +196,10 @@ def test_driver_failure_blacklists_and_recovers():
     assert [(s.hostname, s.rank) for s in driver.get_assignments()] == \
         [("a", 0)]
     release.set()
-    assert driver.get_results() == 1  # a failure occurred along the way
+    # A failure recovered from in an earlier rendezvous round does not doom
+    # the job: the final round completed cleanly (reference parity —
+    # gloo_run_elastic judges the last round's workers).
+    assert driver.get_results() == 0
     driver.stop()
 
 

@@ -39,6 +39,22 @@ def test_prepare_data_and_shard_roundtrip(tmp_path):
                        np.sort(df["label"].to_numpy()[:40]))
 
 
+def test_empty_shard_keeps_schema(tmp_path):
+    from horovod_tpu.spark import LocalStore
+    from horovod_tpu.spark.common.util import (
+        prepare_data, read_shard, to_arrays)
+
+    store = LocalStore(str(tmp_path))
+    meta = prepare_data(store, _make_df(3), ["features"], ["label"])
+    # A world far larger than the row-group count: high ranks get empty
+    # shards that must still carry the dataset schema.
+    empty = read_shard(meta["train_data_path"], rank=97, size=99)
+    assert "features" in empty.columns and len(empty) == 0
+    xs = to_arrays(empty, ["features"], meta)
+    ys = to_arrays(empty, ["label"], meta)
+    assert xs[0].shape == (0, 4) and ys[0].shape == (0,)
+
+
 def test_validation_column_split(tmp_path):
     from horovod_tpu.spark import LocalStore
     from horovod_tpu.spark.common.util import prepare_data

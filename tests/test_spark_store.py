@@ -49,9 +49,11 @@ def test_estimator_params_validation():
     # Missing model fails validation.
     with pytest.raises(ValueError):
         HorovodEstimator(feature_cols=["x"], label_cols=["y"])._validate()
-    # Valid estimator gates on pyspark at fit time.
-    with pytest.raises((ImportError, NotImplementedError)):
+    # The base class requires a store, then defers to framework hooks.
+    with pytest.raises(ValueError, match="store is required"):
         est.fit(None)
+    with pytest.raises(NotImplementedError):
+        est._make_trainer({}, "x")
 
 
 def test_model_wrapper():

@@ -1,0 +1,60 @@
+"""Elastic training demo, PyTorch binding (mirrors the reference's
+``examples/elastic/pytorch_synthetic_benchmark_elastic.py``): training
+state lives in an ``hvd.elastic.TorchState``; the ``@hvd.elastic.run``
+wrapper replays from the last commit on worker failure or membership
+change.
+
+    python -m horovod_tpu.run -np 2 --min-np 1 \
+        --host-discovery-script ./discover.sh \
+        python examples/elastic/pytorch_synthetic_elastic.py
+"""
+
+import argparse
+
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-steps", type=int, default=200)
+    parser.add_argument("--commit-every", type=int, default=10)
+    args = parser.parse_args()
+
+    hvd.init()
+    torch.manual_seed(42)
+
+    model = nn.Sequential(nn.Linear(64, 128), nn.ReLU(), nn.Linear(128, 10))
+    optimizer = torch.optim.SGD(model.parameters(), lr=0.01 * hvd.size())
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+
+    @hvd.elastic.run
+    def training(state):
+        while state.batch < args.num_steps:
+            data = torch.randn(args.batch_size, 64)
+            target = torch.randint(0, 10, (args.batch_size,))
+            state.optimizer.zero_grad()
+            loss = F.cross_entropy(state.model(data), target)
+            loss.backward()
+            state.optimizer.step()
+            state.batch += 1
+            if state.batch % args.commit_every == 0:
+                state.commit()
+            if state.batch % 50 == 0 and hvd.rank() == 0:
+                print(f"step {state.batch}: loss={loss.item():.4f} "
+                      f"world={hvd.size()}")
+
+    state = hvd.elastic.TorchState(model=model, optimizer=optimizer, batch=0)
+    training(state)
+    if hvd.rank() == 0:
+        print("elastic training finished")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

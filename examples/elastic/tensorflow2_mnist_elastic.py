@@ -1,0 +1,69 @@
+"""Elastic training demo, TF2 binding (mirrors the reference's
+``examples/elastic/tensorflow2_mnist_elastic.py``): TensorFlowKerasState +
+@hvd.elastic.run retry loop.
+
+    python -m horovod_tpu.run -np 2 --min-np 1 \
+        --host-discovery-script ./discover.sh \
+        python examples/elastic/tensorflow2_mnist_elastic.py
+"""
+
+import argparse
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--num-steps", type=int, default=200)
+    args = parser.parse_args()
+
+    hvd.init()
+
+    rng = np.random.RandomState(hvd.rank())
+    images = rng.rand(2048, 28, 28, 1).astype(np.float32)
+    labels = rng.randint(0, 10, 2048).astype(np.int64)
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Flatten(input_shape=(28, 28, 1)),
+        tf.keras.layers.Dense(128, activation="relu"),
+        tf.keras.layers.Dense(10),
+    ])
+    loss_fn = tf.losses.SparseCategoricalCrossentropy(from_logits=True)
+    opt = tf.optimizers.SGD(0.01 * hvd.size())
+    model(images[:1])  # build variables
+
+    def training_step(bx, by):
+        with tf.GradientTape() as tape:
+            loss = loss_fn(by, model(bx, training=True))
+        tape = hvd.DistributedGradientTape(tape)
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        return loss
+
+    @hvd.elastic.run
+    def training(state):
+        while state.batch < args.num_steps:
+            i = (state.batch * args.batch_size) % (len(images) -
+                                                  args.batch_size)
+            loss = training_step(images[i:i + args.batch_size],
+                                 labels[i:i + args.batch_size])
+            state.batch += 1
+            if state.batch % 10 == 0:
+                state.commit()
+            if state.batch % 50 == 0 and hvd.rank() == 0:
+                print(f"step {state.batch}: loss={float(loss):.4f} "
+                      f"world={hvd.size()}")
+
+    state = hvd.elastic.TensorFlowKerasState(model, opt, batch=0)
+    training(state)
+    if hvd.rank() == 0:
+        print("elastic training finished")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""MNIST training, PyTorch binding (mirrors the reference's
+``examples/pytorch_mnist.py``: DistributedSampler-style sharding, parameter
+broadcast, DistributedOptimizer, metric allreduce).
+
+Uses generated MNIST-shaped data by default (this environment has no
+dataset downloads); pass ``--data-dir`` with an ``mnist.npz`` to train on
+the real digits.
+
+    python -m horovod_tpu.run -np 2 python examples/pytorch_mnist.py --epochs 1
+"""
+
+import argparse
+import os
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class Net(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(1, 10, 5)
+        self.conv2 = nn.Conv2d(10, 20, 5)
+        self.fc1 = nn.Linear(320, 50)
+        self.fc2 = nn.Linear(50, 10)
+
+    def forward(self, x):
+        x = F.relu(F.max_pool2d(self.conv1(x), 2))
+        x = F.relu(F.max_pool2d(self.conv2(x), 2))
+        x = x.flatten(1)
+        x = F.relu(self.fc1(x))
+        return F.log_softmax(self.fc2(x), dim=1)
+
+
+def load_data(data_dir, n_train=8192, n_test=1024):
+    if data_dir:
+        with np.load(os.path.join(data_dir, "mnist.npz")) as d:
+            return ((d["x_train"] / 255.0).astype(np.float32), d["y_train"],
+                    (d["x_test"] / 255.0).astype(np.float32), d["y_test"])
+    rng = np.random.RandomState(0)
+    x = rng.rand(n_train + n_test, 28, 28).astype(np.float32)
+    y = rng.randint(0, 10, n_train + n_test)
+    return x[:n_train], y[:n_train], x[n_train:], y[n_train:]
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--momentum", type=float, default=0.5)
+    parser.add_argument("--data-dir", default=None)
+    parser.add_argument("--use-adasum", action="store_true")
+    args = parser.parse_args()
+
+    hvd.init()
+    torch.manual_seed(42)
+
+    x_train, y_train, x_test, y_test = load_data(args.data_dir)
+    # Shard the training set by rank (the reference's DistributedSampler).
+    x_train = x_train[hvd.rank()::hvd.size()]
+    y_train = y_train[hvd.rank()::hvd.size()]
+    train_x = torch.from_numpy(x_train).unsqueeze(1)
+    train_y = torch.from_numpy(y_train.astype(np.int64))
+
+    model = Net()
+    lr_scaler = 1 if args.use_adasum else hvd.size()
+    optimizer = torch.optim.SGD(model.parameters(), lr=args.lr * lr_scaler,
+                                momentum=args.momentum)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters(),
+        op=hvd.Adasum if args.use_adasum else hvd.Average)
+
+    for epoch in range(args.epochs):
+        model.train()
+        perm = torch.randperm(len(train_x))
+        for start in range(0, len(train_x), args.batch_size):
+            idx = perm[start:start + args.batch_size]
+            optimizer.zero_grad()
+            loss = F.nll_loss(model(train_x[idx]), train_y[idx])
+            loss.backward()
+            optimizer.step()
+        # Cross-rank averaged test metrics (reference's metric_average).
+        model.eval()
+        with torch.no_grad():
+            tx = torch.from_numpy(x_test).unsqueeze(1)
+            ty = torch.from_numpy(y_test.astype(np.int64))
+            out = model(tx)
+            test_loss = F.nll_loss(out, ty)
+            acc = (out.argmax(1) == ty).float().mean()
+        test_loss = hvd.allreduce(test_loss, name="avg_loss")
+        acc = hvd.allreduce(acc, name="avg_acc")
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: test_loss={test_loss.item():.4f} "
+                  f"accuracy={100 * acc.item():.1f}%")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""MNIST training with Keras ``model.fit`` + the Horovod-style callback
+suite (mirrors the reference's ``examples/tensorflow2_keras_mnist.py``).
+
+    python -m horovod_tpu.run -np 2 python examples/tensorflow2_keras_mnist.py
+"""
+
+import argparse
+
+import numpy as np
+import keras
+
+import horovod_tpu.keras as hvd
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--epochs", type=int, default=2)
+    args = parser.parse_args()
+
+    hvd.init()
+
+    rng = np.random.RandomState(hvd.rank())
+    x = rng.rand(4096, 28, 28, 1).astype(np.float32)
+    y = rng.randint(0, 10, 4096)
+
+    model = keras.Sequential([
+        keras.layers.Input((28, 28, 1)),
+        keras.layers.Conv2D(32, 3, activation="relu"),
+        keras.layers.MaxPooling2D(),
+        keras.layers.Flatten(),
+        keras.layers.Dense(64, activation="relu"),
+        keras.layers.Dense(10, activation="softmax"),
+    ])
+    # Scale LR by world size; the warmup callback ramps into it.
+    opt = hvd.DistributedOptimizer(
+        keras.optimizers.Adam(0.001 * hvd.size()))
+    model.compile(optimizer=opt,
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+
+    callbacks = [
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
+        hvd.callbacks.LearningRateWarmupCallback(
+            initial_lr=0.001 * hvd.size(), warmup_epochs=1,
+            verbose=hvd.rank() == 0),
+    ]
+    model.fit(x, y, batch_size=args.batch_size, epochs=args.epochs,
+              callbacks=callbacks,
+              verbose=1 if hvd.rank() == 0 else 0)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

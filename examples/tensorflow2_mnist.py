@@ -1,0 +1,60 @@
+"""MNIST training, TF2 eager + GradientTape (mirrors the reference's
+``examples/tensorflow2_mnist.py``). Synthetic digits by default.
+
+    python -m horovod_tpu.run -np 2 python examples/tensorflow2_mnist.py
+"""
+
+import argparse
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--steps", type=int, default=200)
+    args = parser.parse_args()
+
+    hvd.init()
+
+    rng = np.random.RandomState(hvd.rank())
+    images = rng.rand(4096, 28, 28, 1).astype(np.float32)
+    labels = rng.randint(0, 10, 4096).astype(np.int64)
+    dataset = tf.data.Dataset.from_tensor_slices((images, labels)) \
+        .repeat().shuffle(1024).batch(args.batch_size)
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Conv2D(32, 3, activation="relu"),
+        tf.keras.layers.MaxPooling2D(),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(64, activation="relu"),
+        tf.keras.layers.Dense(10),
+    ])
+    loss_fn = tf.losses.SparseCategoricalCrossentropy(from_logits=True)
+    opt = tf.optimizers.Adam(0.001 * hvd.size())
+
+    @tf.function
+    def training_step(images, labels, first_batch):
+        with tf.GradientTape() as tape:
+            logits = model(images, training=True)
+            loss = loss_fn(labels, logits)
+        tape = hvd.DistributedGradientTape(tape)
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        if first_batch:
+            hvd.broadcast_variables(model.variables, root_rank=0)
+            hvd.broadcast_variables(opt.variables, root_rank=0)
+        return loss
+
+    for step, (bx, by) in enumerate(dataset.take(args.steps)):
+        loss = training_step(bx, by, step == 0)
+        if step % 50 == 0 and hvd.rank() == 0:
+            print(f"step {step}: loss={loss.numpy():.4f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

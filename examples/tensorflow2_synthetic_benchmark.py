@@ -1,0 +1,76 @@
+"""Synthetic throughput benchmark, TensorFlow 2 binding (mirrors the
+reference's ``examples/tensorflow2_synthetic_benchmark.py``): Keras
+ResNet50, GradientTape training step with ``hvd.DistributedGradientTape``,
+first-batch variable broadcast, per-device img/sec with 95% CI.
+
+    python -m horovod_tpu.run -np 4 python examples/tensorflow2_synthetic_benchmark.py
+"""
+
+import argparse
+import timeit
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="ResNet50",
+                        help="keras.applications model name")
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-warmup-batches", type=int, default=2)
+    parser.add_argument("--num-batches-per-iter", type=int, default=5)
+    parser.add_argument("--num-iters", type=int, default=5)
+    args = parser.parse_args()
+
+    hvd.init()
+
+    model = getattr(tf.keras.applications, args.model)(weights=None)
+    opt = tf.keras.optimizers.SGD(0.01 * hvd.size())
+    loss_fn = tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True)
+
+    data = tf.random.uniform([args.batch_size, 224, 224, 3])
+    target = tf.random.uniform([args.batch_size], minval=0, maxval=999,
+                               dtype=tf.int64)
+
+    @tf.function
+    def benchmark_step(first_batch):
+        with tf.GradientTape() as tape:
+            probs = model(data, training=True)
+            loss = loss_fn(target, probs)
+        tape = hvd.DistributedGradientTape(tape)
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        if first_batch:
+            hvd.broadcast_variables(model.variables, root_rank=0)
+            hvd.broadcast_variables(opt.variables, root_rank=0)
+        return loss
+
+    if hvd.rank() == 0:
+        print(f"Model: {args.model}, batch size: {args.batch_size}, "
+              f"ranks: {hvd.size()}")
+    benchmark_step(True)
+    for _ in range(args.num_warmup_batches - 1):
+        benchmark_step(False)
+
+    img_secs = []
+    for i in range(args.num_iters):
+        elapsed = timeit.timeit(lambda: benchmark_step(False),
+                                number=args.num_batches_per_iter)
+        img_sec = args.batch_size * args.num_batches_per_iter / elapsed
+        if hvd.rank() == 0:
+            print(f"Iter #{i}: {img_sec:.1f} img/sec per device")
+        img_secs.append(img_sec)
+
+    if hvd.rank() == 0:
+        mean, conf = np.mean(img_secs), 1.96 * np.std(img_secs)
+        print(f"Img/sec per device: {mean:.1f} +-{conf:.1f}")
+        print(f"Total img/sec on {hvd.size()} device(s): "
+              f"{hvd.size() * mean:.1f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -216,7 +216,7 @@ class HostWorld:
         self.require_init()
         if self.size == 1:
             return arr.copy()
-        arr = np.ascontiguousarray(arr)
+        arr = np.asarray(arr, order="C")
         out = np.zeros((self.size,) + arr.shape, dtype=arr.dtype)
         code = NUMPY_DTYPE_CODES[str(arr.dtype)]
         h = self.enqueue(name, _native.OP_ALLGATHER, 1, code, arr.shape,
@@ -231,7 +231,7 @@ class HostWorld:
         self.require_init()
         if self.size == 1:
             return arr.copy()
-        arr = np.ascontiguousarray(arr)
+        arr = np.asarray(arr, order="C")
         out = arr.copy()
         code = NUMPY_DTYPE_CODES[str(arr.dtype)]
         h = self.enqueue(name, _native.OP_BROADCAST, 1, code, arr.shape,

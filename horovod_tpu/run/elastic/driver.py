@@ -30,6 +30,17 @@ from .registration import FAILURE, SUCCESS, WorkerStateRegistry
 DISCOVER_HOSTS_FREQUENCY_SECS = 1.0
 
 
+class _WorkerHandle:
+    """Per-worker shutdown event + removal mark (mutated under the
+    driver lock)."""
+
+    __slots__ = ("event", "removed")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.removed = False
+
+
 class ElasticDriver:
     def __init__(self, rendezvous, discovery, min_np: int, max_np: int = 0,
                  timeout: Optional[float] = None,
@@ -51,8 +62,7 @@ class ElasticDriver:
         self._lock = threading.RLock()
         self._shutdown = threading.Event()
         self._host_change = threading.Event()
-        self._workers_active: Dict[Tuple[str, int], threading.Event] = {}
-        self._removed: set = set()
+        self._workers_active: Dict[Tuple[str, int], _WorkerHandle] = {}
         self._requested_np = min_np
         self._round_failures = 0
         self._notify_client_factory = None  # injectable for tests
@@ -70,15 +80,18 @@ class ElasticDriver:
         self._requested_np = max(np, self._min_np)
         self._host_manager.update_available_hosts()
         self._discovery_thread.start()
-        self.wait_for_available_slots(self._min_np)
-        self._activate_workers(self._requested_np)
+        while True:
+            self.wait_for_available_slots(self._min_np)
+            if self._activate_workers(self._requested_np):
+                break
+            self._shutdown.wait(DISCOVER_HOSTS_FREQUENCY_SECS)
 
     def stop(self) -> None:
         self._shutdown.set()
         with self._lock:
-            events = list(self._workers_active.values())
-        for ev in events:
-            ev.set()
+            handles = list(self._workers_active.values())
+        for h in handles:
+            h.event.set()
         if self._discovery_thread.is_alive():
             self._discovery_thread.join(timeout=5.0)
 
@@ -154,12 +167,20 @@ class ElasticDriver:
                         self._max_np or np, max(np, self._min_np))
         return get_host_assignments(hosts, np_actual)
 
-    def _activate_workers(self, np: int) -> None:
+    def _activate_workers(self, np: int) -> bool:
         """(Re)assign ranks, spawn workers for newly-assigned slots, and
         terminate workers whose slot left the plan (blacklisted/removed
-        hosts) (parity: ``driver.py:157,259-277``)."""
+        hosts) (parity: ``driver.py:157,259-277``). Returns False — leaving
+        the current plan untouched — when fewer than min_np slots exist at
+        decision time (the available-slot pre-checks run unlocked, so a
+        concurrent blacklist can shrink the world between check and act)."""
         with self._lock:
             plan = self._compute_assignments(np)
+            if len(plan) < self._min_np:
+                _log.warning(
+                    f"elastic: only {len(plan)} slots available, below "
+                    f"min_np={self._min_np}; keeping current plan")
+                return False
             self._world_size = plan[0].size if plan else 0
             self._rendezvous_round += 1
             self._round_failures = 0
@@ -175,24 +196,29 @@ class ElasticDriver:
                        if k not in assignments]
             self._assignments = assignments
             for key in removed:
-                self._removed.add(key)
-                self._workers_active[key].set()
+                handle = self._workers_active[key]
+                handle.removed = True
+                handle.event.set()
             for slot in new_slots:
                 self._spawn(slot)
+            return True
 
     def _spawn(self, slot: SlotInfo) -> None:
-        shutdown_event = threading.Event()
-        # A slot being respawned is no longer "removed": its new worker's
-        # real exit must be accounted normally.
-        self._removed.discard((slot.hostname, slot.local_rank))
-        self._workers_active[(slot.hostname, slot.local_rank)] = \
-            shutdown_event
+        handle = _WorkerHandle()
+        key = (slot.hostname, slot.local_rank)
+        self._workers_active[key] = handle
 
         def run():
-            code = self._create_worker_fn(slot, [shutdown_event,
+            code = self._create_worker_fn(slot, [handle.event,
                                                  self._shutdown])
             host, lslot = slot.hostname, slot.local_rank
-            if (host, lslot) in self._removed:
+            # Classify under the lock: `removed` is only honored while this
+            # worker's own handle is still the registered one (a respawned
+            # slot carries a fresh handle).
+            with self._lock:
+                removed = handle.removed and \
+                    self._workers_active.get(key) is handle
+            if removed:
                 # Deliberately terminated when its slot left the plan —
                 # neither a success nor a host-blacklisting failure.
                 self.on_worker_removed(host, lslot)
@@ -214,7 +240,6 @@ class ElasticDriver:
         rank is left unstaffed."""
         with self._lock:
             self._workers_active.pop((host, slot), None)
-            self._removed.discard((host, slot))
             reborn = self._assignments.get((host, slot))
             if reborn is not None and not self._shutdown.is_set():
                 self._spawn(reborn)
@@ -248,15 +273,19 @@ class ElasticDriver:
             # Try to resume on the remaining hosts with as many slots as
             # are available (up to the requested/max np); workers meanwhile
             # hit HorovodInternalError and wait in their retry loop for the
-            # new rendezvous.
-            try:
-                self.wait_for_available_slots(self._min_np)
-            except TimeoutError:
-                self._result = 1
-                self._done.set()
-                self._shutdown.set()
-                return
-            self._activate_workers(self._target_np())
+            # new rendezvous. Retry if activation loses a race with another
+            # concurrent blacklist.
+            while not self._shutdown.is_set():
+                try:
+                    self.wait_for_available_slots(self._min_np)
+                except TimeoutError:
+                    self._result = 1
+                    self._done.set()
+                    self._shutdown.set()
+                    return
+                if self._activate_workers(self._target_np()):
+                    return
+                self._shutdown.wait(DISCOVER_HOSTS_FREQUENCY_SECS)
 
     def _target_np(self) -> int:
         """World size to aim for on membership change: grow to max_np when

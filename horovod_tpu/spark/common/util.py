@@ -80,10 +80,11 @@ def write_parquet(pdf, path: str, num_partitions: int = 1) -> int:
             break
         table = pa.Table.from_pandas(chunk.reset_index(drop=True),
                                      preserve_index=False)
-        # Small row groups give the round-robin shard reader granularity:
-        # a world larger than the partition count still gets data on every
-        # rank as long as there are >= size row groups in total.
-        row_group_size = max(1, min(1024, math.ceil(len(chunk) / 8) or 1))
+        # ~8 row groups per partition gives the round-robin shard reader
+        # granularity (a world larger than the partition count still gets
+        # data on every rank) without fragmenting large datasets into tiny
+        # groups.
+        row_group_size = max(1, math.ceil(len(chunk) / 8))
         pq.write_table(table, os.path.join(path, f"part-{i:05d}.parquet"),
                        row_group_size=row_group_size)
         written += len(chunk)

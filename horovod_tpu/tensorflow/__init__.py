@@ -214,3 +214,6 @@ class DistributedGradientTape(tf.GradientTape):
             out.append(allreduce(g, op=self._op,
                                  compression=self._compression))
         return out
+
+
+from . import elastic  # noqa: E402,F401  (exposes hvd.elastic.run / states)

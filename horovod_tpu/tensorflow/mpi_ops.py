@@ -137,7 +137,7 @@ def _np_allreduce(arr: np.ndarray, name: str, op: int, prescale: float,
                   postscale: float) -> np.ndarray:
     w = _world()
     w.require_init()
-    arr = np.ascontiguousarray(arr)
+    arr = np.asarray(arr, order="C")
     if w.size == 1 or not w.native:
         scale = prescale * (postscale if op not in (Min, Max) else 1.0)
         if scale == 1.0:
@@ -159,7 +159,7 @@ def _np_allgather(arr: np.ndarray, name: str) -> np.ndarray:
     ``mpi_operations.cc:140``): exchange dim-0 sizes, pad, gather, slice."""
     w = _world()
     w.require_init()
-    arr = np.ascontiguousarray(arr)
+    arr = np.asarray(arr, order="C")
     if arr.ndim == 0:
         arr = arr.reshape(1)
     if w.size == 1 or not w.native:
@@ -187,7 +187,7 @@ def _np_allgather(arr: np.ndarray, name: str) -> np.ndarray:
 def _np_broadcast(arr: np.ndarray, root_rank: int, name: str) -> np.ndarray:
     w = _world()
     w.require_init()
-    arr = np.ascontiguousarray(arr)
+    arr = np.asarray(arr, order="C")
     if w.size == 1 or not w.native:
         return arr.copy()
     return w.broadcast_np(arr, root_rank, name)
@@ -200,13 +200,18 @@ def _to_numpy(tensor: tf.Tensor) -> np.ndarray:
     return tensor.numpy() if hasattr(tensor, "numpy") else np.asarray(tensor)
 
 
-def _wrap(np_fn, tensor: tf.Tensor) -> tf.Tensor:
-    """Run a numpy-collective on a TF tensor, graph-safe."""
+def _wrap(np_fn, tensor: tf.Tensor, same_shape: bool = True) -> tf.Tensor:
+    """Run a numpy-collective on a TF tensor, graph-safe. ``same_shape``
+    marks shape-preserving collectives (allreduce/broadcast), whose output
+    gets the input's runtime shape forced back — py_function materializes
+    0-d results as shape [1] otherwise."""
     if tf.executing_eagerly() and not isinstance(tensor, tf.Variable) \
             and not tf.is_symbolic_tensor(tensor):
         return tf.constant(np_fn(_to_numpy(tensor)))
     out = tf.py_function(lambda t: np_fn(t.numpy()), [tensor], tensor.dtype)
-    out.set_shape(tensor.shape)
+    if same_shape:
+        out = tf.reshape(out, tf.shape(tensor))
+        out.set_shape(tensor.shape)
     return out
 
 
@@ -233,7 +238,7 @@ def allgather(tensor: tf.Tensor, name: Optional[str] = None) -> tf.Tensor:
 
     @tf.custom_gradient
     def _fn(t):
-        out = _wrap(lambda a: _np_allgather(a, name), t)
+        out = _wrap(lambda a: _np_allgather(a, name), t, same_shape=False)
         if t.shape.rank is not None and t.shape.rank > 0:
             out.set_shape(tf.TensorShape([None]).concatenate(t.shape[1:]))
 
@@ -241,7 +246,8 @@ def allgather(tensor: tf.Tensor, name: Optional[str] = None) -> tf.Tensor:
             summed = _allreduce(dy, name=name + ".grad", op=Sum)
             sizes = _wrap(
                 lambda a: _np_allgather(a, name + ".grad.dim0"),
-                tf.reshape(tf.cast(dim0, tf.int64), [1]))
+                tf.reshape(tf.cast(dim0, tf.int64), [1]),
+                same_shape=False)
             offset = tf.reduce_sum(sizes[: rank()])
             return tf.slice(
                 summed, tf.concat(

@@ -1,0 +1,66 @@
+"""Example smoke tests: run the shipped examples as subprocesses with tiny
+sizes (the reference exercises its examples in CI docker images; SURVEY §4).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_example(relpath, *extra, timeout=240):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", relpath), *extra],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
+    assert proc.returncode == 0, \
+        f"{relpath} failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    return proc.stdout
+
+
+def test_pytorch_mnist_example():
+    pytest.importorskip("torch")
+    out = _run_example("pytorch_mnist.py", "--epochs", "1",
+                       "--batch-size", "256")
+    assert "accuracy=" in out
+
+
+def test_pytorch_synthetic_benchmark_tiny():
+    pytest.importorskip("torch")
+    out = _run_example(
+        "pytorch_synthetic_benchmark.py", "--batch-size", "2",
+        "--image-size", "64", "--num-classes", "10",
+        "--num-warmup-batches", "1", "--num-batches-per-iter", "1",
+        "--num-iters", "2")
+    assert "Img/sec per device" in out
+
+
+def test_adasum_small_model_example():
+    pytest.importorskip("torch")
+    out = _run_example("adasum_small_model.py", "--steps", "30")
+    assert "Adasum:" in out and "Average:" in out
+
+
+def test_keras_spark_mnist_example(tmp_path):
+    pytest.importorskip("keras")
+    out = _run_example("keras_spark_mnist.py", "--epochs", "1",
+                       "--work-dir", str(tmp_path))
+    assert "history:" in out and "predictions column" in out
+
+
+def test_pytorch_spark_mnist_example(tmp_path):
+    pytest.importorskip("torch")
+    out = _run_example("pytorch_spark_mnist.py", "--epochs", "1",
+                       "--work-dir", str(tmp_path))
+    assert "history:" in out
+
+
+def test_elastic_pytorch_example_single():
+    pytest.importorskip("torch")
+    out = _run_example("elastic/pytorch_synthetic_elastic.py",
+                       "--num-steps", "20")
+    assert "elastic training finished" in out

@@ -17,6 +17,7 @@ reference's single background thread serves CPU and GPU entries.
 from __future__ import annotations
 
 import os
+import socket
 import threading
 from typing import Optional, Tuple
 
@@ -28,6 +29,32 @@ from . import native as _native
 from .exceptions import HorovodInternalError, NotInitializedError
 
 NUMPY_DTYPE_CODES = dict(_native.DTYPE_CODES)
+
+# Scheduler-provided rank env fallbacks, tried in order when HOROVOD_* is
+# absent: jsrun/Spectrum MPI (JSM/PMIX/OMPI) and Slurm. This lets jsrun-
+# or srun-spawned workers join without the ssh launcher having exported the
+# topology block (reference parity: under jsrun MPI supplies rank
+# discovery, ``run/js_run.py``).
+_SCHED_RANK = ("JSM_NAMESPACE_RANK", "PMIX_RANK", "OMPI_COMM_WORLD_RANK",
+               "SLURM_PROCID")
+_SCHED_SIZE = ("JSM_NAMESPACE_SIZE", "OMPI_COMM_WORLD_SIZE", "SLURM_NTASKS")
+_SCHED_LOCAL_RANK = ("JSM_NAMESPACE_LOCAL_RANK",
+                     "OMPI_COMM_WORLD_LOCAL_RANK", "SLURM_LOCALID")
+_SCHED_LOCAL_SIZE = ("JSM_NAMESPACE_LOCAL_SIZE",
+                     "OMPI_COMM_WORLD_LOCAL_SIZE", "SLURM_NTASKS_PER_NODE")
+
+
+def _sched_env(primary: str, fallbacks, default: str) -> str:
+    v = os.environ.get(primary)
+    if v is not None:
+        return v
+    for name in fallbacks:
+        v = os.environ.get(name)
+        if v is not None:
+            # Slurm compound counts look like "16(x2)"; the leading int is
+            # the per-node value.
+            return v.split("(")[0]
+    return default
 
 
 class HostWorld:
@@ -51,16 +78,27 @@ class HostWorld:
         with self._lock:
             if self.initialized:
                 return
-            self.rank = int(os.environ.get(_config.HOROVOD_RANK, "0"))
-            self.size = int(os.environ.get(_config.HOROVOD_SIZE, "1"))
+            self.rank = int(_sched_env(_config.HOROVOD_RANK, _SCHED_RANK,
+                                       "0"))
+            self.size = int(_sched_env(_config.HOROVOD_SIZE, _SCHED_SIZE,
+                                       "1"))
             self.local_rank = int(
-                os.environ.get(_config.HOROVOD_LOCAL_RANK, "0"))
+                _sched_env(_config.HOROVOD_LOCAL_RANK, _SCHED_LOCAL_RANK,
+                           "0"))
             self.local_size = int(
-                os.environ.get(_config.HOROVOD_LOCAL_SIZE, "1"))
+                _sched_env(_config.HOROVOD_LOCAL_SIZE, _SCHED_LOCAL_SIZE,
+                           "1"))
+            # Cross (node-level) topology: explicit env from the ssh
+            # launcher wins; under scheduler launches derive it from the
+            # per-node packing (homogeneous layout, the same assumption the
+            # reference's rankfile makes).
+            ls = max(1, self.local_size)
             self.cross_rank = int(
-                os.environ.get(_config.HOROVOD_CROSS_RANK, str(self.rank)))
+                os.environ.get(_config.HOROVOD_CROSS_RANK,
+                               str(self.rank // ls)))
             self.cross_size = int(
-                os.environ.get(_config.HOROVOD_CROSS_SIZE, str(self.size)))
+                os.environ.get(_config.HOROVOD_CROSS_SIZE,
+                               str(max(1, (self.size + ls - 1) // ls))))
             self._maybe_elastic_rerendezvous()
             if comm is not None:
                 # Parity with hvd.init(comm=[ranks]) (basics.py:33-65):
@@ -135,7 +173,13 @@ class HostWorld:
         addr = os.environ.get(_config.HOROVOD_CONTROLLER_ADDR, "127.0.0.1")
         base_port = int(
             os.environ.get(_config.HOROVOD_CONTROLLER_PORT, "29500"))
-        my_host = os.environ.get("HOROVOD_HOSTNAME", "127.0.0.1")
+        # The ssh launcher exports a per-slot HOROVOD_HOSTNAME; scheduler
+        # launchers (jsrun/srun) give every rank the same env, so fall back
+        # to the actual hostname — advertising 127.0.0.1 would point peers'
+        # ring connections at the wrong machine on multi-host worlds.
+        my_host = os.environ.get("HOROVOD_HOSTNAME")
+        if not my_host:
+            my_host = socket.gethostname() if self.size > 1 else "127.0.0.1"
 
         def reject_xla(responses, rid):
             core.response_done(rid, False,

@@ -1,0 +1,92 @@
+"""``jsrun`` launcher for LSF clusters (parity: ``horovod/run/js_run.py``).
+
+On an LSF/CSM machine the scheduler owns process placement: instead of
+ssh-spawning per slot, the launcher emits one ``jsrun`` invocation with an
+explicit resource file (ERF) binding each rank to its host, and jsrun
+starts the workers. Workers still rendezvous through the standard
+``HOROVOD_*`` env + HTTP rendezvous, so below L5 nothing changes.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import shutil
+import tempfile
+from typing import Dict, List, Optional
+
+from .common.util import safe_shell_exec
+from .util.lsf import LSFUtils
+
+
+def is_jsrun_installed() -> bool:
+    return shutil.which("jsrun") is not None
+
+
+def generate_jsrun_rankfile(hosts: Dict[str, int],
+                            path: Optional[str] = None,
+                            num_proc: Optional[int] = None) -> str:
+    """Write an explicit resource file mapping each rank to its host
+    (parity: ``js_run.py`` ``generate_jsrun_rankfile``; format documented
+    by IBM Spectrum LSF ERF).
+
+    One resource set per rank, capped at ``num_proc`` ranks — cpu indices
+    are assigned sequentially per host, the reference's layout for one
+    process per slot.
+    """
+    if path is None:
+        fd, path = tempfile.mkstemp(suffix=".rankfile", text=True)
+        os.close(fd)
+    limit = num_proc if num_proc is not None else sum(hosts.values())
+    lines = ["overlapping_rs: allow", "cpu_index_using: logical", ""]
+    rank = 0
+    for host, slots in hosts.items():
+        for local in range(slots):
+            if rank >= limit:
+                break
+            lines.append(f"rank: {rank}: {{ hostname: {host}; "
+                         f"cpu: {{{local}}} }}")
+            rank += 1
+    if rank < limit:
+        raise ValueError(
+            f"hosts provide only {rank} slots, need num_proc={limit}")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return path
+
+
+def build_jsrun_command(num_proc: int, hosts: Dict[str, int],
+                        command: List[str], rankfile: Optional[str] = None,
+                        output_filename: Optional[str] = None) -> str:
+    """The single jsrun invocation string (parity: ``js_run.py:72-90``)."""
+    rankfile = rankfile or generate_jsrun_rankfile(hosts, num_proc=num_proc)
+    parts = ["jsrun", "--erf_input", rankfile]
+    if output_filename:
+        parts += ["--stdio_stderr", output_filename,
+                  "--stdio_stdout", output_filename]
+    parts += command
+    return " ".join(shlex.quote(p) for p in parts)
+
+
+def js_run(num_proc: int, command: List[str],
+           hosts: Optional[Dict[str, int]] = None,
+           env: Optional[dict] = None,
+           output_filename: Optional[str] = None,
+           verbose: int = 0) -> int:
+    """Launch via jsrun inside an LSF allocation. ``hosts`` is the
+    launcher's slot plan (host → slots, rank order); it defaults to the
+    full allocation but the runner passes its own plan so the launched
+    world always matches HOROVOD_SIZE and the rendezvous plan."""
+    if not LSFUtils.using_lsf():
+        raise RuntimeError("js_run requires an LSF allocation "
+                           "(LSB_JOBID not set)")
+    if not is_jsrun_installed():
+        raise RuntimeError(
+            "jsrun not found; run on an LSF/CSM cluster or use the default "
+            "launcher")
+    hosts = hosts or LSFUtils.get_compute_hosts()
+    cmd = build_jsrun_command(num_proc, hosts, command,
+                              output_filename=output_filename)
+    if verbose:
+        print(cmd)
+    return safe_shell_exec.execute(cmd, env=env)

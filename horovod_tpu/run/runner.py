@@ -139,7 +139,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
 
     group_lib = parser.add_argument_group("library arguments")
     group_lib.add_argument("--launcher", dest="launcher", default="auto",
-                           choices=["auto", "local", "ssh"],
+                           choices=["auto", "local", "ssh", "jsrun"],
                            help="Worker launch transport (the reference's "
                                 "gloo/mpi/jsrun slot).")
     # Reference-compat no-ops: collectives always run on XLA/native ring.
@@ -189,6 +189,17 @@ def _launcher_addr(plan) -> str:
         return socket.gethostname()
 
 
+def _job_env(args, base_env: Optional[dict] = None) -> dict:
+    """CLI-flag → env mapping shared by every launch flavor."""
+    env = dict(base_env if base_env is not None else os.environ)
+    config_parser.set_env_from_args(env, args)
+    if getattr(args, "disable_cache", False):
+        env[_config.HOROVOD_CACHE_CAPACITY] = "0"
+    if getattr(args, "min_np", None):
+        env[_config.HOROVOD_ELASTIC] = "1"
+    return env
+
+
 def _run_static(args, command: List[str], base_env: Optional[dict] = None,
                 collect=None) -> int:
     hosts = _hostnames(args)
@@ -201,12 +212,7 @@ def _run_static(args, command: List[str], base_env: Optional[dict] = None,
     controller_port = _launch.free_port()
     addr = _controller_addr(plan)
 
-    env = dict(base_env if base_env is not None else os.environ)
-    config_parser.set_env_from_args(env, args)
-    if getattr(args, "disable_cache", False):
-        env[_config.HOROVOD_CACHE_CAPACITY] = "0"
-    if getattr(args, "min_np", None):
-        env[_config.HOROVOD_ELASTIC] = "1"
+    env = _job_env(args, base_env)
 
     try:
         codes = _launch.launch_workers(
@@ -242,9 +248,52 @@ def _run(args) -> int:
         # Elastic: discovery script, or fixed hosts with --min-np (the
         # reference's FixedHosts flavor, run/elastic/discovery.py).
         return _run_elastic(args, command)
+    # LSF defaults (parity: runner.py:790 _run LSF branch): inside an
+    # allocation the host list and np come from the scheduler.
+    from .util.lsf import LSFUtils
+
+    if LSFUtils.using_lsf() and not (args.hosts or args.hostfile):
+        args.hosts = LSFUtils.get_hosts_string()
+        if args.np is None:
+            args.np = LSFUtils.get_num_processes()
     if args.np is None and not (args.hosts or args.hostfile):
         raise ValueError("-np (or -H/--hostfile) is required")
+    if args.launcher == "jsrun":
+        return _run_jsrun(args, command)
     return _run_static(args, command)
+
+
+def _run_jsrun(args, command: List[str]) -> int:
+    """Launch through LSF's jsrun (parity: ``run/js_run.py``): one jsrun
+    invocation with an ERF rankfile; workers pick ranks up from the
+    JSM/PMIX env and rendezvous over HTTP as usual."""
+    from . import js_run
+
+    hosts = _hostnames(args)
+    np_ = args.np or sum(h.slots for h in hosts)
+    plan = _hosts.get_host_assignments(hosts, np_)
+    rendezvous = RendezvousServer(verbose=1 if args.verbose else 0)
+    rendezvous_port = rendezvous.start_server()
+    rendezvous.init(plan)
+
+    env = _job_env(args)
+    env[_config.HOROVOD_SIZE] = str(np_)
+    env[_config.HOROVOD_RENDEZVOUS_ADDR] = _launcher_addr(plan)
+    env[_config.HOROVOD_RENDEZVOUS_PORT] = str(rendezvous_port)
+    env[_config.HOROVOD_CONTROLLER_ADDR] = _controller_addr(plan)
+    env[_config.HOROVOD_CONTROLLER_PORT] = str(_launch.free_port())
+    # Rank order in the ERF must match the runner's plan, and the world is
+    # exactly np_ ranks even if the allocation is larger.
+    plan_hosts: dict = {}
+    for slot in plan:
+        plan_hosts[slot.hostname] = plan_hosts.get(slot.hostname, 0) + 1
+    try:
+        return js_run.js_run(
+            np_, command, hosts=plan_hosts, env=env,
+            output_filename=getattr(args, "output_filename", None),
+            verbose=args.verbose)
+    finally:
+        rendezvous.stop_server()
 
 
 def run_commandline(argv: Optional[List[str]] = None) -> int:

@@ -1,0 +1,1 @@
+"""Launcher utilities (parity: ``horovod/run/util/``)."""

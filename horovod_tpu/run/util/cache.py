@@ -1,0 +1,49 @@
+"""On-disk result cache for launch-time discovery (parity:
+``horovod/run/util/cache.py`` Cache): NIC probing and host checks are slow
+over ssh, so their results are cached under ``~/.horovod`` with a TTL.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Optional
+
+
+class Cache:
+    def __init__(self, cache_folder: str, cache_staleness_threshold_minutes:
+                 float, parameters_hash: str = ""):
+        os.makedirs(cache_folder, exist_ok=True)
+        self._path = os.path.join(cache_folder, "cache.json")
+        self._ttl = cache_staleness_threshold_minutes * 60.0
+        self._hash = parameters_hash
+        self._lock = threading.Lock()
+        self._content = {}
+        if os.path.isfile(self._path):
+            try:
+                with open(self._path) as f:
+                    stored = json.load(f)
+                if stored.get("_hash") == self._hash:
+                    self._content = stored.get("entries", {})
+            except (ValueError, OSError):
+                pass
+
+    def get(self, key: str) -> Optional[Any]:
+        with self._lock:
+            entry = self._content.get(key)
+            if entry is None:
+                return None
+            value, ts = entry
+            if time.time() - ts > self._ttl:
+                return None
+            return value
+
+    def put(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._content[key] = (value, time.time())
+            tmp = self._path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"_hash": self._hash, "entries": self._content}, f)
+            os.replace(tmp, self._path)

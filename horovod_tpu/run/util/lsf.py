@@ -1,0 +1,55 @@
+"""LSF cluster detection (parity: ``horovod/run/util/lsf.py`` LSFUtils).
+
+The reference queries IBM CSM for the allocation's node list and GPU/core
+counts; the portable signal set is the LSF batch environment itself
+(``LSB_JOBID``, ``LSB_MCPU_HOSTS``/``LSB_HOSTS``), which this port reads
+directly — CSM tooling is absent on TPU pods, and the slot count per host
+comes from the allocation string rather than GPU discovery.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List
+
+
+class LSFUtils:
+    """LSF utilities (parity: ``lsf.py`` LSFUtils)."""
+
+    @staticmethod
+    def using_lsf() -> bool:
+        """True when running inside an LSF allocation
+        (parity: ``lsf.py`` ``using_lsf``)."""
+        return "LSB_JOBID" in os.environ
+
+    @staticmethod
+    def get_compute_hosts() -> Dict[str, int]:
+        """Ordered host → slot-count map from the allocation.
+
+        ``LSB_MCPU_HOSTS`` is ``"host1 n1 host2 n2 ..."``; ``LSB_HOSTS``
+        repeats each host once per slot. The batch (launch) host keeps its
+        allocation entry, matching the reference's rankfile behavior.
+        """
+        mcpu = os.environ.get("LSB_MCPU_HOSTS", "").split()
+        hosts: Dict[str, int] = {}
+        if mcpu:
+            for i in range(0, len(mcpu) - 1, 2):
+                hosts[mcpu[i]] = hosts.get(mcpu[i], 0) + int(mcpu[i + 1])
+            return hosts
+        for h in os.environ.get("LSB_HOSTS", "").split():
+            hosts[h] = hosts.get(h, 0) + 1
+        return hosts
+
+    @staticmethod
+    def get_num_processes() -> int:
+        return sum(LSFUtils.get_compute_hosts().values())
+
+    @staticmethod
+    def get_num_hosts() -> int:
+        return len(LSFUtils.get_compute_hosts())
+
+    @staticmethod
+    def get_hosts_string() -> str:
+        """``-H``-style ``host:slots,...`` string for the runner."""
+        return ",".join(f"{h}:{n}"
+                        for h, n in LSFUtils.get_compute_hosts().items())

@@ -1,0 +1,29 @@
+"""Thread helpers (parity: ``horovod/run/util/threads.py``)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+
+def in_thread(target: Callable, args=(), name: Optional[str] = None,
+              daemon: bool = True) -> threading.Thread:
+    """Run ``target`` on a fresh daemon thread (parity: ``in_thread``)."""
+    t = threading.Thread(target=target, args=args, name=name, daemon=daemon)
+    t.start()
+    return t
+
+
+def on_event(event: threading.Event, target: Callable, args=(),
+             stop: Optional[threading.Event] = None,
+             daemon: bool = True) -> threading.Thread:
+    """Invoke ``target`` once ``event`` fires, unless ``stop`` fires first
+    (parity: ``on_event``)."""
+
+    def waiter():
+        while not event.wait(0.1):
+            if stop is not None and stop.is_set():
+                return
+        target(*args)
+
+    return in_thread(waiter, daemon=daemon)

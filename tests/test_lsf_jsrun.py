@@ -1,0 +1,134 @@
+"""LSF/jsrun launcher tests (reference: ``test_run.py:720`` rankfile
+generation + mocked command assembly — SURVEY §4 Pattern 2)."""
+
+import os
+from unittest import mock
+
+import pytest
+
+from horovod_tpu.run import js_run
+from horovod_tpu.run.util.lsf import LSFUtils
+
+
+def test_lsf_detection_and_hosts():
+    with mock.patch.dict(os.environ, {"LSB_JOBID": "77",
+                                      "LSB_MCPU_HOSTS":
+                                      "batch 1 nodeA 4 nodeB 4"},
+                         clear=False):
+        assert LSFUtils.using_lsf()
+        assert LSFUtils.get_compute_hosts() == {
+            "batch": 1, "nodeA": 4, "nodeB": 4}
+        assert LSFUtils.get_num_processes() == 9
+        assert LSFUtils.get_num_hosts() == 3
+        assert LSFUtils.get_hosts_string() == "batch:1,nodeA:4,nodeB:4"
+
+
+def test_lsf_hosts_from_lsb_hosts():
+    env = {"LSB_JOBID": "78", "LSB_HOSTS": "a a b b b"}
+    with mock.patch.dict(os.environ, env, clear=False):
+        os.environ.pop("LSB_MCPU_HOSTS", None)
+        assert LSFUtils.get_compute_hosts() == {"a": 2, "b": 3}
+
+
+def test_not_lsf():
+    with mock.patch.dict(os.environ, {}, clear=True):
+        assert not LSFUtils.using_lsf()
+
+
+def test_jsrun_rankfile(tmp_path):
+    rf = js_run.generate_jsrun_rankfile(
+        {"nodeA": 2, "nodeB": 1}, path=str(tmp_path / "erf"))
+    content = open(rf).read()
+    assert "overlapping_rs: allow" in content
+    assert "rank: 0: { hostname: nodeA; cpu: {0} }" in content
+    assert "rank: 1: { hostname: nodeA; cpu: {1} }" in content
+    assert "rank: 2: { hostname: nodeB; cpu: {0} }" in content
+
+
+def test_jsrun_command_string(tmp_path):
+    rf = str(tmp_path / "erf")
+    js_run.generate_jsrun_rankfile({"n1": 2}, path=rf)
+    cmd = js_run.build_jsrun_command(
+        2, {"n1": 2}, ["python", "train.py"], rankfile=rf,
+        output_filename="/tmp/out.log")
+    assert cmd == (f"jsrun --erf_input {rf} --stdio_stderr /tmp/out.log "
+                   f"--stdio_stdout /tmp/out.log python train.py")
+
+
+def test_js_run_requires_lsf():
+    with mock.patch.dict(os.environ, {}, clear=True):
+        with pytest.raises(RuntimeError, match="LSF"):
+            js_run.js_run(2, ["python", "x.py"])
+
+
+def test_js_run_executes_under_mock():
+    env = {"LSB_JOBID": "79", "LSB_MCPU_HOSTS": "n1 2"}
+    with mock.patch.dict(os.environ, env, clear=False), \
+            mock.patch.object(js_run, "is_jsrun_installed",
+                              return_value=True), \
+            mock.patch.object(js_run.safe_shell_exec, "execute",
+                              return_value=0) as ex:
+        assert js_run.js_run(2, ["python", "x.py"], verbose=0) == 0
+        cmd = ex.call_args[0][0]
+        assert cmd.startswith("jsrun --erf_input ")
+        assert cmd.endswith("python x.py")
+
+
+def test_scheduler_env_rank_fallback():
+    from horovod_tpu.common.host_world import _sched_env, _SCHED_RANK
+
+    with mock.patch.dict(os.environ, {"PMIX_RANK": "3"}, clear=True):
+        assert _sched_env("HOROVOD_RANK", _SCHED_RANK, "0") == "3"
+    with mock.patch.dict(os.environ, {"HOROVOD_RANK": "1",
+                                      "PMIX_RANK": "3"}, clear=True):
+        assert _sched_env("HOROVOD_RANK", _SCHED_RANK, "0") == "1"
+    with mock.patch.dict(os.environ, {}, clear=True):
+        assert _sched_env("HOROVOD_RANK", _SCHED_RANK, "0") == "0"
+
+
+def test_run_util_cache(tmp_path):
+    from horovod_tpu.run.util.cache import Cache
+
+    c = Cache(str(tmp_path), cache_staleness_threshold_minutes=10)
+    assert c.get("k") is None
+    c.put("k", ["eth0", "lo"])
+    assert c.get("k") == ["eth0", "lo"]
+    # Fresh instance with same hash reloads from disk.
+    c2 = Cache(str(tmp_path), 10)
+    assert c2.get("k") == ["eth0", "lo"]
+    # Hash change invalidates.
+    c3 = Cache(str(tmp_path), 10, parameters_hash="other")
+    assert c3.get("k") is None
+
+
+def test_run_util_threads():
+    import threading
+
+    from horovod_tpu.run.util.threads import in_thread, on_event
+
+    hits = []
+    in_thread(lambda: hits.append(1)).join(2.0)
+    assert hits == [1]
+    ev, fired = threading.Event(), threading.Event()
+    on_event(ev, fired.set)
+    ev.set()
+    assert fired.wait(2.0)
+
+
+def test_jsrun_rankfile_caps_at_num_proc(tmp_path):
+    rf = js_run.generate_jsrun_rankfile(
+        {"nodeA": 4, "nodeB": 4}, path=str(tmp_path / "erf"), num_proc=3)
+    content = open(rf).read()
+    assert "rank: 2:" in content and "rank: 3:" not in content
+    with pytest.raises(ValueError, match="only 2 slots"):
+        js_run.generate_jsrun_rankfile({"n": 2}, path=str(tmp_path / "e2"),
+                                       num_proc=5)
+
+
+def test_jsrun_command_quotes_arguments(tmp_path):
+    rf = str(tmp_path / "erf")
+    js_run.generate_jsrun_rankfile({"n1": 1}, path=rf)
+    cmd = js_run.build_jsrun_command(
+        1, {"n1": 1}, ["python", "train.py", "--tag", "run 1; rm -rf /"],
+        rankfile=rf)
+    assert "'run 1; rm -rf /'" in cmd

@@ -25,7 +25,7 @@ __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
     "local_size", "cross_rank", "cross_size", "allreduce", "allreduce_",
     "allgather", "broadcast", "broadcast_", "broadcast_parameters",
-    "DistributedOptimizer", "DistributedTrainer",
+    "broadcast_object", "DistributedOptimizer", "DistributedTrainer",
     "Average", "Sum", "Adasum", "Min", "Max", "ReduceOp",
 ]
 
@@ -150,6 +150,15 @@ def broadcast_parameters(params, root_rank=0):
         except Exception:
             continue
         broadcast_(tensor, root_rank, name=f"mx.bcast.{i}.{name}")
+
+
+def broadcast_object(obj, root_rank=0, name=None):
+    """Broadcast an arbitrary picklable object (parity with the other
+    bindings; the reference's mxnet module gained this in later versions).
+    Pure host-plane — usable without mxnet installed."""
+    from ..torch import functions as _torch_functions  # shared host impl
+
+    return _torch_functions.broadcast_object(obj, root_rank, name=name)
 
 
 class DistributedOptimizer:

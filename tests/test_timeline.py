@@ -1,0 +1,82 @@
+"""Timeline tests (reference: ``test/test_timeline.py:53`` — run a tiny
+job with HOROVOD_TIMELINE set and validate the Chrome-tracing JSON;
+SURVEY §4 Pattern 4)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+
+def test_timeline_json_valid(tmp_path, monkeypatch):
+    path = str(tmp_path / "timeline.json")
+    monkeypatch.setenv("HOROVOD_TIMELINE", path)
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    try:
+        xs = [np.full((16,), r + 1.0, np.float32)
+              for r in range(hvd.size())]
+        hvd.allreduce(xs, name="tl.allreduce")
+        hvd.allgather(xs[0] if hvd.size() == 1 else xs, name="tl.allgather")
+    finally:
+        hvd.shutdown()
+
+    assert os.path.isfile(path)
+    events = json.load(open(path))
+    assert isinstance(events, list) and events
+    # Chrome tracing event schema: ph/name/ts (+ pid) per event.
+    for ev in events:
+        assert "ph" in ev
+        if ev["ph"] in ("B", "E", "X", "i"):
+            assert "ts" in ev
+    names = {ev.get("name") for ev in events}
+    assert any(n and n.startswith("XLA_ALLREDUCE") for n in names), names
+    # Begin/End events must balance per (tid, name).
+    opens = {}
+    for ev in events:
+        key = (ev.get("tid"), ev.get("name"))
+        if ev["ph"] == "B":
+            opens[key] = opens.get(key, 0) + 1
+        elif ev["ph"] == "E":
+            opens[key] = opens.get(key, 0) - 1
+    assert all(v == 0 for v in opens.values()), opens
+
+
+def test_timeline_compile_activity(tmp_path, monkeypatch):
+    path = str(tmp_path / "timeline2.json")
+    monkeypatch.setenv("HOROVOD_TIMELINE", path)
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    try:
+        hvd.allreduce(
+            [np.ones((4, 4), np.float32) for _ in range(hvd.size())],
+            name="tl.compile.probe")
+    finally:
+        hvd.shutdown()
+
+    events = json.load(open(path))
+    names = {ev.get("name") for ev in events}
+    assert "COMPILE" in names or any(
+        n and n.startswith("XLA_") for n in names)
+
+
+def test_timeline_mark_cycles(tmp_path, monkeypatch):
+    path = str(tmp_path / "timeline3.json")
+    monkeypatch.setenv("HOROVOD_TIMELINE", path)
+    monkeypatch.setenv("HOROVOD_TIMELINE_MARK_CYCLES", "1")
+
+    from horovod_tpu.common.timeline import Timeline
+
+    tl = Timeline(path, mark_cycles=True)
+    tl.start_activity("t1", "NEGOTIATE_ALLREDUCE")
+    tl.end_activity("t1", "NEGOTIATE_ALLREDUCE")
+    tl.mark_cycle()
+    tl.close()
+    events = json.load(open(path))
+    assert any(ev.get("name") == "CYCLE" or "cycle" in
+               str(ev.get("name", "")).lower() for ev in events)

@@ -8,23 +8,149 @@ ResNet-101 tf_cnn_benchmarks number, 1656.82 images/sec on 16 Pascal GPUs
 = 103.55 img/s/device (``docs/benchmarks.rst:31-41``; BASELINE.md).
 
 Prints exactly one JSON line.
+
+Structure: a supervisor process (default entry) probes the accelerator
+backend in a bounded subprocess and then runs the actual benchmark in a
+worker subprocess with a hard timeout — the experimental TPU plugin has
+been observed to hang indefinitely at backend init, and an unbounded hang
+means no benchmark number at all. If the accelerator is unreachable the
+supervisor retries, then falls back to a reduced-size CPU run so a parsed
+number is always produced.
 """
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
 BASELINE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16.0
 
+PROBE_TIMEOUT_S = 150
+PROBE_ATTEMPTS = 2
+WORKER_TIMEOUT_S = 1200
+CPU_FALLBACK_TIMEOUT_S = 900
 
-def main():
+
+def _probe_backend(timeout_s):
+    """Initialize the default JAX backend in a throwaway subprocess.
+
+    Returns the platform name on success, None on failure/timeout. Keeps
+    backend hangs out of the supervisor process.
+    """
+    code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        print(f"bench: backend probe timed out after {timeout_s}s",
+              file=sys.stderr)
+        return None
+    for line in r.stdout.splitlines():
+        if line.startswith("PLATFORM="):
+            return line.split("=", 1)[1]
+    tail = (r.stderr or "").strip().splitlines()[-3:]
+    print("bench: backend probe failed rc=%d: %s" % (r.returncode, tail),
+          file=sys.stderr)
+    return None
+
+
+def _run_worker(extra_args, env, timeout_s):
+    """Run the benchmark worker; return its JSON line dict or None."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker"] + extra_args
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout_s, env=env)
+    except subprocess.TimeoutExpired:
+        print(f"bench: worker timed out after {timeout_s}s", file=sys.stderr)
+        return None
+    if r.stderr:
+        sys.stderr.write(r.stderr[-4000:])
+    for line in reversed(r.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    print(f"bench: worker rc={r.returncode}, no JSON line", file=sys.stderr)
+    return None
+
+
+def _build_parser():
     parser = argparse.ArgumentParser()
     parser.add_argument("--batch-size", type=int, default=32)
     parser.add_argument("--num-warmup", type=int, default=5)
     parser.add_argument("--num-iters", type=int, default=30)
     parser.add_argument("--image-size", type=int, default=224)
-    args = parser.parse_args()
+    return parser
+
+
+def supervise(argv):
+    args = _build_parser().parse_args(argv)
+
+    platform = None
+    for attempt in range(PROBE_ATTEMPTS):
+        platform = _probe_backend(PROBE_TIMEOUT_S)
+        if platform:
+            break
+        print(f"bench: probe attempt {attempt + 1}/{PROBE_ATTEMPTS} failed",
+              file=sys.stderr)
+
+    if platform == "cpu":
+        # No accelerator in this environment at all: skip the full-size
+        # attempt (ResNet-50/batch-32 on host CPU would only time out).
+        print("bench: backend is cpu-only; using reduced workload",
+              file=sys.stderr)
+        platform = None
+    elif platform is None:
+        print("bench: accelerator backend unreachable; falling back to CPU",
+              file=sys.stderr)
+    if platform:
+        worker_args = ["--batch-size", str(args.batch_size),
+                       "--num-warmup", str(args.num_warmup),
+                       "--num-iters", str(args.num_iters),
+                       "--image-size", str(args.image_size)]
+        result = _run_worker(worker_args, dict(os.environ), WORKER_TIMEOUT_S)
+        if result is not None:
+            result["platform"] = platform
+            print(json.dumps(result))
+            return 0
+        print("bench: accelerator worker failed; falling back to CPU",
+              file=sys.stderr)
+
+    # CPU fallback: tiny workload so it completes in bounded time, but the
+    # same train-step path so the number is honest (just small). Strip the
+    # accelerator plugin's activation var: its sitecustomize registration
+    # can hang `import jax` even under JAX_PLATFORMS=cpu when the device
+    # tunnel is wedged — which is exactly the situation this fallback
+    # exists for.
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    result = _run_worker(["--batch-size", "4", "--num-warmup", "1",
+                          "--num-iters", "2", "--image-size",
+                          str(args.image_size)], env,
+                         CPU_FALLBACK_TIMEOUT_S)
+    if result is not None:
+        result["platform"] = "cpu-fallback"
+        print(json.dumps(result))
+        return 0
+
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "images/sec/chip",
+        "vs_baseline": 0.0,
+        "error": "backend init failed on accelerator and CPU fallback",
+    }))
+    return 1
+
+
+def worker(argv):
+    args = _build_parser().parse_args(argv)
 
     import jax
     import jax.numpy as jnp
@@ -86,4 +212,6 @@ def main():
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        sys.exit(worker(sys.argv[2:]))
+    sys.exit(supervise(sys.argv[1:]))

@@ -153,13 +153,15 @@ def barrier():
 def join() -> int:
     """Graceful departure (parity: ``hvd.join()``, ``operations.cc:937-961``).
 
-    In SPMD mode every chip is driven by a live process, so join degenerates
-    to a barrier; returns the last joined participant id. Elastic mode uses
-    host-level membership instead (``horovod_tpu.elastic``).
+    A process that calls ``join()`` stops submitting tensors and contributes
+    zeros to the remaining processes' allreduces until every process has
+    joined (allgather/broadcast while a rank is joined raise an error, as in
+    the reference). Returns the last joined participant's global rank. In
+    single-controller SPMD mode every chip is driven by one live process, so
+    join degenerates to a barrier.
     """
-    _engine().barrier()
     st = _global_state()
-    st.last_joined = st.size - 1
+    st.last_joined = _engine().join()
     return st.last_joined
 
 

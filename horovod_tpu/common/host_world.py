@@ -286,6 +286,22 @@ class HostWorld:
             raise HorovodInternalError(err)
         return out
 
+    def join(self) -> int:
+        """Graceful departure (reference hvd.join, operations.cc:937-961):
+        this process stops submitting and contributes zeros to the others'
+        reductions until every process joins. Returns the last joined
+        rank."""
+        self.require_init()
+        if self.size == 1 or self._core is None:
+            return self.size - 1
+        h = self._core.join()
+        if h < 0:
+            raise HorovodInternalError("join enqueue failed")
+        r, err = self._core.wait(h)
+        if r < 0:
+            raise HorovodInternalError(err)
+        return self._core.last_joined()
+
     def barrier(self, name: str = "host.barrier"):
         self.require_init()
         if self.size == 1 or self._core is None:

@@ -102,6 +102,10 @@ def load_library():
     lib.hvd_register_exec_callback.restype = None
     lib.hvd_register_exec_callback.argtypes = [_EXEC_CB_TYPE]
     lib.hvd_pending_count.restype = ctypes.c_int
+    lib.hvd_join.restype = ctypes.c_longlong
+    lib.hvd_join.argtypes = []
+    lib.hvd_last_joined.restype = ctypes.c_int
+    lib.hvd_last_joined.argtypes = []
     lib.hvd_set_parameters.restype = None
     lib.hvd_set_parameters.argtypes = [ctypes.c_double, ctypes.c_longlong]
     lib.hvd_get_cycle_time_ms.restype = ctypes.c_double
@@ -249,6 +253,13 @@ class NativeCore:
 
     def pending_count(self) -> int:
         return int(self.lib.hvd_pending_count())
+
+    def join(self) -> int:
+        """Enqueue a JOIN; returns a handle resolved when all ranks join."""
+        return int(self.lib.hvd_join())
+
+    def last_joined(self) -> int:
+        return int(self.lib.hvd_last_joined())
 
     def set_parameters(self, cycle_time_ms: float = -1.0,
                        fusion_threshold: int = -1):

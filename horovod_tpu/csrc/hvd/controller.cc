@@ -142,6 +142,16 @@ std::vector<Response> LocalController::ComputeResponseList(
   std::vector<Response> singles;
   singles.reserve(reqs.size());
   for (auto& q : reqs) {
+    if (q.op == CollectiveOp::JOIN) {
+      // Single-process world: the only rank joined, so everyone has.
+      Response r;
+      r.op = CollectiveOp::JOIN;
+      r.root_rank = 0;
+      r.tensor_names = {kJoinTensorName};
+      r.shapes = {TensorShape()};
+      singles.push_back(std::move(r));
+      continue;
+    }
     Response r;
     std::vector<Request> group = {q};
     ValidateGroup(q.name, group, 1, &r);
@@ -154,6 +164,7 @@ std::vector<Response> LocalController::ComputeResponseList(
 
 Status TcpController::Initialize() {
   shutdown_ranks_.assign(cfg_.size, false);
+  joined_ranks_.assign(cfg_.size, false);
   stall_.Configure(cfg_.stall_warning_sec, cfg_.stall_shutdown_sec,
                    cfg_.size, cfg_.stall_check_enabled);
   if (cfg_.rank == 0) {
@@ -315,6 +326,13 @@ std::vector<Response> TcpController::CoordinatorCycle(
                        std::vector<uint32_t>&& ids, int default_rank) {
     for (auto& q : rs) {
       if (q.rank < 0 || q.rank >= cfg_.size) q.rank = default_rank;
+      if (q.op == CollectiveOp::JOIN) {
+        if (!joined_ranks_[q.rank]) {
+          joined_ranks_[q.rank] = true;
+          last_joined_ = q.rank;
+        }
+        continue;
+      }
       stall_.RecordRank(q.name, q.rank);
       auto& group = pending_[q.name];
       group.push_back(q);
@@ -349,17 +367,49 @@ std::vector<Response> TcpController::CoordinatorCycle(
     }
   }
 
-  // Ready = submitted by all non-departed ranks.
-  int live = 0;
+  // Ready = submitted by all non-departed, non-joined ranks (joined ranks'
+  // pre-join submissions still count toward the group, as in the
+  // reference's IncrementTensorCount with joined_size).
+  int live = 0, joined = 0;
   for (int r = 0; r < cfg_.size; ++r) {
-    if (!shutdown_ranks_[r]) ++live;
+    if (!shutdown_ranks_[r]) {
+      ++live;
+      if (joined_ranks_[r]) ++joined;
+    }
   }
+  int active = live - joined;
+  // Ready = every active rank has submitted this tensor. Counting group
+  // size alone would let a joined rank's pre-join submission stand in for
+  // a still-missing active rank and fire the collective early — the ring
+  // would then hang waiting for the rank that never got an entry.
+  auto all_active_submitted = [&](const std::vector<Request>& group) {
+    std::vector<bool> seen(cfg_.size, false);
+    for (const auto& q : group) seen[q.rank] = true;
+    for (int r = 0; r < cfg_.size; ++r) {
+      if (!shutdown_ranks_[r] && !joined_ranks_[r] && !seen[r]) return false;
+    }
+    return true;
+  };
   std::vector<Response> singles;
   std::vector<std::string> done;
   for (auto& kv : pending_) {
-    if (static_cast<int>(kv.second.size()) >= live && live > 0) {
+    if (active > 0 && all_active_submitted(kv.second)) {
       Response resp;
       ValidateGroup(kv.first, kv.second, cfg_.size, &resp);
+      if (joined > 0 && resp.error_reason.empty() &&
+          resp.op != CollectiveOp::ALLREDUCE &&
+          resp.op != CollectiveOp::BARRIER) {
+        // Joined ranks can only contribute zeros, which is meaningful for
+        // reductions alone (reference controller.cc:454-457,529-531).
+        resp.error_reason =
+            std::string(resp.op == CollectiveOp::ALLGATHER
+                            ? "Allgather"
+                            : resp.op == CollectiveOp::BROADCAST
+                                  ? "Broadcast"
+                                  : "This operation") +
+            " is not supported with Join at this time.";
+        resp.op = CollectiveOp::ERROR_OP;
+      }
       singles.push_back(std::move(resp));
       done.push_back(kv.first);
     }
@@ -382,6 +432,17 @@ std::vector<Response> TcpController::CoordinatorCycle(
   }
 
   auto fused = FuseResponses(std::move(singles), fusion_threshold());
+  if (live > 0 && joined == live) {
+    // Every live rank has joined: release them all and reset join state so
+    // training can resume (reference controller.cc:300-306).
+    Response jr;
+    jr.op = CollectiveOp::JOIN;
+    jr.root_rank = last_joined_;
+    jr.tensor_names = {kJoinTensorName};
+    jr.shapes = {TensorShape()};
+    fused.push_back(std::move(jr));
+    joined_ranks_.assign(cfg_.size, false);
+  }
   CacheResponses(fused);
 
   bool all_down = true;

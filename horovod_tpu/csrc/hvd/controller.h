@@ -134,9 +134,18 @@ class TcpController : public Controller {
   // Coordinator negotiation state: name -> per-rank requests seen so far.
   std::unordered_map<std::string, std::vector<Request>> pending_;
   std::vector<bool> shutdown_ranks_;
+  // Join state (reference controller.cc:219-230,289-306): ranks that called
+  // join() stop submitting; readiness counts only non-joined live ranks, and
+  // when every live rank has joined a JOIN response (root_rank = the rank
+  // that joined last) releases them all.
+  std::vector<bool> joined_ranks_;
+  int last_joined_ = -1;
   StallInspector stall_;
   ResponseCache cache_;  // symmetric ids on all ranks (see CacheResponses)
 };
+
+// Canonical name of the join sentinel entry (reference JOIN_TENSOR_NAME).
+inline const char* kJoinTensorName = "join.internal";
 
 }  // namespace hvd
 

@@ -83,6 +83,10 @@ struct GlobalState {
   int rank = 0, size = 1, local_rank = 0, local_size = 1;
   int cross_rank = 0, cross_size = 1;
   std::atomic<double> cycle_time_ms{5.0};
+  // Join state (reference HorovodGlobalState::joined): while set, this rank
+  // contributes zeros to other ranks' reductions instead of real tensors.
+  std::atomic<bool> joined{false};
+  std::atomic<int> last_joined{-1};
 
   std::unique_ptr<Controller> controller;
   std::unique_ptr<Ring> ring;
@@ -113,13 +117,23 @@ void ExecuteHostResponse(const Response& resp,
   Status st = Status::OK();
   switch (resp.op) {
     case CollectiveOp::ALLREDUCE: {
+      // Build the fused buffer in the response's canonical layout, which
+      // is identical on every rank. A joined rank may hold entries for
+      // only some (or none) of the fused tensors — its missing slots stay
+      // zero so ring transfer lengths agree across ranks (reference
+      // AllocateZeros join path, tensor_queue.cc:88-113).
       int64_t total = 0;
-      for (const auto& e : entries) total += e.request.shape.num_elements();
-      std::vector<char> fusion(total * es);
+      for (const auto& sh : resp.shapes) total += sh.num_elements();
+      std::vector<char> fusion(total * es, 0);
+      std::unordered_map<std::string, TensorTableEntry*> by_name;
+      for (auto& e : entries) by_name[e.name] = &e;
       int64_t off = 0;
-      for (const auto& e : entries) {
-        int64_t n = e.request.shape.num_elements() * es;
-        std::memcpy(fusion.data() + off, e.data, n);
+      for (size_t i = 0; i < resp.tensor_names.size(); ++i) {
+        int64_t n = resp.shapes[i].num_elements() * es;
+        auto it = by_name.find(resp.tensor_names[i]);
+        if (it != by_name.end()) {
+          std::memcpy(fusion.data() + off, it->second->data, n);
+        }
         off += n;
       }
       if (resp.reduce_op == ReduceOp::ADASUM) {
@@ -132,9 +146,14 @@ void ExecuteHostResponse(const Response& resp,
       }
       if (st.ok()) {
         off = 0;
-        for (auto& e : entries) {
-          int64_t n = e.request.shape.num_elements() * es;
-          std::memcpy(e.output ? e.output : e.data, fusion.data() + off, n);
+        for (size_t i = 0; i < resp.tensor_names.size(); ++i) {
+          int64_t n = resp.shapes[i].num_elements() * es;
+          auto it = by_name.find(resp.tensor_names[i]);
+          if (it != by_name.end()) {
+            TensorTableEntry* e = it->second;
+            std::memcpy(e->output ? e->output : e->data,
+                        fusion.data() + off, n);
+          }
           off += n;
         }
       }
@@ -175,6 +194,18 @@ void ExecuteHostResponse(const Response& resp,
 
 void PerformOperation(const Response& resp) {
   auto* s = g();
+  if (resp.op == CollectiveOp::JOIN) {
+    // All ranks have joined: resolve this rank's join sentinel and reset
+    // join state (reference JoinOp::Execute, collective_operations.cc:217).
+    s->last_joined.store(resp.root_rank);
+    s->joined.store(false);
+    auto entries = s->tensor_queue.GetTensorEntries({kJoinTensorName}, true);
+    for (auto& e : entries) {
+      s->handles.MarkDone(e.handle, Status::OK());
+      if (e.callback) e.callback(Status::OK());
+    }
+    return;
+  }
   if (!resp.error_reason.empty() || resp.op == CollectiveOp::ERROR_OP) {
     Status err = Status::PreconditionError(resp.error_reason);
     auto entries = s->tensor_queue.GetTensorEntries(resp.tensor_names, true);
@@ -185,7 +216,12 @@ void PerformOperation(const Response& resp) {
     return;
   }
   auto entries = s->tensor_queue.GetTensorEntries(resp.tensor_names, true);
-  if (entries.empty()) return;
+  // A joined rank may hold entries for some, none, or all of the fused
+  // tensors; it must still participate (with zeros for the missing slots)
+  // so the other ranks' collectives complete — reference
+  // tensor_queue.cc:88-113 AllocateZeros path. Both executors zero-fill
+  // missing slots from the response's canonical layout.
+  if (entries.empty() && !s->joined.load()) return;
   if (resp.plane == DevicePlane::HOST) {
     ExecuteHostResponse(resp, entries);
     return;
@@ -412,6 +448,33 @@ long long hvd_enqueue(const char* name, int op, int reduce_op, int dtype,
   }
   return h;
 }
+
+// Graceful departure (reference EnqueueJoin, operations.cc:937-961): this
+// rank stops submitting tensors and contributes zeros to the other ranks'
+// reductions until every rank has joined. Returns a handle that resolves
+// when all ranks have joined; hvd_last_joined() then reports the rank that
+// joined last.
+long long hvd_join() {
+  auto* s = hvd::g();
+  if (!s->initialized.load()) return -1;
+  hvd::TensorTableEntry e;
+  e.name = hvd::kJoinTensorName;
+  e.request.rank = s->rank;
+  e.request.op = hvd::CollectiveOp::JOIN;
+  e.request.plane = hvd::DevicePlane::HOST;
+  e.request.name = e.name;
+  e.handle = s->handles.NewHandle();
+  long long h = e.handle;
+  s->joined.store(true);
+  hvd::Status st = s->tensor_queue.AddToTensorQueue(std::move(e));
+  if (!st.ok()) {
+    s->joined.store(false);
+    s->handles.MarkDone(h, st);
+  }
+  return h;
+}
+
+int hvd_last_joined() { return hvd::g()->last_joined.load(); }
 
 // Poll: 0 pending, 1 done-ok, -1 done-error.
 int hvd_test(long long handle, char* err, int errlen) {

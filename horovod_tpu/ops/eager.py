@@ -56,6 +56,9 @@ _OP_TO_NATIVE = {
     "alltoall": _native.OP_ALLTOALL,
 }
 
+_DTYPE_FROM_CODE = {v: k for k, v in _native.DTYPE_CODES.items()}
+_KIND_FROM_OP = {v: k for k, v in _OP_TO_NATIVE.items()}
+
 
 def _shard_map(fn, mesh, in_specs, out_specs):
     # check_vma=False: collective outputs (e.g. all_gather) are replicated
@@ -103,6 +106,7 @@ class EagerEngine:
 
         self._core = _native.NativeCore()
         self._native = False
+        self._joined = False
         if self._core.available:
             self._exec_q: "queue.SimpleQueue" = queue.SimpleQueue()
             cfg = state.config
@@ -191,22 +195,37 @@ class EagerEngine:
     def _execute_response(self, resp: "_native.NativeResponse"):
         timeline = self._state.timeline
         names = resp.names
-        entries = [self._pending[n] for n in names if n in self._pending]
-        if not entries:
+        found = {n: self._pending[n] for n in names if n in self._pending}
+        entries = list(found.values())
+        if not entries and not self._joined:
             return
-        kind = entries[0].kind
+        kind = _KIND_FROM_OP.get(resp.op)
+        if kind is None:
+            return
         if timeline:
-            for n in names:
+            for n in found:
                 timeline.end_activity(n, f"NEGOTIATE_{kind.upper()}")
                 timeline.start_activity(n, f"XLA_{kind.upper()}")
         if kind == "allreduce":
-            stacks = [p.stacked for p in entries]
+            # Build stacks in the response's canonical order. A joined
+            # process may hold entries for only some (or none) of the fused
+            # tensors; zero stacks stand in for the rest so every process
+            # compiles and runs the same SPMD program (reference
+            # tensor_queue.cc:88-113 AllocateZeros join path).
+            dtype = _DTYPE_FROM_CODE.get(resp.dtype, "float32")
+            L = self._state.local_size
+            stacks = [
+                found[n].stacked if n in found
+                else jnp.zeros((L,) + tuple(resp.shapes[i]), dtype=dtype)
+                for i, n in enumerate(names)
+            ]
             results = self._exec_grouped_allreduce(
-                stacks, entries[0].op, entries[0].prescale,
-                entries[0].postscale)
-            for p, r in zip(entries, results):
-                p.result = self._from_global_sharded(
-                    r, p.was_list, p.was_unstacked)
+                stacks, resp.reduce_op, resp.prescale, resp.postscale)
+            for n, r in zip(names, results):
+                p = found.get(n)
+                if p is not None:
+                    p.result = self._from_global_sharded(
+                        r, p.was_list, p.was_unstacked)
         elif kind == "allgather":
             for p in entries:
                 out = self._exec_allgather(p.stacked)
@@ -229,7 +248,7 @@ class EagerEngine:
         else:
             raise ValueError(f"unknown response kind {kind}")
         if timeline:
-            for n in names:
+            for n in found:
                 timeline.end_activity(n, f"XLA_{kind.upper()}")
         self._record_autotune([p.stacked for p in entries])
 
@@ -527,7 +546,52 @@ class EagerEngine:
             raise ValueError("alltoall requires dim 0 divisible by size")
         return self._submit("alltoall", name, stacked, wl, wu)
 
+    def join(self) -> int:
+        """Graceful departure (parity: hvd.join(), operations.cc:937-961).
+
+        Blocks until every process has joined; while waiting, this process
+        contributes zeros to the other processes' reductions (host plane in
+        C++, XLA plane via the zero-fill branch of ``_execute_response``).
+        Returns the global rank of the last participant to join.
+        """
+        st = self._state
+        if not self._native or st.process_count == 1:
+            # Single controller (or direct mode): every rank this process
+            # drives joins at once, so join degenerates to a barrier.
+            self.barrier()
+            return st.size - 1
+        self._joined = True
+        try:
+            handle = self._core.join()
+            if handle < 0:
+                raise HorovodInternalError("join enqueue failed")
+            r, reason = self._core.wait(handle)
+            if r < 0:
+                raise HorovodInternalError(reason)
+        finally:
+            self._joined = False
+        # last_joined is a process index; report the last global rank that
+        # process drives (== the process rank when local_size == 1).
+        p = self._core.last_joined()
+        return (p + 1) * st.local_size - 1
+
     def barrier(self):
+        if self._native and self._state.process_count > 1:
+            # Negotiated control-plane barrier: completes among active
+            # ranks even while another process is blocked in join() (a
+            # direct SPMD program would wait forever for the joined
+            # process to launch it).
+            z = np.zeros(1, np.uint8)
+            h = self._core.enqueue(
+                self._auto_name("eager.barrier"), _native.OP_BARRIER, 1, 0,
+                tuple(z.shape), data_ptr=z.ctypes.data,
+                output_ptr=z.ctypes.data, plane=_native.PLANE_HOST)
+            if h < 0:
+                raise HorovodInternalError("barrier enqueue failed")
+            r, reason = self._core.wait(h)
+            if r < 0:
+                raise HorovodInternalError(reason)
+            return
         key = ("barrier",)
         mesh = self._mesh
 

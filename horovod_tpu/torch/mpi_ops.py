@@ -434,11 +434,11 @@ def barrier():
 
 
 def join(device: int = -1) -> int:
-    """Graceful departure. Every live process reaches the same cycle; with
-    process-rank membership handled by the elastic layer, join degenerates
-    to a barrier (see ``horovod_tpu/__init__.py:join``). ``device`` is
-    accepted for API parity and ignored (host plane). A collective failure
-    propagates as ``HorovodInternalError`` so the elastic retry loop can
-    restore + reinit."""
-    barrier()
-    return _world().size - 1
+    """Graceful departure (parity: ``hvd.join()``, ``operations.cc:937-961``):
+    this process stops submitting tensors and contributes zeros to the
+    remaining processes' allreduces until every process has joined; returns
+    the last joined rank. ``device`` is accepted for API parity and ignored
+    (host plane). A collective failure propagates as
+    ``HorovodInternalError`` so the elastic retry loop can restore +
+    reinit."""
+    return _world().join()

@@ -185,6 +185,104 @@ def test_multiprocess_tcp_controller_and_ring(size, tmp_path):
         assert f"WORKER_{r}_OK" in out, out
 
 
+_JOIN_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    from horovod_tpu.common import native as hn
+
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    core = hn.NativeCore()
+    assert core.init(rank=rank, size=2, local_rank=0, local_size=1,
+        cross_rank=rank, cross_size=2, coordinator_addr="127.0.0.1",
+        coordinator_port=port, my_host="127.0.0.1", cycle_time_ms=1.0,
+        fusion_threshold=64 << 20, cache_capacity=64,
+        stall_warning_sec=60.0, stall_shutdown_sec=0.0,
+        stall_check_enabled=True,
+        exec_callback=lambda r, i: core.response_done(i, False, "n/a"))
+
+    # Two steps with both ranks participating.
+    for i in range(2):
+        x = np.full(4, float(rank + 1), np.float32)
+        h = core.enqueue(f"j.{i}", hn.OP_ALLREDUCE, 1, 7, x.shape,
+                         data_ptr=x.ctypes.data, output_ptr=x.ctypes.data,
+                         plane=hn.PLANE_HOST)
+        r, err = core.wait(h); assert r == 1, err
+        assert np.allclose(x, 3.0), x
+
+    # In-flight pre-join submission: rank 1 enqueues a tensor and joins
+    # WITHOUT synchronizing (the reference supports outstanding ops across
+    # join). The collective must wait for rank 0's matching submission and
+    # carry rank 1's real data, not fire early or zero-fill.
+    y = np.full(4, float(rank + 1), np.float32)
+    if rank == 1:
+        hy = core.enqueue("j.late", hn.OP_ALLREDUCE, 1, 7, y.shape,
+                          data_ptr=y.ctypes.data, output_ptr=y.ctypes.data,
+                          plane=hn.PLANE_HOST)
+        # Depart early: block in join() while rank 0 keeps reducing.
+        jh = core.join()
+        r, err = core.wait(jh); assert r == 1, err
+        r, err = core.wait(hy); assert r == 1, err
+        assert np.allclose(y, 3.0), y
+    else:
+        import time
+        time.sleep(0.3)  # let rank 1's submission + join land first
+        hy = core.enqueue("j.late", hn.OP_ALLREDUCE, 1, 7, y.shape,
+                          data_ptr=y.ctypes.data, output_ptr=y.ctypes.data,
+                          plane=hn.PLANE_HOST)
+        r, err = core.wait(hy); assert r == 1, err
+        assert np.allclose(y, 3.0), y
+        # Rank 0 runs five more allreduces to completion; the joined rank
+        # contributes zeros (reference JoinOp semantics).
+        for i in range(2, 7):
+            x = np.full(4, 5.0, np.float32)
+            h = core.enqueue(f"j.{i}", hn.OP_ALLREDUCE, 1, 7, x.shape,
+                             data_ptr=x.ctypes.data,
+                             output_ptr=x.ctypes.data, plane=hn.PLANE_HOST)
+            r, err = core.wait(h); assert r == 1, err
+            assert np.allclose(x, 5.0), x  # 5.0 + rank1's zeros
+        # Allgather while a rank is joined must error loudly.
+        d = np.ones(3, np.float32); out = np.zeros(6, np.float32)
+        h = core.enqueue("j.ag", hn.OP_ALLGATHER, 1, 7, d.shape,
+                         data_ptr=d.ctypes.data, output_ptr=out.ctypes.data,
+                         plane=hn.PLANE_HOST)
+        r, err = core.wait(h)
+        assert r == -1 and "not supported with Join" in err, (r, err)
+        jh = core.join()
+        r, err = core.wait(jh); assert r == 1, err
+    # Rank 0 joined last on both sides' view.
+    assert core.last_joined() == 0, core.last_joined()
+    core.shutdown()
+    print(f"JOIN_{rank}_OK")
+""")
+
+
+def test_join_zero_contribution_two_process(tmp_path):
+    """Rank 1 joins after 2 steps; rank 0 completes 5 more allreduces with
+    rank 1 contributing zeros, then joins. Parity: reference
+    operations.cc:937-961, controller.cc:219-230,289-306."""
+    port = _free_port()
+    script = tmp_path / "join_worker.py"
+    script.write_text(_JOIN_WORKER)
+    env = dict(os.environ)
+    env["HVD_REPO"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(r), str(port)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for r in range(2)]
+    for r, p in enumerate(procs):
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"JOIN_{r}_OK" in out, out
+
+
+def test_join_single_process(hvd):
+    # Single-controller SPMD world: join degenerates to a barrier and
+    # reports the last participant.
+    assert hvd.join() == hvd.size() - 1
+
+
 def test_ragged_host_allgather_rejected(tmp_path):
     # Ranks submit allgathers with differing first dimensions: the
     # coordinator must deliver a loud validation error, not mis-index.

@@ -270,6 +270,41 @@ class HostWorld:
             raise HorovodInternalError(err)
         return out
 
+    def result_fetch(self, handle: int):
+        """Fetch an executor-allocated result (see NativeCore.result_fetch)."""
+        core = self._core
+        if core is None:
+            raise HorovodInternalError(
+                "native host plane unavailable (shut down?)")
+        return core.result_fetch(handle)
+
+    def allgatherv_np(self, arr: np.ndarray, name: str):
+        """Ragged allgather (MPI_Allgatherv semantics, reference
+        ``ops/mpi_operations.cc:140-175``): per-rank dim-0 sizes may
+        differ. Returns (concatenated array, per-rank sizes). The native
+        executor allocates the output once the response's per-rank dims
+        arrive — no size pre-exchange, no padding."""
+        self.require_init()
+        arr = np.ascontiguousarray(arr)
+        if arr.ndim == 0:
+            arr = arr.reshape(1)
+        if self.size == 1 or self._core is None:
+            return arr.copy(), np.asarray([arr.shape[0]], np.int64)
+        code = NUMPY_DTYPE_CODES[str(arr.dtype)]
+        h = self.enqueue(name, _native.OP_ALLGATHER, 1, code, arr.shape,
+                         arr.ctypes.data, 0)
+        r, err = self.wait(h)
+        if r < 0:
+            raise HorovodInternalError(err)
+        fetched = self._core.result_fetch(h)
+        if fetched is None:
+            raise HorovodInternalError(
+                f"allgather result missing for '{name}'")
+        raw, dims = fetched
+        out = np.frombuffer(bytearray(raw), dtype=arr.dtype).reshape(
+            (int(sum(dims)),) + arr.shape[1:])
+        return out, np.asarray(dims, np.int64)
+
     def broadcast_np(self, arr: np.ndarray, root_rank: int,
                      name: str) -> np.ndarray:
         self.require_init()

@@ -106,6 +106,15 @@ def load_library():
     lib.hvd_join.argtypes = []
     lib.hvd_last_joined.restype = ctypes.c_int
     lib.hvd_last_joined.argtypes = []
+    lib.hvd_result_bytes.restype = ctypes.c_longlong
+    lib.hvd_result_bytes.argtypes = [ctypes.c_longlong]
+    lib.hvd_result_dims.restype = ctypes.c_int
+    lib.hvd_result_dims.argtypes = [ctypes.c_longlong,
+                                    ctypes.POINTER(ctypes.c_longlong),
+                                    ctypes.c_int]
+    lib.hvd_result_fetch.restype = ctypes.c_int
+    lib.hvd_result_fetch.argtypes = [ctypes.c_longlong, ctypes.c_void_p,
+                                     ctypes.c_longlong]
     lib.hvd_set_parameters.restype = None
     lib.hvd_set_parameters.argtypes = [ctypes.c_double, ctypes.c_longlong]
     lib.hvd_get_cycle_time_ms.restype = ctypes.c_double
@@ -129,6 +138,8 @@ class NativeResponse:
     postscale: float
     names: List[str] = field(default_factory=list)
     shapes: List[Tuple[int, ...]] = field(default_factory=list)
+    # allgather only: per-tensor per-rank first-dim sizes (ragged support)
+    first_dims: List[Tuple[int, ...]] = field(default_factory=list)
 
 
 class _Cursor:
@@ -175,6 +186,9 @@ def parse_response_list(data: bytes) -> List[NativeResponse]:
             r.names.append(c.s())
             ndim = c.i32()
             r.shapes.append(tuple(c.i64() for _ in range(ndim)))
+        for _ in range(c.i32()):
+            nr = c.i32()
+            r.first_dims.append(tuple(c.i64() for _ in range(nr)))
         out.append(r)
     return out
 
@@ -257,6 +271,23 @@ class NativeCore:
     def join(self) -> int:
         """Enqueue a JOIN; returns a handle resolved when all ranks join."""
         return int(self.lib.hvd_join())
+
+    def result_fetch(self, handle: int):
+        """Fetch an executor-allocated result (ragged allgather): returns
+        (bytes, per_rank_first_dims) and erases the stored buffer, or None
+        if the handle has no stored result."""
+        n = int(self.lib.hvd_result_bytes(handle))
+        if n < 0:
+            return None
+        ndims = int(self.lib.hvd_result_dims(handle, None, 0))
+        dims = (ctypes.c_longlong * max(ndims, 1))()
+        if ndims > 0:
+            self.lib.hvd_result_dims(handle, dims, ndims)
+        buf = ctypes.create_string_buffer(max(n, 1))
+        rc = int(self.lib.hvd_result_fetch(handle, buf, n))
+        if rc != 1:
+            return None
+        return bytes(buf.raw[:n]), tuple(int(dims[i]) for i in range(ndims))
 
     def last_joined(self) -> int:
         return int(self.lib.hvd_last_joined())

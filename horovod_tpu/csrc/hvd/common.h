@@ -170,6 +170,11 @@ struct Response {
   double prescale = 1.0;
   double postscale = 1.0;
   std::string error_reason;  // non-empty => ERROR_OP delivery
+  // ALLGATHER only: per-tensor, per-rank first-dimension sizes (the
+  // reference Response's tensor_sizes, message.h:companion of
+  // SetDisplacements) — lets ranks gather ragged tensors with displacement
+  // math and size their outputs without a separate size exchange.
+  std::vector<std::vector<int64_t>> first_dims;
   int64_t total_bytes() const {
     int64_t n = 0;
     for (const auto& s : shapes) n += s.num_elements();

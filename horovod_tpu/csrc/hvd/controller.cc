@@ -50,17 +50,11 @@ bool Controller::ValidateGroup(const std::string& name,
           break;
         }
       }
-      // The host ring executor requires equal element counts per rank;
-      // ragged first dimensions would silently mis-index its output, so
-      // reject them loudly (XLA-plane allgatherv support is the same
-      // restriction lax.all_gather has today).
-      if (error.empty() && first.plane == DevicePlane::HOST &&
-          r.shape.ndim() > 0 && r.shape.dim(0) != first.shape.dim(0)) {
-        error = "Host-plane allgather requires equal first dimensions for "
-                "tensor '" + name + "' (got " + first.shape.DebugString() +
-                " vs " + r.shape.DebugString() + ")";
-      }
       if (!error.empty()) break;
+      // First dimensions may differ (ragged allgather): per-rank sizes are
+      // published in the response's first_dims (reference
+      // SetDisplacements / MPI_Allgatherv, ops/collective_operations.cc,
+      // ops/mpi_operations.cc:140-175).
     }
     if (first.op == CollectiveOp::BROADCAST &&
         r.root_rank != first.root_rank) {
@@ -84,6 +78,15 @@ bool Controller::ValidateGroup(const std::string& name,
     }
   }
 
+  if (error.empty() && first.op == CollectiveOp::ALLGATHER &&
+      first.plane == DevicePlane::HOST && first.shape.ndim() == 0) {
+    // Parity with the reference's rank-zero allgather rejection
+    // (controller.cc:468-472); the XLA plane accepts 0-d (stacked eager
+    // convention gathers scalars into a vector).
+    error = "Rank zero tried to allgather a rank-zero tensor for '" + name +
+            "'.";
+  }
+
   out->op = first.op;
   out->reduce_op = first.reduce_op;
   out->dtype = first.dtype;
@@ -93,6 +96,23 @@ bool Controller::ValidateGroup(const std::string& name,
   out->postscale = first.postscale;
   out->tensor_names = {name};
   out->shapes = {first.shape};
+  if (error.empty() && first.op == CollectiveOp::ALLGATHER) {
+    // Publish per-rank first-dim sizes so every rank can size outputs and
+    // use displacement math without a separate exchange. Ranks absent
+    // from the group (world_size > group, e.g. a single-controller world)
+    // default to this rank's own size. Exactly one inner vector per tensor
+    // (empty for 0-d) so fused responses stay index-aligned with
+    // tensor_names.
+    if (first.shape.ndim() == 0) {
+      out->first_dims = {std::vector<int64_t>{}};
+    } else {
+      std::vector<int64_t> fd(world_size, first.shape.dim(0));
+      for (const auto& q : group) {
+        if (q.rank >= 0 && q.rank < world_size) fd[q.rank] = q.shape.dim(0);
+      }
+      out->first_dims = {std::move(fd)};
+    }
+  }
   if (!error.empty()) {
     out->error_reason = error;
     out->op = CollectiveOp::ERROR_OP;
@@ -124,6 +144,9 @@ std::vector<Response> Controller::FuseResponses(std::vector<Response> singles,
           f.total_bytes() + r.total_bytes() <= threshold_bytes) {
         f.tensor_names.push_back(std::move(r.tensor_names[0]));
         f.shapes.push_back(std::move(r.shapes[0]));
+        if (!r.first_dims.empty()) {
+          f.first_dims.push_back(std::move(r.first_dims[0]));
+        }
         merged = true;
         break;
       }

@@ -117,9 +117,16 @@ bool DeserializeRequestList(const std::string& bytes,
   return r.ok();
 }
 
-std::string SerializeResponseList(const std::vector<Response>& resps) {
+std::string SerializeResponseList(const std::vector<Response>& resps,
+                                  double cycle_time_ms,
+                                  int64_t fusion_threshold) {
   Writer w;
   w.u8(kResponseMagic);
+  // Tuned-parameter piggyback (reference SynchronizeParameters,
+  // controller.cc:33-47): the coordinator's current cycle time and fusion
+  // threshold ride every response broadcast; -1 = no hint.
+  w.f64(cycle_time_ms);
+  w.i64(fusion_threshold);
   w.i32(static_cast<int32_t>(resps.size()));
   for (const auto& p : resps) {
     w.u8(static_cast<uint8_t>(p.op));
@@ -135,14 +142,25 @@ std::string SerializeResponseList(const std::vector<Response>& resps) {
       w.str(p.tensor_names[i]);
       WriteShape(&w, p.shapes[i]);
     }
+    w.i32(static_cast<int32_t>(p.first_dims.size()));
+    for (const auto& fd : p.first_dims) {
+      w.i32(static_cast<int32_t>(fd.size()));
+      for (auto d : fd) w.i64(d);
+    }
   }
   return w.data();
 }
 
 bool DeserializeResponseList(const std::string& bytes,
-                             std::vector<Response>* resps) {
+                             std::vector<Response>* resps,
+                             double* cycle_time_ms,
+                             int64_t* fusion_threshold) {
   Reader r(bytes);
   if (r.u8() != kResponseMagic) return false;
+  double cyc = r.f64();
+  int64_t fus = r.i64();
+  if (cycle_time_ms != nullptr) *cycle_time_ms = cyc;
+  if (fusion_threshold != nullptr) *fusion_threshold = fus;
   int32_t n = r.i32();
   if (n < 0 || n > (1 << 24)) return false;
   resps->clear();
@@ -162,6 +180,16 @@ bool DeserializeResponseList(const std::string& bytes,
     for (int t = 0; t < nt; ++t) {
       p.tensor_names.push_back(r.str());
       p.shapes.push_back(ReadShape(&r));
+    }
+    int32_t nf = r.i32();
+    if (nf < 0 || nf > (1 << 24)) return false;
+    for (int f = 0; f < nf; ++f) {
+      int32_t nr = r.i32();
+      if (nr < 0 || nr > (1 << 24)) return false;
+      std::vector<int64_t> fd;
+      fd.reserve(nr);
+      for (int k = 0; k < nr; ++k) fd.push_back(r.i64());
+      p.first_dims.push_back(std::move(fd));
     }
     resps->push_back(std::move(p));
   }

@@ -78,9 +78,16 @@ bool DeserializeRequestList(const std::string& bytes,
                             std::vector<uint32_t>* cached_ids,
                             bool* shutdown);
 
-std::string SerializeResponseList(const std::vector<Response>& resps);
+// cycle_time_ms / fusion_threshold piggyback the coordinator's tuned
+// parameters on the broadcast (reference Controller::SynchronizeParameters,
+// controller.cc:33-47); -1 = no hint.
+std::string SerializeResponseList(const std::vector<Response>& resps,
+                                  double cycle_time_ms = -1.0,
+                                  int64_t fusion_threshold = -1);
 bool DeserializeResponseList(const std::string& bytes,
-                             std::vector<Response>* resps);
+                             std::vector<Response>* resps,
+                             double* cycle_time_ms = nullptr,
+                             int64_t* fusion_threshold = nullptr);
 
 }  // namespace hvd
 

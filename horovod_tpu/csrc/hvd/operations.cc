@@ -74,6 +74,16 @@ struct HandleTable {
   }
 };
 
+// Executor-allocated collective result (ragged allgather): the output size
+// is only known once the response's per-rank dims arrive, so the executor
+// allocates and the caller fetches by handle after the wait resolves —
+// the role of the reference's framework allocation callbacks
+// (ops/collective_operations.cc AllocateOutput).
+struct ResultBuffer {
+  std::vector<char> bytes;
+  std::vector<int64_t> first_dims;
+};
+
 struct GlobalState {
   std::mutex init_mu;
   std::atomic<bool> initialized{false};
@@ -100,6 +110,10 @@ struct GlobalState {
   std::mutex inflight_mu;
   std::unordered_map<long, std::vector<TensorTableEntry>> inflight;
   std::atomic<long> next_response_id{1};
+
+  // executor-allocated results, keyed by handle (fetched then erased)
+  std::mutex results_mu;
+  std::unordered_map<int64_t, ResultBuffer> results;
 };
 
 GlobalState* g() {
@@ -160,9 +174,50 @@ void ExecuteHostResponse(const Response& resp,
       break;
     }
     case CollectiveOp::ALLGATHER: {
-      for (auto& e : entries) {
-        int64_t n = e.request.shape.num_elements();
-        st = s->ring->Allgather(e.data, e.output, n, resp.dtype);
+      std::unordered_map<std::string, TensorTableEntry*> by_name;
+      for (auto& e : entries) by_name[e.name] = &e;
+      for (size_t i = 0; i < resp.tensor_names.size(); ++i) {
+        auto it = by_name.find(resp.tensor_names[i]);
+        if (it == by_name.end()) continue;
+        TensorTableEntry& e = *it->second;
+        const TensorShape& sh = e.request.shape;
+        int64_t trailing = 1;
+        for (int d = 1; d < sh.ndim(); ++d) trailing *= sh.dim(d);
+        // Per-rank element counts from the response's first_dims (ragged
+        // allgatherv); equal counts when absent.
+        std::vector<int64_t> counts;
+        const std::vector<int64_t>* fd =
+            (i < resp.first_dims.size() && !resp.first_dims[i].empty())
+                ? &resp.first_dims[i]
+                : nullptr;
+        if (fd != nullptr) {
+          counts.reserve(fd->size());
+          for (auto d : *fd) counts.push_back(d * trailing);
+        } else {
+          counts.assign(s->ring->size(), sh.num_elements());
+        }
+        if (e.output != nullptr) {
+          // Caller-preallocated output (equal-shape fast path).
+          st = s->ring->Allgatherv(e.data, e.output, counts, resp.dtype);
+        } else {
+          // Ragged path: executor allocates; caller fetches by handle
+          // after the wait resolves.
+          int64_t total = 0;
+          for (auto c : counts) total += c;
+          ResultBuffer rb;
+          rb.bytes.resize(total * es);
+          rb.first_dims =
+              fd != nullptr
+                  ? *fd
+                  : std::vector<int64_t>(counts.size(),
+                                         sh.ndim() > 0 ? sh.dim(0) : 1);
+          st = s->ring->Allgatherv(e.data, rb.bytes.data(), counts,
+                                   resp.dtype);
+          if (st.ok()) {
+            std::lock_guard<std::mutex> lk(s->results_mu);
+            s->results[e.handle] = std::move(rb);
+          }
+        }
         if (!st.ok()) break;
       }
       break;
@@ -376,6 +431,10 @@ void hvd_shutdown() {
     }
     s->inflight.clear();
   }
+  {
+    std::lock_guard<std::mutex> rlk(s->results_mu);
+    s->results.clear();
+  }
 }
 
 // Autotuner hook: adjust the cycle time / fusion threshold of a running
@@ -447,6 +506,41 @@ long long hvd_enqueue(const char* name, int op, int reduce_op, int dtype,
     s->handles.MarkDone(h, st);
   }
   return h;
+}
+
+// Executor-allocated result access (ragged allgather): after hvd_wait
+// resolves a handle, the result's byte size, per-rank first dims, and
+// payload are fetched here. hvd_result_fetch erases the stored buffer.
+long long hvd_result_bytes(long long handle) {
+  auto* s = hvd::g();
+  std::lock_guard<std::mutex> lk(s->results_mu);
+  auto it = s->results.find(handle);
+  return it == s->results.end()
+             ? -1
+             : static_cast<long long>(it->second.bytes.size());
+}
+
+int hvd_result_dims(long long handle, long long* dims, int cap) {
+  auto* s = hvd::g();
+  std::lock_guard<std::mutex> lk(s->results_mu);
+  auto it = s->results.find(handle);
+  if (it == s->results.end()) return -1;
+  int n = static_cast<int>(it->second.first_dims.size());
+  for (int i = 0; i < n && i < cap; ++i) {
+    dims[i] = static_cast<long long>(it->second.first_dims[i]);
+  }
+  return n;
+}
+
+int hvd_result_fetch(long long handle, void* dst, long long cap) {
+  auto* s = hvd::g();
+  std::lock_guard<std::mutex> lk(s->results_mu);
+  auto it = s->results.find(handle);
+  if (it == s->results.end()) return -1;
+  if (static_cast<long long>(it->second.bytes.size()) > cap) return -2;
+  std::memcpy(dst, it->second.bytes.data(), it->second.bytes.size());
+  s->results.erase(it);
+  return 1;
 }
 
 // Graceful departure (reference EnqueueJoin, operations.cc:937-961): this

@@ -287,15 +287,26 @@ Status Ring::Allreduce(void* data, void* output, int64_t count, DataType dtype,
 
 Status Ring::Allgather(const void* data, void* output, int64_t count,
                        DataType dtype) {
+  return Allgatherv(data, output, std::vector<int64_t>(size_, count), dtype);
+}
+
+Status Ring::Allgatherv(const void* data, void* output,
+                        const std::vector<int64_t>& counts, DataType dtype) {
+  if (static_cast<int>(counts.size()) != size_) {
+    return Status::InvalidArgument("allgatherv counts/world size mismatch");
+  }
   int es = DataTypeSize(dtype);
-  std::memcpy(static_cast<char*>(output) + rank_ * count * es, data,
-              count * es);
+  // Displacements: rank r's block starts at the sum of earlier ranks'
+  // counts (reference SetDisplacements, ops/collective_operations.cc).
+  std::vector<int64_t> disp(size_ + 1, 0);
+  for (int r = 0; r < size_; ++r) disp[r + 1] = disp[r] + counts[r] * es;
+  char* out = static_cast<char*>(output);
+  std::memcpy(out + disp[rank_], data, counts[rank_] * es);
   for (int step = 0; step < size_ - 1; ++step) {
     int send_c = ((rank_ - step) % size_ + size_) % size_;
     int recv_c = ((rank_ - step - 1) % size_ + size_) % size_;
-    char* sp = static_cast<char*>(output) + send_c * count * es;
-    char* rp = static_cast<char*>(output) + recv_c * count * es;
-    if (!SendRecvStep(sp, count * es, rp, count * es)) {
+    if (!SendRecvStep(out + disp[send_c], counts[send_c] * es,
+                      out + disp[recv_c], counts[recv_c] * es)) {
       return Status::Aborted("ring allgather communication failure");
     }
   }

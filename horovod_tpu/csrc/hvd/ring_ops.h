@@ -37,6 +37,11 @@ class Ring {
                    ReduceOp op, double prescale, double postscale);
   Status Allgather(const void* data, void* output, int64_t count,
                    DataType dtype);  // equal-count per rank
+  // Ragged allgather: counts[r] elements contributed by rank r, laid out
+  // back-to-back in `output` by rank (MPI_Allgatherv displacement
+  // semantics, reference ops/mpi_operations.cc:140-175).
+  Status Allgatherv(const void* data, void* output,
+                    const std::vector<int64_t>& counts, DataType dtype);
   Status Broadcast(void* data, int64_t count, DataType dtype, int root);
   Status AdasumAllreduce(void* data, void* output, int64_t count,
                          DataType dtype);

@@ -227,9 +227,31 @@ class EagerEngine:
                     p.result = self._from_global_sharded(
                         r, p.was_list, p.was_unstacked)
         elif kind == "allgather":
-            for p in entries:
-                out = self._exec_allgather(p.stacked)
-                p.result = np.asarray(out)
+            L = self._state.local_size
+            size = self._state.size
+            for i, n in enumerate(names):
+                p = found.get(n)
+                if p is None:
+                    continue
+                fd = (resp.first_dims[i]
+                      if i < len(resp.first_dims) else ())
+                if fd and len(set(fd)) > 1:
+                    # Ragged across processes: every process pads its
+                    # stack to the global max (so all compile the same
+                    # program), gathers, then slices per the response's
+                    # per-rank dims (the NCCL unequal-shape fallback's
+                    # pad+slice, nccl_operations.cc:402-523).
+                    max0 = max(fd)
+                    pad = [(0, 0), (0, max0 - p.stacked.shape[1])] + \
+                        [(0, 0)] * (p.stacked.ndim - 2)
+                    out = np.asarray(
+                        self._exec_allgather(jnp.pad(p.stacked, pad)))
+                    views = out.reshape((size, max0) + out.shape[1:])
+                    p.result = np.concatenate(
+                        [views[c, : fd[c // L]] for c in range(size)],
+                        axis=0)
+                else:
+                    p.result = np.asarray(self._exec_allgather(p.stacked))
         elif kind == "broadcast":
             for p in entries:
                 out = self._exec_broadcast(p.stacked, p.root)
@@ -522,8 +544,45 @@ class EagerEngine:
                                        post if err is None else None, name)
 
     def allgather_async(self, tensor, name: Optional[str] = None) -> int:
+        if isinstance(tensor, (list, tuple)) and \
+                len(tensor) == self._state.local_size:
+            ts = [jnp.asarray(t) for t in tensor]
+            if all(t.ndim > 0 for t in ts) and \
+                    len({t.shape[0] for t in ts}) > 1:
+                # Ragged across locally-driven chips: per-chip sizes are
+                # all local knowledge, so pad+gather+slice runs directly
+                # (parity: MPI_Allgatherv, mpi_operations.cc:140-175).
+                if self._state.process_count > 1:
+                    raise ValueError(
+                        "ragged allgather with multiple local chips per "
+                        "process is not supported across processes; use "
+                        "one chip per process or equal first dimensions")
+                return self._ragged_local_allgather(ts, name)
         stacked, wl, wu = self._normalize(tensor)
         return self._submit("allgather", name, stacked, wl, wu)
+
+    def _ragged_local_allgather(self, ts: List, name: Optional[str]) -> int:
+        name = name or self._auto_name("allgather")
+        self._check_direct_duplicate(name)
+        sizes = [t.shape[0] for t in ts]
+        max0 = max(sizes)
+        padded = jnp.stack([
+            jnp.pad(t, [(0, max0 - t.shape[0])] + [(0, 0)] * (t.ndim - 1))
+            for t in ts])
+        try:
+            out = self._exec_allgather(padded)
+            err = None
+        except Exception as e:
+            out, err = None, e
+
+        def post(a):
+            a = np.asarray(a)
+            views = a.reshape((len(ts), max0) + a.shape[1:])
+            return np.concatenate(
+                [views[i, : sizes[i]] for i in range(len(ts))], axis=0)
+
+        return self._new_direct_handle(out if err is None else err,
+                                       post if err is None else None, name)
 
     def broadcast_async(self, tensor, root_rank: int,
                         name: Optional[str] = None) -> int:

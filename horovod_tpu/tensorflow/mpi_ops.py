@@ -156,32 +156,11 @@ def _np_allreduce(arr: np.ndarray, name: str, op: int, prescale: float,
 
 def _np_allgather(arr: np.ndarray, name: str) -> np.ndarray:
     """Ragged-dim-0 allgather (parity: MPI_Allgatherv semantics,
-    ``mpi_operations.cc:140``): exchange dim-0 sizes, pad, gather, slice."""
-    w = _world()
-    w.require_init()
-    arr = np.asarray(arr, order="C")
-    if arr.ndim == 0:
-        arr = arr.reshape(1)
-    if w.size == 1 or not w.native:
-        return arr.copy()
-    sizes = w.allgather_np(np.asarray([arr.shape[0]], np.int64),
-                           name + ".dim0")[:, 0]
-    max0 = int(sizes.max())
-    rest = arr.shape[1:]
-    padded = arr
-    if arr.shape[0] != max0:
-        padded = np.zeros((max0,) + rest, dtype=arr.dtype)
-        padded[: arr.shape[0]] = arr
-        padded = np.ascontiguousarray(padded)
-    gathered = np.zeros((w.size * max0,) + rest, dtype=arr.dtype)
-    h = w.enqueue(name, _native.OP_ALLGATHER, 1, _np_code(arr), padded.shape,
-                  padded.ctypes.data, gathered.ctypes.data)
-    r, err = w.wait(h)
-    if r < 0:
-        raise HorovodInternalError(err)
-    views = gathered.reshape((w.size, max0) + rest)
-    return np.concatenate(
-        [views[r, : int(sizes[r])] for r in range(w.size)], axis=0)
+    ``mpi_operations.cc:140-175``): per-rank sizes ride the response and
+    the native ring gathers with displacement math — no size pre-exchange,
+    no padding."""
+    out, _sizes = _world().allgatherv_np(np.asarray(arr), name)
+    return out
 
 
 def _np_broadcast(arr: np.ndarray, root_rank: int, name: str) -> np.ndarray:

@@ -86,12 +86,10 @@ def allgather_object(obj: Any, name: Optional[str] = None) -> list:
     if _ops.size() == 1:
         return [obj]
     w = _world()
+    # Unequal pickles ride the ragged allgatherv directly (reference
+    # MPI_Allgatherv, ops/mpi_operations.cc:140-175).
     payload = np.frombuffer(pickle.dumps(obj), np.uint8)
-    length = w.allgather_np(np.asarray([len(payload)], np.int64),
-                            name + ".len")[:, 0]
-    maxlen = int(length.max())
-    padded = np.zeros(maxlen, np.uint8)
-    padded[: len(payload)] = payload
-    gathered = w.allgather_np(padded, name + ".data")
-    return [pickle.loads(gathered[r, : int(length[r])].tobytes())
+    gathered, sizes = w.allgatherv_np(payload, name + ".data")
+    offsets = np.concatenate([[0], np.cumsum(sizes)])
+    return [pickle.loads(gathered[offsets[r]: offsets[r + 1]].tobytes())
             for r in range(w.size)]

@@ -17,6 +17,7 @@ the native handle futures.
 
 from __future__ import annotations
 
+import ctypes
 import threading
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -255,42 +256,41 @@ def allreduce_(tensor: torch.Tensor, average: Optional[bool] = None,
 
 
 def _submit_allgather(tensor: torch.Tensor, name: str,
-                      sizes: Optional[np.ndarray] = None) -> int:
+                      sizes_out: Optional[list] = None) -> int:
     w = _world()
     w.require_init()
     if tensor.dim() == 0:
         tensor = tensor.reshape(1)
     if w.size == 1 or not w.native:
         out = tensor.clone()
+        if sizes_out is not None:
+            sizes_out.append(np.asarray([out.shape[0]], np.int64))
         return _new_handle(_Handle(None, out, None, result=out))
-    # The reference supports ragged first dimensions via MPI_Allgatherv
-    # (mpi_operations.cc:140). The native ring allgather is equal-shape, so
-    # the binding exchanges dim-0 sizes first, pads to the max, gathers,
-    # then slices — same user semantics, one extra tiny collective (shared
-    # with autograd when the caller already exchanged it).
-    if sizes is None:
-        dim0 = np.asarray([tensor.shape[0]], np.int64)
-        sizes = _world().allgather_np(dim0, name + ".dim0")[:, 0]
-    max0 = int(sizes.max())
-    rest = tuple(tensor.shape[1:])
-    padded = tensor
-    if tensor.shape[0] != max0:
-        padded = torch.zeros((max0,) + rest, dtype=tensor.dtype)
-        padded[: tensor.shape[0]] = tensor
-    padded = padded.contiguous()
-    gathered = torch.zeros((w.size * max0,) + rest, dtype=tensor.dtype)
-    code = TORCH_DTYPE_CODES[tensor.dtype]
-    h = w.enqueue(name, _native.OP_ALLGATHER, 1, code,
-                  tuple(padded.shape), padded.data_ptr(),
-                  gathered.data_ptr())
+    # True ragged allgatherv (parity: MPI_Allgatherv,
+    # mpi_operations.cc:140-175): per-rank dim-0 sizes ride the response
+    # and the native executor allocates the output once they arrive — no
+    # size pre-exchange, no padded bandwidth.
+    t = tensor.contiguous()
+    rest = tuple(t.shape[1:])
+    code = TORCH_DTYPE_CODES[t.dtype]
+    h = w.enqueue(name, _native.OP_ALLGATHER, 1, code, tuple(t.shape),
+                  t.data_ptr(), 0)
 
-    def post(out: torch.Tensor) -> torch.Tensor:
-        views = out.view((w.size, max0) + rest)
-        return torch.cat([views[r, : int(sizes[r])] for r in range(w.size)],
-                         dim=0)
+    def post(_unused) -> torch.Tensor:
+        fetched = w.result_fetch(h)
+        if fetched is None:
+            raise HorovodInternalError(
+                f"allgather result missing for '{name}'")
+        raw, dims = fetched
+        out = torch.empty((int(sum(dims)),) + rest, dtype=t.dtype)
+        if len(raw):
+            ctypes.memmove(out.data_ptr(), raw, len(raw))
+        if sizes_out is not None:
+            sizes_out.append(np.asarray(dims, np.int64))
+        return out
 
-    entry = _Handle(h, gathered, post)
-    entry.keepalive = padded
+    entry = _Handle(h, None, post)
+    entry.keepalive = t
     return _new_handle(entry)
 
 
@@ -306,17 +306,16 @@ class _AllgatherFn(torch.autograd.Function):
         w = _world()
         w.require_init()
         name = name or _auto_name("allgather")
-        # Exchange all ranks' dim-0 sizes once, shared by the padded
-        # gather below and by backward's slice math — backward must never
-        # run a second negotiated collective under an auto-generated name
-        # that could drift across ranks and deadlock negotiation.
-        if w.size > 1 and w.native:
-            ctx.sizes = w.allgather_np(
-                np.asarray([ctx.dim0], np.int64), name + ".dim0")[:, 0]
-        else:
-            ctx.sizes = np.asarray([ctx.dim0])
-        return synchronize(_submit_allgather(_check_tensor(tensor), name,
-                                             sizes=ctx.sizes))
+        # The gather's response carries every rank's dim-0 size
+        # (allgatherv); capture them for backward's slice math so backward
+        # never runs a second negotiated collective under an
+        # auto-generated name that could drift across ranks.
+        sizes_out: list = []
+        out = synchronize(_submit_allgather(_check_tensor(tensor), name,
+                                            sizes_out=sizes_out))
+        ctx.sizes = (sizes_out[0] if sizes_out
+                     else np.asarray([ctx.dim0], np.int64))
+        return out
 
     @staticmethod
     def backward(ctx, grad_output):

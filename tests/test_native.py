@@ -283,9 +283,11 @@ def test_join_single_process(hvd):
     assert hvd.join() == hvd.size() - 1
 
 
-def test_ragged_host_allgather_rejected(tmp_path):
-    # Ranks submit allgathers with differing first dimensions: the
-    # coordinator must deliver a loud validation error, not mis-index.
+def test_ragged_host_allgatherv(tmp_path):
+    """Ranks submit allgathers with differing first dimensions: the ring
+    gathers with displacement math and the executor allocates the output
+    from the response's per-rank dims (reference MPI_Allgatherv,
+    ops/mpi_operations.cc:140-175)."""
     import textwrap as tw
 
     size = 2
@@ -304,14 +306,27 @@ def test_ragged_host_allgather_rejected(tmp_path):
             stall_warning_sec=60.0, stall_shutdown_sec=0.0,
             stall_check_enabled=True,
             exec_callback=lambda r, i: core.response_done(i, False, "n/a"))
+        # rank 0: 3 rows of 2; rank 1: 5 rows of 2
         n = 3 if rank == 0 else 5
-        d = np.ones(n, np.float32)
-        out = np.zeros(16, np.float32)
+        d = np.full((n, 2), float(rank + 1), np.float32)
         h = core.enqueue("rag", hn.OP_ALLGATHER, 1, 7, d.shape,
-                         data_ptr=d.ctypes.data, output_ptr=out.ctypes.data,
+                         data_ptr=d.ctypes.data, output_ptr=0,
                          plane=hn.PLANE_HOST)
         r, err = core.wait(h)
-        assert r == -1 and "equal first dimensions" in err, (r, err)
+        assert r == 1, err
+        raw, dims = core.result_fetch(h)
+        assert dims == (3, 5), dims
+        out = np.frombuffer(raw, np.float32).reshape(8, 2)
+        assert np.allclose(out[:3], 1.0) and np.allclose(out[3:], 2.0), out
+        # fetch erases the stored result
+        assert core.result_fetch(h) is None
+        # a 0-d host allgather is rejected loudly (reference parity)
+        z = np.asarray(1.0, np.float32)
+        hz = core.enqueue("rag0d", hn.OP_ALLGATHER, 1, 7, (),
+                          data_ptr=z.ctypes.data, output_ptr=0,
+                          plane=hn.PLANE_HOST)
+        r, err = core.wait(hz)
+        assert r == -1 and "rank-zero tensor" in err, (r, err)
         core.shutdown()
         print(f"RAGGED_{rank}_OK")
     """)

@@ -115,6 +115,30 @@ class TestAllgather:
         for r in range(n):
             np.testing.assert_allclose(out[2 * r: 2 * r + 2], r)
 
+    def test_ragged_first_dims(self, hvd):
+        # Reference parity: variable dim-0 allgather (MPI_Allgatherv,
+        # test_torch.py variable-size allgather) — rank r contributes r+1
+        # rows.
+        n = hvd.size()
+        xs = [np.full((r + 1, 3), r, dtype=np.float32) for r in range(n)]
+        out = np.asarray(hvd.allgather(xs, name="ragged.eager"))
+        assert out.shape == (sum(r + 1 for r in range(n)), 3)
+        off = 0
+        for r in range(n):
+            np.testing.assert_allclose(out[off: off + r + 1], r)
+            off += r + 1
+
+    def test_ragged_async(self, hvd):
+        n = hvd.size()
+        xs = [np.full((2 if r % 2 else 1,), r, np.float32)
+              for r in range(n)]
+        h = hvd.allgather_async(xs, name="ragged.async")
+        out = np.asarray(hvd.synchronize(h))
+        expected = np.concatenate(
+            [np.full((2 if r % 2 else 1,), r, np.float32)
+             for r in range(n)])
+        np.testing.assert_allclose(out, expected)
+
 
 class TestBroadcast:
     @pytest.mark.parametrize("root", [0, 3, 7])

@@ -177,6 +177,12 @@ class _Cursor:
 def parse_response_list(data: bytes) -> List[NativeResponse]:
     c = _Cursor(data)
     assert c.u8() == 0xA2, "bad response magic"
+    # Tuned-parameter piggyback (mirror of SerializeResponseList,
+    # message.cc:120-129): cycle/fusion hints ride every response frame.
+    # The XLA exec path reads them only to stay frame-aligned; application
+    # happens in the C++ worker cycle (controller.cc WorkerCycle).
+    c.f64()
+    c.i64()
     out = []
     for _ in range(c.i32()):
         r = NativeResponse(op=c.u8(), reduce_op=c.u8(), dtype=c.u8(),

@@ -175,13 +175,13 @@ def init(comm=None, devices=None):
                 "unavailable (direct mode has no tunable cycle/fusion "
                 "machinery); autotuning disabled")
         elif _state.config.autotune and _state.rank != 0:
-            # Fusion planning happens only in the coordinator's controller;
-            # a non-coordinator tuner would fit its GP against a knob with
-            # no effect and drift its cycle time away from the others'.
-            # The reference syncs coordinator-chosen params to all ranks
-            # (Controller::SynchronizeParameters, controller.cc:33-47);
-            # here non-coordinator ranks simply keep their initial params.
-            _log.debug("autotune: inactive on non-coordinator rank")
+            # The tuner runs only on the coordinator (as in the reference);
+            # its chosen (cycle_ms, fusion_bytes) ride every response
+            # broadcast and are applied by the native worker cycle
+            # (Controller::SynchronizeParameters parity, controller.cc:33-47;
+            # see csrc/hvd/controller.cc WorkerCycle).
+            _log.debug("autotune: tuner on coordinator; this rank applies "
+                       "synced parameters")
         elif _state.config.autotune:
             from .parameter_manager import ParameterManager
 

@@ -332,9 +332,22 @@ std::vector<Response> TcpController::WorkerCycle(std::vector<Request> reqs,
     return {};
   }
   std::vector<Response> resps;
-  if (!DeserializeResponseList(bytes, &resps)) {
+  double synced_cycle = -1.0;
+  int64_t synced_fusion = -1;
+  if (!DeserializeResponseList(bytes, &resps, &synced_cycle,
+                               &synced_fusion)) {
     *world_shutdown = true;
     return {};
+  }
+  // Apply the coordinator's tuned parameters (reference
+  // SynchronizeParameters, controller.cc:33-47): fusion is ours to apply,
+  // the cycle time belongs to the background loop and is surfaced via
+  // TakeSyncedCycleMs.
+  if (synced_fusion >= 0 && synced_fusion != fusion_threshold()) {
+    set_fusion_threshold(synced_fusion);
+  }
+  if (synced_cycle > 0) {
+    synced_cycle_ms_.store(synced_cycle, std::memory_order_relaxed);
   }
   CacheResponses(resps);
   return resps;
@@ -482,7 +495,8 @@ std::vector<Response> TcpController::CoordinatorCycle(
     return {};
   }
 
-  std::string bytes = SerializeResponseList(fused);
+  std::string bytes =
+      SerializeResponseList(fused, cycle_hint_ms(), fusion_threshold());
   for (int r = 1; r < cfg_.size; ++r) {
     if (!shutdown_ranks_[r] && worker_socks_[r - 1].valid()) {
       worker_socks_[r - 1].SendFrame(bytes);

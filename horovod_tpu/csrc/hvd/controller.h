@@ -54,6 +54,21 @@ class Controller {
     return fusion_threshold_bytes_.load(std::memory_order_relaxed);
   }
 
+  // Tuned-parameter sync (reference Controller::SynchronizeParameters,
+  // controller.cc:33-47). The coordinator's current cycle time is staged
+  // here by hvd_set_parameters and rides every response broadcast; workers
+  // surface the received value via TakeSyncedCycleMs for the background
+  // loop to apply.
+  void set_cycle_hint_ms(double ms) {
+    cycle_hint_ms_.store(ms, std::memory_order_relaxed);
+  }
+  double cycle_hint_ms() const {
+    return cycle_hint_ms_.load(std::memory_order_relaxed);
+  }
+  // Returns the coordinator-synced cycle time once, then -1 until the next
+  // update arrives.
+  double TakeSyncedCycleMs() { return synced_cycle_ms_.exchange(-1.0); }
+
   virtual Status Initialize() = 0;
   // One negotiation cycle. `this_rank_shutdown` signals this rank wants out;
   // returns responses to execute now; sets *world_shutdown once every rank
@@ -88,6 +103,8 @@ class Controller {
 
   ControllerConfig cfg_;
   std::atomic<int64_t> fusion_threshold_bytes_;
+  std::atomic<double> cycle_hint_ms_{-1.0};
+  std::atomic<double> synced_cycle_ms_{-1.0};
   std::vector<std::pair<std::string, int>> data_endpoints_;
   std::string stall_report_;
 };

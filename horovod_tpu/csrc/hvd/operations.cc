@@ -315,6 +315,11 @@ bool RunLoopOnce(std::chrono::steady_clock::time_point& last_cycle) {
   auto requests = s->tensor_queue.PopMessages();
   auto responses = s->controller->ComputeResponseList(
       std::move(requests), want_shutdown, &world_shutdown);
+  // Worker ranks: adopt the coordinator's autotuned cycle time delivered on
+  // the response broadcast (reference SynchronizeParameters applied inside
+  // BackgroundThreadLoop, operations.cc:598-604).
+  double synced = s->controller->TakeSyncedCycleMs();
+  if (synced > 0) s->cycle_time_ms.store(synced);
   for (const auto& r : responses) PerformOperation(r);
   return !world_shutdown;
 }
@@ -445,7 +450,12 @@ void hvd_set_parameters(double cycle_time_ms, long long fusion_threshold) {
   // init_mu also guards hvd_shutdown's controller.reset(): without it a
   // tuner update racing shutdown could dereference a freed controller.
   std::lock_guard<std::mutex> lk(s->init_mu);
-  if (cycle_time_ms > 0) s->cycle_time_ms.store(cycle_time_ms);
+  if (cycle_time_ms > 0) {
+    s->cycle_time_ms.store(cycle_time_ms);
+    // Stage the new cycle for the next response broadcast so worker ranks
+    // converge to the coordinator's tuned value (SynchronizeParameters).
+    if (s->controller) s->controller->set_cycle_hint_ms(cycle_time_ms);
+  }
   if (fusion_threshold >= 0 && s->controller) {
     s->controller->set_fusion_threshold(
         static_cast<int64_t>(fusion_threshold));

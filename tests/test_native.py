@@ -342,3 +342,69 @@ def test_ragged_host_allgatherv(tmp_path):
     for r, p in enumerate(procs):
         out, _ = p.communicate(timeout=60)
         assert p.returncode == 0 and f"RAGGED_{r}_OK" in out, out
+
+
+_PARAM_SYNC_WORKER = textwrap.dedent("""
+    import os, sys, time
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    from horovod_tpu.common import native as hn
+
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    core = hn.NativeCore()
+    assert core.init(rank=rank, size=2, local_rank=0, local_size=1,
+        cross_rank=rank, cross_size=2, coordinator_addr="127.0.0.1",
+        coordinator_port=port, my_host="127.0.0.1", cycle_time_ms=5.0,
+        fusion_threshold=64 << 20, cache_capacity=64,
+        stall_warning_sec=60.0, stall_shutdown_sec=0.0,
+        stall_check_enabled=True,
+        exec_callback=lambda r, i: core.response_done(i, False, "n/a"))
+
+    if rank == 0:
+        # Coordinator's autotuner picks new parameters.
+        core.set_parameters(2.5, 8 << 20)
+
+    # Collectives drive negotiation cycles; the tuned values ride the
+    # response broadcasts (Controller::SynchronizeParameters parity).
+    for i in range(3):
+        x = np.full(16, float(rank + 1), np.float32)
+        h = core.enqueue(f"ps.{i}", hn.OP_ALLREDUCE, 1, 7, x.shape,
+                         data_ptr=x.ctypes.data, output_ptr=x.ctypes.data,
+                         plane=hn.PLANE_HOST)
+        r, err = core.wait(h); assert r == 1, err
+        assert np.allclose(x, 3.0), x
+
+    # Every rank — coordinator and worker — must converge on the tuned
+    # (cycle_ms, fusion_bytes) pair.
+    deadline = time.time() + 10.0
+    while time.time() < deadline:
+        cyc, fus = core.get_parameters()
+        if abs(cyc - 2.5) < 1e-9 and fus == 8 << 20:
+            break
+        time.sleep(0.05)
+    cyc, fus = core.get_parameters()
+    assert abs(cyc - 2.5) < 1e-9, cyc
+    assert fus == 8 << 20, fus
+    core.shutdown()
+    print(f"PARAMSYNC_{rank}_OK")
+""")
+
+
+def test_autotune_parameter_sync_two_process(tmp_path):
+    """Coordinator-tuned (cycle_ms, fusion_bytes) propagate to worker ranks
+    on the response broadcast. Parity: Controller::SynchronizeParameters,
+    reference controller.cc:33-47."""
+    port = _free_port()
+    script = tmp_path / "param_sync.py"
+    script.write_text(_PARAM_SYNC_WORKER)
+    env = dict(os.environ)
+    env["HVD_REPO"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(r), str(port)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for r in range(2)]
+    for r, p in enumerate(procs):
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"PARAMSYNC_{r}_OK" in out, out

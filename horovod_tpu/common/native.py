@@ -118,6 +118,10 @@ def load_library():
     lib.hvd_set_parameters.restype = None
     lib.hvd_set_parameters.argtypes = [ctypes.c_double, ctypes.c_longlong]
     lib.hvd_get_cycle_time_ms.restype = ctypes.c_double
+    lib.hvd_cache_hits.restype = ctypes.c_longlong
+    lib.hvd_stall_report.restype = ctypes.c_int
+    lib.hvd_stall_report.argtypes = [ctypes.POINTER(ctypes.c_char),
+                                     ctypes.c_int]
     lib.hvd_get_fusion_threshold.restype = ctypes.c_longlong
     _lib = lib
     return _lib
@@ -306,3 +310,14 @@ class NativeCore:
     def get_parameters(self) -> Tuple[float, int]:
         return (float(self.lib.hvd_get_cycle_time_ms()),
                 int(self.lib.hvd_get_fusion_threshold()))
+
+    def cache_hits(self) -> int:
+        """Requests this rank sent as 4-byte cache ids (fast path)."""
+        return int(self.lib.hvd_cache_hits())
+
+    def stall_report(self) -> str:
+        """Accumulated stall-inspector warnings (coordinator); clears on
+        read."""
+        buf = ctypes.create_string_buffer(65536)
+        n = self.lib.hvd_stall_report(buf, len(buf))
+        return buf.raw[:n].decode(errors="replace")

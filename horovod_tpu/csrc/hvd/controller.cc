@@ -318,6 +318,8 @@ std::vector<Response> TcpController::WorkerCycle(std::vector<Request> reqs,
       novel.push_back(std::move(q));
     }
   }
+  cache_hits_.fetch_add(static_cast<int64_t>(hits.size()),
+                        std::memory_order_relaxed);
   if (!coord_sock_.SendFrame(SerializeRequestList(novel, hits, my_shutdown))) {
     *world_shutdown = true;
     return {};
@@ -463,7 +465,10 @@ std::vector<Response> TcpController::CoordinatorCycle(
   bool stall_shutdown = false;
   std::string report = stall_.Check(&stall_shutdown);
   if (!report.empty()) {
-    stall_report_ += report;
+    {
+      std::lock_guard<std::mutex> lk(stall_report_mu_);
+      stall_report_ += report;
+    }
     std::fprintf(stderr, "[horovod_tpu coordinator] %s", report.c_str());
   }
 

@@ -84,10 +84,19 @@ class Controller {
     return data_endpoints_;
   }
   const ControllerConfig& config() const { return cfg_; }
+  // Accumulated stall-inspector warnings (coordinator only); cleared on
+  // read. Called from API threads while the background loop appends.
   std::string TakeStallReport() {
+    std::lock_guard<std::mutex> lk(stall_report_mu_);
     std::string r = std::move(stall_report_);
     stall_report_.clear();
     return r;
+  }
+  // Requests this rank transmitted as 4-byte cache ids instead of full
+  // serialized frames (worker ranks only; the coordinator ingests its own
+  // requests directly).
+  int64_t cache_hits() const {
+    return cache_hits_.load(std::memory_order_relaxed);
   }
 
  protected:
@@ -105,6 +114,8 @@ class Controller {
   std::atomic<int64_t> fusion_threshold_bytes_;
   std::atomic<double> cycle_hint_ms_{-1.0};
   std::atomic<double> synced_cycle_ms_{-1.0};
+  std::atomic<int64_t> cache_hits_{0};
+  std::mutex stall_report_mu_;
   std::vector<std::pair<std::string, int>> data_endpoints_;
   std::string stall_report_;
 };

@@ -14,6 +14,7 @@
 //     reference GPU path (gpu_operations.cc:47-86) without device threads,
 //     since XLA's async dispatch supplies the queueing.
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -463,6 +464,28 @@ void hvd_set_parameters(double cycle_time_ms, long long fusion_threshold) {
 }
 
 double hvd_get_cycle_time_ms() { return hvd::g()->cycle_time_ms.load(); }
+
+// Observability hooks (reference: stall report text goes to the log,
+// stall_inspector.cc; cache effectiveness is visible via timeline — here
+// both are queryable so tests and users can assert on them directly).
+long long hvd_cache_hits() {
+  auto* s = hvd::g();
+  std::lock_guard<std::mutex> lk(s->init_mu);
+  return s->controller ? static_cast<long long>(s->controller->cache_hits())
+                       : 0;
+}
+
+int hvd_stall_report(char* buf, int cap) {
+  auto* s = hvd::g();
+  std::lock_guard<std::mutex> lk(s->init_mu);
+  if (s->controller == nullptr || buf == nullptr || cap <= 0) return 0;
+  std::string r = s->controller->TakeStallReport();
+  int n = static_cast<int>(
+      std::min<size_t>(r.size(), static_cast<size_t>(cap - 1)));
+  std::memcpy(buf, r.data(), static_cast<size_t>(n));
+  buf[n] = '\0';
+  return n;
+}
 
 long long hvd_get_fusion_threshold() {
   auto* s = hvd::g();

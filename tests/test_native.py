@@ -408,3 +408,191 @@ def test_autotune_parameter_sync_two_process(tmp_path):
         out, _ = p.communicate(timeout=120)
         assert p.returncode == 0, f"rank {r} failed:\n{out}"
         assert f"PARAMSYNC_{r}_OK" in out, out
+
+
+_STALL_WARN_WORKER = textwrap.dedent("""
+    import os, sys, time
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    from horovod_tpu.common import native as hn
+
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    core = hn.NativeCore()
+    assert core.init(rank=rank, size=2, local_rank=0, local_size=1,
+        cross_rank=rank, cross_size=2, coordinator_addr="127.0.0.1",
+        coordinator_port=port, my_host="127.0.0.1", cycle_time_ms=1.0,
+        fusion_threshold=64 << 20, cache_capacity=64,
+        stall_warning_sec=0.5, stall_shutdown_sec=0.0,
+        stall_check_enabled=True,
+        exec_callback=lambda r, i: core.response_done(i, False, "n/a"))
+
+    x = np.full(4, float(rank + 1), np.float32)
+    if rank == 0:
+        h = core.enqueue("st.warn", hn.OP_ALLREDUCE, 1, 7, x.shape,
+                         data_ptr=x.ctypes.data, output_ptr=x.ctypes.data,
+                         plane=hn.PLANE_HOST)
+        # Coordinator warns once the tensor has waited past the threshold
+        # with rank 1 missing (reference stall_inspector report,
+        # test_stall.py:25).
+        report = ""
+        deadline = time.time() + 10.0
+        while time.time() < deadline and "st.warn" not in report:
+            report += core.stall_report()
+            time.sleep(0.1)
+        assert "Stalled tensor 'st.warn'" in report, report
+        assert "missing ranks: [1]" in report, report
+    else:
+        time.sleep(2.0)  # stall past the 0.5 s warning threshold
+        h = core.enqueue("st.warn", hn.OP_ALLREDUCE, 1, 7, x.shape,
+                         data_ptr=x.ctypes.data, output_ptr=x.ctypes.data,
+                         plane=hn.PLANE_HOST)
+    r, err = core.wait(h); assert r == 1, err
+    assert np.allclose(x, 3.0), x
+    core.shutdown()
+    print(f"STALLWARN_{rank}_OK")
+""")
+
+
+def test_stall_inspector_warning_two_process(tmp_path):
+    """Asymmetric submission past the warning threshold produces a stall
+    report naming the missing rank; the collective still completes when the
+    straggler arrives. Parity: reference stall_inspector.cc, test_stall.py."""
+    port = _free_port()
+    script = tmp_path / "stall_warn.py"
+    script.write_text(_STALL_WARN_WORKER)
+    env = dict(os.environ)
+    env["HVD_REPO"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(r), str(port)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for r in range(2)]
+    for r, p in enumerate(procs):
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"STALLWARN_{r}_OK" in out, out
+
+
+_STALL_SHUTDOWN_WORKER = textwrap.dedent("""
+    import os, sys, time
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    from horovod_tpu.common import native as hn
+
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    core = hn.NativeCore()
+    assert core.init(rank=rank, size=2, local_rank=0, local_size=1,
+        cross_rank=rank, cross_size=2, coordinator_addr="127.0.0.1",
+        coordinator_port=port, my_host="127.0.0.1", cycle_time_ms=1.0,
+        fusion_threshold=64 << 20, cache_capacity=64,
+        stall_warning_sec=0.3, stall_shutdown_sec=1.0,
+        stall_check_enabled=True,
+        exec_callback=lambda r, i: core.response_done(i, False, "n/a"))
+
+    if rank == 0:
+        # Submit a tensor rank 1 never matches: after stall_shutdown_sec
+        # the coordinator aborts the world and the pending handle resolves
+        # with an abort status instead of hanging forever (reference
+        # HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, stall_inspector.h:80).
+        x = np.full(4, 1.0, np.float32)
+        h = core.enqueue("st.dead", hn.OP_ALLREDUCE, 1, 7, x.shape,
+                         data_ptr=x.ctypes.data, output_ptr=x.ctypes.data,
+                         plane=hn.PLANE_HOST)
+        r, err = core.wait(h)
+        assert r == -1, (r, err)
+        assert "shut down" in err, err
+    else:
+        # Rank 1 submits nothing; it only needs to outlive the shutdown
+        # threshold so its worker cycle receives the SHUTDOWN broadcast.
+        time.sleep(3.0)
+    core.shutdown()
+    print(f"STALLDEAD_{rank}_OK")
+""")
+
+
+def test_stall_inspector_shutdown_two_process(tmp_path):
+    """HOROVOD_STALL_SHUTDOWN parity: a stalled world hard-aborts after the
+    shutdown threshold; waiters resolve with an abort error, no hang."""
+    port = _free_port()
+    script = tmp_path / "stall_dead.py"
+    script.write_text(_STALL_SHUTDOWN_WORKER)
+    env = dict(os.environ)
+    env["HVD_REPO"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(r), str(port)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for r in range(2)]
+    for r, p in enumerate(procs):
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"STALLDEAD_{r}_OK" in out, out
+
+
+_CACHE_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    from horovod_tpu.common import native as hn
+
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    core = hn.NativeCore()
+    # Tiny cache (capacity 4) so 8 distinct names force FIFO eviction
+    # wraparound every round.
+    assert core.init(rank=rank, size=2, local_rank=0, local_size=1,
+        cross_rank=rank, cross_size=2, coordinator_addr="127.0.0.1",
+        coordinator_port=port, my_host="127.0.0.1", cycle_time_ms=1.0,
+        fusion_threshold=64 << 20, cache_capacity=4,
+        stall_warning_sec=60.0, stall_shutdown_sec=0.0,
+        stall_check_enabled=True,
+        exec_callback=lambda r, i: core.response_done(i, False, "n/a"))
+
+    # Phase 1: one hot tensor repeated 100x -> after the first trip every
+    # submission rides the 4-byte cache id (reference response cache
+    # fast path, response_cache.h:45-167).
+    for i in range(100):
+        x = np.full(8, float(rank + 1 + i), np.float32)
+        h = core.enqueue("hot", hn.OP_ALLREDUCE, 1, 7, x.shape,
+                         data_ptr=x.ctypes.data, output_ptr=x.ctypes.data,
+                         plane=hn.PLANE_HOST)
+        r, err = core.wait(h); assert r == 1, err
+        assert np.allclose(x, 3.0 + 2 * i), (i, x[:2])
+    if rank != 0:
+        hot_hits = core.cache_hits()
+        assert hot_hits >= 90, hot_hits
+
+    # Phase 2: 8 distinct names x 3 rounds with capacity 4 -> constant
+    # eviction; ids must stay coherent across ranks (deterministic FIFO),
+    # results must stay correct.
+    for rnd in range(3):
+        for t in range(8):
+            x = np.full(4, float(rank + 1), np.float32)
+            h = core.enqueue(f"evict.{t}", hn.OP_ALLREDUCE, 1, 7, x.shape,
+                             data_ptr=x.ctypes.data,
+                             output_ptr=x.ctypes.data, plane=hn.PLANE_HOST)
+            r, err = core.wait(h); assert r == 1, err
+            assert np.allclose(x, 3.0), (rnd, t, x)
+    core.shutdown()
+    print(f"CACHE_{rank}_OK")
+""")
+
+
+def test_response_cache_fast_path_and_eviction(tmp_path):
+    """A repeated named allreduce takes the cache-id fast path (>=90/100
+    submissions), and correctness holds through FIFO eviction wraparound
+    with a capacity-4 cache. Parity: reference response_cache.cc +
+    CoordinateCacheAndState."""
+    port = _free_port()
+    script = tmp_path / "cache.py"
+    script.write_text(_CACHE_WORKER)
+    env = dict(os.environ)
+    env["HVD_REPO"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(r), str(port)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for r in range(2)]
+    for r, p in enumerate(procs):
+        out, _ = p.communicate(timeout=180)
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"CACHE_{r}_OK" in out, out

@@ -316,8 +316,15 @@ class NativeCore:
         return int(self.lib.hvd_cache_hits())
 
     def stall_report(self) -> str:
-        """Accumulated stall-inspector warnings (coordinator); clears on
-        read."""
+        """Accumulated stall-inspector warnings (coordinator); consumed on
+        read. Loops until the native side drains so no tail is lost."""
         buf = ctypes.create_string_buffer(65536)
-        n = self.lib.hvd_stall_report(buf, len(buf))
-        return buf.raw[:n].decode(errors="replace")
+        parts = []
+        while True:
+            n = self.lib.hvd_stall_report(buf, len(buf))
+            if n <= 0:
+                break
+            parts.append(buf.raw[:n].decode(errors="replace"))
+            if n < len(buf) - 1:
+                break
+        return "".join(parts)

@@ -14,6 +14,7 @@
 #define HVD_CONTROLLER_H_
 
 #include <atomic>
+#include <cstdint>
 #include <map>
 #include <memory>
 #include <string>
@@ -84,12 +85,19 @@ class Controller {
     return data_endpoints_;
   }
   const ControllerConfig& config() const { return cfg_; }
-  // Accumulated stall-inspector warnings (coordinator only); cleared on
-  // read. Called from API threads while the background loop appends.
-  std::string TakeStallReport() {
+  // Accumulated stall-inspector warnings (coordinator only). Consumes and
+  // returns at most max_bytes so a bounded caller buffer never silently
+  // drops the tail; callers loop until empty. Called from API threads
+  // while the background loop appends.
+  std::string TakeStallReport(size_t max_bytes = SIZE_MAX) {
     std::lock_guard<std::mutex> lk(stall_report_mu_);
-    std::string r = std::move(stall_report_);
-    stall_report_.clear();
+    if (stall_report_.size() <= max_bytes) {
+      std::string r = std::move(stall_report_);
+      stall_report_.clear();
+      return r;
+    }
+    std::string r = stall_report_.substr(0, max_bytes);
+    stall_report_.erase(0, max_bytes);
     return r;
   }
   // Requests this rank transmitted as 4-byte cache ids instead of full

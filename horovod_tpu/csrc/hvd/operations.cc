@@ -479,12 +479,13 @@ int hvd_stall_report(char* buf, int cap) {
   auto* s = hvd::g();
   std::lock_guard<std::mutex> lk(s->init_mu);
   if (s->controller == nullptr || buf == nullptr || cap <= 0) return 0;
-  std::string r = s->controller->TakeStallReport();
-  int n = static_cast<int>(
-      std::min<size_t>(r.size(), static_cast<size_t>(cap - 1)));
-  std::memcpy(buf, r.data(), static_cast<size_t>(n));
-  buf[n] = '\0';
-  return n;
+  // Consumes only what fits; unread report text stays queued for the next
+  // call, so a bounded buffer never loses warnings.
+  std::string r =
+      s->controller->TakeStallReport(static_cast<size_t>(cap - 1));
+  std::memcpy(buf, r.data(), r.size());
+  buf[r.size()] = '\0';
+  return static_cast<int>(r.size());
 }
 
 long long hvd_get_fusion_threshold() {

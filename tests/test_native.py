@@ -22,6 +22,28 @@ def _free_port():
     return port
 
 
+def _run_workers(tmp_path, script_text, sentinel, size=2, timeout=120,
+                 extra_args=()):
+    """Launch `size` worker subprocesses of `script_text` (argv: rank,
+    [extra_args...,] port) and assert each exits 0 printing
+    `{sentinel}_{rank}_OK`."""
+    port = _free_port()
+    script = tmp_path / "worker.py"
+    script.write_text(script_text)
+    env = dict(os.environ)
+    env["HVD_REPO"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(r),
+         *[str(a) for a in extra_args], str(port)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for r in range(size)]
+    for r, p in enumerate(procs):
+        out, _ = p.communicate(timeout=timeout)
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"{sentinel}_{r}_OK" in out, out
+
+
 def test_library_loads():
     assert hn.load_library() is not None
 
@@ -165,24 +187,8 @@ _WORKER = textwrap.dedent("""
 
 @pytest.mark.parametrize("size", [2, 4])
 def test_multiprocess_tcp_controller_and_ring(size, tmp_path):
-    port = _free_port()
-    script = tmp_path / "worker.py"
-    script.write_text(_WORKER)
-    env = dict(os.environ)
-    env["HVD_REPO"] = os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))
-    procs = [
-        subprocess.Popen([sys.executable, str(script), str(r), str(size),
-                          str(port)], env=env, stdout=subprocess.PIPE,
-                         stderr=subprocess.STDOUT, text=True)
-        for r in range(size)
-    ]
-    outs = []
-    for r, p in enumerate(procs):
-        out, _ = p.communicate(timeout=120)
-        outs.append(out)
-        assert p.returncode == 0, f"rank {r} failed:\n{out}"
-        assert f"WORKER_{r}_OK" in out, out
+    _run_workers(tmp_path, _WORKER, "WORKER", size=size,
+                 extra_args=(size,))
 
 
 _JOIN_WORKER = textwrap.dedent("""
@@ -261,20 +267,7 @@ def test_join_zero_contribution_two_process(tmp_path):
     """Rank 1 joins after 2 steps; rank 0 completes 5 more allreduces with
     rank 1 contributing zeros, then joins. Parity: reference
     operations.cc:937-961, controller.cc:219-230,289-306."""
-    port = _free_port()
-    script = tmp_path / "join_worker.py"
-    script.write_text(_JOIN_WORKER)
-    env = dict(os.environ)
-    env["HVD_REPO"] = os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))
-    procs = [subprocess.Popen(
-        [sys.executable, str(script), str(r), str(port)], env=env,
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-        for r in range(2)]
-    for r, p in enumerate(procs):
-        out, _ = p.communicate(timeout=120)
-        assert p.returncode == 0, f"rank {r} failed:\n{out}"
-        assert f"JOIN_{r}_OK" in out, out
+    _run_workers(tmp_path, _JOIN_WORKER, "JOIN")
 
 
 def test_join_single_process(hvd):
@@ -330,18 +323,7 @@ def test_ragged_host_allgatherv(tmp_path):
         core.shutdown()
         print(f"RAGGED_{rank}_OK")
     """)
-    script = tmp_path / "ragged.py"
-    script.write_text(code)
-    env = dict(os.environ)
-    env["HVD_REPO"] = os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))
-    procs = [subprocess.Popen(
-        [sys.executable, str(script), str(r), str(port)], env=env,
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-        for r in range(size)]
-    for r, p in enumerate(procs):
-        out, _ = p.communicate(timeout=60)
-        assert p.returncode == 0 and f"RAGGED_{r}_OK" in out, out
+    _run_workers(tmp_path, code, "RAGGED")
 
 
 _PARAM_SYNC_WORKER = textwrap.dedent("""
@@ -394,20 +376,7 @@ def test_autotune_parameter_sync_two_process(tmp_path):
     """Coordinator-tuned (cycle_ms, fusion_bytes) propagate to worker ranks
     on the response broadcast. Parity: Controller::SynchronizeParameters,
     reference controller.cc:33-47."""
-    port = _free_port()
-    script = tmp_path / "param_sync.py"
-    script.write_text(_PARAM_SYNC_WORKER)
-    env = dict(os.environ)
-    env["HVD_REPO"] = os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))
-    procs = [subprocess.Popen(
-        [sys.executable, str(script), str(r), str(port)], env=env,
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-        for r in range(2)]
-    for r, p in enumerate(procs):
-        out, _ = p.communicate(timeout=120)
-        assert p.returncode == 0, f"rank {r} failed:\n{out}"
-        assert f"PARAMSYNC_{r}_OK" in out, out
+    _run_workers(tmp_path, _PARAM_SYNC_WORKER, "PARAMSYNC")
 
 
 _STALL_WARN_WORKER = textwrap.dedent("""
@@ -457,20 +426,7 @@ def test_stall_inspector_warning_two_process(tmp_path):
     """Asymmetric submission past the warning threshold produces a stall
     report naming the missing rank; the collective still completes when the
     straggler arrives. Parity: reference stall_inspector.cc, test_stall.py."""
-    port = _free_port()
-    script = tmp_path / "stall_warn.py"
-    script.write_text(_STALL_WARN_WORKER)
-    env = dict(os.environ)
-    env["HVD_REPO"] = os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))
-    procs = [subprocess.Popen(
-        [sys.executable, str(script), str(r), str(port)], env=env,
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-        for r in range(2)]
-    for r, p in enumerate(procs):
-        out, _ = p.communicate(timeout=120)
-        assert p.returncode == 0, f"rank {r} failed:\n{out}"
-        assert f"STALLWARN_{r}_OK" in out, out
+    _run_workers(tmp_path, _STALL_WARN_WORKER, "STALLWARN")
 
 
 _STALL_SHUTDOWN_WORKER = textwrap.dedent("""
@@ -513,20 +469,7 @@ _STALL_SHUTDOWN_WORKER = textwrap.dedent("""
 def test_stall_inspector_shutdown_two_process(tmp_path):
     """HOROVOD_STALL_SHUTDOWN parity: a stalled world hard-aborts after the
     shutdown threshold; waiters resolve with an abort error, no hang."""
-    port = _free_port()
-    script = tmp_path / "stall_dead.py"
-    script.write_text(_STALL_SHUTDOWN_WORKER)
-    env = dict(os.environ)
-    env["HVD_REPO"] = os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))
-    procs = [subprocess.Popen(
-        [sys.executable, str(script), str(r), str(port)], env=env,
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-        for r in range(2)]
-    for r, p in enumerate(procs):
-        out, _ = p.communicate(timeout=120)
-        assert p.returncode == 0, f"rank {r} failed:\n{out}"
-        assert f"STALLDEAD_{r}_OK" in out, out
+    _run_workers(tmp_path, _STALL_SHUTDOWN_WORKER, "STALLDEAD")
 
 
 _CACHE_WORKER = textwrap.dedent("""
@@ -582,17 +525,4 @@ def test_response_cache_fast_path_and_eviction(tmp_path):
     submissions), and correctness holds through FIFO eviction wraparound
     with a capacity-4 cache. Parity: reference response_cache.cc +
     CoordinateCacheAndState."""
-    port = _free_port()
-    script = tmp_path / "cache.py"
-    script.write_text(_CACHE_WORKER)
-    env = dict(os.environ)
-    env["HVD_REPO"] = os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))
-    procs = [subprocess.Popen(
-        [sys.executable, str(script), str(r), str(port)], env=env,
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-        for r in range(2)]
-    for r, p in enumerate(procs):
-        out, _ = p.communicate(timeout=180)
-        assert p.returncode == 0, f"rank {r} failed:\n{out}"
-        assert f"CACHE_{r}_OK" in out, out
+    _run_workers(tmp_path, _CACHE_WORKER, "CACHE", timeout=180)

@@ -30,6 +30,10 @@ from .exceptions import HorovodInternalError, NotInitializedError
 
 NUMPY_DTYPE_CODES = dict(_native.DTYPE_CODES)
 
+# Enqueue tracing (diagnostics): read once, like the C++ side's static
+# HVD_TRACE check, so the hot path tests a bool.
+_TRACE = bool(os.environ.get("HVD_TRACE"))
+
 # Scheduler-provided rank env fallbacks, tried in order when HOROVOD_* is
 # absent: jsrun/Spectrum MPI (JSM/PMIX/OMPI) and Slurm. This lets jsrun-
 # or srun-spawned workers join without the ssh launcher having exported the
@@ -234,6 +238,13 @@ class HostWorld:
         if self._core is None:
             raise HorovodInternalError(
                 "native host plane unavailable in this process")
+        if _TRACE:
+            import sys as _sys
+            import traceback as _tb
+            caller = "|".join(
+                f"{f.name}:{f.lineno}" for f in _tb.extract_stack()[-5:-1])
+            print(f"[pytrace rank={self.rank} size={self.size}] "
+                  f"enqueue {name} <- {caller}", file=_sys.stderr, flush=True)
         return self._core.enqueue(
             name, op, reduce_op, dtype_code, shape, data_ptr=data_ptr,
             output_ptr=output_ptr, root_rank=root_rank, prescale=prescale,

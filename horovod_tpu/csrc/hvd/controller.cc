@@ -2,6 +2,7 @@
 
 #include <algorithm>
 #include <cstdio>
+#include <cstdlib>
 
 #include "message.h"
 
@@ -428,9 +429,16 @@ std::vector<Response> TcpController::CoordinatorCycle(
     }
     return true;
   };
+  static const bool trace = std::getenv("HVD_TRACE") != nullptr;
   std::vector<Response> singles;
   std::vector<std::string> done;
   for (auto& kv : pending_) {
+    if (trace) {
+      std::string ranks;
+      for (const auto& q : kv.second) ranks += std::to_string(q.rank) + ",";
+      std::fprintf(stderr, "[hvd trace sz=%d act=%d] pending '%s' ranks=%s\n",
+                   cfg_.size, active, kv.first.c_str(), ranks.c_str());
+    }
     if (active > 0 && all_active_submitted(kv.second)) {
       Response resp;
       ValidateGroup(kv.first, kv.second, cfg_.size, &resp);
@@ -486,11 +494,16 @@ std::vector<Response> TcpController::CoordinatorCycle(
   }
   CacheResponses(fused);
 
-  bool all_down = true;
+  // Any rank shutting down (or dying) ends the whole world — reference
+  // semantics (RunLoopOnce exits on any DONE request, operations.cc:557):
+  // survivors' pending collectives resolve as aborted, which the elastic
+  // retry loop converts into restore + re-rendezvous. Graceful departure
+  // that keeps the world alive is join(), not shutdown.
+  bool any_down = false;
   for (int r = 0; r < cfg_.size; ++r) {
-    all_down = all_down && shutdown_ranks_[r];
+    any_down = any_down || shutdown_ranks_[r];
   }
-  if (all_down || stall_shutdown) {
+  if (any_down || stall_shutdown) {
     for (int r = 1; r < cfg_.size; ++r) {
       if (worker_socks_[r - 1].valid()) {
         worker_socks_[r - 1].SendFrame("SHUTDOWN");

@@ -372,6 +372,7 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   s->cycle_time_ms = cycle_time_ms;
   s->shutdown_requested.store(false);
   s->loop_done.store(false);
+  s->tensor_queue.Reopen();  // re-arm after a prior world's final drain
 
   hvd::ControllerConfig cfg;
   cfg.rank = rank;

@@ -4,6 +4,14 @@ namespace hvd {
 
 Status TensorQueue::AddToTensorQueue(TensorTableEntry entry) {
   std::lock_guard<std::mutex> lk(mu_);
+  if (closed_) {
+    // The background loop has exited (world abort or shutdown) and will
+    // never drain this queue again; accepting the entry would strand the
+    // caller's wait forever (observed: a worker death aborts the world
+    // while a peer is mid-step, and the peer's next enqueue raced the
+    // drain). Same closed-under-lock discipline the drain uses.
+    return Status::Aborted("horovod_tpu runtime has been shut down");
+  }
   auto name = entry.name;
   if (table_.count(name)) {
     return Status::InvalidArgument(
@@ -55,10 +63,16 @@ size_t TensorQueue::PendingCount() {
 std::vector<TensorTableEntry> TensorQueue::DrainAll() {
   std::vector<TensorTableEntry> entries;
   std::lock_guard<std::mutex> lk(mu_);
+  closed_ = true;  // refuse post-drain enqueues; see AddToTensorQueue
   for (auto& kv : table_) entries.push_back(std::move(kv.second));
   table_.clear();
   queue_.clear();
   return entries;
+}
+
+void TensorQueue::Reopen() {
+  std::lock_guard<std::mutex> lk(mu_);
+  closed_ = false;
 }
 
 }  // namespace hvd

@@ -35,15 +35,19 @@ class TensorQueue {
   bool Contains(const std::string& name);
   size_t PendingCount();
 
-  // Abort everything pending (elastic reset / shutdown): every callback
-  // fires with ABORTED.
-  // Drain every queued entry (shutdown path); caller resolves handles.
+  // Drain every queued entry (shutdown path) and close the queue: later
+  // enqueues are refused with ABORTED so no submission can slip in after
+  // the final drain and strand its waiter. Caller resolves handles.
   std::vector<TensorTableEntry> DrainAll();
+
+  // Re-arm after hvd_init reuses the process-global state (elastic reset).
+  void Reopen();
 
  private:
   std::mutex mu_;
   std::unordered_map<std::string, TensorTableEntry> table_;
   std::deque<Request> queue_;
+  bool closed_ = false;
 };
 
 }  // namespace hvd

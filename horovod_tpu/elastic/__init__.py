@@ -10,4 +10,5 @@ notices at *slice* granularity (the LOCAL/ICI group is immutable; the
 CROSS/DCN group is elastic — SURVEY §7 "Elastic + ICI").
 """
 
-from .state import ObjectState, State, run  # noqa: F401
+from .state import (  # noqa: F401
+    ObjectState, State, register_preemption_signal, run)

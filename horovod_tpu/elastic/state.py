@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import copy
 import functools
+import os
 import queue
 from typing import Callable, Dict, List
 
@@ -50,6 +51,37 @@ class _HostUpdates:
 
 
 notification_mailbox = _HostUpdates()
+
+
+def register_preemption_signal(signum=None):
+    """Route a preemption signal into the elastic mailbox.
+
+    TPU-VM maintenance/preemption notices arrive as a process signal
+    (SIGTERM by default). Installing this handler converts the signal into
+    a ``HostsUpdatedInterrupt`` at the next ``state.commit()``, so the
+    worker leaves at a committed boundary and the elastic driver
+    re-rendezvouses the remaining hosts — the TPU-native analog of the
+    reference's host-update notification (``run/elastic/worker.py``,
+    ``common/elastic.py:161``).
+
+    Opt-in: call explicitly, or set ``HOROVOD_ELASTIC_PREEMPT_SIGNAL``
+    (e.g. ``SIGTERM``/``15``) to install during worker bring-up. Returns
+    the previous handler.
+    """
+    import signal as _signal
+
+    if signum is None:
+        name = os.environ.get("HOROVOD_ELASTIC_PREEMPT_SIGNAL", "SIGTERM")
+        signum = (int(name) if name.isdigit()
+                  else getattr(_signal, name.upper()))
+
+    def _on_preempt(signo, frame):
+        _log.warning(
+            f"preemption signal {signo} received; will re-rendezvous at "
+            "the next commit")
+        notification_mailbox.post()
+
+    return _signal.signal(signum, _on_preempt)
 
 
 class State:

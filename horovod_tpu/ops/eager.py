@@ -45,7 +45,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..common import logging as _log
 from ..common import native as _native
 from ..common.exceptions import DuplicateTensorNameError, HorovodInternalError
-from ..common.state import AXIS_GLOBAL
+from ..common.state import AXIS_CROSS, AXIS_GLOBAL, AXIS_LOCAL
 from . import xla as _xla
 
 _OP_TO_NATIVE = {
@@ -299,9 +299,14 @@ class EagerEngine:
         # Replicated convenience: same tensor on every local participant.
         return jnp.broadcast_to(t[None], (L,) + t.shape), False, True
 
-    def _to_global(self, stacked):
-        """Build the global (size, ...) array sharded one-slice-per-chip."""
-        sharding = NamedSharding(self._mesh, P(AXIS_GLOBAL))
+    def _to_global(self, stacked, mesh=None, spec=None):
+        """Build the global (size, ...) array sharded one-slice-per-chip.
+
+        ``mesh``/``spec`` default to the flat hvd mesh; the hierarchical
+        dispatch passes the (cross, local) mesh with dim 0 split over both
+        axes (same device order, so the layout is identical on-chip)."""
+        sharding = NamedSharding(mesh if mesh is not None else self._mesh,
+                                 spec if spec is not None else P(AXIS_GLOBAL))
         if self._state.process_count == 1:
             return jax.device_put(stacked, sharding)
         global_shape = (self._state.size,) + tuple(stacked.shape[1:])
@@ -339,39 +344,64 @@ class EagerEngine:
 
     # -- XLA execution primitives (shared by native executor + direct mode) --
 
+    def _use_hierarchical(self, flag: bool, op=None) -> bool:
+        """HOROVOD_HIERARCHICAL_* dispatch (reference: OperationManager
+        priority + ParameterManager::HierarchicalAllreduce gating,
+        operations.cc:142-233): the env/CLI flag routes eager traffic to the
+        ICI×DCN variants when the (cross, local) mesh exists. Hierarchical
+        reduction is expressible for SUM/AVERAGE only; other ops fall back
+        to the flat path."""
+        if not flag or self._state.hier_mesh is None:
+            return False
+        return op is None or op in (_xla.ReduceOp.SUM, _xla.ReduceOp.AVERAGE)
+
     def _exec_grouped_allreduce(self, stacks: List, op, prescale, postscale):
+        hier = self._use_hierarchical(
+            self._state.config.hierarchical_allreduce, op)
         key = ("grouped_allreduce",
                tuple((s.shape[1:], str(s.dtype)) for s in stacks), op,
-               prescale, postscale)
-        mesh = self._mesh
+               prescale, postscale, hier)
+        mesh = self._state.hier_mesh if hier else self._mesh
+        spec = P((AXIS_CROSS, AXIS_LOCAL)) if hier else P(AXIS_GLOBAL)
 
         def build():
             def fn(*xs):
-                ys = _xla.grouped_allreduce(
-                    [x[0] for x in xs], axis_name=AXIS_GLOBAL, op=op,
-                    prescale_factor=prescale, postscale_factor=postscale)
+                if hier:
+                    ys = _xla.grouped_hierarchical_allreduce(
+                        [x[0] for x in xs], op=op, prescale_factor=prescale,
+                        postscale_factor=postscale)
+                else:
+                    ys = _xla.grouped_allreduce(
+                        [x[0] for x in xs], axis_name=AXIS_GLOBAL, op=op,
+                        prescale_factor=prescale, postscale_factor=postscale)
                 return tuple(y[None] for y in ys)
 
             return jax.jit(_shard_map(
-                fn, mesh, in_specs=tuple(P(AXIS_GLOBAL) for _ in stacks),
-                out_specs=tuple(P(AXIS_GLOBAL) for _ in stacks)))
+                fn, mesh, in_specs=tuple(spec for _ in stacks),
+                out_specs=tuple(spec for _ in stacks)))
 
         prog = self._program(key, build)
-        outs = prog(*[self._to_global(s) for s in stacks])
+        outs = prog(*[self._to_global(s, mesh, spec) for s in stacks])
         return list(outs) if isinstance(outs, tuple) else [outs]
 
     def _exec_allgather(self, stacked):
-        key = ("allgather", stacked.shape[1:], str(stacked.dtype))
-        mesh = self._mesh
+        hier = self._use_hierarchical(
+            self._state.config.hierarchical_allgather)
+        key = ("allgather", stacked.shape[1:], str(stacked.dtype), hier)
+        mesh = self._state.hier_mesh if hier else self._mesh
+        spec = P((AXIS_CROSS, AXIS_LOCAL)) if hier else P(AXIS_GLOBAL)
 
         def build():
             def fn(x):
+                if hier:
+                    return _xla.hierarchical_allgather(x[0])
                 return _xla.allgather(x[0], axis_name=AXIS_GLOBAL)
 
-            return jax.jit(_shard_map(fn, mesh, in_specs=P(AXIS_GLOBAL),
+            return jax.jit(_shard_map(fn, mesh, in_specs=spec,
                                       out_specs=P()))
 
-        return self._program(key, build)(self._to_global(stacked))
+        return self._program(key, build)(
+            self._to_global(stacked, mesh, spec))
 
     def _exec_broadcast(self, stacked, root):
         key = ("broadcast", stacked.shape[1:], str(stacked.dtype), root)

@@ -91,9 +91,9 @@ def allreduce(
     return _apply_postscale(out, postscale_factor)
 
 
-def grouped_allreduce(tensors, axis_name: str = AXIS_GLOBAL, op: int = ReduceOp.SUM,
-                      prescale_factor: float = 1.0, postscale_factor: float = 1.0):
-    """Allreduce a list of tensors as one fused operation.
+def _grouped(tensors, reduce_fn):
+    """Shared dtype-concat fusion: flatten, concatenate per dtype, reduce
+    each fused buffer with ``reduce_fn``, slice results back out.
 
     TPU-native tensor fusion: rather than memcpy into a fusion buffer
     (reference ``MemcpyInFusionBuffer``, ``gpu_operations.cc:97``), we
@@ -108,17 +108,27 @@ def grouped_allreduce(tensors, axis_name: str = AXIS_GLOBAL, op: int = ReduceOp.
     for i, f in enumerate(flats):
         by_dtype.setdefault(f.dtype, []).append(i)
     out = [None] * len(tensors)
-    for dt, idxs in by_dtype.items():
-        fused = jnp.concatenate([flats[i] for i in idxs]) if len(idxs) > 1 else flats[idxs[0]]
-        red = allreduce(fused, axis_name=axis_name, op=op,
-                        prescale_factor=prescale_factor,
-                        postscale_factor=postscale_factor)
+    for _, idxs in by_dtype.items():
+        fused = (jnp.concatenate([flats[i] for i in idxs])
+                 if len(idxs) > 1 else flats[idxs[0]])
+        red = reduce_fn(fused)
         off = 0
         for i in idxs:
             n = flats[i].shape[0]
-            out[i] = jnp.reshape(lax.dynamic_slice_in_dim(red, off, n), tensors[i].shape)
+            out[i] = jnp.reshape(lax.dynamic_slice_in_dim(red, off, n),
+                                 tensors[i].shape)
             off += n
     return out
+
+
+def grouped_allreduce(tensors, axis_name: str = AXIS_GLOBAL, op: int = ReduceOp.SUM,
+                      prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+    """Allreduce a list of tensors as one fused operation (see ``_grouped``)."""
+    return _grouped(
+        tensors,
+        lambda fused: allreduce(fused, axis_name=axis_name, op=op,
+                                prescale_factor=prescale_factor,
+                                postscale_factor=postscale_factor))
 
 
 def hierarchical_allreduce(tensor, op: int = ReduceOp.SUM):
@@ -130,7 +140,14 @@ def hierarchical_allreduce(tensor, op: int = ReduceOp.SUM):
     all-gather back along LOCAL. Must run under the hierarchical mesh with
     axes (AXIS_CROSS, AXIS_LOCAL).
     """
-    flat = jnp.ravel(tensor)
+    # Same dtype contract as the flat path (allreduce above): accumulate
+    # low-precision inputs in fp32, cast the result back, so routing
+    # through HOROVOD_HIERARCHICAL_ALLREDUCE never changes dtypes or
+    # precision semantics.
+    dtype = tensor.dtype
+    acc = (tensor.astype(jnp.float32)
+           if dtype in (jnp.bfloat16, jnp.float16) else tensor)
+    flat = jnp.ravel(acc)
     local_n = lax.axis_size(AXIS_LOCAL)
     pad = (-flat.shape[0]) % local_n
     if pad:
@@ -140,17 +157,48 @@ def hierarchical_allreduce(tensor, op: int = ReduceOp.SUM):
     full = lax.all_gather(shard, AXIS_LOCAL, tiled=True)
     if pad:
         full = full[: flat.shape[0] - pad]
-    out = jnp.reshape(full, tensor.shape)
+    out = jnp.reshape(full, acc.shape)
     if op == ReduceOp.AVERAGE:
         n = lax.axis_size(AXIS_LOCAL) * lax.axis_size(AXIS_CROSS)
         out = out / jnp.asarray(n, dtype=out.dtype)
-    return out
+    return out.astype(dtype)
+
+
+def grouped_hierarchical_allreduce(tensors, op: int = ReduceOp.SUM,
+                                   prescale_factor: float = 1.0,
+                                   postscale_factor: float = 1.0):
+    """Fused hierarchical allreduce (dtype-concat fusion like
+    ``grouped_allreduce``, ICI/DCN split like ``hierarchical_allreduce``).
+    Supports SUM/AVERAGE — the ops ``psum_scatter`` expresses."""
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(
+            f"hierarchical allreduce supports SUM/AVERAGE, got op {op}")
+
+    def reduce_fn(fused):
+        fused = _apply_prescale(fused, prescale_factor)
+        return _apply_postscale(hierarchical_allreduce(fused, op=op),
+                                postscale_factor)
+
+    return _grouped(tensors, reduce_fn)
 
 
 def allgather(tensor, axis_name: str = AXIS_GLOBAL):
     """Concatenate per-participant tensors along dim 0 (parity:
     ``MPIAllgather``/``NCCLAllgather`` semantics, same-shape fast path)."""
     return lax.all_gather(tensor, axis_name, tiled=True)
+
+
+def hierarchical_allgather(tensor):
+    """ICI-then-DCN hierarchical allgather over the (cross, local) mesh.
+
+    TPU-native analog of ``MPIHierarchicalAllgather``
+    (``mpi_operations.cc:177-328``: node-local shared-memory gather + a
+    cross-node gather over node leaders): gather along the fast LOCAL (ICI)
+    axis first, then exchange the per-group blocks along CROSS (DCN). With
+    the global mesh laid out cross-major (rank = cross*L + local), the
+    (CROSS, LOCAL) concatenation order reproduces the flat rank order."""
+    local = lax.all_gather(tensor, AXIS_LOCAL, tiled=True)
+    return lax.all_gather(local, AXIS_CROSS, tiled=True)
 
 
 def broadcast(tensor, root_rank: int, axis_name: str = AXIS_GLOBAL):

@@ -66,6 +66,20 @@ class WorkerNotificationManager:
 
         key = base64.b64decode(key_b64)
         self._service = WorkerNotificationService(key)
+        if os.environ.get("HOROVOD_ELASTIC_PREEMPT_SIGNAL"):
+            # Opt-in: convert TPU-VM preemption signals into graceful
+            # re-rendezvous at the next commit (see
+            # elastic.state.register_preemption_signal). Signal handlers
+            # can only be installed on the main thread; degrade to a
+            # warning when init runs elsewhere rather than failing init.
+            from ...common import logging as _log
+            from ...elastic.state import register_preemption_signal
+
+            try:
+                register_preemption_signal()
+            except ValueError as e:
+                _log.warning(
+                    f"preemption-signal handler not installed: {e}")
         addr = os.environ.get(_config.HOROVOD_RENDEZVOUS_ADDR)
         port = os.environ.get(_config.HOROVOD_RENDEZVOUS_PORT)
         # Keyed by (hostname, local_rank) — stable for the process's whole

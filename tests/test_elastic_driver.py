@@ -228,3 +228,30 @@ def test_rendezvous_rounds_written():
         driver.stop()
     finally:
         rendezvous.stop_server()
+
+
+def test_preemption_signal_posts_host_update():
+    """TPU-VM preemption parity: a registered preemption signal surfaces as
+    HostsUpdatedInterrupt at the next commit (graceful departure at a
+    committed boundary)."""
+    import os
+    import signal
+
+    import pytest
+
+    from horovod_tpu.common.exceptions import HostsUpdatedInterrupt
+    from horovod_tpu.elastic.state import (
+        ObjectState, notification_mailbox, register_preemption_signal)
+
+    notification_mailbox.pending()  # drain any leftovers
+    prev = register_preemption_signal(signal.SIGUSR2)
+    try:
+        state = ObjectState(bcast_object=lambda obj, root_rank=0: obj,
+                            batch=0)
+        state.commit()  # no signal yet: commit passes
+        os.kill(os.getpid(), signal.SIGUSR2)
+        with pytest.raises(HostsUpdatedInterrupt):
+            state.commit()
+        state.commit()  # mailbox drained: next commit passes again
+    finally:
+        signal.signal(signal.SIGUSR2, prev)

@@ -6,6 +6,8 @@ sections, SURVEY §4 Pattern 1): each participant's tensor is seeded by its
 rank, the collective runs, and the mathematical result is asserted.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -194,3 +196,75 @@ class TestBroadcastHelpers:
     def test_broadcast_object_single_process(self, hvd):
         obj = {"epoch": 3, "lr": 0.1}
         assert hvd.broadcast_object(obj, root_rank=0) == obj
+
+
+class TestHierarchicalDispatch:
+    """HOROVOD_HIERARCHICAL_ALLREDUCE/ALLGATHER routing (reference
+    OperationManager priority dispatch + MPIHierarchicalAllgather,
+    mpi_operations.cc:177-328): flat and hierarchical variants must agree
+    on the 8-device (cross x local) mesh."""
+
+    @pytest.fixture
+    def hvd_hier(self):
+        os.environ["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+        os.environ["HOROVOD_HIERARCHICAL_ALLGATHER"] = "1"
+        try:
+            import horovod_tpu as hvd
+
+            hvd.init()
+            yield hvd
+            hvd.shutdown()
+        finally:
+            del os.environ["HOROVOD_HIERARCHICAL_ALLREDUCE"]
+            del os.environ["HOROVOD_HIERARCHICAL_ALLGATHER"]
+
+    def test_hier_mesh_exists(self, hvd_hier):
+        from horovod_tpu.common.state import global_state
+
+        assert global_state().hier_mesh is not None
+
+    def test_allreduce_matches_flat(self, hvd_hier):
+        n = hvd_hier.size()
+        xs = [np.arange(37, dtype=np.float32) * (r + 1) for r in range(n)]
+        out = hvd_hier.allreduce(xs, op=hvd_hier.Sum, name="hier.ar")
+        expected = np.arange(37, dtype=np.float32) * sum(
+            r + 1 for r in range(n))
+        for o in out:
+            np.testing.assert_allclose(np.asarray(o), expected, rtol=1e-6)
+
+    def test_allreduce_average(self, hvd_hier):
+        n = hvd_hier.size()
+        xs = [np.full((5,), float(r), np.float32) for r in range(n)]
+        out = hvd_hier.allreduce(xs, op=hvd_hier.Average, name="hier.avg")
+        np.testing.assert_allclose(np.asarray(out[0]),
+                                   np.full((5,), (n - 1) / 2.0), rtol=1e-6)
+
+    def test_allgather_matches_flat(self, hvd_hier):
+        n = hvd_hier.size()
+        xs = [np.full((2, 3), float(r), np.float32) for r in range(n)]
+        out = np.asarray(hvd_hier.allgather(xs, name="hier.ag"))
+        assert out.shape == (2 * n, 3)
+        # Flat rank order must be preserved by the ICI-then-DCN gather.
+        for r in range(n):
+            np.testing.assert_allclose(out[2 * r: 2 * r + 2], float(r))
+
+    def test_min_falls_back_to_flat(self, hvd_hier):
+        n = hvd_hier.size()
+        xs = [np.full((4,), float(r + 1), np.float32) for r in range(n)]
+        out = hvd_hier.allreduce(xs, op=hvd_hier.Min, name="hier.min")
+        np.testing.assert_allclose(np.asarray(out[0]), 1.0)
+
+    def test_dtype_contract_matches_flat(self, hvd_hier):
+        # int AVERAGE keeps int dtype; bf16 accumulates in fp32 — the same
+        # contract the flat path guarantees, regardless of the env flag.
+        n = hvd_hier.size()
+        ints = [np.full((4,), r, np.int32) for r in range(n)]
+        out = hvd_hier.allreduce(ints, op=hvd_hier.Average, name="hier.iavg")
+        assert np.asarray(out[0]).dtype == np.int32
+        import jax.numpy as jnp
+
+        bf = [jnp.full((8,), 1.0 + 2 ** -9, jnp.bfloat16) for _ in range(n)]
+        s = hvd_hier.allreduce(bf, op=hvd_hier.Sum, name="hier.bf16")
+        got = np.asarray(s[0], dtype=np.float32)
+        np.testing.assert_allclose(got, n * (1.0 + 2 ** -9), rtol=1e-2)
+        assert s[0].dtype == jnp.bfloat16

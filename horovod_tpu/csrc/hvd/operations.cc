@@ -512,10 +512,17 @@ void hvd_register_exec_callback(void (*cb)(const char*, int, long)) {
 // Enqueue a collective. Returns a handle (>= 0) or a negative error code.
 // For HOST-plane tensors `data`/`output` are live host pointers that must
 // stay valid until the handle resolves; XLA-plane entries pass nullptrs.
-long long hvd_enqueue(const char* name, int op, int reduce_op, int dtype,
-                      const long long* shape, int ndim, void* data,
-                      void* output, int root_rank, double prescale,
-                      double postscale, int plane) {
+// `done`/`done_arg` (optional): fires exactly once — on the background or
+// executor thread, possibly before this call returns — if and only if the
+// return value is >= 0. The handle is passed to the callback so callers
+// never need to read it from shared state (the role of the reference's
+// StatusCallback for async framework kernels, tensorflow/mpi_ops.cc:294).
+long long hvd_enqueue_cb(const char* name, int op, int reduce_op, int dtype,
+                         const long long* shape, int ndim, void* data,
+                         void* output, int root_rank, double prescale,
+                         double postscale, int plane,
+                         void (*done)(void*, long long, int, const char*),
+                         void* done_arg) {
   auto* s = hvd::g();
   if (!s->initialized.load()) return -1;
   hvd::TensorTableEntry e;
@@ -536,11 +543,26 @@ long long hvd_enqueue(const char* name, int op, int reduce_op, int dtype,
   e.output = output;
   e.handle = s->handles.NewHandle();
   long long h = e.handle;
+  if (done != nullptr) {
+    e.callback = [done, done_arg, h](const hvd::Status& st) {
+      done(done_arg, h, st.ok() ? 1 : 0, st.reason().c_str());
+    };
+  }
   hvd::Status st = s->tensor_queue.AddToTensorQueue(std::move(e));
   if (!st.ok()) {
     s->handles.MarkDone(h, st);
+    if (done != nullptr) done(done_arg, h, 0, st.reason().c_str());
   }
   return h;
+}
+
+long long hvd_enqueue(const char* name, int op, int reduce_op, int dtype,
+                      const long long* shape, int ndim, void* data,
+                      void* output, int root_rank, double prescale,
+                      double postscale, int plane) {
+  return hvd_enqueue_cb(name, op, reduce_op, dtype, shape, ndim, data,
+                        output, root_rank, prescale, postscale, plane,
+                        nullptr, nullptr);
 }
 
 // Executor-allocated result access (ragged allgather): after hvd_wait

@@ -77,7 +77,9 @@ class WorkerNotificationManager:
 
             try:
                 register_preemption_signal()
-            except ValueError as e:
+            except (ValueError, AttributeError, OSError) as e:
+                # ValueError: non-main thread; AttributeError: unknown
+                # signal name; OSError: uncatchable signal (e.g. SIGKILL).
                 _log.warning(
                     f"preemption-signal handler not installed: {e}")
         addr = os.environ.get(_config.HOROVOD_RENDEZVOUS_ADDR)

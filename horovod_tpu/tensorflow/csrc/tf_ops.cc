@@ -1,0 +1,371 @@
+// Native TensorFlow op kernels over the horovod_tpu runtime.
+//
+// Parity: the reference's HorovodAllreduceOp / HorovodAllgatherOp /
+// HorovodBroadcastOp AsyncOpKernels (tensorflow/mpi_ops.cc:287-466). The
+// TF executor drives these kernels directly — no tf.py_function Python hop
+// in the data path — and each kernel enqueues into the shared native
+// runtime (csrc/hvd), completing the async kernel from the entry's status
+// callback exactly as the reference completes its kernels from the
+// background thread's StatusCallback.
+//
+// The runtime library (libhvdtpu.so) is dlopen'ed by path (HVDTPU_LIB env,
+// exported by the Python loader) so this extension shares the ctypes-loaded
+// copy and its process-global state instead of linking a second instance.
+
+#include <dlfcn.h>
+
+#include <cstdlib>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "tensorflow/core/framework/op.h"
+#include "tensorflow/core/framework/op_kernel.h"
+#include "tensorflow/core/framework/shape_inference.h"
+
+namespace hvdtf {
+
+using tensorflow::AsyncOpKernel;
+using tensorflow::DataType;
+using tensorflow::OpKernelConstruction;
+using tensorflow::OpKernelContext;
+using tensorflow::Tensor;
+using tensorflow::TensorShape;
+using tensorflow::errors::Internal;
+using tensorflow::errors::InvalidArgument;
+
+// ---- runtime C API, resolved from the shared libhvdtpu.so ------------------
+
+typedef long long (*EnqueueCbFn)(const char*, int, int, int,
+                                 const long long*, int, void*, void*, int,
+                                 double, double, int,
+                                 void (*)(void*, long long, int,
+                                          const char*),
+                                 void*);
+typedef long long (*ResultBytesFn)(long long);
+typedef int (*ResultDimsFn)(long long, long long*, int);
+typedef int (*ResultFetchFn)(long long, void*, long long);
+typedef int (*IntFn)();
+
+struct Api {
+  EnqueueCbFn enqueue_cb = nullptr;
+  ResultBytesFn result_bytes = nullptr;
+  ResultDimsFn result_dims = nullptr;
+  ResultFetchFn result_fetch = nullptr;
+  IntFn initialized = nullptr;
+  bool ok = false;
+};
+
+static Api* api() {
+  static Api a;
+  static std::once_flag once;
+  std::call_once(once, []() {
+    const char* path = std::getenv("HVDTPU_LIB");
+    if (path == nullptr) return;
+    void* h = ::dlopen(path, RTLD_NOW | RTLD_GLOBAL);
+    if (h == nullptr) return;
+    a.enqueue_cb =
+        reinterpret_cast<EnqueueCbFn>(::dlsym(h, "hvd_enqueue_cb"));
+    a.result_bytes =
+        reinterpret_cast<ResultBytesFn>(::dlsym(h, "hvd_result_bytes"));
+    a.result_dims =
+        reinterpret_cast<ResultDimsFn>(::dlsym(h, "hvd_result_dims"));
+    a.result_fetch =
+        reinterpret_cast<ResultFetchFn>(::dlsym(h, "hvd_result_fetch"));
+    a.initialized = reinterpret_cast<IntFn>(::dlsym(h, "hvd_initialized"));
+    a.ok = a.enqueue_cb && a.result_bytes && a.result_dims &&
+           a.result_fetch && a.initialized;
+  });
+  return &a;
+}
+
+// Native op/dtype codes (mirror of common/native.py).
+constexpr int kOpAllreduce = 0;
+constexpr int kOpAllgather = 1;
+constexpr int kOpBroadcast = 2;
+constexpr int kPlaneHost = 1;
+
+static int DtypeCode(DataType dt) {
+  switch (dt) {
+    case tensorflow::DT_UINT8: return 0;
+    case tensorflow::DT_INT8: return 1;
+    case tensorflow::DT_INT32: return 4;
+    case tensorflow::DT_INT64: return 5;
+    case tensorflow::DT_HALF: return 6;
+    case tensorflow::DT_FLOAT: return 7;
+    case tensorflow::DT_DOUBLE: return 8;
+    case tensorflow::DT_BOOL: return 9;
+    case tensorflow::DT_BFLOAT16: return 10;
+    default: return -1;
+  }
+}
+
+// Heap-allocated completion context. The completion callback owns it:
+// once hvd_enqueue_cb returns >= 0 the callback fires exactly once (maybe
+// before the enqueue returns), so ComputeAsync never touches it after a
+// successful enqueue. The collective's handle arrives as a callback
+// argument — never read back from this struct — so there is no ordering
+// race with the background thread.
+struct Completion {
+  OpKernelContext* ctx;
+  AsyncOpKernel::DoneCallback done;
+  bool allgather = false;
+  std::vector<long long> tail_dims;  // allgather: dims 1.. of the input
+};
+
+static void OnDone(void* arg, long long handle, int ok, const char* err) {
+  Completion* c = static_cast<Completion*>(arg);
+  if (!ok) {
+    c->ctx->SetStatus(Internal(
+        "horovod_tpu collective failed: ", err ? err : "unknown error"));
+    c->done();
+    delete c;
+    return;
+  }
+  if (c->allgather) {
+    // Ragged output: size/first-dims arrive with the response (reference
+    // MPI_Allgatherv displacement flow); allocate now and copy out.
+    Api* a = api();
+    long long nbytes = a->result_bytes(handle);
+    std::vector<long long> dims(512);
+    int nranks = a->result_dims(handle, dims.data(),
+                                static_cast<int>(dims.size()));
+    if (nranks > static_cast<int>(dims.size())) {
+      dims.resize(nranks);
+      nranks = a->result_dims(handle, dims.data(),
+                              static_cast<int>(dims.size()));
+    }
+    if (nbytes < 0 || nranks <= 0) {
+      c->ctx->SetStatus(Internal("allgather result missing"));
+      c->done();
+      delete c;
+      return;
+    }
+    long long dim0 = 0;
+    for (int i = 0; i < nranks; ++i) dim0 += dims[i];
+    TensorShape shape;
+    shape.AddDim(dim0);
+    for (auto d : c->tail_dims) shape.AddDim(d);
+    Tensor* out = nullptr;
+    auto st = c->ctx->allocate_output(0, shape, &out);
+    if (!st.ok()) {
+      c->ctx->SetStatus(st);
+      c->done();
+      delete c;
+      return;
+    }
+    if (nbytes > 0) {
+      a->result_fetch(handle, const_cast<char*>(out->tensor_data().data()),
+                      nbytes);
+    }
+  }
+  c->done();
+  delete c;
+}
+
+static bool Ready(OpKernelContext* ctx, AsyncOpKernel::DoneCallback& done) {
+  Api* a = api();
+  if (!a->ok) {
+    ctx->SetStatus(Internal(
+        "horovod_tpu native runtime unavailable (HVDTPU_LIB not set or "
+        "symbols missing)"));
+    done();
+    return false;
+  }
+  if (!a->initialized()) {
+    ctx->SetStatus(Internal(
+        "horovod_tpu is not initialized; call hvd.init() first"));
+    done();
+    return false;
+  }
+  return true;
+}
+
+// ---- HorovodTpuAllreduce ---------------------------------------------------
+
+class AllreduceOp : public AsyncOpKernel {
+ public:
+  explicit AllreduceOp(OpKernelConstruction* c) : AsyncOpKernel(c) {
+    OP_REQUIRES_OK(c, c->GetAttr("tensor_name", &name_));
+    OP_REQUIRES_OK(c, c->GetAttr("reduce_op", &reduce_op_));
+    OP_REQUIRES_OK(c, c->GetAttr("prescale_factor", &prescale_));
+    OP_REQUIRES_OK(c, c->GetAttr("postscale_factor", &postscale_));
+    if (name_.empty()) name_ = name();
+  }
+
+  void ComputeAsync(OpKernelContext* ctx, DoneCallback done) override {
+    if (!Ready(ctx, done)) return;
+    const Tensor& input = ctx->input(0);
+    int code = DtypeCode(input.dtype());
+    OP_REQUIRES_ASYNC(ctx, code >= 0,
+                      InvalidArgument("unsupported dtype for allreduce"),
+                      done);
+    Tensor* output = nullptr;
+    OP_REQUIRES_OK_ASYNC(
+        ctx, ctx->allocate_output(0, input.shape(), &output), done);
+    std::vector<long long> dims;
+    for (int i = 0; i < input.dims(); ++i) dims.push_back(input.dim_size(i));
+    auto* c = new Completion{ctx, done};
+    long long h = api()->enqueue_cb(
+        name_.c_str(), kOpAllreduce, reduce_op_, code, dims.data(),
+        static_cast<int>(dims.size()),
+        const_cast<char*>(input.tensor_data().data()),
+        const_cast<char*>(output->tensor_data().data()), -1, prescale_,
+        postscale_, kPlaneHost, &OnDone, c);
+    if (h < 0) {
+      // done never fired (enqueue contract): complete + free here.
+      ctx->SetStatus(Internal("horovod_tpu runtime is not initialized"));
+      done();
+      delete c;
+    }
+  }
+
+ private:
+  std::string name_;
+  int reduce_op_ = 1;
+  float prescale_ = 1.0f;
+  float postscale_ = 1.0f;
+};
+
+REGISTER_OP("HorovodTpuAllreduce")
+    .Attr(
+        "T: {uint8, int8, int32, int64, half, float32, float64, bool, "
+        "bfloat16}")
+    .Attr("tensor_name: string = ''")
+    .Attr("reduce_op: int = 1")
+    .Attr("prescale_factor: float = 1.0")
+    .Attr("postscale_factor: float = 1.0")
+    .Input("tensor: T")
+    .Output("sum: T")
+    .SetShapeFn([](tensorflow::shape_inference::InferenceContext* c) {
+      c->set_output(0, c->input(0));
+      return tensorflow::OkStatus();
+    });
+
+REGISTER_KERNEL_BUILDER(
+    Name("HorovodTpuAllreduce").Device(tensorflow::DEVICE_CPU), AllreduceOp);
+
+// ---- HorovodTpuAllgather ---------------------------------------------------
+
+class AllgatherOp : public AsyncOpKernel {
+ public:
+  explicit AllgatherOp(OpKernelConstruction* c) : AsyncOpKernel(c) {
+    OP_REQUIRES_OK(c, c->GetAttr("tensor_name", &name_));
+    if (name_.empty()) name_ = name();
+  }
+
+  void ComputeAsync(OpKernelContext* ctx, DoneCallback done) override {
+    if (!Ready(ctx, done)) return;
+    const Tensor& input = ctx->input(0);
+    int code = DtypeCode(input.dtype());
+    OP_REQUIRES_ASYNC(ctx, code >= 0,
+                      InvalidArgument("unsupported dtype for allgather"),
+                      done);
+    OP_REQUIRES_ASYNC(
+        ctx, input.dims() >= 1,
+        InvalidArgument("allgather requires rank >= 1 tensors"), done);
+    std::vector<long long> dims;
+    for (int i = 0; i < input.dims(); ++i) dims.push_back(input.dim_size(i));
+    auto* c = new Completion{ctx, done};
+    c->allgather = true;
+    c->tail_dims.assign(dims.begin() + 1, dims.end());
+    long long h = api()->enqueue_cb(
+        name_.c_str(), kOpAllgather, 1, code, dims.data(),
+        static_cast<int>(dims.size()),
+        const_cast<char*>(input.tensor_data().data()), nullptr, -1, 1.0,
+        1.0, kPlaneHost, &OnDone, c);
+    if (h < 0) {
+      // done never fired (enqueue contract): complete + free here.
+      ctx->SetStatus(Internal("horovod_tpu runtime is not initialized"));
+      done();
+      delete c;
+    }
+  }
+
+ private:
+  std::string name_;
+};
+
+REGISTER_OP("HorovodTpuAllgather")
+    .Attr(
+        "T: {uint8, int8, int32, int64, half, float32, float64, bool, "
+        "bfloat16}")
+    .Attr("tensor_name: string = ''")
+    .Input("tensor: T")
+    .Output("gathered: T")
+    .SetShapeFn([](tensorflow::shape_inference::InferenceContext* c) {
+      tensorflow::shape_inference::ShapeHandle out;
+      TF_RETURN_IF_ERROR(c->ReplaceDim(
+          c->input(0), 0, c->UnknownDim(), &out));
+      c->set_output(0, out);
+      return tensorflow::OkStatus();
+    });
+
+REGISTER_KERNEL_BUILDER(
+    Name("HorovodTpuAllgather").Device(tensorflow::DEVICE_CPU), AllgatherOp);
+
+// ---- HorovodTpuBroadcast ---------------------------------------------------
+
+class BroadcastOp : public AsyncOpKernel {
+ public:
+  explicit BroadcastOp(OpKernelConstruction* c) : AsyncOpKernel(c) {
+    OP_REQUIRES_OK(c, c->GetAttr("tensor_name", &name_));
+    OP_REQUIRES_OK(c, c->GetAttr("root_rank", &root_rank_));
+    if (name_.empty()) name_ = name();
+  }
+
+  void ComputeAsync(OpKernelContext* ctx, DoneCallback done) override {
+    if (!Ready(ctx, done)) return;
+    const Tensor& input = ctx->input(0);
+    int code = DtypeCode(input.dtype());
+    OP_REQUIRES_ASYNC(ctx, code >= 0,
+                      InvalidArgument("unsupported dtype for broadcast"),
+                      done);
+    Tensor* output = nullptr;
+    OP_REQUIRES_OK_ASYNC(
+        ctx, ctx->allocate_output(0, input.shape(), &output), done);
+    std::vector<long long> dims;
+    for (int i = 0; i < input.dims(); ++i) dims.push_back(input.dim_size(i));
+    // The ring broadcast operates in place on the root's buffer; give every
+    // rank its own output copy seeded from the input.
+    if (output->tensor_data().data() != input.tensor_data().data()) {
+      memcpy(const_cast<char*>(output->tensor_data().data()),
+             input.tensor_data().data(), input.TotalBytes());
+    }
+    auto* c = new Completion{ctx, done};
+    long long h = api()->enqueue_cb(
+        name_.c_str(), kOpBroadcast, 1, code, dims.data(),
+        static_cast<int>(dims.size()),
+        const_cast<char*>(output->tensor_data().data()),
+        const_cast<char*>(output->tensor_data().data()), root_rank_, 1.0,
+        1.0, kPlaneHost, &OnDone, c);
+    if (h < 0) {
+      // done never fired (enqueue contract): complete + free here.
+      ctx->SetStatus(Internal("horovod_tpu runtime is not initialized"));
+      done();
+      delete c;
+    }
+  }
+
+ private:
+  std::string name_;
+  int root_rank_ = 0;
+};
+
+REGISTER_OP("HorovodTpuBroadcast")
+    .Attr(
+        "T: {uint8, int8, int32, int64, half, float32, float64, bool, "
+        "bfloat16}")
+    .Attr("tensor_name: string = ''")
+    .Attr("root_rank: int = 0")
+    .Input("tensor: T")
+    .Output("output: T")
+    .SetShapeFn([](tensorflow::shape_inference::InferenceContext* c) {
+      c->set_output(0, c->input(0));
+      return tensorflow::OkStatus();
+    });
+
+REGISTER_KERNEL_BUILDER(
+    Name("HorovodTpuBroadcast").Device(tensorflow::DEVICE_CPU), BroadcastOp);
+
+}  // namespace hvdtf

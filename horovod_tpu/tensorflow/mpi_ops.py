@@ -2,18 +2,30 @@
 
 Capability parity with the reference's ``horovod/tensorflow/mpi_ops.py:89-197``
 (op wrappers + gradients) and the custom-kernel layer
-``tensorflow/mpi_ops.cc:287-466``, re-architected TPU-native: instead of
-registering custom TF AsyncOpKernels that enqueue into an MPI/NCCL background
-thread, host-resident TF tensors ride the native C++ TCP ring data plane
-(``csrc/hvd/ring_ops.cc``) negotiated by the shared controller cycle loop —
-the same plane the PyTorch binding uses. Graph mode is served through
-``tf.py_function`` (the op still participates in the controller's fusion and
-caching); gradients are registered with ``tf.custom_gradient`` following the
-reference's gradient table (allreduce' = allreduce, allgather' = allreduce +
-local slice, broadcast' = allreduce with non-root zeroing).
+``tensorflow/mpi_ops.cc:287-466``. Architecture:
+
+- **Native kernels (primary)**: real TF AsyncOpKernels
+  (``csrc/tf_ops.cc``, built on demand against the installed TF) enqueue
+  host-resident TF tensors into the shared native runtime — the controller
+  cycle loop, fusion planner, and C++ TCP ring data plane
+  (``csrc/hvd/ring_ops.cc``) the PyTorch binding also rides. The TF
+  executor drives the kernels directly and completion fires from the
+  entry's status callback: no ``tf.py_function`` Python hop in the data
+  path, matching the reference's async-kernel design.
+- **py_function (fallback)**: when the extension can't build/load (no
+  compiler, ``HOROVOD_NATIVE=0``) or the world is single-process, the same
+  collectives run through numpy shims under ``tf.py_function`` with
+  identical semantics.
+
+Gradients follow the reference's table (allreduce' = allreduce,
+allgather' = allreduce + local slice, broadcast' = allreduce with non-root
+zeroing), registered both on the raw kernels (``native_ops.py``) and the
+``tf.custom_gradient`` wrappers.
 
 Ranks are processes, one per ``horovod_tpu.run``-launched worker, exactly as
-in the reference.
+in the reference. For TPU-compiled training the idiomatic path remains the
+JAX plane (``horovod_tpu.make_train_step`` / ``ops.xla``); the TF binding's
+plane is the host ring, as the reference's CPU ops are.
 """
 
 from __future__ import annotations
@@ -28,6 +40,7 @@ from ..common import native as _native
 from ..common.exceptions import HorovodInternalError
 from ..common.host_world import NUMPY_DTYPE_CODES, world as _world
 from ..ops.xla import Adasum, Average, Max, Min, ReduceOp, Sum  # noqa: F401
+from . import native_ops as _native_ops
 
 __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
@@ -175,6 +188,18 @@ def _np_broadcast(arr: np.ndarray, root_rank: int, name: str) -> np.ndarray:
 # ---- TF op wrappers with gradients ------------------------------------------
 
 
+def _kernels():
+    """The native kernel library when the in-graph path is usable (multi-
+    process native world + built extension), else None. Native kernels are
+    real TF AsyncOpKernels driven by the TF executor — no py_function
+    Python hop in the data path (reference tensorflow/mpi_ops.cc:287-466);
+    py_function remains the fallback."""
+    w = _world()
+    if not (w.initialized and w.native):
+        return None
+    return _native_ops.load()
+
+
 def _to_numpy(tensor: tf.Tensor) -> np.ndarray:
     return tensor.numpy() if hasattr(tensor, "numpy") else np.asarray(tensor)
 
@@ -200,6 +225,12 @@ def _allreduce(tensor: tf.Tensor, name: Optional[str] = None, op: int = Sum,
     """Raw summing allreduce, no gradient (parity:
     ``tensorflow/mpi_ops.py:89-110`` ``_allreduce``)."""
     name = name or _auto_name("allreduce")
+    k = _kernels()
+    if k is not None:
+        return k.horovod_tpu_allreduce(
+            tensor, tensor_name=name, reduce_op=op,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor)
     return _wrap(
         lambda a: _np_allreduce(a, name, op, prescale_factor,
                                 postscale_factor), tensor)
@@ -217,16 +248,25 @@ def allgather(tensor: tf.Tensor, name: Optional[str] = None) -> tf.Tensor:
 
     @tf.custom_gradient
     def _fn(t):
-        out = _wrap(lambda a: _np_allgather(a, name), t, same_shape=False)
+        k = _kernels()
+        if k is not None:
+            out = k.horovod_tpu_allgather(t, tensor_name=name)
+        else:
+            out = _wrap(lambda a: _np_allgather(a, name), t,
+                        same_shape=False)
         if t.shape.rank is not None and t.shape.rank > 0:
             out.set_shape(tf.TensorShape([None]).concatenate(t.shape[1:]))
 
         def grad(dy):
             summed = _allreduce(dy, name=name + ".grad", op=Sum)
-            sizes = _wrap(
-                lambda a: _np_allgather(a, name + ".grad.dim0"),
-                tf.reshape(tf.cast(dim0, tf.int64), [1]),
-                same_shape=False)
+            dim0v = tf.reshape(tf.cast(dim0, tf.int64), [1])
+            if k is not None:
+                sizes = k.horovod_tpu_allgather(
+                    dim0v, tensor_name=name + ".grad.dim0")
+            else:
+                sizes = _wrap(
+                    lambda a: _np_allgather(a, name + ".grad.dim0"),
+                    dim0v, same_shape=False)
             offset = tf.reduce_sum(sizes[: rank()])
             return tf.slice(
                 summed, tf.concat(
@@ -250,7 +290,12 @@ def broadcast(tensor: tf.Tensor, root_rank: int,
 
     @tf.custom_gradient
     def _fn(t):
-        out = _wrap(lambda a: _np_broadcast(a, root_rank, name), t)
+        k = _kernels()
+        if k is not None:
+            out = k.horovod_tpu_broadcast(t, tensor_name=name,
+                                          root_rank=root_rank)
+        else:
+            out = _wrap(lambda a: _np_broadcast(a, root_rank, name), t)
         out.set_shape(t.shape)
 
         def grad(dy):

@@ -1,0 +1,128 @@
+"""Loader for the native TF op library (libhvdtf.so).
+
+Parity: the reference's TF binding loads its compiled kernel extension via
+``load_library`` (``tensorflow/mpi_ops.py:89``); here the extension is
+built on demand against the installed TF (see ``csrc/Makefile``) and
+dlopens the shared native runtime so kernels enqueue into the same
+controller world the Python API uses. When the build or load fails the TF
+binding falls back to the ``tf.py_function`` path transparently.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Optional
+
+from ..common import logging as _log
+from ..common import native as _native
+
+_CSRC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "csrc")
+_LIB_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "lib",
+    "libhvdtf.so")
+
+_ops = None
+_tried = False
+
+
+def _build() -> bool:
+    """Build the extension under an exclusive file lock with an atomic
+    rename, so concurrent ranks on one host never dlopen a half-written
+    shared object (the loser of the lock race finds the finished .so)."""
+    try:
+        import fcntl
+
+        import tensorflow as tf
+
+        env = dict(os.environ)
+        env["TF_CFLAGS"] = " ".join(tf.sysconfig.get_compile_flags())
+        env["TF_LFLAGS"] = " ".join(tf.sysconfig.get_link_flags())
+        os.makedirs(os.path.dirname(_LIB_PATH), exist_ok=True)
+        with open(_LIB_PATH + ".lock", "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            if os.path.exists(_LIB_PATH):
+                return True
+            tmp = _LIB_PATH + f".build.{os.getpid()}"
+            env["OUT"] = tmp
+            subprocess.run(["make", "-C", _CSRC_DIR, f"OUT={tmp}"],
+                           check=True, env=env, capture_output=True,
+                           timeout=600)
+            os.rename(tmp, _LIB_PATH)
+        return os.path.exists(_LIB_PATH)
+    except Exception as e:
+        _log.warning(f"native TF op build failed: {e}")
+        return False
+
+
+def load():
+    """Returns the op module (with HorovodTpuAllreduce/Allgather/Broadcast)
+    or None when the native path is unavailable."""
+    global _ops, _tried
+    if _ops is not None or _tried:
+        return _ops
+    _tried = True
+    if os.environ.get("HOROVOD_NATIVE", "1") in ("0", "false"):
+        return None
+    # The kernels resolve the runtime's C API from the ctypes-loaded
+    # libhvdtpu.so; export its path so the extension dlopens the same copy.
+    if _native.load_library() is None:
+        return None
+    os.environ.setdefault("HVDTPU_LIB", _native._LIB_PATH)
+    if not os.path.exists(_LIB_PATH) and not _build():
+        return None
+    try:
+        import tensorflow as tf
+
+        _ops = tf.load_op_library(_LIB_PATH)
+        _register_gradients(_ops)
+        _log.debug("native TF op library loaded")
+    except Exception as e:
+        _log.warning(f"native TF op load failed: {e}")
+        _ops = None
+    return _ops
+
+
+def _register_gradients(k) -> None:
+    """Gradient table for the raw kernels (parity: the reference's
+    RegisterGradient entries, ``tensorflow/mpi_ops.py:89-197``): allreduce'
+    = allreduce; allgather' = allreduce + this rank's dim-0 slice;
+    broadcast' = allreduce, zeroed off-root. Backward collectives derive
+    their names from the forward tensor_name so they stay deterministic
+    across ranks."""
+    import tensorflow as tf
+    from tensorflow.python.framework import ops as tf_framework_ops
+
+    @tf_framework_ops.RegisterGradient("HorovodTpuAllreduce")
+    def _allreduce_grad(op, grad):  # noqa: ANN001
+        name = op.get_attr("tensor_name").decode() + ".bwd"
+        return k.horovod_tpu_allreduce(grad, tensor_name=name, reduce_op=1)
+
+    @tf_framework_ops.RegisterGradient("HorovodTpuAllgather")
+    def _allgather_grad(op, grad):  # noqa: ANN001
+        name = op.get_attr("tensor_name").decode()
+        from .mpi_ops import rank
+
+        summed = k.horovod_tpu_allreduce(grad, tensor_name=name + ".bwd",
+                                         reduce_op=1)
+        dim0 = tf.shape(op.inputs[0], out_type=tf.int64)[0]
+        sizes = k.horovod_tpu_allgather(tf.reshape(dim0, [1]),
+                                        tensor_name=name + ".bwd.dim0")
+        offset = tf.cast(tf.reduce_sum(sizes[: rank()]), tf.int32)
+        n = tf.cast(dim0, tf.int32)
+        begin = tf.concat(
+            [[offset], tf.zeros([tf.rank(grad) - 1], tf.int32)], axis=0)
+        size = tf.concat([[n], tf.fill([tf.rank(grad) - 1], -1)], axis=0)
+        return tf.slice(summed, begin, size)
+
+    @tf_framework_ops.RegisterGradient("HorovodTpuBroadcast")
+    def _broadcast_grad(op, grad):  # noqa: ANN001
+        name = op.get_attr("tensor_name").decode() + ".bwd"
+        root = op.get_attr("root_rank")
+        from .mpi_ops import rank
+
+        summed = k.horovod_tpu_allreduce(grad, tensor_name=name,
+                                         reduce_op=1)
+        if rank() == root:
+            return summed
+        return tf.zeros_like(summed)

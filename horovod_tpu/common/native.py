@@ -122,6 +122,11 @@ def load_library():
     lib.hvd_stall_report.restype = ctypes.c_int
     lib.hvd_stall_report.argtypes = [ctypes.POINTER(ctypes.c_char),
                                      ctypes.c_int]
+    lib.hvd_set_record_negotiation.restype = None
+    lib.hvd_set_record_negotiation.argtypes = [ctypes.c_int]
+    lib.hvd_drain_negotiation.restype = ctypes.c_int
+    lib.hvd_drain_negotiation.argtypes = [ctypes.POINTER(ctypes.c_char),
+                                          ctypes.c_int]
     lib.hvd_get_fusion_threshold.restype = ctypes.c_longlong
     _lib = lib
     return _lib
@@ -213,6 +218,7 @@ class NativeCore:
         self.lib = load_library()
         self.available = self.lib is not None
         self._executor = None
+        self._neg_buf = None  # lazily-allocated drain buffer (hot path)
 
     def init(self, rank: int, size: int, local_rank: int, local_size: int,
              cross_rank: int, cross_size: int, coordinator_addr: str,
@@ -314,6 +320,29 @@ class NativeCore:
     def cache_hits(self) -> int:
         """Requests this rank sent as 4-byte cache ids (fast path)."""
         return int(self.lib.hvd_cache_hits())
+
+    def set_record_negotiation(self, enabled: bool) -> None:
+        """Record per-rank submission ticks on the coordinator (reference
+        Timeline::NegotiateRankReady, controller.cc:797-809)."""
+        self.lib.hvd_set_record_negotiation(1 if enabled else 0)
+
+    def drain_negotiation(self):
+        """Drained ticks as (rank, mono_ns, tensor_name) tuples. Loops
+        until the native side reports empty (it requeues whole events that
+        did not fit, so partial drains never lose ticks)."""
+        buf = self._neg_buf
+        if buf is None:
+            buf = self._neg_buf = ctypes.create_string_buffer(1 << 16)
+        out = []
+        while True:
+            n = self.lib.hvd_drain_negotiation(buf, len(buf))
+            if n <= 0:
+                break
+            for line in buf.raw[:n].decode(errors="replace").splitlines():
+                parts = line.split(" ", 2)
+                if len(parts) == 3:
+                    out.append((int(parts[0]), int(parts[1]), parts[2]))
+        return out
 
     def stall_report(self) -> str:
         """Accumulated stall-inspector warnings (coordinator); consumed on

@@ -168,6 +168,10 @@ def init(comm=None, devices=None):
                 _state.config.timeline_filename,
                 mark_cycles=_state.config.timeline_mark_cycles,
             )
+            if _state.engine.native_core is not None:
+                # Record per-rank negotiation ticks while the timeline is
+                # active (reference NegotiateRankReady).
+                _state.engine.native_core.set_record_negotiation(True)
 
         if _state.config.autotune and _state.engine.native_core is None:
             _log.warning(

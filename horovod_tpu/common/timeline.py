@@ -114,6 +114,28 @@ class Timeline:
             }
         )
 
+    def rank_ready(self, tensor_name: str, rank: int,
+                   mono_ns: Optional[int] = None):
+        """Per-rank negotiation tick (parity: Timeline::NegotiateRankReady,
+        reference controller.cc:797-809): marks when ``rank``'s submission
+        for ``tensor_name`` reached the coordinator, so stragglers are
+        visible inside the NEGOTIATE span. ``mono_ns`` is a
+        CLOCK_MONOTONIC timestamp (the native controller's clock, the same
+        clock as ``time.monotonic_ns``)."""
+        ts = (self._ts_us() if mono_ns is None
+              else (mono_ns - self._start_ns) / 1e3)
+        self._emit(
+            {
+                "name": f"RANK_READY[{rank}]",
+                "ph": "i",
+                "s": "t",
+                "pid": self._pid,
+                "tid": self._tid(tensor_name),
+                "ts": ts,
+                "args": {"rank": rank},
+            }
+        )
+
     def mark_cycle(self):
         if self._mark_cycles:
             self.instant("CYCLE")

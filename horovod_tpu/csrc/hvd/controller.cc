@@ -1,6 +1,7 @@
 #include "controller.h"
 
 #include <algorithm>
+#include <chrono>
 #include <cstdio>
 #include <cstdlib>
 
@@ -157,6 +158,18 @@ std::vector<Response> Controller::FuseResponses(std::vector<Response> singles,
   return fused;
 }
 
+void Controller::RecordNegotiationEvent(const std::string& name, int rank) {
+  if (!record_negotiation_.load(std::memory_order_relaxed)) return;
+  auto ns = std::chrono::duration_cast<std::chrono::nanoseconds>(
+                std::chrono::steady_clock::now().time_since_epoch())
+                .count();
+  std::lock_guard<std::mutex> lk(events_mu_);
+  if (events_.size() >= 65536) {
+    events_.erase(events_.begin(), events_.begin() + 32768);
+  }
+  events_.push_back({name, rank, static_cast<int64_t>(ns)});
+}
+
 // ---- LocalController -------------------------------------------------------
 
 std::vector<Response> LocalController::ComputeResponseList(
@@ -200,7 +213,10 @@ Status TcpController::Initialize() {
     worker_socks_.resize(cfg_.size - 1);
     data_endpoints_.assign(cfg_.size, {"", 0});
     data_endpoints_[0] = {my_host_, data_port_};
-    // Accept size-1 hellos: "rank host data_port".
+    // Accept size-1 hellos: "rank host data_port job_key". The job key
+    // guards against two jobs sharing one host colliding on the default
+    // controller port: a worker from another job is rejected loudly
+    // instead of being adopted into the wrong world.
     for (int i = 0; i < cfg_.size - 1; ++i) {
       Socket s = listener_.Accept(120000);
       if (!s.valid()) {
@@ -213,10 +229,21 @@ Status TcpController::Initialize() {
       }
       int rank = 0, port = 0;
       char host[256] = {0};
-      if (std::sscanf(hello.c_str(), "%d %255s %d", &rank, host, &port) != 3 ||
-          rank <= 0 || rank >= cfg_.size) {
+      char key[256] = {0};
+      int fields =
+          std::sscanf(hello.c_str(), "%d %255s %d %255s", &rank, host,
+                      &port, key);
+      if (fields < 3 || rank <= 0 || rank >= cfg_.size) {
         return Status::Error(StatusType::UNKNOWN_ERROR,
                              "malformed worker hello: " + hello);
+      }
+      if (std::string(key) != cfg_.job_key) {
+        s.SendFrame("JOBKEY_MISMATCH");
+        return Status::Error(
+            StatusType::UNKNOWN_ERROR,
+            "worker connected with a different job key — another job is "
+            "using this controller port (set HOROVOD_CONTROLLER_PORT to "
+            "distinct values per job)");
       }
       data_endpoints_[rank] = {host, port};
       worker_socks_[rank - 1] = std::move(s);
@@ -244,7 +271,7 @@ Status TcpController::Initialize() {
                                std::to_string(cfg_.coordinator_port));
     }
     std::string hello = std::to_string(cfg_.rank) + " " + my_host_ + " " +
-                        std::to_string(data_port_);
+                        std::to_string(data_port_) + " " + cfg_.job_key;
     if (!coord_sock_.SendFrame(hello)) {
       return Status::Error(StatusType::UNKNOWN_ERROR, "hello send failed");
     }
@@ -252,6 +279,13 @@ Status TcpController::Initialize() {
     if (!coord_sock_.RecvFrame(&map_bytes)) {
       return Status::Error(StatusType::UNKNOWN_ERROR,
                            "endpoint map receive failed");
+    }
+    if (map_bytes == "JOBKEY_MISMATCH") {
+      return Status::Error(
+          StatusType::UNKNOWN_ERROR,
+          "coordinator rejected this worker's job key — another job is "
+          "using this controller port (set HOROVOD_CONTROLLER_PORT to "
+          "distinct values per job)");
     }
     Reader r(map_bytes);
     int n = r.i32();
@@ -373,6 +407,7 @@ std::vector<Response> TcpController::CoordinatorCycle(
         continue;
       }
       stall_.RecordRank(q.name, q.rank);
+      RecordNegotiationEvent(q.name, q.rank);
       auto& group = pending_[q.name];
       group.push_back(q);
     }
@@ -381,6 +416,7 @@ std::vector<Response> TcpController::CoordinatorCycle(
       if (cache_.Get(id, &q)) {
         q.rank = default_rank;
         stall_.RecordRank(q.name, q.rank);
+        RecordNegotiationEvent(q.name, q.rank);
         auto& group = pending_[q.name];
         group.push_back(q);
         }

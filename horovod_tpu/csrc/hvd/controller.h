@@ -38,6 +38,10 @@ struct ControllerConfig {
   double stall_warning_sec = 60.0;
   double stall_shutdown_sec = 0.0;
   bool stall_check_enabled = true;
+  // Shared per-job secret (launcher-generated): hellos carrying a
+  // different key are rejected so concurrent jobs on one host can't
+  // cross-connect through a shared default port.
+  std::string job_key;
 };
 
 class Controller {
@@ -107,6 +111,33 @@ class Controller {
     return cache_hits_.load(std::memory_order_relaxed);
   }
 
+  // Per-rank negotiation ticks (reference Timeline::NegotiateRankReady,
+  // controller.cc:797-809): when enabled, the coordinator records the
+  // monotonic time each rank's submission arrives, so the timeline can
+  // show which rank straggled. Bounded buffer; oldest events drop.
+  void set_record_negotiation(bool on) {
+    record_negotiation_.store(on, std::memory_order_relaxed);
+  }
+  struct NegotiationEvent {
+    std::string name;
+    int rank;
+    int64_t mono_ns;
+  };
+  std::vector<NegotiationEvent> DrainNegotiationEvents() {
+    std::lock_guard<std::mutex> lk(events_mu_);
+    std::vector<NegotiationEvent> out;
+    out.swap(events_);
+    return out;
+  }
+  // Put back events a bounded drain could not deliver (oldest first).
+  void RequeueNegotiationEvents(std::vector<NegotiationEvent> undelivered) {
+    std::lock_guard<std::mutex> lk(events_mu_);
+    undelivered.insert(undelivered.end(),
+                       std::make_move_iterator(events_.begin()),
+                       std::make_move_iterator(events_.end()));
+    events_ = std::move(undelivered);
+  }
+
  protected:
   // Shared machinery (used by both concrete controllers).
   // Validates that all ranks' requests for one tensor agree on
@@ -117,6 +148,8 @@ class Controller {
   // Bin single-tensor responses into fused responses under the threshold.
   static std::vector<Response> FuseResponses(std::vector<Response> singles,
                                              int64_t threshold_bytes);
+  // Record a per-rank negotiation tick (no-op unless enabled).
+  void RecordNegotiationEvent(const std::string& name, int rank);
 
   ControllerConfig cfg_;
   std::atomic<int64_t> fusion_threshold_bytes_;
@@ -124,6 +157,9 @@ class Controller {
   std::atomic<double> synced_cycle_ms_{-1.0};
   std::atomic<int64_t> cache_hits_{0};
   std::mutex stall_report_mu_;
+  std::atomic<bool> record_negotiation_{false};
+  std::mutex events_mu_;
+  std::vector<NegotiationEvent> events_;
   std::vector<std::pair<std::string, int>> data_endpoints_;
   std::string stall_report_;
 };

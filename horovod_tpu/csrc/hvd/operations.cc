@@ -18,6 +18,7 @@
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
+#include <cstdlib>
 #include <cstring>
 #include <memory>
 #include <mutex>
@@ -384,6 +385,9 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   cfg.stall_warning_sec = stall_warning_sec;
   cfg.stall_shutdown_sec = stall_shutdown_sec;
   cfg.stall_check_enabled = stall_check_enabled != 0;
+  // Per-job isolation key (launcher-exported, same on every rank): guards
+  // the shared default controller port against cross-job connections.
+  if (const char* jk = std::getenv("HOROVOD_JOB_KEY")) cfg.job_key = jk;
 
   if (size <= 1) {
     s->controller = std::make_unique<hvd::LocalController>(cfg);
@@ -474,6 +478,41 @@ long long hvd_cache_hits() {
   std::lock_guard<std::mutex> lk(s->init_mu);
   return s->controller ? static_cast<long long>(s->controller->cache_hits())
                        : 0;
+}
+
+// Per-rank negotiation ticks (reference Timeline::NegotiateRankReady,
+// controller.cc:797-809). Enable alongside the timeline, then drain
+// periodically: each line is "<rank> <steady-clock ns> <tensor name>".
+void hvd_set_record_negotiation(int enabled) {
+  auto* s = hvd::g();
+  std::lock_guard<std::mutex> lk(s->init_mu);
+  if (s->controller) s->controller->set_record_negotiation(enabled != 0);
+}
+
+int hvd_drain_negotiation(char* buf, int cap) {
+  auto* s = hvd::g();
+  std::lock_guard<std::mutex> lk(s->init_mu);
+  if (s->controller == nullptr || buf == nullptr || cap <= 0) return 0;
+  // Consume only whole events that fit; the rest stay queued for the next
+  // call (same no-silent-truncation rule as hvd_stall_report).
+  auto events = s->controller->DrainNegotiationEvents();
+  std::string text;
+  size_t used = 0;
+  for (; used < events.size(); ++used) {
+    const auto& e = events[used];
+    std::string line = std::to_string(e.rank) + " " +
+                       std::to_string(e.mono_ns) + " " + e.name + "\n";
+    if (text.size() + line.size() > static_cast<size_t>(cap - 1)) break;
+    text += line;
+  }
+  if (used < events.size()) {
+    s->controller->RequeueNegotiationEvents(
+        std::vector<hvd::Controller::NegotiationEvent>(
+            events.begin() + used, events.end()));
+  }
+  std::memcpy(buf, text.data(), text.size());
+  buf[text.size()] = '\0';
+  return static_cast<int>(text.size());
 }
 
 int hvd_stall_report(char* buf, int cap) {

@@ -70,14 +70,16 @@ def _shard_map(fn, mesh, in_specs, out_specs):
 class _Pending:
     """A tensor submitted to the native queue, awaiting execution."""
 
-    __slots__ = ("stacked", "was_list", "was_unstacked", "kind", "op",
-                 "prescale", "postscale", "root", "result", "error")
+    __slots__ = ("stacked", "was_list", "was_unstacked", "was_device",
+                 "kind", "op", "prescale", "postscale", "root", "result",
+                 "error")
 
     def __init__(self, stacked, was_list, was_unstacked, kind, op=None,
-                 prescale=1.0, postscale=1.0, root=-1):
+                 prescale=1.0, postscale=1.0, root=-1, was_device=False):
         self.stacked = stacked
         self.was_list = was_list
         self.was_unstacked = was_unstacked
+        self.was_device = was_device
         self.kind = kind
         self.op = op
         self.prescale = prescale
@@ -194,6 +196,11 @@ class EagerEngine:
 
     def _execute_response(self, resp: "_native.NativeResponse"):
         timeline = self._state.timeline
+        if timeline and self._native:
+            # Per-rank negotiation ticks recorded by the coordinator
+            # (reference NegotiateRankReady, controller.cc:797-809).
+            for rank, mono_ns, tname in self._core.drain_negotiation():
+                timeline.rank_ready(tname, rank, mono_ns)
         names = resp.names
         found = {n: self._pending[n] for n in names if n in self._pending}
         entries = list(found.values())
@@ -225,7 +232,7 @@ class EagerEngine:
                 p = found.get(n)
                 if p is not None:
                     p.result = self._from_global_sharded(
-                        r, p.was_list, p.was_unstacked)
+                        r, p.was_list, p.was_unstacked, p.was_device)
         elif kind == "allgather":
             L = self._state.local_size
             size = self._state.size
@@ -250,23 +257,25 @@ class EagerEngine:
                     p.result = np.concatenate(
                         [views[c, : fd[c // L]] for c in range(size)],
                         axis=0)
+                elif p.was_device:
+                    p.result = self._exec_allgather(p.stacked)
                 else:
                     p.result = np.asarray(self._exec_allgather(p.stacked))
         elif kind == "broadcast":
             for p in entries:
                 out = self._exec_broadcast(p.stacked, p.root)
                 p.result = self._from_global_sharded(
-                    out, p.was_list, p.was_unstacked)
+                    out, p.was_list, p.was_unstacked, p.was_device)
         elif kind == "reducescatter":
             for p in entries:
                 out = self._exec_reducescatter(p.stacked, p.op)
                 p.result = self._from_global_sharded(
-                    out, p.was_list, p.was_unstacked)
+                    out, p.was_list, p.was_unstacked, p.was_device)
         elif kind == "alltoall":
             for p in entries:
                 out = self._exec_alltoall(p.stacked)
                 p.result = self._from_global_sharded(
-                    out, p.was_list, p.was_unstacked)
+                    out, p.was_list, p.was_unstacked, p.was_device)
         else:
             raise ValueError(f"unknown response kind {kind}")
         if timeline:
@@ -281,23 +290,36 @@ class EagerEngine:
             self._name_counter += 1
             return f"{prefix}.noname.{self._name_counter}"
 
-    def _normalize(self, tensor) -> Tuple[jnp.ndarray, bool, bool]:
+    def _normalize(self, tensor) -> Tuple[jnp.ndarray, bool, bool, bool]:
         """Returns (stacked [local_size, ...] array, was_list,
-        was_unstacked)."""
+        was_unstacked, was_device). ``was_device`` marks inputs that were
+        already jax Arrays: their results stay device-resident (no host
+        round-trip in ``_from_global_sharded``)."""
         L = self._state.local_size
         if isinstance(tensor, (list, tuple)):
             if len(tensor) != L:
                 raise ValueError(
                     f"eager collective got a list of {len(tensor)} tensors; "
                     f"expected local_size={L} (one per locally-driven chip)")
-            return jnp.stack([jnp.asarray(t) for t in tensor]), True, False
+            dev = all(isinstance(t, jax.Array) for t in tensor)
+            ts = [jnp.asarray(t) for t in tensor]
+            if dev and len({
+                    next(iter(t.devices())) for t in ts}) > 1:
+                # Chained collectives hand back per-chip views living on
+                # different devices; stage them on one device (a
+                # device-to-device move, still no host hop) so stacking is
+                # legal.
+                target = self._state.local_devices[0]
+                ts = [jax.device_put(t, target) for t in ts]
+            return jnp.stack(ts), True, False, dev
+        dev = isinstance(tensor, jax.Array)
         t = jnp.asarray(tensor)
         if L == 1:
-            return t[None], False, True
+            return t[None], False, True, dev
         if t.ndim >= 1 and t.shape[0] == L:
-            return t, False, False
+            return t, False, False, dev
         # Replicated convenience: same tensor on every local participant.
-        return jnp.broadcast_to(t[None], (L,) + t.shape), False, True
+        return jnp.broadcast_to(t[None], (L,) + t.shape), False, True, dev
 
     def _to_global(self, stacked, mesh=None, spec=None):
         """Build the global (size, ...) array sharded one-slice-per-chip.
@@ -313,11 +335,34 @@ class EagerEngine:
         return jax.make_array_from_process_local_data(
             sharding, np.asarray(stacked), global_shape)
 
-    def _from_global_sharded(self, arr, was_list, was_unstacked):
+    def _from_global_sharded(self, arr, was_list, was_unstacked,
+                             device=False):
         """Extract this process's local slices of a P('hvd')-sharded
-        result."""
+        result.
+
+        ``device=True`` (inputs were device-resident jax Arrays) keeps the
+        result on-device: per-shard views are returned directly with no
+        host round-trip, so chained eager collectives stay at device
+        bandwidth. Host inputs (numpy/torch) keep returning numpy — the
+        reference API contract (and the concatenate below is the one host
+        hop the eager API performs for them)."""
         shards = sorted(arr.addressable_shards,
                         key=lambda s: s.index[0].start)
+        if device:
+            if was_list:
+                return [s.data[0] for s in shards]
+            if was_unstacked:
+                return shards[0].data[0]
+            if len(shards) == 1:
+                return shards[0].data
+            # Stacked convention with multiple local chips: the per-shard
+            # views are committed to different devices, so stage them on
+            # one device before concatenating (device-to-device, no host
+            # hop) — concatenating committed mixed-device arrays is an
+            # error in jax.
+            target = self._state.local_devices[0]
+            return jnp.concatenate(
+                [jax.device_put(s.data, target) for s in shards], axis=0)
         local = np.concatenate([np.asarray(s.data) for s in shards], axis=0)
         if was_list:
             return [local[i] for i in range(local.shape[0])]
@@ -448,7 +493,7 @@ class EagerEngine:
 
     def _submit(self, kind: str, name: Optional[str], stacked, was_list,
                 was_unstacked, op=None, prescale=1.0, postscale=1.0,
-                root=-1) -> int:
+                root=-1, was_device=False) -> int:
         name = name or self._auto_name(kind)
         timeline = self._state.timeline
         if self._native:
@@ -461,7 +506,7 @@ class EagerEngine:
                         "yet complete")
                 self._pending[name] = _Pending(
                     stacked, was_list, was_unstacked, kind, op, prescale,
-                    postscale, root)
+                    postscale, root, was_device)
             handle = self._core.enqueue(
                 name, _OP_TO_NATIVE[kind], op if op is not None else 1,
                 self._dtype_code(stacked), tuple(stacked.shape[1:]),
@@ -494,22 +539,24 @@ class EagerEngine:
                 out = self._exec_grouped_allreduce([stacked], op, prescale,
                                                    postscale)[0]
                 post = lambda a: self._from_global_sharded(  # noqa: E731
-                    a, was_list, was_unstacked)
+                    a, was_list, was_unstacked, was_device)
             elif kind == "allgather":
                 out = self._exec_allgather(stacked)
-                post = lambda a: np.asarray(a)  # noqa: E731
+                post = (  # noqa: E731
+                    (lambda a: a) if was_device else
+                    (lambda a: np.asarray(a)))
             elif kind == "broadcast":
                 out = self._exec_broadcast(stacked, root)
                 post = lambda a: self._from_global_sharded(  # noqa: E731
-                    a, was_list, was_unstacked)
+                    a, was_list, was_unstacked, was_device)
             elif kind == "reducescatter":
                 out = self._exec_reducescatter(stacked, op)
                 post = lambda a: self._from_global_sharded(  # noqa: E731
-                    a, was_list, was_unstacked)
+                    a, was_list, was_unstacked, was_device)
             elif kind == "alltoall":
                 out = self._exec_alltoall(stacked)
                 post = lambda a: self._from_global_sharded(  # noqa: E731
-                    a, was_list, was_unstacked)
+                    a, was_list, was_unstacked, was_device)
             else:
                 raise ValueError(kind)
             self._record_autotune([stacked])
@@ -539,14 +586,16 @@ class EagerEngine:
                         op: int = _xla.ReduceOp.SUM,
                         prescale_factor: float = 1.0,
                         postscale_factor: float = 1.0) -> int:
-        stacked, was_list, was_unstacked = self._normalize(tensor)
+        stacked, was_list, was_unstacked, was_device = \
+            self._normalize(tensor)
         if op == _xla.ReduceOp.ADASUM and not _is_pow2(self._state.size):
             _log.warning("Adasum requested with non-power-of-two size; "
                          "falling back to Average")
             op = _xla.ReduceOp.AVERAGE
         return self._submit("allreduce", name, stacked, was_list,
                             was_unstacked, op=op, prescale=prescale_factor,
-                            postscale=postscale_factor)
+                            postscale=postscale_factor,
+                            was_device=was_device)
 
     def grouped_allreduce_async(self, tensors: List,
                                 name: Optional[str] = None,
@@ -567,8 +616,8 @@ class EagerEngine:
             outs, err = None, e
 
         def post(arrs):
-            return [self._from_global_sharded(a, wl, wu)
-                    for a, (_, wl, wu) in zip(arrs, norm)]
+            return [self._from_global_sharded(a, wl, wu, dev)
+                    for a, (_, wl, wu, dev) in zip(arrs, norm)]
 
         return self._new_direct_handle(outs if err is None else err,
                                        post if err is None else None, name)
@@ -588,8 +637,9 @@ class EagerEngine:
                         "process is not supported across processes; use "
                         "one chip per process or equal first dimensions")
                 return self._ragged_local_allgather(ts, name)
-        stacked, wl, wu = self._normalize(tensor)
-        return self._submit("allgather", name, stacked, wl, wu)
+        stacked, wl, wu, dev = self._normalize(tensor)
+        return self._submit("allgather", name, stacked, wl, wu,
+                            was_device=dev)
 
     def _ragged_local_allgather(self, ts: List, name: Optional[str]) -> int:
         name = name or self._auto_name("allgather")
@@ -616,24 +666,26 @@ class EagerEngine:
 
     def broadcast_async(self, tensor, root_rank: int,
                         name: Optional[str] = None) -> int:
-        stacked, wl, wu = self._normalize(tensor)
+        stacked, wl, wu, dev = self._normalize(tensor)
         return self._submit("broadcast", name, stacked, wl, wu,
-                            root=root_rank)
+                            root=root_rank, was_device=dev)
 
     def reducescatter_async(self, tensor, name: Optional[str] = None,
                             op: int = _xla.ReduceOp.SUM) -> int:
-        stacked, wl, wu = self._normalize(tensor)
+        stacked, wl, wu, dev = self._normalize(tensor)
         if stacked.shape[1] % self._state.size != 0:
             raise ValueError(
                 "reducescatter requires dim 0 divisible by size "
                 f"({stacked.shape[1]} % {self._state.size})")
-        return self._submit("reducescatter", name, stacked, wl, wu, op=op)
+        return self._submit("reducescatter", name, stacked, wl, wu, op=op,
+                            was_device=dev)
 
     def alltoall_async(self, tensor, name: Optional[str] = None) -> int:
-        stacked, wl, wu = self._normalize(tensor)
+        stacked, wl, wu, dev = self._normalize(tensor)
         if stacked.shape[1] % self._state.size != 0:
             raise ValueError("alltoall requires dim 0 divisible by size")
-        return self._submit("alltoall", name, stacked, wl, wu)
+        return self._submit("alltoall", name, stacked, wl, wu,
+                            was_device=dev)
 
     def join(self) -> int:
         """Graceful departure (parity: hvd.join(), operations.cc:937-961).

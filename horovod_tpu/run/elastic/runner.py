@@ -47,6 +47,8 @@ def run_elastic(args, command: List[str],
     config_parser.set_env_from_args(env, args)
     env[_config.HOROVOD_ELASTIC] = "1"
     env["HOROVOD_SECRET_KEY"] = base64.b64encode(key).decode()
+    # Controller-level job isolation (see launch.launch_workers).
+    env.setdefault("HOROVOD_JOB_KEY", os.urandom(8).hex())
 
     driver = ElasticDriver(
         rendezvous, discovery, min_np=min_np, max_np=max_np,

@@ -98,6 +98,12 @@ def launch_workers(host_alloc_plan: List[SlotInfo], command: List[str],
     all_events = list(events or []) + [abort]
     threads = []
 
+    # One shared per-job key: the native controller rejects hellos carrying
+    # a different key, so two jobs colliding on a default controller port
+    # fail loudly instead of cross-connecting.
+    base_env = dict(base_env if base_env is not None else os.environ)
+    base_env.setdefault("HOROVOD_JOB_KEY", os.urandom(8).hex())
+
     def run_slot(i: int, slot: SlotInfo):
         env = slot_env(slot, controller_addr, controller_port,
                        rendezvous_addr, rendezvous_port, base_env)

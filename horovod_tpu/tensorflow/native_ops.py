@@ -44,7 +44,6 @@ def _build() -> bool:
             if os.path.exists(_LIB_PATH):
                 return True
             tmp = _LIB_PATH + f".build.{os.getpid()}"
-            env["OUT"] = tmp
             subprocess.run(["make", "-C", _CSRC_DIR, f"OUT={tmp}"],
                            check=True, env=env, capture_output=True,
                            timeout=600)

@@ -526,3 +526,78 @@ def test_response_cache_fast_path_and_eviction(tmp_path):
     with a capacity-4 cache. Parity: reference response_cache.cc +
     CoordinateCacheAndState."""
     _run_workers(tmp_path, _CACHE_WORKER, "CACHE", timeout=180)
+
+
+_NEGOTIATION_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    from horovod_tpu.common import native as hn
+
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    core = hn.NativeCore()
+    assert core.init(rank=rank, size=2, local_rank=0, local_size=1,
+        cross_rank=rank, cross_size=2, coordinator_addr="127.0.0.1",
+        coordinator_port=port, my_host="127.0.0.1", cycle_time_ms=1.0,
+        fusion_threshold=64 << 20, cache_capacity=64,
+        stall_warning_sec=60.0, stall_shutdown_sec=0.0,
+        stall_check_enabled=True,
+        exec_callback=lambda r, i: core.response_done(i, False, "n/a"))
+
+    if rank == 0:
+        core.set_record_negotiation(True)
+    for i in range(3):
+        x = np.full(4, float(rank + 1), np.float32)
+        h = core.enqueue(f"neg.{i}", hn.OP_ALLREDUCE, 1, 7, x.shape,
+                         data_ptr=x.ctypes.data, output_ptr=x.ctypes.data,
+                         plane=hn.PLANE_HOST)
+        r, err = core.wait(h); assert r == 1, err
+    if rank == 0:
+        # Coordinator saw one tick per (tensor, rank): both ranks on all
+        # three tensors (reference NegotiateRankReady semantics).
+        events = core.drain_negotiation()
+        seen = {(e[0], e[2]) for e in events}
+        for i in range(3):
+            assert (0, f"neg.{i}") in seen, (i, events)
+            assert (1, f"neg.{i}") in seen, (i, events)
+        ts = [e[1] for e in events]
+        assert all(t > 0 for t in ts)
+        assert core.drain_negotiation() == []  # drained
+    core.shutdown()
+    print(f"NEG_{rank}_OK")
+""")
+
+
+def test_negotiation_rank_ready_ticks(tmp_path):
+    """Per-rank negotiation ticks (reference Timeline::NegotiateRankReady,
+    controller.cc:797-809): the coordinator records when each rank's
+    submission arrived, queryable for the timeline."""
+    _run_workers(tmp_path, _NEGOTIATION_WORKER, "NEG")
+
+
+_JOBKEY_WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    from horovod_tpu.common import native as hn
+
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    # Rank 1 simulates a worker from a DIFFERENT job (wrong key) racing to
+    # the same controller port: both sides must fail loudly, not adopt it.
+    os.environ["HOROVOD_JOB_KEY"] = "jobA" if rank == 0 else "jobB"
+    core = hn.NativeCore()
+    ok = core.init(rank=rank, size=2, local_rank=0, local_size=1,
+        cross_rank=rank, cross_size=2, coordinator_addr="127.0.0.1",
+        coordinator_port=port, my_host="127.0.0.1", cycle_time_ms=1.0,
+        fusion_threshold=64 << 20, cache_capacity=64,
+        stall_warning_sec=60.0, stall_shutdown_sec=0.0,
+        stall_check_enabled=True,
+        exec_callback=lambda r, i: core.response_done(i, False, "n/a"))
+    assert not ok, "cross-job connection must be rejected"
+    print(f"JOBKEY_{rank}_OK")
+""")
+
+
+def test_job_key_rejects_cross_job_worker(tmp_path):
+    """Two jobs colliding on one controller port fail loudly instead of
+    cross-connecting (HOROVOD_JOB_KEY hello validation)."""
+    _run_workers(tmp_path, _JOBKEY_WORKER, "JOBKEY")

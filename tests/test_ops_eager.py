@@ -268,3 +268,53 @@ class TestHierarchicalDispatch:
         got = np.asarray(s[0], dtype=np.float32)
         np.testing.assert_allclose(got, n * (1.0 + 2 ** -9), rtol=1e-2)
         assert s[0].dtype == jnp.bfloat16
+
+
+class TestDeviceResidentResults:
+    """Device-resident inputs produce device-resident results — no host
+    round-trip in the eager path (the fast path for chained eager
+    collectives); numpy inputs keep returning numpy."""
+
+    def test_jax_inputs_stay_on_device(self, hvd):
+        import jax
+        import jax.numpy as jnp
+
+        n = hvd.size()
+        xs = [jnp.full((4,), float(r), jnp.float32) for r in range(n)]
+        out = hvd.allreduce(xs, op=hvd.Sum, name="dev.ar")
+        assert all(isinstance(o, jax.Array) for o in out)
+        np.testing.assert_allclose(np.asarray(out[0]),
+                                   sum(range(n)))
+        g = hvd.allgather(xs, name="dev.ag")
+        assert isinstance(g, jax.Array)
+        assert g.shape == (4 * n,)
+
+    def test_numpy_inputs_stay_numpy(self, hvd):
+        n = hvd.size()
+        xs = [np.full((4,), float(r), np.float32) for r in range(n)]
+        out = hvd.allreduce(xs, op=hvd.Sum, name="np.ar")
+        assert all(isinstance(o, np.ndarray) for o in out)
+
+    def test_chained_device_collectives(self, hvd):
+        import jax.numpy as jnp
+
+        n = hvd.size()
+        xs = [jnp.ones((8,), jnp.float32) * (r + 1) for r in range(n)]
+        s1 = hvd.allreduce(xs, op=hvd.Sum, name="chain.1")
+        s2 = hvd.allreduce(s1, op=hvd.Average, name="chain.2")
+        np.testing.assert_allclose(np.asarray(s2[0]),
+                                   sum(range(1, n + 1)))
+
+    def test_stacked_jax_array_input(self, hvd):
+        # Regression: the stacked (non-list) convention with a jax.Array
+        # input must work on a multi-chip mesh — per-shard result views
+        # live on different devices and need staging before concat.
+        import jax
+        import jax.numpy as jnp
+
+        n = hvd.size()
+        stacked = jnp.tile(jnp.arange(3, dtype=jnp.float32)[None], (n, 1))
+        out = hvd.allreduce(stacked, op=hvd.Sum, name="dev.stacked")
+        assert isinstance(out, jax.Array)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.tile(np.arange(3) * n, (n, 1)))

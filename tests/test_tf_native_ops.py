@@ -69,10 +69,16 @@ _WORKER = textwrap.dedent("""
     assert np.allclose(ag.numpy()[:2], 0.0)
     assert np.allclose(ag.numpy()[2:], 1.0)
 
-    # broadcast from rank 1 + its gradient path
-    b = hvd.broadcast(tf.constant([float(hvd.rank() * 7 + 1)]),
-                      root_rank=1, name="k.bc")
+    # broadcast from rank 1 + its gradient path (allreduce of the upstream
+    # gradient, zeroed off-root)
+    bv = tf.Variable([float(hvd.rank() * 7 + 1)])
+    with tf.GradientTape() as tape:
+        b = hvd.broadcast(bv, root_rank=1, name="k.bc")
+        bl = tf.reduce_sum(b * 3.0)
     assert np.allclose(b.numpy(), 8.0), b.numpy()
+    bg = tape.gradient(bl, bv)
+    expect = 6.0 if hvd.rank() == 1 else 0.0  # summed over 2 ranks at root
+    assert np.allclose(bg.numpy(), expect), (hvd.rank(), bg.numpy())
 
     # int64 and bf16 dtypes through the kernel
     i = hvd.allreduce(tf.constant([2 ** 40 + hvd.rank()], tf.int64),

@@ -238,12 +238,16 @@ Status TcpController::Initialize() {
                              "malformed worker hello: " + hello);
       }
       if (std::string(key) != cfg_.job_key) {
+        // A stray worker from another job: reject it loudly and keep
+        // accepting — one foreign packet must not kill this job's startup.
+        std::fprintf(stderr,
+                     "[horovod_tpu coordinator] rejected worker with a "
+                     "different job key (another job sharing this "
+                     "controller port?)\n");
         s.SendFrame("JOBKEY_MISMATCH");
-        return Status::Error(
-            StatusType::UNKNOWN_ERROR,
-            "worker connected with a different job key — another job is "
-            "using this controller port (set HOROVOD_CONTROLLER_PORT to "
-            "distinct values per job)");
+        s.Close();
+        --i;
+        continue;
       }
       data_endpoints_[rank] = {host, port};
       worker_socks_[rank - 1] = std::move(s);

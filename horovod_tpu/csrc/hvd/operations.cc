@@ -20,6 +20,7 @@
 #include <condition_variable>
 #include <cstdlib>
 #include <cstring>
+#include <functional>
 #include <memory>
 #include <mutex>
 #include <thread>
@@ -387,7 +388,14 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   cfg.stall_check_enabled = stall_check_enabled != 0;
   // Per-job isolation key (launcher-exported, same on every rank): guards
   // the shared default controller port against cross-job connections.
-  if (const char* jk = std::getenv("HOROVOD_JOB_KEY")) cfg.job_key = jk;
+  // Hashed to a fixed hex token so any user-supplied charset/length works
+  // in the whitespace-delimited hello.
+  if (const char* jk = std::getenv("HOROVOD_JOB_KEY")) {
+    char tok[32];
+    std::snprintf(tok, sizeof(tok), "%zx",
+                  std::hash<std::string>{}(std::string(jk)));
+    cfg.job_key = tok;
+  }
 
   if (size <= 1) {
     s->controller = std::make_unique<hvd::LocalController>(cfg);

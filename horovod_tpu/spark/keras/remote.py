@@ -23,10 +23,8 @@ def make_remote_trainer(serialized_model: bytes, optimizer_bytes,
     """Build the function executed on every worker."""
 
     def trainer():
-        import numpy as np
-
         import horovod_tpu.keras as hvd
-        from ..common.util import read_shard, to_arrays
+        from ..common.reader import ShardReader
         from .util import deserialize_model
 
         hvd.init()
@@ -41,32 +39,55 @@ def make_remote_trainer(serialized_model: bytes, optimizer_bytes,
             opt = hvd.DistributedOptimizer(opt)
             model.compile(optimizer=opt, loss=loss, metrics=metrics or None)
 
-            pdf = read_shard(meta["train_data_path"], hvd.rank(), hvd.size())
-            if shuffle_buffer_size:
-                pdf = pdf.sample(frac=1.0, random_state=hvd.rank())
-            xs = to_arrays(pdf, meta["feature_cols"], meta)
-            ys = to_arrays(pdf, meta["label_cols"], meta)
-            x = xs[0] if len(xs) == 1 else xs
-            y = ys[0] if len(ys) == 1 else ys
+            # Streaming shard reader (the reference streams through
+            # Petastorm make_keras_dataset; bounded memory per worker).
+            reader = ShardReader(
+                meta["train_data_path"], meta, hvd.rank(), hvd.size(),
+                batch_size=batch_size, shuffle=bool(shuffle_buffer_size))
+            if reader.rows == 0:
+                # Fail loudly (the launcher aborts the job) rather than
+                # spin in fit() waiting for batches that never come.
+                raise ValueError(
+                    f"rank {hvd.rank()}'s training shard is empty: the "
+                    "dataset has fewer row groups than workers; increase "
+                    "num_partitions (or reduce the world size)")
+
+            def unwrap(cols):
+                return cols[0] if len(cols) == 1 else tuple(cols)
+
+            def gen():
+                epoch = 0
+                while True:  # keras pulls steps_per_epoch * epochs batches
+                    for xs, ys in reader.batches(epoch):
+                        yield unwrap(xs), unwrap(ys)
+                    epoch += 1
 
             val = None
             if meta.get("val_data_path"):
-                vdf = read_shard(meta["val_data_path"], hvd.rank(),
-                                 hvd.size())
-                if len(vdf):
-                    vx = to_arrays(vdf, meta["feature_cols"], meta)
-                    vy = to_arrays(vdf, meta["label_cols"], meta)
-                    val = (vx[0] if len(vx) == 1 else vx,
-                           vy[0] if len(vy) == 1 else vy)
+                vreader = ShardReader(
+                    meta["val_data_path"], meta, hvd.rank(), hvd.size(),
+                    batch_size=batch_size, shuffle=False)
+                if vreader.rows:
+                    vx, vy = [], []
+                    for bxs, bys in vreader.batches():
+                        vx.append(bxs)
+                        vy.append(bys)
+                    import numpy as np
+
+                    val = (unwrap([np.concatenate([b[c] for b in vx])
+                                   for c in range(len(vx[0]))]),
+                           unwrap([np.concatenate([b[c] for b in vy])
+                                   for c in range(len(vy[0]))]))
 
             cbs = [hvd.callbacks.BroadcastGlobalVariablesCallback(0),
                    hvd.callbacks.MetricAverageCallback()]
             cbs.extend(callbacks or [])
 
             history = model.fit(
-                x, y, batch_size=batch_size, epochs=epochs,
+                gen(), epochs=epochs,
+                steps_per_epoch=(train_steps_per_epoch
+                                 or reader.steps_per_epoch()),
                 validation_data=val, verbose=verbose, callbacks=cbs,
-                steps_per_epoch=train_steps_per_epoch,
                 validation_steps=validation_steps_per_epoch)
 
             result = {"history": {k: [float(v) for v in vs]

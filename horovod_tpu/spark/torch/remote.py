@@ -24,7 +24,7 @@ def make_remote_trainer(model_bytes: bytes, optimizer_cls, optimizer_kwargs,
         import torch
 
         import horovod_tpu.torch as hvd
-        from ..common.util import read_shard, to_arrays
+        from ..common.reader import ShardReader
 
         hvd.init()
         try:
@@ -35,23 +35,20 @@ def make_remote_trainer(model_bytes: bytes, optimizer_cls, optimizer_kwargs,
             optimizer = hvd.DistributedOptimizer(
                 optimizer, named_parameters=model.named_parameters())
 
-            pdf = read_shard(meta["train_data_path"], hvd.rank(), hvd.size())
-            xs = to_arrays(pdf, meta["feature_cols"], meta)
-            ys = to_arrays(pdf, meta["label_cols"], meta)
-            tx = [torch.as_tensor(np.asarray(a, np.float32)) for a in xs]
-            ty = [torch.as_tensor(np.asarray(a)) for a in ys]
+            # Streaming shard reader (the Petastorm role in the reference's
+            # remote trainer): one row-group window resident at a time.
+            reader = ShardReader(
+                meta["train_data_path"], meta, hvd.rank(), hvd.size(),
+                batch_size=batch_size, shuffle=shuffle)
 
-            n = len(pdf)
             history = []
             model.train()
             for epoch in range(epochs):
-                order = (np.random.RandomState(epoch).permutation(n)
-                         if shuffle else np.arange(n))
                 total, steps = 0.0, 0
-                for start in range(0, n, batch_size):
-                    idx = order[start:start + batch_size]
-                    bx = [t[idx] for t in tx]
-                    by = [t[idx] for t in ty]
+                for xs, ys in reader.batches(epoch):
+                    bx = [torch.as_tensor(np.asarray(a, np.float32))
+                          for a in xs]
+                    by = [torch.as_tensor(np.asarray(a)) for a in ys]
                     optimizer.zero_grad()
                     if train_minibatch_fn is not None:
                         loss = train_minibatch_fn(model, optimizer, bx, by)

@@ -576,14 +576,18 @@ def test_negotiation_rank_ready_ticks(tmp_path):
 
 
 _JOBKEY_WORKER = textwrap.dedent("""
-    import os, sys
+    import os, sys, time
     sys.path.insert(0, os.environ["HVD_REPO"])
     from horovod_tpu.common import native as hn
 
-    rank = int(sys.argv[1]); port = int(sys.argv[2])
-    # Rank 1 simulates a worker from a DIFFERENT job (wrong key) racing to
-    # the same controller port: both sides must fail loudly, not adopt it.
-    os.environ["HOROVOD_JOB_KEY"] = "jobA" if rank == 0 else "jobB"
+    idx = int(sys.argv[1]); port = int(sys.argv[2])
+    # idx 0/1: a healthy 2-rank job with key jobA. idx 2: a stray worker
+    # from another job (key jobB) claiming rank 1 — it must be rejected
+    # WITHOUT killing the healthy job (the coordinator keeps accepting).
+    os.environ["HOROVOD_JOB_KEY"] = "jobA" if idx < 2 else "jobB"
+    rank = 1 if idx == 2 else idx
+    if idx == 1:
+        time.sleep(2.0)  # let the stray worker hit the coordinator first
     core = hn.NativeCore()
     ok = core.init(rank=rank, size=2, local_rank=0, local_size=1,
         cross_rank=rank, cross_size=2, coordinator_addr="127.0.0.1",
@@ -592,12 +596,25 @@ _JOBKEY_WORKER = textwrap.dedent("""
         stall_warning_sec=60.0, stall_shutdown_sec=0.0,
         stall_check_enabled=True,
         exec_callback=lambda r, i: core.response_done(i, False, "n/a"))
-    assert not ok, "cross-job connection must be rejected"
-    print(f"JOBKEY_{rank}_OK")
+    if idx == 2:
+        assert not ok, "stray cross-job worker must be rejected"
+        print(f"JOBKEY_{idx}_OK")
+        sys.exit(0)
+    assert ok, f"healthy rank {rank} failed to init"
+    import numpy as np
+    x = np.full(4, float(rank + 1), np.float32)
+    h = core.enqueue("jk.ar", hn.OP_ALLREDUCE, 1, 7, x.shape,
+                     data_ptr=x.ctypes.data, output_ptr=x.ctypes.data,
+                     plane=hn.PLANE_HOST)
+    r, err = core.wait(h); assert r == 1, err
+    assert np.allclose(x, 3.0), x
+    core.shutdown()
+    print(f"JOBKEY_{idx}_OK")
 """)
 
 
 def test_job_key_rejects_cross_job_worker(tmp_path):
-    """Two jobs colliding on one controller port fail loudly instead of
-    cross-connecting (HOROVOD_JOB_KEY hello validation)."""
-    _run_workers(tmp_path, _JOBKEY_WORKER, "JOBKEY")
+    """A stray worker from another job (wrong HOROVOD_JOB_KEY) is rejected
+    loudly while the healthy job keeps accepting and completes its
+    collectives."""
+    _run_workers(tmp_path, _JOBKEY_WORKER, "JOBKEY", size=3)

@@ -1,0 +1,84 @@
+"""horovod_tpu.spark.run dispatch (parity: reference spark/runner.py:131 +
+SURVEY §4 Pattern 2 mock-based launcher testing): a fake pyspark supplies
+the executor-discovery surface; the collective job itself runs for real
+through the local launcher."""
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+
+class _FakeRDD:
+    def __init__(self, items):
+        self._items = list(items)
+
+    def map(self, f):
+        return _FakeRDD([f(x) for x in self._items])
+
+    def collect(self):
+        return list(self._items)
+
+
+class _FakeSparkContext:
+    defaultParallelism = 2
+    _active_spark_context = None
+
+    def parallelize(self, seq, num):
+        assert num == len(list(seq))
+        return _FakeRDD(seq)
+
+
+@pytest.fixture
+def fake_pyspark(monkeypatch):
+    mod = types.ModuleType("pyspark")
+    ctx = _FakeSparkContext()
+    _FakeSparkContext._active_spark_context = ctx
+    mod.SparkContext = _FakeSparkContext
+    monkeypatch.setitem(sys.modules, "pyspark", mod)
+    yield mod
+    _FakeSparkContext._active_spark_context = None
+
+
+def test_spark_run_executes_on_discovered_hosts(fake_pyspark):
+    import horovod_tpu.spark as spark
+
+    # Defined inline so cloudpickle serializes it by value (worker
+    # processes don't have this test module importable).
+    def _train():
+        import os
+
+        import numpy as np
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import horovod_tpu.torch as hvd
+
+        hvd.init()
+        out = hvd.allreduce(
+            __import__("torch").ones(3) * (hvd.rank() + 1), op=hvd.Sum)
+        r = (hvd.rank(), hvd.size(), float(np.asarray(out)[0]))
+        hvd.shutdown()
+        return r
+
+    results = spark.run(_train, num_proc=2, verbose=0)
+    assert len(results) == 2
+    assert sorted(r[0] for r in results) == [0, 1]
+    assert all(r[1] == 2 for r in results)
+    assert all(r[2] == 3.0 for r in results)  # 1+2 summed across ranks
+
+
+def test_spark_run_requires_active_context(fake_pyspark):
+    import horovod_tpu.spark as spark
+
+    _FakeSparkContext._active_spark_context = None
+    with pytest.raises(ValueError, match="active SparkContext"):
+        spark.run(lambda: None)
+
+
+def test_spark_run_without_pyspark(monkeypatch):
+    import horovod_tpu.spark as spark
+
+    monkeypatch.setitem(sys.modules, "pyspark", None)
+    with pytest.raises(ImportError, match="requires pyspark"):
+        spark.run(lambda: None)

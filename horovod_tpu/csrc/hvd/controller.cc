@@ -225,7 +225,12 @@ Status TcpController::Initialize() {
       }
       std::string hello;
       if (!s.RecvFrame(&hello)) {
-        return Status::Error(StatusType::UNKNOWN_ERROR, "bad worker hello");
+        // Port scanners / health checks connect and close without a
+        // frame; drop the socket and keep accepting (Accept's timeout
+        // still bounds the wait for real workers).
+        s.Close();
+        --i;
+        continue;
       }
       int rank = 0, port = 0;
       char host[256] = {0};
@@ -234,8 +239,12 @@ Status TcpController::Initialize() {
           std::sscanf(hello.c_str(), "%d %255s %d %255s", &rank, host,
                       &port, key);
       if (fields < 3 || rank <= 0 || rank >= cfg_.size) {
-        return Status::Error(StatusType::UNKNOWN_ERROR,
-                             "malformed worker hello: " + hello);
+        std::fprintf(stderr,
+                     "[horovod_tpu coordinator] ignoring malformed hello "
+                     "from a non-worker connection\n");
+        s.Close();
+        --i;
+        continue;
       }
       if (std::string(key) != cfg_.job_key) {
         // A stray worker from another job: reject it loudly and keep

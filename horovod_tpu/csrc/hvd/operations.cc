@@ -389,11 +389,17 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   // Per-job isolation key (launcher-exported, same on every rank): guards
   // the shared default controller port against cross-job connections.
   // Hashed to a fixed hex token so any user-supplied charset/length works
-  // in the whitespace-delimited hello.
+  // in the whitespace-delimited hello. FNV-1a, not std::hash: the token
+  // must agree across ranks built against different stdlibs/word sizes.
   if (const char* jk = std::getenv("HOROVOD_JOB_KEY")) {
+    uint64_t h = 1469598103934665603ull;
+    for (const char* p = jk; *p; ++p) {
+      h ^= static_cast<unsigned char>(*p);
+      h *= 1099511628211ull;
+    }
     char tok[32];
-    std::snprintf(tok, sizeof(tok), "%zx",
-                  std::hash<std::string>{}(std::string(jk)));
+    std::snprintf(tok, sizeof(tok), "%llx",
+                  static_cast<unsigned long long>(h));
     cfg.job_key = tok;
   }
 

@@ -49,10 +49,17 @@ def _block_attend(q, k, v, m, l, o, mask):
 def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True):
     """Context-parallel attention. q/k/v: [B, T_local, H, D] per chip.
 
-    With axis size 1 this degenerates to plain (flash-accumulated)
-    attention, so the same model code runs on any mesh.
+    With axis size 1 this degenerates to plain flash attention and routes
+    through the Pallas TPU kernel (``ops.pallas_attention``) — the MXU hot
+    path — on TPU (or under ``HVD_PALLAS_INTERPRET=1`` in tests); sp > 1
+    keeps the XLA streaming accumulation so K/V rotation overlaps compute
+    under XLA's collective-permute scheduling.
     """
     sp = lax.axis_size(axis_name)
+    if sp == 1:
+        from ..ops.pallas_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal)
     my = lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
     m = jnp.full((B, H, Tq), NEG_INF, dtype=jnp.float32)

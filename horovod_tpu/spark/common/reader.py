@@ -43,23 +43,15 @@ class ShardReader:
         self._label_cols = list(meta["label_cols"])
         self._columns = (list(columns) if columns is not None
                          else self._feature_cols + self._label_cols)
-        # This rank's (file, row_group) list: round-robin on the global
-        # row-group index, the same disjoint-coverage rule the whole-shard
-        # reader uses.
-        files = sorted(
-            os.path.join(path, f) for f in os.listdir(path)
-            if f.endswith(".parquet"))
-        self._groups: List[Tuple[str, int]] = []
+        # This rank's (file, row_group) list — the single sharding rule
+        # lives in util.iter_shard_groups.
+        from .util import iter_shard_groups
+
+        self._groups: List[Tuple] = []  # (ParquetFile, row_group_index)
         self._rows = 0
-        g = 0
-        for fname in files:
-            pf = pq.ParquetFile(fname)
-            md = pf.metadata
-            for rg in range(pf.num_row_groups):
-                if g % size == rank:
-                    self._groups.append((fname, rg))
-                    self._rows += md.row_group(rg).num_rows
-                g += 1
+        for pf, rg in iter_shard_groups(path, rank, size):
+            self._groups.append((pf, rg))
+            self._rows += pf.metadata.row_group(rg).num_rows
 
     @property
     def rows(self) -> int:
@@ -84,13 +76,9 @@ class ShardReader:
         rng = np.random.RandomState(epoch)
         order = (rng.permutation(len(self._groups)) if self._shuffle
                  else np.arange(len(self._groups)))
-        open_files = {}
 
         def read_group(i):
-            fname, rg = self._groups[order[i]]
-            pf = open_files.get(fname)
-            if pf is None:
-                pf = open_files[fname] = self._pq.ParquetFile(fname)
+            pf, rg = self._groups[order[i]]
             return pf.read_row_group(rg, columns=self._columns)
 
         feat_buf: List[np.ndarray] = []

@@ -132,37 +132,51 @@ def prepare_data(store: Store, df, feature_cols: Sequence[str],
     return meta
 
 
-def read_shard(path: str, rank: int = 0, size: int = 1,
-               columns: Optional[List[str]] = None):
-    """Read this rank's shard of a Parquet dataset as a pandas DataFrame.
+def iter_shard_groups(path: str, rank: int = 0, size: int = 1):
+    """This rank's (ParquetFile, row_group_index) pairs.
 
-    Sharding unit = row group (round-robin by global row-group index), the
-    same granularity Petastorm uses in the reference's remote readers
-    (``spark/keras/remote.py``): every rank touches disjoint data and all
-    rows are covered.
+    THE sharding rule (one definition; ``read_shard`` and the streaming
+    ``ShardReader`` both consume it): sorted ``.parquet`` listing,
+    round-robin by global row-group index — disjoint per rank, all rows
+    covered, the granularity Petastorm uses in the reference's remote
+    readers (``spark/keras/remote.py``).
     """
-    import pandas as pd
     import pyarrow.parquet as pq
 
     files = sorted(
         os.path.join(path, f) for f in os.listdir(path)
         if f.endswith(".parquet"))
-    frames = []
-    schema_cols = None
     g = 0  # global row-group index across files
     for fname in files:
         pf = pq.ParquetFile(fname)
-        if schema_cols is None:
-            schema_cols = columns or pf.schema_arrow.names
         for rg in range(pf.num_row_groups):
             if g % size == rank:
-                frames.append(pf.read_row_group(rg, columns=columns)
-                              .to_pandas())
+                yield pf, rg
             g += 1
+
+
+def read_shard(path: str, rank: int = 0, size: int = 1,
+               columns: Optional[List[str]] = None):
+    """Read this rank's whole shard as a pandas DataFrame (see
+    ``iter_shard_groups`` for the sharding rule; ``reader.ShardReader``
+    streams the same shard with bounded memory)."""
+    import pandas as pd
+
+    frames = []
+    for pf, rg in iter_shard_groups(path, rank, size):
+        frames.append(pf.read_row_group(rg, columns=columns).to_pandas())
     if not frames:
         # Keep the dataset schema so downstream column selection works on
-        # empty shards.
-        return pd.DataFrame(columns=schema_cols or columns or [])
+        # empty shards (this rank drew zero row groups).
+        import pyarrow.parquet as pq
+
+        files = sorted(
+            os.path.join(path, f) for f in os.listdir(path)
+            if f.endswith(".parquet"))
+        schema_cols = (columns or
+                       (pq.ParquetFile(files[0]).schema_arrow.names
+                        if files else []))
+        return pd.DataFrame(columns=schema_cols)
     return pd.concat(frames, ignore_index=True)
 
 

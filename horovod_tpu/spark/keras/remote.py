@@ -62,22 +62,19 @@ def make_remote_trainer(serialized_model: bytes, optimizer_bytes,
                         yield unwrap(xs), unwrap(ys)
                     epoch += 1
 
+            # Validation is evaluated whole (fit holds it in memory
+            # anyway), so the simple whole-shard read serves it; only the
+            # training pass streams.
             val = None
             if meta.get("val_data_path"):
-                vreader = ShardReader(
-                    meta["val_data_path"], meta, hvd.rank(), hvd.size(),
-                    batch_size=batch_size, shuffle=False)
-                if vreader.rows:
-                    vx, vy = [], []
-                    for bxs, bys in vreader.batches():
-                        vx.append(bxs)
-                        vy.append(bys)
-                    import numpy as np
+                from ..common.util import read_shard, to_arrays
 
-                    val = (unwrap([np.concatenate([b[c] for b in vx])
-                                   for c in range(len(vx[0]))]),
-                           unwrap([np.concatenate([b[c] for b in vy])
-                                   for c in range(len(vy[0]))]))
+                vdf = read_shard(meta["val_data_path"], hvd.rank(),
+                                 hvd.size())
+                if len(vdf):
+                    vx = to_arrays(vdf, meta["feature_cols"], meta)
+                    vy = to_arrays(vdf, meta["label_cols"], meta)
+                    val = (unwrap(vx), unwrap(vy))
 
             cbs = [hvd.callbacks.BroadcastGlobalVariablesCallback(0),
                    hvd.callbacks.MetricAverageCallback()]

@@ -40,6 +40,14 @@ def make_remote_trainer(model_bytes: bytes, optimizer_cls, optimizer_kwargs,
             reader = ShardReader(
                 meta["train_data_path"], meta, hvd.rank(), hvd.size(),
                 batch_size=batch_size, shuffle=shuffle)
+            if reader.rows == 0:
+                # Fail loudly: a zero-step rank would skip the per-step
+                # gradient allreduces the data-holding ranks submit and
+                # deadlock the negotiation.
+                raise ValueError(
+                    f"rank {hvd.rank()}'s training shard is empty: the "
+                    "dataset has fewer row groups than workers; increase "
+                    "num_partitions (or reduce the world size)")
 
             history = []
             model.train()

@@ -216,9 +216,19 @@ Status TcpController::Initialize() {
     // Accept size-1 hellos: "rank host data_port job_key". The job key
     // guards against two jobs sharing one host colliding on the default
     // controller port: a worker from another job is rejected loudly
-    // instead of being adopted into the wrong world.
+    // instead of being adopted into the wrong world. A wall-clock
+    // deadline spans the WHOLE loop — rejected/garbage connections retry
+    // the slot but cannot extend the wait forever.
+    auto deadline =
+        std::chrono::steady_clock::now() + std::chrono::milliseconds(120000);
     for (int i = 0; i < cfg_.size - 1; ++i) {
-      Socket s = listener_.Accept(120000);
+      auto remaining = std::chrono::duration_cast<std::chrono::milliseconds>(
+          deadline - std::chrono::steady_clock::now());
+      if (remaining.count() <= 0) {
+        return Status::Error(StatusType::UNKNOWN_ERROR,
+                             "timed out waiting for workers to connect");
+      }
+      Socket s = listener_.Accept(static_cast<int>(remaining.count()));
       if (!s.valid()) {
         return Status::Error(StatusType::UNKNOWN_ERROR,
                              "timed out waiting for workers to connect");

@@ -60,6 +60,34 @@ def _recv_frame(sock: socket.socket, key: bytes) -> Any:
     return pickle.loads(payload)
 
 
+def get_local_addresses() -> List[Tuple[str, str]]:
+    """(interface_name, ipv4) for every up interface with an address —
+    ioctl(SIOCGIFADDR) per kernel interface, no third-party deps (the role
+    psutil's net_if_addrs plays in the reference)."""
+    import array
+    import fcntl
+
+    out: List[Tuple[str, str]] = []
+    try:
+        names = socket.if_nameindex()
+    except OSError:
+        return out
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        for _, name in names:
+            ifreq = array.array(
+                "B", name.encode()[:15] + b"\0" * (32 - min(len(name), 15)))
+            try:
+                fcntl.ioctl(s.fileno(), 0x8915, ifreq)  # SIOCGIFADDR
+            except OSError:
+                continue
+            ip = socket.inet_ntoa(bytes(ifreq[20:24]))
+            out.append((name, ip))
+    finally:
+        s.close()
+    return out
+
+
 class BasicService:
     """Threaded TCP service dispatching authenticated pickled requests."""
 
@@ -104,14 +132,27 @@ class BasicService:
         return self._port
 
     def addresses(self) -> List[Tuple[str, int]]:
-        """All (ip, port) pairs this service is reachable at."""
+        """All (ip, port) pairs this service is reachable at — one per
+        local interface (the reference advertises per-NIC addresses so the
+        driver's routability probe can intersect them,
+        ``run/common/service/driver_service.py:43``). Restricted to
+        ``nics`` when the caller passed an allowlist."""
         addrs = [("127.0.0.1", self._port)]
-        try:
-            hostname_ip = socket.gethostbyname(socket.gethostname())
-            if hostname_ip != "127.0.0.1":
-                addrs.append((hostname_ip, self._port))
-        except OSError:
-            pass
+        for name, ip in get_local_addresses():
+            if self._nics and name not in self._nics:
+                continue
+            if all(ip != a for a, _ in addrs):
+                addrs.append((ip, self._port))
+        if len(addrs) == 1 and not self._nics:
+            # Hostname fallback only without an allowlist: appending the
+            # resolver's pick under nics={...} would advertise exactly the
+            # interface the operator excluded.
+            try:
+                hostname_ip = socket.gethostbyname(socket.gethostname())
+                if hostname_ip != "127.0.0.1":
+                    addrs.append((hostname_ip, self._port))
+            except OSError:
+                pass
         return addrs
 
     def shutdown(self):

@@ -91,6 +91,56 @@ class HorovodRunTaskService(network.BasicService):
         self.index = index
 
 
+def probe_routable_addresses(addresses: List[Tuple[str, int]],
+                             service_name: str, key: bytes,
+                             timeout: float = 2.0
+                             ) -> List[Tuple[str, int]]:
+    """The subset of a service's advertised (ip, port) pairs the caller
+    can actually reach (authenticated ping round-trip)."""
+    reachable = []
+    for addr in addresses:
+        try:
+            network.BasicClient(service_name, [addr], key,
+                                probe_timeout=timeout, attempts=1)
+            reachable.append(addr)
+        except (ConnectionError, OSError):
+            continue
+    return reachable
+
+
+def get_common_interfaces(driver: "HorovodRunDriverService",
+                          num_hosts: int, key: bytes,
+                          timeout: float = 2.0
+                          ) -> Dict[int, List[Tuple[str, int]]]:
+    """Routable address set per registered task host (parity:
+    ``run/common/service/driver_service.py:43`` NIC-intersection round):
+    every task advertised one address per local interface; the driver
+    probes them all and keeps the routable subset, so later launch traffic
+    (ssh targets, rendezvous endpoints) only uses interfaces that actually
+    carry driver<->host traffic. Hosts with zero routable addresses raise
+    — the reference fails the launch for the same reason."""
+    routable: Dict[int, List[Tuple[str, int]]] = {}
+    for index in range(num_hosts):
+        addrs = driver.task_addresses_for_driver(index)
+        if addrs is None:
+            raise RuntimeError(f"host index {index} never registered")
+        if num_hosts > 1:
+            # Loopback is trivially routable from a co-located driver but
+            # useless to every OTHER host; exclude it so consumers can
+            # take any returned address (the reference's NIC intersection
+            # excludes lo for the same reason).
+            addrs = [a for a in addrs if not a[0].startswith("127.")]
+        ok = probe_routable_addresses(
+            addrs, HorovodRunTaskService.NAME_FMT % index, key,
+            timeout=timeout)
+        if not ok:
+            raise RuntimeError(
+                f"no routable interface to host index {index} "
+                f"(advertised: {addrs})")
+        routable[index] = ok
+    return routable
+
+
 class HorovodRunDriverClient(network.BasicClient):
     def __init__(self, addresses, key):
         super().__init__(HorovodRunDriverService.NAME, addresses, key)

@@ -43,15 +43,17 @@ class ShardReader:
         self._label_cols = list(meta["label_cols"])
         self._columns = (list(columns) if columns is not None
                          else self._feature_cols + self._label_cols)
-        # This rank's (file, row_group) list — the single sharding rule
-        # lives in util.iter_shard_groups.
+        # This rank's (filename, row_group) list — the single sharding
+        # rule lives in util.iter_shard_groups. Filenames, not handles:
+        # files open lazily during iteration so descriptor count stays
+        # O(1) regardless of partition count.
         from .util import iter_shard_groups
 
-        self._groups: List[Tuple] = []  # (ParquetFile, row_group_index)
+        self._groups: List[Tuple[str, int]] = []
         self._rows = 0
-        for pf, rg in iter_shard_groups(path, rank, size):
-            self._groups.append((pf, rg))
-            self._rows += pf.metadata.row_group(rg).num_rows
+        for fname, rg, rows in iter_shard_groups(path, rank, size):
+            self._groups.append((fname, rg))
+            self._rows += rows
 
     @property
     def rows(self) -> int:
@@ -76,10 +78,14 @@ class ShardReader:
         rng = np.random.RandomState(epoch)
         order = (rng.permutation(len(self._groups)) if self._shuffle
                  else np.arange(len(self._groups)))
+        cache = {"name": None, "pf": None}  # one open file at a time
 
         def read_group(i):
-            pf, rg = self._groups[order[i]]
-            return pf.read_row_group(rg, columns=self._columns)
+            fname, rg = self._groups[order[i]]
+            if cache["name"] != fname:
+                cache["name"] = fname
+                cache["pf"] = self._pq.ParquetFile(fname)
+            return cache["pf"].read_row_group(rg, columns=self._columns)
 
         feat_buf: List[np.ndarray] = []
         lab_buf: List[np.ndarray] = []

@@ -132,26 +132,33 @@ def prepare_data(store: Store, df, feature_cols: Sequence[str],
     return meta
 
 
+def _list_parquet_files(path: str) -> List[str]:
+    """THE dataset file-listing rule (single definition for sharding and
+    schema recovery)."""
+    return sorted(
+        os.path.join(path, f) for f in os.listdir(path)
+        if f.endswith(".parquet"))
+
+
 def iter_shard_groups(path: str, rank: int = 0, size: int = 1):
-    """This rank's (ParquetFile, row_group_index) pairs.
+    """This rank's (filename, row_group_index, num_rows) triples.
 
     THE sharding rule (one definition; ``read_shard`` and the streaming
     ``ShardReader`` both consume it): sorted ``.parquet`` listing,
     round-robin by global row-group index — disjoint per rank, all rows
     covered, the granularity Petastorm uses in the reference's remote
-    readers (``spark/keras/remote.py``).
+    readers (``spark/keras/remote.py``). Only metadata is read here; no
+    file handles outlive the call (a 4096-partition dataset must not pin
+    4096 descriptors for a training run's lifetime).
     """
     import pyarrow.parquet as pq
 
-    files = sorted(
-        os.path.join(path, f) for f in os.listdir(path)
-        if f.endswith(".parquet"))
     g = 0  # global row-group index across files
-    for fname in files:
-        pf = pq.ParquetFile(fname)
-        for rg in range(pf.num_row_groups):
+    for fname in _list_parquet_files(path):
+        md = pq.read_metadata(fname)
+        for rg in range(md.num_row_groups):
             if g % size == rank:
-                yield pf, rg
+                yield fname, rg, md.row_group(rg).num_rows
             g += 1
 
 
@@ -161,21 +168,21 @@ def read_shard(path: str, rank: int = 0, size: int = 1,
     ``iter_shard_groups`` for the sharding rule; ``reader.ShardReader``
     streams the same shard with bounded memory)."""
     import pandas as pd
+    import pyarrow.parquet as pq
 
     frames = []
-    for pf, rg in iter_shard_groups(path, rank, size):
-        frames.append(pf.read_row_group(rg, columns=columns).to_pandas())
+    open_name, open_pf = None, None
+    for fname, rg, _rows in iter_shard_groups(path, rank, size):
+        if fname != open_name:
+            open_name, open_pf = fname, pq.ParquetFile(fname)
+        frames.append(open_pf.read_row_group(rg, columns=columns)
+                      .to_pandas())
     if not frames:
         # Keep the dataset schema so downstream column selection works on
         # empty shards (this rank drew zero row groups).
-        import pyarrow.parquet as pq
-
-        files = sorted(
-            os.path.join(path, f) for f in os.listdir(path)
-            if f.endswith(".parquet"))
+        files = _list_parquet_files(path)
         schema_cols = (columns or
-                       (pq.ParquetFile(files[0]).schema_arrow.names
-                        if files else []))
+                       (pq.read_schema(files[0]).names if files else []))
         return pd.DataFrame(columns=schema_cols)
     return pd.concat(frames, ignore_index=True)
 

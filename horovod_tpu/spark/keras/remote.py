@@ -69,8 +69,9 @@ def make_remote_trainer(serialized_model: bytes, optimizer_bytes,
             if meta.get("val_data_path"):
                 from ..common.util import read_shard, to_arrays
 
-                vdf = read_shard(meta["val_data_path"], hvd.rank(),
-                                 hvd.size())
+                vdf = read_shard(
+                    meta["val_data_path"], hvd.rank(), hvd.size(),
+                    columns=(meta["feature_cols"] + meta["label_cols"]))
                 if len(vdf):
                     vx = to_arrays(vdf, meta["feature_cols"], meta)
                     vy = to_arrays(vdf, meta["label_cols"], meta)

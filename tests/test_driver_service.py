@@ -1,0 +1,61 @@
+"""Driver/task service NIC-intersection probe (parity: reference
+run/common/service/driver_service.py:43 get_common_interfaces): tasks
+advertise per-interface addresses, the driver keeps only routable ones."""
+
+import pytest
+
+from horovod_tpu.run.common.util import secret
+from horovod_tpu.run.common.util.network import get_local_addresses
+from horovod_tpu.run.driver.driver_service import (
+    HorovodRunDriverClient, HorovodRunDriverService, HorovodRunTaskService,
+    get_common_interfaces, probe_routable_addresses)
+
+
+def test_local_address_enumeration():
+    addrs = get_local_addresses()
+    assert ("lo", "127.0.0.1") in addrs
+
+
+def test_common_interfaces_probe():
+    key = secret.make_secret_key()
+    driver = HorovodRunDriverService(num_hosts=2, key=key)
+    tasks = [HorovodRunTaskService(i, key) for i in range(2)]
+    try:
+        client = HorovodRunDriverClient(driver.addresses(), key)
+        for t in tasks:
+            # Advertise a black-hole address alongside the real ones: the
+            # probe must filter it (TEST-NET-1 is unroutable).
+            client.register_task(
+                t.index, [("192.0.2.254", 9)] + t.addresses())
+        driver.wait_for_initial_registration(timeout=10.0)
+        common = get_common_interfaces(driver, 2, key, timeout=1.0)
+        for i, t in enumerate(tasks):
+            assert common[i], "no routable addresses found"
+            assert ("192.0.2.254", 9) not in common[i]
+            assert all(a in t.addresses() for a in common[i])
+    finally:
+        driver.shutdown()
+        for t in tasks:
+            t.shutdown()
+
+
+def test_probe_rejects_wrong_service():
+    key = secret.make_secret_key()
+    t = HorovodRunTaskService(0, key)
+    try:
+        # Probing with the wrong expected service name finds nothing.
+        ok = probe_routable_addresses(
+            t.addresses(), "some other service", key, timeout=1.0)
+        assert ok == []
+    finally:
+        t.shutdown()
+
+
+def test_unregistered_host_raises():
+    key = secret.make_secret_key()
+    driver = HorovodRunDriverService(num_hosts=1, key=key)
+    try:
+        with pytest.raises(RuntimeError, match="never registered"):
+            get_common_interfaces(driver, 1, key, timeout=1.0)
+    finally:
+        driver.shutdown()

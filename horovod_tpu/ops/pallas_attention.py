@@ -39,7 +39,8 @@ BLOCK_K = 512
 
 def _attn_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
                  acc_ref, *, causal: bool, block_q: int, block_k: int,
-                 num_k_tiles: int):
+                 num_k_tiles: int, return_state: bool = False,
+                 mo_ref=None, lo_ref=None):
     """One (batch*head, q-tile, k-tile) grid step.
 
     Refs: q (1, block_q, D), k/v (1, block_k, D), o (1, block_q, D);
@@ -104,8 +105,175 @@ def _attn_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
 
     @pl.when(ki == num_k_tiles - 1)
     def _finalize():
-        o_ref[0] = (acc_ref[:] /
-                    jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+        if return_state:
+            # Block mode (ring attention): emit the UNnormalized
+            # accumulator plus (m, l) so the caller merges blocks with the
+            # standard online-softmax combine.
+            o_ref[0] = acc_ref[:].astype(o_ref.dtype)
+            mo_ref[0] = m_ref[:]
+            lo_ref[0] = l_ref[:]
+        else:
+            o_ref[0] = (acc_ref[:] /
+                        jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+
+
+def _attn_kernel_state(offs_ref, q_ref, k_ref, v_ref, o_ref, mo_ref,
+                       lo_ref, m_ref, l_ref, acc_ref, **kw):
+    """Block-mode positional adapter: pallas passes outputs before
+    scratch, so the three outputs (acc, m, l) precede the scratch refs."""
+    _attn_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                 acc_ref, return_state=True, mo_ref=mo_ref, lo_ref=lo_ref,
+                 **kw)
+
+
+def _pallas_block_state(q, k, v, offs, causal: bool, interpret: bool):
+    """q/k/v: [BH, T, D]. Returns (acc f32 [BH,Tq,D], m f32 [BH,Tq,1],
+    l f32 [BH,Tq,1]) — the unmerged online-softmax state of this K block
+    (ring attention merges blocks as they rotate)."""
+    BH, Tq, D = q.shape
+    Tk = k.shape[1]
+    bq = _pick_block(Tq, BLOCK_Q)
+    bk = _pick_block(Tk, BLOCK_K)
+    num_q = Tq // bq
+    num_k = Tk // bk
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(BH, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, qi, ki, offs: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, qi, ki, offs: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, qi, ki, offs: (bh, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D),
+                         lambda bh, qi, ki, offs: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1),
+                         lambda bh, qi, ki, offs: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1),
+                         lambda bh, qi, ki, offs: (bh, qi, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _attn_kernel_state, causal=causal, block_q=bq, block_k=bk,
+        num_k_tiles=num_k)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tq, D), jnp.float32),
+            jax.ShapeDtypeStruct((BH, Tq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((BH, Tq, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(offs, q, k, v)
+
+
+def _xla_block_state(q, k, v, offs, causal):
+    """XLA twin of the block-mode kernel (backward recompute + fallback).
+    ``offs`` = int32[2] (q_off, k_off) — an array, not statics, because
+    ring attention traces the rotating block origin."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("btd,bsd->bts", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        iq = jnp.arange(q.shape[1])[:, None] + offs[0]
+        ik = jnp.arange(k.shape[1])[None, :] + offs[1]
+        s = jnp.where(iq >= ik, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m))
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bts,bsd->btd", p.astype(v.dtype),
+                     v).astype(jnp.float32)
+    return acc, m, l
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _block_state_core(q, k, v, offs, causal, interpret):
+    if _pick_block(q.shape[1], BLOCK_Q) is None or \
+            _pick_block(k.shape[1], BLOCK_K) is None:
+        return _xla_block_state(q, k, v, offs, causal)
+    return _pallas_block_state(q, k, v, offs, causal, interpret)
+
+
+def _block_state_fwd(q, k, v, offs, causal, interpret):
+    return _block_state_core(q, k, v, offs, causal, interpret), \
+        (q, k, v, offs)
+
+
+def _block_state_bwd(causal, interpret, res, g):
+    import numpy as np
+
+    q, k, v, offs = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _xla_block_state(q_, k_, v_, offs, causal),
+        q, k, v)
+    dq, dk, dv = vjp(g)
+    # Integer offsets carry the symbolic-zero cotangent.
+    return dq, dk, dv, np.zeros((2,), dtype=jax.dtypes.float0)
+
+
+_block_state_core.defvjp(_block_state_fwd, _block_state_bwd)
+
+
+def _resolve_dispatch(use_pallas: Optional[bool]):
+    """Shared backend policy: (use_pallas, interpret). Mosaic on TPU,
+    interpreter under HVD_PALLAS_INTERPRET=1 (tests), XLA elsewhere."""
+    import os
+
+    if use_pallas is None:
+        platform = jax.default_backend()
+        if platform == "tpu":
+            return True, False
+        if os.environ.get("HVD_PALLAS_INTERPRET"):
+            return True, True
+        return False, False
+    if use_pallas:
+        return True, jax.default_backend() != "tpu"
+    return False, False
+
+
+def _merge_heads(x):
+    """[B, T, H, D] -> [B*H, T, D]."""
+    B, T, H, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+
+def flash_attention_block(q, k, v, q_off, k_off, causal: bool = True,
+                          use_pallas: Optional[bool] = None):
+    """One K/V block's unmerged attention state for ring attention.
+
+    q/k/v: [B, T, H, D]. Returns (acc, m, l) with acc f32 [B, T, H, D]
+    (unnormalized P.V), m/l f32 [B, H, T] — merge across blocks with the
+    online-softmax combine. Dispatch rules match ``flash_attention``
+    (shared ``_resolve_dispatch``).
+    """
+    B, Tq, H, D = q.shape
+    use_pallas, interpret = _resolve_dispatch(use_pallas)
+
+    offs = jnp.stack([jnp.asarray(q_off, jnp.int32),
+                      jnp.asarray(k_off, jnp.int32)])
+    if use_pallas:
+        acc, m, l = _block_state_core(
+            _merge_heads(q), _merge_heads(k), _merge_heads(v), offs,
+            causal, interpret)
+    else:
+        acc, m, l = _xla_block_state(
+            _merge_heads(q), _merge_heads(k), _merge_heads(v), offs,
+            causal)
+    acc = acc.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+    m = m.reshape(B, H, Tq)
+    l = l.reshape(B, H, Tq)
+    return acc, m, l
 
 
 def _pallas_attention_fwd(q, k, v, q_off, k_off, causal: bool,
@@ -206,38 +374,21 @@ def flash_attention(q, k, v, causal: bool = True, q_off: int = 0,
                     k_off: int = 0, use_pallas: Optional[bool] = None):
     """Blocked flash attention. q/k/v: [B, T, H, D].
 
-    ``use_pallas=None`` auto-selects: the Mosaic kernel on TPU, the
-    interpreter-backed kernel under ``HVD_PALLAS_INTERPRET=1`` (tests),
-    else the XLA flash path (identical math). ``q_off``/``k_off`` are the
-    global token offsets of the blocks — ring attention passes the
-    rotating K block's origin so causal masking stays globally correct.
+    ``use_pallas=None`` auto-selects via ``_resolve_dispatch``.
+    ``q_off``/``k_off`` are the global token offsets of the blocks — ring
+    attention passes the rotating K block's origin so causal masking stays
+    globally correct.
     """
-    import os
-
     B, Tq, H, D = q.shape
-    interpret = False
-    if use_pallas is None:
-        # default_backend(), not q.devices(): q is a tracer under jit /
-        # shard_map and tracers refuse .devices().
-        platform = jax.default_backend()
-        if platform == "tpu":
-            use_pallas = True
-        elif os.environ.get("HVD_PALLAS_INTERPRET"):
-            use_pallas, interpret = True, True
-        else:
-            use_pallas = False
-    elif use_pallas:
-        interpret = jax.default_backend() != "tpu"
-
-    def merge(x):
-        return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
+    use_pallas, interpret = _resolve_dispatch(use_pallas)
 
     def split(x, t):
         return x.reshape(B, H, t, D).transpose(0, 2, 1, 3)
 
     if not use_pallas:
-        out = _xla_flash(merge(q), merge(k), merge(v), q_off, k_off, causal)
+        out = _xla_flash(_merge_heads(q), _merge_heads(k), _merge_heads(v),
+                         q_off, k_off, causal)
         return split(out, Tq)
-    out = _flash_core(merge(q), merge(k), merge(v), q_off, k_off, causal,
-                      interpret)
+    out = _flash_core(_merge_heads(q), _merge_heads(k), _merge_heads(v),
+                      q_off, k_off, causal, interpret)
     return split(out, Tq)

@@ -49,11 +49,12 @@ def _block_attend(q, k, v, m, l, o, mask):
 def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True):
     """Context-parallel attention. q/k/v: [B, T_local, H, D] per chip.
 
-    With axis size 1 this degenerates to plain flash attention and routes
-    through the Pallas TPU kernel (``ops.pallas_attention``) — the MXU hot
-    path — on TPU (or under ``HVD_PALLAS_INTERPRET=1`` in tests); sp > 1
-    keeps the XLA streaming accumulation so K/V rotation overlaps compute
-    under XLA's collective-permute scheduling.
+    Every K/V block's local attention runs through the flash kernel
+    (Pallas/Mosaic on TPU, XLA elsewhere — ``ops.pallas_attention``):
+    sp == 1 is a single full-attention kernel call; sp > 1 calls the
+    block-state kernel once per ring step and merges blocks with the
+    online-softmax combine, while ``ppermute`` rotates K/V so transfer
+    overlaps compute under XLA's collective scheduling.
     """
     sp = lax.axis_size(axis_name)
     if sp == 1:
@@ -68,24 +69,29 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True):
 
     fwd_perm = [(i, (i + 1) % sp) for i in range(sp)]
 
-    def tile_mask(q_blk, k_blk, Tk):
-        """Additive causal mask between sequence blocks q_blk and k_blk."""
-        if not causal:
-            return None
-        # token positions: q: q_blk*Tq + iq ; k: k_blk*Tk + ik
-        iq = jnp.arange(Tq)[:, None] + q_blk * Tq
-        ik = jnp.arange(Tk)[None, :] + k_blk * Tk
-        return jnp.where(iq >= ik, 0.0, NEG_INF)
-
     def body(carry, step):
         m, l, o, k_cur, v_cur = carry
-        # k_cur originated at rank (my - step) mod sp
+        # k_cur originated at rank (my - step) mod sp. Each block's local
+        # attention state comes from the flash kernel (Pallas on TPU, XLA
+        # elsewhere); the cross-block merge below is the standard
+        # online-softmax combine.
+        from ..ops.pallas_attention import flash_attention_block
+
         k_blk = (my - step) % sp
-        mask = tile_mask(my, k_blk, k_cur.shape[1])
-        m, l, o = _block_attend(q, k_cur, v_cur, m, l, o, mask)
+        acc_b, m_b, l_b = flash_attention_block(
+            q, k_cur, v_cur, q_off=my * Tq, k_off=k_blk * k_cur.shape[1],
+            causal=causal)
+        m_new = jnp.maximum(m, m_b)                       # [B,H,Tq]
+        alive = m_new > NEG_INF / 2
+        c_old = jnp.where(alive, jnp.exp(m - m_new), 1.0)
+        c_blk = jnp.where(alive & (m_b > NEG_INF / 2),
+                          jnp.exp(m_b - m_new), 0.0)
+        l = l * c_old + l_b * c_blk
+        o = (o * c_old.transpose(0, 2, 1)[..., None] +
+             acc_b * c_blk.transpose(0, 2, 1)[..., None])
         k_nxt = lax.ppermute(k_cur, axis_name, fwd_perm)
         v_nxt = lax.ppermute(v_cur, axis_name, fwd_perm)
-        return (m, l, o, k_nxt, v_nxt), None
+        return (m_new, l, o, k_nxt, v_nxt), None
 
     (m, l, o, _, _), _ = lax.scan(
         body, (m, l, o, k, v), jnp.arange(sp))

@@ -92,3 +92,56 @@ def test_bf16_inputs():
     ref = _dense(q, k, v, True)
     np.testing.assert_allclose(np.asarray(out, np.float32),
                                np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+def test_block_state_merge_equals_full():
+    # Two K blocks merged with the online-softmax combine must equal full
+    # attention — the exact contract ring attention relies on per step.
+    from horovod_tpu.ops.pallas_attention import flash_attention_block
+
+    q, k, v = _qkv(T=32)
+    acc0, m0, l0 = flash_attention_block(q, k[:, :16], v[:, :16],
+                                         q_off=0, k_off=0, causal=True,
+                                         use_pallas=True)
+    acc1, m1, l1 = flash_attention_block(q, k[:, 16:], v[:, 16:],
+                                         q_off=0, k_off=16, causal=True,
+                                         use_pallas=True)
+    m = np.maximum(m0, m1)
+    alive0 = m0 > -1e29
+    c0 = np.where(alive0, np.exp(m0 - m), 0.0)
+    c1 = np.where(m1 > -1e29, np.exp(m1 - m), 0.0)
+    l = l0 * c0 + l1 * c1
+    o = (np.asarray(acc0) * np.transpose(c0, (0, 2, 1))[..., None] +
+         np.asarray(acc1) * np.transpose(c1, (0, 2, 1))[..., None])
+    out = o / np.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    ref = _dense(q, k, v, True)
+    np.testing.assert_allclose(out, np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_uses_block_kernel(monkeypatch):
+    # sp>1 ring attention on a 4-device sp mesh must agree with dense
+    # attention with the pallas block path enabled via interpret mode.
+    import os
+
+    monkeypatch.setenv("HVD_PALLAS_INTERPRET", "1")
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from horovod_tpu.parallel.ring_attention import ring_attention
+
+    devices = jax.devices()[:4]
+    mesh = Mesh(np.array(devices).reshape(4), ("sp",))
+    B, T, H, D = 2, 32, 2, 8
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+
+    fn = jax.jit(jax.shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis_name="sp"),
+        mesh=mesh, in_specs=P(None, "sp"), out_specs=P(None, "sp"),
+        check_vma=False))
+    out = fn(q, k, v)
+    ref = _dense(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

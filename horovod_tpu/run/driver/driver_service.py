@@ -13,7 +13,7 @@ transport for Spark-style integrations.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 from ..common.util import network
 

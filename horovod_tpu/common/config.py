@@ -52,6 +52,14 @@ DEFAULT_CACHE_CAPACITY = 1024
 DEFAULT_STALL_WARNING_SECONDS = 60.0  # reference stall_inspector.h:75
 
 
+def native_controller_port(default: int = 29500) -> int:
+    """The native controller's TCP port. ``HOROVOD_CONTROLLER_PORT`` is the
+    *base* coordination port (``jax.distributed`` / gRPC takes it); the
+    native controller always binds base+1. Every derivation of the +1
+    convention goes through here."""
+    return _get_int(HOROVOD_CONTROLLER_PORT, default) + 1
+
+
 def _get_bool(name: str, default: bool = False) -> bool:
     v = os.environ.get(name)
     if v is None:

@@ -47,6 +47,12 @@ _SCHED_LOCAL_RANK = ("JSM_NAMESPACE_LOCAL_RANK",
 _SCHED_LOCAL_SIZE = ("JSM_NAMESPACE_LOCAL_SIZE",
                      "OMPI_COMM_WORLD_LOCAL_SIZE", "SLURM_NTASKS_PER_NODE")
 
+# How long a surviving elastic worker waits for the driver to advance the
+# rendezvous round before concluding the failure was transient and
+# re-joining the current round. Must comfortably cover blacklist cooldown
+# + plan activation (a few seconds).
+_REJOIN_GRACE_SECONDS = 10.0
+
 
 def _sched_env(primary: str, fallbacks, default: str) -> str:
     v = os.environ.get(primary)
@@ -75,6 +81,16 @@ class HostWorld:
         self.cross_size = 1
         self._core: Optional[_native.NativeCore] = None
         self._owns_core = False
+        # (addr, port) fetched from the elastic rendezvous KV this round;
+        # overrides the launch-time HOROVOD_CONTROLLER_ADDR/PORT env, which
+        # goes stale once rank 0 migrates to a different host.
+        self._elastic_controller: Optional[Tuple[str, int]] = None
+        # The rendezvous round this process last joined. Survives shutdown
+        # (reinit = shutdown + init must not forget it): a surviving worker
+        # re-initializing after a collective failure has to wait for the
+        # driver's *next* round — re-joining its own old round would pair
+        # it against a plan the failure already invalidated.
+        self._last_rendezvous_round: Optional[int] = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -137,6 +153,7 @@ class HostWorld:
         worker's current rank layout from there (the reference workers do
         the same against the elastic rendezvous handler,
         ``run/elastic/rendezvous.py:22-45``)."""
+        self._elastic_controller = None
         if not os.environ.get(_config.HOROVOD_ELASTIC):
             return
         addr = os.environ.get(_config.HOROVOD_RENDEZVOUS_ADDR)
@@ -144,23 +161,116 @@ class HostWorld:
         hostname = os.environ.get("HOROVOD_HOSTNAME")
         if not (addr and port and hostname):
             return
-        try:
-            from ..run.elastic.rendezvous import fetch_slot_info
+        import time as _time
 
-            info = fetch_slot_info(addr, int(port), hostname,
-                                   self.local_rank)
-        except Exception as e:
-            _log.warning(f"elastic re-rendezvous failed: {e}")
-            return
-        if info is None:
-            return  # this round's plan excludes us; keep env values
+        from ..run.elastic.rendezvous import fetch_slot_info
+
+        # A surviving worker re-initializing after a failure *prefers* a
+        # newer round: worker-death failures make the driver rebuild the
+        # plan (blacklist cooldown + activation, typically a few seconds),
+        # and re-joining the invalidated round would deadlock against the
+        # replacement worker holding the new one. But the preference is a
+        # bounded grace, not a hard wait — a *transient* collective failure
+        # (no process died, plan unchanged) advances nothing, and everyone
+        # simply re-joins the current round.
+        grace = _time.monotonic() + _REJOIN_GRACE_SECONDS
+        while True:
+            try:
+                fetched = fetch_slot_info(addr, int(port), hostname,
+                                          self.local_rank)
+            except Exception as e:
+                if self._last_rendezvous_round is not None:
+                    # Re-init: the env endpoint may point at a deposed
+                    # rank 0 — falling back to it silently would be a
+                    # blind 120 s connect; surface the failure to the
+                    # elastic retry loop instead.
+                    raise HorovodInternalError(
+                        f"elastic re-rendezvous failed: {e}") from e
+                # First init: the launch-time env block is still
+                # authoritative; proceed on it.
+                _log.warning(f"elastic rendezvous unreachable at first "
+                             f"init; using env topology: {e}")
+                return
+            if fetched is None:
+                return  # this round's plan excludes us; keep env values
+            info, rendezvous_round = fetched
+            if self._last_rendezvous_round is None or \
+                    rendezvous_round > self._last_rendezvous_round or \
+                    _time.monotonic() > grace:
+                break
+            _time.sleep(0.25)
         (self.rank, self.size, self.local_rank, self.local_size,
          self.cross_rank, self.cross_size) = info
+        self._last_rendezvous_round = rendezvous_round
+        self._exchange_controller_endpoint(addr, int(port), hostname,
+                                           rendezvous_round)
         # The notification service must exist before training starts so
         # the driver can reach us on the next membership change.
         from ..run.elastic.worker import notification_manager
 
         notification_manager.init()
+
+    def _exchange_controller_endpoint(self, addr: str, port: int,
+                                      hostname: str, rendezvous_round: int):
+        """Rank 0 publishes its controller endpoint in the rendezvous KV;
+        everyone else polls for it. The launch-time env endpoint points at
+        the *initial* rank-0 host (the launcher's guess); after host churn
+        moves rank 0, only the KV knows the live coordinator. Keys are
+        scoped by rendezvous round so layout and coordinator can't pair
+        across rounds. Failure raises ``HorovodInternalError`` — the
+        elastic retry loop re-rendezvouses; silently falling back to the
+        known-stale env endpoint would trade a clear error for a blind
+        120 s connect to a host that may no longer be rank 0."""
+        from ..run.elastic.rendezvous import publish_controller_endpoint
+
+        ctrl_port = _config.native_controller_port()
+        try:
+            if self.rank == 0:
+                publish_controller_endpoint(addr, port, hostname, ctrl_port,
+                                            rendezvous_round)
+                # Rank 0 only listens; the addr field is unused by it.
+                self._elastic_controller = ("0.0.0.0", ctrl_port)
+                return
+            ep = self._poll_controller_endpoint(addr, port, hostname,
+                                                rendezvous_round)
+        except HorovodInternalError:
+            raise
+        except Exception as e:
+            raise HorovodInternalError(
+                f"elastic controller rendezvous failed: {e}") from e
+        self._elastic_controller = ep
+
+    def _poll_controller_endpoint(self, addr: str, port: int, hostname: str,
+                                  rendezvous_round: int) -> Tuple[str, int]:
+        """Wait for this round's controller endpoint, watching for the
+        round moving on underneath us: if the driver supersedes the round
+        we fetched (another failure, more churn) while we wait, raise
+        immediately so the elastic retry loop re-rendezvouses against the
+        live round instead of burning the full timeout on a coordinator
+        that will never publish."""
+        import time as _time
+
+        from ..run.elastic.rendezvous import (
+            fetch_controller_endpoint, fetch_slot_info)
+
+        deadline = _time.monotonic() + 120.0
+        while _time.monotonic() < deadline:
+            ep = fetch_controller_endpoint(addr, port, rendezvous_round,
+                                           timeout=2.0)
+            if ep is not None:
+                return ep
+            current = fetch_slot_info(addr, port, hostname, self.local_rank)
+            if current is None:
+                raise HorovodInternalError(
+                    "this worker is no longer in the rendezvous plan "
+                    "(slot removed or host blacklisted)")
+            if current[1] != rendezvous_round:
+                raise HorovodInternalError(
+                    f"rendezvous advanced to round {current[1]} while "
+                    f"waiting for round {rendezvous_round}'s controller")
+        raise HorovodInternalError(
+            f"controller endpoint for rendezvous round {rendezvous_round} "
+            f"never appeared in the KV (rank 0 crashed before publishing?)")
 
     @staticmethod
     def _borrow_engine_core():
@@ -174,9 +284,12 @@ class HostWorld:
 
     def _try_init_core(self, core) -> bool:
         cfg = _config.RuntimeConfig.from_env()
-        addr = os.environ.get(_config.HOROVOD_CONTROLLER_ADDR, "127.0.0.1")
-        base_port = int(
-            os.environ.get(_config.HOROVOD_CONTROLLER_PORT, "29500"))
+        if self._elastic_controller is not None:
+            addr, ctrl_port = self._elastic_controller
+        else:
+            addr = os.environ.get(_config.HOROVOD_CONTROLLER_ADDR,
+                                  "127.0.0.1")
+            ctrl_port = _config.native_controller_port()
         # The ssh launcher exports a per-slot HOROVOD_HOSTNAME; scheduler
         # launchers (jsrun/srun) give every rank the same env, so fall back
         # to the actual hostname — advertising 127.0.0.1 would point peers'
@@ -193,7 +306,7 @@ class HostWorld:
             rank=self.rank, size=self.size, local_rank=self.local_rank,
             local_size=self.local_size, cross_rank=self.cross_rank,
             cross_size=self.cross_size, coordinator_addr=addr,
-            coordinator_port=base_port + 1, my_host=my_host,
+            coordinator_port=ctrl_port, my_host=my_host,
             cycle_time_ms=cfg.cycle_time_ms,
             fusion_threshold=cfg.fusion_threshold_bytes,
             cache_capacity=cfg.cache_capacity,
@@ -206,7 +319,16 @@ class HostWorld:
         core = _native.NativeCore()
         if not core.available:
             return None
-        return core if self._try_init_core(core) else None
+        if not self._try_init_core(core):
+            # Distinct from "library missing": the world join itself failed
+            # (coordinator unreachable, hello timeout, job-key mismatch) —
+            # report that, and as HorovodInternalError so the elastic retry
+            # loop treats it as a recoverable rendezvous failure.
+            raise HorovodInternalError(
+                f"native controller world join failed (rank {self.rank} of "
+                f"{self.size}): coordinator unreachable or worker-connect "
+                f"timeout")
+        return core
 
     def shutdown(self):
         with self._lock:
@@ -215,6 +337,7 @@ class HostWorld:
             if self._core is not None and self._owns_core:
                 self._core.shutdown()
             self._core = None
+            self._elastic_controller = None
             self.initialized = False
             self.rank, self.size = 0, 1
             self.local_rank, self.local_size = 0, 1

@@ -161,30 +161,63 @@ def _reinitialize():
     _state.init()
 
 
-def run(func: Callable) -> Callable:
-    """Elastic retry-loop decorator (parity: ``common/elastic.py:147-168``)."""
+# Consecutive re-init failures tolerated before giving up: a transient
+# race with the driver's next plan (rank 0 not yet published, world
+# re-shuffling mid-join) heals on retry; a dead driver does not, and
+# looping forever would mask it.
+_MAX_REINIT_FAILURES = 3
+
+
+def retry_loop(func: Callable, reinitialize: Callable[[], None]) -> Callable:
+    """The elastic retry loop shared by every binding (parity:
+    ``common/elastic.py:147-168``), parameterized by the world re-init.
+
+    Every stage that can hit a collective/rendezvous failure is guarded:
+    ``reinitialize()`` itself may raise ``HorovodInternalError`` (e.g. the
+    controller-endpoint rendezvous when rank 0 died mid-round) and retries
+    up to ``_MAX_REINIT_FAILURES`` consecutive times; a failing
+    ``state.sync()`` restores and re-rendezvouses like any collective
+    failure. An unguarded re-init would turn a transient rendezvous race
+    into a worker death — and the driver would blacklist a healthy host."""
 
     @functools.wraps(func)
     def wrapper(state: State, *args, **kwargs):
         reset_required = False
         skip_sync = False
+        reinit_failures = 0
         while True:
             if reset_required:
-                _reinitialize()
+                try:
+                    reinitialize()
+                except HorovodInternalError as e:
+                    reinit_failures += 1
+                    if reinit_failures > _MAX_REINIT_FAILURES:
+                        raise
+                    _log.warning(f"elastic re-init failed ({e}); retrying")
+                    continue
+                reinit_failures = 0
                 state.on_reset()
                 reset_required = False
-            if not skip_sync:
-                state.sync()
-            skip_sync = False
             try:
-                return func(state, *args, **kwargs)
+                if not skip_sync:
+                    state.sync()
+                skip_sync = False
+                ret = func(state, *args, **kwargs)
             except HorovodInternalError:
-                _log.warning("collective failure: restoring last committed state")
+                _log.warning(
+                    "collective failure: restoring last committed state")
                 state.restore()
                 reset_required = True
             except HostsUpdatedInterrupt as e:
                 _log.info("host membership changed: re-initializing")
                 reset_required = True
                 skip_sync = e.skip_sync
+            else:
+                return ret
 
     return wrapper
+
+
+def run(func: Callable) -> Callable:
+    """Elastic retry-loop decorator (parity: ``common/elastic.py:147-168``)."""
+    return retry_loop(func, _reinitialize)

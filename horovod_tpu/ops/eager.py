@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..common import config as _hvd_config
 from ..common import logging as _log
 from ..common import native as _native
 from ..common.exceptions import DuplicateTensorNameError, HorovodInternalError
@@ -114,17 +115,14 @@ class EagerEngine:
             cfg = state.config
             coordinator_addr = os.environ.get(
                 "HOROVOD_CONTROLLER_ADDR", "127.0.0.1")
-            # The gRPC coordination service (jax.distributed) uses the base
-            # port; the native controller uses base+1.
-            base_port = int(os.environ.get("HOROVOD_CONTROLLER_PORT",
-                                           "29500"))
             my_host = os.environ.get("HOROVOD_HOSTNAME", "127.0.0.1")
             ok = self._core.init(
                 rank=state.process_index, size=state.process_count,
                 local_rank=0, local_size=state.local_size,
                 cross_rank=state.cross_rank, cross_size=state.cross_size,
                 coordinator_addr=coordinator_addr,
-                coordinator_port=base_port + 1, my_host=my_host,
+                coordinator_port=_hvd_config.native_controller_port(),
+                my_host=my_host,
                 cycle_time_ms=cfg.cycle_time_ms,
                 fusion_threshold=cfg.fusion_threshold_bytes,
                 cache_capacity=cfg.cache_capacity,

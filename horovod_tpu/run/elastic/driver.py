@@ -128,6 +128,15 @@ class ElasticDriver:
             self._shutdown.wait(DISCOVER_HOSTS_FREQUENCY_SECS)
 
     def _on_hosts_updated(self):
+        # Gate on the *plan* actually changing, not merely the host set: a
+        # discovery echo (e.g. a blacklisted host returning from cooldown
+        # after the failure path already rebuilt the plan, or spare hosts
+        # beyond max_np appearing) must not interrupt workers — they would
+        # re-rendezvous expecting a new round that never comes.
+        if not self._plan_changed():
+            _log.debug("elastic: host set changed but plan is unchanged "
+                       "and staffed; nothing to do")
+            return
         _log.info("elastic: host set changed; notifying workers")
         ts = time.time()
         with self._lock:
@@ -167,6 +176,29 @@ class ElasticDriver:
                         self._max_np or np, max(np, self._min_np))
         return get_host_assignments(hosts, np_actual)
 
+    @staticmethod
+    def _plan_key(plan: List[SlotInfo]):
+        return sorted((s.hostname, s.local_rank, s.to_response_string())
+                      for s in plan)
+
+    def _plan_is_current(self, plan: List[SlotInfo]) -> bool:
+        """True when ``plan`` equals the active assignments AND every slot
+        has a live worker — i.e. re-activating would change nothing. Must
+        be called under the lock."""
+        if self._plan_key(plan) != self._plan_key(
+                list(self._assignments.values())):
+            return False
+        return all((s.hostname, s.local_rank) in self._workers_active
+                   for s in plan)
+
+    def _plan_changed(self) -> bool:
+        with self._lock:
+            try:
+                plan = self._compute_assignments(self._target_np())
+            except Exception:
+                return True  # can't tell; err on notifying
+            return not self._plan_is_current(plan)
+
     def _activate_workers(self, np: int) -> bool:
         """(Re)assign ranks, spawn workers for newly-assigned slots, and
         terminate workers whose slot left the plan (blacklisted/removed
@@ -181,10 +213,24 @@ class ElasticDriver:
                     f"elastic: only {len(plan)} slots available, below "
                     f"min_np={self._min_np}; keeping current plan")
                 return False
+            if self._plan_is_current(plan):
+                # Nothing would change: same slots, same ranks, all
+                # staffed. Bumping the round anyway is not harmless — a
+                # worker mid-join on the current round would be orphaned
+                # (it waits for the old round's coordinator; new arrivals
+                # wait for the new round's), so dedupe here, where both
+                # the failure path and the discovery thread land. This IS
+                # a completed activation decision, so clear the round's
+                # failure count like the full path does: the failure that
+                # routed us here was already absorbed by a concurrent
+                # activation (which respawned the dead slot), and keeping
+                # its count would doom a fully recovered job's exit code.
+                self._round_failures = 0
+                return True
             self._world_size = plan[0].size if plan else 0
             self._rendezvous_round += 1
             self._round_failures = 0
-            self._rendezvous.init(plan)
+            self._rendezvous.init(plan, self._rendezvous_round)
             new_slots = []
             assignments = {}
             for slot in plan:

@@ -4,24 +4,72 @@ The driver writes each round's slot plan into the rendezvous KV
 (``RendezvousServer.init``); workers fetch their (possibly new) rank layout
 by ``/rank/<hostname>:<local_rank>`` at every (re-)init — the mechanism the
 reference implements as a KV-serving handler (``rendezvous.py:22-45``).
+
+Every slot record carries the driver's rendezvous round, and the
+controller endpoint is keyed by that round: a worker that fetched round
+N's layout can only ever pair it with round N's coordinator, so a
+late-publishing old rank 0 (or an early-polling old worker) can never
+cross rounds.
 """
 
 from __future__ import annotations
 
+import time
 from typing import Optional, Tuple
 
-from ..http.http_client import read_data_from_kvstore
+from ..http.http_client import put_data_into_kvstore, read_data_from_kvstore
 
 RANK_SCOPE = "rank"
+CONTROLLER_SCOPE = "controller"
+
+SlotLayout = Tuple[int, int, int, int, int, int]
 
 
 def fetch_slot_info(addr: str, port: int, hostname: str, local_rank: int
-                    ) -> Optional[Tuple[int, int, int, int, int, int]]:
-    """Return (rank, size, local_rank, local_size, cross_rank, cross_size)
-    for this worker, or None when the round's plan excludes it."""
+                    ) -> Optional[Tuple[SlotLayout, int]]:
+    """Return ((rank, size, local_rank, local_size, cross_rank,
+    cross_size), rendezvous_round) for this worker, or None when the
+    round's plan excludes it."""
     blob = read_data_from_kvstore(addr, port, RANK_SCOPE,
                                   f"{hostname}:{local_rank}")
     if blob is None:
         return None
-    parts = blob.decode().split(",")
-    return tuple(int(p) for p in parts)  # type: ignore[return-value]
+    parts = [int(p) for p in blob.decode().split(",")]
+    return tuple(parts[:6]), parts[6]  # type: ignore[return-value]
+
+
+def publish_controller_endpoint(addr: str, port: int, controller_host: str,
+                                controller_port: int,
+                                rendezvous_round: int) -> None:
+    """Rank 0 announces where its native controller listens this round.
+
+    The static launcher can hand every worker a fixed
+    ``HOROVOD_CONTROLLER_ADDR`` because rank 0's host never moves; under
+    elasticity rank 0 migrates when its host is blacklisted, so the live
+    endpoint must travel through the rendezvous KV — the role the
+    reference's Gloo rendezvous store plays for its full-mesh connect
+    (``gloo_context.cc:70-90``). The key is scoped by the round the
+    publisher fetched its slot from, so a rank 0 deposed between its slot
+    fetch and this publish writes a key no current-round worker reads."""
+    put_data_into_kvstore(addr, port, CONTROLLER_SCOPE,
+                          f"endpoint.{rendezvous_round}",
+                          f"{controller_host}:{controller_port}".encode())
+
+
+def fetch_controller_endpoint(addr: str, port: int, rendezvous_round: int,
+                              timeout: float = 120.0
+                              ) -> Optional[Tuple[str, int]]:
+    """Poll the KV until the given round's controller endpoint appears.
+
+    Returns (host, port), or None on timeout. The deadline is monotonic:
+    NTP steps on freshly provisioned TPU VMs must not stretch or collapse
+    the wait."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        blob = read_data_from_kvstore(addr, port, CONTROLLER_SCOPE,
+                                      f"endpoint.{rendezvous_round}")
+        if blob:
+            host, _, p = blob.decode().rpartition(":")
+            return host, int(p)
+        time.sleep(0.25)
+    return None

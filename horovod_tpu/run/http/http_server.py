@@ -98,16 +98,25 @@ class RendezvousServer:
     def port(self) -> int:
         return self._server.server_address[1]
 
-    def init(self, host_alloc_plan: List) -> None:
+    def init(self, host_alloc_plan: List, rendezvous_round: int = 0) -> None:
         """Load slot assignments into the store so each worker can GET its
         rank layout under ``/rank/<hostname>:<local_rank>`` (parity:
-        ``RendezvousHandler`` scope init, ``http_server.py:139+``)."""
+        ``RendezvousHandler`` scope init, ``http_server.py:139+``). Each
+        record is stamped with the rendezvous round; the controller
+        endpoint is keyed by the same round (see
+        ``elastic/rendezvous.py``), so slot layout and coordinator can
+        never pair across rounds."""
         with self._server.kvstore_lock:
             self._server.kvstore.pop("rank", None)
+            # A new round means a possibly-new rank 0: drop superseded
+            # controller endpoints (their round-scoped keys are unreadable
+            # by current workers anyway; this is garbage collection).
+            self._server.kvstore.pop("controller", None)
             store = self._server.kvstore.setdefault("rank", {})
             for slot in host_alloc_plan:
                 key = f"{slot.hostname}:{slot.local_rank}"
-                store[key] = slot.to_response_string().encode()
+                value = f"{slot.to_response_string()},{rendezvous_round}"
+                store[key] = value.encode()
 
     def put(self, scope: str, key: str, value: bytes) -> None:
         with self._server.kvstore_lock:

@@ -5,13 +5,10 @@ TensorFlowKerasState).
 
 from __future__ import annotations
 
-import functools
 
 import numpy as np
 import tensorflow as tf
 
-from ..common import logging as _log
-from ..common.exceptions import HorovodInternalError, HostsUpdatedInterrupt
 from ..elastic.state import ObjectState, State
 from . import mpi_ops as _ops
 from .functions import broadcast_object, broadcast_variables
@@ -72,33 +69,15 @@ class TensorFlowKerasState(TensorFlowState):
         }
 
 
+def _reinitialize():
+    _ops.shutdown()
+    _ops.init()
+
+
 def run(func):
     """Elastic retry loop for TF training functions (parity:
-    ``tensorflow/elastic.py:23-60`` + ``common/elastic.py:147-168``)."""
+    ``tensorflow/elastic.py:23-60`` + ``common/elastic.py:147-168``). The
+    shared guarded loop lives in ``elastic.state.retry_loop``."""
+    from ..elastic.state import retry_loop
 
-    @functools.wraps(func)
-    def wrapper(state: State, *args, **kwargs):
-        reset_required = False
-        skip_sync = False
-        while True:
-            if reset_required:
-                _ops.shutdown()
-                _ops.init()
-                state.on_reset()
-                reset_required = False
-            if not skip_sync:
-                state.sync()
-            skip_sync = False
-            try:
-                return func(state, *args, **kwargs)
-            except HorovodInternalError:
-                _log.warning(
-                    "collective failure: restoring last committed state")
-                state.restore()
-                reset_required = True
-            except HostsUpdatedInterrupt as e:
-                _log.info("host membership changed: re-initializing")
-                reset_required = True
-                skip_sync = e.skip_sync
-
-    return wrapper
+    return retry_loop(func, _reinitialize)

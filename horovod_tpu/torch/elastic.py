@@ -3,10 +3,7 @@
 from __future__ import annotations
 
 import copy
-import functools
 
-from ..common import logging as _log
-from ..common.exceptions import HorovodInternalError, HostsUpdatedInterrupt
 from ..elastic.state import ObjectState, State
 from . import mpi_ops as _ops
 from .functions import broadcast_object, broadcast_optimizer_state, \
@@ -56,36 +53,17 @@ class TorchState(ObjectState):
         super().sync()
 
 
+def _reinitialize():
+    _ops.shutdown()
+    _ops.init()
+
+
 def run(func):
     """Elastic retry loop for torch training functions (parity:
     ``torch/elastic.py:23`` + ``common/elastic.py:147-168``): catches
     ``HorovodInternalError`` (restore + reinit) and
     ``HostsUpdatedInterrupt`` (reinit), re-initializing the *process-rank*
-    world."""
+    world. The shared guarded loop lives in ``elastic.state.retry_loop``."""
+    from ..elastic.state import retry_loop
 
-    @functools.wraps(func)
-    def wrapper(state: State, *args, **kwargs):
-        reset_required = False
-        skip_sync = False
-        while True:
-            if reset_required:
-                _ops.shutdown()
-                _ops.init()
-                state.on_reset()
-                reset_required = False
-            if not skip_sync:
-                state.sync()
-            skip_sync = False
-            try:
-                return func(state, *args, **kwargs)
-            except HorovodInternalError:
-                _log.warning(
-                    "collective failure: restoring last committed state")
-                state.restore()
-                reset_required = True
-            except HostsUpdatedInterrupt as e:
-                _log.info("host membership changed: re-initializing")
-                reset_required = True
-                skip_sync = e.skip_sync
-
-    return wrapper
+    return retry_loop(func, _reinitialize)

@@ -1,0 +1,138 @@
+"""Minimal numpy-backed stand-in for the slice of the MXNet API the
+``horovod_tpu.mxnet`` binding touches.
+
+MXNet itself is not installed in the TPU image, so the binding's module
+logic (NDArray conversion, in-place ops, parameter broadcast, optimizer
+and gluon-trainer wrappers) would otherwise never execute. Injecting this
+fake via ``install()`` before importing the binding lets tests drive the
+real binding code end-to-end over the host collective plane; only the
+NDArray container is fake. This mirrors how the reference tests framework
+glue without a cluster (SURVEY §4 Pattern 2 mocks).
+"""
+
+import sys
+import types
+
+import numpy as np
+
+
+class NDArray:
+    """numpy-backed NDArray with the members the binding uses:
+    ``asnumpy()``, ``dtype``, ``shape``, and in-place slice assignment."""
+
+    def __init__(self, data, dtype=None):
+        self._np = np.array(data, dtype=dtype)
+
+    @property
+    def dtype(self):
+        return self._np.dtype
+
+    @property
+    def shape(self):
+        return self._np.shape
+
+    def asnumpy(self):
+        return self._np.copy()
+
+    def __setitem__(self, key, value):
+        self._np[key] = value._np if isinstance(value, NDArray) else value
+
+    def __getitem__(self, key):
+        return NDArray(self._np[key])
+
+    def __repr__(self):
+        return f"FakeNDArray({self._np!r})"
+
+
+def _nd_array(data, dtype=None):
+    if isinstance(data, NDArray):
+        data = data._np
+    return NDArray(data, dtype=dtype)
+
+
+class Parameter:
+    """gluon-Parameter-shaped: ``data()``, ``grad_req``, ``list_grad()``."""
+
+    def __init__(self, name, data, grad_req="write"):
+        self.name = name
+        self.grad_req = grad_req
+        self._data = _nd_array(data)
+        self._grad = _nd_array(np.zeros_like(self._data._np))
+
+    def data(self):
+        return self._data
+
+    def list_grad(self):
+        return [self._grad]
+
+    def list_data(self):
+        return [self._data]
+
+
+class Trainer:
+    """gluon.Trainer-shaped base: holds params, ``_scale``, and calls
+    ``_allreduce_grads()`` from ``step()`` the way gluon does."""
+
+    def __init__(self, params, optimizer, optimizer_params=None, **kwargs):
+        if hasattr(params, "values"):
+            params = list(params.values())
+        self._params = list(params)
+        self._scale = 1.0
+        self._optimizer = optimizer
+        self._optimizer_params = dict(optimizer_params or {})
+
+    def _allreduce_grads(self):
+        pass
+
+    def step(self, batch_size):
+        self._allreduce_grads()
+        lr = float(self._optimizer_params.get("learning_rate", 0.1))
+        for p in self._params:
+            if p.grad_req != "null":
+                p._data._np -= lr * self._scale * p._grad._np / batch_size
+
+
+class SGD:
+    """mxnet.optimizer.Optimizer-shaped: ``update(index, weight, grad,
+    state)`` applies plain SGD."""
+
+    def __init__(self, learning_rate=0.1):
+        self.learning_rate = learning_rate
+
+    def update(self, index, weight, grad, state):
+        if isinstance(index, (tuple, list)):
+            for w, g in zip(weight, grad):
+                w._np -= self.learning_rate * g._np
+        else:
+            weight._np -= self.learning_rate * grad._np
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self.update(index, weight, grad, state)
+
+
+def install():
+    """Register the fake under ``mxnet`` / ``mxnet.gluon`` /
+    ``mxnet.optimizer`` in sys.modules. Returns the fake root module."""
+    root = types.ModuleType("mxnet")
+    nd = types.ModuleType("mxnet.nd")
+    nd.array = _nd_array
+    nd.NDArray = NDArray
+    gluon = types.ModuleType("mxnet.gluon")
+    gluon.Trainer = Trainer
+    gluon.Parameter = Parameter
+    optimizer = types.ModuleType("mxnet.optimizer")
+    optimizer.SGD = SGD
+    root.nd = nd
+    root.gluon = gluon
+    root.optimizer = optimizer
+    root.NDArray = NDArray
+    sys.modules["mxnet"] = root
+    sys.modules["mxnet.nd"] = nd
+    sys.modules["mxnet.gluon"] = gluon
+    sys.modules["mxnet.optimizer"] = optimizer
+    return root
+
+
+def uninstall():
+    for name in ("mxnet", "mxnet.nd", "mxnet.gluon", "mxnet.optimizer"):
+        sys.modules.pop(name, None)

@@ -18,7 +18,7 @@ class _FakeRendezvous:
     def __init__(self):
         self.rounds = []
 
-    def init(self, plan):
+    def init(self, plan, rendezvous_round=0):
         self.rounds.append(list(plan))
 
 
@@ -223,8 +223,9 @@ def test_rendezvous_rounds_written():
 
         from horovod_tpu.run.elastic.rendezvous import fetch_slot_info
 
-        info = fetch_slot_info("127.0.0.1", port, "localhost", 1)
+        info, rnd = fetch_slot_info("127.0.0.1", port, "localhost", 1)
         assert info == (1, 2, 1, 2, 0, 1)
+        assert rnd >= 1  # driver stamps its rendezvous round
         driver.stop()
     finally:
         rendezvous.stop_server()
@@ -255,3 +256,104 @@ def test_preemption_signal_posts_host_update():
         state.commit()  # mailbox drained: next commit passes again
     finally:
         signal.signal(signal.SIGUSR2, prev)
+
+
+def test_controller_endpoint_travels_through_rendezvous_kv():
+    """Rank 0's controller endpoint is published per round and wiped by the
+    next round's plan init, so workers can never fetch a stale coordinator
+    (role of the reference's Gloo rendezvous store, gloo_context.cc:70-90)."""
+    from horovod_tpu.run.common.util.hosts import HostInfo, \
+        get_host_assignments
+    from horovod_tpu.run.elastic.rendezvous import (
+        fetch_controller_endpoint, publish_controller_endpoint)
+
+    rendezvous = RendezvousServer()
+    port = rendezvous.start_server()
+    try:
+        publish_controller_endpoint("127.0.0.1", port, "hostA", 40123,
+                                    rendezvous_round=1)
+        assert fetch_controller_endpoint(
+            "127.0.0.1", port, 1, timeout=5.0) == ("hostA", 40123)
+        # Round-scoped keys: a worker holding round 2's layout can never
+        # pair it with round 1's coordinator.
+        assert fetch_controller_endpoint(
+            "127.0.0.1", port, 2, timeout=0.6) is None
+        # A new round's init() garbage-collects superseded endpoints.
+        plan = get_host_assignments([HostInfo("hostB", 1)], 1)
+        rendezvous.init(plan, rendezvous_round=2)
+        assert fetch_controller_endpoint(
+            "127.0.0.1", port, 1, timeout=0.6) is None
+    finally:
+        rendezvous.stop_server()
+
+
+def test_host_world_elastic_controller_exchange(monkeypatch):
+    """HostWorld's elastic re-rendezvous: rank 0 publishes its live
+    controller endpoint, a worker fetches it and overrides the (stale)
+    launch-time env endpoint."""
+    from horovod_tpu.common import config as _config
+    from horovod_tpu.common.host_world import HostWorld
+    from horovod_tpu.run.common.util.hosts import HostInfo, \
+        get_host_assignments
+
+    rendezvous = RendezvousServer()
+    port = rendezvous.start_server()
+    try:
+        rendezvous.init(get_host_assignments([HostInfo("localhost", 2)], 2),
+                        rendezvous_round=3)
+        monkeypatch.setenv(_config.HOROVOD_ELASTIC, "1")
+        monkeypatch.setenv(_config.HOROVOD_RENDEZVOUS_ADDR, "127.0.0.1")
+        monkeypatch.setenv(_config.HOROVOD_RENDEZVOUS_PORT, str(port))
+        monkeypatch.setenv(_config.HOROVOD_CONTROLLER_PORT, "41000")
+        monkeypatch.setenv("HOROVOD_HOSTNAME", "localhost")
+        monkeypatch.delenv("HOROVOD_SECRET_KEY", raising=False)
+
+        w0 = HostWorld()
+        w0.local_rank = 0
+        w0._maybe_elastic_rerendezvous()
+        assert w0.rank == 0 and w0.size == 2
+        # Rank 0 listens itself; workers get the advertised endpoint.
+        assert w0._elastic_controller == ("0.0.0.0", 41001)
+        assert rendezvous.get("controller",
+                              "endpoint.3") == b"localhost:41001"
+
+        w1 = HostWorld()
+        w1.local_rank = 1
+        w1._maybe_elastic_rerendezvous()
+        assert w1.rank == 1 and w1.size == 2
+        assert w1._elastic_controller == ("localhost", 41001)
+    finally:
+        rendezvous.stop_server()
+
+
+def test_activate_workers_dedupes_unchanged_plan():
+    """A redundant activation (discovery echo after the failure path
+    already rebuilt the plan) must not bump the rendezvous round: workers
+    mid-join on the current round would be orphaned waiting for a
+    coordinator that never publishes."""
+    rendezvous = _FakeRendezvous()
+    disc = FixedHosts({"a": 2})
+    driver = ElasticDriver(rendezvous, disc, min_np=2, timeout=5.0)
+    release = threading.Event()
+    try:
+        driver.start(2, _blocking_worker(release))
+        assert driver._rendezvous_round == 1
+        rounds_before = len(rendezvous.rounds)
+        # Same hosts, all slots staffed: a re-activation is a no-op.
+        assert driver._activate_workers(2) is True
+        assert driver._rendezvous_round == 1
+        assert len(rendezvous.rounds) == rounds_before
+        # An unchanged host set never notifies workers either.
+        notified = []
+        driver.set_notify_client_factory(
+            lambda h, lr: notified.append((h, lr)) or None)
+        driver._on_hosts_updated()
+        assert notified == []
+        # A genuine change (new host) does re-activate with a new round.
+        disc.set({"a": 2, "b": 1})
+        driver.host_manager.update_available_hosts()
+        assert driver._activate_workers(3) is True
+        assert driver._rendezvous_round == 2
+    finally:
+        release.set()
+        driver.stop()

@@ -168,7 +168,7 @@ def test_rendezvous_kv_roundtrip():
         server.init(plan)
         blob = read_data_from_kvstore("127.0.0.1", port, "rank",
                                       "localhost:1")
-        assert blob.decode() == "1,2,1,2,0,1"
+        assert blob.decode() == "1,2,1,2,0,1,0"
     finally:
         server.stop_server()
 

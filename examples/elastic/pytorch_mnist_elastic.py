@@ -1,0 +1,85 @@
+"""Elastic MNIST, PyTorch binding (mirrors the reference's
+``examples/elastic/pytorch_mnist_elastic.py``): epoch/batch progress lives
+in the ``TorchState`` so a worker joining mid-epoch resumes exactly where
+the last commit left off, and the data shard is recomputed per world size.
+
+    python -m horovod_tpu.run -np 2 --min-np 1 \
+        --host-discovery-script ./discover.sh \
+        python examples/elastic/pytorch_mnist_elastic.py
+"""
+
+import argparse
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class Net(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(784, 128)
+        self.fc2 = nn.Linear(128, 10)
+
+    def forward(self, x):
+        return F.log_softmax(self.fc2(F.relu(self.fc1(x.flatten(1)))), dim=1)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=0.01)
+    args = parser.parse_args()
+
+    hvd.init()
+    torch.manual_seed(42)
+    rng = np.random.RandomState(0)
+    x_all = rng.rand(4096, 28, 28).astype(np.float32)
+    y_all = rng.randint(0, 10, 4096)
+
+    model = Net()
+    optimizer = torch.optim.SGD(model.parameters(), lr=args.lr * hvd.size())
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+
+    @hvd.elastic.run
+    def training(state):
+        while state.epoch < args.epochs:
+            # Re-shard for the *current* world: membership may have
+            # changed since the last commit.
+            x = torch.from_numpy(x_all[hvd.rank()::hvd.size()])
+            y = torch.from_numpy(
+                y_all[hvd.rank()::hvd.size()].astype(np.int64))
+            batches = len(x) // args.batch_size
+            loss = None  # shard can shrink below the committed batch index
+            while state.batch < batches:
+                i = state.batch * args.batch_size
+                state.optimizer.zero_grad()
+                loss = F.nll_loss(state.model(x[i:i + args.batch_size]),
+                                  y[i:i + args.batch_size])
+                loss.backward()
+                state.optimizer.step()
+                state.batch += 1
+                if state.batch % 10 == 0:
+                    state.commit()
+            if hvd.rank() == 0 and loss is not None:
+                print(f"epoch {state.epoch}: loss={loss.item():.4f} "
+                      f"world={hvd.size()}")
+            state.epoch += 1
+            state.batch = 0
+            state.commit()
+
+    state = hvd.elastic.TorchState(model=model, optimizer=optimizer,
+                                   epoch=0, batch=0)
+    training(state)
+    if hvd.rank() == 0:
+        print("elastic mnist finished")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""Elastic synthetic benchmark, TF2 binding (mirrors the reference's
+``examples/elastic/tensorflow2_synthetic_benchmark_elastic.py``): a
+throughput loop whose step counter and variables live in a
+``TensorFlowState``, so throughput measurement survives membership
+changes.
+
+    python -m horovod_tpu.run -np 2 --min-np 1 \
+        --host-discovery-script ./discover.sh \
+        python examples/elastic/tensorflow2_synthetic_elastic.py
+"""
+
+import argparse
+import time
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-batches", type=int, default=100)
+    parser.add_argument("--commit-every", type=int, default=10)
+    args = parser.parse_args()
+
+    hvd.init()
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Dense(256, activation="relu"),
+        tf.keras.layers.Dense(10),
+    ])
+    opt = tf.optimizers.SGD(0.01 * hvd.size())
+    data = tf.random.uniform([args.batch_size, 64], seed=hvd.rank())
+    target = tf.random.uniform([args.batch_size], maxval=10,
+                               dtype=tf.int64, seed=hvd.rank())
+    model(data[:1])  # build variables
+
+    def training_step():
+        with tf.GradientTape() as tape:
+            loss = tf.losses.sparse_categorical_crossentropy(
+                target, model(data), from_logits=True)
+            loss = tf.reduce_mean(loss)
+        tape = hvd.DistributedGradientTape(tape)
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        return loss
+
+    @hvd.elastic.run
+    def benchmark(state):
+        t0 = time.time()
+        while state.batch < args.num_batches:
+            training_step()
+            state.batch += 1
+            if state.batch % args.commit_every == 0:
+                state.commit()
+        return time.time() - t0
+
+    state = hvd.elastic.TensorFlowState(
+        variables=model.variables + opt.variables, batch=0)
+    elapsed = benchmark(state)
+    img_sec = args.batch_size * args.num_batches / elapsed
+    if hvd.rank() == 0:
+        print(f"{img_sec:.1f} img/sec per worker, world={hvd.size()}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

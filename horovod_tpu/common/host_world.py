@@ -192,7 +192,16 @@ class HostWorld:
                              f"init; using env topology: {e}")
                 return
             if fetched is None:
-                return  # this round's plan excludes us; keep env values
+                if self._last_rendezvous_round is not None:
+                    # Re-init and the current plan excludes us (host
+                    # blacklisted / slot removed). Proceeding on stale env
+                    # topology would join the new round with an old rank
+                    # and could overwrite a legitimate worker's slot in
+                    # the coordinator's tables.
+                    raise HorovodInternalError(
+                        "this worker is no longer in the rendezvous plan "
+                        "(slot removed or host blacklisted)")
+                return  # first init: launch-time env is authoritative
             info, rendezvous_round = fetched
             if self._last_rendezvous_round is None or \
                     rendezvous_round > self._last_rendezvous_round or \

@@ -21,11 +21,11 @@ from .functions import (  # noqa: F401
     allgather_object, broadcast_object, broadcast_object_fn,
     broadcast_variables)
 from .mpi_ops import (  # noqa: F401
-    Adasum, Average, Max, Min, ReduceOp, Sum, _allreduce, allgather, barrier,
-    broadcast, ccl_built, cross_rank, cross_size, ddl_built, gloo_built,
-    gloo_enabled, init, is_initialized, join, local_rank, local_size,
-    mpi_built, mpi_enabled, mpi_threads_supported, nccl_built, rank,
-    shutdown, size)
+    Adasum, Average, Max, Min, ReduceOp, Sum, _allreduce, _np_allreduce,
+    allgather, barrier, broadcast, ccl_built, cross_rank, cross_size,
+    ddl_built, gloo_built, gloo_enabled, init, is_initialized, join,
+    local_rank, local_size, mpi_built, mpi_enabled, mpi_threads_supported,
+    nccl_built, rank, shutdown, size)
 
 
 def allreduce(tensor, average=None, device_dense="", device_sparse="",

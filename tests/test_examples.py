@@ -64,3 +64,65 @@ def test_elastic_pytorch_example_single():
     out = _run_example("elastic/pytorch_synthetic_elastic.py",
                        "--num-steps", "20")
     assert "elastic training finished" in out
+
+
+def test_keras_mnist_example(tmp_path):
+    pytest.importorskip("keras")
+    out = _run_example("keras_mnist.py", "--epochs", "1",
+                       "--checkpoint-dir", str(tmp_path))
+    assert "accuracy=" in out
+
+
+def test_keras_mnist_advanced_example():
+    pytest.importorskip("keras")
+    out = _run_example("keras_mnist_advanced.py", "--epochs", "2",
+                       "--warmup-epochs", "1")
+    assert "accuracy=" in out
+
+
+def test_pytorch_imagenet_resnet50_tiny(tmp_path):
+    pytest.importorskip("torch")
+    out = _run_example(
+        "pytorch_imagenet_resnet50.py", "--epochs", "1",
+        "--batches-per-epoch", "2", "--batch-size", "2",
+        "--image-size", "64", "--num-classes", "10",
+        "--checkpoint-format", str(tmp_path / "ck-{epoch}.pt"))
+    assert "val_acc=" in out
+    assert (tmp_path / "ck-1.pt").exists()
+
+
+def test_keras_imagenet_resnet50_tiny(tmp_path):
+    pytest.importorskip("keras")
+    out = _run_example(
+        "keras_imagenet_resnet50.py", "--epochs", "1",
+        "--steps-per-epoch", "2", "--batch-size", "2",
+        "--image-size", "64", "--num-classes", "10",
+        "--warmup-epochs", "1", "--checkpoint-dir", str(tmp_path))
+    assert "accuracy=" in out
+
+
+def test_mxnet_mnist_example_gates_cleanly():
+    # mxnet is absent in this image: the example must exit with the clear
+    # gate message, not a traceback.
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "mxnet_mnist.py")],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+    assert proc.returncode == 1
+    assert "mxnet is not installed" in proc.stderr
+
+
+def test_elastic_pytorch_mnist_example_single():
+    pytest.importorskip("torch")
+    out = _run_example("elastic/pytorch_mnist_elastic.py", "--epochs", "1",
+                       "--batch-size", "512")
+    assert "elastic mnist finished" in out
+
+
+def test_elastic_tf2_synthetic_example_single():
+    pytest.importorskip("tensorflow")
+    out = _run_example("elastic/tensorflow2_synthetic_elastic.py",
+                       "--num-batches", "20")
+    assert "img/sec per worker" in out

@@ -1,0 +1,77 @@
+"""Build script (parity: the reference's ``setup.py:583-671`` feature-switch
+scheme, collapsed to the two native artifacts this framework ships).
+
+The native core (``libhvdtpu.so``, the controller/ring runtime) and the
+TensorFlow op library (``libhvdtf.so``) are compiled by their Makefiles at
+build time. Switches follow the reference's convention:
+
+- ``HOROVOD_WITHOUT_NATIVE=1``  — skip the native core (pure-Python mode;
+  multi-process host worlds will refuse to start).
+- ``HOROVOD_WITH_NATIVE=1``     — fail the build if the native core can't
+  compile (default: best-effort, it also builds lazily at first import).
+- ``HOROVOD_WITHOUT_TENSORFLOW=1`` / ``HOROVOD_WITH_TENSORFLOW=1`` — same
+  for the TF op library (needs an importable tensorflow at build time,
+  which with pip means ``--no-build-isolation``).
+
+The Makefiles build in-tree (``horovod_tpu/lib/``) and the artifacts ride
+into the wheel as package data — the same location the lazy first-import
+build uses, so an installed tree and a source tree behave identically.
+Read-only checkouts should install from a prebuilt wheel.
+"""
+
+import os
+import subprocess
+import sys
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _env_on(name):
+    return os.environ.get(name, "").lower() in ("1", "true", "yes", "on")
+
+
+def _make(subdir, required, what):
+    path = os.path.join(HERE, "horovod_tpu", subdir)
+    try:
+        subprocess.run(["make", "-C", path], check=True, timeout=600)
+        return True
+    except Exception as e:
+        msg = f"building {what} failed: {e}"
+        if required:
+            raise RuntimeError(
+                msg + f" (required because HOROVOD_WITH_"
+                f"{what.upper()}=1 was set)") from e
+        print(f"warning: {msg}; it will be built lazily at first import "
+              f"instead", file=sys.stderr)
+        return False
+
+
+class BuildWithNative(build_py):
+    def run(self):
+        if not _env_on("HOROVOD_WITHOUT_NATIVE"):
+            _make("csrc", _env_on("HOROVOD_WITH_NATIVE"), "native")
+        if not _env_on("HOROVOD_WITHOUT_TENSORFLOW"):
+            import importlib.util
+
+            # NOTE: under pip's default PEP 517 build isolation the build
+            # env contains only setuptools, so tensorflow is never visible
+            # here even when installed — pass --no-build-isolation to get
+            # the TF op library built at install time. Without it the
+            # library still builds lazily at first import, so skipping is
+            # the right default behavior, not an error.
+            have_tf = importlib.util.find_spec("tensorflow") is not None
+            if have_tf:
+                _make(os.path.join("tensorflow", "csrc"),
+                      _env_on("HOROVOD_WITH_TENSORFLOW"), "tensorflow")
+            elif _env_on("HOROVOD_WITH_TENSORFLOW"):
+                raise RuntimeError(
+                    "HOROVOD_WITH_TENSORFLOW=1 but tensorflow is not "
+                    "importable in the build environment (if it IS "
+                    "installed, rerun with pip --no-build-isolation)")
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildWithNative})

@@ -231,3 +231,24 @@ def test_cli_end_to_end(tmp_path):
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "CLI_RANK_0_OF_2_OK" in proc.stdout
     assert "CLI_RANK_1_OF_2_OK" in proc.stdout
+
+
+def test_packaging_console_entries_resolve():
+    """pyproject's console scripts must keep pointing at real callables
+    (reference parity: bin/horovodrun -> run_commandline)."""
+    import tomllib
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo_root, "pyproject.toml"), "rb") as f:
+        proj = tomllib.load(f)
+    for name, target in proj["project"]["scripts"].items():
+        mod_name, _, attr = target.partition(":")
+        import importlib
+
+        mod = importlib.import_module(mod_name)
+        assert callable(getattr(mod, attr)), (name, target)
+    assert proj["tool"]["setuptools"]["dynamic"]["version"]["attr"] == \
+        "horovod_tpu.version.__version__"
+    from horovod_tpu.version import __version__
+
+    assert __version__

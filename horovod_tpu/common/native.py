@@ -27,6 +27,8 @@ _CSRC_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "csrc")
 DTYPE_CODES = {
     "uint8": 0,
     "int8": 1,
+    "uint16": 2,
+    "int16": 3,
     "int32": 4,
     "int64": 5,
     "float16": 6,

@@ -61,6 +61,8 @@ class Status {
 enum class DataType : int {
   HVD_UINT8 = 0,
   HVD_INT8 = 1,
+  HVD_UINT16 = 2,
+  HVD_INT16 = 3,
   HVD_INT32 = 4,
   HVD_INT64 = 5,
   HVD_FLOAT16 = 6,
@@ -76,6 +78,8 @@ inline int DataTypeSize(DataType t) {
     case DataType::HVD_INT8:
     case DataType::HVD_BOOL:
       return 1;
+    case DataType::HVD_UINT16:
+    case DataType::HVD_INT16:
     case DataType::HVD_FLOAT16:
     case DataType::HVD_BFLOAT16:
       return 2;

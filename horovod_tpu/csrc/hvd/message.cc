@@ -19,6 +19,8 @@ const char* DataTypeName(DataType t) {
   switch (t) {
     case DataType::HVD_UINT8: return "uint8";
     case DataType::HVD_INT8: return "int8";
+    case DataType::HVD_UINT16: return "uint16";
+    case DataType::HVD_INT16: return "int16";
     case DataType::HVD_INT32: return "int32";
     case DataType::HVD_INT64: return "int64";
     case DataType::HVD_FLOAT16: return "float16";

@@ -105,6 +105,14 @@ void Accumulate(void* dst, const void* src, int64_t n, DataType dt,
       AccumulateT(static_cast<int8_t*>(dst), static_cast<const int8_t*>(src),
                   n, op);
       break;
+    case DataType::HVD_UINT16:
+      AccumulateT(static_cast<uint16_t*>(dst),
+                  static_cast<const uint16_t*>(src), n, op);
+      break;
+    case DataType::HVD_INT16:
+      AccumulateT(static_cast<int16_t*>(dst),
+                  static_cast<const int16_t*>(src), n, op);
+      break;
     case DataType::HVD_BOOL: {
       auto* d = static_cast<uint8_t*>(dst);
       auto* s = static_cast<const uint8_t*>(src);

@@ -89,6 +89,8 @@ static int DtypeCode(DataType dt) {
   switch (dt) {
     case tensorflow::DT_UINT8: return 0;
     case tensorflow::DT_INT8: return 1;
+    case tensorflow::DT_UINT16: return 2;
+    case tensorflow::DT_INT16: return 3;
     case tensorflow::DT_INT32: return 4;
     case tensorflow::DT_INT64: return 5;
     case tensorflow::DT_HALF: return 6;
@@ -229,7 +231,8 @@ class AllreduceOp : public AsyncOpKernel {
 
 REGISTER_OP("HorovodTpuAllreduce")
     .Attr(
-        "T: {uint8, int8, int32, int64, half, float32, float64, bool, "
+        "T: {uint8, int8, uint16, int16, int32, int64, half, float32, "
+        "float64, bool, "
         "bfloat16}")
     .Attr("tensor_name: string = ''")
     .Attr("reduce_op: int = 1")
@@ -288,7 +291,8 @@ class AllgatherOp : public AsyncOpKernel {
 
 REGISTER_OP("HorovodTpuAllgather")
     .Attr(
-        "T: {uint8, int8, int32, int64, half, float32, float64, bool, "
+        "T: {uint8, int8, uint16, int16, int32, int64, half, float32, "
+        "float64, bool, "
         "bfloat16}")
     .Attr("tensor_name: string = ''")
     .Input("tensor: T")
@@ -354,7 +358,8 @@ class BroadcastOp : public AsyncOpKernel {
 
 REGISTER_OP("HorovodTpuBroadcast")
     .Attr(
-        "T: {uint8, int8, int32, int64, half, float32, float64, bool, "
+        "T: {uint8, int8, uint16, int16, int32, int64, half, float32, "
+        "float64, bool, "
         "bfloat16}")
     .Attr("tensor_name: string = ''")
     .Attr("root_rank: int = 0")

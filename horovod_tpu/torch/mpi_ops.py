@@ -41,6 +41,7 @@ __all__ = [
 TORCH_DTYPE_CODES = {
     torch.uint8: 0,
     torch.int8: 1,
+    torch.int16: 3,
     torch.int32: 4,
     torch.int64: 5,
     torch.float16: 6,

@@ -1,0 +1,119 @@
+"""Cross-rank dtype × op matrix over the real 2-process host plane
+(reference: ``test/test_torch.py``'s per-dtype allreduce/allgather/
+broadcast sweeps under mpirun, SURVEY §4 Pattern 1).
+
+One pair of worker processes exercises every supported dtype through the
+torch binding so dtype plumbing (Python code ↔ wire ↔ C++ ring
+accumulate) is proven end-to-end, not per-dtype-at-size-1.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+pytest.importorskip("torch")
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    os.environ.update(HOROVOD_RANK=str(rank), HOROVOD_SIZE="2",
+                      HOROVOD_LOCAL_RANK=str(rank), HOROVOD_LOCAL_SIZE="2",
+                      HOROVOD_CONTROLLER_ADDR="127.0.0.1",
+                      HOROVOD_CONTROLLER_PORT=str(port),
+                      JAX_PLATFORMS="cpu")
+    import torch
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    size = hvd.size()
+
+    # ---- allreduce Sum across every supported dtype ----
+    sum_dtypes = [torch.uint8, torch.int8, torch.int16, torch.int32,
+                  torch.int64, torch.float16, torch.float32, torch.float64,
+                  torch.bfloat16]
+    for i, dt in enumerate(sum_dtypes):
+        x = torch.full((7, 3), rank + 1, dtype=dt)
+        out = hvd.allreduce(x, op=hvd.Sum, name=f"mx.sum.{i}")
+        assert out.dtype == dt, (dt, out.dtype)
+        expected = sum(r + 1 for r in range(size))
+        assert torch.all(out == torch.full((7, 3), expected, dtype=dt)), \\
+            (dt, out.flatten()[:4])
+
+    # ---- Min / Max on ints and floats ----
+    for i, dt in enumerate([torch.int16, torch.int32, torch.float32,
+                            torch.float64]):
+        x = torch.full((5,), (rank + 1) * 10, dtype=dt)
+        mn = hvd.allreduce(x, op=hvd.Min, name=f"mx.min.{i}")
+        mx = hvd.allreduce(x, op=hvd.Max, name=f"mx.max.{i}")
+        assert torch.all(mn == 10), (dt, mn)
+        assert torch.all(mx == size * 10), (dt, mx)
+
+    # ---- bool allreduce: logical OR semantics ----
+    x = torch.tensor([rank == 0, rank == 1, False])
+    out = hvd.allreduce(x, op=hvd.Sum, name="mx.bool")
+    assert out.tolist() == [True, True, False], out
+
+    # ---- Average keeps dtype, divides by size ----
+    x = torch.full((4,), float((rank + 1) * size), dtype=torch.float32)
+    out = hvd.allreduce(x, op=hvd.Average, name="mx.avg")
+    assert torch.allclose(out, torch.full((4,), float(sum(
+        (r + 1) for r in range(size)))), atol=1e-6), out
+
+    # ---- broadcast per dtype, non-zero root ----
+    for i, dt in enumerate([torch.int16, torch.float16, torch.bfloat16,
+                            torch.float64]):
+        x = torch.full((6,), rank * 3 + 1, dtype=dt)
+        out = hvd.broadcast(x, root_rank=1, name=f"mx.bc.{i}")
+        assert torch.all(out == torch.full((6,), 4, dtype=dt)), (dt, out)
+
+    # ---- ragged allgather on int16 (dtype x allgatherv displacement) ----
+    x = torch.arange((rank + 1) * 2, dtype=torch.int16).reshape(-1, 1)
+    out = hvd.allgather(x, name="mx.ag16")
+    assert out.dtype == torch.int16
+    assert out.shape == (2 + 4, 1), out.shape
+    assert out[:2].flatten().tolist() == [0, 1]
+    assert out[2:].flatten().tolist() == [0, 1, 2, 3]
+
+    # ---- multi-dim shapes (1-4 dims, reference dim sweep) ----
+    for nd in range(1, 5):
+        shape = tuple([2] * nd)
+        x = torch.full(shape, float(rank + 1))
+        out = hvd.allreduce(x, op=hvd.Sum, name=f"mx.nd.{nd}")
+        assert out.shape == shape
+        assert torch.all(out == sum(r + 1 for r in range(size)))
+
+    # ---- 0-d scalar ----
+    x = torch.tensor(float(rank + 1))
+    out = hvd.allreduce(x, op=hvd.Sum, name="mx.scalar")
+    assert out.shape == () and float(out) == sum(
+        r + 1 for r in range(size))
+
+    hvd.shutdown()
+    print(f"DTMATRIX_{rank}_OK")
+""")
+
+
+def test_dtype_op_matrix_two_process(tmp_path):
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    script = tmp_path / "matrix_worker.py"
+    script.write_text(_WORKER)
+    env = dict(os.environ)
+    env["HVD_REPO"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(r), str(port)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for r in range(2)]
+    for r, p in enumerate(procs):
+        out, _ = p.communicate(timeout=240)
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"DTMATRIX_{r}_OK" in out

@@ -52,6 +52,16 @@ class TestAllreduce:
         np.testing.assert_array_equal(np.asarray(out[0]),
                                       sum(range(hvd.size())))
 
+    def test_int16_uint16_dtypes(self, hvd):
+        # Codes 2/3 of the reference's DataType enum (uint16/int16) are
+        # first-class on the XLA plane too.
+        for dt in (np.int16, np.uint16):
+            xs = _per_rank(hvd, (4,), dtype=dt)
+            out = hvd.allreduce(xs, op=hvd.Sum)
+            assert np.asarray(out[0]).dtype == dt
+            np.testing.assert_array_equal(np.asarray(out[0]),
+                                          sum(range(hvd.size())))
+
     def test_bf16_fp32_accumulation(self, hvd):
         import jax.numpy as jnp
 

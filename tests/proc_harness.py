@@ -1,0 +1,55 @@
+"""Shared multi-process worker harness for Pattern-1 tests (SURVEY §4):
+N subprocesses form a real controller/ring world, each asserts its own
+results and prints ``{sentinel}_{rank}_OK``.
+
+One launcher for every such test so the launch protocol (env block, port
+handling, cleanup) evolves in lockstep — and so a failing/timed-out rank
+never leaves its peers orphaned."""
+
+import os
+import socket
+import subprocess
+import sys
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TESTS_DIR)
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_world(tmp_path, script_text, sentinel, size=2, timeout=240,
+              args_for_rank=None):
+    """Write ``script_text`` and run ``size`` ranks of it.
+
+    Each rank's argv is ``[rank, *args_for_rank(rank, port)]`` (default:
+    ``[rank, port]``). Asserts rc==0 and the sentinel for every rank; on
+    any failure or timeout the remaining workers are killed before the
+    assertion propagates."""
+    port = free_port()
+    script = tmp_path / "worker.py"
+    script.write_text(script_text)
+    env = dict(os.environ)
+    env["HVD_REPO"] = REPO
+    if args_for_rank is None:
+        args_for_rank = lambda rank, port: [str(port)]  # noqa: E731
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(r),
+         *[str(a) for a in args_for_rank(r, port)]], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for r in range(size)]
+    try:
+        for r, p in enumerate(procs):
+            out, _ = p.communicate(timeout=timeout)
+            assert p.returncode == 0, f"rank {r} failed:\n{out}"
+            assert f"{sentinel}_{r}_OK" in out, out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()

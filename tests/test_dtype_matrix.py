@@ -7,10 +7,6 @@ torch binding so dtype plumbing (Python code ↔ wire ↔ C++ ring
 accumulate) is proven end-to-end, not per-dtype-at-size-1.
 """
 
-import os
-import socket
-import subprocess
-import sys
 import textwrap
 
 import pytest
@@ -100,20 +96,6 @@ _WORKER = textwrap.dedent("""
 
 
 def test_dtype_op_matrix_two_process(tmp_path):
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    script = tmp_path / "matrix_worker.py"
-    script.write_text(_WORKER)
-    env = dict(os.environ)
-    env["HVD_REPO"] = os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))
-    procs = [subprocess.Popen(
-        [sys.executable, str(script), str(r), str(port)], env=env,
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-        for r in range(2)]
-    for r, p in enumerate(procs):
-        out, _ = p.communicate(timeout=240)
-        assert p.returncode == 0, f"rank {r} failed:\n{out}"
-        assert f"DTMATRIX_{r}_OK" in out
+    from proc_harness import run_world
+
+    run_world(tmp_path, _WORKER, "DTMATRIX")

@@ -182,25 +182,6 @@ def test_metric_average_callback_two_process(tmp_path):
     """The size>1 branch of MetricAverageCallback runs a real host-plane
     allreduce across 2 processes (it calls the backend's _np_allreduce —
     a path size-1 tests short-circuit past)."""
-    import os
-    import socket
-    import subprocess
-    import sys
+    from proc_harness import run_world
 
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    script = tmp_path / "metric_avg_worker.py"
-    script.write_text(_METRIC_AVG_WORKER)
-    env = dict(os.environ)
-    env["HVD_REPO"] = os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))
-    procs = [subprocess.Popen(
-        [sys.executable, str(script), str(r), str(port)], env=env,
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-        for r in range(2)]
-    for r, p in enumerate(procs):
-        out, _ = p.communicate(timeout=180)
-        assert p.returncode == 0, f"rank {r} failed:\n{out}"
-        assert f"METRICAVG_{r}_OK" in out
+    run_world(tmp_path, _METRIC_AVG_WORKER, "METRICAVG", timeout=180)

@@ -8,10 +8,6 @@ ring world in the subprocess test.
 """
 
 import importlib
-import os
-import socket
-import subprocess
-import sys
 import textwrap
 
 import numpy as np
@@ -152,20 +148,7 @@ _MX_WORKER = textwrap.dedent("""
 def test_mxnet_two_process_ring(tmp_path):
     """The binding's collectives ride the real native 2-process ring —
     the reference's mpirun-launched Pattern-1 test shape."""
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    script = tmp_path / "mx_worker.py"
-    script.write_text(_MX_WORKER)
-    env = dict(os.environ)
-    env["HVD_REPO"] = os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))
-    procs = [subprocess.Popen(
-        [sys.executable, str(script), str(r), "2", str(port)], env=env,
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-        for r in range(2)]
-    for r, p in enumerate(procs):
-        out, _ = p.communicate(timeout=180)
-        assert p.returncode == 0, f"rank {r} failed:\n{out}"
-        assert f"MXRING_{r}_OK" in out, out
+    from proc_harness import run_world
+
+    run_world(tmp_path, _MX_WORKER, "MXRING", timeout=180,
+              args_for_rank=lambda rank, port: [2, port])

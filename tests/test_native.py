@@ -2,10 +2,6 @@
 2-process TCP controller + ring data-plane run (the reference's
 mpirun-launched Pattern-1 tests, SURVEY §4, done with subprocesses)."""
 
-import os
-import socket
-import subprocess
-import sys
 import textwrap
 
 import numpy as np
@@ -14,34 +10,15 @@ import pytest
 from horovod_tpu.common import native as hn
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
 def _run_workers(tmp_path, script_text, sentinel, size=2, timeout=120,
                  extra_args=()):
     """Launch `size` worker subprocesses of `script_text` (argv: rank,
     [extra_args...,] port) and assert each exits 0 printing
     `{sentinel}_{rank}_OK`."""
-    port = _free_port()
-    script = tmp_path / "worker.py"
-    script.write_text(script_text)
-    env = dict(os.environ)
-    env["HVD_REPO"] = os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))
-    procs = [subprocess.Popen(
-        [sys.executable, str(script), str(r),
-         *[str(a) for a in extra_args], str(port)], env=env,
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-        for r in range(size)]
-    for r, p in enumerate(procs):
-        out, _ = p.communicate(timeout=timeout)
-        assert p.returncode == 0, f"rank {r} failed:\n{out}"
-        assert f"{sentinel}_{r}_OK" in out, out
+    from proc_harness import run_world
+
+    run_world(tmp_path, script_text, sentinel, size=size, timeout=timeout,
+              args_for_rank=lambda rank, port: [*extra_args, port])
 
 
 def test_library_loads():
@@ -283,8 +260,6 @@ def test_ragged_host_allgatherv(tmp_path):
     ops/mpi_operations.cc:140-175)."""
     import textwrap as tw
 
-    size = 2
-    port = _free_port()
     code = tw.dedent("""
         import os, sys
         import numpy as np

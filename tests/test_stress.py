@@ -1,0 +1,120 @@
+"""Randomized controller/ring soak: a seeded random schedule of mixed
+collectives submitted in bursts and synchronized out of order across a
+real 2-process world.
+
+The unit suites pin one behavior per test; this shakes the negotiation
+machinery the way training does — many named tensors in flight, mixed
+ops/dtypes/shapes binned into shared fusion cycles, results claimed in
+arbitrary order — and asserts every single result. The schedule is
+deterministic (seeded) so failures reproduce.
+"""
+
+import textwrap
+
+import pytest
+
+pytest.importorskip("torch")
+
+_WORKER = textwrap.dedent("""
+    import os, random, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    rank = int(sys.argv[1]); port = int(sys.argv[2]); seed = int(sys.argv[3])
+    os.environ.update(HOROVOD_RANK=str(rank), HOROVOD_SIZE="2",
+                      HOROVOD_LOCAL_RANK=str(rank), HOROVOD_LOCAL_SIZE="2",
+                      HOROVOD_CONTROLLER_ADDR="127.0.0.1",
+                      HOROVOD_CONTROLLER_PORT=str(port),
+                      HOROVOD_CYCLE_TIME="1.0",
+                      JAX_PLATFORMS="cpu")
+    import torch
+    import horovod_tpu.torch as hvd
+    from horovod_tpu.torch import mpi_ops as ops
+
+    hvd.init()
+    size = hvd.size()
+
+    def fill(i, r):
+        return (i % 7) + r + 1
+
+    def check(h, kind, i, dt, shape, extra):
+        out = ops.synchronize(h)
+        if kind == "allreduce":
+            vals = [fill(i, r) for r in range(size)]
+            if extra == hvd.Sum:
+                expect = sum(vals)
+            elif extra == hvd.Min:
+                expect = min(vals)
+            else:
+                expect = max(vals)
+            assert out.dtype == dt, (i, dt, out.dtype)
+            ref = torch.full(shape, expect, dtype=dt)
+            assert torch.all(out == ref), (i, kind, out.flatten()[:4],
+                                           expect)
+        elif kind == "broadcast":
+            expect = fill(i, extra)
+            assert torch.all(out == torch.full(shape, expect, dtype=dt)), \\
+                (i, kind, extra, out.flatten()[:4])
+        else:
+            parts = []
+            for r in range(size):
+                rows = r + 1 + (i % 3)
+                parts.append(torch.full((rows,) + shape, fill(i, r),
+                                        dtype=dt))
+            ref = torch.cat(parts)
+            assert out.shape == ref.shape, (i, out.shape, ref.shape)
+            assert torch.all(out == ref), (i, kind, out.flatten()[:6])
+
+    # The SAME schedule must be generated on every rank (collective order
+    # is a cross-rank contract); only the data differs by rank. Drain
+    # points are also part of the shared schedule — but the *order* of
+    # synchronize within a drain is shuffled per the shared rng, which is
+    # still rank-identical; out-of-order claiming is legal regardless.
+    rng = random.Random(seed)
+    DTYPES = [torch.float32, torch.float64, torch.int32, torch.int64,
+              torch.int16, torch.float16, torch.bfloat16]
+
+    pending = []
+    N_OPS = 140
+    for i in range(N_OPS):
+        kind = rng.choice(["allreduce", "allreduce", "allreduce",
+                           "broadcast", "allgather"])
+        dt = rng.choice(DTYPES)
+        shape = tuple(rng.choice([1, 2, 3, 5])
+                      for _ in range(rng.randint(1, 3)))
+        if kind == "allreduce":
+            op = rng.choice([hvd.Sum, hvd.Min, hvd.Max])
+            if dt in (torch.float16, torch.bfloat16) and op != hvd.Sum:
+                op = hvd.Sum  # keep 16-bit floats on the fp32-sum path
+            x = torch.full(shape, fill(i, rank), dtype=dt)
+            h = ops.allreduce_async(x, op=op, name=f"s.{i}")
+            pending.append((h, "allreduce", i, dt, shape, op))
+        elif kind == "broadcast":
+            root = rng.randrange(size)
+            x = torch.full(shape, fill(i, rank), dtype=dt)
+            h = ops.broadcast_async(x, root_rank=root, name=f"s.{i}")
+            pending.append((h, "broadcast", i, dt, shape, root))
+        else:
+            rows = rank + 1 + (i % 3)
+            x = torch.full((rows,) + shape, fill(i, rank), dtype=dt)
+            h = ops.allgather_async(x, name=f"s.{i}")
+            pending.append((h, "allgather", i, dt, shape, None))
+        if len(pending) >= rng.randint(6, 16):
+            rng.shuffle(pending)
+            while pending:
+                check(*pending.pop())
+
+    rng.shuffle(pending)
+    while pending:
+        check(*pending.pop())
+
+    hvd.barrier()
+    hvd.shutdown()
+    print(f"STRESS_{rank}_OK")
+""")
+
+
+def test_randomized_schedule_two_process(tmp_path):
+    from proc_harness import run_world
+
+    run_world(tmp_path, _WORKER, "STRESS", timeout=300,
+              args_for_rank=lambda rank, port: [port, 1234])

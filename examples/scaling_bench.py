@@ -1,0 +1,130 @@
+"""Allreduce scaling-efficiency harness (the BASELINE.md north-star
+protocol: ≥90 % efficiency scaling ResNet over chips, reference
+``docs/benchmarks.rst`` methodology).
+
+Runs the compiled data-parallel train step over growing device meshes
+(1, 2, 4, ... up to all attached devices — real chips on a pod, or the
+virtual CPU mesh under ``JAX_PLATFORMS=cpu`` + ``jax_num_cpu_devices``)
+with a FIXED per-device batch, and reports
+
+    efficiency(d) = img/s-per-device(d) / img/s-per-device(1)
+
+which isolates the cost the allreduce adds as the world grows — the
+number the reference's 90 %-at-512-GPUs headline quotes. Prints one
+JSON line last, like bench.py.
+
+NOTE: only meaningful on real multi-chip hardware, where each device is
+its own silicon. On the virtual CPU mesh the "devices" timeshare one
+host's cores, so per-device throughput falls roughly as 1/d by
+construction — there the harness only validates that the protocol runs.
+
+    JAX_PLATFORMS=cpu python examples/scaling_bench.py \
+        --devices 1 2 4 8 --model resnet18 --batch-size 4 --image-size 64
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--devices", type=int, nargs="*", default=None,
+                        help="world sizes to measure (default: powers of 2 "
+                             "up to the attached device count)")
+    parser.add_argument("--model", choices=["resnet18", "resnet50"],
+                        default="resnet18")
+    parser.add_argument("--batch-size", type=int, default=4,
+                        help="per-device batch (held constant across sizes)")
+    parser.add_argument("--image-size", type=int, default=64)
+    parser.add_argument("--num-classes", type=int, default=100)
+    parser.add_argument("--num-warmup", type=int, default=2)
+    parser.add_argument("--num-iters", type=int, default=8)
+    parser.add_argument("--cpu-devices", type=int, default=None,
+                        help="force an N-device virtual CPU mesh "
+                             "(protocol validation without hardware)")
+    args = parser.parse_args()
+
+    import jax
+
+    if args.cpu_devices:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.common.state import AXIS_GLOBAL
+    from horovod_tpu.models.resnet import ResNet18, ResNet50
+    from horovod_tpu.training import (
+        init_train_state, make_train_step, replicate_state, shard_batch)
+
+    all_devices = jax.devices()
+    sizes = args.devices
+    if not sizes:
+        sizes, d = [], 1
+        while d <= len(all_devices):
+            sizes.append(d)
+            d *= 2
+    sizes = [d for d in sizes if d <= len(all_devices)]
+    if not sizes:
+        raise SystemExit(
+            f"no requested world size fits the {len(all_devices)} attached "
+            f"device(s); pass smaller --devices (or --cpu-devices N)")
+    args.num_warmup = max(1, args.num_warmup)  # the fence reads warmup loss
+
+    model_cls = ResNet18 if args.model == "resnet18" else ResNet50
+    model = model_cls(num_classes=args.num_classes, dtype=jnp.bfloat16)
+    optimizer = optax.sgd(0.01, momentum=0.9)
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.zeros((1, args.image_size, args.image_size, 3), jnp.float32)
+    # Host-side master copy: the train step donates its state, and on a
+    # 1-device mesh device_put can alias rather than copy — donating an
+    # aliased buffer would delete the master for the next world size.
+    base_state = jax.tree_util.tree_map(
+        np.asarray, init_train_state(model, optimizer, rng, sample))
+
+    results = []
+    for d in sizes:
+        mesh = jax.sharding.Mesh(np.asarray(all_devices[:d]), (AXIS_GLOBAL,))
+        state = replicate_state(
+            jax.tree_util.tree_map(jnp.asarray, base_state), mesh)
+        gb = args.batch_size * d
+        images = np.random.RandomState(0).rand(
+            gb, args.image_size, args.image_size, 3).astype(np.float32)
+        labels = np.random.RandomState(1).randint(
+            0, args.num_classes, (gb,)).astype(np.int32)
+        images, labels = shard_batch(
+            (jnp.asarray(images), jnp.asarray(labels)), mesh)
+        step = make_train_step(model, optimizer, mesh)
+        for _ in range(args.num_warmup):
+            state, loss = step(state, images, labels)
+        float(np.asarray(loss))  # completion fence (see bench.py)
+        t0 = time.perf_counter()
+        for _ in range(args.num_iters):
+            state, loss = step(state, images, labels)
+        float(np.asarray(loss))
+        dt = time.perf_counter() - t0
+        per_dev = gb * args.num_iters / dt / d
+        results.append((d, per_dev))
+        print(f"devices={d:3d}  img/s/device={per_dev:9.2f}  "
+              f"efficiency vs {results[0][0]}-device: "
+              f"{per_dev / results[0][1] * 100:6.1f}%")
+
+    base = results[0][1]
+    if all_devices[0].platform == "cpu":
+        print("NOTE: virtual CPU devices timeshare one host — this "
+              "efficiency reflects core contention, not allreduce cost; "
+              "run on real chips for the meaningful number.")
+    print(json.dumps({
+        "metric": "scaling_efficiency",
+        "value": round(results[-1][1] / base, 4),
+        "unit": f"fraction at {results[-1][0]} devices vs {results[0][0]}",
+        "per_device_img_per_sec": {str(d): round(v, 2) for d, v in results},
+        "platform": all_devices[0].platform,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -126,3 +126,12 @@ def test_elastic_tf2_synthetic_example_single():
     out = _run_example("elastic/tensorflow2_synthetic_elastic.py",
                        "--num-batches", "20")
     assert "img/sec per worker" in out
+
+
+def test_scaling_bench_protocol_runs():
+    out = _run_example(
+        "scaling_bench.py", "--cpu-devices", "4", "--devices", "1", "2",
+        "--batch-size", "2", "--image-size", "32", "--num-classes", "10",
+        "--num-warmup", "1", "--num-iters", "2", timeout=420)
+    assert '"metric": "scaling_efficiency"' in out
+    assert "efficiency=" in out

@@ -45,6 +45,7 @@ HOROVOD_RENDEZVOUS_PORT = "HOROVOD_GLOO_RENDEZVOUS_PORT"
 HOROVOD_TPU_MESH_AXES = "HOROVOD_TPU_MESH_AXES"
 HOROVOD_TPU_DONUT_SIZE = "HOROVOD_TPU_DONUT_SIZE"
 HOROVOD_ELASTIC = "HOROVOD_ELASTIC"
+HOROVOD_ELASTIC_REJOIN_GRACE = "HOROVOD_ELASTIC_REJOIN_GRACE"
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # reference operations.cc:423
 DEFAULT_CYCLE_TIME_MS = 5.0  # reference operations.cc:431

@@ -19,6 +19,7 @@ from __future__ import annotations
 import os
 import socket
 import threading
+import time
 from typing import Optional, Tuple
 
 import numpy as np
@@ -50,8 +51,16 @@ _SCHED_LOCAL_SIZE = ("JSM_NAMESPACE_LOCAL_SIZE",
 # How long a surviving elastic worker waits for the driver to advance the
 # rendezvous round before concluding the failure was transient and
 # re-joining the current round. Must comfortably cover blacklist cooldown
-# + plan activation (a few seconds).
-_REJOIN_GRACE_SECONDS = 10.0
+# + plan activation; raise HOROVOD_ELASTIC_REJOIN_GRACE when running with
+# long --blacklist-cooldown-range values.
+_REJOIN_GRACE_SECONDS = _config._get_float(
+    _config.HOROVOD_ELASTIC_REJOIN_GRACE, 10.0)
+
+
+def _excluded_from_plan_error() -> "HorovodInternalError":
+    return HorovodInternalError(
+        "this worker is no longer in the rendezvous plan (slot removed or "
+        "host blacklisted)")
 
 
 def _sched_env(primary: str, fallbacks, default: str) -> str:
@@ -161,8 +170,6 @@ class HostWorld:
         hostname = os.environ.get("HOROVOD_HOSTNAME")
         if not (addr and port and hostname):
             return
-        import time as _time
-
         from ..run.elastic.rendezvous import fetch_slot_info
 
         # A surviving worker re-initializing after a failure *prefers* a
@@ -173,7 +180,7 @@ class HostWorld:
         # bounded grace, not a hard wait — a *transient* collective failure
         # (no process died, plan unchanged) advances nothing, and everyone
         # simply re-joins the current round.
-        grace = _time.monotonic() + _REJOIN_GRACE_SECONDS
+        grace = time.monotonic() + _REJOIN_GRACE_SECONDS
         while True:
             try:
                 fetched = fetch_slot_info(addr, int(port), hostname,
@@ -198,16 +205,14 @@ class HostWorld:
                     # topology would join the new round with an old rank
                     # and could overwrite a legitimate worker's slot in
                     # the coordinator's tables.
-                    raise HorovodInternalError(
-                        "this worker is no longer in the rendezvous plan "
-                        "(slot removed or host blacklisted)")
+                    raise _excluded_from_plan_error()
                 return  # first init: launch-time env is authoritative
             info, rendezvous_round = fetched
             if self._last_rendezvous_round is None or \
                     rendezvous_round > self._last_rendezvous_round or \
-                    _time.monotonic() > grace:
+                    time.monotonic() > grace:
                 break
-            _time.sleep(0.25)
+            time.sleep(0.25)
         (self.rank, self.size, self.local_rank, self.local_size,
          self.cross_rank, self.cross_size) = info
         self._last_rendezvous_round = rendezvous_round
@@ -257,22 +262,18 @@ class HostWorld:
         immediately so the elastic retry loop re-rendezvouses against the
         live round instead of burning the full timeout on a coordinator
         that will never publish."""
-        import time as _time
-
         from ..run.elastic.rendezvous import (
             fetch_controller_endpoint, fetch_slot_info)
 
-        deadline = _time.monotonic() + 120.0
-        while _time.monotonic() < deadline:
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
             ep = fetch_controller_endpoint(addr, port, rendezvous_round,
                                            timeout=2.0)
             if ep is not None:
                 return ep
             current = fetch_slot_info(addr, port, hostname, self.local_rank)
             if current is None:
-                raise HorovodInternalError(
-                    "this worker is no longer in the rendezvous plan "
-                    "(slot removed or host blacklisted)")
+                raise _excluded_from_plan_error()
             if current[1] != rendezvous_round:
                 raise HorovodInternalError(
                     f"rendezvous advanced to round {current[1]} while "

@@ -48,13 +48,15 @@ _SCHED_LOCAL_RANK = ("JSM_NAMESPACE_LOCAL_RANK",
 _SCHED_LOCAL_SIZE = ("JSM_NAMESPACE_LOCAL_SIZE",
                      "OMPI_COMM_WORLD_LOCAL_SIZE", "SLURM_NTASKS_PER_NODE")
 
-# How long a surviving elastic worker waits for the driver to advance the
-# rendezvous round before concluding the failure was transient and
-# re-joining the current round. Must comfortably cover blacklist cooldown
-# + plan activation; raise HOROVOD_ELASTIC_REJOIN_GRACE when running with
-# long --blacklist-cooldown-range values.
-_REJOIN_GRACE_SECONDS = _config._get_float(
-    _config.HOROVOD_ELASTIC_REJOIN_GRACE, 10.0)
+
+def _rejoin_grace_seconds() -> float:
+    """How long a surviving elastic worker waits for the driver to advance
+    the rendezvous round before concluding the failure was transient and
+    re-joining the current round. Must comfortably cover blacklist
+    cooldown + plan activation; raise HOROVOD_ELASTIC_REJOIN_GRACE when
+    running with long --blacklist-cooldown-range values. Read per (re-)
+    init, like every other runtime knob."""
+    return _config._get_float(_config.HOROVOD_ELASTIC_REJOIN_GRACE, 10.0)
 
 
 def _excluded_from_plan_error() -> "HorovodInternalError":
@@ -180,7 +182,7 @@ class HostWorld:
         # bounded grace, not a hard wait — a *transient* collective failure
         # (no process died, plan unchanged) advances nothing, and everyone
         # simply re-joins the current round.
-        grace = time.monotonic() + _REJOIN_GRACE_SECONDS
+        grace = time.monotonic() + _rejoin_grace_seconds()
         while True:
             try:
                 fetched = fetch_slot_info(addr, int(port), hostname,

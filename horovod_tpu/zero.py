@@ -1,0 +1,192 @@
+"""ZeRO-1–style sharded optimizer for the JAX-native API.
+
+Beyond the reference's capability set (its DistributedOptimizer keeps the
+full optimizer state on every worker): here each device holds only its
+1/d slice of the optimizer state, cutting optimizer memory by the mesh
+size — the partitioning of Rajbhandari et al.'s ZeRO stage 1, expressed
+TPU-natively. Per step, inside one compiled program:
+
+    grads  --psum_scatter-->  grad shard        (ICI reduce-scatter)
+    shard update (optax on the flat shard, fp32 master arithmetic)
+    params --all_gather-----> full params       (ICI all-gather)
+
+The reduce-scatter + all-gather pair moves exactly the same bytes as the
+allreduce it replaces (an allreduce IS a reduce-scatter + all-gather), so
+the memory saving is communication-neutral.
+
+Works with any *elementwise* optax transformation (sgd, momentum, adam,
+adamw, rmsprop, ...): the update runs on a flat concatenated shard, which
+is elementwise-equivalent to running on the structured pytree. Transforms
+that need global structure (global-norm clipping, layerwise LARS) must
+stay outside or be re-derived with a psum — documented limitation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .common.state import AXIS_GLOBAL
+
+
+class ZeroTrainState(NamedTuple):
+    params: Any       # full pytree, replicated (model dtype)
+    opt_shard: Any    # optimizer state over this device's flat fp32 shard
+    batch_stats: Any
+    step: Any
+
+
+def _flat_spec(params):
+    """Static flattening plan: (leaves treedef, shapes, sizes, total)."""
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    shapes = [l.shape for l in leaves]
+    dtypes = [l.dtype for l in leaves]
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+    return treedef, shapes, dtypes, sizes, int(sum(sizes))
+
+
+def _opt_state_specs(optimizer, shard_len, axis_name):
+    """Per-leaf partition specs for the optimizer state over a flat
+    shard: vector leaves (mu/nu/momentum, one element per parameter
+    element) shard along the axis; scalar leaves (step counts) are
+    replicated — identical on every device by construction."""
+    shapes = jax.eval_shape(
+        optimizer.init, jnp.zeros((shard_len,), jnp.float32))
+    return jax.tree_util.tree_map(
+        lambda s: P(axis_name) if len(s.shape) >= 1 else P(), shapes)
+
+
+def _flatten_f32(params, total, padded):
+    leaves = jax.tree_util.tree_leaves(params)
+    flat = jnp.concatenate(
+        [l.astype(jnp.float32).reshape(-1) for l in leaves])
+    return jnp.pad(flat, (0, padded - total))
+
+
+def _unflatten(flat, treedef, shapes, dtypes, sizes, total):
+    parts = jnp.split(flat[:total], np.cumsum(sizes)[:-1])
+    leaves = [p.reshape(s).astype(dt)
+              for p, s, dt in zip(parts, shapes, dtypes)]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def init_zero_train_state(model, optimizer: optax.GradientTransformation,
+                          rng, sample_input, mesh,
+                          axis_name: str = AXIS_GLOBAL) -> ZeroTrainState:
+    """Initialize params (replicated) + the sharded optimizer state.
+
+    The optimizer state is created per-device on that device's flat
+    shard inside a shard_mapped init, so it is born sharded — no full
+    copy ever exists on any one device."""
+    variables = model.init(rng, sample_input, train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats")
+
+    d = int(mesh.shape[axis_name])
+    treedef, shapes, dtypes, sizes, total = _flat_spec(params)
+    padded = ((total + d - 1) // d) * d
+    shard_len = padded // d
+
+    def init_shard(p):
+        flat = _flatten_f32(p, total, padded)
+        idx = lax.axis_index(axis_name)
+        my = lax.dynamic_slice(flat, (idx * shard_len,), (shard_len,))
+        return optimizer.init(my)
+
+    sharded_init = jax.jit(jax.shard_map(
+        init_shard, mesh=mesh, in_specs=(P(),),
+        out_specs=_opt_state_specs(optimizer, shard_len, axis_name),
+        check_vma=False))
+
+    replicated = NamedSharding(mesh, P())
+    params = jax.device_put(params, replicated)
+    if batch_stats is not None:
+        batch_stats = jax.device_put(batch_stats, replicated)
+    opt_shard = sharded_init(params)
+    return ZeroTrainState(params, opt_shard, batch_stats,
+                          jax.device_put(jnp.zeros((), jnp.int32),
+                                         replicated))
+
+
+def make_zero_train_step(model, optimizer: optax.GradientTransformation,
+                         mesh, axis_name: str = AXIS_GLOBAL,
+                         donate: bool = True):
+    """Build the jitted SPMD train step with ZeRO-1 optimizer sharding.
+
+    Drop-in alternative to ``training.make_train_step`` (same call
+    signature on the state it builds); the loss/batch-stats semantics
+    match it exactly."""
+    from .training import cross_entropy_loss
+
+    d = int(mesh.shape[axis_name])
+
+    def step_fn(state: ZeroTrainState, images, labels):
+        treedef, shapes, dtypes, sizes, total = _flat_spec(state.params)
+        padded = ((total + d - 1) // d) * d
+        shard_len = padded // d
+
+        def loss_fn(p):
+            variables = {"params": p}
+            if state.batch_stats is not None:
+                variables["batch_stats"] = state.batch_stats
+                logits, updated = model.apply(
+                    variables, images, train=True, mutable=["batch_stats"])
+                return (cross_entropy_loss(logits, labels),
+                        updated["batch_stats"])
+            logits = model.apply(variables, images, train=True)
+            return cross_entropy_loss(logits, labels), None
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+
+        # Mean-reduce and scatter in one collective: each device leaves
+        # with its shard of the global-mean gradient.
+        flat_g = _flatten_f32(grads, total, padded)
+        gshard = lax.psum_scatter(flat_g, axis_name, tiled=True) / d
+
+        idx = lax.axis_index(axis_name)
+        flat_p = _flatten_f32(state.params, total, padded)
+        pshard = lax.dynamic_slice(flat_p, (idx * shard_len,), (shard_len,))
+
+        updates, new_opt = optimizer.update(gshard, state.opt_shard, pshard)
+        new_pshard = optax.apply_updates(pshard, updates)
+
+        new_flat = lax.all_gather(new_pshard, axis_name, tiled=True)
+        new_params = _unflatten(new_flat, treedef, shapes, dtypes, sizes,
+                                total)
+
+        if new_stats is not None:
+            new_stats = jax.tree_util.tree_map(
+                lambda x: lax.pmean(x, axis_name), new_stats)
+        loss = lax.pmean(loss, axis_name)
+        return ZeroTrainState(new_params, new_opt, new_stats,
+                              state.step + 1), loss
+
+    cache = {}
+
+    def step(state: ZeroTrainState, images, labels):
+        if "fn" not in cache:
+            # The optimizer-state specs depend on the shard length, which
+            # depends on the parameter count — resolve once from the first
+            # state and cache the compiled step.
+            _, _, _, _, total = _flat_spec(state.params)
+            shard_len = ((total + d - 1) // d * d) // d
+            opt_specs = _opt_state_specs(optimizer, shard_len, axis_name)
+            state_specs = ZeroTrainState(P(), opt_specs, P(), P())
+            sharded = jax.shard_map(
+                step_fn, mesh=mesh,
+                in_specs=(state_specs, P(axis_name), P(axis_name)),
+                out_specs=(state_specs, P()),
+                check_vma=False)
+            cache["fn"] = jax.jit(
+                sharded, donate_argnums=(0,) if donate else ())
+        return cache["fn"](state, images, labels)
+
+    return step

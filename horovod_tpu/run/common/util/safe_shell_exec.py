@@ -20,6 +20,13 @@ from typing import List, Optional
 
 GRACEFUL_TERMINATION_TIME_S = 5
 
+# After the worker exits, how long to keep draining its output pipes.
+# EOF arrives as soon as the (dead) worker's buffered output is consumed;
+# the bound only matters when a surviving grandchild inherited the pipe,
+# where waiting forever would hang the launcher. Long enough that a
+# final burst (a traceback after MBs of logs) is never truncated.
+PUMP_DRAIN_TIME_S = 10
+
 
 def terminate_executor_shell_and_children(pid: int) -> None:
     """SIGTERM the process group, then SIGKILL stragglers (parity:
@@ -95,6 +102,9 @@ def execute(command, env: Optional[dict] = None,
         exit_code = proc.wait()
     finally:
         stop_watch.set()
+        # Drain fully before the caller closes its streams: a short join
+        # here would let redirected log files close mid-pump and silently
+        # truncate the tail (often the crash traceback itself).
         for t in pumps:
-            t.join(timeout=1.0)
+            t.join(timeout=PUMP_DRAIN_TIME_S)
     return exit_code

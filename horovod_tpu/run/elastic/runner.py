@@ -77,6 +77,14 @@ def run_elastic(args, command: List[str],
         cmd = _launch.build_worker_command(
             slot, command, worker_env,
             ssh_port=getattr(args, "ssh_port", None))
+        output_dir = getattr(args, "output_filename", None)
+        if output_dir:
+            # "a" not "w": a slot can be re-staffed across elastic rounds,
+            # and each life's output should append rather than erase its
+            # predecessor's.
+            return _launch.execute_redirected(cmd, worker_env, events,
+                                              output_dir, slot.rank,
+                                              mode="a")
         return safe_shell_exec.execute(
             cmd, env=worker_env, events=events,
             prefix=str(slot.rank), stdout=sys.stdout, stderr=sys.stderr)

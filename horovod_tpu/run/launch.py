@@ -83,16 +83,36 @@ def build_worker_command(slot: SlotInfo, command: List[str],
            f"{shlex.quote(remote)}"
 
 
+def execute_redirected(cmd, env, events, output_dir: str, rank: int,
+                       mode: str = "w") -> int:
+    """Run a worker with stdout/stderr redirected to
+    ``<output_dir>/rank.<rank>/stdout|stderr`` (reference
+    ``--output-filename`` layout). ``mode="a"`` lets elastic re-staffed
+    slots append across lives instead of erasing their predecessor's
+    output."""
+    rank_dir = os.path.join(output_dir, f"rank.{rank}")
+    os.makedirs(rank_dir, exist_ok=True)
+    with open(os.path.join(rank_dir, "stdout"), mode) as out_f, \
+            open(os.path.join(rank_dir, "stderr"), mode) as err_f:
+        return safe_shell_exec.execute(
+            cmd, env=env, events=events, prefix=None,
+            stdout=out_f, stderr=err_f)
+
+
 def launch_workers(host_alloc_plan: List[SlotInfo], command: List[str],
                    controller_addr: str, controller_port: int,
                    rendezvous_addr: str, rendezvous_port: int,
                    ssh_port: Optional[int] = None,
                    base_env: Optional[Dict[str, str]] = None,
                    events: Optional[List[threading.Event]] = None,
-                   prefix_output: bool = True) -> List[int]:
+                   prefix_output: bool = True,
+                   output_filename: Optional[str] = None) -> List[int]:
     """Spawn every slot's worker, pump output, return exit codes in rank
     order. One failing worker triggers termination of the rest (parity:
-    ``gloo_run.py:183-259`` launch + MultiFileWriter behavior)."""
+    ``gloo_run.py:183-259`` launch + MultiFileWriter behavior). With
+    ``output_filename`` set, each rank's stdout/stderr go to
+    ``<dir>/rank.<N>/stdout|stderr`` instead of the launcher's streams
+    (reference ``--output-filename`` semantics)."""
     exit_codes: List[Optional[int]] = [None] * len(host_alloc_plan)
     abort = threading.Event()
     all_events = list(events or []) + [abort]
@@ -108,10 +128,14 @@ def launch_workers(host_alloc_plan: List[SlotInfo], command: List[str],
         env = slot_env(slot, controller_addr, controller_port,
                        rendezvous_addr, rendezvous_port, base_env)
         cmd = build_worker_command(slot, command, env, ssh_port)
-        code = safe_shell_exec.execute(
-            cmd, env=env, events=all_events,
-            prefix=f"{slot.rank}" if prefix_output else None,
-            stdout=sys.stdout, stderr=sys.stderr)
+        if output_filename:
+            code = execute_redirected(cmd, env, all_events,
+                                      output_filename, slot.rank)
+        else:
+            code = safe_shell_exec.execute(
+                cmd, env=env, events=all_events,
+                prefix=f"{slot.rank}" if prefix_output else None,
+                stdout=sys.stdout, stderr=sys.stderr)
         exit_codes[i] = code
         if code != 0:
             abort.set()

@@ -220,7 +220,8 @@ def _run_static(args, command: List[str], base_env: Optional[dict] = None,
             controller_port=controller_port,
             rendezvous_addr=_launcher_addr(plan),
             rendezvous_port=rendezvous_port,
-            ssh_port=getattr(args, "ssh_port", None), base_env=env)
+            ssh_port=getattr(args, "ssh_port", None), base_env=env,
+            output_filename=getattr(args, "output_filename", None))
         if collect is not None and max(codes, default=1) == 0:
             collect(rendezvous, np_)
     finally:
@@ -310,7 +311,8 @@ def main() -> None:
 def run(func, args=(), kwargs=None, np: int = 1,
         hosts: Optional[str] = None, hostfile: Optional[str] = None,
         ssh_port: Optional[int] = None, verbose: bool = False,
-        use_cloudpickle: bool = True, env: Optional[dict] = None):
+        use_cloudpickle: bool = True, env: Optional[dict] = None,
+        output_filename: Optional[str] = None):
     """Run ``func(*args, **kwargs)`` on ``np`` ranks; return the list of
     per-rank return values in rank order."""
     import cloudpickle
@@ -323,7 +325,7 @@ def run(func, args=(), kwargs=None, np: int = 1,
         ns = argparse.Namespace(
             np=np, hosts=hosts, hostfile=hostfile, ssh_port=ssh_port,
             verbose=verbose, disable_cache=False, config_file=None,
-            min_np=None, output_filename=None, start_timeout=30,
+            min_np=None, output_filename=output_filename, start_timeout=30,
             launcher="auto")
         command = [sys.executable, "-m", "horovod_tpu.run.task_fn", fn_path]
         base_env = dict(env if env is not None else os.environ)

@@ -2,17 +2,21 @@
 
 Beyond the reference's capability set (its DistributedOptimizer keeps the
 full optimizer state on every worker): here each device holds only its
-1/d slice of the optimizer state, cutting optimizer memory by the mesh
-size — the partitioning of Rajbhandari et al.'s ZeRO stage 1, expressed
-TPU-natively. Per step, inside one compiled program:
+1/d slice of the optimizer state and of the fp32 master weights, cutting
+optimizer memory by the mesh-axis size — the partitioning of
+Rajbhandari et al.'s ZeRO stage 1, expressed TPU-natively. Per step,
+inside one compiled program:
 
     grads  --psum_scatter-->  grad shard        (ICI reduce-scatter)
-    shard update (optax on the flat shard, fp32 master arithmetic)
-    params --all_gather-----> full params       (ICI all-gather)
+    shard update (optax on the persistent fp32 master shard)
+    masters --all_gather----> full params       (ICI all-gather)
 
 The reduce-scatter + all-gather pair moves exactly the same bytes as the
 allreduce it replaces (an allreduce IS a reduce-scatter + all-gather), so
-the memory saving is communication-neutral.
+the memory saving is communication-neutral. The fp32 master shard lives
+in the train state across steps — updates accumulate at fp32 precision
+even when the model params are bf16, and the step never materializes a
+full fp32 copy of the parameters.
 
 Works with any *elementwise* optax transformation (sgd, momentum, adam,
 adamw, rmsprop, ...): the update runs on a flat concatenated shard, which
@@ -38,7 +42,8 @@ from .common.state import AXIS_GLOBAL
 
 class ZeroTrainState(NamedTuple):
     params: Any       # full pytree, replicated (model dtype)
-    opt_shard: Any    # optimizer state over this device's flat fp32 shard
+    pshard: Any       # this device's flat fp32 master-weight shard
+    opt_shard: Any    # optimizer state over the master shard
     batch_stats: Any
     step: Any
 
@@ -50,6 +55,12 @@ def _flat_spec(params):
     dtypes = [l.dtype for l in leaves]
     sizes = [int(np.prod(s)) if s else 1 for s in shapes]
     return treedef, shapes, dtypes, sizes, int(sum(sizes))
+
+
+def _shard_len(total: int, d: int) -> int:
+    """One source of truth for the padding arithmetic: flat length padded
+    up to a multiple of d, divided across the d shards."""
+    return ((total + d - 1) // d * d) // d
 
 
 def _opt_state_specs(optimizer, shard_len, axis_name):
@@ -80,37 +91,39 @@ def _unflatten(flat, treedef, shapes, dtypes, sizes, total):
 def init_zero_train_state(model, optimizer: optax.GradientTransformation,
                           rng, sample_input, mesh,
                           axis_name: str = AXIS_GLOBAL) -> ZeroTrainState:
-    """Initialize params (replicated) + the sharded optimizer state.
+    """Initialize params (replicated) + the sharded fp32 master weights
+    and optimizer state.
 
-    The optimizer state is created per-device on that device's flat
-    shard inside a shard_mapped init, so it is born sharded — no full
-    copy ever exists on any one device."""
+    Masters and optimizer state are created per-device on that device's
+    flat shard inside a shard_mapped init, so they are born sharded — no
+    full fp32 copy ever exists on any one device."""
     variables = model.init(rng, sample_input, train=False)
     params = variables["params"]
     batch_stats = variables.get("batch_stats")
 
     d = int(mesh.shape[axis_name])
-    treedef, shapes, dtypes, sizes, total = _flat_spec(params)
-    padded = ((total + d - 1) // d) * d
-    shard_len = padded // d
+    _, _, _, _, total = _flat_spec(params)
+    shard_len = _shard_len(total, d)
+    padded = shard_len * d
 
     def init_shard(p):
         flat = _flatten_f32(p, total, padded)
         idx = lax.axis_index(axis_name)
         my = lax.dynamic_slice(flat, (idx * shard_len,), (shard_len,))
-        return optimizer.init(my)
+        return my, optimizer.init(my)
 
     sharded_init = jax.jit(jax.shard_map(
         init_shard, mesh=mesh, in_specs=(P(),),
-        out_specs=_opt_state_specs(optimizer, shard_len, axis_name),
+        out_specs=(P(axis_name),
+                   _opt_state_specs(optimizer, shard_len, axis_name)),
         check_vma=False))
 
     replicated = NamedSharding(mesh, P())
     params = jax.device_put(params, replicated)
     if batch_stats is not None:
         batch_stats = jax.device_put(batch_stats, replicated)
-    opt_shard = sharded_init(params)
-    return ZeroTrainState(params, opt_shard, batch_stats,
+    pshard, opt_shard = sharded_init(params)
+    return ZeroTrainState(params, pshard, opt_shard, batch_stats,
                           jax.device_put(jnp.zeros((), jnp.int32),
                                          replicated))
 
@@ -129,8 +142,7 @@ def make_zero_train_step(model, optimizer: optax.GradientTransformation,
 
     def step_fn(state: ZeroTrainState, images, labels):
         treedef, shapes, dtypes, sizes, total = _flat_spec(state.params)
-        padded = ((total + d - 1) // d) * d
-        shard_len = padded // d
+        padded = _shard_len(total, d) * d
 
         def loss_fn(p):
             variables = {"params": p}
@@ -151,12 +163,9 @@ def make_zero_train_step(model, optimizer: optax.GradientTransformation,
         flat_g = _flatten_f32(grads, total, padded)
         gshard = lax.psum_scatter(flat_g, axis_name, tiled=True) / d
 
-        idx = lax.axis_index(axis_name)
-        flat_p = _flatten_f32(state.params, total, padded)
-        pshard = lax.dynamic_slice(flat_p, (idx * shard_len,), (shard_len,))
-
-        updates, new_opt = optimizer.update(gshard, state.opt_shard, pshard)
-        new_pshard = optax.apply_updates(pshard, updates)
+        updates, new_opt = optimizer.update(gshard, state.opt_shard,
+                                            state.pshard)
+        new_pshard = optax.apply_updates(state.pshard, updates)
 
         new_flat = lax.all_gather(new_pshard, axis_name, tiled=True)
         new_params = _unflatten(new_flat, treedef, shapes, dtypes, sizes,
@@ -166,7 +175,7 @@ def make_zero_train_step(model, optimizer: optax.GradientTransformation,
             new_stats = jax.tree_util.tree_map(
                 lambda x: lax.pmean(x, axis_name), new_stats)
         loss = lax.pmean(loss, axis_name)
-        return ZeroTrainState(new_params, new_opt, new_stats,
+        return ZeroTrainState(new_params, new_pshard, new_opt, new_stats,
                               state.step + 1), loss
 
     cache = {}
@@ -177,9 +186,10 @@ def make_zero_train_step(model, optimizer: optax.GradientTransformation,
             # depends on the parameter count — resolve once from the first
             # state and cache the compiled step.
             _, _, _, _, total = _flat_spec(state.params)
-            shard_len = ((total + d - 1) // d * d) // d
-            opt_specs = _opt_state_specs(optimizer, shard_len, axis_name)
-            state_specs = ZeroTrainState(P(), opt_specs, P(), P())
+            opt_specs = _opt_state_specs(optimizer, _shard_len(total, d),
+                                         axis_name)
+            state_specs = ZeroTrainState(P(), P(axis_name), opt_specs,
+                                         P(), P())
             sharded = jax.shard_map(
                 step_fn, mesh=mesh,
                 in_specs=(state_specs, P(axis_name), P(axis_name)),

@@ -252,3 +252,30 @@ def test_packaging_console_entries_resolve():
     from horovod_tpu.version import __version__
 
     assert __version__
+
+
+def test_output_filename_redirects_worker_output(tmp_path):
+    """--output-filename <dir> writes each rank's output to
+    <dir>/rank.<N>/stdout|stderr (reference horovodrun semantics) instead
+    of the launcher's prefixed streams."""
+    from horovod_tpu.run import run as prog_run
+
+    def fn():
+        import sys
+
+        import horovod_tpu.torch as hvd
+
+        hvd.init()
+        print(f"OUT_FROM_{hvd.rank()}")
+        print(f"ERR_FROM_{hvd.rank()}", file=sys.stderr)
+        return hvd.rank()
+
+    out_dir = tmp_path / "logs"
+    results = prog_run(fn, np=2, hosts="localhost:2",
+                       output_filename=str(out_dir))
+    assert results == [0, 1]
+    for r in range(2):
+        stdout = (out_dir / f"rank.{r}" / "stdout").read_text()
+        stderr = (out_dir / f"rank.{r}" / "stderr").read_text()
+        assert f"OUT_FROM_{r}" in stdout
+        assert f"ERR_FROM_{r}" in stderr

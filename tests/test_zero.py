@@ -75,6 +75,9 @@ def test_zero_state_is_sharded(setup):
     vector_leaves = [l for l in jax.tree_util.tree_leaves(zstate.opt_shard)
                      if l.ndim >= 1]
     assert vector_leaves, "optimizer state has no vector leaves?"
+    # The fp32 master-weight shard is sharded exactly like them.
+    assert zstate.pshard.dtype == jnp.float32
+    vector_leaves = vector_leaves + [zstate.pshard]
     for leaf in vector_leaves:
         assert leaf.shape == (padded,)
         assert leaf.sharding.spec == P(AXIS_GLOBAL)

@@ -21,6 +21,10 @@ def main():
     parser.add_argument("--num-warmup", type=int, default=3)
     parser.add_argument("--num-iters", type=int, default=10)
     parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--zero", action="store_true",
+                        help="ZeRO-1 optimizer sharding (horovod_tpu.zero):"
+                             " reduce-scatter grads, per-shard update on "
+                             "fp32 masters, all-gather params")
     args = parser.parse_args()
 
     import jax
@@ -31,6 +35,7 @@ def main():
     from horovod_tpu.models.resnet import ResNet50
     from horovod_tpu.training import (
         init_train_state, make_train_step, replicate_state, shard_batch)
+    from horovod_tpu.zero import init_zero_train_state, make_zero_train_step
 
     hvd.init()
     n = hvd.size()
@@ -40,8 +45,11 @@ def main():
     optimizer = optax.sgd(0.01 * n, momentum=0.9)
     rng = jax.random.PRNGKey(0)
     sample = jnp.zeros((1, args.image_size, args.image_size, 3), jnp.float32)
-    state = replicate_state(init_train_state(model, optimizer, rng, sample),
-                            mesh)
+    if args.zero:
+        state = init_zero_train_state(model, optimizer, rng, sample, mesh)
+    else:
+        state = replicate_state(
+            init_train_state(model, optimizer, rng, sample), mesh)
 
     global_batch = args.batch_size * n
     images = np.random.RandomState(0).rand(
@@ -50,7 +58,8 @@ def main():
         0, 1000, (global_batch,)).astype(np.int32)
     images, labels = shard_batch((jnp.asarray(images), jnp.asarray(labels)),
                                  mesh)
-    step = make_train_step(model, optimizer, mesh)
+    step = (make_zero_train_step(model, optimizer, mesh) if args.zero
+            else make_train_step(model, optimizer, mesh))
 
     for _ in range(args.num_warmup):
         state, loss = step(state, images, labels)

@@ -104,7 +104,10 @@ def execute(command, env: Optional[dict] = None,
         stop_watch.set()
         # Drain fully before the caller closes its streams: a short join
         # here would let redirected log files close mid-pump and silently
-        # truncate the tail (often the crash traceback itself).
+        # truncate the tail (often the crash traceback itself). One shared
+        # deadline bounds the TOTAL stall when a surviving grandchild
+        # holds both pipes open.
+        deadline = time.time() + PUMP_DRAIN_TIME_S
         for t in pumps:
-            t.join(timeout=PUMP_DRAIN_TIME_S)
+            t.join(timeout=max(0.0, deadline - time.time()))
     return exit_code

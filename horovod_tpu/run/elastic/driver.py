@@ -255,8 +255,18 @@ class ElasticDriver:
         self._workers_active[key] = handle
 
         def run():
-            code = self._create_worker_fn(slot, [handle.event,
-                                                 self._shutdown])
+            try:
+                code = self._create_worker_fn(slot, [handle.event,
+                                                     self._shutdown])
+            except Exception as e:
+                # A launch-side failure (unwritable output dir, ssh exec
+                # error) must be accounted like a worker failure — an
+                # escaped exception would leave the slot unaccounted and
+                # stall the driver forever.
+                _log.warning(
+                    f"worker {slot.hostname}:{slot.local_rank} failed to "
+                    f"launch: {e}")
+                code = 1
             host, lslot = slot.hostname, slot.local_rank
             # Classify under the lock: `removed` is only honored while this
             # worker's own handle is still the registered one (a respawned

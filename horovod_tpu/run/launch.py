@@ -125,17 +125,26 @@ def launch_workers(host_alloc_plan: List[SlotInfo], command: List[str],
     base_env.setdefault("HOROVOD_JOB_KEY", os.urandom(8).hex())
 
     def run_slot(i: int, slot: SlotInfo):
-        env = slot_env(slot, controller_addr, controller_port,
-                       rendezvous_addr, rendezvous_port, base_env)
-        cmd = build_worker_command(slot, command, env, ssh_port)
-        if output_filename:
-            code = execute_redirected(cmd, env, all_events,
-                                      output_filename, slot.rank)
-        else:
-            code = safe_shell_exec.execute(
-                cmd, env=env, events=all_events,
-                prefix=f"{slot.rank}" if prefix_output else None,
-                stdout=sys.stdout, stderr=sys.stderr)
+        # Any launch-side failure (unwritable --output-filename dir, exec
+        # error) must count as this rank failing and abort the rest —
+        # an escaped exception would leave peers blocked in rendezvous
+        # forever waiting for a rank that never comes up.
+        try:
+            env = slot_env(slot, controller_addr, controller_port,
+                           rendezvous_addr, rendezvous_port, base_env)
+            cmd = build_worker_command(slot, command, env, ssh_port)
+            if output_filename:
+                code = execute_redirected(cmd, env, all_events,
+                                          output_filename, slot.rank)
+            else:
+                code = safe_shell_exec.execute(
+                    cmd, env=env, events=all_events,
+                    prefix=f"{slot.rank}" if prefix_output else None,
+                    stdout=sys.stdout, stderr=sys.stderr)
+        except Exception as e:
+            print(f"[launcher] rank {slot.rank} failed to launch: {e}",
+                  file=sys.stderr)
+            code = 1
         exit_codes[i] = code
         if code != 0:
             abort.set()

@@ -279,3 +279,24 @@ def test_output_filename_redirects_worker_output(tmp_path):
         stderr = (out_dir / f"rank.{r}" / "stderr").read_text()
         assert f"OUT_FROM_{r}" in stdout
         assert f"ERR_FROM_{r}" in stderr
+
+
+def test_output_filename_launch_failure_aborts_cleanly(tmp_path):
+    """An unwritable --output-filename target (rank dir path occupied by a
+    regular file) fails the job promptly instead of leaving the other
+    rank blocked in rendezvous forever."""
+    from horovod_tpu.run import run as prog_run
+
+    out_dir = tmp_path / "logs"
+    out_dir.mkdir()
+    (out_dir / "rank.0").write_text("in the way")
+
+    def fn():
+        import horovod_tpu.torch as hvd
+
+        hvd.init()
+        return hvd.rank()
+
+    with pytest.raises(RuntimeError):
+        prog_run(fn, np=2, hosts="localhost:2",
+                 output_filename=str(out_dir))

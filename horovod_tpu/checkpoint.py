@@ -1,0 +1,73 @@
+"""Sharding-aware checkpointing for train states (orbax-backed).
+
+The reference leaves durable checkpoints to user code (rank-0
+``torch.save`` in every example; SURVEY §5 checkpoint/resume) and ships
+only the in-memory elastic ``State``. On TPU the natural store is orbax:
+it writes each device's shards without gathering (a ZeRO state's sharded
+masters/optimizer never materialize on one host) and restores arrays
+directly onto the target mesh's shardings.
+
+    from horovod_tpu.checkpoint import CheckpointManager
+    mgr = CheckpointManager("/ckpt", max_to_keep=3)
+    mgr.save(step, state)                       # any pytree of jax arrays
+    state = mgr.restore(template=state)         # latest, onto state's shardings
+    state = mgr.restore(step=100, template=state)
+
+The template supplies structure, dtypes, and shardings — pass a freshly
+initialized state (e.g. ``init_zero_train_state(...)``) and the restore
+lands every leaf on its proper devices, sharded exactly as initialized.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    """Thin wrapper over ``orbax.checkpoint.CheckpointManager`` with the
+    framework's conventions: step-numbered directories, bounded retention,
+    template-driven sharded restore."""
+
+    def __init__(self, directory: str, max_to_keep: Optional[int] = None):
+        self._directory = os.path.abspath(directory)
+        os.makedirs(self._directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self._directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep))
+
+    def save(self, step: int, state: Any, wait: bool = True) -> None:
+        """Write ``state`` (any pytree of jax/numpy arrays) under ``step``.
+
+        Sharded leaves are written shard-by-shard from their owning
+        devices. With ``wait=False`` the write completes in the
+        background; call ``wait_until_finished()`` (or the next save)
+        before depending on it."""
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def restore(self, template: Any, step: Optional[int] = None) -> Any:
+        """Restore ``step`` (default: latest) onto ``template``'s
+        structure/dtypes/shardings."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoints under {self._directory}")
+        return self._mgr.restore(step,
+                                 args=ocp.args.StandardRestore(template))
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return sorted(self._mgr.all_steps())
+
+    def wait_until_finished(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()

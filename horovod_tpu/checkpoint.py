@@ -33,7 +33,8 @@ class CheckpointManager:
 
     def __init__(self, directory: str, max_to_keep: Optional[int] = None):
         self._directory = os.path.abspath(directory)
-        os.makedirs(self._directory, exist_ok=True)
+        # orbax owns directory creation (create=True default) — in
+        # multi-host deployments it coordinates it on the primary host.
         self._mgr = ocp.CheckpointManager(
             self._directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep))

@@ -118,3 +118,54 @@ def test_randomized_schedule_two_process(tmp_path):
 
     run_world(tmp_path, _WORKER, "STRESS", timeout=300,
               args_for_rank=lambda rank, port: [port, 1234])
+
+
+def test_randomized_eager_schedule_xla_plane(hvd):
+    """The XLA-plane analog of the host soak: one process, the 8-chip
+    mesh, a seeded random schedule of eager collectives claimed out of
+    order. Exercises the program cache (repeat shapes), fusion cycles
+    (bursts), and the handle table under interleaving."""
+    import random
+
+    import numpy as np
+
+    rng = random.Random(99)
+    n = hvd.size()
+    pending = []
+
+    def expect(kind, i, op, root):
+        vals = [i % 5 + r for r in range(n)]
+        if kind == "allreduce":
+            return {hvd.Sum: sum(vals), hvd.Min: min(vals),
+                    hvd.Max: max(vals)}[op]
+        return vals[root]
+
+    def drain(entry):
+        h, kind, i, op, root, shape = entry
+        outs = hvd.synchronize(h)
+        want = expect(kind, i, op, root)
+        assert len(outs) == n, (i, len(outs))
+        for dev, out in enumerate(outs):  # every chip's result, not just 0
+            np.testing.assert_allclose(
+                np.asarray(out), np.full(shape, want),
+                err_msg=f"op {i} ({kind}) device {dev}")
+
+    for i in range(60):
+        kind = rng.choice(["allreduce", "allreduce", "broadcast"])
+        shape = tuple(rng.choice([1, 3, 4]) for _ in range(rng.randint(1, 2)))
+        xs = [np.full(shape, i % 5 + r, np.float32) for r in range(n)]
+        if kind == "allreduce":
+            op = rng.choice([hvd.Sum, hvd.Min, hvd.Max])
+            h = hvd.allreduce_async(xs, name=f"es.{i}", op=op)
+            pending.append((h, "allreduce", i, op, 0, shape))
+        else:
+            root = rng.randrange(n)
+            h = hvd.broadcast_async(xs, root, name=f"es.{i}")
+            pending.append((h, "broadcast", i, None, root, shape))
+        if len(pending) >= rng.randint(4, 10):
+            rng.shuffle(pending)
+            while pending:
+                drain(pending.pop())
+    rng.shuffle(pending)
+    while pending:
+        drain(pending.pop())

@@ -300,3 +300,28 @@ def test_output_filename_launch_failure_aborts_cleanly(tmp_path):
     with pytest.raises(RuntimeError):
         prog_run(fn, np=2, hosts="localhost:2",
                  output_filename=str(out_dir))
+
+
+def test_run_dispatch_matrix(monkeypatch):
+    """_run routes to elastic / jsrun / static from the flags alone
+    (reference run_controller fallback matrix, test_run.py:442)."""
+    from horovod_tpu.run import runner
+
+    calls = []
+    monkeypatch.setattr(runner, "_run_elastic",
+                        lambda a, c: calls.append("elastic") or 0)
+    monkeypatch.setattr(runner, "_run_jsrun",
+                        lambda a, c: calls.append("jsrun") or 0)
+    monkeypatch.setattr(runner, "_run_static",
+                        lambda a, c: calls.append("static") or 0)
+
+    base = ["-np", "2", "-H", "localhost:2", "python", "x.py"]
+    assert runner.run_commandline(base) == 0
+    assert runner.run_commandline(
+        ["--launcher", "jsrun"] + base) == 0
+    assert runner.run_commandline(
+        ["--min-np", "1"] + base) == 0
+    assert runner.run_commandline(
+        ["-np", "2", "--host-discovery-script", "./d.sh",
+         "python", "x.py"]) == 0
+    assert calls == ["static", "jsrun", "elastic", "elastic"]

@@ -136,6 +136,11 @@ def supervise(argv):
                          CPU_FALLBACK_TIMEOUT_S)
     if result is not None:
         result["platform"] = "cpu-fallback"
+        result["note"] = ("TPU tunnel unreachable at bench time; this is "
+                          "the bounded CPU fallback, not an accelerator "
+                          "number. Last measured on-chip (v5e): 1882 "
+                          "img/s/chip at bs32, 1910 at bs64 "
+                          "(docs/benchmarks.md).")
         print(json.dumps(result))
         return 0
 

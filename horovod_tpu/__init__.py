@@ -69,9 +69,11 @@ def _engine():
 # ---- eager async API (parity: hvd.allreduce_async_/poll/synchronize) -------
 
 
-def allreduce_async(tensor, name: Optional[str] = None, op: int = Sum,
+def allreduce_async(tensor, name: Optional[str] = None, op: int = Average,
                     prescale_factor: float = 1.0,
                     postscale_factor: float = 1.0) -> int:
+    """Default op is Average, same as the sync form — the reference's
+    async flavors average by default too (``torch/mpi_ops.py:91-129``)."""
     return _engine().allreduce_async(
         tensor, name=name, op=op, prescale_factor=prescale_factor,
         postscale_factor=postscale_factor)
@@ -87,7 +89,7 @@ def allreduce(tensor, name: Optional[str] = None, op: int = Average,
 
 
 def grouped_allreduce_async(tensors: List, name: Optional[str] = None,
-                            op: int = Sum, prescale_factor: float = 1.0,
+                            op: int = Average, prescale_factor: float = 1.0,
                             postscale_factor: float = 1.0) -> int:
     return _engine().grouped_allreduce_async(
         tensors, name=name, op=op, prescale_factor=prescale_factor,

@@ -68,12 +68,15 @@ _keepalive_cb = None  # prevent GC of the registered CFUNCTYPE
 
 
 def load_library():
-    """Load (building if necessary) the native library; None on failure."""
+    """Load (building if necessary) the native library; None on failure
+    or when disabled. The HOROVOD_NATIVE gate is checked before the cache
+    so disabling it mid-process (tests, a re-init after a bad native
+    world) is honored even after an earlier load."""
     global _lib
-    if _lib is not None:
-        return _lib
     if os.environ.get("HOROVOD_NATIVE", "1") in ("0", "false"):
         return None
+    if _lib is not None:
+        return _lib
     if not os.path.exists(_LIB_PATH) and not _build_library():
         return None
     lib = ctypes.CDLL(_LIB_PATH)

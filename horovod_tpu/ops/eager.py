@@ -581,7 +581,7 @@ class EagerEngine:
     # -- public API ----------------------------------------------------------
 
     def allreduce_async(self, tensor, name: Optional[str] = None,
-                        op: int = _xla.ReduceOp.SUM,
+                        op: int = _xla.ReduceOp.AVERAGE,
                         prescale_factor: float = 1.0,
                         postscale_factor: float = 1.0) -> int:
         stacked, was_list, was_unstacked, was_device = \
@@ -597,7 +597,7 @@ class EagerEngine:
 
     def grouped_allreduce_async(self, tensors: List,
                                 name: Optional[str] = None,
-                                op: int = _xla.ReduceOp.SUM,
+                                op: int = _xla.ReduceOp.AVERAGE,
                                 prescale_factor: float = 1.0,
                                 postscale_factor: float = 1.0) -> int:
         """Explicitly-fused allreduce: submitted as one unit so the result

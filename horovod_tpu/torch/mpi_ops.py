@@ -152,7 +152,9 @@ def _resolve_op(op: Optional[int], average: Optional[bool]) -> int:
         if op is not None:
             raise ValueError("specify either op or average, not both")
         return Average if average else Sum
-    return Sum if op is None else op
+    # Neither given: Average, the reference's default
+    # (get_average_backwards_compatibility_fun, common/util.py:216-234).
+    return Average if op is None else op
 
 
 # ---- core submissions -------------------------------------------------------

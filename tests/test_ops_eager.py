@@ -328,3 +328,60 @@ class TestDeviceResidentResults:
         assert isinstance(out, jax.Array)
         np.testing.assert_allclose(np.asarray(out),
                                    np.tile(np.arange(3) * n, (n, 1)))
+
+
+class TestDirectMode:
+    """HOROVOD_NATIVE=0 degrades to direct mode (no controller, immediate
+    XLA dispatch) — the pure-Python fallback a failed native build leaves
+    users on must still serve the full eager surface."""
+
+    @pytest.fixture()
+    def hvd_direct(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_NATIVE", "0")
+        import horovod_tpu as hvd
+        from horovod_tpu.common import state as _state
+
+        # A fresh world so the engine re-evaluates the native gate.
+        was_init = _state.global_state().initialized
+        if was_init:
+            _state.shutdown()
+        hvd.init()
+        assert not _state.global_state().engine._native
+        try:
+            yield hvd
+        finally:
+            _state.shutdown()
+            # Restore the ambient env BEFORE re-initializing: re-init must
+            # see whatever HOROVOD_NATIVE the suite was launched with, not
+            # our unset.
+            monkeypatch.undo()
+            if was_init:
+                hvd.init()
+
+    def test_collectives_and_handles(self, hvd_direct):
+        hvd = hvd_direct
+        n = hvd.size()
+        out = hvd.allreduce([np.full((3,), r, np.float32)
+                             for r in range(n)], op=hvd.Sum)
+        np.testing.assert_allclose(np.asarray(out[0]), sum(range(n)))
+        # async default op is Average, same as the sync form
+        h = hvd.allreduce_async([np.full((2,), r, np.float32)
+                                 for r in range(n)], name="dm.a")
+        b = hvd.broadcast([np.full((2,), r, np.float32)
+                           for r in range(n)], 1)
+        np.testing.assert_allclose(np.asarray(b[0]), 1)
+        np.testing.assert_allclose(np.asarray(hvd.synchronize(h)[0]),
+                                   np.mean(np.arange(n)))
+        g = hvd.allgather([np.full((1, 2), r, np.float32)
+                           for r in range(n)])
+        assert np.asarray(g).shape == (n, 2)
+
+    def test_duplicate_name_still_rejected(self, hvd_direct):
+        from horovod_tpu.common.exceptions import DuplicateTensorNameError
+
+        hvd = hvd_direct
+        xs = [np.ones((2,), np.float32)] * hvd.size()
+        h = hvd.allreduce_async(xs, name="dm.dup")
+        with pytest.raises(DuplicateTensorNameError):
+            hvd.allreduce_async(xs, name="dm.dup")
+        hvd.synchronize(h)

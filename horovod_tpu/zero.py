@@ -44,6 +44,7 @@ class ZeroTrainState(NamedTuple):
     params: Any       # full pytree, replicated (model dtype)
     pshard: Any       # this device's flat fp32 master-weight shard
     opt_shard: Any    # optimizer state over the master shard
+    gaccum: Any       # accumulated gradient shard (None unless accumulating)
     batch_stats: Any
     step: Any
 
@@ -90,13 +91,16 @@ def _unflatten(flat, treedef, shapes, dtypes, sizes, total):
 
 def init_zero_train_state(model, optimizer: optax.GradientTransformation,
                           rng, sample_input, mesh,
-                          axis_name: str = AXIS_GLOBAL) -> ZeroTrainState:
+                          axis_name: str = AXIS_GLOBAL,
+                          accumulate_steps: int = 1) -> ZeroTrainState:
     """Initialize params (replicated) + the sharded fp32 master weights
     and optimizer state.
 
     Masters and optimizer state are created per-device on that device's
     flat shard inside a shard_mapped init, so they are born sharded — no
-    full fp32 copy ever exists on any one device."""
+    full fp32 copy ever exists on any one device. With
+    ``accumulate_steps > 1`` a sharded gradient accumulator is added (the
+    ``backward_passes_per_step`` role, still 1/d memory)."""
     variables = model.init(rng, sample_input, train=False)
     params = variables["params"]
     batch_stats = variables.get("batch_stats")
@@ -123,22 +127,43 @@ def init_zero_train_state(model, optimizer: optax.GradientTransformation,
     if batch_stats is not None:
         batch_stats = jax.device_put(batch_stats, replicated)
     pshard, opt_shard = sharded_init(params)
-    return ZeroTrainState(params, pshard, opt_shard, batch_stats,
+    gaccum = None
+    if accumulate_steps > 1:
+        # Born sharded, like pshard/opt_shard: materializing the full
+        # padded fp32 buffer on one device first would break the "no full
+        # fp32 copy on any one device" invariant exactly when it matters.
+        gaccum = jax.jit(
+            lambda: jnp.zeros((padded,), jnp.float32),
+            out_shardings=NamedSharding(mesh, P(axis_name)))()
+    return ZeroTrainState(params, pshard, opt_shard, gaccum, batch_stats,
                           jax.device_put(jnp.zeros((), jnp.int32),
                                          replicated))
 
 
 def make_zero_train_step(model, optimizer: optax.GradientTransformation,
                          mesh, axis_name: str = AXIS_GLOBAL,
-                         donate: bool = True):
+                         donate: bool = True, accumulate_steps: int = 1):
     """Build the jitted SPMD train step with ZeRO-1 optimizer sharding.
 
     Drop-in alternative to ``training.make_train_step`` (same call
     signature on the state it builds); the loss/batch-stats semantics
-    match it exactly."""
+    match it exactly.
+
+    ``accumulate_steps=k`` plays the reference's
+    ``backward_passes_per_step`` role: k micro-batches accumulate before
+    one optimizer update. The accumulator is the already-scattered
+    gradient shard, so accumulation memory stays 1/d (each micro-step
+    pays one reduce-scatter — half an allreduce's bytes — and the
+    all-gather only runs on update steps, when params actually change).
+    Micro-batch gradients are AVERAGED (matching this framework's
+    DistributedOptimizer accumulation), not summed as the reference's
+    hook accumulation effectively does — multiply the learning rate by k
+    when porting a reference config that relied on summed accumulation.
+    Requires a state built with the same ``accumulate_steps``."""
     from .training import cross_entropy_loss
 
     d = int(mesh.shape[axis_name])
+    k = accumulate_steps
 
     def step_fn(state: ZeroTrainState, images, labels):
         treedef, shapes, dtypes, sizes, total = _flat_spec(state.params)
@@ -163,24 +188,50 @@ def make_zero_train_step(model, optimizer: optax.GradientTransformation,
         flat_g = _flatten_f32(grads, total, padded)
         gshard = lax.psum_scatter(flat_g, axis_name, tiled=True) / d
 
-        updates, new_opt = optimizer.update(gshard, state.opt_shard,
-                                            state.pshard)
-        new_pshard = optax.apply_updates(state.pshard, updates)
+        def apply_update(gshard, opt_shard, pshard):
+            updates, new_opt = optimizer.update(gshard, opt_shard, pshard)
+            new_pshard = optax.apply_updates(pshard, updates)
+            new_flat = lax.all_gather(new_pshard, axis_name, tiled=True)
+            return (_unflatten(new_flat, treedef, shapes, dtypes, sizes,
+                               total), new_pshard, new_opt)
 
-        new_flat = lax.all_gather(new_pshard, axis_name, tiled=True)
-        new_params = _unflatten(new_flat, treedef, shapes, dtypes, sizes,
-                                total)
+        step = state.step + 1
+        if k <= 1:
+            new_params, new_pshard, new_opt = apply_update(
+                gshard, state.opt_shard, state.pshard)
+            new_gaccum = state.gaccum
+        else:
+            acc = state.gaccum + gshard
+            do_update = (step % k) == 0
+
+            def update_branch(operand):
+                acc, opt_shard, pshard = operand
+                p, ps, op_ = apply_update(acc / k, opt_shard, pshard)
+                return p, ps, op_, jnp.zeros_like(acc)
+
+            def skip_branch(operand):
+                acc, opt_shard, pshard = operand
+                return state.params, pshard, opt_shard, acc
+
+            new_params, new_pshard, new_opt, new_gaccum = lax.cond(
+                do_update, update_branch, skip_branch,
+                (acc, state.opt_shard, state.pshard))
 
         if new_stats is not None:
             new_stats = jax.tree_util.tree_map(
                 lambda x: lax.pmean(x, axis_name), new_stats)
         loss = lax.pmean(loss, axis_name)
-        return ZeroTrainState(new_params, new_pshard, new_opt, new_stats,
-                              state.step + 1), loss
+        return ZeroTrainState(new_params, new_pshard, new_opt, new_gaccum,
+                              new_stats, step), loss
 
     cache = {}
 
     def step(state: ZeroTrainState, images, labels):
+        if (state.gaccum is None) != (k <= 1):
+            raise ValueError(
+                "state/step accumulate_steps mismatch: build the state "
+                "with init_zero_train_state(..., accumulate_steps=k) "
+                "matching make_zero_train_step's")
         if "fn" not in cache:
             # The optimizer-state specs depend on the shard length, which
             # depends on the parameter count — resolve once from the first
@@ -188,8 +239,9 @@ def make_zero_train_step(model, optimizer: optax.GradientTransformation,
             _, _, _, _, total = _flat_spec(state.params)
             opt_specs = _opt_state_specs(optimizer, _shard_len(total, d),
                                          axis_name)
+            gaccum_spec = P() if state.gaccum is None else P(axis_name)
             state_specs = ZeroTrainState(P(), P(axis_name), opt_specs,
-                                         P(), P())
+                                         gaccum_spec, P(), P())
             sharded = jax.shard_map(
                 step_fn, mesh=mesh,
                 in_specs=(state_specs, P(axis_name), P(axis_name)),

@@ -341,13 +341,15 @@ class TestDirectMode:
         import horovod_tpu as hvd
         from horovod_tpu.common import state as _state
 
-        # A fresh world so the engine re-evaluates the native gate.
+        # A fresh world so the engine re-evaluates the native gate. The
+        # whole setup tail sits inside the try: a failing init/assert must
+        # still restore the suite's shared world in the finally.
         was_init = _state.global_state().initialized
-        if was_init:
-            _state.shutdown()
-        hvd.init()
-        assert not _state.global_state().engine._native
         try:
+            if was_init:
+                _state.shutdown()
+            hvd.init()
+            assert not _state.global_state().engine._native
             yield hvd
         finally:
             _state.shutdown()

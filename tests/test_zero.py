@@ -115,3 +115,56 @@ def test_zero_trains_model_without_batch_stats(setup):
         zstate, loss = zstep(zstate, imgs, lbls)
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
+
+
+def test_zero_gradient_accumulation(setup):
+    """accumulate_steps=k (the backward_passes_per_step role): k
+    micro-steps with the same batch must land exactly where one update
+    with that batch's mean gradient lands, and the accumulator shard
+    stays 1/d-sized and sharded."""
+    hvd = setup
+    mesh = hvd.mesh()
+    import flax.linen as nn
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            return nn.Dense(10)(nn.relu(nn.Dense(32)(
+                x.reshape((x.shape[0], -1)))))
+
+    model = MLP()
+    opt = optax.sgd(0.1)
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.zeros((1, 8, 8, 3), jnp.float32)
+    imgs = np.random.RandomState(0).rand(16, 8, 8, 3).astype(np.float32)
+    lbls = np.random.RandomState(1).randint(0, 10, 16).astype(np.int32)
+    imgs, lbls = shard_batch((jnp.asarray(imgs), jnp.asarray(lbls)), mesh)
+
+    k = 3
+    za = init_zero_train_state(model, opt, rng, sample, mesh,
+                               accumulate_steps=k)
+    stepa = make_zero_train_step(model, opt, mesh, accumulate_steps=k)
+    assert za.gaccum.sharding.spec == P(AXIS_GLOBAL)
+
+    zb = init_zero_train_state(model, opt, rng, sample, mesh)
+    stepb = make_zero_train_step(model, opt, mesh)
+
+    # k identical micro-batches -> mean gradient == single-batch gradient,
+    # so one accumulated update must equal one plain update.
+    for _ in range(k):
+        za, _ = stepa(za, imgs, lbls)
+    zb, _ = stepb(zb, imgs, lbls)
+    for a, b in zip(jax.tree_util.tree_leaves(za.params),
+                    jax.tree_util.tree_leaves(zb.params)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), atol=1e-6)
+    # Non-update micro-steps leave params untouched.
+    before = jax.tree_util.tree_leaves(za.params)[0].copy()
+    za, _ = stepa(za, imgs, lbls)  # step 4: k=3, not an update step
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree_util.tree_leaves(za.params)[0]),
+        np.asarray(before))
+
+    # Mismatched state/step configuration fails loudly.
+    with pytest.raises(ValueError):
+        stepa(zb, imgs, lbls)

@@ -27,19 +27,44 @@ import time
 
 BASELINE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16.0
 
-PROBE_TIMEOUT_S = 150
-PROBE_ATTEMPTS = 2
+PROBE_TIMEOUT_S = 100
+PROBE_ATTEMPTS = 6
+PROBE_RETRY_SLEEP_S = 20
 WORKER_TIMEOUT_S = 1200
 CPU_FALLBACK_TIMEOUT_S = 900
+
+# ResNet-50 at 224x224 is ~4.1 GMACs forward per image = ~8.2 GFLOPs in
+# the FMA-counts-as-2 convention hardware peaks use; a training step
+# (fwd + bwd) is conventionally ~3x forward. Used only for the MFU field.
+TRAIN_FLOPS_PER_IMAGE = 3 * 2 * 4.1e9
+
+# Dense bf16 peak per chip, by device_kind substring (lowercase match).
+PEAK_FLOPS_BY_KIND = [
+    ("v6", 918e12),     # Trillium
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),
+    ("v4", 275e12),
+]
+
+
+def _peak_flops(device_kind):
+    kind = (device_kind or "").lower()
+    for sub, peak in PEAK_FLOPS_BY_KIND:
+        if sub in kind:
+            return peak
+    return None
 
 
 def _probe_backend(timeout_s):
     """Initialize the default JAX backend in a throwaway subprocess.
 
-    Returns the platform name on success, None on failure/timeout. Keeps
-    backend hangs out of the supervisor process.
+    Returns (platform, device_kind) on success, None on failure/timeout.
+    Keeps backend hangs out of the supervisor process.
     """
-    code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
+    code = ("import jax; d = jax.devices()[0]; "
+            "print('PLATFORM=' + d.platform); "
+            "print('KIND=' + getattr(d, 'device_kind', ''))")
     try:
         r = subprocess.run([sys.executable, "-c", code],
                            capture_output=True, text=True,
@@ -48,9 +73,14 @@ def _probe_backend(timeout_s):
         print(f"bench: backend probe timed out after {timeout_s}s",
               file=sys.stderr)
         return None
+    platform = kind = None
     for line in r.stdout.splitlines():
         if line.startswith("PLATFORM="):
-            return line.split("=", 1)[1]
+            platform = line.split("=", 1)[1]
+        elif line.startswith("KIND="):
+            kind = line.split("=", 1)[1]
+    if platform:
+        return platform, kind
     tail = (r.stderr or "").strip().splitlines()[-3:]
     print("bench: backend probe failed rc=%d: %s" % (r.returncode, tail),
           file=sys.stderr)
@@ -91,13 +121,28 @@ def _build_parser():
 def supervise(argv):
     args = _build_parser().parse_args(argv)
 
-    platform = None
+    # The TPU tunnel has been observed to be transiently unreachable for
+    # minutes at a time; probe persistently (~10 min total budget) before
+    # giving up on the accelerator, and narrate progress so a hang is
+    # diagnosable from the driver's captured stderr.
+    platform, device_kind = None, None
+    probe_start = time.time()
     for attempt in range(PROBE_ATTEMPTS):
-        platform = _probe_backend(PROBE_TIMEOUT_S)
-        if platform:
+        print("bench: probing accelerator backend, attempt %d/%d "
+              "(%.0fs elapsed)" % (attempt + 1, PROBE_ATTEMPTS,
+                                   time.time() - probe_start),
+              file=sys.stderr)
+        probed = _probe_backend(PROBE_TIMEOUT_S)
+        if probed:
+            platform, device_kind = probed
+            print("bench: backend up: platform=%s kind=%r (%.0fs elapsed)"
+                  % (platform, device_kind, time.time() - probe_start),
+                  file=sys.stderr)
             break
         print(f"bench: probe attempt {attempt + 1}/{PROBE_ATTEMPTS} failed",
               file=sys.stderr)
+        if attempt + 1 < PROBE_ATTEMPTS:
+            time.sleep(PROBE_RETRY_SLEEP_S)
 
     if platform == "cpu":
         # No accelerator in this environment at all: skip the full-size
@@ -116,6 +161,12 @@ def supervise(argv):
         result = _run_worker(worker_args, dict(os.environ), WORKER_TIMEOUT_S)
         if result is not None:
             result["platform"] = platform
+            if device_kind:
+                result["device_kind"] = device_kind
+            peak = _peak_flops(device_kind)
+            if peak and isinstance(result.get("value"), (int, float)):
+                result["mfu"] = round(
+                    result["value"] * TRAIN_FLOPS_PER_IMAGE / peak, 4)
             print(json.dumps(result))
             return 0
         print("bench: accelerator worker failed; falling back to CPU",

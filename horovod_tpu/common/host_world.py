@@ -49,14 +49,28 @@ _SCHED_LOCAL_SIZE = ("JSM_NAMESPACE_LOCAL_SIZE",
                      "OMPI_COMM_WORLD_LOCAL_SIZE", "SLURM_NTASKS_PER_NODE")
 
 
-def _rejoin_grace_seconds() -> float:
+def _rejoin_grace_seconds(addr=None, port=None) -> float:
     """How long a surviving elastic worker waits for the driver to advance
     the rendezvous round before concluding the failure was transient and
     re-joining the current round. Must comfortably cover blacklist
-    cooldown + plan activation; raise HOROVOD_ELASTIC_REJOIN_GRACE when
-    running with long --blacklist-cooldown-range values. Read per (re-)
-    init, like every other runtime knob."""
-    return _config._get_float(_config.HOROVOD_ELASTIC_REJOIN_GRACE, 10.0)
+    cooldown + plan activation. Only the driver knows its cooldown range,
+    so it publishes the derived grace under ``config/rejoin_grace`` in the
+    rendezvous KV and workers read it from there; the
+    HOROVOD_ELASTIC_REJOIN_GRACE env knob, when set, overrides. Read per
+    (re-)init, like every other runtime knob."""
+    if os.environ.get(_config.HOROVOD_ELASTIC_REJOIN_GRACE):
+        return _config._get_float(_config.HOROVOD_ELASTIC_REJOIN_GRACE, 10.0)
+    if addr and port:
+        from ..run.http.http_client import read_data_from_kvstore
+        try:
+            blob = read_data_from_kvstore(addr, int(port), "config",
+                                          "rejoin_grace", timeout=2.0,
+                                          retries=1)
+            if blob:
+                return float(blob.decode())
+        except (OSError, ValueError):
+            pass
+    return 10.0
 
 
 def _excluded_from_plan_error() -> "HorovodInternalError":
@@ -182,7 +196,13 @@ class HostWorld:
         # bounded grace, not a hard wait — a *transient* collective failure
         # (no process died, plan unchanged) advances nothing, and everyone
         # simply re-joins the current round.
-        grace = time.monotonic() + _rejoin_grace_seconds()
+        # First init never waits on the round loop (it breaks immediately
+        # on _last_rendezvous_round is None), so only re-inits pay the KV
+        # read for the driver-published grace.
+        if self._last_rendezvous_round is not None:
+            grace = time.monotonic() + _rejoin_grace_seconds(addr, port)
+        else:
+            grace = time.monotonic()
         while True:
             try:
                 fetched = fetch_slot_info(addr, int(port), hostname,

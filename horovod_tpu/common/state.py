@@ -86,7 +86,10 @@ def _maybe_init_distributed() -> None:
     import jax
 
     nproc = int(os.environ.get(_config.HOROVOD_SIZE, "1"))
-    if nproc <= 1 or jax.process_count() > 1:
+    # NOTE: no jax.process_count()/jax.devices() here — any backend query
+    # initializes XLA, after which jax.distributed.initialize refuses to
+    # run. Use the distributed client's own state to detect re-init.
+    if nproc <= 1 or jax.distributed.is_initialized():
         return
     rank = int(os.environ.get(_config.HOROVOD_RANK, "0"))
     addr = os.environ.get(_config.HOROVOD_CONTROLLER_ADDR, "127.0.0.1")

@@ -53,18 +53,30 @@ def terminate_executor_shell_and_children(pid: int) -> None:
 
 
 def _pump(src, dst, prefix: Optional[str] = None) -> threading.Thread:
+    # After the drain deadline the caller may close ``dst`` (e.g. the
+    # per-rank log files in launch.execute_redirected) while a grandchild
+    # still holds the pipe open. ``stop`` tells the pump to discard any
+    # late lines instead of writing into a closed sink.
+    stop = threading.Event()
+
     def run():
         try:
             for line in iter(src.readline, b""):
+                if stop.is_set():
+                    continue  # keep reading so the grandchild never blocks
                 text = line.decode("utf-8", errors="replace")
                 if prefix:
                     text = f"[{prefix}]{text}" if text.strip() else text
-                dst.write(text)
-                dst.flush()
+                try:
+                    dst.write(text)
+                    dst.flush()
+                except ValueError:
+                    stop.set()  # sink closed under us: drop the tail
         except ValueError:
-            pass  # stream closed
+            pass  # source pipe closed
 
     t = threading.Thread(target=run, daemon=True)
+    t.stop = stop
     t.start()
     return t
 
@@ -110,4 +122,8 @@ def execute(command, env: Optional[dict] = None,
         deadline = time.time() + PUMP_DRAIN_TIME_S
         for t in pumps:
             t.join(timeout=max(0.0, deadline - time.time()))
+        for t in pumps:
+            # Pumps that out-lived the drain deadline must not write into
+            # streams the caller is about to close.
+            t.stop.set()
     return exit_code

@@ -48,6 +48,18 @@ class ElasticDriver:
                  verbose: int = 0):
         self._rendezvous = rendezvous
         self._host_manager = HostManager(discovery, cooldown_range)
+        # Publish the rejoin grace surviving workers should honor before
+        # concluding a failure was transient. It must cover the driver's
+        # own worst-case plan rebuild (blacklist cooldown upper bound +
+        # activation), and only the driver knows the cooldown range — so
+        # the value travels through the rendezvous KV rather than being a
+        # worker-side guess (see host_world._rejoin_grace_seconds).
+        grace = 10.0 + (cooldown_range[1] if cooldown_range else 0.0)
+        try:
+            self._rendezvous.put("config", "rejoin_grace",
+                                 repr(grace).encode())
+        except AttributeError:
+            pass  # fake rendezvous in unit tests may lack put()
         self._min_np = min_np
         self._max_np = max_np or 0
         self._timeout = timeout or 600.0

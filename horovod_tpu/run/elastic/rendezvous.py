@@ -63,11 +63,18 @@ def fetch_controller_endpoint(addr: str, port: int, rendezvous_round: int,
 
     Returns (host, port), or None on timeout. The deadline is monotonic:
     NTP steps on freshly provisioned TPU VMs must not stretch or collapse
-    the wait."""
+    the wait. Each KV read uses a short per-request timeout and a single
+    attempt so short overall deadlines (the stale-round poll passes 2 s)
+    hold — the default client settings could block ~31 s in one read."""
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
-        blob = read_data_from_kvstore(addr, port, CONTROLLER_SCOPE,
-                                      f"endpoint.{rendezvous_round}")
+        per_req = max(0.2, min(2.0, deadline - time.monotonic()))
+        try:
+            blob = read_data_from_kvstore(addr, port, CONTROLLER_SCOPE,
+                                          f"endpoint.{rendezvous_round}",
+                                          timeout=per_req, retries=1)
+        except OSError:
+            blob = None  # transient KV hiccup: keep polling to deadline
         if blob:
             host, _, p = blob.decode().rpartition(":")
             return host, int(p)

@@ -11,12 +11,17 @@ inside one compiled program:
     shard update (optax on the persistent fp32 master shard)
     masters --all_gather----> full params       (ICI all-gather)
 
-The reduce-scatter + all-gather pair moves exactly the same bytes as the
-allreduce it replaces (an allreduce IS a reduce-scatter + all-gather), so
-the memory saving is communication-neutral. The fp32 master shard lives
-in the train state across steps — updates accumulate at fp32 precision
-even when the model params are bf16, and the step never materializes a
-full fp32 copy of the parameters.
+For fp32 models the reduce-scatter + all-gather pair moves exactly the
+same bytes as the allreduce it replaces (an allreduce IS a
+reduce-scatter + all-gather), so the memory saving is
+communication-neutral. For reduced-precision models (uniform bf16/fp16
+params) the gather leg runs at the model dtype — master shards are cast
+before the all-gather — so the gathered flat buffer is model-sized, and
+only the scatter leg pays fp32 width (for reduction precision): total
+wire traffic is 1.5x a bf16 allreduce, and the transient flat buffers
+are one fp32 gradient flat (pre-scatter) and one model-dtype param flat
+(post-gather). The fp32 master shard itself stays 1/d per device across
+steps, so updates still accumulate at fp32 precision.
 
 Works with any *elementwise* optax transformation (sgd, momentum, adam,
 adamw, rmsprop, ...): the update runs on a flat concatenated shard, which
@@ -168,6 +173,11 @@ def make_zero_train_step(model, optimizer: optax.GradientTransformation,
     def step_fn(state: ZeroTrainState, images, labels):
         treedef, shapes, dtypes, sizes, total = _flat_spec(state.params)
         padded = _shard_len(total, d) * d
+        # Uniform-dtype models gather at the model dtype (halving gather
+        # bytes and the transient flat buffer for bf16); mixed-dtype trees
+        # gather at fp32 and let _unflatten cast per leaf.
+        gather_dtype = (dtypes[0] if all(dt == dtypes[0] for dt in dtypes)
+                        else jnp.float32)
 
         def loss_fn(p):
             variables = {"params": p}
@@ -191,7 +201,8 @@ def make_zero_train_step(model, optimizer: optax.GradientTransformation,
         def apply_update(gshard, opt_shard, pshard):
             updates, new_opt = optimizer.update(gshard, opt_shard, pshard)
             new_pshard = optax.apply_updates(pshard, updates)
-            new_flat = lax.all_gather(new_pshard, axis_name, tiled=True)
+            new_flat = lax.all_gather(new_pshard.astype(gather_dtype),
+                                      axis_name, tiled=True)
             return (_unflatten(new_flat, treedef, shapes, dtypes, sizes,
                                total), new_pshard, new_opt)
 
@@ -232,11 +243,16 @@ def make_zero_train_step(model, optimizer: optax.GradientTransformation,
                 "state/step accumulate_steps mismatch: build the state "
                 "with init_zero_train_state(..., accumulate_steps=k) "
                 "matching make_zero_train_step's")
-        if "fn" not in cache:
-            # The optimizer-state specs depend on the shard length, which
-            # depends on the parameter count — resolve once from the first
-            # state and cache the compiled step.
-            _, _, _, _, total = _flat_spec(state.params)
+        # The optimizer-state specs depend on the shard length, which
+        # depends on the parameter count — resolve per parameter-tree
+        # structure and cache the compiled step under that key, so a
+        # state with a different pytree (e.g. after model surgery) gets
+        # its own compilation instead of an opaque shape error from a
+        # stale spec.
+        treedef, shapes, dtypes, _, total = _flat_spec(state.params)
+        key = (treedef, tuple(shapes), tuple(str(dt) for dt in dtypes),
+               state.gaccum is None)
+        if key not in cache:
             opt_specs = _opt_state_specs(optimizer, _shard_len(total, d),
                                          axis_name)
             gaccum_spec = P() if state.gaccum is None else P(axis_name)
@@ -247,8 +263,8 @@ def make_zero_train_step(model, optimizer: optax.GradientTransformation,
                 in_specs=(state_specs, P(axis_name), P(axis_name)),
                 out_specs=(state_specs, P()),
                 check_vma=False)
-            cache["fn"] = jax.jit(
+            cache[key] = jax.jit(
                 sharded, donate_argnums=(0,) if donate else ())
-        return cache["fn"](state, images, labels)
+        return cache[key](state, images, labels)
 
     return step

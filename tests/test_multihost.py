@@ -1,0 +1,130 @@
+"""Multi-host XLA plane: a real 2-process ``jax.distributed`` world.
+
+This is SURVEY §4 Pattern 1 applied to the TPU production path: on a pod,
+``hvd.init()`` joins a multi-process JAX world
+(``common/state.py:_maybe_init_distributed``) and every eager collective
+crosses processes through ``jax.make_array_from_process_local_data``
+(``ops/eager.py:_to_global_sharded``). Every other multi-process test in
+this suite drives the host TCP ring; these two processes drive the XLA
+plane itself — each with 2 virtual CPU devices, so the world is 4
+participants across 2 processes, exercising the same global-mesh SPMD
+programs that span ICI+DCN on real hardware.
+"""
+
+import textwrap
+
+from proc_harness import run_world
+
+# The TPU plugin's sitecustomize activation runs at interpreter startup —
+# before the worker script's env overrides — and a wedged device tunnel
+# then hangs the very first jax backend query even under
+# JAX_PLATFORMS=cpu. Strip the activation var in the parent.
+_DROP_ENV = ("PALLAS_AXON_POOL_IPS",)
+
+_PRELUDE = textwrap.dedent("""
+    import os, sys
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=2")
+    os.environ["HOROVOD_SIZE"] = "2"
+    os.environ["HOROVOD_RANK"] = str(rank)
+    os.environ["HOROVOD_CONTROLLER_ADDR"] = "127.0.0.1"
+    os.environ["HOROVOD_CONTROLLER_PORT"] = str(port)
+    os.environ["HOROVOD_HOSTNAME"] = "127.0.0.1"
+    sys.path.insert(0, os.environ["HVD_REPO"])
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    assert jax.process_count() == 2, jax.process_count()
+    assert hvd.size() == 4, hvd.size()
+    assert hvd.local_size() == 2, hvd.local_size()
+    assert hvd.cross_size() == 2, hvd.cross_size()
+    # Participant ids are device-order: process 0 owns 0,1; process 1
+    # owns 2,3.
+    my_ranks = [2 * rank, 2 * rank + 1]
+    assert hvd.rank() == my_ranks[0], hvd.rank()
+""")
+
+
+def test_eager_collectives_cross_process(tmp_path):
+    """allreduce/allgather/broadcast on jax arrays across 2 processes."""
+    script = _PRELUDE + textwrap.dedent("""
+        # --- allreduce (Sum): participants carry their global rank ---
+        xs = [jnp.full((5,), float(r), jnp.float32) for r in my_ranks]
+        out = hvd.allreduce(xs, op=hvd.Sum, name="mh.ar")
+        for o in out:
+            np.testing.assert_allclose(np.asarray(o), 0 + 1 + 2 + 3)
+
+        # --- allreduce (Average, default) ---
+        out = hvd.allreduce([jnp.full((3,), float(r + 1), jnp.float32)
+                             for r in my_ranks], name="mh.avg")
+        for o in out:
+            np.testing.assert_allclose(np.asarray(o), 2.5)
+
+        # --- allgather: concat along dim 0 in participant order ---
+        xs = [jnp.full((2, 3), float(r), jnp.float32) for r in my_ranks]
+        got = np.asarray(hvd.allgather(xs, name="mh.ag"))
+        expect = np.concatenate(
+            [np.full((2, 3), float(r), np.float32) for r in range(4)])
+        np.testing.assert_allclose(got, expect)
+
+        # --- broadcast from participant 2 (first chip of process 1) ---
+        xs = [jnp.full((4,), float(r), jnp.float32) for r in my_ranks]
+        out = hvd.broadcast(xs, 2, name="mh.bc")
+        for o in out:
+            np.testing.assert_allclose(np.asarray(o), 2.0)
+
+        hvd.shutdown()
+        print(f"MULTIHOST_{rank}_OK")
+    """)
+    run_world(tmp_path, script, "MULTIHOST", drop_env=_DROP_ENV)
+
+
+def test_train_step_and_zero_cross_process(tmp_path):
+    """One DP train step and one ZeRO-1 step through the global mesh."""
+    script = _PRELUDE + textwrap.dedent("""
+        import optax
+        from horovod_tpu.models.resnet import ResNet18
+        from horovod_tpu.training import (
+            init_train_state, make_train_step, replicate_state, shard_batch)
+        from horovod_tpu.zero import (
+            init_zero_train_state, make_zero_train_step)
+
+        mesh = hvd.mesh()
+        model = ResNet18(num_classes=10, dtype=jnp.bfloat16)
+        opt = optax.sgd(0.01)
+        rng = jax.random.PRNGKey(0)
+        sample = jnp.zeros((1, 32, 32, 3), jnp.float32)
+
+        # Every process builds the same global batch; shard_batch hands
+        # each process its addressable slices of the global array.
+        imgs = np.random.RandomState(0).rand(8, 32, 32, 3).astype(np.float32)
+        lbls = np.random.RandomState(1).randint(0, 10, 8).astype(np.int32)
+        imgs, lbls = shard_batch((jnp.asarray(imgs), jnp.asarray(lbls)), mesh)
+
+        state = replicate_state(init_train_state(model, opt, rng, sample),
+                                mesh)
+        step = make_train_step(model, opt, mesh)
+        state, loss = step(state, imgs, lbls)
+        loss0 = float(np.asarray(loss))
+        assert np.isfinite(loss0), loss0
+        state, loss = step(state, imgs, lbls)
+        assert float(np.asarray(loss)) < loss0 + 1.0  # sane progression
+
+        # --- ZeRO-1 step over the same global mesh ---
+        zstate = init_zero_train_state(model, opt, rng, sample, mesh)
+        zstep = make_zero_train_step(model, opt, mesh)
+        zstate, zloss = zstep(zstate, imgs, lbls)
+        np.testing.assert_allclose(float(np.asarray(zloss)), loss0,
+                                   rtol=5e-2)
+
+        hvd.shutdown()
+        print(f"MHTRAIN_{rank}_OK")
+    """)
+    run_world(tmp_path, script, "MHTRAIN", timeout=420, drop_env=_DROP_ENV)

@@ -7,10 +7,13 @@ lives in VMEM scratch across the innermost K-tile grid dimension, so the
 full attention matrix never materializes (the same streaming-accumulation
 math as ``parallel.ring_attention``).
 
-Scope: forward pass. The public entry ``flash_attention`` wraps the kernel
-in a ``jax.custom_vjp`` whose backward recomputes attention with the XLA
-flash implementation — fp32-exact against the kernel's forward — so the op
-is fully trainable while the kernel serves the forward hot path.
+Scope: forward AND backward. Training's forward emits the per-row
+log-sum-exp alongside O; the backward is the standard flash backward as
+two Pallas kernels — one accumulating dQ across K tiles, one accumulating
+dK/dV across Q tiles — each re-materializing P = exp(S - lse) on-chip from
+the saved lse, so neither pass ever writes the attention matrix to HBM.
+``flash_attention_block_grads`` exposes the same per-block backward for
+ring attention's backward ring pass (``parallel.ring_attention``).
 
 Block offsets ride in as prefetched scalars, so the same kernel serves
 ring attention's rotating K/V blocks (global causal masking between
@@ -40,7 +43,7 @@ BLOCK_K = 512
 def _attn_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
                  acc_ref, *, causal: bool, block_q: int, block_k: int,
                  num_k_tiles: int, return_state: bool = False,
-                 mo_ref=None, lo_ref=None):
+                 mo_ref=None, lo_ref=None, lse_ref=None):
     """One (batch*head, q-tile, k-tile) grid step.
 
     Refs: q (1, block_q, D), k/v (1, block_k, D), o (1, block_q, D);
@@ -115,6 +118,14 @@ def _attn_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
         else:
             o_ref[0] = (acc_ref[:] /
                         jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+            if lse_ref is not None:
+                # Rows with no visible key get +NEG_INF's negation so the
+                # backward's exp(s - lse) underflows to exactly zero
+                # instead of exploding on lse = -inf.
+                m = m_ref[:]
+                l = l_ref[:]
+                lse_ref[0] = jnp.where(l > 0.0, m + jnp.log(
+                    jnp.maximum(l, 1e-30)), -NEG_INF)
 
 
 def _attn_kernel_state(offs_ref, q_ref, k_ref, v_ref, o_ref, mo_ref,
@@ -124,6 +135,114 @@ def _attn_kernel_state(offs_ref, q_ref, k_ref, v_ref, o_ref, mo_ref,
     _attn_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
                  acc_ref, return_state=True, mo_ref=mo_ref, lo_ref=lo_ref,
                  **kw)
+
+
+def _attn_kernel_train(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                       m_ref, l_ref, acc_ref, **kw):
+    """Training-forward adapter: normalized O plus the per-row lse
+    residual the flash backward re-materializes P from."""
+    _attn_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                 acc_ref, lse_ref=lse_ref, **kw)
+
+
+def _attn_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                        delta_ref, dq_ref, dq_acc, *, causal: bool,
+                        block_q: int, block_k: int, num_k_tiles: int):
+    """dQ pass: grid (batch*head, q-tile, k-tile), sequential over K tiles.
+
+    P = exp(S - lse) is rebuilt on-chip from the saved lse;
+    dS = P * (dO.V^T - delta); dQ accumulates dS.K in VMEM across the K
+    dimension. delta = rowsum(dO * O), precomputed by the caller.
+    """
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    qi = pl.program_id(1)
+    q_base = offs_ref[0] + qi * block_q
+    k_base = offs_ref[1] + ki * block_k
+    visible = (q_base + block_q - 1 >= k_base) if causal else True
+
+    @pl.when(visible)
+    def _update():
+        q = q_ref[0]
+        k = k_ref[0]
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale      # [bq, bk]
+        p = jnp.exp(s - lse_ref[0])                          # [bq, bk]
+        if causal:
+            q_pos = q_base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = k_base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [bq, bk]
+        ds = p * (dp - delta_ref[0]) * scale                 # [bq, bk]
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [bq, D]
+
+    @pl.when(ki == num_k_tiles - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _attn_bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                         delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                         causal: bool, block_q: int, block_k: int,
+                         num_q_tiles: int):
+    """dK/dV pass: grid (batch*head, k-tile, q-tile), sequential over Q
+    tiles. Same [bq, bk] orientation as the dQ pass; the transposed
+    contractions (P^T.dO, dS^T.Q) ride dot_general dimension numbers so
+    no tile is ever explicitly transposed."""
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    ki = pl.program_id(1)
+    q_base = offs_ref[0] + qi * block_q
+    k_base = offs_ref[1] + ki * block_k
+    visible = (q_base + block_q - 1 >= k_base) if causal else True
+
+    @pl.when(visible)
+    def _update():
+        q = q_ref[0]
+        k = k_ref[0]
+        do = do_ref[0]
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale      # [bq, bk]
+        p = jnp.exp(s - lse_ref[0])
+        if causal:
+            q_pos = q_base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = k_base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [bk, D]
+        dp = jax.lax.dot_general(
+            do, v_ref[0], dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [bq, bk]
+        ds = p * (dp - delta_ref[0]) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [bk, D]
+
+    @pl.when(qi == num_q_tiles - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
 def _pallas_block_state(q, k, v, offs, causal: bool, interpret: bool):
@@ -276,6 +395,41 @@ def flash_attention_block(q, k, v, q_off, k_off, causal: bool = True,
     return acc, m, l
 
 
+def flash_attention_block_grads(q, k, v, do, lse, delta, q_off, k_off,
+                                causal: bool = True,
+                                use_pallas: Optional[bool] = None):
+    """One K/V block's (dq, dk, dv) for ring attention's backward pass.
+
+    q/k/v/do: [B, T, H, D]; lse/delta: f32 [B, H, T] — the GLOBAL row
+    statistics (lse over all keys, delta = rowsum(dO*O)), so each block's
+    P = exp(S - lse) is already globally normalized and the per-block
+    gradients simply sum across the ring. Returns f32 arrays in the
+    [B, T, H, D] layout (f32 so the ring's cross-block accumulation
+    doesn't round at the model dtype each step).
+    """
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    use_pallas, interpret = _resolve_dispatch(use_pallas)
+
+    offs = jnp.stack([jnp.asarray(q_off, jnp.int32),
+                      jnp.asarray(k_off, jnp.int32)])
+    qm, km, vm, dom = (_merge_heads(x) for x in (q, k, v, do))
+    lse_m = lse.reshape(B * H, Tq, 1)
+    delta_m = delta.reshape(B * H, Tq, 1)
+    if use_pallas and _pick_block(Tq, BLOCK_Q) is not None and \
+            _pick_block(Tk, BLOCK_K) is not None:
+        dq, dk, dv = _pallas_bwd(qm, km, vm, dom, lse_m, delta_m, offs,
+                                 causal, interpret, out_dtype=jnp.float32)
+    else:
+        dq, dk, dv = _xla_block_grads(qm, km, vm, dom, lse_m, delta_m,
+                                      offs, causal, out_dtype=jnp.float32)
+
+    def split(x, t):
+        return x.reshape(B, H, t, D).transpose(0, 2, 1, 3)
+
+    return split(dq, Tq), split(dk, Tk), split(dv, Tk)
+
+
 def _pallas_attention_fwd(q, k, v, q_off, k_off, causal: bool,
                           interpret: bool):
     """q/k/v: [BH, T, D] (already merged batch*heads, padded to tiles)."""
@@ -317,6 +471,138 @@ def _pallas_attention_fwd(q, k, v, q_off, k_off, causal: bool,
     )(offs, q, k, v)
 
 
+def _pallas_attention_fwd_train(q, k, v, offs, causal: bool,
+                                interpret: bool):
+    """Forward with residuals: (o [BH,T,D] in q.dtype, lse f32 [BH,T,1])."""
+    BH, Tq, D = q.shape
+    Tk = k.shape[1]
+    bq = _pick_block(Tq, BLOCK_Q)
+    bk = _pick_block(Tk, BLOCK_K)
+    num_q = Tq // bq
+    num_k = Tk // bk
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(BH, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, qi, ki, offs: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, qi, ki, offs: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, qi, ki, offs: (bh, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, qi, ki, offs: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki, offs: (bh, qi, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _attn_kernel_train, causal=causal, block_q=bq, block_k=bk,
+        num_k_tiles=num_k)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, Tq, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(offs, q, k, v)
+
+
+def _pallas_bwd(q, k, v, do, lse, delta, offs, causal: bool,
+                interpret: bool, out_dtype=None):
+    """The two flash-backward kernels; returns (dq, dk, dv) in the input
+    dtypes (or ``out_dtype`` when given — ring accumulation wants f32).
+    lse/delta: f32 [BH, T, 1]."""
+    dq_dt = out_dtype or q.dtype
+    dk_dt = out_dtype or k.dtype
+    dv_dt = out_dtype or v.dtype
+    BH, Tq, D = q.shape
+    Tk = k.shape[1]
+    bq = _pick_block(Tq, BLOCK_Q)
+    bk = _pick_block(Tk, BLOCK_K)
+    num_q = Tq // bq
+    num_k = Tk // bk
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    q_spec = pl.BlockSpec((1, bq, D), lambda bh, qi, ki, offs: (bh, qi, 0))
+    k_spec = pl.BlockSpec((1, bk, D), lambda bh, qi, ki, offs: (bh, ki, 0))
+    row_spec = pl.BlockSpec((1, bq, 1), lambda bh, qi, ki, offs: (bh, qi, 0))
+    dq = pl.pallas_call(
+        functools.partial(_attn_bwd_dq_kernel, causal=causal, block_q=bq,
+                          block_k=bk, num_k_tiles=num_k),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(BH, num_q, num_k),
+            in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+            out_specs=q_spec,
+            scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((BH, Tq, D), dq_dt),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(offs, q, k, v, do, lse, delta)
+
+    # dK/dV pass: K tiles are the parallel dimension, Q tiles sequential.
+    qkv_spec = pl.BlockSpec((1, bq, D), lambda bh, ki, qi, offs: (bh, qi, 0))
+    kkv_spec = pl.BlockSpec((1, bk, D), lambda bh, ki, qi, offs: (bh, ki, 0))
+    rowkv_spec = pl.BlockSpec((1, bq, 1),
+                              lambda bh, ki, qi, offs: (bh, qi, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_attn_bwd_dkv_kernel, causal=causal, block_q=bq,
+                          block_k=bk, num_q_tiles=num_q),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(BH, num_k, num_q),
+            in_specs=[qkv_spec, kkv_spec, kkv_spec, qkv_spec, rowkv_spec,
+                      rowkv_spec],
+            out_specs=[kkv_spec, kkv_spec],
+            scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                            pltpu.VMEM((bk, D), jnp.float32)],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((BH, Tk, D), dk_dt),
+                   jax.ShapeDtypeStruct((BH, Tk, D), dv_dt)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(offs, q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+def _xla_block_grads(q, k, v, do, lse, delta, offs, causal: bool,
+                     out_dtype=None):
+    """XLA twin of the backward kernels (fallback for untileable shapes
+    and non-TPU platforms). Same math, same lse/delta residuals."""
+    dq_dt = out_dtype or q.dtype
+    dk_dt = out_dtype or k.dtype
+    dv_dt = out_dtype or v.dtype
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("btd,bsd->bts", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    p = jnp.exp(s - lse)
+    if causal:
+        iq = jnp.arange(q.shape[1])[:, None] + offs[0]
+        ik = jnp.arange(k.shape[1])[None, :] + offs[1]
+        p = jnp.where((iq >= ik)[None], p, 0.0)
+    dof = do.astype(jnp.float32)
+    dv = jnp.einsum("bts,btd->bsd", p, dof)
+    dp = jnp.einsum("btd,bsd->bts", dof, v.astype(jnp.float32))
+    ds = p * (dp - delta) * scale
+    dq = jnp.einsum("bts,bsd->btd", ds, k.astype(jnp.float32))
+    dk = jnp.einsum("bts,btd->bsd", ds, q.astype(jnp.float32))
+    return dq.astype(dq_dt), dk.astype(dk_dt), dv.astype(dv_dt)
+
+
 def _pick_block(t: int, cap: int) -> Optional[int]:
     """Largest MXU-friendly tile (multiple of the fp32 sublane count, up
     to ``cap``) that divides ``t``; None when ``t`` isn't tileable
@@ -356,15 +642,27 @@ def _flash_core(q, k, v, q_off, k_off, causal, interpret):
 
 
 def _flash_fwd(q, k, v, q_off, k_off, causal, interpret):
-    return _flash_core(q, k, v, q_off, k_off, causal, interpret), (q, k, v)
+    if _pick_block(q.shape[1], BLOCK_Q) is None or \
+            _pick_block(k.shape[1], BLOCK_K) is None:
+        return _xla_flash(q, k, v, q_off, k_off, causal), \
+            (q, k, v, None, None)
+    offs = jnp.asarray([q_off, k_off], jnp.int32)
+    o, lse = _pallas_attention_fwd_train(q, k, v, offs, causal, interpret)
+    return o, (q, k, v, o, lse)
 
 
 def _flash_bwd(q_off, k_off, causal, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _xla_flash(q_, k_, v_, q_off, k_off, causal),
-        q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    if lse is None:
+        # Untileable shapes: recompute through the XLA twin.
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _xla_flash(q_, k_, v_, q_off, k_off, causal),
+            q, k, v)
+        return vjp(g)
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    offs = jnp.asarray([q_off, k_off], jnp.int32)
+    return _pallas_bwd(q, k, v, g, lse, delta, offs, causal, interpret)
 
 
 _flash_core.defvjp(_flash_fwd, _flash_bwd)

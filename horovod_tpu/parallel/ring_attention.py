@@ -14,6 +14,9 @@ dtype — the same max-shifted accumulation flash attention uses.
 
 from __future__ import annotations
 
+import functools
+
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -46,21 +49,11 @@ def _block_attend(q, k, v, m, l, o, mask):
     return m_new, l_new, o_new
 
 
-def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True):
-    """Context-parallel attention. q/k/v: [B, T_local, H, D] per chip.
-
-    Every K/V block's local attention runs through the flash kernel
-    (Pallas/Mosaic on TPU, XLA elsewhere — ``ops.pallas_attention``):
-    sp == 1 is a single full-attention kernel call; sp > 1 calls the
-    block-state kernel once per ring step and merges blocks with the
-    online-softmax combine, while ``ppermute`` rotates K/V so transfer
-    overlaps compute under XLA's collective scheduling.
-    """
+def _ring_fwd_pass(q, k, v, axis_name: str, causal: bool):
+    """The forward ring: flash block kernel per rotating K/V block +
+    online-softmax merge. Returns (o in q.dtype, lse f32 [B, H, Tq]) —
+    lse is the backward pass's residual."""
     sp = lax.axis_size(axis_name)
-    if sp == 1:
-        from ..ops.pallas_attention import flash_attention
-
-        return flash_attention(q, k, v, causal=causal)
     my = lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
     m = jnp.full((B, H, Tq), NEG_INF, dtype=jnp.float32)
@@ -96,7 +89,85 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True):
     (m, l, o, _, _), _ = lax.scan(
         body, (m, l, o, k, v), jnp.arange(sp))
     o = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
-    return o.astype(q.dtype)
+    # Dead rows (no visible key) take a huge POSITIVE lse so the
+    # backward's exp(s - lse) underflows to zero for them.
+    lse = jnp.where(l > 0.0, m + jnp.log(jnp.maximum(l, 1e-30)), -NEG_INF)
+    return o.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ring_core(q, k, v, axis_name, causal):
+    return _ring_fwd_pass(q, k, v, axis_name, causal)[0]
+
+
+def _ring_vjp_fwd(q, k, v, axis_name, causal):
+    o, lse = _ring_fwd_pass(q, k, v, axis_name, causal)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_vjp_bwd(axis_name, causal, res, do):
+    """Backward ring pass (the ring-attention paper's second rotation):
+    K/V blocks rotate again, each visit computes that block's (dq, dk, dv)
+    through the flash backward kernels with the GLOBAL lse/delta
+    residuals, and dK/dV accumulators travel with their blocks — after sp
+    rotations every gradient is home. Twice the forward's ppermute bytes
+    (k, v, dk, dv per step), the standard ring-backward cost."""
+    from ..ops.pallas_attention import flash_attention_block_grads
+
+    q, k, v, o, lse = res
+    sp = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1).transpose(0, 2, 1)            # [B, H, Tq]
+
+    fwd_perm = [(i, (i + 1) % sp) for i in range(sp)]
+    dq0 = jnp.zeros((B, Tq, H, D), jnp.float32)
+    dk0 = jnp.zeros((B, Tk, H, D), jnp.float32)
+    dv0 = jnp.zeros((B, Tk, H, D), jnp.float32)
+
+    def body(carry, step):
+        dq, dk, dv, k_cur, v_cur = carry
+        k_blk = (my - step) % sp
+        dq_b, dk_b, dv_b = flash_attention_block_grads(
+            q, k_cur, v_cur, do, lse, delta,
+            q_off=my * Tq, k_off=k_blk * Tk, causal=causal)
+        dq = dq + dq_b
+        dk = dk + dk_b
+        dv = dv + dv_b
+        k_nxt = lax.ppermute(k_cur, axis_name, fwd_perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, fwd_perm)
+        dk = lax.ppermute(dk, axis_name, fwd_perm)
+        dv = lax.ppermute(dv, axis_name, fwd_perm)
+        return (dq, dk, dv, k_nxt, v_nxt), None
+
+    (dq, dk, dv, _, _), _ = lax.scan(
+        body, (dq0, dk0, dv0, k, v), jnp.arange(sp))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_core.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
+
+
+def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True):
+    """Context-parallel attention. q/k/v: [B, T_local, H, D] per chip.
+
+    Every K/V block's local attention runs through the flash kernel
+    (Pallas/Mosaic on TPU, XLA elsewhere — ``ops.pallas_attention``):
+    sp == 1 is a single full-attention kernel call; sp > 1 calls the
+    block-state kernel once per ring step and merges blocks with the
+    online-softmax combine, while ``ppermute`` rotates K/V so transfer
+    overlaps compute under XLA's collective scheduling. Training's
+    backward is a second ring pass through the flash backward kernels
+    (``_ring_vjp_bwd``) — no attention recompute through XLA.
+    """
+    sp = lax.axis_size(axis_name)
+    if sp == 1:
+        from ..ops.pallas_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal)
+    return _ring_core(q, k, v, axis_name, causal)
 
 
 def local_flash_attention(q, k, v, causal: bool = True):

@@ -75,6 +75,52 @@ def test_gradients_match_dense():
                                    rtol=1e-4, atol=1e-4)
 
 
+def test_gradients_multi_tile(monkeypatch):
+    # T spans several q/k tiles: the backward kernels' VMEM accumulation
+    # across the sequential grid dimension is exercised (dq over k tiles,
+    # dk/dv over q tiles). Tile caps are shrunk so T=256 genuinely yields
+    # a 4x4 tile grid — at the default 512 cap a 256-token sequence is a
+    # single tile and the accumulation logic would be dead in this test.
+    from horovod_tpu.ops import pallas_attention as pa
+
+    monkeypatch.setattr(pa, "BLOCK_Q", 64)
+    monkeypatch.setattr(pa, "BLOCK_K", 64)
+    q, k, v = _qkv(B=1, T=256, H=2, D=8)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True, use_pallas=True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_dense(q, k, v, True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_gradients_bf16():
+    q, k, v = _qkv(T=16)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(
+            q, k, v, causal=True, use_pallas=True).astype(jnp.float32) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(qb, kb, vb)
+    assert all(g.dtype == jnp.bfloat16 for g in gf)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_dense(q, k, v, True) ** 2)
+
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b), rtol=1e-1, atol=1e-1)
+
+
 def test_untileable_sizes_fall_back():
     # T=20 has no MXU-friendly divisor: the XLA path serves it, same math.
     q, k, v = _qkv(T=20)
@@ -145,3 +191,39 @@ def test_ring_attention_uses_block_kernel(monkeypatch):
     ref = _dense(q, k, v, True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_gradients(monkeypatch):
+    # Training through sp>1 ring attention: the backward ring pass (flash
+    # backward kernels + rotating dK/dV accumulators) must reproduce the
+    # dense-attention gradients.
+    monkeypatch.setenv("HVD_PALLAS_INTERPRET", "1")
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from horovod_tpu.parallel.ring_attention import ring_attention
+
+    devices = jax.devices()[:4]
+    mesh = Mesh(np.array(devices).reshape(4), ("sp",))
+    B, T, H, D = 1, 32, 2, 8
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+
+    ring = jax.shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis_name="sp"),
+        mesh=mesh, in_specs=P(None, "sp"), out_specs=P(None, "sp"),
+        check_vma=False)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring(q, k, v) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_dense(q, k, v, True) ** 2)
+
+    gr = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gr, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4, err_msg=name)

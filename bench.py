@@ -27,9 +27,13 @@ import time
 
 BASELINE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16.0
 
-PROBE_TIMEOUT_S = 100
-PROBE_ATTEMPTS = 6
-PROBE_RETRY_SLEEP_S = 20
+# Per-attempt timeout must cover a *slow but healthy* backend init (large
+# pod, cold tunnel — observed up to ~2.5 min); retries only help transient
+# unreachability, since each attempt restarts init from scratch. Total
+# probe budget ~11.5 min before the CPU fallback.
+PROBE_TIMEOUT_S = 150
+PROBE_ATTEMPTS = 4
+PROBE_RETRY_SLEEP_S = 30
 WORKER_TIMEOUT_S = 1200
 CPU_FALLBACK_TIMEOUT_S = 900
 

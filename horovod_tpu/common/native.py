@@ -95,6 +95,14 @@ def load_library():
         ctypes.c_void_p, ctypes.c_int, ctypes.c_double, ctypes.c_double,
         ctypes.c_int,
     ]
+    lib.hvd_enqueue_chips.restype = ctypes.c_longlong
+    lib.hvd_enqueue_chips.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_double, ctypes.c_double,
+        ctypes.c_int,
+    ]
     lib.hvd_test.restype = ctypes.c_int
     lib.hvd_test.argtypes = [ctypes.c_longlong, ctypes.c_char_p,
                              ctypes.c_int]
@@ -264,12 +272,23 @@ class NativeCore:
                 shape: Tuple[int, ...], data_ptr: Optional[int] = None,
                 output_ptr: Optional[int] = None, root_rank: int = -1,
                 prescale: float = 1.0, postscale: float = 1.0,
-                plane: int = PLANE_XLA) -> int:
+                plane: int = PLANE_XLA,
+                chip_dims: Optional[Tuple[int, ...]] = None) -> int:
+        """``chip_dims`` (allgather, XLA plane): first dims of the chips
+        this process drives, possibly ragged; they ride the Request so the
+        coordinator publishes the per-chip dim table in the response."""
         arr = (ctypes.c_longlong * len(shape))(*shape)
-        h = self.lib.hvd_enqueue(
-            name.encode(), op, reduce_op, dtype_code, arr, len(shape),
-            data_ptr or None, output_ptr or None, root_rank, prescale,
-            postscale, plane)
+        if chip_dims:
+            cd = (ctypes.c_longlong * len(chip_dims))(*chip_dims)
+            h = self.lib.hvd_enqueue_chips(
+                name.encode(), op, reduce_op, dtype_code, arr, len(shape),
+                cd, len(chip_dims), data_ptr or None, output_ptr or None,
+                root_rank, prescale, postscale, plane)
+        else:
+            h = self.lib.hvd_enqueue(
+                name.encode(), op, reduce_op, dtype_code, arr, len(shape),
+                data_ptr or None, output_ptr or None, root_rank, prescale,
+                postscale, plane)
         return int(h)
 
     def test(self, handle: int) -> Tuple[int, str]:

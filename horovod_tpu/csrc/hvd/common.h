@@ -159,6 +159,11 @@ struct Request {
   TensorShape shape;
   double prescale = 1.0;
   double postscale = 1.0;
+  // Allgather only: first dims of the individual chips this process
+  // drives (XLA plane, local_size > 1). Empty = one chip of shape.dim(0).
+  // Lets per-chip ragged gathers negotiate; the response publishes the
+  // rank-major concatenation (one entry per CHIP) in first_dims.
+  std::vector<int64_t> chip_dims;
 };
 
 // Coordinator -> ranks (reference: message.h Response). One response may

@@ -99,18 +99,29 @@ bool Controller::ValidateGroup(const std::string& name,
   out->tensor_names = {name};
   out->shapes = {first.shape};
   if (error.empty() && first.op == CollectiveOp::ALLGATHER) {
-    // Publish per-rank first-dim sizes so every rank can size outputs and
-    // use displacement math without a separate exchange. Ranks absent
-    // from the group (world_size > group, e.g. a single-controller world)
-    // default to this rank's own size. Exactly one inner vector per tensor
-    // (empty for 0-d) so fused responses stay index-aligned with
-    // tensor_names.
+    // Publish per-CHIP first-dim sizes, rank-major, so every rank can
+    // size outputs and use displacement math without a separate exchange
+    // (a host-plane rank drives one chip, so its entry count is 1; an
+    // XLA-plane rank contributes one entry per locally-driven chip via
+    // Request::chip_dims). Ranks absent from the group (world_size >
+    // group, e.g. a single-controller world) default to the first
+    // requester's chip list. Exactly one inner vector per tensor (empty
+    // for 0-d) so fused responses stay index-aligned with tensor_names.
     if (first.shape.ndim() == 0) {
       out->first_dims = {std::vector<int64_t>{}};
     } else {
-      std::vector<int64_t> fd(world_size, first.shape.dim(0));
+      auto chips_of = [](const Request& q) -> std::vector<int64_t> {
+        if (!q.chip_dims.empty()) return q.chip_dims;
+        return {q.shape.dim(0)};
+      };
+      std::vector<std::vector<int64_t>> per_rank(
+          world_size, chips_of(first));
       for (const auto& q : group) {
-        if (q.rank >= 0 && q.rank < world_size) fd[q.rank] = q.shape.dim(0);
+        if (q.rank >= 0 && q.rank < world_size) per_rank[q.rank] = chips_of(q);
+      }
+      std::vector<int64_t> fd;
+      for (const auto& chips : per_rank) {
+        fd.insert(fd.end(), chips.begin(), chips.end());
       }
       out->first_dims = {std::move(fd)};
     }

@@ -67,6 +67,8 @@ static void WriteRequest(Writer* w, const Request& q) {
   WriteShape(w, q.shape);
   w->f64(q.prescale);
   w->f64(q.postscale);
+  w->i32(static_cast<int32_t>(q.chip_dims.size()));
+  for (auto d : q.chip_dims) w->i64(d);
 }
 
 static Request ReadRequest(Reader* r) {
@@ -81,6 +83,11 @@ static Request ReadRequest(Reader* r) {
   q.shape = ReadShape(r);
   q.prescale = r->f64();
   q.postscale = r->f64();
+  int32_t nc = r->i32();
+  if (nc >= 0 && nc <= (1 << 16)) {
+    q.chip_dims.reserve(nc);
+    for (int32_t i = 0; i < nc; ++i) q.chip_dims.push_back(r->i64());
+  }
   return q;
 }
 

@@ -570,15 +570,20 @@ void hvd_register_exec_callback(void (*cb)(const char*, int, long)) {
 // return value is >= 0. The handle is passed to the callback so callers
 // never need to read it from shared state (the role of the reference's
 // StatusCallback for async framework kernels, tensorflow/mpi_ops.cc:294).
-long long hvd_enqueue_cb(const char* name, int op, int reduce_op, int dtype,
-                         const long long* shape, int ndim, void* data,
-                         void* output, int root_rank, double prescale,
-                         double postscale, int plane,
-                         void (*done)(void*, long long, int, const char*),
-                         void* done_arg) {
+static long long EnqueueImpl(const char* name, int op, int reduce_op,
+                             int dtype, const long long* shape, int ndim,
+                             const long long* chip_dims, int n_chips,
+                             void* data, void* output, int root_rank,
+                             double prescale, double postscale, int plane,
+                             void (*done)(void*, long long, int,
+                                          const char*),
+                             void* done_arg) {
   auto* s = hvd::g();
   if (!s->initialized.load()) return -1;
   hvd::TensorTableEntry e;
+  if (chip_dims != nullptr && n_chips > 0) {
+    e.request.chip_dims.assign(chip_dims, chip_dims + n_chips);
+  }
   e.name = name;
   e.request.rank = s->rank;
   e.request.op = static_cast<hvd::CollectiveOp>(op);
@@ -609,6 +614,17 @@ long long hvd_enqueue_cb(const char* name, int op, int reduce_op, int dtype,
   return h;
 }
 
+long long hvd_enqueue_cb(const char* name, int op, int reduce_op, int dtype,
+                         const long long* shape, int ndim, void* data,
+                         void* output, int root_rank, double prescale,
+                         double postscale, int plane,
+                         void (*done)(void*, long long, int, const char*),
+                         void* done_arg) {
+  return EnqueueImpl(name, op, reduce_op, dtype, shape, ndim, nullptr, 0,
+                     data, output, root_rank, prescale, postscale, plane,
+                     done, done_arg);
+}
+
 long long hvd_enqueue(const char* name, int op, int reduce_op, int dtype,
                       const long long* shape, int ndim, void* data,
                       void* output, int root_rank, double prescale,
@@ -616,6 +632,20 @@ long long hvd_enqueue(const char* name, int op, int reduce_op, int dtype,
   return hvd_enqueue_cb(name, op, reduce_op, dtype, shape, ndim, data,
                         output, root_rank, prescale, postscale, plane,
                         nullptr, nullptr);
+}
+
+// Allgather with explicit per-chip first dims (XLA plane, local_size > 1,
+// possibly ragged across the locally-driven chips). chip_dims rides the
+// Request so the coordinator can publish the rank-major per-chip dim
+// table in the response (see Controller::ConstructResponse).
+long long hvd_enqueue_chips(const char* name, int op, int reduce_op,
+                            int dtype, const long long* shape, int ndim,
+                            const long long* chip_dims, int n_chips,
+                            void* data, void* output, int root_rank,
+                            double prescale, double postscale, int plane) {
+  return EnqueueImpl(name, op, reduce_op, dtype, shape, ndim, chip_dims,
+                     n_chips, data, output, root_rank, prescale, postscale,
+                     plane, nullptr, nullptr);
 }
 
 // Executor-allocated result access (ragged allgather): after hvd_wait

@@ -241,19 +241,25 @@ class EagerEngine:
                 fd = (resp.first_dims[i]
                       if i < len(resp.first_dims) else ())
                 if fd and len(set(fd)) > 1:
-                    # Ragged across processes: every process pads its
-                    # stack to the global max (so all compile the same
-                    # program), gathers, then slices per the response's
-                    # per-rank dims (the NCCL unequal-shape fallback's
-                    # pad+slice, nccl_operations.cc:402-523).
+                    # Ragged across chips/processes: every process pads
+                    # its stack to the global max (so all compile the
+                    # same program), gathers, then slices per the
+                    # response's dim table (the NCCL unequal-shape
+                    # fallback's pad+slice, nccl_operations.cc:402-523).
+                    # fd is rank-major per CHIP when every request
+                    # carried chip_dims (XLA plane, the multi-process
+                    # path does); a host-plane rank contributes exactly
+                    # one entry, so per-chip and per-rank coincide there.
                     max0 = max(fd)
                     pad = [(0, 0), (0, max0 - p.stacked.shape[1])] + \
                         [(0, 0)] * (p.stacked.ndim - 2)
                     out = np.asarray(
                         self._exec_allgather(jnp.pad(p.stacked, pad)))
                     views = out.reshape((size, max0) + out.shape[1:])
+                    idx = (lambda c: c) if len(fd) == size \
+                        else (lambda c: c // L)
                     p.result = np.concatenate(
-                        [views[c, : fd[c // L]] for c in range(size)],
+                        [views[c, : fd[idx(c)]] for c in range(size)],
                         axis=0)
                 elif p.was_device:
                     p.result = self._exec_allgather(p.stacked)
@@ -491,7 +497,7 @@ class EagerEngine:
 
     def _submit(self, kind: str, name: Optional[str], stacked, was_list,
                 was_unstacked, op=None, prescale=1.0, postscale=1.0,
-                root=-1, was_device=False) -> int:
+                root=-1, was_device=False, chip_dims=None) -> int:
         name = name or self._auto_name(kind)
         timeline = self._state.timeline
         if self._native:
@@ -509,7 +515,7 @@ class EagerEngine:
                 name, _OP_TO_NATIVE[kind], op if op is not None else 1,
                 self._dtype_code(stacked), tuple(stacked.shape[1:]),
                 root_rank=root, prescale=prescale, postscale=postscale,
-                plane=_native.PLANE_XLA)
+                plane=_native.PLANE_XLA, chip_dims=chip_dims)
             if handle < 0:
                 # Negative returns are error codes, not handles — they would
                 # collide with the direct-handle namespace below.
@@ -626,18 +632,44 @@ class EagerEngine:
             ts = [jnp.asarray(t) for t in tensor]
             if all(t.ndim > 0 for t in ts) and \
                     len({t.shape[0] for t in ts}) > 1:
-                # Ragged across locally-driven chips: per-chip sizes are
-                # all local knowledge, so pad+gather+slice runs directly
-                # (parity: MPI_Allgatherv, mpi_operations.cc:140-175).
                 if self._state.process_count > 1:
-                    raise ValueError(
-                        "ragged allgather with multiple local chips per "
-                        "process is not supported across processes; use "
-                        "one chip per process or equal first dimensions")
+                    if not self._native:
+                        # Direct mode has no negotiated dim table: the
+                        # padded stacks would gather with their zero pad
+                        # rows silently included.
+                        raise ValueError(
+                            "ragged allgather with multiple local chips "
+                            "per process requires the native runtime "
+                            "across processes (chip-dim negotiation); "
+                            "build libhvdtpu.so or use equal first "
+                            "dimensions")
+                    # Ragged across locally-driven chips AND processes:
+                    # pad the local chips to the local max, negotiate with
+                    # the true per-chip dims riding the request
+                    # (chip_dims), and let the response's rank-major
+                    # per-chip dim table drive the global pad+slice
+                    # (parity: the NCCL unequal-shape fallback,
+                    # nccl_operations.cc:402-523).
+                    sizes = tuple(t.shape[0] for t in ts)
+                    max0 = max(sizes)
+                    padded = jnp.stack([
+                        jnp.pad(t, [(0, max0 - t.shape[0])] +
+                                [(0, 0)] * (t.ndim - 1)) for t in ts])
+                    return self._submit("allgather", name, padded, True,
+                                        False, chip_dims=sizes)
+                # Single process: per-chip sizes are all local knowledge,
+                # so pad+gather+slice runs directly (parity:
+                # MPI_Allgatherv, mpi_operations.cc:140-175).
                 return self._ragged_local_allgather(ts, name)
         stacked, wl, wu, dev = self._normalize(tensor)
+        chip_dims = None
+        if self._state.process_count > 1 and stacked.ndim > 1:
+            # Per-chip dims always ride multi-process allgathers so the
+            # response's dim table is per-chip regardless of which
+            # processes turn out to be ragged.
+            chip_dims = (stacked.shape[1],) * self._state.local_size
         return self._submit("allgather", name, stacked, wl, wu,
-                            was_device=dev)
+                            was_device=dev, chip_dims=chip_dims)
 
     def _ragged_local_allgather(self, ts: List, name: Optional[str]) -> int:
         name = name or self._auto_name("allgather")

@@ -55,11 +55,9 @@ class ElasticDriver:
         # the value travels through the rendezvous KV rather than being a
         # worker-side guess (see host_world._rejoin_grace_seconds).
         grace = 10.0 + (cooldown_range[1] if cooldown_range else 0.0)
-        try:
+        if hasattr(rendezvous, "put"):
             self._rendezvous.put("config", "rejoin_grace",
                                  repr(grace).encode())
-        except AttributeError:
-            pass  # fake rendezvous in unit tests may lack put()
         self._min_np = min_np
         self._max_np = max_np or 0
         self._timeout = timeout or 600.0

@@ -86,6 +86,40 @@ def test_eager_collectives_cross_process(tmp_path):
     run_world(tmp_path, script, "MULTIHOST", drop_env=_DROP_ENV)
 
 
+def test_ragged_allgather_multi_chip_cross_process(tmp_path):
+    """Ragged first dims on chips of BOTH processes (local_size 2): the
+    per-chip dim table (Request.chip_dims -> response first_dims) drives
+    the global pad+gather+slice."""
+    script = _PRELUDE + textwrap.dedent("""
+        # Chip c contributes (c+1) rows: proc0 chips 1,2 rows; proc1 3,4.
+        xs = [jnp.full((r + 1, 3), float(r), jnp.float32)
+              for r in my_ranks]
+        got = np.asarray(hvd.allgather(xs, name="mh.rag"))
+        expect = np.concatenate(
+            [np.full((r + 1, 3), float(r), np.float32) for r in range(4)])
+        assert got.shape == expect.shape, (got.shape, expect.shape)
+        np.testing.assert_allclose(got, expect)
+
+        # Mixed: one process ragged, the other equal-dims, same collective.
+        if rank == 0:
+            ys = [jnp.full((2, 2), 0.0, jnp.float32),
+                  jnp.full((5, 2), 1.0, jnp.float32)]
+        else:
+            ys = [jnp.full((3, 2), 2.0, jnp.float32),
+                  jnp.full((3, 2), 3.0, jnp.float32)]
+        got = np.asarray(hvd.allgather(ys, name="mh.rag2"))
+        sizes = [2, 5, 3, 3]
+        expect = np.concatenate(
+            [np.full((sizes[c], 2), float(c), np.float32)
+             for c in range(4)])
+        np.testing.assert_allclose(got, expect)
+
+        hvd.shutdown()
+        print(f"MHRAGGED_{rank}_OK")
+    """)
+    run_world(tmp_path, script, "MHRAGGED", drop_env=_DROP_ENV)
+
+
 def test_train_step_and_zero_cross_process(tmp_path):
     """One DP train step and one ZeRO-1 step through the global mesh."""
     script = _PRELUDE + textwrap.dedent("""

@@ -45,6 +45,9 @@ HOROVOD_RENDEZVOUS_PORT = "HOROVOD_GLOO_RENDEZVOUS_PORT"
 HOROVOD_TPU_MESH_AXES = "HOROVOD_TPU_MESH_AXES"
 HOROVOD_TPU_DONUT_SIZE = "HOROVOD_TPU_DONUT_SIZE"
 HOROVOD_ELASTIC = "HOROVOD_ELASTIC"
+HOROVOD_HOST_VIA_XLA = "HOROVOD_HOST_VIA_XLA"
+HOROVOD_HOST_VIA_XLA_THRESHOLD = "HOROVOD_HOST_VIA_XLA_THRESHOLD"
+DEFAULT_HOST_VIA_XLA_THRESHOLD = 1 << 20  # 1 MiB fused response
 HOROVOD_ELASTIC_REJOIN_GRACE = "HOROVOD_ELASTIC_REJOIN_GRACE"
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # reference operations.cc:423
@@ -109,6 +112,8 @@ class RuntimeConfig:
     stall_warning_seconds: float = DEFAULT_STALL_WARNING_SECONDS
     stall_shutdown_seconds: float = 0.0
     elastic: bool = False
+    host_via_xla: bool = False
+    host_via_xla_threshold: int = DEFAULT_HOST_VIA_XLA_THRESHOLD
 
     @classmethod
     def from_env(cls) -> "RuntimeConfig":
@@ -138,4 +143,8 @@ class RuntimeConfig:
             ),
             stall_shutdown_seconds=_get_float(HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, 0.0),
             elastic=_get_bool(HOROVOD_ELASTIC),
+            host_via_xla=_get_bool(HOROVOD_HOST_VIA_XLA),
+            host_via_xla_threshold=_get_int(
+                HOROVOD_HOST_VIA_XLA_THRESHOLD,
+                DEFAULT_HOST_VIA_XLA_THRESHOLD),
         )

@@ -1,0 +1,323 @@
+"""Host-tensor staging through the XLA plane (``HOROVOD_HOST_VIA_XLA=1``).
+
+On a pod, a PyTorch/TensorFlow script's gradients are host tensors; by
+default they cross hosts on the native TCP ring. This executor gives them
+the fast fabric: the native cycle routes large fused host allreduces here
+(``hvd_set_host_via_xla``), the fused buffer is staged to a device, one
+compiled psum over a one-device-per-process mesh runs the reduction over
+ICI/DCN, and the result is copied back into the framework tensors' output
+buffers. The reference's GPU staging paths play this role on NVLink/IB
+(``torch/mpi_ops_v2.cc:81`` DoAllreduceCudaOnCPU, hierarchical
+``nccl_operations.cc:164-357``).
+
+Activation: ``HostWorld.init`` calls :func:`activate` when the env knob is
+set and the process world is multi-process. The executor replaces the host
+world's reject-XLA callback; host-plane responses below the byte threshold
+(``HOROVOD_HOST_VIA_XLA_THRESHOLD``, default 1 MiB) keep riding the ring —
+small tensors aren't worth the host<->device hops.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import queue
+import threading
+from typing import Optional
+
+import numpy as np
+
+from . import config as _config
+from . import logging as _log
+from . import native as _native
+
+def _np_from_code(code):
+    """Native dtype code -> numpy dtype ("bfloat16" resolves through
+    ml_dtypes' numpy registration, present with jax installed)."""
+    for name, c in _native.DTYPE_CODES.items():
+        if c == code:
+            if name == "bfloat16":
+                import ml_dtypes
+
+                return np.dtype(ml_dtypes.bfloat16)
+            return np.dtype(name)
+    return np.dtype(np.float32)
+
+# ReduceOp codes (ops/xla.py ReduceOp / csrc common.h, identical).
+_OP_AVERAGE = 0
+_OP_SUM = 1
+_OP_MIN = 3
+_OP_MAX = 4
+
+
+class HostStagingExecutor:
+    """Executor thread + compiled psum programs over the process mesh."""
+
+    def __init__(self, world, core):
+        self._world = world
+        self._core = core
+        self._mesh = None
+        self._programs = {}
+        self._timeline = None
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._thread: Optional[threading.Thread] = None
+        self._threshold = -1
+        self._closed = False
+
+    # -- activation ----------------------------------------------------------
+
+    def activate(self) -> bool:
+        """Join/build the device world and start serving. False (with a
+        log line) when no usable per-process device mesh exists."""
+        import jax
+
+        world = self._world
+        if world.size > 1 and not jax.distributed.is_initialized():
+            addr = os.environ.get(_config.HOROVOD_CONTROLLER_ADDR,
+                                  "127.0.0.1")
+            port = int(os.environ.get(_config.HOROVOD_CONTROLLER_PORT,
+                                      "29500"))
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=f"{addr}:{port}",
+                    num_processes=world.size, process_id=world.rank)
+            except Exception as e:
+                _log.warning(
+                    f"HOROVOD_HOST_VIA_XLA: jax.distributed init failed "
+                    f"({e}); host tensors stay on the TCP ring")
+                return False
+        try:
+            per_proc = {}
+            for d in jax.devices():
+                per_proc.setdefault(d.process_index, d)
+        except Exception as e:
+            _log.warning(f"HOROVOD_HOST_VIA_XLA: no device backend ({e}); "
+                         "host tensors stay on the TCP ring")
+            return False
+        if len(per_proc) != world.size:
+            _log.warning(
+                f"HOROVOD_HOST_VIA_XLA: device world spans "
+                f"{len(per_proc)} processes but the host world has "
+                f"{world.size}; host tensors stay on the TCP ring")
+            return False
+
+        from jax.sharding import Mesh
+
+        devices = [per_proc[i] for i in sorted(per_proc)]
+        self._mesh = Mesh(np.array(devices, dtype=object), ("proc",))
+
+        cfg = _config.RuntimeConfig.from_env()
+        if cfg.timeline_filename and world.rank == 0:
+            from .timeline import Timeline
+
+            self._timeline = Timeline(cfg.timeline_filename)
+
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="hvd-host-staging")
+        self._thread.start()
+        self._core.register_exec_callback(self._on_responses)
+        self._threshold = cfg.host_via_xla_threshold
+        return True
+
+    def enable_routing(self):
+        """Flip the native cycle to route large host responses here. Only
+        call after ALL processes agreed to stage (see maybe_activate) —
+        the stage-vs-ring decision must be global or the world deadlocks
+        (staged ranks wait in the psum, ring ranks wait on the ring)."""
+        self._core.set_host_via_xla(self._threshold)
+        _log.info(
+            f"HOROVOD_HOST_VIA_XLA active: fused host allreduces >= "
+            f"{self._threshold} bytes ride the XLA plane over "
+            f"{self._world.size} processes")
+
+    def close(self):
+        """Stop the executor thread (sentinel) and close the timeline.
+        Responses already handed to the native cycle after this point are
+        failed fast instead of touching a shutting-down core."""
+        self._closed = True
+        if self._thread is not None and self._thread.is_alive():
+            self._q.put(None)
+            self._thread.join(timeout=5.0)
+        if self._timeline is not None:
+            self._timeline.close()
+            self._timeline = None
+
+    # -- native callback (cycle thread: enqueue only) ------------------------
+
+    def _on_responses(self, responses, response_id):
+        self._q.put((responses, response_id))
+
+    # -- executor thread -----------------------------------------------------
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return  # close() sentinel
+            responses, response_id = item
+            if self._closed:
+                self._core.response_done(response_id, False,
+                                         "staging executor closed")
+                continue
+            try:
+                for resp in responses:
+                    self._execute(resp, response_id)
+                self._core.response_done(response_id, True)
+            except Exception as e:
+                _log.error(f"host staging executor failure: {e}")
+                self._core.response_done(response_id, False, str(e))
+
+    def _execute(self, resp, response_id):
+        if resp.plane != _native.PLANE_HOST or \
+                resp.op != _native.OP_ALLREDUCE:
+            raise _native_error(
+                f"host staging executor got unexpected response "
+                f"(plane={resp.plane}, op={resp.op})")
+        dtype = _np_from_code(resp.dtype)
+        counts = [int(np.prod(s)) if s else 1 for s in resp.shapes]
+        total = sum(counts)
+
+        if self._timeline:
+            for n in resp.names:
+                self._timeline.start_activity(n, "XLA_ALLREDUCE")
+
+        # Fuse into one flat host buffer in the response's canonical
+        # order; a joined rank's missing slots stay zero (the reference
+        # AllocateZeros join path).
+        fused = np.zeros((total,), dtype)
+        views = {}
+        off = 0
+        for name, count in zip(resp.names, counts):
+            ptrs = self._core.inflight_ptrs(response_id, name)
+            if ptrs is not None:
+                data_ptr, out_ptr = ptrs
+                src = _as_array(data_ptr, count, dtype)
+                fused[off:off + count] = src
+                views[name] = (off, count,
+                               _as_array(out_ptr or data_ptr, count, dtype))
+            off += count
+
+        reduced = self._allreduce(fused, resp.reduce_op, resp.prescale,
+                                  resp.postscale)
+
+        for name, (off, count, out_view) in views.items():
+            np.copyto(out_view, reduced[off:off + count])
+
+        if self._timeline:
+            for n in resp.names:
+                self._timeline.end_activity(n, "XLA_ALLREDUCE")
+
+    def _allreduce(self, fused, reduce_op, prescale, postscale):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        P_devices = self._world.size
+        # Accumulate 16-bit floats in fp32 (the ring and the XLA eager
+        # plane both do).
+        upcast = fused.dtype.kind == "f" and fused.dtype.itemsize == 2
+        key = (fused.shape[0], str(fused.dtype), reduce_op, prescale,
+               postscale)
+        prog = self._programs.get(key)
+        if prog is None:
+            mesh = self._mesh
+
+            def fn(x):
+                y = x[0]
+                if upcast:
+                    y = y.astype(jnp.float32)
+                if prescale != 1.0:
+                    y = y * prescale
+                if reduce_op == _OP_MIN:
+                    y = lax.pmin(y, "proc")
+                elif reduce_op == _OP_MAX:
+                    y = lax.pmax(y, "proc")
+                else:
+                    y = lax.psum(y, "proc")
+                    if reduce_op == _OP_AVERAGE:
+                        y = y / P_devices
+                if postscale != 1.0:
+                    y = y * postscale
+                return y.astype(x.dtype)[None]
+
+            prog = jax.jit(jax.shard_map(
+                fn, mesh=mesh, in_specs=P("proc"), out_specs=P("proc"),
+                check_vma=False))
+            self._programs[key] = prog
+
+        sharding = NamedSharding(self._mesh, P("proc"))
+        global_shape = (P_devices,) + fused.shape
+        arr = jax.make_array_from_process_local_data(
+            sharding, fused[None], global_shape)
+        out = prog(arr)
+        # This process's shard is the reduced buffer (replicated content
+        # across shards by construction of the allreduce).
+        return np.asarray(list(out.addressable_shards)[0].data[0])
+
+
+def _as_array(ptr, count, dtype):
+    buf = (ctypes.c_char * (count * dtype.itemsize)).from_address(ptr)
+    return np.frombuffer(buf, dtype=dtype, count=count)
+
+
+def _native_error(msg):
+    from .exceptions import HorovodInternalError
+
+    return HorovodInternalError(msg)
+
+
+def maybe_activate(world, core) -> Optional[HostStagingExecutor]:
+    """Called from ``HostWorld.init``: returns the active executor or
+    None. Never raises — staging is an optimization, the ring is the
+    always-correct fallback."""
+    if not _config._get_bool(_config.HOROVOD_HOST_VIA_XLA):
+        return None
+    if core is None or world.size <= 1:
+        return None
+    from . import state as _state
+
+    if _state.global_state().engine is not None and \
+            getattr(_state.global_state().engine, "_native", False):
+        # The JAX-native eager engine owns the exec callback in this
+        # process; its executor serves the XLA plane and staging would
+        # fight it for the slot.
+        _log.warning("HOROVOD_HOST_VIA_XLA ignored: the JAX-native engine "
+                     "already owns the XLA executor in this process")
+        return None
+    try:
+        ex = HostStagingExecutor(world, core)
+        ok = ex.activate()
+    except Exception as e:
+        _log.warning(f"HOROVOD_HOST_VIA_XLA activation failed: {e}; host "
+                     f"tensors stay on the TCP ring")
+        ok, ex = False, None
+
+    # The stage-vs-ring routing decision MUST be unanimous: a rank that
+    # failed activation would run the ring while the others wait in the
+    # psum — a world deadlock. Agree via a MIN-allreduce of the local
+    # outcome on the (always-available) ring before enabling routing.
+    flag = np.array([1.0 if ok else 0.0], np.float32)
+    # Straight onto the core (not world.enqueue): maybe_activate runs
+    # inside HostWorld.init, before the world reports initialized.
+    h = core.enqueue("__hvd.staging.agree", _native.OP_ALLREDUCE,
+                     3,  # ReduceOp.MIN
+                     _native.DTYPE_CODES["float32"], (1,),
+                     data_ptr=flag.ctypes.data, output_ptr=flag.ctypes.data,
+                     plane=_native.PLANE_HOST)
+    r, err = core.wait(h)
+    if r != 1:
+        _log.warning(f"HOROVOD_HOST_VIA_XLA agreement allreduce failed "
+                     f"({err}); host tensors stay on the TCP ring")
+        if ex is not None:
+            ex.close()
+        return None
+    if flag[0] < 1.0:
+        if ok:
+            _log.warning("HOROVOD_HOST_VIA_XLA disabled: activation "
+                         "failed on another process (unanimity required)")
+        if ex is not None:
+            ex.close()
+        return None
+    ex.enable_routing()
+    return ex

@@ -106,6 +106,7 @@ class HostWorld:
         self.cross_size = 1
         self._core: Optional[_native.NativeCore] = None
         self._owns_core = False
+        self._staging = None  # host_staging.HostStagingExecutor when active
         # (addr, port) fetched from the elastic rendezvous KV this round;
         # overrides the launch-time HOROVOD_CONTROLLER_ADDR/PORT env, which
         # goes stale once rank 0 migrates to a different host.
@@ -169,6 +170,15 @@ class HostWorld:
                 # size-1 world: every collective is an identity op locally;
                 # no controller or ring needed.
                 self._core = None
+            self._staging = None
+            if self._core is not None and self._owns_core:
+                from . import host_staging
+
+                # Opt-in fast fabric for large host tensors
+                # (HOROVOD_HOST_VIA_XLA=1): fused allreduces above the
+                # threshold stage through the XLA plane instead of the
+                # TCP ring. No-op unless the env knob is set.
+                self._staging = host_staging.maybe_activate(self, self._core)
             self.initialized = True
 
     def _maybe_elastic_rerendezvous(self):
@@ -367,8 +377,12 @@ class HostWorld:
             if not self.initialized:
                 return
             if self._core is not None and self._owns_core:
+                if self._staging is not None:
+                    self._core.set_host_via_xla(-1)
+                    self._staging.close()
                 self._core.shutdown()
             self._core = None
+            self._staging = None
             self._elastic_controller = None
             self.initialized = False
             self.rank, self.size = 0, 1

@@ -64,7 +64,11 @@ def _build_library() -> bool:
 
 
 _lib = None
-_keepalive_cb = None  # prevent GC of the registered CFUNCTYPE
+# Every registered CFUNCTYPE trampoline stays referenced forever: the C++
+# cycle thread may hold a superseded pointer across a re-registration
+# (host_staging replacing the host world's placeholder), and freeing it
+# would turn that in-flight call into a jump to freed memory.
+_keepalive_cbs = []
 
 
 def load_library():
@@ -115,6 +119,13 @@ def load_library():
     lib.hvd_register_exec_callback.restype = None
     lib.hvd_register_exec_callback.argtypes = [_EXEC_CB_TYPE]
     lib.hvd_pending_count.restype = ctypes.c_int
+    lib.hvd_set_host_via_xla.restype = None
+    lib.hvd_set_host_via_xla.argtypes = [ctypes.c_longlong]
+    lib.hvd_inflight_ptrs.restype = ctypes.c_int
+    lib.hvd_inflight_ptrs.argtypes = [
+        ctypes.c_long, ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
     lib.hvd_join.restype = ctypes.c_longlong
     lib.hvd_join.argtypes = []
     lib.hvd_last_joined.restype = ctypes.c_int
@@ -244,7 +255,19 @@ class NativeCore:
         (push to an executor queue)."""
         if not self.available:
             return False
-        global _keepalive_cb
+        self.register_exec_callback(exec_callback)
+        rc = self.lib.hvd_init(
+            rank, size, local_rank, local_size, cross_rank, cross_size,
+            coordinator_addr.encode(), coordinator_port, my_host.encode(),
+            cycle_time_ms, fusion_threshold, cache_capacity,
+            stall_warning_sec, stall_shutdown_sec,
+            1 if stall_check_enabled else 0)
+        return rc == 0
+
+    def register_exec_callback(self, exec_callback) -> None:
+        """(Re-)install the executor callback. Callable after init too —
+        the host-staging executor replaces the host world's reject-XLA
+        placeholder when HOROVOD_HOST_VIA_XLA activates."""
 
         def _cb(data_ptr, length, response_id):
             try:
@@ -254,15 +277,26 @@ class NativeCore:
                 _log.error(f"exec callback error: {e}")
                 self.response_done(response_id, False, str(e))
 
-        _keepalive_cb = _EXEC_CB_TYPE(_cb)
-        self.lib.hvd_register_exec_callback(_keepalive_cb)
-        rc = self.lib.hvd_init(
-            rank, size, local_rank, local_size, cross_rank, cross_size,
-            coordinator_addr.encode(), coordinator_port, my_host.encode(),
-            cycle_time_ms, fusion_threshold, cache_capacity,
-            stall_warning_sec, stall_shutdown_sec,
-            1 if stall_check_enabled else 0)
-        return rc == 0
+        trampoline = _EXEC_CB_TYPE(_cb)
+        _keepalive_cbs.append(trampoline)
+        self.lib.hvd_register_exec_callback(trampoline)
+
+    def set_host_via_xla(self, threshold: int) -> None:
+        """Route fused host-plane allreduces >= threshold bytes to the
+        executor callback for XLA-plane staging; -1 disables."""
+        if self.available:
+            self.lib.hvd_set_host_via_xla(threshold)
+
+    def inflight_ptrs(self, response_id: int, name: str):
+        """Raw (data_ptr, output_ptr) of one named entry of an in-flight
+        response; None when this rank holds no such entry (joined)."""
+        data = ctypes.c_void_p()
+        out = ctypes.c_void_p()
+        r = self.lib.hvd_inflight_ptrs(response_id, name.encode(),
+                                       ctypes.byref(data), ctypes.byref(out))
+        if r != 1:
+            return None
+        return data.value, out.value
 
     def shutdown(self):
         if self.available:

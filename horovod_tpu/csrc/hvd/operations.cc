@@ -108,11 +108,20 @@ struct GlobalState {
   HandleTable handles;
   std::thread background;
 
-  ExecCallback exec_cb = nullptr;
+  // Atomic: re-registered at runtime (host staging replaces the host
+  // world's placeholder) while the cycle thread reads it.
+  std::atomic<ExecCallback> exec_cb{nullptr};
   // responses handed to the XLA executor, keyed by response id
   std::mutex inflight_mu;
   std::unordered_map<long, std::vector<TensorTableEntry>> inflight;
   std::atomic<long> next_response_id{1};
+
+  // >= 0: fused host-plane allreduces of at least this many bytes are
+  // routed to the registered executor (which stages them through the XLA
+  // plane over ICI/DCN) instead of the TCP ring — the role of the
+  // reference's GPU staging paths (torch/mpi_ops_v2.cc:81
+  // DoAllreduceCudaOnCPU, nccl_operations.cc:164-357 hierarchical).
+  std::atomic<long long> host_via_xla_threshold{-1};
 
   // executor-allocated results, keyed by handle (fetched then erased)
   std::mutex results_mu;
@@ -281,11 +290,31 @@ void PerformOperation(const Response& resp) {
   // missing slots from the response's canonical layout.
   if (entries.empty() && !s->joined.load()) return;
   if (resp.plane == DevicePlane::HOST) {
-    ExecuteHostResponse(resp, entries);
-    return;
+    // Large fused allreduces may opt into the XLA-plane staging executor
+    // (hvd_set_host_via_xla); everything else runs on the TCP ring.
+    bool stage = resp.op == CollectiveOp::ALLREDUCE &&
+                 resp.reduce_op != ReduceOp::ADASUM &&
+                 s->exec_cb.load() != nullptr;
+    if (stage) {
+      long long thr = s->host_via_xla_threshold.load();
+      if (thr < 0) {
+        stage = false;
+      } else {
+        int64_t bytes = 0;
+        int es = DataTypeSize(resp.dtype);
+        for (const auto& sh : resp.shapes) bytes += sh.num_elements() * es;
+        stage = bytes >= thr;
+      }
+    }
+    if (!stage) {
+      ExecuteHostResponse(resp, entries);
+      return;
+    }
   }
-  // XLA plane: hand off to the registered executor.
-  if (s->exec_cb == nullptr) {
+  // XLA plane (or staged host response): hand off to the registered
+  // executor.
+  ExecCallback cb = s->exec_cb.load();
+  if (cb == nullptr) {
     Status err = Status::PreconditionError(
         "no XLA executor callback registered");
     for (auto& e : entries) {
@@ -300,7 +329,7 @@ void PerformOperation(const Response& resp) {
     s->inflight[id] = std::move(entries);
   }
   std::string bytes = SerializeResponseList({resp});
-  s->exec_cb(bytes.data(), static_cast<int>(bytes.size()), id);
+  cb(bytes.data(), static_cast<int>(bytes.size()), id);
 }
 
 bool RunLoopOnce(std::chrono::steady_clock::time_point& last_cycle) {
@@ -559,7 +588,7 @@ int hvd_cross_rank() { return hvd::g()->cross_rank; }
 int hvd_cross_size() { return hvd::g()->cross_size; }
 
 void hvd_register_exec_callback(void (*cb)(const char*, int, long)) {
-  hvd::g()->exec_cb = cb;
+  hvd::g()->exec_cb.store(cb);
 }
 
 // Enqueue a collective. Returns a handle (>= 0) or a negative error code.
@@ -753,6 +782,31 @@ void hvd_response_done(long response_id, int ok, const char* error) {
 
 int hvd_pending_count() {
   return static_cast<int>(hvd::g()->tensor_queue.PendingCount());
+}
+
+// Enable (threshold >= 0, bytes) or disable (-1) routing of large fused
+// host-plane allreduces to the registered executor for XLA-plane staging.
+void hvd_set_host_via_xla(long long threshold) {
+  hvd::g()->host_via_xla_threshold.store(threshold);
+}
+
+// Host-staging executor data access: the raw buffer pointers of one named
+// entry of an in-flight response. Returns 1 (found), 0 (absent — a joined
+// rank's missing slot), -1 (unknown response id).
+int hvd_inflight_ptrs(long response_id, const char* name, void** data,
+                      void** output) {
+  auto* s = hvd::g();
+  std::lock_guard<std::mutex> lk(s->inflight_mu);
+  auto it = s->inflight.find(response_id);
+  if (it == s->inflight.end()) return -1;
+  for (auto& e : it->second) {
+    if (e.name == name) {
+      if (data) *data = e.data;
+      if (output) *output = e.output;
+      return 1;
+    }
+  }
+  return 0;
 }
 
 }  // extern "C"

@@ -40,6 +40,21 @@ std::string ResponseCache::Key(const Request& req) {
   k += DoubleKey(req.prescale);
   k += '/';
   k += DoubleKey(req.postscale);
+  // Per-chip dims are part of the identity: cached entries are rebuilt
+  // from responses (CacheResponses) with chip_dims empty, so a request
+  // that carries a multi-chip dim list must never replay such an entry —
+  // the rebuilt request would publish a wrong per-chip dim table.
+  // Multi-chip-per-process allgathers therefore always take the full
+  // negotiation path; single-chip worlds keep their cache hits (a
+  // single-entry chip list only matches when it equals shape.dim(0),
+  // which is exactly the value the rebuilt entry would publish).
+  if (!(req.chip_dims.size() == 1 &&
+        req.shape.ndim() > 0 && req.chip_dims[0] == req.shape.dim(0))) {
+    for (auto d : req.chip_dims) {
+      k += '/';
+      k += std::to_string(d);
+    }
+  }
   return k;
 }
 

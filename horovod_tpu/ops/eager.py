@@ -652,6 +652,14 @@ class EagerEngine:
                     # nccl_operations.cc:402-523).
                     sizes = tuple(t.shape[0] for t in ts)
                     max0 = max(sizes)
+                    if all(isinstance(t, jax.Array) for t in tensor) and \
+                            len({next(iter(t.devices()))
+                                 for t in ts}) > 1:
+                        # Chained collectives hand back per-chip views on
+                        # different devices; stage on one device so the
+                        # stack below is legal (same as _normalize).
+                        target = self._state.local_devices[0]
+                        ts = [jax.device_put(t, target) for t in ts]
                     padded = jnp.stack([
                         jnp.pad(t, [(0, max0 - t.shape[0])] +
                                 [(0, 0)] * (t.ndim - 1)) for t in ts])

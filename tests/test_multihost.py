@@ -92,13 +92,17 @@ def test_ragged_allgather_multi_chip_cross_process(tmp_path):
     the global pad+gather+slice."""
     script = _PRELUDE + textwrap.dedent("""
         # Chip c contributes (c+1) rows: proc0 chips 1,2 rows; proc1 3,4.
-        xs = [jnp.full((r + 1, 3), float(r), jnp.float32)
-              for r in my_ranks]
-        got = np.asarray(hvd.allgather(xs, name="mh.rag"))
+        # Submitted three times with the same name — training loops repeat
+        # names every step, and a response-cache replay that dropped the
+        # per-chip dims would corrupt every pass after the first.
         expect = np.concatenate(
             [np.full((r + 1, 3), float(r), np.float32) for r in range(4)])
-        assert got.shape == expect.shape, (got.shape, expect.shape)
-        np.testing.assert_allclose(got, expect)
+        for _ in range(3):
+            xs = [jnp.full((r + 1, 3), float(r), jnp.float32)
+                  for r in my_ranks]
+            got = np.asarray(hvd.allgather(xs, name="mh.rag"))
+            assert got.shape == expect.shape, (got.shape, expect.shape)
+            np.testing.assert_allclose(got, expect)
 
         # Mixed: one process ragged, the other equal-dims, same collective.
         if rank == 0:

@@ -48,14 +48,25 @@ def _require_pyspark():
 def run(fn, args=(), kwargs=None, num_proc: Optional[int] = None,
         start_timeout: Optional[int] = None, env=None,
         stdout=None, stderr=None, verbose: int = 1,
-        nics=None, prefix_output_with_timestamp: bool = False):
+        nics=None, prefix_output_with_timestamp: bool = False,
+        use_ssh: bool = False):
     """Run ``fn`` on ``num_proc`` Spark executors (parity:
-    ``spark/runner.py:131``). Each task initializes the collective world
-    before calling ``fn`` and returns its result to the driver."""
+    ``spark/runner.py:131``).
+
+    Default transport is **in-executor** (reference semantics,
+    ``spark/runner.py:40-262``): one long-lived Spark task per rank
+    starts an authenticated task service, the driver sends the pickled
+    fn over it, and fn runs as a subprocess of the executor — its Python
+    env, cwd, and resource limits — with no inter-host ssh anywhere.
+
+    ``use_ssh=True`` keeps the previous behavior (collect executor
+    hostnames, relaunch over ssh from the driver); it requires the
+    driver to have passwordless ssh to every executor host, which many
+    Spark clusters do not allow — the error you get without it is an
+    ssh/launch timeout, not a Spark failure.
+    """
     _require_pyspark()
     import pyspark
-
-    from ..run import run as _local_run
 
     sc = pyspark.SparkContext._active_spark_context
     if sc is None:
@@ -63,17 +74,58 @@ def run(fn, args=(), kwargs=None, num_proc: Optional[int] = None,
     if num_proc is None:
         num_proc = sc.defaultParallelism
 
-    # One task per executor: each discovers its hostname; the driver then
-    # launches the collective job across those hosts through the standard
-    # launcher path (the reference piggybacks mpirun_rsh over Spark RPC,
-    # spark/mpi_run.py; on TPU pods ssh/local exec is the transport).
-    import socket
+    if use_ssh:
+        from ..run import run as _local_run
+        import socket
 
-    hosts = sc.parallelize(range(num_proc), num_proc) \
-        .map(lambda _: socket.gethostname()).collect()
-    counts = {}
-    for h in hosts:
-        counts[h] = counts.get(h, 0) + 1
-    hosts_str = ",".join(f"{h}:{n}" for h, n in sorted(counts.items()))
-    return _local_run(fn, args=args, kwargs=kwargs, np=num_proc,
-                      hosts=hosts_str, env=env, verbose=bool(verbose))
+        hosts = sc.parallelize(range(num_proc), num_proc) \
+            .map(lambda _: socket.gethostname()).collect()
+        counts = {}
+        for h in hosts:
+            counts[h] = counts.get(h, 0) + 1
+        hosts_str = ",".join(f"{h}:{n}" for h, n in sorted(counts.items()))
+        return _local_run(fn, args=args, kwargs=kwargs, np=num_proc,
+                          hosts=hosts_str, env=env, verbose=bool(verbose))
+
+    import threading
+
+    from ..run.common.util import secret
+    from .exec import SparkDriverService, run_via_task_services, task_main
+
+    key = secret.make_secret_key()
+    driver = SparkDriverService(num_proc, key)
+    driver_addresses = driver.addresses()
+    timeout = float(start_timeout or 120)
+    exec_timeout = 3600.0
+    # The task services must outlive the whole round: registration + exec
+    # + collection margin (a service dying mid-train turns the driver's
+    # result polls into ConnectionErrors).
+    task_lifetime = timeout + exec_timeout + 60
+
+    def _spark_task(index, _iterator):
+        yield task_main(index, driver_addresses, key,
+                        timeout=task_lifetime)
+
+    collect_result = {}
+
+    def _collect():
+        try:
+            collect_result["states"] = sc.parallelize(
+                range(num_proc), num_proc) \
+                .mapPartitionsWithIndex(_spark_task).collect()
+        except Exception as e:  # surfaced after the exec round
+            collect_result["error"] = e
+
+    spark_thread = threading.Thread(target=_collect, daemon=True)
+    spark_thread.start()
+    try:
+        driver.wait_for_initial_registration(timeout)
+        results = run_via_task_services(
+            driver, fn, args, kwargs, num_proc, key,
+            exec_timeout=exec_timeout, env=env)
+    finally:
+        spark_thread.join(timeout=30)
+        driver.shutdown()
+    if "error" in collect_result:
+        raise collect_result["error"]
+    return results

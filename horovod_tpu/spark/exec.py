@@ -1,0 +1,329 @@
+"""In-executor execution over the HMAC task services (parity:
+``horovod/spark/runner.py:40-262`` + ``spark/driver/mpirun_rsh.py`` +
+``spark/task/mpirun_exec_fn.py``).
+
+The reference runs the user fn *inside* the Spark executors: each task
+starts a service, registers with the driver, and the launcher reaches the
+executors through Spark's own connectivity (mpirun rsh piggybacked on the
+task services) — no inter-host ssh, and fn sees the executor's exact
+Python env, working directory, and resource cgroup. This module is the
+TPU-native equivalent on this repo's authenticated pickle-over-TCP
+services (``run/driver/driver_service.py``, ``run/common/util/network.py``):
+
+driver                                  executor (one task per rank)
+------                                  ----------------------------
+SparkDriverService                       SparkTaskService starts
+  <- RegisterTaskRequest(index, addrs, hostname)
+probe routable addrs                     ...
+  -> FreePortRequest (task 0)            picks the controller base port
+  -> ExecuteRequest(env, payload)        subprocess runs fn (task_exec)
+  -> ResultRequest (poll)                state: running -> done/failed
+  -> ShutdownRequest                     service exits, Spark task returns
+
+Everything here is pyspark-independent so the full path is testable with
+a plain process pool standing in for the executors.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..run.common.util import network
+from ..run.common.util.hosts import SlotInfo
+from ..run.driver.driver_service import (
+    HorovodRunDriverService, RegisterTaskRequest, probe_routable_addresses)
+from ..run.launch import slot_env
+
+try:  # cloudpickle handles closures/lambdas; plain pickle is the last
+    import cloudpickle as _pickle  # noqa: F401
+except ImportError:
+    try:
+        from pyspark import cloudpickle as _pickle  # noqa: F401
+    except ImportError:  # module-level fns only
+        import pickle as _pickle
+
+
+# -- protocol ----------------------------------------------------------------
+
+
+class RegisterTaskHostnameRequest:
+    def __init__(self, index: int, hostname: str):
+        self.index = index
+        self.hostname = hostname
+
+
+class FreePortRequest:
+    pass
+
+
+class FreePortResponse:
+    def __init__(self, base_port: int):
+        self.base_port = base_port
+
+
+class ExecuteRequest:
+    def __init__(self, env: Dict[str, str], payload: bytes):
+        self.env = env          # HOROVOD_* topology block
+        self.payload = payload  # pickled (fn, args, kwargs)
+
+
+class ResultRequest:
+    pass
+
+
+class ResultResponse:
+    def __init__(self, state: str, result: Optional[bytes], error: str):
+        self.state = state      # idle | running | done | failed
+        self.result = result
+        self.error = error
+
+
+class ShutdownRequest:
+    pass
+
+
+# -- driver side -------------------------------------------------------------
+
+
+class SparkDriverService(HorovodRunDriverService):
+    """Driver service that also records each task's hostname (needed for
+    LOCAL/CROSS topology when several executors share a host)."""
+
+    NAME = "horovod spark driver service"
+
+    def __init__(self, num_tasks: int, key: bytes, nics=None):
+        super().__init__(num_tasks, key, nics)
+        self.hostnames: Dict[int, str] = {}
+
+    def _handle(self, req, client_address):
+        if isinstance(req, RegisterTaskHostnameRequest):
+            self.hostnames[req.index] = req.hostname
+            return network.AckResponse()
+        return super()._handle(req, client_address)
+
+
+# -- task (executor) side ----------------------------------------------------
+
+
+class SparkTaskService(network.BasicService):
+    """Runs inside one Spark executor; executes fn as a subprocess of the
+    executor (the reference's mpirun_exec_fn role) so fn inherits the
+    executor's env/cwd/container."""
+
+    NAME_FMT = "horovod spark task service #%d"
+
+    def __init__(self, index: int, key: bytes, nics=None):
+        super().__init__(self.NAME_FMT % index, key, nics)
+        self.index = index
+        self._state = "idle"
+        self._result: Optional[bytes] = None
+        self._error = ""
+        self._shutdown_ev = threading.Event()
+
+    def _handle(self, req, client_address):
+        if isinstance(req, FreePortRequest):
+            return FreePortResponse(_free_port_pair())
+        if isinstance(req, ExecuteRequest):
+            if self._state == "running":
+                return ResultResponse("running", None,
+                                      "already executing")
+            self._state = "running"
+            threading.Thread(target=self._exec, args=(req,),
+                             daemon=True).start()
+            return network.AckResponse()
+        if isinstance(req, ResultRequest):
+            return ResultResponse(self._state, self._result, self._error)
+        if isinstance(req, ShutdownRequest):
+            self._shutdown_ev.set()
+            return network.AckResponse()
+        return super()._handle(req, client_address)
+
+    def _exec(self, req: ExecuteRequest):
+        try:
+            with tempfile.NamedTemporaryFile(
+                    suffix=".hvdtask", delete=False) as f:
+                f.write(req.payload)
+                payload_path = f.name
+            env = dict(os.environ)
+            env.update(req.env)
+            proc = subprocess.run(
+                [sys.executable, "-m", "horovod_tpu.spark.task_exec",
+                 payload_path],
+                env=env, capture_output=True, text=True)
+            out_path = payload_path + ".out"
+            if proc.returncode == 0 and os.path.exists(out_path):
+                with open(out_path, "rb") as f:
+                    self._result = f.read()
+                self._state = "done"
+            else:
+                tail = (proc.stderr or "").strip().splitlines()[-20:]
+                self._error = (f"task fn exited rc={proc.returncode}: " +
+                               "\n".join(tail))
+                self._state = "failed"
+            for p in (payload_path, out_path):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+        except Exception as e:
+            self._error = str(e)
+            self._state = "failed"
+
+    def wait_for_shutdown(self, timeout: Optional[float] = None) -> bool:
+        return self._shutdown_ev.wait(timeout)
+
+
+def task_main(index: int, driver_addresses: List[Tuple[str, int]],
+              key: bytes, timeout: Optional[float] = None):
+    """The body of one Spark task: start the service, register, serve
+    until the driver says shutdown (or ``timeout`` — pass the driver's
+    full registration+exec budget; the service MUST outlive the exec
+    round or the driver's result polls hit a closed socket mid-train).
+    Returns the task's final state."""
+    service = SparkTaskService(index, key)
+    try:
+        client = network.BasicClient(SparkDriverService.NAME,
+                                     driver_addresses, key)
+        client._request(RegisterTaskRequest(index, service.addresses()))
+        client._request(
+            RegisterTaskHostnameRequest(index, socket.gethostname()))
+        service.wait_for_shutdown(timeout)
+        return service._state
+    finally:
+        service.shutdown()
+
+
+# -- orchestration (driver) --------------------------------------------------
+
+
+def run_via_task_services(driver: SparkDriverService, fn, args, kwargs,
+                          num_proc: int, key: bytes,
+                          exec_timeout: float = 3600.0,
+                          env: Optional[Dict[str, str]] = None
+                          ) -> List[Any]:
+    """The full register -> exec -> collect round. ``driver`` must already
+    have every task registered (``wait_for_initial_registration``)."""
+    routable: Dict[int, List[Tuple[str, int]]] = {}
+    for i in range(num_proc):
+        addrs = driver.task_addresses_for_driver(i)
+        if not addrs:
+            raise RuntimeError(f"task {i} never registered")
+        ok = probe_routable_addresses(
+            addrs, SparkTaskService.NAME_FMT % i, key)
+        if not ok:
+            raise RuntimeError(
+                f"task {i} registered but none of its addresses "
+                f"{addrs} are routable from the driver")
+        routable[i] = ok
+
+    clients = {
+        i: network.BasicClient(SparkTaskService.NAME_FMT % i, routable[i],
+                               key)
+        for i in range(num_proc)
+    }
+
+    # Topology: tasks grouped by executor hostname, ranks in task order
+    # (the reference's get_host_assignments over executor hosts).
+    hostnames = {i: driver.hostnames.get(i, f"task{i}")
+                 for i in range(num_proc)}
+    by_host: Dict[str, List[int]] = {}
+    for i in range(num_proc):
+        by_host.setdefault(hostnames[i], []).append(i)
+    cross_size = len(by_host)
+    cross_of = {h: c for c, h in enumerate(sorted(by_host))}
+
+    # Rank 0's executor picks the controller base port (it must be free
+    # *there*, not on the driver).
+    base_port = clients[0]._request(FreePortRequest()).base_port
+    # Controller address: other EXECUTORS must reach it, so loopback (a
+    # driver co-located with task 0 probes its own 127.0.0.1 as routable)
+    # only qualifies when the whole world shares one host.
+    non_loop = [a for a, _ in routable[0] if a != "127.0.0.1"]
+    if non_loop:
+        controller_addr = non_loop[0]
+    elif len(set(hostnames.values())) <= 1:
+        controller_addr = routable[0][0][0]
+    else:
+        raise RuntimeError(
+            f"task 0 on {hostnames[0]} advertised no non-loopback "
+            f"address reachable from the driver, but the job spans "
+            f"{len(set(hostnames.values()))} hosts — other executors "
+            f"cannot reach its controller")
+
+    payload = _pickle.dumps((fn, tuple(args), dict(kwargs or {})))
+    for i in range(num_proc):
+        h = hostnames[i]
+        slot = SlotInfo(
+            hostname=h, rank=i, local_rank=by_host[h].index(i),
+            cross_rank=cross_of[h], size=num_proc,
+            local_size=len(by_host[h]), cross_size=cross_size)
+        block = slot_env(slot, controller_addr, base_port,
+                         controller_addr, base_port, base_env={})
+        if env:
+            block.update(env)
+        clients[i]._request(ExecuteRequest(block, payload))
+
+    deadline = time.monotonic() + exec_timeout
+    results: Dict[int, Any] = {}
+    failed: Dict[int, str] = {}
+
+    def _shutdown_all():
+        for i in range(num_proc):
+            try:
+                clients[i]._request(ShutdownRequest())
+            except (ConnectionError, OSError):
+                pass
+
+    while len(results) < num_proc:
+        for i in range(num_proc):
+            if i in results or i in failed:
+                continue
+            r = clients[i]._request(ResultRequest())
+            if r.state == "done":
+                results[i] = _pickle.loads(r.result)
+            elif r.state == "failed":
+                failed[i] = r.error
+        if failed:
+            # Fail fast: peers are likely blocked in hvd.init waiting for
+            # the dead rank; waiting out exec_timeout would bury the root
+            # cause for an hour.
+            _shutdown_all()
+            raise RuntimeError(
+                "spark tasks failed: " +
+                "; ".join(f"rank {i}: {e}"
+                          for i, e in sorted(failed.items())))
+        if len(results) < num_proc and time.monotonic() > deadline:
+            _shutdown_all()
+            raise TimeoutError(
+                f"spark tasks still running after {exec_timeout}s "
+                f"(ranks {sorted(set(range(num_proc)) - set(results))})")
+        time.sleep(0.5)
+
+    _shutdown_all()
+    return [results[i] for i in range(num_proc)]
+
+
+def _free_port_pair() -> int:
+    """A base port such that base AND base+1 are free (gRPC coordination
+    takes base, the native controller base+1 — config.py convention)."""
+    for _ in range(64):
+        s1 = socket.socket()
+        s1.bind(("0.0.0.0", 0))
+        base = s1.getsockname()[1]
+        s2 = socket.socket()
+        try:
+            s2.bind(("0.0.0.0", base + 1))
+            return base
+        except OSError:
+            continue
+        finally:
+            s2.close()
+            s1.close()
+    raise RuntimeError("no free port pair found")

@@ -1,0 +1,33 @@
+"""Subprocess bootstrap for in-executor fn execution (parity:
+``horovod/spark/task/mpirun_exec_fn.py:1-30``).
+
+Launched by ``SparkTaskService`` inside a Spark executor with the
+HOROVOD_* topology block already in the environment: loads the pickled
+(fn, args, kwargs) payload, runs fn, and writes the pickled result next
+to the payload. hvd.init() inside fn joins the world exactly as an
+ssh-launched worker would — the transport to get *here* was Spark's own
+(task service over TCP), not ssh.
+"""
+
+import sys
+
+try:
+    import cloudpickle as _pickle
+except ImportError:
+    try:
+        from pyspark import cloudpickle as _pickle
+    except ImportError:
+        import pickle as _pickle
+
+
+def main(payload_path: str) -> int:
+    with open(payload_path, "rb") as f:
+        fn, args, kwargs = _pickle.loads(f.read())
+    result = fn(*args, **kwargs)
+    with open(payload_path + ".out", "wb") as f:
+        f.write(_pickle.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1]))

@@ -1,12 +1,15 @@
 """horovod_tpu.spark.run dispatch (parity: reference spark/runner.py:131 +
 SURVEY §4 Pattern 2 mock-based launcher testing): a fake pyspark supplies
-the executor-discovery surface; the collective job itself runs for real
-through the local launcher."""
+the executor surface — ``mapPartitionsWithIndex`` runs each partition on
+its own thread, like executors do — and the collective job itself runs
+for real: the user fn executes in a subprocess per rank via the task
+services (``spark/exec.py``), joins the native controller world, and
+allreduces across ranks."""
 
 import sys
+import threading
 import types
 
-import numpy as np
 import pytest
 
 
@@ -16,6 +19,29 @@ class _FakeRDD:
 
     def map(self, f):
         return _FakeRDD([f(x) for x in self._items])
+
+    def mapPartitionsWithIndex(self, f):
+        # One element per partition; each partition on its own thread —
+        # the concurrency shape of real executors, which the in-executor
+        # transport depends on (tasks block serving until shutdown).
+        results = [None] * len(self._items)
+        errors = []
+
+        def _one(i, x):
+            try:
+                results[i] = list(f(i, iter([x])))
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=_one, args=(i, x), daemon=True)
+                   for i, x in enumerate(self._items)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        if errors:
+            raise errors[0]
+        return _FakeRDD([r for part in results if part for r in part])
 
     def collect(self):
         return list(self._items)
@@ -41,11 +67,9 @@ def fake_pyspark(monkeypatch):
     _FakeSparkContext._active_spark_context = None
 
 
-def test_spark_run_executes_on_discovered_hosts(fake_pyspark):
-    import horovod_tpu.spark as spark
-
-    # Defined inline so cloudpickle serializes it by value (worker
-    # processes don't have this test module importable).
+def _make_train():
+    # Nested so cloudpickle serializes it by value — the executor
+    # subprocesses can't import this test module.
     def _train():
         import os
 
@@ -61,11 +85,41 @@ def test_spark_run_executes_on_discovered_hosts(fake_pyspark):
         hvd.shutdown()
         return r
 
-    results = spark.run(_train, num_proc=2, verbose=0)
+    return _train
+
+
+def test_spark_run_in_executor(fake_pyspark):
+    """The full register -> exec -> collect path: fn runs in a subprocess
+    per rank (in-executor semantics), the world forms, results return in
+    rank order."""
+    import horovod_tpu.spark as spark
+
+    results = spark.run(_make_train(), num_proc=2, verbose=0)
     assert len(results) == 2
-    assert sorted(r[0] for r in results) == [0, 1]
+    assert [r[0] for r in results] == [0, 1]  # rank order
     assert all(r[1] == 2 for r in results)
     assert all(r[2] == 3.0 for r in results)  # 1+2 summed across ranks
+
+
+def test_spark_run_ssh_fallback(fake_pyspark):
+    """use_ssh=True keeps the hostname-collect + local-launcher path."""
+    import horovod_tpu.spark as spark
+
+    results = spark.run(_make_train(), num_proc=2, verbose=0,
+                        use_ssh=True)
+    assert len(results) == 2
+    assert sorted(r[0] for r in results) == [0, 1]
+    assert all(r[2] == 3.0 for r in results)
+
+
+def test_spark_run_reports_task_failure(fake_pyspark):
+    import horovod_tpu.spark as spark
+
+    def _boom():
+        raise RuntimeError("exploded in executor")
+
+    with pytest.raises(RuntimeError, match="exploded in executor"):
+        spark.run(_boom, num_proc=2, verbose=0)
 
 
 def test_spark_run_requires_active_context(fake_pyspark):
@@ -82,3 +136,39 @@ def test_spark_run_without_pyspark(monkeypatch):
     monkeypatch.setitem(sys.modules, "pyspark", None)
     with pytest.raises(ImportError, match="requires pyspark"):
         spark.run(lambda: None)
+
+
+def test_exec_round_without_spark():
+    """spark/exec.py is pyspark-independent: a plain process pool stands
+    in for the executors and the full protocol round runs for real."""
+    import multiprocessing as mp
+
+    from horovod_tpu.run.common.util import secret
+    from horovod_tpu.spark.exec import (
+        SparkDriverService, run_via_task_services, task_main)
+
+    key = secret.make_secret_key()
+    driver = SparkDriverService(2, key)
+    ctx = mp.get_context("spawn")
+    procs = [ctx.Process(target=task_main,
+                         args=(i, driver.addresses(), key))
+             for i in range(2)]
+    for p in procs:
+        p.start()
+    try:
+        driver.wait_for_initial_registration(60)
+
+        def _double_with_env(x):
+            import os
+
+            return (x * 2, "HOROVOD_RANK" in os.environ)
+
+        results = run_via_task_services(driver, _double_with_env, (21,),
+                                        {}, 2, key)
+        assert results == [(42, True), (42, True)]
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+        driver.shutdown()

@@ -45,6 +45,7 @@ def test_adasum_small_model_example():
     assert "Adasum:" in out and "Average:" in out
 
 
+@pytest.mark.full
 def test_keras_spark_mnist_example(tmp_path):
     pytest.importorskip("keras")
     out = _run_example("keras_spark_mnist.py", "--epochs", "1",
@@ -66,6 +67,7 @@ def test_elastic_pytorch_example_single():
     assert "elastic training finished" in out
 
 
+@pytest.mark.full
 def test_keras_mnist_example(tmp_path):
     pytest.importorskip("keras")
     out = _run_example("keras_mnist.py", "--epochs", "1",
@@ -73,6 +75,7 @@ def test_keras_mnist_example(tmp_path):
     assert "accuracy=" in out
 
 
+@pytest.mark.full
 def test_keras_mnist_advanced_example():
     pytest.importorskip("keras")
     out = _run_example("keras_mnist_advanced.py", "--epochs", "2",
@@ -91,6 +94,7 @@ def test_pytorch_imagenet_resnet50_tiny(tmp_path):
     assert (tmp_path / "ck-1.pt").exists()
 
 
+@pytest.mark.full
 def test_keras_imagenet_resnet50_tiny(tmp_path):
     pytest.importorskip("keras")
     out = _run_example(
@@ -114,6 +118,27 @@ def test_mxnet_mnist_example_gates_cleanly():
     assert "mxnet is not installed" in proc.stderr
 
 
+def test_mxnet_imagenet_resnet50_gates_cleanly():
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "examples", "mxnet_imagenet_resnet50.py")],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+    assert proc.returncode == 1
+    assert "mxnet is not installed" in proc.stderr
+
+
+def test_keras_rossmann_estimator_example(tmp_path):
+    pytest.importorskip("keras")
+    pytest.importorskip("pandas")
+    out = _run_example("keras_spark_rossmann_estimator.py",
+                       "--epochs", "1", "--num-proc", "2",
+                       "--work-dir", str(tmp_path), timeout=420)
+    assert "validation RMSPE" in out
+
+
 def test_elastic_pytorch_mnist_example_single():
     pytest.importorskip("torch")
     out = _run_example("elastic/pytorch_mnist_elastic.py", "--epochs", "1",
@@ -128,6 +153,7 @@ def test_elastic_tf2_synthetic_example_single():
     assert "img/sec per worker" in out
 
 
+@pytest.mark.full
 def test_scaling_bench_protocol_runs():
     out = _run_example(
         "scaling_bench.py", "--cpu-devices", "4", "--devices", "1", "2",

@@ -80,9 +80,14 @@ class ResNet(nn.Module):
     def __call__(self, x, train: bool = True):
         conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype,
                                  param_dtype=jnp.float32, padding="SAME")
+        # BN statistics are computed in fp32 regardless of ``dtype`` (flax
+        # promotes the reductions) and the running stats live in fp32
+        # (param_dtype); the OUTPUT stays in the model dtype so the
+        # act+residual elementwise chains between convs run at bf16 HBM
+        # width instead of fp32 — on v5e this path is bandwidth-bound.
         norm = functools.partial(nn.BatchNorm, use_running_average=not train,
                                  momentum=0.9, epsilon=1e-5,
-                                 dtype=jnp.float32, param_dtype=jnp.float32)
+                                 dtype=self.dtype, param_dtype=jnp.float32)
         x = x.astype(self.dtype)
         x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
         x = norm(name="bn_init")(x)

@@ -58,6 +58,10 @@ def run(fn, args=(), kwargs=None, num_proc: Optional[int] = None,
     starts an authenticated task service, the driver sends the pickled
     fn over it, and fn runs as a subprocess of the executor — its Python
     env, cwd, and resource limits — with no inter-host ssh anywhere.
+    fn's output streams into the executor's logs (where Spark surfaces
+    worker output); the ``stdout``/``stderr`` capture params apply only
+    to the ssh path. fn runs unbounded — ``start_timeout`` covers
+    registration, not training.
 
     ``use_ssh=True`` keeps the previous behavior (collect executor
     hostnames, relaunch over ssh from the driver); it requires the
@@ -93,18 +97,14 @@ def run(fn, args=(), kwargs=None, num_proc: Optional[int] = None,
     from .exec import SparkDriverService, run_via_task_services, task_main
 
     key = secret.make_secret_key()
-    driver = SparkDriverService(num_proc, key)
+    driver = SparkDriverService(num_proc, key, nics=nics)
     driver_addresses = driver.addresses()
     timeout = float(start_timeout or 120)
-    exec_timeout = 3600.0
-    # The task services must outlive the whole round: registration + exec
-    # + collection margin (a service dying mid-train turns the driver's
-    # result polls into ConnectionErrors).
-    task_lifetime = timeout + exec_timeout + 60
 
     def _spark_task(index, _iterator):
-        yield task_main(index, driver_addresses, key,
-                        timeout=task_lifetime)
+        # No lifetime cap: training runs unbounded, and every driver exit
+        # path (success, failure, probe error) sends ShutdownRequest.
+        yield task_main(index, driver_addresses, key, nics=nics)
 
     collect_result = {}
 
@@ -121,8 +121,7 @@ def run(fn, args=(), kwargs=None, num_proc: Optional[int] = None,
     try:
         driver.wait_for_initial_registration(timeout)
         results = run_via_task_services(
-            driver, fn, args, kwargs, num_proc, key,
-            exec_timeout=exec_timeout, env=env)
+            driver, fn, args, kwargs, num_proc, key, env=env)
     finally:
         spark_thread.join(timeout=30)
         driver.shutdown()

@@ -153,18 +153,30 @@ class SparkTaskService(network.BasicService):
                 payload_path = f.name
             env = dict(os.environ)
             env.update(req.env)
-            proc = subprocess.run(
+            # fn's output streams into the executor's own stdout/stderr —
+            # Spark surfaces those as the executor logs, exactly where the
+            # reference's in-executor fn logs land. Only a bounded stderr
+            # tail is retained (for the driver's error report); capturing
+            # the full output in memory would grow unbounded over a
+            # multi-hour fn.
+            import collections
+
+            tail: "collections.deque" = collections.deque(maxlen=20)
+            proc = subprocess.Popen(
                 [sys.executable, "-m", "horovod_tpu.spark.task_exec",
                  payload_path],
-                env=env, capture_output=True, text=True)
+                env=env, stdout=None, stderr=subprocess.PIPE, text=True)
+            for line in proc.stderr:
+                sys.stderr.write(line)
+                tail.append(line.rstrip("\n"))
+            rc = proc.wait()
             out_path = payload_path + ".out"
-            if proc.returncode == 0 and os.path.exists(out_path):
+            if rc == 0 and os.path.exists(out_path):
                 with open(out_path, "rb") as f:
                     self._result = f.read()
                 self._state = "done"
             else:
-                tail = (proc.stderr or "").strip().splitlines()[-20:]
-                self._error = (f"task fn exited rc={proc.returncode}: " +
+                self._error = (f"task fn exited rc={rc}: " +
                                "\n".join(tail))
                 self._state = "failed"
             for p in (payload_path, out_path):
@@ -181,13 +193,13 @@ class SparkTaskService(network.BasicService):
 
 
 def task_main(index: int, driver_addresses: List[Tuple[str, int]],
-              key: bytes, timeout: Optional[float] = None):
+              key: bytes, timeout: Optional[float] = None, nics=None):
     """The body of one Spark task: start the service, register, serve
     until the driver says shutdown (or ``timeout`` — pass the driver's
     full registration+exec budget; the service MUST outlive the exec
     round or the driver's result polls hit a closed socket mid-train).
     Returns the task's final state."""
-    service = SparkTaskService(index, key)
+    service = SparkTaskService(index, key, nics)
     try:
         client = network.BasicClient(SparkDriverService.NAME,
                                      driver_addresses, key)
@@ -205,23 +217,43 @@ def task_main(index: int, driver_addresses: List[Tuple[str, int]],
 
 def run_via_task_services(driver: SparkDriverService, fn, args, kwargs,
                           num_proc: int, key: bytes,
-                          exec_timeout: float = 3600.0,
+                          exec_timeout: Optional[float] = None,
                           env: Optional[Dict[str, str]] = None
                           ) -> List[Any]:
     """The full register -> exec -> collect round. ``driver`` must already
-    have every task registered (``wait_for_initial_registration``)."""
+    have every task registered (``wait_for_initial_registration``).
+    ``exec_timeout=None`` (default) lets fn run unbounded — training jobs
+    routinely exceed any fixed cap; the old ssh path had none either.
+    Every exit (success, failure, probe error, timeout) shuts the task
+    services down so executors never idle out their full lifetime."""
+    # Probe every task's advertised addresses concurrently: each dead
+    # address costs a full connect timeout, and serial probing would add
+    # O(num_proc x dead_addrs x timeout) to every launch.
     routable: Dict[int, List[Tuple[str, int]]] = {}
-    for i in range(num_proc):
+    errors: Dict[int, str] = {}
+
+    def _probe(i):
         addrs = driver.task_addresses_for_driver(i)
         if not addrs:
-            raise RuntimeError(f"task {i} never registered")
+            errors[i] = f"task {i} never registered"
+            return
         ok = probe_routable_addresses(
             addrs, SparkTaskService.NAME_FMT % i, key)
         if not ok:
-            raise RuntimeError(
-                f"task {i} registered but none of its addresses "
-                f"{addrs} are routable from the driver")
+            errors[i] = (f"task {i} registered but none of its addresses "
+                         f"{addrs} are routable from the driver")
+            return
         routable[i] = ok
+
+    probers = [threading.Thread(target=_probe, args=(i,), daemon=True)
+               for i in range(num_proc)]
+    for t in probers:
+        t.start()
+    for t in probers:
+        t.join()
+    if errors:
+        _best_effort_shutdown(routable, key)
+        raise RuntimeError("; ".join(errors[i] for i in sorted(errors)))
 
     clients = {
         i: network.BasicClient(SparkTaskService.NAME_FMT % i, routable[i],
@@ -229,10 +261,46 @@ def run_via_task_services(driver: SparkDriverService, fn, args, kwargs,
         for i in range(num_proc)
     }
 
+    def _shutdown_all():
+        for i in range(num_proc):
+            try:
+                clients[i]._request(ShutdownRequest())
+            except (ConnectionError, OSError):
+                pass
+
+    try:
+        return _exec_round(driver, clients, routable, fn, args, kwargs,
+                           num_proc, exec_timeout, env)
+    finally:
+        # Idempotent: tasks treat shutdown-after-shutdown as a no-op.
+        _shutdown_all()
+
+
+def _best_effort_shutdown(routable, key):
+    for i, addrs in routable.items():
+        try:
+            network.BasicClient(SparkTaskService.NAME_FMT % i, addrs,
+                                key)._request(ShutdownRequest())
+        except (ConnectionError, OSError):
+            pass
+
+
+def _exec_round(driver, clients, routable, fn, args, kwargs, num_proc,
+                exec_timeout, env):
     # Topology: tasks grouped by executor hostname, ranks in task order
-    # (the reference's get_host_assignments over executor hosts).
-    hostnames = {i: driver.hostnames.get(i, f"task{i}")
-                 for i in range(num_proc)}
+    # (the reference's get_host_assignments over executor hosts). The
+    # hostname arrives in a second registration request, so wait for all
+    # of them — fabricating placeholders would silently wreck
+    # local/cross ranks for late registrants.
+    deadline = time.monotonic() + 30
+    while len(driver.hostnames) < num_proc:
+        if time.monotonic() > deadline:
+            missing = sorted(set(range(num_proc)) - set(driver.hostnames))
+            raise RuntimeError(
+                f"tasks {missing} registered addresses but never their "
+                f"hostname")
+        time.sleep(0.05)
+    hostnames = {i: driver.hostnames[i] for i in range(num_proc)}
     by_host: Dict[str, List[int]] = {}
     for i in range(num_proc):
         by_host.setdefault(hostnames[i], []).append(i)
@@ -270,16 +338,10 @@ def run_via_task_services(driver: SparkDriverService, fn, args, kwargs,
             block.update(env)
         clients[i]._request(ExecuteRequest(block, payload))
 
-    deadline = time.monotonic() + exec_timeout
+    deadline = (time.monotonic() + exec_timeout
+                if exec_timeout is not None else None)
     results: Dict[int, Any] = {}
     failed: Dict[int, str] = {}
-
-    def _shutdown_all():
-        for i in range(num_proc):
-            try:
-                clients[i]._request(ShutdownRequest())
-            except (ConnectionError, OSError):
-                pass
 
     while len(results) < num_proc:
         for i in range(num_proc):
@@ -292,21 +354,19 @@ def run_via_task_services(driver: SparkDriverService, fn, args, kwargs,
                 failed[i] = r.error
         if failed:
             # Fail fast: peers are likely blocked in hvd.init waiting for
-            # the dead rank; waiting out exec_timeout would bury the root
-            # cause for an hour.
-            _shutdown_all()
+            # the dead rank; waiting out any timeout would bury the root
+            # cause (the caller's finally shuts every task down).
             raise RuntimeError(
                 "spark tasks failed: " +
                 "; ".join(f"rank {i}: {e}"
                           for i, e in sorted(failed.items())))
-        if len(results) < num_proc and time.monotonic() > deadline:
-            _shutdown_all()
+        if len(results) < num_proc and deadline is not None and \
+                time.monotonic() > deadline:
             raise TimeoutError(
                 f"spark tasks still running after {exec_timeout}s "
                 f"(ranks {sorted(set(range(num_proc)) - set(results))})")
         time.sleep(0.5)
 
-    _shutdown_all()
     return [results[i] for i in range(num_proc)]
 
 

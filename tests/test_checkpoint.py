@@ -37,6 +37,7 @@ def _leaves_equal(a, b):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
+@pytest.mark.full
 def test_save_restore_replicated_state(hvd_world, tmp_path):
     hvd = hvd_world
     mesh = hvd.mesh()
@@ -65,6 +66,7 @@ def test_save_restore_replicated_state(hvd_world, tmp_path):
     mgr.close()
 
 
+@pytest.mark.full
 def test_save_restore_zero_sharded_state(hvd_world, tmp_path):
     """ZeRO states round-trip with their shardings intact: the fp32
     master shard and vector optimizer leaves come back sharded over the

@@ -95,6 +95,7 @@ _WORKER = textwrap.dedent("""
 """)
 
 
+@pytest.mark.full
 def test_dtype_op_matrix_two_process(tmp_path):
     from proc_harness import run_world
 

@@ -139,6 +139,7 @@ def _read_log(path):
 
 
 
+@pytest.mark.full
 def test_elastic_scale_up_then_down(tmp_path):
     """Real host churn through a live elastic run (reference
     test/integration/elastic_common.py:33-60): the discovery output grows
@@ -204,6 +205,7 @@ def test_elastic_scale_up_then_down(tmp_path):
 
 
 
+@pytest.mark.full
 def test_elastic_worker_failure_recovery(tmp_path):
     """A worker dies mid-training: survivors hit HorovodInternalError,
     restore the last commit, and re-rendezvous; the host returns after the

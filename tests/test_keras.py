@@ -178,6 +178,7 @@ print(f"METRICAVG_{rank}_OK")
 """
 
 
+@pytest.mark.full
 def test_metric_average_callback_two_process(tmp_path):
     """The size>1 branch of MetricAverageCallback runs a real host-plane
     allreduce across 2 processes (it calls the backend's _np_allreduce —

@@ -13,6 +13,8 @@ programs that span ICI+DCN on real hardware.
 
 import textwrap
 
+import pytest
+
 from proc_harness import run_world
 
 # The TPU plugin's sitecustomize activation runs at interpreter startup —
@@ -124,6 +126,7 @@ def test_ragged_allgather_multi_chip_cross_process(tmp_path):
     run_world(tmp_path, script, "MHRAGGED", drop_env=_DROP_ENV)
 
 
+@pytest.mark.full
 def test_train_step_and_zero_cross_process(tmp_path):
     """One DP train step and one ZeRO-1 step through the global mesh."""
     script = _PRELUDE + textwrap.dedent("""

@@ -145,6 +145,7 @@ _MX_WORKER = textwrap.dedent("""
 """)
 
 
+@pytest.mark.full
 def test_mxnet_two_process_ring(tmp_path):
     """The binding's collectives ride the real native 2-process ring —
     the reference's mpirun-launched Pattern-1 test shape."""

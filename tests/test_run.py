@@ -254,6 +254,7 @@ def test_packaging_console_entries_resolve():
     assert __version__
 
 
+@pytest.mark.full
 def test_output_filename_redirects_worker_output(tmp_path):
     """--output-filename <dir> writes each rank's output to
     <dir>/rank.<N>/stdout|stderr (reference horovodrun semantics) instead

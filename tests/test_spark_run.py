@@ -101,6 +101,7 @@ def test_spark_run_in_executor(fake_pyspark):
     assert all(r[2] == 3.0 for r in results)  # 1+2 summed across ranks
 
 
+@pytest.mark.full
 def test_spark_run_ssh_fallback(fake_pyspark):
     """use_ssh=True keeps the hostname-collect + local-launcher path."""
     import horovod_tpu.spark as spark

@@ -15,6 +15,9 @@ import pytest
 
 pytest.importorskip("torch")
 
+# Randomized soak: full-profile depth by definition.
+pytestmark = pytest.mark.full
+
 _WORKER = textwrap.dedent("""
     import os, random, sys
     import numpy as np

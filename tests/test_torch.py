@@ -310,7 +310,8 @@ _WORKER = textwrap.dedent("""
 """)
 
 
-@pytest.mark.parametrize("size", [2, 4])
+@pytest.mark.parametrize(
+    "size", [2, pytest.param(4, marks=pytest.mark.full)])
 def test_torch_multiprocess(size, tmp_path):
     port = _free_port()
     script = tmp_path / "torch_worker.py"

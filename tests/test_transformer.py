@@ -74,6 +74,7 @@ def test_grads_match_dense(sizes):
             err_msg=f"grad mismatch for {key} with mesh {sizes}")
 
 
+@pytest.mark.full
 def test_moe_grads_match_dense():
     # Validates the differentiable path through routing, all_to_all
     # dispatch/return, and gate combination (ample capacity: no drops).

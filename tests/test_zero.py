@@ -33,6 +33,7 @@ def _batch(mesh, n=16, hw=32, classes=10):
     return shard_batch((jnp.asarray(imgs), jnp.asarray(lbls)), mesh)
 
 
+@pytest.mark.full
 def test_zero_matches_replicated_optimizer(setup):
     hvd = setup
     mesh = hvd.mesh()

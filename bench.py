@@ -211,6 +211,9 @@ def supervise(argv):
 
 def worker(argv):
     args = _build_parser().parse_args(argv)
+    # At least one timed iteration: the loop variable feeds the
+    # completion fence and the throughput numerator.
+    args.num_iters = max(1, args.num_iters)
 
     import jax
     import jax.numpy as jnp
@@ -250,7 +253,8 @@ def worker(argv):
     # platforms where block_until_ready returns early.
     for _ in range(args.num_warmup):
         state, loss = step(state, images, labels)
-    float(np.asarray(loss))
+    if args.num_warmup > 0:
+        float(np.asarray(loss))
 
     t0 = time.perf_counter()
     for _ in range(args.num_iters):

@@ -31,6 +31,78 @@ from .common.util import config_parser, hosts as _hosts
 from .http.http_server import RendezvousServer
 
 
+def check_build(verbose: bool = False) -> str:
+    """Capability report (parity: ``horovodrun --check-build``,
+    reference ``runner.py:112-146``) — what this installation can
+    actually drive, probed live rather than baked at compile time.
+    Every probe is guarded: a diagnostic command must never crash on a
+    corrupt .so or hang on a wedged accelerator tunnel."""
+    def mark(flag):
+        return "X" if flag else " "
+
+    def importable(mod):
+        try:
+            __import__(mod)
+            return True
+        except Exception:
+            return False
+
+    try:
+        from ..common import native as _native
+
+        native_ok = _native.NativeCore().available
+    except Exception:
+        native_ok = False
+    try:
+        import jax  # noqa: F401
+
+        xla_ok = True
+    except Exception:
+        xla_ok = False
+    platform = None
+    if verbose and xla_ok:
+        # Backend init can hang indefinitely on a wedged TPU tunnel
+        # (bench.py documents this); probe in a bounded subprocess, the
+        # same recipe as bench._probe_backend.
+        import subprocess
+
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.default_backend())"],
+                capture_output=True, text=True, timeout=60)
+            platform = (r.stdout.strip().splitlines() or ["unknown"])[-1] \
+                if r.returncode == 0 else "unreachable"
+        except subprocess.TimeoutExpired:
+            platform = "unreachable (backend init timed out)"
+
+    lines = [
+        f"horovod_tpu v{__version__}:",
+        "",
+        "Available Frameworks:",
+        f"    [{mark(xla_ok)}] JAX (native SPMD)",
+        f"    [{mark(importable('tensorflow'))}] TensorFlow",
+        f"    [{mark(importable('torch'))}] PyTorch",
+        f"    [{mark(importable('mxnet'))}] MXNet",
+        "",
+        "Available Controllers:",
+        f"    [{mark(native_ok)}] native TCP star (libhvdtpu.so)",
+        "    [X] direct (single-process)",
+        "",
+        "Available Tensor Operations:",
+        f"    [{mark(xla_ok)}] XLA collectives (ICI/DCN)",
+        f"    [{mark(native_ok)}] host TCP ring (allreduce/allgatherv/"
+        "broadcast/Adasum VHDD)",
+        f"    [{mark(native_ok and xla_ok)}] host-via-XLA staging "
+        "(HOROVOD_HOST_VIA_XLA)",
+        f"    [{mark(xla_ok)}] Pallas flash attention (fwd+bwd)",
+    ]
+    if platform:
+        lines.append("")
+        lines.append(f"Default JAX backend: {platform}")
+    return "\n".join(lines)
+
+
 def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     parser = argparse.ArgumentParser(
         prog="horovodrun",
@@ -38,6 +110,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         formatter_class=argparse.ArgumentDefaultsHelpFormatter)
     parser.add_argument("-v", "--version", action="version",
                         version=__version__)
+    parser.add_argument("-cb", "--check-build", action="store_true",
+                        help="Print the installation's available "
+                             "frameworks, controllers, and tensor "
+                             "operations, then exit. Handled after the "
+                             "full parse, so --verbose works in either "
+                             "position.")
 
     parser.add_argument("-np", "--num-proc", type=int, dest="np",
                         help="Total number of training processes.")
@@ -237,6 +315,9 @@ def _run_elastic(args, command: List[str],
 
 
 def _run(args) -> int:
+    if getattr(args, "check_build", False):
+        print(check_build(verbose=getattr(args, "verbose", False)))
+        return 0
     config_parser.load_config_file(args, getattr(args, "_override_args",
                                                  set()))
     command = list(args.command)

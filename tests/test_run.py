@@ -208,6 +208,23 @@ def test_programmatic_run_two_ranks():
     assert results == [0, 10]
 
 
+def test_check_build_report(capsys):
+    # Parity: horovodrun --check-build (reference runner.py:112-146).
+    from horovod_tpu.run.runner import check_build, run_commandline
+
+    out = check_build()
+    assert "Available Frameworks" in out
+    assert "[X] JAX (native SPMD)" in out
+    assert "Available Controllers" in out
+    assert "host TCP ring" in out
+    # Handled after the full parse: flag position must not matter.
+    assert run_commandline(["--check-build"]) == 0
+    assert run_commandline(["--check-build", "--verbose"]) == 0
+    printed = capsys.readouterr().out
+    assert printed.count("Available Tensor Operations") == 2
+    assert "Default JAX backend" in printed  # --verbose honored
+
+
 def test_cli_end_to_end(tmp_path):
     script = tmp_path / "train.py"
     script.write_text(textwrap.dedent("""

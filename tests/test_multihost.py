@@ -127,6 +127,46 @@ def test_ragged_allgather_multi_chip_cross_process(tmp_path):
 
 
 @pytest.mark.full
+def test_randomized_schedule_cross_process(tmp_path):
+    """Soak for the multi-host XLA plane: a deterministic pseudo-random
+    schedule of mixed collectives (both ranks generate the same schedule
+    from a shared seed) stresses negotiation ordering, the response
+    cache across repeated names, and fusion across processes."""
+    script = _PRELUDE + textwrap.dedent("""
+        import random
+
+        r_sched = random.Random(1234)  # same schedule on both processes
+        for step in range(30):
+            op = r_sched.choice(["ar", "ag", "bc"])
+            n = r_sched.randint(1, 64)
+            name = f"soak.{op}.{step % 7}"  # names repeat: cache hits
+            xs = [jnp.full((n,), float(r + step), jnp.float32)
+                  for r in my_ranks]
+            if op == "ar":
+                out = hvd.allreduce(xs, op=hvd.Sum, name=name)
+                for o in out:  # both local chips, full values
+                    np.testing.assert_allclose(
+                        np.asarray(o), sum(range(4)) + 4 * step)
+            elif op == "ag":
+                got = np.asarray(hvd.allgather(xs, name=name))
+                expect = np.concatenate(
+                    [np.full((n,), float(r + step), np.float32)
+                     for r in range(4)])
+                np.testing.assert_allclose(got, expect)
+            else:
+                root = r_sched.randint(0, 3)
+                out = hvd.broadcast(xs, root, name=name)
+                for o in out:
+                    np.testing.assert_allclose(np.asarray(o),
+                                               float(root + step))
+
+        hvd.shutdown()
+        print(f"MHSOAK_{rank}_OK")
+    """)
+    run_world(tmp_path, script, "MHSOAK", timeout=420, drop_env=_DROP_ENV)
+
+
+@pytest.mark.full
 def test_train_step_and_zero_cross_process(tmp_path):
     """One DP train step and one ZeRO-1 step through the global mesh."""
     script = _PRELUDE + textwrap.dedent("""

@@ -169,21 +169,30 @@ class HostStagingExecutor:
 
     def _execute(self, resp, response_id):
         if resp.plane != _native.PLANE_HOST or \
-                resp.op != _native.OP_ALLREDUCE:
+                resp.op not in (_native.OP_ALLREDUCE, _native.OP_BROADCAST):
             raise _native_error(
                 f"host staging executor got unexpected response "
                 f"(plane={resp.plane}, op={resp.op})")
+        is_bcast = resp.op == _native.OP_BROADCAST
+        activity = "XLA_BROADCAST" if is_bcast else "XLA_ALLREDUCE"
         dtype = _np_from_code(resp.dtype)
+        if dtype == np.bool_:
+            # psum has no bool flavor; byte-identical uint8 works for the
+            # zeros+root-sum broadcast (bool allreduce stays on the ring).
+            dtype = np.dtype(np.uint8)
         counts = [int(np.prod(s)) if s else 1 for s in resp.shapes]
         total = sum(counts)
 
         if self._timeline:
             for n in resp.names:
-                self._timeline.start_activity(n, "XLA_ALLREDUCE")
+                self._timeline.start_activity(n, activity)
 
         # Fuse into one flat host buffer in the response's canonical
         # order; a joined rank's missing slots stay zero (the reference
-        # AllocateZeros join path).
+        # AllocateZeros join path). Broadcast rides the same psum with
+        # non-root ranks contributing zeros — sum(root_value, 0, ...) IS
+        # the broadcast, and one program serves both ops.
+        contribute = not is_bcast or resp.root_rank == self._world.rank
         fused = np.zeros((total,), dtype)
         views = {}
         off = 0
@@ -191,21 +200,25 @@ class HostStagingExecutor:
             ptrs = self._core.inflight_ptrs(response_id, name)
             if ptrs is not None:
                 data_ptr, out_ptr = ptrs
-                src = _as_array(data_ptr, count, dtype)
-                fused[off:off + count] = src
+                if contribute:
+                    fused[off:off + count] = _as_array(data_ptr, count,
+                                                       dtype)
                 views[name] = (off, count,
                                _as_array(out_ptr or data_ptr, count, dtype))
             off += count
 
-        reduced = self._allreduce(fused, resp.reduce_op, resp.prescale,
-                                  resp.postscale)
+        if is_bcast:
+            reduced = self._allreduce(fused, _OP_SUM, 1.0, 1.0)
+        else:
+            reduced = self._allreduce(fused, resp.reduce_op, resp.prescale,
+                                      resp.postscale)
 
         for name, (off, count, out_view) in views.items():
             np.copyto(out_view, reduced[off:off + count])
 
         if self._timeline:
             for n in resp.names:
-                self._timeline.end_activity(n, "XLA_ALLREDUCE")
+                self._timeline.end_activity(n, activity)
 
     def _allreduce(self, fused, reduce_op, prescale, postscale):
         import jax

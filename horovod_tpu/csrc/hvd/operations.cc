@@ -290,10 +290,22 @@ void PerformOperation(const Response& resp) {
   // missing slots from the response's canonical layout.
   if (entries.empty() && !s->joined.load()) return;
   if (resp.plane == DevicePlane::HOST) {
-    // Large fused allreduces may opt into the XLA-plane staging executor
-    // (hvd_set_host_via_xla); everything else runs on the TCP ring.
-    bool stage = resp.op == CollectiveOp::ALLREDUCE &&
+    // Large fused allreduces and broadcasts may opt into the XLA-plane
+    // staging executor (hvd_set_host_via_xla); everything else runs on
+    // the TCP ring. Broadcast staging matters for job startup:
+    // broadcast_parameters moves the whole model.
+    bool stage = (resp.op == CollectiveOp::ALLREDUCE ||
+                  resp.op == CollectiveOp::BROADCAST) &&
                  resp.reduce_op != ReduceOp::ADASUM &&
+                 // bool allreduce semantics belong to the ring (logical
+                 // reduction); bool BROADCAST stages fine as bytes.
+                 !(resp.op == CollectiveOp::ALLREDUCE &&
+                   resp.dtype == DataType::HVD_BOOL) &&
+                 // 64-bit dtypes stay on the ring: the staging executor
+                 // runs under default JAX config, which canonicalizes
+                 // int64/float64 buffers to 32 bits — silent truncation.
+                 resp.dtype != DataType::HVD_INT64 &&
+                 resp.dtype != DataType::HVD_FLOAT64 &&
                  s->exec_cb.load() != nullptr;
     if (stage) {
       long long thr = s->host_via_xla_threshold.load();

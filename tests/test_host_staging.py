@@ -62,10 +62,25 @@ _WORKER = textwrap.dedent("""
     assert torch.allclose(bf.float(), torch.full((4096,), 2 * (1 + 2**-9)),
                           rtol=1e-2), bf[:5]
 
+    # Broadcast above threshold (the broadcast_parameters startup path):
+    # root 1's values must land everywhere via the staged psum.
+    b = torch.arange(2000, dtype=torch.float32) * (rank + 1)
+    bout = hvd.broadcast(b, root_rank=1, name="big.bcast")
+    assert torch.allclose(bout, torch.arange(2000, dtype=torch.float32)
+                          * 2), bout[:5]
+
     # Below threshold: stays on the ring, same math.
     small = hvd.allreduce(torch.full((10,), float(rank + 1)),
                           name="small.grad", op=hvd.Sum)
     assert torch.allclose(small, torch.full((10,), 3.0)), small
+
+    # int64 above threshold: MUST stay on the ring (JAX canonicalizes
+    # 64-bit buffers to 32 bits — staging would truncate). Values above
+    # 2^31 prove full 64-bit fidelity end to end.
+    i64 = torch.arange(2000, dtype=torch.int64) + (1 << 40) * (rank + 1)
+    iout = hvd.allreduce(i64, name="big.i64", op=hvd.Sum)
+    expect = 2 * torch.arange(2000, dtype=torch.int64) + 3 * (1 << 40)
+    assert torch.equal(iout, expect), iout[:3]
 
     hvd.shutdown()
     print(f"STAGING_{rank}_OK")
@@ -93,6 +108,12 @@ def test_host_via_xla_staging(tmp_path):
         "no XLA_ALLREDUCE activity in the timeline — staging never ran"
     for name in ("big.grad", "big.avg", "big.bf16"):
         assert tid_of.get(name) in staged_tids, (name, tid_of, staged_tids)
+    bcast_tids = {e["tid"] for e in events
+                  if e.get("name") == "XLA_BROADCAST"}
+    assert tid_of.get("big.bcast") in bcast_tids, (tid_of, bcast_tids)
+    # 64-bit tensors never stage (silent-truncation guard).
+    if "big.i64" in tid_of:
+        assert tid_of["big.i64"] not in staged_tids
     # The small tensor rode the ring: no XLA_ALLREDUCE span for it.
     if "small.grad" in tid_of:
         assert tid_of["small.grad"] not in staged_tids

@@ -169,10 +169,13 @@ class HostStagingExecutor:
 
     def _execute(self, resp, response_id):
         if resp.plane != _native.PLANE_HOST or \
-                resp.op not in (_native.OP_ALLREDUCE, _native.OP_BROADCAST):
+                resp.op not in (_native.OP_ALLREDUCE, _native.OP_BROADCAST,
+                                _native.OP_ALLGATHER):
             raise _native_error(
                 f"host staging executor got unexpected response "
                 f"(plane={resp.plane}, op={resp.op})")
+        if resp.op == _native.OP_ALLGATHER:
+            return self._execute_allgather(resp, response_id)
         is_bcast = resp.op == _native.OP_BROADCAST
         activity = "XLA_BROADCAST" if is_bcast else "XLA_ALLREDUCE"
         dtype = _np_from_code(resp.dtype)
@@ -219,6 +222,98 @@ class HostStagingExecutor:
         if self._timeline:
             for n in resp.names:
                 self._timeline.end_activity(n, activity)
+
+    def _execute_allgather(self, resp, response_id):
+        """Staged allgatherv: ALL of the fused response's tensors pack
+        into ONE flat buffer (per-tensor regions padded to that tensor's
+        global max), one compiled all_gather over the process mesh moves
+        it, then per-tensor/per-rank slices deposit via hvd_store_result
+        (the same fetch path ring-produced ragged results use). Pure data
+        movement, so every dtype the 32-bit canonicalization allows
+        stages (bool as bytes); fused responses share one dtype by the
+        fusion rules, so one buffer serves the whole response."""
+        rank = self._world.rank
+        size = self._world.size
+        dtype = _np_from_code(resp.dtype)
+        if dtype == np.bool_:
+            dtype = np.dtype(np.uint8)
+
+        if self._timeline:
+            for n in resp.names:
+                self._timeline.start_activity(n, "XLA_ALLGATHER")
+
+        # Region plan: (offset, region_len, counts, fd, ptrs) per tensor.
+        regions = []
+        off = 0
+        for i, name in enumerate(resp.names):
+            shape = resp.shapes[i]
+            trailing = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+            fd = (resp.first_dims[i]
+                  if i < len(resp.first_dims) and resp.first_dims[i]
+                  else ((shape[0] if shape else 1,) * size))
+            counts = [int(d) * trailing for d in fd]
+            region = max(int(d) for d in fd) * trailing
+            regions.append((name, off, region, counts, fd))
+            off += region
+
+        # Bucket the padded length so ragged/sparse steps reuse compiled
+        # programs instead of recompiling per distinct size (and the
+        # program cache stays bounded).
+        bucket = 128
+        while bucket < off:
+            bucket *= 2
+        buf = np.zeros((bucket,), dtype)
+        for name, roff, region, counts, fd in regions:
+            ptrs = self._core.inflight_ptrs(response_id, name)
+            if ptrs is not None:
+                buf[roff:roff + counts[rank]] = _as_array(
+                    ptrs[0], counts[rank], dtype)
+
+        gathered = self._allgather(buf)              # [size, bucket]
+
+        for name, roff, region, counts, fd in regions:
+            ptrs = self._core.inflight_ptrs(response_id, name)
+            if ptrs is None:
+                continue  # joined rank's missing slot
+            out = np.concatenate(
+                [gathered[r, roff: roff + counts[r]] for r in range(size)])
+            if ptrs[1]:
+                # Caller-preallocated output (equal-shape fast path).
+                np.copyto(_as_array(ptrs[1], out.shape[0], dtype), out)
+            else:
+                handle = self._core.inflight_handle(response_id, name)
+                if handle >= 0:
+                    self._core.store_result(handle, out.tobytes(),
+                                            tuple(int(d) for d in fd))
+        if self._timeline:
+            for n in resp.names:
+                self._timeline.end_activity(n, "XLA_ALLGATHER")
+
+    def _allgather(self, buf):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        P_devices = self._world.size
+        key = ("ag", buf.shape[0], str(buf.dtype))
+        prog = self._programs.get(key)
+        if prog is None:
+            from jax import lax
+
+            mesh = self._mesh
+
+            def fn(x):
+                return lax.all_gather(x[0], "proc")  # [P, n], replicated
+
+            prog = jax.jit(jax.shard_map(
+                fn, mesh=mesh, in_specs=P("proc"), out_specs=P(),
+                check_vma=False))
+            self._programs[key] = prog
+
+        sharding = NamedSharding(self._mesh, P("proc"))
+        arr = jax.make_array_from_process_local_data(
+            sharding, buf[None], (P_devices,) + buf.shape)
+        out = prog(arr)
+        return np.asarray(list(out.addressable_shards)[0].data)
 
     def _allreduce(self, fused, reduce_op, prescale, postscale):
         import jax

@@ -126,6 +126,13 @@ def load_library():
         ctypes.c_long, ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p),
         ctypes.POINTER(ctypes.c_void_p),
     ]
+    lib.hvd_inflight_handle.restype = ctypes.c_longlong
+    lib.hvd_inflight_handle.argtypes = [ctypes.c_long, ctypes.c_char_p]
+    lib.hvd_store_result.restype = ctypes.c_int
+    lib.hvd_store_result.argtypes = [
+        ctypes.c_longlong, ctypes.c_void_p, ctypes.c_longlong,
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
+    ]
     lib.hvd_join.restype = ctypes.c_longlong
     lib.hvd_join.argtypes = []
     lib.hvd_last_joined.restype = ctypes.c_int
@@ -297,6 +304,17 @@ class NativeCore:
         if r != 1:
             return None
         return data.value, out.value
+
+    def inflight_handle(self, response_id: int, name: str) -> int:
+        """Native handle of one named in-flight entry (-1 if absent)."""
+        return int(self.lib.hvd_inflight_handle(response_id, name.encode()))
+
+    def store_result(self, handle: int, data: bytes,
+                     dims: Tuple[int, ...]) -> None:
+        """Deposit an executor-allocated result for ``handle`` (staged
+        allgather); the caller fetches it via ``result_fetch``."""
+        arr = (ctypes.c_longlong * len(dims))(*dims)
+        self.lib.hvd_store_result(handle, data, len(data), arr, len(dims))
 
     def shutdown(self):
         if self.available:

@@ -295,7 +295,8 @@ void PerformOperation(const Response& resp) {
     // the TCP ring. Broadcast staging matters for job startup:
     // broadcast_parameters moves the whole model.
     bool stage = (resp.op == CollectiveOp::ALLREDUCE ||
-                  resp.op == CollectiveOp::BROADCAST) &&
+                  resp.op == CollectiveOp::BROADCAST ||
+                  resp.op == CollectiveOp::ALLGATHER) &&
                  resp.reduce_op != ReduceOp::ADASUM &&
                  // bool allreduce semantics belong to the ring (logical
                  // reduction); bool BROADCAST stages fine as bytes.
@@ -786,6 +787,13 @@ void hvd_response_done(long response_id, int ok, const char* error) {
   }
   hvd::Status st = ok ? hvd::Status::OK()
                       : hvd::Status::Aborted(error ? error : "exec failed");
+  if (!ok) {
+    // Erroring callers never reach hvd_result_fetch (the only consumer
+    // that erases stored results), so results already deposited for this
+    // response's handles would strand until shutdown — drop them here.
+    std::lock_guard<std::mutex> lk(s->results_mu);
+    for (auto& e : entries) s->results.erase(e.handle);
+  }
   for (auto& e : entries) {
     s->handles.MarkDone(e.handle, st);
     if (e.callback) e.callback(st);
@@ -818,6 +826,34 @@ int hvd_inflight_ptrs(long response_id, const char* name, void** data,
       return 1;
     }
   }
+  return 0;
+}
+
+// The native handle of one named entry of an in-flight response (-1 when
+// absent) — the key under which hvd_store_result deposits
+// executor-allocated outputs (staged ragged allgather).
+long long hvd_inflight_handle(long response_id, const char* name) {
+  auto* s = hvd::g();
+  std::lock_guard<std::mutex> lk(s->inflight_mu);
+  auto it = s->inflight.find(response_id);
+  if (it == s->inflight.end()) return -1;
+  for (auto& e : it->second) {
+    if (e.name == name) return e.handle;
+  }
+  return -1;
+}
+
+// Deposit an executor-allocated result (staged allgather): the caller's
+// wait then fetches it via hvd_result_bytes/dims/fetch exactly as for
+// ring-produced ragged results.
+int hvd_store_result(long long handle, const void* data, long long nbytes,
+                     const long long* dims, int ndims) {
+  auto* s = hvd::g();
+  std::lock_guard<std::mutex> lk(s->results_mu);
+  auto& rb = s->results[handle];
+  rb.bytes.assign(static_cast<const char*>(data),
+                  static_cast<const char*>(data) + nbytes);
+  rb.first_dims.assign(dims, dims + ndims);
   return 0;
 }
 

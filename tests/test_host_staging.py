@@ -62,6 +62,18 @@ _WORKER = textwrap.dedent("""
     assert torch.allclose(bf.float(), torch.full((4096,), 2 * (1 + 2**-9)),
                           rtol=1e-2), bf[:5]
 
+    # Ragged allgather above threshold (the IndexedSlices/sparse path):
+    # rank 0 contributes 700 rows, rank 1 contributes 1100.
+    nrows = 700 if rank == 0 else 1100
+    g = torch.arange(nrows, dtype=torch.float32).reshape(nrows, 1) \
+        + 1000 * rank
+    gout = hvd.allgather(g, name="big.gather")
+    expect = torch.cat([
+        torch.arange(700, dtype=torch.float32).reshape(700, 1),
+        torch.arange(1100, dtype=torch.float32).reshape(1100, 1) + 1000])
+    assert gout.shape == (1800, 1), gout.shape
+    assert torch.equal(gout, expect), gout[:3]
+
     # Broadcast above threshold (the broadcast_parameters startup path):
     # root 1's values must land everywhere via the staged psum.
     b = torch.arange(2000, dtype=torch.float32) * (rank + 1)
@@ -111,6 +123,9 @@ def test_host_via_xla_staging(tmp_path):
     bcast_tids = {e["tid"] for e in events
                   if e.get("name") == "XLA_BROADCAST"}
     assert tid_of.get("big.bcast") in bcast_tids, (tid_of, bcast_tids)
+    gather_tids = {e["tid"] for e in events
+                   if e.get("name") == "XLA_ALLGATHER"}
+    assert tid_of.get("big.gather") in gather_tids, (tid_of, gather_tids)
     # 64-bit tensors never stage (silent-truncation guard).
     if "big.i64" in tid_of:
         assert tid_of["big.i64"] not in staged_tids

@@ -82,6 +82,37 @@ def test_eager_collectives_cross_process(tmp_path):
         for o in out:
             np.testing.assert_allclose(np.asarray(o), 2.0)
 
+        # --- reducescatter: each participant keeps its 1/4 of the sum ---
+        xs = [jnp.arange(8, dtype=jnp.float32) + r for r in my_ranks]
+        out = hvd.reducescatter(xs, op=hvd.Sum, name="mh.rs")
+        full = sum(np.arange(8, dtype=np.float32) + r for r in range(4))
+        for o, r in zip(out, my_ranks):
+            np.testing.assert_allclose(np.asarray(o),
+                                       full[2 * r: 2 * (r + 1)])
+
+        # --- alltoall: participant p's j-th slice lands on participant j
+        xs = [jnp.arange(4, dtype=jnp.float32) * 10 + r for r in my_ranks]
+        out = hvd.alltoall(xs, name="mh.a2a")
+        for o, r in zip(out, my_ranks):
+            np.testing.assert_allclose(
+                np.asarray(o), np.array([10.0 * r + p for p in range(4)]))
+
+        # --- Adasum (pow2 world) vs the NumPy oracle: non-parallel
+        # per-rank vectors so a silent fallback to Sum/Average would fail.
+        from horovod_tpu.ops.adasum import adasum_reference
+
+        def vec(r):
+            v = np.zeros(6, np.float32)
+            v[r] = 2.0 + r
+            v[(r + 1) % 6] = 1.0
+            return v
+
+        xs = [jnp.asarray(vec(r)) for r in my_ranks]
+        out = hvd.allreduce(xs, op=hvd.Adasum, name="mh.adasum")
+        expect = adasum_reference([vec(r) for r in range(4)])
+        for o in out:
+            np.testing.assert_allclose(np.asarray(o), expect, rtol=1e-4)
+
         hvd.shutdown()
         print(f"MULTIHOST_{rank}_OK")
     """)

@@ -119,6 +119,42 @@ def test_eager_collectives_cross_process(tmp_path):
     run_world(tmp_path, script, "MULTIHOST", drop_env=_DROP_ENV)
 
 
+def test_hierarchical_dispatch_cross_process(tmp_path):
+    """HOROVOD_HIERARCHICAL_ALLREDUCE/ALLGATHER across 2 processes: the
+    (cross, local) mesh genuinely spans a process boundary here — local
+    reduce-scatter inside each process's chips, cross leg between
+    processes — the ICI x DCN split the hierarchical variants model."""
+    script = _PRELUDE.replace(
+        'os.environ["HOROVOD_HOSTNAME"] = "127.0.0.1"',
+        'os.environ["HOROVOD_HOSTNAME"] = "127.0.0.1"\n'
+        'os.environ["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"\n'
+        'os.environ["HOROVOD_HIERARCHICAL_ALLGATHER"] = "1"'
+    ) + textwrap.dedent("""
+        # hier_mesh exists for any homogeneous world; the CONFIG flags are
+        # the actual dispatch gate (a silently failed prelude-replace must
+        # not leave this test green on the flat path).
+        from horovod_tpu.common.state import global_state
+        assert hvd.hierarchical_mesh() is not None
+        assert global_state().config.hierarchical_allreduce
+        assert global_state().config.hierarchical_allgather
+
+        xs = [jnp.full((8,), float(r + 1), jnp.float32) for r in my_ranks]
+        out = hvd.allreduce(xs, op=hvd.Sum, name="mh.har")
+        for o in out:
+            np.testing.assert_allclose(np.asarray(o), 1 + 2 + 3 + 4)
+
+        xs = [jnp.full((3, 2), float(r), jnp.float32) for r in my_ranks]
+        got = np.asarray(hvd.allgather(xs, name="mh.hag"))
+        expect = np.concatenate(
+            [np.full((3, 2), float(r), np.float32) for r in range(4)])
+        np.testing.assert_allclose(got, expect)
+
+        hvd.shutdown()
+        print(f"MHHIER_{rank}_OK")
+    """)
+    run_world(tmp_path, script, "MHHIER", drop_env=_DROP_ENV)
+
+
 def test_ragged_allgather_multi_chip_cross_process(tmp_path):
     """Ragged first dims on chips of BOTH processes (local_size 2): the
     per-chip dim table (Request.chip_dims -> response first_dims) drives

@@ -148,6 +148,9 @@ def load_library():
                                      ctypes.c_longlong]
     lib.hvd_set_parameters.restype = None
     lib.hvd_set_parameters.argtypes = [ctypes.c_double, ctypes.c_longlong]
+    lib.hvd_set_hier_flags.restype = None
+    lib.hvd_set_hier_flags.argtypes = [ctypes.c_int]
+    lib.hvd_get_hier_flags.restype = ctypes.c_int
     lib.hvd_get_cycle_time_ms.restype = ctypes.c_double
     lib.hvd_cache_hits.restype = ctypes.c_longlong
     lib.hvd_stall_report.restype = ctypes.c_int
@@ -180,6 +183,9 @@ class NativeResponse:
     shapes: List[Tuple[int, ...]] = field(default_factory=list)
     # allgather only: per-tensor per-rank first-dim sizes (ragged support)
     first_dims: List[Tuple[int, ...]] = field(default_factory=list)
+    # autotuned hierarchical-dispatch flags stamped into this frame
+    # (bit0 = allreduce, bit1 = allgather; -1 = untuned -> env config)
+    hier_flags: int = -1
 
 
 class _Cursor:
@@ -217,17 +223,21 @@ class _Cursor:
 def parse_response_list(data: bytes) -> List[NativeResponse]:
     c = _Cursor(data)
     assert c.u8() == 0xA2, "bad response magic"
-    # Tuned-parameter piggyback (mirror of SerializeResponseList,
-    # message.cc:120-129): cycle/fusion hints ride every response frame.
-    # The XLA exec path reads them only to stay frame-aligned; application
-    # happens in the C++ worker cycle (controller.cc WorkerCycle).
+    # Tuned-parameter piggyback (mirror of SerializeResponseList):
+    # cycle/fusion hints ride every response frame and are applied in the
+    # C++ worker cycle; the hierarchical-dispatch flags are stamped into
+    # each frame at PerformOperation time and consumed HERE — the
+    # executor must dispatch this frame's responses with exactly these
+    # flags to stay in lockstep with every other rank.
     c.f64()
     c.i64()
+    hier_flags = c.i32()
     out = []
     for _ in range(c.i32()):
         r = NativeResponse(op=c.u8(), reduce_op=c.u8(), dtype=c.u8(),
                            plane=c.u8(), root_rank=c.i32(), error=c.s(),
-                           prescale=c.f64(), postscale=c.f64())
+                           prescale=c.f64(), postscale=c.f64(),
+                           hier_flags=hier_flags)
         for _ in range(c.i32()):
             r.names.append(c.s())
             ndim = c.i32()
@@ -388,6 +398,15 @@ class NativeCore:
                        fusion_threshold: int = -1):
         """Autotuner hook: apply new tunables to the running world."""
         self.lib.hvd_set_parameters(cycle_time_ms, fusion_threshold)
+
+    def set_hier_flags(self, flags: int) -> None:
+        """Autotuner hook (coordinator): propose categorical
+        hierarchical-dispatch flags (bit0 = allreduce, bit1 = allgather);
+        they ride the next response broadcast to every rank."""
+        self.lib.hvd_set_hier_flags(flags)
+
+    def get_hier_flags(self) -> int:
+        return int(self.lib.hvd_get_hier_flags())
 
     def get_parameters(self) -> Tuple[float, int]:
         return (float(self.lib.hvd_get_cycle_time_ms()),

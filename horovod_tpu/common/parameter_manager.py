@@ -9,13 +9,16 @@ samples have been taken, after which the best point is pinned.
 
 TPU-native placement: fusion planning happens centrally in the coordinator
 (csrc controller ``FuseResponses``), so applying the tuned threshold on the
-coordinator process governs the whole job; cycle time paces each rank's own
-background loop. There is therefore no cross-rank parameter broadcast — the
-reference needs ``Controller::SynchronizeParameters`` (controller.cc:33-47)
-only because every rank fuses independently.
+coordinator process governs the whole job; cycle time rides the response
+broadcast to pace every rank's loop, and the categorical
+hierarchical-dispatch flags ride the same broadcast (the
+``Controller::SynchronizeParameters`` role, controller.cc:33-47) and are
+stamped into each response frame so all ranks compile the same programs.
 
 Search space follows the reference (``parameter_manager.cc:42``): fusion
-threshold 0-64 MB, cycle time 1-25 ms, in log scale for the threshold.
+threshold 0-64 MB, cycle time 1-25 ms; plus, when a (cross, local) mesh
+exists, a leading grid phase over the four hierarchical
+allreduce/allgather combos (the reference's categorical parameters).
 """
 
 from __future__ import annotations
@@ -34,7 +37,8 @@ class ParameterManager:
                  steps_per_sample: int = 10, max_samples: int = 20,
                  gp_noise: float = 0.8, log_file: str = "",
                  initial_cycle_ms: float = 5.0,
-                 initial_fusion_bytes: int = 64 * MB):
+                 initial_fusion_bytes: int = 64 * MB,
+                 tune_hierarchical: bool = False):
         self._core = core
         self._warmup_remaining = warmup_samples
         self._steps_per_sample = steps_per_sample
@@ -50,9 +54,22 @@ class ParameterManager:
         self._current = (initial_fusion_bytes / MB, initial_cycle_ms)
         self._tuning = True
         self._best_score: Optional[float] = None
+        # Categorical phase (reference ParameterManager's categorical
+        # params, parameter_manager.h:42-246): when a (cross, local) mesh
+        # exists, grid-sample the four hierarchical-dispatch combos at the
+        # initial numeric params, pin the best, then run the numeric GP.
+        # Flags sync to every rank via the response-broadcast piggyback
+        # (set_hier_flags -> Controller::set_hier_flags_hint).
+        self._cat_combos = [0, 1, 2, 3] if tune_hierarchical else []
+        self._cat_scores: dict = {}
+        self._cat_best: Optional[int] = None
+        self._log_rows = 0
+        if self._cat_combos:
+            self._apply_hier(self._cat_combos[0])
         if log_file:
             with open(log_file, "w") as f:
-                f.write("sample,fusion_mb,cycle_ms,score_bytes_per_sec\n")
+                f.write("sample,fusion_mb,cycle_ms,hier_flags,"
+                        "score_bytes_per_sec\n")
 
     @property
     def active(self) -> bool:
@@ -81,12 +98,26 @@ class ParameterManager:
 
     def _record_sample(self, score: float) -> None:
         fusion_mb, cycle_ms = self._current
+        self._log_sample(score)
+        # Phase 1: grid over the hierarchical combos (categorical params
+        # first, like the reference's categorical exploration), then pin
+        # the winner for the numeric GP phase.
+        if self._cat_combos:
+            combo = self._cat_combos.pop(0)
+            self._cat_scores[combo] = score
+            if self._cat_combos:
+                self._apply_hier(self._cat_combos[0])
+                return
+            self._cat_best = max(self._cat_scores,
+                                 key=self._cat_scores.get)
+            self._apply_hier(self._cat_best)
+            _log.info(f"autotune: hierarchical flags pinned to "
+                      f"{self._cat_best:#04b} "
+                      f"({self._cat_scores[self._cat_best] / MB:.1f} MB/s)")
+            return
+        # Phase 2: numeric GP over (fusion, cycle).
         self._bayes.add_sample([fusion_mb, cycle_ms], score)
         self._samples_taken += 1
-        if self._log_file:
-            with open(self._log_file, "a") as f:
-                f.write(f"{self._samples_taken},{fusion_mb:.2f},"
-                        f"{cycle_ms:.2f},{score:.0f}\n")
         if self._samples_taken >= self._max_samples:
             best_x, best_y = self._bayes.best()
             self._tuning = False
@@ -99,12 +130,27 @@ class ParameterManager:
         nxt = self._bayes.suggest()
         self._apply(nxt[0], nxt[1])
 
+    def _log_sample(self, score: float) -> None:
+        if not self._log_file:
+            return
+        self._log_rows += 1
+        fusion_mb, cycle_ms = self._current
+        hier = self._cat_combos[0] if self._cat_combos else \
+            (self._cat_best if self._cat_best is not None else -1)
+        with open(self._log_file, "a") as f:
+            f.write(f"{self._log_rows},{fusion_mb:.2f},"
+                    f"{cycle_ms:.2f},{hier},{score:.0f}\n")
+
     def _apply(self, fusion_mb: float, cycle_ms: float) -> None:
         self._current = (float(fusion_mb), float(cycle_ms))
         if self._core is not None:
             self._core.set_parameters(
                 cycle_time_ms=float(cycle_ms),
                 fusion_threshold=int(fusion_mb * MB))
+
+    def _apply_hier(self, flags: int) -> None:
+        if self._core is not None:
+            self._core.set_hier_flags(int(flags))
 
     # introspection
     @property
@@ -114,3 +160,8 @@ class ParameterManager:
     @property
     def samples_taken(self) -> int:
         return self._samples_taken
+
+    @property
+    def hier_flags(self) -> Optional[int]:
+        """The pinned categorical decision (None before phase 1 ends)."""
+        return self._cat_best

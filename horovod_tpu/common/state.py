@@ -201,7 +201,13 @@ def init(comm=None, devices=None):
                 gp_noise=cfg.autotune_gaussian_process_noise,
                 log_file=cfg.autotune_log,
                 initial_cycle_ms=cfg.cycle_time_ms,
-                initial_fusion_bytes=cfg.fusion_threshold_bytes)
+                initial_fusion_bytes=cfg.fusion_threshold_bytes,
+                # Categorical phase only when the hierarchy actually
+                # spans hosts — with cross_size 1 the hier variants can
+                # only lose (or win by noise), and the grid would burn
+                # 4 sample windows on a meaningless choice.
+                tune_hierarchical=(_state.hier_mesh is not None
+                                   and _state.cross_size > 1))
 
         _state.initialized = True
         _log.info(

@@ -187,6 +187,12 @@ std::vector<Response> LocalController::ComputeResponseList(
     std::vector<Request> reqs, bool this_rank_shutdown,
     bool* world_shutdown) {
   *world_shutdown = this_rank_shutdown;
+  // Single-rank world: the tuner's categorical hint has no broadcast to
+  // ride; apply it at the same cycle boundary the TCP path would.
+  int hier = hier_flags_hint();
+  if (hier >= 0) {
+    synced_hier_flags_.store(hier, std::memory_order_relaxed);
+  }
   std::vector<Response> singles;
   singles.reserve(reqs.size());
   for (auto& q : reqs) {
@@ -405,20 +411,26 @@ std::vector<Response> TcpController::WorkerCycle(std::vector<Request> reqs,
   std::vector<Response> resps;
   double synced_cycle = -1.0;
   int64_t synced_fusion = -1;
+  int synced_hier = -1;
   if (!DeserializeResponseList(bytes, &resps, &synced_cycle,
-                               &synced_fusion)) {
+                               &synced_fusion, &synced_hier)) {
     *world_shutdown = true;
     return {};
   }
   // Apply the coordinator's tuned parameters (reference
   // SynchronizeParameters, controller.cc:33-47): fusion is ours to apply,
-  // the cycle time belongs to the background loop and is surfaced via
-  // TakeSyncedCycleMs.
+  // the cycle time belongs to the background loop (TakeSyncedCycleMs),
+  // and the hierarchical flags to the executor (TakeSyncedHierFlags) —
+  // both consumed at this frame boundary so every rank applies them to
+  // the same responses.
   if (synced_fusion >= 0 && synced_fusion != fusion_threshold()) {
     set_fusion_threshold(synced_fusion);
   }
   if (synced_cycle > 0) {
     synced_cycle_ms_.store(synced_cycle, std::memory_order_relaxed);
+  }
+  if (synced_hier >= 0) {
+    synced_hier_flags_.store(synced_hier, std::memory_order_relaxed);
   }
   CacheResponses(resps);
   return resps;
@@ -583,12 +595,19 @@ std::vector<Response> TcpController::CoordinatorCycle(
     return {};
   }
 
-  std::string bytes =
-      SerializeResponseList(fused, cycle_hint_ms(), fusion_threshold());
+  int hier = hier_flags_hint();
+  std::string bytes = SerializeResponseList(fused, cycle_hint_ms(),
+                                            fusion_threshold(), hier);
   for (int r = 1; r < cfg_.size; ++r) {
     if (!shutdown_ranks_[r] && worker_socks_[r - 1].valid()) {
       worker_socks_[r - 1].SendFrame(bytes);
     }
+  }
+  // The coordinator applies the flags at the same frame boundary it
+  // broadcast them (workers apply on receive), so no rank ever executes
+  // this frame's responses under a different dispatch.
+  if (hier >= 0) {
+    synced_hier_flags_.store(hier, std::memory_order_relaxed);
   }
   return fused;
 }

@@ -74,6 +74,19 @@ class Controller {
   // update arrives.
   double TakeSyncedCycleMs() { return synced_cycle_ms_.exchange(-1.0); }
 
+  // Tuned categorical flags (bit0 = hierarchical allreduce, bit1 =
+  // hierarchical allgather; -1 = untuned). The coordinator's autotuner
+  // sets the hint; it rides the next response broadcast and every rank
+  // (coordinator included) applies it at that frame boundary via
+  // TakeSyncedHierFlags, so dispatch never diverges across ranks.
+  void set_hier_flags_hint(int flags) {
+    hier_flags_hint_.store(flags, std::memory_order_relaxed);
+  }
+  int hier_flags_hint() const {
+    return hier_flags_hint_.load(std::memory_order_relaxed);
+  }
+  int TakeSyncedHierFlags() { return synced_hier_flags_.exchange(-1); }
+
   virtual Status Initialize() = 0;
   // One negotiation cycle. `this_rank_shutdown` signals this rank wants out;
   // returns responses to execute now; sets *world_shutdown once every rank
@@ -155,6 +168,8 @@ class Controller {
   std::atomic<int64_t> fusion_threshold_bytes_;
   std::atomic<double> cycle_hint_ms_{-1.0};
   std::atomic<double> synced_cycle_ms_{-1.0};
+  std::atomic<int> hier_flags_hint_{-1};
+  std::atomic<int> synced_hier_flags_{-1};
   std::atomic<int64_t> cache_hits_{0};
   std::mutex stall_report_mu_;
   std::atomic<bool> record_negotiation_{false};

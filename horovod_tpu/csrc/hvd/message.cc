@@ -128,14 +128,17 @@ bool DeserializeRequestList(const std::string& bytes,
 
 std::string SerializeResponseList(const std::vector<Response>& resps,
                                   double cycle_time_ms,
-                                  int64_t fusion_threshold) {
+                                  int64_t fusion_threshold,
+                                  int hier_flags) {
   Writer w;
   w.u8(kResponseMagic);
   // Tuned-parameter piggyback (reference SynchronizeParameters,
-  // controller.cc:33-47): the coordinator's current cycle time and fusion
-  // threshold ride every response broadcast; -1 = no hint.
+  // controller.cc:33-47): the coordinator's current cycle time, fusion
+  // threshold, and categorical hierarchical-dispatch flags ride every
+  // response broadcast; -1 = no hint.
   w.f64(cycle_time_ms);
   w.i64(fusion_threshold);
+  w.i32(hier_flags);
   w.i32(static_cast<int32_t>(resps.size()));
   for (const auto& p : resps) {
     w.u8(static_cast<uint8_t>(p.op));
@@ -163,13 +166,16 @@ std::string SerializeResponseList(const std::vector<Response>& resps,
 bool DeserializeResponseList(const std::string& bytes,
                              std::vector<Response>* resps,
                              double* cycle_time_ms,
-                             int64_t* fusion_threshold) {
+                             int64_t* fusion_threshold,
+                             int* hier_flags) {
   Reader r(bytes);
   if (r.u8() != kResponseMagic) return false;
   double cyc = r.f64();
   int64_t fus = r.i64();
+  int32_t hf = r.i32();
   if (cycle_time_ms != nullptr) *cycle_time_ms = cyc;
   if (fusion_threshold != nullptr) *fusion_threshold = fus;
+  if (hier_flags != nullptr) *hier_flags = hf;
   int32_t n = r.i32();
   if (n < 0 || n > (1 << 24)) return false;
   resps->clear();

@@ -78,16 +78,20 @@ bool DeserializeRequestList(const std::string& bytes,
                             std::vector<uint32_t>* cached_ids,
                             bool* shutdown);
 
-// cycle_time_ms / fusion_threshold piggyback the coordinator's tuned
-// parameters on the broadcast (reference Controller::SynchronizeParameters,
-// controller.cc:33-47); -1 = no hint.
+// cycle_time_ms / fusion_threshold / hier_flags piggyback the
+// coordinator's tuned parameters on the broadcast (reference
+// Controller::SynchronizeParameters, controller.cc:33-47); -1 = no hint.
+// hier_flags: bit0 = hierarchical allreduce, bit1 = hierarchical
+// allgather (the tuner's categorical dimensions).
 std::string SerializeResponseList(const std::vector<Response>& resps,
                                   double cycle_time_ms = -1.0,
-                                  int64_t fusion_threshold = -1);
+                                  int64_t fusion_threshold = -1,
+                                  int hier_flags = -1);
 bool DeserializeResponseList(const std::string& bytes,
                              std::vector<Response>* resps,
                              double* cycle_time_ms = nullptr,
-                             int64_t* fusion_threshold = nullptr);
+                             int64_t* fusion_threshold = nullptr,
+                             int* hier_flags = nullptr);
 
 }  // namespace hvd
 

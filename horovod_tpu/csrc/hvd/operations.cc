@@ -123,6 +123,13 @@ struct GlobalState {
   // DoAllreduceCudaOnCPU, nccl_operations.cc:164-357 hierarchical).
   std::atomic<long long> host_via_xla_threshold{-1};
 
+  // Autotuned categorical dispatch flags (bit0 = hierarchical allreduce,
+  // bit1 = hierarchical allgather; -1 = untuned — Python falls back to
+  // the env config). Applied at frame boundaries from the controller's
+  // synced value; stamped into each response frame handed to the
+  // executor so dispatch is frame-exact on every rank.
+  std::atomic<int> hier_flags{-1};
+
   // executor-allocated results, keyed by handle (fetched then erased)
   std::mutex results_mu;
   std::unordered_map<int64_t, ResultBuffer> results;
@@ -341,7 +348,8 @@ void PerformOperation(const Response& resp) {
     std::lock_guard<std::mutex> lk(s->inflight_mu);
     s->inflight[id] = std::move(entries);
   }
-  std::string bytes = SerializeResponseList({resp});
+  std::string bytes =
+      SerializeResponseList({resp}, -1.0, -1, s->hier_flags.load());
   cb(bytes.data(), static_cast<int>(bytes.size()), id);
 }
 
@@ -365,6 +373,8 @@ bool RunLoopOnce(std::chrono::steady_clock::time_point& last_cycle) {
   // BackgroundThreadLoop, operations.cc:598-604).
   double synced = s->controller->TakeSyncedCycleMs();
   if (synced > 0) s->cycle_time_ms.store(synced);
+  int synced_hier = s->controller->TakeSyncedHierFlags();
+  if (synced_hier >= 0) s->hier_flags.store(synced_hier);
   for (const auto& r : responses) PerformOperation(r);
   return !world_shutdown;
 }
@@ -407,6 +417,9 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
     // caller bug that must not be silently ignored.
     return (rank == s->rank && size == s->size) ? 0 : -2;
   }
+  // A fresh world starts from the env config; a previous world's tuned
+  // dispatch flags must not leak through re-init.
+  s->hier_flags.store(-1);
   s->rank = rank;
   s->size = size;
   s->local_rank = local_rank;
@@ -809,6 +822,16 @@ int hvd_pending_count() {
 void hvd_set_host_via_xla(long long threshold) {
   hvd::g()->host_via_xla_threshold.store(threshold);
 }
+
+// Coordinator autotuner: propose tuned hierarchical-dispatch flags
+// (bit0 = allreduce, bit1 = allgather). They ride the next response
+// broadcast and apply on every rank at that frame boundary.
+void hvd_set_hier_flags(int flags) {
+  auto* s = hvd::g();
+  if (s->controller) s->controller->set_hier_flags_hint(flags);
+}
+
+int hvd_get_hier_flags() { return hvd::g()->hier_flags.load(); }
 
 // Host-staging executor data access: the raw buffer pointers of one named
 // entry of an in-flight response. Returns 1 (found), 0 (absent — a joined

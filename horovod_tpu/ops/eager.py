@@ -211,6 +211,12 @@ class EagerEngine:
             for n in found:
                 timeline.end_activity(n, f"NEGOTIATE_{kind.upper()}")
                 timeline.start_activity(n, f"XLA_{kind.upper()}")
+        # Autotuned hierarchical dispatch, frame-exact across ranks: the
+        # flags stamped into this response frame supersede the env config
+        # (None = untuned).
+        hf = getattr(resp, "hier_flags", -1)
+        hier_ar = None if hf < 0 else bool(hf & 1)
+        hier_ag = None if hf < 0 else bool(hf & 2)
         if kind == "allreduce":
             # Build stacks in the response's canonical order. A joined
             # process may hold entries for only some (or none) of the fused
@@ -225,7 +231,8 @@ class EagerEngine:
                 for i, n in enumerate(names)
             ]
             results = self._exec_grouped_allreduce(
-                stacks, resp.reduce_op, resp.prescale, resp.postscale)
+                stacks, resp.reduce_op, resp.prescale, resp.postscale,
+                hier_override=hier_ar)
             for n, r in zip(names, results):
                 p = found.get(n)
                 if p is not None:
@@ -254,7 +261,8 @@ class EagerEngine:
                     pad = [(0, 0), (0, max0 - p.stacked.shape[1])] + \
                         [(0, 0)] * (p.stacked.ndim - 2)
                     out = np.asarray(
-                        self._exec_allgather(jnp.pad(p.stacked, pad)))
+                        self._exec_allgather(jnp.pad(p.stacked, pad),
+                                             hier_override=hier_ag))
                     views = out.reshape((size, max0) + out.shape[1:])
                     idx = (lambda c: c) if len(fd) == size \
                         else (lambda c: c // L)
@@ -262,9 +270,11 @@ class EagerEngine:
                         [views[c, : fd[idx(c)]] for c in range(size)],
                         axis=0)
                 elif p.was_device:
-                    p.result = self._exec_allgather(p.stacked)
+                    p.result = self._exec_allgather(
+                        p.stacked, hier_override=hier_ag)
                 else:
-                    p.result = np.asarray(self._exec_allgather(p.stacked))
+                    p.result = np.asarray(self._exec_allgather(
+                        p.stacked, hier_override=hier_ag))
         elif kind == "broadcast":
             for p in entries:
                 out = self._exec_broadcast(p.stacked, p.root)
@@ -393,20 +403,26 @@ class EagerEngine:
 
     # -- XLA execution primitives (shared by native executor + direct mode) --
 
-    def _use_hierarchical(self, flag: bool, op=None) -> bool:
+    def _use_hierarchical(self, flag: bool, op=None, override=None) -> bool:
         """HOROVOD_HIERARCHICAL_* dispatch (reference: OperationManager
         priority + ParameterManager::HierarchicalAllreduce gating,
         operations.cc:142-233): the env/CLI flag routes eager traffic to the
-        ICI×DCN variants when the (cross, local) mesh exists. Hierarchical
-        reduction is expressible for SUM/AVERAGE only; other ops fall back
-        to the flat path."""
+        ICI×DCN variants when the (cross, local) mesh exists; the
+        autotuner's synced categorical decision (``override``, stamped into
+        each response frame) supersedes the static flag so every rank
+        dispatches identically. Hierarchical reduction is expressible for
+        SUM/AVERAGE only; other ops fall back to the flat path."""
+        if override is not None:
+            flag = override
         if not flag or self._state.hier_mesh is None:
             return False
         return op is None or op in (_xla.ReduceOp.SUM, _xla.ReduceOp.AVERAGE)
 
-    def _exec_grouped_allreduce(self, stacks: List, op, prescale, postscale):
+    def _exec_grouped_allreduce(self, stacks: List, op, prescale, postscale,
+                                hier_override=None):
         hier = self._use_hierarchical(
-            self._state.config.hierarchical_allreduce, op)
+            self._state.config.hierarchical_allreduce, op,
+            override=hier_override)
         key = ("grouped_allreduce",
                tuple((s.shape[1:], str(s.dtype)) for s in stacks), op,
                prescale, postscale, hier)
@@ -433,9 +449,10 @@ class EagerEngine:
         outs = prog(*[self._to_global(s, mesh, spec) for s in stacks])
         return list(outs) if isinstance(outs, tuple) else [outs]
 
-    def _exec_allgather(self, stacked):
+    def _exec_allgather(self, stacked, hier_override=None):
         hier = self._use_hierarchical(
-            self._state.config.hierarchical_allgather)
+            self._state.config.hierarchical_allgather,
+            override=hier_override)
         key = ("allgather", stacked.shape[1:], str(stacked.dtype), hier)
         mesh = self._state.hier_mesh if hier else self._mesh
         spec = P((AXIS_CROSS, AXIS_LOCAL)) if hier else P(AXIS_GLOBAL)
@@ -607,7 +624,14 @@ class EagerEngine:
                                 prescale_factor: float = 1.0,
                                 postscale_factor: float = 1.0) -> int:
         """Explicitly-fused allreduce: submitted as one unit so the result
-        is one compiled program regardless of cycle timing."""
+        is one compiled program regardless of cycle timing.
+
+        Deliberately follows the STATIC hierarchical config, not the
+        autotuner's synced flags: grouped/direct calls execute outside
+        the response-frame protocol that guarantees every rank applies a
+        flag flip at the same boundary, and a mid-tune flip here would
+        compile divergent SPMD programs across ranks (see
+        docs/autotune.md)."""
         name = name or self._auto_name("grouped_allreduce")
         norm = [self._normalize(t) for t in tensors]
         stacks = [n[0] for n in norm]

@@ -43,9 +43,13 @@ def test_bayes_opt_finds_max_of_quadratic():
 class _FakeCore:
     def __init__(self):
         self.applied = []
+        self.hier_applied = []
 
     def set_parameters(self, cycle_time_ms=-1.0, fusion_threshold=-1):
         self.applied.append((cycle_time_ms, fusion_threshold))
+
+    def set_hier_flags(self, flags):
+        self.hier_applied.append(flags)
 
 
 def test_parameter_manager_warmup_then_tunes_then_pins():
@@ -80,6 +84,56 @@ def test_parameter_manager_logs(tmp_path):
     assert len(lines) == 3  # header + 2 samples
 
 
+def test_parameter_manager_categorical_hier_phase():
+    """The reference's categorical params: a leading grid over the four
+    hierarchical combos, winner pinned, then the numeric GP phase."""
+    core = _FakeCore()
+    pm = ParameterManager(core, warmup_samples=0, steps_per_sample=1,
+                          max_samples=2, tune_hierarchical=True)
+    assert core.hier_applied == [0]  # phase 1 starts at combo 0
+
+    # Feed scores so combo 2 (hier allgather only) wins: one update per
+    # sample (steps_per_sample=1), combos sampled in order 0,1,2,3.
+    scores = {0: 2 * MB, 1: 1 * MB, 2: 9 * MB, 3: 3 * MB}
+    for combo in range(4):
+        pm.update(scores[combo])
+    assert pm.hier_flags == 2
+    assert core.hier_applied[-1] == 2
+    assert pm.active  # numeric phase still running
+
+    pm.update(MB)
+    pm.update(MB)
+    assert not pm.active          # GP phase converged (max_samples=2)
+    assert pm.hier_flags == 2     # pinned decision survives convergence
+
+
+def test_hier_flags_frame_sync_native():
+    """The synced flags ride response frames end to end: set via the C
+    API, the next collective's frame carries them, and the engine
+    dispatches hierarchically (program cache key hier=True)."""
+    import horovod_tpu as hvd
+    from horovod_tpu.common.state import global_state
+
+    hvd.init()
+    try:
+        st = global_state()
+        core = st.engine.native_core
+        if core is None or st.hier_mesh is None:
+            pytest.skip("native core or hier mesh unavailable")
+        core.set_hier_flags(3)  # hier allreduce + allgather
+        hvd.allreduce(np.ones(32, np.float32), name="hier.sync.ar",
+                      op=hvd.Sum)
+        hvd.allgather(np.ones((2, 2), np.float32), name="hier.sync.ag")
+        keys = list(st.engine._program_cache)
+        assert any(k[0] == "grouped_allreduce" and k[-1] is True
+                   for k in keys), keys
+        assert any(k[0] == "allgather" and k[-1] is True
+                   for k in keys), keys
+        assert core.get_hier_flags() == 3
+    finally:
+        hvd.shutdown()
+
+
 def test_autotune_end_to_end_engine():
     """HOROVOD_AUTOTUNE=1: the live engine feeds the tuner and the native
     core's parameters move off their defaults."""
@@ -98,11 +152,17 @@ def test_autotune_end_to_end_engine():
 
             st = global_state()
             assert st.autotuner is not None
-            for i in range(8):
+            # warmup (1 sample) + categorical grid (4 samples when the
+            # hier mesh exists) + 2 GP samples, at 2 steps each.
+            for i in range(16):
                 hvd.allreduce(np.ones(64, np.float32),
                               name=f"autotune.{i}", op=hvd.Sum)
             assert st.autotuner.samples_taken >= 2
             assert not st.autotuner.active
+            if st.hier_mesh is not None and st.cross_size > 1:
+                # Categorical phase only runs when the hierarchy spans
+                # hosts (single-process worlds skip it).
+                assert st.autotuner.hier_flags is not None
             if st.engine.native_core is not None:
                 cycle, fusion = st.engine.native_core.get_parameters()
                 assert 1.0 <= cycle <= 25.0

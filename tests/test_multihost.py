@@ -155,6 +155,45 @@ def test_hierarchical_dispatch_cross_process(tmp_path):
     run_world(tmp_path, script, "MHHIER", drop_env=_DROP_ENV)
 
 
+def test_autotune_categorical_sync_cross_process(tmp_path):
+    """The tuner's categorical hierarchical decision must reach every
+    rank: the coordinator grid-samples the four combos, the pinned flags
+    ride the response broadcast, and the WORKER's native core reports the
+    same applied value."""
+    script = _PRELUDE.replace(
+        'os.environ["HOROVOD_HOSTNAME"] = "127.0.0.1"',
+        'os.environ["HOROVOD_HOSTNAME"] = "127.0.0.1"\n'
+        'os.environ["HOROVOD_AUTOTUNE"] = "1"\n'
+        'os.environ["HOROVOD_AUTOTUNE_WARMUP_SAMPLES"] = "1"\n'
+        'os.environ["HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"] = "1"\n'
+        'os.environ["HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"] = "2"'
+    ) + textwrap.dedent("""
+        from horovod_tpu.common.state import global_state
+
+        st = global_state()
+        assert st.cross_size == 2
+        if rank == 0:
+            assert st.autotuner is not None
+
+        # warmup(1) + categorical grid(4) + GP(2) samples at 1 step each.
+        for i in range(10):
+            out = hvd.allreduce(
+                [jnp.full((16,), float(r + i), jnp.float32)
+                 for r in my_ranks], op=hvd.Sum, name=f"tune.{i}")
+            np.testing.assert_allclose(np.asarray(out[0]),
+                                       sum(range(4)) + 4 * i)
+
+        flags = st.engine.native_core.get_hier_flags()
+        assert flags >= 0, flags  # synced decision arrived on this rank
+        if rank == 0:
+            assert st.autotuner.hier_flags == flags
+
+        hvd.shutdown()
+        print(f"MHTUNE_{rank}_OK")
+    """)
+    run_world(tmp_path, script, "MHTUNE", drop_env=_DROP_ENV)
+
+
 def test_ragged_allgather_multi_chip_cross_process(tmp_path):
     """Ragged first dims on chips of BOTH processes (local_size 2): the
     per-chip dim table (Request.chip_dims -> response first_dims) drives

@@ -83,7 +83,28 @@ def load_library():
         return _lib
     if not os.path.exists(_LIB_PATH) and not _build_library():
         return None
-    lib = ctypes.CDLL(_LIB_PATH)
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+        return _bind_prototypes(lib)
+    except (OSError, AttributeError) as e:
+        # A stale .so from an older build (missing symbols) or a
+        # corrupt/wrong-arch one: rebuild once, then either bind the
+        # fresh library or degrade to direct mode — never crash init.
+        _log.warning(f"native library unusable ({e}); rebuilding")
+        if not _build_library():
+            return None
+        try:
+            _lib = None
+            lib = ctypes.CDLL(_LIB_PATH)
+            return _bind_prototypes(lib)
+        except (OSError, AttributeError) as e2:
+            _log.warning(f"native library still unusable after rebuild "
+                         f"({e2}); using direct mode")
+            return None
+
+
+def _bind_prototypes(lib):
+    global _lib
     lib.hvd_init.restype = ctypes.c_int
     lib.hvd_init.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,

@@ -828,6 +828,9 @@ void hvd_set_host_via_xla(long long threshold) {
 // broadcast and apply on every rank at that frame boundary.
 void hvd_set_hier_flags(int flags) {
   auto* s = hvd::g();
+  // init_mu guards hvd_shutdown's controller.reset() — same race as
+  // hvd_set_parameters (a tuner update vs a concurrent shutdown).
+  std::lock_guard<std::mutex> lk(s->init_mu);
   if (s->controller) s->controller->set_hier_flags_hint(flags);
 }
 

@@ -155,6 +155,37 @@ def test_hierarchical_dispatch_cross_process(tmp_path):
     run_world(tmp_path, script, "MHHIER", drop_env=_DROP_ENV)
 
 
+def test_join_cross_process(tmp_path):
+    """hvd.join across the 2-process XLA-plane world: process 1 runs one
+    more allreduce than process 0; the joined process 0 contributes
+    zeros (the JoinOp AllocateZeros role) so process 1's collective
+    completes, and join returns the last joiner's rank everywhere."""
+    script = _PRELUDE + textwrap.dedent("""
+        out = hvd.allreduce(
+            [jnp.full((4,), float(r + 1), jnp.float32) for r in my_ranks],
+            op=hvd.Sum, name="mh.pre")
+        np.testing.assert_allclose(np.asarray(out[0]), 1 + 2 + 3 + 4)
+
+        if rank == 1:
+            # The straggler: one extra allreduce after rank 0 joined —
+            # rank 0's zero contribution must complete it.
+            extra = hvd.allreduce(
+                [jnp.full((4,), 5.0, jnp.float32) for _ in my_ranks],
+                op=hvd.Sum, name="mh.extra")
+            np.testing.assert_allclose(np.asarray(extra[0]), 5.0 + 5.0)
+        # Process 1 deterministically joins last (its extra allreduce
+        # precedes its join); join returns the last joiner's global
+        # PARTICIPANT rank, which must be one of process 1's chips —
+        # and identically on every process.
+        last = hvd.join()
+        assert last in (2, 3), last
+
+        hvd.shutdown()
+        print(f"MHJOIN_{rank}_OK")
+    """)
+    run_world(tmp_path, script, "MHJOIN", drop_env=_DROP_ENV)
+
+
 def test_autotune_categorical_sync_cross_process(tmp_path):
     """The tuner's categorical hierarchical decision must reach every
     rank: the coordinator grid-samples the four combos, the pinned flags

@@ -19,6 +19,7 @@ small tensors aren't worth the host<->device hops.
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import os
 import queue
@@ -32,14 +33,19 @@ from . import logging as _log
 from . import native as _native
 
 def _np_from_code(code):
-    """Native dtype code -> numpy dtype ("bfloat16" resolves through
-    ml_dtypes' numpy registration, present with jax installed)."""
+    """Native dtype code -> the numpy dtype staging computes in.
+    bfloat16 resolves through ml_dtypes' numpy registration (present with
+    jax installed); bool maps to byte-identical uint8 — staging only ever
+    moves or zero-sums bool data (the C++ guard keeps bool *allreduce* on
+    the ring), and psum has no bool flavor."""
     for name, c in _native.DTYPE_CODES.items():
         if c == code:
             if name == "bfloat16":
                 import ml_dtypes
 
                 return np.dtype(ml_dtypes.bfloat16)
+            if name == "bool":
+                return np.dtype(np.uint8)
             return np.dtype(name)
     return np.dtype(np.float32)
 
@@ -167,6 +173,21 @@ class HostStagingExecutor:
                 _log.error(f"host staging executor failure: {e}")
                 self._core.response_done(response_id, False, str(e))
 
+    @contextlib.contextmanager
+    def _activity(self, names, activity):
+        """Timeline span over every tensor of a response (no-op without
+        a timeline); closed in finally so failures don't leak open
+        spans."""
+        if self._timeline:
+            for n in names:
+                self._timeline.start_activity(n, activity)
+        try:
+            yield
+        finally:
+            if self._timeline:
+                for n in names:
+                    self._timeline.end_activity(n, activity)
+
     def _execute(self, resp, response_id):
         if resp.plane != _native.PLANE_HOST or \
                 resp.op not in (_native.OP_ALLREDUCE, _native.OP_BROADCAST,
@@ -179,49 +200,40 @@ class HostStagingExecutor:
         is_bcast = resp.op == _native.OP_BROADCAST
         activity = "XLA_BROADCAST" if is_bcast else "XLA_ALLREDUCE"
         dtype = _np_from_code(resp.dtype)
-        if dtype == np.bool_:
-            # psum has no bool flavor; byte-identical uint8 works for the
-            # zeros+root-sum broadcast (bool allreduce stays on the ring).
-            dtype = np.dtype(np.uint8)
         counts = [int(np.prod(s)) if s else 1 for s in resp.shapes]
         total = sum(counts)
 
-        if self._timeline:
-            for n in resp.names:
-                self._timeline.start_activity(n, activity)
+        with self._activity(resp.names, activity):
+            # Fuse into one flat host buffer in the response's canonical
+            # order; a joined rank's missing slots stay zero (the
+            # reference AllocateZeros join path). Broadcast rides the
+            # same psum with non-root ranks contributing zeros —
+            # sum(root_value, 0, ...) IS the broadcast, and one program
+            # serves both ops.
+            contribute = not is_bcast or resp.root_rank == self._world.rank
+            fused = np.zeros((total,), dtype)
+            views = {}
+            off = 0
+            for name, count in zip(resp.names, counts):
+                ptrs = self._core.inflight_ptrs(response_id, name)
+                if ptrs is not None:
+                    data_ptr, out_ptr = ptrs
+                    if contribute:
+                        fused[off:off + count] = _as_array(data_ptr, count,
+                                                           dtype)
+                    views[name] = (off, count,
+                                   _as_array(out_ptr or data_ptr, count,
+                                             dtype))
+                off += count
 
-        # Fuse into one flat host buffer in the response's canonical
-        # order; a joined rank's missing slots stay zero (the reference
-        # AllocateZeros join path). Broadcast rides the same psum with
-        # non-root ranks contributing zeros — sum(root_value, 0, ...) IS
-        # the broadcast, and one program serves both ops.
-        contribute = not is_bcast or resp.root_rank == self._world.rank
-        fused = np.zeros((total,), dtype)
-        views = {}
-        off = 0
-        for name, count in zip(resp.names, counts):
-            ptrs = self._core.inflight_ptrs(response_id, name)
-            if ptrs is not None:
-                data_ptr, out_ptr = ptrs
-                if contribute:
-                    fused[off:off + count] = _as_array(data_ptr, count,
-                                                       dtype)
-                views[name] = (off, count,
-                               _as_array(out_ptr or data_ptr, count, dtype))
-            off += count
+            if is_bcast:
+                reduced = self._allreduce(fused, _OP_SUM, 1.0, 1.0)
+            else:
+                reduced = self._allreduce(fused, resp.reduce_op,
+                                          resp.prescale, resp.postscale)
 
-        if is_bcast:
-            reduced = self._allreduce(fused, _OP_SUM, 1.0, 1.0)
-        else:
-            reduced = self._allreduce(fused, resp.reduce_op, resp.prescale,
-                                      resp.postscale)
-
-        for name, (off, count, out_view) in views.items():
-            np.copyto(out_view, reduced[off:off + count])
-
-        if self._timeline:
-            for n in resp.names:
-                self._timeline.end_activity(n, activity)
+            for name, (off, count, out_view) in views.items():
+                np.copyto(out_view, reduced[off:off + count])
 
     def _execute_allgather(self, resp, response_id):
         """Staged allgatherv: ALL of the fused response's tensors pack
@@ -235,59 +247,50 @@ class HostStagingExecutor:
         rank = self._world.rank
         size = self._world.size
         dtype = _np_from_code(resp.dtype)
-        if dtype == np.bool_:
-            dtype = np.dtype(np.uint8)
 
-        if self._timeline:
-            for n in resp.names:
-                self._timeline.start_activity(n, "XLA_ALLGATHER")
+        with self._activity(resp.names, "XLA_ALLGATHER"):
+            # Region plan: (name, offset, counts, fd, ptrs) per tensor.
+            regions = []
+            off = 0
+            for i, name in enumerate(resp.names):
+                shape = resp.shapes[i]
+                trailing = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+                fd = (resp.first_dims[i]
+                      if i < len(resp.first_dims) and resp.first_dims[i]
+                      else ((shape[0] if shape else 1,) * size))
+                counts = [int(d) * trailing for d in fd]
+                ptrs = self._core.inflight_ptrs(response_id, name)
+                regions.append((name, off, counts, fd, ptrs))
+                off += max(int(d) for d in fd) * trailing
 
-        # Region plan: (offset, region_len, counts, fd, ptrs) per tensor.
-        regions = []
-        off = 0
-        for i, name in enumerate(resp.names):
-            shape = resp.shapes[i]
-            trailing = int(np.prod(shape[1:])) if len(shape) > 1 else 1
-            fd = (resp.first_dims[i]
-                  if i < len(resp.first_dims) and resp.first_dims[i]
-                  else ((shape[0] if shape else 1,) * size))
-            counts = [int(d) * trailing for d in fd]
-            region = max(int(d) for d in fd) * trailing
-            regions.append((name, off, region, counts, fd))
-            off += region
+            # Bucket the padded length so ragged/sparse steps reuse
+            # compiled programs instead of recompiling per distinct size
+            # (and the program cache stays bounded).
+            bucket = 128
+            while bucket < off:
+                bucket *= 2
+            buf = np.zeros((bucket,), dtype)
+            for name, roff, counts, fd, ptrs in regions:
+                if ptrs is not None:
+                    buf[roff:roff + counts[rank]] = _as_array(
+                        ptrs[0], counts[rank], dtype)
 
-        # Bucket the padded length so ragged/sparse steps reuse compiled
-        # programs instead of recompiling per distinct size (and the
-        # program cache stays bounded).
-        bucket = 128
-        while bucket < off:
-            bucket *= 2
-        buf = np.zeros((bucket,), dtype)
-        for name, roff, region, counts, fd in regions:
-            ptrs = self._core.inflight_ptrs(response_id, name)
-            if ptrs is not None:
-                buf[roff:roff + counts[rank]] = _as_array(
-                    ptrs[0], counts[rank], dtype)
+            gathered = self._allgather(buf)          # [size, bucket]
 
-        gathered = self._allgather(buf)              # [size, bucket]
-
-        for name, roff, region, counts, fd in regions:
-            ptrs = self._core.inflight_ptrs(response_id, name)
-            if ptrs is None:
-                continue  # joined rank's missing slot
-            out = np.concatenate(
-                [gathered[r, roff: roff + counts[r]] for r in range(size)])
-            if ptrs[1]:
-                # Caller-preallocated output (equal-shape fast path).
-                np.copyto(_as_array(ptrs[1], out.shape[0], dtype), out)
-            else:
-                handle = self._core.inflight_handle(response_id, name)
-                if handle >= 0:
-                    self._core.store_result(handle, out.tobytes(),
-                                            tuple(int(d) for d in fd))
-        if self._timeline:
-            for n in resp.names:
-                self._timeline.end_activity(n, "XLA_ALLGATHER")
+            for name, roff, counts, fd, ptrs in regions:
+                if ptrs is None:
+                    continue  # joined rank's missing slot
+                out = np.concatenate(
+                    [gathered[r, roff: roff + counts[r]]
+                     for r in range(size)])
+                if ptrs[1]:
+                    # Caller-preallocated output (equal-shape fast path).
+                    np.copyto(_as_array(ptrs[1], out.shape[0], dtype), out)
+                else:
+                    handle = self._core.inflight_handle(response_id, name)
+                    if handle >= 0:
+                        self._core.store_result(handle, out.tobytes(),
+                                                tuple(int(d) for d in fd))
 
     def _allgather(self, buf):
         import jax

@@ -304,6 +304,17 @@ class EagerEngine:
             self._name_counter += 1
             return f"{prefix}.noname.{self._name_counter}"
 
+    def _stage_same_device(self, ts, device_inputs: bool):
+        """Chained collectives hand back per-chip views committed to
+        different devices; stacking those is illegal in jax, so stage
+        them on one device first (a device-to-device move, no host hop).
+        No-op for host inputs or single-device lists."""
+        if device_inputs and \
+                len({next(iter(t.devices())) for t in ts}) > 1:
+            target = self._state.local_devices[0]
+            ts = [jax.device_put(t, target) for t in ts]
+        return ts
+
     def _normalize(self, tensor) -> Tuple[jnp.ndarray, bool, bool, bool]:
         """Returns (stacked [local_size, ...] array, was_list,
         was_unstacked, was_device). ``was_device`` marks inputs that were
@@ -316,15 +327,8 @@ class EagerEngine:
                     f"eager collective got a list of {len(tensor)} tensors; "
                     f"expected local_size={L} (one per locally-driven chip)")
             dev = all(isinstance(t, jax.Array) for t in tensor)
-            ts = [jnp.asarray(t) for t in tensor]
-            if dev and len({
-                    next(iter(t.devices())) for t in ts}) > 1:
-                # Chained collectives hand back per-chip views living on
-                # different devices; stage them on one device (a
-                # device-to-device move, still no host hop) so stacking is
-                # legal.
-                target = self._state.local_devices[0]
-                ts = [jax.device_put(t, target) for t in ts]
+            ts = self._stage_same_device([jnp.asarray(t) for t in tensor],
+                                         dev)
             return jnp.stack(ts), True, False, dev
         dev = isinstance(tensor, jax.Array)
         t = jnp.asarray(tensor)
@@ -676,14 +680,8 @@ class EagerEngine:
                     # nccl_operations.cc:402-523).
                     sizes = tuple(t.shape[0] for t in ts)
                     max0 = max(sizes)
-                    if all(isinstance(t, jax.Array) for t in tensor) and \
-                            len({next(iter(t.devices()))
-                                 for t in ts}) > 1:
-                        # Chained collectives hand back per-chip views on
-                        # different devices; stage on one device so the
-                        # stack below is legal (same as _normalize).
-                        target = self._state.local_devices[0]
-                        ts = [jax.device_put(t, target) for t in ts]
+                    ts = self._stage_same_device(
+                        ts, all(isinstance(t, jax.Array) for t in tensor))
                     padded = jnp.stack([
                         jnp.pad(t, [(0, max0 - t.shape[0])] +
                                 [(0, 0)] * (t.ndim - 1)) for t in ts])

@@ -49,10 +49,14 @@ static void WriteShape(Writer* w, const TensorShape& s) {
 static TensorShape ReadShape(Reader* r) {
   int32_t nd = r->i32();
   std::vector<int64_t> dims;
-  if (nd >= 0 && nd < 256) {
-    dims.reserve(nd);
-    for (int i = 0; i < nd; ++i) dims.push_back(r->i64());
+  if (nd < 0 || nd >= 256) {
+    // Out-of-range rank is a malformed frame, not a skippable field:
+    // skipping the payload would leave the reader misaligned.
+    r->fail();
+    return TensorShape(std::move(dims));
   }
+  dims.reserve(nd);
+  for (int i = 0; i < nd; ++i) dims.push_back(r->i64());
   return TensorShape(std::move(dims));
 }
 
@@ -84,10 +88,14 @@ static Request ReadRequest(Reader* r) {
   q.prescale = r->f64();
   q.postscale = r->f64();
   int32_t nc = r->i32();
-  if (nc >= 0 && nc <= (1 << 16)) {
-    q.chip_dims.reserve(nc);
-    for (int32_t i = 0; i < nc; ++i) q.chip_dims.push_back(r->i64());
+  if (nc < 0 || nc > (1 << 16)) {
+    // Malformed count: reject the frame instead of skipping the payload
+    // and parsing every subsequent request from a misaligned offset.
+    r->fail();
+    return q;
   }
+  q.chip_dims.reserve(nc);
+  for (int32_t i = 0; i < nc; ++i) q.chip_dims.push_back(r->i64());
   return q;
 }
 
@@ -115,7 +123,10 @@ bool DeserializeRequestList(const std::string& bytes,
   if (n < 0 || n > (1 << 24)) return false;
   reqs->clear();
   reqs->reserve(n);
-  for (int i = 0; i < n; ++i) reqs->push_back(ReadRequest(&r));
+  for (int i = 0; i < n; ++i) {
+    reqs->push_back(ReadRequest(&r));
+    if (!r.ok()) return false;  // don't accumulate garbage past a bad frame
+  }
   int32_t nc = r.i32();
   if (nc < 0 || nc > (1 << 24)) return false;
   cached_ids->clear();
@@ -207,6 +218,7 @@ bool DeserializeResponseList(const std::string& bytes,
       p.first_dims.push_back(std::move(fd));
     }
     resps->push_back(std::move(p));
+    if (!r.ok()) return false;  // same bail as the request loop
   }
   return r.ok();
 }

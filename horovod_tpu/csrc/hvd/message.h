@@ -41,6 +41,10 @@ class Reader {
   Reader(const char* p, size_t n) : p_(p), end_(p + n) {}
   explicit Reader(const std::string& s) : Reader(s.data(), s.size()) {}
   bool ok() const { return ok_; }
+  // Callers mark structurally invalid content (e.g. an out-of-range
+  // element count) as a parse failure; continuing past it would leave
+  // the reader misaligned and every later field parsing as garbage.
+  void fail() { ok_ = false; }
   uint8_t u8() { return static_cast<uint8_t>(*take(1)); }
   int32_t i32() { int32_t v = 0; memcpy_(&v, 4); return v; }
   int64_t i64() { int64_t v = 0; memcpy_(&v, 8); return v; }

@@ -94,7 +94,8 @@ def run(fn, args=(), kwargs=None, num_proc: Optional[int] = None,
     import threading
 
     from ..run.common.util import secret
-    from .exec import SparkDriverService, run_via_task_services, task_main
+    from .exec import (SparkDriverService, run_via_task_services,
+                       shutdown_registered_tasks, task_main)
 
     key = secret.make_secret_key()
     driver = SparkDriverService(num_proc, key, nics=nics)
@@ -122,6 +123,14 @@ def run(fn, args=(), kwargs=None, num_proc: Optional[int] = None,
         driver.wait_for_initial_registration(timeout)
         results = run_via_task_services(
             driver, fn, args, kwargs, num_proc, key, env=env)
+    except Exception:
+        # Exit paths that never reach run_via_task_services (registration
+        # timeout with a partial world) still owe ShutdownRequest to the
+        # tasks that DID register — without it they serve forever and leak
+        # their executor slots. Idempotent on the paths that already shut
+        # down inside run_via_task_services.
+        shutdown_registered_tasks(driver, num_proc, key)
+        raise
     finally:
         spark_thread.join(timeout=30)
         driver.shutdown()

@@ -285,6 +285,21 @@ def _best_effort_shutdown(routable, key):
             pass
 
 
+def shutdown_registered_tasks(driver, num_proc: int, key: bytes) -> None:
+    """Best-effort ShutdownRequest to every task that has registered
+    addresses with ``driver``. Driver exit paths that never reach
+    ``run_via_task_services`` (e.g. a registration timeout with a partial
+    world) call this so the tasks that DID register don't serve forever:
+    ``task_main`` waits on ``wait_for_shutdown(None)``, and a leaked Spark
+    task holds its executor slot for the application's lifetime."""
+    registered = {}
+    for i in range(num_proc):
+        addrs = driver.task_addresses_for_driver(i)
+        if addrs:
+            registered[i] = addrs
+    _best_effort_shutdown(registered, key)
+
+
 def _exec_round(driver, clients, routable, fn, args, kwargs, num_proc,
                 exec_timeout, env):
     # Topology: tasks grouped by executor hostname, ranks in task order

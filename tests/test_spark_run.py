@@ -173,3 +173,33 @@ def test_exec_round_without_spark():
             if p.is_alive():
                 p.terminate()
         driver.shutdown()
+
+
+def test_registration_timeout_shuts_down_registered_tasks():
+    """A registration timeout (partial world) must still send
+    ShutdownRequest to the tasks that DID register — otherwise task_main
+    serves wait_for_shutdown(None) forever and leaks its executor slot
+    (round-3 advisor finding)."""
+    import multiprocessing as mp
+
+    from horovod_tpu.run.common.util import secret
+    from horovod_tpu.spark.exec import (
+        SparkDriverService, shutdown_registered_tasks, task_main)
+
+    key = secret.make_secret_key()
+    driver = SparkDriverService(2, key)  # expects 2, only 1 will register
+    ctx = mp.get_context("spawn")
+    p = ctx.Process(target=task_main, args=(0, driver.addresses(), key))
+    p.start()
+    try:
+        with pytest.raises(TimeoutError):
+            driver.wait_for_initial_registration(5)
+        # The fix: the driver's error path shuts down registered tasks.
+        shutdown_registered_tasks(driver, 2, key)
+        p.join(timeout=30)
+        assert not p.is_alive(), \
+            "registered task kept serving after the driver gave up"
+    finally:
+        if p.is_alive():
+            p.terminate()
+        driver.shutdown()

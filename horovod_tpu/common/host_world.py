@@ -171,14 +171,19 @@ class HostWorld:
                 # no controller or ring needed.
                 self._core = None
             self._staging = None
-            if self._core is not None and self._owns_core:
+            if self._core is not None:
                 from . import host_staging
 
                 # Opt-in fast fabric for large host tensors
                 # (HOROVOD_HOST_VIA_XLA=1): fused allreduces above the
                 # threshold stage through the XLA plane instead of the
-                # TCP ring. No-op unless the env knob is set.
-                self._staging = host_staging.maybe_activate(self, self._core)
+                # TCP ring. Called on every multi-process world — ranks
+                # without the knob (or with a borrowed engine core) vote
+                # "no" in the unanimity agreement rather than skipping
+                # it, so per-host env drift degrades to the ring instead
+                # of deadlocking the voters.
+                self._staging = host_staging.maybe_activate(
+                    self, self._core, owns_exec_slot=self._owns_core)
             self.initialized = True
 
     def _maybe_elastic_rerendezvous(self):

@@ -64,7 +64,21 @@ class MetricAverageCallbackImpl:
 class LearningRateScheduleCallbackImpl:
     """Multiply the initial LR by ``multiplier`` (a constant or a function
     of epoch) inside ``[start_epoch, end_epoch)`` (parity:
-    ``_keras/callbacks.py:89-141``)."""
+    ``_keras/callbacks.py:89-141``).
+
+    ``momentum_correction=True`` applies the Goyal et al. momentum
+    correction whenever the LR changes: the SGD velocity buffers carry the
+    old LR's scale, so they are rescaled by ``new_lr / old_lr`` at the
+    adjusting batch. The reference gets the same effect by scaling the
+    ``momentum`` *coefficient* for one batch and restoring it afterwards
+    (``_keras/callbacks.py:125-139``) — arithmetically identical for that
+    batch (``m * (r * v) == (m * r) * v``), but the coefficient in Keras 3
+    is a plain Python float baked into the compiled train step, so this
+    build scales the velocity slot *variables* instead, which take effect
+    under compiled ``fit()``. Applies to optimizers exposing a nonzero
+    ``momentum`` with ``momentums`` slot variables (SGD); others are
+    untouched, like the reference's ``hasattr(optimizer, 'momentum')``
+    gate."""
 
     def __init__(self, backend, multiplier, start_epoch=0, end_epoch=None,
                  staircase=True, momentum_correction=True, steps_per_epoch=None,
@@ -76,7 +90,6 @@ class LearningRateScheduleCallbackImpl:
         self.staircase = staircase
         self.momentum_correction = momentum_correction
         self.initial_lr = initial_lr
-        self.restore_momentum = None
         self.steps_per_epoch = steps_per_epoch
         self.current_epoch = 0
         if not callable(multiplier):
@@ -111,6 +124,33 @@ class LearningRateScheduleCallbackImpl:
         except AttributeError:
             return float(var)
 
+    def _momentum_slots(self):
+        """The optimizer's velocity slot variables, when the correction
+        applies (nonzero scalar momentum + built slots); else None."""
+        opt = self.model.optimizer
+        try:
+            momentum = float(getattr(opt, "momentum", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            return None
+        if not momentum:
+            return None
+        return getattr(opt, "momentums", None) or None
+
+    def _adjust_learning_rate(self, epoch):
+        old_lr = self._get_lr()
+        new_lr = self.initial_lr * self.multiplier(epoch)
+        self._set_lr(new_lr)
+        if not self.momentum_correction or new_lr == old_lr or old_lr <= 0:
+            return
+        slots = self._momentum_slots()
+        if not slots:
+            # Unbuilt slots (before the first update) hold zero velocity;
+            # nothing to rescale.
+            return
+        ratio = new_lr / old_lr
+        for v in slots:
+            v.assign(v * ratio)
+
     def on_train_begin(self, logs=None):
         if self.initial_lr is None:
             self.initial_lr = self._get_lr()
@@ -123,13 +163,19 @@ class LearningRateScheduleCallbackImpl:
 
     def on_epoch_begin(self, epoch, logs=None):
         self.current_epoch = epoch
-        if self.staircase and self._in_range(epoch):
-            self._set_lr(self.initial_lr * self.multiplier(epoch))
 
     def on_batch_begin(self, batch, logs=None):
-        if not self.staircase and self._in_range(self.current_epoch):
+        # Reference semantics (_keras/callbacks.py:150-162): staircase
+        # adjusts on the first batch of every in-range epoch, continuous
+        # schedules on every batch — both at batch-begin so the momentum
+        # correction lands on exactly the update it compensates.
+        if not self._in_range(self.current_epoch):
+            return
+        if self.staircase and batch == 0:
+            self._adjust_learning_rate(self.current_epoch)
+        elif not self.staircase:
             epoch = self.current_epoch + float(batch) / self.steps_per_epoch
-            self._set_lr(self.initial_lr * self.multiplier(epoch))
+            self._adjust_learning_rate(epoch)
 
     def on_epoch_end(self, epoch, logs=None):
         if logs is not None:

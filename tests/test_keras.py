@@ -97,9 +97,101 @@ def test_lr_schedule_callback(khvd):
     cb.set_model(model)
     cb.on_train_begin()
     cb.on_epoch_begin(0)
+    cb.on_batch_begin(0)
     assert float(model.optimizer.learning_rate.numpy()) == pytest.approx(0.1)
     cb.on_epoch_begin(1)
+    cb.on_batch_begin(0)
     assert float(model.optimizer.learning_rate.numpy()) == pytest.approx(0.05)
+
+
+def _momentum_model(lr=0.4, momentum=0.9):
+    model = _tiny_model()
+    model.compile(optimizer=keras.optimizers.SGD(learning_rate=lr,
+                                                 momentum=momentum),
+                  loss="mse")
+    x = np.random.RandomState(0).rand(8, 4).astype(np.float32)
+    y = (x.sum(axis=1, keepdims=True) * 0.5).astype(np.float32)
+    # One real fit step so the optimizer builds its velocity slots and
+    # they hold nonzero state carrying the current LR's scale.
+    model.fit(x, y, batch_size=8, epochs=1, verbose=0)
+    return model
+
+
+def test_lr_schedule_momentum_correction(khvd):
+    """Goyal et al. momentum correction: when the schedule changes the LR,
+    the SGD velocity buffers are rescaled by new_lr/old_lr (the runtime-
+    effective equivalent of the reference's coefficient scale+restore,
+    reference _keras/callbacks.py:125-139)."""
+    from horovod_tpu.keras.callbacks import LearningRateScheduleCallback
+
+    model = _momentum_model(lr=0.4)
+    before = [v.numpy().copy() for v in model.optimizer.momentums]
+    assert any(np.abs(b).sum() > 0 for b in before)
+
+    cb = LearningRateScheduleCallback(multiplier=0.5, start_epoch=1)
+    cb.set_model(model)
+    cb.on_train_begin()
+    cb.on_epoch_begin(1)
+    cb.on_batch_begin(0)
+    assert float(model.optimizer.learning_rate.numpy()) == pytest.approx(0.2)
+    after = [v.numpy() for v in model.optimizer.momentums]
+    for b, a in zip(before, after):
+        np.testing.assert_allclose(a, b * 0.5, rtol=1e-6)
+    # The coefficient itself is untouched (it is a compiled constant in
+    # Keras 3 — the correction lives in the buffers).
+    assert float(model.optimizer.momentum) == pytest.approx(0.9)
+    # Second batch of the same epoch: staircase adjusts only at batch 0,
+    # so no further rescale.
+    cb.on_batch_begin(1)
+    again = [v.numpy() for v in model.optimizer.momentums]
+    for a, g in zip(after, again):
+        np.testing.assert_allclose(g, a, rtol=1e-7)
+
+
+def test_lr_schedule_momentum_correction_disabled(khvd):
+    from horovod_tpu.keras.callbacks import LearningRateScheduleCallback
+
+    model = _momentum_model(lr=0.4)
+    before = [v.numpy().copy() for v in model.optimizer.momentums]
+    cb = LearningRateScheduleCallback(multiplier=0.5, start_epoch=1,
+                                      momentum_correction=False)
+    cb.set_model(model)
+    cb.on_train_begin()
+    cb.on_epoch_begin(1)
+    cb.on_batch_begin(0)
+    assert float(model.optimizer.learning_rate.numpy()) == pytest.approx(0.2)
+    after = [v.numpy() for v in model.optimizer.momentums]
+    for b, a in zip(before, after):
+        np.testing.assert_allclose(a, b, rtol=1e-7)
+
+
+def test_warmup_momentum_correction_each_batch(khvd):
+    """The warmup ramp changes the LR every batch; each change rescales
+    the velocity by that batch's new_lr/old_lr, so over consecutive
+    batches the buffers track the LR exactly (compounding ratios)."""
+    from horovod_tpu.keras.callbacks import LearningRateWarmupCallback
+
+    model = _momentum_model(lr=0.8)
+    cb = LearningRateWarmupCallback(warmup_epochs=2, steps_per_epoch=4)
+    cb.set_model(model)
+    # Pretend a 4-process world so the ramp is non-trivial at size 1.
+    cb.multiplier = lambda epoch: 0.25 + epoch * (1 - 0.25) / 2
+    cb.on_train_begin()
+    cb.on_epoch_begin(0)
+
+    lr0 = float(model.optimizer.learning_rate.numpy())
+    v0 = [v.numpy().copy() for v in model.optimizer.momentums]
+    cb.on_batch_begin(0)
+    lr1 = float(model.optimizer.learning_rate.numpy())
+    v1 = [v.numpy() for v in model.optimizer.momentums]
+    for b, a in zip(v0, v1):
+        np.testing.assert_allclose(a, b * (lr1 / lr0), rtol=1e-6)
+    cb.on_batch_begin(1)
+    lr2 = float(model.optimizer.learning_rate.numpy())
+    assert lr2 > lr1
+    v2 = [v.numpy() for v in model.optimizer.momentums]
+    for b, a in zip(v0, v2):
+        np.testing.assert_allclose(a, b * (lr2 / lr0), rtol=1e-6)
 
 
 def test_lr_warmup_callback_ramps(khvd):

@@ -119,6 +119,10 @@ def _build_parser():
     parser.add_argument("--num-warmup", type=int, default=5)
     parser.add_argument("--num-iters", type=int, default=30)
     parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--fence-each", action="store_true",
+                        help="fence every timed iteration and report "
+                             "steps/sec with a 95%% CI (regression-canary "
+                             "mode; trades pipelining for variance data)")
     return parser
 
 
@@ -162,9 +166,12 @@ def supervise(argv):
                        "--num-warmup", str(args.num_warmup),
                        "--num-iters", str(args.num_iters),
                        "--image-size", str(args.image_size)]
+        if args.fence_each:
+            worker_args.append("--fence-each")
         result = _run_worker(worker_args, dict(os.environ), WORKER_TIMEOUT_S)
         if result is not None:
             result["platform"] = platform
+            result["comparable"] = True
             if device_kind:
                 result["device_kind"] = device_kind
             peak = _peak_flops(device_kind)
@@ -177,7 +184,12 @@ def supervise(argv):
               file=sys.stderr)
 
     # CPU fallback: tiny workload so it completes in bounded time, but the
-    # same train-step path so the number is honest (just small). Strip the
+    # same train-step path so the number is honest (just small). Pinned
+    # workload (batch 4, 2 warmup, 6 fenced iters) with a per-step 95% CI
+    # so consecutive fallback runs are comparable as a regression canary —
+    # but the machine itself is shared and threads are not pinned, so the
+    # JSON is explicitly labeled non-comparable against accelerator
+    # numbers AND against fallback runs on other machines. Strip the
     # accelerator plugin's activation var: its sitecustomize registration
     # can hang `import jax` even under JAX_PLATFORMS=cpu when the device
     # tunnel is wedged — which is exactly the situation this fallback
@@ -185,17 +197,20 @@ def supervise(argv):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PALLAS_AXON_POOL_IPS", None)
-    result = _run_worker(["--batch-size", "4", "--num-warmup", "1",
-                          "--num-iters", "2", "--image-size",
-                          str(args.image_size)], env,
+    result = _run_worker(["--batch-size", "4", "--num-warmup", "2",
+                          "--num-iters", "6", "--fence-each",
+                          "--image-size", str(args.image_size)], env,
                          CPU_FALLBACK_TIMEOUT_S)
     if result is not None:
         result["platform"] = "cpu-fallback"
+        result["comparable"] = False
         result["note"] = ("TPU tunnel unreachable at bench time; this is "
                           "the bounded CPU fallback, not an accelerator "
-                          "number. Last measured on-chip (v5e): 1882 "
-                          "img/s/chip at bs32, 1910 at bs64 "
-                          "(docs/benchmarks.md).")
+                          "number (comparable=false: shared machine, "
+                          "unpinned threads — use steps_per_sec +- ci95 "
+                          "only as a same-machine drift canary). Last "
+                          "driver-verified on-chip (v5e): see "
+                          "docs/benchmarks.md.")
         print(json.dumps(result))
         return 0
 
@@ -256,22 +271,37 @@ def worker(argv):
     if args.num_warmup > 0:
         float(np.asarray(loss))
 
+    step_times = []
     t0 = time.perf_counter()
     for _ in range(args.num_iters):
+        t1 = time.perf_counter()
         state, loss = step(state, images, labels)
+        if args.fence_each:
+            float(np.asarray(loss))
+            step_times.append(time.perf_counter() - t1)
     float(np.asarray(loss))
     dt = time.perf_counter() - t0
 
     img_per_sec = global_batch * args.num_iters / dt
     img_per_sec_per_chip = img_per_sec / n
 
-    print(json.dumps({
+    result = {
         "metric": "resnet50_images_per_sec_per_chip",
         "value": round(img_per_sec_per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(
             img_per_sec_per_chip / BASELINE_IMG_PER_SEC_PER_DEVICE, 3),
-    }))
+    }
+    if step_times:
+        # Per-step rates + a 95% CI (the reference benchmark's
+        # mean +- 1.96*std protocol, pytorch_synthetic_benchmark.py:115).
+        rates = [1.0 / t for t in step_times]
+        mean = sum(rates) / len(rates)
+        var = sum((r - mean) ** 2 for r in rates) / len(rates)
+        result["steps_per_sec"] = round(mean, 4)
+        result["steps_per_sec_ci95"] = round(
+            1.96 * var ** 0.5 / len(rates) ** 0.5, 4)
+    print(json.dumps(result))
     return 0
 
 

@@ -250,6 +250,21 @@ def make_zero_train_step(model, optimizer: optax.GradientTransformation,
         # its own compilation instead of an opaque shape error from a
         # stale spec.
         treedef, shapes, dtypes, _, total = _flat_spec(state.params)
+        # Surgery on params without rebuilding the state leaves master/
+        # optimizer shards sized for the OLD tree; catch that here with a
+        # descriptive error instead of an opaque shard_map shape failure
+        # (round-2 advisor finding).
+        expected_padded = _shard_len(total, d) * d
+        actual_padded = int(np.prod(state.pshard.shape))
+        if actual_padded != expected_padded:
+            raise ValueError(
+                f"ZeroTrainState shards were built for a different "
+                f"parameter tree: params flatten to {total} elements "
+                f"(padded {expected_padded}) but pshard holds "
+                f"{actual_padded}. After changing the model's parameter "
+                f"structure, rebuild the state with "
+                f"init_zero_train_state(...) instead of reusing the old "
+                f"one.")
         key = (treedef, tuple(shapes), tuple(str(dt) for dt in dtypes),
                state.gaccum is None)
         if key not in cache:

@@ -23,8 +23,20 @@ def free_port() -> int:
     return port
 
 
+# A stolen port manifests as a controller world-join failure: rank 0's
+# bind fails outright, or the squatter accepts the connection and the
+# job-key hello handshake rejects it — both funnel into these messages.
+# Anything else (assertion failures, crashes, timeouts) is a real bug and
+# must not be retried away.
+_PORT_CLASH_MARKERS = (
+    "world join failed",
+    "Address already in use",
+    "EADDRINUSE",
+)
+
+
 def run_world(tmp_path, script_text, sentinel, size=2, timeout=240,
-              args_for_rank=None, drop_env=()):
+              args_for_rank=None, drop_env=(), attempts=3):
     """Write ``script_text`` and run ``size`` ranks of it.
 
     Each rank's argv is ``[rank, *args_for_rank(rank, port)]`` (default:
@@ -32,8 +44,12 @@ def run_world(tmp_path, script_text, sentinel, size=2, timeout=240,
     any failure or timeout the remaining workers are killed before the
     assertion propagates. ``drop_env`` names vars stripped from the
     workers' environment — needed for vars that act at interpreter
-    startup (sitecustomize), before the script body can unset them."""
-    port = free_port()
+    startup (sitecustomize), before the script body can unset them.
+
+    free_port() has a TOCTOU window (another process can bind the port
+    between probe and worker startup); failures that look like a port
+    clash — and ONLY those — are retried with a fresh port, up to
+    ``attempts`` worlds total."""
     script = tmp_path / "worker.py"
     script.write_text(script_text)
     env = dict(os.environ)
@@ -42,18 +58,40 @@ def run_world(tmp_path, script_text, sentinel, size=2, timeout=240,
         env.pop(name, None)
     if args_for_rank is None:
         args_for_rank = lambda rank, port: [str(port)]  # noqa: E731
-    procs = [subprocess.Popen(
-        [sys.executable, str(script), str(r),
-         *[str(a) for a in args_for_rank(r, port)]], env=env,
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-        for r in range(size)]
-    try:
-        for r, p in enumerate(procs):
-            out, _ = p.communicate(timeout=timeout)
-            assert p.returncode == 0, f"rank {r} failed:\n{out}"
+
+    for attempt in range(attempts):
+        port = free_port()
+        procs = [subprocess.Popen(
+            [sys.executable, str(script), str(r),
+             *[str(a) for a in args_for_rank(r, port)]], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+            for r in range(size)]
+        results = []
+        try:
+            for r, p in enumerate(procs):
+                out, _ = p.communicate(timeout=timeout)
+                results.append((r, p.returncode, out))
+                if p.returncode != 0:
+                    break  # peers can't succeed without this rank
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+        ok = (len(results) == size and
+              all(rc == 0 and f"{sentinel}_{r}_OK" in out
+                  for r, rc, out in results))
+        if ok:
+            return
+        blob = "".join(out for _, _, out in results)
+        if attempt + 1 < attempts and \
+                any(m in blob for m in _PORT_CLASH_MARKERS):
+            print(f"proc_harness: suspected port clash on port {port} "
+                  f"(attempt {attempt + 1}/{attempts}); retrying with a "
+                  f"fresh port", file=sys.stderr)
+            continue
+        for r, rc, out in results:
+            assert rc == 0, f"rank {r} failed:\n{out}"
             assert f"{sentinel}_{r}_OK" in out, out
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-                p.wait()
+        raise AssertionError(
+            f"only {len(results)}/{size} ranks reported")

@@ -66,3 +66,9 @@ def test_bench_supervisor_probe_and_fallback(monkeypatch, capsys):
         [ln for ln in out.splitlines() if ln.startswith("{")][-1])
     assert parsed["platform"] == "cpu-fallback"
     assert parsed["value"] > 0
+    # Canary contract (round-3 verdict): the fallback is explicitly
+    # labeled non-comparable and carries per-step rate + CI so two runs
+    # on the same machine can be checked for drift.
+    assert parsed["comparable"] is False
+    assert parsed["steps_per_sec"] > 0
+    assert parsed["steps_per_sec_ci95"] >= 0

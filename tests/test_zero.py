@@ -169,3 +169,38 @@ def test_zero_gradient_accumulation(setup):
     # Mismatched state/step configuration fails loudly.
     with pytest.raises(ValueError):
         stepa(zb, imgs, lbls)
+
+
+def test_zero_model_surgery_stale_state_errors(setup):
+    """Changing the params tree without rebuilding the state must raise
+    the descriptive rebuild error, not an opaque shard_map shape failure
+    (round-2 advisor finding)."""
+    import flax.linen as nn
+
+    hvd = setup
+    mesh = hvd.mesh()
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            return nn.Dense(10)(nn.relu(nn.Dense(16)(
+                x.reshape((x.shape[0], -1)))))
+
+    model = MLP()
+    opt = optax.sgd(0.1)
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.zeros((1, 8, 8, 3), jnp.float32)
+    imgs = np.random.RandomState(0).rand(16, 8, 8, 3).astype(np.float32)
+    lbls = np.random.RandomState(1).randint(0, 10, 16).astype(np.int32)
+    imgs, lbls = shard_batch((jnp.asarray(imgs), jnp.asarray(lbls)), mesh)
+
+    zstate = init_zero_train_state(model, opt, rng, sample, mesh)
+    zstep = make_zero_train_step(model, opt, mesh)
+    zstate, _ = zstep(zstate, imgs, lbls)
+
+    # Surgery: widen one layer's params, keep the old shards.
+    surgered = jax.tree_util.tree_map(
+        lambda p: jnp.concatenate([p, p], axis=-1), zstate.params)
+    stale = zstate._replace(params=surgered)
+    with pytest.raises(ValueError, match="rebuild the state"):
+        zstep(stale, imgs, lbls)

@@ -1,0 +1,79 @@
+"""Mosaic lowering smoke tests (VERDICT r3 #4): the Pallas kernels are
+numerically verified in interpret mode, but a kernel that no longer
+*compiles* for TPU would only surface on hardware. ``jax.export`` with
+``platforms=["tpu"]`` runs the actual Mosaic lowering pipeline on a CPU
+host — the exported module must contain the ``tpu_custom_call`` carrying
+the serialized kernel, so lowering regressions fail here, in CI, without
+a chip."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import jax.numpy as jnp  # noqa: E402
+
+import horovod_tpu.ops.pallas_attention as pa  # noqa: E402
+
+
+@pytest.fixture
+def mosaic(monkeypatch):
+    """Force the Mosaic path (use_pallas=True, interpret=False) even on
+    the CPU test host — export lowers for the TPU target platform."""
+    monkeypatch.setattr(pa, "_resolve_dispatch", lambda up: (True, False))
+
+
+def _export_tpu(fn, *args):
+    exp = jax.export.export(jax.jit(fn), platforms=["tpu"])(*args)
+    return exp.mlir_module()
+
+
+def _qkv(B=1, T=1024, H=2, D=128, dtype=jnp.bfloat16):
+    rng = np.random.RandomState(0)
+    mk = lambda: jnp.asarray(rng.randn(B, T, H, D), dtype)  # noqa: E731
+    return mk(), mk(), mk()
+
+
+def test_flash_attention_fwd_lowers_to_mosaic(mosaic):
+    q, k, v = _qkv()
+    txt = _export_tpu(
+        lambda q, k, v: pa.flash_attention(q, k, v, causal=True), q, k, v)
+    assert "tpu_custom_call" in txt
+
+
+def test_flash_attention_bwd_lowers_to_mosaic(mosaic):
+    """The backward kernels (dQ and dK/dV) are newer than the forward and
+    have never run on hardware — their Mosaic lowering is the one most
+    worth guarding."""
+    q, k, v = _qkv()
+
+    def loss(q, k, v):
+        return pa.flash_attention(
+            q, k, v, causal=True).astype(jnp.float32).sum()
+
+    txt = _export_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+    # Forward (rematerialized for residuals) + dq + dkv custom calls.
+    assert txt.count("tpu_custom_call") >= 2
+
+
+def test_ring_attention_block_kernels_lower_to_mosaic(mosaic):
+    """The ring-attention per-block state/grad kernels lower too."""
+    q, k, v = _qkv(T=512)
+
+    def fwd(q, k, v):
+        return pa.flash_attention_block(q, k, v, q_off=0, k_off=0,
+                                        causal=True)
+
+    txt = _export_tpu(fwd, q, k, v)
+    assert "tpu_custom_call" in txt
+
+    def bwd(q, k, v, do, lse, delta):
+        return pa.flash_attention_block_grads(
+            q, k, v, do, lse, delta, q_off=0, k_off=0, causal=True)
+
+    B, T, H, D = q.shape
+    do = jnp.ones_like(q)
+    lse = jnp.zeros((B, H, T), jnp.float32)
+    delta = jnp.zeros((B, H, T), jnp.float32)
+    txt = _export_tpu(bwd, q, k, v, do, lse, delta)
+    assert "tpu_custom_call" in txt

@@ -216,7 +216,12 @@ def test_check_build_report(capsys):
     assert "Available Frameworks" in out
     assert "[X] JAX (native SPMD)" in out
     assert "Available Controllers" in out
+    # Every tensor-operation plane is listed (docs/troubleshooting.md
+    # teaches reading this report — keep them in lockstep).
+    assert "XLA collectives (ICI/DCN)" in out
     assert "host TCP ring" in out
+    assert "host-via-XLA staging" in out
+    assert "Pallas flash attention" in out
     # Handled after the full parse: flag position must not matter.
     assert run_commandline(["--check-build"]) == 0
     assert run_commandline(["--check-build", "--verbose"]) == 0

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Opportunistic TPU-window harvester.
+
+The judging environment reaches the TPU through a tunnel that is up in
+short windows. This script is the "the moment it answers, capture
+everything" play from VERDICT r3: one cheap probe, then a fixed sequence
+of time-boxed capture phases, each in its own subprocess so a wedged
+backend can't take the harvester down. Artifacts land in docs/probes/
+with timestamps; phases keep going even when earlier ones fail.
+
+Usage: python tools/harvest_tpu.py [--skip bench32,bench64,pallas,profile]
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "docs", "probes")
+
+
+def probe(timeout=160):
+    code = ("import jax; d=jax.devices()[0]; "
+            "print(d.platform, getattr(d,'device_kind',''))")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None
+    out = (r.stdout or "").strip()
+    return out if out.startswith("tpu") else None
+
+
+def phase(name, cmd, timeout):
+    ts = time.strftime("%Y%m%dT%H%M%S")
+    out_path = os.path.join(OUT, f"{name}_{ts}.out")
+    err_path = os.path.join(OUT, f"{name}_{ts}.err")
+    print(f"harvest: {name} (timeout {timeout}s) -> {out_path}",
+          file=sys.stderr)
+    t0 = time.time()
+    try:
+        with open(out_path, "w") as fo, open(err_path, "w") as fe:
+            r = subprocess.run(cmd, stdout=fo, stderr=fe, timeout=timeout,
+                               cwd=REPO)
+        rc = r.returncode
+    except subprocess.TimeoutExpired:
+        rc = "timeout"
+    print(f"harvest: {name} rc={rc} ({time.time()-t0:.0f}s)",
+          file=sys.stderr)
+    with open(out_path) as f:
+        tail = f.read()[-1500:]
+    if tail.strip():
+        print(tail, file=sys.stderr)
+    return rc == 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--skip", default="")
+    args = p.parse_args(argv)
+    skip = set(filter(None, args.skip.split(",")))
+    os.makedirs(OUT, exist_ok=True)
+
+    got = probe()
+    if not got:
+        print("harvest: TPU tunnel down (probe failed); nothing captured",
+              file=sys.stderr)
+        return 1
+    print(f"harvest: tunnel OPEN ({got}) — capturing", file=sys.stderr)
+
+    py = sys.executable
+    plan = [
+        ("bench32", [py, "bench.py"], 900),
+        ("pallas", [py, "tools/pallas_bench.py"], 900),
+        ("profile", [py, "tools/profile_resnet.py"], 700),
+        ("bench64", [py, "bench.py", "--batch-size", "64"], 700),
+        ("bench128", [py, "bench.py", "--batch-size", "128"], 700),
+    ]
+    results = {}
+    for name, cmd, to in plan:
+        if name in skip:
+            continue
+        results[name] = phase(name, cmd, to)
+    print(f"harvest: done {results}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

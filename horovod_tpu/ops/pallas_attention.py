@@ -607,8 +607,10 @@ def _pick_block(t: int, cap: int) -> Optional[int]:
     """Largest MXU-friendly tile (multiple of the fp32 sublane count, up
     to ``cap``) that divides ``t``; None when ``t`` isn't tileable
     (callers fall back to the XLA path rather than reason about
-    padded-position masking)."""
-    for c in (512, 256, 128, 64, 32, 16, 8):
+    padded-position masking). Candidates extend above the 512 default so
+    a BLOCK_Q/BLOCK_K override (tools/pallas_bench.py --sweep-blocks)
+    genuinely changes the tiling."""
+    for c in (2048, 1024, 512, 256, 128, 64, 32, 16, 8):
         if c <= cap and t % c == 0:
             return c
     return None

@@ -1,0 +1,166 @@
+// Wire-codec robustness harness (built and run by
+// tests/test_native.py::test_message_codec_robustness).
+//
+// Exercises the compact codec the way the reference's FlatBuffers schema
+// is implicitly exercised by its verifier: round-trips, structurally
+// malformed frames (out-of-range counts must REJECT the frame, not skip
+// payload bytes and parse the rest misaligned — the round-3 advisor
+// finding), truncations at every length, and a deterministic mutation
+// fuzz loop. Exits 0 when every property holds.
+
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "../../horovod_tpu/csrc/hvd/message.h"
+
+using namespace hvd;
+
+namespace {
+
+Request MakeRequest(int i) {
+  Request q;
+  q.rank = i;
+  q.op = i % 2 ? CollectiveOp::ALLGATHER : CollectiveOp::ALLREDUCE;
+  q.reduce_op = ReduceOp::SUM;
+  q.dtype = DataType::HVD_BFLOAT16;
+  q.plane = DevicePlane::HOST;
+  q.root_rank = i;
+  q.name = "tensor_" + std::to_string(i);
+  q.shape = TensorShape({i + 1, 7});
+  q.prescale = 0.5;
+  q.postscale = 2.0;
+  q.chip_dims = {i + 1, i + 2};
+  return q;
+}
+
+std::string Serialize(int n) {
+  std::vector<Request> reqs;
+  for (int i = 0; i < n; ++i) reqs.push_back(MakeRequest(i));
+  return SerializeRequestList(reqs, {1u, 2u, 3u}, false);
+}
+
+bool Parse(const std::string& bytes, std::vector<Request>* out) {
+  std::vector<uint32_t> ids;
+  bool shutdown = false;
+  return DeserializeRequestList(bytes, out, &ids, &shutdown);
+}
+
+int failures = 0;
+#define CHECK(cond, what)                                         \
+  do {                                                            \
+    if (!(cond)) {                                                \
+      std::fprintf(stderr, "FAIL: %s\n", what);                   \
+      ++failures;                                                 \
+    }                                                             \
+  } while (0)
+
+}  // namespace
+
+int main() {
+  // 1. Round trip.
+  std::string wire = Serialize(3);
+  std::vector<Request> reqs;
+  CHECK(Parse(wire, &reqs), "roundtrip parses");
+  CHECK(reqs.size() == 3, "roundtrip count");
+  CHECK(reqs[1].name == "tensor_1", "roundtrip name");
+  CHECK(reqs[2].chip_dims == std::vector<int64_t>({3, 4}),
+        "roundtrip chip_dims");
+
+  // 2. Malformed chip_dims count: find the serialized count for request 0
+  // (follows rank/op/reduce/dtype/plane/root/name/shape/scales) and stomp
+  // it; the frame must be REJECTED, not parsed misaligned.
+  {
+    std::string one = Serialize(1);
+    // The chip_dims count is the last i32 before the two chip dim i64s
+    // and the trailing cached-ids block (count 3 + 3 i32s).
+    size_t tail = 4 + 3 * 4 + 2 * 8;  // cached block + chip payload
+    size_t count_off = one.size() - tail - 4;
+    int32_t bad = -7;
+    std::string mut = one;
+    std::memcpy(&mut[count_off], &bad, 4);
+    std::vector<Request> r;
+    CHECK(!Parse(mut, &r), "negative chip_dims count rejects frame");
+    bad = (1 << 20);
+    std::memcpy(&mut[count_off], &bad, 4);
+    CHECK(!Parse(mut, &r), "huge chip_dims count rejects frame");
+  }
+
+  // 3. A malformed frame with MULTIPLE requests must not yield garbage
+  // requests parsed from the misaligned offset.
+  {
+    std::string two = Serialize(2);
+    // Stomp request 0's shape rank (first i32 after the name bytes).
+    size_t name_pos = two.find("tensor_0");
+    size_t rank_off = name_pos + std::strlen("tensor_0");
+    int32_t bad = 300;  // >= 256: invalid rank
+    std::string mut = two;
+    std::memcpy(&mut[rank_off], &bad, 4);
+    std::vector<Request> r;
+    CHECK(!Parse(mut, &r), "invalid shape rank rejects frame");
+    CHECK(r.size() <= 1, "no garbage requests accumulated past bad frame");
+  }
+
+  // 4. Every truncation either fails or (never) fabricates trailing data.
+  for (size_t len = 0; len < wire.size(); ++len) {
+    std::vector<Request> r;
+    if (Parse(wire.substr(0, len), &r)) {
+      CHECK(false, "truncated frame accepted");
+      break;
+    }
+  }
+
+  // 5. Deterministic single-byte mutation fuzz: parsing must terminate
+  // and either reject or produce a bounded, well-formed result. (An
+  // xorshift PRNG; no libc rand dependency.)
+  uint64_t s = 0x9E3779B97F4A7C15ull;
+  auto next = [&s]() {
+    s ^= s << 13; s ^= s >> 7; s ^= s << 17; return s;
+  };
+  for (int it = 0; it < 20000; ++it) {
+    std::string mut = wire;
+    size_t pos = next() % mut.size();
+    mut[pos] = static_cast<char>(next() & 0xFF);
+    std::vector<Request> r;
+    if (Parse(mut, &r)) {
+      // Accepted mutants must still be structurally sane.
+      CHECK(r.size() <= 3, "mutant parsed with inflated request count");
+      for (const auto& q : r) {
+        CHECK(q.name.size() <= 64, "mutant name bounded");
+        CHECK(q.chip_dims.size() <= (1u << 16), "mutant chip_dims bounded");
+      }
+    }
+    if (failures) break;
+  }
+
+  // 6. Response list: same early-bail property.
+  {
+    Response p;
+    p.tensor_names = {"a", "b"};
+    p.shapes = {TensorShape({2, 2}), TensorShape({3})};
+    p.first_dims = {{2, 2}, {3, 3}};
+    std::string rw = SerializeResponseList({p, p}, 1.5, 1 << 20, 2);
+    std::vector<Response> rs;
+    double cyc; int64_t fus; int hf;
+    CHECK(DeserializeResponseList(rw, &rs, &cyc, &fus, &hf),
+          "response roundtrip");
+    CHECK(rs.size() == 2 && rs[1].first_dims[1][0] == 3,
+          "response roundtrip content");
+    // Stomp response 0's first shape rank; frame must reject without
+    // accumulating a garbage second response.
+    size_t apos = rw.find('a');
+    int32_t bad = 999;
+    std::string mut = rw;
+    std::memcpy(&mut[apos + 1], &bad, 4);
+    std::vector<Response> r2;
+    CHECK(!DeserializeResponseList(mut, &r2, &cyc, &fus, &hf),
+          "invalid response shape rank rejects frame");
+    CHECK(r2.size() <= 1, "no garbage responses past bad frame");
+  }
+
+  if (failures) return 1;
+  std::puts("MESSAGE_CODEC_OK");
+  return 0;
+}

@@ -209,6 +209,39 @@ def test_lr_warmup_callback_ramps(khvd):
     assert float(model.optimizer.learning_rate.numpy()) == pytest.approx(0.8)
 
 
+def test_warmup_callback_through_fit(khvd):
+    """Integration: model.fit drives the warmup callback's batch hooks
+    (on_train_batch_begin -> on_batch_begin in Keras 3), the LR ramps,
+    and training still converges with momentum correction active."""
+    from horovod_tpu.keras.callbacks import LearningRateWarmupCallback
+
+    model = _tiny_model()
+    model.compile(optimizer=keras.optimizers.SGD(learning_rate=0.2,
+                                                 momentum=0.9),
+                  loss="mse")
+    x = np.random.RandomState(0).rand(32, 4).astype(np.float32)
+    y = (x.sum(axis=1, keepdims=True) * 0.5).astype(np.float32)
+
+    cb = LearningRateWarmupCallback(warmup_epochs=2, steps_per_epoch=4)
+    # Non-trivial ramp at size 1: pretend a 4-process world.
+    cb.multiplier = lambda epoch: 0.25 + epoch * (1 - 0.25) / 2
+    lrs = []
+
+    class Spy(keras.callbacks.Callback):
+        def on_train_batch_end(self, batch, logs=None):
+            lrs.append(float(model.optimizer.learning_rate.numpy()))
+
+    h0 = model.evaluate(x, y, verbose=0)
+    model.fit(x, y, batch_size=8, epochs=2, verbose=0,
+              callbacks=[cb, Spy()])
+    assert len(lrs) == 8
+    # Strictly increasing ramp across the warmup batches, ending at the
+    # full LR's neighborhood.
+    assert all(b > a for a, b in zip(lrs, lrs[1:])), lrs
+    assert lrs[0] < 0.1 and lrs[-1] > 0.15, lrs
+    assert model.evaluate(x, y, verbose=0) < h0
+
+
 def test_elastic_keras_callbacks(khvd):
     from horovod_tpu.elastic.state import ObjectState
     from horovod_tpu.keras.callbacks import (

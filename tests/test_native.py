@@ -2,12 +2,16 @@
 2-process TCP controller + ring data-plane run (the reference's
 mpirun-launched Pattern-1 tests, SURVEY §4, done with subprocesses)."""
 
+import os
 import textwrap
 
 import numpy as np
 import pytest
 
 from horovod_tpu.common import native as hn
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TESTS_DIR)
 
 
 def _run_workers(tmp_path, script_text, sentinel, size=2, timeout=120,
@@ -593,3 +597,23 @@ def test_job_key_rejects_cross_job_worker(tmp_path):
     loudly while the healthy job keeps accepting and completes its
     collectives."""
     _run_workers(tmp_path, _JOBKEY_WORKER, "JOBKEY", size=3)
+
+
+def test_message_codec_robustness(tmp_path):
+    """Builds and runs the C++ wire-codec harness (tests/csrc/
+    test_message.cc): round-trips, malformed counts rejecting the whole
+    frame (round-3 advisor finding — no misaligned parsing past a bad
+    field), truncations, and a deterministic mutation fuzz loop."""
+    import subprocess
+
+    src = os.path.join(TESTS_DIR, "csrc", "test_message.cc")
+    msg_cc = os.path.join(REPO, "horovod_tpu", "csrc", "hvd", "message.cc")
+    binary = tmp_path / "test_message"
+    subprocess.run(
+        ["g++", "-O1", "-std=c++17", "-Wall", src, msg_cc, "-o",
+         str(binary)],
+        check=True, timeout=120)
+    r = subprocess.run([str(binary)], capture_output=True, text=True,
+                       timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "MESSAGE_CODEC_OK" in r.stdout

@@ -77,6 +77,8 @@ def main(argv=None):
         ("profile", [py, "tools/profile_resnet.py"], 700),
         ("bench64", [py, "bench.py", "--batch-size", "64"], 700),
         ("bench128", [py, "bench.py", "--batch-size", "128"], 700),
+        ("pallas_sweep", [py, "tools/pallas_bench.py", "--sweep-blocks",
+                          "--seq-lens", "2048", "--iters", "10"], 1200),
     ]
     results = {}
     for name, cmd, to in plan:

@@ -20,33 +20,55 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def bench_one(T, iters, batch, heads, dim, causal=True):
-    import jax
+def _make_qkv(T, batch, heads, dim):
     import jax.numpy as jnp
     import numpy as np
 
-    from horovod_tpu.ops.pallas_attention import flash_attention
-
     rng = np.random.RandomState(0)
     shape = (batch, T, heads, dim)
-    q = jnp.asarray(rng.randn(*shape), jnp.bfloat16)
-    k = jnp.asarray(rng.randn(*shape), jnp.bfloat16)
-    v = jnp.asarray(rng.randn(*shape), jnp.bfloat16)
+    mk = lambda: jnp.asarray(rng.randn(*shape), jnp.bfloat16)  # noqa: E731
+    return mk(), mk(), mk()
 
-    def make(use_pallas):
-        fwd = jax.jit(lambda q, k, v: flash_attention(
-            q, k, v, causal=causal, use_pallas=use_pallas))
 
-        def loss(q, k, v):
-            return flash_attention(
-                q, k, v, causal=causal, use_pallas=use_pallas
-            ).astype(jnp.float32).sum()
+def _make_fns(use_pallas, causal):
+    import jax
+    import jax.numpy as jnp
 
-        bwd = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-        return fwd, bwd
+    from horovod_tpu.ops.pallas_attention import flash_attention
 
-    p_fwd, p_bwd = make(True)
-    x_fwd, x_bwd = make(False)
+    fwd = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, causal=causal, use_pallas=use_pallas))
+
+    def loss(q, k, v):
+        return flash_attention(
+            q, k, v, causal=causal, use_pallas=use_pallas
+        ).astype(jnp.float32).sum()
+
+    bwd = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    return fwd, bwd
+
+
+def _clock(fn, iters, *args):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3  # ms
+
+
+def bench_one(T, iters, batch, heads, dim, causal=True, xla_ms=None):
+    """Mosaic vs XLA at the current BLOCK_Q/BLOCK_K. ``xla_ms`` —
+    {"fwd": ms, "bwd": ms} from a previous call — skips re-timing the
+    block-size-invariant XLA baseline (the sweep reuses it)."""
+    import numpy as np
+
+    q, k, v = _make_qkv(T, batch, heads, dim)
+    p_fwd, p_bwd = _make_fns(True, causal)
+    x_fwd, x_bwd = _make_fns(False, causal)
 
     # Numerics: Mosaic vs the XLA oracle on the SAME device.
     po = np.asarray(p_fwd(q, k, v), np.float32)
@@ -59,19 +81,13 @@ def bench_one(T, iters, batch, heads, dim, causal=True):
                             - np.asarray(b, np.float32))))
         for a, b in zip(pg, xg))
 
-    def clock(fn, *args):
-        out = fn(*args)
-        jax.block_until_ready(out)
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn(*args)
-        jax.block_until_ready(out)
-        return (time.perf_counter() - t0) / iters * 1e3  # ms
-
+    if xla_ms is None:
+        xla_ms = {"fwd": _clock(x_fwd, iters, q, k, v),
+                  "bwd": _clock(x_bwd, iters, q, k, v)}
     rows = []
-    for phase, pf, xf in (("fwd", p_fwd, x_fwd), ("bwd", p_bwd, x_bwd)):
-        p_ms = clock(pf, q, k, v)
-        x_ms = clock(xf, q, k, v)
+    for phase, pf in (("fwd", p_fwd), ("bwd", p_bwd)):
+        p_ms = _clock(pf, iters, q, k, v)
+        x_ms = xla_ms[phase]
         rows.append({
             "seq_len": T, "phase": phase, "batch": batch, "heads": heads,
             "head_dim": dim, "causal": causal,
@@ -80,7 +96,36 @@ def bench_one(T, iters, batch, heads, dim, causal=True):
             "maxerr_vs_xla": round(
                 fwd_maxerr if phase == "fwd" else bwd_maxerr, 4),
         })
-    return rows
+    return rows, xla_ms
+
+
+def sweep_blocks(T, iters, batch, heads, dim):
+    """Time the Mosaic kernels across (BLOCK_Q, BLOCK_K) tilings — run on
+    an open tunnel window to pick the VMEM-fit sweet spot per chip
+    generation. Fresh jit wrappers per config re-trace with the patched
+    module constants."""
+    import horovod_tpu.ops.pallas_attention as pa
+
+    orig = (pa.BLOCK_Q, pa.BLOCK_K)
+    xla_ms = None  # block-size-invariant: timed once, reused across configs
+    try:
+        for bq in (256, 512, 1024):
+            for bk in (256, 512, 1024):
+                pa.BLOCK_Q, pa.BLOCK_K = bq, bk
+                try:
+                    rows, xla_ms = bench_one(T, iters, batch, heads, dim,
+                                             xla_ms=xla_ms)
+                except Exception as e:  # VMEM overflow etc.: report, go on
+                    print(json.dumps({"seq_len": T, "block_q": bq,
+                                      "block_k": bk,
+                                      "error": str(e)[:200]}))
+                    continue
+                for row in rows:
+                    row["block_q"], row["block_k"] = bq, bk
+                    print(json.dumps(row))
+                    sys.stdout.flush()
+    finally:
+        pa.BLOCK_Q, pa.BLOCK_K = orig
 
 
 def main(argv=None):
@@ -90,6 +135,8 @@ def main(argv=None):
     p.add_argument("--batch", type=int, default=4)
     p.add_argument("--heads", type=int, default=8)
     p.add_argument("--dim", type=int, default=128)
+    p.add_argument("--sweep-blocks", action="store_true",
+                   help="sweep (BLOCK_Q, BLOCK_K) tilings per seq len")
     args = p.parse_args(argv)
 
     import jax
@@ -97,10 +144,14 @@ def main(argv=None):
     print(json.dumps({"platform": d.platform,
                       "device_kind": getattr(d, "device_kind", "")}))
     for T in [int(t) for t in args.seq_lens.split(",")]:
-        for row in bench_one(T, args.iters, args.batch, args.heads,
-                             args.dim):
-            print(json.dumps(row))
-            sys.stdout.flush()
+        if args.sweep_blocks:
+            sweep_blocks(T, args.iters, args.batch, args.heads, args.dim)
+        else:
+            rows, _ = bench_one(T, args.iters, args.batch, args.heads,
+                                args.dim)
+            for row in rows:
+                print(json.dumps(row))
+                sys.stdout.flush()
     return 0
 
 

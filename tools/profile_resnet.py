@@ -103,8 +103,10 @@ def summarize(trace_dir, meta, args):
             stats_meta = plane.stat_metadata
             for line in plane.lines:
                 ln = line.name.lower()
-                # Skip derived/step lines; XLA Ops carry the real timings.
-                if "step" in ln or "framework" in ln:
+                # Skip derived lines (steps, framework annotations, and
+                # the whole-module spans that would double-count every
+                # op); the "XLA Ops" line carries the real timings.
+                if "step" in ln or "framework" in ln or "module" in ln:
                     continue
                 for ev in line.events:
                     md = ev_meta.get(ev.metadata_id)
@@ -117,10 +119,8 @@ def summarize(trace_dir, meta, args):
                     cat = ""
                     for st in ev.stats:
                         smd = stats_meta.get(st.metadata_id)
-                        if smd is not None and smd.name in (
-                                "equation", "hlo_category"):
-                            if smd.name == "hlo_category":
-                                cat = st.str_value
+                        if smd is not None and smd.name == "hlo_category":
+                            cat = st.str_value
                     if cat:
                         per_cat[cat] += dur
     if not per_op:

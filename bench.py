@@ -123,6 +123,11 @@ def _build_parser():
                         help="fence every timed iteration and report "
                              "steps/sec with a 95%% CI (regression-canary "
                              "mode; trades pipelining for variance data)")
+    parser.add_argument("--space-to-depth", action="store_true",
+                        help="use the MXU space-to-depth stem (exact "
+                             "re-tiling of the 7x7/s2 stem conv; "
+                             "models/resnet.py) — A/B flag for on-chip "
+                             "MFU work")
     return parser
 
 
@@ -168,6 +173,8 @@ def supervise(argv):
                        "--image-size", str(args.image_size)]
         if args.fence_each:
             worker_args.append("--fence-each")
+        if args.space_to_depth:
+            worker_args.append("--space-to-depth")
         result = _run_worker(worker_args, dict(os.environ), WORKER_TIMEOUT_S)
         if result is not None:
             result["platform"] = platform
@@ -197,10 +204,14 @@ def supervise(argv):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PALLAS_AXON_POOL_IPS", None)
-    result = _run_worker(["--batch-size", "4", "--num-warmup", "2",
-                          "--num-iters", "6", "--fence-each",
-                          "--image-size", str(args.image_size)], env,
-                         CPU_FALLBACK_TIMEOUT_S)
+    fallback_args = ["--batch-size", "4", "--num-warmup", "2",
+                     "--num-iters", "6", "--fence-each",
+                     "--image-size", str(args.image_size)]
+    if args.space_to_depth:
+        # Keep workload flags so an A/B artifact isn't silently the
+        # baseline workload under the variant's label.
+        fallback_args.append("--space-to-depth")
+    result = _run_worker(fallback_args, env, CPU_FALLBACK_TIMEOUT_S)
     if result is not None:
         result["platform"] = "cpu-fallback"
         result["comparable"] = False
@@ -244,7 +255,8 @@ def worker(argv):
     n = hvd.size()
     mesh = hvd.mesh()
 
-    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16,
+                     space_to_depth_stem=args.space_to_depth)
     optimizer = optax.sgd(0.01, momentum=0.9)
 
     rng = jax.random.PRNGKey(0)

@@ -68,6 +68,41 @@ class BasicBlock(nn.Module):
         return self.act(residual + y)
 
 
+def space_to_depth(x, block: int = 2):
+    """[N, H, W, C] -> [N, H/b, W/b, b*b*C]; channel order is
+    (dh, dw, c) — the layout :func:`stem_weights_to_s2d` maps onto."""
+    n, h, w, c = x.shape
+    b = block
+    x = x.reshape(n, h // b, b, w // b, b, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(
+        n, h // b, w // b, b * b * c)
+
+
+def stem_weights_to_s2d(w):
+    """Exact re-tiling of a 7x7/stride-2 stem kernel [7, 7, C, F] into
+    the equivalent 4x4/stride-1 kernel [4, 4, 4*C, F] over
+    space-to-depth(2) input: new tap (m, n) with sub-position (dh, dw)
+    carries original tap kh = 2m + dh, kw = 2n + dw (m, n in 0..3, so
+    kh, kw in 0..7; the pad books balance because XLA SAME's pad_lo=2
+    for k=7/s=2 equals 2x the s2d conv's pad_lo=1). The one
+    out-of-range slot per axis (kh or kw = 7) stays zero."""
+    import numpy as np
+
+    kh_, kw_, c, f = w.shape
+    assert (kh_, kw_) == (7, 7), "stem re-tiling is for the 7x7 kernel"
+    w2 = np.zeros((4, 4, 4 * c, f), np.asarray(w).dtype)
+    for m in range(4):
+        for n in range(4):
+            for dh in range(2):
+                for dw in range(2):
+                    kh = 2 * m + dh
+                    kw = 2 * n + dw
+                    if 0 <= kh < 7 and 0 <= kw < 7:
+                        w2[m, n, (dh * 2 + dw) * c:(dh * 2 + dw + 1) * c] \
+                            = np.asarray(w)[kh, kw]
+    return w2
+
+
 class ResNet(nn.Module):
     stage_sizes: Sequence[int]
     block_cls: ModuleDef
@@ -75,6 +110,13 @@ class ResNet(nn.Module):
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
     act: Callable = nn.relu
+    # MXU stem (the public MLPerf ResNet trick): the 7x7/stride-2 conv on
+    # 3-channel input uses 3 of the MXU's 128 input lanes; space-to-depth
+    # by 2 turns it into an equivalent 4x4/stride-1 conv on 12 channels
+    # (4x the lane utilization, same FLOPs, bit-identical function class —
+    # stem_weights_to_s2d maps any original kernel exactly). Opt-in so
+    # checkpoints keep the reference layout by default.
+    space_to_depth_stem: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -89,7 +131,12 @@ class ResNet(nn.Module):
                                  momentum=0.9, epsilon=1e-5,
                                  dtype=self.dtype, param_dtype=jnp.float32)
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        if self.space_to_depth_stem:
+            x = space_to_depth(x, 2)
+            x = conv(self.num_filters, (4, 4), (1, 1),
+                     padding=((1, 2), (1, 2)), name="conv_init_s2d")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = self.act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")

@@ -1,0 +1,90 @@
+"""Model-zoo correctness: the space-to-depth MXU stem is an exact
+re-tiling of the reference 7x7/stride-2 stem, not an approximation."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import jax.numpy as jnp  # noqa: E402
+from flax import linen as nn  # noqa: E402
+
+from horovod_tpu.models.resnet import (  # noqa: E402
+    ResNet50, space_to_depth, stem_weights_to_s2d)
+
+
+def test_space_to_depth_layout():
+    x = np.arange(2 * 4 * 4 * 3, dtype=np.float32).reshape(2, 4, 4, 3)
+    y = np.asarray(space_to_depth(jnp.asarray(x)))
+    assert y.shape == (2, 2, 2, 12)
+    # Channel order (dh, dw, c): block (0,0) of image 0 holds rows 0-1,
+    # cols 0-1.
+    np.testing.assert_array_equal(y[0, 0, 0, 0:3], x[0, 0, 0])     # dh0 dw0
+    np.testing.assert_array_equal(y[0, 0, 0, 3:6], x[0, 0, 1])     # dh0 dw1
+    np.testing.assert_array_equal(y[0, 0, 0, 6:9], x[0, 1, 0])     # dh1 dw0
+    np.testing.assert_array_equal(y[0, 0, 0, 9:12], x[0, 1, 1])    # dh1 dw1
+
+
+def test_s2d_stem_exactly_matches_7x7_stride2():
+    """conv(4x4, s1, pad (1,2)) over space_to_depth(x) with re-tiled
+    weights == conv(7x7, s2, SAME) over x — element for element, so the
+    MXU stem changes performance, never the function."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 32, 32, 3), jnp.float32)
+    w = jnp.asarray(rng.randn(7, 7, 3, 16), jnp.float32)
+
+    ref = jax.lax.conv_general_dilated(
+        x, w, window_strides=(2, 2), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    w2 = jnp.asarray(stem_weights_to_s2d(w))
+    got = jax.lax.conv_general_dilated(
+        space_to_depth(x), w2, window_strides=(1, 1),
+        padding=((1, 2), (1, 2)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    assert got.shape == ref.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_resnet_s2d_stem_forward():
+    """The flagged model builds, runs, and matches output shape; with
+    re-tiled weights grafted in, the stem path produces the same logits
+    as the reference stem given identical downstream params."""
+    model_ref = ResNet50(num_classes=10, dtype=jnp.float32)
+    model_s2d = ResNet50(num_classes=10, dtype=jnp.float32,
+                         space_to_depth_stem=True)
+    rng = jax.random.PRNGKey(0)
+    x = jnp.asarray(np.random.RandomState(1).randn(2, 64, 64, 3),
+                    jnp.float32)
+
+    vars_ref = model_ref.init(rng, x, train=False)
+    vars_s2d = model_s2d.init(rng, x, train=False)
+
+    # Graft: identical downstream params; stem re-tiled from the ref.
+    params = jax.tree_util.tree_map(lambda a: a, vars_s2d["params"])
+    params = dict(params)
+    ref_params = vars_ref["params"]
+    for k in ref_params:
+        if k == "conv_init":
+            continue
+        params[k] = ref_params[k]
+    params["conv_init_s2d"] = {
+        "kernel": jnp.asarray(
+            stem_weights_to_s2d(ref_params["conv_init"]["kernel"]))}
+
+    out_ref = model_ref.apply(
+        {"params": ref_params, "batch_stats": vars_ref["batch_stats"]},
+        x, train=False)
+    out_s2d = model_s2d.apply(
+        {"params": params, "batch_stats": vars_ref["batch_stats"]},
+        x, train=False)
+    np.testing.assert_allclose(np.asarray(out_s2d), np.asarray(out_ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_s2d_requires_even_hw():
+    with pytest.raises(Exception):
+        nn  # placeholder to keep flax import used
+        space_to_depth(jnp.zeros((1, 5, 5, 3)))

@@ -76,6 +76,7 @@ def main(argv=None):
         ("pallas", [py, "tools/pallas_bench.py"], 900),
         ("profile", [py, "tools/profile_resnet.py"], 700),
         ("bench64", [py, "bench.py", "--batch-size", "64"], 700),
+        ("bench_s2d", [py, "bench.py", "--space-to-depth"], 700),
         ("bench128", [py, "bench.py", "--batch-size", "128"], 700),
         ("pallas_sweep", [py, "tools/pallas_bench.py", "--sweep-blocks",
                           "--seq-lens", "2048", "--iters", "10"], 1200),

@@ -60,34 +60,38 @@ def _clock(fn, iters, *args):
     return (time.perf_counter() - t0) / iters * 1e3  # ms
 
 
-def bench_one(T, iters, batch, heads, dim, causal=True, xla_ms=None):
-    """Mosaic vs XLA at the current BLOCK_Q/BLOCK_K. ``xla_ms`` —
-    {"fwd": ms, "bwd": ms} from a previous call — skips re-timing the
-    block-size-invariant XLA baseline (the sweep reuses it)."""
+def bench_one(T, iters, batch, heads, dim, causal=True, xla_cache=None):
+    """Mosaic vs XLA at the current BLOCK_Q/BLOCK_K. ``xla_cache`` — the
+    dict a previous call returned — skips re-running the
+    block-size-invariant XLA baseline (timings AND the numerics-oracle
+    outputs/grads; the sweep reuses both)."""
     import numpy as np
 
     q, k, v = _make_qkv(T, batch, heads, dim)
     p_fwd, p_bwd = _make_fns(True, causal)
-    x_fwd, x_bwd = _make_fns(False, causal)
+
+    if xla_cache is None:
+        x_fwd, x_bwd = _make_fns(False, causal)
+        xla_cache = {
+            "out": np.asarray(x_fwd(q, k, v), np.float32),
+            "grads": [np.asarray(g, np.float32)
+                      for g in x_bwd(q, k, v)],
+            "ms": {"fwd": _clock(x_fwd, iters, q, k, v),
+                   "bwd": _clock(x_bwd, iters, q, k, v)},
+        }
 
     # Numerics: Mosaic vs the XLA oracle on the SAME device.
     po = np.asarray(p_fwd(q, k, v), np.float32)
-    xo = np.asarray(x_fwd(q, k, v), np.float32)
-    fwd_maxerr = float(np.max(np.abs(po - xo)))
+    fwd_maxerr = float(np.max(np.abs(po - xla_cache["out"])))
     pg = p_bwd(q, k, v)
-    xg = x_bwd(q, k, v)
     bwd_maxerr = max(
-        float(np.max(np.abs(np.asarray(a, np.float32)
-                            - np.asarray(b, np.float32))))
-        for a, b in zip(pg, xg))
+        float(np.max(np.abs(np.asarray(a, np.float32) - b)))
+        for a, b in zip(pg, xla_cache["grads"]))
 
-    if xla_ms is None:
-        xla_ms = {"fwd": _clock(x_fwd, iters, q, k, v),
-                  "bwd": _clock(x_bwd, iters, q, k, v)}
     rows = []
     for phase, pf in (("fwd", p_fwd), ("bwd", p_bwd)):
         p_ms = _clock(pf, iters, q, k, v)
-        x_ms = xla_ms[phase]
+        x_ms = xla_cache["ms"][phase]
         rows.append({
             "seq_len": T, "phase": phase, "batch": batch, "heads": heads,
             "head_dim": dim, "causal": causal,
@@ -96,7 +100,7 @@ def bench_one(T, iters, batch, heads, dim, causal=True, xla_ms=None):
             "maxerr_vs_xla": round(
                 fwd_maxerr if phase == "fwd" else bwd_maxerr, 4),
         })
-    return rows, xla_ms
+    return rows, xla_cache
 
 
 def sweep_blocks(T, iters, batch, heads, dim):
@@ -107,14 +111,14 @@ def sweep_blocks(T, iters, batch, heads, dim):
     import horovod_tpu.ops.pallas_attention as pa
 
     orig = (pa.BLOCK_Q, pa.BLOCK_K)
-    xla_ms = None  # block-size-invariant: timed once, reused across configs
+    xla_cache = None  # block-size-invariant: run once, reused across configs
     try:
         for bq in (256, 512, 1024):
             for bk in (256, 512, 1024):
                 pa.BLOCK_Q, pa.BLOCK_K = bq, bk
                 try:
-                    rows, xla_ms = bench_one(T, iters, batch, heads, dim,
-                                             xla_ms=xla_ms)
+                    rows, xla_cache = bench_one(T, iters, batch, heads,
+                                                dim, xla_cache=xla_cache)
                 except Exception as e:  # VMEM overflow etc.: report, go on
                     print(json.dumps({"seq_len": T, "block_q": bq,
                                       "block_k": bk,

@@ -92,16 +92,20 @@ def _probe_backend(timeout_s):
 
 
 def _run_worker(extra_args, env, timeout_s):
-    """Run the benchmark worker; return its JSON line dict or None."""
+    """Run the benchmark worker; return its JSON line dict or None.
+
+    The worker's stderr is inherited (not captured) so its progress
+    breadcrumbs stream live — when a tunneled backend wedges and the
+    supervisor is killed from outside, the captured log still shows the
+    last phase the worker reached.
+    """
     cmd = [sys.executable, os.path.abspath(__file__), "--worker"] + extra_args
     try:
-        r = subprocess.run(cmd, capture_output=True, text=True,
-                           timeout=timeout_s, env=env)
+        r = subprocess.run(cmd, stdout=subprocess.PIPE, stderr=None,
+                           text=True, timeout=timeout_s, env=env)
     except subprocess.TimeoutExpired:
         print(f"bench: worker timed out after {timeout_s}s", file=sys.stderr)
         return None
-    if r.stderr:
-        sys.stderr.write(r.stderr[-4000:])
     for line in reversed(r.stdout.splitlines()):
         line = line.strip()
         if line.startswith("{"):
@@ -128,6 +132,12 @@ def _build_parser():
                              "re-tiling of the 7x7/s2 stem conv; "
                              "models/resnet.py) — A/B flag for on-chip "
                              "MFU work")
+    parser.add_argument("--no-fallback", action="store_true",
+                        help="exit nonzero instead of running the CPU "
+                             "fallback when the accelerator is "
+                             "unreachable (harvest mode: a fallback "
+                             "artifact is worthless there and burns the "
+                             "window's clock)")
     return parser
 
 
@@ -190,6 +200,14 @@ def supervise(argv):
         print("bench: accelerator worker failed; falling back to CPU",
               file=sys.stderr)
 
+    if args.no_fallback:
+        print(json.dumps({
+            "metric": "resnet50_images_per_sec_per_chip",
+            "value": 0.0, "unit": "images/sec/chip", "vs_baseline": 0.0,
+            "error": "accelerator unreachable and --no-fallback set",
+        }))
+        return 1
+
     # CPU fallback: tiny workload so it completes in bounded time, but the
     # same train-step path so the number is honest (just small). Pinned
     # workload (batch 4, 2 warmup, 6 fenced iters) with a per-step 95% CI
@@ -241,6 +259,15 @@ def worker(argv):
     # completion fence and the throughput numerator.
     args.num_iters = max(1, args.num_iters)
 
+    t_start = time.perf_counter()
+
+    def mark(msg):
+        # Progress breadcrumbs on stderr (streamed live by the
+        # supervisor): when a tunneled backend wedges, the harvest log
+        # shows the last phase reached instead of 900s of silence.
+        print("bench-worker: %s (+%.0fs)" % (msg,
+              time.perf_counter() - t_start), file=sys.stderr, flush=True)
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -251,9 +278,11 @@ def worker(argv):
     from horovod_tpu.training import (
         init_train_state, make_train_step, replicate_state, shard_batch)
 
+    mark("imports done")
     hvd.init()
     n = hvd.size()
     mesh = hvd.mesh()
+    mark(f"backend init done ({n} device(s))")
 
     model = ResNet50(num_classes=1000, dtype=jnp.bfloat16,
                      space_to_depth_stem=args.space_to_depth)
@@ -278,10 +307,12 @@ def worker(argv):
     # final loss depends on every prior step through the donated state
     # chain, and fetching it forces full execution even on remote-tunnel
     # platforms where block_until_ready returns early.
+    mark("state initialized; compiling + warmup")
     for _ in range(args.num_warmup):
         state, loss = step(state, images, labels)
     if args.num_warmup > 0:
         float(np.asarray(loss))
+    mark("warmup fenced; timing")
 
     step_times = []
     t0 = time.perf_counter()
@@ -293,6 +324,7 @@ def worker(argv):
             step_times.append(time.perf_counter() - t1)
     float(np.asarray(loss))
     dt = time.perf_counter() - t0
+    mark(f"timed {args.num_iters} iters in {dt:.1f}s")
 
     img_per_sec = global_batch * args.num_iters / dt
     img_per_sec_per_chip = img_per_sec / n

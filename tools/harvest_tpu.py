@@ -21,16 +21,28 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "docs", "probes")
 
 
-def probe(timeout=160):
-    code = ("import jax; d=jax.devices()[0]; "
-            "print(d.platform, getattr(d,'device_kind',''))")
+def probe(timeout=200):
+    """Compute probe: enumeration alone is not enough — the tunnel has a
+    failure mode where `jax.devices()` answers in seconds but any actual
+    compile/execute wedges forever (observed 2026-07-31: bench32 and
+    pallas each burned a full 900 s phase timeout after a 6 s
+    enumeration). Only a fenced jitted matmul proves the window is real.
+    Returns 'ENUM ... / COMPUTE ...' on success, None otherwise."""
+    code = ("import jax, jax.numpy as jnp; d = jax.devices()[0]; "
+            "print('ENUM', d.platform, getattr(d, 'device_kind', ''), "
+            "flush=True); "
+            "x = jnp.ones((512, 512), jnp.bfloat16); "
+            "y = jax.jit(lambda a: (a @ a).sum())(x); "
+            "print('COMPUTE', float(y), flush=True)")
     try:
         r = subprocess.run([sys.executable, "-c", code],
                            capture_output=True, text=True, timeout=timeout)
     except subprocess.TimeoutExpired:
         return None
     out = (r.stdout or "").strip()
-    return out if out.startswith("tpu") else None
+    if "COMPUTE" in out and out.startswith("ENUM tpu"):
+        return " / ".join(out.splitlines())
+    return None
 
 
 def phase(name, cmd, timeout):
@@ -59,11 +71,19 @@ def phase(name, cmd, timeout):
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--skip", default="")
+    p.add_argument("--loop", type=int, default=0, metavar="SECONDS",
+                   help="keep probing on this cadence until a compute "
+                        "probe succeeds, then capture once and exit")
     args = p.parse_args(argv)
     skip = set(filter(None, args.skip.split(",")))
     os.makedirs(OUT, exist_ok=True)
 
     got = probe()
+    while not got and args.loop > 0:
+        print(time.strftime("harvest: %Y%m%dT%H%M%S compute probe failed; "
+                            f"retrying in {args.loop}s"), file=sys.stderr)
+        time.sleep(args.loop)
+        got = probe()
     if not got:
         print("harvest: TPU tunnel down (probe failed); nothing captured",
               file=sys.stderr)
@@ -71,13 +91,20 @@ def main(argv=None):
     print(f"harvest: tunnel OPEN ({got}) — capturing", file=sys.stderr)
 
     py = sys.executable
+    nf = "--no-fallback"  # a CPU-fallback artifact is worthless here
+    # Value order: headline number first, then the MFU-attribution trace,
+    # then the A/B points, then the kernel microbenches — a window that
+    # closes mid-run should have captured the most decisive artifacts.
+    # Bench phase timeouts must cover bench.py's own worst case (probe
+    # retries ~690 s + worker 1200 s ≈ 1900 s) — a shorter phase timeout
+    # kills a legitimately slow-but-recovering run mid-worker.
     plan = [
-        ("bench32", [py, "bench.py"], 900),
-        ("pallas", [py, "tools/pallas_bench.py"], 900),
+        ("bench32", [py, "bench.py", nf], 2000),
         ("profile", [py, "tools/profile_resnet.py"], 700),
-        ("bench64", [py, "bench.py", "--batch-size", "64"], 700),
-        ("bench_s2d", [py, "bench.py", "--space-to-depth"], 700),
-        ("bench128", [py, "bench.py", "--batch-size", "128"], 700),
+        ("bench_s2d", [py, "bench.py", nf, "--space-to-depth"], 2000),
+        ("bench64", [py, "bench.py", nf, "--batch-size", "64"], 2000),
+        ("pallas", [py, "tools/pallas_bench.py"], 900),
+        ("bench128", [py, "bench.py", nf, "--batch-size", "128"], 2000),
         ("pallas_sweep", [py, "tools/pallas_bench.py", "--sweep-blocks",
                           "--seq-lens", "2048", "--iters", "10"], 1200),
     ]
@@ -86,6 +113,18 @@ def main(argv=None):
         if name in skip:
             continue
         results[name] = phase(name, cmd, to)
+        if not results[name] and probe() is None:
+            # Distinguish "this phase failed" from "the window closed":
+            # a dead tunnel fails every remaining phase too — stop
+            # burning their timeouts. Full probe timeout: a healthy
+            # tunnel can need minutes, and a false "closed" here skips
+            # the rest of a live window. rc 2 tells the caller the run
+            # was truncated (vs 0 = full capture) so a wrapper can
+            # re-enter its probe loop.
+            print("harvest: tunnel closed mid-run; stopping early",
+                  file=sys.stderr)
+            print(f"harvest: done {results}", file=sys.stderr)
+            return 2
     print(f"harvest: done {results}", file=sys.stderr)
     return 0
 

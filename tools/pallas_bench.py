@@ -60,9 +60,26 @@ def _clock(fn, iters, *args):
     return (time.perf_counter() - t0) / iters * 1e3  # ms
 
 
+def _build_xla_cache(T, iters, batch, heads, dim, causal=True):
+    """Run the block-size-invariant XLA baseline once: oracle outputs and
+    grads for the numerics check plus fwd/bwd timings. Built separately
+    from :func:`bench_one` so a Pallas failure (VMEM overflow on one
+    sweep config) can't discard the most expensive part of the run."""
+    import numpy as np
+
+    q, k, v = _make_qkv(T, batch, heads, dim)
+    x_fwd, x_bwd = _make_fns(False, causal)
+    return {
+        "out": np.asarray(x_fwd(q, k, v), np.float32),
+        "grads": [np.asarray(g, np.float32) for g in x_bwd(q, k, v)],
+        "ms": {"fwd": _clock(x_fwd, iters, q, k, v),
+               "bwd": _clock(x_bwd, iters, q, k, v)},
+    }
+
+
 def bench_one(T, iters, batch, heads, dim, causal=True, xla_cache=None):
-    """Mosaic vs XLA at the current BLOCK_Q/BLOCK_K. ``xla_cache`` — the
-    dict a previous call returned — skips re-running the
+    """Mosaic vs XLA at the current BLOCK_Q/BLOCK_K. ``xla_cache`` — a
+    dict from :func:`_build_xla_cache` — skips re-running the
     block-size-invariant XLA baseline (timings AND the numerics-oracle
     outputs/grads; the sweep reuses both)."""
     import numpy as np
@@ -71,14 +88,7 @@ def bench_one(T, iters, batch, heads, dim, causal=True, xla_cache=None):
     p_fwd, p_bwd = _make_fns(True, causal)
 
     if xla_cache is None:
-        x_fwd, x_bwd = _make_fns(False, causal)
-        xla_cache = {
-            "out": np.asarray(x_fwd(q, k, v), np.float32),
-            "grads": [np.asarray(g, np.float32)
-                      for g in x_bwd(q, k, v)],
-            "ms": {"fwd": _clock(x_fwd, iters, q, k, v),
-                   "bwd": _clock(x_bwd, iters, q, k, v)},
-        }
+        xla_cache = _build_xla_cache(T, iters, batch, heads, dim, causal)
 
     # Numerics: Mosaic vs the XLA oracle on the SAME device.
     po = np.asarray(p_fwd(q, k, v), np.float32)
@@ -111,7 +121,9 @@ def sweep_blocks(T, iters, batch, heads, dim):
     import horovod_tpu.ops.pallas_attention as pa
 
     orig = (pa.BLOCK_Q, pa.BLOCK_K)
-    xla_cache = None  # block-size-invariant: run once, reused across configs
+    # Block-size-invariant: built once up front (before any Pallas config
+    # can fail), reused across every config.
+    xla_cache = _build_xla_cache(T, iters, batch, heads, dim)
     try:
         for bq in (256, 512, 1024):
             for bk in (256, 512, 1024):

@@ -173,9 +173,11 @@ def supervise(argv):
         print("bench: backend is cpu-only; using reduced workload",
               file=sys.stderr)
         platform = None
+        fail_reason = "backend is cpu-only"
     elif platform is None:
         print("bench: accelerator backend unreachable; falling back to CPU",
               file=sys.stderr)
+        fail_reason = "accelerator backend unreachable"
     if platform:
         worker_args = ["--batch-size", str(args.batch_size),
                        "--num-warmup", str(args.num_warmup),
@@ -199,12 +201,18 @@ def supervise(argv):
             return 0
         print("bench: accelerator worker failed; falling back to CPU",
               file=sys.stderr)
+        # Enumeration worked but the benchmark itself failed/timed out —
+        # the mid-compute wedge, not an unreachable tunnel. The error
+        # artifact must keep that distinction (it's what the compute
+        # probe in tools/harvest_tpu.py exists to tell apart).
+        fail_reason = ("accelerator worker failed or timed out after "
+                       "a successful backend probe")
 
     if args.no_fallback:
         print(json.dumps({
             "metric": "resnet50_images_per_sec_per_chip",
             "value": 0.0, "unit": "images/sec/chip", "vs_baseline": 0.0,
-            "error": "accelerator unreachable and --no-fallback set",
+            "error": fail_reason + "; --no-fallback set",
         }))
         return 1
 

@@ -10,7 +10,9 @@ reference's DP-only surface (SURVEY §2.5): every mesh axis of
 - **pp**: decoder layers split into stages, GPipe schedule via
   ``parallel.pipeline.spmd_pipeline`` (params sharded over ``pp``).
 - **sp**: sequence/context parallelism — the token axis is sharded and
-  attention runs as ring attention (``parallel.ring_attention``).
+  attention runs as ring attention (``parallel.ring_attention``) or
+  all-to-all Ulysses-style re-sharding (``parallel.ulysses``), selected
+  by ``TransformerConfig.sp_strategy``.
 - **tp**: Megatron-style tensor parallelism — attention heads and MLP
   hidden dim sharded over ``tp``, partial outputs psum'd.
 - **ep**: MoE experts sharded over the dp axis with all_to_all dispatch
@@ -31,7 +33,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..parallel.moe import moe_layer
 from ..parallel.pipeline import spmd_pipeline
-from ..parallel.ring_attention import ring_attention
+from ..parallel.ulysses import context_parallel_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -48,6 +50,11 @@ class TransformerConfig:
     d_expert: int = 128
     capacity_factor: float = 2.0
     dtype: Any = jnp.float32
+    # Sequence-parallel attention strategy over the sp axis: "ring"
+    # (K/V rotation, no head constraint), "ulysses" (all-to-all head
+    # re-shard, needs (n_heads/tp) % sp == 0), or "auto"
+    # (parallel/ulysses.py).
+    sp_strategy: str = "ring"
 
 
 def _param_specs(cfg: TransformerConfig) -> Dict[str, P]:
@@ -141,7 +148,9 @@ def _make_stage_fn(cfg: TransformerConfig):
         h = _layernorm(x, lp["ln1"])
         qkv = jnp.einsum("btd,dchk->btchk", h, lp["wqkv"])  # c=3, h=H/tp
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        attn = ring_attention(q, k, v, axis_name="sp", causal=True)
+        attn = context_parallel_attention(q, k, v, axis_name="sp",
+                                          causal=True,
+                                          strategy=cfg.sp_strategy)
         out = jnp.einsum("bthk,hkd->btd", attn, lp["wo"])
         out = lax.psum(out, "tp")  # combine head shards
         x = x + out

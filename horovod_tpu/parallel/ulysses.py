@@ -1,0 +1,90 @@
+"""All-to-all sequence parallelism (Ulysses-style context parallelism).
+
+The second first-class long-context strategy next to
+:mod:`~horovod_tpu.parallel.ring_attention` (SURVEY §5 "Long-context /
+sequence parallelism"; absent from the reference, which is DP-only).
+Where ring attention keeps the sequence sharded and rotates K/V blocks
+around the ``sp`` ring (sp - 1 ppermute steps, compute/transfer
+overlapped), the all-to-all strategy re-shards once: an ``all_to_all``
+swaps the sequence sharding for a head sharding, every chip runs plain
+flash attention over the FULL sequence for its H/sp heads, and a second
+``all_to_all`` swaps back.
+
+Trade-offs (why both exist):
+
+- **Bytes on the fabric**: all-to-all moves each Q/K/V element once
+  (3 + 1 collectives of (sp-1)/sp of the local block each) — about half
+  the ring's 2 x (sp-1) K/V block rotations. Better when attention
+  compute is too short to hide the ring's rotations behind.
+- **Constraint**: needs ``heads % sp == 0`` (after tp sharding). The
+  ring has no head constraint and its working set stays T_local — the
+  only option when the full sequence doesn't fit one chip's HBM.
+- **Kernel shape**: local attention sees the full sequence, so the
+  Pallas flash kernel runs at its natural tiling with a plain causal
+  mask — no cross-block online-softmax merge.
+
+Autodiff: ``lax.all_to_all`` is linear and differentiable; the backward
+pass is the mirrored pair of all-to-alls around the flash backward — no
+custom VJP needed.
+"""
+
+from __future__ import annotations
+
+from jax import lax
+
+
+def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True):
+    """Context-parallel attention via head<->sequence all-to-all.
+
+    q/k/v: [B, T_local, H, D] per chip, sequence-sharded over
+    ``axis_name``. Returns [B, T_local, H, D] with the same sharding.
+    Requires ``H % axis_size == 0``.
+    """
+    sp = lax.axis_size(axis_name)
+    from ..ops.pallas_attention import flash_attention
+
+    if sp == 1:
+        return flash_attention(q, k, v, causal=causal)
+    heads = q.shape[2]
+    if heads % sp != 0:
+        raise ValueError(
+            f"ulysses_attention needs heads divisible by the '{axis_name}' "
+            f"axis: {heads} heads across {sp} chips (after any tp head "
+            f"sharding). Use ring_attention when heads don't divide.")
+
+    # [B, T_local, H, D] -> [B, T_global, H/sp, D]: split the head axis
+    # sp ways, concatenate the received blocks along the sequence axis.
+    def seq_to_heads(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    o = flash_attention(seq_to_heads(q), seq_to_heads(k), seq_to_heads(v),
+                        causal=causal)
+    return heads_to_seq(o)
+
+
+def context_parallel_attention(q, k, v, axis_name: str = "sp",
+                               causal: bool = True,
+                               strategy: str = "ring"):
+    """Dispatch between the two sequence-parallel attention strategies.
+
+    ``strategy``: ``"ring"`` (default — no head constraint, T_local
+    working set), ``"ulysses"`` (all-to-all re-shard, needs
+    heads % sp == 0), or ``"auto"`` (ulysses when the head constraint
+    holds, ring otherwise).
+    """
+    from .ring_attention import ring_attention
+
+    if strategy == "auto":
+        sp = lax.axis_size(axis_name)
+        strategy = "ulysses" if q.shape[2] % sp == 0 else "ring"
+    if strategy == "ulysses":
+        return ulysses_attention(q, k, v, axis_name=axis_name, causal=causal)
+    if strategy == "ring":
+        return ring_attention(q, k, v, axis_name=axis_name, causal=causal)
+    raise ValueError(f"unknown sequence-parallel strategy {strategy!r}; "
+                     "expected 'ring', 'ulysses', or 'auto'")

@@ -13,6 +13,8 @@ from horovod_tpu.parallel.moe import init_moe_params, moe_layer
 from horovod_tpu.parallel.pipeline import spmd_pipeline
 from horovod_tpu.parallel.ring_attention import (
     local_flash_attention, ring_attention)
+from horovod_tpu.parallel.ulysses import (
+    context_parallel_attention, ulysses_attention)
 
 
 def _reference_attention(q, k, v, causal=True):
@@ -96,6 +98,89 @@ class TestRingAttention:
         g = jax.jit(jax.grad(loss))(q, k, v)
         assert np.isfinite(np.asarray(g)).all()
         assert np.abs(np.asarray(g)).max() > 0
+
+
+class TestUlyssesAttention:
+    def _sharded_fn(self, attn_fn, sp, **kw):
+        mesh = Mesh(np.array(jax.devices()[:sp]), ("sp",))
+        return jax.jit(jax.shard_map(
+            lambda q, k, v: attn_fn(q, k, v, "sp", **kw),
+            mesh=mesh, in_specs=P(None, "sp"), out_specs=P(None, "sp"),
+            check_vma=False))
+
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("sp", [2, 4])
+    def test_matches_reference(self, causal, sp):
+        B, T, H, D = 2, 16, 4, 8
+        rng = np.random.RandomState(0)
+        q, k, v = (rng.randn(B, T, H, D).astype(np.float32)
+                   for _ in range(3))
+        fn = self._sharded_fn(ulysses_attention, sp, causal=causal)
+        out = np.asarray(fn(q, k, v))
+        expected = _reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(out, expected, rtol=2e-4, atol=2e-5)
+
+    def test_grads_match_ring(self):
+        # Both strategies compute the same function; their autodiff
+        # gradients must agree (ulysses: all_to_all transpose; ring:
+        # custom VJP second rotation).
+        B, T, H, D = 1, 8, 2, 4
+        sp = 2
+        rng = np.random.RandomState(3)
+        q, k, v = (jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+                   for _ in range(3))
+
+        def make_loss(attn_fn):
+            fn = self._sharded_fn(attn_fn, sp)
+
+            def loss(q, k, v):
+                return jnp.sum(fn(q, k, v) ** 2)
+            return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+        g_u = make_loss(ulysses_attention)(q, k, v)
+        g_r = make_loss(ring_attention)(q, k, v)
+        for gu, gr in zip(g_u, g_r):
+            np.testing.assert_allclose(np.asarray(gu), np.asarray(gr),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_indivisible_heads_rejected(self):
+        B, T, H, D = 1, 8, 3, 4
+        rng = np.random.RandomState(4)
+        q, k, v = (rng.randn(B, T, H, D).astype(np.float32)
+                   for _ in range(3))
+        with pytest.raises(ValueError, match="divisible"):
+            self._sharded_fn(ulysses_attention, 2)(q, k, v)
+
+    def test_auto_dispatch(self):
+        # H=3 over sp=2 can't use ulysses; auto must fall back to ring.
+        # H=4 takes the ulysses path. Both strategies compute the same
+        # function, so matching the oracle alone can't tell which path
+        # ran — assert the path through the lowered collectives too
+        # (ulysses lowers to all-to-all, ring to collective-permute).
+        B, T, D = 2, 16, 8
+        rng = np.random.RandomState(5)
+        for H, want_ulysses in ((3, False), (4, True)):
+            q, k, v = (rng.randn(B, T, H, D).astype(np.float32)
+                       for _ in range(3))
+            fn = self._sharded_fn(context_parallel_attention, 2,
+                                  strategy="auto")
+            txt = fn.lower(q, k, v).as_text().lower().replace("-", "_")
+            assert ("all_to_all" in txt) == want_ulysses, \
+                f"H={H}: wrong strategy path"
+            assert ("collective_permute" in txt) == (not want_ulysses), \
+                f"H={H}: wrong strategy path"
+            out = np.asarray(fn(q, k, v))
+            expected = _reference_attention(q, k, v, causal=True)
+            np.testing.assert_allclose(out, expected, rtol=2e-4, atol=2e-5)
+
+    def test_unknown_strategy_rejected(self):
+        B, T, H, D = 1, 8, 2, 4
+        rng = np.random.RandomState(6)
+        q, k, v = (rng.randn(B, T, H, D).astype(np.float32)
+                   for _ in range(3))
+        with pytest.raises(ValueError, match="strategy"):
+            self._sharded_fn(context_parallel_attention, 2,
+                             strategy="spiral")(q, k, v)
 
 
 class TestMoE:

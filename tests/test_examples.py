@@ -11,9 +11,19 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run_example(relpath, *extra, timeout=240):
+def _example_env():
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    # The accelerator plugin's sitecustomize registration can hang
+    # `import jax` in a fresh subprocess when the device tunnel is
+    # wedged, even under JAX_PLATFORMS=cpu — strip its activation var
+    # (same hardening as bench.py's CPU fallback).
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
+
+
+def _run_example(relpath, *extra, timeout=240):
+    env = _example_env()
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "examples", relpath), *extra],
         capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
@@ -108,9 +118,7 @@ def test_keras_imagenet_resnet50_tiny(tmp_path):
 def test_mxnet_mnist_example_gates_cleanly():
     # mxnet is absent in this image: the example must exit with the clear
     # gate message, not a traceback.
-    env = dict(os.environ, JAX_PLATFORMS="cpu",
-               PYTHONPATH=REPO + os.pathsep + os.environ.get(
-                   "PYTHONPATH", ""))
+    env = _example_env()
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "examples", "mxnet_mnist.py")],
         capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
@@ -161,3 +169,13 @@ def test_scaling_bench_protocol_runs():
         "--num-warmup", "1", "--num-iters", "2", timeout=420)
     assert '"metric": "scaling_efficiency"' in out
     assert "efficiency vs" in out
+
+
+@pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+def test_long_context_example(strategy):
+    out = _run_example(
+        "jax_long_context.py", "--sp", "2", "--seq-len", "64",
+        "--d-model", "32", "--n-heads", "4", "--n-layers", "2",
+        "--steps", "2", "--strategy", strategy, timeout=420)
+    assert "T_local=32" in out
+    assert "tokens/s" in out

@@ -26,6 +26,18 @@ if _platform == "cpu":
 import pytest  # noqa: E402
 
 
+def subprocess_cpu_env(**overrides):
+    """Environment for test subprocesses that must run on the CPU
+    backend: pins JAX_PLATFORMS and strips the accelerator plugin's
+    activation var, whose sitecustomize registration can hang
+    `import jax` in a fresh process when the device tunnel is wedged —
+    even under JAX_PLATFORMS=cpu (same hardening as bench.py's CPU
+    fallback). The single copy of that knowledge for every test file."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **overrides)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",

@@ -17,13 +17,14 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 @pytest.mark.full
 def test_bench_worker_protocol(tmp_path):
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
-    env.pop("PALLAS_AXON_POOL_IPS", None)  # wedged-tunnel guard
+    from conftest import subprocess_cpu_env
+
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--worker",
          "--batch-size", "2", "--num-warmup", "0", "--num-iters", "1",
          "--image-size", "64"],
-        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+        capture_output=True, text=True, timeout=420,
+        env=subprocess_cpu_env(), cwd=REPO)
     assert proc.returncode == 0, proc.stderr[-2000:]
     line = [ln for ln in proc.stdout.splitlines()
             if ln.strip().startswith("{")][-1]
@@ -32,6 +33,27 @@ def test_bench_worker_protocol(tmp_path):
     assert parsed["value"] > 0
     assert parsed["unit"] == "images/sec/chip"
     assert "vs_baseline" in parsed
+
+
+@pytest.mark.full
+def test_transformer_bench_protocol():
+    from conftest import subprocess_cpu_env
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/transformer_bench.py"),
+         "--d-model", "64", "--n-heads", "4", "--n-layers", "2",
+         "--vocab", "256", "--seq-len", "64", "--batch-size", "4",
+         "--num-warmup", "1", "--num-iters", "2"],
+        capture_output=True, text=True, timeout=420,
+        env=subprocess_cpu_env(), cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.strip().startswith("{")][-1]
+    parsed = json.loads(line)
+    assert parsed["metric"] == "transformer_tokens_per_sec_per_chip"
+    assert parsed["value"] > 0
+    assert parsed["n_params"] > 0
+    assert parsed["loss"] > 0
 
 
 def test_bench_supervisor_probe_and_fallback(monkeypatch, capsys):

@@ -12,14 +12,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _example_env():
-    env = dict(os.environ, JAX_PLATFORMS="cpu",
-               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
-    # The accelerator plugin's sitecustomize registration can hang
-    # `import jax` in a fresh subprocess when the device tunnel is
-    # wedged, even under JAX_PLATFORMS=cpu — strip its activation var
-    # (same hardening as bench.py's CPU fallback).
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    return env
+    from conftest import subprocess_cpu_env
+
+    return subprocess_cpu_env(
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
 
 
 def _run_example(relpath, *extra, timeout=240):

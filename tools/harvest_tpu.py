@@ -103,6 +103,7 @@ def main(argv=None):
         ("profile", [py, "tools/profile_resnet.py"], 700),
         ("bench_s2d", [py, "bench.py", nf, "--space-to-depth"], 2000),
         ("bench64", [py, "bench.py", nf, "--batch-size", "64"], 2000),
+        ("transformer", [py, "tools/transformer_bench.py"], 900),
         ("pallas", [py, "tools/pallas_bench.py"], 900),
         ("bench128", [py, "bench.py", nf, "--batch-size", "128"], 2000),
         ("pallas_sweep", [py, "tools/pallas_bench.py", "--sweep-blocks",

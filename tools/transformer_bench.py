@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Transformer training benchmark: tokens/sec and MFU for a GPT-2-small
+class decoder on the sharded transformer (models/transformer.py).
+
+Widens the headline evidence beyond the ResNet protocol (bench.py): the
+same mesh machinery drives a causal LM step — flash attention, Megatron
+tp sharding, sp context parallelism all exercised by flags. One JSON
+line per run, same discipline as bench.py.
+
+    python tools/transformer_bench.py                  # GPT-2-small-ish
+    python tools/transformer_bench.py --sp 4 --seq-len 8192   # long-ctx
+
+MFU convention: model FLOPs per token = 6*N (N = MATMUL parameter
+count — embedding table and learned positions excluded, untied output
+head included; the standard fwd+bwd estimate with FMA counted as 2)
+plus the attention term 12*L*T*d_attn (QK^T and PV, fwd+bwd, causality
+NOT discounted — the kernel does the full matmul shape unless the
+Pallas path skips masked tiles). Peak table matches bench.py.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+PEAK_FLOPS_BY_KIND = [
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),
+    ("v4", 275e12),
+]
+
+
+def _peak_flops(device_kind):
+    kind = (device_kind or "").lower()
+    for sub, peak in PEAK_FLOPS_BY_KIND:
+        if sub in kind:
+            return peak
+    return None
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--d-model", type=int, default=768)
+    p.add_argument("--n-heads", type=int, default=12)
+    p.add_argument("--n-layers", type=int, default=12)
+    p.add_argument("--vocab", type=int, default=50304,
+                   help="GPT-2 vocab rounded up to a multiple of 128 "
+                        "(lane-aligned for the MXU)")
+    p.add_argument("--seq-len", type=int, default=1024,
+                   help="GLOBAL sequence length")
+    p.add_argument("--batch-size", type=int, default=None,
+                   help="global batch (default: 8 per dp shard)")
+    p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--strategy", default="ring",
+                   choices=["ring", "ulysses", "auto"])
+    p.add_argument("--num-warmup", type=int, default=3)
+    p.add_argument("--num-iters", type=int, default=20)
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from horovod_tpu.models.transformer import (
+        TransformerConfig, init_params, make_train_step, shard_params)
+    from horovod_tpu.parallel.mesh import build_parallel_mesh
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = build_parallel_mesh(jax.devices(), sp=args.sp, tp=args.tp,
+                               pp=1)
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if args.batch_size is None:
+        args.batch_size = 8 * sizes["dp"]
+    d = jax.devices()[0]
+    platform = d.platform
+    kind = getattr(d, "device_kind", "")
+    print(f"bench: mesh {sizes} on {platform} ({kind}); "
+          f"B={args.batch_size} T={args.seq_len}", file=sys.stderr)
+
+    cfg = TransformerConfig(
+        vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
+        d_head=args.d_model // args.n_heads, d_ff=4 * args.d_model,
+        n_layers=args.n_layers, max_seq=args.seq_len, dtype=jnp.bfloat16,
+        sp_strategy=args.strategy)
+    params = init_params(cfg, jax.random.PRNGKey(0), n_stages=1)
+    n_params = sum(int(np.prod(v.shape)) for v in params.values())
+    # The 6N estimate counts matmul params only: the embedding table and
+    # learned positions are gathers/adds, not matmuls (Kaplan
+    # convention). The untied output head IS a matmul and stays in.
+    n_matmul_params = n_params - sum(
+        int(np.prod(params[k].shape)) for k in ("embed", "pos"))
+
+    sharded = shard_params(params, cfg, mesh)
+    del params
+    optimizer = optax.adamw(3e-4)
+    opt_state = jax.jit(optimizer.init)(sharded)
+    step = make_train_step(cfg, optimizer, mesh, n_microbatches=1)
+
+    rng = np.random.RandomState(0)
+    data_sharding = NamedSharding(mesh, P("dp", "sp"))
+    tokens = jax.device_put(
+        jnp.asarray(rng.randint(0, cfg.vocab,
+                                (args.batch_size, args.seq_len)), jnp.int32),
+        data_sharding)
+    labels = jnp.roll(tokens, -1, axis=1)
+
+    for _ in range(max(1, args.num_warmup)):
+        sharded, opt_state, loss = step(sharded, opt_state, tokens, labels)
+    float(np.asarray(loss))  # scalar fetch: the real completion fence
+
+    t0 = time.perf_counter()
+    for _ in range(args.num_iters):
+        sharded, opt_state, loss = step(sharded, opt_state, tokens, labels)
+    float(np.asarray(loss))
+    dt = time.perf_counter() - t0
+
+    n_chips = mesh.devices.size
+    tokens_per_step = args.batch_size * args.seq_len
+    tok_per_s = tokens_per_step * args.num_iters / dt
+    # 6N matmul estimate + attention QK^T/PV term (fwd 2*2*T*d_attn per
+    # token per layer, x3 for fwd+bwd).
+    d_attn = args.n_heads * (args.d_model // args.n_heads)
+    flops_per_token = (6 * n_matmul_params +
+                       12 * args.n_layers * args.seq_len * d_attn)
+    model_flops_per_s = tok_per_s * flops_per_token
+
+    result = {
+        "metric": "transformer_tokens_per_sec_per_chip",
+        "value": round(tok_per_s / n_chips, 1),
+        "unit": "tokens/sec/chip",
+        "platform": platform,
+        "device_kind": kind,
+        "n_params": n_params,
+        "n_matmul_params": n_matmul_params,
+        "d_model": args.d_model,
+        "n_layers": args.n_layers,
+        "seq_len": args.seq_len,
+        "global_batch": args.batch_size,
+        "mesh": sizes,
+        "sp_strategy": args.strategy,
+        "loss": round(float(np.asarray(loss)), 4),
+        "step_ms": round(1e3 * dt / args.num_iters, 2),
+    }
+    peak = _peak_flops(kind)
+    if peak:
+        result["mfu"] = round(model_flops_per_s / (n_chips * peak), 4)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,6 +46,10 @@ def main():
     parser.add_argument("--n-heads", type=int, default=8)
     parser.add_argument("--n-layers", type=int, default=4)
     parser.add_argument("--steps", type=int, default=5)
+    parser.add_argument("--remat", action="store_true",
+                        help="rematerialize decoder layers in backward "
+                             "(activation HBM ~O(1) layers; the knob "
+                             "that lets very long sequences fit)")
     args = parser.parse_args()
 
     import jax
@@ -80,7 +84,7 @@ def main():
         vocab=1024, d_model=args.d_model, n_heads=args.n_heads,
         d_head=args.d_model // args.n_heads, d_ff=4 * args.d_model,
         n_layers=args.n_layers, max_seq=args.seq_len,
-        dtype=jnp.bfloat16, sp_strategy=args.strategy)
+        dtype=jnp.bfloat16, sp_strategy=args.strategy, remat=args.remat)
     params = init_params(cfg, jax.random.PRNGKey(0), n_stages=1)
     sharded = shard_params(params, cfg, mesh)
     optimizer = optax.adamw(3e-4)

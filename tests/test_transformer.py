@@ -105,6 +105,32 @@ def test_ulysses_strategy_matches_dense(sizes):
             err_msg=f"ulysses grad mismatch for {key} with mesh {sizes}")
 
 
+def test_remat_matches_dense():
+    # jax.checkpoint must not change the math — only when activations
+    # are recomputed. Same oracle check as the non-remat path.
+    cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4, d_head=8,
+                            d_ff=64, n_layers=4, max_seq=64, remat=True)
+    mesh = build_parallel_mesh(jax.devices(), dp=2, pp=2, sp=2, tp=1)
+    params, tokens, labels = _setup(cfg, mesh)
+    loss_fn = make_loss_fn(cfg, mesh, n_microbatches=2)
+    sharded = shard_params(params, cfg, mesh)
+    data_sharding = NamedSharding(mesh, P("dp", "sp"))
+    tok_s = jax.device_put(tokens, data_sharding)
+    lab_s = jax.device_put(labels, data_sharding)
+    loss = float(jax.jit(loss_fn)(sharded, tok_s, lab_s))
+    expected = float(dense_reference_loss(cfg, params, tokens, labels))
+    assert loss == pytest.approx(expected, rel=1e-4)
+
+    grads = jax.jit(jax.grad(loss_fn))(sharded, tok_s, lab_s)
+    ref_grads = jax.grad(
+        lambda p: dense_reference_loss(cfg, p, tokens, labels))(params)
+    for key in ("embed", "wqkv", "w1", "head"):
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(grads[key])),
+            np.asarray(ref_grads[key]), rtol=5e-3, atol=1e-5,
+            err_msg=f"remat grad mismatch for {key}")
+
+
 @pytest.mark.full
 def test_moe_grads_match_dense():
     # Validates the differentiable path through routing, all_to_all

@@ -60,6 +60,10 @@ def main(argv=None):
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--strategy", default="ring",
                    choices=["ring", "ulysses", "auto"])
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize decoder layers (activation HBM "
+                        "for FLOPs; measure the cost of the long-context "
+                        "memory knob)")
     p.add_argument("--num-warmup", type=int, default=3)
     p.add_argument("--num-iters", type=int, default=20)
     args = p.parse_args(argv)
@@ -90,7 +94,7 @@ def main(argv=None):
         vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
         d_head=args.d_model // args.n_heads, d_ff=4 * args.d_model,
         n_layers=args.n_layers, max_seq=args.seq_len, dtype=jnp.bfloat16,
-        sp_strategy=args.strategy)
+        sp_strategy=args.strategy, remat=args.remat)
     params = init_params(cfg, jax.random.PRNGKey(0), n_stages=1)
     n_params = sum(int(np.prod(v.shape)) for v in params.values())
     # The 6N estimate counts matmul params only: the embedding table and

@@ -304,6 +304,58 @@ def test_randomized_schedule_cross_process(tmp_path):
 
 
 @pytest.mark.full
+def test_sequence_parallel_attention_cross_process(tmp_path):
+    """Ring AND Ulysses context-parallel attention with the sp axis
+    spanning a real process boundary: 4 sequence shards over 2 processes,
+    so ppermute rotations / all_to_all re-shards cross the
+    ``jax.distributed`` fabric the way they cross DCN on a pod."""
+    script = _PRELUDE + textwrap.dedent("""
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from horovod_tpu.parallel.ring_attention import ring_attention
+        from horovod_tpu.parallel.ulysses import ulysses_attention
+
+        B, T, H, D = 2, 16, 4, 8
+        rng = np.random.RandomState(0)
+        q, k, v = (rng.randn(B, T, H, D).astype(np.float32)
+                   for _ in range(3))
+
+        # Dense causal oracle, computed identically on both processes.
+        s = np.einsum("bqhd,bkhd->bhqk", q.astype(np.float64),
+                      k.astype(np.float64)) / np.sqrt(D)
+        s = np.where(np.tril(np.ones((T, T), bool))[None, None], s, -np.inf)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        expected = np.einsum("bhqk,bkhd->bqhd", p, v.astype(np.float64))
+
+        mesh = Mesh(np.array(jax.devices()), ("sp",))
+        sharding = NamedSharding(mesh, P(None, "sp"))
+
+        def to_global(x):
+            return jax.make_array_from_callback(
+                x.shape, sharding, lambda idx: x[idx])
+
+        qa, ka, va = (to_global(t) for t in (q, k, v))
+        for name, attn in (("ring", ring_attention),
+                           ("ulysses", ulysses_attention)):
+            fn = jax.jit(jax.shard_map(
+                lambda q, k, v, a=attn: a(q, k, v, "sp", causal=True),
+                mesh=mesh, in_specs=P(None, "sp"), out_specs=P(None, "sp"),
+                check_vma=False))
+            out = fn(qa, ka, va)
+            for shard in out.addressable_shards:
+                np.testing.assert_allclose(
+                    np.asarray(shard.data), expected[shard.index],
+                    rtol=2e-4, atol=2e-5,
+                    err_msg=f"{name} shard {shard.index} mismatch")
+
+        hvd.shutdown()
+        print(f"MHSEQ_{rank}_OK")
+    """)
+    run_world(tmp_path, script, "MHSEQ", timeout=420, drop_env=_DROP_ENV)
+
+
+@pytest.mark.full
 def test_train_step_and_zero_cross_process(tmp_path):
     """One DP train step and one ZeRO-1 step through the global mesh."""
     script = _PRELUDE + textwrap.dedent("""

@@ -49,6 +49,7 @@ class TransformerConfig:
     n_experts: int = 4
     d_expert: int = 128
     capacity_factor: float = 2.0
+    moe_top_k: int = 1  # 1 = Switch, 2 = GShard renormalized top-2
     dtype: Any = jnp.float32
     # Sequence-parallel attention strategy over the sp axis: "ring"
     # (K/V rotation, no head constraint), "ulysses" (all-to-all head
@@ -167,7 +168,8 @@ def _make_stage_fn(cfg: TransformerConfig):
             y = moe_layer(flat, {"gate": lp["gate"], "w_in": lp["we_in"],
                                  "w_out": lp["we_out"]},
                           axis_name="dp",
-                          capacity_factor=cfg.capacity_factor)
+                          capacity_factor=cfg.capacity_factor,
+                          top_k=cfg.moe_top_k)
             y = y.reshape(B, T, d)
         else:
             y = jax.nn.gelu(jnp.einsum("btd,df->btf", h, lp["w1"]))
@@ -281,13 +283,18 @@ def dense_reference_loss(cfg: TransformerConfig, params, tokens, labels):
                 flat = h.reshape(b * t, d).astype(jnp.float32)
                 logits = flat @ params["gate"][s, li]
                 probs = jax.nn.softmax(logits, -1)
-                idx = jnp.argmax(probs, -1)
-                gate = jnp.take_along_axis(probs, idx[:, None], -1)[:, 0]
-                w_in = params["we_in"][s, li].astype(jnp.float32)[idx]
-                w_out = params["we_out"][s, li].astype(jnp.float32)[idx]
-                y = jax.nn.gelu(jnp.einsum("td,tdf->tf", flat, w_in),
-                                approximate=False)
-                y = jnp.einsum("tf,tfd->td", y, w_out) * gate[:, None]
+                gates, idxs = lax.top_k(probs, cfg.moe_top_k)
+                if cfg.moe_top_k > 1:
+                    gates = gates / jnp.sum(gates, -1, keepdims=True)
+                y = 0.0
+                for j in range(cfg.moe_top_k):
+                    idx = idxs[:, j]
+                    w_in = params["we_in"][s, li].astype(jnp.float32)[idx]
+                    w_out = params["we_out"][s, li].astype(jnp.float32)[idx]
+                    yj = jax.nn.gelu(jnp.einsum("td,tdf->tf", flat, w_in),
+                                     approximate=False)
+                    yj = jnp.einsum("tf,tfd->td", yj, w_out)
+                    y = y + yj * gates[:, j][:, None]
                 x = x + y.reshape(b, t, d).astype(x.dtype)
             else:
                 y = jax.nn.gelu(jnp.einsum(

@@ -1,4 +1,5 @@
-"""Mixture-of-Experts with expert parallelism (Switch-style top-1 routing).
+"""Mixture-of-Experts with expert parallelism (top-1 Switch or top-2
+GShard routing).
 
 Expert parallelism rides the ``dp`` mesh axis (the standard GShard/Switch
 placement): each dp group member owns ``E / ep`` experts; tokens are
@@ -6,6 +7,11 @@ delivered to their expert's owner with a single ``lax.all_to_all`` over the
 axis and returned the same way. Routing uses static capacity
 (``capacity_factor``) so every shape is compile-time constant — the XLA
 requirement that rules out the reference-style dynamic dispatch.
+
+``top_k=2`` follows the GShard recipe: gates renormalized over the two
+picks, first choices take capacity priority over every second choice,
+and the optional auxiliary load-balance loss (``return_aux=True``) is
+the Switch formulation E * sum_e(f_e * P_e) — 1.0 at perfect balance.
 """
 
 from __future__ import annotations
@@ -30,37 +36,54 @@ def init_moe_params(rng, d_model: int, d_ff: int, n_experts: int,
     }
 
 
-def moe_layer(x, params, axis_name: str = "dp", capacity_factor: float = 1.25):
-    """Top-1 MoE over tokens. x: [T, d] (local tokens); params['w_in']:
+def moe_layer(x, params, axis_name: str = "dp", capacity_factor: float = 1.25,
+              top_k: int = 1, return_aux: bool = False):
+    """Top-k MoE over tokens. x: [T, d] (local tokens); params['w_in']:
     [E_local, d, f] — the *local* expert shard when run under shard_map
     with the expert dim sharded over ``axis_name``.
 
-    Returns [T, d].
+    ``top_k``: 1 (Switch) or 2 (GShard; gates renormalized over the two
+    picks, first choices win capacity). ``return_aux``: also return the
+    load-balance auxiliary loss (scalar, ~1.0 when balanced) for the
+    caller to weight into the training loss.
+
+    Returns [T, d], or ([T, d], aux) with ``return_aux``.
     """
     ep = lax.axis_size(axis_name)
     T, d = x.shape
     e_local = params["w_in"].shape[0]
     E = e_local * ep
+    if not 1 <= top_k <= E:
+        raise ValueError(f"top_k={top_k} must be in [1, {E}]")
 
     # --- routing (fp32) -----------------------------------------------------
     logits = x.astype(jnp.float32) @ params["gate"]  # [T, E]
     probs = jax.nn.softmax(logits, axis=-1)
-    expert_idx = jnp.argmax(probs, axis=-1)  # [T]
-    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
+    topg, topi = lax.top_k(probs, top_k)  # [T, k]
+    if top_k > 1:
+        topg = topg / jnp.sum(topg, axis=-1, keepdims=True)
 
-    capacity = max(1, int(capacity_factor * T / E))
-    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [T, E]
-    # position of each token within its expert's queue
-    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # [T, E]
-    keep = (pos < capacity) * onehot  # [T, E] tokens within capacity
-    pos = jnp.sum(pos * keep, axis=-1).astype(jnp.int32)  # [T]
-    kept = jnp.sum(keep, axis=-1) > 0  # [T]
+    # Virtual-token view, choice-major ([all 1st choices; all 2nd ...]):
+    # the capacity cumsum below then gives every first choice priority
+    # over any second choice (the GShard policy).
+    vidx = topi.T.reshape(-1)   # [k*T]
+    vgate = topg.T.reshape(-1)  # [k*T]
 
-    # dispatch tensor [T, E, C]
+    capacity = max(1, int(capacity_factor * top_k * T / E))
+    onehot = jax.nn.one_hot(vidx, E, dtype=jnp.float32)  # [kT, E]
+    # position of each virtual token within its expert's queue
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # [kT, E]
+    keep = (pos < capacity) * onehot  # [kT, E] tokens within capacity
+    pos = jnp.sum(pos * keep, axis=-1).astype(jnp.int32)  # [kT]
+    kept = jnp.sum(keep, axis=-1) > 0  # [kT]
+
+    # dispatch tensor [kT, E, C]
     dispatch = (keep[:, :, None]
                 * jax.nn.one_hot(pos, capacity, dtype=jnp.float32)[:, None, :])
-    # expert input buffers [E, C, d]
-    buffers = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    # expert input buffers [E, C, d]; each token's features enter once
+    # per surviving choice
+    x32 = jnp.tile(x.astype(jnp.float32), (top_k, 1))  # [kT, d]
+    buffers = jnp.einsum("tec,td->ecd", dispatch, x32)
 
     # --- all_to_all: deliver each expert's buffer to its owner --------------
     # [E, C, d] -> [ep, e_local, C, d]; exchange over axis -> every member
@@ -82,7 +105,20 @@ def moe_layer(x, params, axis_name: str = "dp", capacity_factor: float = 1.25):
                           tiled=False)  # [ep, e_local, C, d]
     back = back.reshape(E, capacity, d)
 
-    # combine: [T, d]
+    # combine: weight each choice's returned features by its gate, then
+    # sum the k choices per real token: [kT, d] -> [k, T, d] -> [T, d]
     combined = jnp.einsum("tec,ecd->td", dispatch, back)
-    y = combined * (gate * kept)[:, None]
-    return y.astype(x.dtype)
+    y = (combined * (vgate * kept)[:, None]).reshape(top_k, T, d).sum(0)
+    y = y.astype(x.dtype)
+    if not return_aux:
+        return y
+    # Switch aux loss: E * sum_e(fraction of tokens whose FIRST choice is
+    # e  *  mean router prob on e). 1.0 at perfect balance; grows as
+    # routing collapses onto few experts. The token means are averaged
+    # over the expert-parallel axis so every member returns the same
+    # (global) scalar.
+    first = jax.nn.one_hot(topi[:, 0], E, dtype=jnp.float32)  # [T, E]
+    f = lax.pmean(jnp.mean(first, axis=0), axis_name)
+    p = lax.pmean(jnp.mean(probs, axis=0), axis_name)
+    aux = E * jnp.sum(f * p)
+    return y, aux

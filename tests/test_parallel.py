@@ -209,25 +209,97 @@ class TestMoE:
         assert out.shape == (ep * T, d)
         assert np.isfinite(out).all()
 
-        # Oracle: dense computation of top-1 MoE with ample capacity.
-        logits = np.asarray(x, np.float64) @ np.asarray(params["gate"],
-                                                        np.float64)
-        probs = np.exp(logits - logits.max(-1, keepdims=True))
-        probs /= probs.sum(-1, keepdims=True)
-        idx = probs.argmax(-1)
-        gate = probs[np.arange(len(idx)), idx]
-        w_in = np.asarray(params["w_in"], np.float64)
-        w_out = np.asarray(params["w_out"], np.float64)
-
-        def gelu(x):
-            from scipy.stats import norm  # noqa: PLC0415
-            return x * norm.cdf(x)
-
-        expected = np.stack([
-            gelu(np.asarray(x[t], np.float64) @ w_in[idx[t]]) @ w_out[idx[t]]
-            * gate[t]
-            for t in range(len(idx))])
+        # Oracle: dense computation of top-1 MoE with ample capacity
+        # (the k=1 case of the shared top-k oracle).
+        expected = _dense_moe_oracle(np.asarray(x), params, top_k=1)
         np.testing.assert_allclose(out, expected, rtol=1e-3, atol=1e-4)
+
+
+def _dense_moe_oracle(x, params, top_k):
+    """Ample-capacity top-k MoE oracle, gates renormalized for k > 1
+    (GShard). Shared by the top-1 and top-2 tests so the two stay in
+    sync by construction."""
+    from scipy.stats import norm as _norm
+
+    x64 = np.asarray(x, np.float64)
+    logits = x64 @ np.asarray(params["gate"], np.float64)
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    order = np.argsort(-probs, axis=-1)[:, :top_k]
+    gates = np.take_along_axis(probs, order, axis=-1)
+    if top_k > 1:
+        gates = gates / gates.sum(-1, keepdims=True)
+    w_in = np.asarray(params["w_in"], np.float64)
+    w_out = np.asarray(params["w_out"], np.float64)
+    out = np.zeros_like(x64)
+    for t in range(x64.shape[0]):
+        for j in range(top_k):
+            e = order[t, j]
+            h = x64[t] @ w_in[e]
+            h = h * _norm.cdf(h)  # exact gelu
+            out[t] += gates[t, j] * (h @ w_out[e])
+    return out
+
+
+class TestMoETop2:
+    def _run_layer(self, x, params, ep, **kw):
+        mesh = Mesh(np.array(jax.devices()[:ep]), ("dp",))
+        param_specs = {"gate": P(), "w_in": P("dp"), "w_out": P("dp")}
+        sharded = {
+            k: jax.device_put(v, NamedSharding(mesh, param_specs[k]))
+            for k, v in params.items()}
+        xs = jax.device_put(x, NamedSharding(mesh, P("dp")))
+        fn = jax.jit(jax.shard_map(
+            lambda x, p: moe_layer(x, p, axis_name="dp", **kw),
+            mesh=mesh, in_specs=(P("dp"), param_specs),
+            out_specs=P("dp") if not kw.get("return_aux") else
+            (P("dp"), P()), check_vma=False))
+        return fn(xs, sharded)
+
+    def test_top2_matches_dense(self):
+        ep, T, d, f, E = 2, 16, 8, 16, 4
+        params = init_moe_params(jax.random.PRNGKey(0), d, f, E)
+        x = np.asarray(jax.random.normal(jax.random.PRNGKey(1),
+                                         (ep * T, d), jnp.float32))
+        out = np.asarray(self._run_layer(jnp.asarray(x), params, ep,
+                                         capacity_factor=4.0, top_k=2))
+        expected = _dense_moe_oracle(x, params, top_k=2)
+        np.testing.assert_allclose(out, expected, rtol=1e-3, atol=1e-4)
+
+    def test_aux_loss_balance(self):
+        # A uniform router (zero gate weights -> equal probs) must score
+        # aux == 1.0 exactly; a collapsed router (huge bias onto expert
+        # 0 via a rigged gate) must score ~E.
+        ep, T, d, f, E = 2, 32, 8, 16, 4
+        params = init_moe_params(jax.random.PRNGKey(0), d, f, E)
+        x = jax.random.normal(jax.random.PRNGKey(1), (ep * T, d),
+                              jnp.float32)
+
+        params_uni = dict(params, gate=jnp.zeros((d, E), jnp.float32))
+        _, aux = self._run_layer(x, params_uni, ep, capacity_factor=4.0,
+                                 top_k=1, return_aux=True)
+        # Uniform probs: P_e = 1/E exactly; argmax ties resolve to
+        # expert 0, so f_0 = 1 and aux = E * (1 * 1/E) = 1.0.
+        assert float(aux) == pytest.approx(1.0, rel=1e-5)
+
+        # Collapse: first gate column dominates. The gate is linear (no
+        # bias), so positive features make logits[:, 0] large for every
+        # token.
+        g = np.zeros((d, E), np.float32)
+        g[:, 0] = 10.0
+        x_pos = jnp.abs(x) + 0.5
+        _, aux = self._run_layer(x_pos, dict(params, gate=jnp.asarray(g)),
+                                 ep, capacity_factor=4.0, top_k=1,
+                                 return_aux=True)
+        assert float(aux) > 0.9 * E
+
+    def test_top_k_validated(self):
+        ep, T, d, f, E = 2, 8, 8, 16, 4
+        params = init_moe_params(jax.random.PRNGKey(0), d, f, E)
+        x = jax.random.normal(jax.random.PRNGKey(1), (ep * T, d),
+                              jnp.float32)
+        with pytest.raises(ValueError, match="top_k"):
+            self._run_layer(x, params, ep, top_k=0)
 
 
 class TestPipeline:

@@ -156,10 +156,11 @@ def test_moe_grads_match_dense():
             err_msg=f"moe grad mismatch for {key}")
 
 
-def test_moe_loss_matches_dense():
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_moe_loss_matches_dense(top_k):
     cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4, d_head=8,
                             n_layers=2, max_seq=64, use_moe=True,
-                            n_experts=4, d_expert=64,
+                            n_experts=4, d_expert=64, moe_top_k=top_k,
                             capacity_factor=8.0)  # ample: no token drops
     mesh = build_parallel_mesh(jax.devices(), dp=2, pp=2, sp=1, tp=2)
     params, tokens, labels = _setup(cfg, mesh)

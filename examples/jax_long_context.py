@@ -61,6 +61,7 @@ def main():
     from horovod_tpu.models.transformer import (
         TransformerConfig, init_params, make_train_step, shard_params)
     from horovod_tpu.parallel.mesh import build_parallel_mesh
+    from horovod_tpu.training import init_opt_state
 
     # Must run before any device touch; harmless on a real TPU slice
     # (only sizes the host-CPU backend used by the virtual-mesh demo).
@@ -88,7 +89,7 @@ def main():
     params = init_params(cfg, jax.random.PRNGKey(0), n_stages=1)
     sharded = shard_params(params, cfg, mesh)
     optimizer = optax.adamw(3e-4)
-    opt_state = jax.jit(optimizer.init)(sharded)
+    opt_state = init_opt_state(optimizer, sharded, mesh)
     step = make_train_step(cfg, optimizer, mesh, n_microbatches=1)
 
     rng = np.random.RandomState(0)

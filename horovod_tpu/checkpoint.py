@@ -16,6 +16,11 @@ directly onto the target mesh's shardings.
 The template supplies structure, dtypes, and shardings — pass a freshly
 initialized state (e.g. ``init_zero_train_state(...)``) and the restore
 lands every leaf on its proper devices, sharded exactly as initialized.
+For raw optax states on a model-parallel mesh, build the template with
+``training.init_opt_state(optimizer, params, mesh)``: a bare
+``jit(optimizer.init)`` leaves scalar leaves (Adam's ``count``) on one
+device, and a state restored onto that template then mixes single-device
+and full-mesh arrays in the next step, which jax rejects.
 """
 
 from __future__ import annotations

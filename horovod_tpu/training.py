@@ -106,6 +106,28 @@ def replicate_state(state: TrainState, mesh) -> TrainState:
         lambda x: jax.device_put(x, sharding), state)
 
 
+def init_opt_state(optimizer: optax.GradientTransformation, params, mesh):
+    """Optimizer state with mesh-consistent shardings.
+
+    ``jax.jit(optimizer.init)(params)`` commits EVERY output leaf to a
+    single device (no out_shardings → XLA's default assignment) — a
+    state that happens to step (jit re-shards it) but poisons a
+    checkpoint template: an orbax restore faithfully reproduces the
+    single-device placement, and the restored state then mixes
+    single-device and full-mesh committed arrays in the next step, which
+    jax rejects. Eager ``optimizer.init`` instead builds moments with
+    ``zeros_like`` — inheriting each param's NamedSharding — and this
+    helper re-places the remaining scalar leaves (e.g. Adam's ``count``)
+    as mesh-replicated, so every leaf is mesh-consistent.
+    """
+    state = optimizer.init(params)
+    replicated = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(
+        lambda leaf: (jax.device_put(leaf, replicated)
+                      if getattr(leaf, "ndim", None) == 0 else leaf),
+        state)
+
+
 def shard_batch(batch, mesh, axis_name: str = AXIS_GLOBAL):
     sharding = NamedSharding(mesh, P(axis_name))
     return jax.tree_util.tree_map(

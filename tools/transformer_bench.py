@@ -76,6 +76,7 @@ def main(argv=None):
     from horovod_tpu.models.transformer import (
         TransformerConfig, init_params, make_train_step, shard_params)
     from horovod_tpu.parallel.mesh import build_parallel_mesh
+    from horovod_tpu.training import init_opt_state
 
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -106,7 +107,7 @@ def main(argv=None):
     sharded = shard_params(params, cfg, mesh)
     del params
     optimizer = optax.adamw(3e-4)
-    opt_state = jax.jit(optimizer.init)(sharded)
+    opt_state = init_opt_state(optimizer, sharded, mesh)
     step = make_train_step(cfg, optimizer, mesh, n_microbatches=1)
 
     rng = np.random.RandomState(0)

@@ -13,6 +13,7 @@ from horovod_tpu.models.transformer import (
     TransformerConfig, dense_reference_loss, init_params, make_loss_fn,
     make_train_step, shard_params)
 from horovod_tpu.parallel.mesh import build_parallel_mesh
+from horovod_tpu.training import init_opt_state
 
 
 def _setup(cfg, mesh, seed=0):
@@ -181,7 +182,7 @@ def test_train_step_improves_loss():
     params, tokens, labels = _setup(cfg, mesh)
     optimizer = optax.adam(1e-2)
     sharded = shard_params(params, cfg, mesh)
-    opt_state = jax.jit(optimizer.init)(sharded)
+    opt_state = init_opt_state(optimizer, sharded, mesh)
     step = make_train_step(cfg, optimizer, mesh, n_microbatches=2)
     data_sharding = NamedSharding(mesh, P("dp", "sp"))
     tok_s = jax.device_put(tokens, data_sharding)
@@ -235,7 +236,7 @@ def test_dryrun_config_train_step():
     params = init_params(cfg, jax.random.PRNGKey(0), n_stages=sizes["pp"])
     sharded = shard_params(params, cfg, mesh)
     optimizer = optax.adam(1e-3)
-    opt_state = jax.jit(optimizer.init)(sharded)
+    opt_state = init_opt_state(optimizer, sharded, mesh)
     B, T = 2 * max(2, sizes["dp"]), 8 * sizes["sp"]
     rng = np.random.RandomState(0)
     data_sharding = NamedSharding(mesh, P("dp", "sp"))

@@ -11,4 +11,4 @@ CROSS/DCN group is elastic — SURVEY §7 "Elastic + ICI").
 """
 
 from .state import (  # noqa: F401
-    ObjectState, State, register_preemption_signal, run)
+    JaxState, ObjectState, State, register_preemption_signal, run)

@@ -152,6 +152,117 @@ class ObjectState(State):
         self.save()
 
 
+class JaxState(ObjectState):
+    """Elastic state for the compiled JAX path: a pytree of (possibly
+    sharded) jax arrays plus picklable step attributes.
+
+    The tree lives under ``.tree``. ``save()`` snapshots it to HOST
+    memory (numpy) — device buffers don't survive a world re-init.
+    ``restore()``/``sync()`` re-place every leaf onto the CURRENT mesh
+    through ``place`` (default: replicate over ``hvd.mesh()``, the
+    data-parallel layout) — after a membership change the mesh is a
+    different device set, so placement must be recomputed, not reused.
+
+    Reference counterpart: ``TorchState``'s tensor handling
+    (``horovod/torch/elastic.py``) — here generalized to jax pytrees.
+
+        state = hvd.elastic.JaxState(train_state, batch=0, epoch=0)
+        @hvd.elastic.run
+        def train(state):
+            while state.batch < num_batches:
+                state.tree, loss = step(state.tree,
+                                        get_batch(state.batch))
+                state.batch += 1
+                if state.batch % 10 == 0: state.commit()
+
+    Scope: snapshots need every leaf locally readable — fully
+    addressable (single-process, or sharded within this process's
+    devices) or fully replicated. For states sharded ACROSS processes,
+    elastic recovery must go through durable checkpoints
+    (``horovod_tpu.checkpoint.CheckpointManager``); ``save()`` raises a
+    descriptive error rather than hanging on the first commit.
+    """
+
+    def __init__(self, tree, place: Callable = None, **kwargs):
+        self._place = place or self._replicate
+        self.tree = tree
+        super().__init__(**kwargs)
+
+    def _replace_from_snapshot(self):
+        self.tree = self._place(self._saved_tree)
+
+    @staticmethod
+    def _replicate(host_tree):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from ..common import state as _hvd_state
+
+        sharding = NamedSharding(_hvd_state.mesh(), PartitionSpec())
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sharding), host_tree)
+
+    def save(self):
+        import jax
+
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                self.tree)[0]:
+            addressable = getattr(leaf, "is_fully_addressable", True)
+            replicated = getattr(getattr(leaf, "sharding", None),
+                                 "is_fully_replicated", True)
+            if not (addressable or replicated):
+                raise NotImplementedError(
+                    f"JaxState cannot snapshot leaf {path}: it is "
+                    "sharded across processes (neither fully "
+                    "addressable nor fully replicated). Use "
+                    "horovod_tpu.checkpoint.CheckpointManager (orbax "
+                    "writes shards from their owning processes) for "
+                    "elastic recovery of cross-process sharded states.")
+        # Host snapshot of the tree; deepcopy-snapshot of the rest.
+        self._saved_tree = jax.device_get(self.tree)
+        tree, self.tree = self.tree, None
+        try:
+            super().save()  # snapshots public attrs minus the tree
+        finally:
+            self.tree = tree
+
+    def restore(self):
+        super().restore()
+        # In the retry loop restore() runs BEFORE the world re-init, so
+        # the current mesh may span dead processes. Try eager placement
+        # (manual rollback in a healthy world); on failure defer to
+        # on_reset(), which runs after re-initialization.
+        try:
+            self._replace_from_snapshot()
+        except Exception as e:  # placement on a stale/dead mesh
+            _log.warning(f"JaxState: deferring tree placement to the "
+                         f"re-initialized world ({e})")
+            self.tree = None
+
+    def on_reset(self):
+        # Runs after _reinitialize(): the mesh now reflects the NEW
+        # world — (re-)place the last committed snapshot on it.
+        super().on_reset()
+        self._replace_from_snapshot()
+
+    def sync(self):
+        # One broadcast from the coordinator: the last committed HOST
+        # snapshot rides with the picklable attrs (never device_get of
+        # live buffers here — in the retry loop sync() runs right after
+        # a world re-init, when pre-failure device buffers may already
+        # be dead); every process then re-places the leaves on its view
+        # of the (possibly new) mesh.
+        payload = {k: v for k, v in self._public_attrs().items()
+                   if k != "tree"}
+        payload["tree"] = self._saved_tree
+        synced = self._bcast_object(payload, root_rank=0)
+        self._saved_tree = synced.pop("tree")
+        for k, v in synced.items():
+            setattr(self, k, v)
+        self._replace_from_snapshot()
+        self.save()
+
+
 def _reinitialize():
     """shutdown + init against the (possibly changed) world — the
     reference's ``reset()`` (``torch/elastic.py:47``)."""

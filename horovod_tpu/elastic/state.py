@@ -230,20 +230,26 @@ class JaxState(ObjectState):
         super().restore()
         # In the retry loop restore() runs BEFORE the world re-init, so
         # the current mesh may span dead processes. Try eager placement
-        # (manual rollback in a healthy world); on failure defer to
-        # on_reset(), which runs after re-initialization.
+        # (manual rollback in a healthy world); when the RUNTIME is the
+        # problem (backend/world errors only — user bugs in a custom
+        # ``place`` must propagate) defer to on_reset(), which runs
+        # after re-initialization.
+        from ..common.exceptions import NotInitializedError
+
         try:
             self._replace_from_snapshot()
-        except Exception as e:  # placement on a stale/dead mesh
+        except (RuntimeError, NotInitializedError) as e:
             _log.warning(f"JaxState: deferring tree placement to the "
                          f"re-initialized world ({e})")
             self.tree = None
 
     def on_reset(self):
         # Runs after _reinitialize(): the mesh now reflects the NEW
-        # world — (re-)place the last committed snapshot on it.
-        super().on_reset()
+        # world. Place the last committed snapshot BEFORE the user's
+        # reset callbacks run — they are documented to rebuild steps and
+        # layouts from ``state.tree``.
         self._replace_from_snapshot()
+        super().on_reset()
 
     def sync(self):
         # One broadcast from the coordinator: the last committed HOST
@@ -260,7 +266,14 @@ class JaxState(ObjectState):
         for k, v in synced.items():
             setattr(self, k, v)
         self._replace_from_snapshot()
-        self.save()
+        # Commit the synced point: the broadcast payload IS the host
+        # snapshot (just assigned to _saved_tree) — snapshot only the
+        # picklable attrs instead of device_get-ing the whole tree back.
+        tree, self.tree = self.tree, None
+        try:
+            ObjectState.save(self)
+        finally:
+            self.tree = tree
 
 
 def _reinitialize():

@@ -242,9 +242,17 @@ def make_loss_fn(cfg: TransformerConfig, mesh, n_microbatches: int = 2):
 
 
 def make_train_step(cfg: TransformerConfig, optimizer, mesh,
-                    n_microbatches: int = 2):
+                    n_microbatches: int = 2, opt_shardings=None):
     """Full sharded training step: loss + grads + optimizer update, jitted
-    once over the 4-axis mesh."""
+    once over the 4-axis mesh.
+
+    ``opt_shardings`` (a pytree of NamedShardings matching the optimizer
+    state, e.g. ``jax.tree.map(lambda x: x.sharding, opt_state)`` from a
+    ``training.init_opt_state(..., zero_axis="dp")`` state) pins the
+    updated optimizer state to those shardings inside the compiled
+    program — the ZeRO-1 composition: moments stay partitioned over dp
+    on top of the params' tp/pp sharding, and XLA inserts the
+    slice/gather collectives around the elementwise update."""
     import optax
 
     loss_fn = make_loss_fn(cfg, mesh, n_microbatches)
@@ -252,6 +260,9 @@ def make_train_step(cfg: TransformerConfig, optimizer, mesh,
     def step(params, opt_state, tokens, labels):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens, labels)
         updates, opt_state = optimizer.update(grads, opt_state, params)
+        if opt_shardings is not None:
+            opt_state = jax.lax.with_sharding_constraint(
+                opt_state, opt_shardings)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 

@@ -106,8 +106,17 @@ def replicate_state(state: TrainState, mesh) -> TrainState:
         lambda x: jax.device_put(x, sharding), state)
 
 
-def init_opt_state(optimizer: optax.GradientTransformation, params, mesh):
+def init_opt_state(optimizer: optax.GradientTransformation, params, mesh,
+                   zero_axis: Optional[str] = None):
     """Optimizer state with mesh-consistent shardings.
+
+    ``zero_axis="dp"`` additionally shards every moment leaf over that
+    mesh axis (ZeRO-1 memory partitioning composed with whatever
+    model-parallel sharding the param already has): the first unsharded
+    dimension divisible by the axis size gets the axis; leaves with no
+    such dimension stay as-is (partial ZeRO). Pair with
+    ``make_train_step(..., opt_shardings=...)`` so the compiled step
+    keeps the moments sharded instead of replicating them back.
 
     ``jax.jit(optimizer.init)(params)`` commits EVERY output leaf to a
     single device (no out_shardings → XLA's default assignment) — a
@@ -122,10 +131,26 @@ def init_opt_state(optimizer: optax.GradientTransformation, params, mesh):
     """
     state = optimizer.init(params)
     replicated = NamedSharding(mesh, P())
-    return jax.tree_util.tree_map(
-        lambda leaf: (jax.device_put(leaf, replicated)
-                      if getattr(leaf, "ndim", None) == 0 else leaf),
-        state)
+    zero_size = int(mesh.shape[zero_axis]) if zero_axis else 0
+
+    def place(leaf):
+        if getattr(leaf, "ndim", None) == 0:
+            return jax.device_put(leaf, replicated)
+        if not zero_axis or zero_size <= 1:
+            return leaf
+        if not hasattr(leaf, "sharding"):
+            return leaf  # host (numpy) leaf: nothing to partition
+        # Extend the leaf's inherited (param) spec with the zero axis on
+        # the first unsharded, divisible dimension.
+        spec = list(getattr(leaf.sharding, "spec", ()) or ())
+        spec += [None] * (leaf.ndim - len(spec))
+        for i, (dim, cur) in enumerate(zip(leaf.shape, spec)):
+            if cur is None and dim % zero_size == 0 and dim >= zero_size:
+                spec[i] = zero_axis
+                return jax.device_put(leaf, NamedSharding(mesh, P(*spec)))
+        return leaf  # no divisible dim: this leaf stays un-partitioned
+
+    return jax.tree_util.tree_map(place, state)
 
 
 def shard_batch(batch, mesh, axis_name: str = AXIS_GLOBAL):

@@ -106,6 +106,78 @@ def test_ulysses_strategy_matches_dense(sizes):
             err_msg=f"ulysses grad mismatch for {key} with mesh {sizes}")
 
 
+def test_init_opt_state_tolerates_host_leaves():
+    # zero_axis partitioning must pass genuinely host-side state leaves
+    # (custom transforms keeping numpy tables) through untouched instead
+    # of crashing on the missing .sharding; ordinary jnp moments built
+    # from numpy params still get partitioned.
+    mesh = build_parallel_mesh(jax.devices(), dp=2, pp=2, sp=1, tp=2)
+
+    table = np.ones((4, 4), np.float32)
+    custom = optax.GradientTransformation(
+        init=lambda p: {"table": table},
+        update=lambda g, s, p=None: (g, s))
+    state = init_opt_state(custom, {"w": np.ones((8, 4), np.float32)},
+                           mesh, zero_axis="dp")
+    assert state["table"] is table
+
+    adam = init_opt_state(optax.adam(1e-2),
+                          {"w": np.ones((8, 4), np.float32)},
+                          mesh, zero_axis="dp")
+    assert "dp" in list(adam[0].mu["w"].sharding.spec)
+
+
+def test_zero_over_dp_composes_with_model_parallelism():
+    # ZeRO-1 for the model-parallel path: moments sharded over dp ON TOP
+    # of the params' pp/tp sharding, pinned by opt_shardings in the
+    # compiled step. The math must not change; the memory must.
+    cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4, d_head=8,
+                            d_ff=64, n_layers=4, max_seq=64)
+    mesh = build_parallel_mesh(jax.devices(), dp=2, pp=2, sp=1, tp=2)
+    params, tokens, labels = _setup(cfg, mesh)
+    sharded = shard_params(params, cfg, mesh)
+    optimizer = optax.adam(1e-2)
+    opt_state = init_opt_state(optimizer, sharded, mesh, zero_axis="dp")
+    opt_shardings = jax.tree_util.tree_map(lambda x: x.sharding, opt_state)
+
+    # Moment leaves carry dp on top of the param's axes, and each
+    # device's addressable shard is half the leaf (dp=2).
+    mu = opt_state[0].mu
+    assert "dp" in jax.tree_util.tree_leaves(
+        [list(mu["wqkv"].sharding.spec)])
+    assert "pp" in list(mu["wqkv"].sharding.spec)
+    full = int(np.prod(mu["embed"].shape))
+    local = int(np.prod(mu["embed"].addressable_shards[0].data.shape))
+    assert local * 2 <= full, (local, full)
+
+    step = make_train_step(cfg, optimizer, mesh, n_microbatches=2,
+                           opt_shardings=opt_shardings)
+    data_sharding = NamedSharding(mesh, P("dp", "sp"))
+    tok_s = jax.device_put(tokens, data_sharding)
+    lab_s = jax.device_put(labels, data_sharding)
+
+    # Baseline: same model, un-partitioned optimizer state.
+    base_opt_state = init_opt_state(optimizer, sharded, mesh)
+    base_step = make_train_step(cfg, optimizer, mesh, n_microbatches=2)
+
+    # Fresh param buffers for the baseline: the zero step donates its
+    # inputs, and device_put may alias the host-side source arrays.
+    sharded_b = shard_params(init_params(cfg, jax.random.PRNGKey(0), 2),
+                             cfg, mesh)
+    p_z, o_z, l_z = step(sharded, opt_state, tok_s, lab_s)
+    p_b, o_b, l_b = base_step(sharded_b, base_opt_state, tok_s, lab_s)
+    assert float(np.asarray(l_z)) == pytest.approx(
+        float(np.asarray(l_b)), rel=1e-6)
+    for key in ("wqkv", "embed", "head"):
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(p_z[key])),
+            np.asarray(jax.device_get(p_b[key])), rtol=1e-5, atol=1e-6,
+            err_msg=f"zero-dp param divergence for {key}")
+    # The updated moments keep the dp partitioning (the constraint held
+    # through the compiled step).
+    assert "dp" in list(o_z[0].mu["wqkv"].sharding.spec)
+
+
 def test_remat_matches_dense():
     # jax.checkpoint must not change the math — only when activations
     # are recomputed. Same oracle check as the non-remat path.

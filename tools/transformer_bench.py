@@ -60,6 +60,10 @@ def main(argv=None):
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--strategy", default="ring",
                    choices=["ring", "ulysses", "auto"])
+    p.add_argument("--zero", action="store_true",
+                   help="ZeRO-1 over the dp axis: moments partitioned on "
+                        "top of the params' sharding (pure sharding "
+                        "annotations; measures the memory/perf trade)")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize decoder layers (activation HBM "
                         "for FLOPs; measure the cost of the long-context "
@@ -107,8 +111,12 @@ def main(argv=None):
     sharded = shard_params(params, cfg, mesh)
     del params
     optimizer = optax.adamw(3e-4)
-    opt_state = init_opt_state(optimizer, sharded, mesh)
-    step = make_train_step(cfg, optimizer, mesh, n_microbatches=1)
+    opt_state = init_opt_state(optimizer, sharded, mesh,
+                               zero_axis="dp" if args.zero else None)
+    opt_shardings = (jax.tree_util.tree_map(lambda x: x.sharding, opt_state)
+                     if args.zero else None)
+    step = make_train_step(cfg, optimizer, mesh, n_microbatches=1,
+                           opt_shardings=opt_shardings)
 
     rng = np.random.RandomState(0)
     data_sharding = NamedSharding(mesh, P("dp", "sp"))
@@ -152,6 +160,7 @@ def main(argv=None):
         "global_batch": args.batch_size,
         "mesh": sizes,
         "sp_strategy": args.strategy,
+        "zero": bool(args.zero),
         "loss": round(float(np.asarray(loss)), 4),
         "step_ms": round(1e3 * dt / args.num_iters, 2),
     }

@@ -297,10 +297,23 @@ def _pallas_block_state(q, k, v, offs, causal: bool, interpret: bool):
     )(offs, q, k, v)
 
 
-def _xla_block_state(q, k, v, offs, causal):
+def _apply_segment_mask(x, q_seg, k_seg, fill):
+    """Packed-sequence masking, the single definition: positions with
+    differing segment ids take ``fill`` (NEG_INF on scores, 0 on
+    probabilities). x: [BH, Tq, Tk]; q_seg/k_seg: int32 [BH, T]."""
+    return jnp.where(q_seg[:, :, None] == k_seg[:, None, :], x, fill)
+
+
+def _require_both_segs(q_seg, k_seg):
+    if (q_seg is None) != (k_seg is None):
+        raise ValueError("pass both q_segment_ids and k_segment_ids")
+
+
+def _xla_block_state(q, k, v, offs, causal, q_seg=None, k_seg=None):
     """XLA twin of the block-mode kernel (backward recompute + fallback).
     ``offs`` = int32[2] (q_off, k_off) — an array, not statics, because
-    ring attention traces the rotating block origin."""
+    ring attention traces the rotating block origin. ``q_seg``/``k_seg``:
+    optional int32 [BH, T] per-block segment ids (packed sequences)."""
     scale = 1.0 / (q.shape[-1] ** 0.5)
     s = jnp.einsum("btd,bsd->bts", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
@@ -308,6 +321,8 @@ def _xla_block_state(q, k, v, offs, causal):
         iq = jnp.arange(q.shape[1])[:, None] + offs[0]
         ik = jnp.arange(k.shape[1])[None, :] + offs[1]
         s = jnp.where(iq >= ik, s, NEG_INF)
+    if q_seg is not None:
+        s = _apply_segment_mask(s, q_seg, k_seg, NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m))
     l = jnp.sum(p, axis=-1, keepdims=True)
@@ -368,27 +383,36 @@ def _merge_heads(x):
 
 
 def flash_attention_block(q, k, v, q_off, k_off, causal: bool = True,
-                          use_pallas: Optional[bool] = None):
+                          use_pallas: Optional[bool] = None,
+                          q_segment_ids=None, k_segment_ids=None):
     """One K/V block's unmerged attention state for ring attention.
 
     q/k/v: [B, T, H, D]. Returns (acc, m, l) with acc f32 [B, T, H, D]
     (unnormalized P.V), m/l f32 [B, H, T] — merge across blocks with the
     online-softmax combine. Dispatch rules match ``flash_attention``
-    (shared ``_resolve_dispatch``).
+    (shared ``_resolve_dispatch``); segment ids route to the XLA twin
+    (packed sequences, Mosaic segment tiles pending).
     """
     B, Tq, H, D = q.shape
-    use_pallas, interpret = _resolve_dispatch(use_pallas)
 
     offs = jnp.stack([jnp.asarray(q_off, jnp.int32),
                       jnp.asarray(k_off, jnp.int32)])
-    if use_pallas:
-        acc, m, l = _block_state_core(
-            _merge_heads(q), _merge_heads(k), _merge_heads(v), offs,
-            causal, interpret)
-    else:
+    _require_both_segs(q_segment_ids, k_segment_ids)
+    if q_segment_ids is not None:
         acc, m, l = _xla_block_state(
             _merge_heads(q), _merge_heads(k), _merge_heads(v), offs,
-            causal)
+            causal, q_seg=_tile_seg(q_segment_ids, H),
+            k_seg=_tile_seg(k_segment_ids, H))
+    else:
+        use_pallas, interpret = _resolve_dispatch(use_pallas)
+        if use_pallas:
+            acc, m, l = _block_state_core(
+                _merge_heads(q), _merge_heads(k), _merge_heads(v), offs,
+                causal, interpret)
+        else:
+            acc, m, l = _xla_block_state(
+                _merge_heads(q), _merge_heads(k), _merge_heads(v), offs,
+                causal)
     acc = acc.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
     m = m.reshape(B, H, Tq)
     l = l.reshape(B, H, Tq)
@@ -397,7 +421,8 @@ def flash_attention_block(q, k, v, q_off, k_off, causal: bool = True,
 
 def flash_attention_block_grads(q, k, v, do, lse, delta, q_off, k_off,
                                 causal: bool = True,
-                                use_pallas: Optional[bool] = None):
+                                use_pallas: Optional[bool] = None,
+                                q_segment_ids=None, k_segment_ids=None):
     """One K/V block's (dq, dk, dv) for ring attention's backward pass.
 
     q/k/v/do: [B, T, H, D]; lse/delta: f32 [B, H, T] — the GLOBAL row
@@ -416,7 +441,13 @@ def flash_attention_block_grads(q, k, v, do, lse, delta, q_off, k_off,
     qm, km, vm, dom = (_merge_heads(x) for x in (q, k, v, do))
     lse_m = lse.reshape(B * H, Tq, 1)
     delta_m = delta.reshape(B * H, Tq, 1)
-    if use_pallas and _pick_block(Tq, BLOCK_Q) is not None and \
+    _require_both_segs(q_segment_ids, k_segment_ids)
+    if q_segment_ids is not None:
+        dq, dk, dv = _xla_block_grads(
+            qm, km, vm, dom, lse_m, delta_m, offs, causal,
+            out_dtype=jnp.float32, q_seg=_tile_seg(q_segment_ids, H),
+            k_seg=_tile_seg(k_segment_ids, H))
+    elif use_pallas and _pick_block(Tq, BLOCK_Q) is not None and \
             _pick_block(Tk, BLOCK_K) is not None:
         dq, dk, dv = _pallas_bwd(qm, km, vm, dom, lse_m, delta_m, offs,
                                  causal, interpret, out_dtype=jnp.float32)
@@ -580,7 +611,7 @@ def _pallas_bwd(q, k, v, do, lse, delta, offs, causal: bool,
 
 
 def _xla_block_grads(q, k, v, do, lse, delta, offs, causal: bool,
-                     out_dtype=None):
+                     out_dtype=None, q_seg=None, k_seg=None):
     """XLA twin of the backward kernels (fallback for untileable shapes
     and non-TPU platforms). Same math, same lse/delta residuals."""
     dq_dt = out_dtype or q.dtype
@@ -594,6 +625,8 @@ def _xla_block_grads(q, k, v, do, lse, delta, offs, causal: bool,
         iq = jnp.arange(q.shape[1])[:, None] + offs[0]
         ik = jnp.arange(k.shape[1])[None, :] + offs[1]
         p = jnp.where((iq >= ik)[None], p, 0.0)
+    if q_seg is not None:
+        p = _apply_segment_mask(p, q_seg, k_seg, 0.0)
     dof = do.astype(jnp.float32)
     dv = jnp.einsum("bts,btd->bsd", p, dof)
     dp = jnp.einsum("btd,bsd->bts", dof, v.astype(jnp.float32))
@@ -616,9 +649,11 @@ def _pick_block(t: int, cap: int) -> Optional[int]:
     return None
 
 
-def _xla_flash(q, k, v, q_off, k_off, causal):
+def _xla_flash(q, k, v, q_off, k_off, causal, q_seg=None, k_seg=None):
     """XLA reference path (backward recompute + non-TPU fallback), fp32
-    accumulation — the same math as parallel.ring_attention."""
+    accumulation — the same math as parallel.ring_attention.
+    ``q_seg``/``k_seg``: optional int32 [BH, T] segment ids (packed
+    sequences); tokens attend only within their segment."""
     scale = 1.0 / (q.shape[-1] ** 0.5)
     s = jnp.einsum("btd,bsd->bts", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
@@ -626,6 +661,8 @@ def _xla_flash(q, k, v, q_off, k_off, causal):
         iq = jnp.arange(q.shape[1])[:, None] + q_off
         ik = jnp.arange(k.shape[1])[None, :] + k_off
         s = jnp.where(iq >= ik, s, NEG_INF)
+    if q_seg is not None:
+        s = _apply_segment_mask(s, q_seg, k_seg, NEG_INF)
     # Rows whose keys are all masked normalize to zero output, matching
     # the kernel's max(l, eps) guard.
     m = jnp.max(s, axis=-1, keepdims=True)
@@ -670,21 +707,42 @@ def _flash_bwd(q_off, k_off, causal, interpret, res, g):
 _flash_core.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _tile_seg(seg, heads):
+    """[B, T] int segment ids -> [B*H, T] aligned with _merge_heads."""
+    return jnp.repeat(jnp.asarray(seg, jnp.int32), heads, axis=0)
+
+
 def flash_attention(q, k, v, causal: bool = True, q_off: int = 0,
-                    k_off: int = 0, use_pallas: Optional[bool] = None):
+                    k_off: int = 0, use_pallas: Optional[bool] = None,
+                    q_segment_ids=None, k_segment_ids=None):
     """Blocked flash attention. q/k/v: [B, T, H, D].
 
     ``use_pallas=None`` auto-selects via ``_resolve_dispatch``.
     ``q_off``/``k_off`` are the global token offsets of the blocks — ring
     attention passes the rotating K block's origin so causal masking stays
     globally correct.
+
+    ``q_segment_ids``/``k_segment_ids`` (int [B, T]): packed-sequence
+    masking — a token attends only to keys with its segment id (composed
+    with the causal mask). Currently served by the XLA path (still
+    flash-style fp32-accumulated math, XLA-fused); the Mosaic kernels
+    don't take segment tiles yet, so ``use_pallas`` is ignored when
+    segments are given.
     """
     B, Tq, H, D = q.shape
-    use_pallas, interpret = _resolve_dispatch(use_pallas)
 
     def split(x, t):
         return x.reshape(B, H, t, D).transpose(0, 2, 1, 3)
 
+    _require_both_segs(q_segment_ids, k_segment_ids)
+    if q_segment_ids is not None:
+        out = _xla_flash(_merge_heads(q), _merge_heads(k), _merge_heads(v),
+                         q_off, k_off, causal,
+                         q_seg=_tile_seg(q_segment_ids, H),
+                         k_seg=_tile_seg(k_segment_ids, H))
+        return split(out, Tq)
+
+    use_pallas, interpret = _resolve_dispatch(use_pallas)
     if not use_pallas:
         out = _xla_flash(_merge_heads(q), _merge_heads(k), _merge_heads(v),
                          q_off, k_off, causal)

@@ -16,6 +16,8 @@ from __future__ import annotations
 
 import functools
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -49,10 +51,12 @@ def _block_attend(q, k, v, m, l, o, mask):
     return m_new, l_new, o_new
 
 
-def _ring_fwd_pass(q, k, v, axis_name: str, causal: bool):
+def _ring_fwd_pass(q, k, v, seg, axis_name: str, causal: bool):
     """The forward ring: flash block kernel per rotating K/V block +
     online-softmax merge. Returns (o in q.dtype, lse f32 [B, H, Tq]) —
-    lse is the backward pass's residual."""
+    lse is the backward pass's residual. ``seg``: optional int32 [B, T]
+    local segment ids (packed sequences); the K-side ids rotate with
+    their K/V block."""
     sp = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
@@ -63,7 +67,7 @@ def _ring_fwd_pass(q, k, v, axis_name: str, causal: bool):
     fwd_perm = [(i, (i + 1) % sp) for i in range(sp)]
 
     def body(carry, step):
-        m, l, o, k_cur, v_cur = carry
+        m, l, o, k_cur, v_cur, kseg_cur = carry
         # k_cur originated at rank (my - step) mod sp. Each block's local
         # attention state comes from the flash kernel (Pallas on TPU, XLA
         # elsewhere); the cross-block merge below is the standard
@@ -73,7 +77,8 @@ def _ring_fwd_pass(q, k, v, axis_name: str, causal: bool):
         k_blk = (my - step) % sp
         acc_b, m_b, l_b = flash_attention_block(
             q, k_cur, v_cur, q_off=my * Tq, k_off=k_blk * k_cur.shape[1],
-            causal=causal)
+            causal=causal, q_segment_ids=seg,
+            k_segment_ids=None if seg is None else kseg_cur)
         m_new = jnp.maximum(m, m_b)                       # [B,H,Tq]
         alive = m_new > NEG_INF / 2
         c_old = jnp.where(alive, jnp.exp(m - m_new), 1.0)
@@ -84,10 +89,13 @@ def _ring_fwd_pass(q, k, v, axis_name: str, causal: bool):
              acc_b * c_blk.transpose(0, 2, 1)[..., None])
         k_nxt = lax.ppermute(k_cur, axis_name, fwd_perm)
         v_nxt = lax.ppermute(v_cur, axis_name, fwd_perm)
-        return (m_new, l, o, k_nxt, v_nxt), None
+        kseg_nxt = (kseg_cur if seg is None else
+                    lax.ppermute(kseg_cur, axis_name, fwd_perm))
+        return (m_new, l, o, k_nxt, v_nxt, kseg_nxt), None
 
-    (m, l, o, _, _), _ = lax.scan(
-        body, (m, l, o, k, v), jnp.arange(sp))
+    kseg0 = jnp.zeros((B, Tq), jnp.int32) if seg is None else seg
+    (m, l, o, _, _, _), _ = lax.scan(
+        body, (m, l, o, k, v, kseg0), jnp.arange(sp))
     o = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
     # Dead rows (no visible key) take a huge POSITIVE lse so the
     # backward's exp(s - lse) underflows to zero for them.
@@ -95,14 +103,14 @@ def _ring_fwd_pass(q, k, v, axis_name: str, causal: bool):
     return o.astype(q.dtype), lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _ring_core(q, k, v, axis_name, causal):
-    return _ring_fwd_pass(q, k, v, axis_name, causal)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _ring_core(q, k, v, seg, axis_name, causal):
+    return _ring_fwd_pass(q, k, v, seg, axis_name, causal)[0]
 
 
-def _ring_vjp_fwd(q, k, v, axis_name, causal):
-    o, lse = _ring_fwd_pass(q, k, v, axis_name, causal)
-    return o, (q, k, v, o, lse)
+def _ring_vjp_fwd(q, k, v, seg, axis_name, causal):
+    o, lse = _ring_fwd_pass(q, k, v, seg, axis_name, causal)
+    return o, (q, k, v, seg, o, lse)
 
 
 def _ring_vjp_bwd(axis_name, causal, res, do):
@@ -114,7 +122,7 @@ def _ring_vjp_bwd(axis_name, causal, res, do):
     (k, v, dk, dv per step), the standard ring-backward cost."""
     from ..ops.pallas_attention import flash_attention_block_grads
 
-    q, k, v, o, lse = res
+    q, k, v, seg, o, lse = res
     sp = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
@@ -128,29 +136,37 @@ def _ring_vjp_bwd(axis_name, causal, res, do):
     dv0 = jnp.zeros((B, Tk, H, D), jnp.float32)
 
     def body(carry, step):
-        dq, dk, dv, k_cur, v_cur = carry
+        dq, dk, dv, k_cur, v_cur, kseg_cur = carry
         k_blk = (my - step) % sp
         dq_b, dk_b, dv_b = flash_attention_block_grads(
             q, k_cur, v_cur, do, lse, delta,
-            q_off=my * Tq, k_off=k_blk * Tk, causal=causal)
+            q_off=my * Tq, k_off=k_blk * Tk, causal=causal,
+            q_segment_ids=seg,
+            k_segment_ids=None if seg is None else kseg_cur)
         dq = dq + dq_b
         dk = dk + dk_b
         dv = dv + dv_b
         k_nxt = lax.ppermute(k_cur, axis_name, fwd_perm)
         v_nxt = lax.ppermute(v_cur, axis_name, fwd_perm)
+        kseg_nxt = (kseg_cur if seg is None else
+                    lax.ppermute(kseg_cur, axis_name, fwd_perm))
         dk = lax.ppermute(dk, axis_name, fwd_perm)
         dv = lax.ppermute(dv, axis_name, fwd_perm)
-        return (dq, dk, dv, k_nxt, v_nxt), None
+        return (dq, dk, dv, k_nxt, v_nxt, kseg_nxt), None
 
-    (dq, dk, dv, _, _), _ = lax.scan(
-        body, (dq0, dk0, dv0, k, v), jnp.arange(sp))
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    kseg0 = jnp.zeros((B, Tq), jnp.int32) if seg is None else seg
+    (dq, dk, dv, _, _, _), _ = lax.scan(
+        body, (dq0, dk0, dv0, k, v, kseg0), jnp.arange(sp))
+    dseg = None if seg is None else np.zeros(
+        seg.shape, dtype=jax.dtypes.float0)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), dseg
 
 
 _ring_core.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
 
 
-def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True):
+def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
+                   segment_ids=None):
     """Context-parallel attention. q/k/v: [B, T_local, H, D] per chip.
 
     Every K/V block's local attention runs through the flash kernel
@@ -161,13 +177,23 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True):
     overlaps compute under XLA's collective scheduling. Training's
     backward is a second ring pass through the flash backward kernels
     (``_ring_vjp_bwd``) — no attention recompute through XLA.
+
+    ``segment_ids`` (int [B, T_local], sequence-sharded like q):
+    packed-sequence masking — tokens attend only within their segment;
+    the K-side ids rotate around the ring with their K/V block. Segment
+    blocks currently run the XLA flash twin (Mosaic segment tiles
+    pending, ``ops.pallas_attention``).
     """
     sp = lax.axis_size(axis_name)
     if sp == 1:
         from ..ops.pallas_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=causal)
-    return _ring_core(q, k, v, axis_name, causal)
+        return flash_attention(q, k, v, causal=causal,
+                               q_segment_ids=segment_ids,
+                               k_segment_ids=segment_ids)
+    if segment_ids is not None:
+        segment_ids = jnp.asarray(segment_ids, jnp.int32)
+    return _ring_core(q, k, v, segment_ids, axis_name, causal)
 
 
 def local_flash_attention(q, k, v, causal: bool = True):

@@ -33,18 +33,24 @@ from __future__ import annotations
 from jax import lax
 
 
-def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True):
+def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
+                      segment_ids=None):
     """Context-parallel attention via head<->sequence all-to-all.
 
     q/k/v: [B, T_local, H, D] per chip, sequence-sharded over
     ``axis_name``. Returns [B, T_local, H, D] with the same sharding.
-    Requires ``H % axis_size == 0``.
+    Requires ``H % axis_size == 0``. ``segment_ids`` (int [B, T_local],
+    sequence-sharded like q): packed-sequence masking — after the
+    re-shard every chip holds the full sequence, so the ids are simply
+    all-gathered along it.
     """
     sp = lax.axis_size(axis_name)
     from ..ops.pallas_attention import flash_attention
 
     if sp == 1:
-        return flash_attention(q, k, v, causal=causal)
+        return flash_attention(q, k, v, causal=causal,
+                               q_segment_ids=segment_ids,
+                               k_segment_ids=segment_ids)
     heads = q.shape[2]
     if heads % sp != 0:
         raise ValueError(
@@ -62,20 +68,30 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True):
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
 
+    full_seg = None
+    if segment_ids is not None:
+        from jax import numpy as jnp
+
+        full_seg = lax.all_gather(
+            jnp.asarray(segment_ids, jnp.int32), axis_name,
+            axis=1, tiled=True)  # [B, T_global]
     o = flash_attention(seq_to_heads(q), seq_to_heads(k), seq_to_heads(v),
-                        causal=causal)
+                        causal=causal, q_segment_ids=full_seg,
+                        k_segment_ids=full_seg)
     return heads_to_seq(o)
 
 
 def context_parallel_attention(q, k, v, axis_name: str = "sp",
                                causal: bool = True,
-                               strategy: str = "ring"):
+                               strategy: str = "ring",
+                               segment_ids=None):
     """Dispatch between the two sequence-parallel attention strategies.
 
     ``strategy``: ``"ring"`` (default — no head constraint, T_local
     working set), ``"ulysses"`` (all-to-all re-shard, needs
     heads % sp == 0), or ``"auto"`` (ulysses when the head constraint
-    holds, ring otherwise).
+    holds, ring otherwise). ``segment_ids``: packed-sequence masking,
+    accepted by both strategies.
     """
     from .ring_attention import ring_attention
 
@@ -83,8 +99,10 @@ def context_parallel_attention(q, k, v, axis_name: str = "sp",
         sp = lax.axis_size(axis_name)
         strategy = "ulysses" if q.shape[2] % sp == 0 else "ring"
     if strategy == "ulysses":
-        return ulysses_attention(q, k, v, axis_name=axis_name, causal=causal)
+        return ulysses_attention(q, k, v, axis_name=axis_name,
+                                 causal=causal, segment_ids=segment_ids)
     if strategy == "ring":
-        return ring_attention(q, k, v, axis_name=axis_name, causal=causal)
+        return ring_attention(q, k, v, axis_name=axis_name, causal=causal,
+                              segment_ids=segment_ids)
     raise ValueError(f"unknown sequence-parallel strategy {strategy!r}; "
                      "expected 'ring', 'ulysses', or 'auto'")

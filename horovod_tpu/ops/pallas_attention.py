@@ -43,13 +43,17 @@ BLOCK_K = 512
 def _attn_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
                  acc_ref, *, causal: bool, block_q: int, block_k: int,
                  num_k_tiles: int, return_state: bool = False,
-                 mo_ref=None, lo_ref=None, lse_ref=None):
+                 mo_ref=None, lo_ref=None, lse_ref=None,
+                 qs_ref=None, ks_ref=None):
     """One (batch*head, q-tile, k-tile) grid step.
 
     Refs: q (1, block_q, D), k/v (1, block_k, D), o (1, block_q, D);
     scratch m/l (block_q, 1) and acc (block_q, D) carry the online-softmax
     state across the sequential k dimension. offs = [q_off, k_off] global
     token offsets of sequence block 0 (ring attention rotates k blocks).
+    qs/ks (1, block, 1) int32: optional packed-sequence segment ids —
+    the mask composes with causal at trace time, so the segment-free
+    path compiles identically to before.
     """
     ki = pl.program_id(2)
 
@@ -89,6 +93,9 @@ def _attn_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
             k_pos = (k_base +
                      jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if qs_ref is not None:
+            s = jnp.where(qs_ref[0] == ks_ref[0].reshape(1, -1),
+                          s, NEG_INF)
 
         m_prev = m_ref[:]                      # [block_q, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -137,6 +144,15 @@ def _attn_kernel_state(offs_ref, q_ref, k_ref, v_ref, o_ref, mo_ref,
                  **kw)
 
 
+def _attn_kernel_state_seg(offs_ref, q_ref, k_ref, v_ref, qs_ref, ks_ref,
+                           o_ref, mo_ref, lo_ref, m_ref, l_ref, acc_ref,
+                           **kw):
+    """Block-mode adapter with segment-id tiles (inputs ride after v)."""
+    _attn_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                 acc_ref, return_state=True, mo_ref=mo_ref, lo_ref=lo_ref,
+                 qs_ref=qs_ref, ks_ref=ks_ref, **kw)
+
+
 def _attn_kernel_train(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                        m_ref, l_ref, acc_ref, **kw):
     """Training-forward adapter: normalized O plus the per-row lse
@@ -145,9 +161,18 @@ def _attn_kernel_train(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                  acc_ref, lse_ref=lse_ref, **kw)
 
 
+def _attn_kernel_train_seg(offs_ref, q_ref, k_ref, v_ref, qs_ref, ks_ref,
+                           o_ref, lse_ref, m_ref, l_ref, acc_ref, **kw):
+    """Training-forward adapter with segment-id tiles."""
+    _attn_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                 acc_ref, lse_ref=lse_ref, qs_ref=qs_ref, ks_ref=ks_ref,
+                 **kw)
+
+
 def _attn_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                         delta_ref, dq_ref, dq_acc, *, causal: bool,
-                        block_q: int, block_k: int, num_k_tiles: int):
+                        block_q: int, block_k: int, num_k_tiles: int,
+                        qs_ref=None, ks_ref=None):
     """dQ pass: grid (batch*head, q-tile, k-tile), sequential over K tiles.
 
     P = exp(S - lse) is rebuilt on-chip from the saved lse;
@@ -178,6 +203,8 @@ def _attn_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             q_pos = q_base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = k_base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             p = jnp.where(q_pos >= k_pos, p, 0.0)
+        if qs_ref is not None:
+            p = jnp.where(qs_ref[0] == ks_ref[0].reshape(1, -1), p, 0.0)
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)              # [bq, bk]
@@ -192,10 +219,19 @@ def _attn_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
+def _attn_bwd_dq_kernel_seg(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                            delta_ref, qs_ref, ks_ref, dq_ref, dq_acc,
+                            **kw):
+    """dQ adapter with segment-id tiles (inputs ride after delta)."""
+    _attn_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                        delta_ref, dq_ref, dq_acc, qs_ref=qs_ref,
+                        ks_ref=ks_ref, **kw)
+
+
 def _attn_bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                          delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
                          causal: bool, block_q: int, block_k: int,
-                         num_q_tiles: int):
+                         num_q_tiles: int, qs_ref=None, ks_ref=None):
     """dK/dV pass: grid (batch*head, k-tile, q-tile), sequential over Q
     tiles. Same [bq, bk] orientation as the dQ pass; the transposed
     contractions (P^T.dO, dS^T.Q) ride dot_general dimension numbers so
@@ -226,6 +262,8 @@ def _attn_bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             q_pos = q_base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = k_base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             p = jnp.where(q_pos >= k_pos, p, 0.0)
+        if qs_ref is not None:
+            p = jnp.where(qs_ref[0] == ks_ref[0].reshape(1, -1), p, 0.0)
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do,
             dimension_numbers=(((0,), (0,)), ((), ())),
@@ -245,10 +283,48 @@ def _attn_bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _pallas_block_state(q, k, v, offs, causal: bool, interpret: bool):
+def _attn_bwd_dkv_kernel_seg(offs_ref, q_ref, k_ref, v_ref, do_ref,
+                             lse_ref, delta_ref, qs_ref, ks_ref, dk_ref,
+                             dv_ref, dk_acc, dv_acc, **kw):
+    """dK/dV adapter with segment-id tiles (inputs ride after delta)."""
+    _attn_bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                         delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                         qs_ref=qs_ref, ks_ref=ks_ref, **kw)
+
+
+def _seg3(seg):
+    """[BH, T] int32 -> [BH, T, 1]: the row-oriented layout the lse/delta
+    tiles already use. Mosaic requires the last two block dims be
+    (8, 128)-divisible or full-extent; a (1, block, 1) tile satisfies
+    that for EVERY _pick_block size (block >= 8 on the sublane dim, the
+    lane dim full at 1) — the lane-major (1, 1, block) layout fails for
+    blocks < 128."""
+    return seg[:, :, None]
+
+
+def _seg_specs(bq, bk):
+    """BlockSpecs for the (1, block, 1) int32 segment-id tiles."""
+    return [
+        pl.BlockSpec((1, bq, 1), lambda bh, qi, ki, offs: (bh, qi, 0)),
+        pl.BlockSpec((1, bk, 1), lambda bh, qi, ki, offs: (bh, ki, 0)),
+    ]
+
+
+def int_cotangent(x):
+    """Symbolic-zero cotangent for an optional integer array argument of
+    a custom_vjp (None passes through)."""
+    import numpy as np
+
+    return None if x is None else np.zeros(x.shape,
+                                           dtype=jax.dtypes.float0)
+
+
+def _pallas_block_state(q, k, v, offs, causal: bool, interpret: bool,
+                        q_seg=None, k_seg=None):
     """q/k/v: [BH, T, D]. Returns (acc f32 [BH,Tq,D], m f32 [BH,Tq,1],
     l f32 [BH,Tq,1]) — the unmerged online-softmax state of this K block
-    (ring attention merges blocks as they rotate)."""
+    (ring attention merges blocks as they rotate). ``q_seg``/``k_seg``:
+    optional int32 [BH, T] segment ids (streamed as extra tiles)."""
     BH, Tq, D = q.shape
     Tk = k.shape[1]
     bq = _pick_block(Tq, BLOCK_Q)
@@ -258,14 +334,22 @@ def _pallas_block_state(q, k, v, offs, causal: bool, interpret: bool):
 
     from jax.experimental.pallas import tpu as pltpu
 
+    in_specs = [
+        pl.BlockSpec((1, bq, D), lambda bh, qi, ki, offs: (bh, qi, 0)),
+        pl.BlockSpec((1, bk, D), lambda bh, qi, ki, offs: (bh, ki, 0)),
+        pl.BlockSpec((1, bk, D), lambda bh, qi, ki, offs: (bh, ki, 0)),
+    ]
+    args = [offs, q, k, v]
+    if q_seg is not None:
+        in_specs += _seg_specs(bq, bk)
+        args += [_seg3(q_seg), _seg3(k_seg)]
+        kernel_fn = _attn_kernel_state_seg
+    else:
+        kernel_fn = _attn_kernel_state
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(BH, num_q, num_k),
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda bh, qi, ki, offs: (bh, qi, 0)),
-            pl.BlockSpec((1, bk, D), lambda bh, qi, ki, offs: (bh, ki, 0)),
-            pl.BlockSpec((1, bk, D), lambda bh, qi, ki, offs: (bh, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, D),
                          lambda bh, qi, ki, offs: (bh, qi, 0)),
@@ -281,7 +365,7 @@ def _pallas_block_state(q, k, v, offs, causal: bool, interpret: bool):
         ],
     )
     kernel = functools.partial(
-        _attn_kernel_state, causal=causal, block_q=bq, block_k=bk,
+        kernel_fn, causal=causal, block_q=bq, block_k=bk,
         num_k_tiles=num_k)
     return pl.pallas_call(
         kernel,
@@ -294,7 +378,7 @@ def _pallas_block_state(q, k, v, offs, causal: bool, interpret: bool):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(offs, q, k, v)
+    )(*args)
 
 
 def _apply_segment_mask(x, q_seg, k_seg, fill):
@@ -331,29 +415,34 @@ def _xla_block_state(q, k, v, offs, causal, q_seg=None, k_seg=None):
     return acc, m, l
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _block_state_core(q, k, v, offs, causal, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7))
+def _block_state_core(q, k, v, offs, q_seg, k_seg, causal, interpret):
     if _pick_block(q.shape[1], BLOCK_Q) is None or \
             _pick_block(k.shape[1], BLOCK_K) is None:
-        return _xla_block_state(q, k, v, offs, causal)
-    return _pallas_block_state(q, k, v, offs, causal, interpret)
+        return _xla_block_state(q, k, v, offs, causal, q_seg=q_seg,
+                                k_seg=k_seg)
+    return _pallas_block_state(q, k, v, offs, causal, interpret,
+                               q_seg=q_seg, k_seg=k_seg)
 
 
-def _block_state_fwd(q, k, v, offs, causal, interpret):
-    return _block_state_core(q, k, v, offs, causal, interpret), \
-        (q, k, v, offs)
+def _block_state_fwd(q, k, v, offs, q_seg, k_seg, causal, interpret):
+    return _block_state_core(q, k, v, offs, q_seg, k_seg, causal,
+                             interpret), (q, k, v, offs, q_seg, k_seg)
 
 
 def _block_state_bwd(causal, interpret, res, g):
     import numpy as np
 
-    q, k, v, offs = res
+    q, k, v, offs, q_seg, k_seg = res
     _, vjp = jax.vjp(
-        lambda q_, k_, v_: _xla_block_state(q_, k_, v_, offs, causal),
+        lambda q_, k_, v_: _xla_block_state(q_, k_, v_, offs, causal,
+                                            q_seg=q_seg, k_seg=k_seg),
         q, k, v)
     dq, dk, dv = vjp(g)
-    # Integer offsets carry the symbolic-zero cotangent.
-    return dq, dk, dv, np.zeros((2,), dtype=jax.dtypes.float0)
+
+    # Integer offsets/segment ids carry the symbolic-zero cotangent.
+    return (dq, dk, dv, np.zeros((2,), dtype=jax.dtypes.float0),
+            int_cotangent(q_seg), int_cotangent(k_seg))
 
 
 _block_state_core.defvjp(_block_state_fwd, _block_state_bwd)
@@ -390,29 +479,27 @@ def flash_attention_block(q, k, v, q_off, k_off, causal: bool = True,
     q/k/v: [B, T, H, D]. Returns (acc, m, l) with acc f32 [B, T, H, D]
     (unnormalized P.V), m/l f32 [B, H, T] — merge across blocks with the
     online-softmax combine. Dispatch rules match ``flash_attention``
-    (shared ``_resolve_dispatch``); segment ids route to the XLA twin
-    (packed sequences, Mosaic segment tiles pending).
+    (shared ``_resolve_dispatch``); segment ids stream into the same
+    kernels as extra id tiles (packed sequences).
     """
     B, Tq, H, D = q.shape
 
     offs = jnp.stack([jnp.asarray(q_off, jnp.int32),
                       jnp.asarray(k_off, jnp.int32)])
     _require_both_segs(q_segment_ids, k_segment_ids)
+    q_seg = k_seg = None
     if q_segment_ids is not None:
+        q_seg = _tile_seg(q_segment_ids, H)
+        k_seg = _tile_seg(k_segment_ids, H)
+    use_pallas, interpret = _resolve_dispatch(use_pallas)
+    if use_pallas:
+        acc, m, l = _block_state_core(
+            _merge_heads(q), _merge_heads(k), _merge_heads(v), offs,
+            q_seg, k_seg, causal, interpret)
+    else:
         acc, m, l = _xla_block_state(
             _merge_heads(q), _merge_heads(k), _merge_heads(v), offs,
-            causal, q_seg=_tile_seg(q_segment_ids, H),
-            k_seg=_tile_seg(k_segment_ids, H))
-    else:
-        use_pallas, interpret = _resolve_dispatch(use_pallas)
-        if use_pallas:
-            acc, m, l = _block_state_core(
-                _merge_heads(q), _merge_heads(k), _merge_heads(v), offs,
-                causal, interpret)
-        else:
-            acc, m, l = _xla_block_state(
-                _merge_heads(q), _merge_heads(k), _merge_heads(v), offs,
-                causal)
+            causal, q_seg=q_seg, k_seg=k_seg)
     acc = acc.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
     m = m.reshape(B, H, Tq)
     l = l.reshape(B, H, Tq)
@@ -442,18 +529,19 @@ def flash_attention_block_grads(q, k, v, do, lse, delta, q_off, k_off,
     lse_m = lse.reshape(B * H, Tq, 1)
     delta_m = delta.reshape(B * H, Tq, 1)
     _require_both_segs(q_segment_ids, k_segment_ids)
+    q_seg = k_seg = None
     if q_segment_ids is not None:
-        dq, dk, dv = _xla_block_grads(
-            qm, km, vm, dom, lse_m, delta_m, offs, causal,
-            out_dtype=jnp.float32, q_seg=_tile_seg(q_segment_ids, H),
-            k_seg=_tile_seg(k_segment_ids, H))
-    elif use_pallas and _pick_block(Tq, BLOCK_Q) is not None and \
+        q_seg = _tile_seg(q_segment_ids, H)
+        k_seg = _tile_seg(k_segment_ids, H)
+    if use_pallas and _pick_block(Tq, BLOCK_Q) is not None and \
             _pick_block(Tk, BLOCK_K) is not None:
         dq, dk, dv = _pallas_bwd(qm, km, vm, dom, lse_m, delta_m, offs,
-                                 causal, interpret, out_dtype=jnp.float32)
+                                 causal, interpret, out_dtype=jnp.float32,
+                                 q_seg=q_seg, k_seg=k_seg)
     else:
         dq, dk, dv = _xla_block_grads(qm, km, vm, dom, lse_m, delta_m,
-                                      offs, causal, out_dtype=jnp.float32)
+                                      offs, causal, out_dtype=jnp.float32,
+                                      q_seg=q_seg, k_seg=k_seg)
 
     def split(x, t):
         return x.reshape(B, H, t, D).transpose(0, 2, 1, 3)
@@ -503,7 +591,7 @@ def _pallas_attention_fwd(q, k, v, q_off, k_off, causal: bool,
 
 
 def _pallas_attention_fwd_train(q, k, v, offs, causal: bool,
-                                interpret: bool):
+                                interpret: bool, q_seg=None, k_seg=None):
     """Forward with residuals: (o [BH,T,D] in q.dtype, lse f32 [BH,T,1])."""
     BH, Tq, D = q.shape
     Tk = k.shape[1]
@@ -514,14 +602,22 @@ def _pallas_attention_fwd_train(q, k, v, offs, causal: bool,
 
     from jax.experimental.pallas import tpu as pltpu
 
+    in_specs = [
+        pl.BlockSpec((1, bq, D), lambda bh, qi, ki, offs: (bh, qi, 0)),
+        pl.BlockSpec((1, bk, D), lambda bh, qi, ki, offs: (bh, ki, 0)),
+        pl.BlockSpec((1, bk, D), lambda bh, qi, ki, offs: (bh, ki, 0)),
+    ]
+    args = [offs, q, k, v]
+    if q_seg is not None:
+        in_specs += _seg_specs(bq, bk)
+        args += [_seg3(q_seg), _seg3(k_seg)]
+        kernel_fn = _attn_kernel_train_seg
+    else:
+        kernel_fn = _attn_kernel_train
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(BH, num_q, num_k),
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda bh, qi, ki, offs: (bh, qi, 0)),
-            pl.BlockSpec((1, bk, D), lambda bh, qi, ki, offs: (bh, ki, 0)),
-            pl.BlockSpec((1, bk, D), lambda bh, qi, ki, offs: (bh, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda bh, qi, ki, offs: (bh, qi, 0)),
             pl.BlockSpec((1, bq, 1), lambda bh, qi, ki, offs: (bh, qi, 0)),
@@ -533,7 +629,7 @@ def _pallas_attention_fwd_train(q, k, v, offs, causal: bool,
         ],
     )
     kernel = functools.partial(
-        _attn_kernel_train, causal=causal, block_q=bq, block_k=bk,
+        kernel_fn, causal=causal, block_q=bq, block_k=bk,
         num_k_tiles=num_k)
     return pl.pallas_call(
         kernel,
@@ -545,11 +641,11 @@ def _pallas_attention_fwd_train(q, k, v, offs, causal: bool,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(offs, q, k, v)
+    )(*args)
 
 
 def _pallas_bwd(q, k, v, do, lse, delta, offs, causal: bool,
-                interpret: bool, out_dtype=None):
+                interpret: bool, out_dtype=None, q_seg=None, k_seg=None):
     """The two flash-backward kernels; returns (dq, dk, dv) in the input
     dtypes (or ``out_dtype`` when given — ring accumulation wants f32).
     lse/delta: f32 [BH, T, 1]."""
@@ -568,13 +664,21 @@ def _pallas_bwd(q, k, v, do, lse, delta, offs, causal: bool,
     q_spec = pl.BlockSpec((1, bq, D), lambda bh, qi, ki, offs: (bh, qi, 0))
     k_spec = pl.BlockSpec((1, bk, D), lambda bh, qi, ki, offs: (bh, ki, 0))
     row_spec = pl.BlockSpec((1, bq, 1), lambda bh, qi, ki, offs: (bh, qi, 0))
+    dq_in_specs = [q_spec, k_spec, k_spec, q_spec, row_spec, row_spec]
+    dq_args = [offs, q, k, v, do, lse, delta]
+    if q_seg is not None:
+        dq_in_specs += _seg_specs(bq, bk)
+        dq_args += [_seg3(q_seg), _seg3(k_seg)]
+        dq_kernel = _attn_bwd_dq_kernel_seg
+    else:
+        dq_kernel = _attn_bwd_dq_kernel
     dq = pl.pallas_call(
-        functools.partial(_attn_bwd_dq_kernel, causal=causal, block_q=bq,
+        functools.partial(dq_kernel, causal=causal, block_q=bq,
                           block_k=bk, num_k_tiles=num_k),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(BH, num_q, num_k),
-            in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+            in_specs=dq_in_specs,
             out_specs=q_spec,
             scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         ),
@@ -582,21 +686,32 @@ def _pallas_bwd(q, k, v, do, lse, delta, offs, causal: bool,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(offs, q, k, v, do, lse, delta)
+    )(*dq_args)
 
     # dK/dV pass: K tiles are the parallel dimension, Q tiles sequential.
     qkv_spec = pl.BlockSpec((1, bq, D), lambda bh, ki, qi, offs: (bh, qi, 0))
     kkv_spec = pl.BlockSpec((1, bk, D), lambda bh, ki, qi, offs: (bh, ki, 0))
     rowkv_spec = pl.BlockSpec((1, bq, 1),
                               lambda bh, ki, qi, offs: (bh, qi, 0))
+    kv_in_specs = [qkv_spec, kkv_spec, kkv_spec, qkv_spec, rowkv_spec,
+                   rowkv_spec]
+    kv_args = [offs, q, k, v, do, lse, delta]
+    if q_seg is not None:
+        kv_in_specs += [
+            pl.BlockSpec((1, bq, 1), lambda bh, ki, qi, offs: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, 1), lambda bh, ki, qi, offs: (bh, ki, 0)),
+        ]
+        kv_args += [_seg3(q_seg), _seg3(k_seg)]
+        kv_kernel = _attn_bwd_dkv_kernel_seg
+    else:
+        kv_kernel = _attn_bwd_dkv_kernel
     dk, dv = pl.pallas_call(
-        functools.partial(_attn_bwd_dkv_kernel, causal=causal, block_q=bq,
+        functools.partial(kv_kernel, causal=causal, block_q=bq,
                           block_k=bk, num_q_tiles=num_q),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(BH, num_k, num_q),
-            in_specs=[qkv_spec, kkv_spec, kkv_spec, qkv_spec, rowkv_spec,
-                      rowkv_spec],
+            in_specs=kv_in_specs,
             out_specs=[kkv_spec, kkv_spec],
             scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
                             pltpu.VMEM((bk, D), jnp.float32)],
@@ -606,7 +721,7 @@ def _pallas_bwd(q, k, v, do, lse, delta, offs, causal: bool,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(offs, q, k, v, do, lse, delta)
+    )(*kv_args)
     return dq, dk, dv
 
 
@@ -672,36 +787,54 @@ def _xla_flash(q, k, v, q_off, k_off, causal, q_seg=None, k_seg=None):
     return o.astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_core(q, k, v, q_off, k_off, causal, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_core(q, k, v, q_seg, k_seg, q_off, k_off, causal, interpret):
+    # Primal (non-autodiff) calls take the training forward too when
+    # segments ride along — the lse output is simply dropped.
     if _pick_block(q.shape[1], BLOCK_Q) is None or \
             _pick_block(k.shape[1], BLOCK_K) is None:
-        return _xla_flash(q, k, v, q_off, k_off, causal)
-    return _pallas_attention_fwd(q, k, v, q_off, k_off, causal, interpret)
-
-
-def _flash_fwd(q, k, v, q_off, k_off, causal, interpret):
-    if _pick_block(q.shape[1], BLOCK_Q) is None or \
-            _pick_block(k.shape[1], BLOCK_K) is None:
-        return _xla_flash(q, k, v, q_off, k_off, causal), \
-            (q, k, v, None, None)
+        return _xla_flash(q, k, v, q_off, k_off, causal, q_seg=q_seg,
+                          k_seg=k_seg)
+    if q_seg is None:
+        return _pallas_attention_fwd(q, k, v, q_off, k_off, causal,
+                                     interpret)
     offs = jnp.asarray([q_off, k_off], jnp.int32)
-    o, lse = _pallas_attention_fwd_train(q, k, v, offs, causal, interpret)
-    return o, (q, k, v, o, lse)
+    o, _ = _pallas_attention_fwd_train(q, k, v, offs, causal, interpret,
+                                       q_seg=q_seg, k_seg=k_seg)
+    return o
+
+
+def _flash_fwd(q, k, v, q_seg, k_seg, q_off, k_off, causal, interpret):
+    if _pick_block(q.shape[1], BLOCK_Q) is None or \
+            _pick_block(k.shape[1], BLOCK_K) is None:
+        return _xla_flash(q, k, v, q_off, k_off, causal, q_seg=q_seg,
+                          k_seg=k_seg), (q, k, v, q_seg, k_seg, None, None)
+    offs = jnp.asarray([q_off, k_off], jnp.int32)
+    o, lse = _pallas_attention_fwd_train(q, k, v, offs, causal, interpret,
+                                         q_seg=q_seg, k_seg=k_seg)
+    return o, (q, k, v, q_seg, k_seg, o, lse)
 
 
 def _flash_bwd(q_off, k_off, causal, interpret, res, g):
-    q, k, v, o, lse = res
+    import numpy as np
+
+    q, k, v, q_seg, k_seg, o, lse = res
+
+    seg_ct = int_cotangent
+
     if lse is None:
         # Untileable shapes: recompute through the XLA twin.
         _, vjp = jax.vjp(
-            lambda q_, k_, v_: _xla_flash(q_, k_, v_, q_off, k_off, causal),
+            lambda q_, k_, v_: _xla_flash(q_, k_, v_, q_off, k_off, causal,
+                                          q_seg=q_seg, k_seg=k_seg),
             q, k, v)
-        return vjp(g)
+        return (*vjp(g), seg_ct(q_seg), seg_ct(k_seg))
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)
     offs = jnp.asarray([q_off, k_off], jnp.int32)
-    return _pallas_bwd(q, k, v, g, lse, delta, offs, causal, interpret)
+    dq, dk, dv = _pallas_bwd(q, k, v, g, lse, delta, offs, causal,
+                             interpret, q_seg=q_seg, k_seg=k_seg)
+    return dq, dk, dv, seg_ct(q_seg), seg_ct(k_seg)
 
 
 _flash_core.defvjp(_flash_fwd, _flash_bwd)
@@ -724,10 +857,9 @@ def flash_attention(q, k, v, causal: bool = True, q_off: int = 0,
 
     ``q_segment_ids``/``k_segment_ids`` (int [B, T]): packed-sequence
     masking — a token attends only to keys with its segment id (composed
-    with the causal mask). Currently served by the XLA path (still
-    flash-style fp32-accumulated math, XLA-fused); the Mosaic kernels
-    don't take segment tiles yet, so ``use_pallas`` is ignored when
-    segments are given.
+    with the causal mask). The Mosaic kernels stream the ids as extra
+    (1, block) int32 tiles; the mask composes at trace time so the
+    segment-free path compiles unchanged.
     """
     B, Tq, H, D = q.shape
 
@@ -735,18 +867,16 @@ def flash_attention(q, k, v, causal: bool = True, q_off: int = 0,
         return x.reshape(B, H, t, D).transpose(0, 2, 1, 3)
 
     _require_both_segs(q_segment_ids, k_segment_ids)
+    q_seg = k_seg = None
     if q_segment_ids is not None:
-        out = _xla_flash(_merge_heads(q), _merge_heads(k), _merge_heads(v),
-                         q_off, k_off, causal,
-                         q_seg=_tile_seg(q_segment_ids, H),
-                         k_seg=_tile_seg(k_segment_ids, H))
-        return split(out, Tq)
+        q_seg = _tile_seg(q_segment_ids, H)
+        k_seg = _tile_seg(k_segment_ids, H)
 
     use_pallas, interpret = _resolve_dispatch(use_pallas)
     if not use_pallas:
         out = _xla_flash(_merge_heads(q), _merge_heads(k), _merge_heads(v),
-                         q_off, k_off, causal)
+                         q_off, k_off, causal, q_seg=q_seg, k_seg=k_seg)
         return split(out, Tq)
     out = _flash_core(_merge_heads(q), _merge_heads(k), _merge_heads(v),
-                      q_off, k_off, causal, interpret)
+                      q_seg, k_seg, q_off, k_off, causal, interpret)
     return split(out, Tq)

@@ -157,9 +157,10 @@ def _ring_vjp_bwd(axis_name, causal, res, do):
     kseg0 = jnp.zeros((B, Tq), jnp.int32) if seg is None else seg
     (dq, dk, dv, _, _, _), _ = lax.scan(
         body, (dq0, dk0, dv0, k, v, kseg0), jnp.arange(sp))
-    dseg = None if seg is None else np.zeros(
-        seg.shape, dtype=jax.dtypes.float0)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), dseg
+    from ..ops.pallas_attention import int_cotangent
+
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            int_cotangent(seg))
 
 
 _ring_core.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
@@ -180,9 +181,8 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
 
     ``segment_ids`` (int [B, T_local], sequence-sharded like q):
     packed-sequence masking — tokens attend only within their segment;
-    the K-side ids rotate around the ring with their K/V block. Segment
-    blocks currently run the XLA flash twin (Mosaic segment tiles
-    pending, ``ops.pallas_attention``).
+    the K-side ids rotate around the ring with their K/V block and
+    stream into the flash kernels as extra id tiles.
     """
     sp = lax.axis_size(axis_name)
     if sp == 1:

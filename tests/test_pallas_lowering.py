@@ -77,3 +77,38 @@ def test_ring_attention_block_kernels_lower_to_mosaic(mosaic):
     delta = jnp.zeros((B, H, T), jnp.float32)
     txt = _export_tpu(bwd, q, k, v, do, lse, delta)
     assert "tpu_custom_call" in txt
+
+
+def test_segment_id_kernels_lower_to_mosaic(mosaic):
+    """The segment-tiled variants (packed sequences) must lower too —
+    they stream (1, block) int32 id tiles next to the Q/K/V tiles, a
+    layout Mosaic has to accept in forward AND both backward kernels."""
+    q, k, v = _qkv()
+    B, T = q.shape[:2]
+    seg = jnp.zeros((B, T), jnp.int32)
+
+    def loss(q, k, v):
+        return pa.flash_attention(
+            q, k, v, causal=True, q_segment_ids=seg,
+            k_segment_ids=seg).astype(jnp.float32).sum()
+
+    txt = _export_tpu(loss, q, k, v)
+    assert "tpu_custom_call" in txt
+    txt = _export_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+    assert txt.count("tpu_custom_call") >= 2
+
+
+def test_segment_id_kernels_lower_with_small_blocks(mosaic):
+    """Sub-128 tiles (T=192 -> block 64): the row-oriented (1, block, 1)
+    id layout must lower where a lane-major (1, 1, block) tile fails
+    Mosaic's (8, 128)-divisibility rule."""
+    q, k, v = _qkv(T=192)
+    seg = jnp.zeros((q.shape[0], q.shape[1]), jnp.int32)
+
+    def loss(q, k, v):
+        return pa.flash_attention(
+            q, k, v, causal=True, q_segment_ids=seg,
+            k_segment_ids=seg).astype(jnp.float32).sum()
+
+    txt = _export_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+    assert txt.count("tpu_custom_call") >= 2

@@ -143,20 +143,23 @@ def _layernorm(x, scale):
     return ((xf - mu) * jax.lax.rsqrt(var + 1e-5) * scale).astype(x.dtype)
 
 
-def _make_stage_fn(cfg: TransformerConfig):
+def _make_stage_fn(cfg: TransformerConfig, packed: bool = False):
     """stage_fn(stage_params, x) applying this stage's layers.
 
-    x: [mb, t_local, d]; runs under the full (dp, pp, sp, tp) mesh.
+    x: [mb, t_local, d] (or ``(x, segment_ids)`` with ``packed`` — the
+    ids ride the pipeline ring with the activations and pass through
+    each stage unchanged); runs under the full (dp, pp, sp, tp) mesh.
     """
 
-    def layer(x, lp):
+    def layer(x, lp, seg):
         # --- attention (tp-sharded heads, sp ring) --------------------------
         h = _layernorm(x, lp["ln1"])
         qkv = jnp.einsum("btd,dchk->btchk", h, lp["wqkv"])  # c=3, h=H/tp
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         attn = context_parallel_attention(q, k, v, axis_name="sp",
                                           causal=True,
-                                          strategy=cfg.sp_strategy)
+                                          strategy=cfg.sp_strategy,
+                                          segment_ids=seg)
         out = jnp.einsum("bthk,hkd->btd", attn, lp["wo"])
         out = lax.psum(out, "tp")  # combine head shards
         x = x + out
@@ -180,20 +183,27 @@ def _make_stage_fn(cfg: TransformerConfig):
     layer_fn = jax.checkpoint(layer) if cfg.remat else layer
 
     def stage_fn(stage_params, x):
+        seg = None
+        if packed:
+            x, seg = x
+
         def body(x, lp):
-            return layer_fn(x, lp), None
+            return layer_fn(x, lp, seg), None
 
         x, _ = lax.scan(body, x, stage_params)
-        return x
+        return (x, seg) if packed else x
 
     return stage_fn
 
 
 def _spmd_forward(cfg: TransformerConfig, stage_fn, params, tokens,
-                  n_microbatches: int):
+                  n_microbatches: int, segment_ids=None):
     """Shared SPMD forward (embed → pipeline → final norm → logits).
 
-    Runs under the (dp, pp, sp, tp) mesh; tokens: local [b, t]."""
+    Runs under the (dp, pp, sp, tp) mesh; tokens: local [b, t];
+    ``segment_ids`` (int [b, t], sequence-sharded like tokens): packed
+    sequences — microbatched alongside the activations so each pipeline
+    stage masks attention for the microbatch it is holding."""
     b, t = tokens.shape
     sp_idx = lax.axis_index("sp")
     x = params["embed"][tokens]  # [b, t, d]
@@ -203,6 +213,9 @@ def _spmd_forward(cfg: TransformerConfig, stage_fn, params, tokens,
     # microbatch for the pipeline: [M, mb, t, d]
     M = n_microbatches
     x = x.reshape(M, b // M, t, x.shape[-1])
+    if segment_ids is not None:
+        seg_mb = jnp.asarray(segment_ids, jnp.int32).reshape(M, b // M, t)
+        x = (x, seg_mb)
     # Per-stage params: strip the leading pp dim. The local slice MUST be
     # exactly one stage — if init_params was built with a different stage
     # count than the mesh's pp size, layers would silently be dropped.
@@ -215,6 +228,8 @@ def _spmd_forward(cfg: TransformerConfig, stage_fn, params, tokens,
             "n_stages must equal the mesh pp size")
         stage_params[k] = v[0]
     y = spmd_pipeline(stage_fn, stage_params, x, axis_name="pp")
+    if segment_ids is not None:
+        y = y[0]
     y = y.reshape(b, t, -1)
 
     y = _layernorm(y, params["final_ln"])
@@ -222,23 +237,32 @@ def _spmd_forward(cfg: TransformerConfig, stage_fn, params, tokens,
                       params["head"].astype(jnp.float32))
 
 
-def make_loss_fn(cfg: TransformerConfig, mesh, n_microbatches: int = 2):
+def make_loss_fn(cfg: TransformerConfig, mesh, n_microbatches: int = 2,
+                 packed: bool = False):
     """Build loss(params, tokens, labels) -> scalar, shard_mapped over the
-    full mesh. tokens/labels: [B_global, T_global] sharded P('dp','sp')."""
-    stage_fn = _make_stage_fn(cfg)
+    full mesh. tokens/labels: [B_global, T_global] sharded P('dp','sp').
+
+    ``packed=True`` builds loss(params, tokens, labels, segment_ids)
+    instead: attention masks within segments (packed sequences). The
+    loss itself stays plain mean cross-entropy — mask cross-segment
+    next-token positions through the labels (e.g. weight-zero ids) as
+    your data pipeline defines them."""
+    stage_fn = _make_stage_fn(cfg, packed=packed)
     specs = _param_specs(cfg)
 
-    def spmd_loss(params, tokens, labels):
-        logits = _spmd_forward(cfg, stage_fn, params, tokens, n_microbatches)
+    def spmd_loss(params, tokens, labels, segment_ids=None):
+        logits = _spmd_forward(cfg, stage_fn, params, tokens,
+                               n_microbatches, segment_ids=segment_ids)
         logp = jax.nn.log_softmax(logits, axis=-1)
         ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
         loss = -jnp.mean(ll)
         return lax.pmean(loss, ("dp", "sp"))
 
-    return jax.shard_map(
-        spmd_loss, mesh=mesh,
-        in_specs=(specs, P("dp", "sp"), P("dp", "sp")),
-        out_specs=P(), check_vma=False)
+    data = P("dp", "sp")
+    in_specs = ((specs, data, data, data) if packed
+                else (specs, data, data))
+    return jax.shard_map(spmd_loss, mesh=mesh, in_specs=in_specs,
+                         out_specs=P(), check_vma=False)
 
 
 def make_train_step(cfg: TransformerConfig, optimizer, mesh,
@@ -269,12 +293,30 @@ def make_train_step(cfg: TransformerConfig, optimizer, mesh,
     return jax.jit(step, donate_argnums=(0, 1))
 
 
-def dense_reference_loss(cfg: TransformerConfig, params, tokens, labels):
+def dense_reference_loss(cfg: TransformerConfig, params, tokens, labels,
+                         segment_ids=None):
     """Unsharded single-device oracle: mathematically identical to the
     sharded loss (pipeline == sequential layers; ring attention == dense
     causal attention; MoE exact when capacity is ample). Used by tests to
     validate sharded loss AND gradients."""
     from ..parallel.ring_attention import local_flash_attention
+
+    def attend(q, k, v):
+        if segment_ids is None:
+            return local_flash_attention(q, k, v, causal=True)
+        T = q.shape[1]
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) / jnp.sqrt(
+            jnp.asarray(q.shape[-1], jnp.float32))
+        iq = jnp.arange(T)[:, None]
+        ik = jnp.arange(T)[None, :]
+        seg = jnp.asarray(segment_ids)
+        allowed = ((iq >= ik)[None, None]
+                   & (seg[:, None, :, None] == seg[:, None, None, :]))
+        s = jnp.where(allowed, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p,
+                          v.astype(jnp.float32)).astype(q.dtype)
 
     b, t = tokens.shape
     x = params["embed"][tokens] + params["pos"][:t][None]
@@ -285,8 +327,7 @@ def dense_reference_loss(cfg: TransformerConfig, params, tokens, labels):
         for li in range(lps):
             h = _layernorm(x, params["ln1"][s, li])
             qkv = jnp.einsum("btd,dchk->btchk", h, params["wqkv"][s, li])
-            attn = local_flash_attention(
-                qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], causal=True)
+            attn = attend(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
             x = x + jnp.einsum("bthk,hkd->btd", attn, params["wo"][s, li])
             h = _layernorm(x, params["ln2"][s, li])
             if cfg.use_moe:

@@ -816,11 +816,7 @@ def _flash_fwd(q, k, v, q_seg, k_seg, q_off, k_off, causal, interpret):
 
 
 def _flash_bwd(q_off, k_off, causal, interpret, res, g):
-    import numpy as np
-
     q, k, v, q_seg, k_seg, o, lse = res
-
-    seg_ct = int_cotangent
 
     if lse is None:
         # Untileable shapes: recompute through the XLA twin.
@@ -828,13 +824,13 @@ def _flash_bwd(q_off, k_off, causal, interpret, res, g):
             lambda q_, k_, v_: _xla_flash(q_, k_, v_, q_off, k_off, causal,
                                           q_seg=q_seg, k_seg=k_seg),
             q, k, v)
-        return (*vjp(g), seg_ct(q_seg), seg_ct(k_seg))
+        return (*vjp(g), int_cotangent(q_seg), int_cotangent(k_seg))
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)
     offs = jnp.asarray([q_off, k_off], jnp.int32)
     dq, dk, dv = _pallas_bwd(q, k, v, g, lse, delta, offs, causal,
                              interpret, q_seg=q_seg, k_seg=k_seg)
-    return dq, dk, dv, seg_ct(q_seg), seg_ct(k_seg)
+    return dq, dk, dv, int_cotangent(q_seg), int_cotangent(k_seg)
 
 
 _flash_core.defvjp(_flash_fwd, _flash_bwd)

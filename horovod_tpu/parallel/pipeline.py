@@ -18,6 +18,7 @@ from __future__ import annotations
 
 from typing import Callable
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -26,43 +27,53 @@ def spmd_pipeline(stage_fn: Callable, stage_params, microbatches,
                   axis_name: str = "pp"):
     """Run ``microbatches`` through the pipeline.
 
-    stage_fn(params, x) -> y : applies ONE stage (same shape in/out).
+    stage_fn(params, x) -> y : applies ONE stage (same structure in/out).
     stage_params: this member's stage parameters (already pp-local).
-    microbatches: [M, ...] stacked microbatch activations (stage-0 input
-    layout; other stages ignore the values and receive via the ring).
+    microbatches: [M, ...] stacked microbatch activations — a single
+    array or any pytree of [M, ...] leaves (e.g. ``(x, segment_ids)``
+    for packed sequences: per-microbatch side data rides the activation
+    ring with the activations). Stage-0 input layout; other stages
+    ignore the values and receive via the ring.
 
     Returns [M, ...] outputs as produced by the LAST stage (valid on every
     member after the closing psum-broadcast).
     """
+    tmap = jax.tree_util.tree_map
     S = lax.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
-    M = microbatches.shape[0]
+    M = jax.tree_util.tree_leaves(microbatches)[0].shape[0]
     T = M + S - 1
 
     fwd = [(i, (i + 1) % S) for i in range(S)]
-    x0 = jnp.zeros_like(microbatches[0])
-    outbuf = jnp.zeros_like(microbatches)
+    x0 = tmap(lambda m: jnp.zeros_like(m[0]), microbatches)
+    outbuf = tmap(jnp.zeros_like, microbatches)
 
     def tick(carry, t):
         state, outbuf = carry
         # stage 0 injects microbatch t (clamped; masked when t >= M)
-        mb = lax.dynamic_index_in_dim(
-            microbatches, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+        mb = tmap(lambda m: lax.dynamic_index_in_dim(
+            m, jnp.clip(t, 0, M - 1), 0, keepdims=False), microbatches)
         inject = jnp.logical_and(stage == 0, t < M)
-        state = jnp.where(inject, mb, state)
+        state = tmap(lambda m, s: jnp.where(inject, m, s), mb, state)
         y = stage_fn(stage_params, state)
         # last stage collects finished microbatch t-(S-1)
         out_idx = jnp.clip(t - (S - 1), 0, M - 1)
         collect = jnp.logical_and(stage == S - 1, t >= S - 1)
-        cur = lax.dynamic_index_in_dim(outbuf, out_idx, 0, keepdims=False)
-        outbuf = lax.dynamic_update_index_in_dim(
-            outbuf, jnp.where(collect, y, cur), out_idx, 0)
-        state = lax.ppermute(y, axis_name, fwd)
+
+        def collect_leaf(ob, yy):
+            cur = lax.dynamic_index_in_dim(ob, out_idx, 0, keepdims=False)
+            return lax.dynamic_update_index_in_dim(
+                ob, jnp.where(collect, yy, cur), out_idx, 0)
+
+        outbuf = tmap(collect_leaf, outbuf, y)
+        state = tmap(lambda yy: lax.ppermute(yy, axis_name, fwd), y)
         return (state, outbuf), None
 
     (_, outbuf), _ = lax.scan(tick, (x0, outbuf), jnp.arange(T))
     # Broadcast the last stage's outputs to all pp members so downstream
     # (loss) code is uniform SPMD.
-    outbuf = jnp.where(stage == S - 1, outbuf, jnp.zeros_like(outbuf))
-    outbuf = lax.psum(outbuf, axis_name)
+    outbuf = tmap(
+        lambda ob: lax.psum(
+            jnp.where(stage == S - 1, ob, jnp.zeros_like(ob)), axis_name),
+        outbuf)
     return outbuf

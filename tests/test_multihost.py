@@ -349,6 +349,35 @@ def test_sequence_parallel_attention_cross_process(tmp_path):
                     rtol=2e-4, atol=2e-5,
                     err_msg=f"{name} shard {shard.index} mismatch")
 
+        # Packed sequences across the process boundary: segment ids
+        # shard with the tokens; the ring rotates the K-side ids through
+        # the distributed fabric.
+        seg = np.stack([np.repeat([0, 1, 2], [5, 6, 5]),
+                        np.repeat([0, 1], [9, 7])]).astype(np.int32)
+        allowed = (np.tril(np.ones((T, T), bool))[None, None]
+                   & (seg[:, None, :, None] == seg[:, None, None, :]))
+        s2 = np.einsum("bqhd,bkhd->bhqk", q.astype(np.float64),
+                       k.astype(np.float64)) / np.sqrt(D)
+        s2 = np.where(allowed, s2, -np.inf)
+        p2 = np.exp(s2 - s2.max(-1, keepdims=True))
+        p2 /= p2.sum(-1, keepdims=True)
+        expected_seg = np.einsum("bhqk,bkhd->bqhd", p2,
+                                 v.astype(np.float64))
+        sega = to_global(seg)
+        for name, attn in (("ring", ring_attention),
+                           ("ulysses", ulysses_attention)):
+            fn = jax.jit(jax.shard_map(
+                lambda q, k, v, s, a=attn: a(q, k, v, "sp", causal=True,
+                                             segment_ids=s),
+                mesh=mesh, in_specs=(P(None, "sp"),) * 4,
+                out_specs=P(None, "sp"), check_vma=False))
+            out = fn(qa, ka, va, sega)
+            for shard in out.addressable_shards:
+                np.testing.assert_allclose(
+                    np.asarray(shard.data), expected_seg[shard.index],
+                    rtol=2e-4, atol=2e-5,
+                    err_msg=f"seg {name} shard {shard.index} mismatch")
+
         hvd.shutdown()
         print(f"MHSEQ_{rank}_OK")
     """)

@@ -252,15 +252,22 @@ class JaxState(ObjectState):
         super().on_reset()
 
     def sync(self):
-        # One broadcast from the coordinator: the last committed HOST
-        # snapshot rides with the picklable attrs (never device_get of
-        # live buffers here — in the retry loop sync() runs right after
-        # a world re-init, when pre-failure device buffers may already
-        # be dead); every process then re-places the leaves on its view
-        # of the (possibly new) mesh.
+        # One broadcast from the coordinator: the LIVE tree (host
+        # snapshot) rides with the live picklable attrs — the pairing
+        # must be consistent (broadcasting the committed tree with live
+        # attrs would commit an advanced step counter onto stale
+        # weights). Safe in every retry-loop path: sync() runs after
+        # on_reset() has re-placed the tree on the re-initialized mesh,
+        # and a first-sync/live-world tree is alive by definition. The
+        # deferred-placement case (tree still None because restore()
+        # could not place and no reset followed) falls back to the
+        # committed snapshot, whose attrs were restored with it.
+        import jax
+
         payload = {k: v for k, v in self._public_attrs().items()
                    if k != "tree"}
-        payload["tree"] = self._saved_tree
+        payload["tree"] = (jax.device_get(self.tree)
+                           if self.tree is not None else self._saved_tree)
         synced = self._bcast_object(payload, root_rank=0)
         self._saved_tree = synced.pop("tree")
         for k, v in synced.items():

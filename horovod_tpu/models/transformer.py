@@ -151,15 +151,15 @@ def _make_stage_fn(cfg: TransformerConfig, packed: bool = False):
     each stage unchanged); runs under the full (dp, pp, sp, tp) mesh.
     """
 
-    def layer(x, lp, seg):
+    def layer(x, lp, seg, gathered_seg):
         # --- attention (tp-sharded heads, sp ring) --------------------------
         h = _layernorm(x, lp["ln1"])
         qkv = jnp.einsum("btd,dchk->btchk", h, lp["wqkv"])  # c=3, h=H/tp
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        attn = context_parallel_attention(q, k, v, axis_name="sp",
-                                          causal=True,
-                                          strategy=cfg.sp_strategy,
-                                          segment_ids=seg)
+        attn = context_parallel_attention(
+            q, k, v, axis_name="sp", causal=True,
+            strategy=cfg.sp_strategy, segment_ids=seg,
+            gathered_segment_ids=gathered_seg)
         out = jnp.einsum("bthk,hkd->btd", attn, lp["wo"])
         out = lax.psum(out, "tp")  # combine head shards
         x = x + out
@@ -183,12 +183,19 @@ def _make_stage_fn(cfg: TransformerConfig, packed: bool = False):
     layer_fn = jax.checkpoint(layer) if cfg.remat else layer
 
     def stage_fn(stage_params, x):
-        seg = None
+        seg = gathered = None
         if packed:
             x, seg = x
+            if cfg.sp_strategy in ("ulysses", "auto"):
+                # Hoist the loop-invariant id gather out of the layer
+                # scan (XLA won't lift collectives out of scan bodies);
+                # if "auto" resolves to ring, the unused gather is DCE'd.
+                from ..parallel.ulysses import gather_segment_ids
+
+                gathered = gather_segment_ids(seg, "sp")
 
         def body(x, lp):
-            return layer_fn(x, lp, seg), None
+            return layer_fn(x, lp, seg, gathered), None
 
         x, _ = lax.scan(body, x, stage_params)
         return (x, seg) if packed else x
@@ -266,7 +273,8 @@ def make_loss_fn(cfg: TransformerConfig, mesh, n_microbatches: int = 2,
 
 
 def make_train_step(cfg: TransformerConfig, optimizer, mesh,
-                    n_microbatches: int = 2, opt_shardings=None):
+                    n_microbatches: int = 2, opt_shardings=None,
+                    packed: bool = False):
     """Full sharded training step: loss + grads + optimizer update, jitted
     once over the 4-axis mesh.
 
@@ -276,19 +284,33 @@ def make_train_step(cfg: TransformerConfig, optimizer, mesh,
     updated optimizer state to those shardings inside the compiled
     program — the ZeRO-1 composition: moments stay partitioned over dp
     on top of the params' tp/pp sharding, and XLA inserts the
-    slice/gather collectives around the elementwise update."""
+    slice/gather collectives around the elementwise update.
+
+    ``packed=True`` builds step(params, opt_state, tokens, labels,
+    segment_ids) for packed-sequence training (``make_loss_fn``)."""
     import optax
 
-    loss_fn = make_loss_fn(cfg, mesh, n_microbatches)
+    loss_fn = make_loss_fn(cfg, mesh, n_microbatches, packed=packed)
 
-    def step(params, opt_state, tokens, labels):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, labels)
+    def apply(grads, params, opt_state):
         updates, opt_state = optimizer.update(grads, opt_state, params)
         if opt_shardings is not None:
             opt_state = jax.lax.with_sharding_constraint(
                 opt_state, opt_shardings)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+        return optax.apply_updates(params, updates), opt_state
+
+    if packed:
+        def step(params, opt_state, tokens, labels, segment_ids):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, tokens, labels, segment_ids)
+            params, opt_state = apply(grads, params, opt_state)
+            return params, opt_state, loss
+    else:
+        def step(params, opt_state, tokens, labels):
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens,
+                                                      labels)
+            params, opt_state = apply(grads, params, opt_state)
+            return params, opt_state, loss
 
     return jax.jit(step, donate_argnums=(0, 1))
 

@@ -549,8 +549,15 @@ def flash_attention_block_grads(q, k, v, do, lse, delta, q_off, k_off,
     return split(dq, Tq), split(dk, Tk), split(dv, Tk)
 
 
+def _attn_kernel_seg(offs_ref, q_ref, k_ref, v_ref, qs_ref, ks_ref,
+                     o_ref, m_ref, l_ref, acc_ref, **kw):
+    """Plain-forward adapter with segment-id tiles (no lse residual)."""
+    _attn_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                 acc_ref, qs_ref=qs_ref, ks_ref=ks_ref, **kw)
+
+
 def _pallas_attention_fwd(q, k, v, q_off, k_off, causal: bool,
-                          interpret: bool):
+                          interpret: bool, q_seg=None, k_seg=None):
     """q/k/v: [BH, T, D] (already merged batch*heads, padded to tiles)."""
     BH, Tq, D = q.shape
     Tk = k.shape[1]
@@ -561,14 +568,23 @@ def _pallas_attention_fwd(q, k, v, q_off, k_off, causal: bool,
 
     from jax.experimental.pallas import tpu as pltpu
 
+    in_specs = [
+        pl.BlockSpec((1, bq, D), lambda bh, qi, ki, offs: (bh, qi, 0)),
+        pl.BlockSpec((1, bk, D), lambda bh, qi, ki, offs: (bh, ki, 0)),
+        pl.BlockSpec((1, bk, D), lambda bh, qi, ki, offs: (bh, ki, 0)),
+    ]
+    offs = jnp.asarray([q_off, k_off], jnp.int32)
+    args = [offs, q, k, v]
+    if q_seg is not None:
+        in_specs += _seg_specs(bq, bk)
+        args += [_seg3(q_seg), _seg3(k_seg)]
+        kernel_fn = _attn_kernel_seg
+    else:
+        kernel_fn = _attn_kernel
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(BH, num_q, num_k),
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda bh, qi, ki, offs: (bh, qi, 0)),
-            pl.BlockSpec((1, bk, D), lambda bh, qi, ki, offs: (bh, ki, 0)),
-            pl.BlockSpec((1, bk, D), lambda bh, qi, ki, offs: (bh, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, bq, D),
                                lambda bh, qi, ki, offs: (bh, qi, 0)),
         scratch_shapes=[
@@ -578,8 +594,8 @@ def _pallas_attention_fwd(q, k, v, q_off, k_off, causal: bool,
         ],
     )
     kernel = functools.partial(
-        _attn_kernel, causal=causal, block_q=bq, block_k=bk, num_k_tiles=num_k)
-    offs = jnp.asarray([q_off, k_off], jnp.int32)
+        kernel_fn, causal=causal, block_q=bq, block_k=bk,
+        num_k_tiles=num_k)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -587,7 +603,7 @@ def _pallas_attention_fwd(q, k, v, q_off, k_off, causal: bool,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(offs, q, k, v)
+    )(*args)
 
 
 def _pallas_attention_fwd_train(q, k, v, offs, causal: bool,
@@ -789,19 +805,12 @@ def _xla_flash(q, k, v, q_off, k_off, causal, q_seg=None, k_seg=None):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
 def _flash_core(q, k, v, q_seg, k_seg, q_off, k_off, causal, interpret):
-    # Primal (non-autodiff) calls take the training forward too when
-    # segments ride along — the lse output is simply dropped.
     if _pick_block(q.shape[1], BLOCK_Q) is None or \
             _pick_block(k.shape[1], BLOCK_K) is None:
         return _xla_flash(q, k, v, q_off, k_off, causal, q_seg=q_seg,
                           k_seg=k_seg)
-    if q_seg is None:
-        return _pallas_attention_fwd(q, k, v, q_off, k_off, causal,
-                                     interpret)
-    offs = jnp.asarray([q_off, k_off], jnp.int32)
-    o, _ = _pallas_attention_fwd_train(q, k, v, offs, causal, interpret,
-                                       q_seg=q_seg, k_seg=k_seg)
-    return o
+    return _pallas_attention_fwd(q, k, v, q_off, k_off, causal, interpret,
+                                 q_seg=q_seg, k_seg=k_seg)
 
 
 def _flash_fwd(q, k, v, q_seg, k_seg, q_off, k_off, causal, interpret):

@@ -33,8 +33,21 @@ from __future__ import annotations
 from jax import lax
 
 
+def gather_segment_ids(segment_ids, axis_name: str = "sp"):
+    """All-gather sequence-sharded segment ids to [B, T_global].
+
+    The gather is loop-invariant across decoder layers; callers running
+    attention inside a layer scan (models/transformer.py) hoist it by
+    gathering once and passing ``gathered_segment_ids`` — XLA does not
+    lift collectives out of ``lax.scan`` bodies."""
+    from jax import numpy as jnp
+
+    return lax.all_gather(jnp.asarray(segment_ids, jnp.int32), axis_name,
+                          axis=1, tiled=True)
+
+
 def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
-                      segment_ids=None):
+                      segment_ids=None, gathered_segment_ids=None):
     """Context-parallel attention via head<->sequence all-to-all.
 
     q/k/v: [B, T_local, H, D] per chip, sequence-sharded over
@@ -42,7 +55,9 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
     Requires ``H % axis_size == 0``. ``segment_ids`` (int [B, T_local],
     sequence-sharded like q): packed-sequence masking — after the
     re-shard every chip holds the full sequence, so the ids are simply
-    all-gathered along it.
+    all-gathered along it (or pass ``gathered_segment_ids`` [B, T_global]
+    from :func:`gather_segment_ids` to hoist the gather out of a layer
+    loop).
     """
     sp = lax.axis_size(axis_name)
     from ..ops.pallas_attention import flash_attention
@@ -68,13 +83,9 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
 
-    full_seg = None
-    if segment_ids is not None:
-        from jax import numpy as jnp
-
-        full_seg = lax.all_gather(
-            jnp.asarray(segment_ids, jnp.int32), axis_name,
-            axis=1, tiled=True)  # [B, T_global]
+    full_seg = gathered_segment_ids
+    if full_seg is None and segment_ids is not None:
+        full_seg = gather_segment_ids(segment_ids, axis_name)
     o = flash_attention(seq_to_heads(q), seq_to_heads(k), seq_to_heads(v),
                         causal=causal, q_segment_ids=full_seg,
                         k_segment_ids=full_seg)
@@ -84,14 +95,17 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
 def context_parallel_attention(q, k, v, axis_name: str = "sp",
                                causal: bool = True,
                                strategy: str = "ring",
-                               segment_ids=None):
+                               segment_ids=None,
+                               gathered_segment_ids=None):
     """Dispatch between the two sequence-parallel attention strategies.
 
     ``strategy``: ``"ring"`` (default — no head constraint, T_local
     working set), ``"ulysses"`` (all-to-all re-shard, needs
     heads % sp == 0), or ``"auto"`` (ulysses when the head constraint
     holds, ring otherwise). ``segment_ids``: packed-sequence masking,
-    accepted by both strategies.
+    accepted by both strategies (``gathered_segment_ids`` additionally
+    lets ulysses callers hoist the id gather out of a layer loop; the
+    ring ignores it — its masking is block-local).
     """
     from .ring_attention import ring_attention
 
@@ -100,7 +114,8 @@ def context_parallel_attention(q, k, v, axis_name: str = "sp",
         strategy = "ulysses" if q.shape[2] % sp == 0 else "ring"
     if strategy == "ulysses":
         return ulysses_attention(q, k, v, axis_name=axis_name,
-                                 causal=causal, segment_ids=segment_ids)
+                                 causal=causal, segment_ids=segment_ids,
+                                 gathered_segment_ids=gathered_segment_ids)
     if strategy == "ring":
         return ring_attention(q, k, v, axis_name=axis_name, causal=causal,
                               segment_ids=segment_ids)

@@ -281,7 +281,12 @@ def _best_effort_shutdown(routable, key):
         try:
             network.BasicClient(SparkTaskService.NAME_FMT % i, addrs,
                                 key)._request(ShutdownRequest())
-        except (ConnectionError, OSError):
+        except Exception:
+            # Best-effort means best-effort: a task mid-teardown can
+            # reply with a truncated/garbage frame (UnpicklingError,
+            # EOFError — not just socket errors), and one bad reply must
+            # not leak the remaining tasks or mask the caller's original
+            # exception.
             pass
 
 

@@ -76,6 +76,32 @@ def test_sync_replaces_and_resnapshots(hvd):
                                   np.arange(8.0))
 
 
+def test_sync_broadcasts_live_pair(hvd):
+    # sync() must pair the LIVE tree with the LIVE attrs (training past
+    # the last commit then syncing must not pair an advanced counter
+    # with stale committed weights) — and commit that consistent pair.
+    seen = {}
+
+    def bcast(obj, root_rank=0):
+        seen.update(obj)
+        return obj
+
+    state = JaxState(_tree(), bcast_object=bcast, batch=0)
+    state.commit()
+    state.tree = jax.tree_util.tree_map(lambda x: x + 5.0, state.tree)
+    state.batch = 9  # past the commit
+    state.sync()
+    assert seen["batch"] == 9
+    np.testing.assert_array_equal(seen["tree"]["w"], np.arange(8.0) + 5.0)
+    # The synced (live) pair is now the committed point.
+    state.tree = jax.tree_util.tree_map(lambda x: x * 0.0, state.tree)
+    state.batch = 1
+    state.restore()
+    assert state.batch == 9
+    np.testing.assert_array_equal(np.asarray(state.tree["w"]),
+                                  np.arange(8.0) + 5.0)
+
+
 def test_restore_defers_placement_when_world_is_dead(hvd):
     # In the retry loop restore() runs BEFORE re-init: placement onto a
     # stale mesh may fail, and must defer to on_reset() (which runs

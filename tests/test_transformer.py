@@ -222,6 +222,14 @@ def test_packed_sequences_match_dense(strategy):
             np.asarray(ref_grads[key]), rtol=5e-3, atol=1e-5,
             err_msg=f"packed grad mismatch for {key} ({strategy})")
 
+    # The packed TRAIN step exists end to end (loss + optimizer update).
+    optimizer = optax.adam(1e-2)
+    opt_state = init_opt_state(optimizer, sharded, mesh)
+    step = make_train_step(cfg, optimizer, mesh, n_microbatches=2,
+                           packed=True)
+    sharded, opt_state, l1 = step(sharded, opt_state, tok_s, lab_s, seg_s)
+    assert float(np.asarray(l1)) == pytest.approx(expected, rel=1e-4)
+
 
 def test_remat_matches_dense():
     # jax.checkpoint must not change the math — only when activations

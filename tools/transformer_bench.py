@@ -27,21 +27,9 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-PEAK_FLOPS_BY_KIND = [
-    ("v6", 918e12),
-    ("v5p", 459e12),
-    ("v5e", 197e12),
-    ("v5 lite", 197e12),
-    ("v4", 275e12),
-]
-
-
-def _peak_flops(device_kind):
-    kind = (device_kind or "").lower()
-    for sub, peak in PEAK_FLOPS_BY_KIND:
-        if sub in kind:
-            return peak
-    return None
+# One peak-FLOPs table for the whole repo: bench.py owns it (repo root
+# is already on sys.path above).
+from bench import _peak_flops  # noqa: E402
 
 
 def main(argv=None):

@@ -50,6 +50,10 @@ def main():
                         help="rematerialize decoder layers in backward "
                              "(activation HBM ~O(1) layers; the knob "
                              "that lets very long sequences fit)")
+    parser.add_argument("--packed", type=int, default=0, metavar="N_DOCS",
+                        help="pack N_DOCS documents per row with segment-"
+                             "id attention masking (tokens attend only "
+                             "within their document)")
     args = parser.parse_args()
 
     import jax
@@ -90,7 +94,8 @@ def main():
     sharded = shard_params(params, cfg, mesh)
     optimizer = optax.adamw(3e-4)
     opt_state = init_opt_state(optimizer, sharded, mesh)
-    step = make_train_step(cfg, optimizer, mesh, n_microbatches=1)
+    step = make_train_step(cfg, optimizer, mesh, n_microbatches=1,
+                           packed=args.packed > 0)
 
     rng = np.random.RandomState(0)
     data_sharding = NamedSharding(mesh, P("dp", "sp"))
@@ -99,12 +104,25 @@ def main():
                                 (args.batch_size, args.seq_len)), jnp.int32),
         data_sharding)
     labels = jnp.roll(tokens, -1, axis=1)
+    extra = ()
+    if args.packed:
+        # Evenly packed documents; a real pipeline carries the ids from
+        # its packer. Attention masks within each document.
+        doc_len = args.seq_len // args.packed
+        seg = jnp.minimum(jnp.arange(args.seq_len) // doc_len,
+                          args.packed - 1)
+        extra = (jax.device_put(
+            jnp.tile(seg[None], (args.batch_size, 1)).astype(jnp.int32),
+            data_sharding),)
+        print(f"packed: {args.packed} docs/row, ~{doc_len} tokens each")
 
-    sharded, opt_state, loss = step(sharded, opt_state, tokens, labels)
+    sharded, opt_state, loss = step(sharded, opt_state, tokens, labels,
+                                    *extra)
     print(f"step 0 (compile): loss={float(np.asarray(loss)):.4f}")
     t0 = time.perf_counter()
     for i in range(args.steps):
-        sharded, opt_state, loss = step(sharded, opt_state, tokens, labels)
+        sharded, opt_state, loss = step(sharded, opt_state, tokens,
+                                        labels, *extra)
     loss = float(np.asarray(loss))
     dt = (time.perf_counter() - t0) / args.steps
     tok_per_s = args.batch_size * args.seq_len / dt

@@ -175,3 +175,12 @@ def test_long_context_example(strategy):
         "--steps", "2", "--strategy", strategy, timeout=420)
     assert "T_local=32" in out
     assert "tokens/s" in out
+
+
+def test_long_context_example_packed():
+    out = _run_example(
+        "jax_long_context.py", "--sp", "2", "--seq-len", "64",
+        "--d-model", "32", "--n-heads", "4", "--n-layers", "2",
+        "--steps", "2", "--packed", "4", timeout=420)
+    assert "packed: 4 docs/row" in out
+    assert "tokens/s" in out

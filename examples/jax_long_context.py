@@ -106,6 +106,9 @@ def main():
     labels = jnp.roll(tokens, -1, axis=1)
     extra = ()
     if args.packed:
+        if args.packed > args.seq_len:
+            parser.error(f"--packed {args.packed} must be <= --seq-len "
+                         f"{args.seq_len}")
         # Evenly packed documents; a real pipeline carries the ids from
         # its packer. Attention masks within each document.
         doc_len = args.seq_len // args.packed

@@ -24,7 +24,7 @@ Pure-jax pytree params (no flax) so shard_map in_specs map 1:1 onto leaves.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -56,6 +56,12 @@ class TransformerConfig:
     # re-shard, needs (n_heads/tp) % sp == 0), or "auto"
     # (parallel/ulysses.py).
     sp_strategy: str = "ring"
+    # Sliding-window attention (Mistral-style SWA): each token attends
+    # to itself plus the `attention_window - 1` preceding tokens
+    # (receptive field = attention_window; mask q_pos - k_pos < W).
+    # None = full causal. Out-of-window K tiles are culled in the
+    # kernels.
+    attention_window: Optional[int] = None
     # Rematerialize each decoder layer in the backward pass
     # (jax.checkpoint): activations are recomputed instead of saved, so
     # activation HBM drops from O(n_layers) to O(1) layers — the
@@ -159,7 +165,8 @@ def _make_stage_fn(cfg: TransformerConfig, packed: bool = False):
         attn = context_parallel_attention(
             q, k, v, axis_name="sp", causal=True,
             strategy=cfg.sp_strategy, segment_ids=seg,
-            gathered_segment_ids=gathered_seg)
+            gathered_segment_ids=gathered_seg,
+            window=cfg.attention_window)
         out = jnp.einsum("bthk,hkd->btd", attn, lp["wo"])
         out = lax.psum(out, "tp")  # combine head shards
         x = x + out
@@ -324,7 +331,7 @@ def dense_reference_loss(cfg: TransformerConfig, params, tokens, labels,
     from ..parallel.ring_attention import local_flash_attention
 
     def attend(q, k, v):
-        if segment_ids is None:
+        if segment_ids is None and cfg.attention_window is None:
             return local_flash_attention(q, k, v, causal=True)
         T = q.shape[1]
         s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
@@ -332,9 +339,13 @@ def dense_reference_loss(cfg: TransformerConfig, params, tokens, labels,
             jnp.asarray(q.shape[-1], jnp.float32))
         iq = jnp.arange(T)[:, None]
         ik = jnp.arange(T)[None, :]
-        seg = jnp.asarray(segment_ids)
-        allowed = ((iq >= ik)[None, None]
-                   & (seg[:, None, :, None] == seg[:, None, None, :]))
+        allowed = (iq >= ik)[None, None]
+        if cfg.attention_window is not None:
+            allowed = allowed & (iq - ik < cfg.attention_window)[None, None]
+        if segment_ids is not None:
+            seg = jnp.asarray(segment_ids)
+            allowed = allowed & (seg[:, None, :, None]
+                                 == seg[:, None, None, :])
         s = jnp.where(allowed, s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         return jnp.einsum("bhqk,bkhd->bqhd", p,

@@ -44,7 +44,7 @@ def _attn_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
                  acc_ref, *, causal: bool, block_q: int, block_k: int,
                  num_k_tiles: int, return_state: bool = False,
                  mo_ref=None, lo_ref=None, lse_ref=None,
-                 qs_ref=None, ks_ref=None):
+                 qs_ref=None, ks_ref=None, window=None):
     """One (batch*head, q-tile, k-tile) grid step.
 
     Refs: q (1, block_q, D), k/v (1, block_k, D), o (1, block_q, D);
@@ -73,6 +73,12 @@ def _attn_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
         # contributes nothing — predicate the whole update away (halves
         # the causal FLOPs; the reference flash kernels do the same).
         visible = q_base + block_q - 1 >= k_base
+        if window is not None:
+            # Sliding-window culling: a K tile entirely beyond the
+            # window into this Q tile's past is dead too — for
+            # T >> window most tiles skip, the real SWA saving.
+            visible = jnp.logical_and(
+                visible, k_base + block_k - 1 >= q_base - (window - 1))
     else:
         visible = True
 
@@ -93,6 +99,8 @@ def _attn_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
             k_pos = (k_base +
                      jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            if window is not None:
+                s = jnp.where(q_pos - k_pos < window, s, NEG_INF)
         if qs_ref is not None:
             s = jnp.where(qs_ref[0] == ks_ref[0].reshape(1, -1),
                           s, NEG_INF)
@@ -172,7 +180,7 @@ def _attn_kernel_train_seg(offs_ref, q_ref, k_ref, v_ref, qs_ref, ks_ref,
 def _attn_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                         delta_ref, dq_ref, dq_acc, *, causal: bool,
                         block_q: int, block_k: int, num_k_tiles: int,
-                        qs_ref=None, ks_ref=None):
+                        qs_ref=None, ks_ref=None, window=None):
     """dQ pass: grid (batch*head, q-tile, k-tile), sequential over K tiles.
 
     P = exp(S - lse) is rebuilt on-chip from the saved lse;
@@ -189,6 +197,9 @@ def _attn_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     q_base = offs_ref[0] + qi * block_q
     k_base = offs_ref[1] + ki * block_k
     visible = (q_base + block_q - 1 >= k_base) if causal else True
+    if causal and window is not None:
+        visible = jnp.logical_and(
+            visible, k_base + block_k - 1 >= q_base - (window - 1))
 
     @pl.when(visible)
     def _update():
@@ -203,6 +214,8 @@ def _attn_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             q_pos = q_base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = k_base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             p = jnp.where(q_pos >= k_pos, p, 0.0)
+            if window is not None:
+                p = jnp.where(q_pos - k_pos < window, p, 0.0)
         if qs_ref is not None:
             p = jnp.where(qs_ref[0] == ks_ref[0].reshape(1, -1), p, 0.0)
         dp = jax.lax.dot_general(
@@ -231,7 +244,8 @@ def _attn_bwd_dq_kernel_seg(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 def _attn_bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                          delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
                          causal: bool, block_q: int, block_k: int,
-                         num_q_tiles: int, qs_ref=None, ks_ref=None):
+                         num_q_tiles: int, qs_ref=None, ks_ref=None,
+                         window=None):
     """dK/dV pass: grid (batch*head, k-tile, q-tile), sequential over Q
     tiles. Same [bq, bk] orientation as the dQ pass; the transposed
     contractions (P^T.dO, dS^T.Q) ride dot_general dimension numbers so
@@ -247,6 +261,9 @@ def _attn_bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     q_base = offs_ref[0] + qi * block_q
     k_base = offs_ref[1] + ki * block_k
     visible = (q_base + block_q - 1 >= k_base) if causal else True
+    if causal and window is not None:
+        visible = jnp.logical_and(
+            visible, k_base + block_k - 1 >= q_base - (window - 1))
 
     @pl.when(visible)
     def _update():
@@ -262,6 +279,8 @@ def _attn_bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             q_pos = q_base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = k_base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             p = jnp.where(q_pos >= k_pos, p, 0.0)
+            if window is not None:
+                p = jnp.where(q_pos - k_pos < window, p, 0.0)
         if qs_ref is not None:
             p = jnp.where(qs_ref[0] == ks_ref[0].reshape(1, -1), p, 0.0)
         dv_acc[:] += jax.lax.dot_general(
@@ -320,7 +339,7 @@ def int_cotangent(x):
 
 
 def _pallas_block_state(q, k, v, offs, causal: bool, interpret: bool,
-                        q_seg=None, k_seg=None):
+                        q_seg=None, k_seg=None, window=None):
     """q/k/v: [BH, T, D]. Returns (acc f32 [BH,Tq,D], m f32 [BH,Tq,1],
     l f32 [BH,Tq,1]) — the unmerged online-softmax state of this K block
     (ring attention merges blocks as they rotate). ``q_seg``/``k_seg``:
@@ -366,7 +385,7 @@ def _pallas_block_state(q, k, v, offs, causal: bool, interpret: bool,
     )
     kernel = functools.partial(
         kernel_fn, causal=causal, block_q=bq, block_k=bk,
-        num_k_tiles=num_k)
+        num_k_tiles=num_k, window=window)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -393,7 +412,18 @@ def _require_both_segs(q_seg, k_seg):
         raise ValueError("pass both q_segment_ids and k_segment_ids")
 
 
-def _xla_block_state(q, k, v, offs, causal, q_seg=None, k_seg=None):
+def _check_window(window, causal):
+    if window is None:
+        return
+    if not causal:
+        raise ValueError("sliding-window attention is defined for the "
+                         "causal case; pass causal=True with window")
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+
+
+def _xla_block_state(q, k, v, offs, causal, q_seg=None, k_seg=None,
+                     window=None):
     """XLA twin of the block-mode kernel (backward recompute + fallback).
     ``offs`` = int32[2] (q_off, k_off) — an array, not statics, because
     ring attention traces the rotating block origin. ``q_seg``/``k_seg``:
@@ -405,6 +435,8 @@ def _xla_block_state(q, k, v, offs, causal, q_seg=None, k_seg=None):
         iq = jnp.arange(q.shape[1])[:, None] + offs[0]
         ik = jnp.arange(k.shape[1])[None, :] + offs[1]
         s = jnp.where(iq >= ik, s, NEG_INF)
+        if window is not None:
+            s = jnp.where(iq - ik < window, s, NEG_INF)
     if q_seg is not None:
         s = _apply_segment_mask(s, q_seg, k_seg, NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
@@ -415,28 +447,32 @@ def _xla_block_state(q, k, v, offs, causal, q_seg=None, k_seg=None):
     return acc, m, l
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7))
-def _block_state_core(q, k, v, offs, q_seg, k_seg, causal, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
+def _block_state_core(q, k, v, offs, q_seg, k_seg, causal, interpret,
+                      window):
     if _pick_block(q.shape[1], BLOCK_Q) is None or \
             _pick_block(k.shape[1], BLOCK_K) is None:
         return _xla_block_state(q, k, v, offs, causal, q_seg=q_seg,
-                                k_seg=k_seg)
+                                k_seg=k_seg, window=window)
     return _pallas_block_state(q, k, v, offs, causal, interpret,
-                               q_seg=q_seg, k_seg=k_seg)
+                               q_seg=q_seg, k_seg=k_seg, window=window)
 
 
-def _block_state_fwd(q, k, v, offs, q_seg, k_seg, causal, interpret):
+def _block_state_fwd(q, k, v, offs, q_seg, k_seg, causal, interpret,
+                     window):
     return _block_state_core(q, k, v, offs, q_seg, k_seg, causal,
-                             interpret), (q, k, v, offs, q_seg, k_seg)
+                             interpret, window), (q, k, v, offs, q_seg,
+                                                  k_seg)
 
 
-def _block_state_bwd(causal, interpret, res, g):
+def _block_state_bwd(causal, interpret, window, res, g):
     import numpy as np
 
     q, k, v, offs, q_seg, k_seg = res
     _, vjp = jax.vjp(
         lambda q_, k_, v_: _xla_block_state(q_, k_, v_, offs, causal,
-                                            q_seg=q_seg, k_seg=k_seg),
+                                            q_seg=q_seg, k_seg=k_seg,
+                                            window=window),
         q, k, v)
     dq, dk, dv = vjp(g)
 
@@ -473,7 +509,8 @@ def _merge_heads(x):
 
 def flash_attention_block(q, k, v, q_off, k_off, causal: bool = True,
                           use_pallas: Optional[bool] = None,
-                          q_segment_ids=None, k_segment_ids=None):
+                          q_segment_ids=None, k_segment_ids=None,
+                          window: Optional[int] = None):
     """One K/V block's unmerged attention state for ring attention.
 
     q/k/v: [B, T, H, D]. Returns (acc, m, l) with acc f32 [B, T, H, D]
@@ -491,15 +528,16 @@ def flash_attention_block(q, k, v, q_off, k_off, causal: bool = True,
     if q_segment_ids is not None:
         q_seg = _tile_seg(q_segment_ids, H)
         k_seg = _tile_seg(k_segment_ids, H)
+    _check_window(window, causal)
     use_pallas, interpret = _resolve_dispatch(use_pallas)
     if use_pallas:
         acc, m, l = _block_state_core(
             _merge_heads(q), _merge_heads(k), _merge_heads(v), offs,
-            q_seg, k_seg, causal, interpret)
+            q_seg, k_seg, causal, interpret, window)
     else:
         acc, m, l = _xla_block_state(
             _merge_heads(q), _merge_heads(k), _merge_heads(v), offs,
-            causal, q_seg=q_seg, k_seg=k_seg)
+            causal, q_seg=q_seg, k_seg=k_seg, window=window)
     acc = acc.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
     m = m.reshape(B, H, Tq)
     l = l.reshape(B, H, Tq)
@@ -509,7 +547,8 @@ def flash_attention_block(q, k, v, q_off, k_off, causal: bool = True,
 def flash_attention_block_grads(q, k, v, do, lse, delta, q_off, k_off,
                                 causal: bool = True,
                                 use_pallas: Optional[bool] = None,
-                                q_segment_ids=None, k_segment_ids=None):
+                                q_segment_ids=None, k_segment_ids=None,
+                                window: Optional[int] = None):
     """One K/V block's (dq, dk, dv) for ring attention's backward pass.
 
     q/k/v/do: [B, T, H, D]; lse/delta: f32 [B, H, T] — the GLOBAL row
@@ -533,15 +572,17 @@ def flash_attention_block_grads(q, k, v, do, lse, delta, q_off, k_off,
     if q_segment_ids is not None:
         q_seg = _tile_seg(q_segment_ids, H)
         k_seg = _tile_seg(k_segment_ids, H)
+    _check_window(window, causal)
     if use_pallas and _pick_block(Tq, BLOCK_Q) is not None and \
             _pick_block(Tk, BLOCK_K) is not None:
         dq, dk, dv = _pallas_bwd(qm, km, vm, dom, lse_m, delta_m, offs,
                                  causal, interpret, out_dtype=jnp.float32,
-                                 q_seg=q_seg, k_seg=k_seg)
+                                 q_seg=q_seg, k_seg=k_seg, window=window)
     else:
         dq, dk, dv = _xla_block_grads(qm, km, vm, dom, lse_m, delta_m,
                                       offs, causal, out_dtype=jnp.float32,
-                                      q_seg=q_seg, k_seg=k_seg)
+                                      q_seg=q_seg, k_seg=k_seg,
+                                      window=window)
 
     def split(x, t):
         return x.reshape(B, H, t, D).transpose(0, 2, 1, 3)
@@ -557,7 +598,8 @@ def _attn_kernel_seg(offs_ref, q_ref, k_ref, v_ref, qs_ref, ks_ref,
 
 
 def _pallas_attention_fwd(q, k, v, q_off, k_off, causal: bool,
-                          interpret: bool, q_seg=None, k_seg=None):
+                          interpret: bool, q_seg=None, k_seg=None,
+                          window=None):
     """q/k/v: [BH, T, D] (already merged batch*heads, padded to tiles)."""
     BH, Tq, D = q.shape
     Tk = k.shape[1]
@@ -595,7 +637,7 @@ def _pallas_attention_fwd(q, k, v, q_off, k_off, causal: bool,
     )
     kernel = functools.partial(
         kernel_fn, causal=causal, block_q=bq, block_k=bk,
-        num_k_tiles=num_k)
+        num_k_tiles=num_k, window=window)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -607,7 +649,8 @@ def _pallas_attention_fwd(q, k, v, q_off, k_off, causal: bool,
 
 
 def _pallas_attention_fwd_train(q, k, v, offs, causal: bool,
-                                interpret: bool, q_seg=None, k_seg=None):
+                                interpret: bool, q_seg=None, k_seg=None,
+                                window=None):
     """Forward with residuals: (o [BH,T,D] in q.dtype, lse f32 [BH,T,1])."""
     BH, Tq, D = q.shape
     Tk = k.shape[1]
@@ -646,7 +689,7 @@ def _pallas_attention_fwd_train(q, k, v, offs, causal: bool,
     )
     kernel = functools.partial(
         kernel_fn, causal=causal, block_q=bq, block_k=bk,
-        num_k_tiles=num_k)
+        num_k_tiles=num_k, window=window)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -661,7 +704,8 @@ def _pallas_attention_fwd_train(q, k, v, offs, causal: bool,
 
 
 def _pallas_bwd(q, k, v, do, lse, delta, offs, causal: bool,
-                interpret: bool, out_dtype=None, q_seg=None, k_seg=None):
+                interpret: bool, out_dtype=None, q_seg=None, k_seg=None,
+                window=None):
     """The two flash-backward kernels; returns (dq, dk, dv) in the input
     dtypes (or ``out_dtype`` when given — ring accumulation wants f32).
     lse/delta: f32 [BH, T, 1]."""
@@ -690,7 +734,7 @@ def _pallas_bwd(q, k, v, do, lse, delta, offs, causal: bool,
         dq_kernel = _attn_bwd_dq_kernel
     dq = pl.pallas_call(
         functools.partial(dq_kernel, causal=causal, block_q=bq,
-                          block_k=bk, num_k_tiles=num_k),
+                          block_k=bk, num_k_tiles=num_k, window=window),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(BH, num_q, num_k),
@@ -723,7 +767,7 @@ def _pallas_bwd(q, k, v, do, lse, delta, offs, causal: bool,
         kv_kernel = _attn_bwd_dkv_kernel
     dk, dv = pl.pallas_call(
         functools.partial(kv_kernel, causal=causal, block_q=bq,
-                          block_k=bk, num_q_tiles=num_q),
+                          block_k=bk, num_q_tiles=num_q, window=window),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(BH, num_k, num_q),
@@ -742,7 +786,7 @@ def _pallas_bwd(q, k, v, do, lse, delta, offs, causal: bool,
 
 
 def _xla_block_grads(q, k, v, do, lse, delta, offs, causal: bool,
-                     out_dtype=None, q_seg=None, k_seg=None):
+                     out_dtype=None, q_seg=None, k_seg=None, window=None):
     """XLA twin of the backward kernels (fallback for untileable shapes
     and non-TPU platforms). Same math, same lse/delta residuals."""
     dq_dt = out_dtype or q.dtype
@@ -756,6 +800,8 @@ def _xla_block_grads(q, k, v, do, lse, delta, offs, causal: bool,
         iq = jnp.arange(q.shape[1])[:, None] + offs[0]
         ik = jnp.arange(k.shape[1])[None, :] + offs[1]
         p = jnp.where((iq >= ik)[None], p, 0.0)
+        if window is not None:
+            p = jnp.where((iq - ik < window)[None], p, 0.0)
     if q_seg is not None:
         p = _apply_segment_mask(p, q_seg, k_seg, 0.0)
     dof = do.astype(jnp.float32)
@@ -780,7 +826,8 @@ def _pick_block(t: int, cap: int) -> Optional[int]:
     return None
 
 
-def _xla_flash(q, k, v, q_off, k_off, causal, q_seg=None, k_seg=None):
+def _xla_flash(q, k, v, q_off, k_off, causal, q_seg=None, k_seg=None,
+               window=None):
     """XLA reference path (backward recompute + non-TPU fallback), fp32
     accumulation — the same math as parallel.ring_attention.
     ``q_seg``/``k_seg``: optional int32 [BH, T] segment ids (packed
@@ -792,6 +839,8 @@ def _xla_flash(q, k, v, q_off, k_off, causal, q_seg=None, k_seg=None):
         iq = jnp.arange(q.shape[1])[:, None] + q_off
         ik = jnp.arange(k.shape[1])[None, :] + k_off
         s = jnp.where(iq >= ik, s, NEG_INF)
+        if window is not None:
+            s = jnp.where(iq - ik < window, s, NEG_INF)
     if q_seg is not None:
         s = _apply_segment_mask(s, q_seg, k_seg, NEG_INF)
     # Rows whose keys are all masked normalize to zero output, matching
@@ -803,42 +852,48 @@ def _xla_flash(q, k, v, q_off, k_off, causal, q_seg=None, k_seg=None):
     return o.astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _flash_core(q, k, v, q_seg, k_seg, q_off, k_off, causal, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_core(q, k, v, q_seg, k_seg, q_off, k_off, causal, interpret,
+                window):
     if _pick_block(q.shape[1], BLOCK_Q) is None or \
             _pick_block(k.shape[1], BLOCK_K) is None:
         return _xla_flash(q, k, v, q_off, k_off, causal, q_seg=q_seg,
-                          k_seg=k_seg)
+                          k_seg=k_seg, window=window)
     return _pallas_attention_fwd(q, k, v, q_off, k_off, causal, interpret,
-                                 q_seg=q_seg, k_seg=k_seg)
+                                 q_seg=q_seg, k_seg=k_seg, window=window)
 
 
-def _flash_fwd(q, k, v, q_seg, k_seg, q_off, k_off, causal, interpret):
+def _flash_fwd(q, k, v, q_seg, k_seg, q_off, k_off, causal, interpret,
+               window):
     if _pick_block(q.shape[1], BLOCK_Q) is None or \
             _pick_block(k.shape[1], BLOCK_K) is None:
         return _xla_flash(q, k, v, q_off, k_off, causal, q_seg=q_seg,
-                          k_seg=k_seg), (q, k, v, q_seg, k_seg, None, None)
+                          k_seg=k_seg, window=window), \
+            (q, k, v, q_seg, k_seg, None, None)
     offs = jnp.asarray([q_off, k_off], jnp.int32)
     o, lse = _pallas_attention_fwd_train(q, k, v, offs, causal, interpret,
-                                         q_seg=q_seg, k_seg=k_seg)
+                                         q_seg=q_seg, k_seg=k_seg,
+                                         window=window)
     return o, (q, k, v, q_seg, k_seg, o, lse)
 
 
-def _flash_bwd(q_off, k_off, causal, interpret, res, g):
+def _flash_bwd(q_off, k_off, causal, interpret, window, res, g):
     q, k, v, q_seg, k_seg, o, lse = res
 
     if lse is None:
         # Untileable shapes: recompute through the XLA twin.
         _, vjp = jax.vjp(
             lambda q_, k_, v_: _xla_flash(q_, k_, v_, q_off, k_off, causal,
-                                          q_seg=q_seg, k_seg=k_seg),
+                                          q_seg=q_seg, k_seg=k_seg,
+                                          window=window),
             q, k, v)
         return (*vjp(g), int_cotangent(q_seg), int_cotangent(k_seg))
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)
     offs = jnp.asarray([q_off, k_off], jnp.int32)
     dq, dk, dv = _pallas_bwd(q, k, v, g, lse, delta, offs, causal,
-                             interpret, q_seg=q_seg, k_seg=k_seg)
+                             interpret, q_seg=q_seg, k_seg=k_seg,
+                             window=window)
     return dq, dk, dv, int_cotangent(q_seg), int_cotangent(k_seg)
 
 
@@ -852,7 +907,8 @@ def _tile_seg(seg, heads):
 
 def flash_attention(q, k, v, causal: bool = True, q_off: int = 0,
                     k_off: int = 0, use_pallas: Optional[bool] = None,
-                    q_segment_ids=None, k_segment_ids=None):
+                    q_segment_ids=None, k_segment_ids=None,
+                    window: Optional[int] = None):
     """Blocked flash attention. q/k/v: [B, T, H, D].
 
     ``use_pallas=None`` auto-selects via ``_resolve_dispatch``.
@@ -872,6 +928,7 @@ def flash_attention(q, k, v, causal: bool = True, q_off: int = 0,
         return x.reshape(B, H, t, D).transpose(0, 2, 1, 3)
 
     _require_both_segs(q_segment_ids, k_segment_ids)
+    _check_window(window, causal)
     q_seg = k_seg = None
     if q_segment_ids is not None:
         q_seg = _tile_seg(q_segment_ids, H)
@@ -880,8 +937,10 @@ def flash_attention(q, k, v, causal: bool = True, q_off: int = 0,
     use_pallas, interpret = _resolve_dispatch(use_pallas)
     if not use_pallas:
         out = _xla_flash(_merge_heads(q), _merge_heads(k), _merge_heads(v),
-                         q_off, k_off, causal, q_seg=q_seg, k_seg=k_seg)
+                         q_off, k_off, causal, q_seg=q_seg, k_seg=k_seg,
+                         window=window)
         return split(out, Tq)
     out = _flash_core(_merge_heads(q), _merge_heads(k), _merge_heads(v),
-                      q_seg, k_seg, q_off, k_off, causal, interpret)
+                      q_seg, k_seg, q_off, k_off, causal, interpret,
+                      window)
     return split(out, Tq)

@@ -51,7 +51,8 @@ def _block_attend(q, k, v, m, l, o, mask):
     return m_new, l_new, o_new
 
 
-def _ring_fwd_pass(q, k, v, seg, axis_name: str, causal: bool):
+def _ring_fwd_pass(q, k, v, seg, axis_name: str, causal: bool,
+                   window=None):
     """The forward ring: flash block kernel per rotating K/V block +
     online-softmax merge. Returns (o in q.dtype, lse f32 [B, H, Tq]) —
     lse is the backward pass's residual. ``seg``: optional int32 [B, T]
@@ -78,7 +79,8 @@ def _ring_fwd_pass(q, k, v, seg, axis_name: str, causal: bool):
         acc_b, m_b, l_b = flash_attention_block(
             q, k_cur, v_cur, q_off=my * Tq, k_off=k_blk * k_cur.shape[1],
             causal=causal, q_segment_ids=seg,
-            k_segment_ids=None if seg is None else kseg_cur)
+            k_segment_ids=None if seg is None else kseg_cur,
+            window=window)
         m_new = jnp.maximum(m, m_b)                       # [B,H,Tq]
         alive = m_new > NEG_INF / 2
         c_old = jnp.where(alive, jnp.exp(m - m_new), 1.0)
@@ -103,17 +105,17 @@ def _ring_fwd_pass(q, k, v, seg, axis_name: str, causal: bool):
     return o.astype(q.dtype), lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _ring_core(q, k, v, seg, axis_name, causal):
-    return _ring_fwd_pass(q, k, v, seg, axis_name, causal)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _ring_core(q, k, v, seg, axis_name, causal, window):
+    return _ring_fwd_pass(q, k, v, seg, axis_name, causal, window)[0]
 
 
-def _ring_vjp_fwd(q, k, v, seg, axis_name, causal):
-    o, lse = _ring_fwd_pass(q, k, v, seg, axis_name, causal)
+def _ring_vjp_fwd(q, k, v, seg, axis_name, causal, window):
+    o, lse = _ring_fwd_pass(q, k, v, seg, axis_name, causal, window)
     return o, (q, k, v, seg, o, lse)
 
 
-def _ring_vjp_bwd(axis_name, causal, res, do):
+def _ring_vjp_bwd(axis_name, causal, window, res, do):
     """Backward ring pass (the ring-attention paper's second rotation):
     K/V blocks rotate again, each visit computes that block's (dq, dk, dv)
     through the flash backward kernels with the GLOBAL lse/delta
@@ -142,7 +144,8 @@ def _ring_vjp_bwd(axis_name, causal, res, do):
             q, k_cur, v_cur, do, lse, delta,
             q_off=my * Tq, k_off=k_blk * Tk, causal=causal,
             q_segment_ids=seg,
-            k_segment_ids=None if seg is None else kseg_cur)
+            k_segment_ids=None if seg is None else kseg_cur,
+            window=window)
         dq = dq + dq_b
         dk = dk + dk_b
         dv = dv + dv_b
@@ -167,7 +170,7 @@ _ring_core.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
 
 
 def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
-                   segment_ids=None):
+                   segment_ids=None, window=None):
     """Context-parallel attention. q/k/v: [B, T_local, H, D] per chip.
 
     Every K/V block's local attention runs through the flash kernel
@@ -190,10 +193,10 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
 
         return flash_attention(q, k, v, causal=causal,
                                q_segment_ids=segment_ids,
-                               k_segment_ids=segment_ids)
+                               k_segment_ids=segment_ids, window=window)
     if segment_ids is not None:
         segment_ids = jnp.asarray(segment_ids, jnp.int32)
-    return _ring_core(q, k, v, segment_ids, axis_name, causal)
+    return _ring_core(q, k, v, segment_ids, axis_name, causal, window)
 
 
 def local_flash_attention(q, k, v, causal: bool = True):

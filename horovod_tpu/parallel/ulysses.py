@@ -47,7 +47,8 @@ def gather_segment_ids(segment_ids, axis_name: str = "sp"):
 
 
 def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
-                      segment_ids=None, gathered_segment_ids=None):
+                      segment_ids=None, gathered_segment_ids=None,
+                      window=None):
     """Context-parallel attention via head<->sequence all-to-all.
 
     q/k/v: [B, T_local, H, D] per chip, sequence-sharded over
@@ -65,7 +66,7 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
     if sp == 1:
         return flash_attention(q, k, v, causal=causal,
                                q_segment_ids=segment_ids,
-                               k_segment_ids=segment_ids)
+                               k_segment_ids=segment_ids, window=window)
     heads = q.shape[2]
     if heads % sp != 0:
         raise ValueError(
@@ -88,7 +89,7 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
         full_seg = gather_segment_ids(segment_ids, axis_name)
     o = flash_attention(seq_to_heads(q), seq_to_heads(k), seq_to_heads(v),
                         causal=causal, q_segment_ids=full_seg,
-                        k_segment_ids=full_seg)
+                        k_segment_ids=full_seg, window=window)
     return heads_to_seq(o)
 
 
@@ -96,7 +97,7 @@ def context_parallel_attention(q, k, v, axis_name: str = "sp",
                                causal: bool = True,
                                strategy: str = "ring",
                                segment_ids=None,
-                               gathered_segment_ids=None):
+                               gathered_segment_ids=None, window=None):
     """Dispatch between the two sequence-parallel attention strategies.
 
     ``strategy``: ``"ring"`` (default — no head constraint, T_local
@@ -115,9 +116,10 @@ def context_parallel_attention(q, k, v, axis_name: str = "sp",
     if strategy == "ulysses":
         return ulysses_attention(q, k, v, axis_name=axis_name,
                                  causal=causal, segment_ids=segment_ids,
-                                 gathered_segment_ids=gathered_segment_ids)
+                                 gathered_segment_ids=gathered_segment_ids,
+                                 window=window)
     if strategy == "ring":
         return ring_attention(q, k, v, axis_name=axis_name, causal=causal,
-                              segment_ids=segment_ids)
+                              segment_ids=segment_ids, window=window)
     raise ValueError(f"unknown sequence-parallel strategy {strategy!r}; "
                      "expected 'ring', 'ulysses', or 'auto'")

@@ -47,6 +47,83 @@ def test_multi_tile_sequences():
                                rtol=2e-5, atol=2e-5)
 
 
+def _window_dense(q, k, v, window):
+    D = q.shape[-1]
+    s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / np.sqrt(D)
+    iq = jnp.arange(q.shape[1])[:, None]
+    ik = jnp.arange(k.shape[1])[None, :]
+    allowed = (iq >= ik) & (iq - ik < window)
+    s = jnp.where(allowed[None, None], s, -1e30)
+    p = jax.nn.softmax(s, -1)
+    return jnp.einsum("bhts,bshd->bthd", p, v.astype(jnp.float32))
+
+
+@pytest.mark.parametrize("window", [1, 8, 24])
+def test_sliding_window_matches_dense(window):
+    # Single-tile case (T=256 -> one 256-wide tile): the in-tile mask.
+    q, k, v = _qkv(B=1, T=256, H=2, D=8)
+    out = flash_attention(q, k, v, causal=True, use_pallas=True,
+                          window=window)
+    ref = _window_dense(q, k, v, window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_sliding_window_tile_culling():
+    # T=1536 -> three 512-wide K tiles with window=64 << 512: whole
+    # out-of-window K tiles hit the cull predicate (a sign/off-by-one
+    # error there drops a LIVE tile and this comparison catches it).
+    q, k, v = _qkv(B=1, T=1536, H=1, D=8)
+    out = flash_attention(q, k, v, causal=True, use_pallas=True,
+                          window=64)
+    ref = _window_dense(q, k, v, 64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_sliding_window_gradients_match_xla_path():
+    q, k, v = _qkv(B=1, T=64, H=2, D=8)
+
+    def make(up):
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(
+                q, k, v, causal=True, use_pallas=up, window=16) ** 2)
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    for gp, gx in zip(make(True), make(False)):
+        assert np.abs(np.asarray(gp)).max() > 0
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gx),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_sliding_window_composes_with_segments():
+    q, k, v = _qkv()
+    seg = jnp.asarray(np.repeat([[0, 1]], 2, axis=0).repeat(16, axis=1),
+                      jnp.int32)  # [2, 32]
+    out = flash_attention(q, k, v, causal=True, use_pallas=True,
+                          window=4, q_segment_ids=seg, k_segment_ids=seg)
+    # Oracle: window AND segment masks compose.
+    D = q.shape[-1]
+    s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / np.sqrt(D)
+    iq = jnp.arange(q.shape[1])[:, None]
+    ik = jnp.arange(k.shape[1])[None, :]
+    allowed = ((iq >= ik) & (iq - ik < 4))[None, None] & \
+        (seg[:, None, :, None] == seg[:, None, None, :])
+    s = jnp.where(allowed, s, -1e30)
+    ref = jnp.einsum("bhts,bshd->bthd", jax.nn.softmax(s, -1),
+                     v.astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_window_requires_causal():
+    q, k, v = _qkv()
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, causal=False, window=8)
+
+
 def _seg_dense(q, k, v, seg, causal):
     D = q.shape[-1]
     s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),

@@ -231,6 +231,30 @@ def test_packed_sequences_match_dense(strategy):
     assert float(np.asarray(l1)) == pytest.approx(expected, rel=1e-4)
 
 
+def test_sliding_window_matches_dense():
+    # SWA through the sharded stack: the dense oracle gets the same
+    # window mask; the sharded loss must match, and must differ from
+    # full-causal (the window can't silently no-op).
+    cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4, d_head=8,
+                            d_ff=64, n_layers=4, max_seq=64,
+                            attention_window=8)
+    mesh = build_parallel_mesh(jax.devices(), dp=2, pp=2, sp=2, tp=1)
+    params, tokens, labels = _setup(cfg, mesh)
+    loss_fn = make_loss_fn(cfg, mesh, n_microbatches=2)
+    sharded = shard_params(params, cfg, mesh)
+    data_sharding = NamedSharding(mesh, P("dp", "sp"))
+    loss = float(jax.jit(loss_fn)(
+        sharded, jax.device_put(tokens, data_sharding),
+        jax.device_put(labels, data_sharding)))
+    expected = float(dense_reference_loss(cfg, params, tokens, labels))
+    assert loss == pytest.approx(expected, rel=1e-4)
+    import dataclasses
+    full = float(dense_reference_loss(
+        dataclasses.replace(cfg, attention_window=None), params, tokens,
+        labels))
+    assert abs(full - expected) > 1e-4
+
+
 def test_remat_matches_dense():
     # jax.checkpoint must not change the math — only when activations
     # are recomputed. Same oracle check as the non-remat path.

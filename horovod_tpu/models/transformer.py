@@ -67,6 +67,33 @@ class TransformerConfig:
     # activation HBM drops from O(n_layers) to O(1) layers — the
     # standard trade that lets long sequences fit, at ~1/3 extra FLOPs.
     remat: bool = False
+    # Grouped-query attention (Llama/Mistral-style): n_kv_heads < n_heads
+    # shares each K/V head across n_heads/n_kv_heads query heads (KV
+    # params cut by that factor; K/V expanded before the kernel — the
+    # training-side GQA formulation). None = multi-head (= n_heads).
+    n_kv_heads: Optional[int] = None
+    # Rotary position embeddings instead of the learned position table.
+    # Positions are GLOBAL (sp-sharded ranks offset by their shard), so
+    # RoPE composes with sequence parallelism.
+    rope: bool = False
+    rope_theta: float = 10000.0
+
+    def __post_init__(self):
+        if self.n_kv_heads is not None:
+            if self.n_kv_heads < 1:
+                raise ValueError(
+                    f"n_kv_heads must be >= 1, got {self.n_kv_heads}")
+            if self.n_heads % self.n_kv_heads != 0:
+                raise ValueError(
+                    f"n_heads ({self.n_heads}) must divide by n_kv_heads "
+                    f"({self.n_kv_heads})")
+        if self.rope and self.d_head % 2 != 0:
+            raise ValueError(f"rope needs an even d_head, got "
+                             f"{self.d_head}")
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_heads if self.n_kv_heads is None else self.n_kv_heads
 
 
 def _param_specs(cfg: TransformerConfig) -> Dict[str, P]:
@@ -75,14 +102,19 @@ def _param_specs(cfg: TransformerConfig) -> Dict[str, P]:
     stage)] on per-layer params)."""
     specs = {
         "embed": P(),
-        "pos": P(),
         "ln1": P("pp"),
-        "wqkv": P("pp", None, None, None, "tp"),
         "wo": P("pp", None, "tp"),
         "ln2": P("pp"),
         "final_ln": P(),
         "head": P(),
     }
+    if not cfg.rope:
+        specs["pos"] = P()
+    if cfg.kv_heads == cfg.n_heads:
+        specs["wqkv"] = P("pp", None, None, None, "tp")
+    else:
+        specs["wq"] = P("pp", None, None, "tp")
+        specs["wkv"] = P("pp", None, None, None, "tp")
     if cfg.use_moe:
         specs.update({
             "gate": P("pp"),
@@ -110,14 +142,22 @@ def init_params(cfg: TransformerConfig, rng, n_stages: int) -> Dict:
 
     params = {
         "embed": norm(ks[0], (cfg.vocab, d), 0.02),
-        "pos": norm(ks[1], (cfg.max_seq, d), 0.02),
         "ln1": jnp.ones((n_stages, lps, d), jnp.float32),
-        "wqkv": norm(ks[2], (n_stages, lps, d, 3, H, Dh), d ** -0.5),
         "wo": norm(ks[3], (n_stages, lps, H, Dh, d), (H * Dh) ** -0.5),
         "ln2": jnp.ones((n_stages, lps, d), jnp.float32),
         "final_ln": jnp.ones((d,), jnp.float32),
         "head": norm(ks[4], (d, cfg.vocab), d ** -0.5),
     }
+    if not cfg.rope:
+        params["pos"] = norm(ks[1], (cfg.max_seq, d), 0.02)
+    Hkv = cfg.kv_heads
+    if Hkv == H:
+        params["wqkv"] = norm(ks[2], (n_stages, lps, d, 3, H, Dh),
+                              d ** -0.5)
+    else:
+        params["wq"] = norm(ks[2], (n_stages, lps, d, H, Dh), d ** -0.5)
+        params["wkv"] = norm(ks[8], (n_stages, lps, d, 2, Hkv, Dh),
+                             d ** -0.5)
     if cfg.use_moe:
         E, Fe = cfg.n_experts, cfg.d_expert
         params.update({
@@ -142,6 +182,24 @@ def shard_params(params: Dict, cfg: TransformerConfig, mesh) -> Dict:
     }
 
 
+def _rope(x, positions, theta):
+    """Rotary position embeddings (rotate-half convention).
+
+    x: [b, t, H, Dh] (Dh even); positions: [t] GLOBAL token positions —
+    sequence-parallel shards pass their offset range, which is what
+    makes RoPE compose with the sp axis."""
+    Dh = x.shape[-1]
+    half = Dh // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x2 * cos + x1 * sin], -1).astype(x.dtype)
+
+
 def _layernorm(x, scale):
     xf = x.astype(jnp.float32)
     mu = jnp.mean(xf, -1, keepdims=True)
@@ -160,8 +218,22 @@ def _make_stage_fn(cfg: TransformerConfig, packed: bool = False):
     def layer(x, lp, seg, gathered_seg):
         # --- attention (tp-sharded heads, sp ring) --------------------------
         h = _layernorm(x, lp["ln1"])
-        qkv = jnp.einsum("btd,dchk->btchk", h, lp["wqkv"])  # c=3, h=H/tp
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if "wqkv" in lp:
+            qkv = jnp.einsum("btd,dchk->btchk", h, lp["wqkv"])  # h=H/tp
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        else:  # GQA: separate q and (fewer-headed) kv projections
+            q = jnp.einsum("btd,dhk->bthk", h, lp["wq"])
+            kv = jnp.einsum("btd,dchk->btchk", h, lp["wkv"])  # h=Hkv/tp
+            k, v = kv[:, :, 0], kv[:, :, 1]
+        if cfg.rope:
+            t_local = x.shape[1]
+            pos = (lax.axis_index("sp") * t_local
+                   + jnp.arange(t_local, dtype=jnp.int32))
+            q = _rope(q, pos, cfg.rope_theta)
+            k = _rope(k, pos, cfg.rope_theta)
+        # GQA K/V stay at their reduced head width here — the
+        # context-parallel strategies carry them across the sp fabric
+        # at that width and expand only at the kernel boundary.
         attn = context_parallel_attention(
             q, k, v, axis_name="sp", causal=True,
             strategy=cfg.sp_strategy, segment_ids=seg,
@@ -221,8 +293,11 @@ def _spmd_forward(cfg: TransformerConfig, stage_fn, params, tokens,
     b, t = tokens.shape
     sp_idx = lax.axis_index("sp")
     x = params["embed"][tokens]  # [b, t, d]
-    pos = lax.dynamic_slice_in_dim(params["pos"], sp_idx * t, t, axis=0)
-    x = (x + pos[None]).astype(cfg.dtype)
+    if "pos" in params:  # learned positions; RoPE rotates in the layers
+        pos = lax.dynamic_slice_in_dim(params["pos"], sp_idx * t, t,
+                                       axis=0)
+        x = x + pos[None]
+    x = x.astype(cfg.dtype)
 
     # microbatch for the pipeline: [M, mb, t, d]
     M = n_microbatches
@@ -352,15 +427,33 @@ def dense_reference_loss(cfg: TransformerConfig, params, tokens, labels,
                           v.astype(jnp.float32)).astype(q.dtype)
 
     b, t = tokens.shape
-    x = params["embed"][tokens] + params["pos"][:t][None]
+    x = params["embed"][tokens]
+    if "pos" in params:
+        x = x + params["pos"][:t][None]
     x = x.astype(cfg.dtype)
     n_stages, lps = params["ln1"].shape[:2]
 
     for s in range(n_stages):
         for li in range(lps):
             h = _layernorm(x, params["ln1"][s, li])
-            qkv = jnp.einsum("btd,dchk->btchk", h, params["wqkv"][s, li])
-            attn = attend(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
+            if "wqkv" in params:
+                qkv = jnp.einsum("btd,dchk->btchk", h,
+                                 params["wqkv"][s, li])
+                q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            else:
+                q = jnp.einsum("btd,dhk->bthk", h, params["wq"][s, li])
+                kv = jnp.einsum("btd,dchk->btchk", h,
+                                params["wkv"][s, li])
+                k, v = kv[:, :, 0], kv[:, :, 1]
+            if cfg.rope:
+                pos = jnp.arange(t, dtype=jnp.int32)
+                q = _rope(q, pos, cfg.rope_theta)
+                k = _rope(k, pos, cfg.rope_theta)
+            if k.shape[2] != q.shape[2]:
+                g = q.shape[2] // k.shape[2]
+                k = jnp.repeat(k, g, axis=2)
+                v = jnp.repeat(v, g, axis=2)
+            attn = attend(q, k, v)
             x = x + jnp.einsum("bthk,hkd->btd", attn, params["wo"][s, li])
             h = _layernorm(x, params["ln2"][s, li])
             if cfg.use_moe:

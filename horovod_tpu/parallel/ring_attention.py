@@ -61,6 +61,7 @@ def _ring_fwd_pass(q, k, v, seg, axis_name: str, causal: bool,
     sp = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
+    g = H // k.shape[2]  # GQA group size (1 = plain multi-head)
     m = jnp.full((B, H, Tq), NEG_INF, dtype=jnp.float32)
     l = jnp.zeros((B, H, Tq), dtype=jnp.float32)
     o = jnp.zeros((B, Tq, H, D), dtype=jnp.float32)
@@ -72,12 +73,16 @@ def _ring_fwd_pass(q, k, v, seg, axis_name: str, causal: bool,
         # k_cur originated at rank (my - step) mod sp. Each block's local
         # attention state comes from the flash kernel (Pallas on TPU, XLA
         # elsewhere); the cross-block merge below is the standard
-        # online-softmax combine.
+        # online-softmax combine. GQA K/V travel the ring at their
+        # reduced head width and expand only for the kernel call.
         from ..ops.pallas_attention import flash_attention_block
 
         k_blk = (my - step) % sp
+        k_full = jnp.repeat(k_cur, g, axis=2) if g > 1 else k_cur
+        v_full = jnp.repeat(v_cur, g, axis=2) if g > 1 else v_cur
         acc_b, m_b, l_b = flash_attention_block(
-            q, k_cur, v_cur, q_off=my * Tq, k_off=k_blk * k_cur.shape[1],
+            q, k_full, v_full, q_off=my * Tq,
+            k_off=k_blk * k_cur.shape[1],
             causal=causal, q_segment_ids=seg,
             k_segment_ids=None if seg is None else kseg_cur,
             window=window)
@@ -129,23 +134,33 @@ def _ring_vjp_bwd(axis_name, causal, window, res, do):
     my = lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
+    Hkv = k.shape[2]
+    g = H // Hkv
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1).transpose(0, 2, 1)            # [B, H, Tq]
 
     fwd_perm = [(i, (i + 1) % sp) for i in range(sp)]
     dq0 = jnp.zeros((B, Tq, H, D), jnp.float32)
-    dk0 = jnp.zeros((B, Tk, H, D), jnp.float32)
-    dv0 = jnp.zeros((B, Tk, H, D), jnp.float32)
+    dk0 = jnp.zeros((B, Tk, Hkv, D), jnp.float32)
+    dv0 = jnp.zeros((B, Tk, Hkv, D), jnp.float32)
 
     def body(carry, step):
         dq, dk, dv, k_cur, v_cur, kseg_cur = carry
         k_blk = (my - step) % sp
+        k_full = jnp.repeat(k_cur, g, axis=2) if g > 1 else k_cur
+        v_full = jnp.repeat(v_cur, g, axis=2) if g > 1 else v_cur
         dq_b, dk_b, dv_b = flash_attention_block_grads(
-            q, k_cur, v_cur, do, lse, delta,
+            q, k_full, v_full, do, lse, delta,
             q_off=my * Tq, k_off=k_blk * Tk, causal=causal,
             q_segment_ids=seg,
             k_segment_ids=None if seg is None else kseg_cur,
             window=window)
+        if g > 1:
+            # repeat's transpose: sum each query-head group back onto
+            # its shared K/V head, so dK/dV accumulate (and rotate) at
+            # the reduced width.
+            dk_b = dk_b.reshape(B, Tk, Hkv, g, D).sum(3)
+            dv_b = dv_b.reshape(B, Tk, Hkv, g, D).sum(3)
         dq = dq + dq_b
         dk = dk + dk_b
         dv = dv + dv_b
@@ -191,6 +206,10 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
     if sp == 1:
         from ..ops.pallas_attention import flash_attention
 
+        g1 = q.shape[2] // k.shape[2]
+        if g1 > 1:
+            k = jnp.repeat(k, g1, axis=2)
+            v = jnp.repeat(v, g1, axis=2)
         return flash_attention(q, k, v, causal=causal,
                                q_segment_ids=segment_ids,
                                k_segment_ids=segment_ids, window=window)

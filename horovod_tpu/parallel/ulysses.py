@@ -61,18 +61,25 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
     loop).
     """
     sp = lax.axis_size(axis_name)
+    from jax import numpy as jnp
+
     from ..ops.pallas_attention import flash_attention
 
+    heads = q.shape[2]
+    g = heads // k.shape[2]  # GQA group size (1 = plain multi-head)
     if sp == 1:
+        if g > 1:
+            k = jnp.repeat(k, g, axis=2)
+            v = jnp.repeat(v, g, axis=2)
         return flash_attention(q, k, v, causal=causal,
                                q_segment_ids=segment_ids,
                                k_segment_ids=segment_ids, window=window)
-    heads = q.shape[2]
-    if heads % sp != 0:
+    if heads % sp != 0 or k.shape[2] % sp != 0:
         raise ValueError(
             f"ulysses_attention needs heads divisible by the '{axis_name}' "
-            f"axis: {heads} heads across {sp} chips (after any tp head "
-            f"sharding). Use ring_attention when heads don't divide.")
+            f"axis: {heads} query / {k.shape[2]} KV heads across {sp} "
+            f"chips (after any tp head sharding). Use ring_attention "
+            f"when heads don't divide.")
 
     # [B, T_local, H, D] -> [B, T_global, H/sp, D]: split the head axis
     # sp ways, concatenate the received blocks along the sequence axis.
@@ -87,7 +94,14 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
     full_seg = gathered_segment_ids
     if full_seg is None and segment_ids is not None:
         full_seg = gather_segment_ids(segment_ids, axis_name)
-    o = flash_attention(seq_to_heads(q), seq_to_heads(k), seq_to_heads(v),
+    # GQA K/V cross the fabric at their reduced width; the contiguous
+    # head split means shard i's query heads use exactly shard i's KV
+    # heads, so the post-exchange expansion is purely local.
+    kf, vf = seq_to_heads(k), seq_to_heads(v)
+    if g > 1:
+        kf = jnp.repeat(kf, g, axis=2)
+        vf = jnp.repeat(vf, g, axis=2)
+    o = flash_attention(seq_to_heads(q), kf, vf,
                         causal=causal, q_segment_ids=full_seg,
                         k_segment_ids=full_seg, window=window)
     return heads_to_seq(o)

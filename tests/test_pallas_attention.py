@@ -11,14 +11,21 @@ import jax.numpy as jnp
 from horovod_tpu.ops.pallas_attention import flash_attention
 
 
-def _dense(q, k, v, causal, q_off=0, k_off=0):
+def _dense(q, k, v, causal, q_off=0, k_off=0, window=None, seg=None):
+    """The ONE dense oracle: causal/offset/window/segment masks compose
+    here exactly as the kernels compose them."""
     D = q.shape[-1]
     s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
                    k.astype(jnp.float32)) / np.sqrt(D)
+    iq = jnp.arange(q.shape[1])[:, None] + q_off
+    ik = jnp.arange(k.shape[1])[None, :] + k_off
     if causal:
-        iq = jnp.arange(q.shape[1])[:, None] + q_off
-        ik = jnp.arange(k.shape[1])[None, :] + k_off
         s = jnp.where((iq >= ik)[None, None], s, -1e30)
+        if window is not None:
+            s = jnp.where((iq - ik < window)[None, None], s, -1e30)
+    if seg is not None:
+        allowed = seg[:, None, :, None] == seg[:, None, None, :]
+        s = jnp.where(allowed, s, -1e30)
     p = jax.nn.softmax(s, -1)
     return jnp.einsum("bhts,bshd->bthd", p, v.astype(jnp.float32))
 
@@ -47,25 +54,13 @@ def test_multi_tile_sequences():
                                rtol=2e-5, atol=2e-5)
 
 
-def _window_dense(q, k, v, window):
-    D = q.shape[-1]
-    s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) / np.sqrt(D)
-    iq = jnp.arange(q.shape[1])[:, None]
-    ik = jnp.arange(k.shape[1])[None, :]
-    allowed = (iq >= ik) & (iq - ik < window)
-    s = jnp.where(allowed[None, None], s, -1e30)
-    p = jax.nn.softmax(s, -1)
-    return jnp.einsum("bhts,bshd->bthd", p, v.astype(jnp.float32))
-
-
 @pytest.mark.parametrize("window", [1, 8, 24])
 def test_sliding_window_matches_dense(window):
     # Single-tile case (T=256 -> one 256-wide tile): the in-tile mask.
     q, k, v = _qkv(B=1, T=256, H=2, D=8)
     out = flash_attention(q, k, v, causal=True, use_pallas=True,
                           window=window)
-    ref = _window_dense(q, k, v, window)
+    ref = _dense(q, k, v, True, window=window)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
 
@@ -77,7 +72,7 @@ def test_sliding_window_tile_culling():
     q, k, v = _qkv(B=1, T=1536, H=1, D=8)
     out = flash_attention(q, k, v, causal=True, use_pallas=True,
                           window=64)
-    ref = _window_dense(q, k, v, 64)
+    ref = _dense(q, k, v, True, window=64)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
 
@@ -104,16 +99,7 @@ def test_sliding_window_composes_with_segments():
     out = flash_attention(q, k, v, causal=True, use_pallas=True,
                           window=4, q_segment_ids=seg, k_segment_ids=seg)
     # Oracle: window AND segment masks compose.
-    D = q.shape[-1]
-    s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) / np.sqrt(D)
-    iq = jnp.arange(q.shape[1])[:, None]
-    ik = jnp.arange(k.shape[1])[None, :]
-    allowed = ((iq >= ik) & (iq - ik < 4))[None, None] & \
-        (seg[:, None, :, None] == seg[:, None, None, :])
-    s = jnp.where(allowed, s, -1e30)
-    ref = jnp.einsum("bhts,bshd->bthd", jax.nn.softmax(s, -1),
-                     v.astype(jnp.float32))
+    ref = _dense(q, k, v, True, window=4, seg=seg)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
 
@@ -124,20 +110,6 @@ def test_window_requires_causal():
         flash_attention(q, k, v, causal=False, window=8)
 
 
-def _seg_dense(q, k, v, seg, causal):
-    D = q.shape[-1]
-    s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) / np.sqrt(D)
-    if causal:
-        iq = jnp.arange(q.shape[1])[:, None]
-        ik = jnp.arange(k.shape[1])[None, :]
-        s = jnp.where((iq >= ik)[None, None], s, -1e30)
-    allowed = seg[:, None, :, None] == seg[:, None, None, :]
-    s = jnp.where(allowed, s, -1e30)
-    p = jax.nn.softmax(s, -1)
-    return jnp.einsum("bhts,bshd->bthd", p, v.astype(jnp.float32))
-
-
 @pytest.mark.parametrize("causal", [True, False])
 def test_segment_ids_match_dense(causal):
     # The SAME Mosaic kernels, with the ids streamed as extra tiles.
@@ -146,7 +118,7 @@ def test_segment_ids_match_dense(causal):
                                 ).repeat(8, axis=1), jnp.int32)  # [2, 32]
     out = flash_attention(q, k, v, causal=causal, use_pallas=True,
                           q_segment_ids=seg, k_segment_ids=seg)
-    ref = _seg_dense(q, k, v, seg, causal)
+    ref = _dense(q, k, v, causal, seg=seg)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
 
@@ -346,7 +318,7 @@ def test_ring_attention_segments_block_kernel(monkeypatch):
         mesh=mesh, in_specs=(P(None, "sp"),) * 4,
         out_specs=P(None, "sp"), check_vma=False))
     out = fn(q, k, v, seg)
-    ref = _seg_dense(q, k, v, seg, True)
+    ref = _dense(q, k, v, True, seg=seg)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
 
@@ -354,7 +326,7 @@ def test_ring_attention_segments_block_kernel(monkeypatch):
         return jnp.sum(fn(q, k, v, seg).astype(jnp.float32) ** 2)
 
     def ref_loss(q, k, v):
-        return jnp.sum(_seg_dense(q, k, v, seg, True) ** 2)
+        return jnp.sum(_dense(q, k, v, True, seg=seg) ** 2)
 
     g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
     g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)

@@ -231,6 +231,37 @@ def test_packed_sequences_match_dense(strategy):
     assert float(np.asarray(l1)) == pytest.approx(expected, rel=1e-4)
 
 
+@pytest.mark.parametrize("sizes", [dict(dp=2, pp=2, sp=1, tp=2),
+                                   dict(dp=2, pp=1, sp=2, tp=2)])
+def test_gqa_rope_matches_dense(sizes):
+    # Modern-decoder config: grouped-query attention (2 KV heads shared
+    # across 4 query heads, projections tp-sharded at their own widths)
+    # + rotary positions (GLOBAL positions on the sp-sharded ranks).
+    cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4, d_head=8,
+                            d_ff=64, n_layers=4, max_seq=64,
+                            n_kv_heads=2, rope=True)
+    mesh = build_parallel_mesh(jax.devices(), **sizes)
+    params, tokens, labels = _setup(cfg, mesh)
+    assert "wq" in params and "wkv" in params and "pos" not in params
+    loss_fn = make_loss_fn(cfg, mesh, n_microbatches=2)
+    sharded = shard_params(params, cfg, mesh)
+    data_sharding = NamedSharding(mesh, P("dp", "sp"))
+    tok_s = jax.device_put(tokens, data_sharding)
+    lab_s = jax.device_put(labels, data_sharding)
+    loss = float(jax.jit(loss_fn)(sharded, tok_s, lab_s))
+    expected = float(dense_reference_loss(cfg, params, tokens, labels))
+    assert loss == pytest.approx(expected, rel=1e-4)
+
+    grads = jax.jit(jax.grad(loss_fn))(sharded, tok_s, lab_s)
+    ref_grads = jax.grad(
+        lambda p: dense_reference_loss(cfg, p, tokens, labels))(params)
+    for key in ("embed", "wq", "wkv", "wo", "head"):
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(grads[key])),
+            np.asarray(ref_grads[key]), rtol=5e-3, atol=1e-5,
+            err_msg=f"gqa/rope grad mismatch for {key} with {sizes}")
+
+
 def test_sliding_window_matches_dense():
     # SWA through the sharded stack: the dense oracle gets the same
     # window mask; the sharded loss must match, and must differ from

@@ -48,6 +48,12 @@ def main(argv=None):
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--strategy", default="ring",
                    choices=["ring", "ulysses", "auto"])
+    p.add_argument("--n-kv-heads", type=int, default=None,
+                   help="grouped-query attention: KV heads < --n-heads")
+    p.add_argument("--rope", action="store_true",
+                   help="rotary positions instead of the learned table")
+    p.add_argument("--window", type=int, default=None,
+                   help="sliding-window attention width")
     p.add_argument("--zero", action="store_true",
                    help="ZeRO-1 over the dp axis: moments partitioned on "
                         "top of the params' sharding (pure sharding "
@@ -87,14 +93,17 @@ def main(argv=None):
         vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
         d_head=args.d_model // args.n_heads, d_ff=4 * args.d_model,
         n_layers=args.n_layers, max_seq=args.seq_len, dtype=jnp.bfloat16,
-        sp_strategy=args.strategy, remat=args.remat)
+        sp_strategy=args.strategy, remat=args.remat,
+        n_kv_heads=args.n_kv_heads, rope=args.rope,
+        attention_window=args.window)
     params = init_params(cfg, jax.random.PRNGKey(0), n_stages=1)
     n_params = sum(int(np.prod(v.shape)) for v in params.values())
     # The 6N estimate counts matmul params only: the embedding table and
     # learned positions are gathers/adds, not matmuls (Kaplan
     # convention). The untied output head IS a matmul and stays in.
     n_matmul_params = n_params - sum(
-        int(np.prod(params[k].shape)) for k in ("embed", "pos"))
+        int(np.prod(params[k].shape)) for k in ("embed", "pos")
+        if k in params)  # no "pos" table under RoPE
 
     sharded = shard_params(params, cfg, mesh)
     del params

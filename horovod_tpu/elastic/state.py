@@ -245,10 +245,17 @@ class JaxState(ObjectState):
 
     def on_reset(self):
         # Runs after _reinitialize(): the mesh now reflects the NEW
-        # world. Place the last committed snapshot BEFORE the user's
-        # reset callbacks run — they are documented to rebuild steps and
-        # layouts from ``state.tree``.
-        self._replace_from_snapshot()
+        # world. Re-place from the committed snapshot ONLY when
+        # placement was deferred (restore() could not place on the dead
+        # mesh) — a live tree survives a membership change untouched:
+        # its leaves are locally-readable (save() enforces that), the
+        # following sync() re-places them on the new mesh, and
+        # overwriting it here would silently roll live progress back to
+        # the last commit. Placement happens BEFORE the user's reset
+        # callbacks, which are documented to rebuild steps from
+        # ``state.tree``.
+        if self.tree is None:
+            self._replace_from_snapshot()
         super().on_reset()
 
     def sync(self):

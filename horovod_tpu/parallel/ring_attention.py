@@ -51,6 +51,16 @@ def _block_attend(q, k, v, m, l, o, mask):
     return m_new, l_new, o_new
 
 
+def _expand_kv(k, v, g):
+    """Expand GQA K/V from Hkv to H = g * Hkv query heads (consecutive
+    repeat: query head j reads KV head j // g). The backward adjoint is
+    the matching group-sum, dk.reshape(B, T, Hkv, g, D).sum(3) — keep
+    the two in lockstep."""
+    if g <= 1:
+        return k, v
+    return jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2)
+
+
 def _ring_fwd_pass(q, k, v, seg, axis_name: str, causal: bool,
                    window=None):
     """The forward ring: flash block kernel per rotating K/V block +
@@ -78,8 +88,7 @@ def _ring_fwd_pass(q, k, v, seg, axis_name: str, causal: bool,
         from ..ops.pallas_attention import flash_attention_block
 
         k_blk = (my - step) % sp
-        k_full = jnp.repeat(k_cur, g, axis=2) if g > 1 else k_cur
-        v_full = jnp.repeat(v_cur, g, axis=2) if g > 1 else v_cur
+        k_full, v_full = _expand_kv(k_cur, v_cur, g)
         acc_b, m_b, l_b = flash_attention_block(
             q, k_full, v_full, q_off=my * Tq,
             k_off=k_blk * k_cur.shape[1],
@@ -147,8 +156,7 @@ def _ring_vjp_bwd(axis_name, causal, window, res, do):
     def body(carry, step):
         dq, dk, dv, k_cur, v_cur, kseg_cur = carry
         k_blk = (my - step) % sp
-        k_full = jnp.repeat(k_cur, g, axis=2) if g > 1 else k_cur
-        v_full = jnp.repeat(v_cur, g, axis=2) if g > 1 else v_cur
+        k_full, v_full = _expand_kv(k_cur, v_cur, g)
         dq_b, dk_b, dv_b = flash_attention_block_grads(
             q, k_full, v_full, do, lse, delta,
             q_off=my * Tq, k_off=k_blk * Tk, causal=causal,
@@ -206,10 +214,7 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
     if sp == 1:
         from ..ops.pallas_attention import flash_attention
 
-        g1 = q.shape[2] // k.shape[2]
-        if g1 > 1:
-            k = jnp.repeat(k, g1, axis=2)
-            v = jnp.repeat(v, g1, axis=2)
+        k, v = _expand_kv(k, v, q.shape[2] // k.shape[2])
         return flash_attention(q, k, v, causal=causal,
                                q_segment_ids=segment_ids,
                                k_segment_ids=segment_ids, window=window)

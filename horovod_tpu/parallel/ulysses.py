@@ -67,10 +67,10 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
 
     heads = q.shape[2]
     g = heads // k.shape[2]  # GQA group size (1 = plain multi-head)
+    from .ring_attention import _expand_kv
+
     if sp == 1:
-        if g > 1:
-            k = jnp.repeat(k, g, axis=2)
-            v = jnp.repeat(v, g, axis=2)
+        k, v = _expand_kv(k, v, g)
         return flash_attention(q, k, v, causal=causal,
                                q_segment_ids=segment_ids,
                                k_segment_ids=segment_ids, window=window)
@@ -97,10 +97,7 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
     # GQA K/V cross the fabric at their reduced width; the contiguous
     # head split means shard i's query heads use exactly shard i's KV
     # heads, so the post-exchange expansion is purely local.
-    kf, vf = seq_to_heads(k), seq_to_heads(v)
-    if g > 1:
-        kf = jnp.repeat(kf, g, axis=2)
-        vf = jnp.repeat(vf, g, axis=2)
+    kf, vf = _expand_kv(seq_to_heads(k), seq_to_heads(v), g)
     o = flash_attention(seq_to_heads(q), kf, vf,
                         causal=causal, q_segment_ids=full_seg,
                         k_segment_ids=full_seg, window=window)
@@ -126,7 +123,10 @@ def context_parallel_attention(q, k, v, axis_name: str = "sp",
 
     if strategy == "auto":
         sp = lax.axis_size(axis_name)
-        strategy = "ulysses" if q.shape[2] % sp == 0 else "ring"
+        # Both query AND (GQA-reduced) KV heads must divide the axis for
+        # ulysses' head split; otherwise fall back to ring as documented.
+        strategy = ("ulysses" if q.shape[2] % sp == 0
+                    and k.shape[2] % sp == 0 else "ring")
     if strategy == "ulysses":
         return ulysses_attention(q, k, v, axis_name=axis_name,
                                  causal=causal, segment_ids=segment_ids,

@@ -137,6 +137,26 @@ def test_save_rejects_cross_process_sharded_leaves(hvd):
         state.save()
 
 
+def test_on_reset_preserves_live_tree(hvd):
+    # A membership change (HostsUpdatedInterrupt path: no restore())
+    # must NOT roll a live tree back to the last commit — on_reset only
+    # places from the snapshot when placement was deferred.
+    state = JaxState(_tree(), bcast_object=_bcast_stub, batch=0)
+    state.commit()
+    state.tree = jax.tree_util.tree_map(lambda x: x + 3.0, state.tree)
+    state.batch = 4
+    state.on_reset()  # simulated re-init after a host joined
+    np.testing.assert_array_equal(np.asarray(state.tree["w"]),
+                                  np.arange(8.0) + 3.0)
+    assert state.batch == 4
+    # The following sync commits the live pair on the new mesh.
+    state.sync()
+    state.tree = jax.tree_util.tree_map(lambda x: x * 0.0, state.tree)
+    state.restore()
+    np.testing.assert_array_equal(np.asarray(state.tree["w"]),
+                                  np.arange(8.0) + 3.0)
+
+
 def test_custom_placement(hvd):
     calls = []
 

@@ -299,6 +299,27 @@ class TestGQA:
                                         np.repeat(v, g, axis=2))
         np.testing.assert_allclose(out, expected, rtol=2e-4, atol=2e-5)
 
+    def test_auto_falls_back_to_ring_for_indivisible_kv(self):
+        # H=4 divides sp=4 but Hkv=2 doesn't: auto must pick ring (the
+        # documented fallback), not crash in ulysses' KV split.
+        B, T, H, Hkv, D = 1, 16, 4, 2, 8
+        rng = np.random.RandomState(15)
+        q = rng.randn(B, T, H, D).astype(np.float32)
+        k = rng.randn(B, T, Hkv, D).astype(np.float32)
+        v = rng.randn(B, T, Hkv, D).astype(np.float32)
+        mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+        fn = jax.jit(jax.shard_map(
+            lambda q, k, v: context_parallel_attention(q, k, v, "sp",
+                                                       strategy="auto"),
+            mesh=mesh, in_specs=P(None, "sp"), out_specs=P(None, "sp"),
+            check_vma=False))
+        txt = fn.lower(q, k, v).as_text().lower().replace("-", "_")
+        assert "collective_permute" in txt and "all_to_all" not in txt
+        out = np.asarray(fn(q, k, v))
+        expected = _reference_attention(q, np.repeat(k, 2, axis=2),
+                                        np.repeat(v, 2, axis=2))
+        np.testing.assert_allclose(out, expected, rtol=2e-4, atol=2e-5)
+
     def test_grads_match_expanded(self):
         # The ring's reduced-width dK/dV accumulation (group-sum) must
         # equal autodiff through explicit expansion.

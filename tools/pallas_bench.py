@@ -30,18 +30,18 @@ def _make_qkv(T, batch, heads, dim):
     return mk(), mk(), mk()
 
 
-def _make_fns(use_pallas, causal):
+def _make_fns(use_pallas, causal, window=None):
     import jax
     import jax.numpy as jnp
 
     from horovod_tpu.ops.pallas_attention import flash_attention
 
     fwd = jax.jit(lambda q, k, v: flash_attention(
-        q, k, v, causal=causal, use_pallas=use_pallas))
+        q, k, v, causal=causal, use_pallas=use_pallas, window=window))
 
     def loss(q, k, v):
         return flash_attention(
-            q, k, v, causal=causal, use_pallas=use_pallas
+            q, k, v, causal=causal, use_pallas=use_pallas, window=window
         ).astype(jnp.float32).sum()
 
     bwd = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
@@ -60,7 +60,8 @@ def _clock(fn, iters, *args):
     return (time.perf_counter() - t0) / iters * 1e3  # ms
 
 
-def _build_xla_cache(T, iters, batch, heads, dim, causal=True):
+def _build_xla_cache(T, iters, batch, heads, dim, causal=True,
+                     window=None):
     """Run the block-size-invariant XLA baseline once: oracle outputs and
     grads for the numerics check plus fwd/bwd timings. Built separately
     from :func:`bench_one` so a Pallas failure (VMEM overflow on one
@@ -68,7 +69,7 @@ def _build_xla_cache(T, iters, batch, heads, dim, causal=True):
     import numpy as np
 
     q, k, v = _make_qkv(T, batch, heads, dim)
-    x_fwd, x_bwd = _make_fns(False, causal)
+    x_fwd, x_bwd = _make_fns(False, causal, window)
     return {
         "out": np.asarray(x_fwd(q, k, v), np.float32),
         "grads": [np.asarray(g, np.float32) for g in x_bwd(q, k, v)],
@@ -77,7 +78,8 @@ def _build_xla_cache(T, iters, batch, heads, dim, causal=True):
     }
 
 
-def bench_one(T, iters, batch, heads, dim, causal=True, xla_cache=None):
+def bench_one(T, iters, batch, heads, dim, causal=True, xla_cache=None,
+              window=None):
     """Mosaic vs XLA at the current BLOCK_Q/BLOCK_K. ``xla_cache`` — a
     dict from :func:`_build_xla_cache` — skips re-running the
     block-size-invariant XLA baseline (timings AND the numerics-oracle
@@ -85,10 +87,11 @@ def bench_one(T, iters, batch, heads, dim, causal=True, xla_cache=None):
     import numpy as np
 
     q, k, v = _make_qkv(T, batch, heads, dim)
-    p_fwd, p_bwd = _make_fns(True, causal)
+    p_fwd, p_bwd = _make_fns(True, causal, window)
 
     if xla_cache is None:
-        xla_cache = _build_xla_cache(T, iters, batch, heads, dim, causal)
+        xla_cache = _build_xla_cache(T, iters, batch, heads, dim, causal,
+                                     window)
 
     # Numerics: Mosaic vs the XLA oracle on the SAME device.
     po = np.asarray(p_fwd(q, k, v), np.float32)
@@ -104,7 +107,7 @@ def bench_one(T, iters, batch, heads, dim, causal=True, xla_cache=None):
         x_ms = xla_cache["ms"][phase]
         rows.append({
             "seq_len": T, "phase": phase, "batch": batch, "heads": heads,
-            "head_dim": dim, "causal": causal,
+            "head_dim": dim, "causal": causal, "window": window,
             "pallas_ms": round(p_ms, 3), "xla_ms": round(x_ms, 3),
             "speedup": round(x_ms / p_ms, 2),
             "maxerr_vs_xla": round(
@@ -151,6 +154,9 @@ def main(argv=None):
     p.add_argument("--batch", type=int, default=4)
     p.add_argument("--heads", type=int, default=8)
     p.add_argument("--dim", type=int, default=128)
+    p.add_argument("--window", type=int, default=None,
+                   help="sliding-window width: measures the whole-tile "
+                        "culling speedup vs the XLA masked path")
     p.add_argument("--sweep-blocks", action="store_true",
                    help="sweep (BLOCK_Q, BLOCK_K) tilings per seq len")
     args = p.parse_args(argv)
@@ -164,7 +170,7 @@ def main(argv=None):
             sweep_blocks(T, args.iters, args.batch, args.heads, args.dim)
         else:
             rows, _ = bench_one(T, args.iters, args.batch, args.heads,
-                                args.dim)
+                                args.dim, window=args.window)
             for row in rows:
                 print(json.dumps(row))
                 sys.stdout.flush()

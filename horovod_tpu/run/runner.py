@@ -61,20 +61,38 @@ def check_build(verbose: bool = False) -> str:
         xla_ok = False
     platform = None
     if verbose and xla_ok:
-        # Backend init can hang indefinitely on a wedged TPU tunnel
-        # (bench.py documents this); probe in a bounded subprocess, the
-        # same recipe as bench._probe_backend.
+        # Backend init can hang indefinitely on a wedged TPU tunnel, and
+        # enumeration alone answers even while all compute wedges
+        # (docs/troubleshooting.md) — so this is a *compute* probe like
+        # bench._probe_backend: enumerate (flushed) then run a fenced
+        # jitted matmul, in a bounded subprocess. Partial output on
+        # timeout tells the two failure modes apart.
         import subprocess
 
+        code = ("import jax, jax.numpy as jnp; "
+                "print('ENUM=' + jax.default_backend(), flush=True); "
+                "x = jnp.ones((128, 128), jnp.bfloat16); "
+                "v = float(jax.jit(lambda a: (a @ a).sum())(x)); "
+                "assert v == v; "
+                "print('COMPUTE=' + jax.default_backend())")
         try:
-            r = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; print(jax.default_backend())"],
-                capture_output=True, text=True, timeout=60)
-            platform = (r.stdout.strip().splitlines() or ["unknown"])[-1] \
-                if r.returncode == 0 else "unreachable"
-        except subprocess.TimeoutExpired:
-            platform = "unreachable (backend init timed out)"
+            r = subprocess.run([sys.executable, "-c", code],
+                               capture_output=True, text=True, timeout=60)
+            out = r.stdout or ""
+            if r.returncode == 0 and "COMPUTE=" in out:
+                platform = out.rsplit("COMPUTE=", 1)[1].strip()
+            else:
+                platform = "unreachable"
+        except subprocess.TimeoutExpired as e:
+            out = e.stdout or ""
+            if isinstance(out, bytes):
+                out = out.decode(errors="replace")
+            if "ENUM=" in out:
+                platform = ("%s enumerated, but compute WEDGED (tunnel "
+                            "in the known mid-compute wedge)"
+                            % out.rsplit("ENUM=", 1)[1].strip())
+            else:
+                platform = "unreachable (backend init timed out)"
 
     lines = [
         f"horovod_tpu v{__version__}:",

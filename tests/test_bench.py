@@ -56,24 +56,38 @@ def test_transformer_bench_protocol():
     assert parsed["loss"] > 0
 
 
-def test_bench_supervisor_probe_and_fallback(monkeypatch, capsys):
-    """Dead accelerator: the supervisor must retry with progress lines,
-    then produce a labeled CPU-fallback JSON line (the round-2 failure
-    mode was giving up too early)."""
+def _load_bench():
     spec = importlib.util.spec_from_file_location(
         "bench", os.path.join(REPO, "bench.py"))
     bench = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bench)
+    return bench
+
+
+def test_bench_supervisor_probe_and_fallback(monkeypatch, capsys,
+                                             tmp_path):
+    """Dead accelerator: the supervisor must compute-probe exactly ONCE
+    and fall back immediately (round-4 verdict: the 4x150s retry ladder
+    burned ~10 min on a wedge the first probe already proved), producing
+    a labeled CPU-fallback JSON line that embeds the freshest on-chip
+    capture."""
+    bench = _load_bench()
 
     bench.PROBE_TIMEOUT_S = 1
-    bench.PROBE_ATTEMPTS = 2
-    bench.PROBE_RETRY_SLEEP_S = 0
     bench.CPU_FALLBACK_TIMEOUT_S = 300
+    # Controlled capture fixture: the live docs/probes/ contents must not
+    # decide this test's outcome.
+    (tmp_path / "bench_tpu_20260731T005944.json").write_text(json.dumps(
+        {"metric": "resnet50_images_per_sec_per_chip", "value": 1994.04,
+         "unit": "images/sec/chip", "platform": "tpu", "mfu": 0.249}))
+    monkeypatch.setattr(bench, "PROBES_DIR", str(tmp_path))
 
     real_run = subprocess.run
+    probe_calls = []
 
     def fake_run(cmd, **kw):
         if isinstance(cmd, list) and len(cmd) == 3 and cmd[1] == "-c":
+            probe_calls.append(cmd)
             raise subprocess.TimeoutExpired(cmd, kw.get("timeout"))
         return real_run(cmd, **kw)
 
@@ -82,8 +96,8 @@ def test_bench_supervisor_probe_and_fallback(monkeypatch, capsys):
                           "--image-size", "64"])
     out, err = capsys.readouterr()
     assert rc == 0
-    assert "probing accelerator backend, attempt 1/2" in err
-    assert "attempt 2/2" in err
+    assert "compute-probing accelerator backend" in err
+    assert len(probe_calls) == 1, "fast-fail contract: exactly one probe"
     parsed = json.loads(
         [ln for ln in out.splitlines() if ln.startswith("{")][-1])
     assert parsed["platform"] == "cpu-fallback"
@@ -94,3 +108,41 @@ def test_bench_supervisor_probe_and_fallback(monkeypatch, capsys):
     assert parsed["comparable"] is False
     assert parsed["steps_per_sec"] > 0
     assert parsed["steps_per_sec_ci95"] >= 0
+    # Freshest-evidence contract (round-4 verdict): the fallback embeds
+    # the newest self-captured on-chip artifact from docs/probes/.
+    assert "last_on_chip" in parsed
+    assert parsed["last_on_chip"]["platform"] == "tpu"
+    assert "self-captured" in parsed["last_on_chip"]["provenance"]
+    assert parsed["last_on_chip"]["captured_at_utc"]
+
+
+def test_bench_probe_is_compute_not_enumeration():
+    """The probe code must jit-execute and fence (scalar fetch), not just
+    enumerate devices — enumeration succeeds while a wedged tunnel hangs
+    all compute (docs/troubleshooting.md)."""
+    bench = _load_bench()
+    import inspect
+    src = inspect.getsource(bench._probe_backend)
+    assert "jax.jit" in src and "float(" in src
+
+
+def test_bench_capture_roundtrip(tmp_path, monkeypatch):
+    """_save_capture writes a timestamped artifact that _latest_capture
+    finds, annotates, and prefers over older ones."""
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "PROBES_DIR", str(tmp_path))
+
+    old = {"metric": "resnet50_images_per_sec_per_chip", "value": 100.0,
+           "platform": "tpu"}
+    (tmp_path / "bench_tpu_20250101T000000.json").write_text(
+        json.dumps(old))
+    new = {"metric": "resnet50_images_per_sec_per_chip", "value": 2000.0,
+           "platform": "tpu", "mfu": 0.3}
+    bench._save_capture(dict(new))
+
+    got = bench._latest_capture()
+    assert got["value"] == 2000.0
+    assert got["mfu"] == 0.3
+    assert "self-captured" in got["provenance"]
+    # Stamp comes from the filename, so it survives artifact copies.
+    assert got["captured_at_utc"] > "20250101T000000"

@@ -21,27 +21,36 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "docs", "probes")
 
 
+_BENCH = None
+
+
+def _bench_module():
+    """Load repo-root bench.py once (tools/ is not a package sibling)."""
+    global _BENCH
+    if _BENCH is None:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "bench", os.path.join(REPO, "bench.py"))
+        _BENCH = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(_BENCH)
+    return _BENCH
+
+
 def probe(timeout=200):
     """Compute probe: enumeration alone is not enough — the tunnel has a
     failure mode where `jax.devices()` answers in seconds but any actual
     compile/execute wedges forever (observed 2026-07-31: bench32 and
     pallas each burned a full 900 s phase timeout after a 6 s
     enumeration). Only a fenced jitted matmul proves the window is real.
-    Returns 'ENUM ... / COMPUTE ...' on success, None otherwise."""
-    code = ("import jax, jax.numpy as jnp; d = jax.devices()[0]; "
-            "print('ENUM', d.platform, getattr(d, 'device_kind', ''), "
-            "flush=True); "
-            "x = jnp.ones((512, 512), jnp.bfloat16); "
-            "y = jax.jit(lambda a: (a @ a).sum())(x); "
-            "print('COMPUTE', float(y), flush=True)")
-    try:
-        r = subprocess.run([sys.executable, "-c", code],
-                           capture_output=True, text=True, timeout=timeout)
-    except subprocess.TimeoutExpired:
-        return None
-    out = (r.stdout or "").strip()
-    if "COMPUTE" in out and out.startswith("ENUM tpu"):
-        return " / ".join(out.splitlines())
+
+    The probe itself is shared with bench.py (`_probe_backend`) so the
+    two tools can never drift on what "window open" means; this wrapper
+    additionally requires the platform to be TPU (a CPU backend is a
+    healthy answer for bench.py's fallback, but no harvest window).
+    Returns 'tpu <kind>' on success, None otherwise."""
+    probed = _bench_module()._probe_backend(timeout)
+    if probed and probed[0] == "tpu":
+        return " ".join(filter(None, probed))
     return None
 
 
@@ -95,9 +104,9 @@ def main(argv=None):
     # Value order: headline number first, then the MFU-attribution trace,
     # then the A/B points, then the kernel microbenches — a window that
     # closes mid-run should have captured the most decisive artifacts.
-    # Bench phase timeouts must cover bench.py's own worst case (probe
-    # retries ~690 s + worker 1200 s ≈ 1900 s) — a shorter phase timeout
-    # kills a legitimately slow-but-recovering run mid-worker.
+    # Bench phase timeouts must cover bench.py's own worst case (single
+    # 150 s probe + worker 1200 s + startup slack) — a shorter phase
+    # timeout kills a legitimately slow-but-recovering run mid-worker.
     plan = [
         ("bench32", [py, "bench.py", nf], 2000),
         ("profile", [py, "tools/profile_resnet.py"], 700),

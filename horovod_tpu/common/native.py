@@ -183,6 +183,8 @@ def _bind_prototypes(lib):
     lib.hvd_drain_negotiation.argtypes = [ctypes.POINTER(ctypes.c_char),
                                           ctypes.c_int]
     lib.hvd_get_fusion_threshold.restype = ctypes.c_longlong
+    lib.hvd_ring_bytes_sent.restype = ctypes.c_longlong
+    lib.hvd_ring_bytes_sent.argtypes = []
     _lib = lib
     return _lib
 
@@ -436,6 +438,11 @@ class NativeCore:
     def cache_hits(self) -> int:
         """Requests this rank sent as 4-byte cache ids (fast path)."""
         return int(self.lib.hvd_cache_hits())
+
+    def ring_bytes_sent(self) -> int:
+        """Payload bytes this rank has sent on the host data plane (ring
+        + VHDD peer links). Test hook for traffic-complexity assertions."""
+        return int(self.lib.hvd_ring_bytes_sent())
 
     def set_record_negotiation(self, enabled: bool) -> None:
         """Record per-rank submission ticks on the coordinator (reference

@@ -170,8 +170,17 @@ void ExecuteHostResponse(const Response& resp,
         off += n;
       }
       if (resp.reduce_op == ReduceOp::ADASUM) {
-        st = s->ring->AdasumAllreduce(fusion.data(), fusion.data(), total,
-                                      resp.dtype);
+        // Per-tensor boundaries ride into the fused Adasum: the
+        // combination's dot/norm coefficients are computed per tensor,
+        // so fusion never changes the math (reference tensor_counts
+        // contract, adasum_gpu_operations.cc:208-232).
+        std::vector<int64_t> tensor_counts;
+        tensor_counts.reserve(resp.shapes.size());
+        for (const auto& sh : resp.shapes) {
+          tensor_counts.push_back(sh.num_elements());
+        }
+        st = s->ring->AdasumAllreduce(fusion.data(), fusion.data(),
+                                      tensor_counts, resp.dtype);
       } else {
         st = s->ring->Allreduce(fusion.data(), fusion.data(), total,
                                 resp.dtype, resp.reduce_op, resp.prescale,
@@ -764,6 +773,14 @@ long long hvd_join() {
 }
 
 int hvd_last_joined() { return hvd::g()->last_joined.load(); }
+
+// Payload bytes this rank has sent on the host data plane (ring + peer
+// links). Test hook for wire-traffic complexity assertions (e.g. VHDD
+// Adasum must be O(count) per rank, not O(count * size)).
+long long hvd_ring_bytes_sent() {
+  auto* s = hvd::g();
+  return s->ring ? s->ring->bytes_sent() : 0;
+}
 
 // Poll: 0 pending, 1 done-ok, -1 done-error.
 int hvd_test(long long handle, char* err, int errlen) {

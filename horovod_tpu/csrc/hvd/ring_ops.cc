@@ -1,7 +1,9 @@
 #include "ring_ops.h"
 
 #include <algorithm>
+#include <cfloat>
 #include <cmath>
+#include <cstdlib>
 #include <cstring>
 #include <thread>
 
@@ -166,9 +168,11 @@ void Ring::SenderLoop() {
     if (sender_exit_) return;
     const void* buf = send_buf_;
     size_t n = send_bytes_;
+    Socket* sock = send_sock_;
     lk.unlock();
     std::string payload(static_cast<const char*>(buf), n);
-    bool ok = next_.SendFrame(payload);
+    bool ok = sock->SendFrame(payload);
+    if (ok) bytes_sent_.fetch_add(static_cast<long long>(n));
     lk.lock();
     send_buf_ = nullptr;
     send_done_ = true;
@@ -177,23 +181,39 @@ void Ring::SenderLoop() {
   }
 }
 
-bool Ring::SendRecvStep(const void* sbuf, size_t sbytes, void* rbuf,
-                        size_t rbytes) {
+bool Ring::CountedSendFrame(Socket& sock, const std::string& payload) {
+  bool ok = sock.SendFrame(payload);
+  if (ok) bytes_sent_.fetch_add(static_cast<long long>(payload.size()));
+  return ok;
+}
+
+bool Ring::SendRecvDuplex(Socket* send_sock, const void* sbuf, size_t sbytes,
+                          Socket* recv_sock, void* rbuf, size_t rbytes) {
+  static const char kEmpty = 0;
+  // A null sbuf (legal for 0-byte fragments) must not look like "no
+  // pending send" to the sender loop's wakeup predicate.
+  if (sbuf == nullptr) sbuf = &kEmpty;
   {
     std::lock_guard<std::mutex> lk(send_mu_);
+    send_sock_ = send_sock;
     send_buf_ = sbuf;
     send_bytes_ = sbytes;
     send_done_ = false;
   }
   send_cv_.notify_all();
   std::string rframe;
-  bool recv_ok = prev_.RecvFrame(&rframe) && rframe.size() == rbytes;
+  bool recv_ok = recv_sock->RecvFrame(&rframe) && rframe.size() == rbytes;
   {
     std::unique_lock<std::mutex> lk(send_mu_);
     send_cv_.wait(lk, [&] { return send_done_; });
-    if (recv_ok) std::memcpy(rbuf, rframe.data(), rbytes);
+    if (recv_ok && rbytes > 0) std::memcpy(rbuf, rframe.data(), rbytes);
     return send_ok_ && recv_ok;
   }
+}
+
+bool Ring::SendRecvStep(const void* sbuf, size_t sbytes, void* rbuf,
+                        size_t rbytes) {
+  return SendRecvDuplex(&next_, sbuf, sbytes, &prev_, rbuf, rbytes);
 }
 
 Ring::~Ring() {
@@ -212,6 +232,8 @@ Status Ring::Connect(int rank, const std::vector<std::pair<std::string, int>>&
                      Listener* listener) {
   rank_ = rank;
   size_ = static_cast<int>(endpoints.size());
+  endpoints_ = endpoints;
+  listener_ = listener;
   if (size_ == 1) return Status::OK();
   int next_rank = (rank_ + 1) % size_;
   // Even ranks connect first then accept; odd ranks accept first — avoids
@@ -220,15 +242,23 @@ Status Ring::Connect(int rank, const std::vector<std::pair<std::string, int>>&
     next_ = Socket::Connect(endpoints[next_rank].first,
                             endpoints[next_rank].second, 120000);
     if (!next_.valid()) return false;
-    return next_.SendFrame(std::to_string(rank_));
+    return CountedSendFrame(next_, std::to_string(rank_));
   };
+  int prev_rank = (rank_ - 1 + size_) % size_;
   auto answer = [&]() -> bool {
-    // Accept until the peer introducing itself as prev arrives.
+    // Accept until the peer introducing itself as prev arrives; stash
+    // early VHDD peer dials instead of mistaking them for prev.
     for (int tries = 0; tries < 64; ++tries) {
       Socket s = listener->Accept(120000);
       if (!s.valid()) return false;
       std::string hello;
       if (!s.RecvFrame(&hello)) continue;
+      if (hello.rfind("vhdd ", 0) == 0) {
+        int pr = std::atoi(hello.c_str() + 5);
+        peers_[pr] = std::move(s);
+        continue;
+      }
+      if (std::atoi(hello.c_str()) != prev_rank) continue;
       prev_ = std::move(s);
       return true;
     }
@@ -329,7 +359,9 @@ Status Ring::Broadcast(void* data, int64_t count, DataType dtype, int root) {
   bool is_last = ((rank_ + 1) % size_) == root;
   if (rank_ == root) {
     std::string payload(static_cast<const char*>(data), nbytes);
-    if (!next_.SendFrame(payload)) return Status::Aborted("bcast send failed");
+    if (!CountedSendFrame(next_, payload)) {
+      return Status::Aborted("bcast send failed");
+    }
   } else {
     std::string frame;
     if (!prev_.RecvFrame(&frame) || frame.size() != nbytes) {
@@ -337,71 +369,279 @@ Status Ring::Broadcast(void* data, int64_t count, DataType dtype, int root) {
     }
     std::memcpy(data, frame.data(), nbytes);
     if (!is_last) {
-      if (!next_.SendFrame(frame)) return Status::Aborted("bcast fwd failed");
+      if (!CountedSendFrame(next_, frame)) {
+        return Status::Aborted("bcast fwd failed");
+      }
     }
   }
   return Status::OK();
 }
 
-Status Ring::AdasumAllreduce(void* data, void* output, int64_t count,
+Socket* Ring::PeerLink(int peer) {
+  auto it = peers_.find(peer);
+  if (it != peers_.end()) return &it->second;
+  if (peer < 0 || peer >= size_ || peer == rank_) return nullptr;
+  if (rank_ < peer) {
+    // Lower rank dials; deterministic on both sides, so no crossed dials.
+    Socket s = Socket::Connect(endpoints_[peer].first,
+                               endpoints_[peer].second, 120000);
+    if (!s.valid()) return nullptr;
+    if (!CountedSendFrame(s, "vhdd " + std::to_string(rank_)))
+      return nullptr;
+    peers_[peer] = std::move(s);
+  } else {
+    // Higher rank accepts. Dials from *other* lower peers can arrive
+    // first (ranks progress through VHDD levels at different speeds);
+    // stash them by rank instead of mis-assigning. Bounded like
+    // Connect's answer loop so garbage hellos can't spin forever.
+    for (int tries = 0;
+         peers_.find(peer) == peers_.end() && tries < 64; ++tries) {
+      if (listener_ == nullptr) return nullptr;
+      Socket s = listener_->Accept(120000);
+      if (!s.valid()) return nullptr;
+      std::string hello;
+      if (!s.RecvFrame(&hello)) continue;
+      if (hello.rfind("vhdd ", 0) != 0) continue;
+      int pr = std::atoi(hello.c_str() + 5);
+      peers_[pr] = std::move(s);
+    }
+    if (peers_.find(peer) == peers_.end()) return nullptr;
+  }
+  return &peers_[peer];
+}
+
+Status Ring::ScalarTreeAllreduce(std::vector<double>& vals, int span) {
+  // Fixed binomial tree over the `span`-rank block containing this rank
+  // (the role of the reference's reduction_comms, adasum_mpi.cc:29-69):
+  // reduce to the block root, broadcast the exact bytes back down — every
+  // rank ends with bitwise-identical scalars, so the coefficients applied
+  // to the distributed fragments agree everywhere.
+  size_t nbytes = vals.size() * sizeof(double);
+  int rb = rank_ & (span - 1);
+  for (int d = 1; d < span; d <<= 1) {
+    int low = rb & (2 * d - 1);
+    if (low == d) {
+      Socket* s = PeerLink(rank_ ^ d);
+      if (s == nullptr ||
+          !CountedSendFrame(*s, std::string(
+              reinterpret_cast<const char*>(vals.data()), nbytes))) {
+        return Status::Aborted("adasum scalar reduce send failed");
+      }
+      break;
+    }
+    if (low == 0) {
+      Socket* s = PeerLink(rank_ ^ d);
+      std::string frame;
+      if (s == nullptr || !s->RecvFrame(&frame) || frame.size() != nbytes) {
+        return Status::Aborted("adasum scalar reduce recv failed");
+      }
+      const double* other = reinterpret_cast<const double*>(frame.data());
+      for (size_t i = 0; i < vals.size(); ++i) vals[i] += other[i];
+    }
+  }
+  for (int d = span >> 1; d >= 1; d >>= 1) {
+    int low = rb & (2 * d - 1);
+    if (low == 0) {
+      Socket* s = PeerLink(rank_ ^ d);
+      if (s == nullptr ||
+          !CountedSendFrame(*s, std::string(
+              reinterpret_cast<const char*>(vals.data()), nbytes))) {
+        return Status::Aborted("adasum scalar bcast send failed");
+      }
+    } else if (low == d) {
+      Socket* s = PeerLink(rank_ ^ d);
+      std::string frame;
+      if (s == nullptr || !s->RecvFrame(&frame) || frame.size() != nbytes) {
+        return Status::Aborted("adasum scalar bcast recv failed");
+      }
+      std::memcpy(vals.data(), frame.data(), nbytes);
+    }
+  }
+  return Status::OK();
+}
+
+Status Ring::PairwiseCombine(float* a, const float* b,
+                             const std::vector<int64_t>& counts, int level,
+                             bool is_left) {
+  // Per-tensor dot/norms on the local fragments, reduced over the
+  // 2*level block so they cover the pair's FULL vectors, then the Adasum
+  // linear combination per tensor (reference
+  // FusedPairwiseReduceWithComm, adasum.h:338-398). Scalar slots are
+  // packed canonically as (dot, left-norm, right-norm) so both sides of
+  // the pair sum agreeing layouts.
+  // Zero-norm fallback threshold. The reference uses sqrt(DBL_MIN)
+  // (adasum.h:345); this repo standardizes on 1e-30 across both planes
+  // (ops/adasum.py _adasum_combine / adasum_reference) so host- and
+  // XLA-plane results agree in the degenerate-input regime too.
+  static const double kNormFloor = 1e-30;
+  size_t T = counts.size();
+  std::vector<double> scal(3 * T, 0.0);
+  int64_t off = 0;
+  for (size_t t = 0; t < T; ++t) {
+    double dot = 0, mine = 0, theirs = 0;
+    for (int64_t i = 0; i < counts[t]; ++i) {
+      double av = a[off + i], bv = b[off + i];
+      dot += av * bv;
+      mine += av * av;
+      theirs += bv * bv;
+    }
+    scal[3 * t] = dot;
+    scal[3 * t + 1] = is_left ? mine : theirs;
+    scal[3 * t + 2] = is_left ? theirs : mine;
+    off += counts[t];
+  }
+  Status s = ScalarTreeAllreduce(scal, 2 * level);
+  if (!s.ok()) return s;
+  off = 0;
+  for (size_t t = 0; t < T; ++t) {
+    double dot = scal[3 * t];
+    double anorm = is_left ? scal[3 * t + 1] : scal[3 * t + 2];
+    double bnorm = is_left ? scal[3 * t + 2] : scal[3 * t + 1];
+    double ac = anorm >= kNormFloor ? 1.0 - dot / anorm * 0.5 : 1.0;
+    double bc = bnorm >= kNormFloor ? 1.0 - dot / bnorm * 0.5 : 1.0;
+    for (int64_t i = 0; i < counts[t]; ++i) {
+      a[off + i] = static_cast<float>(ac * a[off + i] + bc * b[off + i]);
+    }
+    off += counts[t];
+  }
+  return Status::OK();
+}
+
+namespace {
+
+// Split `cur` per-tensor counts at element position `cut` (prefix
+// length): `prefix[i]` + `suffix[i]` == cur[i], prefix filled greedily in
+// tensor order (reference nghrCountVec bookkeeping, adasum.h:240-290).
+void SplitCounts(const std::vector<int64_t>& cur, int64_t cut,
+                 std::vector<int64_t>* prefix, std::vector<int64_t>* suffix) {
+  prefix->assign(cur.size(), 0);
+  suffix->assign(cur.size(), 0);
+  int64_t sofar = 0;
+  for (size_t i = 0; i < cur.size(); ++i) {
+    int64_t take = std::max<int64_t>(
+        0, std::min(cur[i], cut - sofar));
+    (*prefix)[i] = take;
+    (*suffix)[i] = cur[i] - take;
+    sofar += cur[i];
+  }
+}
+
+}  // namespace
+
+Status Ring::AdasumAllreduce(void* data, void* output,
+                             const std::vector<int64_t>& tensor_counts,
                              DataType dtype) {
-  // Allgather every rank's vector, then run the recursive pairwise Adasum
-  // tree locally — bitwise-identical results on all ranks, exact reference
-  // numerics with fp32/fp64 accumulation.
-  int es = DataTypeSize(dtype);
+  // True vector-halving distance-doubling (reference FusedAllreduce,
+  // adasum.h:194-336): at each doubling level exchange *halves* with
+  // rank^level, combine per tensor with block-reduced scalars, then
+  // distance-halving allgather back. Per-rank wire traffic is O(count)
+  // (count/2 + count/4 + ... down, the reverse up) versus the
+  // O(count*size) of an allgather-everything scheme. The working dtype on
+  // the wire is fp32 (the accumulation dtype), so 16-bit inputs ride at
+  // 2x their storage width — still O(count).
+  int64_t count = 0;
+  for (int64_t c : tensor_counts) count += c;
   if ((size_ & (size_ - 1)) != 0) {
     return Status::InvalidArgument(
         "Adasum requires a power-of-two world size");
   }
-  std::vector<char> all(static_cast<size_t>(size_) * count * es);
-  Status s = Allgather(data, all.data(), count, dtype);
-  if (!s.ok()) return s;
+  if (!(Is16BitFloat(dtype) || dtype == DataType::HVD_FLOAT32 ||
+        dtype == DataType::HVD_FLOAT64)) {
+    return Status::InvalidArgument("Adasum requires floating point data");
+  }
 
-  // promote all vectors to float
-  std::vector<std::vector<float>> vecs(size_);
-  for (int r = 0; r < size_; ++r) {
-    vecs[r].resize(count);
-    const char* src = all.data() + static_cast<size_t>(r) * count * es;
-    if (Is16BitFloat(dtype)) {
-      ToFloat(src, vecs[r].data(), count, dtype);
-    } else if (dtype == DataType::HVD_FLOAT32) {
-      std::memcpy(vecs[r].data(), src, count * 4);
-    } else if (dtype == DataType::HVD_FLOAT64) {
-      auto* p = reinterpret_cast<const double*>(src);
-      for (int64_t i = 0; i < count; ++i) vecs[r][i] =
-          static_cast<float>(p[i]);
-    } else {
-      return Status::InvalidArgument("Adasum requires floating point data");
-    }
-  }
-  int n = size_;
-  while (n > 1) {
-    for (int p = 0; p < n / 2; ++p) {
-      auto& a = vecs[2 * p];
-      auto& b = vecs[2 * p + 1];
-      double dot = 0, na = 0, nb = 0;
-      for (int64_t i = 0; i < count; ++i) {
-        dot += static_cast<double>(a[i]) * b[i];
-        na += static_cast<double>(a[i]) * a[i];
-        nb += static_cast<double>(b[i]) * b[i];
-      }
-      double ca = na <= 1e-30 ? 1.0 : 1.0 - dot / (2.0 * na);
-      double cb = nb <= 1e-30 ? 1.0 : 1.0 - dot / (2.0 * nb);
-      for (int64_t i = 0; i < count; ++i) {
-        a[i] = static_cast<float>(ca * a[i] + cb * b[i]);
-      }
-      if (p != 2 * p) vecs[p] = std::move(vecs[2 * p]);
-    }
-    n /= 2;
-  }
-  // write back
+  // Promote to the fp32 working buffer.
+  std::vector<float> work(count), recv(count);
   if (Is16BitFloat(dtype)) {
-    FromFloat(vecs[0].data(), output, count, dtype);
+    ToFloat(data, work.data(), count, dtype);
   } else if (dtype == DataType::HVD_FLOAT32) {
-    std::memcpy(output, vecs[0].data(), count * 4);
+    std::memcpy(work.data(), data, count * 4);
+  } else {
+    auto* p = static_cast<const double*>(data);
+    for (int64_t i = 0; i < count; ++i) work[i] = static_cast<float>(p[i]);
+  }
+
+  if (size_ > 1) {
+    float* grad = work.data();
+    float* rbuf = recv.data();
+    std::vector<int64_t> my_counts = tensor_counts;
+    int64_t my_count = count;
+    struct LevelInfo {
+      std::vector<int64_t> nghr_counts;
+      int64_t nghr_count;
+    };
+    std::vector<LevelInfo> hist;
+
+    for (int level = 1; level < size_; level <<= 1) {
+      Socket* peer = PeerLink(rank_ ^ level);
+      if (peer == nullptr) {
+        return Status::Aborted("adasum peer link failed at level " +
+                               std::to_string(level));
+      }
+      int64_t first_half = my_count >> 1;
+      int64_t second_half = my_count - first_half;
+      LevelInfo li;
+      std::vector<int64_t> kept;
+      int64_t send_off, nghr;
+      bool is_left = (rank_ & level) == 0;
+      if (is_left) {
+        // Keep the low (first) half; the partner takes the suffix.
+        nghr = second_half;
+        SplitCounts(my_counts, first_half, &kept, &li.nghr_counts);
+        my_count = first_half;
+        send_off = my_count;
+      } else {
+        // Keep the high half; the partner takes the prefix.
+        nghr = first_half;
+        SplitCounts(my_counts, first_half, &li.nghr_counts, &kept);
+        my_count = second_half;
+        send_off = 0;
+      }
+      my_counts = kept;
+      li.nghr_count = nghr;
+      // Full-duplex half-exchange: my outgoing half against the
+      // partner's fragment aligned with what I keep.
+      if (!SendRecvDuplex(peer, grad + send_off, nghr * 4, peer,
+                          rbuf + (is_left ? 0 : nghr), my_count * 4)) {
+        return Status::Aborted("adasum half-exchange failed");
+      }
+      if (!is_left) {
+        grad += nghr;
+        rbuf += nghr;
+      }
+      Status s = PairwiseCombine(grad, rbuf, my_counts, level, is_left);
+      if (!s.ok()) return s;
+      hist.push_back(std::move(li));
+    }
+
+    // Distance-halving allgather: undo each split in reverse, exchanging
+    // full fragments with the same partners.
+    for (int level = size_ >> 1; level >= 1; level >>= 1) {
+      LevelInfo li = std::move(hist.back());
+      hist.pop_back();
+      Socket* peer = PeerLink(rank_ ^ level);
+      bool is_left = (rank_ & level) == 0;
+      float* rdst = is_left ? grad + my_count : grad - li.nghr_count;
+      if (!SendRecvDuplex(peer, grad, my_count * 4, peer, rdst,
+                          li.nghr_count * 4)) {
+        return Status::Aborted("adasum allgather exchange failed");
+      }
+      if (!is_left) grad -= li.nghr_count;
+      my_count += li.nghr_count;
+      for (size_t i = 0; i < my_counts.size(); ++i) {
+        my_counts[i] += li.nghr_counts[i];
+      }
+    }
+  }
+
+  // Demote back to the caller's dtype.
+  if (Is16BitFloat(dtype)) {
+    FromFloat(work.data(), output, count, dtype);
+  } else if (dtype == DataType::HVD_FLOAT32) {
+    std::memcpy(output, work.data(), count * 4);
   } else {
     auto* p = static_cast<double*>(output);
-    for (int64_t i = 0; i < count; ++i) p[i] = vecs[0][i];
+    for (int64_t i = 0; i < count; ++i) p[i] = work[i];
   }
   return Status::OK();
 }

@@ -1,18 +1,24 @@
-// Native host-tensor collectives over a TCP ring.
+// Native host-tensor collectives over a TCP ring + pairwise peer links.
 //
 // This is the "Gloo role" of the reference (ops/gloo_operations.cc, CPU
 // collectives without MPI): bandwidth-optimal chunked ring allreduce
 // (reduce-scatter + allgather), ring allgather, and pipeline broadcast over
 // persistent neighbor sockets. 16-bit types accumulate in float32 (the
 // role of the reference's AVX fp16 paths, adasum.h:426-546). Adasum runs as
-// allgather + locally-replicated recursive pairwise combination — exact
-// reference numerics (adasum.h:194-336) with deterministic results on every
-// rank.
+// true vector-halving distance-doubling (VHDD) over lazily-established
+// direct peer links — reference numerics and O(count) per-rank wire
+// traffic (adasum.h:194-336 FusedAllreduce), with per-tensor dot/norm
+// boundaries inside fused buffers (adasum.h:338-398
+// FusedPairwiseReduceWithComm) and deterministic results on every rank
+// (scalar reductions run on a fixed binomial tree, so all ranks apply
+// bitwise-identical coefficients).
 
 #ifndef HVD_RING_OPS_H_
 #define HVD_RING_OPS_H_
 
+#include <atomic>
 #include <condition_variable>
+#include <map>
 #include <memory>
 #include <mutex>
 #include <string>
@@ -43,27 +49,63 @@ class Ring {
   Status Allgatherv(const void* data, void* output,
                     const std::vector<int64_t>& counts, DataType dtype);
   Status Broadcast(void* data, int64_t count, DataType dtype, int root);
-  Status AdasumAllreduce(void* data, void* output, int64_t count,
+  // Adasum over a fused buffer with per-tensor boundaries:
+  // ``tensor_counts[i]`` elements belong to tensor i, and the Adasum
+  // combination (dot/norm coefficients) is applied per tensor — fusing
+  // never changes the math (reference adasum_gpu_operations.cc:208-232
+  // tensor_counts contract).
+  Status AdasumAllreduce(void* data, void* output,
+                         const std::vector<int64_t>& tensor_counts,
                          DataType dtype);
 
   int rank() const { return rank_; }
   int size() const { return size_; }
+  // Total payload bytes this rank has put on the wire (frames + scalar
+  // messages). Exposed so tests can assert traffic complexity (VHDD must
+  // be O(count) per rank, not O(count * size)).
+  long long bytes_sent() const { return bytes_sent_.load(); }
 
  private:
-  // Full-duplex step: send to next while receiving from prev, using one
-  // persistent sender thread (no per-step thread spawn on the hot path).
+  // Full-duplex step: send on `sock` while receiving from `recv_sock`,
+  // using one persistent sender thread (no per-step thread spawn on the
+  // hot path). Ring steps pass (next_, prev_); VHDD passes the same peer
+  // socket for both directions.
+  bool SendRecvDuplex(Socket* send_sock, const void* sbuf, size_t sbytes,
+                      Socket* recv_sock, void* rbuf, size_t rbytes);
   bool SendRecvStep(const void* sbuf, size_t sbytes, void* rbuf,
                     size_t rbytes);
   void SenderLoop();
+  bool CountedSendFrame(Socket& sock, const std::string& payload);
+
+  // Direct link to an arbitrary peer, established lazily on first use
+  // (lower rank dials, higher rank accepts with hello routing — accepts
+  // arriving out of order are stashed by rank). nullptr on failure.
+  Socket* PeerLink(int peer);
+
+  // Per-tensor pairwise Adasum combine: a (mine) and b (partner's) are
+  // fragments laid out per `counts`; scalars are reduced over the
+  // 2*level-rank block on a fixed binomial tree so every rank applies
+  // identical coefficients. `is_left` = this rank kept the low half.
+  Status PairwiseCombine(float* a, const float* b,
+                         const std::vector<int64_t>& counts, int level,
+                         bool is_left);
+  Status ScalarTreeAllreduce(std::vector<double>& vals, int span);
 
   int rank_ = 0;
   int size_ = 1;
   Socket next_;
   Socket prev_;
 
+  std::vector<std::pair<std::string, int>> endpoints_;
+  Listener* listener_ = nullptr;
+  std::map<int, Socket> peers_;
+
+  std::atomic<long long> bytes_sent_{0};
+
   std::thread sender_;
   std::mutex send_mu_;
   std::condition_variable send_cv_;
+  Socket* send_sock_ = nullptr;     // socket for the pending send
   const void* send_buf_ = nullptr;  // pending send request (one at a time)
   size_t send_bytes_ = 0;
   bool send_done_ = true;

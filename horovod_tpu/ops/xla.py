@@ -123,7 +123,22 @@ def _grouped(tensors, reduce_fn):
 
 def grouped_allreduce(tensors, axis_name: str = AXIS_GLOBAL, op: int = ReduceOp.SUM,
                       prescale_factor: float = 1.0, postscale_factor: float = 1.0):
-    """Allreduce a list of tensors as one fused operation (see ``_grouped``)."""
+    """Allreduce a list of tensors as one fused operation (see ``_grouped``).
+
+    Adasum is NOT a per-element reduction: its dot/norm coefficients are
+    per tensor, so a fused Adasum group applies the combination per
+    tensor instead of on the concatenated buffer (reference
+    ``tensor_counts`` contract, ``adasum_gpu_operations.cc:208-232``) —
+    XLA still compiles the whole group into one program, so fusion's
+    launch-overhead win is preserved.
+    """
+    if op == ReduceOp.ADASUM:
+        from .adasum import grouped_adasum_allreduce
+
+        pre = [_apply_prescale(t, prescale_factor) for t in tensors]
+        return [_apply_postscale(t, postscale_factor)
+                for t in grouped_adasum_allreduce(pre,
+                                                  axis_name=axis_name)]
     return _grouped(
         tensors,
         lambda fused: allreduce(fused, axis_name=axis_name, op=op,
@@ -169,10 +184,18 @@ def grouped_hierarchical_allreduce(tensors, op: int = ReduceOp.SUM,
                                    postscale_factor: float = 1.0):
     """Fused hierarchical allreduce (dtype-concat fusion like
     ``grouped_allreduce``, ICI/DCN split like ``hierarchical_allreduce``).
-    Supports SUM/AVERAGE — the ops ``psum_scatter`` expresses."""
+    Supports SUM/AVERAGE (``psum_scatter``-expressible) and ADASUM — the
+    latter per tensor (Adasum coefficients are per-tensor; see
+    ``grouped_allreduce``) via ``hierarchical_adasum_allreduce``."""
+    if op == ReduceOp.ADASUM:
+        from .adasum import grouped_hierarchical_adasum_allreduce
+
+        pre = [_apply_prescale(t, prescale_factor) for t in tensors]
+        return [_apply_postscale(t, postscale_factor)
+                for t in grouped_hierarchical_adasum_allreduce(pre)]
     if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
         raise ValueError(
-            f"hierarchical allreduce supports SUM/AVERAGE, got op {op}")
+            f"hierarchical allreduce supports SUM/AVERAGE/ADASUM, got op {op}")
 
     def reduce_fn(fused):
         fused = _apply_prescale(fused, prescale_factor)

@@ -24,7 +24,7 @@ from jax import lax
 
 
 def spmd_pipeline(stage_fn: Callable, stage_params, microbatches,
-                  axis_name: str = "pp"):
+                  axis_name: str = "pp", collect_fn: Callable = None):
     """Run ``microbatches`` through the pipeline.
 
     stage_fn(params, x) -> y : applies ONE stage (same structure in/out).
@@ -35,18 +35,27 @@ def spmd_pipeline(stage_fn: Callable, stage_params, microbatches,
     ring with the activations). Stage-0 input layout; other stages
     ignore the values and receive via the ring.
 
-    Returns [M, ...] outputs as produced by the LAST stage (valid on every
-    member after the closing psum-broadcast).
+    collect_fn(y) selects the sub-pytree that is actually an OUTPUT;
+    defaults to the whole structure. Side data the stages merely pass
+    through (segment ids) still rides the per-tick ring carry — later
+    stages consume it — but is excluded from the per-tick output
+    collect and the closing psum-broadcast, saving a dynamic-update per
+    tick and collective bandwidth per leaf.
+
+    Returns ``collect_fn``-selected [M, ...] outputs as produced by the
+    LAST stage (valid on every member after the closing psum-broadcast).
     """
     tmap = jax.tree_util.tree_map
     S = lax.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     M = jax.tree_util.tree_leaves(microbatches)[0].shape[0]
     T = M + S - 1
+    if collect_fn is None:
+        collect_fn = lambda y: y  # noqa: E731
 
     fwd = [(i, (i + 1) % S) for i in range(S)]
     x0 = tmap(lambda m: jnp.zeros_like(m[0]), microbatches)
-    outbuf = tmap(jnp.zeros_like, microbatches)
+    outbuf = tmap(jnp.zeros_like, collect_fn(microbatches))
 
     def tick(carry, t):
         state, outbuf = carry
@@ -65,7 +74,7 @@ def spmd_pipeline(stage_fn: Callable, stage_params, microbatches,
             return lax.dynamic_update_index_in_dim(
                 ob, jnp.where(collect, yy, cur), out_idx, 0)
 
-        outbuf = tmap(collect_leaf, outbuf, y)
+        outbuf = tmap(collect_leaf, outbuf, collect_fn(y))
         state = tmap(lambda yy: lax.ppermute(yy, axis_name, fwd), y)
         return (state, outbuf), None
 

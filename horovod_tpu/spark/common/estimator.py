@@ -16,38 +16,134 @@ from typing import Any, Dict, List, Optional
 from .store import Store
 
 
-class EstimatorParams:
-    """Declared parameters (parity: the Param list in
-    ``common/estimator.py`` + ``params.py``)."""
+def _to_int(name, v):
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise TypeError(f"estimator param '{name}' must be an int, "
+                        f"got {type(v).__name__}")
+    if int(v) != v:
+        raise TypeError(f"estimator param '{name}' must be integral, "
+                        f"got {v}")
+    return int(v)
 
-    _PARAMS = [
-        "num_proc", "model", "backend", "store", "loss", "loss_constructors",
-        "metrics", "loss_weights", "sample_weight_col", "feature_cols",
-        "label_cols", "validation", "callbacks", "batch_size", "epochs",
-        "verbose", "shuffle_buffer_size", "partitions_per_process",
-        "run_id", "train_steps_per_epoch", "validation_steps_per_epoch",
-        "transformation_fn", "train_reader_num_workers",
-        "val_reader_num_workers", "label_shapes",
-    ]
+
+def _to_str(name, v):
+    if not isinstance(v, str):
+        raise TypeError(f"estimator param '{name}' must be a str, "
+                        f"got {type(v).__name__}")
+    return v
+
+
+def _to_str_list(name, v):
+    if isinstance(v, str):
+        return [v]
+    if not all(isinstance(s, str) for s in v):
+        raise TypeError(f"estimator param '{name}' must be a list of str")
+    return list(v)
+
+
+class EstimatorParams:
+    """Declared parameters (parity: the Param list + camelCase accessor
+    surface of ``common/params.py:25-350`` — each param gets
+    ``set<Name>``/``get<Name>`` methods generated below, the reference's
+    Spark-ML ``Params`` idiom without the pyspark dependency).
+
+    Values may be supplied via the constructor, ``setParams(**kwargs)``,
+    or the per-param setters; typed params validate on set (the role of
+    Spark's ``TypeConverters``)."""
+
+    # name -> (camel accessor suffix, converter or None)
+    _PARAM_DEFS = {
+        "num_proc": ("NumProc", _to_int),
+        "model": ("Model", None),
+        "backend": ("Backend", None),
+        "store": ("Store", None),
+        "optimizer": ("Optimizer", None),
+        "loss": ("Loss", None),
+        "loss_constructors": ("LossConstructors", None),
+        "metrics": ("Metrics", None),
+        "loss_weights": ("LossWeights", None),
+        "sample_weight_col": ("SampleWeightCol", _to_str),
+        "gradient_compression": ("GradientCompression", None),
+        "feature_cols": ("FeatureCols", _to_str_list),
+        "label_cols": ("LabelCols", _to_str_list),
+        "validation": ("Validation", None),
+        "callbacks": ("Callbacks", None),
+        "batch_size": ("BatchSize", _to_int),
+        "epochs": ("Epochs", _to_int),
+        "verbose": ("Verbose", _to_int),
+        "shuffle_buffer_size": ("ShuffleBufferSize", _to_int),
+        "partitions_per_process": ("PartitionsPerProcess", _to_int),
+        "run_id": ("RunId", _to_str),
+        "train_steps_per_epoch": ("TrainStepsPerEpoch", _to_int),
+        "validation_steps_per_epoch": ("ValidationStepsPerEpoch", _to_int),
+        "transformation_fn": ("TransformationFn", None),
+        "train_reader_num_workers": ("TrainReaderNumWorkers", _to_int),
+        "val_reader_num_workers": ("ValReaderNumWorkers", _to_int),
+        "label_shapes": ("LabelShapes", None),
+    }
+    # Subclasses contribute framework-specific params (the reference's
+    # class-level Param declarations on KerasEstimator/TorchEstimator)
+    # via _EXTRA_PARAM_DEFS, merged down the MRO.
+    _EXTRA_PARAM_DEFS: Dict[str, tuple] = {}
+
+    @classmethod
+    def _param_defs(cls) -> Dict[str, tuple]:
+        defs = dict(EstimatorParams._PARAM_DEFS)
+        for klass in reversed(cls.__mro__):
+            defs.update(getattr(klass, "_EXTRA_PARAM_DEFS", {}))
+        return defs
 
     def __init__(self, **kwargs):
-        self._params: Dict[str, Any] = {k: None for k in self._PARAMS}
-        for k, v in kwargs.items():
-            if k not in self._params:
-                raise ValueError(
-                    f"unknown estimator param '{k}'; valid: "
-                    f"{sorted(self._params)}")
-            self._params[k] = v
+        self._params: Dict[str, Any] = {
+            k: None for k in type(self)._param_defs()}
+        self.setParams(**kwargs)
+
+    def _set_one(self, name: str, value):
+        if name not in self._params:
+            raise ValueError(
+                f"unknown estimator param '{name}'; valid: "
+                f"{sorted(self._params)}")
+        conv = type(self)._param_defs().get(name, (None, None))[1]
+        if value is not None and conv is not None:
+            value = conv(name, value)
+        self._params[name] = value
 
     def getOrDefault(self, name: str):
         return self._params.get(name)
 
     def setParams(self, **kwargs) -> "EstimatorParams":
         for k, v in kwargs.items():
-            if k not in self._params:
-                raise ValueError(f"unknown estimator param '{k}'")
-            self._params[k] = v
+            self._set_one(k, v)
         return self
+
+
+def install_accessors(cls):
+    """Generate ``set<Name>``/``get<Name>`` pairs for every declared param
+    (parity: the explicit accessor list in ``common/params.py:145-350``).
+    Apply to every concrete estimator class that adds _EXTRA_PARAM_DEFS."""
+    def make(name):
+        def setter(self, value):
+            self._set_one(name, value)
+            return self
+
+        def getter(self):
+            return self.getOrDefault(name)
+
+        return setter, getter
+
+    for name, (camel, _) in cls._param_defs().items():
+        setter, getter = make(name)
+        setter.__name__, getter.__name__ = f"set{camel}", f"get{camel}"
+        setter.__doc__ = f"Set estimator param ``{name}``; returns self."
+        getter.__doc__ = f"Get estimator param ``{name}``."
+        if not hasattr(cls, f"set{camel}"):
+            setattr(cls, setter.__name__, setter)
+        if not hasattr(cls, f"get{camel}"):
+            setattr(cls, getter.__name__, getter)
+    return cls
+
+
+install_accessors(EstimatorParams)
 
 
 class HorovodEstimator(EstimatorParams):
@@ -97,15 +193,21 @@ class HorovodEstimator(EstimatorParams):
         if store is None:
             raise ValueError("store is required to fit")
         run_id = self.getOrDefault("run_id") or f"run_{uuid.uuid4().hex[:8]}"
-        backend = getattr(self, "_backend", None) or LocalBackend(
-            self.getOrDefault("num_proc") or 1)
+        backend = (self.getOrDefault("backend")
+                   or getattr(self, "_backend", None)
+                   or LocalBackend(self.getOrDefault("num_proc") or 1))
 
+        # partitions_per_process scales the Parquet partition count so
+        # each worker shards over several row groups (reference
+        # params.py:77-80; default 10 there, 1 here keeps tiny test
+        # datasets intact — pass explicitly for production layouts).
+        ppp = self.getOrDefault("partitions_per_process") or 1
         meta = prepare_data(
             store, df,
             self.getOrDefault("feature_cols"),
             self.getOrDefault("label_cols"),
             validation=self.getOrDefault("validation"),
-            num_partitions=backend.num_processes())
+            num_partitions=backend.num_processes() * ppp)
 
         checkpoint = os.path.join(store.get_checkpoint_path(run_id),
                                   self._checkpoint_filename)

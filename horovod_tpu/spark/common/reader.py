@@ -31,7 +31,14 @@ class ShardReader:
     def __init__(self, path: str, meta: Dict, rank: int = 0, size: int = 1,
                  batch_size: int = 32, shuffle: bool = True,
                  shuffle_window_row_groups: int = 4,
-                 columns: Optional[Sequence[str]] = None):
+                 columns: Optional[Sequence[str]] = None,
+                 transform_fn=None, sample_weight_col: Optional[str] = None):
+        """``transform_fn(pdf) -> pdf`` is applied to each row group's
+        pandas frame before batching — the Estimator ``transformation_fn``
+        hook (the role Petastorm's TransformSpec plays in the reference's
+        remote trainers). ``sample_weight_col`` adds a third per-batch
+        array of per-row weights (reference ``sample_weight_col`` param).
+        """
         import pyarrow.parquet as pq
 
         self._pq = pq
@@ -39,10 +46,14 @@ class ShardReader:
         self._batch = batch_size
         self._shuffle = shuffle
         self._window = max(1, shuffle_window_row_groups)
+        self._transform = transform_fn
+        self._weight_col = sample_weight_col
         self._feature_cols = list(meta["feature_cols"])
         self._label_cols = list(meta["label_cols"])
         self._columns = (list(columns) if columns is not None
                          else self._feature_cols + self._label_cols)
+        if sample_weight_col and sample_weight_col not in self._columns:
+            self._columns.append(sample_weight_col)
         # This rank's (filename, row_group) list — the single sharding
         # rule lives in util.iter_shard_groups. Filenames, not handles:
         # files open lazily during iteration so descriptor count stays
@@ -63,18 +74,29 @@ class ShardReader:
     def steps_per_epoch(self) -> int:
         return max(1, int(np.ceil(self._rows / self._batch)))
 
-    def _column_arrays(self, table, cols: Sequence[str]) -> List[np.ndarray]:
+    def _group_arrays(self, table) -> List[List[np.ndarray]]:
         # Decode through to_arrays (shared layout contract with the
         # whole-shard path) — pandas/pyarrow convert columns at C speed;
-        # per-cell Python conversion would dominate epoch time.
+        # per-cell Python conversion would dominate epoch time. One
+        # to_pandas per row group; the transformation_fn hook sees the
+        # frame before any array extraction.
         from .util import to_arrays
 
-        return to_arrays(table.to_pandas(), cols, self._meta)
+        pdf = table.to_pandas()
+        if self._transform is not None:
+            pdf = self._transform(pdf)
+        cols = [to_arrays(pdf, self._feature_cols, self._meta),
+                to_arrays(pdf, self._label_cols, self._meta)]
+        if self._weight_col:
+            cols.append([np.asarray(pdf[self._weight_col])])
+        return cols
 
     def batches(self, epoch: int = 0
-                ) -> Iterator[Tuple[List[np.ndarray], List[np.ndarray]]]:
-        """One pass over the shard. Bounded memory: at most
-        ``shuffle_window_row_groups`` row groups resident."""
+                ) -> Iterator[Tuple[List[np.ndarray], ...]]:
+        """One pass over the shard, yielding ``(features, labels)`` — or
+        ``(features, labels, [weights])`` with ``sample_weight_col`` —
+        per batch. Bounded memory: at most ``shuffle_window_row_groups``
+        row groups resident."""
         rng = np.random.RandomState(epoch)
         order = (rng.permutation(len(self._groups)) if self._shuffle
                  else np.arange(len(self._groups)))
@@ -87,22 +109,22 @@ class ShardReader:
                 cache["pf"] = self._pq.ParquetFile(fname)
             return cache["pf"].read_row_group(rg, columns=self._columns)
 
-        feat_buf: List[np.ndarray] = []
-        lab_buf: List[np.ndarray] = []
+        n_streams = 3 if self._weight_col else 2
+        bufs: List[List[List[np.ndarray]]] = [[] for _ in range(n_streams)]
         buffered = 0
 
         def drain(final=False):
-            nonlocal feat_buf, lab_buf, buffered
+            nonlocal bufs, buffered
             if buffered == 0:
                 return
-            feats = [np.concatenate([b[c] for b in feat_buf])
-                     for c in range(len(self._feature_cols))]
-            labs = [np.concatenate([b[c] for b in lab_buf])
-                    for c in range(len(self._label_cols))]
+            streams = [
+                [np.concatenate([b[c] for b in bufs[s]])
+                 for c in range(len(bufs[s][0]))]
+                for s in range(n_streams)
+            ]
             if self._shuffle:
                 perm = rng.permutation(buffered)
-                feats = [f[perm] for f in feats]
-                labs = [y[perm] for y in labs]
+                streams = [[a[perm] for a in s] for s in streams]
             n = buffered
             start = 0
             while start < n:
@@ -110,20 +132,20 @@ class ShardReader:
                 if not final and n - start < self._batch:
                     # Carry the remainder into the next window so only the
                     # epoch's last batch can be short.
-                    feat_buf = [[f[start:] for f in feats]]
-                    lab_buf = [[y[start:] for y in labs]]
+                    bufs = [[[a[start:] for a in s]] for s in streams]
                     buffered = n - start
                     return
-                yield ([f[start:end] for f in feats],
-                       [y[start:end] for y in labs])
+                yield tuple([a[start:end] for a in s] for s in streams)
                 start = end
-            feat_buf, lab_buf, buffered = [], [], 0
+            bufs, buffered = [[] for _ in range(n_streams)], 0
 
         for i in range(len(self._groups)):
             table = read_group(i)
-            feat_buf.append(self._column_arrays(table, self._feature_cols))
-            lab_buf.append(self._column_arrays(table, self._label_cols))
-            buffered += table.num_rows
-            if len(feat_buf) >= self._window:
+            arrays = self._group_arrays(table)
+            n_rows = len(arrays[1][0]) if arrays[1] else table.num_rows
+            for s in range(n_streams):
+                bufs[s].append(arrays[s])
+            buffered += n_rows
+            if len(bufs[0]) >= self._window:
                 yield from drain(final=False)
         yield from drain(final=True)

@@ -19,7 +19,9 @@ def make_remote_trainer(serialized_model: bytes, optimizer_bytes,
                         shuffle_buffer_size: int = 0,
                         train_steps_per_epoch=None,
                         validation_steps_per_epoch=None,
-                        callbacks=None):
+                        callbacks=None, loss_weights=None,
+                        sample_weight_col=None, transformation_fn=None,
+                        gradient_compression=None):
     """Build the function executed on every worker."""
 
     def trainer():
@@ -36,14 +38,20 @@ def make_remote_trainer(serialized_model: bytes, optimizer_bytes,
                 from .util import deserialize_optimizer
                 opt = deserialize_optimizer(optimizer_bytes)
             plain_opt = opt  # kept for the wrapper-free checkpoint below
-            opt = hvd.DistributedOptimizer(opt)
-            model.compile(optimizer=opt, loss=loss, metrics=metrics or None)
+            opt = hvd.DistributedOptimizer(
+                opt, compression=(gradient_compression
+                                  or hvd.Compression.none))
+            model.compile(optimizer=opt, loss=loss,
+                          loss_weights=loss_weights,
+                          metrics=metrics or None)
 
             # Streaming shard reader (the reference streams through
             # Petastorm make_keras_dataset; bounded memory per worker).
             reader = ShardReader(
                 meta["train_data_path"], meta, hvd.rank(), hvd.size(),
-                batch_size=batch_size, shuffle=bool(shuffle_buffer_size))
+                batch_size=batch_size, shuffle=bool(shuffle_buffer_size),
+                transform_fn=transformation_fn,
+                sample_weight_col=sample_weight_col)
             if reader.rows == 0:
                 # Fail loudly (the launcher aborts the job) rather than
                 # spin in fit() waiting for batches that never come.
@@ -58,8 +66,13 @@ def make_remote_trainer(serialized_model: bytes, optimizer_bytes,
             def gen():
                 epoch = 0
                 while True:  # keras pulls steps_per_epoch * epochs batches
-                    for xs, ys in reader.batches(epoch):
-                        yield unwrap(xs), unwrap(ys)
+                    for batch in reader.batches(epoch):
+                        if sample_weight_col:
+                            xs, ys, ws = batch
+                            yield unwrap(xs), unwrap(ys), ws[0]
+                        else:
+                            xs, ys = batch
+                            yield unwrap(xs), unwrap(ys)
                     epoch += 1
 
             # Validation is evaluated whole (fit holds it in memory
@@ -72,6 +85,10 @@ def make_remote_trainer(serialized_model: bytes, optimizer_bytes,
                 vdf = read_shard(
                     meta["val_data_path"], hvd.rank(), hvd.size(),
                     columns=(meta["feature_cols"] + meta["label_cols"]))
+                if transformation_fn is not None:
+                    # Same transform as the training stream — val
+                    # metrics on untransformed data would be garbage.
+                    vdf = transformation_fn(vdf)
                 if len(vdf):
                     vx = to_arrays(vdf, meta["feature_cols"], meta)
                     vy = to_arrays(vdf, meta["label_cols"], meta)
@@ -97,7 +114,8 @@ def make_remote_trainer(serialized_model: bytes, optimizer_bytes,
                 # serialization.py plays the same role).
                 model.compile(
                     optimizer=type(plain_opt).from_config(opt.get_config()),
-                    loss=loss, metrics=metrics or None)
+                    loss=loss, loss_weights=loss_weights,
+                    metrics=metrics or None)
                 model.save(checkpoint_path)
                 result["checkpoint"] = checkpoint_path
             return result

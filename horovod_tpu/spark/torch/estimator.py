@@ -7,7 +7,8 @@ import io
 from typing import Optional
 
 from ..common.backend import Backend
-from ..common.estimator import HorovodEstimator, HorovodModel
+from ..common.estimator import (HorovodEstimator, HorovodModel,
+                                install_accessors)
 from ..common.store import Store
 from ..common.util import to_arrays
 from .remote import make_remote_trainer
@@ -16,11 +17,19 @@ from .remote import make_remote_trainer
 class TorchEstimator(HorovodEstimator):
     """Train a torch ``nn.Module`` over Store-backed Parquet data.
 
-    Param surface mirrors ``torch/estimator.py:146-187``: model, optimizer
+    Param surface mirrors ``torch/estimator.py:139-187``: model, optimizer
     (class + kwargs or an instance whose defaults are recovered), loss (one
-    fn per label col), input_shapes, feature_cols, label_cols, batch_size,
-    epochs, validation, store, num_proc, train_minibatch_fn.
+    fn per label col), loss_constructors, input_shapes, feature_cols,
+    label_cols, sample_weight_col, gradient_compression, batch_size,
+    epochs, validation, transformation_fn, store, num_proc,
+    train_minibatch_fn — each with the Spark-ML camelCase accessor pair.
     """
+
+    # Framework-specific params (reference torch/estimator.py:139-143).
+    _EXTRA_PARAM_DEFS = {
+        "input_shapes": ("InputShapes", None),
+        "train_minibatch_fn": ("TrainMinibatchFn", None),
+    }
 
     def __init__(self, model=None, optimizer=None, loss=None,
                  loss_constructors=None, feature_cols=None, label_cols=None,
@@ -37,13 +46,13 @@ class TorchEstimator(HorovodEstimator):
                          batch_size=batch_size, epochs=epochs,
                          validation=validation, store=store,
                          num_proc=num_proc, verbose=verbose,
+                         optimizer=optimizer, backend=backend,
+                         input_shapes=input_shapes,
+                         train_minibatch_fn=train_minibatch_fn,
                          shuffle_buffer_size=shuffle_buffer_size,
                          sample_weight_col=sample_weight_col,
                          run_id=run_id, **kwargs)
-        self._optimizer = optimizer
         self._backend = backend
-        self._input_shapes = input_shapes
-        self._train_minibatch_fn = train_minibatch_fn
 
     def _optimizer_spec(self):
         """(class, kwargs) for rebuilding the optimizer against the
@@ -51,7 +60,7 @@ class TorchEstimator(HorovodEstimator):
         re-instantiates from ``optimizer.state_dict`` the same way)."""
         import torch
 
-        opt = self._optimizer
+        opt = self.getOrDefault("optimizer")
         if isinstance(opt, torch.optim.Optimizer):
             kwargs = {k: v for k, v in opt.defaults.items()}
             return type(opt), kwargs
@@ -78,8 +87,11 @@ class TorchEstimator(HorovodEstimator):
             buf.getvalue(), opt_cls, opt_kwargs, loss_fns,
             self.getOrDefault("batch_size"), self.getOrDefault("epochs"),
             meta, checkpoint_path, verbose=self.getOrDefault("verbose"),
-            train_minibatch_fn=self._train_minibatch_fn,
-            sample_weight_col=self.getOrDefault("sample_weight_col"))
+            train_minibatch_fn=self.getOrDefault("train_minibatch_fn"),
+            sample_weight_col=self.getOrDefault("sample_weight_col"),
+            transformation_fn=self.getOrDefault("transformation_fn"),
+            gradient_compression=self.getOrDefault("gradient_compression"),
+            input_shapes=self.getOrDefault("input_shapes"))
 
     def _load_model(self, store, checkpoint_path):
         import torch
@@ -92,7 +104,10 @@ class TorchEstimator(HorovodEstimator):
                           feature_cols=self.getOrDefault("feature_cols"),
                           label_cols=self.getOrDefault("label_cols"),
                           run_id=run_id, history=history, _metadata=meta,
-                          input_shapes=self._input_shapes)
+                          input_shapes=self.getOrDefault("input_shapes"))
+
+
+install_accessors(TorchEstimator)
 
 
 class TorchModel(HorovodModel):
@@ -118,7 +133,8 @@ class TorchModel(HorovodModel):
         xs = to_arrays(pdf, self.feature_cols, meta)
         tx = [torch.as_tensor(np.asarray(a, np.float32)) for a in xs]
         if self.input_shapes:
-            tx = [t.reshape((-1,) + tuple(s))
+            # Reference convention: shapes include the -1 batch dim.
+            tx = [t.reshape(tuple(s))
                   for t, s in zip(tx, self.input_shapes)]
         self.model.eval()
         with torch.no_grad():

@@ -18,7 +18,8 @@ def make_remote_trainer(model_bytes: bytes, optimizer_cls, optimizer_kwargs,
                         loss_fns, batch_size: int, epochs: int, meta: Dict,
                         checkpoint_path: str, verbose: int = 0,
                         shuffle: bool = True, train_minibatch_fn=None,
-                        sample_weight_col=None):
+                        sample_weight_col=None, transformation_fn=None,
+                        gradient_compression=None, input_shapes=None):
     def trainer():
         import numpy as np
         import torch
@@ -33,13 +34,17 @@ def make_remote_trainer(model_bytes: bytes, optimizer_cls, optimizer_kwargs,
             hvd.broadcast_parameters(model.state_dict(), root_rank=0)
             hvd.broadcast_optimizer_state(optimizer, root_rank=0)
             optimizer = hvd.DistributedOptimizer(
-                optimizer, named_parameters=model.named_parameters())
+                optimizer, named_parameters=model.named_parameters(),
+                compression=(gradient_compression
+                             or hvd.Compression.none))
 
             # Streaming shard reader (the Petastorm role in the reference's
             # remote trainer): one row-group window resident at a time.
             reader = ShardReader(
                 meta["train_data_path"], meta, hvd.rank(), hvd.size(),
-                batch_size=batch_size, shuffle=shuffle)
+                batch_size=batch_size, shuffle=shuffle,
+                transform_fn=transformation_fn,
+                sample_weight_col=sample_weight_col)
             if reader.rows == 0:
                 # Fail loudly: a zero-step rank would skip the per-step
                 # gradient allreduces the data-holding ranks submit and
@@ -53,9 +58,16 @@ def make_remote_trainer(model_bytes: bytes, optimizer_cls, optimizer_kwargs,
             model.train()
             for epoch in range(epochs):
                 total, steps = 0.0, 0
-                for xs, ys in reader.batches(epoch):
+                for batch in reader.batches(epoch):
+                    xs, ys = batch[0], batch[1]
+                    ws = batch[2][0] if sample_weight_col else None
                     bx = [torch.as_tensor(np.asarray(a, np.float32))
                           for a in xs]
+                    if input_shapes:
+                        # Reference convention: shapes include the -1
+                        # batch dim (e.g. [[-1, 1, 28, 28]]).
+                        bx = [t.reshape(tuple(s))
+                              for t, s in zip(bx, input_shapes)]
                     by = [torch.as_tensor(np.asarray(a)) for a in ys]
                     optimizer.zero_grad()
                     if train_minibatch_fn is not None:
@@ -63,8 +75,28 @@ def make_remote_trainer(model_bytes: bytes, optimizer_cls, optimizer_kwargs,
                     else:
                         out = model(*bx)
                         outs = out if isinstance(out, (list, tuple)) else [out]
-                        losses = [fn(o, y) for fn, o, y
-                                  in zip(loss_fns, outs, by)]
+                        if ws is not None:
+                            # Per-ROW weighting (reference
+                            # torch/remote.py calculate_loss): the loss
+                            # fn must accept reduction='none' (functional
+                            # losses do); each sample's loss scales by
+                            # its weight, then batch-mean.
+                            wt = torch.as_tensor(np.asarray(ws, np.float32))
+                            try:
+                                losses = [
+                                    (fn(o, y, reduction="none").flatten()
+                                     * wt).mean()
+                                    for fn, o, y in zip(loss_fns, outs, by)]
+                            except TypeError as e:
+                                raise TypeError(
+                                    "sample_weight_col requires loss "
+                                    "functions accepting "
+                                    "reduction='none' (use functional "
+                                    "losses like torch.nn.functional."
+                                    "mse_loss)") from e
+                        else:
+                            losses = [fn(o, y) for fn, o, y
+                                      in zip(loss_fns, outs, by)]
                         loss = sum(losses)
                         loss.backward()
                         optimizer.step()

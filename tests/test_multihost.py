@@ -149,6 +149,25 @@ def test_hierarchical_dispatch_cross_process(tmp_path):
             [np.full((3, 2), float(r), np.float32) for r in range(4)])
         np.testing.assert_allclose(got, expect)
 
+        # --- hierarchical Adasum (reference AdasumGpu semantics: plain
+        # sum inside each process's LOCAL group, Adasum across the two
+        # processes). Non-parallel per-rank vectors so both a fallback
+        # to flat Adasum and a fallback to Sum/Average would fail.
+        from horovod_tpu.ops.adasum import hierarchical_adasum_reference
+
+        def hvec(r):
+            v = np.zeros(6, np.float32)
+            v[r] = 2.0 + r
+            v[(r + 3) % 6] = 1.0
+            return v
+
+        xs = [jnp.asarray(hvec(r)) for r in my_ranks]
+        out = hvd.allreduce(xs, op=hvd.Adasum, name="mh.hadasum")
+        expect = hierarchical_adasum_reference(
+            [hvec(r) for r in range(4)], local_size=2)
+        for o in out:
+            np.testing.assert_allclose(np.asarray(o), expect, rtol=1e-4)
+
         hvd.shutdown()
         print(f"MHHIER_{rank}_OK")
     """)

@@ -12,7 +12,9 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from horovod_tpu.ops import xla as hx
-from horovod_tpu.ops.adasum import adasum_reference
+from horovod_tpu.ops.adasum import (adasum_reference,
+                                    hierarchical_adasum_allreduce,
+                                    hierarchical_adasum_reference)
 
 
 def _run_spmd(hvd, fn, per_rank_inputs, out_spec=P("hvd")):
@@ -115,6 +117,138 @@ class TestAdasum:
         expected = adasum_reference(xs)
         np.testing.assert_allclose(np.asarray(out[0]), expected, rtol=1e-4,
                                    atol=1e-5)
+
+    def test_grouped_adasum_is_per_tensor(self, hvd):
+        """Fused Adasum groups must apply the combination per tensor, not
+        on the concatenated buffer (reference tensor_counts contract,
+        adasum_gpu_operations.cc:208-232). Non-parallel inputs make a
+        joint-buffer combination give visibly different numbers."""
+        n = hvd.size()
+        rng = np.random.RandomState(3)
+        a_in = [rng.randn(8).astype(np.float32) for _ in range(n)]
+        b_in = [np.roll(np.eye(6, dtype=np.float32)[r % 6] * (r + 2), r)
+                for r in range(n)]
+
+        def fn(x):
+            a, b = hx.grouped_allreduce(
+                [x[0, :8], x[0, 8:]], op=hx.Adasum)
+            return jnp.concatenate([a, b])[None]
+
+        packed = [np.concatenate([a_in[r], b_in[r]]) for r in range(n)]
+        out = _run_spmd(hvd, fn, packed)
+        ea = adasum_reference(a_in)
+        eb = adasum_reference(b_in)
+        for r in range(n):
+            np.testing.assert_allclose(out[r][:8], ea, rtol=1e-4, atol=1e-5)
+            np.testing.assert_allclose(out[r][8:], eb, rtol=1e-4, atol=1e-5)
+
+    def test_eager_grouped_adasum_per_tensor(self, hvd):
+        n = hvd.size()
+        rng = np.random.RandomState(11)
+        a_in = [rng.randn(5).astype(np.float32) for _ in range(n)]
+        b_in = [rng.randn(9).astype(np.float32) * (r + 1)
+                for r in range(n)]
+        h = hvd.grouped_allreduce_async(
+            [a_in, b_in], op=hvd.Adasum, name="grp.adasum")
+        out_a, out_b = hvd.synchronize(h)
+        np.testing.assert_allclose(np.asarray(out_a[0]),
+                                   adasum_reference(a_in),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(out_b[0]),
+                                   adasum_reference(b_in),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestHierarchicalAdasum:
+    """Reference AdasumGpuAllreduceOp semantics (ICI sum + cross Adasum),
+    validated against the hierarchical NumPy oracle on explicit
+    (cross, local) meshes."""
+
+    @pytest.mark.parametrize("cross,local", [(2, 4), (4, 2), (2, 2)])
+    def test_matches_hierarchical_oracle(self, hvd, cross, local):
+        n = cross * local
+        if n > len(jax.devices()):
+            pytest.skip("needs more virtual devices")
+        from horovod_tpu.common.state import AXIS_CROSS, AXIS_LOCAL
+
+        mesh = jax.sharding.Mesh(
+            np.array(jax.devices()[:n]).reshape(cross, local),
+            (AXIS_CROSS, AXIS_LOCAL))
+        rng = np.random.RandomState(13)
+        # 11 elements: forces the LOCAL-padding path.
+        data = rng.randn(n, 11).astype(np.float32)
+        stacked = jnp.asarray(data).reshape(cross, local, 11)
+        sharded = jax.device_put(
+            stacked, jax.sharding.NamedSharding(mesh, P(AXIS_CROSS,
+                                                        AXIS_LOCAL)))
+
+        def fn(x):
+            return hierarchical_adasum_allreduce(x[0, 0])[None, None]
+
+        prog = jax.jit(jax.shard_map(
+            fn, mesh=mesh, in_specs=P(AXIS_CROSS, AXIS_LOCAL),
+            out_specs=P(AXIS_CROSS, AXIS_LOCAL), check_vma=False))
+        out = np.asarray(prog(sharded)).reshape(n, 11)
+        # Cross-major layout: local group g = ranks [g*local, (g+1)*local)
+        expected = hierarchical_adasum_reference(list(data), local)
+        for r in range(n):
+            np.testing.assert_allclose(out[r], expected, rtol=1e-4,
+                                       atol=1e-5)
+
+    def test_grouped_hierarchical_adasum_per_tensor(self, hvd):
+        """Fused hierarchical Adasum: one exchange chain on the
+        concatenated buffer, per-tensor scalars (segment sums survive
+        the LOCAL reduce-scatter), padding isolated in its own segment.
+        Sizes 11+6 force both the pad path and uneven shard/segment
+        alignment."""
+        from horovod_tpu.common.state import AXIS_CROSS, AXIS_LOCAL
+        from horovod_tpu.ops.adasum import (
+            grouped_hierarchical_adasum_allreduce)
+
+        cross, local = 2, 4
+        n = cross * local
+        if n > len(jax.devices()):
+            pytest.skip("needs more virtual devices")
+        mesh = jax.sharding.Mesh(
+            np.array(jax.devices()[:n]).reshape(cross, local),
+            (AXIS_CROSS, AXIS_LOCAL))
+        rng = np.random.RandomState(23)
+        a_in = rng.randn(n, 11).astype(np.float32)
+        b_in = rng.randn(n, 6).astype(np.float32) * 3
+        packed = np.concatenate([a_in, b_in], axis=1)
+        stacked = jnp.asarray(packed).reshape(cross, local, 17)
+        sharded = jax.device_put(
+            stacked, jax.sharding.NamedSharding(mesh, P(AXIS_CROSS,
+                                                        AXIS_LOCAL)))
+
+        def fn(x):
+            a, b = grouped_hierarchical_adasum_allreduce(
+                [x[0, 0, :11], x[0, 0, 11:]])
+            return jnp.concatenate([a, b])[None, None]
+
+        prog = jax.jit(jax.shard_map(
+            fn, mesh=mesh, in_specs=P(AXIS_CROSS, AXIS_LOCAL),
+            out_specs=P(AXIS_CROSS, AXIS_LOCAL), check_vma=False))
+        out = np.asarray(prog(sharded)).reshape(n, 17)
+        ea = hierarchical_adasum_reference(list(a_in), local)
+        eb = hierarchical_adasum_reference(list(b_in), local)
+        for r in range(n):
+            np.testing.assert_allclose(out[r][:11], ea, rtol=1e-4,
+                                       atol=1e-5)
+            np.testing.assert_allclose(out[r][11:], eb, rtol=1e-4,
+                                       atol=1e-5)
+
+    def test_differs_from_flat_adasum(self, hvd):
+        """Hierarchical Adasum plain-sums the LOCAL group (reference
+        NCCL-mode behavior) — for generic inputs that is a different
+        number than flat Adasum, and the test would catch a silent
+        fallback to the flat path."""
+        n = hvd.size()
+        rng = np.random.RandomState(17)
+        data = [rng.randn(6).astype(np.float32) for _ in range(n)]
+        flat = adasum_reference(data)
+        hier = hierarchical_adasum_reference(data, local_size=n // 2)
+        assert not np.allclose(flat, hier, rtol=1e-3)
 
 
 class TestBroadcastInJit:

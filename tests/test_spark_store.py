@@ -13,10 +13,48 @@ from horovod_tpu.spark.common.estimator import (
 
 
 def test_store_create_dispatch(tmp_path):
+    from horovod_tpu.spark.common.store import HDFSStore, S3Store
+
     s = Store.create(str(tmp_path))
     assert isinstance(s, LocalStore)
-    with pytest.raises(NotImplementedError):
-        Store.create("hdfs://nn/path")
+    assert isinstance(Store.create("hdfs://nn:9000/path"), HDFSStore)
+    assert isinstance(Store.create("s3://bucket/path"), S3Store)
+
+
+def test_hdfs_store_paths_without_cluster():
+    """Path layout and authority parsing need no Hadoop client; only
+    actual IO touches the (lazily-connected) filesystem (reference
+    store.py:280-430 HDFSStore layout)."""
+    from horovod_tpu.spark.common.store import HDFSStore
+
+    s = HDFSStore("hdfs://namenode:9000/user/me/exp")
+    assert s._host == "namenode" and s._port == 9000
+    assert s.get_train_data_path() == \
+        "hdfs://namenode:9000/user/me/exp/intermediate_train_data"
+    assert s.get_train_data_path(2).endswith(".2")
+    assert s.get_checkpoint_path("r7") == \
+        "hdfs://namenode:9000/user/me/exp/runs/r7/checkpoint"
+    # Scheme+authority strip to an absolute cluster path for pyarrow.
+    assert s._strip(s.get_checkpoint_path("r7")) == \
+        "/user/me/exp/runs/r7/checkpoint"
+    # Bare-authority form (hdfs://nn/path) and default-from-config form.
+    assert HDFSStore("hdfs://nn/path")._host == "nn"
+    # The filesystem connects lazily: construction above touched no
+    # cluster. On this image (no libhdfs) the first real IO must raise
+    # pyarrow's descriptive environment error, not fail silently.
+    with pytest.raises(Exception) as excinfo:
+        s.exists(s.get_train_data_path())
+    assert str(excinfo.value)  # descriptive, not an empty raise
+
+
+def test_s3_store_path_strip():
+    from horovod_tpu.spark.common.store import S3Store
+
+    s = S3Store("s3://bucket/prefix")
+    assert s.get_train_data_path() == \
+        "s3://bucket/prefix/intermediate_train_data"
+    assert s._strip(s.get_train_data_path()) == \
+        "bucket/prefix/intermediate_train_data"
 
 
 def test_local_store_paths(tmp_path):
@@ -54,6 +92,66 @@ def test_estimator_params_validation():
         est.fit(None)
     with pytest.raises(NotImplementedError):
         est._make_trainer({}, "x")
+
+
+def test_estimator_param_accessor_matrix():
+    """Every declared param has the Spark-ML camelCase accessor pair
+    (reference common/params.py:145-350) and round-trips through all
+    three entry points: constructor kwarg, setParams, set<Name>."""
+    est = HorovodEstimator()
+    for name, (camel, _) in type(est)._param_defs().items():
+        setter = getattr(est, f"set{camel}", None)
+        getter = getattr(est, f"get{camel}", None)
+        assert callable(setter), f"missing set{camel}"
+        assert callable(getter), f"missing get{camel}"
+        assert getter() is None
+    # Fluent chaining returns self (Spark-ML idiom).
+    out = est.setEpochs(4).setBatchSize(8).setFeatureCols(["a", "b"])
+    assert out is est
+    assert est.getEpochs() == 4
+    assert est.getBatchSize() == 8
+    assert est.getFeatureCols() == ["a", "b"]
+    # setParams and constructor hit the same storage.
+    est.setParams(verbose=1)
+    assert est.getVerbose() == 1
+    assert HorovodEstimator(num_proc=3).getNumProc() == 3
+    # A single string is promoted to a list (TypeConverters.toListString
+    # role); run_id must be a string.
+    assert HorovodEstimator(label_cols="y").getLabelCols() == ["y"]
+    with pytest.raises(TypeError, match="run_id"):
+        HorovodEstimator(run_id=7)
+
+
+def test_estimator_param_type_validation():
+    """Typed params convert/reject on set (the reference's
+    TypeConverters role) at every entry point."""
+    with pytest.raises(TypeError, match="epochs"):
+        HorovodEstimator(epochs="three")
+    with pytest.raises(TypeError, match="batch_size"):
+        HorovodEstimator().setBatchSize(2.5)
+    with pytest.raises(TypeError, match="feature_cols"):
+        HorovodEstimator(feature_cols=[1, 2])
+    # Floats holding integral values convert (Spark passes py floats).
+    assert HorovodEstimator(epochs=3.0).getEpochs() == 3
+
+
+def test_framework_estimators_declare_extra_params():
+    """Subclass params merge into the accessor surface (reference:
+    class-level Param declarations on KerasEstimator/TorchEstimator)."""
+    from horovod_tpu.spark import KerasEstimator, TorchEstimator
+
+    ke = KerasEstimator(custom_objects={"f": int})
+    assert ke.getCustomObjects() == {"f": int}
+    ke.setCustomObjects({"g": str})
+    assert ke.getCustomObjects() == {"g": str}
+    # Base params keep their accessors on subclasses.
+    assert ke.setEpochs(2).getEpochs() == 2
+
+    te = TorchEstimator(input_shapes=[[-1, 4]])
+    assert te.getInputShapes() == [[-1, 4]]
+    assert te.setTrainMinibatchFn(abs).getTrainMinibatchFn() is abs
+    with pytest.raises(ValueError, match="unknown estimator param"):
+        TorchEstimator(custom_objects={})  # keras-only param
 
 
 def test_model_wrapper():

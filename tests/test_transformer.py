@@ -50,6 +50,27 @@ def test_loss_matches_dense(sizes):
     assert loss == pytest.approx(expected, rel=1e-4)
 
 
+def test_indivisible_heads_raise_descriptive_error():
+    """n_heads / kv_heads not divisible by the tp axis must fail fast
+    with a named error at shard_params/make_loss_fn — not as an opaque
+    XLA sharding error at compile time (round-4 advisor finding)."""
+    mesh = build_parallel_mesh(jax.devices(), dp=2, pp=1, sp=1, tp=4)
+    # 6 query heads over tp=4: indivisible.
+    cfg = TransformerConfig(vocab=64, d_model=48, n_heads=6, d_head=8,
+                            d_ff=64, n_layers=2, max_seq=64)
+    params = init_params(cfg, jax.random.PRNGKey(0), 1)
+    with pytest.raises(ValueError, match="n_heads.*tp"):
+        shard_params(params, cfg, mesh)
+    with pytest.raises(ValueError, match="n_heads.*tp"):
+        make_loss_fn(cfg, mesh)
+    # 8 query heads but 2 KV heads over tp=4: GQA KV split indivisible.
+    cfg = TransformerConfig(vocab=64, d_model=64, n_heads=8, d_head=8,
+                            d_ff=64, n_layers=2, max_seq=64, n_kv_heads=2)
+    params = init_params(cfg, jax.random.PRNGKey(0), 1)
+    with pytest.raises(ValueError, match="kv_heads.*tp"):
+        shard_params(params, cfg, mesh)
+
+
 @pytest.mark.parametrize("sizes", MESHES)
 def test_grads_match_dense(sizes):
     cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4, d_head=8,

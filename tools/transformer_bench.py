@@ -137,10 +137,17 @@ def main(argv=None):
     tokens_per_step = args.batch_size * args.seq_len
     tok_per_s = tokens_per_step * args.num_iters / dt
     # 6N matmul estimate + attention QK^T/PV term (fwd 2*2*T*d_attn per
-    # token per layer, x3 for fwd+bwd).
+    # token per layer, x3 for fwd+bwd). With a sliding window the Pallas
+    # kernels cull out-of-window tiles, so the achievable attention span
+    # per query is min(seq_len, window) — counting the full T here would
+    # overstate MFU for SWA runs. (Causal masking still halves the real
+    # work on average; that known overstatement is documented in
+    # docs/benchmarks.md and applies equally with and without a window.)
     d_attn = args.n_heads * (args.d_model // args.n_heads)
+    attn_span = (min(args.seq_len, args.window) if args.window
+                 else args.seq_len)
     flops_per_token = (6 * n_matmul_params +
-                       12 * args.n_layers * args.seq_len * d_attn)
+                       12 * args.n_layers * attn_span * d_attn)
     model_flops_per_s = tok_per_s * flops_per_token
 
     result = {
@@ -157,6 +164,7 @@ def main(argv=None):
         "global_batch": args.batch_size,
         "mesh": sizes,
         "sp_strategy": args.strategy,
+        "window": args.window,
         "zero": bool(args.zero),
         "loss": round(float(np.asarray(loss)), 4),
         "step_ms": round(1e3 * dt / args.num_iters, 2),

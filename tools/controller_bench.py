@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""Control-plane latency microbench: enqueue -> response round-trip.
+
+SURVEY §7 names the per-cycle negotiation the control-plane perf risk:
+the reference's background loop budgets a 5 ms cycle
+(``operations.cc:431`` default ``HOROVOD_CYCLE_TIME``), and its response
+cache exists so repeat submissions skip the full negotiation
+(``response_cache.h:45-167``). This bench measures, over a REAL
+multi-process TCP-star controller + ring world (no XLA involvement —
+tiny host-plane tensors), the wall-clock from ``enqueue`` to completion
+for:
+
+- **miss**: first-ever tensor names — full negotiation every time
+  (request gather, validation, response broadcast).
+- **hit**: the same tensor name resubmitted each step (the training-loop
+  shape) — requests travel as 4-byte cache ids.
+
+One JSON line on stdout:
+``{"metric": "controller_cached_rtt_ms", "value": <worst cached p50
+across sizes>, ...,"sizes": {...}}``. The companion CI test asserts the
+cached path beats the reference's 5 ms cycle budget at every measured
+world size.
+
+Usage: python tools/controller_bench.py [--sizes 2,4,8] [--iters 200]
+       [--out docs/controller_bench.json]
+"""
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _stats(samples_ms):
+    xs = sorted(samples_ms)
+    n = len(xs)
+    return {
+        "p50": round(xs[n // 2], 4),
+        "p90": round(xs[min(n - 1, (9 * n) // 10)], 4),
+        "mean": round(sum(xs) / n, 4),
+        "n": n,
+    }
+
+
+def worker(rank: int, size: int, port: int, iters: int,
+           cycle_ms: float) -> int:
+    import numpy as np
+
+    sys.path.insert(0, REPO)
+    from horovod_tpu.common import native as hn
+
+    core = hn.NativeCore()
+    assert core.available, "native core unavailable"
+    ok = core.init(rank=rank, size=size, local_rank=0, local_size=1,
+                   cross_rank=rank, cross_size=size,
+                   coordinator_addr="127.0.0.1", coordinator_port=port,
+                   my_host="127.0.0.1", cycle_time_ms=cycle_ms,
+                   fusion_threshold=64 << 20, cache_capacity=1024,
+                   stall_warning_sec=60.0, stall_shutdown_sec=0.0,
+                   stall_check_enabled=True,
+                   exec_callback=lambda resp, rid: core.response_done(
+                       rid, False, "host-plane only bench"))
+    assert ok, "native init failed"
+
+    buf = np.ones(4, np.float32)
+
+    def rtt(name):
+        t0 = time.perf_counter()
+        h = core.enqueue(name, hn.OP_ALLREDUCE, 1, 7, buf.shape,
+                         data_ptr=buf.ctypes.data,
+                         output_ptr=buf.ctypes.data, plane=hn.PLANE_HOST)
+        r, err = core.wait(h)
+        assert r == 1, err
+        return (time.perf_counter() - t0) * 1e3
+
+    # Warm the world (connections, first negotiation) before timing.
+    for i in range(3):
+        rtt(f"warm.{i}")
+
+    miss = [rtt(f"miss.{i}") for i in range(iters)]
+
+    # Same name every step: after the first submission the request rides
+    # the response cache's id fast path.
+    hit_all = [rtt("hit") for _ in range(iters + 1)]
+    hit = hit_all[1:]
+    # The coordinator (rank 0) never puts its own requests on the wire,
+    # so id-fast-path hits are counted on worker ranks only.
+    hits_seen = core.cache_hits()
+
+    core.shutdown()
+    print(f"WORKER_CACHE {rank} {int(hits_seen)}", flush=True)
+    if rank == 0:
+        print("WORKER_RESULT " + json.dumps({
+            "size": size,
+            "cycle_time_ms": cycle_ms,
+            "miss_ms": _stats(miss),
+            "hit_ms": _stats(hit),
+        }), flush=True)
+    return 0
+
+
+# Port-clash signatures (same contract as tests/proc_harness.py, which
+# documents free_port()'s TOCTOU window): ONLY these retry.
+_PORT_CLASH_MARKERS = (
+    "world join failed",
+    "Address already in use",
+    "EADDRINUSE",
+)
+
+
+def run_size(size: int, iters: int, cycle_ms: float, timeout: float,
+             attempts: int = 3):
+    last_blob = ""
+    for attempt in range(attempts):
+        port = _free_port()
+        procs = [subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker",
+             str(r), str(size), str(port), str(iters), str(cycle_ms)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=REPO) for r in range(size)]
+        result = None
+        cache_hits = 0
+        failed = None
+        try:
+            for r, p in enumerate(procs):
+                out, _ = p.communicate(timeout=timeout)
+                last_blob += out
+                if p.returncode != 0 and failed is None:
+                    failed = (r, out)
+                for line in out.splitlines():
+                    if line.startswith("WORKER_RESULT "):
+                        result = json.loads(line[len("WORKER_RESULT "):])
+                    elif line.startswith("WORKER_CACHE "):
+                        cache_hits += int(line.split()[2])
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+        if failed is None and result is not None:
+            # Worker ranks resubmitting "hit" rode the id fast path.
+            result["cache_hits_worker_ranks"] = cache_hits
+            return result
+        if attempt + 1 < attempts and any(
+                m in last_blob for m in _PORT_CLASH_MARKERS):
+            print(f"controller_bench: suspected port clash on {port} "
+                  f"(attempt {attempt + 1}/{attempts}); retrying",
+                  file=sys.stderr)
+            continue
+        if failed is not None:
+            raise RuntimeError(
+                f"controller_bench rank {failed[0]} failed:\n"
+                f"{failed[1][-2000:]}")
+        raise RuntimeError("rank 0 produced no result line")
+    raise RuntimeError(
+        f"controller_bench: no clean world in {attempts} attempts")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--sizes", default="2,4,8")
+    p.add_argument("--iters", type=int, default=200)
+    p.add_argument("--cycle-ms", default="1.0",
+                   help="comma list of controller cycle times to sweep. "
+                        "5.0 is both the reference's and this repo's "
+                        "default (operations.cc:431 / config.py); at "
+                        "that setting the RTT is dominated by the cycle "
+                        "sleep itself, so 1.0 isolates the actual "
+                        "negotiation+wire work")
+    p.add_argument("--timeout", type=float, default=300.0)
+    p.add_argument("--out", default=None,
+                   help="also write the JSON to this path")
+    args = p.parse_args(argv)
+
+    sizes = [int(s) for s in args.sizes.split(",") if s]
+    cycles = [float(c) for c in str(args.cycle_ms).split(",") if c]
+    by_cycle = {}
+    for cycle_ms in cycles:
+        per_size = {}
+        for size in sizes:
+            per_size[str(size)] = run_size(size, args.iters, cycle_ms,
+                                           args.timeout)
+            print(f"controller_bench: cycle {cycle_ms} ms, size {size} "
+                  f"done (hit p50 "
+                  f"{per_size[str(size)]['hit_ms']['p50']} ms, miss p50 "
+                  f"{per_size[str(size)]['miss_ms']['p50']} ms)",
+                  file=sys.stderr)
+        by_cycle[str(cycle_ms)] = per_size
+
+    # Headline: the tightest-cycle sweep isolates negotiation+wire work;
+    # it must fit within the reference's 5 ms cycle budget.
+    tightest = by_cycle[str(min(cycles))]
+    worst_hit_p50 = max(v["hit_ms"]["p50"] for v in tightest.values())
+    result = {
+        "metric": "controller_cached_rtt_ms",
+        "value": worst_hit_p50,
+        "unit": "ms (worst cached p50 across sizes, tightest cycle)",
+        "vs_baseline": round(5.0 / worst_hit_p50, 3) if worst_hit_p50
+        else None,
+        "baseline": "reference 5 ms cycle budget (operations.cc:431)",
+        "note": ("RTT at a given --cycle-ms includes waiting for the "
+                 "next controller tick; the tightest-cycle row bounds "
+                 "the per-round negotiation+wire work itself"),
+        "iters": args.iters,
+        "by_cycle_ms": by_cycle,
+        "sizes": tightest,
+    }
+    print(json.dumps(result))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        sys.exit(worker(int(sys.argv[2]), int(sys.argv[3]),
+                        int(sys.argv[4]), int(sys.argv[5]),
+                        float(sys.argv[6])))
+    sys.exit(main())

@@ -67,11 +67,13 @@ def set_args_from_config(args, config: dict, override_args: set) -> None:
 
 
 def _set(env: dict, name: str, value) -> None:
+    # Tri-state booleans: None = unset (leave ambient env alone),
+    # True/False = user-forced — an explicit False (the --no-* negations)
+    # must export "0" so it overrides an ambient HOROVOD_*=1.
     if value is None:
         return
     if isinstance(value, bool):
-        if value:
-            env[name] = "1"
+        env[name] = "1" if value else "0"
         return
     env[name] = str(value)
 

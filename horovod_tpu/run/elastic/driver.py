@@ -60,7 +60,8 @@ class ElasticDriver:
                                  repr(grace).encode())
         self._min_np = min_np
         self._max_np = max_np or 0
-        self._timeout = timeout or 600.0
+        # `is None` check: timeout=0 is an explicit fail-fast request.
+        self._timeout = 600.0 if timeout is None else timeout
         self._verbose = verbose
 
         self._worker_registry = WorkerStateRegistry(self, self._host_manager)

@@ -50,9 +50,17 @@ def run_elastic(args, command: List[str],
     # Controller-level job isolation (see launch.launch_workers).
     env.setdefault("HOROVOD_JOB_KEY", os.urandom(8).hex())
 
+    # --elastic-timeout governs world (re)assembly after re-scaling
+    # (reference runner.py:360 elastic_timeout, default 600 — distinct
+    # from --start-timeout's process-startup wait, whose parser default
+    # of 30 must NOT leak in here). `is None` check: an explicit 0 is a
+    # fail-fast request, not "unset".
+    elastic_timeout = getattr(args, "elastic_timeout", None)
+    if elastic_timeout is None:
+        elastic_timeout = 600
     driver = ElasticDriver(
         rendezvous, discovery, min_np=min_np, max_np=max_np,
-        timeout=getattr(args, "start_timeout", None) or 600,
+        timeout=elastic_timeout,
         cooldown_range=getattr(args, "blacklist_cooldown_range", None),
         verbose=1 if args.verbose else 0)
 

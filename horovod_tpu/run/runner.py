@@ -2,12 +2,16 @@
 ``horovod/run/runner.py``).
 
 ``parse_args`` mirrors the reference's flag groups (``runner.py:218-484``):
-basic np/hosts, tuning params, autotune, timeline, elastic, stall check,
-logging, ssh. ``_run`` dispatches static vs elastic
-(``runner.py:790-811``); the static path computes slot assignments, starts
-the HTTP rendezvous, and launches one worker per slot with the topology env
-(the gloo launcher's role — there is no mpirun to shell out to on TPU; the
-``--launcher`` flag keeps the reference's pluggable-launcher slot).
+basic np/hosts, tuning params (with the ``--no-*`` negation pairs),
+autotune, timeline, elastic (incl. ``--elastic-timeout``), stall check
+(``--stall-check``/``--no-stall-check``), logging, ssh. ``_run``
+dispatches static vs elastic (``runner.py:790-811``) and
+``choose_launcher`` reproduces ``run_controller``'s fallback matrix
+(``runner.py:732-763``): forced ``--launcher`` choices validate their
+prerequisites with descriptive errors, and ``auto`` detects
+jsrun-under-LSF → ssh-for-remote-hosts → local fork. (There is no mpirun
+to shell out to on TPU; the launcher slot keeps the reference's
+pluggable pattern.)
 
 Programmatic use (parity: ``horovod.run.run()``, ``runner.py:824+``)::
 
@@ -174,14 +178,25 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                               action="store_const", const=True,
                               dest="hierarchical_allreduce",
                               help="Force hierarchical (ICIxDCN) allreduce.")
+    group_params.add_argument("--no-hierarchical-allreduce",
+                              action="store_const", const=False,
+                              dest="hierarchical_allreduce",
+                              help="Force the flat allreduce path even "
+                                   "when a hier mesh exists.")
     group_params.add_argument("--hierarchical-allgather",
                               action="store_const", const=True,
                               dest="hierarchical_allgather",
                               help="Force hierarchical allgather.")
+    group_params.add_argument("--no-hierarchical-allgather",
+                              action="store_const", const=False,
+                              dest="hierarchical_allgather",
+                              help="Force the flat allgather path.")
 
     group_autotune = parser.add_argument_group("autotune arguments")
     group_autotune.add_argument("--autotune", action="store_const",
                                 const=True, dest="autotune")
+    group_autotune.add_argument("--no-autotune", action="store_const",
+                                const=False, dest="autotune")
     group_autotune.add_argument("--autotune-log-file",
                                 dest="autotune_log_file")
     group_autotune.add_argument("--autotune-warmup-samples", type=int,
@@ -201,6 +216,9 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     group_timeline.add_argument("--timeline-mark-cycles",
                                 action="store_const", const=True,
                                 dest="timeline_mark_cycles")
+    group_timeline.add_argument("--no-timeline-mark-cycles",
+                                action="store_const", const=False,
+                                dest="timeline_mark_cycles")
 
     group_elastic = parser.add_argument_group("elastic arguments")
     group_elastic.add_argument("--min-np", type=int, dest="min_np",
@@ -217,10 +235,20 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                                nargs=2, dest="blacklist_cooldown_range",
                                help="Min/max seconds before a blacklisted "
                                     "host may be retried.")
+    group_elastic.add_argument("--elastic-timeout", type=int,
+                               dest="elastic_timeout",
+                               help="Seconds to wait for the elastic "
+                                    "world to (re)assemble after a "
+                                    "re-scaling event (reference "
+                                    "runner.py:360; default 600).")
 
     group_stall = parser.add_argument_group("stall check arguments")
     group_stall.add_argument("--no-stall-check", action="store_const",
                              const=True, dest="no_stall_check")
+    group_stall.add_argument("--stall-check", action="store_const",
+                             const=False, dest="no_stall_check",
+                             help="Explicitly enable the stall inspector "
+                                  "(overrides a config-file disable).")
     group_stall.add_argument("--stall-check-warning-time-seconds", type=int,
                              dest="stall_check_warning_time_seconds")
     group_stall.add_argument("--stall-check-shutdown-time-seconds", type=int,
@@ -232,6 +260,8 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                                     "ERROR", "FATAL"])
     group_log.add_argument("--log-hide-timestamp", action="store_const",
                            const=True, dest="log_hide_timestamp")
+    group_log.add_argument("--no-log-hide-timestamp", action="store_const",
+                           const=False, dest="log_hide_timestamp")
 
     group_lib = parser.add_argument_group("library arguments")
     group_lib.add_argument("--launcher", dest="launcher", default="auto",
@@ -248,13 +278,14 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     args = parser.parse_args(argv)
     # Track which flags the user set explicitly so the config file never
     # overrides the command line (parity: runner.py override_args).
-    # (identity comparison: 0/0.0 are explicit values, not "unset", and
-    # 0 == False would swallow them under `in (None, False)`)
+    # "Explicit" = the parsed value differs from the parser's default —
+    # this keeps 0/0.0 explicit AND counts the --no-* negations, whose
+    # explicit value is False against a None default (tri-state flags:
+    # None = unset, True/False = user-forced either way).
     args._override_args = {
         a.dest for a in parser._actions
-        if not (getattr(args, a.dest, None) is None
-                or getattr(args, a.dest, None) is False)
-        and a.dest not in ("command", "help")
+        if a.dest not in ("command", "help")
+        and getattr(args, a.dest, None) != parser.get_default(a.dest)
     }
     return args
 
@@ -332,6 +363,49 @@ def _run_elastic(args, command: List[str],
     return run_elastic(args, command, base_env)
 
 
+def choose_launcher(args, hosts: List[_hosts.HostInfo]) -> str:
+    """Pick the worker-launch transport (the reference's
+    ``run_controller`` gloo→mpi→jsrun fallback matrix,
+    ``run/runner.py:732-763``, mapped to this launcher's slots):
+
+    - forced choices (``--launcher jsrun/ssh/local``) are validated and
+      fail with a descriptive error when their prerequisite is missing
+      (the reference's "Gloo support has not been built" pattern);
+    - ``auto`` detects: **jsrun** inside an LSF allocation with the
+      binary installed → **ssh** when the host plan reaches remote
+      hosts → **local** fork otherwise.
+    """
+    from . import js_run
+    from .util.lsf import LSFUtils
+
+    choice = getattr(args, "launcher", "auto") or "auto"
+    remote = sorted({h.hostname for h in hosts
+                     if not _launch.is_local(h.hostname)})
+    if choice == "jsrun":
+        if not LSFUtils.using_lsf():
+            raise ValueError(
+                "--launcher jsrun requested but this process is not "
+                "inside an LSF allocation (LSB_* env missing); run under "
+                "bsub or use --launcher ssh/local")
+        if not js_run.is_jsrun_installed():
+            raise ValueError(
+                "--launcher jsrun requested but the jsrun binary is not "
+                "on PATH")
+        return "jsrun"
+    if choice == "local":
+        if remote:
+            raise ValueError(
+                "--launcher local requested but the host plan reaches "
+                f"remote hosts {remote[:3]}; use --launcher ssh")
+        return "local"
+    if choice == "ssh":
+        return "ssh"
+    # auto: scheduler first, then topology.
+    if LSFUtils.using_lsf() and js_run.is_jsrun_installed():
+        return "jsrun"
+    return "ssh" if remote else "local"
+
+
 def _run(args) -> int:
     if getattr(args, "check_build", False):
         print(check_build(verbose=getattr(args, "verbose", False)))
@@ -358,7 +432,10 @@ def _run(args) -> int:
             args.np = LSFUtils.get_num_processes()
     if args.np is None and not (args.hosts or args.hostfile):
         raise ValueError("-np (or -H/--hostfile) is required")
-    if args.launcher == "jsrun":
+    launcher = choose_launcher(args, _hostnames(args))
+    if args.verbose:
+        print(f"hvdrun: using the {launcher} launcher", file=sys.stderr)
+    if launcher == "jsrun":
         return _run_jsrun(args, command)
     return _run_static(args, command)
 

@@ -327,8 +327,11 @@ def test_output_filename_launch_failure_aborts_cleanly(tmp_path):
 
 def test_run_dispatch_matrix(monkeypatch):
     """_run routes to elastic / jsrun / static from the flags alone
-    (reference run_controller fallback matrix, test_run.py:442)."""
-    from horovod_tpu.run import runner
+    (reference run_controller fallback matrix, test_run.py:442).
+    --launcher jsrun is validated against the (mocked) LSF environment
+    before dispatch."""
+    from horovod_tpu.run import js_run, runner
+    from horovod_tpu.run.util.lsf import LSFUtils
 
     calls = []
     monkeypatch.setattr(runner, "_run_elastic",
@@ -339,12 +342,166 @@ def test_run_dispatch_matrix(monkeypatch):
                         lambda a, c: calls.append("static") or 0)
 
     base = ["-np", "2", "-H", "localhost:2", "python", "x.py"]
+    monkeypatch.setattr(LSFUtils, "using_lsf", staticmethod(lambda: False))
     assert runner.run_commandline(base) == 0
+    monkeypatch.setattr(LSFUtils, "using_lsf", staticmethod(lambda: True))
+    monkeypatch.setattr(js_run, "is_jsrun_installed", lambda: True)
     assert runner.run_commandline(
         ["--launcher", "jsrun"] + base) == 0
+    monkeypatch.setattr(LSFUtils, "using_lsf", staticmethod(lambda: False))
     assert runner.run_commandline(
         ["--min-np", "1"] + base) == 0
     assert runner.run_commandline(
         ["-np", "2", "--host-discovery-script", "./d.sh",
          "python", "x.py"]) == 0
     assert calls == ["static", "jsrun", "elastic", "elastic"]
+
+
+def test_choose_launcher_matrix(monkeypatch):
+    """run_controller-style fallback matrix (reference run/runner.py:732
+    + the mock-asserted patterns of test/test_run.py:442-658): auto
+    detection order jsrun -> ssh -> local, and forced choices fail with
+    descriptive errors when their prerequisite is missing."""
+    from horovod_tpu.run import js_run, runner
+    from horovod_tpu.run.common.util import hosts as hosts_util
+    from horovod_tpu.run.util.lsf import LSFUtils
+
+    local = hosts_util.parse_hosts("localhost:2")
+    remote = hosts_util.parse_hosts("localhost:2,nodeA:2")
+
+    def ns(**kw):
+        import argparse
+        return argparse.Namespace(launcher=kw.pop("launcher", "auto"), **kw)
+
+    # auto on a pure-local plan -> local fork
+    monkeypatch.setattr(LSFUtils, "using_lsf", staticmethod(lambda: False))
+    assert runner.choose_launcher(ns(), local) == "local"
+    # auto with remote hosts -> ssh
+    assert runner.choose_launcher(ns(), remote) == "ssh"
+    # auto inside LSF with jsrun installed -> jsrun (beats ssh/local)
+    monkeypatch.setattr(LSFUtils, "using_lsf", staticmethod(lambda: True))
+    monkeypatch.setattr(js_run, "is_jsrun_installed", lambda: True)
+    assert runner.choose_launcher(ns(), local) == "jsrun"
+    assert runner.choose_launcher(ns(), remote) == "jsrun"
+    # auto inside LSF without the binary -> falls through to topology
+    monkeypatch.setattr(js_run, "is_jsrun_installed", lambda: False)
+    assert runner.choose_launcher(ns(), remote) == "ssh"
+    assert runner.choose_launcher(ns(), local) == "local"
+    # forced jsrun outside LSF / without binary -> descriptive errors
+    monkeypatch.setattr(LSFUtils, "using_lsf", staticmethod(lambda: False))
+    with pytest.raises(ValueError, match="LSF allocation"):
+        runner.choose_launcher(ns(launcher="jsrun"), local)
+    monkeypatch.setattr(LSFUtils, "using_lsf", staticmethod(lambda: True))
+    with pytest.raises(ValueError, match="jsrun binary"):
+        runner.choose_launcher(ns(launcher="jsrun"), local)
+    # forced local with remote hosts -> error naming the hosts
+    with pytest.raises(ValueError, match="nodeA"):
+        runner.choose_launcher(ns(launcher="local"), remote)
+    # forced ssh always honored (works for local plans too)
+    assert runner.choose_launcher(ns(launcher="ssh"), local) == "ssh"
+
+
+def test_auto_dispatch_reaches_jsrun(monkeypatch):
+    """Inside a (mocked) LSF allocation with jsrun installed, plain
+    `hvdrun -np 2 ... cmd` auto-routes to the jsrun path without
+    --launcher (reference run_controller auto-detection)."""
+    from horovod_tpu.run import js_run, runner
+    from horovod_tpu.run.util.lsf import LSFUtils
+
+    monkeypatch.setattr(LSFUtils, "using_lsf", staticmethod(lambda: True))
+    monkeypatch.setattr(js_run, "is_jsrun_installed", lambda: True)
+    calls = []
+    monkeypatch.setattr(runner, "_run_jsrun",
+                        lambda a, c: calls.append(("jsrun", c)) or 0)
+    monkeypatch.setattr(runner, "_run_static",
+                        lambda a, c: calls.append(("static", c)) or 0)
+    assert runner.run_commandline(
+        ["-np", "2", "-H", "localhost:2", "python", "x.py"]) == 0
+    assert calls == [("jsrun", ["python", "x.py"])]
+
+
+def test_jsrun_exact_command_string(tmp_path):
+    """The jsrun path builds the exact documented command string
+    (reference test_run.py:720 rankfile pattern + :537 command-string
+    asserts)."""
+    from horovod_tpu.run import js_run
+
+    rf = js_run.generate_jsrun_rankfile({"h1": 2, "h2": 1},
+                                        str(tmp_path / "rf"))
+    content = open(rf).read()
+    assert "rank: 0: { hostname: h1; cpu: {0} }" in content
+    assert "rank: 1: { hostname: h1; cpu: {1} }" in content
+    assert "rank: 2: { hostname: h2; cpu: {0} }" in content
+    cmd = js_run.build_jsrun_command(3, {"h1": 2, "h2": 1},
+                                     ["python", "train.py"], rankfile=rf)
+    assert cmd == f"jsrun --erf_input {rf} python train.py"
+    with_out = js_run.build_jsrun_command(
+        3, {"h1": 2, "h2": 1}, ["python", "train.py"], rankfile=rf,
+        output_filename="/tmp/o")
+    assert with_out == (f"jsrun --erf_input {rf} --stdio_stderr /tmp/o "
+                        "--stdio_stdout /tmp/o python train.py")
+
+
+def test_cli_negation_flags_export_zero_env():
+    """--no-* negations must export an explicit 0 (overriding ambient
+    HOROVOD_*=1) and count as command-line overrides against the config
+    file (reference runner.py:294-311 negation pairs)."""
+    from horovod_tpu.common import config as _config
+    from horovod_tpu.run import runner
+    from horovod_tpu.run.common.util import config_parser
+
+    args = runner.parse_args(
+        ["-np", "1", "--no-hierarchical-allreduce",
+         "--no-hierarchical-allgather", "--no-autotune",
+         "--stall-check", "--no-timeline-mark-cycles",
+         "--no-log-hide-timestamp", "--elastic-timeout", "120",
+         "python", "x.py"])
+    assert args.hierarchical_allreduce is False
+    assert args.no_stall_check is False
+    assert args.elastic_timeout == 120
+    # Negations are explicit overrides (config file must not clobber).
+    for dest in ("hierarchical_allreduce", "hierarchical_allgather",
+                 "autotune", "no_stall_check", "timeline_mark_cycles",
+                 "log_hide_timestamp", "elastic_timeout"):
+        assert dest in args._override_args, dest
+
+    env = {_config.HOROVOD_HIERARCHICAL_ALLREDUCE: "1",
+           _config.HOROVOD_AUTOTUNE: "1"}
+    config_parser.set_env_from_args(env, args)
+    assert env[_config.HOROVOD_HIERARCHICAL_ALLREDUCE] == "0"
+    assert env[_config.HOROVOD_HIERARCHICAL_ALLGATHER] == "0"
+    assert env[_config.HOROVOD_AUTOTUNE] == "0"
+    assert env[_config.HOROVOD_TIMELINE_MARK_CYCLES] == "0"
+    assert env[_config.HOROVOD_STALL_CHECK_DISABLE] == "0"
+    assert env[_config.HOROVOD_LOG_HIDE_TIME] == "0"
+    # Positive forms still export 1.
+    args2 = runner.parse_args(["-np", "1", "--hierarchical-allreduce",
+                               "python", "x.py"])
+    env2 = config_parser.set_env_from_args({}, args2)
+    assert env2[_config.HOROVOD_HIERARCHICAL_ALLREDUCE] == "1"
+
+
+def test_elastic_timeout_reaches_driver(monkeypatch, tmp_path):
+    """--elastic-timeout flows into ElasticDriver's world-assembly
+    deadline (distinct from --start-timeout)."""
+    from horovod_tpu.run import runner
+    from horovod_tpu.run.elastic import runner as elastic_runner
+
+    seen = {}
+
+    class FakeDriver:
+        def __init__(self, rendezvous, discovery, min_np, max_np,
+                     timeout, cooldown_range, verbose):
+            seen["timeout"] = timeout
+            raise RuntimeError("stop here")
+
+    monkeypatch.setattr(elastic_runner, "ElasticDriver", FakeDriver)
+    script = tmp_path / "d.sh"
+    script.write_text("#!/bin/sh\necho localhost:2\n")
+    script.chmod(0o755)
+    args = runner.parse_args(
+        ["-np", "2", "--host-discovery-script", str(script),
+         "--elastic-timeout", "77", "python", "x.py"])
+    with pytest.raises(RuntimeError, match="stop here"):
+        elastic_runner.run_elastic(args, ["python", "x.py"])
+    assert seen["timeout"] == 77

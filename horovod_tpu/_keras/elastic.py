@@ -25,28 +25,68 @@ class CommitStateCallbackImpl:
 
 
 class UpdateBatchStateCallbackImpl:
+    """Tracks ``state.batch`` and, when restarting an epoch after a
+    restore with ``state.batch > 0``, runs only the REMAINING batches of
+    the interrupted epoch (the reference's mid-epoch resume,
+    ``_keras/elastic.py:32-49``).
+
+    Two mechanisms, engaged together:
+
+    - shrink ``params['steps']`` (the reference's lever — honored by the
+      tf.keras-2-era training loop, which re-read it each epoch);
+    - a Keras-3-native enforcement: its trainer snapshots
+      ``steps_per_epoch`` up front and ignores params mutations, so when
+      the loop overruns the resume budget, ``on_train_batch_begin``
+      raises ``StopIteration`` — Keras 3 wraps the batch loop in
+      ``catch_stop_iteration()``, which ends exactly this epoch and
+      continues with the next one full-length. The raise fires only on
+      an actual overrun, so a loop that honored the shrink (or a stop
+      requested by another callback, e.g. EarlyStopping) is untouched.
+
+    Unlike the reference, ``state.batch`` records the GLOBAL epoch
+    position (``resume offset + local batch``): a second failure inside
+    a resumed epoch then restores to the true position instead of the
+    shrunk epoch's local index.
+    """
+
     def __init__(self, backend, state, *args):
         super().__init__(*args)
         self.backend = backend
         self.state = state
+        self.steps_per_epoch = None
+        self._resume_offset = 0
 
     def on_epoch_begin(self, epoch, logs=None):
-        if self.state.batch > 0:
-            # Resuming mid-epoch: steer fit()'s progress from state.batch.
-            self.params["initial_batch"] = self.state.batch
+        self._resume_offset = self.state.batch
+        if self.params.get("steps"):
+            if self.steps_per_epoch is None:
+                self.steps_per_epoch = self.params.get("steps")
+            self.params["steps"] = self.steps_per_epoch - self.state.batch
+
+    def on_train_batch_begin(self, batch, logs=None):
+        if (self._resume_offset and self.steps_per_epoch
+                and self._resume_offset + batch >= self.steps_per_epoch):
+            raise StopIteration  # resumed epoch's budget exhausted
 
     def on_batch_end(self, batch, logs=None):
-        self.state.batch = batch
+        self.state.batch = self._resume_offset + batch
 
     def on_epoch_end(self, epoch, logs=None):
         self.state.batch = 0
+        self._resume_offset = 0
 
 
 class UpdateEpochStateCallbackImpl:
+    """Records the index of the last COMPLETED epoch (reference
+    ``_keras/elastic.py:51-58``: assignment happens at epoch end, so a
+    mid-epoch restore re-runs the interrupted epoch; pair with
+    ``fit(epochs=total - state.epoch)`` as in the reference's elastic
+    examples)."""
+
     def __init__(self, backend, state, *args):
         super().__init__(*args)
         self.backend = backend
         self.state = state
 
-    def on_epoch_begin(self, epoch, logs=None):
+    def on_epoch_end(self, epoch, logs=None):
         self.state.epoch = epoch

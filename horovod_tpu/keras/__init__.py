@@ -21,7 +21,7 @@ from ..tensorflow import (  # noqa: F401
     ccl_built, cross_rank, cross_size, ddl_built, gloo_built, gloo_enabled,
     init, is_initialized, join, local_rank, local_size, mpi_built,
     mpi_enabled, mpi_threads_supported, nccl_built, rank, shutdown, size)
-from . import callbacks  # noqa: F401
+from . import callbacks, elastic  # noqa: F401
 
 
 def DistributedOptimizer(optimizer, name=None,

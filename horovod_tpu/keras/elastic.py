@@ -1,0 +1,41 @@
+"""Elastic Keras surface (parity: ``horovod/tensorflow/keras/elastic.py``
+and ``horovod/keras/elastic.py`` — one module here, since Keras 3 unified
+``keras``/``tf.keras``).
+
+Usage, the reference's elastic-Keras shape
+(``examples/elastic/tensorflow_keras_mnist_elastic.py``)::
+
+    import horovod_tpu.keras as hvd
+    from horovod_tpu.keras import elastic
+
+    hvd.init()
+    model.compile(optimizer=hvd.DistributedOptimizer(opt), loss=...)
+    state = elastic.KerasState(model, batch=0, epoch=0)
+
+    @elastic.run
+    def train(state):
+        model.fit(dataset, steps_per_epoch=steps,
+                  epochs=epochs - state.epoch,
+                  callbacks=[elastic.CommitStateCallback(state),
+                             elastic.UpdateBatchStateCallback(state),
+                             elastic.UpdateEpochStateCallback(state)],
+                  verbose=verbose)
+
+    train(state)
+"""
+
+from __future__ import annotations
+
+from ..tensorflow.elastic import TensorFlowKerasState
+from ..tensorflow.elastic import run  # noqa: F401  (elastic retry loop)
+from .callbacks import (  # noqa: F401
+    CommitStateCallback, UpdateBatchStateCallback, UpdateEpochStateCallback)
+
+
+class KerasState(TensorFlowKerasState):
+    """State of a Keras model + optimizer for elastic training (parity:
+    ``tensorflow/keras/elastic.py`` KerasState): snapshots weights on
+    ``commit``, restores them after a ``HorovodInternalError``, and
+    broadcasts from the coordinator on ``sync``. Extra kwargs (``batch``,
+    ``epoch``, ...) become synced attributes driven by the Update*
+    callbacks."""

@@ -14,3 +14,4 @@ from ...keras import (  # noqa: F401
     is_initialized, join, load_model, local_rank, local_size, mpi_built,
     mpi_enabled, mpi_threads_supported, nccl_built, rank, shutdown, size)
 from ...keras import callbacks  # noqa: F401
+from . import elastic  # noqa: F401

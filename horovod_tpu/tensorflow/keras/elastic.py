@@ -1,0 +1,7 @@
+"""``horovod_tpu.tensorflow.keras.elastic`` (parity:
+``horovod/tensorflow/keras/elastic.py``) — shares the Keras-3-unified
+implementation in ``horovod_tpu.keras.elastic``."""
+
+from ...keras.elastic import (  # noqa: F401
+    CommitStateCallback, KerasState, UpdateBatchStateCallback,
+    UpdateEpochStateCallback, run)

@@ -244,6 +244,87 @@ def test_autotune_categorical_sync_cross_process(tmp_path):
     run_world(tmp_path, script, "MHTUNE", drop_env=_DROP_ENV)
 
 
+def test_grouped_vs_tuned_hier_coherence_cross_process(tmp_path):
+    """Autotune coherence proof for grouped/direct-mode traffic (VERDICT
+    r4 #7): while the tuner's categorical sampling flips the
+    hierarchical flags for cycle-fused traffic (frame-stamped, applied
+    identically on every rank), grouped_allreduce_async deliberately
+    follows the STATIC config only — a mid-tune flip must never compile
+    divergent SPMD programs across ranks for interleaved grouped calls.
+
+    The proof is two-layered: (1) the interleaved schedule completes
+    with correct numbers on both processes — divergent hier-vs-flat
+    programs across ranks would wedge or corrupt the collective; (2) the
+    engine's program cache records the hier variant in each key, and
+    every grouped-path program (distinguished by its shapes) compiled
+    with hier=False on every rank, even on samples where the tuner
+    pinned hierarchical=on for the cycle-fused shapes."""
+    script = _PRELUDE.replace(
+        'os.environ["HOROVOD_HOSTNAME"] = "127.0.0.1"',
+        'os.environ["HOROVOD_HOSTNAME"] = "127.0.0.1"\n'
+        'os.environ["HOROVOD_AUTOTUNE"] = "1"\n'
+        'os.environ["HOROVOD_AUTOTUNE_WARMUP_SAMPLES"] = "1"\n'
+        'os.environ["HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"] = "1"\n'
+        'os.environ["HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"] = "2"'
+    ) + textwrap.dedent("""
+        from horovod_tpu.common.state import global_state
+
+        st = global_state()
+        assert st.hier_mesh is not None  # tuner explores hier combos
+
+        # Interleave cycle-fused traffic (shape 16 — the tuner's grid
+        # walks warmup + 4 categorical combos + GP samples across these)
+        # with grouped/direct submissions (shapes 7 and 9).
+        for i in range(10):
+            out = hvd.allreduce(
+                [jnp.full((16,), float(r + i), jnp.float32)
+                 for r in my_ranks], op=hvd.Sum, name=f"coh.{i}")
+            np.testing.assert_allclose(np.asarray(out[0]),
+                                       sum(range(4)) + 4 * i)
+            h = hvd.grouped_allreduce_async(
+                [[jnp.full((7,), float(r + i), jnp.float32)
+                  for r in my_ranks],
+                 [jnp.full((9,), 2.0 * (r + i), jnp.float32)
+                  for r in my_ranks]], op=hvd.Sum, name=f"cohg.{i}")
+            ga, gb = hvd.synchronize(h)
+            np.testing.assert_allclose(np.asarray(ga[0]),
+                                       sum(range(4)) + 4 * i)
+            np.testing.assert_allclose(np.asarray(gb[0]),
+                                       2.0 * (sum(range(4)) + 4 * i))
+
+        # The tuner's synced decision reached this rank (the flip
+        # actually happened — otherwise this test proves nothing).
+        flags = st.engine.native_core.get_hier_flags()
+        assert flags >= 0, flags
+
+        # Program-cache audit: grouped/direct programs (shapes (7,),(9,))
+        # must ALL be the static-config variant (hier=False); only the
+        # cycle-fused shape (16,) may have compiled a hier variant.
+        grouped_keys = [
+            k for k in st.engine._program_cache
+            if k[0] == "grouped_allreduce"
+            and any(s == (7,) for s, _ in k[1])
+        ]
+        assert grouped_keys, "grouped programs never compiled"
+        for k in grouped_keys:
+            assert k[-1] is False, f"grouped program used hier: {k}"
+        # Positive control: the flip genuinely happened — the tuner's
+        # categorical grid pins hier=on for some samples, so the
+        # cycle-fused shape must have compiled a hier=True variant. If
+        # the frame-stamping plumbing regressed to always-flat, the
+        # grouped audit above would pass vacuously; this catches that.
+        assert any(
+            k[0] == "grouped_allreduce"
+            and any(s == (16,) for s, _ in k[1]) and k[-1] is True
+            for k in st.engine._program_cache
+        ), "cycle-fused traffic never compiled a hier variant"
+
+        hvd.shutdown()
+        print(f"MHCOH_{rank}_OK")
+    """)
+    run_world(tmp_path, script, "MHCOH", drop_env=_DROP_ENV)
+
+
 def test_ragged_allgather_multi_chip_cross_process(tmp_path):
     """Ragged first dims on chips of BOTH processes (local_size 2): the
     per-chip dim table (Request.chip_dims -> response first_dims) drives

@@ -1,0 +1,100 @@
+"""Elastic Keras MNIST (capability parity:
+``reference examples/elastic/tensorflow_keras_mnist_elastic.py``).
+
+Run elastically — workers may come and go between commits::
+
+    hvdrun -np 2 --min-np 1 --host-discovery-script ./discover.sh \\
+        python examples/elastic/tensorflow2_keras_mnist_elastic.py
+
+The elastic pieces:
+
+- ``KerasState`` snapshots model + optimizer weights (and the ``batch``/
+  ``epoch`` counters) in memory on every commit;
+- on a collective failure (``HorovodInternalError``) the ``elastic.run``
+  wrapper restores the last commit, re-rendezvouses the surviving
+  workers, and re-enters ``train``;
+- ``UpdateBatchStateCallback``/``UpdateEpochStateCallback`` keep the
+  counters current so the re-entered ``fit`` skips work already done
+  (mid-epoch resume included);
+- the reset callback re-scales the learning rate when the world size
+  changes.
+"""
+
+import argparse
+
+import keras
+import numpy as np
+
+import horovod_tpu.keras as hvd
+from horovod_tpu.keras import elastic
+
+BASE_LR = 0.01
+
+
+def make_dataset(n, rank):
+    # Synthetic MNIST-shaped data so the example runs offline; swap for
+    # keras.datasets.mnist.load_data() with network access.
+    rng = np.random.RandomState(rank)
+    x = rng.rand(n, 28, 28, 1).astype("float32")
+    y = rng.randint(0, 10, size=(n,)).astype("int32")
+    return x, y
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--n-samples", type=int, default=4096)
+    args = p.parse_args()
+
+    hvd.init()
+
+    model = keras.Sequential([
+        keras.layers.Input(shape=(28, 28, 1)),
+        keras.layers.Conv2D(16, 3, activation="relu"),
+        keras.layers.MaxPooling2D(),
+        keras.layers.Flatten(),
+        keras.layers.Dense(64, activation="relu"),
+        keras.layers.Dense(10),
+    ])
+    # LR scales with the CURRENT world size; re-applied on re-scale.
+    opt = keras.optimizers.SGD(learning_rate=BASE_LR * hvd.size(),
+                               momentum=0.9)
+    model.compile(
+        optimizer=hvd.DistributedOptimizer(opt),
+        loss=keras.losses.SparseCategoricalCrossentropy(from_logits=True),
+        metrics=["accuracy"])
+
+    state = elastic.KerasState(model, batch=0, epoch=0)
+
+    def on_reset():
+        # World re-sized: re-scale the LR for the new worker count.
+        model.optimizer.learning_rate.assign(BASE_LR * hvd.size())
+
+    state.register_reset_callbacks([on_reset])
+
+    x, y = make_dataset(args.n_samples, hvd.rank())
+    steps = max(1, len(x) // (args.batch_size * max(1, hvd.size())))
+
+    @elastic.run
+    def train(state):
+        state.model.fit(
+            x, y, batch_size=args.batch_size, steps_per_epoch=steps,
+            epochs=args.epochs - state.epoch,
+            callbacks=[
+                elastic.CommitStateCallback(state, batches_per_commit=8),
+                elastic.UpdateBatchStateCallback(state),
+                elastic.UpdateEpochStateCallback(state),
+            ],
+            verbose=1 if hvd.rank() == 0 else 0)
+
+    train(state)
+
+    if hvd.rank() == 0:
+        loss, acc = model.evaluate(x[:256], y[:256], verbose=0)
+        print(f"elastic keras finished: loss={loss:.4f} acc={acc:.4f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -184,3 +184,11 @@ def test_long_context_example_packed():
         "--steps", "2", "--packed", "4", timeout=420)
     assert "packed: 4 docs/row" in out
     assert "tokens/s" in out
+
+
+def test_elastic_keras_mnist_example_single():
+    pytest.importorskip("keras")
+    out = _run_example("elastic/tensorflow2_keras_mnist_elastic.py",
+                       "--epochs", "1", "--batch-size", "64",
+                       "--n-samples", "256")
+    assert "elastic keras finished" in out

@@ -15,6 +15,58 @@ from typing import Any, Dict, List, Optional
 
 from .store import Store
 
+# cloudpickle handles closures/lambdas (callbacks, transformation_fn);
+# pyspark bundles one; plain pickle is the module-level-only fallback
+# (same chain as spark/exec.py).
+try:
+    import cloudpickle as _pickle
+except ImportError:
+    try:
+        from pyspark import cloudpickle as _pickle
+    except ImportError:
+        import pickle as _pickle
+
+
+def _save_dir(obj, payload, path: str, meta_name: str,
+              blob_name: str) -> None:
+    """Versioned-directory persistence shared by Estimator and Model
+    (parity role: the reference's HorovodParamsWriter,
+    ``keras/estimator.py:40-70``): a json sidecar naming the concrete
+    class + format version, and a pickle blob of ``payload``."""
+    import json
+    import os
+
+    os.makedirs(path, exist_ok=True)
+    meta = {
+        "class": f"{type(obj).__module__}.{type(obj).__qualname__}",
+        "format_version": 1,
+    }
+    with open(os.path.join(path, meta_name), "w") as f:
+        json.dump(meta, f)
+    with open(os.path.join(path, blob_name), "wb") as f:
+        _pickle.dump(payload, f)
+
+
+def _load_meta_class(cls, path: str, meta_name: str, kind: str):
+    """Read the meta sidecar and resolve+validate the saved class BEFORE
+    any pickle bytes are touched (unpickling runs arbitrary code; the
+    class gate must come first)."""
+    import importlib
+    import json
+    import os
+
+    with open(os.path.join(path, meta_name)) as f:
+        meta = json.load(f)
+    if meta.get("format_version") != 1:
+        raise ValueError(
+            f"unsupported {kind} format {meta.get('format_version')}")
+    mod_name, _, qual = meta["class"].rpartition(".")
+    klass = getattr(importlib.import_module(mod_name), qual)
+    if not (klass is cls or issubclass(klass, cls)):
+        raise TypeError(
+            f"saved {kind} is a {meta['class']}, not a {cls.__qualname__}")
+    return klass
+
 
 def _to_int(name, v):
     if isinstance(v, bool) or not isinstance(v, (int, float)):
@@ -149,6 +201,37 @@ install_accessors(EstimatorParams)
 class HorovodEstimator(EstimatorParams):
     """Base estimator (parity: ``common/estimator.py:26``)."""
 
+    # -- persistence (parity: the Spark-ML read/write surface the
+    # reference provides through HorovodParamsWriter/Reader with custom
+    # param serializers, keras/estimator.py:40-101; pyspark-free here:
+    # params ride cloudpickle, the directory format is versioned) -------
+
+    _PERSIST_META = "estimator.json"
+    _PERSIST_PARAMS = "params.pkl"
+
+    def save(self, path: str) -> "HorovodEstimator":
+        """Persist this estimator (all params, including the model and
+        any callbacks/functions) to a directory; reload with
+        ``load(path)`` — the reference's ``est.write().save(path)``."""
+        _save_dir(self, self._params, path, self._PERSIST_META,
+                  self._PERSIST_PARAMS)
+        return self
+
+    @classmethod
+    def load(cls, path: str) -> "HorovodEstimator":
+        """Reload an estimator saved with ``save`` (reference
+        ``Estimator.read().load(path)``). Returns an instance of the
+        originally-saved class (which must be ``cls`` or a subclass)."""
+        import os
+        import pickle
+
+        klass = _load_meta_class(cls, path, cls._PERSIST_META, "estimator")
+        with open(os.path.join(path, cls._PERSIST_PARAMS), "rb") as f:
+            params = pickle.load(f)
+        est = klass()
+        est._params.update(params)
+        return est
+
     def _validate(self) -> None:
         if self.getOrDefault("model") is None:
             raise ValueError("model is required")
@@ -228,6 +311,34 @@ class HorovodModel:
         self.feature_cols = feature_cols
         self.label_cols = label_cols
         self.run_id = run_id
+
+    # -- persistence (the Spark-ML Model read/write role) --------------------
+
+    _PERSIST_META = "model.json"
+    _PERSIST_BLOB = "model.pkl"
+
+    def save(self, path: str) -> "HorovodModel":
+        """Persist the trained-model wrapper (framework model + columns
+        + history/metadata) to a directory; reload with ``load(path)``.
+        Keras 3 and torch models both round-trip through cloudpickle."""
+        _save_dir(self, self, path, self._PERSIST_META, self._PERSIST_BLOB)
+        return self
+
+    @classmethod
+    def load(cls, path: str) -> "HorovodModel":
+        import os
+        import pickle
+
+        # Class gate runs on the json sidecar BEFORE any pickle bytes
+        # are touched (unpickling executes arbitrary code).
+        _load_meta_class(cls, path, cls._PERSIST_META, "model")
+        with open(os.path.join(path, cls._PERSIST_BLOB), "rb") as f:
+            obj = pickle.load(f)
+        if not isinstance(obj, cls):
+            raise TypeError(
+                f"saved model is a {type(obj).__qualname__}, not a "
+                f"{cls.__qualname__}")
+        return obj
 
     def transform(self, df):
         from .. import _require_pyspark

@@ -265,6 +265,16 @@ def test_adasum_vhdd_multiprocess(size, tmp_path):
                  extra_args=(size,))
 
 
+@pytest.mark.full
+def test_adasum_vhdd_16_processes(tmp_path):
+    """Deep-recursion VHDD: 16 ranks = 4 halving levels, peer links up
+    to rank^8, scalar binomial trees spanning the full world — the
+    controller, ring and pairwise planes all at the largest pow2 world
+    this single-core machine can still schedule."""
+    _run_workers(tmp_path, _ADASUM_WORKER, "ADASUM", size=16,
+                 extra_args=(16,), timeout=360)
+
+
 _JOIN_WORKER = textwrap.dedent("""
     import os, sys
     import numpy as np

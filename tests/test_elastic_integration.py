@@ -251,3 +251,73 @@ def test_elastic_worker_failure_recovery(tmp_path):
     text = _read_log(log)
     assert "DONE RANK 0 BATCHES 8" in text, text
     assert "DONE RANK 1 BATCHES 8" in text, text
+
+
+_KERAS_TRAIN = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    import numpy as np
+    import keras
+    import horovod_tpu.keras as hvd
+    from horovod_tpu.keras import elastic
+
+    hvd.init()
+
+    model = keras.Sequential([
+        keras.layers.Input(shape=(4,)),
+        keras.layers.Dense(1),
+    ])
+    model.compile(
+        optimizer=hvd.DistributedOptimizer(
+            keras.optimizers.SGD(learning_rate=0.05)),
+        loss="mse")
+
+    state = elastic.KerasState(model, batch=0, epoch=0)
+    rng = np.random.RandomState(0)
+    x = rng.rand(64, 4).astype("float32")
+    y = (x.sum(axis=1, keepdims=True) * 0.5).astype("float32")
+
+    @elastic.run
+    def train(state):
+        state.model.fit(
+            x, y, batch_size=16, steps_per_epoch=4,
+            epochs=3 - state.epoch,
+            callbacks=[
+                elastic.CommitStateCallback(state, batches_per_commit=2),
+                elastic.UpdateBatchStateCallback(state),
+                elastic.UpdateEpochStateCallback(state),
+            ],
+            verbose=0)
+
+    train(state)
+    assert state.epoch == 2, state.epoch
+    print(f"KELASTIC_RANK_{hvd.rank()}_DONE")
+""")
+
+
+def test_elastic_keras_end_to_end(tmp_path):
+    """Keras flavor of the elastic integration (reference per-framework
+    test_elastic_* pattern, SURVEY §4 Pattern 3): hvdrun elastic launch,
+    KerasState + Commit/Update callbacks through real fit epochs on
+    every rank."""
+    pytest.importorskip("keras")
+    script = tmp_path / "ktrain.py"
+    script.write_text(_KERAS_TRAIN)
+    discover = tmp_path / "discover.sh"
+    discover.write_text("#!/bin/sh\necho localhost:2\n")
+    discover.chmod(0o755)
+
+    env = dict(os.environ)
+    env["HVD_REPO"] = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run",
+         "-np", "2", "--min-np", "2",
+         "--host-discovery-script", str(discover),
+         "--cycle-time-ms", "1.0",
+         sys.executable, str(script)],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "KELASTIC_RANK_0_DONE" in proc.stdout
+    assert "KELASTIC_RANK_1_DONE" in proc.stdout

@@ -47,10 +47,26 @@ PROBES_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # from "enumerated but compute wedged" in the fallback JSON's note.
 LAST_PROBE_FAILURE = None
 
-# ResNet-50 at 224x224 is ~4.1 GMACs forward per image = ~8.2 GFLOPs in
-# the FMA-counts-as-2 convention hardware peaks use; a training step
-# (fwd + bwd) is conventionally ~3x forward. Used only for the MFU field.
-TRAIN_FLOPS_PER_IMAGE = 3 * 2 * 4.1e9
+# Forward GMACs per image at the canonical input size, x2 for the
+# FMA-counts-as-2 convention hardware peaks use; a training step
+# (fwd + bwd) is conventionally ~3x forward. Used only for the MFU
+# field. The model set mirrors the reference's headline benchmark trio
+# (docs/benchmarks.rst:8-13: Inception V3 / ResNet / VGG-16) plus the
+# ResNet-101 its throughput table quotes (:43).
+MODELS = {
+    "resnet50": {"fwd_flops": 2 * 4.1e9, "size": 224,
+                 "module": "horovod_tpu.models.resnet", "cls": "ResNet50",
+                 "s2d": True},
+    "resnet101": {"fwd_flops": 2 * 7.6e9, "size": 224,
+                  "module": "horovod_tpu.models.resnet",
+                  "cls": "ResNet101", "s2d": True},
+    "vgg16": {"fwd_flops": 2 * 15.5e9, "size": 224,
+              "module": "horovod_tpu.models.vgg", "cls": "VGG16",
+              "s2d": False},
+    "inception3": {"fwd_flops": 2 * 2.85e9, "size": 299,
+                   "module": "horovod_tpu.models.inception",
+                   "cls": "InceptionV3", "s2d": False},
+}
 
 # Dense bf16 peak per chip, by device_kind substring (lowercase match).
 PEAK_FLOPS_BY_KIND = [
@@ -147,9 +163,11 @@ def _save_capture(result):
         print(f"bench: capture save failed: {e}", file=sys.stderr)
 
 
-def _latest_capture():
-    """Return the newest docs/probes/bench_tpu_*.json payload, annotated
-    with its capture timestamp and provenance, or None."""
+def _latest_capture(model="resnet50"):
+    """Return the newest docs/probes/bench_tpu_*.json payload FOR THIS
+    MODEL, annotated with its capture timestamp and provenance, or None.
+    Captures predating the workload block carry no model field and were
+    all resnet50 runs."""
     try:
         names = sorted(n for n in os.listdir(PROBES_DIR)
                        if n.startswith("bench_tpu_") and n.endswith(".json"))
@@ -163,6 +181,9 @@ def _latest_capture():
         except (OSError, ValueError):
             continue
         if not isinstance(data, dict):
+            continue
+        cap_model = (data.get("workload") or {}).get("model", "resnet50")
+        if cap_model != model:
             continue
         stamp = name[len("bench_tpu_"):-len(".json")]
         data["captured_at_utc"] = stamp
@@ -201,10 +222,21 @@ def _run_worker(extra_args, env, timeout_s):
 
 def _build_parser():
     parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="resnet50",
+                        choices=sorted(MODELS),
+                        help="benchmark model (the reference's headline "
+                             "trio + ResNet-101); the driver-facing "
+                             "default stays resnet50. The non-default "
+                             "models are TPU-targeted (harvest phases): "
+                             "their full train-step compile exceeds this "
+                             "image's single-core CPU-fallback budget, "
+                             "so expect a timeout artifact off-chip")
     parser.add_argument("--batch-size", type=int, default=32)
     parser.add_argument("--num-warmup", type=int, default=5)
     parser.add_argument("--num-iters", type=int, default=30)
-    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--image-size", type=int, default=None,
+                        help="defaults to the model's canonical size "
+                             "(224; 299 for inception3)")
     parser.add_argument("--fence-each", action="store_true",
                         help="fence every timed iteration and report "
                              "steps/sec with a 95%% CI (regression-canary "
@@ -225,6 +257,8 @@ def _build_parser():
 
 def supervise(argv):
     args = _build_parser().parse_args(argv)
+    if args.image_size is None:
+        args.image_size = MODELS[args.model]["size"]
 
     # Single compute probe, then decide. The known bad state (wedged
     # tunnel) lasts hours, so retrying here only delays the fallback
@@ -253,7 +287,8 @@ def supervise(argv):
         fail_reason = (LAST_PROBE_FAILURE
                        or "accelerator backend unreachable")
     if platform:
-        worker_args = ["--batch-size", str(args.batch_size),
+        worker_args = ["--model", args.model,
+                       "--batch-size", str(args.batch_size),
                        "--num-warmup", str(args.num_warmup),
                        "--num-iters", str(args.num_iters),
                        "--image-size", str(args.image_size)]
@@ -268,17 +303,26 @@ def supervise(argv):
             if device_kind:
                 result["device_kind"] = device_kind
             peak = _peak_flops(device_kind)
+            spec = MODELS[args.model]
+            # Conv FLOPs scale ~quadratically with input size; scale the
+            # canonical-size figure so a non-canonical --image-size run
+            # doesn't overstate MFU.
+            train_flops = (3 * spec["fwd_flops"]
+                           * (args.image_size / spec["size"]) ** 2)
             if peak and isinstance(result.get("value"), (int, float)):
                 result["mfu"] = round(
-                    result["value"] * TRAIN_FLOPS_PER_IMAGE / peak, 4)
+                    result["value"] * train_flops / peak, 4)
             # Workload identity rides the artifact: without it, a
             # batch-128 or space-to-depth A/B capture is
             # indistinguishable from the headline batch-32 protocol
             # when later embedded as last_on_chip.
             result["workload"] = {
+                "model": args.model,
                 "batch_size": args.batch_size,
                 "image_size": args.image_size,
-                "space_to_depth": bool(args.space_to_depth),
+                # Effective value: only the resnets have an s2d stem.
+                "space_to_depth": (bool(args.space_to_depth)
+                                   and spec["s2d"]),
                 "fence_each": bool(args.fence_each),
                 "num_iters": args.num_iters,
             }
@@ -296,7 +340,7 @@ def supervise(argv):
 
     if args.no_fallback:
         print(json.dumps({
-            "metric": "resnet50_images_per_sec_per_chip",
+            "metric": f"{args.model}_images_per_sec_per_chip",
             "value": 0.0, "unit": "images/sec/chip", "vs_baseline": 0.0,
             "error": fail_reason + "; --no-fallback set",
         }))
@@ -316,7 +360,8 @@ def supervise(argv):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PALLAS_AXON_POOL_IPS", None)
-    fallback_args = ["--batch-size", "4", "--num-warmup", "2",
+    fallback_args = ["--model", args.model,
+                     "--batch-size", "4", "--num-warmup", "2",
                      "--num-iters", "6", "--fence-each",
                      "--image-size", str(args.image_size)]
     if args.space_to_depth:
@@ -334,14 +379,14 @@ def supervise(argv):
                           "(comparable=false: shared machine, unpinned "
                           "threads — use steps_per_sec +- ci95 only as a "
                           "same-machine drift canary).")
-        last = _latest_capture()
+        last = _latest_capture(args.model)
         if last is not None:
             result["last_on_chip"] = last
         print(json.dumps(result))
         return 0
 
     print(json.dumps({
-        "metric": "resnet50_images_per_sec_per_chip",
+        "metric": f"{args.model}_images_per_sec_per_chip",
         "value": 0.0,
         "unit": "images/sec/chip",
         "vs_baseline": 0.0,
@@ -352,6 +397,8 @@ def supervise(argv):
 
 def worker(argv):
     args = _build_parser().parse_args(argv)
+    if args.image_size is None:
+        args.image_size = MODELS[args.model]["size"]
     # At least one timed iteration: the loop variable feeds the
     # completion fence and the throughput numerator.
     args.num_iters = max(1, args.num_iters)
@@ -371,7 +418,6 @@ def worker(argv):
     import optax
 
     import horovod_tpu as hvd
-    from horovod_tpu.models.resnet import ResNet50
     from horovod_tpu.training import (
         init_train_state, make_train_step, replicate_state, shard_batch)
 
@@ -381,8 +427,17 @@ def worker(argv):
     mesh = hvd.mesh()
     mark(f"backend init done ({n} device(s))")
 
-    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16,
-                     space_to_depth_stem=args.space_to_depth)
+    # Registry-driven dispatch: a MODELS entry fully describes the model
+    # (module/class/s2d support), so adding one cannot silently fall
+    # through to the wrong constructor.
+    import importlib
+
+    spec = MODELS[args.model]
+    ctor = getattr(importlib.import_module(spec["module"]), spec["cls"])
+    kwargs = {"num_classes": 1000, "dtype": jnp.bfloat16}
+    if spec["s2d"]:
+        kwargs["space_to_depth_stem"] = args.space_to_depth
+    model = ctor(**kwargs)
     optimizer = optax.sgd(0.01, momentum=0.9)
 
     rng = jax.random.PRNGKey(0)
@@ -427,11 +482,16 @@ def worker(argv):
     img_per_sec_per_chip = img_per_sec / n
 
     result = {
-        "metric": "resnet50_images_per_sec_per_chip",
+        "metric": f"{args.model}_images_per_sec_per_chip",
         "value": round(img_per_sec_per_chip, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(
-            img_per_sec_per_chip / BASELINE_IMG_PER_SEC_PER_DEVICE, 3),
+        # The only per-device throughput the reference publishes is
+        # ResNet-101 tf_cnn_benchmarks (103.55 img/s/device); a
+        # cross-model ratio against it would be meaningless, so
+        # vs_baseline is emitted for the resnets only.
+        "vs_baseline": (round(
+            img_per_sec_per_chip / BASELINE_IMG_PER_SEC_PER_DEVICE, 3)
+            if args.model.startswith("resnet") else None),
     }
     if step_times:
         # Per-step rates + a 95% CI (the reference benchmark's

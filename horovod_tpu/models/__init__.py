@@ -1,1 +1,1 @@
-from . import resnet  # noqa: F401
+from . import inception, resnet, vgg  # noqa: F401

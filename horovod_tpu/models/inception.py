@@ -1,0 +1,174 @@
+"""Inception V3 (TPU-idiomatic flax): one of the reference's three
+headline benchmark models (``README.rst:80-84`` /
+``docs/benchmarks.rst:8-13`` report 90% scaling efficiency for
+Inception V3 at 512 GPUs).
+
+Structure follows the published architecture (Szegedy et al. 2015,
+"Rethinking the Inception Architecture"): stem → 3×InceptionA →
+InceptionB → 4×InceptionC → InceptionD → 2×InceptionE → pool → head.
+The mixed blocks' parallel branches are a good fit for XLA: each branch
+is an independent conv chain the compiler schedules side by side, and
+the concatenations are layout no-ops on TPU's channel-last tiling.
+
+TPU notes: all convs bf16 with fp32 params/BN-stats (elementwise chains
+at half HBM width), fp32 classifier head. The canonical input is
+299×299 (the stem's three stride-2 reductions need ≥75×75); the aux
+classifier is omitted (benchmark configs run without it, and the
+reference's tf_cnn_benchmarks default does too).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class ConvBN(nn.Module):
+    """Conv → BN → ReLU, the Inception building unit."""
+
+    filters: int
+    kernel: Sequence[int] = (1, 1)
+    strides: Sequence[int] = (1, 1)
+    padding: Any = "SAME"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.filters, self.kernel, self.strides,
+                    padding=self.padding, use_bias=False,
+                    dtype=self.dtype, param_dtype=jnp.float32)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=self.dtype,
+                         param_dtype=jnp.float32)(x)
+        return nn.relu(x)
+
+
+def _avg_pool_same(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(64)(x, train)
+        b2 = cbn(48)(x, train)
+        b2 = cbn(64, (5, 5))(b2, train)
+        b3 = cbn(64)(x, train)
+        b3 = cbn(96, (3, 3))(b3, train)
+        b3 = cbn(96, (3, 3))(b3, train)
+        b4 = cbn(self.pool_features)(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """Grid reduction 35→17."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(384, (3, 3), (2, 2), padding="VALID")(x, train)
+        b2 = cbn(64)(x, train)
+        b2 = cbn(96, (3, 3))(b2, train)
+        b2 = cbn(96, (3, 3), (2, 2), padding="VALID")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """Factorized 7x7 branches."""
+
+    c7: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = functools.partial(ConvBN, dtype=self.dtype)
+        c = self.c7
+        b1 = cbn(192)(x, train)
+        b2 = cbn(c)(x, train)
+        b2 = cbn(c, (1, 7))(b2, train)
+        b2 = cbn(192, (7, 1))(b2, train)
+        b3 = cbn(c)(x, train)
+        b3 = cbn(c, (7, 1))(b3, train)
+        b3 = cbn(c, (1, 7))(b3, train)
+        b3 = cbn(c, (7, 1))(b3, train)
+        b3 = cbn(192, (1, 7))(b3, train)
+        b4 = cbn(192)(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionD(nn.Module):
+    """Grid reduction 17→8."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(192)(x, train)
+        b1 = cbn(320, (3, 3), (2, 2), padding="VALID")(b1, train)
+        b2 = cbn(192)(x, train)
+        b2 = cbn(192, (1, 7))(b2, train)
+        b2 = cbn(192, (7, 1))(b2, train)
+        b2 = cbn(192, (3, 3), (2, 2), padding="VALID")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionE(nn.Module):
+    """Expanded-filter-bank blocks for the 8x8 grid."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(320)(x, train)
+        b2 = cbn(384)(x, train)
+        b2 = jnp.concatenate([cbn(384, (1, 3))(b2, train),
+                              cbn(384, (3, 1))(b2, train)], axis=-1)
+        b3 = cbn(448)(x, train)
+        b3 = cbn(384, (3, 3))(b3, train)
+        b3 = jnp.concatenate([cbn(384, (1, 3))(b3, train),
+                              cbn(384, (3, 1))(b3, train)], axis=-1)
+        b4 = cbn(192)(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = functools.partial(ConvBN, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        # Stem: 299 -> 35x35x192.
+        x = cbn(32, (3, 3), (2, 2), padding="VALID")(x, train)
+        x = cbn(32, (3, 3), padding="VALID")(x, train)
+        x = cbn(64, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = cbn(80)(x, train)
+        x = cbn(192, (3, 3), padding="VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        # Mixed blocks.
+        for pf in (32, 64, 64):
+            x = InceptionA(pool_features=pf, dtype=self.dtype)(x, train)
+        x = InceptionB(dtype=self.dtype)(x, train)
+        for c7 in (128, 160, 160, 192):
+            x = InceptionC(c7=c7, dtype=self.dtype)(x, train)
+        x = InceptionD(dtype=self.dtype)(x, train)
+        x = InceptionE(dtype=self.dtype)(x, train)
+        x = InceptionE(dtype=self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=jnp.float32)(x)

@@ -1,0 +1,65 @@
+"""VGG (TPU-idiomatic flax): one of the reference's three headline
+benchmark models (``README.rst:80-84`` / ``docs/benchmarks.rst:8-13``
+report 68% scaling efficiency for VGG-16 at 512 GPUs — VGG's huge dense
+head makes it the communication-heavy stress case of the trio).
+
+TPU notes: conv stacks run in bf16 (fp32 params) so the elementwise
+ReLU chains ride HBM at half width; the classifier head computes in
+fp32. The 25088->4096 dense layers dominate the parameter count
+(~138 M 224px/1000 classes) exactly as in the original architecture —
+that is the point of benchmarking VGG: gradient allreduce bytes per
+step are ~20x ResNet-50's.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class VGG(nn.Module):
+    # (convs per stage, filters per stage) — VGG-D is [2,2,3,3,3].
+    stage_convs: Sequence[int]
+    num_classes: int = 1000
+    num_filters: Sequence[int] = (64, 128, 256, 512, 512)
+    dense_width: int = 4096
+    dtype: Any = jnp.bfloat16
+    # Classic VGG has no batch norm; the widely-benchmarked "vgg16"
+    # (incl. tf_cnn_benchmarks) is the plain version. BN variant
+    # (vgg16_bn) is opt-in.
+    batch_norm: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, kernel_size=(3, 3),
+                                 padding="SAME", dtype=self.dtype,
+                                 param_dtype=jnp.float32)
+        x = x.astype(self.dtype)
+        for i, n_convs in enumerate(self.stage_convs):
+            for j in range(n_convs):
+                x = conv(self.num_filters[i], name=f"conv{i}_{j}")(x)
+                if self.batch_norm:
+                    x = nn.BatchNorm(use_running_average=not train,
+                                     momentum=0.9, epsilon=1e-5,
+                                     dtype=self.dtype,
+                                     param_dtype=jnp.float32,
+                                     name=f"bn{i}_{j}")(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        for k in range(2):
+            x = nn.Dense(self.dense_width, dtype=self.dtype,
+                         param_dtype=jnp.float32, name=f"fc{k}")(x)
+            x = nn.relu(x)
+        # fp32 head for a stable softmax.
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=jnp.float32, name="head")(x)
+
+
+VGG11 = functools.partial(VGG, stage_convs=[1, 1, 2, 2, 2])
+VGG13 = functools.partial(VGG, stage_convs=[2, 2, 2, 2, 2])
+VGG16 = functools.partial(VGG, stage_convs=[2, 2, 3, 3, 3])
+VGG19 = functools.partial(VGG, stage_convs=[2, 2, 4, 4, 4])

@@ -117,6 +117,13 @@ def main(argv=None):
         ("bench128", [py, "bench.py", nf, "--batch-size", "128"], 2000),
         ("pallas_sweep", [py, "tools/pallas_bench.py", "--sweep-blocks",
                           "--seq-lens", "2048", "--iters", "10"], 1200),
+        # The reference's full headline trio (benchmarks.rst:8-13) —
+        # after the decisive artifacts, since a window may close early.
+        ("bench_r101", [py, "bench.py", nf, "--model", "resnet101"], 2000),
+        ("bench_incep", [py, "bench.py", nf, "--model", "inception3"],
+         2000),
+        ("bench_vgg", [py, "bench.py", nf, "--model", "vgg16",
+                       "--batch-size", "16"], 2000),
     ]
     results = {}
     for name, cmd, to in plan:

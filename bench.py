@@ -341,7 +341,8 @@ def supervise(argv):
     if args.no_fallback:
         print(json.dumps({
             "metric": f"{args.model}_images_per_sec_per_chip",
-            "value": 0.0, "unit": "images/sec/chip", "vs_baseline": 0.0,
+            "value": 0.0, "unit": "images/sec/chip", "vs_baseline": (0.0 if args.model.startswith("resnet")
+                            else None),
             "error": fail_reason + "; --no-fallback set",
         }))
         return 1
@@ -389,7 +390,8 @@ def supervise(argv):
         "metric": f"{args.model}_images_per_sec_per_chip",
         "value": 0.0,
         "unit": "images/sec/chip",
-        "vs_baseline": 0.0,
+        "vs_baseline": (0.0 if args.model.startswith("resnet")
+                            else None),
         "error": "backend init failed on accelerator and CPU fallback",
     }))
     return 1

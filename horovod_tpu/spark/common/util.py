@@ -203,3 +203,22 @@ def to_arrays(pdf, cols: Sequence[str], meta: Dict) -> List[np.ndarray]:
             arr = pdf[col].to_numpy()
         out.append(arr.astype(info["dtype"]))
     return out
+
+
+def read_val_arrays(meta: Dict, rank: int, size: int,
+                    transformation_fn=None):
+    """This rank's validation split as ``(features, labels)`` array
+    lists, or ``None`` when the split is absent or the shard empty.
+    Shared by the keras/torch remote trainers (identical read →
+    transform → to_arrays flow; one copy so fixes can't miss a
+    framework)."""
+    if not meta.get("val_data_path"):
+        return None
+    vdf = read_shard(meta["val_data_path"], rank, size,
+                     columns=(meta["feature_cols"] + meta["label_cols"]))
+    if transformation_fn is not None:
+        vdf = transformation_fn(vdf)
+    if not len(vdf):
+        return None
+    return (to_arrays(vdf, meta["feature_cols"], meta),
+            to_arrays(vdf, meta["label_cols"], meta))

@@ -79,20 +79,15 @@ def make_remote_trainer(serialized_model: bytes, optimizer_bytes,
             # anyway), so the simple whole-shard read serves it; only the
             # training pass streams.
             val = None
-            if meta.get("val_data_path"):
-                from ..common.util import read_shard, to_arrays
+            from ..common.util import read_val_arrays
 
-                vdf = read_shard(
-                    meta["val_data_path"], hvd.rank(), hvd.size(),
-                    columns=(meta["feature_cols"] + meta["label_cols"]))
-                if transformation_fn is not None:
-                    # Same transform as the training stream — val
-                    # metrics on untransformed data would be garbage.
-                    vdf = transformation_fn(vdf)
-                if len(vdf):
-                    vx = to_arrays(vdf, meta["feature_cols"], meta)
-                    vy = to_arrays(vdf, meta["label_cols"], meta)
-                    val = (unwrap(vx), unwrap(vy))
+            # Same transform as the training stream — val metrics on
+            # untransformed data would be garbage (shared helper with
+            # the torch remote).
+            arrays = read_val_arrays(meta, hvd.rank(), hvd.size(),
+                                     transformation_fn)
+            if arrays is not None:
+                val = (unwrap(arrays[0]), unwrap(arrays[1]))
 
             cbs = [hvd.callbacks.BroadcastGlobalVariablesCallback(0),
                    hvd.callbacks.MetricAverageCallback()]

@@ -54,7 +54,55 @@ def make_remote_trainer(model_bytes: bytes, optimizer_cls, optimizer_kwargs,
                     "dataset has fewer row groups than workers; increase "
                     "num_partitions (or reduce the world size)")
 
+            # Validation shard, read whole (evaluation only; reference
+            # torch/remote.py evaluates the val split every epoch).
+            # Participation is decided by meta['val_data_path'] — the
+            # SAME on every rank — so the per-epoch val collectives
+            # cannot diverge even when some ranks' shards are empty
+            # (those contribute 0 rows to the weighted mean).
+            has_val = bool(meta.get("val_data_path"))
+            val = None
+            if has_val:
+                from ..common.util import read_val_arrays
+
+                arrays = read_val_arrays(meta, hvd.rank(), hvd.size(),
+                                         transformation_fn)
+                if arrays is not None:
+                    vx = [torch.as_tensor(np.asarray(a, np.float32))
+                          for a in arrays[0]]
+                    if input_shapes:
+                        vx = [t.reshape(tuple(s))
+                              for t, s in zip(vx, input_shapes)]
+                    vy = [torch.as_tensor(np.asarray(a))
+                          for a in arrays[1]]
+                    val = (vx, vy)
+
+            def evaluate_val():
+                """(loss_sum_weighted, rows) for the row-weighted global
+                mean; empty local shards contribute (0, 0). Evaluation
+                is mini-batched so a large validation shard never needs
+                whole-shard activations in memory at once."""
+                if val is None:
+                    return 0.0, 0.0
+                model.eval()
+                total, rows = 0.0, 0
+                n = len(val[1][0])
+                with torch.no_grad():
+                    for s in range(0, n, batch_size):
+                        bx = [t[s:s + batch_size] for t in val[0]]
+                        by = [t[s:s + batch_size] for t in val[1]]
+                        out = model(*bx)
+                        outs = (out if isinstance(out, (list, tuple))
+                                else [out])
+                        b = len(by[0])
+                        total += b * sum(float(fn(o, y)) for fn, o, y
+                                         in zip(loss_fns, outs, by))
+                        rows += b
+                model.train()
+                return total, float(rows)
+
             history = []
+            val_history = []
             model.train()
             for epoch in range(epochs):
                 total, steps = 0.0, 0
@@ -106,10 +154,21 @@ def make_remote_trainer(model_bytes: bytes, optimizer_cls, optimizer_kwargs,
                     torch.tensor(total / max(1, steps)),
                     name=f"epoch_loss.{epoch}", op=hvd.Average)
                 history.append(float(avg))
+                if has_val:
+                    lw, rows = evaluate_val()
+                    sums = hvd.allreduce(
+                        torch.tensor([lw, rows]),
+                        name=f"epoch_val_loss.{epoch}", op=hvd.Sum)
+                    val_history.append(
+                        float(sums[0]) / max(1.0, float(sums[1])))
                 if verbose and hvd.rank() == 0:
-                    print(f"epoch {epoch}: loss={float(avg):.5f}")
+                    tail = (f" val_loss={val_history[-1]:.5f}"
+                            if val_history else "")
+                    print(f"epoch {epoch}: loss={float(avg):.5f}{tail}")
 
             result = {"history": {"loss": history}}
+            if val_history:
+                result["history"]["val_loss"] = val_history
             if hvd.rank() == 0:
                 os.makedirs(os.path.dirname(checkpoint_path), exist_ok=True)
                 torch.save(model, checkpoint_path)

@@ -263,3 +263,28 @@ def test_estimator_and_model_persistence(tmp_path):
                                np.stack(after).astype(np.float64),
                                rtol=1e-6)
     assert isinstance(HorovodModel.load(str(tmp_path / "mdl")), KerasModel)
+
+
+def test_torch_estimator_validation_history(tmp_path):
+    """Torch estimator with validation= produces per-epoch val_loss
+    (reference torch/remote.py evaluates the val split every epoch;
+    row-weighted across ranks so empty shards cannot diverge the
+    collective)."""
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.spark import LocalStore, TorchEstimator
+
+    model = torch.nn.Sequential(
+        torch.nn.Linear(4, 8), torch.nn.ReLU(), torch.nn.Linear(8, 1),
+        torch.nn.Flatten(0))
+    est = TorchEstimator(
+        model=model,
+        optimizer=(torch.optim.SGD, {"lr": 0.1}),
+        loss=torch.nn.functional.mse_loss,
+        feature_cols=["features"], label_cols=["label"],
+        batch_size=16, epochs=6, validation=0.25,
+        store=LocalStore(str(tmp_path)))
+    trained = est.fit(_make_df(128))
+    h = trained.history
+    assert len(h["val_loss"]) == 6
+    assert h["val_loss"][-1] < h["val_loss"][0]
+

@@ -34,6 +34,8 @@ class ConvBN(nn.Module):
     strides: Sequence[int] = (1, 1)
     padding: Any = "SAME"
     dtype: Any = jnp.bfloat16
+    # Cross-replica BN statistics (see resnet.ResNet.sync_bn_axis).
+    sync_bn_axis: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -42,7 +44,8 @@ class ConvBN(nn.Module):
                     dtype=self.dtype, param_dtype=jnp.float32)(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                          epsilon=1e-3, dtype=self.dtype,
-                         param_dtype=jnp.float32)(x)
+                         param_dtype=jnp.float32,
+                         axis_name=self.sync_bn_axis)(x)
         return nn.relu(x)
 
 
@@ -53,10 +56,12 @@ def _avg_pool_same(x):
 class InceptionA(nn.Module):
     pool_features: int
     dtype: Any = jnp.bfloat16
+    sync_bn_axis: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        cbn = functools.partial(ConvBN, dtype=self.dtype)
+        cbn = functools.partial(ConvBN, dtype=self.dtype,
+                                sync_bn_axis=self.sync_bn_axis)
         b1 = cbn(64)(x, train)
         b2 = cbn(48)(x, train)
         b2 = cbn(64, (5, 5))(b2, train)
@@ -71,10 +76,12 @@ class InceptionB(nn.Module):
     """Grid reduction 35→17."""
 
     dtype: Any = jnp.bfloat16
+    sync_bn_axis: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        cbn = functools.partial(ConvBN, dtype=self.dtype)
+        cbn = functools.partial(ConvBN, dtype=self.dtype,
+                                sync_bn_axis=self.sync_bn_axis)
         b1 = cbn(384, (3, 3), (2, 2), padding="VALID")(x, train)
         b2 = cbn(64)(x, train)
         b2 = cbn(96, (3, 3))(b2, train)
@@ -88,10 +95,12 @@ class InceptionC(nn.Module):
 
     c7: int
     dtype: Any = jnp.bfloat16
+    sync_bn_axis: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        cbn = functools.partial(ConvBN, dtype=self.dtype)
+        cbn = functools.partial(ConvBN, dtype=self.dtype,
+                                sync_bn_axis=self.sync_bn_axis)
         c = self.c7
         b1 = cbn(192)(x, train)
         b2 = cbn(c)(x, train)
@@ -110,10 +119,12 @@ class InceptionD(nn.Module):
     """Grid reduction 17→8."""
 
     dtype: Any = jnp.bfloat16
+    sync_bn_axis: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        cbn = functools.partial(ConvBN, dtype=self.dtype)
+        cbn = functools.partial(ConvBN, dtype=self.dtype,
+                                sync_bn_axis=self.sync_bn_axis)
         b1 = cbn(192)(x, train)
         b1 = cbn(320, (3, 3), (2, 2), padding="VALID")(b1, train)
         b2 = cbn(192)(x, train)
@@ -128,10 +139,12 @@ class InceptionE(nn.Module):
     """Expanded-filter-bank blocks for the 8x8 grid."""
 
     dtype: Any = jnp.bfloat16
+    sync_bn_axis: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        cbn = functools.partial(ConvBN, dtype=self.dtype)
+        cbn = functools.partial(ConvBN, dtype=self.dtype,
+                                sync_bn_axis=self.sync_bn_axis)
         b1 = cbn(320)(x, train)
         b2 = cbn(384)(x, train)
         b2 = jnp.concatenate([cbn(384, (1, 3))(b2, train),
@@ -147,10 +160,12 @@ class InceptionE(nn.Module):
 class InceptionV3(nn.Module):
     num_classes: int = 1000
     dtype: Any = jnp.bfloat16
+    sync_bn_axis: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        cbn = functools.partial(ConvBN, dtype=self.dtype)
+        cbn = functools.partial(ConvBN, dtype=self.dtype,
+                                sync_bn_axis=self.sync_bn_axis)
         x = x.astype(self.dtype)
         # Stem: 299 -> 35x35x192.
         x = cbn(32, (3, 3), (2, 2), padding="VALID")(x, train)
@@ -162,13 +177,19 @@ class InceptionV3(nn.Module):
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
         # Mixed blocks.
         for pf in (32, 64, 64):
-            x = InceptionA(pool_features=pf, dtype=self.dtype)(x, train)
-        x = InceptionB(dtype=self.dtype)(x, train)
+            x = InceptionA(pool_features=pf, dtype=self.dtype,
+                           sync_bn_axis=self.sync_bn_axis)(x, train)
+        x = InceptionB(dtype=self.dtype,
+                       sync_bn_axis=self.sync_bn_axis)(x, train)
         for c7 in (128, 160, 160, 192):
-            x = InceptionC(c7=c7, dtype=self.dtype)(x, train)
-        x = InceptionD(dtype=self.dtype)(x, train)
-        x = InceptionE(dtype=self.dtype)(x, train)
-        x = InceptionE(dtype=self.dtype)(x, train)
+            x = InceptionC(c7=c7, dtype=self.dtype,
+                           sync_bn_axis=self.sync_bn_axis)(x, train)
+        x = InceptionD(dtype=self.dtype,
+                       sync_bn_axis=self.sync_bn_axis)(x, train)
+        x = InceptionE(dtype=self.dtype,
+                       sync_bn_axis=self.sync_bn_axis)(x, train)
+        x = InceptionE(dtype=self.dtype,
+                       sync_bn_axis=self.sync_bn_axis)(x, train)
         x = jnp.mean(x, axis=(1, 2))
         return nn.Dense(self.num_classes, dtype=jnp.float32,
                         param_dtype=jnp.float32)(x)

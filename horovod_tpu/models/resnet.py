@@ -117,6 +117,14 @@ class ResNet(nn.Module):
     # stem_weights_to_s2d maps any original kernel exactly). Opt-in so
     # checkpoints keep the reference layout by default.
     space_to_depth_stem: bool = False
+    # Cross-replica batch norm (the compiled-path role of the reference's
+    # torch SyncBatchNorm, torch/sync_batch_norm.py:35-194): with a mesh
+    # axis name, BN statistics are psum'd over that axis inside the
+    # sharded step, so normalization uses GLOBAL-batch statistics — the
+    # correctness lever for small per-chip batches at large dp. On ICI
+    # this is a pair of tiny per-layer allreduces XLA overlaps with the
+    # convs; None (default) keeps per-shard stats.
+    sync_bn_axis: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -129,7 +137,8 @@ class ResNet(nn.Module):
         # width instead of fp32 — on v5e this path is bandwidth-bound.
         norm = functools.partial(nn.BatchNorm, use_running_average=not train,
                                  momentum=0.9, epsilon=1e-5,
-                                 dtype=self.dtype, param_dtype=jnp.float32)
+                                 dtype=self.dtype, param_dtype=jnp.float32,
+                                 axis_name=self.sync_bn_axis)
         x = x.astype(self.dtype)
         if self.space_to_depth_stem:
             x = space_to_depth(x, 2)

@@ -31,6 +31,9 @@ class VGG(nn.Module):
     # (incl. tf_cnn_benchmarks) is the plain version. BN variant
     # (vgg16_bn) is opt-in.
     batch_norm: bool = False
+    # Cross-replica BN statistics (see resnet.ResNet.sync_bn_axis);
+    # effective only with batch_norm=True.
+    sync_bn_axis: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -46,6 +49,7 @@ class VGG(nn.Module):
                                      momentum=0.9, epsilon=1e-5,
                                      dtype=self.dtype,
                                      param_dtype=jnp.float32,
+                                     axis_name=self.sync_bn_axis,
                                      name=f"bn{i}_{j}")(x)
                 x = nn.relu(x)
             x = nn.max_pool(x, (2, 2), strides=(2, 2))

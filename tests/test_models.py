@@ -102,6 +102,8 @@ def test_vgg16_structure_and_forward():
     n = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(shapes["params"]))
     assert n == 138_357_544, n  # canonical VGG-16
 
+    # The whole zoo accepts the cross-replica-BN axis.
+    VGG16(batch_norm=True, sync_bn_axis="hvd")
     small = VGG16(num_classes=10, num_filters=(8, 8, 8, 8, 8),
                   dense_width=32)
     v = small.init(jax.random.PRNGKey(0), jnp.zeros((2, 64, 64, 3)),
@@ -118,6 +120,7 @@ def test_inception_v3_structure_and_forward():
     viable input (the stem's three stride-2 reductions need >=75px)."""
     from horovod_tpu.models.inception import InceptionV3
 
+    InceptionV3(sync_bn_axis="hvd")  # zoo-wide cross-replica-BN axis
     model = InceptionV3(num_classes=1000)
     shapes = jax.eval_shape(
         lambda r: model.init(r, jnp.zeros((1, 299, 299, 3)), train=False),
@@ -153,3 +156,62 @@ def test_vgg_train_step_runs(hvd):
     y = jnp.zeros((n,), jnp.int32)
     state2, loss = step(state, x, y)
     assert bool(jnp.isfinite(loss))
+
+
+@pytest.mark.full
+def test_sync_batch_norm_matches_global_batch(hvd):
+    """sync_bn_axis (the compiled-path SyncBatchNorm, reference
+    torch/sync_batch_norm.py): with BN statistics psum'd over the dp
+    axis, the sharded training-mode forward must match a single-device
+    run over the FULL batch — and without it, per-shard statistics must
+    NOT (the positive control that the sync changes the math)."""
+    import optax
+
+    from horovod_tpu.models.resnet import ResNet18
+
+    mesh = hvd.mesh()
+    n = mesh.devices.size
+    if n == 1:
+        pytest.skip("per-shard stats ARE global stats at one device; "
+                    "the positive control needs a multi-device mesh")
+    rng = np.random.RandomState(0)
+    # Non-iid shards: each device's local batch has a different mean, so
+    # per-shard and global BN statistics differ strongly.
+    x = np.concatenate([
+        rng.rand(2, 32, 32, 3).astype(np.float32) + 3.0 * d
+        for d in range(n)])
+    y = rng.randint(0, 10, size=(2 * n,)).astype(np.int32)
+
+    def loss_of(model):
+        from horovod_tpu.training import init_train_state, make_train_step
+
+        state = init_train_state(model, optax.sgd(0.01),
+                                 jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 32, 32, 3)))
+        from horovod_tpu.training import shard_batch
+
+        step = make_train_step(model, optax.sgd(0.01), mesh, donate=False)
+        xs, ys = shard_batch((jnp.asarray(x), jnp.asarray(y)), mesh)
+        _, loss = step(state, xs, ys)
+        return float(loss)
+
+    # Dense oracle: the same model/params on one device, full batch.
+    def dense_loss():
+        from horovod_tpu.training import cross_entropy_loss
+
+        model = ResNet18(num_classes=10, dtype=jnp.float32, num_filters=8)
+        v = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)),
+                       train=False)
+        logits, _ = model.apply(v, jnp.asarray(x), train=True,
+                                mutable=["batch_stats"])
+        return float(cross_entropy_loss(logits, jnp.asarray(y)))
+
+    synced = loss_of(ResNet18(num_classes=10, dtype=jnp.float32,
+                              num_filters=8, sync_bn_axis="hvd"))
+    local = loss_of(ResNet18(num_classes=10, dtype=jnp.float32,
+                             num_filters=8))
+    expected = dense_loss()
+    assert synced == pytest.approx(expected, rel=1e-4), (synced, expected)
+    assert abs(local - expected) > 1e-3, (
+        "per-shard BN unexpectedly matched the global-batch oracle — "
+        "the shards are not statistically distinct enough")

@@ -65,16 +65,15 @@ def run_elastic(args, command: List[str],
         verbose=1 if args.verbose else 0)
 
     def launcher_addr() -> str:
+        # Shared with the static/jsrun paths so --network-interface pins
+        # the advertised NIC here too (elastic is where it matters most:
+        # hosts change at runtime and every newcomer must reach the
+        # launcher over the pinned fabric).
+        from ..runner import _launcher_addr
+
         hosts_now = [h for h, _ in driver.host_manager.current_hosts]
         plan_like = [type("S", (), {"hostname": h})() for h in hosts_now]
-        if all(_launch.is_local(s.hostname) for s in plan_like):
-            return "127.0.0.1"
-        import socket as _socket
-
-        try:
-            return _socket.gethostbyname(_socket.gethostname())
-        except OSError:
-            return _socket.gethostname()
+        return _launcher_addr(plan_like, getattr(args, "nics", None))
 
     def create_worker(slot, events):
         worker_env = _launch.slot_env(

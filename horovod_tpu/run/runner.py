@@ -306,10 +306,26 @@ def _controller_addr(host_alloc_plan) -> str:
     return first
 
 
-def _launcher_addr(plan) -> str:
-    """Address where workers reach launcher-side services (rendezvous)."""
+def _launcher_addr(plan, nics=None) -> str:
+    """Address where workers reach launcher-side services (rendezvous).
+
+    ``nics`` (the --network-interface allowlist, comma string or
+    iterable) pins the advertised address to a named interface — the
+    reference's NIC-restriction knob (``run/runner.py`` --network-
+    interface + the driver service's interface intersection)."""
     if all(_launch.is_local(s.hostname) for s in plan):
         return "127.0.0.1"
+    if nics:
+        from .common.util.network import get_local_addresses
+
+        allowed = ({n.strip() for n in nics.split(",") if n.strip()}
+                   if isinstance(nics, str) else set(nics))
+        for name, ip in get_local_addresses():
+            if name in allowed:
+                return ip
+        raise ValueError(
+            f"--network-interface {sorted(allowed)} matched no local "
+            "interface with an IPv4 address")
     try:
         return socket.gethostbyname(socket.gethostname())
     except OSError:
@@ -345,7 +361,8 @@ def _run_static(args, command: List[str], base_env: Optional[dict] = None,
         codes = _launch.launch_workers(
             plan, command, controller_addr=addr,
             controller_port=controller_port,
-            rendezvous_addr=_launcher_addr(plan),
+            rendezvous_addr=_launcher_addr(
+                plan, getattr(args, 'nics', None)),
             rendezvous_port=rendezvous_port,
             ssh_port=getattr(args, "ssh_port", None), base_env=env,
             output_filename=getattr(args, "output_filename", None))
@@ -455,7 +472,8 @@ def _run_jsrun(args, command: List[str]) -> int:
 
     env = _job_env(args)
     env[_config.HOROVOD_SIZE] = str(np_)
-    env[_config.HOROVOD_RENDEZVOUS_ADDR] = _launcher_addr(plan)
+    env[_config.HOROVOD_RENDEZVOUS_ADDR] = _launcher_addr(
+        plan, getattr(args, 'nics', None))
     env[_config.HOROVOD_RENDEZVOUS_PORT] = str(rendezvous_port)
     env[_config.HOROVOD_CONTROLLER_ADDR] = _controller_addr(plan)
     env[_config.HOROVOD_CONTROLLER_PORT] = str(_launch.free_port())

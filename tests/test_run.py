@@ -505,3 +505,27 @@ def test_elastic_timeout_reaches_driver(monkeypatch, tmp_path):
     with pytest.raises(RuntimeError, match="stop here"):
         elastic_runner.run_elastic(args, ["python", "x.py"])
     assert seen["timeout"] == 77
+
+
+def test_network_interface_pins_rendezvous_addr(monkeypatch):
+    """--network-interface restricts the advertised launcher address to
+    a named NIC (reference run/runner.py --network-interface); unknown
+    interfaces fail with a descriptive error rather than advertising
+    whatever the resolver picks."""
+    from horovod_tpu.run import runner
+    from horovod_tpu.run.common.util import network
+    from horovod_tpu.run.common.util import hosts as hosts_util
+
+    remote_plan = hosts_util.get_host_assignments(
+        hosts_util.parse_hosts("localhost:1,nodeA:1"), 2)
+    monkeypatch.setattr(
+        network, "get_local_addresses",
+        lambda: [("eth0", "10.0.0.5"), ("ib0", "192.168.9.9")])
+    assert runner._launcher_addr(remote_plan, "ib0") == "192.168.9.9"
+    assert runner._launcher_addr(remote_plan, "eth0,ib0") == "10.0.0.5"
+    with pytest.raises(ValueError, match="bond0"):
+        runner._launcher_addr(remote_plan, "bond0")
+    # Pure-local plans stay on loopback regardless.
+    local_plan = hosts_util.get_host_assignments(
+        hosts_util.parse_hosts("localhost:2"), 2)
+    assert runner._launcher_addr(local_plan, "ib0") == "127.0.0.1"

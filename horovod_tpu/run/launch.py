@@ -83,6 +83,44 @@ def build_worker_command(slot: SlotInfo, command: List[str],
            f"{shlex.quote(remote)}"
 
 
+def check_ssh_all_hosts(hostnames, ssh_port: Optional[int] = None,
+                        timeout: float = 15.0) -> None:
+    """Preflight: every remote host must be reachable over passwordless
+    ssh BEFORE any worker launches (reference ``runner.py:641-648`` —
+    failing one rank mid-launch leaves the rest to time out at
+    rendezvous; failing fast here names the broken hosts instead).
+    Probes run in parallel; raises listing every unreachable host."""
+    import concurrent.futures
+    import subprocess
+
+    remote = sorted({h for h in hostnames if not is_local(h)})
+    if not remote:
+        return
+
+    def probe(host):
+        port_args = ["-p", str(ssh_port)] if ssh_port else []
+        cmd = (SSH_COMMAND_PREFIX.split() + port_args
+               + ["-o", "BatchMode=yes",
+                  "-o", f"ConnectTimeout={int(timeout)}", host, "true"])
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=timeout + 5)
+            return host, r.returncode == 0, (r.stderr or "").strip()
+        except subprocess.TimeoutExpired:
+            return host, False, f"ssh timed out after {timeout:.0f}s"
+
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(16, len(remote))) as pool:
+        results = list(pool.map(probe, remote))
+    bad = [(h, msg) for h, ok, msg in results if not ok]
+    if bad:
+        detail = "; ".join(f"{h}: {msg or 'ssh failed'}" for h, msg in bad)
+        raise RuntimeError(
+            f"ssh preflight failed for {len(bad)}/{len(remote)} remote "
+            f"host(s) — {detail}. Passwordless ssh to every host is "
+            "required (reference horovodrun contract).")
+
+
 def execute_redirected(cmd, env, events, output_dir: str, rank: int,
                        mode: str = "w") -> int:
     """Run a worker with stdout/stderr redirected to

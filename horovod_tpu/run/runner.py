@@ -349,6 +349,12 @@ def _run_static(args, command: List[str], base_env: Optional[dict] = None,
     np_ = args.np or sum(h.slots for h in hosts)
     plan = _hosts.get_host_assignments(hosts, np_)
 
+    # Fail fast with named hosts before any worker launches (reference
+    # runner.py:641-648 ssh check). Probe only hosts the plan actually
+    # assigns ranks to — trailing unused hosts must not block a launch.
+    _launch.check_ssh_all_hosts({s.hostname for s in plan},
+                                ssh_port=getattr(args, "ssh_port", None))
+
     rendezvous = RendezvousServer(verbose=1 if args.verbose else 0)
     rendezvous_port = rendezvous.start_server()
     rendezvous.init(plan)

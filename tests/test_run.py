@@ -529,3 +529,41 @@ def test_network_interface_pins_rendezvous_addr(monkeypatch):
     local_plan = hosts_util.get_host_assignments(
         hosts_util.parse_hosts("localhost:2"), 2)
     assert runner._launcher_addr(local_plan, "ib0") == "127.0.0.1"
+
+
+def test_ssh_preflight_check(monkeypatch):
+    """Remote hosts are ssh-probed in parallel BEFORE any worker
+    launches; failures raise naming every broken host (reference
+    runner.py:641-648). Local-only plans skip the probe entirely."""
+    import subprocess as sp
+
+    from horovod_tpu.run import launch as lm
+
+    calls = []
+
+    class R:
+        def __init__(self, rc, err=""):
+            self.returncode = rc
+            self.stderr = err
+
+    def fake_run(cmd, **kw):
+        calls.append(cmd)
+        host = cmd[-2]
+        assert cmd[-1] == "true"
+        assert "BatchMode=yes" in " ".join(cmd)
+        return R(0) if host == "goodhost" else R(255, "Connection refused")
+
+    monkeypatch.setattr(sp, "run", fake_run)
+    # All reachable: no raise; one probe per unique remote host, none
+    # for local names.
+    lm.check_ssh_all_hosts(["localhost", "goodhost", "goodhost"])
+    assert sum(1 for c in calls if c[-2] == "goodhost") == 1
+    # Local-only: no probes at all.
+    n = len(calls)
+    lm.check_ssh_all_hosts(["localhost", "127.0.0.1"])
+    assert len(calls) == n
+    # Unreachable host named in the error; ssh port rides the command.
+    with pytest.raises(RuntimeError, match="badhost.*Connection refused"):
+        lm.check_ssh_all_hosts(["goodhost", "badhost"], ssh_port=2222)
+    port_cmds = [c for c in calls if c[-2] == "badhost"]
+    assert port_cmds and "2222" in port_cmds[0]

@@ -265,6 +265,62 @@ def test_adasum_vhdd_multiprocess(size, tmp_path):
                  extra_args=(size,))
 
 
+_STALL_WORKER = textwrap.dedent("""
+    import os, sys, time
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    from horovod_tpu.common import native as hn
+
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    core = hn.NativeCore()
+    assert core.available
+    ok = core.init(rank=rank, size=2, local_rank=0, local_size=1,
+                   cross_rank=rank, cross_size=2,
+                   coordinator_addr="127.0.0.1", coordinator_port=port,
+                   my_host="127.0.0.1", cycle_time_ms=1.0,
+                   fusion_threshold=64 << 20, cache_capacity=64,
+                   stall_warning_sec=1.0, stall_shutdown_sec=0.0,
+                   stall_check_enabled=True,
+                   exec_callback=lambda resp, rid: core.response_done(
+                       rid, False, "host-plane only"))
+    assert ok, "native init failed"
+
+    a = np.ones(8, np.float32)
+    if rank == 0:
+        # Submit and wait; rank 1 stalls deliberately for >1s.
+        h = core.enqueue("stall.t", hn.OP_ALLREDUCE, 1, 7, a.shape,
+                         data_ptr=a.ctypes.data, output_ptr=a.ctypes.data,
+                         plane=hn.PLANE_HOST)
+        # The coordinator must report the missing-rank tensor after the
+        # 1s threshold (reference stall_inspector report contract,
+        # test_stall.py:25 pattern).
+        report = ""
+        deadline = time.time() + 20
+        while time.time() < deadline and "stall.t" not in report:
+            time.sleep(0.5)
+            report += core.stall_report()
+        assert "stall.t" in report, f"no stall warning: {report!r}"
+        r, err = core.wait(h); assert r == 1, err
+    else:
+        time.sleep(4.0)  # stall past the warning threshold
+        h = core.enqueue("stall.t", hn.OP_ALLREDUCE, 1, 7, a.shape,
+                         data_ptr=a.ctypes.data, output_ptr=a.ctypes.data,
+                         plane=hn.PLANE_HOST)
+        r, err = core.wait(h); assert r == 1, err
+    assert np.allclose(a, 2.0), a[:4]
+    core.shutdown()
+    print(f"STALL_{rank}_OK")
+""")
+
+
+def test_stall_warning_triggers_and_recovers(tmp_path):
+    """One rank submits, the other stalls past the warning threshold:
+    the coordinator's stall report names the missing tensor, and the
+    collective still completes once the straggler arrives (reference
+    test_stall.py — warn, don't kill, when shutdown_sec is 0)."""
+    _run_workers(tmp_path, _STALL_WORKER, "STALL", size=2)
+
+
 @pytest.mark.full
 def test_adasum_vhdd_16_processes(tmp_path):
     """Deep-recursion VHDD: 16 ranks = 4 halving levels, peer links up

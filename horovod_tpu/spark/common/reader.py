@@ -32,12 +32,18 @@ class ShardReader:
                  batch_size: int = 32, shuffle: bool = True,
                  shuffle_window_row_groups: int = 4,
                  columns: Optional[Sequence[str]] = None,
-                 transform_fn=None, sample_weight_col: Optional[str] = None):
+                 transform_fn=None, sample_weight_col: Optional[str] = None,
+                 num_workers: int = 0):
         """``transform_fn(pdf) -> pdf`` is applied to each row group's
         pandas frame before batching — the Estimator ``transformation_fn``
         hook (the role Petastorm's TransformSpec plays in the reference's
         remote trainers). ``sample_weight_col`` adds a third per-batch
         array of per-row weights (reference ``sample_weight_col`` param).
+        ``num_workers`` > 0 prefetches+decodes row groups on that many
+        background threads (the ``train_reader_num_workers`` /
+        ``val_reader_num_workers`` role — Petastorm's reader pool), with
+        a bounded queue so memory stays at O(workers) row groups; 0 reads
+        synchronously.
         """
         import pyarrow.parquet as pq
 
@@ -48,6 +54,7 @@ class ShardReader:
         self._window = max(1, shuffle_window_row_groups)
         self._transform = transform_fn
         self._weight_col = sample_weight_col
+        self._num_workers = max(0, int(num_workers or 0))
         self._feature_cols = list(meta["feature_cols"])
         self._label_cols = list(meta["label_cols"])
         self._columns = (list(columns) if columns is not None
@@ -91,23 +98,84 @@ class ShardReader:
             cols.append([np.asarray(pdf[self._weight_col])])
         return cols
 
+    def _read_decode(self, group, tls):
+        """Read + transform + decode one (file, row_group); returns
+        (arrays, n_rows). Used from reader worker threads, so the
+        transform_fn must be thread-safe when num_workers > 0. ``tls``
+        is a threading.local carrying a per-worker {fname: ParquetFile}
+        handle cache (one footer parse per file per worker, matching
+        the synchronous path's cost profile)."""
+        fname, rg = group
+        cache = getattr(tls, "files", None)
+        if cache is None:
+            cache = tls.files = {}
+        pf = cache.get(fname)
+        if pf is None:
+            pf = cache[fname] = self._pq.ParquetFile(fname)
+        table = pf.read_row_group(rg, columns=self._columns)
+        arrays = self._group_arrays(table)
+        n_rows = len(arrays[1][0]) if arrays[1] else table.num_rows
+        return arrays, n_rows
+
+    def _iter_group_arrays(self, order):
+        """Yield (arrays, n_rows) per row group in ``order``. With
+        ``num_workers`` > 0, reads+decodes run ahead on a thread pool
+        with bounded in-flight work (the Petastorm reader-pool role);
+        results always arrive in order."""
+        if self._num_workers <= 0:
+            cache = {"name": None, "pf": None}  # one open file at a time
+            for i in order:
+                fname, rg = self._groups[i]
+                if cache["name"] != fname:
+                    cache["name"] = fname
+                    cache["pf"] = self._pq.ParquetFile(fname)
+                table = cache["pf"].read_row_group(
+                    rg, columns=self._columns)
+                arrays = self._group_arrays(table)
+                n_rows = (len(arrays[1][0]) if arrays[1]
+                          else table.num_rows)
+                yield arrays, n_rows
+            return
+        import collections
+        import concurrent.futures
+        import threading
+
+        tls = threading.local()
+        pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=self._num_workers)
+        pending = collections.deque()
+        it = iter(order)
+
+        def submit_next():
+            try:
+                i = next(it)
+            except StopIteration:
+                return
+            pending.append(
+                pool.submit(self._read_decode, self._groups[i], tls))
+
+        try:
+            for _ in range(self._num_workers + 1):
+                submit_next()
+            while pending:
+                result = pending.popleft().result()
+                submit_next()
+                yield result
+        finally:
+            # An abandoned epoch (fit pulling fewer steps than the
+            # shard holds) must not block on — or waste — the
+            # prefetched reads: drop queued work, don't wait.
+            pool.shutdown(wait=False, cancel_futures=True)
+
     def batches(self, epoch: int = 0
                 ) -> Iterator[Tuple[List[np.ndarray], ...]]:
         """One pass over the shard, yielding ``(features, labels)`` — or
         ``(features, labels, [weights])`` with ``sample_weight_col`` —
         per batch. Bounded memory: at most ``shuffle_window_row_groups``
-        row groups resident."""
+        (+ prefetch depth) row groups resident."""
         rng = np.random.RandomState(epoch)
         order = (rng.permutation(len(self._groups)) if self._shuffle
                  else np.arange(len(self._groups)))
-        cache = {"name": None, "pf": None}  # one open file at a time
-
-        def read_group(i):
-            fname, rg = self._groups[order[i]]
-            if cache["name"] != fname:
-                cache["name"] = fname
-                cache["pf"] = self._pq.ParquetFile(fname)
-            return cache["pf"].read_row_group(rg, columns=self._columns)
 
         n_streams = 3 if self._weight_col else 2
         bufs: List[List[List[np.ndarray]]] = [[] for _ in range(n_streams)]
@@ -139,10 +207,7 @@ class ShardReader:
                 start = end
             bufs, buffered = [[] for _ in range(n_streams)], 0
 
-        for i in range(len(self._groups)):
-            table = read_group(i)
-            arrays = self._group_arrays(table)
-            n_rows = len(arrays[1][0]) if arrays[1] else table.num_rows
+        for arrays, n_rows in self._iter_group_arrays(order):
             for s in range(n_streams):
                 bufs[s].append(arrays[s])
             buffered += n_rows

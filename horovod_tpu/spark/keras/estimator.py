@@ -87,7 +87,9 @@ class KerasEstimator(HorovodEstimator):
             loss_weights=self.getOrDefault("loss_weights"),
             sample_weight_col=self.getOrDefault("sample_weight_col"),
             transformation_fn=self.getOrDefault("transformation_fn"),
-            gradient_compression=self.getOrDefault("gradient_compression"))
+            gradient_compression=self.getOrDefault("gradient_compression"),
+            train_reader_num_workers=self.getOrDefault(
+                "train_reader_num_workers"))
 
     def _load_model(self, store, checkpoint_path):
         return deserialize_model(

@@ -21,7 +21,8 @@ def make_remote_trainer(serialized_model: bytes, optimizer_bytes,
                         validation_steps_per_epoch=None,
                         callbacks=None, loss_weights=None,
                         sample_weight_col=None, transformation_fn=None,
-                        gradient_compression=None):
+                        gradient_compression=None,
+                        train_reader_num_workers=None):
     """Build the function executed on every worker."""
 
     def trainer():
@@ -51,7 +52,8 @@ def make_remote_trainer(serialized_model: bytes, optimizer_bytes,
                 meta["train_data_path"], meta, hvd.rank(), hvd.size(),
                 batch_size=batch_size, shuffle=bool(shuffle_buffer_size),
                 transform_fn=transformation_fn,
-                sample_weight_col=sample_weight_col)
+                sample_weight_col=sample_weight_col,
+                num_workers=train_reader_num_workers or 0)
             if reader.rows == 0:
                 # Fail loudly (the launcher aborts the job) rather than
                 # spin in fit() waiting for batches that never come.

@@ -91,7 +91,9 @@ class TorchEstimator(HorovodEstimator):
             sample_weight_col=self.getOrDefault("sample_weight_col"),
             transformation_fn=self.getOrDefault("transformation_fn"),
             gradient_compression=self.getOrDefault("gradient_compression"),
-            input_shapes=self.getOrDefault("input_shapes"))
+            input_shapes=self.getOrDefault("input_shapes"),
+            train_reader_num_workers=self.getOrDefault(
+                "train_reader_num_workers"))
 
     def _load_model(self, store, checkpoint_path):
         import torch

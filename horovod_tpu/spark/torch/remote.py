@@ -19,7 +19,8 @@ def make_remote_trainer(model_bytes: bytes, optimizer_cls, optimizer_kwargs,
                         checkpoint_path: str, verbose: int = 0,
                         shuffle: bool = True, train_minibatch_fn=None,
                         sample_weight_col=None, transformation_fn=None,
-                        gradient_compression=None, input_shapes=None):
+                        gradient_compression=None, input_shapes=None,
+                        train_reader_num_workers=None):
     def trainer():
         import numpy as np
         import torch
@@ -44,7 +45,8 @@ def make_remote_trainer(model_bytes: bytes, optimizer_cls, optimizer_kwargs,
                 meta["train_data_path"], meta, hvd.rank(), hvd.size(),
                 batch_size=batch_size, shuffle=shuffle,
                 transform_fn=transformation_fn,
-                sample_weight_col=sample_weight_col)
+                sample_weight_col=sample_weight_col,
+                num_workers=train_reader_num_workers or 0)
             if reader.rows == 0:
                 # Fail loudly: a zero-step rank would skip the per-step
                 # gradient allreduces the data-holding ranks submit and

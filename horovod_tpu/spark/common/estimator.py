@@ -130,6 +130,10 @@ class EstimatorParams:
         "validation_steps_per_epoch": ("ValidationStepsPerEpoch", _to_int),
         "transformation_fn": ("TransformationFn", None),
         "train_reader_num_workers": ("TrainReaderNumWorkers", _to_int),
+        # Accepted for reference-API compatibility: validation here is a
+        # one-shot whole-shard read (fit holds it in memory), not the
+        # reference's streaming Petastorm reader, so a val reader pool
+        # has nothing to parallelize.
         "val_reader_num_workers": ("ValReaderNumWorkers", _to_int),
         "label_shapes": ("LabelShapes", None),
     }

@@ -1,13 +1,15 @@
 #!/usr/bin/env python
-"""Capture a jax.profiler trace of the ResNet-50 train step and summarize
+"""Capture a jax.profiler trace of a model-zoo train step and summarize
 the device-plane op costs (the trace evidence VERDICT r3 asked for: name
-the single-chip MFU ceiling operation-by-operation).
+the single-chip MFU ceiling operation-by-operation). --model picks any
+bench.py registry entry (resnet50/resnet101/vgg16/inception3).
 
-Usage: python tools/profile_resnet.py [--batch-size 32] [--steps 5]
+Usage: python tools/profile_resnet.py [--model resnet50]
+                                      [--batch-size 32] [--steps 5]
                                       [--out docs/probes]
 
-Writes <out>/resnet_trace_<ts>/ (the raw TB trace dir) and
-<out>/resnet_trace_<ts>_summary.md (top ops by device self-time).
+Writes <out>/<model>_trace_<ts>/ (the raw TB trace dir) and
+<out>/<model>_trace_<ts>_summary.md (top ops by device self-time).
 """
 
 import argparse
@@ -28,8 +30,11 @@ def capture(args):
     import numpy as np
     import optax
 
+    import importlib
+
+    import bench as _bench
+
     import horovod_tpu as hvd
-    from horovod_tpu.models.resnet import ResNet50
     from horovod_tpu.training import (
         init_train_state, make_train_step, replicate_state, shard_batch)
 
@@ -37,7 +42,12 @@ def capture(args):
     n = hvd.size()
     mesh = hvd.mesh()
 
-    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    # Same registry as bench.py --model: trace any of the headline zoo.
+    mspec = _bench.MODELS[args.model]
+    if args.image_size is None:
+        args.image_size = mspec["size"]
+    ctor = getattr(importlib.import_module(mspec["module"]), mspec["cls"])
+    model = ctor(num_classes=1000, dtype=jnp.bfloat16)
     optimizer = optax.sgd(0.01, momentum=0.9)
     rng = jax.random.PRNGKey(0)
     sample = jnp.zeros((1, args.image_size, args.image_size, 3), jnp.float32)
@@ -58,7 +68,7 @@ def capture(args):
     float(np.asarray(loss))
 
     ts = time.strftime("%Y%m%dT%H%M%S")
-    trace_dir = os.path.join(args.out, f"resnet_trace_{ts}")
+    trace_dir = os.path.join(args.out, f"{args.model}_trace_{ts}")
     jax.profiler.start_trace(trace_dir)
     t0 = time.perf_counter()
     for _ in range(args.steps):
@@ -71,6 +81,7 @@ def capture(args):
     platform = jax.devices()[0].platform
     kind = getattr(jax.devices()[0], "device_kind", "")
     return trace_dir, dict(platform=platform, device_kind=kind,
+                           model=args.model,
                            batch_size=args.batch_size, steps=args.steps,
                            img_per_sec=round(img_per_sec, 1),
                            step_ms=round(1e3 * dt / args.steps, 2))
@@ -137,7 +148,7 @@ def summarize(trace_dir, meta, args):
 
     top = sorted(per_op.items(), key=lambda kv: -kv[1])[:args.top]
     lines = [
-        f"# ResNet-50 train-step trace — {meta['platform']} "
+        f"# {meta.get('model', 'resnet50')} train-step trace — {meta['platform']} "
         f"({meta['device_kind']})",
         "",
         f"Captured {time.strftime('%Y-%m-%d %H:%M:%S')}: "
@@ -164,9 +175,14 @@ def summarize(trace_dir, meta, args):
 
 def main(argv=None):
     p = argparse.ArgumentParser()
+    import bench as _bench
+
+    p.add_argument("--model", default="resnet50",
+                   choices=sorted(_bench.MODELS))
     p.add_argument("--batch-size", type=int, default=32)
     p.add_argument("--steps", type=int, default=5)
-    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--image-size", type=int, default=None,
+                   help="defaults to the model's canonical size")
     p.add_argument("--top", type=int, default=25)
     p.add_argument("--out", default="docs/probes")
     p.add_argument("--include-host", action="store_true",

@@ -236,7 +236,31 @@ _ADASUM_WORKER = textwrap.dedent("""
             [np.cos(np.arange(n_elem) * (rr + 1)) for rr in range(size)])
         assert np.allclose(c, ec, rtol=1e-4), (n_elem, c, ec)
 
-    # 3) Wire-traffic complexity: VHDD must be O(count) per rank. The
+    # 3) bf16 Adasum through the VHDD path: fp32 accumulation with
+    #    bf16 storage between levels (loose tolerance — bf16 has ~3
+    #    decimal digits).
+    def to_bf16(v32):
+        u = v32.astype(np.float32).view(np.uint32)
+        return ((u + 0x7FFF + ((u >> 16) & 1)) >> 16).astype(np.uint16)
+
+    def from_bf16(u16):
+        return (u16.astype(np.uint32) << 16).view(np.float32)
+
+    vb16 = (np.linspace(0.25, 2.0, 12).astype(np.float32)
+            * (1.0 + 0.1 * rank))
+    buf16 = to_bf16(vb16)
+    hb16 = core.enqueue("ad.bf16", hn.OP_ALLREDUCE, 2, 10, buf16.shape,
+                        data_ptr=buf16.ctypes.data,
+                        output_ptr=buf16.ctypes.data, plane=hn.PLANE_HOST)
+    r, err = core.wait(hb16); assert r == 1, err
+    eb16 = adasum_reference(
+        [from_bf16(to_bf16(np.linspace(0.25, 2.0, 12).astype(np.float32)
+                           * (1.0 + 0.1 * rr)))
+         for rr in range(size)])
+    assert np.allclose(from_bf16(buf16), eb16, rtol=3e-2), (
+        from_bf16(buf16), eb16)
+
+    # 4) Wire-traffic complexity: VHDD must be O(count) per rank. The
     #    halving leg sends < count floats, the allgather leg < count
     #    more, scalars are negligible -> well under 3*count*4 bytes.
     #    The old allgather-everything scheme sent (size-1)*count*4.

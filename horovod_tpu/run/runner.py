@@ -512,9 +512,14 @@ def run(func, args=(), kwargs=None, np: int = 1,
         hosts: Optional[str] = None, hostfile: Optional[str] = None,
         ssh_port: Optional[int] = None, verbose: bool = False,
         use_cloudpickle: bool = True, env: Optional[dict] = None,
-        output_filename: Optional[str] = None):
+        output_filename: Optional[str] = None,
+        network_interface: Optional[str] = None,
+        start_timeout: int = 30, disable_cache: bool = False):
     """Run ``func(*args, **kwargs)`` on ``np`` ranks; return the list of
-    per-rank return values in rank order."""
+    per-rank return values in rank order (parity:
+    ``horovod.run.run()``, reference ``runner.py:824+`` — the
+    network_interface/start_timeout/disable_cache knobs mirror the CLI
+    flags of the same names)."""
     import cloudpickle
 
     with tempfile.TemporaryDirectory(prefix="hvdrun_") as tmpdir:
@@ -524,8 +529,9 @@ def run(func, args=(), kwargs=None, np: int = 1,
 
         ns = argparse.Namespace(
             np=np, hosts=hosts, hostfile=hostfile, ssh_port=ssh_port,
-            verbose=verbose, disable_cache=False, config_file=None,
-            min_np=None, output_filename=output_filename, start_timeout=30,
+            verbose=verbose, disable_cache=disable_cache, config_file=None,
+            min_np=None, output_filename=output_filename,
+            start_timeout=start_timeout, nics=network_interface,
             launcher="auto")
         command = [sys.executable, "-m", "horovod_tpu.run.task_fn", fn_path]
         base_env = dict(env if env is not None else os.environ)

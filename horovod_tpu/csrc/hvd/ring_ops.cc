@@ -460,30 +460,55 @@ Status Ring::ScalarTreeAllreduce(std::vector<double>& vals, int span) {
   return Status::OK();
 }
 
-Status Ring::PairwiseCombine(float* a, const float* b,
+Status Ring::PairwiseCombine(char* a, const char* b,
                              const std::vector<int64_t>& counts, int level,
-                             bool is_left) {
+                             bool is_left, DataType work_dt) {
   // Per-tensor dot/norms on the local fragments, reduced over the
   // 2*level block so they cover the pair's FULL vectors, then the Adasum
   // linear combination per tensor (reference
   // FusedPairwiseReduceWithComm, adasum.h:338-398). Scalar slots are
   // packed canonically as (dot, left-norm, right-norm) so both sides of
-  // the pair sum agreeing layouts.
+  // the pair sum agreeing layouts. ``work_dt`` is the wire/storage
+  // element: fp32, or the caller's own 16-bit float — fragments then
+  // convert through fp32 scratch for the math and round back per level
+  // (the reference's AVX fp16 path semantics, adasum.h:426-546).
   // Zero-norm fallback threshold. The reference uses sqrt(DBL_MIN)
   // (adasum.h:345); this repo standardizes on 1e-30 across both planes
   // (ops/adasum.py _adasum_combine / adasum_reference) so host- and
   // XLA-plane results agree in the degenerate-input regime too.
   static const double kNormFloor = 1e-30;
+  const bool narrow = work_dt != DataType::HVD_FLOAT32;
   size_t T = counts.size();
+  int64_t total = 0;
+  for (int64_t c : counts) total += c;
   std::vector<double> scal(3 * T, 0.0);
+
+  // Narrow path: convert both spans to fp32 ONCE, do all math on the
+  // scratch, round back with one FromFloat at the end (per-level
+  // rounding, exactly the reference's fp16 buffer behavior).
+  std::vector<float> fa, fb;
+  float* ap;
+  const float* bp;
+  if (narrow) {
+    fa.resize(total);
+    fb.resize(total);
+    ToFloat(a, fa.data(), total, work_dt);
+    ToFloat(b, fb.data(), total, work_dt);
+    ap = fa.data();
+    bp = fb.data();
+  } else {
+    ap = reinterpret_cast<float*>(a);
+    bp = reinterpret_cast<const float*>(b);
+  }
+
   int64_t off = 0;
   for (size_t t = 0; t < T; ++t) {
     double dot = 0, mine = 0, theirs = 0;
     for (int64_t i = 0; i < counts[t]; ++i) {
-      double av = a[off + i], bv = b[off + i];
-      dot += av * bv;
-      mine += av * av;
-      theirs += bv * bv;
+      double x = ap[off + i], y = bp[off + i];
+      dot += x * y;
+      mine += x * x;
+      theirs += y * y;
     }
     scal[3 * t] = dot;
     scal[3 * t + 1] = is_left ? mine : theirs;
@@ -500,9 +525,13 @@ Status Ring::PairwiseCombine(float* a, const float* b,
     double ac = anorm >= kNormFloor ? 1.0 - dot / anorm * 0.5 : 1.0;
     double bc = bnorm >= kNormFloor ? 1.0 - dot / bnorm * 0.5 : 1.0;
     for (int64_t i = 0; i < counts[t]; ++i) {
-      a[off + i] = static_cast<float>(ac * a[off + i] + bc * b[off + i]);
+      ap[off + i] = static_cast<float>(ac * ap[off + i]
+                                       + bc * bp[off + i]);
     }
     off += counts[t];
+  }
+  if (narrow) {
+    FromFloat(fa.data(), a, total, work_dt);
   }
   return Status::OK();
 }
@@ -537,9 +566,9 @@ Status Ring::AdasumAllreduce(void* data, void* output,
   // rank^level, combine per tensor with block-reduced scalars, then
   // distance-halving allgather back. Per-rank wire traffic is O(count)
   // (count/2 + count/4 + ... down, the reverse up) versus the
-  // O(count*size) of an allgather-everything scheme. The working dtype on
-  // the wire is fp32 (the accumulation dtype), so 16-bit inputs ride at
-  // 2x their storage width — still O(count).
+  // O(count*size) of an allgather-everything scheme. 16-bit floats ride
+  // the wire AT 16-BIT WIDTH with fp32 math per level (the reference's
+  // AVX fp16 path, adasum.h:426-546); fp32/fp64 work in fp32.
   int64_t count = 0;
   for (int64_t c : tensor_counts) count += c;
   if ((size_ & (size_ - 1)) != 0) {
@@ -551,27 +580,29 @@ Status Ring::AdasumAllreduce(void* data, void* output,
     return Status::InvalidArgument("Adasum requires floating point data");
   }
 
-  // Promote to the fp32 working buffer.
-  std::vector<float> work(count), recv(count);
-  if (Is16BitFloat(dtype)) {
-    ToFloat(data, work.data(), count, dtype);
-  } else if (dtype == DataType::HVD_FLOAT32) {
-    std::memcpy(work.data(), data, count * 4);
+  // Working buffer in the WIRE dtype: the caller's own 16-bit float, or
+  // fp32 for fp32/fp64 inputs.
+  const DataType work_dt =
+      Is16BitFloat(dtype) ? dtype : DataType::HVD_FLOAT32;
+  const int wes = DataTypeSize(work_dt);
+  std::vector<char> work(static_cast<size_t>(count) * wes);
+  std::vector<char> recv(static_cast<size_t>(count) * wes);
+  if (Is16BitFloat(dtype) || dtype == DataType::HVD_FLOAT32) {
+    std::memcpy(work.data(), data, static_cast<size_t>(count) * wes);
   } else {
     auto* p = static_cast<const double*>(data);
-    for (int64_t i = 0; i < count; ++i) work[i] = static_cast<float>(p[i]);
+    auto* w = reinterpret_cast<float*>(work.data());
+    for (int64_t i = 0; i < count; ++i) w[i] = static_cast<float>(p[i]);
   }
   // Pre/postscale parity with the non-Adasum path and the XLA plane
   // (grouped_allreduce applies _apply_prescale/_apply_postscale).
   if (prescale != 1.0) {
-    for (int64_t i = 0; i < count; ++i) {
-      work[i] = static_cast<float>(work[i] * prescale);
-    }
+    ScaleBuffer(work.data(), count, work_dt, prescale);
   }
 
   if (size_ > 1) {
-    float* grad = work.data();
-    float* rbuf = recv.data();
+    char* grad = work.data();
+    char* rbuf = recv.data();
     std::vector<int64_t> my_counts = tensor_counts;
     int64_t my_count = count;
     struct LevelInfo {
@@ -609,15 +640,17 @@ Status Ring::AdasumAllreduce(void* data, void* output,
       li.nghr_count = nghr;
       // Full-duplex half-exchange: my outgoing half against the
       // partner's fragment aligned with what I keep.
-      if (!SendRecvDuplex(peer, grad + send_off, nghr * 4, peer,
-                          rbuf + (is_left ? 0 : nghr), my_count * 4)) {
+      if (!SendRecvDuplex(peer, grad + send_off * wes, nghr * wes, peer,
+                          rbuf + (is_left ? 0 : nghr * wes),
+                          my_count * wes)) {
         return Status::Aborted("adasum half-exchange failed");
       }
       if (!is_left) {
-        grad += nghr;
-        rbuf += nghr;
+        grad += nghr * wes;
+        rbuf += nghr * wes;
       }
-      Status s = PairwiseCombine(grad, rbuf, my_counts, level, is_left);
+      Status s = PairwiseCombine(grad, rbuf, my_counts, level, is_left,
+                                 work_dt);
       if (!s.ok()) return s;
       hist.push_back(std::move(li));
     }
@@ -629,12 +662,13 @@ Status Ring::AdasumAllreduce(void* data, void* output,
       hist.pop_back();
       Socket* peer = PeerLink(rank_ ^ level);
       bool is_left = (rank_ & level) == 0;
-      float* rdst = is_left ? grad + my_count : grad - li.nghr_count;
-      if (!SendRecvDuplex(peer, grad, my_count * 4, peer, rdst,
-                          li.nghr_count * 4)) {
+      char* rdst = is_left ? grad + my_count * wes
+                           : grad - li.nghr_count * wes;
+      if (!SendRecvDuplex(peer, grad, my_count * wes, peer, rdst,
+                          li.nghr_count * wes)) {
         return Status::Aborted("adasum allgather exchange failed");
       }
-      if (!is_left) grad -= li.nghr_count;
+      if (!is_left) grad -= li.nghr_count * wes;
       my_count += li.nghr_count;
       for (size_t i = 0; i < my_counts.size(); ++i) {
         my_counts[i] += li.nghr_counts[i];
@@ -643,19 +677,16 @@ Status Ring::AdasumAllreduce(void* data, void* output,
   }
 
   if (postscale != 1.0) {
-    for (int64_t i = 0; i < count; ++i) {
-      work[i] = static_cast<float>(work[i] * postscale);
-    }
+    ScaleBuffer(work.data(), count, work_dt, postscale);
   }
 
-  // Demote back to the caller's dtype.
-  if (Is16BitFloat(dtype)) {
-    FromFloat(work.data(), output, count, dtype);
-  } else if (dtype == DataType::HVD_FLOAT32) {
-    std::memcpy(output, work.data(), count * 4);
-  } else {
+  // The work buffer is already in the caller's dtype except for fp64.
+  if (dtype == DataType::HVD_FLOAT64) {
+    auto* w = reinterpret_cast<const float*>(work.data());
     auto* p = static_cast<double*>(output);
-    for (int64_t i = 0; i < count; ++i) p[i] = work[i];
+    for (int64_t i = 0; i < count; ++i) p[i] = w[i];
+  } else {
+    std::memcpy(output, work.data(), static_cast<size_t>(count) * wes);
   }
   return Status::OK();
 }

@@ -84,12 +84,14 @@ class Ring {
   Socket* PeerLink(int peer);
 
   // Per-tensor pairwise Adasum combine: a (mine) and b (partner's) are
-  // fragments laid out per `counts`; scalars are reduced over the
-  // 2*level-rank block on a fixed binomial tree so every rank applies
-  // identical coefficients. `is_left` = this rank kept the low half.
-  Status PairwiseCombine(float* a, const float* b,
+  // fragments laid out per `counts` in `work_dt` storage (fp32, or the
+  // caller's 16-bit float — then fp32 math with per-level rounding);
+  // scalars are reduced over the 2*level-rank block on a fixed binomial
+  // tree so every rank applies identical coefficients. `is_left` = this
+  // rank kept the low half.
+  Status PairwiseCombine(char* a, const char* b,
                          const std::vector<int64_t>& counts, int level,
-                         bool is_left);
+                         bool is_left, DataType work_dt);
   Status ScalarTreeAllreduce(std::vector<double>& vals, int span);
 
   int rank_ = 0;

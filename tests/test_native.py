@@ -275,6 +275,25 @@ _ADASUM_WORKER = textwrap.dedent("""
         [np.sin(np.arange(count) + rr) for rr in range(size)])
     assert np.allclose(d, ed, rtol=1e-3, atol=1e-5)
 
+    # 5) 16-bit floats ride the wire at 16-BIT width (the reference's
+    #    fp16-on-wire AVX path): the same vector as bf16 must move under
+    #    3*count*2 bytes — half the fp32 bound.
+    before = core.ring_bytes_sent()
+    d16 = to_bf16(np.sin(np.arange(count) + rank).astype(np.float32))
+    h16 = core.enqueue("ad.big16", hn.OP_ALLREDUCE, 2, 10, d16.shape,
+                       data_ptr=d16.ctypes.data,
+                       output_ptr=d16.ctypes.data, plane=hn.PLANE_HOST)
+    r, err = core.wait(h16); assert r == 1, err
+    delta16 = core.ring_bytes_sent() - before
+    assert delta16 < 3 * count * 2, (delta16, 3 * count * 2)
+    # Oracle has no intermediate rounding; the wire path rounds to bf16
+    # at every level (eps ~0.8%), so the bound is log2(size) roundings
+    # of O(1) values.
+    e16 = adasum_reference(
+        [from_bf16(to_bf16(np.sin(np.arange(count) + rr)
+                           .astype(np.float32))) for rr in range(size)])
+    assert np.allclose(from_bf16(d16), e16, rtol=5e-2, atol=3e-2)
+
     core.shutdown()
     print(f"ADASUM_{rank}_OK")
 """)

@@ -308,6 +308,74 @@ def test_adasum_vhdd_multiprocess(size, tmp_path):
                  extra_args=(size,))
 
 
+_ADASUM_FUZZ_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    from horovod_tpu.common import native as hn
+
+    rank = int(sys.argv[1]); size = int(sys.argv[2])
+    port = int(sys.argv[3])
+    core = hn.NativeCore()
+    assert core.available
+    ok = core.init(rank=rank, size=size, local_rank=0, local_size=1,
+                   cross_rank=rank, cross_size=size,
+                   coordinator_addr="127.0.0.1", coordinator_port=port,
+                   my_host="127.0.0.1", cycle_time_ms=1.0,
+                   fusion_threshold=64 << 20, cache_capacity=256,
+                   stall_warning_sec=60.0, stall_shutdown_sec=0.0,
+                   stall_check_enabled=True,
+                   exec_callback=lambda resp, rid: core.response_done(
+                       rid, False, "host-plane only"))
+    assert ok, "native init failed"
+
+    from horovod_tpu.ops.adasum import adasum_reference
+
+    # Deterministic random layouts, identical on every rank: rounds of
+    # K tensors with adversarial lengths (1, primes, pow2 +- 1) fused by
+    # the controller however the cycle timing bins them — per-tensor
+    # VHDD bookkeeping must hold for every layout.
+    layout_rng = np.random.RandomState(1234)
+    for rnd in range(6):
+        k = int(layout_rng.randint(1, 6))
+        lens = [int(layout_rng.choice([1, 2, 3, 7, 13, 31, 64, 65, 127]))
+                for _ in range(k)]
+        bufs = []
+        for t, n in enumerate(lens):
+            v = (np.cos(np.arange(n) * (0.37 + t) + rank * 1.7)
+                 .astype(np.float32) * (1.0 + 0.2 * rank))
+            bufs.append(v)
+        handles = [
+            core.enqueue(f"fz.{rnd}.{t}", hn.OP_ALLREDUCE, 2, 7,
+                         b.shape, data_ptr=b.ctypes.data,
+                         output_ptr=b.ctypes.data, plane=hn.PLANE_HOST)
+            for t, b in enumerate(bufs)
+        ]
+        for h in handles:
+            r, err = core.wait(h); assert r == 1, err
+        for t, (n, b) in enumerate(zip(lens, bufs)):
+            expect = adasum_reference(
+                [np.cos(np.arange(n) * (0.37 + t) + rr * 1.7)
+                 * (1.0 + 0.2 * rr) for rr in range(size)])
+            assert np.allclose(b, expect, rtol=1e-4, atol=1e-6), (
+                rnd, t, n, b, expect)
+
+    core.shutdown()
+    print(f"ADFUZZ_{rank}_OK")
+""")
+
+
+@pytest.mark.full
+def test_adasum_fused_layout_fuzz(tmp_path):
+    """Randomized multi-tensor Adasum layouts at 4 ranks: whatever the
+    cycle fuses together, per-tensor VHDD bookkeeping (SplitCounts +
+    segment scalars) must match the per-tensor oracle for adversarial
+    lengths (1, primes, pow2 +- 1) — the trickiest code added this
+    round, soak-tested."""
+    _run_workers(tmp_path, _ADASUM_FUZZ_WORKER, "ADFUZZ", size=4,
+                 extra_args=(4,), timeout=300)
+
+
 _STALL_WORKER = textwrap.dedent("""
     import os, sys, time
     import numpy as np

@@ -1,0 +1,53 @@
+"""The harvest capture plan must not rot: a renamed flag or moved script
+would silently burn an entire tunnel window (the scarcest resource in
+this environment). Every plan command's script must exist and accept its
+flags — asserted against each tool's REAL argparse surface via --help."""
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _harvest():
+    spec = importlib.util.spec_from_file_location(
+        "harvest_tpu", os.path.join(REPO, "tools", "harvest_tpu.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_capture_plan_commands_are_valid():
+    plan = _harvest().capture_plan(sys.executable)
+    assert plan, "empty capture plan"
+    helps = {}
+    for name, cmd, timeout in plan:
+        assert timeout > 0
+        script = cmd[1]
+        path = os.path.join(REPO, script)
+        assert os.path.exists(path), f"{name}: {script} missing"
+        flags = [a for a in cmd[2:] if a.startswith("--")]
+        if script not in helps:
+            proc = subprocess.run(
+                [sys.executable, path, "--help"], capture_output=True,
+                text=True, timeout=180, cwd=REPO)
+            assert proc.returncode == 0, (script, proc.stderr[-500:])
+            helps[script] = proc.stdout
+        for flag in flags:
+            assert flag in helps[script], (
+                f"{name}: {script} no longer accepts {flag}")
+    # The decisive artifact stays first (a window may close mid-run).
+    assert plan[0][0] == "bench32"
+
+
+def test_harvest_probe_shares_bench_probe():
+    """probe() must stay the shared compute probe (no drift with
+    bench._probe_backend — the wedge-detection contract)."""
+    import inspect
+
+    src = inspect.getsource(_harvest().probe)
+    assert "_probe_backend" in src

@@ -54,6 +54,36 @@ def probe(timeout=200):
     return None
 
 
+def capture_plan(py):
+    """The capture sequence for an open window. Value order: headline
+    number first, then the MFU-attribution trace, then the A/B points,
+    then the kernel microbenches, then the rest of the reference's
+    headline trio (benchmarks.rst:8-13) — a window that closes mid-run
+    should have captured the most decisive artifacts. Bench phase
+    timeouts must cover bench.py's own worst case (single 150 s probe +
+    worker 1200 s + startup slack) — a shorter phase timeout kills a
+    legitimately slow-but-recovering run mid-worker. Kept as a function
+    so tests can assert every command still matches its tool's real
+    flag surface (a renamed flag would silently burn a window)."""
+    nf = "--no-fallback"  # a CPU-fallback artifact is worthless here
+    return [
+        ("bench32", [py, "bench.py", nf], 2000),
+        ("profile", [py, "tools/profile_resnet.py"], 700),
+        ("bench_s2d", [py, "bench.py", nf, "--space-to-depth"], 2000),
+        ("bench64", [py, "bench.py", nf, "--batch-size", "64"], 2000),
+        ("transformer", [py, "tools/transformer_bench.py"], 900),
+        ("pallas", [py, "tools/pallas_bench.py"], 900),
+        ("bench128", [py, "bench.py", nf, "--batch-size", "128"], 2000),
+        ("pallas_sweep", [py, "tools/pallas_bench.py", "--sweep-blocks",
+                          "--seq-lens", "2048", "--iters", "10"], 1200),
+        ("bench_r101", [py, "bench.py", nf, "--model", "resnet101"], 2000),
+        ("bench_incep", [py, "bench.py", nf, "--model", "inception3"],
+         2000),
+        ("bench_vgg", [py, "bench.py", nf, "--model", "vgg16",
+                       "--batch-size", "16"], 2000),
+    ]
+
+
 def phase(name, cmd, timeout):
     ts = time.strftime("%Y%m%dT%H%M%S")
     out_path = os.path.join(OUT, f"{name}_{ts}.out")
@@ -99,32 +129,7 @@ def main(argv=None):
         return 1
     print(f"harvest: tunnel OPEN ({got}) — capturing", file=sys.stderr)
 
-    py = sys.executable
-    nf = "--no-fallback"  # a CPU-fallback artifact is worthless here
-    # Value order: headline number first, then the MFU-attribution trace,
-    # then the A/B points, then the kernel microbenches — a window that
-    # closes mid-run should have captured the most decisive artifacts.
-    # Bench phase timeouts must cover bench.py's own worst case (single
-    # 150 s probe + worker 1200 s + startup slack) — a shorter phase
-    # timeout kills a legitimately slow-but-recovering run mid-worker.
-    plan = [
-        ("bench32", [py, "bench.py", nf], 2000),
-        ("profile", [py, "tools/profile_resnet.py"], 700),
-        ("bench_s2d", [py, "bench.py", nf, "--space-to-depth"], 2000),
-        ("bench64", [py, "bench.py", nf, "--batch-size", "64"], 2000),
-        ("transformer", [py, "tools/transformer_bench.py"], 900),
-        ("pallas", [py, "tools/pallas_bench.py"], 900),
-        ("bench128", [py, "bench.py", nf, "--batch-size", "128"], 2000),
-        ("pallas_sweep", [py, "tools/pallas_bench.py", "--sweep-blocks",
-                          "--seq-lens", "2048", "--iters", "10"], 1200),
-        # The reference's full headline trio (benchmarks.rst:8-13) —
-        # after the decisive artifacts, since a window may close early.
-        ("bench_r101", [py, "bench.py", nf, "--model", "resnet101"], 2000),
-        ("bench_incep", [py, "bench.py", nf, "--model", "inception3"],
-         2000),
-        ("bench_vgg", [py, "bench.py", nf, "--model", "vgg16",
-                       "--batch-size", "16"], 2000),
-    ]
+    plan = capture_plan(sys.executable)
     results = {}
     for name, cmd, to in plan:
         if name in skip:

@@ -246,6 +246,13 @@ def _build_parser():
                              "re-tiling of the 7x7/s2 stem conv; "
                              "models/resnet.py) — A/B flag for on-chip "
                              "MFU work")
+    parser.add_argument("--bucket-mb", type=float, default=None,
+                        help="tensor-fusion v2 bucket cap in MB for the "
+                             "gradient AllReduce (backward-order bucketed "
+                             "fusion; 0 forces monolithic). Unset: follow "
+                             "HOROVOD_FUSION_THRESHOLD, monolithic when "
+                             "that is unset too. The effective config is "
+                             "recorded in the emitted JSON either way")
     parser.add_argument("--no-fallback", action="store_true",
                         help="exit nonzero instead of running the CPU "
                              "fallback when the accelerator is "
@@ -296,6 +303,8 @@ def supervise(argv):
             worker_args.append("--fence-each")
         if args.space_to_depth:
             worker_args.append("--space-to-depth")
+        if args.bucket_mb is not None:
+            worker_args += ["--bucket-mb", str(args.bucket_mb)]
         result = _run_worker(worker_args, dict(os.environ), WORKER_TIMEOUT_S)
         if result is not None:
             result["platform"] = platform
@@ -369,6 +378,8 @@ def supervise(argv):
         # Keep workload flags so an A/B artifact isn't silently the
         # baseline workload under the variant's label.
         fallback_args.append("--space-to-depth")
+    if args.bucket_mb is not None:
+        fallback_args += ["--bucket-mb", str(args.bucket_mb)]
     result = _run_worker(fallback_args, env, CPU_FALLBACK_TIMEOUT_S)
     if result is not None:
         result["platform"] = "cpu-fallback"
@@ -455,7 +466,35 @@ def worker(argv):
     images, labels = shard_batch((jnp.asarray(images), jnp.asarray(labels)),
                                  mesh)
 
-    step = make_train_step(model, optimizer, mesh)
+    # Tensor-fusion v2: --bucket-mb wins, else HOROVOD_FUSION_THRESHOLD
+    # ("auto"), else monolithic. The effective config rides the JSON so
+    # the bench trajectory can attribute wins to the fusion setting.
+    from horovod_tpu.common.fusion import (
+        describe_plan, plan_buckets_for, resolve_bucket_cap)
+
+    if args.bucket_mb is not None:
+        bucket_cap = int(args.bucket_mb * 1024 * 1024) or None
+        cap_source = "flag"
+    else:
+        bucket_cap = resolve_bucket_cap("auto")
+        # Attribute correctly: "auto" may resolve from the env var OR
+        # from an autotuner-published threshold in the live config.
+        if bucket_cap is None:
+            cap_source = "unset"
+        elif os.environ.get("HOROVOD_FUSION_THRESHOLD") is not None:
+            cap_source = "env"
+        else:
+            cap_source = "autotune"
+    fusion_cfg = {
+        "bucket_cap_bytes": bucket_cap,
+        "source": cap_source,
+        **describe_plan(plan_buckets_for(
+            jax.tree_util.tree_leaves(state.params), bucket_cap)),
+    }
+    mark(f"fusion config: {fusion_cfg}")
+
+    step = make_train_step(model, optimizer, mesh,
+                           bucket_cap_bytes=bucket_cap)
 
     # A scalar fetch (not block_until_ready) is the completion fence: the
     # final loss depends on every prior step through the donated state
@@ -494,6 +533,7 @@ def worker(argv):
         "vs_baseline": (round(
             img_per_sec_per_chip / BASELINE_IMG_PER_SEC_PER_DEVICE, 3)
             if args.model.startswith("resnet") else None),
+        "fusion": fusion_cfg,
     }
     if step_times:
         # Per-step rates + a 95% CI (the reference benchmark's

@@ -69,10 +69,9 @@ def main():
 
     # Must run before any device touch; harmless on a real TPU slice
     # (only sizes the host-CPU backend used by the virtual-mesh demo).
-    try:
-        jax.config.update("jax_num_cpu_devices", max(args.sp, 8))
-    except RuntimeError:
-        pass  # backend already initialized by the caller
+    from horovod_tpu.common.compat import ensure_cpu_devices
+
+    ensure_cpu_devices(max(args.sp, 8))
 
     mesh = build_parallel_mesh(jax.devices(), sp=args.sp, pp=1, tp=1,
                                dp=args.dp)

@@ -51,7 +51,9 @@ def main():
 
     if args.cpu_devices:
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+        from horovod_tpu.common.compat import ensure_cpu_devices
+
+        ensure_cpu_devices(args.cpu_devices)
     import jax.numpy as jnp
     import optax
 

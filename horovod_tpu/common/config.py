@@ -79,6 +79,19 @@ def _get_int(name: str, default: int) -> int:
         return default
 
 
+def _get_int_explicit(name: str, default: int):
+    """(value, explicit): the parsed env int and whether it counts as an
+    explicit setting. Unset OR unparseable → (default, False) — an
+    unparseable value must not count as explicit, or it would silently
+    flip the XLA plane's "auto" bucket cap to the 64 MB host-plane
+    default. The single parse shared by both fusion-threshold fields."""
+    v = os.environ.get(name)
+    try:
+        return (int(v), True) if v is not None else (default, False)
+    except ValueError:
+        return default, False
+
+
 def _get_float(name: str, default: float) -> float:
     v = os.environ.get(name)
     try:
@@ -96,6 +109,12 @@ class RuntimeConfig:
     """
 
     fusion_threshold_bytes: int = DEFAULT_FUSION_THRESHOLD_BYTES
+    # True when the threshold was explicitly set (env var present) or has
+    # been autotuned. The XLA-plane bucket cap ("auto" resolution in
+    # common/fusion.py) only engages then: the *default* 64 MB exists for
+    # the host plane's cycle fusion, and silently bucketing the compiled
+    # path by default would change programs under users' feet.
+    fusion_threshold_explicit: bool = False
     cycle_time_ms: float = DEFAULT_CYCLE_TIME_MS
     cache_capacity: int = DEFAULT_CACHE_CAPACITY
     timeline_filename: str = ""
@@ -117,10 +136,11 @@ class RuntimeConfig:
 
     @classmethod
     def from_env(cls) -> "RuntimeConfig":
+        fusion_bytes, fusion_explicit = _get_int_explicit(
+            HOROVOD_FUSION_THRESHOLD, DEFAULT_FUSION_THRESHOLD_BYTES)
         return cls(
-            fusion_threshold_bytes=_get_int(
-                HOROVOD_FUSION_THRESHOLD, DEFAULT_FUSION_THRESHOLD_BYTES
-            ),
+            fusion_threshold_bytes=fusion_bytes,
+            fusion_threshold_explicit=fusion_explicit,
             cycle_time_ms=_get_float(HOROVOD_CYCLE_TIME, DEFAULT_CYCLE_TIME_MS),
             cache_capacity=_get_int(HOROVOD_CACHE_CAPACITY, DEFAULT_CACHE_CAPACITY),
             timeline_filename=os.environ.get(HOROVOD_TIMELINE, ""),

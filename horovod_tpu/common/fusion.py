@@ -1,0 +1,205 @@
+"""Bucketed fusion planner — tensor fusion v2 for the XLA plane.
+
+The v1 XLA-plane fusion (``ops/xla.py _grouped``) concatenates the whole
+gradient list into ONE fused buffer per dtype. That single AllReduce
+data-depends on the *last* gradient backprop produces, so XLA's scheduler
+cannot launch any communication until the backward pass has fully
+finished — exactly the serialization the reference's background fusion
+cycle exists to avoid (reference ``tensor_fusion`` docs; PAPER.md §7).
+
+This module is the shared planner: a pure function over (byte-size,
+dtype) specs that returns size-capped, dtype-pure buckets in **reverse
+parameter order** — the approximation of backward production order that
+PyTorch DDP's ``bucket_cap_mb`` gradient bucketing and ZeRO's bucketed
+reduce-scatter use on the GPU side. Each bucket's collective depends only
+on that bucket's gradients, so XLA can overlap bucket k's AllReduce with
+the computation of bucket k+1's gradients.
+
+Consumers:
+
+- ``ops/xla.py grouped_allreduce / grouped_hierarchical_allreduce``
+  (``bucket_cap_bytes=`` path): one AllReduce per bucket.
+- ``opt.py DistributedOptimizer`` / ``training.py make_train_step``:
+  cap plumbed from ``HOROVOD_FUSION_THRESHOLD`` (the same knob the host
+  plane's cycle fusion consumes), default "auto".
+- ``zero.py``: the reduce-scatter/all-gather flat layout is built
+  per-bucket so shard exchange overlaps backward the same way.
+- ``common/parameter_manager.py``: the autotuner's fusion-threshold
+  search drives this cap too, so one tuner governs both planes.
+
+The planner is deliberately static and pure — under ``jit`` it runs at
+trace time on shape/dtype metadata only, so bucketing never adds runtime
+work beyond the collectives it restructures.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Bucket",
+    "plan_buckets",
+    "plan_buckets_for",
+    "leaf_nbytes",
+    "resolve_bucket_cap",
+    "describe_plan",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Bucket:
+    """One fusion bucket: the leaf indices it covers (in emission order),
+    their common dtype (as a string key; "mixed" never occurs — buckets
+    are dtype-pure by construction), and its payload size in bytes."""
+
+    indices: Tuple[int, ...]
+    dtype: Any
+    nbytes: int
+
+
+def leaf_nbytes(leaf) -> int:
+    """Byte size of an array-like or abstract value (works on tracers)."""
+    size = 1
+    for d in leaf.shape:
+        size *= int(d)
+    return size * leaf.dtype.itemsize
+
+
+# Low-precision floats are accumulated — and therefore travel the wire —
+# at fp32 (ops/xla.py allreduce; zero.py flattens to fp32 masters).
+_FP32_WIRE_DTYPES = ("bfloat16", "float16")
+
+
+def leaf_wire_nbytes(leaf) -> int:
+    """Bytes the leaf actually occupies in the fused collective: fp32
+    width for bf16/fp16 (the accumulation dtype), native width otherwise.
+    The cap is a *wire* budget — planning on storage bytes would make one
+    ``HOROVOD_FUSION_THRESHOLD`` mean 2x different effective bucket sizes
+    between a bf16 data-parallel allreduce and ZeRO's fp32 scatter."""
+    size = 1
+    for d in leaf.shape:
+        size *= int(d)
+    item = 4 if str(leaf.dtype) in _FP32_WIRE_DTYPES else leaf.dtype.itemsize
+    return size * item
+
+
+def plan_buckets(
+    sizes_bytes: Sequence[int],
+    dtypes: Sequence[Any],
+    bucket_cap_bytes: Optional[int] = None,
+) -> List[Bucket]:
+    """Partition leaves ``0..n-1`` into fusion buckets.
+
+    With ``bucket_cap_bytes`` unset (None or <= 0) the plan reproduces the
+    v1 monolithic grouping exactly: one bucket per dtype, dtypes in
+    first-seen order, indices ascending — byte-identical programs to the
+    pre-bucketing ``_grouped`` fast path.
+
+    With a cap, leaves are walked in REVERSE index order (parameter order
+    approximates forward graph order, so reverse order approximates the
+    order backprop produces gradients). A bucket closes when the next
+    leaf would push it past the cap or has a different dtype (buckets
+    stay dtype-pure AND contiguous in production order — an interleaved
+    dtype reopening an old bucket would reintroduce the late dependency
+    bucketing exists to break). A single leaf larger than the cap gets a
+    bucket of its own.
+    """
+    n = len(sizes_bytes)
+    if n != len(dtypes):
+        raise ValueError(f"sizes/dtypes length mismatch: {n} vs {len(dtypes)}")
+    if n == 0:
+        return []
+
+    if not bucket_cap_bytes or bucket_cap_bytes <= 0:
+        by_dtype: dict = {}
+        for i in range(n):
+            key = _dtype_key(dtypes[i])
+            by_dtype.setdefault(key, ([], dtypes[i]))[0].append(i)
+        return [
+            Bucket(tuple(idxs), dt, sum(sizes_bytes[i] for i in idxs))
+            for idxs, dt in by_dtype.values()
+        ]
+
+    cap = int(bucket_cap_bytes)
+    buckets: List[Bucket] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    cur_dtype: Any = None
+
+    def close():
+        nonlocal cur, cur_bytes, cur_dtype
+        if cur:
+            buckets.append(Bucket(tuple(cur), cur_dtype, cur_bytes))
+        cur, cur_bytes, cur_dtype = [], 0, None
+
+    for i in range(n - 1, -1, -1):
+        nb = int(sizes_bytes[i])
+        if cur and (_dtype_key(dtypes[i]) != _dtype_key(cur_dtype)
+                    or cur_bytes + nb > cap):
+            close()
+        cur.append(i)
+        cur_bytes += nb
+        cur_dtype = dtypes[i]
+        if cur_bytes >= cap:
+            close()
+    close()
+    return buckets
+
+
+def plan_buckets_for(leaves: Sequence[Any],
+                     bucket_cap_bytes: Optional[int] = None) -> List[Bucket]:
+    """Convenience overload: plan directly from array-likes / tracers,
+    budgeting each leaf at its WIRE width (see ``leaf_wire_nbytes``) so
+    the same cap means the same bucket sizes on every plane."""
+    return plan_buckets([leaf_wire_nbytes(l) for l in leaves],
+                        [l.dtype for l in leaves], bucket_cap_bytes)
+
+
+def _dtype_key(dtype: Any) -> str:
+    return str(dtype)
+
+
+def resolve_bucket_cap(bucket_cap_bytes) -> Optional[int]:
+    """Resolve a user-facing cap knob to an int or None (monolithic).
+
+    - ``"auto"`` (the plumbing default): the autotuned/explicit
+      ``HOROVOD_FUSION_THRESHOLD`` when one is in force — the live
+      runtime config when ``hvd.init()`` has run and the knob was set or
+      tuned, else the raw env var — otherwise None. An *unset* knob keeps
+      the v1 monolithic behavior byte-identical.
+    - ``None`` / ``0``: monolithic (explicitly no bucketing).
+    - int > 0: that many bytes.
+    """
+    if bucket_cap_bytes is None:
+        return None
+    if isinstance(bucket_cap_bytes, str):
+        if bucket_cap_bytes != "auto":
+            raise ValueError(
+                f"bucket_cap_bytes must be an int, None, or 'auto'; "
+                f"got {bucket_cap_bytes!r}")
+        from . import config as _config
+        from .state import global_state
+
+        st = global_state()
+        if (st.initialized and st.config is not None
+                and getattr(st.config, "fusion_threshold_explicit", False)):
+            v = int(st.config.fusion_threshold_bytes)
+            return v if v > 0 else None
+        # Same parser as RuntimeConfig.from_env (one owner for the env
+        # var's int semantics); <= 0 normalizes to monolithic everywhere.
+        v, explicit = _config._get_int_explicit(
+            _config.HOROVOD_FUSION_THRESHOLD, 0)
+        return v if explicit and v > 0 else None
+    cap = int(bucket_cap_bytes)
+    return cap if cap > 0 else None
+
+
+def describe_plan(buckets: Sequence[Bucket]) -> dict:
+    """JSON-friendly summary of a plan (bench/timeline attribution)."""
+    return {
+        "num_buckets": len(buckets),
+        "bucket_bytes": [b.nbytes for b in buckets],
+        "bucket_dtypes": [str(b.dtype) for b in buckets],
+        "bucket_sizes": [len(b.indices) for b in buckets],
+    }

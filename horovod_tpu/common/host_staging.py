@@ -30,6 +30,8 @@ from typing import Optional
 import numpy as np
 
 from . import config as _config
+from .compat import (distributed_is_initialized,
+                     shard_map as _compat_shard_map)
 from . import logging as _log
 from . import native as _native
 
@@ -105,7 +107,7 @@ class HostStagingExecutor:
         import jax
 
         world = self._world
-        if world.size > 1 and not jax.distributed.is_initialized():
+        if world.size > 1 and not distributed_is_initialized():
             addr = os.environ.get(_config.HOROVOD_CONTROLLER_ADDR,
                                   "127.0.0.1")
             port = int(os.environ.get(_config.HOROVOD_CONTROLLER_PORT,
@@ -138,6 +140,36 @@ class HostStagingExecutor:
 
         devices = [per_proc[i] for i in sorted(per_proc)]
         self._mesh = Mesh(np.array(devices, dtype=object), ("proc",))
+
+        if world.size > 1:
+            # Capability probe: some backends enumerate a multi-process
+            # device world but cannot COMPILE cross-process programs
+            # (jax < 0.5's CPU backend: "Multiprocess computations aren't
+            # implemented"). Prove one tiny psum compiles before going
+            # live — otherwise every staged collective would fail after
+            # routing already left the ring. COMPILE-ONLY on purpose:
+            # compilation is process-local, while *executing* a probe
+            # collective here would deadlock whenever a peer bailed out
+            # of activation earlier (env drift; init failure) — the
+            # stage-vs-ring agreement vote only happens after activate()
+            # returns, so no cross-rank rendezvous is safe yet.
+            try:
+                from jax import lax
+                from jax.sharding import PartitionSpec as P
+
+                probe = jax.jit(_compat_shard_map(
+                    lambda x: lax.psum(x, "proc"), self._mesh,
+                    in_specs=P("proc"), out_specs=P(), check_vma=False))
+                sharding = jax.sharding.NamedSharding(self._mesh, P("proc"))
+                arr = jax.make_array_from_process_local_data(
+                    sharding, np.ones((1,), np.float32), (world.size,))
+                probe.lower(arr).compile()
+            except Exception as e:
+                _log.warning(
+                    f"HOROVOD_HOST_VIA_XLA: backend cannot compile "
+                    f"cross-process programs ({e}); host tensors stay on "
+                    f"the TCP ring")
+                return False
 
         cfg = _config.RuntimeConfig.from_env()
         if cfg.timeline_filename and world.rank == 0:
@@ -400,7 +432,7 @@ class HostStagingExecutor:
             def fn(x):
                 return lax.all_gather(x[0], "proc")  # [P, n], replicated
 
-            prog = jax.jit(jax.shard_map(
+            prog = jax.jit(_compat_shard_map(
                 fn, mesh=mesh, in_specs=P("proc"), out_specs=P(),
                 check_vma=False))
             self._prog_put(key, prog)
@@ -445,7 +477,7 @@ class HostStagingExecutor:
                     y = y * postscale
                 return y.astype(x.dtype)[None]
 
-            prog = jax.jit(jax.shard_map(
+            prog = jax.jit(_compat_shard_map(
                 fn, mesh=mesh, in_specs=P("proc"), out_specs=P("proc"),
                 check_vma=False))
             self._prog_put(key, prog)
@@ -497,7 +529,7 @@ def build_ring_broadcast(mesh, n, root, p, axis="proc"):
         yc = lax.fori_loop(0, steps, body, yc)
         return yc.reshape(padded)[:n][None]
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(_compat_shard_map(
         fn, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
         check_vma=False))
 

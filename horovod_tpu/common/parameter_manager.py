@@ -38,8 +38,17 @@ class ParameterManager:
                  gp_noise: float = 0.8, log_file: str = "",
                  initial_cycle_ms: float = 5.0,
                  initial_fusion_bytes: int = 64 * MB,
-                 tune_hierarchical: bool = False):
+                 tune_hierarchical: bool = False,
+                 xla_cap_setter=None):
         self._core = core
+        # Tensor-fusion v2 hook: the tuned fusion threshold also governs
+        # the XLA plane's bucket cap (common/fusion.resolve_bucket_cap
+        # "auto"), so ONE tuner drives both planes. The setter publishes
+        # each applied threshold into the live RuntimeConfig; compiled
+        # steps pick it up at their next build (a changed cap is a new
+        # program — rebuilding/recompiling is inherent, not an autotune
+        # limitation).
+        self._xla_cap_setter = xla_cap_setter
         self._warmup_remaining = warmup_samples
         self._steps_per_sample = steps_per_sample
         self._max_samples = max_samples
@@ -147,6 +156,8 @@ class ParameterManager:
             self._core.set_parameters(
                 cycle_time_ms=float(cycle_ms),
                 fusion_threshold=int(fusion_mb * MB))
+        if self._xla_cap_setter is not None:
+            self._xla_cap_setter(int(fusion_mb * MB))
 
     def _apply_hier(self, flags: int) -> None:
         if self._core is not None:

@@ -89,7 +89,9 @@ def _maybe_init_distributed() -> None:
     # NOTE: no jax.process_count()/jax.devices() here — any backend query
     # initializes XLA, after which jax.distributed.initialize refuses to
     # run. Use the distributed client's own state to detect re-init.
-    if nproc <= 1 or jax.distributed.is_initialized():
+    from .compat import distributed_is_initialized
+
+    if nproc <= 1 or distributed_is_initialized():
         return
     rank = int(os.environ.get(_config.HOROVOD_RANK, "0"))
     addr = os.environ.get(_config.HOROVOD_CONTROLLER_ADDR, "127.0.0.1")
@@ -193,6 +195,30 @@ def init(comm=None, devices=None):
             from .parameter_manager import ParameterManager
 
             cfg = _state.config
+
+            def _publish_xla_cap(nbytes: int) -> None:
+                # Publish the tuned threshold into the live config, where
+                # common/fusion.resolve_bucket_cap("auto") reads it — the
+                # tuner's (fusion MB, cycle ms) point governs the XLA
+                # plane's bucket cap as well as the host plane's cycle
+                # fusion (tensor-fusion v2; steps built after this pick
+                # the new cap up). SINGLE-CONTROLLER ONLY (gated below):
+                # in a multi-process world this config lives on rank 0
+                # alone — "auto" steps rebuilt after tuning would bucket
+                # on rank 0 but stay monolithic elsewhere, divergent
+                # collective sequences in one SPMD program. Workers
+                # receive tuned parameters through the native response
+                # sync, which does not touch their Python RuntimeConfig.
+                cfg.fusion_threshold_bytes = int(nbytes)
+                cfg.fusion_threshold_explicit = True
+
+            if _state.process_count > 1:
+                _log.debug(
+                    "autotune: XLA bucket-cap publish disabled in "
+                    "multi-process worlds (set HOROVOD_FUSION_THRESHOLD "
+                    "explicitly to bucket the compiled path everywhere)")
+                _publish_xla_cap = None
+
             core = _state.engine.native_core
             _state.autotuner = ParameterManager(
                 core, warmup_samples=cfg.autotune_warmup_samples,
@@ -207,7 +233,8 @@ def init(comm=None, devices=None):
                 # only lose (or win by noise), and the grid would burn
                 # 4 sample windows on a meaningless choice.
                 tune_hierarchical=(_state.hier_mesh is not None
-                                   and _state.cross_size > 1))
+                                   and _state.cross_size > 1),
+                xla_cap_setter=_publish_xla_cap)
 
         _state.initialized = True
         _log.info(

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..common.compat import shard_map as _compat_shard_map
 from ..parallel.moe import moe_layer
 from ..parallel.pipeline import spmd_pipeline
 from ..parallel.ulysses import context_parallel_attention
@@ -372,7 +373,7 @@ def make_loss_fn(cfg: TransformerConfig, mesh, n_microbatches: int = 2,
     data = P("dp", "sp")
     in_specs = ((specs, data, data, data) if packed
                 else (specs, data, data))
-    return jax.shard_map(spmd_loss, mesh=mesh, in_specs=in_specs,
+    return _compat_shard_map(spmd_loss, mesh=mesh, in_specs=in_specs,
                          out_specs=P(), check_vma=False)
 
 
@@ -517,7 +518,7 @@ def make_forward_fn(cfg: TransformerConfig, mesh, n_microbatches: int = 2):
     def spmd_fwd(params, tokens):
         return _spmd_forward(cfg, stage_fn, params, tokens, n_microbatches)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(_compat_shard_map(
         spmd_fwd, mesh=mesh,
         in_specs=(specs, P("dp", "sp")),
         out_specs=P("dp", "sp"), check_vma=False))

@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..common.compat import axis_size as _axis_size
+
 from ..common.state import AXIS_CROSS, AXIS_GLOBAL, AXIS_LOCAL
 
 
@@ -64,7 +66,7 @@ def adasum_allreduce(tensor, axis_name: str = AXIS_GLOBAL):
     verified against the same NumPy reference the reference tests use
     (``test_adasum_pytorch.py``).
     """
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     if not _is_power_of_two(n):
         raise ValueError(
             f"Adasum requires a power-of-two participant count, got {n}"
@@ -128,7 +130,7 @@ def grouped_adasum_allreduce(tensors, axis_name: str = AXIS_GLOBAL):
     coefficients computed per tensor (reference ``tensor_counts``
     contract) via segment sums — the wire cost of one allreduce chain
     instead of ``len(tensors)`` of them, exact per-tensor math."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     if not _is_power_of_two(n):
         raise ValueError(
             f"Adasum requires a power-of-two participant count, got {n}")
@@ -150,14 +152,14 @@ def grouped_hierarchical_adasum_allreduce(tensors):
     Per-tensor dots survive the scatter because each rank's shard keeps
     its element→tensor segment map (sliced by ``axis_index``) and the
     scalars are psum'd over AXIS_LOCAL before use."""
-    n = lax.axis_size(AXIS_CROSS)
+    n = _axis_size(AXIS_CROSS)
     if not _is_power_of_two(n):
         raise ValueError(
             f"hierarchical Adasum requires a power-of-two cross size, got {n}"
         )
     fused, seg_ids, bounds = _fused_segments(tensors)
     T = len(tensors)
-    local_n = lax.axis_size(AXIS_LOCAL)
+    local_n = _axis_size(AXIS_LOCAL)
     pad = (-fused.shape[0]) % local_n
     if pad:
         fused = jnp.pad(fused, (0, pad))
@@ -209,7 +211,7 @@ def hierarchical_adasum_allreduce(tensor):
     documented hierarchical behavior (LR-scaling guidance ~= local_size,
     ``docs/adasum_user_guide.rst:208-210``).
     """
-    n = lax.axis_size(AXIS_CROSS)
+    n = _axis_size(AXIS_CROSS)
     if not _is_power_of_two(n):
         raise ValueError(
             f"hierarchical Adasum requires a power-of-two cross size, got {n}"
@@ -217,7 +219,7 @@ def hierarchical_adasum_allreduce(tensor):
     dtype = tensor.dtype
     shape = tensor.shape
     flat = jnp.ravel(tensor).astype(jnp.float32)
-    local_n = lax.axis_size(AXIS_LOCAL)
+    local_n = _axis_size(AXIS_LOCAL)
     pad = (-flat.shape[0]) % local_n
     if pad:
         flat = jnp.pad(flat, (0, pad))

@@ -64,8 +64,10 @@ _KIND_FROM_OP = {v: k for k, v in _OP_TO_NATIVE.items()}
 def _shard_map(fn, mesh, in_specs, out_specs):
     # check_vma=False: collective outputs (e.g. all_gather) are replicated
     # by construction, which the static VMA checker cannot always infer.
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, check_vma=False)
+    from ..common.compat import shard_map
+
+    return shard_map(fn, mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_vma=False)
 
 
 class _Pending:

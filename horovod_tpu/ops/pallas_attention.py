@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ..common.compat import pallas_tpu_compiler_params as _compiler_params
+
 NEG_INF = -1e30
 
 # Tile sizes: multiples of the fp32 (8, 128) tile, sized by an on-chip
@@ -394,7 +396,7 @@ def _pallas_block_state(q, k, v, offs, causal: bool, interpret: bool,
             jax.ShapeDtypeStruct((BH, Tq, 1), jnp.float32),
             jax.ShapeDtypeStruct((BH, Tq, 1), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*args)
@@ -642,7 +644,7 @@ def _pallas_attention_fwd(q, k, v, q_off, k_off, causal: bool,
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*args)
@@ -697,7 +699,7 @@ def _pallas_attention_fwd_train(q, k, v, offs, causal: bool,
             jax.ShapeDtypeStruct((BH, Tq, D), q.dtype),
             jax.ShapeDtypeStruct((BH, Tq, 1), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*args)
@@ -743,7 +745,7 @@ def _pallas_bwd(q, k, v, do, lse, delta, offs, causal: bool,
             scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((BH, Tq, D), dq_dt),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*dq_args)
@@ -778,7 +780,7 @@ def _pallas_bwd(q, k, v, do, lse, delta, offs, causal: bool,
         ),
         out_shape=[jax.ShapeDtypeStruct((BH, Tk, D), dk_dt),
                    jax.ShapeDtypeStruct((BH, Tk, D), dv_dt)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*kv_args)

@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..common.compat import axis_size as _axis_size
+
 from ..common.state import AXIS_CROSS, AXIS_GLOBAL, AXIS_LOCAL
 
 
@@ -79,7 +81,7 @@ def allreduce(
     if op in (ReduceOp.SUM, ReduceOp.AVERAGE):
         out = lax.psum(acc, axis_name)
         if op == ReduceOp.AVERAGE:
-            n = lax.axis_size(axis_name)
+            n = _axis_size(axis_name)
             out = out / jnp.asarray(n, dtype=out.dtype)
     elif op == ReduceOp.MIN:
         out = lax.pmin(acc, axis_name)
@@ -91,24 +93,33 @@ def allreduce(
     return _apply_postscale(out, postscale_factor)
 
 
-def _grouped(tensors, reduce_fn):
-    """Shared dtype-concat fusion: flatten, concatenate per dtype, reduce
-    each fused buffer with ``reduce_fn``, slice results back out.
+def _grouped(tensors, reduce_fn, bucket_cap_bytes=None):
+    """Shared dtype-concat fusion: flatten, concatenate per plan bucket,
+    reduce each fused buffer with ``reduce_fn``, slice results back out.
 
     TPU-native tensor fusion: rather than memcpy into a fusion buffer
     (reference ``MemcpyInFusionBuffer``, ``gpu_operations.cc:97``), we
-    concatenate flattened tensors per dtype inside the compiled program and
-    let XLA emit a single AllReduce per dtype group; the concat/split are
-    fused away or become cheap on-chip moves.
+    concatenate flattened tensors inside the compiled program and let XLA
+    emit one AllReduce per bucket; the concat/split are fused away or
+    become cheap on-chip moves.
+
+    ``bucket_cap_bytes`` unset → the v1 monolithic plan (one bucket per
+    dtype, parameter order) — byte-identical programs to before the
+    planner existed. Set → size-capped dtype-pure buckets in reverse
+    parameter (≈ backward-production) order from
+    ``common/fusion.plan_buckets``, so each bucket's AllReduce depends
+    only on its own gradients and XLA can overlap communication with the
+    rest of the backward pass (tensor-fusion v2; see
+    ``docs/tensor-fusion.md``).
     """
+    from ..common.fusion import plan_buckets_for
+
     if not tensors:
         return []
     flats = [jnp.ravel(t) for t in tensors]
-    by_dtype = {}
-    for i, f in enumerate(flats):
-        by_dtype.setdefault(f.dtype, []).append(i)
     out = [None] * len(tensors)
-    for _, idxs in by_dtype.items():
+    for bucket in plan_buckets_for(flats, bucket_cap_bytes):
+        idxs = list(bucket.indices)
         fused = (jnp.concatenate([flats[i] for i in idxs])
                  if len(idxs) > 1 else flats[idxs[0]])
         red = reduce_fn(fused)
@@ -122,28 +133,61 @@ def _grouped(tensors, reduce_fn):
 
 
 def grouped_allreduce(tensors, axis_name: str = AXIS_GLOBAL, op: int = ReduceOp.SUM,
-                      prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+                      prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+                      bucket_cap_bytes=None):
     """Allreduce a list of tensors as one fused operation (see ``_grouped``).
+
+    ``bucket_cap_bytes`` (bytes, or ``"auto"`` to follow
+    ``HOROVOD_FUSION_THRESHOLD``) switches v1's one-AllReduce-per-dtype
+    fusion to size-capped backward-order buckets — one AllReduce per
+    bucket that XLA can launch while earlier-layer gradients are still
+    being computed. Unset keeps the v1 monolithic behavior exactly.
 
     Adasum is NOT a per-element reduction: its dot/norm coefficients are
     per tensor, so a fused Adasum group applies the combination per
     tensor instead of on the concatenated buffer (reference
     ``tensor_counts`` contract, ``adasum_gpu_operations.cc:208-232``) —
     XLA still compiles the whole group into one program, so fusion's
-    launch-overhead win is preserved.
+    launch-overhead win is preserved. Bucketing partitions the *launch*
+    groups only; the per-tensor Adasum contract is unchanged.
     """
+    from ..common.fusion import resolve_bucket_cap
+
+    cap = resolve_bucket_cap(bucket_cap_bytes)
     if op == ReduceOp.ADASUM:
         from .adasum import grouped_adasum_allreduce
 
         pre = [_apply_prescale(t, prescale_factor) for t in tensors]
-        return [_apply_postscale(t, postscale_factor)
-                for t in grouped_adasum_allreduce(pre,
-                                                  axis_name=axis_name)]
+        red = _grouped_per_tensor(
+            pre, lambda chunk: grouped_adasum_allreduce(
+                chunk, axis_name=axis_name), cap)
+        return [_apply_postscale(t, postscale_factor) for t in red]
     return _grouped(
         tensors,
         lambda fused: allreduce(fused, axis_name=axis_name, op=op,
                                 prescale_factor=prescale_factor,
-                                postscale_factor=postscale_factor))
+                                postscale_factor=postscale_factor),
+        bucket_cap_bytes=cap)
+
+
+def _grouped_per_tensor(tensors, group_fn, bucket_cap_bytes):
+    """Bucketing for per-tensor (non-elementwise) group reductions
+    (Adasum): partition the tensor list with the same backward-order
+    planner, apply ``group_fn`` to each bucket's tensors as a list.
+    With no cap this is a single call over the whole list — identical to
+    the unbucketed path."""
+    from ..common.fusion import plan_buckets_for
+
+    if not tensors:
+        return []
+    if not bucket_cap_bytes:
+        return group_fn(tensors)
+    out = [None] * len(tensors)
+    for bucket in plan_buckets_for(tensors, bucket_cap_bytes):
+        idxs = list(bucket.indices)
+        for i, r in zip(idxs, group_fn([tensors[i] for i in idxs])):
+            out[i] = r
+    return out
 
 
 def hierarchical_allreduce(tensor, op: int = ReduceOp.SUM):
@@ -163,7 +207,7 @@ def hierarchical_allreduce(tensor, op: int = ReduceOp.SUM):
     acc = (tensor.astype(jnp.float32)
            if dtype in (jnp.bfloat16, jnp.float16) else tensor)
     flat = jnp.ravel(acc)
-    local_n = lax.axis_size(AXIS_LOCAL)
+    local_n = _axis_size(AXIS_LOCAL)
     pad = (-flat.shape[0]) % local_n
     if pad:
         flat = jnp.pad(flat, (0, pad))
@@ -174,25 +218,34 @@ def hierarchical_allreduce(tensor, op: int = ReduceOp.SUM):
         full = full[: flat.shape[0] - pad]
     out = jnp.reshape(full, acc.shape)
     if op == ReduceOp.AVERAGE:
-        n = lax.axis_size(AXIS_LOCAL) * lax.axis_size(AXIS_CROSS)
+        n = _axis_size(AXIS_LOCAL) * _axis_size(AXIS_CROSS)
         out = out / jnp.asarray(n, dtype=out.dtype)
     return out.astype(dtype)
 
 
 def grouped_hierarchical_allreduce(tensors, op: int = ReduceOp.SUM,
                                    prescale_factor: float = 1.0,
-                                   postscale_factor: float = 1.0):
+                                   postscale_factor: float = 1.0,
+                                   bucket_cap_bytes=None):
     """Fused hierarchical allreduce (dtype-concat fusion like
     ``grouped_allreduce``, ICI/DCN split like ``hierarchical_allreduce``).
     Supports SUM/AVERAGE (``psum_scatter``-expressible) and ADASUM — the
     latter per tensor (Adasum coefficients are per-tensor; see
-    ``grouped_allreduce``) via ``hierarchical_adasum_allreduce``."""
+    ``grouped_allreduce``) via ``hierarchical_adasum_allreduce``.
+    ``bucket_cap_bytes`` buckets exactly as in ``grouped_allreduce``;
+    each bucket runs the full ICI/DCN ladder independently, so the
+    scatter leg of bucket k overlaps the backward that produces bucket
+    k+1."""
+    from ..common.fusion import resolve_bucket_cap
+
+    cap = resolve_bucket_cap(bucket_cap_bytes)
     if op == ReduceOp.ADASUM:
         from .adasum import grouped_hierarchical_adasum_allreduce
 
         pre = [_apply_prescale(t, prescale_factor) for t in tensors]
-        return [_apply_postscale(t, postscale_factor)
-                for t in grouped_hierarchical_adasum_allreduce(pre)]
+        red = _grouped_per_tensor(
+            pre, grouped_hierarchical_adasum_allreduce, cap)
+        return [_apply_postscale(t, postscale_factor) for t in red]
     if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
         raise ValueError(
             f"hierarchical allreduce supports SUM/AVERAGE/ADASUM, got op {op}")
@@ -202,7 +255,7 @@ def grouped_hierarchical_allreduce(tensors, op: int = ReduceOp.SUM,
         return _apply_postscale(hierarchical_allreduce(fused, op=op),
                                 postscale_factor)
 
-    return _grouped(tensors, reduce_fn)
+    return _grouped(tensors, reduce_fn, bucket_cap_bytes=cap)
 
 
 def allgather(tensor, axis_name: str = AXIS_GLOBAL):
@@ -244,13 +297,13 @@ def reducescatter(tensor, axis_name: str = AXIS_GLOBAL, op: int = ReduceOp.SUM):
     this op after v0.19 — included for completeness on TPU)."""
     out = lax.psum_scatter(tensor, axis_name, tiled=True)
     if op == ReduceOp.AVERAGE:
-        out = out / jnp.asarray(lax.axis_size(axis_name), dtype=out.dtype)
+        out = out / jnp.asarray(_axis_size(axis_name), dtype=out.dtype)
     return out
 
 
 def alltoall(tensor, axis_name: str = AXIS_GLOBAL):
     """Exchange equal splits of dim 0 between all participants."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     x = jnp.reshape(tensor, (n, -1) + tensor.shape[1:] if tensor.ndim > 1 else (n, tensor.shape[0] // n))
     x = lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0, tiled=False)
     return jnp.reshape(x, (-1,) + tensor.shape[1:])

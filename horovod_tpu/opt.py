@@ -40,14 +40,27 @@ def DistributedOptimizer(
     postscale_factor: float = 1.0,
     backward_passes_per_step: int = 1,
     compression=None,
+    bucket_cap_bytes="auto",
 ) -> optax.GradientTransformation:
     """Wrap ``optimizer`` so updates are computed from mesh-reduced grads.
 
     Must be used inside a program where ``axis_name`` is bound (shard_map /
     pjit over ``hvd.mesh()``); single-device programs may simply not bind
     the axis and pass ``axis_name=None`` to skip communication.
+
+    ``bucket_cap_bytes`` selects tensor-fusion v2 (backward-order bucketed
+    AllReduces that overlap backprop, ``common/fusion.py``): an int caps
+    each bucket at that many bytes; ``"auto"`` (default) follows
+    ``HOROVOD_FUSION_THRESHOLD`` — the same knob that paces the host
+    plane's cycle fusion, including its autotuned value — and stays
+    monolithic (v1, one AllReduce per dtype) when the knob was never set;
+    ``None`` forces monolithic.
     """
     import jax.numpy as jnp
+
+    from .common.fusion import resolve_bucket_cap
+
+    cap = resolve_bucket_cap(bucket_cap_bytes)
 
     def reduce_grads(grads):
         if axis_name is None:
@@ -59,6 +72,7 @@ def DistributedOptimizer(
             leaves, axis_name=axis_name, op=op,
             prescale_factor=prescale_factor,
             postscale_factor=postscale_factor,
+            bucket_cap_bytes=cap,
         )
         out = jax.tree_util.tree_unflatten(treedef, reduced)
         if compression is not None:

@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..common.compat import axis_size as _axis_size
+
 
 def init_moe_params(rng, d_model: int, d_ff: int, n_experts: int,
                     dtype=jnp.float32):
@@ -49,7 +51,7 @@ def moe_layer(x, params, axis_name: str = "dp", capacity_factor: float = 1.25,
 
     Returns [T, d], or ([T, d], aux) with ``return_aux``.
     """
-    ep = lax.axis_size(axis_name)
+    ep = _axis_size(axis_name)
     T, d = x.shape
     e_local = params["w_in"].shape[0]
     E = e_local * ep

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..common.compat import axis_size as _axis_size
+
 
 def spmd_pipeline(stage_fn: Callable, stage_params, microbatches,
                   axis_name: str = "pp", collect_fn: Callable = None):
@@ -46,7 +48,7 @@ def spmd_pipeline(stage_fn: Callable, stage_params, microbatches,
     LAST stage (valid on every member after the closing psum-broadcast).
     """
     tmap = jax.tree_util.tree_map
-    S = lax.axis_size(axis_name)
+    S = _axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     M = jax.tree_util.tree_leaves(microbatches)[0].shape[0]
     T = M + S - 1

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..common.compat import axis_size as _axis_size
+
 NEG_INF = -1e30
 
 
@@ -68,7 +70,7 @@ def _ring_fwd_pass(q, k, v, seg, axis_name: str, causal: bool,
     lse is the backward pass's residual. ``seg``: optional int32 [B, T]
     local segment ids (packed sequences); the K-side ids rotate with
     their K/V block."""
-    sp = lax.axis_size(axis_name)
+    sp = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
     g = H // k.shape[2]  # GQA group size (1 = plain multi-head)
@@ -116,6 +118,13 @@ def _ring_fwd_pass(q, k, v, seg, axis_name: str, causal: bool,
     # Dead rows (no visible key) take a huge POSITIVE lse so the
     # backward's exp(s - lse) underflows to zero for them.
     lse = jnp.where(l > 0.0, m + jnp.log(jnp.maximum(l, 1e-30)), -NEG_INF)
+    # Anchor the axis index in the live output dataflow: when the mask
+    # path doesn't consume it (causal=False, no window/segments), some
+    # XLA versions leave the dead partition-id where the SPMD partitioner
+    # rejects it ("PartitionId instruction is not supported for SPMD
+    # partitioning", jaxlib 0.4.x CPU). A zero-weight use costs nothing
+    # and keeps the op inside the manual region.
+    o = o + (my * 0).astype(o.dtype)
     return o.astype(q.dtype), lse
 
 
@@ -139,7 +148,7 @@ def _ring_vjp_bwd(axis_name, causal, window, res, do):
     from ..ops.pallas_attention import flash_attention_block_grads
 
     q, k, v, seg, o, lse = res
-    sp = lax.axis_size(axis_name)
+    sp = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
@@ -185,6 +194,8 @@ def _ring_vjp_bwd(axis_name, causal, window, res, do):
         body, (dq0, dk0, dv0, k, v, kseg0), jnp.arange(sp))
     from ..ops.pallas_attention import int_cotangent
 
+    # Same partition-id anchor as the forward pass (see _ring_fwd_pass).
+    dq = dq + (my * 0).astype(dq.dtype)
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
             int_cotangent(seg))
 
@@ -210,7 +221,7 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
     the K-side ids rotate around the ring with their K/V block and
     stream into the flash kernels as extra id tiles.
     """
-    sp = lax.axis_size(axis_name)
+    sp = _axis_size(axis_name)
     if sp == 1:
         from ..ops.pallas_attention import flash_attention
 

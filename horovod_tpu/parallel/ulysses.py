@@ -32,6 +32,8 @@ from __future__ import annotations
 
 from jax import lax
 
+from ..common.compat import axis_size as _axis_size
+
 
 def gather_segment_ids(segment_ids, axis_name: str = "sp"):
     """All-gather sequence-sharded segment ids to [B, T_global].
@@ -60,7 +62,7 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
     from :func:`gather_segment_ids` to hoist the gather out of a layer
     loop).
     """
-    sp = lax.axis_size(axis_name)
+    sp = _axis_size(axis_name)
     from jax import numpy as jnp
 
     from ..ops.pallas_attention import flash_attention
@@ -122,7 +124,7 @@ def context_parallel_attention(q, k, v, axis_name: str = "sp",
     from .ring_attention import ring_attention
 
     if strategy == "auto":
-        sp = lax.axis_size(axis_name)
+        sp = _axis_size(axis_name)
         # Both query AND (GQA-reduced) KV heads must divide the axis for
         # ulysses' head split; otherwise fall back to ring as documented.
         strategy = ("ulysses" if q.shape[2] % sp == 0

@@ -19,6 +19,7 @@ import optax
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .common.compat import shard_map as _shard_map
 from .common.state import AXIS_GLOBAL
 from .opt import DistributedOptimizer
 
@@ -39,17 +40,25 @@ def cross_entropy_loss(logits, labels):
 def make_train_step(model, optimizer: optax.GradientTransformation,
                     mesh, axis_name: str = AXIS_GLOBAL,
                     reduce_op: Optional[int] = None,
-                    donate: bool = True):
+                    donate: bool = True,
+                    bucket_cap_bytes="auto"):
     """Build a jitted SPMD train step over ``mesh``.
 
     Params/optimizer state are replicated; the batch is sharded along
     ``axis_name``. Batch-norm statistics are cross-chip averaged each step
     (the reference ships SyncBatchNorm for this, ``torch/sync_batch_norm.py``).
+
+    ``bucket_cap_bytes`` is the tensor-fusion v2 knob (see
+    ``DistributedOptimizer``): an int buckets the gradient AllReduce at
+    that byte cap in backward order so communication overlaps backprop;
+    ``"auto"`` (default) follows ``HOROVOD_FUSION_THRESHOLD`` and stays
+    monolithic when that knob was never set; ``None`` forces monolithic.
     """
     from .ops.xla import ReduceOp
 
     op = ReduceOp.AVERAGE if reduce_op is None else reduce_op
-    dist_opt = DistributedOptimizer(optimizer, op=op, axis_name=axis_name)
+    dist_opt = DistributedOptimizer(optimizer, op=op, axis_name=axis_name,
+                                    bucket_cap_bytes=bucket_cap_bytes)
 
     def step_fn(state: TrainState, images, labels):
         def loss_fn(p):
@@ -78,8 +87,8 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
     replicated = P()
     batch_spec = P(axis_name)
 
-    sharded_step = jax.shard_map(
-        step_fn, mesh=mesh,
+    sharded_step = _shard_map(
+        step_fn, mesh,
         in_specs=(replicated, batch_spec, batch_spec),
         out_specs=(replicated, replicated),
         check_vma=False,

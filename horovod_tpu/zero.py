@@ -42,6 +42,7 @@ import optax
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .common.compat import shard_map as _shard_map
 from .common.state import AXIS_GLOBAL
 
 
@@ -52,21 +53,102 @@ class ZeroTrainState(NamedTuple):
     gaccum: Any       # accumulated gradient shard (None unless accumulating)
     batch_stats: Any
     step: Any
-
-
-def _flat_spec(params):
-    """Static flattening plan: (leaves treedef, shapes, sizes, total)."""
-    leaves, treedef = jax.tree_util.tree_flatten(params)
-    shapes = [l.shape for l in leaves]
-    dtypes = [l.dtype for l in leaves]
-    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
-    return treedef, shapes, dtypes, sizes, int(sum(sizes))
+    # Fusion-bucket cap (bytes) the shard layout was built under, as a
+    # replicated int32 scalar (-1 = monolithic). THE STATE OWNS THE
+    # LAYOUT: make_zero_train_step reads the cap from here, so an
+    # "auto"-resolved cap can never drift between init and step (e.g.
+    # when the autotuner publishes a new threshold in between) — total
+    # padded size alone cannot detect such drift when leaf sizes align
+    # with the mesh (zero per-bucket padding).
+    bucket_cap: Any = None
 
 
 def _shard_len(total: int, d: int) -> int:
     """One source of truth for the padding arithmetic: flat length padded
     up to a multiple of d, divided across the d shards."""
     return ((total + d - 1) // d * d) // d
+
+
+class _ZeroPlan(NamedTuple):
+    """Static flattening plan, generalized over fusion buckets.
+
+    The device shard is the concatenation of per-bucket shards: bucket j
+    flattens its leaves (fp32), pads to a multiple of d, reduce-scatters,
+    and contributes ``bucket_padded[j] // d`` elements. With no bucket
+    cap there is exactly one bucket holding every leaf in parameter
+    order — the layout (and therefore every checkpointed shard) is
+    bit-identical to the pre-bucketing monolithic flat. With a cap,
+    buckets come from ``common/fusion.plan_buckets`` in reverse parameter
+    (≈ backward-production) order, so each bucket's reduce-scatter
+    depends only on its own gradients and overlaps the rest of backprop.
+    States built under different caps have different shard layouts and
+    are not interchangeable — rebuild (or restore via the pytree
+    checkpoint path) when changing the cap.
+    """
+
+    treedef: Any
+    shapes: tuple
+    dtypes: tuple
+    sizes: tuple          # per-leaf element counts
+    total: int            # sum(sizes)
+    buckets: tuple        # tuple[tuple[int, ...]]: leaf indices per bucket
+    bucket_elems: tuple   # unpadded element count per bucket
+    bucket_padded: tuple  # padded element count per bucket (multiple of d)
+    shard_len: int        # per-device shard length
+
+    @property
+    def padded(self) -> int:
+        return sum(self.bucket_padded)
+
+
+def _make_plan(params, d: int, bucket_cap_bytes=None) -> _ZeroPlan:
+    from .common.fusion import plan_buckets
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    shapes = tuple(l.shape for l in leaves)
+    dtypes = tuple(l.dtype for l in leaves)
+    sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
+    total = int(sum(sizes))
+    if bucket_cap_bytes:
+        # The wire format is fp32 regardless of model dtype (reduction
+        # precision), so the planner sees fp32 byte sizes and one dtype —
+        # buckets close on the byte cap only.
+        buckets = tuple(
+            b.indices for b in plan_buckets(
+                [s * 4 for s in sizes], [jnp.float32] * len(sizes),
+                bucket_cap_bytes))
+    else:
+        buckets = (tuple(range(len(sizes))),) if sizes else ()
+    bucket_elems = tuple(sum(sizes[i] for i in idxs) for idxs in buckets)
+    bucket_padded = tuple(_shard_len(n, d) * d for n in bucket_elems)
+    shard_len = sum(p // d for p in bucket_padded)
+    return _ZeroPlan(treedef, shapes, dtypes, sizes, total, buckets,
+                     bucket_elems, bucket_padded, shard_len)
+
+
+def _bucket_flat_f32(leaves, plan: _ZeroPlan, j: int):
+    """Bucket j's leaves as one padded fp32 flat (the scatter payload)."""
+    idxs = plan.buckets[j]
+    flat = (jnp.concatenate([leaves[i].astype(jnp.float32).reshape(-1)
+                             for i in idxs])
+            if len(idxs) > 1
+            else leaves[idxs[0]].astype(jnp.float32).reshape(-1))
+    pad = plan.bucket_padded[j] - plan.bucket_elems[j]
+    return jnp.pad(flat, (0, pad)) if pad else flat
+
+
+def _unflatten_plan(bucket_flats, plan: _ZeroPlan):
+    """Rebuild the parameter pytree from per-bucket gathered flats."""
+    leaves = [None] * len(plan.sizes)
+    for j, idxs in enumerate(plan.buckets):
+        flat = bucket_flats[j]
+        off = 0
+        for i in idxs:
+            n = plan.sizes[i]
+            leaves[i] = (flat[off:off + n].reshape(plan.shapes[i])
+                         .astype(plan.dtypes[i]))
+            off += n
+    return jax.tree_util.tree_unflatten(plan.treedef, leaves)
 
 
 def _opt_state_specs(optimizer, shard_len, axis_name):
@@ -80,24 +162,11 @@ def _opt_state_specs(optimizer, shard_len, axis_name):
         lambda s: P(axis_name) if len(s.shape) >= 1 else P(), shapes)
 
 
-def _flatten_f32(params, total, padded):
-    leaves = jax.tree_util.tree_leaves(params)
-    flat = jnp.concatenate(
-        [l.astype(jnp.float32).reshape(-1) for l in leaves])
-    return jnp.pad(flat, (0, padded - total))
-
-
-def _unflatten(flat, treedef, shapes, dtypes, sizes, total):
-    parts = jnp.split(flat[:total], np.cumsum(sizes)[:-1])
-    leaves = [p.reshape(s).astype(dt)
-              for p, s, dt in zip(parts, shapes, dtypes)]
-    return jax.tree_util.tree_unflatten(treedef, leaves)
-
-
 def init_zero_train_state(model, optimizer: optax.GradientTransformation,
                           rng, sample_input, mesh,
                           axis_name: str = AXIS_GLOBAL,
-                          accumulate_steps: int = 1) -> ZeroTrainState:
+                          accumulate_steps: int = 1,
+                          bucket_cap_bytes="auto") -> ZeroTrainState:
     """Initialize params (replicated) + the sharded fp32 master weights
     and optimizer state.
 
@@ -105,24 +174,44 @@ def init_zero_train_state(model, optimizer: optax.GradientTransformation,
     flat shard inside a shard_mapped init, so they are born sharded — no
     full fp32 copy ever exists on any one device. With
     ``accumulate_steps > 1`` a sharded gradient accumulator is added (the
-    ``backward_passes_per_step`` role, still 1/d memory)."""
+    ``backward_passes_per_step`` role, still 1/d memory).
+
+    ``bucket_cap_bytes`` defines the shard layout (see ``_ZeroPlan``)
+    and is recorded IN the state (``bucket_cap``); the step built by
+    ``make_zero_train_step`` reads it from there, so an "auto"-resolved
+    cap cannot drift between init and step even if the autotuner
+    publishes a new threshold in between."""
+    from .common.fusion import resolve_bucket_cap
+
     variables = model.init(rng, sample_input, train=False)
     params = variables["params"]
     batch_stats = variables.get("batch_stats")
 
     d = int(mesh.shape[axis_name])
-    _, _, _, _, total = _flat_spec(params)
-    shard_len = _shard_len(total, d)
-    padded = shard_len * d
+    cap = resolve_bucket_cap(bucket_cap_bytes)
+    if cap is not None and cap >= 2 ** 31:
+        # The cap is stamped into the state as int32 (x64-safe); a >=2GiB
+        # bucket cap is indistinguishable from monolithic in practice —
+        # reject it instead of overflowing deep inside init.
+        raise ValueError(
+            f"bucket_cap_bytes={cap} does not fit int32; use a smaller "
+            f"cap (or None for monolithic fusion)")
+    plan = _make_plan(params, d, cap)
+    shard_len = plan.shard_len
 
     def init_shard(p):
-        flat = _flatten_f32(p, total, padded)
+        leaves = jax.tree_util.tree_leaves(p)
         idx = lax.axis_index(axis_name)
-        my = lax.dynamic_slice(flat, (idx * shard_len,), (shard_len,))
+        segs = []
+        for j in range(len(plan.buckets)):
+            slen = plan.bucket_padded[j] // d
+            segs.append(lax.dynamic_slice(
+                _bucket_flat_f32(leaves, plan, j), (idx * slen,), (slen,)))
+        my = jnp.concatenate(segs) if len(segs) > 1 else segs[0]
         return my, optimizer.init(my)
 
-    sharded_init = jax.jit(jax.shard_map(
-        init_shard, mesh=mesh, in_specs=(P(),),
+    sharded_init = jax.jit(_shard_map(
+        init_shard, mesh, in_specs=(P(),),
         out_specs=(P(axis_name),
                    _opt_state_specs(optimizer, shard_len, axis_name)),
         check_vma=False))
@@ -138,16 +227,20 @@ def init_zero_train_state(model, optimizer: optax.GradientTransformation,
         # padded fp32 buffer on one device first would break the "no full
         # fp32 copy on any one device" invariant exactly when it matters.
         gaccum = jax.jit(
-            lambda: jnp.zeros((padded,), jnp.float32),
+            lambda: jnp.zeros((plan.padded,), jnp.float32),
             out_shardings=NamedSharding(mesh, P(axis_name)))()
     return ZeroTrainState(params, pshard, opt_shard, gaccum, batch_stats,
                           jax.device_put(jnp.zeros((), jnp.int32),
-                                         replicated))
+                                         replicated),
+                          jax.device_put(
+                              jnp.asarray(-1 if cap is None else cap,
+                                          jnp.int32), replicated))
 
 
 def make_zero_train_step(model, optimizer: optax.GradientTransformation,
                          mesh, axis_name: str = AXIS_GLOBAL,
-                         donate: bool = True, accumulate_steps: int = 1):
+                         donate: bool = True, accumulate_steps: int = 1,
+                         bucket_cap_bytes="auto"):
     """Build the jitted SPMD train step with ZeRO-1 optimizer sharding.
 
     Drop-in alternative to ``training.make_train_step`` (same call
@@ -165,75 +258,97 @@ def make_zero_train_step(model, optimizer: optax.GradientTransformation,
     hook accumulation effectively does — multiply the learning rate by k
     when porting a reference config that relied on summed accumulation.
     Requires a state built with the same ``accumulate_steps``."""
+    from .common.fusion import resolve_bucket_cap
     from .training import cross_entropy_loss
 
     d = int(mesh.shape[axis_name])
     k = accumulate_steps
+    # THE STATE OWNS THE LAYOUT: the effective cap is read from
+    # state.bucket_cap at call time. An explicit (non-"auto") argument
+    # here is only a cross-check against the state; "auto" simply
+    # follows whatever the state was built under.
+    _auto = isinstance(bucket_cap_bytes, str) and bucket_cap_bytes == "auto"
+    _requested_cap = None if _auto else resolve_bucket_cap(bucket_cap_bytes)
 
-    def step_fn(state: ZeroTrainState, images, labels):
-        treedef, shapes, dtypes, sizes, total = _flat_spec(state.params)
-        padded = _shard_len(total, d) * d
-        # Uniform-dtype models gather at the model dtype (halving gather
-        # bytes and the transient flat buffer for bf16); mixed-dtype trees
-        # gather at fp32 and let _unflatten cast per leaf.
-        gather_dtype = (dtypes[0] if all(dt == dtypes[0] for dt in dtypes)
-                        else jnp.float32)
+    def _build_step_fn(cap):
+        def step_fn(state: ZeroTrainState, images, labels):
+            plan = _make_plan(state.params, d, cap)
+            dtypes = plan.dtypes
+            # Uniform-dtype models gather at the model dtype (halving gather
+            # bytes and the transient flat buffer for bf16); mixed-dtype trees
+            # gather at fp32 and let _unflatten_plan cast per leaf.
+            gather_dtype = (dtypes[0] if all(dt == dtypes[0] for dt in dtypes)
+                            else jnp.float32)
 
-        def loss_fn(p):
-            variables = {"params": p}
-            if state.batch_stats is not None:
-                variables["batch_stats"] = state.batch_stats
-                logits, updated = model.apply(
-                    variables, images, train=True, mutable=["batch_stats"])
-                return (cross_entropy_loss(logits, labels),
-                        updated["batch_stats"])
-            logits = model.apply(variables, images, train=True)
-            return cross_entropy_loss(logits, labels), None
+            def loss_fn(p):
+                variables = {"params": p}
+                if state.batch_stats is not None:
+                    variables["batch_stats"] = state.batch_stats
+                    logits, updated = model.apply(
+                        variables, images, train=True, mutable=["batch_stats"])
+                    return (cross_entropy_loss(logits, labels),
+                            updated["batch_stats"])
+                logits = model.apply(variables, images, train=True)
+                return cross_entropy_loss(logits, labels), None
 
-        (loss, new_stats), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params)
+            (loss, new_stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params)
 
-        # Mean-reduce and scatter in one collective: each device leaves
-        # with its shard of the global-mean gradient.
-        flat_g = _flatten_f32(grads, total, padded)
-        gshard = lax.psum_scatter(flat_g, axis_name, tiled=True) / d
+            # Mean-reduce and scatter per fusion bucket: each device leaves
+            # with its shard of the global-mean gradient. One bucket (no cap)
+            # = one collective, the original monolithic layout; with a cap,
+            # bucket k's psum_scatter depends only on bucket k's gradients —
+            # produced *early* in backprop (reverse parameter order) — so XLA
+            # overlaps the shard exchange with the rest of the backward pass.
+            gleaves = jax.tree_util.tree_leaves(grads)
+            segs = [lax.psum_scatter(_bucket_flat_f32(gleaves, plan, j),
+                                     axis_name, tiled=True) / d
+                    for j in range(len(plan.buckets))]
+            gshard = jnp.concatenate(segs) if len(segs) > 1 else segs[0]
 
-        def apply_update(gshard, opt_shard, pshard):
-            updates, new_opt = optimizer.update(gshard, opt_shard, pshard)
-            new_pshard = optax.apply_updates(pshard, updates)
-            new_flat = lax.all_gather(new_pshard.astype(gather_dtype),
-                                      axis_name, tiled=True)
-            return (_unflatten(new_flat, treedef, shapes, dtypes, sizes,
-                               total), new_pshard, new_opt)
+            def apply_update(gshard, opt_shard, pshard):
+                updates, new_opt = optimizer.update(gshard, opt_shard, pshard)
+                new_pshard = optax.apply_updates(pshard, updates)
+                flats = []
+                off = 0
+                for j in range(len(plan.buckets)):
+                    slen = plan.bucket_padded[j] // d
+                    seg = lax.slice_in_dim(new_pshard, off, off + slen)
+                    flats.append(lax.all_gather(seg.astype(gather_dtype),
+                                                axis_name, tiled=True))
+                    off += slen
+                return (_unflatten_plan(flats, plan), new_pshard, new_opt)
 
-        step = state.step + 1
-        if k <= 1:
-            new_params, new_pshard, new_opt = apply_update(
-                gshard, state.opt_shard, state.pshard)
-            new_gaccum = state.gaccum
-        else:
-            acc = state.gaccum + gshard
-            do_update = (step % k) == 0
+            step = state.step + 1
+            if k <= 1:
+                new_params, new_pshard, new_opt = apply_update(
+                    gshard, state.opt_shard, state.pshard)
+                new_gaccum = state.gaccum
+            else:
+                acc = state.gaccum + gshard
+                do_update = (step % k) == 0
 
-            def update_branch(operand):
-                acc, opt_shard, pshard = operand
-                p, ps, op_ = apply_update(acc / k, opt_shard, pshard)
-                return p, ps, op_, jnp.zeros_like(acc)
+                def update_branch(operand):
+                    acc, opt_shard, pshard = operand
+                    p, ps, op_ = apply_update(acc / k, opt_shard, pshard)
+                    return p, ps, op_, jnp.zeros_like(acc)
 
-            def skip_branch(operand):
-                acc, opt_shard, pshard = operand
-                return state.params, pshard, opt_shard, acc
+                def skip_branch(operand):
+                    acc, opt_shard, pshard = operand
+                    return state.params, pshard, opt_shard, acc
 
-            new_params, new_pshard, new_opt, new_gaccum = lax.cond(
-                do_update, update_branch, skip_branch,
-                (acc, state.opt_shard, state.pshard))
+                new_params, new_pshard, new_opt, new_gaccum = lax.cond(
+                    do_update, update_branch, skip_branch,
+                    (acc, state.opt_shard, state.pshard))
 
-        if new_stats is not None:
-            new_stats = jax.tree_util.tree_map(
-                lambda x: lax.pmean(x, axis_name), new_stats)
-        loss = lax.pmean(loss, axis_name)
-        return ZeroTrainState(new_params, new_pshard, new_opt, new_gaccum,
-                              new_stats, step), loss
+            if new_stats is not None:
+                new_stats = jax.tree_util.tree_map(
+                    lambda x: lax.pmean(x, axis_name), new_stats)
+            loss = lax.pmean(loss, axis_name)
+            return ZeroTrainState(new_params, new_pshard, new_opt, new_gaccum,
+                                  new_stats, step, state.bucket_cap), loss
+
+        return step_fn
 
     cache = {}
 
@@ -243,43 +358,83 @@ def make_zero_train_step(model, optimizer: optax.GradientTransformation,
                 "state/step accumulate_steps mismatch: build the state "
                 "with init_zero_train_state(..., accumulate_steps=k) "
                 "matching make_zero_train_step's")
+        # The layout-defining cap rides the state (init stamped it);
+        # an explicit cap passed to make_zero_train_step must agree.
+        # The fetch never blocks the train loop: bucket_cap is the
+        # init-time array carried OUTSIDE the jitted program (stripped
+        # below), so it is always ready — never an output of the
+        # in-flight step.
+        if state.bucket_cap is None:
+            raise ValueError(
+                "ZeroTrainState has no bucket_cap stamp — it was built "
+                "by hand or restored without the field. Rebuild it with "
+                "init_zero_train_state(...), or _replace(bucket_cap="
+                "jnp.int32(-1)) if the layout is known-monolithic.")
+        try:
+            cap_raw = int(np.asarray(state.bucket_cap))
+        except jax.errors.TracerArrayConversionError:
+            raise ValueError(
+                "make_zero_train_step's step function jits internally "
+                "and selects the shard layout from the concrete "
+                "state.bucket_cap — call it eagerly instead of wrapping "
+                "it in jax.jit (the compiled programs are exposed on "
+                "step.cache for lowering/inspection)") from None
+        cap = None if cap_raw < 0 else cap_raw
+        if not _auto and _requested_cap != cap:
+            raise ValueError(
+                f"state/step bucket cap mismatch: the state's shard "
+                f"layout was built under bucket_cap_bytes={cap} but "
+                f"make_zero_train_step was given {_requested_cap}. "
+                f"Rebuild the state with init_zero_train_state(..., "
+                f"bucket_cap_bytes={_requested_cap}) or drop the "
+                f"explicit argument to follow the state.")
         # The optimizer-state specs depend on the shard length, which
         # depends on the parameter count — resolve per parameter-tree
         # structure and cache the compiled step under that key, so a
         # state with a different pytree (e.g. after model surgery) gets
         # its own compilation instead of an opaque shape error from a
         # stale spec.
-        treedef, shapes, dtypes, _, total = _flat_spec(state.params)
+        plan = _make_plan(state.params, d, cap)
         # Surgery on params without rebuilding the state leaves master/
-        # optimizer shards sized for the OLD tree; catch that here with a
-        # descriptive error instead of an opaque shard_map shape failure
-        # (round-2 advisor finding).
-        expected_padded = _shard_len(total, d) * d
+        # optimizer shards sized for the OLD tree — and a state built
+        # under a different bucket cap has a different shard layout; catch
+        # both here with a descriptive error instead of an opaque
+        # shard_map shape failure (round-2 advisor finding).
+        expected_padded = plan.padded
         actual_padded = int(np.prod(state.pshard.shape))
         if actual_padded != expected_padded:
             raise ValueError(
                 f"ZeroTrainState shards were built for a different "
-                f"parameter tree: params flatten to {total} elements "
-                f"(padded {expected_padded}) but pshard holds "
-                f"{actual_padded}. After changing the model's parameter "
-                f"structure, rebuild the state with "
-                f"init_zero_train_state(...) instead of reusing the old "
-                f"one.")
-        key = (treedef, tuple(shapes), tuple(str(dt) for dt in dtypes),
-               state.gaccum is None)
+                f"parameter tree or bucket cap: params flatten to "
+                f"{plan.total} elements (padded {expected_padded} under "
+                f"bucket_cap_bytes={cap}) but pshard holds "
+                f"{actual_padded}. After changing either, rebuild the "
+                f"state with init_zero_train_state(...) using the same "
+                f"model and bucket_cap_bytes as this step instead of "
+                f"reusing the old one.")
+        key = (plan.treedef, plan.shapes,
+               tuple(str(dt) for dt in plan.dtypes),
+               state.gaccum is None, cap)
         if key not in cache:
-            opt_specs = _opt_state_specs(optimizer, _shard_len(total, d),
+            opt_specs = _opt_state_specs(optimizer, plan.shard_len,
                                          axis_name)
             gaccum_spec = P() if state.gaccum is None else P(axis_name)
+            # bucket_cap is None here: the cap array travels outside the
+            # compiled program (re-attached below), so the device never
+            # copies it and the host fetch above stays non-blocking.
             state_specs = ZeroTrainState(P(), P(axis_name), opt_specs,
-                                         gaccum_spec, P(), P())
-            sharded = jax.shard_map(
-                step_fn, mesh=mesh,
+                                         gaccum_spec, P(), P(), None)
+            sharded = _shard_map(
+                _build_step_fn(cap), mesh,
                 in_specs=(state_specs, P(axis_name), P(axis_name)),
                 out_specs=(state_specs, P()),
                 check_vma=False)
             cache[key] = jax.jit(
                 sharded, donate_argnums=(0,) if donate else ())
-        return cache[key](state, images, labels)
+        cap_arr = state.bucket_cap
+        new_state, loss = cache[key](
+            state._replace(bucket_cap=None), images, labels)
+        return new_state._replace(bucket_cap=cap_arr), loss
 
+    step.cache = cache  # compiled programs per tree-key (introspection)
     return step

@@ -21,7 +21,34 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", _platform)
 if _platform == "cpu":
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        # pre-0.5 jax: compat's XLA_FLAGS fallback covers it. Initialize
+        # the backend NOW (it reads XLA_FLAGS exactly once) so the env
+        # var can be restored — worker SUBPROCESSES spawned by the
+        # multi-process tests must NOT inherit it (they are real
+        # one-device-per-process worlds; 8 forced host devices each
+        # would change their topology).
+        from horovod_tpu.common.compat import ensure_cpu_devices
+
+        _prior_flags = os.environ.get("XLA_FLAGS")
+        ensure_cpu_devices(8)
+        _ndev = len(jax.devices("cpu"))  # forces backend init
+        if _prior_flags is None:
+            os.environ.pop("XLA_FLAGS", None)
+        else:
+            os.environ["XLA_FLAGS"] = _prior_flags
+        if _ndev != 8:
+            raise RuntimeError(
+                f"XLA_FLAGS fallback failed to create the 8-device test "
+                f"mesh (got {_ndev})")
+
+# Tests are written against the modern `jax.shard_map` spelling; plant the
+# compat wrapper on jax installs that predate it (no-op otherwise).
+from horovod_tpu.common import compat as _compat  # noqa: E402
+
+_compat.install()
 
 import pytest  # noqa: E402
 
@@ -36,6 +63,23 @@ def subprocess_cpu_env(**overrides):
     env = dict(os.environ, JAX_PLATFORMS="cpu", **overrides)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     return env
+
+
+def cpu_multiprocess_xla_supported() -> bool:
+    """jax's CPU backend gained cross-process compiled computations in
+    0.5; before that every multi-process program fails with
+    'Multiprocess computations aren't implemented on the CPU backend'.
+    Gates the real 2-process XLA-plane worlds (test_multihost, the
+    host->XLA staging routing proof) on older installs — the SPMD
+    programs themselves are covered on the single-process 8-device mesh
+    either way."""
+    import jax
+
+    parts = jax.__version__.split(".")[:2]
+    try:
+        return tuple(int(p) for p in parts) >= (0, 5)
+    except ValueError:
+        return True  # unparseable dev version: assume modern
 
 
 def pytest_configure(config):

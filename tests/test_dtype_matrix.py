@@ -1,6 +1,8 @@
 """Cross-rank dtype × op matrix over the real 2-process host plane
 (reference: ``test/test_torch.py``'s per-dtype allreduce/allgather/
-broadcast sweeps under mpirun, SURVEY §4 Pattern 1).
+broadcast sweeps under mpirun, SURVEY §4 Pattern 1), plus the XLA-plane
+dtype matrix through the tensor-fusion v2 bucketed path (the bf16/fp16
+fp32-accumulation contract of ``ops/xla.py`` must survive bucketing).
 
 One pair of worker processes exercises every supported dtype through the
 torch binding so dtype plumbing (Python code ↔ wire ↔ C++ ring
@@ -9,9 +11,8 @@ accumulate) is proven end-to-end, not per-dtype-at-size-1.
 
 import textwrap
 
+import numpy as np
 import pytest
-
-pytest.importorskip("torch")
 
 _WORKER = textwrap.dedent("""
     import os, sys
@@ -97,6 +98,132 @@ _WORKER = textwrap.dedent("""
 
 @pytest.mark.full
 def test_dtype_op_matrix_two_process(tmp_path):
+    pytest.importorskip("torch")
     from proc_harness import run_world
 
     run_world(tmp_path, _WORKER, "DTMATRIX")
+
+
+# ---- XLA-plane dtype matrix through the bucketed (tensor-fusion v2) path ---
+#
+# grouped_allreduce with bucket_cap_bytes set must keep every per-dtype
+# contract of the monolithic path: ints reduce exactly, bf16/fp16
+# accumulate in fp32 and cast back (ops/xla.py allreduce), and results
+# are BITWISE equal to the monolithic plan (bucketing only partitions an
+# elementwise reduction).
+
+TINY_CAP = 64  # bytes — forces multiple buckets for every matrix entry
+
+
+def _grouped_prog(mesh, n_tensors, op, cap):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.ops import xla as hvd_xla
+
+    def fn(*tensors):
+        out = hvd_xla.grouped_allreduce(
+            [t[0] for t in tensors], axis_name="hvd", op=op,
+            bucket_cap_bytes=cap)
+        return tuple(o[None] for o in out)
+
+    return jax.jit(jax.shard_map(
+        fn, mesh=mesh, in_specs=(P("hvd"),) * n_tensors,
+        out_specs=(P("hvd"),) * n_tensors, check_vma=False))
+
+
+@pytest.mark.parametrize("np_dtype", [
+    np.float32, np.float16, "bfloat16", np.int32, np.int16, np.uint8,
+])
+def test_bucketed_allreduce_dtype_matrix(hvd, np_dtype):
+    import jax.numpy as jnp
+
+    from horovod_tpu.ops.xla import ReduceOp
+
+    mesh = hvd.mesh()
+    n = hvd.size()
+    dtype = jnp.bfloat16 if np_dtype == "bfloat16" else np_dtype
+    rng = np.random.RandomState(3)
+    # Values exact in every tested dtype (small ints): psum is exact, so
+    # bucketed == monolithic == numpy-fp64 oracle EXACTLY.
+    vals = rng.randint(0, 4, size=(n, 5, 7)).astype(np.float64)
+    stacked = jnp.asarray(vals).astype(dtype)
+    tensors = [stacked * (i + 1) for i in range(4)]  # 4 leaves per rank
+
+    prog_b = _grouped_prog(mesh, 4, ReduceOp.SUM, TINY_CAP)
+    prog_m = _grouped_prog(mesh, 4, ReduceOp.SUM, None)
+    out_b = prog_b(*tensors)
+    out_m = prog_m(*tensors)
+    for i, (ob, om) in enumerate(zip(out_b, out_m)):
+        assert ob.dtype == dtype
+        expect = (vals * (i + 1)).sum(axis=0)
+        # Every device row carries the replicated result.
+        for row in np.asarray(ob, dtype=np.float64):
+            np.testing.assert_array_equal(row, expect)
+        np.testing.assert_array_equal(np.asarray(om), np.asarray(ob))
+
+
+@pytest.mark.parametrize("np_dtype", [np.float16, "bfloat16"])
+def test_bucketed_low_precision_accumulates_in_fp32(hvd, np_dtype):
+    """The fp32-accumulation contract survives bucketing: pick values
+    whose naive low-precision accumulation rounds away the small
+    contributions; the result must match fp32-accumulate-then-cast."""
+    import jax.numpy as jnp
+
+    from horovod_tpu.ops.xla import ReduceOp
+
+    mesh = hvd.mesh()
+    n = hvd.size()
+    dtype = jnp.bfloat16 if np_dtype == "bfloat16" else np_dtype
+    big = 2048.0 if np_dtype == np.float16 else 256.0
+    small = 0.25 if np_dtype == np.float16 else 0.5
+    # rank 0 contributes `big`, everyone else `small`: sequential
+    # low-precision accumulation would return `big` unchanged.
+    vals = np.full((n, 16), small, dtype=np.float64)
+    vals[0, :] = big
+    stacked = jnp.asarray(vals).astype(dtype)
+
+    prog_b = _grouped_prog(mesh, 2, ReduceOp.SUM, TINY_CAP)
+    out_b = prog_b(stacked, stacked * 2)
+    oracle = np.asarray(
+        jnp.asarray(vals.sum(axis=0), jnp.float32).astype(dtype))
+    naive = np.asarray(jnp.asarray(big, dtype))
+    assert not np.array_equal(oracle, np.full(16, naive)), \
+        "test values don't discriminate fp32 vs low-precision accumulation"
+    np.testing.assert_array_equal(np.asarray(out_b[0])[0], oracle)
+
+
+def test_bucketed_mixed_dtype_pytree(hvd):
+    """A mixed-dtype gradient pytree forces dtype-pure buckets; results
+    keep each leaf's dtype and match the monolithic path bitwise."""
+    import jax.numpy as jnp
+
+    from horovod_tpu.common.fusion import plan_buckets_for
+    from horovod_tpu.ops.xla import ReduceOp
+
+    mesh = hvd.mesh()
+    n = hvd.size()
+    rng = np.random.RandomState(7)
+    dtypes = [jnp.float32, jnp.bfloat16, jnp.float32, jnp.int32,
+              jnp.bfloat16, jnp.float16]
+    leaves = [jnp.asarray(rng.randint(0, 4, size=(n, 11)))
+              .astype(dt) for dt in dtypes]
+
+    # Planner-level: even a huge cap must close buckets on every dtype
+    # boundary (dtype purity beats packing).
+    buckets = plan_buckets_for([l[0] for l in leaves], 1 << 30)
+    for b in buckets:
+        leaf_dts = {str(leaves[i].dtype) for i in b.indices}
+        assert len(leaf_dts) == 1, (b.indices, leaf_dts)
+    assert len(buckets) >= 4  # f16 | bf16 | i32 | f32 | bf16 | f32 runs
+
+    prog_b = _grouped_prog(mesh, len(leaves), ReduceOp.SUM, TINY_CAP)
+    prog_m = _grouped_prog(mesh, len(leaves), ReduceOp.SUM, None)
+    out_b = prog_b(*leaves)
+    out_m = prog_m(*leaves)
+    for lf, ob, om in zip(leaves, out_b, out_m):
+        assert ob.dtype == lf.dtype
+        expect = np.asarray(lf.astype(jnp.float64)).sum(axis=0)
+        for row in np.asarray(ob.astype(jnp.float64)):
+            np.testing.assert_array_equal(row, expect)
+        np.testing.assert_array_equal(np.asarray(om), np.asarray(ob))

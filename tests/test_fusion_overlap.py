@@ -1,0 +1,288 @@
+"""Structural proof of tensor-fusion v2's comm/compute overlap (CPU).
+
+The monolithic v1 gradient fusion emits ONE AllReduce per dtype whose
+operand depends on every gradient — XLA cannot start communicating until
+backprop fully finishes. With ``bucket_cap_bytes`` set, the train step
+must instead contain multiple *independent* all-reduce ops (bucket k's
+operand cone excludes bucket j's), which is exactly the structure XLA's
+latency-hiding scheduler needs to overlap communication with the rest of
+the backward pass. Proven two ways:
+
+- compiled HLO (``jax.jit(...).lower(...).compile().as_text()``): the
+  all-reduce op count goes from 2 (fused grads + loss pmean) to
+  buckets + 1, surviving XLA's optimization pipeline;
+- jaxpr dataflow: pairwise cone analysis shows the gradient psums are
+  mutually independent (neither is in the other's transitive operand
+  cone), i.e. their operands do not all depend on the final gradient.
+
+Plus the regression guarantee: with the cap unset the program keeps the
+v1 monolithic shape, and bucketed numerics match monolithic BITWISE
+(bucketing partitions an elementwise reduction — rtol 0, not approx).
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.core import Var
+
+import flax.linen as nn
+
+from horovod_tpu.training import (
+    init_train_state, make_train_step, replicate_state, shard_batch)
+from horovod_tpu.zero import init_zero_train_state, make_zero_train_step
+
+BUCKET_CAP = 8192  # bytes; small enough to split the MLP below
+
+
+class MLP8(nn.Module):
+    """8 Dense layers -> 16 param leaves, all fp32 (one dtype group)."""
+
+    feats: tuple = (32, 32, 32, 32, 32, 32, 32, 10)
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = x.reshape((x.shape[0], -1))
+        for f in self.feats:
+            x = nn.Dense(f)(x)
+            if f != self.feats[-1]:
+                x = jax.nn.relu(x)
+        return x
+
+
+def _problem(hvd, bucket_cap, donate=True):
+    mesh = hvd.mesh()
+    model = MLP8()
+    opt = optax.sgd(0.1, momentum=0.9)
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.zeros((1, 16), jnp.float32)
+    state = replicate_state(init_train_state(model, opt, rng, sample), mesh)
+    imgs = jnp.asarray(
+        np.random.RandomState(0).rand(16, 16).astype(np.float32))
+    lbls = jnp.asarray(
+        np.random.RandomState(1).randint(0, 10, 16).astype(np.int32))
+    imgs, lbls = shard_batch((imgs, lbls), mesh)
+    step = make_train_step(model, opt, mesh, bucket_cap_bytes=bucket_cap,
+                           donate=donate)
+    return step, state, imgs, lbls
+
+
+# ---- jaxpr dataflow analysis helpers ---------------------------------------
+
+
+def _find_psums(jaxpr, acc):
+    """Collect (body, eqn_index) for every psum eqn, recursing through
+    pjit/shard_map/cond bodies."""
+    for i, eqn in enumerate(jaxpr.eqns):
+        if eqn.primitive.name == "psum":
+            acc.append((jaxpr, i))
+        for v in eqn.params.values():
+            for w in (v if isinstance(v, (list, tuple)) else (v,)):
+                sub = getattr(w, "jaxpr", w)
+                if hasattr(sub, "eqns"):
+                    _find_psums(sub, acc)
+    return acc
+
+
+def _cone(body, idx):
+    """Transitive operand cone of eqn ``idx``: the set of eqn indices in
+    ``body`` whose outputs it (transitively) consumes."""
+    producers = {}
+    for j, e in enumerate(body.eqns):
+        for ov in e.outvars:
+            producers[ov] = j
+    seen = set()
+    stack = [idx]
+    while stack:
+        j = stack.pop()
+        if j in seen:
+            continue
+        seen.add(j)
+        for iv in body.eqns[j].invars:
+            if isinstance(iv, Var) and iv in producers:
+                stack.append(producers[iv])
+    return seen
+
+
+def _grad_psums(step, state, imgs, lbls):
+    """(body, [eqn indices]) of the non-scalar (gradient) psums."""
+    jaxpr = jax.make_jaxpr(step)(state, imgs, lbls)
+    acc = _find_psums(jaxpr.jaxpr, [])
+    assert acc, "no psum eqns found in the train step"
+    body = acc[0][0]
+    assert all(b is body for b, _ in acc), \
+        "psums unexpectedly split across jaxpr bodies"
+    grad_idxs = [i for b, i in acc
+                 if b.eqns[i].invars[0].aval.shape != ()]
+    return body, grad_idxs
+
+
+# ---- the structural overlap proof ------------------------------------------
+
+
+def test_bucketed_step_has_independent_allreduces(hvd):
+    step, state, imgs, lbls = _problem(hvd, BUCKET_CAP)
+
+    # Compiled HLO: >= 2 gradient all-reduces survive XLA's optimization
+    # pipeline (the count here includes the scalar loss pmean, hence -1).
+    hlo = step.lower(state, imgs, lbls).compile().as_text()
+    n_allreduce = hlo.count(" all-reduce(") + hlo.count(" all-reduce-start(")
+    assert n_allreduce - 1 >= 2, \
+        f"expected >=2 gradient all-reduce ops in compiled HLO, " \
+        f"found {n_allreduce} total"
+
+    # Dataflow: >= 2 gradient psums, and at least one pair is mutually
+    # independent — neither lives in the other's operand cone, so their
+    # operands cannot all depend on the final gradient and XLA is free
+    # to launch one while the other's inputs are still being computed.
+    body, grad_idxs = _grad_psums(step, state, imgs, lbls)
+    assert len(grad_idxs) >= 2, grad_idxs
+    cones = {i: _cone(body, i) for i in grad_idxs}
+    independent = [
+        (a, b) for a, b in itertools.combinations(grad_idxs, 2)
+        if a not in cones[b] and b not in cones[a]
+    ]
+    assert independent, \
+        "every pair of gradient psums is dependency-ordered; no overlap " \
+        "structure"
+    # Stronger: the FIRST bucket's psum must not depend on the final
+    # gradient — i.e. some other gradient psum's cone is disjoint enough
+    # that it is independent of EVERY other bucket.
+    fully_indep = [
+        i for i in grad_idxs
+        if all(i not in cones[j] and j not in cones[i]
+               for j in grad_idxs if j != i)
+    ]
+    assert fully_indep, "no gradient psum is independent of all others"
+
+
+def test_unset_cap_keeps_monolithic_program(hvd):
+    """cap unset -> exactly one fused gradient all-reduce (v1 shape)."""
+    step, state, imgs, lbls = _problem(hvd, None)
+    body, grad_idxs = _grad_psums(step, state, imgs, lbls)
+    assert len(grad_idxs) == 1, \
+        f"monolithic path must emit exactly 1 gradient psum, " \
+        f"got {len(grad_idxs)}"
+    hlo = step.lower(state, imgs, lbls).compile().as_text()
+    n_allreduce = hlo.count(" all-reduce(") + hlo.count(" all-reduce-start(")
+    assert n_allreduce == 2, hlo.count("all-reduce")  # fused grads + loss
+
+
+def test_bucketed_matches_monolithic_bitwise(hvd):
+    """Bucketing partitions an elementwise reduction — results must be
+    IDENTICAL to the monolithic path, not merely close (rtol 0)."""
+    step_m, state_m, imgs, lbls = _problem(hvd, None, donate=False)
+    step_b, state_b, _, _ = _problem(hvd, BUCKET_CAP, donate=False)
+    for _ in range(3):
+        state_m, loss_m = step_m(state_m, imgs, lbls)
+        state_b, loss_b = step_b(state_b, imgs, lbls)
+    assert float(loss_m) == float(loss_b)
+    for pm, pb in zip(jax.tree_util.tree_leaves(state_m.params),
+                      jax.tree_util.tree_leaves(state_b.params)):
+        np.testing.assert_array_equal(np.asarray(pm), np.asarray(pb))
+
+
+def test_tiny_cap_one_bucket_per_leaf(hvd):
+    """Degenerate cap: every leaf its own bucket — 16 gradient psums."""
+    step, state, imgs, lbls = _problem(hvd, 1)
+    _, grad_idxs = _grad_psums(step, state, imgs, lbls)
+    assert len(grad_idxs) == 16
+
+
+# ---- the ZeRO reduce-scatter path ------------------------------------------
+
+
+def _zero_problem(hvd, bucket_cap):
+    mesh = hvd.mesh()
+    model = MLP8()
+    opt = optax.sgd(0.1, momentum=0.9)
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.zeros((1, 16), jnp.float32)
+    zstate = init_zero_train_state(model, opt, rng, sample, mesh,
+                                   bucket_cap_bytes=bucket_cap)
+    imgs = jnp.asarray(
+        np.random.RandomState(0).rand(16, 16).astype(np.float32))
+    lbls = jnp.asarray(
+        np.random.RandomState(1).randint(0, 10, 16).astype(np.int32))
+    imgs, lbls = shard_batch((imgs, lbls), mesh)
+    zstep = make_zero_train_step(model, opt, mesh, donate=False,
+                                 bucket_cap_bytes=bucket_cap)
+    return zstep, zstate, imgs, lbls
+
+
+def test_zero_bucketed_scatter_structure_and_numerics(hvd):
+    zstep_m, zstate_m, imgs, lbls = _zero_problem(hvd, None)
+    zstep_b, zstate_b, _, _ = _zero_problem(hvd, BUCKET_CAP)
+
+    # Numerics: the bucketed layout reorders the *private* shard, never
+    # the math — params after k steps are bitwise equal.
+    for _ in range(2):
+        zstate_m, loss_m = zstep_m(zstate_m, imgs, lbls)
+        zstate_b, loss_b = zstep_b(zstate_b, imgs, lbls)
+    assert float(loss_m) == float(loss_b)
+    for pm, pb in zip(jax.tree_util.tree_leaves(zstate_m.params),
+                      jax.tree_util.tree_leaves(zstate_b.params)):
+        np.testing.assert_array_equal(np.asarray(pm), np.asarray(pb))
+
+    # Structure: the grad exchange went from ONE whole-model
+    # reduce-scatter to one per bucket (overlap-schedulable), visible in
+    # the lowered programs.
+    # make_zero_train_step returns a plain function that jits internally
+    # and selects the layout from the concrete state — lower through its
+    # exposed program cache (populated by the eager calls above).
+    def reduce_scatter_count(zstep, zstate):
+        prog = next(iter(zstep.cache.values()))
+        # The cached program takes the state with bucket_cap stripped
+        # (the cap array travels outside the compiled step).
+        lowered = prog.lower(zstate._replace(bucket_cap=None), imgs, lbls)
+        return lowered.as_text().count("reduce_scatter")
+
+    n_mono = reduce_scatter_count(zstep_m, zstate_m)
+    n_buck = reduce_scatter_count(zstep_b, zstate_b)
+    assert n_mono >= 1
+    assert n_buck > n_mono, (n_mono, n_buck)
+
+
+def test_zero_mismatched_cap_rejected(hvd):
+    """A state built monolithic cannot silently run under a step that
+    demands a bucketed layout. MLP8's leaf sizes all divide the mesh, so
+    total padded size is IDENTICAL across layouts — only the cap stamped
+    in the state (state-owns-the-layout) can catch the mismatch."""
+    zstep_b, _, imgs, lbls = _zero_problem(hvd, BUCKET_CAP)
+    _, zstate_m, _, _ = _zero_problem(hvd, None)
+    with pytest.raises(ValueError, match="bucket cap mismatch"):
+        zstep_b(zstate_m, imgs, lbls)
+
+
+def test_zero_auto_step_follows_state_layout(hvd):
+    """A step built with the default "auto" must follow whatever layout
+    the state carries — even when the ambient threshold changed between
+    init and step (the autotuner-publishes-mid-training scenario)."""
+    import os
+
+    zstep_auto, zstate_b, imgs, lbls = _zero_problem(hvd, BUCKET_CAP)
+    # Build the auto step under a DIFFERENT ambient env value.
+    prev = os.environ.get("HOROVOD_FUSION_THRESHOLD")
+    os.environ["HOROVOD_FUSION_THRESHOLD"] = "999999"
+    try:
+        mesh = hvd.mesh()
+        model = MLP8()
+        opt = optax.sgd(0.1, momentum=0.9)
+        zstep = make_zero_train_step(model, opt, mesh, donate=False)
+    finally:
+        if prev is None:
+            os.environ.pop("HOROVOD_FUSION_THRESHOLD", None)
+        else:
+            os.environ["HOROVOD_FUSION_THRESHOLD"] = prev
+    # Runs against the BUCKET_CAP-layout state without error, matching
+    # the explicitly-bucketed step bitwise.
+    s1, l1 = zstep(zstate_b, imgs, lbls)
+    s2, l2 = zstep_auto(zstate_b, imgs, lbls)
+    assert float(l1) == float(l2)
+    for a, b in zip(jax.tree_util.tree_leaves(s1.params),
+                    jax.tree_util.tree_leaves(s2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -1,0 +1,153 @@
+"""Unit tests for the bucketed fusion planner (``common/fusion.py``) —
+the pure core of tensor-fusion v2. No devices needed: the planner runs on
+(byte-size, dtype) metadata only."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common.fusion import (
+    Bucket, describe_plan, leaf_nbytes, leaf_wire_nbytes, plan_buckets,
+    plan_buckets_for, resolve_bucket_cap)
+
+F32 = np.dtype(np.float32)
+BF16 = np.dtype(np.float16)  # any 2-byte float works for size math
+I32 = np.dtype(np.int32)
+
+
+def _indices(buckets):
+    return [list(b.indices) for b in buckets]
+
+
+class TestMonolithicPlan:
+    """cap unset -> v1 grouping exactly: per dtype, first-seen order,
+    ascending indices."""
+
+    def test_single_dtype_single_bucket(self):
+        buckets = plan_buckets([40, 8, 400], [F32] * 3, None)
+        assert _indices(buckets) == [[0, 1, 2]]
+        assert buckets[0].nbytes == 448
+
+    def test_per_dtype_first_seen_order(self):
+        buckets = plan_buckets(
+            [4, 2, 4, 2, 4], [F32, BF16, F32, BF16, F32], None)
+        assert _indices(buckets) == [[0, 2, 4], [1, 3]]
+        assert [b.dtype for b in buckets] == [F32, BF16]
+
+    def test_zero_cap_means_monolithic(self):
+        assert _indices(plan_buckets([4, 4], [F32, F32], 0)) == [[0, 1]]
+
+    def test_empty(self):
+        assert plan_buckets([], [], None) == []
+        assert plan_buckets([], [], 1024) == []
+
+
+class TestCappedPlan:
+    def test_reverse_order(self):
+        # 3 leaves of 4 bytes, cap 4 -> three singleton buckets in
+        # reverse parameter order (backward production order).
+        buckets = plan_buckets([4, 4, 4], [F32] * 3, 4)
+        assert _indices(buckets) == [[2], [1], [0]]
+
+    def test_cap_respected(self):
+        buckets = plan_buckets([4, 4, 4, 4], [F32] * 4, 8)
+        assert _indices(buckets) == [[3, 2], [1, 0]]
+        assert all(b.nbytes <= 8 for b in buckets)
+
+    def test_oversize_leaf_gets_own_bucket(self):
+        buckets = plan_buckets([4, 100, 4], [F32] * 3, 8)
+        assert _indices(buckets) == [[2], [1], [0]]
+        assert buckets[1].nbytes == 100
+
+    def test_dtype_boundary_closes_bucket(self):
+        # Plenty of cap room, but dtype changes force pure buckets.
+        buckets = plan_buckets([4, 2, 4], [F32, BF16, F32], 1 << 20)
+        assert _indices(buckets) == [[2], [1], [0]]
+        assert [b.dtype for b in buckets] == [F32, BF16, F32]
+
+    def test_dtype_pure_buckets(self):
+        buckets = plan_buckets(
+            [4, 4, 2, 2, 4], [F32, F32, BF16, BF16, F32], 1 << 20)
+        assert _indices(buckets) == [[4], [3, 2], [1, 0]]
+        for b in buckets:
+            assert len({str(b.dtype)}) == 1
+
+    def test_partition_is_exact(self):
+        # Every index exactly once, regardless of cap.
+        rng = np.random.RandomState(0)
+        sizes = [int(s) for s in rng.randint(1, 1000, size=50)]
+        dtypes = [F32 if rng.rand() < 0.7 else I32 for _ in sizes]
+        for cap in (1, 64, 1024, 10**9):
+            buckets = plan_buckets(sizes, dtypes, cap)
+            seen = sorted(i for b in buckets for i in b.indices)
+            assert seen == list(range(50)), cap
+            assert sum(b.nbytes for b in buckets) == sum(sizes)
+
+    def test_mismatched_lengths_raise(self):
+        with pytest.raises(ValueError, match="length mismatch"):
+            plan_buckets([4, 4], [F32], 8)
+
+
+class TestLeafHelpers:
+    def test_leaf_nbytes(self):
+        assert leaf_nbytes(np.zeros((3, 4), np.float32)) == 48
+        assert leaf_nbytes(np.zeros((), np.float32)) == 4
+
+    def test_plan_buckets_for(self):
+        leaves = [np.zeros(2, np.float32), np.zeros(2, np.int32)]
+        buckets = plan_buckets_for(leaves, None)
+        assert _indices(buckets) == [[0], [1]]
+
+    def test_wire_bytes_fp32_for_low_precision(self):
+        # bf16/fp16 travel the wire at fp32 (accumulation dtype): the
+        # cap must budget 4 bytes/elem so one HOROVOD_FUSION_THRESHOLD
+        # means the same bucket sizes on the allreduce and ZeRO planes.
+        assert leaf_wire_nbytes(np.zeros(8, np.float16)) == 32
+        assert leaf_wire_nbytes(np.zeros(8, np.float32)) == 32
+        assert leaf_wire_nbytes(np.zeros(8, np.int16)) == 16
+        # 4 fp16 leaves of 8 elems: 16 storage but 32 wire bytes each ->
+        # cap 64 packs exactly two per bucket.
+        leaves = [np.zeros(8, np.float16)] * 4
+        assert _indices(plan_buckets_for(leaves, 64)) == [[3, 2], [1, 0]]
+
+    def test_describe_plan(self):
+        d = describe_plan([Bucket((1, 0), F32, 8), Bucket((2,), I32, 4)])
+        assert d == {"num_buckets": 2, "bucket_bytes": [8, 4],
+                     "bucket_dtypes": ["float32", "int32"],
+                     "bucket_sizes": [2, 1]}
+
+
+class TestResolveCap:
+    def test_none_and_zero(self):
+        assert resolve_bucket_cap(None) is None
+        assert resolve_bucket_cap(0) is None
+
+    def test_int_passthrough(self):
+        assert resolve_bucket_cap(12345) == 12345
+
+    def test_bad_string(self):
+        with pytest.raises(ValueError, match="auto"):
+            resolve_bucket_cap("4mb")
+
+    def test_auto_unset_env(self, monkeypatch):
+        monkeypatch.delenv("HOROVOD_FUSION_THRESHOLD", raising=False)
+        assert resolve_bucket_cap("auto") is None
+
+    def test_auto_env(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", str(1 << 20))
+        assert resolve_bucket_cap("auto") == 1 << 20
+
+    def test_auto_env_zero_is_monolithic(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "0")
+        assert resolve_bucket_cap("auto") is None
+
+    def test_auto_prefers_live_tuned_config(self, monkeypatch, hvd):
+        # The autotuner publishes into the live config
+        # (fusion_threshold_explicit=True); "auto" must read that over
+        # the env var.
+        from horovod_tpu.common.state import global_state
+
+        st = global_state()
+        monkeypatch.setattr(st.config, "fusion_threshold_bytes", 4096)
+        monkeypatch.setattr(st.config, "fusion_threshold_explicit", True)
+        monkeypatch.delenv("HOROVOD_FUSION_THRESHOLD", raising=False)
+        assert resolve_bucket_cap("auto") == 4096

@@ -15,7 +15,13 @@ import textwrap
 
 import pytest
 
+from conftest import cpu_multiprocess_xla_supported
 from proc_harness import run_world
+
+pytestmark = pytest.mark.skipif(
+    not cpu_multiprocess_xla_supported(),
+    reason="jax CPU backend lacks cross-process computations (< 0.5); "
+           "the XLA-plane worlds cannot run")
 
 # The TPU plugin's sitecustomize activation runs at interpreter startup —
 # before the worker script's env overrides — and a wedged device tunnel

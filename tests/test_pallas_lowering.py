@@ -11,6 +11,7 @@ import pytest
 
 jax = pytest.importorskip("jax")
 
+import jax.export  # noqa: E402,F401  (not auto-imported on older jax)
 import jax.numpy as jnp  # noqa: E402
 
 import horovod_tpu.ops.pallas_attention as pa  # noqa: E402

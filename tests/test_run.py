@@ -258,7 +258,10 @@ def test_cli_end_to_end(tmp_path):
 def test_packaging_console_entries_resolve():
     """pyproject's console scripts must keep pointing at real callables
     (reference parity: bin/horovodrun -> run_commandline)."""
-    import tomllib
+    try:
+        import tomllib  # Python 3.11+
+    except ModuleNotFoundError:
+        import tomli as tomllib  # 3.10 backport
 
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     with open(os.path.join(repo_root, "pyproject.toml"), "rb") as f:

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Tier-1 verify — the ROADMAP.md "Tier-1 verify" command, verbatim, so
+# builders and CI invoke one script instead of hand-copying the shell
+# line. Run from anywhere; it cd's to the repo root first.
+#
+# Exit code: pytest's (via pipefail through tee), 124 on timeout.
+# Prints DOTS_PASSED=<n> (count of passing-test dots in the quiet
+# progress output) as the machine-readable pass tally.
+#
+# One deviation from the ROADMAP line: the log goes to a per-run mktemp
+# path (override with T1LOG=...) instead of the fixed /tmp/_t1.log —
+# two concurrent runs on one machine would interleave into a shared
+# file and tally each other's dots.
+
+cd "$(dirname "$0")/.." || exit 1
+T1LOG="${T1LOG:-$(mktemp /tmp/_t1.XXXXXX.log)}"
+
+set -o pipefail; rm -f "$T1LOG"; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee "$T1LOG"; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$T1LOG" | tr -cd . | wc -c); exit $rc

@@ -253,6 +253,15 @@ def _build_parser():
                              "HOROVOD_FUSION_THRESHOLD, monolithic when "
                              "that is unset too. The effective config is "
                              "recorded in the emitted JSON either way")
+    parser.add_argument("--compression", default=None,
+                        choices=["none", "fp16", "bf16", "ef16"],
+                        help="on-wire gradient compression for the "
+                             "gradient AllReduce (common/compression.py; "
+                             "docs/compression.md). Unset: follow "
+                             "HOROVOD_COMPRESSION, uncompressed when "
+                             "that is unset too. The effective mode and "
+                             "wire bytes/step are recorded in the "
+                             "emitted JSON either way")
     parser.add_argument("--no-fallback", action="store_true",
                         help="exit nonzero instead of running the CPU "
                              "fallback when the accelerator is "
@@ -305,6 +314,8 @@ def supervise(argv):
             worker_args.append("--space-to-depth")
         if args.bucket_mb is not None:
             worker_args += ["--bucket-mb", str(args.bucket_mb)]
+        if args.compression is not None:
+            worker_args += ["--compression", args.compression]
         result = _run_worker(worker_args, dict(os.environ), WORKER_TIMEOUT_S)
         if result is not None:
             result["platform"] = platform
@@ -380,6 +391,8 @@ def supervise(argv):
         fallback_args.append("--space-to-depth")
     if args.bucket_mb is not None:
         fallback_args += ["--bucket-mb", str(args.bucket_mb)]
+    if args.compression is not None:
+        fallback_args += ["--compression", args.compression]
     result = _run_worker(fallback_args, env, CPU_FALLBACK_TIMEOUT_S)
     if result is not None:
         result["platform"] = "cpu-fallback"
@@ -453,9 +466,23 @@ def worker(argv):
     model = ctor(**kwargs)
     optimizer = optax.sgd(0.01, momentum=0.9)
 
+    # On-wire compression: --compression wins, else HOROVOD_COMPRESSION
+    # ("auto"), else uncompressed. Resolved ONCE, before the state is
+    # built, so error-feedback residual structure matches the step.
+    from horovod_tpu.common.compression import resolve_compression
+
+    if args.compression is not None:
+        comp = resolve_compression(args.compression)
+        comp_source = "flag"
+    else:
+        comp = resolve_compression("auto")
+        comp_source = ("env" if os.environ.get("HOROVOD_COMPRESSION")
+                       is not None else "unset")
+
     rng = jax.random.PRNGKey(0)
     sample = jnp.zeros((1, args.image_size, args.image_size, 3), jnp.float32)
-    state = replicate_state(init_train_state(model, optimizer, rng, sample),
+    state = replicate_state(init_train_state(model, optimizer, rng, sample,
+                                             compression=comp),
                             mesh)
 
     global_batch = args.batch_size * n
@@ -485,16 +512,31 @@ def worker(argv):
             cap_source = "env"
         else:
             cap_source = "autotune"
+    from horovod_tpu.common.fusion import leaf_wire_nbytes
+
+    param_leaves = jax.tree_util.tree_leaves(state.params)
     fusion_cfg = {
         "bucket_cap_bytes": bucket_cap,
         "source": cap_source,
-        **describe_plan(plan_buckets_for(
-            jax.tree_util.tree_leaves(state.params), bucket_cap)),
+        **describe_plan(plan_buckets_for(param_leaves, bucket_cap,
+                                         comp)),
+    }
+    compression_cfg = {
+        "mode": comp.name if comp is not None else "none",
+        "source": comp_source,
+        # Gradient bytes one chip moves into the allreduce per step at
+        # the effective wire dtype (fp32 for uncompressed bf16/fp16
+        # models — the accumulation wire; leaf_wire_nbytes delegates
+        # through the error-feedback wrapper to its inner wire).
+        "wire_bytes_per_step": sum(
+            leaf_wire_nbytes(l, comp) for l in param_leaves),
     }
     mark(f"fusion config: {fusion_cfg}")
+    mark(f"compression config: {compression_cfg}")
 
     step = make_train_step(model, optimizer, mesh,
-                           bucket_cap_bytes=bucket_cap)
+                           bucket_cap_bytes=bucket_cap,
+                           compression=comp)
 
     # A scalar fetch (not block_until_ready) is the completion fence: the
     # final loss depends on every prior step through the donated state
@@ -534,6 +576,7 @@ def worker(argv):
             img_per_sec_per_chip / BASELINE_IMG_PER_SEC_PER_DEVICE, 3)
             if args.model.startswith("resnet") else None),
         "fusion": fusion_cfg,
+        "compression": compression_cfg,
     }
     if step_times:
         # Per-step rates + a 95% CI (the reference benchmark's

@@ -53,6 +53,7 @@ from .common.state import (  # noqa: F401
     xla_built,
 )
 from .common.state import global_state as _global_state
+from .common.compression import Compression  # noqa: F401
 from .ops import xla  # noqa: F401
 from .ops.xla import Adasum, Average, Max, Min, ReduceOp, Sum  # noqa: F401
 

@@ -1,0 +1,334 @@
+"""On-wire gradient compression — the shared compressor implementation.
+
+Horovod's headline bandwidth lever after tensor fusion is wire
+compression: ``hvd.Compression.fp16`` casts gradients to a 16-bit wire
+format before the allreduce and back after (reference
+``horovod/torch/compression.py:46``), halving collective bytes on
+bandwidth-bound models. This module is the single implementation behind
+every plane:
+
+- **Compiled collectives** (``ops/xla.py``): ``allreduce`` /
+  ``grouped_allreduce`` / ``hierarchical_allreduce`` take a
+  ``compression`` argument and reduce *in the wire dtype* — the compiled
+  HLO all-reduce operand is f16/bf16, so the ICI/DCN bytes actually
+  halve — then accumulate post-reduction arithmetic (averaging,
+  postscale) in fp32 before casting back.
+- **Optimizer plane** (``opt.py`` / ``training.py`` / ``zero.py``):
+  ``DistributedOptimizer(compression=...)`` and the ZeRO pair thread the
+  compressor through the gradient exchange; the error-feedback variant
+  keeps per-parameter fp32 residuals in the train state so quantization
+  error is re-injected next step instead of lost (the EF-SGD /
+  PyTorch-DDP bf16-comm-hook residual scheme, PAPERS.md).
+- **Fusion planner** (``common/fusion.py``): bucket caps budget the
+  *compressed* wire dtype, so one ``HOROVOD_FUSION_THRESHOLD`` value
+  keeps meaning wire bytes whether or not compression is on.
+- **Framework stubs** (``torch/compression.py``,
+  ``tensorflow/compression.py``): built by
+  ``make_framework_compression`` from the same cast policy, so there is
+  one compressor implementation tree-wide.
+
+Selection: ``HOROVOD_COMPRESSION`` env var (``none`` / ``fp16`` /
+``bf16`` / ``ef16``), resolved by ``resolve_compression("auto")`` with
+the same live-config-then-env precedence as the fusion threshold. With
+the knob unset, every compiled program is byte-identical to the
+uncompressed path — compression only engages when asked for.
+
+Choosing a format (docs/compression.md): bf16 keeps fp32's exponent
+range (no overflow, TPU-native) but only 8 mantissa bits; fp16 has more
+mantissa but can overflow/underflow large or tiny gradients; ef16 is
+fp16 plus error feedback, recovering convergence where plain fp16's
+rounding stalls it, at the cost of one fp32 residual per parameter.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+__all__ = [
+    "Compressor",
+    "NoneCompressor",
+    "Fp16Compressor",
+    "Bf16Compressor",
+    "ErrorFeedbackCompressor",
+    "Compression",
+    "resolve_compression",
+    "apply_error_feedback",
+    "init_residual",
+    "make_framework_compression",
+]
+
+# Canonical wire-format names shared by every binding (the one policy
+# table: a compressor compresses floating tensors to its wire format and
+# leaves integer/bool tensors untouched).
+_WIRE_FORMATS = ("float16", "bfloat16")
+
+
+class Compressor:
+    """A wire-format compressor for the JAX/XLA plane.
+
+    ``wire_dtype(dtype)`` is the compiled path's contract: the dtype a
+    tensor of ``dtype`` travels at inside the collective, or None when
+    the tensor is not compressed (non-float inputs, or the
+    NoneCompressor). ``compress``/``decompress`` keep the reference's
+    per-tensor ``(tensor, ctx)`` API for the eager/legacy paths.
+    """
+
+    name = "none"
+    wire: Optional[str] = None  # canonical wire format name, or None
+    error_feedback = False
+
+    def wire_dtype(self, dtype):
+        """Wire dtype for an input of ``dtype``, or None (uncompressed)."""
+        if self.wire is None:
+            return None
+        import jax.numpy as jnp
+
+        dt = jnp.dtype(dtype)
+        if not jnp.issubdtype(dt, jnp.floating):
+            return None
+        return jnp.dtype(self.wire)
+
+    def compress(self, tensor):
+        w = self.wire_dtype(tensor.dtype)
+        if w is None or w == tensor.dtype:
+            return tensor, None
+        return tensor.astype(w), tensor.dtype
+
+    def decompress(self, tensor, ctx):
+        return tensor.astype(ctx) if ctx is not None else tensor
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.name!r}>"
+
+
+class NoneCompressor(Compressor):
+    """Identity: tensors travel at their accumulation dtype (bf16/fp16
+    inputs upcast to fp32 on the wire — the uncompressed contract)."""
+
+    name = "none"
+
+
+class Fp16Compressor(Compressor):
+    """float16 wire format. More mantissa than bf16 but a narrow
+    exponent: very large/tiny gradients can overflow/flush — pair with
+    ``ef16`` (error feedback) when that stalls convergence."""
+
+    name = "fp16"
+    wire = "float16"
+
+
+class Bf16Compressor(Compressor):
+    """bfloat16 wire format — TPU-native. fp32's exponent range (no
+    overflow scaling needed), 8 mantissa bits."""
+
+    name = "bf16"
+    wire = "bfloat16"
+
+
+class ErrorFeedbackCompressor(Compressor):
+    """Wraps a wire compressor with error feedback: the caller keeps a
+    per-parameter fp32 residual in train state, adds it to the gradient
+    before quantization, and stores back the quantization error
+    (``corrected - Q(corrected)``) so low-precision rounding is
+    re-injected next step instead of lost (EF-SGD; PyTorch DDP's bf16
+    comm hook ships the same residual scheme).
+
+    The wrapper itself is stateless — state lives in the optimizer /
+    ZeRO train state (``opt.py DistributedState.residual``,
+    ``zero.py ZeroTrainState.residual``); ``apply_error_feedback`` is
+    the shared correct/quantize/residual-update step.
+    """
+
+    error_feedback = True
+
+    def __init__(self, inner: Compressor, name: Optional[str] = None):
+        if inner.wire is None:
+            raise ValueError("error feedback needs a lossy wire format; "
+                             "wrapping the NoneCompressor is meaningless")
+        self.inner = inner
+        self.name = name or f"ef-{inner.name}"
+
+    @property
+    def wire(self):  # type: ignore[override]
+        return self.inner.wire
+
+    def wire_dtype(self, dtype):
+        return self.inner.wire_dtype(dtype)
+
+
+class Compression:
+    """Option namespace (parity: reference ``Compression.none`` /
+    ``Compression.fp16``), JAX-native. ``ef16`` is fp16 with error
+    feedback — requires the optimizer plane (it needs residual state);
+    the raw collectives treat it as its fp16 wire format."""
+
+    none = NoneCompressor()
+    fp16 = Fp16Compressor()
+    bf16 = Bf16Compressor()
+    ef16 = ErrorFeedbackCompressor(Fp16Compressor(), name="ef16")
+
+
+_BY_NAME = {
+    "none": None,
+    "fp16": Compression.fp16,
+    "bf16": Compression.bf16,
+    "ef16": Compression.ef16,
+}
+
+COMPRESSION_NAMES = tuple(_BY_NAME)
+
+
+def resolve_compression(compression="auto") -> Optional[Compressor]:
+    """Resolve a user-facing compression knob to a Compressor or None.
+
+    - ``"auto"`` (the plumbing default): the live runtime config when
+      ``hvd.init()`` has run and ``HOROVOD_COMPRESSION`` was explicitly
+      set (or the autotuner pinned a mode), else the raw env var —
+      otherwise None. An *unset* knob keeps every program byte-identical
+      to the uncompressed path (the same contract as the fusion
+      threshold's "auto").
+    - ``None`` / ``"none"`` / ``Compression.none``: no compression.
+    - ``"fp16"`` / ``"bf16"`` / ``"ef16"``: the named compressor.
+    - a ``Compressor`` instance: itself.
+    """
+    if compression is None:
+        return None
+    if isinstance(compression, Compressor):
+        return None if isinstance(compression, NoneCompressor) else compression
+    if isinstance(compression, str):
+        name = compression
+        if name == "auto":
+            from . import config as _config
+            from .state import global_state
+
+            st = global_state()
+            if (st.initialized and st.config is not None
+                    and getattr(st.config, "compression_explicit", False)):
+                name = st.config.compression
+            else:
+                name = _config.parse_compression_env()
+        if name not in _BY_NAME:
+            raise ValueError(
+                f"unknown compression {name!r}; expected one of "
+                f"{sorted(_BY_NAME)} or 'auto'")
+        return _BY_NAME[name]
+    if hasattr(compression, "compress"):
+        raise TypeError(
+            f"{compression!r} looks like a framework compressor stub "
+            f"(torch/tensorflow Compression); the XLA plane takes "
+            f"horovod_tpu.Compression.{{none,fp16,bf16,ef16}} or the "
+            f"name as a string")
+    raise TypeError(f"cannot resolve compression from {compression!r}")
+
+
+def apply_error_feedback(compressor: ErrorFeedbackCompressor, grads,
+                         residual):
+    """One error-feedback step over a gradient pytree.
+
+    Returns ``(wire_grads, new_residual)``: per leaf,
+    ``corrected = grad(fp32) + residual``; ``wire = Q(corrected)`` in
+    the inner compressor's wire dtype; ``new_residual = corrected -
+    wire(fp32)``. Leaves the wire format does not apply to (ints) pass
+    through with a zero residual. The caller reduces ``wire_grads`` (in
+    the wire dtype — that is the on-wire saving) and owns persisting
+    ``new_residual`` in its state.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    # Two passes (quantize, then residual) rather than one tuple-valued
+    # tree_map: a gradient pytree may itself contain tuples, which an
+    # is_leaf=tuple transpose would mistake for result pairs. The
+    # recomputed `corrected` is CSE'd away under jit.
+    def quantize(g, r):
+        w = compressor.wire_dtype(g.dtype)
+        if w is None:
+            return g
+        return (g.astype(jnp.float32) + r).astype(w)
+
+    def new_residual(g, r):
+        w = compressor.wire_dtype(g.dtype)
+        if w is None:
+            return jnp.zeros_like(r)
+        corrected = g.astype(jnp.float32) + r
+        return corrected - corrected.astype(w).astype(jnp.float32)
+
+    wire = jax.tree_util.tree_map(quantize, grads, residual)
+    new_res = jax.tree_util.tree_map(new_residual, grads, residual)
+    return wire, new_res
+
+
+def init_residual(params):
+    """fp32 zero residuals matching a parameter/gradient pytree (the
+    error-feedback state; one fp32 scalar per parameter element)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+
+# ---- framework stub factory -------------------------------------------------
+
+
+def make_framework_compression(cast, is_floating):
+    """Build the reference-compatible ``Compression`` namespace for a
+    framework binding from two primitives: ``cast(tensor, dtype)`` (where
+    dtype is a canonical wire-format name or a framework dtype captured
+    as ctx) and ``is_floating(tensor)``.
+
+    This is the one implementation behind ``torch/compression.py`` and
+    ``tensorflow/compression.py`` — the stubs only supply the cast.
+    Returned namespace: ``Compression.none/fp16/bf16`` are classes with
+    the reference's static ``compress(tensor) -> (tensor, ctx)`` /
+    ``decompress(tensor, ctx)`` API; the interface base is attached as
+    ``Compression.Compressor``.
+    """
+
+    class Compressor:
+        """Interface: ``compress(tensor) -> (tensor, ctx)``,
+        ``decompress(tensor, ctx) -> tensor``."""
+
+        @staticmethod
+        def compress(tensor):
+            raise NotImplementedError
+
+        @staticmethod
+        def decompress(tensor, ctx):
+            raise NotImplementedError
+
+    def _make(wire_name):
+        class _WireCompressor(Compressor):
+            @staticmethod
+            def compress(tensor):
+                if is_floating(tensor):
+                    return cast(tensor, wire_name), tensor.dtype
+                return tensor, None
+
+            @staticmethod
+            def decompress(tensor, ctx):
+                return cast(tensor, ctx) if ctx is not None else tensor
+
+        _WireCompressor.__name__ = (
+            "FP16Compressor" if wire_name == "float16" else "BF16Compressor")
+        return _WireCompressor
+
+    class NoneCompressor(Compressor):
+        @staticmethod
+        def compress(tensor):
+            return tensor, None
+
+        @staticmethod
+        def decompress(tensor, ctx):
+            return tensor
+
+    class Compression:
+        """Option namespace (parity: ``Compression.none`` /
+        ``Compression.fp16``); bf16 is the TPU-native extension (fp32
+        exponent range, no loss-scaling needed)."""
+
+    Compression.Compressor = Compressor
+    Compression.none = NoneCompressor
+    Compression.fp16 = _make("float16")
+    Compression.bf16 = _make("bfloat16")
+    return Compression

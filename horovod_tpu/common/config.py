@@ -23,6 +23,7 @@ HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE = "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"
 HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES = "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"
 HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE = "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"
 HOROVOD_CACHE_CAPACITY = "HOROVOD_CACHE_CAPACITY"
+HOROVOD_COMPRESSION = "HOROVOD_COMPRESSION"
 HOROVOD_HIERARCHICAL_ALLREDUCE = "HOROVOD_HIERARCHICAL_ALLREDUCE"
 HOROVOD_HIERARCHICAL_ALLGATHER = "HOROVOD_HIERARCHICAL_ALLGATHER"
 HOROVOD_STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
@@ -92,6 +93,39 @@ def _get_int_explicit(name: str, default: int):
         return default, False
 
 
+# On-wire gradient compression modes (common/compression.py;
+# docs/compression.md). "ef16" = fp16 wire + error-feedback residuals.
+COMPRESSION_CHOICES = ("none", "fp16", "bf16", "ef16")
+
+
+def _get_choice_explicit(name: str, choices, default: str):
+    """(value, explicit) for an enumerated env knob. Unset OR an unknown
+    value → (default, False) — a typo'd mode must not count as explicit
+    (same tolerance contract as ``_get_int_explicit``), but it is worth
+    a warning: silently training uncompressed under a misspelled
+    ``HOROVOD_COMPRESSION`` would be a nasty surprise."""
+    v = os.environ.get(name)
+    if v is None:
+        return default, False
+    v = v.strip().lower()
+    if v in choices:
+        return v, True
+    from . import logging as _log
+
+    _log.warning(f"{name}={v!r} is not one of {sorted(choices)}; "
+                 f"ignoring (using {default!r})")
+    return default, False
+
+
+def parse_compression_env() -> str:
+    """The env-level compression mode ("none" when unset/invalid) — the
+    raw-env half of ``compression.resolve_compression('auto')``'s
+    precedence (live config first, then this)."""
+    v, _ = _get_choice_explicit(HOROVOD_COMPRESSION, COMPRESSION_CHOICES,
+                                "none")
+    return v
+
+
 def _get_float(name: str, default: float) -> float:
     v = os.environ.get(name)
     try:
@@ -115,6 +149,12 @@ class RuntimeConfig:
     # the host plane's cycle fusion, and silently bucketing the compiled
     # path by default would change programs under users' feet.
     fusion_threshold_explicit: bool = False
+    # On-wire gradient compression mode (common/compression.py). Explicit
+    # means env-set or autotuner-pinned; resolve_compression("auto") only
+    # engages then — unset keeps every compiled program byte-identical to
+    # the uncompressed path (same contract as the fusion threshold).
+    compression: str = "none"
+    compression_explicit: bool = False
     cycle_time_ms: float = DEFAULT_CYCLE_TIME_MS
     cache_capacity: int = DEFAULT_CACHE_CAPACITY
     timeline_filename: str = ""
@@ -138,9 +178,13 @@ class RuntimeConfig:
     def from_env(cls) -> "RuntimeConfig":
         fusion_bytes, fusion_explicit = _get_int_explicit(
             HOROVOD_FUSION_THRESHOLD, DEFAULT_FUSION_THRESHOLD_BYTES)
+        compression, compression_explicit = _get_choice_explicit(
+            HOROVOD_COMPRESSION, COMPRESSION_CHOICES, "none")
         return cls(
             fusion_threshold_bytes=fusion_bytes,
             fusion_threshold_explicit=fusion_explicit,
+            compression=compression,
+            compression_explicit=compression_explicit,
             cycle_time_ms=_get_float(HOROVOD_CYCLE_TIME, DEFAULT_CYCLE_TIME_MS),
             cache_capacity=_get_int(HOROVOD_CACHE_CAPACITY, DEFAULT_CACHE_CAPACITY),
             timeline_filename=os.environ.get(HOROVOD_TIMELINE, ""),

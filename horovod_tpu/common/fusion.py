@@ -71,15 +71,25 @@ def leaf_nbytes(leaf) -> int:
 _FP32_WIRE_DTYPES = ("bfloat16", "float16")
 
 
-def leaf_wire_nbytes(leaf) -> int:
-    """Bytes the leaf actually occupies in the fused collective: fp32
-    width for bf16/fp16 (the accumulation dtype), native width otherwise.
+def leaf_wire_nbytes(leaf, compression=None) -> int:
+    """Bytes the leaf actually occupies in the fused collective: the
+    compressed wire dtype's width when ``compression`` (a resolved
+    ``common/compression.Compressor``) applies to the leaf, else fp32
+    width for bf16/fp16 (the accumulation dtype), else native width.
     The cap is a *wire* budget — planning on storage bytes would make one
     ``HOROVOD_FUSION_THRESHOLD`` mean 2x different effective bucket sizes
-    between a bf16 data-parallel allreduce and ZeRO's fp32 scatter."""
+    between a bf16 data-parallel allreduce and ZeRO's fp32 scatter; the
+    same argument makes a compressed plan budget f16/bf16 widths, so one
+    threshold keeps meaning wire bytes with compression on."""
+    import numpy as np
+
     size = 1
     for d in leaf.shape:
         size *= int(d)
+    if compression is not None:
+        w = compression.wire_dtype(leaf.dtype)
+        if w is not None:
+            return size * np.dtype(w).itemsize
     item = 4 if str(leaf.dtype) in _FP32_WIRE_DTYPES else leaf.dtype.itemsize
     return size * item
 
@@ -148,11 +158,14 @@ def plan_buckets(
 
 
 def plan_buckets_for(leaves: Sequence[Any],
-                     bucket_cap_bytes: Optional[int] = None) -> List[Bucket]:
+                     bucket_cap_bytes: Optional[int] = None,
+                     compression=None) -> List[Bucket]:
     """Convenience overload: plan directly from array-likes / tracers,
-    budgeting each leaf at its WIRE width (see ``leaf_wire_nbytes``) so
-    the same cap means the same bucket sizes on every plane."""
-    return plan_buckets([leaf_wire_nbytes(l) for l in leaves],
+    budgeting each leaf at its WIRE width (see ``leaf_wire_nbytes``,
+    including the compressed dtype when ``compression`` is a resolved
+    compressor) so the same cap means the same bucket sizes on every
+    plane."""
+    return plan_buckets([leaf_wire_nbytes(l, compression) for l in leaves],
                         [l.dtype for l in leaves], bucket_cap_bytes)
 
 

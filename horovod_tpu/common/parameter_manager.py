@@ -39,7 +39,9 @@ class ParameterManager:
                  initial_cycle_ms: float = 5.0,
                  initial_fusion_bytes: int = 64 * MB,
                  tune_hierarchical: bool = False,
-                 xla_cap_setter=None):
+                 xla_cap_setter=None,
+                 compression_setter=None,
+                 compression_candidates=()):
         self._core = core
         # Tensor-fusion v2 hook: the tuned fusion threshold also governs
         # the XLA plane's bucket cap (common/fusion.resolve_bucket_cap
@@ -72,12 +74,32 @@ class ParameterManager:
         self._cat_combos = [0, 1, 2, 3] if tune_hierarchical else []
         self._cat_scores: dict = {}
         self._cat_best: Optional[int] = None
+        # Compression phase (tensor-fusion v2's wire-compression sibling):
+        # a categorical grid over the on-wire compression modes —
+        # typically ("none", <the configured mode>), i.e. "does the
+        # compression the user asked for actually pay on this model?" —
+        # scored exactly like the hierarchical combos, pinned via
+        # compression_setter (publishes into the live RuntimeConfig so
+        # "auto"-built steps pick it up at their next build). Runs after
+        # the hierarchical grid and before the numeric GP.
+        self._compression_setter = compression_setter
+        self._comp_candidates = (list(compression_candidates)
+                                 if compression_setter else [])
+        self._comp_scores: dict = {}
+        self._comp_best: Optional[str] = None
+        # Set by _apply_compression only — during an earlier (hier)
+        # phase the ambient config's mode is still in force, and the
+        # log column shows "-" rather than claiming a mode this tuner
+        # has not applied yet.
+        self._current_compression: Optional[str] = None
         self._log_rows = 0
         if self._cat_combos:
             self._apply_hier(self._cat_combos[0])
+        elif self._comp_candidates:
+            self._apply_compression(self._comp_candidates[0])
         if log_file:
             with open(log_file, "w") as f:
-                f.write("sample,fusion_mb,cycle_ms,hier_flags,"
+                f.write("sample,fusion_mb,cycle_ms,hier_flags,compression,"
                         "score_bytes_per_sec\n")
 
     @property
@@ -123,6 +145,22 @@ class ParameterManager:
             _log.info(f"autotune: hierarchical flags pinned to "
                       f"{self._cat_best:#04b} "
                       f"({self._cat_scores[self._cat_best] / MB:.1f} MB/s)")
+            if self._comp_candidates:
+                self._apply_compression(self._comp_candidates[0])
+            return
+        # Phase 1b: grid over the compression modes, pin the winner.
+        if self._comp_candidates:
+            mode = self._comp_candidates.pop(0)
+            self._comp_scores[mode] = score
+            if self._comp_candidates:
+                self._apply_compression(self._comp_candidates[0])
+                return
+            self._comp_best = max(self._comp_scores,
+                                  key=self._comp_scores.get)
+            self._apply_compression(self._comp_best)
+            _log.info(
+                f"autotune: compression pinned to {self._comp_best!r} "
+                f"({self._comp_scores[self._comp_best] / MB:.1f} MB/s)")
             return
         # Phase 2: numeric GP over (fusion, cycle).
         self._bayes.add_sample([fusion_mb, cycle_ms], score)
@@ -146,9 +184,10 @@ class ParameterManager:
         fusion_mb, cycle_ms = self._current
         hier = self._cat_combos[0] if self._cat_combos else \
             (self._cat_best if self._cat_best is not None else -1)
+        comp = self._current_compression or "-"
         with open(self._log_file, "a") as f:
             f.write(f"{self._log_rows},{fusion_mb:.2f},"
-                    f"{cycle_ms:.2f},{hier},{score:.0f}\n")
+                    f"{cycle_ms:.2f},{hier},{comp},{score:.0f}\n")
 
     def _apply(self, fusion_mb: float, cycle_ms: float) -> None:
         self._current = (float(fusion_mb), float(cycle_ms))
@@ -163,6 +202,11 @@ class ParameterManager:
         if self._core is not None:
             self._core.set_hier_flags(int(flags))
 
+    def _apply_compression(self, mode: str) -> None:
+        self._current_compression = mode
+        if self._compression_setter is not None:
+            self._compression_setter(mode)
+
     # introspection
     @property
     def current(self):
@@ -176,3 +220,8 @@ class ParameterManager:
     def hier_flags(self) -> Optional[int]:
         """The pinned categorical decision (None before phase 1 ends)."""
         return self._cat_best
+
+    @property
+    def compression(self) -> Optional[str]:
+        """The pinned compression mode (None before phase 1b ends)."""
+        return self._comp_best

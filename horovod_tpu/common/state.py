@@ -212,12 +212,43 @@ def init(comm=None, devices=None):
                 cfg.fusion_threshold_bytes = int(nbytes)
                 cfg.fusion_threshold_explicit = True
 
+            def _publish_compression(mode: str) -> None:
+                # Same live-config publish as the bucket cap, for the
+                # compression mode: resolve_compression("auto") reads it,
+                # so "auto"-built steps adopt the tuner's pick at their
+                # next build. SINGLE-CONTROLLER ONLY (same divergence
+                # argument as the cap).
+                cfg.compression = mode
+                cfg.compression_explicit = True
+
+            # The tuner explores compression ONLY when the user opted in
+            # (HOROVOD_COMPRESSION explicitly set to a non-none mode):
+            # compression changes numerics, and silently quantizing
+            # gradients because it benched faster is not the tuner's
+            # call. The grid then answers "does the requested mode
+            # actually pay on this model?" — none vs the configured mode.
+            # The samples are real A/Bs: the eager engine resolves the
+            # live mode per program build (ops/eager.py
+            # _exec_grouped_allreduce, mode in the cache key), so each
+            # published candidate recompiles the negotiated collectives
+            # with that wire format before the sample is scored — and
+            # the score's nbytes are *application* bytes, invariant
+            # across modes, so bytes/sec genuinely ranks the modes by
+            # collective speed.
+            comp_candidates = ()
+            if cfg.compression_explicit and cfg.compression != "none":
+                comp_candidates = ("none", cfg.compression)
+
             if _state.process_count > 1:
                 _log.debug(
-                    "autotune: XLA bucket-cap publish disabled in "
-                    "multi-process worlds (set HOROVOD_FUSION_THRESHOLD "
-                    "explicitly to bucket the compiled path everywhere)")
+                    "autotune: XLA bucket-cap/compression publish "
+                    "disabled in multi-process worlds (set "
+                    "HOROVOD_FUSION_THRESHOLD / HOROVOD_COMPRESSION "
+                    "explicitly — same env everywhere — to govern the "
+                    "compiled path)")
                 _publish_xla_cap = None
+                _publish_compression = None
+                comp_candidates = ()
 
             core = _state.engine.native_core
             _state.autotuner = ParameterManager(
@@ -234,7 +265,10 @@ def init(comm=None, devices=None):
                 # 4 sample windows on a meaningless choice.
                 tune_hierarchical=(_state.hier_mesh is not None
                                    and _state.cross_size > 1),
-                xla_cap_setter=_publish_xla_cap)
+                xla_cap_setter=_publish_xla_cap,
+                compression_setter=(_publish_compression
+                                    if comp_candidates else None),
+                compression_candidates=comp_candidates)
 
         _state.initialized = True
         _log.info(

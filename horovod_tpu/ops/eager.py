@@ -435,9 +435,26 @@ class EagerEngine:
         hier = self._use_hierarchical(
             self._state.config.hierarchical_allreduce, op,
             override=hier_override)
+        # On-wire compression (common/compression.py): the live "auto"
+        # mode — HOROVOD_COMPRESSION, or the autotuner's published pick —
+        # compresses the device-plane collective. Error feedback needs
+        # per-parameter state the eager API has nowhere to keep, so ef16
+        # degrades to its fp16 wire here (the optimizer plane carries
+        # the residuals). The mode rides the program-cache key, so a
+        # tuner flip recompiles — which is exactly what makes the
+        # tuner's compression grid measure real compressed collectives
+        # rather than two identical programs.
+        from ..common.compression import resolve_compression
+
+        comp = resolve_compression("auto")
+        if comp is not None and comp.error_feedback:
+            comp = comp.inner
+        # Key order contract: the hier flag stays the LAST element
+        # (test_autotune's frame-sync proof reads it there).
         key = ("grouped_allreduce",
                tuple((s.shape[1:], str(s.dtype)) for s in stacks), op,
-               prescale, postscale, hier)
+               prescale, postscale,
+               comp.name if comp is not None else None, hier)
         mesh = self._state.hier_mesh if hier else self._mesh
         spec = P((AXIS_CROSS, AXIS_LOCAL)) if hier else P(AXIS_GLOBAL)
 
@@ -446,11 +463,12 @@ class EagerEngine:
                 if hier:
                     ys = _xla.grouped_hierarchical_allreduce(
                         [x[0] for x in xs], op=op, prescale_factor=prescale,
-                        postscale_factor=postscale)
+                        postscale_factor=postscale, compression=comp)
                 else:
                     ys = _xla.grouped_allreduce(
                         [x[0] for x in xs], axis_name=AXIS_GLOBAL, op=op,
-                        prescale_factor=prescale, postscale_factor=postscale)
+                        prescale_factor=prescale, postscale_factor=postscale,
+                        compression=comp)
                 return tuple(y[None] for y in ys)
 
             return jax.jit(_shard_map(

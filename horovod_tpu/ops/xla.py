@@ -45,16 +45,56 @@ Min = ReduceOp.MIN
 Max = ReduceOp.MAX
 
 
+_LOW_PRECISION = (jnp.bfloat16, jnp.float16)
+
+
+def _scale(acc, factor):
+    """Multiply inside the accumulation window: ``acc`` is already at
+    the accumulation dtype (fp32 for low-precision inputs), so the
+    factor never rounds at 16-bit precision. No-op for factor 1 — the
+    default path's program is untouched."""
+    if factor != 1.0:
+        acc = acc * jnp.asarray(factor, dtype=acc.dtype)
+    return acc
+
+
+def _scale_f32(tensor, factor):
+    """Scale at fp32 regardless of input dtype (no-op for factor 1, no
+    upcast then either). Scaling bf16/fp16 in their own dtype loses the
+    factor's precision and can overflow for large factors — the
+    prescale precision bug; every scaling site routes through here or
+    ``_scale``."""
+    if factor == 1.0:
+        return tensor
+    return tensor.astype(jnp.float32) * jnp.float32(factor)
+
+
 def _apply_prescale(tensor, prescale_factor):
-    if prescale_factor != 1.0:
-        tensor = tensor * jnp.asarray(prescale_factor, dtype=tensor.dtype)
-    return tensor
+    """Dtype-preserving pre-scale for the per-tensor (Adasum) paths:
+    fp32 math (see ``_scale_f32``), rounded back once. The elementwise
+    reduce paths scale inside their fp32 accumulation window instead
+    (no extra round-trip); this helper exists for callers that must
+    hand a dtype-stable tensor onward (Adasum's per-tensor
+    coefficients)."""
+    if tensor.dtype in _LOW_PRECISION:
+        return _scale_f32(tensor, prescale_factor).astype(tensor.dtype)
+    return _scale(tensor, prescale_factor)
 
 
 def _apply_postscale(tensor, postscale_factor):
-    if postscale_factor != 1.0:
-        tensor = tensor * jnp.asarray(postscale_factor, dtype=tensor.dtype)
-    return tensor
+    """Dtype-preserving post-scale; fp32 math for bf16/fp16 (see
+    ``_apply_prescale``)."""
+    if tensor.dtype in _LOW_PRECISION:
+        return _scale_f32(tensor, postscale_factor).astype(tensor.dtype)
+    return _scale(tensor, postscale_factor)
+
+
+def _resolve_compression(compression):
+    if compression is None:
+        return None
+    from ..common.compression import resolve_compression
+
+    return resolve_compression(compression)
 
 
 def allreduce(
@@ -63,37 +103,58 @@ def allreduce(
     op: int = ReduceOp.SUM,
     prescale_factor: float = 1.0,
     postscale_factor: float = 1.0,
+    compression=None,
 ):
     """Allreduce a per-participant tensor across ``axis_name``.
 
-    Low-precision inputs (bf16/fp16) are accumulated in fp32 — the TPU
-    analog of the reference's AVX fp32-accumulation fp16 path
-    (``adasum.h:426-468``) — then cast back.
+    Uncompressed, low-precision inputs (bf16/fp16) are accumulated in
+    fp32 — the TPU analog of the reference's AVX fp32-accumulation fp16
+    path (``adasum.h:426-468``) — then cast back: the *wire* dtype is
+    fp32. With ``compression`` (a ``common/compression`` compressor,
+    its name, or None), floating tensors reduce IN the compressed wire
+    dtype — the compiled all-reduce operand is f16/bf16, halving wire
+    bytes — and post-reduction arithmetic (averaging, postscale) runs in
+    fp32 on the reduced value before casting back to the input dtype.
+
+    Pre/postscale factors are applied in fp32 inside the accumulation
+    window (never in a 16-bit dtype), on both paths.
+
+    Adasum ignores compression: its dot/norm coefficients are computed
+    per tensor in fp32, and quantizing the operands would bias the
+    coefficients themselves, not just the payload.
     """
     if op == ReduceOp.ADASUM:
         from .adasum import adasum_allreduce
 
         return adasum_allreduce(tensor, axis_name=axis_name)
 
-    tensor = _apply_prescale(tensor, prescale_factor)
+    comp = _resolve_compression(compression)
     dtype = tensor.dtype
-    acc = tensor.astype(jnp.float32) if dtype in (jnp.bfloat16, jnp.float16) else tensor
+    wire = comp.wire_dtype(dtype) if comp is not None else None
+    if wire is not None:
+        acc = _scale_f32(tensor, prescale_factor).astype(wire)
+    else:
+        acc = tensor.astype(jnp.float32) if dtype in _LOW_PRECISION else tensor
+        acc = _scale(acc, prescale_factor)
     if op in (ReduceOp.SUM, ReduceOp.AVERAGE):
         out = lax.psum(acc, axis_name)
-        if op == ReduceOp.AVERAGE:
-            n = _axis_size(axis_name)
-            out = out / jnp.asarray(n, dtype=out.dtype)
     elif op == ReduceOp.MIN:
         out = lax.pmin(acc, axis_name)
     elif op == ReduceOp.MAX:
         out = lax.pmax(acc, axis_name)
     else:
         raise ValueError(f"unknown reduce op {op}")
-    out = out.astype(dtype)
-    return _apply_postscale(out, postscale_factor)
+    if wire is not None:
+        # fp32 accumulation on the reduced value: averaging/postscale
+        # must not round at wire precision.
+        out = out.astype(jnp.float32)
+    if op == ReduceOp.AVERAGE:
+        n = _axis_size(axis_name)
+        out = out / jnp.asarray(n, dtype=out.dtype)
+    return _scale(out, postscale_factor).astype(dtype)
 
 
-def _grouped(tensors, reduce_fn, bucket_cap_bytes=None):
+def _grouped(tensors, reduce_fn, bucket_cap_bytes=None, compression=None):
     """Shared dtype-concat fusion: flatten, concatenate per plan bucket,
     reduce each fused buffer with ``reduce_fn``, slice results back out.
 
@@ -118,7 +179,11 @@ def _grouped(tensors, reduce_fn, bucket_cap_bytes=None):
         return []
     flats = [jnp.ravel(t) for t in tensors]
     out = [None] * len(tensors)
-    for bucket in plan_buckets_for(flats, bucket_cap_bytes):
+    # The plan budgets the COMPRESSED wire dtype when compression is on
+    # (fusion.leaf_wire_nbytes), so one HOROVOD_FUSION_THRESHOLD keeps
+    # meaning wire bytes; buckets are dtype-pure either way, so the fused
+    # buffer compresses as one cast inside reduce_fn.
+    for bucket in plan_buckets_for(flats, bucket_cap_bytes, compression):
         idxs = list(bucket.indices)
         fused = (jnp.concatenate([flats[i] for i in idxs])
                  if len(idxs) > 1 else flats[idxs[0]])
@@ -134,7 +199,7 @@ def _grouped(tensors, reduce_fn, bucket_cap_bytes=None):
 
 def grouped_allreduce(tensors, axis_name: str = AXIS_GLOBAL, op: int = ReduceOp.SUM,
                       prescale_factor: float = 1.0, postscale_factor: float = 1.0,
-                      bucket_cap_bytes=None):
+                      bucket_cap_bytes=None, compression=None):
     """Allreduce a list of tensors as one fused operation (see ``_grouped``).
 
     ``bucket_cap_bytes`` (bytes, or ``"auto"`` to follow
@@ -150,6 +215,10 @@ def grouped_allreduce(tensors, axis_name: str = AXIS_GLOBAL, op: int = ReduceOp.
     XLA still compiles the whole group into one program, so fusion's
     launch-overhead win is preserved. Bucketing partitions the *launch*
     groups only; the per-tensor Adasum contract is unchanged.
+
+    ``compression`` (see ``allreduce``) makes each bucket reduce in the
+    compressed wire dtype, and the plan budget the compressed width.
+    Adasum ignores it (per-tensor fp32 coefficients).
     """
     from ..common.fusion import resolve_bucket_cap
 
@@ -162,12 +231,14 @@ def grouped_allreduce(tensors, axis_name: str = AXIS_GLOBAL, op: int = ReduceOp.
             pre, lambda chunk: grouped_adasum_allreduce(
                 chunk, axis_name=axis_name), cap)
         return [_apply_postscale(t, postscale_factor) for t in red]
+    comp = _resolve_compression(compression)
     return _grouped(
         tensors,
         lambda fused: allreduce(fused, axis_name=axis_name, op=op,
                                 prescale_factor=prescale_factor,
-                                postscale_factor=postscale_factor),
-        bucket_cap_bytes=cap)
+                                postscale_factor=postscale_factor,
+                                compression=comp),
+        bucket_cap_bytes=cap, compression=comp)
 
 
 def _grouped_per_tensor(tensors, group_fn, bucket_cap_bytes):
@@ -190,7 +261,10 @@ def _grouped_per_tensor(tensors, group_fn, bucket_cap_bytes):
     return out
 
 
-def hierarchical_allreduce(tensor, op: int = ReduceOp.SUM):
+def hierarchical_allreduce(tensor, op: int = ReduceOp.SUM,
+                           prescale_factor: float = 1.0,
+                           postscale_factor: float = 1.0,
+                           compression=None):
     """ICI-then-DCN hierarchical allreduce over the (cross, local) mesh.
 
     TPU-native analog of ``NCCLHierarchicalAllreduce``
@@ -198,14 +272,26 @@ def hierarchical_allreduce(tensor, op: int = ReduceOp.SUM):
     (ICI) axis, allreduce the shards along the CROSS (DCN) axis, then
     all-gather back along LOCAL. Must run under the hierarchical mesh with
     axes (AXIS_CROSS, AXIS_LOCAL).
+
+    ``compression`` runs every ladder leg (scatter, cross psum, gather)
+    in the compressed wire dtype — the DCN leg is exactly where wire
+    bytes hurt most — with averaging/postscale in fp32 on the reduced
+    value, as in the flat path. Pre/postscale are applied in fp32 inside
+    the accumulation window.
     """
     # Same dtype contract as the flat path (allreduce above): accumulate
     # low-precision inputs in fp32, cast the result back, so routing
     # through HOROVOD_HIERARCHICAL_ALLREDUCE never changes dtypes or
     # precision semantics.
+    comp = _resolve_compression(compression)
     dtype = tensor.dtype
-    acc = (tensor.astype(jnp.float32)
-           if dtype in (jnp.bfloat16, jnp.float16) else tensor)
+    wire = comp.wire_dtype(dtype) if comp is not None else None
+    if wire is not None:
+        acc = _scale_f32(tensor, prescale_factor).astype(wire)
+    else:
+        acc = (tensor.astype(jnp.float32)
+               if dtype in _LOW_PRECISION else tensor)
+        acc = _scale(acc, prescale_factor)
     flat = jnp.ravel(acc)
     local_n = _axis_size(AXIS_LOCAL)
     pad = (-flat.shape[0]) % local_n
@@ -217,16 +303,18 @@ def hierarchical_allreduce(tensor, op: int = ReduceOp.SUM):
     if pad:
         full = full[: flat.shape[0] - pad]
     out = jnp.reshape(full, acc.shape)
+    if wire is not None:
+        out = out.astype(jnp.float32)
     if op == ReduceOp.AVERAGE:
         n = _axis_size(AXIS_LOCAL) * _axis_size(AXIS_CROSS)
         out = out / jnp.asarray(n, dtype=out.dtype)
-    return out.astype(dtype)
+    return _scale(out, postscale_factor).astype(dtype)
 
 
 def grouped_hierarchical_allreduce(tensors, op: int = ReduceOp.SUM,
                                    prescale_factor: float = 1.0,
                                    postscale_factor: float = 1.0,
-                                   bucket_cap_bytes=None):
+                                   bucket_cap_bytes=None, compression=None):
     """Fused hierarchical allreduce (dtype-concat fusion like
     ``grouped_allreduce``, ICI/DCN split like ``hierarchical_allreduce``).
     Supports SUM/AVERAGE (``psum_scatter``-expressible) and ADASUM — the
@@ -250,12 +338,18 @@ def grouped_hierarchical_allreduce(tensors, op: int = ReduceOp.SUM,
         raise ValueError(
             f"hierarchical allreduce supports SUM/AVERAGE/ADASUM, got op {op}")
 
-    def reduce_fn(fused):
-        fused = _apply_prescale(fused, prescale_factor)
-        return _apply_postscale(hierarchical_allreduce(fused, op=op),
-                                postscale_factor)
+    comp = _resolve_compression(compression)
 
-    return _grouped(tensors, reduce_fn, bucket_cap_bytes=cap)
+    def reduce_fn(fused):
+        # Pre/postscale ride into the ladder's accumulation window
+        # (fp32/wire math there) instead of rounding at the input dtype.
+        return hierarchical_allreduce(fused, op=op,
+                                      prescale_factor=prescale_factor,
+                                      postscale_factor=postscale_factor,
+                                      compression=comp)
+
+    return _grouped(tensors, reduce_fn, bucket_cap_bytes=cap,
+                    compression=comp)
 
 
 def allgather(tensor, axis_name: str = AXIS_GLOBAL):

@@ -1,65 +1,26 @@
 """Gradient compression for TF tensors (parity:
 ``horovod/tensorflow/compression.py``).
 
-bfloat16 is added as the TPU-native wire format (fp32 exponent range, no
-loss-scaling needed); fp16 is kept for reference-script compatibility.
+Thin binding over the tree-wide compressor implementation
+(``horovod_tpu/common/compression.py``): this module only supplies the
+TF cast primitives; the compress/decompress logic — and the wire format
+policy (fp16 for reference-script compatibility, bfloat16 as the
+TPU-native extension with fp32's exponent range) — lives in one place.
 """
 
 import tensorflow as tf
 
+from ..common.compression import make_framework_compression
 
-class Compressor:
-    """Interface: ``compress(tensor) -> (tensor, ctx)``,
-    ``decompress(tensor, ctx) -> tensor``."""
+_WIRE = {"float16": tf.float16, "bfloat16": tf.bfloat16}
 
-    @staticmethod
-    def compress(tensor):
-        raise NotImplementedError
+Compression = make_framework_compression(
+    cast=lambda tensor, dtype: tf.cast(tensor, _WIRE.get(dtype, dtype)),
+    is_floating=lambda tensor: tensor.dtype.is_floating,
+)
 
-    @staticmethod
-    def decompress(tensor, ctx):
-        raise NotImplementedError
-
-
-class NoneCompressor(Compressor):
-    @staticmethod
-    def compress(tensor):
-        return tensor, None
-
-    @staticmethod
-    def decompress(tensor, ctx):
-        return tensor
-
-
-class FP16Compressor(Compressor):
-    @staticmethod
-    def compress(tensor):
-        if tensor.dtype.is_floating:
-            return tf.cast(tensor, tf.float16), tensor.dtype
-        return tensor, None
-
-    @staticmethod
-    def decompress(tensor, ctx):
-        return tf.cast(tensor, ctx) if ctx is not None else tensor
-
-
-class BF16Compressor(Compressor):
-    """TPU-native extension: bfloat16 wire format."""
-
-    @staticmethod
-    def compress(tensor):
-        if tensor.dtype.is_floating:
-            return tf.cast(tensor, tf.bfloat16), tensor.dtype
-        return tensor, None
-
-    @staticmethod
-    def decompress(tensor, ctx):
-        return tf.cast(tensor, ctx) if ctx is not None else tensor
-
-
-class Compression:
-    """Option enum (parity: ``Compression.none`` / ``Compression.fp16``)."""
-
-    none = NoneCompressor
-    fp16 = FP16Compressor
-    bf16 = BF16Compressor
+# Reference-compatible module-level names.
+Compressor = Compression.Compressor
+NoneCompressor = Compression.none
+FP16Compressor = Compression.fp16
+BF16Compressor = Compression.bf16

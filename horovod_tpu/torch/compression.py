@@ -1,66 +1,25 @@
 """Gradient compression (parity: ``horovod/torch/compression.py:46``).
 
-On TPU the natural wire format is bfloat16 (MXU-native); fp16 is kept for
-reference-script compatibility.
+Thin binding over the tree-wide compressor implementation
+(``horovod_tpu/common/compression.py``): this module only supplies the
+torch cast primitives; the compress/decompress logic — and the wire
+format policy (fp16 for reference-script compatibility, bfloat16 as the
+MXU-native TPU extension) — lives in one place.
 """
 
 import torch
 
+from ..common.compression import make_framework_compression
 
-class Compressor:
-    """Interface: ``compress(tensor) -> (tensor, ctx)``,
-    ``decompress(tensor, ctx) -> tensor``."""
+_WIRE = {"float16": torch.float16, "bfloat16": torch.bfloat16}
 
-    @staticmethod
-    def compress(tensor):
-        raise NotImplementedError
+Compression = make_framework_compression(
+    cast=lambda tensor, dtype: tensor.type(_WIRE.get(dtype, dtype)),
+    is_floating=lambda tensor: tensor.dtype.is_floating_point,
+)
 
-    @staticmethod
-    def decompress(tensor, ctx):
-        raise NotImplementedError
-
-
-class NoneCompressor(Compressor):
-    @staticmethod
-    def compress(tensor):
-        return tensor, None
-
-    @staticmethod
-    def decompress(tensor, ctx):
-        return tensor
-
-
-class FP16Compressor(Compressor):
-    @staticmethod
-    def compress(tensor):
-        if tensor.dtype.is_floating_point:
-            return tensor.type(torch.float16), tensor.dtype
-        return tensor, None
-
-    @staticmethod
-    def decompress(tensor, ctx):
-        return tensor.type(ctx) if ctx is not None else tensor
-
-
-class BF16Compressor(Compressor):
-    """TPU-native extension: bfloat16 wire format (same exponent range as
-    fp32, no overflow scaling needed)."""
-
-    @staticmethod
-    def compress(tensor):
-        if tensor.dtype.is_floating_point:
-            return tensor.type(torch.bfloat16), tensor.dtype
-        return tensor, None
-
-    @staticmethod
-    def decompress(tensor, ctx):
-        return tensor.type(ctx) if ctx is not None else tensor
-
-
-class Compression:
-    """Option enum (parity: reference ``Compression.none`` /
-    ``Compression.fp16``)."""
-
-    none = NoneCompressor
-    fp16 = FP16Compressor
-    bf16 = BF16Compressor
+# Reference-compatible module-level names.
+Compressor = Compression.Compressor
+NoneCompressor = Compression.none
+FP16Compressor = Compression.fp16
+BF16Compressor = Compression.bf16

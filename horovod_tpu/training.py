@@ -41,7 +41,8 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
                     mesh, axis_name: str = AXIS_GLOBAL,
                     reduce_op: Optional[int] = None,
                     donate: bool = True,
-                    bucket_cap_bytes="auto"):
+                    bucket_cap_bytes="auto",
+                    compression="auto"):
     """Build a jitted SPMD train step over ``mesh``.
 
     Params/optimizer state are replicated; the batch is sharded along
@@ -53,12 +54,20 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
     that byte cap in backward order so communication overlaps backprop;
     ``"auto"`` (default) follows ``HOROVOD_FUSION_THRESHOLD`` and stays
     monolithic when that knob was never set; ``None`` forces monolithic.
+
+    ``compression`` is the on-wire gradient format (see
+    ``DistributedOptimizer``; docs/compression.md): ``"auto"`` (default)
+    follows ``HOROVOD_COMPRESSION`` and stays uncompressed — programs
+    byte-identical — when that knob was never set. ``"ef16"`` keeps
+    error-feedback residuals in the optimizer state: build the state
+    with the same mode (``init_train_state(..., compression=...)``).
     """
     from .ops.xla import ReduceOp
 
     op = ReduceOp.AVERAGE if reduce_op is None else reduce_op
     dist_opt = DistributedOptimizer(optimizer, op=op, axis_name=axis_name,
-                                    bucket_cap_bytes=bucket_cap_bytes)
+                                    bucket_cap_bytes=bucket_cap_bytes,
+                                    compression=compression)
 
     def step_fn(state: TrainState, images, labels):
         def loss_fn(p):
@@ -99,11 +108,17 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
     return jitted
 
 
-def init_train_state(model, optimizer, rng, sample_input) -> TrainState:
+def init_train_state(model, optimizer, rng, sample_input,
+                     compression="auto") -> TrainState:
+    """``compression`` must match the step's (``make_train_step``): the
+    error-feedback mode ("ef16") adds fp32 residuals to the optimizer
+    state, so init and step have to agree on the state pytree. Both
+    default to "auto" (the ``HOROVOD_COMPRESSION`` env), which agrees by
+    construction."""
     variables = model.init(rng, sample_input, train=False)
     params = variables["params"]
     batch_stats = variables.get("batch_stats")
-    dist_opt = DistributedOptimizer(optimizer)
+    dist_opt = DistributedOptimizer(optimizer, compression=compression)
     opt_state = dist_opt.init(params)
     return TrainState(params, opt_state, batch_stats,
                       jnp.zeros((), dtype=jnp.int32))

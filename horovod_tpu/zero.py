@@ -61,6 +61,16 @@ class ZeroTrainState(NamedTuple):
     # padded size alone cannot detect such drift when leaf sizes align
     # with the mesh (zero per-bucket padding).
     bucket_cap: Any = None
+    # Error-feedback residuals for the compressed reduce-scatter
+    # ("ef16"), sharded like gaccum (1/d per device, fp32, padded flat
+    # layout). Each device keeps the quantization error of ITS OWN
+    # contribution to ITS OWN output shard and re-injects it there next
+    # step — the sharded-residual scheme (full per-rank residuals would
+    # cost a persistent fp32 gradient copy per device, forfeiting ZeRO's
+    # memory scaling; see docs/compression.md). None when the state was
+    # built without error feedback; like bucket_cap, the state owns it —
+    # a step resolving a different mode is rejected.
+    residual: Any = None
 
 
 def _shard_len(total: int, d: int) -> int:
@@ -166,7 +176,8 @@ def init_zero_train_state(model, optimizer: optax.GradientTransformation,
                           rng, sample_input, mesh,
                           axis_name: str = AXIS_GLOBAL,
                           accumulate_steps: int = 1,
-                          bucket_cap_bytes="auto") -> ZeroTrainState:
+                          bucket_cap_bytes="auto",
+                          compression="auto") -> ZeroTrainState:
     """Initialize params (replicated) + the sharded fp32 master weights
     and optimizer state.
 
@@ -180,7 +191,14 @@ def init_zero_train_state(model, optimizer: optax.GradientTransformation,
     and is recorded IN the state (``bucket_cap``); the step built by
     ``make_zero_train_step`` reads it from there, so an "auto"-resolved
     cap cannot drift between init and step even if the autotuner
-    publishes a new threshold in between."""
+    publishes a new threshold in between.
+
+    ``compression`` (on-wire gradient format, ``common/compression.py``)
+    only shapes the state through its error-feedback variant: "ef16"
+    adds a sharded fp32 residual (``ZeroTrainState.residual``); fp16 and
+    bf16 are stateless wire casts, so their states are identical to the
+    uncompressed one. "auto" (default) follows ``HOROVOD_COMPRESSION``."""
+    from .common.compression import resolve_compression
     from .common.fusion import resolve_bucket_cap
 
     variables = model.init(rng, sample_input, train=False)
@@ -221,26 +239,35 @@ def init_zero_train_state(model, optimizer: optax.GradientTransformation,
     if batch_stats is not None:
         batch_stats = jax.device_put(batch_stats, replicated)
     pshard, opt_shard = sharded_init(params)
-    gaccum = None
-    if accumulate_steps > 1:
+
+    def _born_sharded_zeros():
         # Born sharded, like pshard/opt_shard: materializing the full
         # padded fp32 buffer on one device first would break the "no full
         # fp32 copy on any one device" invariant exactly when it matters.
-        gaccum = jax.jit(
+        return jax.jit(
             lambda: jnp.zeros((plan.padded,), jnp.float32),
             out_shardings=NamedSharding(mesh, P(axis_name)))()
+
+    gaccum = None
+    if accumulate_steps > 1:
+        gaccum = _born_sharded_zeros()
+    comp = resolve_compression(compression)
+    residual = None
+    if comp is not None and comp.error_feedback:
+        residual = _born_sharded_zeros()
     return ZeroTrainState(params, pshard, opt_shard, gaccum, batch_stats,
                           jax.device_put(jnp.zeros((), jnp.int32),
                                          replicated),
                           jax.device_put(
                               jnp.asarray(-1 if cap is None else cap,
-                                          jnp.int32), replicated))
+                                          jnp.int32), replicated),
+                          residual)
 
 
 def make_zero_train_step(model, optimizer: optax.GradientTransformation,
                          mesh, axis_name: str = AXIS_GLOBAL,
                          donate: bool = True, accumulate_steps: int = 1,
-                         bucket_cap_bytes="auto"):
+                         bucket_cap_bytes="auto", compression="auto"):
     """Build the jitted SPMD train step with ZeRO-1 optimizer sharding.
 
     Drop-in alternative to ``training.make_train_step`` (same call
@@ -257,7 +284,20 @@ def make_zero_train_step(model, optimizer: optax.GradientTransformation,
     DistributedOptimizer accumulation), not summed as the reference's
     hook accumulation effectively does — multiply the learning rate by k
     when porting a reference config that relied on summed accumulation.
-    Requires a state built with the same ``accumulate_steps``."""
+    Requires a state built with the same ``accumulate_steps``.
+
+    ``compression`` compresses the reduce-scatter leg: with fp16/bf16
+    the scatter payload travels at the 16-bit wire dtype (half the
+    scatter bytes of the fp32 wire) and the reduced shard is upcast to
+    fp32 before the ``/d`` averaging and the optimizer update; the
+    gather leg already runs at the model dtype and is unchanged. "ef16"
+    additionally keeps a sharded fp32 residual in the state (see
+    ``ZeroTrainState.residual``) — states with/without residuals are not
+    interchangeable, and like the bucket cap, a mismatched state/step
+    pair is rejected. "auto" (default) follows ``HOROVOD_COMPRESSION``
+    and, for error feedback, the state: a state carrying residuals gets
+    the ef16 step."""
+    from .common.compression import Compression, resolve_compression
     from .common.fusion import resolve_bucket_cap
     from .training import cross_entropy_loss
 
@@ -269,8 +309,12 @@ def make_zero_train_step(model, optimizer: optax.GradientTransformation,
     # follows whatever the state was built under.
     _auto = isinstance(bucket_cap_bytes, str) and bucket_cap_bytes == "auto"
     _requested_cap = None if _auto else resolve_bucket_cap(bucket_cap_bytes)
+    _auto_comp = isinstance(compression, str) and compression == "auto"
+    _requested_comp = None if _auto_comp else resolve_compression(compression)
 
-    def _build_step_fn(cap):
+    def _build_step_fn(cap, comp):
+        wire = comp.wire_dtype(jnp.float32) if comp is not None else None
+        ef = comp is not None and comp.error_feedback
         def step_fn(state: ZeroTrainState, images, labels):
             plan = _make_plan(state.params, d, cap)
             dtypes = plan.dtypes
@@ -300,11 +344,40 @@ def make_zero_train_step(model, optimizer: optax.GradientTransformation,
             # bucket k's psum_scatter depends only on bucket k's gradients —
             # produced *early* in backprop (reverse parameter order) — so XLA
             # overlaps the shard exchange with the rest of the backward pass.
+            # With compression the scatter payload is cast to the 16-bit
+            # wire dtype (that halving is the on-wire saving; the flats
+            # are fp32 by construction, so one wire dtype covers every
+            # bucket) and the reduced shard upcast to fp32 before the /d
+            # averaging — fp32 accumulation on the reduced value.
             gleaves = jax.tree_util.tree_leaves(grads)
-            segs = [lax.psum_scatter(_bucket_flat_f32(gleaves, plan, j),
-                                     axis_name, tiled=True) / d
-                    for j in range(len(plan.buckets))]
+            idx = lax.axis_index(axis_name) if ef else None
+            segs = []
+            res_segs = []
+            off = 0
+            for j in range(len(plan.buckets)):
+                flat = _bucket_flat_f32(gleaves, plan, j)
+                slen = plan.bucket_padded[j] // d
+                if ef:
+                    # Sharded error feedback: this device's residual
+                    # covers its own contribution to its own output
+                    # shard — add it back into that segment before
+                    # quantizing (ZeroTrainState.residual docstring).
+                    my = (lax.dynamic_slice(flat, (idx * slen,), (slen,))
+                          + lax.slice_in_dim(state.residual, off, off + slen))
+                    flat = lax.dynamic_update_slice(flat, my, (idx * slen,))
+                payload = flat.astype(wire) if wire is not None else flat
+                seg = lax.psum_scatter(payload, axis_name, tiled=True)
+                if wire is not None:
+                    seg = seg.astype(jnp.float32)
+                segs.append(seg / d)
+                if ef:
+                    sent = lax.dynamic_slice(payload, (idx * slen,), (slen,))
+                    res_segs.append(my - sent.astype(jnp.float32))
+                off += slen
             gshard = jnp.concatenate(segs) if len(segs) > 1 else segs[0]
+            new_residual = ((jnp.concatenate(res_segs)
+                             if len(res_segs) > 1 else res_segs[0])
+                            if ef else state.residual)
 
             def apply_update(gshard, opt_shard, pshard):
                 updates, new_opt = optimizer.update(gshard, opt_shard, pshard)
@@ -346,7 +419,8 @@ def make_zero_train_step(model, optimizer: optax.GradientTransformation,
                     lambda x: lax.pmean(x, axis_name), new_stats)
             loss = lax.pmean(loss, axis_name)
             return ZeroTrainState(new_params, new_pshard, new_opt, new_gaccum,
-                                  new_stats, step, state.bucket_cap), loss
+                                  new_stats, step, state.bucket_cap,
+                                  new_residual), loss
 
         return step_fn
 
@@ -380,6 +454,35 @@ def make_zero_train_step(model, optimizer: optax.GradientTransformation,
                 "it in jax.jit (the compiled programs are exposed on "
                 "step.cache for lowering/inspection)") from None
         cap = None if cap_raw < 0 else cap_raw
+        # Compression follows the same state-owns-it discipline as the
+        # cap: the residual's presence IS the error-feedback stamp
+        # (ef16 is the only residual-carrying mode), so an "auto" step
+        # adopts it; an explicit argument must agree with the state.
+        if _auto_comp:
+            comp = (Compression.ef16 if state.residual is not None
+                    else resolve_compression("auto"))
+            if (comp is not None and comp.error_feedback
+                    and state.residual is None):
+                raise ValueError(
+                    "HOROVOD_COMPRESSION resolves to error feedback "
+                    "(ef16) but this ZeroTrainState carries no residual "
+                    "— it was built without it. Rebuild the state with "
+                    "init_zero_train_state(..., compression='ef16') (or "
+                    "under the same env) so the residual is born "
+                    "sharded.")
+        else:
+            comp = _requested_comp
+            ef_req = comp is not None and comp.error_feedback
+            if ef_req != (state.residual is not None):
+                mode = comp.name if comp is not None else "none"
+                has = ("carries" if state.residual is not None
+                       else "has no")
+                raise ValueError(
+                    f"state/step compression mismatch: the state {has} "
+                    f"error-feedback residuals but make_zero_train_step "
+                    f"was given compression={mode!r}. Rebuild the state "
+                    f"with init_zero_train_state(..., "
+                    f"compression={mode!r}) or pass the state's mode.")
         if not _auto and _requested_cap != cap:
             raise ValueError(
                 f"state/step bucket cap mismatch: the state's shard "
@@ -412,20 +515,32 @@ def make_zero_train_step(model, optimizer: optax.GradientTransformation,
                 f"state with init_zero_train_state(...) using the same "
                 f"model and bucket_cap_bytes as this step instead of "
                 f"reusing the old one.")
+        if state.residual is not None:
+            actual_res = int(np.prod(state.residual.shape))
+            if actual_res != expected_padded:
+                raise ValueError(
+                    f"ZeroTrainState residual was built for a different "
+                    f"layout: expected {expected_padded} elements under "
+                    f"bucket_cap_bytes={cap}, got {actual_res}. Rebuild "
+                    f"the state with init_zero_train_state(...).")
         key = (plan.treedef, plan.shapes,
                tuple(str(dt) for dt in plan.dtypes),
-               state.gaccum is None, cap)
+               state.gaccum is None, cap,
+               comp.name if comp is not None else None)
         if key not in cache:
             opt_specs = _opt_state_specs(optimizer, plan.shard_len,
                                          axis_name)
             gaccum_spec = P() if state.gaccum is None else P(axis_name)
+            residual_spec = (None if state.residual is None
+                             else P(axis_name))
             # bucket_cap is None here: the cap array travels outside the
             # compiled program (re-attached below), so the device never
             # copies it and the host fetch above stays non-blocking.
             state_specs = ZeroTrainState(P(), P(axis_name), opt_specs,
-                                         gaccum_spec, P(), P(), None)
+                                         gaccum_spec, P(), P(), None,
+                                         residual_spec)
             sharded = _shard_map(
-                _build_step_fn(cap), mesh,
+                _build_step_fn(cap, comp), mesh,
                 in_specs=(state_specs, P(axis_name), P(axis_name)),
                 out_specs=(state_specs, P()),
                 check_vma=False)

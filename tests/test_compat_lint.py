@@ -1,0 +1,50 @@
+"""Tier-1 guard for jax-0.4.37 compatibility: no raw new-jax API
+spellings outside ``common/compat.py``.
+
+The installed jax predates the modern API (``jax.shard_map``,
+``lax.axis_size``, ``jax.distributed.is_initialized``,
+``jax_num_cpu_devices``, pallas ``CompilerParams``); the tree routes
+every use through ``horovod_tpu/common/compat.py``. A raw spelling
+imports cleanly, passes review, and then fails at call time on this
+image — so the lint (``tools/lint_compat.sh``) runs in tier-1 and fails
+fast with the offending lines.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "tools", "lint_compat.sh")
+
+
+def test_no_raw_new_jax_apis_outside_compat():
+    r = subprocess.run(["bash", SCRIPT], capture_output=True, text=True,
+                       timeout=120)
+    assert r.returncode == 0, (
+        "raw new-jax API spellings found (route them through "
+        "horovod_tpu/common/compat.py):\n" + r.stdout + r.stderr)
+
+
+def test_lint_catches_a_violation(tmp_path):
+    """The lint actually bites: a synthetic violation planted in a
+    throwaway copy of the package dir is reported nonzero. (Copying the
+    whole repo is overkill — plant into a scratch tree that mirrors the
+    layout the script greps.)"""
+    import shutil
+
+    scratch = tmp_path / "repo"
+    (scratch / "tools").mkdir(parents=True)
+    pkg = scratch / "horovod_tpu"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(
+        "import jax\n"
+        "f = jax.shard_map(lambda x: x)\n")
+    common = pkg / "common"
+    common.mkdir()
+    (common / "compat.py").write_text("# the allowed home\n")
+    shutil.copy(SCRIPT, scratch / "tools" / "lint_compat.sh")
+    r = subprocess.run(["bash", str(scratch / "tools" / "lint_compat.sh")],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "bad.py" in r.stdout

@@ -193,6 +193,80 @@ def test_bucketed_low_precision_accumulates_in_fp32(hvd, np_dtype):
     np.testing.assert_array_equal(np.asarray(out_b[0])[0], oracle)
 
 
+# ---- compressed allreduce matrix (input dtype x compression mode) ----------
+#
+# The on-wire compression contract (common/compression.py) through the
+# bucketed path: float inputs reduce in the compressed wire dtype with
+# fp32 accumulation on the reduced value; integer inputs pass through
+# untouched. Small-int values are exact in every dtype here (f16
+# integers <= 2048, bf16 <= 256), so results must EQUAL the fp64 oracle
+# — compression changes the wire, not these numerics.
+
+
+def _grouped_comp_prog(mesh, n_tensors, op, cap, compression):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.ops import xla as hvd_xla
+
+    def fn(*tensors):
+        out = hvd_xla.grouped_allreduce(
+            [t[0] for t in tensors], axis_name="hvd", op=op,
+            bucket_cap_bytes=cap, compression=compression)
+        return tuple(o[None] for o in out)
+
+    return jax.jit(jax.shard_map(
+        fn, mesh=mesh, in_specs=(P("hvd"),) * n_tensors,
+        out_specs=(P("hvd"),) * n_tensors, check_vma=False))
+
+
+@pytest.mark.parametrize("compression", [None, "fp16", "bf16", "ef16"])
+@pytest.mark.parametrize("np_dtype", [np.float32, "bfloat16", np.float16])
+def test_compressed_allreduce_matrix(hvd, np_dtype, compression):
+    import jax.numpy as jnp
+
+    from horovod_tpu.ops.xla import ReduceOp
+
+    mesh = hvd.mesh()
+    n = hvd.size()
+    dtype = jnp.bfloat16 if np_dtype == "bfloat16" else np_dtype
+    rng = np.random.RandomState(11)
+    vals = rng.randint(0, 4, size=(n, 5, 7)).astype(np.float64)
+    stacked = jnp.asarray(vals).astype(dtype)
+    tensors = [stacked * (i + 1) for i in range(2)]
+
+    prog = _grouped_comp_prog(mesh, 2, ReduceOp.SUM, TINY_CAP, compression)
+    out = prog(*tensors)
+    for i, o in enumerate(out):
+        assert o.dtype == dtype  # compression never changes the API dtype
+        expect = (vals * (i + 1)).sum(axis=0)
+        for row in np.asarray(o.astype(jnp.float64)):
+            np.testing.assert_array_equal(row, expect)
+
+
+@pytest.mark.parametrize("compression", ["fp16", "bf16", "ef16"])
+def test_compressed_allreduce_int_passthrough(hvd, compression):
+    """Integer tensors are not floats: compression leaves them on the
+    exact integer wire, mixed into the same grouped call."""
+    import jax.numpy as jnp
+
+    from horovod_tpu.ops.xla import ReduceOp
+
+    mesh = hvd.mesh()
+    n = hvd.size()
+    rng = np.random.RandomState(13)
+    ints = jnp.asarray(rng.randint(-50, 50, size=(n, 9)), jnp.int32)
+    floats = jnp.asarray(rng.randint(0, 4, size=(n, 9)), jnp.float32)
+
+    prog = _grouped_comp_prog(mesh, 2, ReduceOp.SUM, TINY_CAP, compression)
+    oi, of = prog(ints, floats)
+    assert oi.dtype == jnp.int32 and of.dtype == jnp.float32
+    np.testing.assert_array_equal(
+        np.asarray(oi)[0], np.asarray(ints, np.int64).sum(axis=0))
+    np.testing.assert_array_equal(
+        np.asarray(of)[0], np.asarray(floats, np.float64).sum(axis=0))
+
+
 def test_bucketed_mixed_dtype_pytree(hvd):
     """A mixed-dtype gradient pytree forces dtype-pure buckets; results
     keep each leaf's dtype and match the monolithic path bitwise."""

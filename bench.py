@@ -262,6 +262,14 @@ def _build_parser():
                              "that is unset too. The effective mode and "
                              "wire bytes/step are recorded in the "
                              "emitted JSON either way")
+    parser.add_argument("--fault-spec", default=None,
+                        help="HOROVOD_FAULT_SPEC for the benched worker "
+                             "(docs/fault-injection.md): chaos-bench the "
+                             "recovery overhead, e.g. "
+                             "'ring.exec:kind=delay_ms:ms=5'. The spec "
+                             "is recorded in the emitted JSON so a "
+                             "fault-injected number can never be "
+                             "mistaken for a clean one")
     parser.add_argument("--no-fallback", action="store_true",
                         help="exit nonzero instead of running the CPU "
                              "fallback when the accelerator is "
@@ -316,7 +324,10 @@ def supervise(argv):
             worker_args += ["--bucket-mb", str(args.bucket_mb)]
         if args.compression is not None:
             worker_args += ["--compression", args.compression]
-        result = _run_worker(worker_args, dict(os.environ), WORKER_TIMEOUT_S)
+        worker_env = dict(os.environ)
+        if args.fault_spec:
+            worker_env["HOROVOD_FAULT_SPEC"] = args.fault_spec
+        result = _run_worker(worker_args, worker_env, WORKER_TIMEOUT_S)
         if result is not None:
             result["platform"] = platform
             result["comparable"] = True
@@ -346,6 +357,8 @@ def supervise(argv):
                 "fence_each": bool(args.fence_each),
                 "num_iters": args.num_iters,
             }
+            if args.fault_spec:
+                result["fault_spec"] = args.fault_spec
             _save_capture(result)
             print(json.dumps(result))
             return 0
@@ -393,10 +406,14 @@ def supervise(argv):
         fallback_args += ["--bucket-mb", str(args.bucket_mb)]
     if args.compression is not None:
         fallback_args += ["--compression", args.compression]
+    if args.fault_spec:
+        env["HOROVOD_FAULT_SPEC"] = args.fault_spec
     result = _run_worker(fallback_args, env, CPU_FALLBACK_TIMEOUT_S)
     if result is not None:
         result["platform"] = "cpu-fallback"
         result["comparable"] = False
+        if args.fault_spec:
+            result["fault_spec"] = args.fault_spec
         # fail_reason keeps the probe-failed vs worker-wedged distinction
         # (the compute probe exists precisely to tell those apart).
         result["note"] = (fail_reason + "; this is the bounded CPU "

@@ -153,6 +153,39 @@ def barrier():
     _engine().barrier()
 
 
+def stall_report() -> str:
+    """Drain and return the native stall inspector's accumulated warnings
+    (reference ``stall_inspector.cc``: the coordinator reports tensors
+    some ranks submitted and others never did — the classic desync
+    signature). Empty string when nothing stalled, when ``hvd.init()``
+    hasn't run, or when the native core is absent (pure-XLA direct mode).
+
+    Consuming a non-empty report also records a ``STALL_WARNING`` instant
+    in the timeline (when one is active), so stalls line up with the
+    collectives that caused them in post-mortems."""
+    core = None
+    st = _global_state()
+    if st.initialized and st.engine is not None:
+        core = getattr(st.engine, "native_core", None)
+    if core is None:
+        # The host (process-rank) plane may own the core instead — e.g.
+        # torch/tensorflow bindings without a live XLA engine.
+        from .common import host_world as _host_world
+
+        world = _host_world.world()
+        if world.initialized:
+            core = world._core
+    if core is None:
+        return ""
+    report = core.stall_report()
+    if report and st.initialized and st.timeline is not None:
+        from .common import timeline as _timeline_mod
+
+        st.timeline.instant(_timeline_mod.STALL_WARNING,
+                            {"report": report})
+    return report
+
+
 def join() -> int:
     """Graceful departure (parity: ``hvd.join()``, ``operations.cc:937-961``).
 

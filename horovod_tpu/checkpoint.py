@@ -30,6 +30,8 @@ from typing import Any, Optional
 
 import orbax.checkpoint as ocp
 
+from .common import faults as _faults
+
 
 class CheckpointManager:
     """Thin wrapper over ``orbax.checkpoint.CheckpointManager`` with the
@@ -51,6 +53,9 @@ class CheckpointManager:
         devices. With ``wait=False`` the write completes in the
         background; call ``wait_until_finished()`` (or the next save)
         before depending on it."""
+        # Chaos seam: prove recovery paths against a checkpoint write
+        # that dies / stalls / drops mid-flight (docs/fault-injection.md).
+        _faults.point("checkpoint.write")
         self._mgr.save(step, args=ocp.args.StandardSave(state))
         if wait:
             self._mgr.wait_until_finished()

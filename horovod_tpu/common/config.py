@@ -50,6 +50,14 @@ HOROVOD_HOST_VIA_XLA = "HOROVOD_HOST_VIA_XLA"
 HOROVOD_HOST_VIA_XLA_THRESHOLD = "HOROVOD_HOST_VIA_XLA_THRESHOLD"
 DEFAULT_HOST_VIA_XLA_THRESHOLD = 1 << 20  # 1 MiB fused response
 HOROVOD_ELASTIC_REJOIN_GRACE = "HOROVOD_ELASTIC_REJOIN_GRACE"
+# Fault injection + retry/backoff + blacklist (common/faults.py;
+# docs/fault-injection.md)
+HOROVOD_FAULT_SPEC = "HOROVOD_FAULT_SPEC"
+HOROVOD_RETRY_PREFIX = "HOROVOD_RETRY"
+HOROVOD_ELASTIC_BLACKLIST_STRIKES = "HOROVOD_ELASTIC_BLACKLIST_STRIKES"
+HOROVOD_ELASTIC_PAROLE_WINDOW = "HOROVOD_ELASTIC_PAROLE_WINDOW"
+DEFAULT_BLACKLIST_STRIKES = 3
+DEFAULT_PAROLE_WINDOW_SECONDS = 300.0
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # reference operations.cc:423
 DEFAULT_CYCLE_TIME_MS = 5.0  # reference operations.cc:431
@@ -132,6 +140,163 @@ def _get_float(name: str, default: float) -> float:
         return float(v) if v is not None else default
     except ValueError:
         return default
+
+
+# ---- fault injection (common/faults.py; docs/fault-injection.md) ----------
+#
+# HOROVOD_FAULT_SPEC grammar:  spec(;spec)*
+#   spec  = point(:key=value)*
+#   point = dotted fault-point name, e.g. "ring.exec" (see faults.CATALOG)
+#   keys  = rank  (int; only this rank fires — default: every rank)
+#           step  (int; fire on the Nth hit of the point in this process,
+#                  0-based — default: every hit)
+#           kind  (raise | delay_ms | exit | drop_conn — default: raise)
+#           ms    (float; delay for kind=delay_ms — default 100)
+#           code  (int; exit status for kind=exit — default 13)
+#           times (int; max fires — default 1 when step given, else
+#                  unlimited)
+#
+# e.g. HOROVOD_FAULT_SPEC="ring.exec:rank=1:step=3:kind=exit"
+# Parsing is strict: a malformed spec raises instead of silently injecting
+# nothing — a chaos test whose fault never fires "passes" vacuously.
+
+FAULT_KINDS = ("raise", "delay_ms", "exit", "drop_conn")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    point: str
+    rank: int = -1          # -1 = any rank
+    step: int = -1          # -1 = every hit
+    kind: str = "raise"
+    ms: float = 100.0
+    code: int = 13
+    times: int = 0          # 0 = unlimited
+
+
+def parse_fault_spec(text: str) -> tuple:
+    """Parse a ``HOROVOD_FAULT_SPEC`` string into ``FaultSpec`` tuples.
+
+    Raises ``ValueError`` on any malformed field (loud-by-design, see
+    grammar comment above)."""
+    specs = []
+    for chunk in text.split(";"):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        fields = chunk.split(":")
+        point = fields[0].strip()
+        if not point:
+            raise ValueError(f"fault spec {chunk!r}: empty point name")
+        kw = {"point": point}
+        for field in fields[1:]:
+            if "=" not in field:
+                raise ValueError(
+                    f"fault spec {chunk!r}: field {field!r} is not "
+                    f"key=value")
+            key, _, val = field.partition("=")
+            key = key.strip()
+            val = val.strip()
+            try:
+                if key in ("rank", "step", "code", "times"):
+                    kw[key] = int(val)
+                elif key == "ms":
+                    kw[key] = float(val)
+                elif key == "kind":
+                    if val not in FAULT_KINDS:
+                        raise ValueError(
+                            f"unknown kind {val!r} (choices: "
+                            f"{', '.join(FAULT_KINDS)})")
+                    kw[key] = val
+                else:
+                    raise ValueError(f"unknown key {key!r}")
+            except ValueError as e:
+                raise ValueError(f"fault spec {chunk!r}: {e}") from None
+        if "times" not in kw and kw.get("step", -1) >= 0:
+            kw["times"] = 1  # a step-pinned fault is one-shot by default
+        specs.append(FaultSpec(**kw))
+    return tuple(specs)
+
+
+def parse_fault_spec_env() -> tuple:
+    """The active fault specs from ``HOROVOD_FAULT_SPEC`` (empty tuple
+    when unset — the zero-cost-disabled case)."""
+    text = os.environ.get(HOROVOD_FAULT_SPEC)
+    return parse_fault_spec(text) if text else ()
+
+
+# ---- shared retry/backoff policy (common/faults.py Retrier) ----------------
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff with full jitter + an overall deadline.
+
+    ``max_attempts=0`` means unlimited (bounded by ``deadline``);
+    ``deadline=0`` means no overall deadline (bounded by attempts)."""
+
+    max_attempts: int = 3
+    base_delay: float = 0.5
+    max_delay: float = 15.0
+    multiplier: float = 2.0
+    deadline: float = 0.0
+    jitter: bool = True
+
+
+_RETRY_FIELD_ENV = {
+    "max_attempts": ("MAX_ATTEMPTS", int),
+    "base_delay": ("BASE_DELAY", float),
+    "max_delay": ("MAX_DELAY", float),
+    "multiplier": ("MULTIPLIER", float),
+    "deadline": ("DEADLINE", float),
+}
+
+
+def retry_policy_from_env(scope: str = "", pinned=(),
+                          **defaults) -> RetryPolicy:
+    """Build a ``RetryPolicy`` with env precedence per field:
+
+        HOROVOD_RETRY_<SCOPE>_<FIELD>  >  HOROVOD_RETRY_<FIELD>  >  defaults
+
+    ``scope`` names the call site ("KV", "RENDEZVOUS", "DRIVER", ...);
+    the scoped spelling lets operators tune one seam without loosening
+    every other. ``pinned`` names fields the env may NOT override — the
+    values that encode a correctness contract rather than a tuning knob
+    (e.g. the rejoin poll's unlimited attempts, a caller-passed short
+    deadline): a global HOROVOD_RETRY_MAX_ATTEMPTS=3 must bound flaky KV
+    reads without silently truncating the elastic rejoin grace.
+    Unparseable values fall back a level (same tolerance contract as
+    ``_get_int_explicit``)."""
+    kw = dict(defaults)
+    scope = scope.strip().upper().replace(".", "_")
+    for field, (suffix, conv) in _RETRY_FIELD_ENV.items():
+        if field in pinned:
+            continue
+        names = [f"{HOROVOD_RETRY_PREFIX}_{suffix}"]
+        if scope:
+            names.insert(0, f"{HOROVOD_RETRY_PREFIX}_{scope}_{suffix}")
+        for name in names:
+            v = os.environ.get(name)
+            if v is None:
+                continue
+            try:
+                kw[field] = conv(v)
+                break
+            except ValueError:
+                continue
+    return RetryPolicy(**kw)
+
+
+def blacklist_strikes() -> int:
+    """Failures a host absorbs before its blacklist turns permanent."""
+    return max(1, _get_int(HOROVOD_ELASTIC_BLACKLIST_STRIKES,
+                           DEFAULT_BLACKLIST_STRIKES))
+
+
+def parole_window_seconds() -> float:
+    """How long a host returning from blacklist cooldown must run clean
+    before its strike count resets (0 disables strike decay)."""
+    return _get_float(HOROVOD_ELASTIC_PAROLE_WINDOW,
+                      DEFAULT_PAROLE_WINDOW_SECONDS)
 
 
 @dataclasses.dataclass

@@ -1,0 +1,296 @@
+"""Deterministic fault injection + the shared retry/backoff policy.
+
+Two halves of one robustness story (Sergeev & Del Balso, 2018 pair the
+elastic driver with blacklisting + stall inspection; Li et al., 2020 treat
+failure detection and deterministic reproduction as a first-class
+subsystem):
+
+- **Fault points** — named, zero-cost-when-disabled hooks
+  (``faults.point("ring.exec")``) sprinkled through every host-plane seam
+  and activated by a parsed ``HOROVOD_FAULT_SPEC`` env (grammar in
+  ``common/config.py``; catalog below). Firing is deterministic by rank +
+  a per-point hit counter, so a multi-process chaos test that kills rank 1
+  on the 3rd enqueue reproduces exactly, every run.
+
+- **Retrier** — the one retry/backoff implementation for every
+  host-plane network loop (KV reads, rendezvous polls, driver probes):
+  exponential backoff with full jitter, an overall deadline, and an
+  on-retry callback into ``common/logging.py`` + ``timeline.py``.
+  Per-call policies come from ``HOROVOD_RETRY_*`` envs
+  (``config.retry_policy_from_env``). tools/lint_retry.sh enforces that
+  no new bare ``time.sleep(`` retry loop appears outside this module.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from . import config as _config
+from . import logging as _log
+from .exceptions import HorovodInternalError
+
+# Fault-point catalog (docs/fault-injection.md). Extensible — an unknown
+# point in a spec only warns — but these are the wired seams:
+CATALOG = (
+    "host_world.enqueue",    # HostWorld.enqueue, before the native submit
+    "rendezvous.poll",       # elastic slot-layout fetch from the KV
+    "rendezvous.endpoint",   # controller-endpoint poll from the KV
+    "ring.exec",             # blocking wait on a host-ring collective
+    "xla.exec",              # eager engine executing an XLA-plane response
+    "elastic.worker.start",  # driver-side worker launch (slot.rank)
+    "checkpoint.write",      # CheckpointManager.save
+)
+
+# Injectable for tests (fake clock / no real sleeps in tier-1).
+_sleep = time.sleep
+
+
+class FaultInjected(HorovodInternalError):
+    """Raised by ``kind=raise`` faults. A subclass of
+    ``HorovodInternalError`` so the elastic retry loop treats an injected
+    failure exactly like a real collective failure."""
+
+
+_lock = threading.Lock()
+_specs: Tuple[_config.FaultSpec, ...] = ()
+_hits: Dict[str, int] = {}
+_fired: Dict[int, int] = {}  # spec index -> fire count
+_loaded = False
+
+
+def refresh() -> None:
+    """(Re-)read ``HOROVOD_FAULT_SPEC`` and reset all hit/fire counters.
+
+    Called lazily on the first ``point()`` of a process; call explicitly
+    after mutating the env in-process (tests)."""
+    global _specs, _hits, _fired, _loaded
+    with _lock:
+        _specs = _config.parse_fault_spec_env()
+        _hits = {}
+        _fired = {}
+        _loaded = True
+        for spec in _specs:
+            if spec.point not in CATALOG:
+                _log.warning(
+                    f"fault spec names unknown point {spec.point!r} "
+                    f"(catalog: {', '.join(CATALOG)}); it will only fire "
+                    f"if some code calls faults.point({spec.point!r})")
+
+
+def active() -> bool:
+    """True when any fault spec is armed in this process."""
+    if not _loaded:
+        refresh()
+    return bool(_specs)
+
+
+def _default_rank() -> int:
+    try:
+        return int(os.environ.get(_config.HOROVOD_RANK, "0"))
+    except ValueError:
+        return 0
+
+
+def point(name: str, rank: Optional[int] = None) -> None:
+    """A named fault point. No-op (and near-zero cost: one global load +
+    truthiness test) unless ``HOROVOD_FAULT_SPEC`` armed a spec in this
+    process — hit counters only advance while armed, so the disabled
+    behavior is byte-identical to the hook not existing.
+
+    ``rank`` is the caller's rank when it knows it (elastic re-rendezvous
+    moves ranks while the env stays stale); default is ``HOROVOD_RANK``.
+    """
+    if _loaded:
+        if not _specs:
+            return
+    else:
+        refresh()
+        if not _specs:
+            return
+    with _lock:
+        hit = _hits.get(name, 0)
+        _hits[name] = hit + 1
+        if rank is None:
+            rank = _default_rank()
+        to_fire = None
+        for i, spec in enumerate(_specs):
+            if spec.point != name:
+                continue
+            if spec.rank >= 0 and spec.rank != rank:
+                continue
+            if spec.step >= 0 and spec.step != hit:
+                continue
+            if spec.times > 0 and _fired.get(i, 0) >= spec.times:
+                continue
+            _fired[i] = _fired.get(i, 0) + 1
+            to_fire = spec
+            break
+    if to_fire is None:
+        return
+    _fire(to_fire, name, rank, hit)
+
+
+def _fire(spec: _config.FaultSpec, name: str, rank: int, hit: int) -> None:
+    desc = f"fault injected at {name} (rank={rank} hit={hit} " \
+           f"kind={spec.kind})"
+    _log.warning(desc)
+    if spec.kind == "delay_ms":
+        _sleep(spec.ms / 1000.0)
+        return
+    if spec.kind == "exit":
+        # Hard death, as if the process was OOM-killed/preempted: no
+        # atexit, no finally blocks — the chaos being simulated.
+        os._exit(spec.code)
+    if spec.kind == "drop_conn":
+        raise ConnectionResetError(desc)
+    raise FaultInjected(desc)
+
+
+# ---- shared retry/backoff -------------------------------------------------
+
+
+def _timeline_instant(name: str, args: dict) -> None:
+    """Best-effort timeline event for a retry (rank-side only: the
+    launcher has no global state). Imported lazily — faults sits below
+    state in the module graph."""
+    try:
+        from . import state as _state
+
+        st = _state.global_state()
+        timeline = st.timeline if st.initialized else None
+    except Exception:
+        return
+    if timeline is not None:
+        timeline.instant(name, args)
+
+
+def default_on_retry(name: str, attempt: int, delay: float,
+                     err: Optional[BaseException]) -> None:
+    """Log + timeline-record one retry (the Retrier default)."""
+    why = f" ({err})" if err is not None else ""
+    _log.warning(f"{name}: attempt {attempt + 1} failed{why}; "
+                 f"retrying in {delay:.2f}s")
+    from . import timeline as _timeline
+
+    _timeline_instant(_timeline.RETRY, {
+        "site": name, "attempt": attempt, "delay_s": round(delay, 3),
+        "error": str(err) if err is not None else "",
+    })
+
+
+class RetryExhausted(TimeoutError):
+    """Raised by ``Retrier.poll`` when the deadline expires without a
+    result (``Retrier.call`` re-raises the last real exception instead)."""
+
+
+class Retrier:
+    """Exponential backoff + full jitter + overall deadline.
+
+    Deterministic where it matters: the jitter rng is seeded by
+    ``(name, rank)``, so a retry schedule observed in one chaos run is
+    the schedule of every run. ``clock``/``sleep`` are injectable so
+    tier-1 tests verify schedules with a fake clock and zero real
+    sleeping.
+
+        Retrier(policy, "kv.read").call(fn, retry_on=(OSError,))
+        Retrier(policy, "endpoint").poll(fetch)   # until non-None
+    """
+
+    def __init__(self, policy: _config.RetryPolicy, name: str,
+                 on_retry: Optional[Callable] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Optional[Callable[[float], None]] = None,
+                 rank: Optional[int] = None):
+        self.policy = policy
+        self.name = name
+        self._on_retry = on_retry if on_retry is not None else (
+            lambda attempt, delay, err: default_on_retry(
+                name, attempt, delay, err))
+        self._clock = clock
+        self._sleep = sleep if sleep is not None else (lambda s: _sleep(s))
+        self._seed_rank = rank if rank is not None else _default_rank()
+        # Lazily seeded: the no-retry success path (every healthy KV
+        # read) should not pay Random construction.
+        self._rng = None
+
+    def backoff(self, attempt: int) -> float:
+        """The delay after ``attempt`` (0-based) failures: full jitter
+        over an exponentially growing cap (AWS-style ``uniform(0, cap)``
+        — decorrelates a thundering herd of workers re-rendezvousing
+        after the same failure)."""
+        p = self.policy
+        cap = min(p.max_delay, p.base_delay * (p.multiplier ** attempt))
+        if not p.jitter:
+            return cap
+        if self._rng is None:
+            self._rng = random.Random(f"{self.name}:{self._seed_rank}")
+        return self._rng.uniform(0.0, cap)
+
+    def _deadline(self) -> float:
+        p = self.policy
+        return self._clock() + p.deadline if p.deadline > 0 \
+            else float("inf")
+
+    def call(self, fn: Callable, retry_on: Tuple = (OSError,), *args,
+             **kwargs):
+        """Run ``fn`` until it returns, retrying on ``retry_on``. The
+        final failure re-raises ``fn``'s own exception — callers keep
+        their existing error contracts."""
+        deadline = self._deadline()
+        attempt = 0
+        while True:
+            try:
+                return fn(*args, **kwargs)
+            except retry_on as e:
+                attempt += 1
+                p = self.policy
+                if p.max_attempts > 0 and attempt >= p.max_attempts:
+                    raise
+                delay = self.backoff(attempt - 1)
+                if self._clock() + delay > deadline:
+                    raise
+                self._on_retry(attempt - 1, delay, e)
+                self._sleep(delay)
+
+    def poll(self, fn: Callable, *args, **kwargs):
+        """Run ``fn`` until it returns non-None; between polls sleep the
+        backoff schedule (capped by ``max_delay``). Returns the value, or
+        raises ``RetryExhausted`` at the deadline. ``fn`` raising
+        propagates immediately — a poll target that errors is a different
+        failure than one that is merely not ready."""
+        deadline = self._deadline()
+        attempt = 0
+        while True:
+            result = fn(*args, **kwargs)
+            if result is not None:
+                return result
+            p = self.policy
+            attempt += 1
+            if p.max_attempts > 0 and attempt >= p.max_attempts:
+                raise RetryExhausted(
+                    f"{self.name}: no result after {attempt} attempts")
+            delay = self.backoff(attempt - 1)
+            now = self._clock()
+            if now >= deadline:
+                raise RetryExhausted(
+                    f"{self.name}: no result within "
+                    f"{self.policy.deadline:.1f}s deadline")
+            delay = min(delay, max(0.0, deadline - now))
+            self._sleep(delay)
+
+
+def retrier(scope: str, name: Optional[str] = None,
+            on_retry: Optional[Callable] = None,
+            rank: Optional[int] = None, pinned=(),
+            **defaults) -> Retrier:
+    """Sugar: a ``Retrier`` whose policy comes from the ``scope``'s
+    ``HOROVOD_RETRY_*`` envs over the given coded defaults (``pinned``
+    fields stay at their coded values — see
+    ``config.retry_policy_from_env``)."""
+    return Retrier(
+        _config.retry_policy_from_env(scope, pinned=pinned, **defaults),
+        name or scope.lower(), on_retry=on_retry, rank=rank)

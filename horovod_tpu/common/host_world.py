@@ -19,12 +19,12 @@ from __future__ import annotations
 import os
 import socket
 import threading
-import time
 from typing import Optional, Tuple
 
 import numpy as np
 
 from . import config as _config
+from . import faults as _faults
 from . import logging as _log
 from . import native as _native
 from .exceptions import HorovodInternalError, NotInitializedError
@@ -211,45 +211,62 @@ class HostWorld:
         # bounded grace, not a hard wait — a *transient* collective failure
         # (no process died, plan unchanged) advances nothing, and everyone
         # simply re-joins the current round.
-        # First init never waits on the round loop (it breaks immediately
-        # on _last_rendezvous_round is None), so only re-inits pay the KV
-        # read for the driver-published grace.
-        if self._last_rendezvous_round is not None:
-            grace = time.monotonic() + _rejoin_grace_seconds(addr, port)
-        else:
-            grace = time.monotonic()
-        while True:
+        # First init never waits on the round loop, so only re-inits pay
+        # the KV read for the driver-published grace.
+        if self._last_rendezvous_round is None:
             try:
                 fetched = fetch_slot_info(addr, int(port), hostname,
-                                          self.local_rank)
+                                          self.local_rank, rank=self.rank)
             except Exception as e:
-                if self._last_rendezvous_round is not None:
-                    # Re-init: the env endpoint may point at a deposed
-                    # rank 0 — falling back to it silently would be a
-                    # blind 120 s connect; surface the failure to the
-                    # elastic retry loop instead.
-                    raise HorovodInternalError(
-                        f"elastic re-rendezvous failed: {e}") from e
                 # First init: the launch-time env block is still
                 # authoritative; proceed on it.
                 _log.warning(f"elastic rendezvous unreachable at first "
                              f"init; using env topology: {e}")
                 return
             if fetched is None:
-                if self._last_rendezvous_round is not None:
-                    # Re-init and the current plan excludes us (host
-                    # blacklisted / slot removed). Proceeding on stale env
-                    # topology would join the new round with an old rank
-                    # and could overwrite a legitimate worker's slot in
-                    # the coordinator's tables.
-                    raise _excluded_from_plan_error()
                 return  # first init: launch-time env is authoritative
-            info, rendezvous_round = fetched
-            if self._last_rendezvous_round is None or \
-                    rendezvous_round > self._last_rendezvous_round or \
-                    time.monotonic() > grace:
-                break
-            time.sleep(0.25)
+        else:
+            last = self._last_rendezvous_round
+            latest = [None]
+
+            def fetch_newer():
+                try:
+                    got = fetch_slot_info(addr, int(port), hostname,
+                                          self.local_rank, rank=self.rank)
+                except Exception as e:
+                    # Re-init: the env endpoint may point at a deposed
+                    # rank 0 — falling back to it silently would be a
+                    # blind 120 s connect; surface the failure to the
+                    # elastic retry loop instead.
+                    raise HorovodInternalError(
+                        f"elastic re-rendezvous failed: {e}") from e
+                if got is None:
+                    # The current plan excludes us (host blacklisted /
+                    # slot removed). Proceeding on stale env topology
+                    # would join the new round with an old rank and could
+                    # overwrite a legitimate worker's slot in the
+                    # coordinator's tables.
+                    raise _excluded_from_plan_error()
+                latest[0] = got
+                return got if got[1] > last else None
+
+            # max_attempts/deadline are pinned: unlimited polling for the
+            # whole grace IS the rejoin contract (the grace has its own
+            # knob, HOROVOD_ELASTIC_REJOIN_GRACE) — a global
+            # HOROVOD_RETRY_MAX_ATTEMPTS must not truncate it into a
+            # stale-round rejoin mid plan-rebuild.
+            retrier = _faults.retrier(
+                "REJOIN", name="elastic.rejoin", rank=self.rank,
+                pinned=("max_attempts", "deadline"),
+                max_attempts=0, base_delay=0.25, max_delay=1.0,
+                deadline=max(_rejoin_grace_seconds(addr, port), 0.001))
+            try:
+                fetched = retrier.poll(fetch_newer)
+            except _faults.RetryExhausted:
+                # Grace expired with the round unchanged: the failure was
+                # transient and everyone re-joins the current round.
+                fetched = latest[0]
+        info, rendezvous_round = fetched
         (self.rank, self.size, self.local_rank, self.local_size,
          self.cross_rank, self.cross_size) = info
         self._last_rendezvous_round = rendezvous_round
@@ -298,26 +315,39 @@ class HostWorld:
         we fetched (another failure, more churn) while we wait, raise
         immediately so the elastic retry loop re-rendezvouses against the
         live round instead of burning the full timeout on a coordinator
-        that will never publish."""
+        that will never publish. Schedule + 120 s default deadline come
+        from the shared Retrier under the ``RENDEZVOUS`` scope."""
         from ..run.elastic.rendezvous import (
             fetch_controller_endpoint, fetch_slot_info)
 
-        deadline = time.monotonic() + 120.0
-        while time.monotonic() < deadline:
+        def fetch_once():
             ep = fetch_controller_endpoint(addr, port, rendezvous_round,
-                                           timeout=2.0)
+                                           timeout=2.0, rank=self.rank)
             if ep is not None:
                 return ep
-            current = fetch_slot_info(addr, port, hostname, self.local_rank)
+            current = fetch_slot_info(addr, port, hostname,
+                                      self.local_rank, rank=self.rank)
             if current is None:
                 raise _excluded_from_plan_error()
             if current[1] != rendezvous_round:
                 raise HorovodInternalError(
                     f"rendezvous advanced to round {current[1]} while "
                     f"waiting for round {rendezvous_round}'s controller")
-        raise HorovodInternalError(
-            f"controller endpoint for rendezvous round {rendezvous_round} "
-            f"never appeared in the KV (rank 0 crashed before publishing?)")
+            return None
+
+        # Unlimited attempts within the deadline IS the wait contract;
+        # only the deadline and the poll cadence are tuning knobs.
+        retrier = _faults.retrier(
+            "RENDEZVOUS", name="controller.endpoint", rank=self.rank,
+            pinned=("max_attempts",),
+            max_attempts=0, base_delay=0.25, max_delay=2.0, deadline=120.0)
+        try:
+            return retrier.poll(fetch_once)
+        except _faults.RetryExhausted:
+            raise HorovodInternalError(
+                f"controller endpoint for rendezvous round "
+                f"{rendezvous_round} never appeared in the KV (rank 0 "
+                f"crashed before publishing?)") from None
 
     @staticmethod
     def _borrow_engine_core():
@@ -409,6 +439,7 @@ class HostWorld:
                 root_rank: int = -1, prescale: float = 1.0,
                 postscale: float = 1.0) -> int:
         self.require_init()
+        _faults.point("host_world.enqueue", rank=self.rank)
         if self._core is None:
             raise HorovodInternalError(
                 "native host plane unavailable in this process")
@@ -436,6 +467,10 @@ class HostWorld:
         if core is None:
             raise HorovodInternalError(
                 "native host plane unavailable (shut down?)")
+        # The blocking seam of a ring collective: a kind=exit fault here
+        # kills the worker mid-step, after its tensor was submitted —
+        # the canonical chaos-test death (docs/fault-injection.md).
+        _faults.point("ring.exec", rank=self.rank)
         return core.wait(handle)
 
     # -- small helper collectives (numpy, blocking) --------------------------

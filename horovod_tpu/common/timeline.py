@@ -34,6 +34,12 @@ XLA_ALLGATHER = "XLA_ALLGATHER"
 XLA_BCAST = "XLA_BCAST"
 XLA_REDUCESCATTER = "XLA_REDUCESCATTER"
 COMPILE = "COMPILE"
+# Robustness-plane instants (docs/fault-injection.md): a Retrier backing
+# off, a stall-inspector warning drained by hvd.stall_report(), and the
+# elastic driver blacklisting a host (launcher-side timeline).
+RETRY = "RETRY"
+STALL_WARNING = "STALL_WARNING"
+HOST_BLACKLISTED = "HOST_BLACKLISTED"
 
 
 class Timeline:

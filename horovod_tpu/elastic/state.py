@@ -219,12 +219,20 @@ class JaxState(ObjectState):
                     "writes shards from their owning processes) for "
                     "elastic recovery of cross-process sharded states.")
         # Host snapshot of the tree; deepcopy-snapshot of the rest.
-        self._saved_tree = jax.device_get(self.tree)
+        # Staged then assigned TOGETHER: commit() can die mid-save (the
+        # world failing under device_get/deepcopy raises
+        # HorovodInternalError), and a half-updated pair — new tree, old
+        # attrs — would make the next restore() place an advanced step
+        # counter onto stale weights (or vice versa). Either snapshot
+        # half failing must leave BOTH halves at the last good commit.
+        saved_tree = jax.device_get(self.tree)
         tree, self.tree = self.tree, None
         try:
-            super().save()  # snapshots public attrs minus the tree
+            saved_attrs = copy.deepcopy(self._public_attrs())
         finally:
             self.tree = tree
+        self._saved_tree = saved_tree
+        self._saved_state = saved_attrs
 
     def restore(self):
         super().restore()

@@ -43,6 +43,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..common import config as _hvd_config
+from ..common import faults as _faults
 from ..common import logging as _log
 from ..common import native as _native
 from ..common.exceptions import DuplicateTensorNameError, HorovodInternalError
@@ -195,6 +196,14 @@ class EagerEngine:
                 self._core.response_done(response_id, False, str(e))
 
     def _execute_response(self, resp: "_native.NativeResponse"):
+        # Chaos seam for the XLA execution plane (docs/fault-injection.md):
+        # a fault here surfaces exactly like a real executor failure —
+        # response_done(False) and every pending entry errors. Its own
+        # point name (not "ring.exec"): this runs on the engine's
+        # executor thread, and sharing a hit counter with HostWorld.wait
+        # would make step= targeting depend on thread interleaving when
+        # both planes are live in one process.
+        _faults.point("xla.exec", rank=self._state.process_index)
         timeline = self._state.timeline
         if timeline and self._native:
             # Per-rank negotiation ticks recorded by the coordinator

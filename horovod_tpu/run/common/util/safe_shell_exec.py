@@ -112,6 +112,15 @@ def execute(command, env: Optional[dict] = None,
 
     try:
         exit_code = proc.wait()
+    except BaseException:
+        # Parent interrupt (KeyboardInterrupt in the launcher, SystemExit,
+        # a test runner's timeout): the worker's whole process group must
+        # die with us — the launcher-side analog of worker death. Without
+        # this, Ctrl-C on the launcher orphans every worker (and its
+        # grandchildren) into init's lap, still holding ports and TPU
+        # devices.
+        terminate_executor_shell_and_children(proc.pid)
+        raise
     finally:
         stop_watch.set()
         # Drain fully before the caller closes its streams: a short join

@@ -13,8 +13,9 @@ transport for Spark-style integrations.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
+from ...common import config as _config
 from ..common.util import network
 
 
@@ -69,7 +70,18 @@ class HorovodRunDriverService(network.BasicService):
             return TaskIndexResponse(self._hostnames[req.hostname])
         return super()._handle(req, client_address)
 
-    def wait_for_initial_registration(self, timeout: float = 30.0) -> None:
+    def wait_for_initial_registration(
+            self, timeout: Optional[float] = None) -> None:
+        """Block until every host registered. The default deadline is the
+        ``DRIVER`` retry scope's (``HOROVOD_RETRY_DRIVER_DEADLINE``,
+        coded default 30 s; 0 = wait forever, per the RetryPolicy
+        sentinel) — slow-provisioning pods tune the env instead of
+        patching call sites."""
+        if timeout is None:
+            timeout = _config.retry_policy_from_env(
+                "DRIVER", deadline=30.0).deadline
+            if timeout <= 0:
+                timeout = None  # deadline=0 means unbounded, not instant
         with self._wait_cond:
             ok = self._wait_cond.wait_for(
                 lambda: len(self._all_task_addresses) >= self._num_hosts,
@@ -93,15 +105,32 @@ class HorovodRunTaskService(network.BasicService):
 
 def probe_routable_addresses(addresses: List[Tuple[str, int]],
                              service_name: str, key: bytes,
-                             timeout: float = 2.0
+                             timeout: Optional[float] = None
                              ) -> List[Tuple[str, int]]:
     """The subset of a service's advertised (ip, port) pairs the caller
-    can actually reach (authenticated ping round-trip)."""
+    can actually reach (authenticated ping round-trip). The per-address
+    connect timeout comes from the ``PROBE`` retry scope
+    (``HOROVOD_RETRY_PROBE_DEADLINE``, coded default 2 s) when the
+    caller didn't pass one; an explicit ``timeout`` is a call-site
+    contract and pinned against env override. Probes are single-attempt
+    by design (pinned): a dead address must cost one bounded connect,
+    not an env-inflated retry storm per NIC."""
+    policy = _config.retry_policy_from_env(
+        "PROBE",
+        pinned=("max_attempts",) + (
+            ("deadline",) if timeout is not None else ()),
+        deadline=timeout if timeout is not None else 2.0,
+        max_attempts=1)
+    # RetryPolicy's deadline=0 sentinel means "no deadline", but a probe
+    # must stay bounded — and 0 passed as a socket timeout would mean
+    # non-blocking connects that fail every healthy address.
+    probe_timeout = policy.deadline if policy.deadline > 0 else 2.0
     reachable = []
     for addr in addresses:
         try:
             network.BasicClient(service_name, [addr], key,
-                                probe_timeout=timeout, attempts=1)
+                                probe_timeout=probe_timeout,
+                                attempts=max(1, policy.max_attempts))
             reachable.append(addr)
         except (ConnectionError, OSError):
             continue
@@ -110,7 +139,7 @@ def probe_routable_addresses(addresses: List[Tuple[str, int]],
 
 def get_common_interfaces(driver: "HorovodRunDriverService",
                           num_hosts: int, key: bytes,
-                          timeout: float = 2.0
+                          timeout: Optional[float] = None
                           ) -> Dict[int, List[Tuple[str, int]]]:
     """Routable address set per registered task host (parity:
     ``run/common/service/driver_service.py:43`` NIC-intersection round):

@@ -5,7 +5,17 @@
 ``hostname`` or ``hostname:slots`` per line); ``HostManager`` tracks the
 available host set with **age ordering** — hosts keep their discovery order
 across updates, so rank assignment stays stable and rank 0 lives on the
-oldest host (``discovery.py:113-121``) — plus blacklisting with cooldown.
+oldest host (``discovery.py:113-121``) — plus strike-counted blacklisting
+with cooldown + parole (docs/fault-injection.md):
+
+- each failure is a **strike**; below ``HOROVOD_ELASTIC_BLACKLIST_STRIKES``
+  strikes (and given a ``cooldown_range``) the host sits out a randomized
+  cooldown, then returns **on parole**;
+- a host that runs clean through ``HOROVOD_ELASTIC_PAROLE_WINDOW`` seconds
+  of parole has its strikes reset (transient faults don't accumulate into
+  a death sentence);
+- at ``N`` strikes — or when no cooldown range was configured — the
+  blacklist is permanent and the host is never re-invited.
 """
 
 from __future__ import annotations
@@ -14,7 +24,10 @@ import random
 import subprocess
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ...common import config as _config
+from ...common import logging as _log
 
 
 class HostDiscovery:
@@ -66,24 +79,63 @@ class FixedHosts(HostDiscovery):
 
 
 class HostManager:
-    """Tracks available hosts in age order + blacklist (parity:
-    ``discovery.py:62-121``)."""
+    """Tracks available hosts in age order + strike-counted blacklist
+    with cooldown/parole (parity: ``discovery.py:62-121``, extended per
+    the module docstring). ``clock`` is injectable so strike/parole logic
+    is testable with zero real sleeping."""
 
     def __init__(self, discovery: HostDiscovery,
-                 cooldown_range: Optional[Tuple[int, int]] = None):
+                 cooldown_range: Optional[Tuple[int, int]] = None,
+                 max_strikes: Optional[int] = None,
+                 parole_window: Optional[float] = None,
+                 clock: Callable[[], float] = time.time):
         self._discovery = discovery
         self._lock = threading.Lock()
         self._order: List[str] = []  # discovery age order, oldest first
         self._slots: Dict[str, int] = {}
         self._blacklist: Dict[str, float] = {}  # host -> retry-after ts
         self._cooldown_range = cooldown_range
+        self._max_strikes = (max_strikes if max_strikes is not None
+                             else _config.blacklist_strikes())
+        self._parole_window = (parole_window if parole_window is not None
+                               else _config.parole_window_seconds())
+        self._clock = clock
+        self._strikes: Dict[str, int] = {}
+        self._parole_until: Dict[str, float] = {}
+        self._events: List[dict] = []  # blacklist history, queryable
+        self._on_blacklist: Optional[Callable[[str, dict], None]] = None
+
+    def set_on_blacklist(self, cb: Optional[Callable[[str, dict], None]]
+                         ) -> None:
+        """Observer for blacklist decisions (the driver wires timeline +
+        log recording here)."""
+        self._on_blacklist = cb
 
     def update_available_hosts(self) -> bool:
         """Poll discovery; True when the usable host set changed (parity:
         ``HostManager.update_available_hosts``)."""
         found = self._discovery.find_available_hosts_and_slots()
         with self._lock:
-            now = time.time()
+            now = self._clock()
+            # Cooldown expiry → parole: the host may rejoin, but its
+            # strikes stand until it runs clean through the parole window.
+            for h in list(self._blacklist):
+                if self._blacklist[h] <= now and h in found:
+                    del self._blacklist[h]
+                    if self._parole_window > 0:
+                        self._parole_until[h] = now + self._parole_window
+                    _log.info(
+                        f"elastic: host {h} returns from blacklist "
+                        f"cooldown on parole "
+                        f"(strikes {self._strikes.get(h, 0)}/"
+                        f"{self._max_strikes})")
+            # Clean parole served → strikes forgiven.
+            for h in list(self._parole_until):
+                if self._parole_until[h] <= now:
+                    del self._parole_until[h]
+                    if self._strikes.pop(h, 0):
+                        _log.info(f"elastic: host {h} served its parole "
+                                  f"cleanly; strikes reset")
             usable = {
                 h: s for h, s in found.items()
                 if self._blacklist.get(h, 0.0) <= now
@@ -106,17 +158,76 @@ class HostManager:
             return sum(self._slots[h] for h in self._order)
 
     def blacklist(self, host: str) -> None:
-        """Exclude a failing host; with a cooldown range it may return
-        after a randomized backoff (parity: ``discovery.py:102-108``)."""
+        """Record a strike against ``host`` and exclude it. Below the
+        strike limit (and given a cooldown range) the exclusion is a
+        randomized cooldown; at the limit — or with no cooldown range —
+        it is permanent (parity: ``discovery.py:102-108``, extended with
+        strike counting). One *incident* is one strike: a host running N
+        workers fans N ``record_failure`` calls into here when it dies,
+        and calls arriving while the host is already excluded are that
+        same incident, not N separate offenses — without the dedupe a
+        3-slot host would go permanent on its first crash."""
         with self._lock:
-            if self._cooldown_range:
-                lo, hi = self._cooldown_range
-                self._blacklist[host] = time.time() + random.uniform(lo, hi)
+            if self._blacklist.get(host, 0.0) > self._clock():
+                return  # already excluded: same incident's fan-in
+            strikes = self._strikes.get(host, 0) + 1
+            self._strikes[host] = strikes
+            # A failure during parole ends the parole; the host must
+            # re-earn a clean window after its next return.
+            self._parole_until.pop(host, None)
+            permanent = (not self._cooldown_range
+                         or strikes >= self._max_strikes)
+            if permanent:
+                until = float("inf")
             else:
-                self._blacklist[host] = float("inf")
+                lo, hi = self._cooldown_range
+                until = self._clock() + random.uniform(lo, hi)
+            self._blacklist[host] = until
             self._order = [h for h in self._order if h != host]
             self._slots.pop(host, None)
+            info = {
+                "host": host, "strikes": strikes,
+                "max_strikes": self._max_strikes, "permanent": permanent,
+                "until": until, "ts": self._clock(),
+            }
+            self._events.append(info)
+            cb = self._on_blacklist
+        cooldown = ("permanent" if permanent
+                    else f"cooldown until t={until:.1f}")
+        _log.warning(f"elastic: host {host} blacklisted "
+                     f"(strike {strikes}/{self._max_strikes}, {cooldown})")
+        if cb is not None:
+            cb(host, dict(info))
 
     def is_blacklisted(self, host: str) -> bool:
         with self._lock:
-            return self._blacklist.get(host, 0.0) > time.time()
+            return self._blacklist.get(host, 0.0) > self._clock()
+
+    def blacklist_info(self) -> Dict[str, dict]:
+        """Queryable blacklist state: ``{host: {strikes, until, permanent,
+        on_parole}}`` for every host with strikes or an active exclusion."""
+        with self._lock:
+            now = self._clock()
+            hosts = set(self._strikes) | set(self._blacklist) | \
+                set(self._parole_until)
+            return {
+                h: {
+                    "strikes": self._strikes.get(h, 0),
+                    "until": self._blacklist.get(h, 0.0),
+                    "permanent": self._blacklist.get(h, 0.0) == float("inf"),
+                    "blacklisted": self._blacklist.get(h, 0.0) > now,
+                    "on_parole": self._parole_until.get(h, 0.0) > now,
+                }
+                for h in sorted(hosts)
+            }
+
+    def blacklist_events(self) -> List[dict]:
+        """The append-only history of blacklist decisions."""
+        with self._lock:
+            return [dict(e) for e in self._events]
+
+    def has_recoverable_hosts(self) -> bool:
+        """True when some excluded host can still return (finite
+        cooldown) — i.e. waiting for slots is not provably futile."""
+        with self._lock:
+            return any(t != float("inf") for t in self._blacklist.values())

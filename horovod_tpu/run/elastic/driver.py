@@ -22,7 +22,9 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ...common import faults as _faults
 from ...common import logging as _log
+from ...common import timeline as _timeline
 from ..common.util.hosts import HostInfo, SlotInfo, get_host_assignments
 from .discovery import HostManager
 from .registration import FAILURE, SUCCESS, WorkerStateRegistry
@@ -45,9 +47,11 @@ class ElasticDriver:
     def __init__(self, rendezvous, discovery, min_np: int, max_np: int = 0,
                  timeout: Optional[float] = None,
                  cooldown_range: Optional[Tuple[int, int]] = None,
-                 verbose: int = 0):
+                 verbose: int = 0, timeline=None):
         self._rendezvous = rendezvous
+        self._timeline = timeline  # launcher-side Timeline, optional
         self._host_manager = HostManager(discovery, cooldown_range)
+        self._host_manager.set_on_blacklist(self._on_host_blacklisted)
         # Publish the rejoin grace surviving workers should honor before
         # concluding a failure was transient. It must cover the driver's
         # own worst-case plan rebuild (blacklist cooldown upper bound +
@@ -115,18 +119,37 @@ class ElasticDriver:
 
     # -- membership ----------------------------------------------------------
 
+    def _blacklist_detail(self) -> str:
+        info = self._host_manager.blacklist_info()
+        if not info:
+            return ""
+        parts = []
+        for host, st in info.items():
+            if not st["blacklisted"]:
+                continue
+            kind = "permanently" if st["permanent"] else "in cooldown"
+            parts.append(f"{host} ({kind}, strikes {st['strikes']})")
+        return f"; blacklisted hosts: {', '.join(parts)}" if parts else ""
+
     def wait_for_available_slots(self, min_np: int):
         """Block until at least ``min_np`` slots exist (parity:
-        ``driver.py:133``)."""
+        ``driver.py:133``). Refusing to shrink below ``min_np`` comes
+        with a clear error: the timeout message names every blacklisted
+        host and whether it can ever return — "job died because the
+        driver blacklisted its last hosts" must be diagnosable from the
+        launcher log alone. The wait itself always runs the full timeout:
+        discovery may hand out brand-new replacement hosts (autoscaler)
+        that no blacklist state can predict."""
         deadline = time.time() + self._timeout
         while not self._shutdown.is_set():
-            if self._host_manager.available_slots() >= min_np:
+            available = self._host_manager.available_slots()
+            if available >= min_np:
                 return
             if time.time() > deadline:
                 raise TimeoutError(
                     f"timed out waiting for {min_np} slots; only "
-                    f"{self._host_manager.available_slots()} available")
-            time.sleep(DISCOVER_HOSTS_FREQUENCY_SECS)
+                    f"{available} available{self._blacklist_detail()}")
+            self._shutdown.wait(DISCOVER_HOSTS_FREQUENCY_SECS)
 
     def _discover_loop(self):
         while not self._shutdown.is_set():
@@ -178,6 +201,24 @@ class ElasticDriver:
 
     def set_notify_client_factory(self, factory) -> None:
         self._notify_client_factory = factory
+
+    def _on_host_blacklisted(self, host: str, info: dict) -> None:
+        """Observer wired into the HostManager: every blacklist decision
+        lands in the launcher timeline (when one is configured) so a
+        post-mortem shows membership churn on the same time axis as the
+        workers' collectives."""
+        if self._timeline is not None:
+            args = dict(info)
+            if args.get("until") == float("inf"):
+                # json.dumps would emit bare `Infinity` — invalid JSON
+                # for strict trace parsers; `permanent` carries the fact.
+                args["until"] = None
+            self._timeline.instant(_timeline.HOST_BLACKLISTED, args)
+
+    def blacklist_status(self):
+        """Queryable blacklist state (strikes / cooldown / parole per
+        host) — see ``HostManager.blacklist_info``."""
+        return self._host_manager.blacklist_info()
 
     # -- rank assignment -----------------------------------------------------
 
@@ -267,6 +308,10 @@ class ElasticDriver:
 
         def run():
             try:
+                # Chaos seam: a kind=raise fault here simulates a launch-
+                # side failure (bad ssh, unwritable output dir) for
+                # slot.rank and must be accounted exactly like one.
+                _faults.point("elastic.worker.start", rank=slot.rank)
                 code = self._create_worker_fn(slot, [handle.event,
                                                      self._shutdown])
             except Exception as e:

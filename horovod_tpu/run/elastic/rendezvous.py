@@ -17,6 +17,8 @@ from __future__ import annotations
 import time
 from typing import Optional, Tuple
 
+from ...common import config as _config
+from ...common import faults as _faults
 from ..http.http_client import put_data_into_kvstore, read_data_from_kvstore
 
 RANK_SCOPE = "rank"
@@ -25,11 +27,15 @@ CONTROLLER_SCOPE = "controller"
 SlotLayout = Tuple[int, int, int, int, int, int]
 
 
-def fetch_slot_info(addr: str, port: int, hostname: str, local_rank: int
+def fetch_slot_info(addr: str, port: int, hostname: str, local_rank: int,
+                    rank: Optional[int] = None
                     ) -> Optional[Tuple[SlotLayout, int]]:
     """Return ((rank, size, local_rank, local_size, cross_rank,
     cross_size), rendezvous_round) for this worker, or None when the
-    round's plan excludes it."""
+    round's plan excludes it. ``rank`` is the caller's CURRENT rank for
+    fault targeting (the env copy goes stale once the driver moves
+    ranks)."""
+    _faults.point("rendezvous.poll", rank=rank)
     blob = read_data_from_kvstore(addr, port, RANK_SCOPE,
                                   f"{hostname}:{local_rank}")
     if blob is None:
@@ -57,26 +63,51 @@ def publish_controller_endpoint(addr: str, port: int, controller_host: str,
 
 
 def fetch_controller_endpoint(addr: str, port: int, rendezvous_round: int,
-                              timeout: float = 120.0
+                              timeout: float = 120.0,
+                              rank: Optional[int] = None
                               ) -> Optional[Tuple[str, int]]:
     """Poll the KV until the given round's controller endpoint appears.
 
-    Returns (host, port), or None on timeout. The deadline is monotonic:
-    NTP steps on freshly provisioned TPU VMs must not stretch or collapse
-    the wait. Each KV read uses a short per-request timeout and a single
-    attempt so short overall deadlines (the stale-round poll passes 2 s)
-    hold — the default client settings could block ~31 s in one read."""
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        per_req = max(0.2, min(2.0, deadline - time.monotonic()))
+    Returns (host, port), or None on timeout. The poll schedule comes
+    from the shared Retrier under the ``RENDEZVOUS`` scope (monotonic
+    deadline: NTP steps on freshly provisioned TPU VMs must not stretch
+    or collapse the wait). Each KV read uses a short per-request timeout
+    and a single attempt so short overall deadlines (the stale-round poll
+    passes 2 s) hold — the default client settings could block ~31 s in
+    one read."""
+    # The caller's ``timeout`` is a contract (the stale-round poll in
+    # host_world passes 2 s and depends on it): deadline and attempts are
+    # pinned against env override; only the poll cadence is tunable.
+    retrier = _faults.Retrier(
+        _config.retry_policy_from_env(
+            "RENDEZVOUS", pinned=("max_attempts", "deadline"),
+            max_attempts=0, base_delay=0.25, max_delay=2.0,
+            deadline=timeout),
+        f"rendezvous.endpoint.{rendezvous_round}")
+    overall_deadline = time.monotonic() + timeout
+
+    def fetch() -> Optional[Tuple[str, int]]:
+        # Its own point name (not rendezvous.poll): sharing a hit
+        # counter with the slot-info fetches would make step= targeting
+        # depend on how many endpoint polls interleave with them.
+        _faults.point("rendezvous.endpoint", rank=rank)
+        # Clamp each request to the REMAINING overall budget: a read
+        # started at deadline-ε must not block its full 2 s and stretch
+        # a short caller deadline to ~2x.
+        remaining = overall_deadline - time.monotonic()
+        per_req = max(0.2, min(2.0, remaining))
         try:
             blob = read_data_from_kvstore(addr, port, CONTROLLER_SCOPE,
                                           f"endpoint.{rendezvous_round}",
                                           timeout=per_req, retries=1)
         except OSError:
-            blob = None  # transient KV hiccup: keep polling to deadline
-        if blob:
-            host, _, p = blob.decode().rpartition(":")
-            return host, int(p)
-        time.sleep(0.25)
-    return None
+            return None  # transient KV hiccup: keep polling to deadline
+        if not blob:
+            return None
+        host, _, p = blob.decode().rpartition(":")
+        return host, int(p)
+
+    try:
+        return retrier.poll(fetch)
+    except _faults.RetryExhausted:
+        return None

@@ -58,11 +58,20 @@ def run_elastic(args, command: List[str],
     elastic_timeout = getattr(args, "elastic_timeout", None)
     if elastic_timeout is None:
         elastic_timeout = 600
+    # Launcher-side timeline: when the job records a timeline, membership
+    # events (host blacklisted, strikes, parole) land in a sibling
+    # `<timeline>.driver.json` — rank 0's own file belongs to the worker.
+    driver_timeline = None
+    timeline_path = env.get(_config.HOROVOD_TIMELINE)
+    if timeline_path:
+        from ...common.timeline import Timeline
+
+        driver_timeline = Timeline(timeline_path + ".driver.json")
     driver = ElasticDriver(
         rendezvous, discovery, min_np=min_np, max_np=max_np,
         timeout=elastic_timeout,
         cooldown_range=getattr(args, "blacklist_cooldown_range", None),
-        verbose=1 if args.verbose else 0)
+        verbose=1 if args.verbose else 0, timeline=driver_timeline)
 
     def launcher_addr() -> str:
         # Shared with the static/jsrun paths so --network-interface pins
@@ -105,3 +114,5 @@ def run_elastic(args, command: List[str],
     finally:
         driver.stop()
         rendezvous.stop_server()
+        if driver_timeline is not None:
+            driver_timeline.close()

@@ -1,31 +1,48 @@
-"""HTTP KV client helpers (parity: ``horovod/run/http/http_client.py``)."""
+"""HTTP KV client helpers (parity: ``horovod/run/http/http_client.py``).
+
+Retries route through the shared ``common/faults.py`` Retrier under the
+``KV`` scope, so one set of ``HOROVOD_RETRY_KV_*`` envs tunes every KV
+read tree-wide (docs/fault-injection.md)."""
 
 from __future__ import annotations
 
-import time
 import urllib.error
 import urllib.request
 from typing import Optional
+
+from ...common import config as _config
+from ...common import faults as _faults
 
 
 def read_data_from_kvstore(addr: str, port: int, scope: str,
                            key: str, timeout: float = 10.0,
                            retries: int = 3) -> Optional[bytes]:
+    """One KV GET with retries. ``timeout`` bounds each request;
+    ``retries`` is the call site's attempt budget (short-deadline callers
+    pass 1 as a correctness contract, so attempts are NOT env-tunable);
+    ``HOROVOD_RETRY_KV_{BASE_DELAY,MAX_DELAY,MULTIPLIER,DEADLINE}`` tune
+    the backoff between those attempts."""
     url = f"http://{addr}:{port}/{scope}/{key}"
-    for attempt in range(retries):
+
+    def get() -> Optional[bytes]:
         try:
             with urllib.request.urlopen(url, timeout=timeout) as resp:
                 return resp.read()
         except urllib.error.HTTPError as e:
             if e.code == 404:
-                return None
-            if attempt == retries - 1:
-                raise
-        except (urllib.error.URLError, OSError):
-            if attempt == retries - 1:
-                raise
-        time.sleep(0.5)
-    return None
+                return None  # "not there (yet)" is an answer, not an error
+            raise
+
+    # max_attempts is pinned to the caller's ``retries``: short-deadline
+    # call sites pass retries=1 as a correctness contract (e.g. the 2 s
+    # stale-round poll), which a global HOROVOD_RETRY_MAX_ATTEMPTS must
+    # not inflate. Delays/deadline stay env-tunable.
+    retrier = _faults.Retrier(
+        _config.retry_policy_from_env(
+            "KV", pinned=("max_attempts",), max_attempts=retries,
+            base_delay=0.5, max_delay=2.0, multiplier=1.5),
+        f"kv.read/{scope}/{key}")
+    return retrier.call(get, retry_on=(urllib.error.URLError, OSError))
 
 
 def put_data_into_kvstore(addr: str, port: int, scope: str, key: str,

@@ -7,12 +7,59 @@ handling, cleanup) evolves in lockstep — and so a failing/timed-out rank
 never leaves its peers orphaned."""
 
 import os
+import signal
 import socket
 import subprocess
 import sys
+import time
 
 TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(TESTS_DIR)
+
+# SIGTERM grace before SIGKILL when tearing down a failed world, and how
+# long a SIGKILLed group gets to actually disappear before we declare an
+# orphan leak (kernel delivery is fast; the slack is for scheduler lag).
+_TERM_GRACE_S = 3.0
+_KILL_GRACE_S = 2.0
+
+
+def _group_alive(pgid: int) -> bool:
+    try:
+        os.killpg(pgid, 0)
+        return True
+    except OSError:
+        return False
+
+
+def _terminate_group(proc: "subprocess.Popen") -> None:
+    """Terminate-then-kill a worker's whole process group (the worker is
+    its own session leader, so grandchildren die with it), then verify
+    nothing survived — a hung worker outliving a failed test would squat
+    its controller port and wedge every later world."""
+    try:
+        pgid = os.getpgid(proc.pid)
+    except OSError:
+        proc.wait()
+        return
+    try:
+        os.killpg(pgid, signal.SIGTERM)
+    except OSError:
+        pass
+    deadline = time.time() + _TERM_GRACE_S
+    while time.time() < deadline and proc.poll() is None:
+        time.sleep(0.05)
+    try:
+        os.killpg(pgid, signal.SIGKILL)
+    except OSError:
+        pass
+    proc.wait()  # reap the direct child; grandchildren go to init
+    deadline = time.time() + _KILL_GRACE_S
+    while time.time() < deadline and _group_alive(pgid):
+        time.sleep(0.05)
+    if _group_alive(pgid):
+        raise RuntimeError(
+            f"process group {pgid} survived SIGKILL: orphaned worker "
+            f"children outlived a failed run_world")
 
 
 def free_port() -> int:
@@ -61,10 +108,15 @@ def run_world(tmp_path, script_text, sentinel, size=2, timeout=240,
 
     for attempt in range(attempts):
         port = free_port()
+        # Each worker leads its own session/process group so that a
+        # failed or timed-out world can be torn down TRANSITIVELY: the
+        # worker's own subprocesses (launcher-spawned ranks, shelled-out
+        # discovery scripts) die with it instead of surviving as orphans.
         procs = [subprocess.Popen(
             [sys.executable, str(script), str(r),
              *[str(a) for a in args_for_rank(r, port)]], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            start_new_session=True)
             for r in range(size)]
         results = []
         try:
@@ -76,8 +128,7 @@ def run_world(tmp_path, script_text, sentinel, size=2, timeout=240,
         finally:
             for p in procs:
                 if p.poll() is None:
-                    p.kill()
-                    p.wait()
+                    _terminate_group(p)
         ok = (len(results) == size and
               all(rc == 0 and f"{sentinel}_{r}_OK" in out
                   for r, rc, out in results))

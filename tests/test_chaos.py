@@ -1,0 +1,330 @@
+"""Chaos proofs for the fault-injection plane (docs/fault-injection.md):
+real multi-process worlds where ``HOROVOD_FAULT_SPEC`` injects the
+failure and the elastic machinery must recover exactly as documented.
+
+Fast deterministic cases run in tier-1; the multi-life strike/parole soak
+is ``full``-profile. Also home to the launcher-side cleanup proofs
+(proc_harness group teardown, safe_shell_exec parent interrupt) — the
+"no orphaned children" half of the robustness story.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from proc_harness import run_world
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _read(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except FileNotFoundError:
+        return ""
+
+
+# ---- fault points in a real 2-process host world (tier-1) ------------------
+
+_DELAY_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    os.environ.update(HOROVOD_RANK=str(rank), HOROVOD_SIZE="2",
+                      HOROVOD_LOCAL_RANK=str(rank), HOROVOD_LOCAL_SIZE="2",
+                      HOROVOD_CONTROLLER_ADDR="127.0.0.1",
+                      HOROVOD_CONTROLLER_PORT=str(port),
+                      HOROVOD_CYCLE_TIME="1.0",
+                      JAX_PLATFORMS="cpu")
+    # Every enqueue on every rank takes a 1 ms injected delay; the
+    # results must still be exact — faults compose, they don't corrupt.
+    os.environ["HOROVOD_FAULT_SPEC"] = \\
+        "host_world.enqueue:kind=delay_ms:ms=1"
+    from horovod_tpu.common import faults
+    from horovod_tpu.common.host_world import world
+
+    w = world()
+    w.init()
+    assert w.size == 2, w.size
+    for i in range(4):
+        out = w.allgather_np(np.asarray([rank + 10.0 * i]), f"ag.{i}")
+        np.testing.assert_allclose(out.ravel(), [10.0 * i, 1 + 10.0 * i])
+    # Deterministic accounting: 4 collectives -> exactly 4 enqueue hits,
+    # each one delayed (times unlimited without step=).
+    assert faults._hits.get("host_world.enqueue") == 4, faults._hits
+    assert faults._fired.get(0) == 4, faults._fired
+    w.shutdown()
+    print(f"CHAOSDELAY_{rank}_OK")
+""")
+
+
+def test_fault_delay_in_real_world_preserves_results(tmp_path):
+    run_world(tmp_path, _DELAY_WORKER, "CHAOSDELAY")
+
+
+_RAISE_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    os.environ.update(HOROVOD_RANK=str(rank), HOROVOD_SIZE="2",
+                      HOROVOD_LOCAL_RANK=str(rank), HOROVOD_LOCAL_SIZE="2",
+                      HOROVOD_CONTROLLER_ADDR="127.0.0.1",
+                      HOROVOD_CONTROLLER_PORT=str(port),
+                      HOROVOD_CYCLE_TIME="1.0",
+                      JAX_PLATFORMS="cpu")
+    # Rank 1's SECOND enqueue raises; both ranks then agree to stop
+    # before the poisoned collective, so the world tears down cleanly.
+    os.environ["HOROVOD_FAULT_SPEC"] = \\
+        "host_world.enqueue:rank=1:step=1:kind=raise"
+    from horovod_tpu.common import faults
+    from horovod_tpu.common.exceptions import HorovodInternalError
+    from horovod_tpu.common.host_world import world
+
+    w = world()
+    w.init()
+    out = w.allgather_np(np.asarray([float(rank)]), "ag.0")
+    np.testing.assert_allclose(out.ravel(), [0.0, 1.0])
+    if rank == 1:
+        try:
+            w.allgather_np(np.asarray([2.0]), "ag.poisoned")
+            raise AssertionError("fault did not fire")
+        except faults.FaultInjected as e:
+            # FaultInjected IS-A HorovodInternalError: the elastic retry
+            # loop would treat this like any real collective failure.
+            assert isinstance(e, HorovodInternalError)
+            assert "fault injected" in str(e), e
+    w.shutdown()
+    print(f"CHAOSRAISE_{rank}_OK")
+""")
+
+
+def test_fault_raise_fires_on_exact_rank_and_hit(tmp_path):
+    run_world(tmp_path, _RAISE_WORKER, "CHAOSRAISE")
+
+
+# ---- the acceptance chaos run: kill rank 1 mid-step via the env ------------
+
+_ELASTIC_TRAIN = textwrap.dedent("""
+    import os, sys, time
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    import torch
+    import horovod_tpu.torch as hvd
+    import horovod_tpu.torch.elastic as elastic
+
+    LOG = os.environ["CHAOS_LOG"]
+    TARGET = int(os.environ.get("CHAOS_TARGET", "10"))
+    SLEEP = float(os.environ.get("CHAOS_SLEEP", "0.05"))
+
+    def log_line(text):
+        with open(LOG, "a") as f:
+            f.write(text + "\\n")
+
+    hvd.init()
+    model = torch.nn.Linear(4, 1)
+    opt = torch.optim.SGD(model.parameters(), lr=0.05)
+    state = elastic.TorchState(model=model, optimizer=opt, batch=0)
+
+    @elastic.run
+    def train(state):
+        while state.batch < TARGET:
+            x = torch.ones(2, 4) * (hvd.rank() + 1)
+            loss = model(x).sum()
+            opt.zero_grad()
+            loss.backward()
+            grad = hvd.allreduce(model.weight.grad, op=hvd.Average,
+                                 name=f"grad.b{state.batch}")
+            model.weight.grad.copy_(grad)
+            opt.step()
+            state.batch += 1
+            log_line(f"BATCH {state.batch} RANK {hvd.rank()} "
+                     f"SIZE {hvd.size()} HOST "
+                     + os.environ.get("HOROVOD_HOSTNAME", "?"))
+            time.sleep(SLEEP)
+            state.commit()
+        return state.batch
+
+    batches = train(state)
+    log_line(f"DONE RANK {hvd.rank()} BATCHES {batches}")
+    print(f"CHAOS_RANK_{hvd.rank()}_DONE_{batches}")
+""")
+
+
+def _launch_elastic(tmp_path, hosts_text, env_extra, np_args,
+                    timeout=300):
+    pytest.importorskip("torch")
+    discover = tmp_path / "discover.sh"
+    hosts = tmp_path / "hosts.txt"
+    hosts.write_text(hosts_text)
+    discover.write_text(f"#!/bin/sh\ncat {hosts}\n")
+    discover.chmod(0o755)
+    log = tmp_path / "chaos.log"
+    script = tmp_path / "train.py"
+    script.write_text(_ELASTIC_TRAIN)
+
+    env = dict(os.environ)
+    env["HVD_REPO"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env["CHAOS_LOG"] = str(log)
+    env.update(env_extra)
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run",
+         *np_args,
+         "--host-discovery-script", str(discover),
+         "--cycle-time-ms", "1.0",
+         sys.executable, str(script)],
+        env=env, capture_output=True, text=True, timeout=timeout)
+    return proc, log
+
+
+def test_chaos_kill_rank1_blacklists_host_and_completes(tmp_path):
+    """THE acceptance chaos run: HOROVOD_FAULT_SPEC hard-kills rank 1
+    mid-step (no hand-injected os._exit in the training script — the
+    fault plane does it). Deterministically: the survivors restore the
+    last committed state, the driver blacklists rank 1's host after N=1
+    strikes (permanent), and training completes with the shrunk world."""
+    proc, log = _launch_elastic(
+        tmp_path,
+        # Two distinct "hosts", both locally launchable: localhost is
+        # older (rank 0), 127.0.0.1 carries rank 1 — blacklisting it
+        # must not take the survivor down.
+        "localhost:1\n127.0.0.1:1\n",
+        {
+            # Rank 1's 8th host-plane enqueue dies as if OOM-killed.
+            "HOROVOD_FAULT_SPEC":
+                "host_world.enqueue:rank=1:step=8:kind=exit",
+            "HOROVOD_ELASTIC_BLACKLIST_STRIKES": "1",
+            "CHAOS_TARGET": "10",
+        },
+        ["-np", "2", "--min-np", "1", "--max-np", "2"])
+    out = proc.stdout + proc.stderr
+    text = _read(log)
+    assert proc.returncode == 0, out + text
+    # Survivor finished every batch.
+    assert "DONE RANK 0 BATCHES 10" in text, text
+    assert "CHAOS_RANK_0_DONE_10" in proc.stdout, out
+    # The dead host was struck out, permanently, after exactly N=1.
+    assert "host 127.0.0.1 blacklisted (strike 1/1, permanent)" in out, out
+    # Training spanned both worlds: size 2 before the kill, size 1 after.
+    assert "SIZE 2" in text and "SIZE 1" in text, text
+    # Rank 1 really did die mid-run rather than completing.
+    assert "DONE RANK 1" not in text, text
+
+
+@pytest.mark.full
+def test_chaos_strike_two_lives_then_permanent(tmp_path):
+    """Strike/parole composition under repeated deterministic failure:
+    rank 1's host dies on BOTH of its lives (per-process hit counters
+    reset with the respawn, so the same spec fires again), eats strike
+    1/2 (cooldown), returns, eats strike 2/2 (permanent), and the job
+    still completes on the survivor."""
+    proc, log = _launch_elastic(
+        tmp_path,
+        "localhost:1\n127.0.0.1:1\n",
+        {
+            "HOROVOD_FAULT_SPEC":
+                "host_world.enqueue:rank=1:step=7:kind=exit",
+            "HOROVOD_ELASTIC_BLACKLIST_STRIKES": "2",
+            # Parole long enough that strikes never reset mid-test.
+            "HOROVOD_ELASTIC_PAROLE_WINDOW": "600",
+            # The parole-return breadcrumb is INFO-level.
+            "HOROVOD_LOG_LEVEL": "info",
+            "CHAOS_TARGET": "40",
+            "CHAOS_SLEEP": "0.2",
+        },
+        ["-np", "2", "--min-np", "1", "--max-np", "2",
+         "--blacklist-cooldown-range", "1", "2"],
+        timeout=420)
+    out = proc.stdout + proc.stderr
+    text = _read(log)
+    assert proc.returncode == 0, out + text
+    assert "DONE RANK 0 BATCHES 40" in text, text
+    assert "host 127.0.0.1 blacklisted (strike 1/2" in out, out
+    assert "host 127.0.0.1 blacklisted (strike 2/2, permanent)" in out, out
+    assert "returns from blacklist cooldown on parole" in out, out
+    assert "DONE RANK 1" not in text, text
+
+
+# ---- launcher-side cleanup proofs ------------------------------------------
+
+
+def test_run_world_kills_orphaned_grandchildren(tmp_path):
+    """A hung worker that spawned its own child must not outlive a failed
+    run_world: the harness terminates the whole process group and
+    verifies nothing survives."""
+    pidfile = tmp_path / "grandchild.pid"
+    worker = textwrap.dedent(f"""
+        import subprocess, sys, time
+        child = subprocess.Popen([sys.executable, "-c",
+                                  "import time; time.sleep(300)"])
+        open({str(pidfile)!r}, "w").write(str(child.pid))
+        time.sleep(300)  # hang: never prints the sentinel
+    """)
+    with pytest.raises(subprocess.TimeoutExpired):
+        run_world(tmp_path, worker, "NEVER", size=1, timeout=8,
+                  attempts=1)
+    pid = int(_read(pidfile) or "0")
+    assert pid > 0, "worker never started"
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return  # grandchild is gone: no orphans
+        time.sleep(0.1)
+    os.kill(pid, signal.SIGKILL)
+    raise AssertionError(
+        f"grandchild {pid} survived run_world teardown")
+
+
+def test_safe_shell_exec_kills_children_on_parent_interrupt(tmp_path):
+    """The launcher-side analog of worker death: SIGINT on a process
+    blocked in safe_shell_exec.execute() must take the worker's whole
+    process group (grandchildren included) down with it."""
+    pgidfile = tmp_path / "worker.pgid"
+    driver = textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {REPO!r})
+        from horovod_tpu.run.common.util import safe_shell_exec
+        # The worker leads a fresh group ($$ == pgid) and spawns a
+        # grandchild into it; both must die on the driver's SIGINT.
+        safe_shell_exec.execute(
+            "echo $$ > {pgidfile}; sleep 300 & sleep 300")
+    """)
+    script = tmp_path / "driver.py"
+    script.write_text(driver)
+    proc = subprocess.Popen([sys.executable, str(script)],
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.time() + 20.0
+        while time.time() < deadline and not _read(pgidfile).strip():
+            time.sleep(0.1)
+        pgid = int(_read(pgidfile).strip() or "0")
+        assert pgid > 0, "worker shell never started"
+        proc.send_signal(signal.SIGINT)
+        proc.wait(timeout=30)
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            try:
+                os.killpg(pgid, 0)
+            except OSError:
+                return  # whole group gone: no orphans
+            time.sleep(0.1)
+        raise AssertionError(
+            f"worker process group {pgid} survived the parent interrupt")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        try:
+            os.killpg(int(_read(pgidfile).strip() or "0"), signal.SIGKILL)
+        except (OSError, ValueError):
+            pass

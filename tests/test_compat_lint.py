@@ -16,6 +16,7 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPT = os.path.join(REPO, "tools", "lint_compat.sh")
+RETRY_SCRIPT = os.path.join(REPO, "tools", "lint_retry.sh")
 
 
 def test_no_raw_new_jax_apis_outside_compat():
@@ -48,3 +49,37 @@ def test_lint_catches_a_violation(tmp_path):
                        capture_output=True, text=True, timeout=120)
     assert r.returncode == 1, r.stdout + r.stderr
     assert "bad.py" in r.stdout
+
+
+def test_no_bare_retry_sleeps_outside_faults():
+    """Retry-discipline guard (tools/lint_retry.sh): every retry/poll
+    loop routes through common.faults.Retrier; bare time.sleep( outside
+    the allowlist fails tier-1."""
+    r = subprocess.run(["bash", RETRY_SCRIPT], capture_output=True,
+                       text=True, timeout=120)
+    assert r.returncode == 0, (
+        "bare time.sleep( retry loops found (use common.faults.Retrier, "
+        "see docs/fault-injection.md):\n" + r.stdout + r.stderr)
+
+
+def test_retry_lint_catches_a_violation(tmp_path):
+    import shutil
+
+    scratch = tmp_path / "repo"
+    (scratch / "tools").mkdir(parents=True)
+    pkg = scratch / "horovod_tpu"
+    (pkg / "common").mkdir(parents=True)
+    (pkg / "common" / "faults.py").write_text(
+        "import time\ntime.sleep(1)  # the allowed home\n")
+    (pkg / "sneaky.py").write_text(
+        "import time\n"
+        "while True:\n"
+        "    time.sleep(0.5)\n")
+    shutil.copy(RETRY_SCRIPT, scratch / "tools" / "lint_retry.sh")
+    r = subprocess.run(["bash", str(scratch / "tools" / "lint_retry.sh")],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "sneaky.py" in r.stdout
+    # Allowlisted files that are absent (or sleep-free) must not produce
+    # shell arithmetic noise — grep -c's exit-1-on-zero-matches trap.
+    assert "integer expression" not in r.stderr, r.stderr

@@ -1,0 +1,556 @@
+"""Fault-injection plane + shared retry policy + blacklist strike/parole:
+single-process determinism tests (fake clocks, zero real sleeps — the
+multi-process chaos proofs live in test_chaos.py).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu.common import config as _config
+from horovod_tpu.common import faults
+from horovod_tpu.common.exceptions import HorovodInternalError
+from horovod_tpu.run.elastic.discovery import FixedHosts, HostManager
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_env(monkeypatch):
+    monkeypatch.delenv(_config.HOROVOD_FAULT_SPEC, raising=False)
+    faults.refresh()
+    yield
+    faults.refresh()
+
+
+def _arm(monkeypatch, spec: str):
+    monkeypatch.setenv(_config.HOROVOD_FAULT_SPEC, spec)
+    faults.refresh()
+
+
+# ---- spec parsing ----------------------------------------------------------
+
+
+def test_parse_fault_spec_full():
+    (spec,) = _config.parse_fault_spec("ring.exec:rank=1:step=3:kind=exit")
+    assert spec.point == "ring.exec"
+    assert spec.rank == 1 and spec.step == 3
+    assert spec.kind == "exit" and spec.code == 13
+    assert spec.times == 1  # step-pinned faults default to one-shot
+
+
+def test_parse_fault_spec_defaults_and_multi():
+    specs = _config.parse_fault_spec(
+        "host_world.enqueue; rendezvous.poll:kind=delay_ms:ms=5")
+    assert [s.point for s in specs] == ["host_world.enqueue",
+                                       "rendezvous.poll"]
+    first, second = specs
+    assert first.kind == "raise" and first.rank == -1 and first.step == -1
+    assert first.times == 0  # no step -> fires on every hit
+    assert second.ms == 5.0
+
+
+@pytest.mark.parametrize("bad", [
+    "ring.exec:kind=explode",       # unknown kind
+    "ring.exec:rank=x",             # non-int
+    "ring.exec:foo=1",              # unknown key
+    "ring.exec:rank",               # not key=value
+    ":rank=1",                      # empty point
+])
+def test_parse_fault_spec_is_strict(bad):
+    with pytest.raises(ValueError):
+        _config.parse_fault_spec(bad)
+
+
+# ---- fault points ----------------------------------------------------------
+
+
+def test_disabled_point_is_inert():
+    """With the env unset, point() is a no-op: no exception, no counter
+    mutation, no per-call state at all — the byte-identity contract."""
+    for _ in range(1000):
+        assert faults.point("ring.exec") is None
+    assert faults._hits == {}
+    assert faults.active() is False
+
+
+def test_point_fires_on_exact_hit(monkeypatch):
+    _arm(monkeypatch, "ring.exec:rank=0:step=2:kind=raise")
+    faults.point("ring.exec", rank=0)  # hit 0
+    faults.point("ring.exec", rank=0)  # hit 1
+    with pytest.raises(faults.FaultInjected):
+        faults.point("ring.exec", rank=0)  # hit 2 fires
+    faults.point("ring.exec", rank=0)  # one-shot: hit 3 passes
+
+
+def test_point_rank_filter(monkeypatch):
+    _arm(monkeypatch, "ring.exec:rank=1:kind=raise")
+    faults.point("ring.exec", rank=0)  # other rank: inert
+    with pytest.raises(faults.FaultInjected):
+        faults.point("ring.exec", rank=1)
+
+
+def test_point_counters_are_per_point(monkeypatch):
+    _arm(monkeypatch, "ring.exec:step=1:kind=raise")
+    faults.point("host_world.enqueue")  # different point: separate counter
+    faults.point("ring.exec")           # hit 0
+    faults.point("host_world.enqueue")
+    with pytest.raises(faults.FaultInjected):
+        faults.point("ring.exec")       # hit 1
+
+
+def test_point_determinism_across_refresh(monkeypatch):
+    """Same spec + same call sequence -> same firing hit, every time."""
+    for _ in range(3):
+        _arm(monkeypatch, "ring.exec:step=4:kind=raise")
+        fired_at = None
+        for i in range(8):
+            try:
+                faults.point("ring.exec")
+            except faults.FaultInjected:
+                fired_at = i
+        assert fired_at == 4
+
+
+def test_point_delay_kind_uses_injectable_sleep(monkeypatch):
+    slept = []
+    monkeypatch.setattr(faults, "_sleep", slept.append)
+    _arm(monkeypatch, "rendezvous.poll:kind=delay_ms:ms=250")
+    faults.point("rendezvous.poll")
+    faults.point("rendezvous.poll")
+    assert slept == [0.25, 0.25]  # no step -> every hit delays
+
+
+def test_point_drop_conn_kind(monkeypatch):
+    _arm(monkeypatch, "rendezvous.poll:kind=drop_conn")
+    with pytest.raises(ConnectionResetError):
+        faults.point("rendezvous.poll")
+
+
+def test_fault_injected_is_internal_error(monkeypatch):
+    """kind=raise must surface as HorovodInternalError so the elastic
+    retry loop treats an injected failure like a real one."""
+    _arm(monkeypatch, "ring.exec:kind=raise")
+    with pytest.raises(HorovodInternalError):
+        faults.point("ring.exec")
+
+
+def test_point_exit_kind_kills_process(tmp_path):
+    """kind=exit hard-kills the process with the spec'd code (subprocess:
+    os._exit is not mockable politely)."""
+    script = tmp_path / "die.py"
+    script.write_text(
+        "import os, sys\n"
+        f"sys.path.insert(0, {str(_repo_root())!r})\n"
+        "os.environ['HOROVOD_FAULT_SPEC'] = "
+        "'checkpoint.write:step=1:kind=exit:code=7'\n"
+        "from horovod_tpu.common import faults\n"
+        "faults.point('checkpoint.write')\n"
+        "faults.point('checkpoint.write')\n"
+        "print('UNREACHABLE')\n")
+    proc = subprocess.run([sys.executable, str(script)],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 7, proc.stdout + proc.stderr
+    assert "UNREACHABLE" not in proc.stdout
+
+
+def _repo_root():
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---- retry policy env precedence ------------------------------------------
+
+
+def test_retry_policy_env_precedence(monkeypatch):
+    p = _config.retry_policy_from_env("KV", base_delay=0.5)
+    assert p.base_delay == 0.5  # coded default
+    monkeypatch.setenv("HOROVOD_RETRY_BASE_DELAY", "2.0")
+    assert _config.retry_policy_from_env("KV").base_delay == 2.0
+    monkeypatch.setenv("HOROVOD_RETRY_KV_BASE_DELAY", "3.0")
+    assert _config.retry_policy_from_env("KV").base_delay == 3.0
+    # Other scopes keep the global value.
+    assert _config.retry_policy_from_env("RENDEZVOUS").base_delay == 2.0
+    # Unparseable scoped value falls back a level, not to zero.
+    monkeypatch.setenv("HOROVOD_RETRY_KV_BASE_DELAY", "soon")
+    assert _config.retry_policy_from_env("KV").base_delay == 2.0
+
+
+def test_retry_policy_pinned_fields_ignore_env(monkeypatch):
+    """Pinned fields encode call-site correctness contracts (the rejoin
+    poll's unlimited attempts, a caller's short deadline): even scoped
+    envs must not override them."""
+    monkeypatch.setenv("HOROVOD_RETRY_MAX_ATTEMPTS", "3")
+    monkeypatch.setenv("HOROVOD_RETRY_REJOIN_MAX_ATTEMPTS", "5")
+    monkeypatch.setenv("HOROVOD_RETRY_REJOIN_BASE_DELAY", "9.0")
+    p = _config.retry_policy_from_env(
+        "REJOIN", pinned=("max_attempts",), max_attempts=0,
+        base_delay=0.25)
+    assert p.max_attempts == 0       # pinned: env ignored
+    assert p.base_delay == 9.0       # unpinned fields stay tunable
+
+
+# ---- Retrier schedules (fake clock, no real sleeps) ------------------------
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+
+def _retrier(policy, clock, **kw):
+    return faults.Retrier(policy, "test", clock=clock, sleep=clock.sleep,
+                          on_retry=lambda *a: None, rank=0, **kw)
+
+
+def test_retrier_backoff_schedule_no_jitter():
+    policy = _config.RetryPolicy(max_attempts=0, base_delay=1.0,
+                                 max_delay=8.0, multiplier=2.0,
+                                 jitter=False)
+    r = _retrier(policy, _FakeClock())
+    assert [r.backoff(a) for a in range(5)] == [1.0, 2.0, 4.0, 8.0, 8.0]
+
+
+def test_retrier_full_jitter_is_deterministic_by_name_and_rank():
+    policy = _config.RetryPolicy(base_delay=1.0, max_delay=8.0)
+    a = faults.Retrier(policy, "site", rank=1)
+    b = faults.Retrier(policy, "site", rank=1)
+    c = faults.Retrier(policy, "site", rank=2)
+    sched_a = [a.backoff(i) for i in range(6)]
+    sched_b = [b.backoff(i) for i in range(6)]
+    sched_c = [c.backoff(i) for i in range(6)]
+    assert sched_a == sched_b          # reproducible
+    assert sched_a != sched_c          # decorrelated across ranks
+    for i, d in enumerate(sched_a):    # jitter stays under the exp cap
+        assert 0.0 <= d <= min(8.0, 1.0 * 2 ** i)
+
+
+def test_retrier_call_retries_then_succeeds():
+    clock = _FakeClock()
+    policy = _config.RetryPolicy(max_attempts=5, base_delay=1.0,
+                                 jitter=False)
+    calls = []
+
+    def flaky():
+        calls.append(clock.t)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    retries = []
+    r = faults.Retrier(policy, "t", clock=clock, sleep=clock.sleep,
+                       on_retry=lambda att, d, e: retries.append((att, d)))
+    assert r.call(flaky) == "ok"
+    assert len(calls) == 3
+    assert retries == [(0, 1.0), (1, 2.0)]
+    assert clock.t == 3.0  # slept exactly the schedule
+
+
+def test_retrier_call_exhausts_attempts_with_original_error():
+    clock = _FakeClock()
+    policy = _config.RetryPolicy(max_attempts=3, base_delay=1.0,
+                                 jitter=False)
+    r = _retrier(policy, clock)
+
+    def always():
+        raise OSError("nope")
+
+    with pytest.raises(OSError, match="nope"):
+        r.call(always)
+
+
+def test_retrier_call_respects_overall_deadline():
+    clock = _FakeClock()
+    policy = _config.RetryPolicy(max_attempts=0, base_delay=4.0,
+                                 max_delay=4.0, deadline=10.0,
+                                 jitter=False)
+    r = _retrier(policy, clock)
+    calls = []
+
+    def always():
+        calls.append(clock.t)
+        raise OSError("nope")
+
+    with pytest.raises(OSError):
+        r.call(always)
+    # t=0, t=4, t=8 ran; the next sleep would land at 12 > 10 -> stop.
+    assert calls == [0.0, 4.0, 8.0]
+
+
+def test_retrier_call_does_not_catch_unlisted_exceptions():
+    r = _retrier(_config.RetryPolicy(max_attempts=5), _FakeClock())
+
+    def boom():
+        raise ValueError("not retryable")
+
+    with pytest.raises(ValueError):
+        r.call(boom)
+
+
+def test_retrier_poll_returns_value_and_respects_deadline():
+    clock = _FakeClock()
+    policy = _config.RetryPolicy(max_attempts=0, base_delay=1.0,
+                                 max_delay=1.0, deadline=5.0, jitter=False)
+    r = _retrier(policy, clock)
+    state = {"n": 0}
+
+    def ready_at_third():
+        state["n"] += 1
+        return "ep" if state["n"] == 3 else None
+
+    assert r.poll(ready_at_third) == "ep"
+
+    r2 = _retrier(policy, _FakeClock())
+    with pytest.raises(faults.RetryExhausted):
+        r2.poll(lambda: None)
+
+
+def test_retrier_poll_propagates_fn_errors():
+    r = _retrier(_config.RetryPolicy(deadline=5.0), _FakeClock())
+
+    def explode():
+        raise HorovodInternalError("excluded from plan")
+
+    with pytest.raises(HorovodInternalError):
+        r.poll(explode)
+
+
+# ---- blacklist strikes / cooldown / parole (fake clock) --------------------
+
+
+def _manager(clock, hosts=None, cooldown=(10, 10), strikes=3, parole=60.0):
+    disc = FixedHosts(hosts or {"a": 1, "b": 1})
+    return HostManager(disc, cooldown_range=cooldown, max_strikes=strikes,
+                       parole_window=parole, clock=clock)
+
+
+def test_blacklist_cooldown_then_parole_then_forgiveness():
+    clock = _FakeClock()
+    mgr = _manager(clock)
+    mgr.update_available_hosts()
+    mgr.blacklist("b")
+    assert mgr.is_blacklisted("b")
+    info = mgr.blacklist_info()["b"]
+    assert info["strikes"] == 1 and not info["permanent"]
+
+    # Cooldown (10 s) expires -> host returns ON PAROLE.
+    clock.t = 11.0
+    mgr.update_available_hosts()
+    assert [h for h, _ in mgr.current_hosts] == ["a", "b"]
+    assert mgr.blacklist_info()["b"]["on_parole"] is True
+    assert mgr.blacklist_info()["b"]["strikes"] == 1  # strikes stand
+
+    # Clean parole window (60 s) served -> strikes forgiven.
+    clock.t = 72.0
+    mgr.update_available_hosts()
+    assert mgr.blacklist_info().get("b", {"strikes": 0})["strikes"] == 0
+
+
+def test_blacklist_goes_permanent_at_strike_limit():
+    clock = _FakeClock()
+    mgr = _manager(clock, strikes=2)
+    mgr.update_available_hosts()
+    mgr.blacklist("b")                 # strike 1: cooldown
+    assert not mgr.blacklist_info()["b"]["permanent"]
+    clock.t = 11.0
+    mgr.update_available_hosts()       # returns on parole
+    mgr.blacklist("b")                 # strike 2: permanent
+    info = mgr.blacklist_info()["b"]
+    assert info["permanent"] and info["until"] == float("inf")
+    clock.t = 1e9
+    mgr.update_available_hosts()       # never comes back
+    assert [h for h, _ in mgr.current_hosts] == ["a"]
+    assert not mgr.has_recoverable_hosts()
+
+
+def test_blacklist_failure_during_parole_strikes_again():
+    clock = _FakeClock()
+    mgr = _manager(clock, strikes=3)
+    mgr.update_available_hosts()
+    mgr.blacklist("b")
+    clock.t = 11.0
+    mgr.update_available_hosts()       # on parole, strikes=1
+    mgr.blacklist("b")                 # fails during parole
+    info = mgr.blacklist_info()["b"]
+    assert info["strikes"] == 2 and not info["on_parole"]
+
+
+def test_blacklist_one_incident_one_strike():
+    """A host running N workers fans N record_failure calls into
+    blacklist() when it dies; calls landing while the host is already
+    excluded are the SAME incident — without the dedupe a 3-slot host
+    would go permanent on its first crash."""
+    clock = _FakeClock()
+    mgr = _manager(clock, hosts={"a": 1, "b": 3}, strikes=3)
+    mgr.update_available_hosts()
+    for _ in range(3):  # all three of b's workers die in one crash
+        mgr.blacklist("b")
+    info = mgr.blacklist_info()["b"]
+    assert info["strikes"] == 1 and not info["permanent"]
+    assert len(mgr.blacklist_events()) == 1
+    # A NEW incident after the host returns does strike again.
+    clock.t = 11.0
+    mgr.update_available_hosts()
+    mgr.blacklist("b")
+    assert mgr.blacklist_info()["b"]["strikes"] == 2
+
+
+def test_blacklist_no_cooldown_range_is_immediately_permanent():
+    clock = _FakeClock()
+    mgr = _manager(clock, cooldown=None)
+    mgr.update_available_hosts()
+    mgr.blacklist("b")
+    assert mgr.blacklist_info()["b"]["permanent"]
+
+
+def test_blacklist_events_and_observer():
+    clock = _FakeClock()
+    mgr = _manager(clock)
+    seen = []
+    mgr.set_on_blacklist(lambda host, info: seen.append((host, info)))
+    mgr.update_available_hosts()
+    mgr.blacklist("a")
+    assert [e["host"] for e in mgr.blacklist_events()] == ["a"]
+    assert seen and seen[0][0] == "a" and seen[0][1]["strikes"] == 1
+
+
+def test_blacklist_strikes_env_default(monkeypatch):
+    monkeypatch.setenv(_config.HOROVOD_ELASTIC_BLACKLIST_STRIKES, "1")
+    clock = _FakeClock()
+    mgr = HostManager(FixedHosts({"a": 1}), cooldown_range=(5, 5),
+                      clock=clock)
+    mgr.update_available_hosts()
+    mgr.blacklist("a")  # env strikes=1 -> first failure is permanent
+    assert mgr.blacklist_info()["a"]["permanent"]
+
+
+def test_min_np_timeout_error_names_blacklisted_hosts():
+    from horovod_tpu.run.elastic.driver import ElasticDriver
+
+    class _Rdv:
+        def init(self, plan, rendezvous_round=0):
+            pass
+
+    clock = _FakeClock()
+    driver = ElasticDriver(_Rdv(), FixedHosts({"a": 1, "b": 1}),
+                           min_np=2, timeout=0.2)
+    driver.host_manager.update_available_hosts()
+    driver.host_manager.blacklist("b")
+    with pytest.raises(TimeoutError) as e:
+        driver.wait_for_available_slots(2)
+    msg = str(e.value)
+    assert "b" in msg and "strikes" in msg
+    driver.stop()
+
+
+# ---- retry_loop hardening: HorovodInternalError inside commit() ------------
+
+
+def test_retry_loop_survives_commit_failure():
+    """A HorovodInternalError raised INSIDE state.commit() (the snapshot
+    itself dying with the world) must restore the last good snapshot and
+    re-rendezvous — not lose the step, not corrupt the snapshot pair."""
+    from horovod_tpu.elastic.state import ObjectState, retry_loop
+
+    class FlakyState(ObjectState):
+        def save(self):
+            if getattr(self, "_fail_next_save", False):
+                self._fail_next_save = False
+                raise HorovodInternalError("world died mid-commit")
+            super().save()
+
+    state = FlakyState(bcast_object=lambda obj, root_rank=0: obj, batch=0)
+    reinits = []
+
+    def reinitialize():
+        reinits.append(True)
+
+    log = []
+
+    def train(state):
+        while state.batch < 6:
+            state.batch += 1
+            if state.batch == 4 and not reinits:
+                state._fail_next_save = True
+            log.append(state.batch)
+            state.commit()
+        return state.batch
+
+    assert retry_loop(train, reinitialize)(state) == 6
+    assert len(reinits) == 1
+    # The failed commit at batch 4 rolled back to the batch-3 snapshot:
+    # batch 4 was re-trained, and no later batch was lost.
+    assert log == [1, 2, 3, 4, 4, 5, 6]
+
+
+def test_jax_state_save_failure_keeps_snapshot_pair_consistent():
+    """JaxState.save dying AFTER the tree snapshot but before the attr
+    snapshot must leave BOTH halves at the last committed values (a
+    mixed pair restores an advanced step counter onto stale weights)."""
+    import numpy as np
+
+    from horovod_tpu.elastic.state import JaxState
+
+    class _Poison:
+        """Deepcopy-time bomb: stands in for an attr whose snapshot dies
+        with the world mid-commit."""
+
+        def __deepcopy__(self, memo):
+            raise HorovodInternalError("attr snapshot died")
+
+    state = JaxState(tree={"w": np.zeros(2)}, place=lambda t: t, batch=0)
+    state.tree = {"w": np.ones(2)}
+    state.batch = 5
+    state.commit()  # good commit: tree=ones, batch=5
+
+    state.tree = {"w": np.full(2, 7.0)}
+    state.batch = 9
+    state.poison = _Poison()
+    with pytest.raises(HorovodInternalError):
+        state.commit()  # dies mid-save
+
+    del state.poison
+    state.restore()
+    np.testing.assert_array_equal(state.tree["w"], np.ones(2))
+    assert state.batch == 5  # the PAIR from the last good commit
+
+
+# ---- stall report ----------------------------------------------------------
+
+
+def test_stall_report_empty_safe():
+    import horovod_tpu as hvd
+
+    assert hvd.stall_report() == ""
+
+
+def test_stall_report_drains_core_and_records_timeline(monkeypatch):
+    import horovod_tpu as hvd
+    from horovod_tpu.common import state as _state
+    from horovod_tpu.common import timeline as _timeline
+
+    class _Core:
+        def stall_report(self):
+            return "rank 1 missing tensor grad.b3 for 61s"
+
+    class _Engine:
+        native_core = _Core()
+
+    events = []
+
+    class _Timeline:
+        def instant(self, name, args=None):
+            events.append((name, args))
+
+    st = _state.global_state()
+    monkeypatch.setattr(st, "initialized", True)
+    monkeypatch.setattr(st, "engine", _Engine())
+    monkeypatch.setattr(st, "timeline", _Timeline())
+    report = hvd.stall_report()
+    assert "grad.b3" in report
+    assert events == [(_timeline.STALL_WARNING, {"report": report})]

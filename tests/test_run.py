@@ -494,7 +494,7 @@ def test_elastic_timeout_reaches_driver(monkeypatch, tmp_path):
 
     class FakeDriver:
         def __init__(self, rendezvous, discovery, min_np, max_np,
-                     timeout, cooldown_range, verbose):
+                     timeout, cooldown_range, verbose, timeline=None):
             seen["timeout"] = timeout
             raise RuntimeError("stop here")
 

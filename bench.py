@@ -595,6 +595,20 @@ def worker(argv):
         "fusion": fusion_cfg,
         "compression": compression_cfg,
     }
+    # Host data-plane traffic shape (docs/hierarchical.md): the
+    # local/cross byte split plus the effective two-level dispatch, read
+    # AFTER the timed loop so the counters cover the run. Zeros/False on
+    # a pure-XLA single-process bench (no host ring) — the fields still
+    # ride the JSON so every BENCH artifact records which plane moved
+    # the bytes and whether the hierarchical path was on.
+    traffic = hvd.ring_traffic()
+    result["ring_local_bytes"] = traffic["local_bytes"]
+    result["ring_cross_bytes"] = traffic["cross_bytes"]
+    result["host_hierarchical"] = {
+        "allreduce": traffic["hierarchical_allreduce"],
+        "allgather": traffic["hierarchical_allgather"],
+        "tuned": traffic["tuned"],
+    }
     if step_times:
         # Per-step rates + a 95% CI (the reference benchmark's
         # mean +- 1.96*std protocol, pytorch_synthetic_benchmark.py:115).

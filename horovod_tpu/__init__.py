@@ -163,20 +163,10 @@ def stall_report() -> str:
     Consuming a non-empty report also records a ``STALL_WARNING`` instant
     in the timeline (when one is active), so stalls line up with the
     collectives that caused them in post-mortems."""
-    core = None
-    st = _global_state()
-    if st.initialized and st.engine is not None:
-        core = getattr(st.engine, "native_core", None)
-    if core is None:
-        # The host (process-rank) plane may own the core instead — e.g.
-        # torch/tensorflow bindings without a live XLA engine.
-        from .common import host_world as _host_world
-
-        world = _host_world.world()
-        if world.initialized:
-            core = world._core
+    core = _native_core()
     if core is None:
         return ""
+    st = _global_state()
     report = core.stall_report()
     if report and st.initialized and st.timeline is not None:
         from .common import timeline as _timeline_mod
@@ -184,6 +174,48 @@ def stall_report() -> str:
         st.timeline.instant(_timeline_mod.STALL_WARNING,
                             {"report": report})
     return report
+
+
+def _native_core():
+    """The process's live NativeCore: the XLA engine's when one runs,
+    else the host (process-rank) world's. None in pure-direct mode."""
+    st = _global_state()
+    if st.initialized and st.engine is not None:
+        core = getattr(st.engine, "native_core", None)
+        if core is not None:
+            return core
+    from .common import host_world as _host_world
+
+    world = _host_world.world()
+    return world._core if world.initialized else None
+
+
+def ring_traffic() -> dict:
+    """Host data-plane traffic accounting with the local/cross split.
+
+    Returns a dict with ``bytes_sent`` (every payload byte this process
+    put on the host TCP plane), ``local_bytes`` (to same-host peers —
+    the loopback legs of the hierarchical collectives), ``cross_bytes``
+    (to peers on other hosts: the scarce budget the two-level paths
+    minimize; see ``docs/hierarchical.md``), the effective
+    ``hierarchical_allreduce``/``hierarchical_allgather`` host-plane
+    dispatch (autotuner-synced value when present, else the env config),
+    and ``tuned`` (True once an autotuner decision reached this rank).
+    All zeros/False before init or in pure-XLA direct mode."""
+    core = _native_core()
+    if core is None:
+        return {"bytes_sent": 0, "local_bytes": 0, "cross_bytes": 0,
+                "hierarchical_allreduce": False,
+                "hierarchical_allgather": False, "tuned": False}
+    flags = core.host_hier_flags()
+    return {
+        "bytes_sent": core.ring_bytes_sent(),
+        "local_bytes": core.ring_local_bytes(),
+        "cross_bytes": core.ring_cross_bytes(),
+        "hierarchical_allreduce": bool(flags & 1),
+        "hierarchical_allgather": bool(flags & 2),
+        "tuned": core.get_hier_flags() >= 0,
+    }
 
 
 def join() -> int:

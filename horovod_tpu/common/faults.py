@@ -40,6 +40,9 @@ CATALOG = (
     "rendezvous.poll",       # elastic slot-layout fetch from the KV
     "rendezvous.endpoint",   # controller-endpoint poll from the KV
     "ring.exec",             # blocking wait on a host-ring collective
+    "ring.hier.cross",       # same seam, armed only on a local leader of a
+                             # hierarchical multi-host world — kills/delays
+                             # the rank carrying the cross-host leg
     "xla.exec",              # eager engine executing an XLA-plane response
     "elastic.worker.start",  # driver-side worker launch (slot.rank)
     "checkpoint.write",      # CheckpointManager.save

@@ -107,6 +107,11 @@ class HostWorld:
         self._core: Optional[_native.NativeCore] = None
         self._owns_core = False
         self._staging = None  # host_staging.HostStagingExecutor when active
+        # True when this rank is a local leader on a hierarchical
+        # multi-host world — the rank whose background thread carries the
+        # cross-host leg of the two-level collectives. Gates the
+        # ring.hier.cross fault point (chaos-testing leader death).
+        self._hier_cross_seam = False
         # (addr, port) fetched from the elastic rendezvous KV this round;
         # overrides the launch-time HOROVOD_CONTROLLER_ADDR/PORT env, which
         # goes stale once rank 0 migrates to a different host.
@@ -171,6 +176,12 @@ class HostWorld:
                 # no controller or ring needed.
                 self._core = None
             self._staging = None
+            cfg = _config.RuntimeConfig.from_env()
+            self._hier_cross_seam = (
+                self.size > 1 and self.cross_size > 1
+                and self.local_rank == 0
+                and (cfg.hierarchical_allreduce or
+                     cfg.hierarchical_allgather))
             if self._core is not None:
                 from . import host_staging
 
@@ -419,6 +430,7 @@ class HostWorld:
             self._core = None
             self._staging = None
             self._elastic_controller = None
+            self._hier_cross_seam = False
             self.initialized = False
             self.rank, self.size = 0, 1
             self.local_rank, self.local_size = 0, 1
@@ -471,6 +483,12 @@ class HostWorld:
         # kills the worker mid-step, after its tensor was submitted —
         # the canonical chaos-test death (docs/fault-injection.md).
         _faults.point("ring.exec", rank=self.rank)
+        if self._hier_cross_seam:
+            # Local leader of a hierarchical world: this process's
+            # background thread carries the cross-host leg, so a fault
+            # here is "the leader died mid cross-exchange" — the
+            # highest-blast-radius death the two-level path adds.
+            _faults.point("ring.hier.cross", rank=self.rank)
         return core.wait(handle)
 
     # -- small helper collectives (numpy, blocking) --------------------------

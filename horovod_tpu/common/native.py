@@ -185,6 +185,12 @@ def _bind_prototypes(lib):
     lib.hvd_get_fusion_threshold.restype = ctypes.c_longlong
     lib.hvd_ring_bytes_sent.restype = ctypes.c_longlong
     lib.hvd_ring_bytes_sent.argtypes = []
+    lib.hvd_ring_local_bytes.restype = ctypes.c_longlong
+    lib.hvd_ring_local_bytes.argtypes = []
+    lib.hvd_ring_cross_bytes.restype = ctypes.c_longlong
+    lib.hvd_ring_cross_bytes.argtypes = []
+    lib.hvd_host_hier_flags.restype = ctypes.c_int
+    lib.hvd_host_hier_flags.argtypes = []
     _lib = lib
     return _lib
 
@@ -443,6 +449,23 @@ class NativeCore:
         """Payload bytes this rank has sent on the host data plane (ring
         + VHDD peer links). Test hook for traffic-complexity assertions."""
         return int(self.lib.hvd_ring_bytes_sent())
+
+    def ring_local_bytes(self) -> int:
+        """Host-plane bytes this rank sent to SAME-host peers (loopback
+        links of the hierarchical paths)."""
+        return int(self.lib.hvd_ring_local_bytes())
+
+    def ring_cross_bytes(self) -> int:
+        """Host-plane bytes this rank sent to peers on OTHER hosts — the
+        scarce cross-host budget the hierarchical paths minimize."""
+        return int(self.lib.hvd_ring_cross_bytes())
+
+    def host_hier_flags(self) -> int:
+        """The EFFECTIVE host-plane hierarchical dispatch (bit0 =
+        allreduce, bit1 = allgather): the autotuner's synced value when
+        present, else the env default — unlike ``get_hier_flags``, which
+        reports only the tuned value (-1 until a tuner syncs one)."""
+        return int(self.lib.hvd_host_hier_flags())
 
     def set_record_negotiation(self, enabled: bool) -> None:
         """Record per-rank submission ticks on the coordinator (reference

@@ -230,7 +230,18 @@ Status TcpController::Initialize() {
     worker_socks_.resize(cfg_.size - 1);
     data_endpoints_.assign(cfg_.size, {"", 0});
     data_endpoints_[0] = {my_host_, data_port_};
-    // Accept size-1 hellos: "rank host data_port job_key". The job key
+    // Every rank defaults to its own host group until its hello says
+    // otherwise — the conservative stance matching the ring's
+    // no-topology accounting (each process presumed on its own node).
+    // The sentinel size+r cannot collide with any reported host-group
+    // id (those are host indices < size), so a rank whose hello omits
+    // the cross field can never be folded into a real host's group.
+    cross_ranks_.assign(cfg_.size, 0);
+    for (int r = 0; r < cfg_.size; ++r) cross_ranks_[r] = cfg_.size + r;
+    cross_ranks_[0] = cfg_.cross_rank;
+    // Accept size-1 hellos: "rank host data_port job_key cross_rank".
+    // An empty job key travels as the "-" placeholder so the
+    // whitespace-delimited field positions stay fixed. The job key
     // guards against two jobs sharing one host colliding on the default
     // controller port: a worker from another job is rejected loudly
     // instead of being adopted into the wrong world. A wall-clock
@@ -259,12 +270,12 @@ Status TcpController::Initialize() {
         --i;
         continue;
       }
-      int rank = 0, port = 0;
+      int rank = 0, port = 0, cross = -1;
       char host[256] = {0};
       char key[256] = {0};
       int fields =
-          std::sscanf(hello.c_str(), "%d %255s %d %255s", &rank, host,
-                      &port, key);
+          std::sscanf(hello.c_str(), "%d %255s %d %255s %d", &rank, host,
+                      &port, key, &cross);
       if (fields < 3 || rank <= 0 || rank >= cfg_.size) {
         std::fprintf(stderr,
                      "[horovod_tpu coordinator] ignoring malformed hello "
@@ -273,7 +284,9 @@ Status TcpController::Initialize() {
         --i;
         continue;
       }
-      if (std::string(key) != cfg_.job_key) {
+      std::string peer_key = fields >= 4 ? key : "";
+      if (peer_key == "-") peer_key = "";
+      if (peer_key != cfg_.job_key) {
         // A stray worker from another job: reject it loudly and keep
         // accepting — one foreign packet must not kill this job's startup.
         std::fprintf(stderr,
@@ -286,14 +299,18 @@ Status TcpController::Initialize() {
         continue;
       }
       data_endpoints_[rank] = {host, port};
+      if (fields >= 5) cross_ranks_[rank] = cross;
       worker_socks_[rank - 1] = std::move(s);
     }
-    // Broadcast the endpoint map.
+    // Broadcast the endpoint map with the host-topology column: every
+    // rank ends up with the same rank -> (host, port, cross_rank) table,
+    // so the ring's hierarchical grouping needs no further exchange.
     Writer w;
     w.i32(cfg_.size);
-    for (auto& ep : data_endpoints_) {
-      w.str(ep.first);
-      w.i32(ep.second);
+    for (int r = 0; r < cfg_.size; ++r) {
+      w.str(data_endpoints_[r].first);
+      w.i32(data_endpoints_[r].second);
+      w.i32(cross_ranks_[r]);
     }
     for (auto& s : worker_socks_) {
       if (!s.SendFrame(w.data())) {
@@ -311,7 +328,9 @@ Status TcpController::Initialize() {
                                std::to_string(cfg_.coordinator_port));
     }
     std::string hello = std::to_string(cfg_.rank) + " " + my_host_ + " " +
-                        std::to_string(data_port_) + " " + cfg_.job_key;
+                        std::to_string(data_port_) + " " +
+                        (cfg_.job_key.empty() ? "-" : cfg_.job_key) + " " +
+                        std::to_string(cfg_.cross_rank);
     if (!coord_sock_.SendFrame(hello)) {
       return Status::Error(StatusType::UNKNOWN_ERROR, "hello send failed");
     }
@@ -333,10 +352,12 @@ Status TcpController::Initialize() {
       return Status::Error(StatusType::UNKNOWN_ERROR, "endpoint map mismatch");
     }
     data_endpoints_.clear();
+    cross_ranks_.assign(n, 0);
     for (int i = 0; i < n; ++i) {
       std::string host = r.str();
       int port = r.i32();
       data_endpoints_.emplace_back(host, port);
+      cross_ranks_[i] = r.i32();
     }
   }
   return Status::OK();

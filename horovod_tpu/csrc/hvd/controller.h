@@ -13,6 +13,7 @@
 #ifndef HVD_CONTROLLER_H_
 #define HVD_CONTROLLER_H_
 
+#include <algorithm>
 #include <atomic>
 #include <cstdint>
 #include <map>
@@ -31,6 +32,10 @@ namespace hvd {
 struct ControllerConfig {
   int rank = 0;
   int size = 1;
+  // This rank's host group (node index). Exchanged at world join so the
+  // ring data plane can install the full rank -> host table (hierarchical
+  // dispatch + the local/cross traffic split).
+  int cross_rank = 0;
   std::string coordinator_addr = "127.0.0.1";
   int coordinator_port = 0;
   int64_t fusion_threshold_bytes = 64 * 1024 * 1024;
@@ -48,7 +53,14 @@ class Controller {
  public:
   explicit Controller(ControllerConfig cfg)
       : cfg_(std::move(cfg)),
-        fusion_threshold_bytes_(cfg_.fusion_threshold_bytes) {}
+        fusion_threshold_bytes_(cfg_.fusion_threshold_bytes) {
+    // Pre-exchange default: only this rank's own group is known; the TCP
+    // controller replaces the table with the exchanged one at Initialize.
+    cross_ranks_.assign(std::max(cfg_.size, 1), 0);
+    if (cfg_.rank >= 0 && cfg_.rank < cfg_.size) {
+      cross_ranks_[cfg_.rank] = cfg_.cross_rank;
+    }
+  }
   virtual ~Controller() = default;
 
   // Runtime-tunable (autotuner): read each cycle by the fusion planner.
@@ -101,6 +113,9 @@ class Controller {
   const std::vector<std::pair<std::string, int>>& data_endpoints() const {
     return data_endpoints_;
   }
+  // Per-rank host groups (rank -> cross_rank), exchanged alongside the
+  // endpoint map. Feeds Ring::SetTopology.
+  const std::vector<int>& cross_ranks() const { return cross_ranks_; }
   const ControllerConfig& config() const { return cfg_; }
   // Accumulated stall-inspector warnings (coordinator only). Consumes and
   // returns at most max_bytes so a bounded caller buffer never silently
@@ -176,6 +191,7 @@ class Controller {
   std::mutex events_mu_;
   std::vector<NegotiationEvent> events_;
   std::vector<std::pair<std::string, int>> data_endpoints_;
+  std::vector<int> cross_ranks_;
   std::string stall_report_;
 };
 

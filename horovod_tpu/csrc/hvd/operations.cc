@@ -16,6 +16,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <cctype>
 #include <chrono>
 #include <condition_variable>
 #include <cstdlib>
@@ -124,11 +125,16 @@ struct GlobalState {
   std::atomic<long long> host_via_xla_threshold{-1};
 
   // Autotuned categorical dispatch flags (bit0 = hierarchical allreduce,
-  // bit1 = hierarchical allgather; -1 = untuned — Python falls back to
-  // the env config). Applied at frame boundaries from the controller's
-  // synced value; stamped into each response frame handed to the
-  // executor so dispatch is frame-exact on every rank.
+  // bit1 = hierarchical allgather; -1 = untuned — fall back to the env
+  // config). Applied at frame boundaries from the controller's synced
+  // value; stamped into each response frame handed to the executor so
+  // dispatch is frame-exact on every rank. The HOST plane consumes the
+  // same bits in ExecuteHostResponse, so the autotuner's categorical
+  // grid tunes a real host-plane routing choice too.
   std::atomic<int> hier_flags{-1};
+  // Untuned default from HOROVOD_HIERARCHICAL_ALLREDUCE/ALLGATHER (read
+  // at init; must agree across ranks, like every dispatch env).
+  int hier_env_flags = 0;
 
   // executor-allocated results, keyed by handle (fetched then erased)
   std::mutex results_mu;
@@ -138,6 +144,31 @@ struct GlobalState {
 GlobalState* g() {
   static GlobalState* state = new GlobalState();
   return state;
+}
+
+bool EnvFlag(const char* name) {
+  // Mirrors common/config.py _get_bool: only an explicit true-ish value
+  // enables the flag, so "False"/"no"/"off" mean the same thing to the
+  // host plane as to every Python-side consumer of the same variable.
+  const char* v = std::getenv(name);
+  if (v == nullptr) return false;
+  std::string s(v);
+  size_t b = s.find_first_not_of(" \t");
+  size_t e = s.find_last_not_of(" \t");
+  s = (b == std::string::npos) ? "" : s.substr(b, e - b + 1);
+  for (auto& c : s) c = static_cast<char>(std::tolower(c));
+  return s == "1" || s == "true" || s == "yes" || s == "on";
+}
+
+// Effective hierarchical-dispatch bit for the host plane: the tuner's
+// frame-synced flags when present, else the env default. Frame-exact:
+// synced flags are applied in RunLoopOnce before PerformOperation runs
+// this frame's responses, so every rank routes identically.
+bool HostHierBit(int bit) {
+  auto* s = g();
+  int hf = s->hier_flags.load();
+  int flags = hf >= 0 ? hf : s->hier_env_flags;
+  return ((flags >> bit) & 1) != 0;
 }
 
 void ExecuteHostResponse(const Response& resp,
@@ -169,6 +200,7 @@ void ExecuteHostResponse(const Response& resp,
         }
         off += n;
       }
+      bool hier_ar = resp.reduce_op != ReduceOp::ADASUM && HostHierBit(0);
       if (resp.reduce_op == ReduceOp::ADASUM) {
         // Per-tensor boundaries ride into the fused Adasum: the
         // combination's dot/norm coefficients are computed per tensor,
@@ -182,6 +214,12 @@ void ExecuteHostResponse(const Response& resp,
         st = s->ring->AdasumAllreduce(fusion.data(), fusion.data(),
                                       tensor_counts, resp.dtype,
                                       resp.prescale, resp.postscale);
+      } else if (hier_ar) {
+        // Two-level local-leader route (tuned bit0 / env default): the
+        // fused buffer crosses hosts once per host, not once per rank.
+        st = s->ring->HierAllreduce(fusion.data(), fusion.data(), total,
+                                    resp.dtype, resp.reduce_op,
+                                    resp.prescale, resp.postscale);
       } else {
         st = s->ring->Allreduce(fusion.data(), fusion.data(), total,
                                 resp.dtype, resp.reduce_op, resp.prescale,
@@ -203,6 +241,7 @@ void ExecuteHostResponse(const Response& resp,
       break;
     }
     case CollectiveOp::ALLGATHER: {
+      bool hier_ag = HostHierBit(1);
       std::unordered_map<std::string, TensorTableEntry*> by_name;
       for (auto& e : entries) by_name[e.name] = &e;
       for (size_t i = 0; i < resp.tensor_names.size(); ++i) {
@@ -227,7 +266,11 @@ void ExecuteHostResponse(const Response& resp,
         }
         if (e.output != nullptr) {
           // Caller-preallocated output (equal-shape fast path).
-          st = s->ring->Allgatherv(e.data, e.output, counts, resp.dtype);
+          st = hier_ag
+                   ? s->ring->HierAllgatherv(e.data, e.output, counts,
+                                             resp.dtype)
+                   : s->ring->Allgatherv(e.data, e.output, counts,
+                                         resp.dtype);
         } else {
           // Ragged path: executor allocates; caller fetches by handle
           // after the wait resolves.
@@ -240,8 +283,11 @@ void ExecuteHostResponse(const Response& resp,
                   ? *fd
                   : std::vector<int64_t>(counts.size(),
                                          sh.ndim() > 0 ? sh.dim(0) : 1);
-          st = s->ring->Allgatherv(e.data, rb.bytes.data(), counts,
-                                   resp.dtype);
+          st = hier_ag
+                   ? s->ring->HierAllgatherv(e.data, rb.bytes.data(),
+                                             counts, resp.dtype)
+                   : s->ring->Allgatherv(e.data, rb.bytes.data(), counts,
+                                         resp.dtype);
           if (st.ok()) {
             std::lock_guard<std::mutex> lk(s->results_mu);
             s->results[e.handle] = std::move(rb);
@@ -370,7 +416,19 @@ bool RunLoopOnce(std::chrono::steady_clock::time_point& last_cycle) {
                                  std::chrono::steady_clock::duration>(
                                  std::chrono::duration<double, std::milli>(
                                      s->cycle_time_ms));
-  if (now < target) std::this_thread::sleep_for(target - now);
+  // Latency fast path: the cycle sleep exists to batch submissions and
+  // bound idle polling, but once requests are queued it only delays
+  // them. The wait is interruptible — a LOCAL enqueue landing mid-sleep
+  // wakes this rank's loop at once (TensorQueue::WaitForMessages), so a
+  // rank's own submissions reach the wire without waiting out the
+  // cycle. The coordinator still reads worker sockets only at its own
+  // tick, so a worker-initiated round can wait up to one residual
+  // coordinator cycle; cycle_time_ms therefore still bounds (not adds
+  // to) cross-rank RTT. Idle ranks pace the world at cycle_time and
+  // nothing busy-spins: the queue drains every cycle.
+  if (now < target) {
+    s->tensor_queue.WaitForMessages(target);
+  }
   last_cycle = std::chrono::steady_clock::now();
 
   bool want_shutdown = s->shutdown_requested.load();
@@ -430,6 +488,9 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   // A fresh world starts from the env config; a previous world's tuned
   // dispatch flags must not leak through re-init.
   s->hier_flags.store(-1);
+  s->hier_env_flags =
+      (hvd::EnvFlag("HOROVOD_HIERARCHICAL_ALLREDUCE") ? 1 : 0) |
+      (hvd::EnvFlag("HOROVOD_HIERARCHICAL_ALLGATHER") ? 2 : 0);
   s->rank = rank;
   s->size = size;
   s->local_rank = local_rank;
@@ -444,6 +505,7 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   hvd::ControllerConfig cfg;
   cfg.rank = rank;
   cfg.size = size;
+  cfg.cross_rank = cross_rank;
   cfg.coordinator_addr = coordinator_addr ? coordinator_addr : "127.0.0.1";
   cfg.coordinator_port = coordinator_port;
   cfg.fusion_threshold_bytes = static_cast<int64_t>(fusion_threshold);
@@ -491,6 +553,9 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
                    st.reason().c_str());
       return -1;
     }
+    // Host topology from the controller's exchanged table: enables the
+    // two-level hierarchical paths and the local/cross traffic split.
+    s->ring->SetTopology(s->controller->cross_ranks());
   }
   s->background = std::thread(hvd::BackgroundLoop);
   s->initialized.store(true);
@@ -781,6 +846,31 @@ int hvd_last_joined() { return hvd::g()->last_joined.load(); }
 long long hvd_ring_bytes_sent() {
   auto* s = hvd::g();
   return s->ring ? s->ring->bytes_sent() : 0;
+}
+
+// Split traffic accounting: bytes to same-host peers (loopback links) vs
+// different-host peers (the scarce cross-host budget). local + cross ==
+// bytes_sent once a topology is installed; without one everything is
+// accounted cross (one process per host presumed).
+long long hvd_ring_local_bytes() {
+  auto* s = hvd::g();
+  return s->ring ? s->ring->local_bytes_sent() : 0;
+}
+
+long long hvd_ring_cross_bytes() {
+  auto* s = hvd::g();
+  return s->ring ? s->ring->cross_bytes_sent() : 0;
+}
+
+// The EFFECTIVE host-plane hierarchical dispatch flags this process would
+// apply right now: the tuner's synced value when present, else the env
+// default (bit0 = allreduce, bit1 = allgather). Observability for
+// hvd.ring_traffic() / bench.py — hvd_get_hier_flags reports only the
+// tuned value (-1 when untuned).
+int hvd_host_hier_flags() {
+  auto* s = hvd::g();
+  int hf = s->hier_flags.load();
+  return hf >= 0 ? hf : s->hier_env_flags;
 }
 
 // Poll: 0 pending, 1 done-ok, -1 done-error.

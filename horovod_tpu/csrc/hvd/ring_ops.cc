@@ -159,7 +159,68 @@ void ScaleBuffer(void* data, int64_t n, DataType dt, double factor) {
   }
 }
 
+// Small-payload routing threshold (wire bytes): at or under it allreduces
+// take the binomial-tree path instead of the chunked ring. The ring is
+// bandwidth-optimal but its 2*(N-1) lock-stepped steps each wake every
+// process — latency-hostile for the few-byte tensors of the cached
+// negotiation fast path. Read once per process.
+long long TreeThresholdBytes() {
+  static const long long v = [] {
+    const char* e = std::getenv("HOROVOD_RING_TREE_THRESHOLD");
+    if (e != nullptr && *e != 0) {
+      char* end = nullptr;
+      long long n = std::strtoll(e, &end, 10);
+      if (end != nullptr && *end == 0 && n >= 0) return n;
+    }
+    return 16384LL;
+  }();
+  return v;
+}
+
 }  // namespace
+
+void Ring::SetTopology(const std::vector<int>& cross_ranks) {
+  if (static_cast<int>(cross_ranks.size()) != size_) return;
+  cross_ranks_ = cross_ranks;
+  // Host groups keyed by cross_rank; members ascend within a group, so
+  // every rank derives the identical leader (the group's lowest rank)
+  // without another exchange. Groups are then ordered by leader rank
+  // ascending — the tree/sub-ring index math over `leaders_` requires a
+  // sorted rank list, and cross_rank values carry no such guarantee.
+  std::map<int, std::vector<int>> by_host;
+  for (int r = 0; r < size_; ++r) by_host[cross_ranks[r]].push_back(r);
+  std::map<int, std::vector<int>> by_leader;
+  for (auto& kv : by_host) by_leader[kv.second.front()] = kv.second;
+  groups_.clear();
+  leaders_.clear();
+  group_.clear();
+  group_idx_ = -1;
+  for (auto& kv : by_leader) {
+    if (cross_ranks_[kv.first] == cross_ranks_[rank_]) {
+      group_idx_ = static_cast<int>(leaders_.size());
+      group_ = kv.second;
+    }
+    leaders_.push_back(kv.first);
+    groups_.push_back(kv.second);
+  }
+}
+
+bool Ring::IsCrossHost(int peer) const {
+  // No topology installed: conservative one-process-per-host accounting
+  // (every TCP byte presumed to cross hosts).
+  if (cross_ranks_.empty() || peer < 0 || peer >= size_) return true;
+  return cross_ranks_[peer] != cross_ranks_[rank_];
+}
+
+void Ring::AddSent(int peer, size_t nbytes) {
+  long long n = static_cast<long long>(nbytes);
+  bytes_sent_.fetch_add(n);
+  if (IsCrossHost(peer)) {
+    cross_bytes_sent_.fetch_add(n);
+  } else {
+    local_bytes_sent_.fetch_add(n);
+  }
+}
 
 void Ring::SenderLoop() {
   std::unique_lock<std::mutex> lk(send_mu_);
@@ -169,10 +230,11 @@ void Ring::SenderLoop() {
     const void* buf = send_buf_;
     size_t n = send_bytes_;
     Socket* sock = send_sock_;
+    int peer = send_peer_;
     lk.unlock();
     std::string payload(static_cast<const char*>(buf), n);
     bool ok = sock->SendFrame(payload);
-    if (ok) bytes_sent_.fetch_add(static_cast<long long>(n));
+    if (ok) AddSent(peer, n);
     lk.lock();
     send_buf_ = nullptr;
     send_done_ = true;
@@ -181,13 +243,15 @@ void Ring::SenderLoop() {
   }
 }
 
-bool Ring::CountedSendFrame(Socket& sock, const std::string& payload) {
+bool Ring::CountedSendFrame(Socket& sock, int peer,
+                            const std::string& payload) {
   bool ok = sock.SendFrame(payload);
-  if (ok) bytes_sent_.fetch_add(static_cast<long long>(payload.size()));
+  if (ok) AddSent(peer, payload.size());
   return ok;
 }
 
-bool Ring::SendRecvDuplex(Socket* send_sock, const void* sbuf, size_t sbytes,
+bool Ring::SendRecvDuplex(Socket* send_sock, int send_peer,
+                          const void* sbuf, size_t sbytes,
                           Socket* recv_sock, void* rbuf, size_t rbytes) {
   static const char kEmpty = 0;
   // A null sbuf (legal for 0-byte fragments) must not look like "no
@@ -196,6 +260,7 @@ bool Ring::SendRecvDuplex(Socket* send_sock, const void* sbuf, size_t sbytes,
   {
     std::lock_guard<std::mutex> lk(send_mu_);
     send_sock_ = send_sock;
+    send_peer_ = send_peer;
     send_buf_ = sbuf;
     send_bytes_ = sbytes;
     send_done_ = false;
@@ -213,7 +278,8 @@ bool Ring::SendRecvDuplex(Socket* send_sock, const void* sbuf, size_t sbytes,
 
 bool Ring::SendRecvStep(const void* sbuf, size_t sbytes, void* rbuf,
                         size_t rbytes) {
-  return SendRecvDuplex(&next_, sbuf, sbytes, &prev_, rbuf, rbytes);
+  return SendRecvDuplex(&next_, (rank_ + 1) % size_, sbuf, sbytes, &prev_,
+                        rbuf, rbytes);
 }
 
 Ring::~Ring() {
@@ -242,7 +308,7 @@ Status Ring::Connect(int rank, const std::vector<std::pair<std::string, int>>&
     next_ = Socket::Connect(endpoints[next_rank].first,
                             endpoints[next_rank].second, 120000);
     if (!next_.valid()) return false;
-    return CountedSendFrame(next_, std::to_string(rank_));
+    return CountedSendFrame(next_, next_rank, std::to_string(rank_));
   };
   int prev_rank = (rank_ - 1 + size_) % size_;
   auto answer = [&]() -> bool {
@@ -283,6 +349,16 @@ Status Ring::Allreduce(void* data, void* output, int64_t count, DataType dtype,
     if (op == ReduceOp::ADASUM) {
       return Status::InvalidArgument("use AdasumAllreduce");
     }
+    if (static_cast<long long>(count) * es <= TreeThresholdBytes()) {
+      // Latency path: for tiny payloads (the cached negotiation round's
+      // few-byte tensors) the chunked ring's 2*(size-1) lock-stepped
+      // steps dominate RTT — wake O(size) processes total instead of
+      // O(size^2).
+      std::vector<int> all(size_);
+      for (int r = 0; r < size_; ++r) all[r] = r;
+      Status st = TreeAllreduce(output, count, dtype, op, all);
+      if (!st.ok()) return st;
+    } else {
     // chunk partition
     std::vector<int64_t> offs(size_ + 1);
     for (int i = 0; i <= size_; ++i) offs[i] = count * i / size_;
@@ -315,11 +391,304 @@ Status Ring::Allreduce(void* data, void* output, int64_t count, DataType dtype,
       }
       std::memcpy(chunk_ptr(recv_c), recv_buf.data(), chunk_n(recv_c) * es);
     }
+    }
   }
   if (op == ReduceOp::AVERAGE) {
     ScaleBuffer(output, count, dtype, 1.0 / size_);
   }
   ScaleBuffer(output, count, dtype, postscale);
+  return Status::OK();
+}
+
+Status Ring::TreeAllreduce(void* buf, int64_t count, DataType dtype,
+                           ReduceOp op, const std::vector<int>& ranks) {
+  // Binomial reduce to ranks[0], binomial broadcast back (any participant
+  // count, tree rooted at index 0). Every link used by the broadcast was
+  // established by the reduce (same parent/child pairs), and a parent is
+  // always the lower rank of its pairs, so PeerLink's lower-dials rule
+  // never deadlocks: dials are non-blocking and accepts stash strays.
+  int n = static_cast<int>(ranks.size());
+  if (n <= 1) return Status::OK();
+  int idx = static_cast<int>(
+      std::lower_bound(ranks.begin(), ranks.end(), rank_) - ranks.begin());
+  if (idx >= n || ranks[idx] != rank_) {
+    return Status::InvalidArgument("tree allreduce: caller not in group");
+  }
+  int es = DataTypeSize(dtype);
+  size_t nbytes = static_cast<size_t>(count) * es;
+  int sent_mask = 0;  // the level at which this index reduced up
+  for (int mask = 1; mask < n; mask <<= 1) {
+    if (idx & mask) {
+      int parent = ranks[idx - mask];
+      Socket* s = PeerLink(parent);
+      if (s == nullptr ||
+          !CountedSendFrame(*s, parent, std::string(
+              static_cast<const char*>(buf), nbytes))) {
+        return Status::Aborted("tree reduce send failed");
+      }
+      sent_mask = mask;
+      break;
+    }
+    int src = idx + mask;
+    if (src < n) {
+      Socket* s = PeerLink(ranks[src]);
+      std::string frame;
+      if (s == nullptr || !s->RecvFrame(&frame) ||
+          frame.size() != nbytes) {
+        return Status::Aborted("tree reduce recv failed");
+      }
+      Accumulate(buf, frame.data(), count, dtype, op);
+    }
+  }
+  int top;
+  if (idx == 0) {
+    top = 1;
+    while (top < n) top <<= 1;
+    top >>= 1;
+  } else {
+    Socket* s = PeerLink(ranks[idx - sent_mask]);
+    std::string frame;
+    if (s == nullptr || !s->RecvFrame(&frame) || frame.size() != nbytes) {
+      return Status::Aborted("tree bcast recv failed");
+    }
+    std::memcpy(buf, frame.data(), nbytes);
+    top = sent_mask >> 1;
+  }
+  for (int d = top; d >= 1; d >>= 1) {
+    if (idx + d < n) {
+      Socket* s = PeerLink(ranks[idx + d]);
+      if (s == nullptr ||
+          !CountedSendFrame(*s, ranks[idx + d], std::string(
+              static_cast<const char*>(buf), nbytes))) {
+        return Status::Aborted("tree bcast send failed");
+      }
+    }
+  }
+  return Status::OK();
+}
+
+Status Ring::SubRingAllreduce(void* buf, int64_t count, DataType dtype,
+                              ReduceOp op, const std::vector<int>& ranks) {
+  // The flat chunked ring (reduce-scatter + allgather) over an arbitrary
+  // sorted rank subset, on direct peer links — the cross-host leader leg
+  // of the hierarchical path. Bandwidth-optimal: each participant puts
+  // 2*count*(H-1)/H elements on the wire.
+  int n = static_cast<int>(ranks.size());
+  if (n <= 1) return Status::OK();
+  if (static_cast<long long>(count) * DataTypeSize(dtype) <=
+      TreeThresholdBytes()) {
+    return TreeAllreduce(buf, count, dtype, op, ranks);
+  }
+  int idx = static_cast<int>(
+      std::lower_bound(ranks.begin(), ranks.end(), rank_) - ranks.begin());
+  if (idx >= n || ranks[idx] != rank_) {
+    return Status::InvalidArgument("sub-ring allreduce: caller not in group");
+  }
+  int next = ranks[(idx + 1) % n];
+  int prev = ranks[(idx - 1 + n) % n];
+  Socket* snext = PeerLink(next);
+  Socket* sprev = PeerLink(prev);
+  if (snext == nullptr || sprev == nullptr) {
+    return Status::Aborted("sub-ring peer link failed");
+  }
+  int es = DataTypeSize(dtype);
+  std::vector<int64_t> offs(n + 1);
+  for (int i = 0; i <= n; ++i) offs[i] = count * i / n;
+  auto chunk_ptr = [&](int c) {
+    return static_cast<char*>(buf) + offs[c] * es;
+  };
+  auto chunk_n = [&](int c) { return offs[c + 1] - offs[c]; };
+  int64_t max_chunk = 0;
+  for (int c = 0; c < n; ++c) max_chunk = std::max(max_chunk, chunk_n(c));
+  std::vector<char> recv_buf(max_chunk * es);
+  for (int step = 0; step < n - 1; ++step) {
+    int send_c = ((idx - step) % n + n) % n;
+    int recv_c = ((idx - step - 1) % n + n) % n;
+    if (!SendRecvDuplex(snext, next, chunk_ptr(send_c), chunk_n(send_c) * es,
+                        sprev, recv_buf.data(), chunk_n(recv_c) * es)) {
+      return Status::Aborted("sub-ring reduce-scatter failure");
+    }
+    Accumulate(chunk_ptr(recv_c), recv_buf.data(), chunk_n(recv_c), dtype,
+               op);
+  }
+  for (int step = 0; step < n - 1; ++step) {
+    int send_c = ((idx + 1 - step) % n + n) % n;
+    int recv_c = ((idx - step) % n + n) % n;
+    if (!SendRecvDuplex(snext, next, chunk_ptr(send_c), chunk_n(send_c) * es,
+                        sprev, recv_buf.data(), chunk_n(recv_c) * es)) {
+      return Status::Aborted("sub-ring allgather failure");
+    }
+    std::memcpy(chunk_ptr(recv_c), recv_buf.data(), chunk_n(recv_c) * es);
+  }
+  return Status::OK();
+}
+
+Status Ring::HierAllreduce(void* data, void* output, int64_t count,
+                           DataType dtype, ReduceOp op, double prescale,
+                           double postscale) {
+  if (op == ReduceOp::ADASUM) {
+    return Status::InvalidArgument("use AdasumAllreduce");
+  }
+  // Degenerate topologies where two-level == flat: no topology table, a
+  // single host (everything is loopback anyway), or one rank per host
+  // (the leader ring IS the flat ring).
+  if (cross_ranks_.empty() || leaders_.size() <= 1 ||
+      static_cast<int>(leaders_.size()) == size_) {
+    return Allreduce(data, output, count, dtype, op, prescale, postscale);
+  }
+  int es = DataTypeSize(dtype);
+  size_t nbytes = static_cast<size_t>(count) * es;
+  if (output != data) std::memcpy(output, data, count * es);
+  ScaleBuffer(output, count, dtype, prescale);
+  int leader = group_.front();
+  // Phase 1: intra-host reduce to the local leader over loopback links
+  // (deterministic ascending-member order, so every run sums in the same
+  // order). The reference's NCCLReduce-to-local-root leg
+  // (nccl_operations.cc:164-357).
+  if (rank_ != leader) {
+    Socket* s = PeerLink(leader);
+    if (s == nullptr ||
+        !CountedSendFrame(*s, leader, std::string(
+            static_cast<const char*>(output), nbytes))) {
+      return Status::Aborted("hier intra-host reduce send failed");
+    }
+  } else {
+    for (int m : group_) {
+      if (m == rank_) continue;
+      Socket* s = PeerLink(m);
+      std::string frame;
+      if (s == nullptr || !s->RecvFrame(&frame) ||
+          frame.size() != nbytes) {
+        return Status::Aborted("hier intra-host reduce recv failed");
+      }
+      Accumulate(output, frame.data(), count, dtype, op);
+    }
+    // Phase 2: cross-host leg among leaders only — every byte that
+    // crosses the slow links is paid once per host, not once per rank.
+    Status st = SubRingAllreduce(output, count, dtype, op, leaders_);
+    if (!st.ok()) return st;
+    // Phase 3: intra-host broadcast of the reduced result.
+    std::string result(static_cast<const char*>(output), nbytes);
+    for (int m : group_) {
+      if (m == rank_) continue;
+      Socket* s = PeerLink(m);
+      if (s == nullptr || !CountedSendFrame(*s, m, result)) {
+        return Status::Aborted("hier intra-host bcast send failed");
+      }
+    }
+  }
+  if (rank_ != leader) {
+    Socket* s = PeerLink(leader);
+    std::string frame;
+    if (s == nullptr || !s->RecvFrame(&frame) || frame.size() != nbytes) {
+      return Status::Aborted("hier intra-host bcast recv failed");
+    }
+    std::memcpy(output, frame.data(), nbytes);
+  }
+  if (op == ReduceOp::AVERAGE) {
+    ScaleBuffer(output, count, dtype, 1.0 / size_);
+  }
+  ScaleBuffer(output, count, dtype, postscale);
+  return Status::OK();
+}
+
+Status Ring::HierAllgatherv(const void* data, void* output,
+                            const std::vector<int64_t>& counts,
+                            DataType dtype) {
+  if (static_cast<int>(counts.size()) != size_) {
+    return Status::InvalidArgument("allgatherv counts/world size mismatch");
+  }
+  if (cross_ranks_.empty() || leaders_.size() <= 1 ||
+      static_cast<int>(leaders_.size()) == size_) {
+    return Allgatherv(data, output, counts, dtype);
+  }
+  int es = DataTypeSize(dtype);
+  std::vector<int64_t> disp(size_ + 1, 0);
+  for (int r = 0; r < size_; ++r) disp[r + 1] = disp[r] + counts[r] * es;
+  char* out = static_cast<char*>(output);
+  std::memcpy(out + disp[rank_], data, counts[rank_] * es);
+  int leader = group_.front();
+  size_t total = static_cast<size_t>(disp[size_]);
+  if (rank_ != leader) {
+    // Phase 1: hand my block to the leader; phase 3: receive the fully
+    // assembled result. Both legs are loopback.
+    Socket* s = PeerLink(leader);
+    if (s == nullptr) {
+      return Status::Aborted("hier allgather leader link failed");
+    }
+    if (counts[rank_] > 0 &&
+        !CountedSendFrame(*s, leader, std::string(
+            out + disp[rank_], counts[rank_] * es))) {
+      return Status::Aborted("hier allgather gather send failed");
+    }
+    std::string frame;
+    if (!s->RecvFrame(&frame) || frame.size() != total) {
+      return Status::Aborted("hier allgather result recv failed");
+    }
+    std::memcpy(out, frame.data(), total);
+    return Status::OK();
+  }
+  // Leader: collect the host's blocks into place.
+  for (int m : group_) {
+    if (m == rank_ || counts[m] == 0) continue;
+    Socket* s = PeerLink(m);
+    std::string frame;
+    if (s == nullptr || !s->RecvFrame(&frame) ||
+        frame.size() != static_cast<size_t>(counts[m] * es)) {
+      return Status::Aborted("hier allgather gather recv failed");
+    }
+    std::memcpy(out + disp[m], frame.data(), frame.size());
+  }
+  // Phase 2: ring the per-host bundles around the leaders. A bundle is
+  // the host's rank blocks concatenated in rank order — hosts need not
+  // be contiguous in rank space (round-robin placement), so bundles are
+  // (de)serialized against the global displacement map on each hop.
+  int H = static_cast<int>(leaders_.size());
+  auto bundle_bytes = [&](int g) {
+    size_t b = 0;
+    for (int m : groups_[g]) b += static_cast<size_t>(counts[m] * es);
+    return b;
+  };
+  auto pack = [&](int g) {
+    std::string b;
+    b.reserve(bundle_bytes(g));
+    for (int m : groups_[g]) b.append(out + disp[m], counts[m] * es);
+    return b;
+  };
+  auto unpack = [&](int g, const std::string& b) {
+    size_t off = 0;
+    for (int m : groups_[g]) {
+      std::memcpy(out + disp[m], b.data() + off, counts[m] * es);
+      off += static_cast<size_t>(counts[m] * es);
+    }
+  };
+  int next = leaders_[(group_idx_ + 1) % H];
+  int prev = leaders_[(group_idx_ - 1 + H) % H];
+  Socket* snext = PeerLink(next);
+  Socket* sprev = PeerLink(prev);
+  if (snext == nullptr || sprev == nullptr) {
+    return Status::Aborted("hier allgather leader ring link failed");
+  }
+  for (int step = 0; step < H - 1; ++step) {
+    int send_g = ((group_idx_ - step) % H + H) % H;
+    int recv_g = ((group_idx_ - step - 1) % H + H) % H;
+    std::string sbuf = pack(send_g);
+    std::string rbuf(bundle_bytes(recv_g), 0);
+    if (!SendRecvDuplex(snext, next, sbuf.data(), sbuf.size(), sprev,
+                        rbuf.empty() ? nullptr : &rbuf[0], rbuf.size())) {
+      return Status::Aborted("hier allgather leader ring failure");
+    }
+    unpack(recv_g, rbuf);
+  }
+  // Phase 3: hand the assembled result to every local member.
+  std::string result(out, total);
+  for (int m : group_) {
+    if (m == rank_) continue;
+    Socket* s = PeerLink(m);
+    if (s == nullptr || !CountedSendFrame(*s, m, result)) {
+      return Status::Aborted("hier allgather result send failed");
+    }
+  }
   return Status::OK();
 }
 
@@ -357,9 +726,10 @@ Status Ring::Broadcast(void* data, int64_t count, DataType dtype, int root) {
   size_t nbytes = count * es;
   // pipeline around the ring, root -> ... -> root-1
   bool is_last = ((rank_ + 1) % size_) == root;
+  int next_rank = (rank_ + 1) % size_;
   if (rank_ == root) {
     std::string payload(static_cast<const char*>(data), nbytes);
-    if (!CountedSendFrame(next_, payload)) {
+    if (!CountedSendFrame(next_, next_rank, payload)) {
       return Status::Aborted("bcast send failed");
     }
   } else {
@@ -369,7 +739,7 @@ Status Ring::Broadcast(void* data, int64_t count, DataType dtype, int root) {
     }
     std::memcpy(data, frame.data(), nbytes);
     if (!is_last) {
-      if (!CountedSendFrame(next_, frame)) {
+      if (!CountedSendFrame(next_, next_rank, frame)) {
         return Status::Aborted("bcast fwd failed");
       }
     }
@@ -386,7 +756,7 @@ Socket* Ring::PeerLink(int peer) {
     Socket s = Socket::Connect(endpoints_[peer].first,
                                endpoints_[peer].second, 120000);
     if (!s.valid()) return nullptr;
-    if (!CountedSendFrame(s, "vhdd " + std::to_string(rank_)))
+    if (!CountedSendFrame(s, peer, "vhdd " + std::to_string(rank_)))
       return nullptr;
     peers_[peer] = std::move(s);
   } else {
@@ -423,7 +793,7 @@ Status Ring::ScalarTreeAllreduce(std::vector<double>& vals, int span) {
     if (low == d) {
       Socket* s = PeerLink(rank_ ^ d);
       if (s == nullptr ||
-          !CountedSendFrame(*s, std::string(
+          !CountedSendFrame(*s, rank_ ^ d, std::string(
               reinterpret_cast<const char*>(vals.data()), nbytes))) {
         return Status::Aborted("adasum scalar reduce send failed");
       }
@@ -444,7 +814,7 @@ Status Ring::ScalarTreeAllreduce(std::vector<double>& vals, int span) {
     if (low == 0) {
       Socket* s = PeerLink(rank_ ^ d);
       if (s == nullptr ||
-          !CountedSendFrame(*s, std::string(
+          !CountedSendFrame(*s, rank_ ^ d, std::string(
               reinterpret_cast<const char*>(vals.data()), nbytes))) {
         return Status::Aborted("adasum scalar bcast send failed");
       }
@@ -640,7 +1010,8 @@ Status Ring::AdasumAllreduce(void* data, void* output,
       li.nghr_count = nghr;
       // Full-duplex half-exchange: my outgoing half against the
       // partner's fragment aligned with what I keep.
-      if (!SendRecvDuplex(peer, grad + send_off * wes, nghr * wes, peer,
+      if (!SendRecvDuplex(peer, rank_ ^ level, grad + send_off * wes,
+                          nghr * wes, peer,
                           rbuf + (is_left ? 0 : nghr * wes),
                           my_count * wes)) {
         return Status::Aborted("adasum half-exchange failed");
@@ -664,8 +1035,8 @@ Status Ring::AdasumAllreduce(void* data, void* output,
       bool is_left = (rank_ & level) == 0;
       char* rdst = is_left ? grad + my_count * wes
                            : grad - li.nghr_count * wes;
-      if (!SendRecvDuplex(peer, grad, my_count * wes, peer, rdst,
-                          li.nghr_count * wes)) {
+      if (!SendRecvDuplex(peer, rank_ ^ level, grad, my_count * wes, peer,
+                          rdst, li.nghr_count * wes)) {
         return Status::Aborted("adasum allgather exchange failed");
       }
       if (!is_left) grad -= li.nghr_count * wes;

@@ -38,6 +38,12 @@ class Ring {
   Status Connect(int rank, const std::vector<std::pair<std::string, int>>&
                                endpoints,
                  Listener* listener);
+  // Install the host topology: `cross_ranks[r]` is the host group of rank
+  // r (the controller exchanges each rank's cross_rank at world join).
+  // Enables the split local/cross traffic counters and the two-level
+  // hierarchical paths; without it every send is accounted cross-host
+  // (the conservative pre-topology behavior: one process per host).
+  void SetTopology(const std::vector<int>& cross_ranks);
 
   Status Allreduce(void* data, void* output, int64_t count, DataType dtype,
                    ReduceOp op, double prescale, double postscale);
@@ -48,6 +54,20 @@ class Ring {
   // semantics, reference ops/mpi_operations.cc:140-175).
   Status Allgatherv(const void* data, void* output,
                     const std::vector<int64_t>& counts, DataType dtype);
+  // Two-level (local-leader) variants — the host-plane analog of the
+  // reference's hierarchical NCCL/MPI paths (nccl_operations.cc:164-357,
+  // mpi_operations.cc:177-328): intra-host reduce/gather to a per-host
+  // leader over loopback links, a cross-host exchange among leaders only,
+  // then intra-host broadcast/scatter. Fall back to the flat paths when
+  // no topology is installed or it degenerates (one host, or one rank per
+  // host). Results are the same reduction, routed differently — for
+  // exactly-representable inputs they are byte-identical to the flat
+  // ring (asserted in tests/test_hier_host.py).
+  Status HierAllreduce(void* data, void* output, int64_t count,
+                       DataType dtype, ReduceOp op, double prescale,
+                       double postscale);
+  Status HierAllgatherv(const void* data, void* output,
+                        const std::vector<int64_t>& counts, DataType dtype);
   Status Broadcast(void* data, int64_t count, DataType dtype, int root);
   // Adasum over a fused buffer with per-tensor boundaries:
   // ``tensor_counts[i]`` elements belong to tensor i, and the Adasum
@@ -65,18 +85,41 @@ class Ring {
   // messages). Exposed so tests can assert traffic complexity (VHDD must
   // be O(count) per rank, not O(count * size)).
   long long bytes_sent() const { return bytes_sent_.load(); }
+  // Split traffic accounting: bytes sent to peers in the SAME host group
+  // (loopback/intra-host links) vs a DIFFERENT group (the scarce
+  // cross-host budget). local + cross == bytes_sent once a topology is
+  // installed; without one every byte is accounted cross.
+  long long local_bytes_sent() const { return local_bytes_sent_.load(); }
+  long long cross_bytes_sent() const { return cross_bytes_sent_.load(); }
 
  private:
   // Full-duplex step: send on `sock` while receiving from `recv_sock`,
   // using one persistent sender thread (no per-step thread spawn on the
   // hot path). Ring steps pass (next_, prev_); VHDD passes the same peer
-  // socket for both directions.
-  bool SendRecvDuplex(Socket* send_sock, const void* sbuf, size_t sbytes,
-                      Socket* recv_sock, void* rbuf, size_t rbytes);
+  // socket for both directions. `send_peer` is the destination rank, for
+  // the local/cross traffic split.
+  bool SendRecvDuplex(Socket* send_sock, int send_peer, const void* sbuf,
+                      size_t sbytes, Socket* recv_sock, void* rbuf,
+                      size_t rbytes);
   bool SendRecvStep(const void* sbuf, size_t sbytes, void* rbuf,
                     size_t rbytes);
   void SenderLoop();
-  bool CountedSendFrame(Socket& sock, const std::string& payload);
+  bool CountedSendFrame(Socket& sock, int peer, const std::string& payload);
+  void AddSent(int peer, size_t nbytes);
+  bool IsCrossHost(int peer) const;
+  // Latency-optimal small-payload allreduce over `ranks` (sorted global
+  // ranks containing rank_): binomial-tree reduce to ranks[0] +
+  // binomial broadcast back over direct peer links. 2*(|ranks|-1) total
+  // process wakeups on the critical path instead of the chunked ring's
+  // |ranks| wakeups per step x 2*(|ranks|-1) steps — the ring is
+  // bandwidth-optimal but latency-hostile for tiny tensors (the cached
+  // negotiation fast path's payload is a few bytes).
+  Status TreeAllreduce(void* buf, int64_t count, DataType dtype,
+                       ReduceOp op, const std::vector<int>& ranks);
+  // Bandwidth-optimal chunked ring allreduce over an arbitrary sorted
+  // rank subset (the cross-host leader leg) via direct peer links.
+  Status SubRingAllreduce(void* buf, int64_t count, DataType dtype,
+                          ReduceOp op, const std::vector<int>& ranks);
 
   // Direct link to an arbitrary peer, established lazily on first use
   // (lower rank dials, higher rank accepts with hello routing — accepts
@@ -103,12 +146,24 @@ class Ring {
   Listener* listener_ = nullptr;
   std::map<int, Socket> peers_;
 
+  // Host topology (SetTopology): per-rank host group, my group's member
+  // ranks (sorted; front() is the local leader), and each group's leader
+  // in group order (groups ordered by cross_rank ascending).
+  std::vector<int> cross_ranks_;
+  std::vector<int> group_;
+  std::vector<std::vector<int>> groups_;
+  std::vector<int> leaders_;
+  int group_idx_ = -1;  // my group's index into leaders_/groups_
+
   std::atomic<long long> bytes_sent_{0};
+  std::atomic<long long> local_bytes_sent_{0};
+  std::atomic<long long> cross_bytes_sent_{0};
 
   std::thread sender_;
   std::mutex send_mu_;
   std::condition_variable send_cv_;
   Socket* send_sock_ = nullptr;     // socket for the pending send
+  int send_peer_ = -1;              // destination rank of the pending send
   const void* send_buf_ = nullptr;  // pending send request (one at a time)
   size_t send_bytes_ = 0;
   bool send_done_ = true;

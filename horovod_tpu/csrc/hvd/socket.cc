@@ -8,17 +8,28 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <sys/uio.h>
+
 #include <chrono>
 #include <cstring>
 #include <thread>
 
 namespace hvd {
 
+namespace {
+// Over-read size for the buffered receive path (covers a frame header +
+// a small payload — the controller's cached-id frames — in one recv).
+constexpr size_t kRecvBuf = 4096;
+}  // namespace
+
 Socket& Socket::operator=(Socket&& o) noexcept {
   if (this != &o) {
     Close();
     fd_ = o.fd_;
+    rbuf_ = std::move(o.rbuf_);
+    rpos_ = o.rpos_;
     o.fd_ = -1;
+    o.rpos_ = 0;
   }
   return *this;
 }
@@ -30,6 +41,8 @@ void Socket::Close() {
     ::close(fd_);
     fd_ = -1;
   }
+  rbuf_.clear();
+  rpos_ = 0;
 }
 
 bool Socket::SendAll(const void* p, size_t n) {
@@ -48,7 +61,41 @@ bool Socket::SendAll(const void* p, size_t n) {
 
 bool Socket::RecvAll(void* p, size_t n) {
   char* c = static_cast<char*>(p);
+  // Drain the user-space buffer first.
+  size_t buffered = rbuf_.size() - rpos_;
+  if (buffered > 0) {
+    size_t take = buffered < n ? buffered : n;
+    std::memcpy(c, rbuf_.data() + rpos_, take);
+    rpos_ += take;
+    if (rpos_ == rbuf_.size()) {
+      rbuf_.clear();
+      rpos_ = 0;
+    }
+    c += take;
+    n -= take;
+  }
   while (n > 0) {
+    if (n < kRecvBuf) {
+      // Short remainder (frame headers, small payloads): over-read into
+      // the buffer so the header and payload — and often the next frame
+      // — cost one syscall instead of one each.
+      char tmp[kRecvBuf];
+      ssize_t r = ::recv(fd_, tmp, sizeof(tmp), 0);
+      if (r <= 0) {
+        if (r < 0 && errno == EINTR) continue;
+        return false;
+      }
+      size_t got = static_cast<size_t>(r);
+      size_t take = got < n ? got : n;
+      std::memcpy(c, tmp, take);
+      c += take;
+      n -= take;
+      if (got > take) {
+        rbuf_.assign(tmp + take, tmp + got);
+        rpos_ = 0;
+      }
+      continue;
+    }
     ssize_t r = ::recv(fd_, c, n, 0);
     if (r <= 0) {
       if (r < 0 && errno == EINTR) continue;
@@ -62,7 +109,37 @@ bool Socket::RecvAll(void* p, size_t n) {
 
 bool Socket::SendFrame(const std::string& payload) {
   uint32_t len = static_cast<uint32_t>(payload.size());
-  return SendAll(&len, 4) && SendAll(payload.data(), payload.size());
+  // One writev for header + payload (one syscall for the common short
+  // frame); fall back to SendAll for partial writes.
+  struct iovec iov[2];
+  iov[0].iov_base = &len;
+  iov[0].iov_len = 4;
+  iov[1].iov_base = const_cast<char*>(payload.data());
+  iov[1].iov_len = payload.size();
+  struct msghdr msg;
+  std::memset(&msg, 0, sizeof(msg));
+  msg.msg_iov = iov;
+  msg.msg_iovlen = 2;
+  size_t total = 4 + payload.size();
+  while (true) {
+    // sendmsg, not writev: a dying peer must surface as an error, not a
+    // process-killing SIGPIPE (MSG_NOSIGNAL — the chaos tests kill ranks
+    // mid-frame on purpose).
+    ssize_t w = ::sendmsg(fd_, &msg, MSG_NOSIGNAL);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    size_t sent = static_cast<size_t>(w);
+    if (sent >= total) return true;
+    // Partial write: finish byte-precise via SendAll.
+    if (sent < 4) {
+      const char* h = reinterpret_cast<const char*>(&len);
+      return SendAll(h + sent, 4 - sent) &&
+             SendAll(payload.data(), payload.size());
+    }
+    return SendAll(payload.data() + (sent - 4), payload.size() - (sent - 4));
+  }
 }
 
 bool Socket::RecvFrame(std::string* payload) {

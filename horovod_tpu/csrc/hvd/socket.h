@@ -11,6 +11,7 @@
 
 #include <cstdint>
 #include <string>
+#include <vector>
 
 namespace hvd {
 
@@ -20,7 +21,11 @@ class Socket {
   explicit Socket(int fd) : fd_(fd) {}
   Socket(const Socket&) = delete;
   Socket& operator=(const Socket&) = delete;
-  Socket(Socket&& o) noexcept : fd_(o.fd_) { o.fd_ = -1; }
+  Socket(Socket&& o) noexcept
+      : fd_(o.fd_), rbuf_(std::move(o.rbuf_)), rpos_(o.rpos_) {
+    o.fd_ = -1;
+    o.rpos_ = 0;
+  }
   Socket& operator=(Socket&& o) noexcept;
   ~Socket();
 
@@ -28,7 +33,12 @@ class Socket {
   int fd() const { return fd_; }
   void Close();
 
-  // Frame IO: 4-byte little-endian length + payload.
+  // Frame IO: 4-byte little-endian length + payload. Syscall-lean on
+  // purpose — this runs under sandboxed kernels (gVisor-class) where a
+  // syscall costs 10-20x native, and the controller hot path is frames:
+  // sends coalesce header+payload into one writev, receives drain the
+  // kernel buffer through a small user-space buffer so a short frame
+  // (header + payload, often the NEXT frame too) costs one recv.
   bool SendFrame(const std::string& payload);
   bool RecvFrame(std::string* payload);
 
@@ -37,8 +47,12 @@ class Socket {
 
  private:
   bool SendAll(const void* p, size_t n);
+  // Buffered receive: exactly n bytes into p, reading through rbuf_.
+  // Single-reader per socket (every frame consumer is one thread).
   bool RecvAll(void* p, size_t n);
   int fd_ = -1;
+  std::vector<char> rbuf_;
+  size_t rpos_ = 0;
 };
 
 class Listener {

@@ -20,6 +20,7 @@ Status TensorQueue::AddToTensorQueue(TensorTableEntry entry) {
   }
   queue_.push_back(entry.request);
   table_.emplace(std::move(name), std::move(entry));
+  cv_.notify_all();
   return Status::OK();
 }
 
@@ -60,6 +61,12 @@ size_t TensorQueue::PendingCount() {
   return table_.size();
 }
 
+void TensorQueue::WaitForMessages(
+    std::chrono::steady_clock::time_point deadline) {
+  std::unique_lock<std::mutex> lk(mu_);
+  cv_.wait_until(lk, deadline, [&] { return !queue_.empty() || closed_; });
+}
+
 std::vector<TensorTableEntry> TensorQueue::DrainAll() {
   std::vector<TensorTableEntry> entries;
   std::lock_guard<std::mutex> lk(mu_);
@@ -67,6 +74,7 @@ std::vector<TensorTableEntry> TensorQueue::DrainAll() {
   for (auto& kv : table_) entries.push_back(std::move(kv.second));
   table_.clear();
   queue_.clear();
+  cv_.notify_all();
   return entries;
 }
 

@@ -7,6 +7,8 @@
 #ifndef HVD_TENSOR_QUEUE_H_
 #define HVD_TENSOR_QUEUE_H_
 
+#include <chrono>
+#include <condition_variable>
 #include <deque>
 #include <mutex>
 #include <string>
@@ -34,6 +36,14 @@ class TensorQueue {
 
   bool Contains(const std::string& name);
   size_t PendingCount();
+  // Interruptible cycle sleep for the background loop: parks until a
+  // request is queued (AddToTensorQueue notifies), the queue closes, or
+  // `deadline` passes. Returns immediately when requests are already
+  // waiting. An enqueue that lands mid-sleep thus starts the next
+  // negotiation round at once instead of waiting out the cycle — at
+  // large world sizes the cached-path RTT is otherwise dominated by
+  // ranks sleeping through the round their peers are trying to start.
+  void WaitForMessages(std::chrono::steady_clock::time_point deadline);
 
   // Drain every queued entry (shutdown path) and close the queue: later
   // enqueues are refused with ABORTED so no submission can slip in after
@@ -45,6 +55,7 @@ class TensorQueue {
 
  private:
   std::mutex mu_;
+  std::condition_variable cv_;
   std::unordered_map<std::string, TensorTableEntry> table_;
   std::deque<Request> queue_;
   bool closed_ = false;

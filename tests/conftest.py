@@ -87,6 +87,10 @@ def pytest_configure(config):
         "markers",
         "full: slow soak/e2e/multi-process depth — excluded from the "
         "default (fast) profile; run with --full or -m full")
+    config.addinivalue_line(
+        "markers",
+        "slow: heavyweight scale soaks (e.g. the 32-process controller "
+        "world) — excluded from the tier-1 gate's -m 'not slow' run")
 
 
 def pytest_addoption(parser):

@@ -218,6 +218,44 @@ def test_chaos_kill_rank1_blacklists_host_and_completes(tmp_path):
     assert "DONE RANK 1" not in text, text
 
 
+def test_chaos_hier_leader_death_recovers(tmp_path):
+    """Fault composition with the hierarchical host plane (the
+    ``ring.hier.cross`` seam): the local leader carrying the cross-host
+    leg is hard-killed mid-collective on a hierarchical world. The
+    survivors surface it as a collective failure (HorovodInternalError
+    inside the retry loop — FaultInjected IS one), the driver blacklists
+    the dead leader's host, and training completes shrunk — no hang."""
+    proc, log = _launch_elastic(
+        tmp_path,
+        "localhost:1\n127.0.0.1:1\n",
+        {
+            # One slot per host => every rank is a local leader
+            # (local_rank 0) and cross_size = 2, so the seam arms; the
+            # spec kills rank 1's 16th pass through its cross leg.
+            # Unlike the enqueue seam above, this one fires on EVERY
+            # HostWorld.wait — including the handful of elastic
+            # startup/state-sync collectives, whose count jitters by a
+            # few with rendezvous poll timing — so the step is placed
+            # mid-training with margin on both sides: enough batches
+            # before it that SIZE 2 provably ran, enough after that the
+            # shrunk world provably resumed.
+            "HOROVOD_FAULT_SPEC":
+                "ring.hier.cross:rank=1:step=15:kind=exit",
+            "HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
+            "HOROVOD_ELASTIC_BLACKLIST_STRIKES": "1",
+            "CHAOS_TARGET": "30",
+        },
+        ["-np", "2", "--min-np", "1", "--max-np", "2"])
+    out = proc.stdout + proc.stderr
+    text = _read(log)
+    assert proc.returncode == 0, out + text
+    assert "fault injected at ring.hier.cross" in out, out
+    assert "DONE RANK 0 BATCHES 30" in text, text
+    assert "host 127.0.0.1 blacklisted (strike 1/1, permanent)" in out, out
+    assert "SIZE 2" in text and "SIZE 1" in text, text
+    assert "DONE RANK 1" not in text, text
+
+
 @pytest.mark.full
 def test_chaos_strike_two_lives_then_permanent(tmp_path):
     """Strike/parole composition under repeated deterministic failure:

@@ -76,10 +76,17 @@ def test_cached_rtt_beats_cycle_budget(tmp_path):
 @pytest.mark.full
 def test_committed_artifact_matches_schema():
     """docs/controller_bench.json stays parseable and under budget —
-    the judge-facing evidence can't silently go stale-invalid."""
+    the judge-facing evidence can't silently go stale-invalid. The
+    like-for-like ladder (2/4/8) gates at the 5 ms budget; the 32-rank
+    scale-soak row gates at 2x, the documented allowance for 16x core
+    oversubscription on the 2-core capture machine (the headline `value`
+    excludes soak rows for trajectory comparability)."""
     path = os.path.join(REPO, "docs", "controller_bench.json")
     with open(path) as f:
         data = json.load(f)
     assert data["metric"] == "controller_cached_rtt_ms"
     assert data["value"] < BUDGET_MS
-    assert set(data["sizes"]) >= {"2", "4", "8"}
+    assert set(data["sizes"]) >= {"2", "4", "8", "32"}
+    for size, row in data["sizes"].items():
+        limit = BUDGET_MS if int(size) <= 8 else 2 * BUDGET_MS
+        assert row["hit_ms"]["p50"] < limit, (size, row["hit_ms"])

@@ -73,9 +73,13 @@ _WORKER = textwrap.dedent("""
     x = torch.arange((rank + 1) * 2, dtype=torch.int16).reshape(-1, 1)
     out = hvd.allgather(x, name="mx.ag16")
     assert out.dtype == torch.int16
-    assert out.shape == (2 + 4, 1), out.shape
-    assert out[:2].flatten().tolist() == [0, 1]
-    assert out[2:].flatten().tolist() == [0, 1, 2, 3]
+    total = sum((r + 1) * 2 for r in range(size))
+    assert out.shape == (total, 1), out.shape
+    off = 0
+    for r in range(size):
+        n = (r + 1) * 2
+        assert out[off:off + n].flatten().tolist() == list(range(n)), out
+        off += n
 
     # ---- multi-dim shapes (1-4 dims, reference dim sweep) ----
     for nd in range(1, 5):
@@ -102,6 +106,42 @@ def test_dtype_op_matrix_two_process(tmp_path):
     from proc_harness import run_world
 
     run_world(tmp_path, _WORKER, "DTMATRIX")
+
+
+# The same matrix through the HIERARCHICAL host plane: 4 ranks as
+# 2 hosts x 2 local (block placement), HOROVOD_HIERARCHICAL_* on. Every
+# expected value is exactly representable in its dtype, so these rows are
+# byte-identity proofs against the flat path (both routes must produce
+# the mathematically exact tensor; the direct flat-vs-hier bitwise
+# comparison on one world lives in tests/test_hier_host.py).
+_HIER_ENV = (
+    'os.environ.update(HOROVOD_RANK=str(rank), HOROVOD_SIZE="4",\n'
+    '                  HOROVOD_LOCAL_RANK=str(rank % 2),\n'
+    '                  HOROVOD_LOCAL_SIZE="2",\n'
+    '                  HOROVOD_CROSS_RANK=str(rank // 2),\n'
+    '                  HOROVOD_CROSS_SIZE="2",\n'
+    '                  HOROVOD_HIERARCHICAL_ALLREDUCE="1",\n'
+    '                  HOROVOD_HIERARCHICAL_ALLGATHER="1",\n'
+    '                  HOROVOD_CONTROLLER_ADDR="127.0.0.1",\n'
+    '                  HOROVOD_CONTROLLER_PORT=str(port),\n'
+    '                  JAX_PLATFORMS="cpu")')
+
+_HIER_WORKER = _WORKER.replace(textwrap.dedent("""\
+    os.environ.update(HOROVOD_RANK=str(rank), HOROVOD_SIZE="2",
+                      HOROVOD_LOCAL_RANK=str(rank), HOROVOD_LOCAL_SIZE="2",
+                      HOROVOD_CONTROLLER_ADDR="127.0.0.1",
+                      HOROVOD_CONTROLLER_PORT=str(port),
+                      JAX_PLATFORMS="cpu")"""), _HIER_ENV)
+assert "HOROVOD_HIERARCHICAL_ALLREDUCE" in _HIER_WORKER, \
+    "env-block replace failed; the hier matrix would silently test flat"
+
+
+@pytest.mark.full
+def test_dtype_op_matrix_hierarchical_four_process(tmp_path):
+    pytest.importorskip("torch")
+    from proc_harness import run_world
+
+    run_world(tmp_path, _HIER_WORKER, "DTMATRIX", size=4)
 
 
 # ---- XLA-plane dtype matrix through the bucketed (tensor-fusion v2) path ---

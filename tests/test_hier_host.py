@@ -1,0 +1,448 @@
+"""Topology-aware hierarchical host-plane collectives (two-level
+local-leader routing, ``ring_ops.cc HierAllreduce/HierAllgatherv``).
+
+The headline world: 8 ranks simulating 2 hosts x 4 local ranks with
+ROUND-ROBIN placement (rank r on host r % 2) — the flat ring's worst
+case, where ring order interleaves hosts and every neighbor hop crosses
+the slow links (the "every byte crosses the cross-host links N-1 times"
+regime from the hierarchical-allreduce literature; reference Horovod
+ships hierarchical NCCL/MPI paths for exactly this,
+``nccl_operations.cc:164-357``). The split traffic counters
+(``local_bytes_sent`` / ``cross_bytes_sent``, exchanged topology from the
+controller hello) prove the shape: two-level routing pays the cross-host
+budget once per HOST, not once per rank, while results stay
+byte-identical to the flat ring for exactly-representable inputs.
+
+Also here: the autotuner round-trip — ``hvd_set_hier_flags`` on the
+coordinator rides a response broadcast, every rank (workers included)
+applies it at the same frame, and the HOST-plane dispatch genuinely
+flips (asserted via the traffic counters, not just the flag value).
+"""
+
+import textwrap
+
+import pytest
+
+from proc_harness import run_world
+
+# 8 ranks = 2 hosts x 4 local, round-robin placement: host(r) = r % 2.
+# Group members {0,2,4,6} / {1,3,5,7}; leaders are ranks 0 and 1.
+_HEADLINE_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    from horovod_tpu.common import native as hn
+
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    SIZE, HOSTS, LOCAL = 8, 2, 4
+    core = hn.NativeCore()
+    assert core.available
+    ok = core.init(rank=rank, size=SIZE, local_rank=rank // HOSTS,
+                   local_size=LOCAL, cross_rank=rank % HOSTS,
+                   cross_size=HOSTS, coordinator_addr="127.0.0.1",
+                   coordinator_port=port, my_host="127.0.0.1",
+                   cycle_time_ms=1.0, fusion_threshold=64 << 20,
+                   cache_capacity=64, stall_warning_sec=60.0,
+                   stall_shutdown_sec=0.0, stall_check_enabled=True,
+                   exec_callback=lambda resp, rid: core.response_done(
+                       rid, False, "host-plane only"))
+    assert ok, "native init failed"
+    is_leader = rank in (0, 1)  # lowest rank of each host group
+
+    ES = 4  # fp32
+    COUNT = 1 << 16  # 256 KiB: well above the small-payload tree cutoff
+
+    def traffic():
+        return core.ring_local_bytes(), core.ring_cross_bytes()
+
+    def run_allreduce(name):
+        # Exact in fp32 at any summation order -> flat and hierarchical
+        # routing must produce identical BYTES.
+        buf = (np.arange(COUNT, dtype=np.float32) % 13) + rank
+        l0, c0 = traffic()
+        h = core.enqueue(name, hn.OP_ALLREDUCE, 1, 7, buf.shape,
+                         data_ptr=buf.ctypes.data,
+                         output_ptr=buf.ctypes.data, plane=hn.PLANE_HOST)
+        r, err = core.wait(h); assert r == 1, err
+        l1, c1 = traffic()
+        return buf, l1 - l0, c1 - c0
+
+    def run_allgather(name):
+        blk = (np.arange(4096, dtype=np.float32) % 7) * (rank + 1)
+        out = np.zeros(4096 * SIZE, np.float32)
+        l0, c0 = traffic()
+        h = core.enqueue(name, hn.OP_ALLGATHER, 1, 7, blk.shape,
+                         data_ptr=blk.ctypes.data,
+                         output_ptr=out.ctypes.data, plane=hn.PLANE_HOST)
+        r, err = core.wait(h); assert r == 1, err
+        l1, c1 = traffic()
+        return out, l1 - l0, c1 - c0
+
+    def run_allgatherv(name):
+        # Ragged: rank r contributes (r % 3 + 1) rows of 8 int32.
+        rows = rank % 3 + 1
+        blk = np.full((rows, 8), rank + 1, np.int32)
+        h = core.enqueue(name, hn.OP_ALLGATHER, 1, 4, blk.shape,
+                         data_ptr=blk.ctypes.data, output_ptr=0,
+                         plane=hn.PLANE_HOST)
+        r, err = core.wait(h); assert r == 1, err
+        raw, dims = core.result_fetch(h)
+        assert dims == tuple(rr % 3 + 1 for rr in range(SIZE)), dims
+        return np.frombuffer(raw, np.int32).reshape(-1, 8)
+
+    def run_small(name):
+        # 8 floats: the latency (binomial-tree) path under the cutoff.
+        buf = np.full(8, float(rank + 1), np.float32)
+        h = core.enqueue(name, hn.OP_ALLREDUCE, 1, 7, buf.shape,
+                         data_ptr=buf.ctypes.data,
+                         output_ptr=buf.ctypes.data, plane=hn.PLANE_HOST)
+        r, err = core.wait(h); assert r == 1, err
+        return buf
+
+    # ---- flat baseline (hier untuned, env off) ----
+    assert core.host_hier_flags() == 0
+    flat_ar, fl_l, fl_c = run_allreduce("flat.ar")
+    flat_ag, gl_l, gl_c = run_allgather("flat.ag")
+    flat_agv = run_allgatherv("flat.agv")
+    flat_small = run_small("flat.small")
+    # Round-robin placement: both ring neighbors are on the other host,
+    # so EVERY flat ring byte is cross-host.
+    assert fl_l == 0, (fl_l, fl_c)
+    assert fl_c > 0 and gl_c > 0 and gl_l == 0, (fl_c, gl_l, gl_c)
+
+    # ---- the autotuner's categorical bits flip the host plane ----
+    # One barrier makes the sync deterministic: rank 0 sets the hint
+    # BEFORE submitting, so the response frame completing this barrier
+    # necessarily carries the flags, and every rank applies them at that
+    # frame boundary before its wait resolves.
+    if rank == 0:
+        core.set_hier_flags(3)  # bit0 allreduce | bit1 allgather
+    z = np.zeros(1, np.uint8)
+    h = core.enqueue("sync.flip", hn.OP_BARRIER, 1, 0, z.shape,
+                     data_ptr=z.ctypes.data, output_ptr=z.ctypes.data,
+                     plane=hn.PLANE_HOST)
+    r, err = core.wait(h); assert r == 1, err
+    # Round-trip: the WORKER ranks' native cores report the synced value
+    # (it rode a response broadcast, frame-exact), and the effective
+    # host-plane dispatch follows it.
+    assert core.get_hier_flags() == 3, core.get_hier_flags()
+    assert core.host_hier_flags() == 3
+
+    # ---- hierarchical rerun: identical bytes, reshaped traffic ----
+    hier_ar, hr_l, hr_c = run_allreduce("hier.ar")
+    hier_ag, hg_l, hg_c = run_allgather("hier.ag")
+    hier_agv = run_allgatherv("hier.agv")
+    hier_small = run_small("hier.small")
+    assert np.array_equal(flat_ar.view(np.uint32),
+                          hier_ar.view(np.uint32)), "allreduce diverged"
+    assert np.array_equal(flat_ag.view(np.uint32),
+                          hier_ag.view(np.uint32)), "allgather diverged"
+    assert np.array_equal(flat_agv, hier_agv), "allgatherv diverged"
+    assert np.array_equal(flat_small, hier_small), "small path diverged"
+
+    # Traffic shape, per rank: members never touch the cross budget;
+    # leaders pay the cross ring 2*count*(H-1)/H ~= count elements once.
+    if is_leader:
+        assert hr_c > 0, hr_c
+        assert abs(hr_c - COUNT * ES) <= COUNT * ES // 4, (hr_c, COUNT * ES)
+    else:
+        assert hr_c == 0, hr_c
+        assert hr_l > 0, hr_l
+
+    # Aggregate acceptance shape: summed over ranks, the cross-host bytes
+    # of one fused allreduce drop by >= local_size x vs the flat ring
+    # (exactly (N-1)/(H-1) = 7x here; local_size = 4 is the floor).
+    report = np.asarray([fl_c, hr_c, gl_c, hg_c], np.int64)
+    gathered = np.zeros((SIZE, 4), np.int64)
+    h = core.enqueue("tr.report", hn.OP_ALLGATHER, 1, 5, report.shape,
+                     data_ptr=report.ctypes.data,
+                     output_ptr=gathered.ctypes.data, plane=hn.PLANE_HOST)
+    r, err = core.wait(h); assert r == 1, err
+    tot = gathered.sum(axis=0)
+    assert tot[0] >= LOCAL * tot[1], ("allreduce cross drop", tot)
+    assert tot[2] >= LOCAL * tot[3], ("allgather cross drop", tot)
+
+    core.shutdown()
+    print(f"HIER_{rank}_OK")
+""")
+
+
+def test_hierarchical_8rank_traffic_shape_and_identity(tmp_path):
+    """THE acceptance world: 8 ranks as 2 hosts x 4 local (round-robin
+    placement). Hierarchical allreduce AND allgather byte-identical to
+    the flat ring; cross-host bytes per fused collective drop >=
+    local_size x (split counters), members never touch the cross budget,
+    and the tuner's hier_flags bits demonstrably flip the host-plane
+    dispatch on every rank."""
+    run_world(tmp_path, _HEADLINE_WORKER, "HIER", size=8, timeout=300)
+
+
+_ENV_DISPATCH_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    # Env-default dispatch (no tuner): the config flags alone must route
+    # the host plane hierarchically from the first collective.
+    os.environ["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+    os.environ["HOROVOD_HIERARCHICAL_ALLGATHER"] = "1"
+    from horovod_tpu.common import native as hn
+
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    SIZE, LOCAL = 4, 2
+    core = hn.NativeCore()
+    # Block placement this time: host(r) = r // 2 — hierarchical routing
+    # is placement-agnostic (groups come from the exchanged cross_ranks).
+    ok = core.init(rank=rank, size=SIZE, local_rank=rank % LOCAL,
+                   local_size=LOCAL, cross_rank=rank // LOCAL,
+                   cross_size=SIZE // LOCAL,
+                   coordinator_addr="127.0.0.1", coordinator_port=port,
+                   my_host="127.0.0.1", cycle_time_ms=1.0,
+                   fusion_threshold=64 << 20, cache_capacity=64,
+                   stall_warning_sec=60.0, stall_shutdown_sec=0.0,
+                   stall_check_enabled=True,
+                   exec_callback=lambda resp, rid: core.response_done(
+                       rid, False, "host-plane only"))
+    assert ok, "native init failed"
+    assert core.host_hier_flags() == 3
+    assert core.get_hier_flags() == -1  # untuned: env is the source
+
+    COUNT = 1 << 15
+    buf = (np.arange(COUNT, dtype=np.float32) % 11) * (rank + 1)
+    expect = sum((np.arange(COUNT) % 11) * (r + 1) for r in range(SIZE))
+    c0 = core.ring_cross_bytes()
+    h = core.enqueue("env.ar", hn.OP_ALLREDUCE, 1, 7, buf.shape,
+                     data_ptr=buf.ctypes.data, output_ptr=buf.ctypes.data,
+                     plane=hn.PLANE_HOST)
+    r, err = core.wait(h); assert r == 1, err
+    np.testing.assert_array_equal(buf, expect.astype(np.float32))
+    dc = core.ring_cross_bytes() - c0
+    if rank in (0, 2):  # leaders (block layout: lowest rank per host)
+        assert dc > 0, dc
+    else:
+        assert dc == 0, dc
+
+    # Ragged allgatherv through the env-dispatched hierarchical path.
+    rows = rank + 1
+    blk = np.full((rows, 3), float(rank), np.float32)
+    h = core.enqueue("env.agv", hn.OP_ALLGATHER, 1, 7, blk.shape,
+                     data_ptr=blk.ctypes.data, output_ptr=0,
+                     plane=hn.PLANE_HOST)
+    r, err = core.wait(h); assert r == 1, err
+    raw, dims = core.result_fetch(h)
+    assert dims == (1, 2, 3, 4), dims
+    out = np.frombuffer(raw, np.float32).reshape(10, 3)
+    off = 0
+    for rr in range(SIZE):
+        assert np.all(out[off:off + rr + 1] == float(rr)), (rr, out)
+        off += rr + 1
+
+    core.shutdown()
+    print(f"HIERENV_{rank}_OK")
+""")
+
+
+def test_hierarchical_env_dispatch_block_layout(tmp_path):
+    """HOROVOD_HIERARCHICAL_* env defaults route the host plane without
+    any tuner involvement, under block placement (host = rank // 2):
+    exact results, leaders-only cross traffic, ragged allgatherv
+    included."""
+    run_world(tmp_path, _ENV_DISPATCH_WORKER, "HIERENV", size=4)
+
+
+_LEADER_RAISE_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    os.environ.update(HOROVOD_RANK=str(rank), HOROVOD_SIZE="4",
+                      HOROVOD_LOCAL_RANK=str(rank % 2),
+                      HOROVOD_LOCAL_SIZE="2",
+                      HOROVOD_CROSS_RANK=str(rank // 2),
+                      HOROVOD_CROSS_SIZE="2",
+                      HOROVOD_CONTROLLER_ADDR="127.0.0.1",
+                      HOROVOD_CONTROLLER_PORT=str(port),
+                      HOROVOD_CYCLE_TIME="1.0",
+                      HOROVOD_HIERARCHICAL_ALLREDUCE="1",
+                      JAX_PLATFORMS="cpu")
+    # The leader of host 0 (rank 0, local_rank 0) raises at its SECOND
+    # pass through the cross-leg seam; every other rank sails through.
+    os.environ["HOROVOD_FAULT_SPEC"] = \\
+        "ring.hier.cross:rank=0:step=1:kind=raise"
+    from horovod_tpu.common import faults
+    from horovod_tpu.common.exceptions import HorovodInternalError
+    from horovod_tpu.common.host_world import world
+
+    w = world()
+    w.init()
+    assert w.size == 4 and w.cross_size == 2, (w.size, w.cross_size)
+    out = w.allgather_np(np.asarray([float(rank)]), "hc.0")
+    np.testing.assert_allclose(out.ravel(), [0.0, 1.0, 2.0, 3.0])
+    if rank == 0:
+        try:
+            w.allgather_np(np.asarray([9.0]), "hc.poisoned")
+            raise AssertionError("leader cross-leg fault did not fire")
+        except faults.FaultInjected as e:
+            # FaultInjected IS-A HorovodInternalError: the elastic retry
+            # loop treats a dead leader like any collective failure.
+            assert isinstance(e, HorovodInternalError)
+            assert "ring.hier.cross" in str(e), e
+    else:
+        # Peers complete the collective (the fault interrupts the
+        # leader's WAITER, not the background data plane).
+        out = w.allgather_np(np.asarray([9.0 + rank]), "hc.poisoned")
+        assert out.shape[0] == 4
+    # Non-leaders never arm the seam: the point is gated on local_rank 0.
+    if rank % 2 == 1:
+        assert "ring.hier.cross" not in faults._hits, faults._hits
+    # All ranks re-sync before teardown: rank 0's shutdown ends the WHOLE
+    # world (coordinator semantics), so it must not race the peers still
+    # completing the poisoned collective. step=1 pinned the fault to the
+    # previous wait, so this barrier passes the seam untouched.
+    w.barrier("hc.done")
+    w.shutdown()
+    print(f"HIERRAISE_{rank}_OK")
+""")
+
+
+def test_leader_cross_leg_fault_surfaces_internal_error(tmp_path):
+    """faults.point('ring.hier.cross'): armed only on local leaders of a
+    hierarchical world; kind=raise surfaces as HorovodInternalError (the
+    elastic contract), deterministically on the exact rank + hit."""
+    run_world(tmp_path, _LEADER_RAISE_WORKER, "HIERRAISE", size=4)
+
+
+# ---- hvd.ring_traffic() (the Python surface of the split counters) ---------
+
+
+def test_ring_traffic_empty_safe(monkeypatch):
+    # Pure-direct mode / before init: all zeros, no native core touched.
+    # Both core sources are pinned uninitialized so in-process tests that
+    # ran earlier in this pytest session can't leak a live world in.
+    import horovod_tpu as hvd
+    from horovod_tpu.common import host_world as _hw
+    from horovod_tpu.common import state as _state
+
+    monkeypatch.setattr(_state.global_state(), "initialized", False)
+    monkeypatch.setattr(_hw, "_world", _hw.HostWorld())
+    assert hvd.ring_traffic() == {
+        "bytes_sent": 0, "local_bytes": 0, "cross_bytes": 0,
+        "hierarchical_allreduce": False, "hierarchical_allgather": False,
+        "tuned": False}
+
+
+def test_ring_traffic_reads_engine_core_and_decodes_flags(monkeypatch):
+    import horovod_tpu as hvd
+    from horovod_tpu.common import state as _state
+
+    class _Core:
+        def ring_bytes_sent(self):
+            return 700
+
+        def ring_local_bytes(self):
+            return 500
+
+        def ring_cross_bytes(self):
+            return 200
+
+        def host_hier_flags(self):
+            return 2  # allgather bit only
+
+        def get_hier_flags(self):
+            return 2  # >= 0: an autotuner decision reached this rank
+
+    class _Engine:
+        native_core = _Core()
+
+    st = _state.global_state()
+    monkeypatch.setattr(st, "initialized", True)
+    monkeypatch.setattr(st, "engine", _Engine())
+    assert hvd.ring_traffic() == {
+        "bytes_sent": 700, "local_bytes": 500, "cross_bytes": 200,
+        "hierarchical_allreduce": False, "hierarchical_allgather": True,
+        "tuned": True}
+
+
+# ---- 32-rank scale soak (VERDICT r5 #5) ------------------------------------
+
+_SOAK_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    from horovod_tpu.common import native as hn
+
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    SIZE, HOSTS = 32, 8  # 8 hosts x 4 local, round-robin
+    core = hn.NativeCore()
+    ok = core.init(rank=rank, size=SIZE, local_rank=rank // HOSTS,
+                   local_size=SIZE // HOSTS, cross_rank=rank % HOSTS,
+                   cross_size=HOSTS, coordinator_addr="127.0.0.1",
+                   coordinator_port=port, my_host="127.0.0.1",
+                   cycle_time_ms=1.0, fusion_threshold=64 << 20,
+                   cache_capacity=256, stall_warning_sec=120.0,
+                   stall_shutdown_sec=0.0, stall_check_enabled=True,
+                   exec_callback=lambda resp, rid: core.response_done(
+                       rid, False, "host-plane only"))
+    assert ok, "native init failed"
+
+    # Negotiation soak: repeated cached-path rounds (tree allreduce).
+    for i in range(10):
+        x = np.full(8, float(rank + 1), np.float32)
+        h = core.enqueue("soak.hot", hn.OP_ALLREDUCE, 1, 7, x.shape,
+                         data_ptr=x.ctypes.data, output_ptr=x.ctypes.data,
+                         plane=hn.PLANE_HOST)
+        r, err = core.wait(h); assert r == 1, err
+        assert np.allclose(x, sum(range(1, SIZE + 1))), x[:2]
+    if rank != 0:
+        assert core.cache_hits() >= 8, core.cache_hits()
+
+    # Large ring allreduce (above the tree cutoff) + hierarchical rerun.
+    buf = (np.arange(1 << 14, dtype=np.float32) % 9) + rank
+    expect = (np.arange(1 << 14) % 9) * SIZE + sum(range(SIZE))
+    h = core.enqueue("soak.big", hn.OP_ALLREDUCE, 1, 7, buf.shape,
+                     data_ptr=buf.ctypes.data, output_ptr=buf.ctypes.data,
+                     plane=hn.PLANE_HOST)
+    r, err = core.wait(h); assert r == 1, err
+    np.testing.assert_array_equal(buf, expect.astype(np.float32))
+
+    # Deterministic flip (see the headline worker): the hint is set
+    # before rank 0 submits, so this barrier's frame carries the flags.
+    if rank == 0:
+        core.set_hier_flags(3)
+    z = np.zeros(1, np.uint8)
+    h = core.enqueue("soak.sync", hn.OP_BARRIER, 1, 0, z.shape,
+                     data_ptr=z.ctypes.data, output_ptr=z.ctypes.data,
+                     plane=hn.PLANE_HOST)
+    r, err = core.wait(h); assert r == 1, err
+    assert core.get_hier_flags() == 3
+    buf2 = (np.arange(1 << 14, dtype=np.float32) % 9) + rank
+    h = core.enqueue("soak.hier", hn.OP_ALLREDUCE, 1, 7, buf2.shape,
+                     data_ptr=buf2.ctypes.data, output_ptr=buf2.ctypes.data,
+                     plane=hn.PLANE_HOST)
+    r, err = core.wait(h); assert r == 1, err
+    assert np.array_equal(buf, buf2), "hier diverged from flat at 32 ranks"
+
+    # VHDD Adasum at 32 ranks (5 halving levels, peer links to rank^16).
+    from horovod_tpu.ops.adasum import adasum_reference
+    e = np.array([1.0, 2.0, 3.0], np.float32) * (rank + 1)
+    h = core.enqueue("soak.ad", hn.OP_ALLREDUCE, 2, 7, e.shape,
+                     data_ptr=e.ctypes.data, output_ptr=e.ctypes.data,
+                     plane=hn.PLANE_HOST)
+    r, err = core.wait(h); assert r == 1, err
+    expected_e = adasum_reference(
+        [np.array([1.0, 2.0, 3.0]) * (rr + 1) for rr in range(SIZE)])
+    assert np.allclose(e, expected_e, rtol=1e-4), (e, expected_e)
+
+    core.shutdown()
+    print(f"SOAK32_{rank}_OK")
+""")
+
+
+@pytest.mark.slow
+@pytest.mark.full
+def test_controller_scale_soak_32_ranks(tmp_path):
+    """32-process controller + data-plane soak (VERDICT r5 #5): cached
+    negotiation rounds, the large flat ring, the tuner-flipped
+    hierarchical rerun (byte-identity at 32 ranks), and VHDD Adasum at
+    the deepest recursion this machine can schedule. The companion RTT
+    evidence lives in docs/controller_bench.json (size-32 row)."""
+    run_world(tmp_path, _SOAK_WORKER, "SOAK32", size=32, timeout=540)

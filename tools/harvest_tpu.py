@@ -20,6 +20,33 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "docs", "probes")
 
+# One rolling narration log for every harvest/probe-loop run (VERDICT r5
+# #7): a single truncated docs/probes/harvest.log instead of a dated
+# file per invocation, so probe chatter stops accreting head-of-history
+# commits while the recent window evidence stays inspectable.
+LOG_PATH = os.path.join(OUT, "harvest.log")
+LOG_MAX_BYTES = 64 * 1024
+
+
+def log(msg):
+    """Narrate to stderr AND the rolling log. The file keeps roughly the
+    last LOG_MAX_BYTES/2, truncated at a line boundary; logging failures
+    never take the harvester down."""
+    line = time.strftime("[%Y%m%dT%H%M%S] ") + msg
+    print(line, file=sys.stderr)
+    try:
+        os.makedirs(OUT, exist_ok=True)
+        with open(LOG_PATH, "a") as f:
+            f.write(line + "\n")
+        if os.path.getsize(LOG_PATH) > LOG_MAX_BYTES:
+            with open(LOG_PATH) as f:
+                data = f.read()[-LOG_MAX_BYTES // 2:]
+            nl = data.find("\n")
+            with open(LOG_PATH, "w") as f:
+                f.write("[...truncated...]\n" + data[nl + 1:])
+    except OSError:
+        pass
+
 
 _BENCH = None
 
@@ -88,8 +115,7 @@ def phase(name, cmd, timeout):
     ts = time.strftime("%Y%m%dT%H%M%S")
     out_path = os.path.join(OUT, f"{name}_{ts}.out")
     err_path = os.path.join(OUT, f"{name}_{ts}.err")
-    print(f"harvest: {name} (timeout {timeout}s) -> {out_path}",
-          file=sys.stderr)
+    log(f"harvest: {name} (timeout {timeout}s) -> {out_path}")
     t0 = time.time()
     try:
         with open(out_path, "w") as fo, open(err_path, "w") as fe:
@@ -98,12 +124,11 @@ def phase(name, cmd, timeout):
         rc = r.returncode
     except subprocess.TimeoutExpired:
         rc = "timeout"
-    print(f"harvest: {name} rc={rc} ({time.time()-t0:.0f}s)",
-          file=sys.stderr)
+    log(f"harvest: {name} rc={rc} ({time.time()-t0:.0f}s)")
     with open(out_path) as f:
         tail = f.read()[-1500:]
     if tail.strip():
-        print(tail, file=sys.stderr)
+        log(tail)
     return rc == 0
 
 
@@ -119,15 +144,13 @@ def main(argv=None):
 
     got = probe()
     while not got and args.loop > 0:
-        print(time.strftime("harvest: %Y%m%dT%H%M%S compute probe failed; "
-                            f"retrying in {args.loop}s"), file=sys.stderr)
+        log(f"harvest: compute probe failed; retrying in {args.loop}s")
         time.sleep(args.loop)
         got = probe()
     if not got:
-        print("harvest: TPU tunnel down (probe failed); nothing captured",
-              file=sys.stderr)
+        log("harvest: TPU tunnel down (probe failed); nothing captured")
         return 1
-    print(f"harvest: tunnel OPEN ({got}) — capturing", file=sys.stderr)
+    log(f"harvest: tunnel OPEN ({got}) — capturing")
 
     plan = capture_plan(sys.executable)
     results = {}
@@ -143,11 +166,10 @@ def main(argv=None):
             # the rest of a live window. rc 2 tells the caller the run
             # was truncated (vs 0 = full capture) so a wrapper can
             # re-enter its probe loop.
-            print("harvest: tunnel closed mid-run; stopping early",
-                  file=sys.stderr)
-            print(f"harvest: done {results}", file=sys.stderr)
+            log("harvest: tunnel closed mid-run; stopping early")
+            log(f"harvest: done {results}")
             return 2
-    print(f"harvest: done {results}", file=sys.stderr)
+    log(f"harvest: done {results}")
     return 0
 
 

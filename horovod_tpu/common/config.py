@@ -42,6 +42,11 @@ HOROVOD_CONTROLLER_ADDR = "HOROVOD_CONTROLLER_ADDR"
 HOROVOD_CONTROLLER_PORT = "HOROVOD_CONTROLLER_PORT"
 HOROVOD_RENDEZVOUS_ADDR = "HOROVOD_GLOO_RENDEZVOUS_ADDR"
 HOROVOD_RENDEZVOUS_PORT = "HOROVOD_GLOO_RENDEZVOUS_PORT"
+HOROVOD_HOSTNAME = "HOROVOD_HOSTNAME"
+HOROVOD_SECRET_KEY = "HOROVOD_SECRET_KEY"
+HOROVOD_ELASTIC_PREEMPT_SIGNAL = "HOROVOD_ELASTIC_PREEMPT_SIGNAL"
+HOROVOD_NATIVE = "HOROVOD_NATIVE"
+HOROVOD_NATIVE_SANITIZE = "HOROVOD_NATIVE_SANITIZE"
 # TPU-specific additions
 HOROVOD_TPU_MESH_AXES = "HOROVOD_TPU_MESH_AXES"
 HOROVOD_TPU_DONUT_SIZE = "HOROVOD_TPU_DONUT_SIZE"
@@ -140,6 +145,174 @@ def _get_float(name: str, default: float) -> float:
         return float(v) if v is not None else default
     except ValueError:
         return default
+
+
+# ---- launch-topology + identity accessors ----------------------------------
+#
+# One accessor per knob: every consumer shares one default and one parse
+# (the env-discipline check in tools/hvdlint rejects raw reads anywhere
+# else, and docs/env-vars.md is generated from these shapes). Boolean
+# knobs all go through ``_get_bool`` — the same grammar as the native
+# core's ``EnvFlag`` parser ("1"/"true"/"yes"/"on" enable, anything else
+# disables, case/whitespace-insensitive).
+
+
+def rank() -> int:
+    """This process's launch-time global rank (0 when unlaunched)."""
+    return _get_int(HOROVOD_RANK, 0)
+
+
+def rank_string():
+    """The raw ``HOROVOD_RANK`` value, ``None`` when not launched —
+    for consumers that want presence (log prefixes), not a parsed 0."""
+    return os.environ.get(HOROVOD_RANK)
+
+
+def size() -> int:
+    """Launch-time world size (1 when unlaunched)."""
+    return _get_int(HOROVOD_SIZE, 1)
+
+
+def local_rank() -> int:
+    """Launch-time local (per-host) rank (0 when unlaunched)."""
+    return _get_int(HOROVOD_LOCAL_RANK, 0)
+
+
+def cross_rank(default: int) -> int:
+    """Node index from the launcher; the caller supplies the derived
+    fallback (rank // local_size under homogeneous packing)."""
+    return _get_int(HOROVOD_CROSS_RANK, default)
+
+
+def cross_size(default: int) -> int:
+    """Node count from the launcher; fallback derived like cross_rank."""
+    return _get_int(HOROVOD_CROSS_SIZE, default)
+
+
+def controller_addr() -> str:
+    """The coordination-service host (gRPC base + native controller)."""
+    return os.environ.get(HOROVOD_CONTROLLER_ADDR, "127.0.0.1")
+
+
+def controller_base_port() -> int:
+    """The *base* coordination port (jax.distributed/gRPC binds it; the
+    native controller binds base+1 via ``native_controller_port``)."""
+    return _get_int(HOROVOD_CONTROLLER_PORT, 29500)
+
+
+def rendezvous_addr():
+    """Elastic rendezvous KV host, ``None`` when not under the elastic
+    driver (empty counts as unset)."""
+    return os.environ.get(HOROVOD_RENDEZVOUS_ADDR) or None
+
+
+def rendezvous_port():
+    """Elastic rendezvous KV port as an int, ``None`` when unset or
+    unparseable (matching ``rendezvous_addr``'s None-when-absent).
+
+    Unparseable-but-set warns loudly: callers guard with ``if addr and
+    port`` and degrade to non-elastic operation, which must not look
+    identical to the launcher never exporting the port (the pre-accessor
+    code raised ValueError here; a silent None would send debugging in
+    the wrong direction)."""
+    v = os.environ.get(HOROVOD_RENDEZVOUS_PORT)
+    if not v:
+        return None
+    try:
+        return int(v)
+    except ValueError:
+        from . import logging as _hvd_logging
+        _hvd_logging.warning(
+            f"{HOROVOD_RENDEZVOUS_PORT}={v!r} is not a valid port; "
+            "elastic rendezvous registration disabled")
+        return None
+
+
+def rendezvous_port_string():
+    """The raw ``HOROVOD_GLOO_RENDEZVOUS_PORT`` value, ``None`` when
+    unset/empty — for error messages that must show an unparseable value
+    instead of misreporting it as missing (``rendezvous_port()`` maps
+    both cases to None)."""
+    return os.environ.get(HOROVOD_RENDEZVOUS_PORT) or None
+
+
+def hostname(default=None):
+    """This slot's advertised hostname. The ssh launcher exports a
+    per-slot value; scheduler launches leave it unset, so callers pass
+    the fallback that is right for their plane (loopback, localhost, or
+    ``socket.gethostname()``)."""
+    return os.environ.get(HOROVOD_HOSTNAME, default)
+
+
+def secret_key_b64():
+    """The elastic driver's base64 notification key; ``None`` when this
+    process was not launched by the elastic driver."""
+    return os.environ.get(HOROVOD_SECRET_KEY) or None
+
+
+def preempt_signal_spec() -> str:
+    """The opt-in preemption signal (name or number; empty = opt-out).
+    Truthiness of the return is the opt-in check; parsing to a signal
+    number happens at the one consumer (elastic.state)."""
+    return os.environ.get(HOROVOD_ELASTIC_PREEMPT_SIGNAL, "").strip()
+
+
+def elastic_enabled() -> bool:
+    """Whether this world runs under the elastic driver (same
+    ``_get_bool`` grammar as ``RuntimeConfig.elastic`` — previously the
+    host-world check counted ANY non-empty value, so ``HOROVOD_ELASTIC=0``
+    enabled elastic; that drift is what this accessor retires)."""
+    return _get_bool(HOROVOD_ELASTIC)
+
+
+def native_enabled() -> bool:
+    """Whether the native (C++) host plane may load. Default on;
+    ``_get_bool`` grammar means "0"/"false"/"no"/"off" all disable —
+    the raw reads this replaces special-cased only "0"/"false", so
+    ``HOROVOD_NATIVE=no`` silently stayed enabled."""
+    return _get_bool(HOROVOD_NATIVE, default=True)
+
+
+NATIVE_SANITIZE_CHOICES = ("tsan", "asan")
+
+
+def native_sanitize() -> str:
+    """Sanitizer variant of the native core to build and load ("" = the
+    production artifact). "tsan"/"asan" select ``libhvdtpu_{tsan,asan}.so``
+    (``csrc/Makefile`` variant targets), built beside — never instead
+    of — the normal library. Read at first library load per process;
+    docs/static-analysis.md has the build/run recipe (an instrumented
+    .so needs its sanitizer runtime present in the host process)."""
+    v = os.environ.get(HOROVOD_NATIVE_SANITIZE, "").strip().lower()
+    if v in ("", "0", "none", "off"):
+        return ""
+    if v in NATIVE_SANITIZE_CHOICES:
+        return v
+    from . import logging as _log
+
+    _log.warning(f"{HOROVOD_NATIVE_SANITIZE}={v!r} is not one of "
+                 f"{sorted(NATIVE_SANITIZE_CHOICES)}; ignoring "
+                 f"(loading the uninstrumented library)")
+    return ""
+
+
+def log_level_name() -> str:
+    """Lower-cased ``HOROVOD_LOG_LEVEL`` ("warning" default)."""
+    return os.environ.get(HOROVOD_LOG_LEVEL, "warning").strip().lower()
+
+
+def log_hide_time() -> bool:
+    """Drop timestamps from log lines (``_get_bool`` grammar — the raw
+    read accepted only "1"/"true", missing "yes"/"on")."""
+    return _get_bool(HOROVOD_LOG_HIDE_TIME)
+
+
+def rejoin_grace_env():
+    """Operator override for the elastic rejoin grace, ``None`` when
+    unset/empty (the driver-published KV value applies then)."""
+    if not os.environ.get(HOROVOD_ELASTIC_REJOIN_GRACE):
+        return None
+    return _get_float(HOROVOD_ELASTIC_REJOIN_GRACE, 10.0)
 
 
 # ---- fault injection (common/faults.py; docs/fault-injection.md) ----------

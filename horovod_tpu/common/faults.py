@@ -17,7 +17,8 @@ subsystem):
   exponential backoff with full jitter, an overall deadline, and an
   on-retry callback into ``common/logging.py`` + ``timeline.py``.
   Per-call policies come from ``HOROVOD_RETRY_*`` envs
-  (``config.retry_policy_from_env``). tools/lint_retry.sh enforces that
+  (``config.retry_policy_from_env``). hvdlint's retry-discipline check
+  (docs/static-analysis.md) enforces that
   no new bare ``time.sleep(`` retry loop appears outside this module.
 """
 
@@ -92,10 +93,7 @@ def active() -> bool:
 
 
 def _default_rank() -> int:
-    try:
-        return int(os.environ.get(_config.HOROVOD_RANK, "0"))
-    except ValueError:
-        return 0
+    return _config.rank()
 
 
 def point(name: str, rank: Optional[int] = None) -> None:

@@ -58,8 +58,9 @@ def _rejoin_grace_seconds(addr=None, port=None) -> float:
     rendezvous KV and workers read it from there; the
     HOROVOD_ELASTIC_REJOIN_GRACE env knob, when set, overrides. Read per
     (re-)init, like every other runtime knob."""
-    if os.environ.get(_config.HOROVOD_ELASTIC_REJOIN_GRACE):
-        return _config._get_float(_config.HOROVOD_ELASTIC_REJOIN_GRACE, 10.0)
+    grace = _config.rejoin_grace_env()
+    if grace is not None:
+        return grace
     if addr and port:
         from ..run.http.http_client import read_data_from_kvstore
         try:
@@ -144,12 +145,9 @@ class HostWorld:
             # per-node packing (homogeneous layout, the same assumption the
             # reference's rankfile makes).
             ls = max(1, self.local_size)
-            self.cross_rank = int(
-                os.environ.get(_config.HOROVOD_CROSS_RANK,
-                               str(self.rank // ls)))
-            self.cross_size = int(
-                os.environ.get(_config.HOROVOD_CROSS_SIZE,
-                               str(max(1, (self.size + ls - 1) // ls))))
+            self.cross_rank = _config.cross_rank(self.rank // ls)
+            self.cross_size = _config.cross_size(
+                max(1, (self.size + ls - 1) // ls))
             self._maybe_elastic_rerendezvous()
             if comm is not None:
                 # Parity with hvd.init(comm=[ranks]) (basics.py:33-65):
@@ -205,11 +203,11 @@ class HostWorld:
         the same against the elastic rendezvous handler,
         ``run/elastic/rendezvous.py:22-45``)."""
         self._elastic_controller = None
-        if not os.environ.get(_config.HOROVOD_ELASTIC):
+        if not _config.elastic_enabled():
             return
-        addr = os.environ.get(_config.HOROVOD_RENDEZVOUS_ADDR)
-        port = os.environ.get(_config.HOROVOD_RENDEZVOUS_PORT)
-        hostname = os.environ.get("HOROVOD_HOSTNAME")
+        addr = _config.rendezvous_addr()
+        port = _config.rendezvous_port()
+        hostname = _config.hostname()
         if not (addr and port and hostname):
             return
         from ..run.elastic.rendezvous import fetch_slot_info
@@ -228,9 +226,10 @@ class HostWorld:
             try:
                 fetched = fetch_slot_info(addr, int(port), hostname,
                                           self.local_rank, rank=self.rank)
+            # hvdlint: ignore[exception-discipline] -- first init only:
+            # the launch-time env block is still authoritative, so an
+            # unreachable rendezvous degrades to it (re-inits DO raise)
             except Exception as e:
-                # First init: the launch-time env block is still
-                # authoritative; proceed on it.
                 _log.warning(f"elastic rendezvous unreachable at first "
                              f"init; using env topology: {e}")
                 return
@@ -375,14 +374,13 @@ class HostWorld:
         if self._elastic_controller is not None:
             addr, ctrl_port = self._elastic_controller
         else:
-            addr = os.environ.get(_config.HOROVOD_CONTROLLER_ADDR,
-                                  "127.0.0.1")
+            addr = _config.controller_addr()
             ctrl_port = _config.native_controller_port()
         # The ssh launcher exports a per-slot HOROVOD_HOSTNAME; scheduler
         # launchers (jsrun/srun) give every rank the same env, so fall back
         # to the actual hostname — advertising 127.0.0.1 would point peers'
         # ring connections at the wrong machine on multi-host worlds.
-        my_host = os.environ.get("HOROVOD_HOSTNAME")
+        my_host = _config.hostname()
         if not my_host:
             my_host = socket.gethostname() if self.size > 1 else "127.0.0.1"
 

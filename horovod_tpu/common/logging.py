@@ -8,8 +8,9 @@ timestamps suppressed by ``HOROVOD_LOG_HIDE_TIME``.
 from __future__ import annotations
 
 import logging as _pylogging
-import os
 import sys
+
+from . import config as _config
 
 _LEVELS = {
     "trace": 5,
@@ -29,13 +30,10 @@ def get_logger() -> _pylogging.Logger:
     global _logger
     if _logger is None:
         _logger = _pylogging.getLogger("horovod_tpu")
-        level_name = os.environ.get("HOROVOD_LOG_LEVEL", "warning").strip().lower()
+        level_name = _config.log_level_name()
         _logger.setLevel(_LEVELS.get(level_name, _pylogging.WARNING))
         handler = _pylogging.StreamHandler(sys.stderr)
-        hide_time = os.environ.get("HOROVOD_LOG_HIDE_TIME", "").strip().lower() in (
-            "1",
-            "true",
-        )
+        hide_time = _config.log_hide_time()
         fmt = "[%(levelname)s] %(message)s" if hide_time else (
             "%(asctime)s [%(levelname)s] %(message)s"
         )
@@ -46,7 +44,7 @@ def get_logger() -> _pylogging.Logger:
 
 
 def _prefix(msg: str) -> str:
-    rank = os.environ.get("HOROVOD_RANK")
+    rank = _config.rank_string()
     return f"[rank {rank}] {msg}" if rank is not None else msg
 
 

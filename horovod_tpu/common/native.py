@@ -17,11 +17,21 @@ import subprocess
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
+from . import config as _config
 from . import logging as _log
 
 _LIB_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "lib")
-_LIB_PATH = os.path.join(_LIB_DIR, "libhvdtpu.so")
 _CSRC_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "csrc")
+
+
+def _lib_path() -> str:
+    """The artifact for the selected build variant: the sanitizer
+    variants live BESIDE the production .so (``libhvdtpu_{tsan,asan}.so``,
+    csrc/Makefile) so an instrumented run never clobbers or masquerades
+    as the normal build."""
+    san = _config.native_sanitize()
+    name = f"libhvdtpu_{san}.so" if san else "libhvdtpu.so"
+    return os.path.join(_LIB_DIR, name)
 
 # dtype codes must match csrc/hvd/common.h DataType
 DTYPE_CODES = {
@@ -55,9 +65,12 @@ _EXEC_CB_TYPE = ctypes.CFUNCTYPE(None, ctypes.POINTER(ctypes.c_char),
 
 def _build_library() -> bool:
     try:
-        subprocess.run(["make", "-C", _CSRC_DIR], check=True,
-                       capture_output=True, timeout=300)
-        return os.path.exists(_LIB_PATH)
+        san = _config.native_sanitize()
+        cmd = ["make", "-C", _CSRC_DIR] + ([san] if san else [])
+        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+        return os.path.exists(_lib_path())
+    # hvdlint: ignore[exception-discipline] -- build probe: the native
+    # core is optional and no collective exists before it loads
     except Exception as e:  # compiler missing etc.
         _log.warning(f"native runtime build failed: {e}")
         return False
@@ -77,14 +90,15 @@ def load_library():
     so disabling it mid-process (tests, a re-init after a bad native
     world) is honored even after an earlier load."""
     global _lib
-    if os.environ.get("HOROVOD_NATIVE", "1") in ("0", "false"):
+    if not _config.native_enabled():
         return None
     if _lib is not None:
         return _lib
-    if not os.path.exists(_LIB_PATH) and not _build_library():
+    lib_path = _lib_path()
+    if not os.path.exists(lib_path) and not _build_library():
         return None
     try:
-        lib = ctypes.CDLL(_LIB_PATH)
+        lib = ctypes.CDLL(lib_path)
         return _bind_prototypes(lib)
     except (OSError, AttributeError) as e:
         # A stale .so from an older build (missing symbols) or a
@@ -95,7 +109,7 @@ def load_library():
             return None
         try:
             _lib = None
-            lib = ctypes.CDLL(_LIB_PATH)
+            lib = ctypes.CDLL(lib_path)
             return _bind_prototypes(lib)
         except (OSError, AttributeError) as e2:
             _log.warning(f"native library still unusable after rebuild "
@@ -319,7 +333,10 @@ class NativeCore:
             try:
                 raw = ctypes.string_at(data_ptr, length)
                 exec_callback(parse_response_list(raw), response_id)
-            except Exception as e:  # never let exceptions cross into C++
+            # hvdlint: ignore[exception-discipline] -- an exception must
+            # never cross into the C++ cycle thread; response_done(False)
+            # is the error channel every waiting rank raises from
+            except Exception as e:
                 _log.error(f"exec callback error: {e}")
                 self.response_done(response_id, False, str(e))
 

@@ -20,7 +20,6 @@ re-designed TPU-first:
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Optional, Sequence
 
@@ -85,7 +84,7 @@ def _maybe_init_distributed() -> None:
     """
     import jax
 
-    nproc = int(os.environ.get(_config.HOROVOD_SIZE, "1"))
+    nproc = _config.size()
     # NOTE: no jax.process_count()/jax.devices() here — any backend query
     # initializes XLA, after which jax.distributed.initialize refuses to
     # run. Use the distributed client's own state to detect re-init.
@@ -93,9 +92,9 @@ def _maybe_init_distributed() -> None:
 
     if nproc <= 1 or distributed_is_initialized():
         return
-    rank = int(os.environ.get(_config.HOROVOD_RANK, "0"))
-    addr = os.environ.get(_config.HOROVOD_CONTROLLER_ADDR, "127.0.0.1")
-    port = os.environ.get(_config.HOROVOD_CONTROLLER_PORT, "29500")
+    rank = _config.rank()
+    addr = _config.controller_addr()
+    port = _config.controller_base_port()
     _log.debug(f"joining distributed world: {rank}/{nproc} via {addr}:{port}")
     jax.distributed.initialize(
         coordinator_address=f"{addr}:{port}",

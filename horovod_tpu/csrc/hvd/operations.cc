@@ -94,8 +94,12 @@ struct GlobalState {
   std::atomic<bool> shutdown_requested{false};
   std::atomic<bool> loop_done{false};
 
-  int rank = 0, size = 1, local_rank = 0, local_size = 1;
-  int cross_rank = 0, cross_size = 1;
+  // Atomic: written by hvd_init (under init_mu) but read lock-free by the
+  // topology getters and the enqueue path — a monitor thread polling
+  // hvd_rank() across an elastic re-init must not race the store
+  // (TSan-verified by tests/test_native_tsan.py).
+  std::atomic<int> rank{0}, size{1}, local_rank{0}, local_size{1};
+  std::atomic<int> cross_rank{0}, cross_size{1};
   std::atomic<double> cycle_time_ms{5.0};
   // Join state (reference HorovodGlobalState::joined): while set, this rank
   // contributes zeros to other ranks' reductions instead of real tensors.
@@ -133,8 +137,9 @@ struct GlobalState {
   // grid tunes a real host-plane routing choice too.
   std::atomic<int> hier_flags{-1};
   // Untuned default from HOROVOD_HIERARCHICAL_ALLREDUCE/ALLGATHER (read
-  // at init; must agree across ranks, like every dispatch env).
-  int hier_env_flags = 0;
+  // at init; must agree across ranks, like every dispatch env). Atomic:
+  // hvd_host_hier_flags polls it lock-free while re-init rewrites it.
+  std::atomic<int> hier_env_flags{0};
 
   // executor-allocated results, keyed by handle (fetched then erased)
   std::mutex results_mu;
@@ -167,7 +172,7 @@ bool EnvFlag(const char* name) {
 bool HostHierBit(int bit) {
   auto* s = g();
   int hf = s->hier_flags.load();
-  int flags = hf >= 0 ? hf : s->hier_env_flags;
+  int flags = hf >= 0 ? hf : s->hier_env_flags.load();
   return ((flags >> bit) & 1) != 0;
 }
 
@@ -488,9 +493,9 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   // A fresh world starts from the env config; a previous world's tuned
   // dispatch flags must not leak through re-init.
   s->hier_flags.store(-1);
-  s->hier_env_flags =
+  s->hier_env_flags.store(
       (hvd::EnvFlag("HOROVOD_HIERARCHICAL_ALLREDUCE") ? 1 : 0) |
-      (hvd::EnvFlag("HOROVOD_HIERARCHICAL_ALLGATHER") ? 2 : 0);
+      (hvd::EnvFlag("HOROVOD_HIERARCHICAL_ALLGATHER") ? 2 : 0));
   s->rank = rank;
   s->size = size;
   s->local_rank = local_rank;
@@ -681,12 +686,12 @@ long long hvd_get_fusion_threshold() {
 }
 
 int hvd_initialized() { return hvd::g()->initialized.load() ? 1 : 0; }
-int hvd_rank() { return hvd::g()->rank; }
-int hvd_size() { return hvd::g()->size; }
-int hvd_local_rank() { return hvd::g()->local_rank; }
-int hvd_local_size() { return hvd::g()->local_size; }
-int hvd_cross_rank() { return hvd::g()->cross_rank; }
-int hvd_cross_size() { return hvd::g()->cross_size; }
+int hvd_rank() { return hvd::g()->rank.load(); }
+int hvd_size() { return hvd::g()->size.load(); }
+int hvd_local_rank() { return hvd::g()->local_rank.load(); }
+int hvd_local_size() { return hvd::g()->local_size.load(); }
+int hvd_cross_rank() { return hvd::g()->cross_rank.load(); }
+int hvd_cross_size() { return hvd::g()->cross_size.load(); }
 
 void hvd_register_exec_callback(void (*cb)(const char*, int, long)) {
   hvd::g()->exec_cb.store(cb);
@@ -845,6 +850,10 @@ int hvd_last_joined() { return hvd::g()->last_joined.load(); }
 // Adasum must be O(count) per rank, not O(count * size)).
 long long hvd_ring_bytes_sent() {
   auto* s = hvd::g();
+  // init_mu also guards hvd_shutdown's ring.reset(): a monitor thread
+  // polling traffic counters across shutdown must not dereference a ring
+  // being freed (same race family as hvd_set_parameters vs shutdown).
+  std::lock_guard<std::mutex> lk(s->init_mu);
   return s->ring ? s->ring->bytes_sent() : 0;
 }
 
@@ -854,11 +863,13 @@ long long hvd_ring_bytes_sent() {
 // accounted cross (one process per host presumed).
 long long hvd_ring_local_bytes() {
   auto* s = hvd::g();
+  std::lock_guard<std::mutex> lk(s->init_mu);
   return s->ring ? s->ring->local_bytes_sent() : 0;
 }
 
 long long hvd_ring_cross_bytes() {
   auto* s = hvd::g();
+  std::lock_guard<std::mutex> lk(s->init_mu);
   return s->ring ? s->ring->cross_bytes_sent() : 0;
 }
 
@@ -870,7 +881,7 @@ long long hvd_ring_cross_bytes() {
 int hvd_host_hier_flags() {
   auto* s = hvd::g();
   int hf = s->hier_flags.load();
-  return hf >= 0 ? hf : s->hier_env_flags;
+  return hf >= 0 ? hf : s->hier_env_flags.load();
 }
 
 // Poll: 0 pending, 1 done-ok, -1 done-error.

@@ -1,5 +1,15 @@
 #include "tensor_queue.h"
 
+// TSan-build detection across compilers (GCC spells it
+// __SANITIZE_THREAD__, clang exposes __has_feature(thread_sanitizer)).
+#if defined(__SANITIZE_THREAD__)
+#define HVD_TSAN_BUILD 1
+#elif defined(__has_feature)
+#if __has_feature(thread_sanitizer)
+#define HVD_TSAN_BUILD 1
+#endif
+#endif
+
 namespace hvd {
 
 Status TensorQueue::AddToTensorQueue(TensorTableEntry entry) {
@@ -64,7 +74,24 @@ size_t TensorQueue::PendingCount() {
 void TensorQueue::WaitForMessages(
     std::chrono::steady_clock::time_point deadline) {
   std::unique_lock<std::mutex> lk(mu_);
+#ifdef HVD_TSAN_BUILD
+  // libstdc++ implements steady_clock cv waits via pthread_cond_clockwait,
+  // which GCC-10-era libtsan does NOT intercept: TSan misses the
+  // unlock/relock inside the wait, so every later lock of mu_ reports a
+  // false "double lock" and the happens-before state of the whole mutex
+  // is poisoned (verified with a minimal correct repro). The TSan build
+  // therefore waits on the intercepted system_clock path. The clock
+  // conversion is bounded by one cycle (ms) and an enqueue's notify
+  // still breaks the wait, so instrumented behavior stays equivalent.
+  auto sys_deadline =
+      std::chrono::system_clock::now() +
+      std::chrono::duration_cast<std::chrono::system_clock::duration>(
+          deadline - std::chrono::steady_clock::now());
+  cv_.wait_until(lk, sys_deadline,
+                 [&] { return !queue_.empty() || closed_; });
+#else
   cv_.wait_until(lk, deadline, [&] { return !queue_.empty() || closed_; });
+#endif
 }
 
 std::vector<TensorTableEntry> TensorQueue::DrainAll() {

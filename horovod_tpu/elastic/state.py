@@ -17,10 +17,10 @@ from __future__ import annotations
 
 import copy
 import functools
-import os
 import queue
 from typing import Callable, Dict, List
 
+from ..common import config as _config
 from ..common import logging as _log
 from ..common.exceptions import HorovodInternalError, HostsUpdatedInterrupt
 
@@ -71,7 +71,7 @@ def register_preemption_signal(signum=None):
     import signal as _signal
 
     if signum is None:
-        name = os.environ.get("HOROVOD_ELASTIC_PREEMPT_SIGNAL", "SIGTERM")
+        name = _config.preempt_signal_spec() or "SIGTERM"
         signum = (int(name) if name.isdigit()
                   else getattr(_signal, name.upper()))
 

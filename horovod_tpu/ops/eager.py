@@ -31,7 +31,6 @@ chip".
 
 from __future__ import annotations
 
-import os
 import queue
 import threading
 from typing import Any, Dict, List, Optional, Tuple
@@ -116,9 +115,8 @@ class EagerEngine:
         if self._core.available:
             self._exec_q: "queue.SimpleQueue" = queue.SimpleQueue()
             cfg = state.config
-            coordinator_addr = os.environ.get(
-                "HOROVOD_CONTROLLER_ADDR", "127.0.0.1")
-            my_host = os.environ.get("HOROVOD_HOSTNAME", "127.0.0.1")
+            coordinator_addr = _hvd_config.controller_addr()
+            my_host = _hvd_config.hostname("127.0.0.1")
             ok = self._core.init(
                 rank=state.process_index, size=state.process_count,
                 local_rank=0, local_size=state.local_size,
@@ -186,6 +184,9 @@ class EagerEngine:
                 for resp in responses:
                     self._execute_response(resp)
                 self._core.response_done(response_id, True)
+            # hvdlint: ignore[exception-discipline] -- not swallowed: the
+            # error lands in every pending handle (raised at wait) AND in
+            # response_done(ok=False), the collective error channel
             except Exception as e:
                 _log.error(f"XLA executor failure: {e}")
                 for resp in responses:
@@ -621,6 +622,9 @@ class EagerEngine:
                 raise ValueError(kind)
             self._record_autotune([stacked])
             err = None
+        # hvdlint: ignore[exception-discipline] -- deferred, not
+        # swallowed: the handle stores the exception and synchronize()
+        # re-raises it on the caller's thread
         except Exception as e:
             out, post, err = None, None, e
         return self._new_direct_handle(out if err is None else err,
@@ -686,6 +690,9 @@ class EagerEngine:
             outs = self._exec_grouped_allreduce(stacks, op, prescale_factor,
                                                 postscale_factor)
             err = None
+        # hvdlint: ignore[exception-discipline] -- deferred, not
+        # swallowed: the handle stores the exception and synchronize()
+        # re-raises it on the caller's thread
         except Exception as e:
             outs, err = None, e
 
@@ -754,6 +761,9 @@ class EagerEngine:
         try:
             out = self._exec_allgather(padded)
             err = None
+        # hvdlint: ignore[exception-discipline] -- deferred, not
+        # swallowed: the handle stores the exception and synchronize()
+        # re-raises it on the caller's thread
         except Exception as e:
             out, err = None, e
 

@@ -45,6 +45,9 @@ def terminate_executor_shell_and_children(pid: int) -> None:
             os.killpg(pgid, 0)
         except OSError:
             return  # group is gone
+        # hvdlint: ignore[retry-discipline] -- SIGTERM->SIGKILL grace
+        # poll on a process group, not a retry: fixed cadence against a
+        # hard deadline, nothing to back off from
         time.sleep(0.1)
     try:
         os.killpg(pgid, signal.SIGKILL)

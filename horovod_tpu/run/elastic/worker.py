@@ -12,7 +12,6 @@ posts into the process-local elastic mailbox, which surfaces as
 
 from __future__ import annotations
 
-import os
 import pickle
 from typing import List, Optional, Tuple
 
@@ -59,14 +58,14 @@ class WorkerNotificationManager:
     def init(self) -> None:
         if self._service is not None:
             return
-        key_b64 = os.environ.get("HOROVOD_SECRET_KEY")
+        key_b64 = _config.secret_key_b64()
         if not key_b64:
             return  # not launched by the elastic driver
         import base64
 
         key = base64.b64decode(key_b64)
         self._service = WorkerNotificationService(key)
-        if os.environ.get("HOROVOD_ELASTIC_PREEMPT_SIGNAL"):
+        if _config.preempt_signal_spec():
             # Opt-in: convert TPU-VM preemption signals into graceful
             # re-rendezvous at the next commit (see
             # elastic.state.register_preemption_signal). Signal handlers
@@ -82,16 +81,16 @@ class WorkerNotificationManager:
                 # signal name; OSError: uncatchable signal (e.g. SIGKILL).
                 _log.warning(
                     f"preemption-signal handler not installed: {e}")
-        addr = os.environ.get(_config.HOROVOD_RENDEZVOUS_ADDR)
-        port = os.environ.get(_config.HOROVOD_RENDEZVOUS_PORT)
+        addr = _config.rendezvous_addr()
+        port = _config.rendezvous_port()
         # Keyed by (hostname, local_rank) — stable for the process's whole
         # lifetime, unlike the rank, which the driver reassigns on
         # membership changes.
-        hostname = os.environ.get("HOROVOD_HOSTNAME", "localhost")
-        local_rank = os.environ.get(_config.HOROVOD_LOCAL_RANK, "0")
+        hostname = _config.hostname("localhost")
+        local_rank = _config.local_rank()
         if addr and port:
             put_data_into_kvstore(
-                addr, int(port), "workers", f"{hostname}:{local_rank}",
+                addr, port, "workers", f"{hostname}:{local_rank}",
                 pickle.dumps(self._service.addresses()))
 
     def shutdown(self) -> None:

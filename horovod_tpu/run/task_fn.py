@@ -6,7 +6,6 @@ the pickled return value into the launcher's rendezvous KV store under
 
 from __future__ import annotations
 
-import os
 import sys
 
 
@@ -22,9 +21,21 @@ def main() -> int:
 
     result = func(*args, **kwargs)
 
-    addr = os.environ[_config.HOROVOD_RENDEZVOUS_ADDR]
-    port = int(os.environ[_config.HOROVOD_RENDEZVOUS_PORT])
-    rank = os.environ.get(_config.HOROVOD_RANK, "0")
+    addr = _config.rendezvous_addr()
+    port = _config.rendezvous_port()
+    if addr is None or port is None:
+        raw_port = _config.rendezvous_port_string()
+        # Distinguish "launcher never set the env" from "the env is set
+        # but garbage": the old raw int() raised showing the bad value,
+        # and losing that would send debugging in the wrong direction.
+        detail = (f" ({_config.HOROVOD_RENDEZVOUS_PORT}={raw_port!r} is "
+                  f"not a valid port)" if addr is not None and raw_port
+                  else "; run it under horovodrun")
+        raise RuntimeError(
+            "task_fn requires the launcher's rendezvous env "
+            f"({_config.HOROVOD_RENDEZVOUS_ADDR}/"
+            f"{_config.HOROVOD_RENDEZVOUS_PORT}){detail}")
+    rank = _config.rank()
     put_data_into_kvstore(addr, port, "result", f"rank.{rank}",
                           cloudpickle.dumps(result))
     return 0

@@ -14,6 +14,7 @@ import os
 import subprocess
 from typing import Optional
 
+from ..common import config as _config
 from ..common import logging as _log
 from ..common import native as _native
 
@@ -61,13 +62,13 @@ def load():
     if _ops is not None or _tried:
         return _ops
     _tried = True
-    if os.environ.get("HOROVOD_NATIVE", "1") in ("0", "false"):
+    if not _config.native_enabled():
         return None
     # The kernels resolve the runtime's C API from the ctypes-loaded
     # libhvdtpu.so; export its path so the extension dlopens the same copy.
     if _native.load_library() is None:
         return None
-    os.environ.setdefault("HVDTPU_LIB", _native._LIB_PATH)
+    os.environ.setdefault("HVDTPU_LIB", _native._lib_path())
     if not os.path.exists(_LIB_PATH) and not _build():
         return None
     try:

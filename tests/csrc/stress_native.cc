@@ -1,0 +1,234 @@
+// ThreadSanitizer stress harness for the native core (built and run by
+// tests/test_native_tsan.py; recipe in docs/static-analysis.md).
+//
+// Hammers the concurrency surfaces the Python bindings expose to real
+// user threads, under the interleavings the 32-rank soak (PR 4) leans
+// on but cannot observe races in:
+//
+//   - concurrent EnqueueTensorAllreduce from several submitter threads
+//     vs the background cycle loop (tensor queue, handle table,
+//     response execution, wait/erase);
+//   - observability getters (cache hits, ring traffic counters, stall
+//     report, topology getters, cache-hit fast-path counters) polled
+//     from a monitor thread THROUGH hvd_shutdown — the getter-vs-
+//     ring.reset() use-after-free family;
+//   - autotuner hooks (set_parameters / set_hier_flags /
+//     set_host_via_xla / negotiation recording) racing the cycle loop
+//     and shutdown;
+//   - repeated init/shutdown worlds (elastic re-init), where the
+//     topology fields are rewritten while monitors poll them;
+//   - Ring::SetTopology + traffic counters on a standalone ring, the
+//     init-thread-then-collective handoff the hierarchical paths rely
+//     on.
+//
+// The harness itself must stay race-free: every stop flag is atomic and
+// threads are joined before each world teardown completes. Exits 0 and
+// prints STRESS_OK when all phases complete; any TSan report fails the
+// run via TSAN_OPTIONS=exitcode=66 (set by the pytest driver).
+
+#include <atomic>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "../../horovod_tpu/csrc/hvd/ring_ops.h"
+
+// The extern "C" surface of operations.cc (no installed header — the
+// Python side binds by symbol, and so does this harness).
+extern "C" {
+int hvd_init(int rank, int size, int local_rank, int local_size,
+             int cross_rank, int cross_size, const char* coordinator_addr,
+             int coordinator_port, const char* my_host, double cycle_time_ms,
+             long long fusion_threshold, int cache_capacity,
+             double stall_warning_sec, double stall_shutdown_sec,
+             int stall_check_enabled);
+void hvd_shutdown();
+long long hvd_enqueue(const char* name, int op, int reduce_op, int dtype,
+                      const long long* shape, int ndim, void* data,
+                      void* output, int root_rank, double prescale,
+                      double postscale, int plane);
+int hvd_test(long long handle, char* err, int errlen);
+int hvd_wait(long long handle, char* err, int errlen);
+int hvd_pending_count();
+int hvd_initialized();
+int hvd_rank();
+int hvd_size();
+int hvd_local_rank();
+int hvd_local_size();
+int hvd_cross_rank();
+int hvd_cross_size();
+int hvd_last_joined();
+long long hvd_cache_hits();
+long long hvd_ring_bytes_sent();
+long long hvd_ring_local_bytes();
+long long hvd_ring_cross_bytes();
+int hvd_host_hier_flags();
+int hvd_get_hier_flags();
+void hvd_set_hier_flags(int flags);
+double hvd_get_cycle_time_ms();
+long long hvd_get_fusion_threshold();
+void hvd_set_parameters(double cycle_time_ms, long long fusion_threshold);
+void hvd_set_host_via_xla(long long threshold);
+void hvd_set_record_negotiation(int enabled);
+int hvd_drain_negotiation(char* buf, int cap);
+int hvd_stall_report(char* buf, int cap);
+}
+
+namespace {
+
+constexpr int kOpAllreduce = 0;   // CollectiveOp::ALLREDUCE
+constexpr int kReduceSum = 1;     // ReduceOp::SUM
+constexpr int kDtypeF32 = 7;      // DataType::HVD_FLOAT32
+constexpr int kPlaneHost = 1;     // DevicePlane::HOST
+
+int failures = 0;
+#define CHECK(cond, what)                       \
+  do {                                          \
+    if (!(cond)) {                              \
+      std::fprintf(stderr, "FAIL: %s\n", what); \
+      ++failures;                               \
+    }                                           \
+  } while (0)
+
+// Submitter: enqueue host-plane allreduces and wait each one out. Names
+// repeat every 8 iterations so the controller's per-name negotiation
+// path sees steady reuse (the cached-response shape of a training
+// loop). A handle < 0 (enqueue refused: shutdown won the race) is fine;
+// waiting on it would hang, so it is skipped.
+void Submitter(int id, int iters) {
+  float buf[16];
+  float out[16];
+  long long shape[1] = {16};
+  char err[256];
+  for (int i = 0; i < iters; ++i) {
+    for (int k = 0; k < 16; ++k) buf[k] = static_cast<float>(id + i + k);
+    std::string name =
+        "stress_t" + std::to_string(id) + "_" + std::to_string(i % 8);
+    long long h =
+        hvd_enqueue(name.c_str(), kOpAllreduce, kReduceSum, kDtypeF32,
+                    shape, 1, buf, out, -1, 1.0, 1.0, kPlaneHost);
+    if (h < 0) return;  // world already gone — a valid interleaving
+    hvd_wait(h, err, sizeof(err));  // ok or aborted-by-shutdown
+  }
+}
+
+// Monitor: poll every observability getter, including straight through
+// shutdown (the getters must be safe against a concurrently-freed
+// ring/controller).
+void Monitor(std::atomic<bool>* stop) {
+  char buf[4096];
+  volatile long long sink = 0;  // keep loads observable
+  while (!stop->load()) {
+    sink += hvd_cache_hits();
+    sink += hvd_ring_bytes_sent();
+    sink += hvd_ring_local_bytes();
+    sink += hvd_ring_cross_bytes();
+    sink += hvd_host_hier_flags();
+    sink += hvd_get_hier_flags();
+    sink += static_cast<long long>(hvd_get_cycle_time_ms());
+    sink += hvd_get_fusion_threshold();
+    sink += hvd_pending_count();
+    sink += hvd_initialized();
+    sink += hvd_rank() + hvd_size() + hvd_local_rank() + hvd_local_size();
+    sink += hvd_cross_rank() + hvd_cross_size();
+    sink += hvd_last_joined();
+    sink += hvd_stall_report(buf, sizeof(buf));
+  }
+  (void)sink;
+}
+
+// Tuner: exercise every runtime-mutation hook the autotuner owns.
+void Tuner(std::atomic<bool>* stop) {
+  char buf[4096];
+  int k = 0;
+  while (!stop->load()) {
+    ++k;
+    hvd_set_parameters(1.0 + (k % 3), 1 << 20);
+    hvd_set_hier_flags(k % 4);
+    hvd_set_host_via_xla(k % 2 ? -1 : (1 << 30));
+    hvd_set_record_negotiation(k % 2);
+    hvd_drain_negotiation(buf, sizeof(buf));
+  }
+}
+
+// One world: init, hammer from submitters + monitor + tuner, then shut
+// down WHILE the monitor and tuner are still hammering — the teardown
+// interleaving is the point.
+void RunWorld(int world, int submitters, int iters) {
+  int rc = hvd_init(/*rank=*/0, /*size=*/1, /*local_rank=*/0,
+                    /*local_size=*/1, /*cross_rank=*/0, /*cross_size=*/1,
+                    "127.0.0.1", /*port=*/0, "127.0.0.1",
+                    /*cycle_time_ms=*/1.0, /*fusion_threshold=*/1 << 20,
+                    /*cache_capacity=*/64, /*stall_warning_sec=*/60.0,
+                    /*stall_shutdown_sec=*/0.0, /*stall_check=*/0);
+  CHECK(rc == 0, "hvd_init");
+  if (rc != 0) return;
+
+  std::atomic<bool> stop{false};
+  std::thread monitor(Monitor, &stop);
+  std::thread tuner(Tuner, &stop);
+  std::vector<std::thread> subs;
+  for (int i = 0; i < submitters; ++i) {
+    subs.emplace_back(Submitter, world * 100 + i, iters);
+  }
+  // Tear the world down under the last submitter (odd worlds) or after
+  // all submitters finished (even worlds) — both interleavings matter.
+  if (world % 2 == 1 && !subs.empty()) {
+    for (size_t i = 0; i + 1 < subs.size(); ++i) subs[i].join();
+    hvd_shutdown();  // races the final submitter's enqueue/wait
+    subs.back().join();
+  } else {
+    for (auto& t : subs) t.join();
+    hvd_shutdown();
+  }
+  // Monitor/tuner keep hammering a torn-down world for a moment: the
+  // getters must stay safe against controller.reset()/ring.reset().
+  hvd_shutdown();  // double-shutdown must be a no-op
+  stop.store(true);
+  monitor.join();
+  tuner.join();
+}
+
+// Standalone Ring: SetTopology on one thread, then collectives on
+// another (the init-thread -> background-thread handoff), with traffic
+// counters polled concurrently throughout.
+void RingPhase() {
+  hvd::Ring ring;  // unconnected: size 1, local loop-back semantics
+  std::atomic<bool> stop{false};
+  std::thread poll([&] {
+    volatile long long sink = 0;
+    while (!stop.load()) {
+      sink += ring.bytes_sent() + ring.local_bytes_sent() +
+              ring.cross_bytes_sent() + ring.rank() + ring.size();
+    }
+    (void)sink;
+  });
+  for (int round = 0; round < 50; ++round) {
+    ring.SetTopology({round % 2});  // rewrites the host-group table
+    std::thread worker([&] {        // created AFTER: the real ordering
+      float buf[32];
+      for (int k = 0; k < 32; ++k) buf[k] = static_cast<float>(round + k);
+      hvd::Status st =
+          ring.Allreduce(buf, buf, 32, hvd::DataType::HVD_FLOAT32,
+                         hvd::ReduceOp::SUM, 1.0, 1.0);
+      CHECK(st.ok(), "standalone ring allreduce");
+    });
+    worker.join();
+  }
+  stop.store(true);
+  poll.join();
+}
+
+}  // namespace
+
+int main() {
+  for (int world = 0; world < 4 && failures == 0; ++world) {
+    RunWorld(world, /*submitters=*/3, /*iters=*/150);
+  }
+  if (failures == 0) RingPhase();
+  if (failures) return 1;
+  std::puts("STRESS_OK");
+  return 0;
+}

@@ -160,6 +160,81 @@ int main() {
     CHECK(r2.size() <= 1, "no garbage responses past bad frame");
   }
 
+  // 7. Endpoint-map frame with the PR 4 host-topology column: the
+  // coordinator broadcasts rank -> (host, port, cross_rank) after the
+  // hello exchange (controller.cc TcpController::Initialize). Round-trip
+  // the frame, then verify every truncation is detected by Reader::ok()
+  // — a worker must never adopt a half-parsed topology table.
+  {
+    const int n = 3;
+    const char* hosts[n] = {"10.0.0.1", "10.0.0.2", "host-c.local"};
+    const int ports[n] = {40001, 40002, 40003};
+    // Mixed real groups and the collision-free "unreported" sentinel
+    // (size + rank) the coordinator assigns when a hello omits the
+    // cross field.
+    const int cross[n] = {0, 1, n + 2};
+    Writer w;
+    w.i32(n);
+    for (int i = 0; i < n; ++i) {
+      w.str(hosts[i]);
+      w.i32(ports[i]);
+      w.i32(cross[i]);
+    }
+    const std::string& frame = w.data();
+    Reader r(frame);
+    CHECK(r.i32() == n, "endpoint map count");
+    for (int i = 0; i < n; ++i) {
+      CHECK(r.str() == hosts[i], "endpoint map host");
+      CHECK(r.i32() == ports[i], "endpoint map port");
+      CHECK(r.i32() == cross[i], "endpoint map cross_rank");
+    }
+    CHECK(r.ok(), "endpoint map roundtrip ok");
+    for (size_t len = 0; len < frame.size(); ++len) {
+      Reader t(frame.data(), len);
+      int m = t.i32();
+      for (int i = 0; i < m && t.ok(); ++i) {
+        t.str();
+        t.i32();
+        t.i32();
+      }
+      CHECK(!t.ok(), "truncated endpoint map detected");
+      if (failures) break;
+    }
+  }
+
+  // 8. Hello-line contract (controller.cc:277 sscanf shape): the
+  // whitespace-delimited "rank host data_port job_key cross_rank" must
+  // parse field-position-stably — a 4-field (pre-PR 4) hello yields
+  // fields==4 and leaves cross at its -1 sentinel, so old workers are
+  // grouped by the coordinator's collision-free default instead of
+  // being folded into host 0.
+  {
+    struct Case {
+      const char* hello;
+      int want_fields, want_rank, want_port, want_cross;
+    } cases[] = {
+        {"2 10.0.0.7 41000 ab12cd 1", 5, 2, 41000, 1},
+        {"2 10.0.0.7 41000 - 0", 5, 2, 41000, 0},   // empty job key
+        {"2 10.0.0.7 41000 ab12cd", 4, 2, 41000, -1},  // pre-PR4 hello
+        {"2 10.0.0.7 41000", 3, 2, 41000, -1},
+        {"garbage", 0, 0, 0, -1},
+    };
+    for (const auto& c : cases) {
+      int rank = 0, port = 0, cross = -1;
+      char host[256] = {0};
+      char key[256] = {0};
+      int fields = std::sscanf(c.hello, "%d %255s %d %255s %d", &rank,
+                               host, &port, key, &cross);
+      if (fields < 0) fields = 0;  // EOF on no-conversion
+      CHECK(fields == c.want_fields, "hello field count");
+      if (fields >= 3) {
+        CHECK(rank == c.want_rank, "hello rank");
+        CHECK(port == c.want_port, "hello port");
+      }
+      CHECK(cross == c.want_cross, "hello cross_rank");
+    }
+  }
+
   if (failures) return 1;
   std::puts("MESSAGE_CODEC_OK");
   return 0;

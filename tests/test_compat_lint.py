@@ -1,85 +1,105 @@
-"""Tier-1 guard for jax-0.4.37 compatibility: no raw new-jax API
-spellings outside ``common/compat.py``.
+"""Tier-1 guards driven through hvdlint (docs/static-analysis.md).
 
-The installed jax predates the modern API (``jax.shard_map``,
-``lax.axis_size``, ``jax.distributed.is_initialized``,
-``jax_num_cpu_devices``, pallas ``CompilerParams``); the tree routes
-every use through ``horovod_tpu/common/compat.py``. A raw spelling
-imports cleanly, passes review, and then fails at call time on this
-image — so the lint (``tools/lint_compat.sh``) runs in tier-1 and fails
-fast with the offending lines.
+The jax-0.4.37 compatibility rule (no raw new-jax API outside
+``common/compat.py``) and the retry rule (no ``time.sleep`` loops
+outside ``common/faults.py``) used to be regex shell lints; they are
+now AST checks in ``tools/hvdlint`` (``compat-discipline`` /
+``retry-discipline``). These tests keep the rules failing INSIDE the
+pytest run, prove each check still bites on a planted violation, and
+hold the deprecated shell wrappers to their delegation contract until
+they are removed.
 """
 
 import os
 import subprocess
 import sys
+import textwrap
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-SCRIPT = os.path.join(REPO, "tools", "lint_compat.sh")
-RETRY_SCRIPT = os.path.join(REPO, "tools", "lint_retry.sh")
+COMPAT_WRAPPER = os.path.join(REPO, "tools", "lint_compat.sh")
+RETRY_WRAPPER = os.path.join(REPO, "tools", "lint_retry.sh")
+
+
+def _hvdlint(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.hvdlint", *args], cwd=REPO,
+        capture_output=True, text=True, timeout=300)
+
+
+def _scratch_tree(tmp_path, files):
+    root = tmp_path / "repo"
+    pkg = root / "horovod_tpu"
+    (pkg / "common").mkdir(parents=True)
+    (pkg / "common" / "compat.py").write_text("# the allowed home\n")
+    (pkg / "common" / "faults.py").write_text("CATALOG = ()\n")
+    for rel, text in files.items():
+        (pkg / rel).write_text(textwrap.dedent(text))
+    return str(root)
 
 
 def test_no_raw_new_jax_apis_outside_compat():
-    r = subprocess.run(["bash", SCRIPT], capture_output=True, text=True,
-                       timeout=120)
+    r = _hvdlint("--check", "compat-discipline")
     assert r.returncode == 0, (
         "raw new-jax API spellings found (route them through "
         "horovod_tpu/common/compat.py):\n" + r.stdout + r.stderr)
 
 
-def test_lint_catches_a_violation(tmp_path):
-    """The lint actually bites: a synthetic violation planted in a
-    throwaway copy of the package dir is reported nonzero. (Copying the
-    whole repo is overkill — plant into a scratch tree that mirrors the
-    layout the script greps.)"""
-    import shutil
+def test_no_bare_retry_sleeps_outside_faults():
+    r = _hvdlint("--check", "retry-discipline")
+    assert r.returncode == 0, (
+        "time.sleep retry loops found (use common.faults.Retrier, "
+        "see docs/fault-injection.md):\n" + r.stdout + r.stderr)
 
-    scratch = tmp_path / "repo"
-    (scratch / "tools").mkdir(parents=True)
-    pkg = scratch / "horovod_tpu"
-    pkg.mkdir()
-    (pkg / "bad.py").write_text(
-        "import jax\n"
-        "f = jax.shard_map(lambda x: x)\n")
-    common = pkg / "common"
-    common.mkdir()
-    (common / "compat.py").write_text("# the allowed home\n")
-    shutil.copy(SCRIPT, scratch / "tools" / "lint_compat.sh")
-    r = subprocess.run(["bash", str(scratch / "tools" / "lint_compat.sh")],
-                       capture_output=True, text=True, timeout=120)
+
+def test_compat_check_catches_an_aliased_violation(tmp_path):
+    """The AST check bites where the old regex was blind: the banned
+    API reached through an import alias."""
+    root = _scratch_tree(tmp_path, {"bad.py": """\
+        import jax as j
+        f = j.shard_map(lambda x: x)
+        """})
+    r = _hvdlint("--check", "compat-discipline", root)
     assert r.returncode == 1, r.stdout + r.stderr
     assert "bad.py" in r.stdout
 
 
-def test_no_bare_retry_sleeps_outside_faults():
-    """Retry-discipline guard (tools/lint_retry.sh): every retry/poll
-    loop routes through common.faults.Retrier; bare time.sleep( outside
-    the allowlist fails tier-1."""
-    r = subprocess.run(["bash", RETRY_SCRIPT], capture_output=True,
-                       text=True, timeout=120)
-    assert r.returncode == 0, (
-        "bare time.sleep( retry loops found (use common.faults.Retrier, "
-        "see docs/fault-injection.md):\n" + r.stdout + r.stderr)
-
-
-def test_retry_lint_catches_a_violation(tmp_path):
-    import shutil
-
-    scratch = tmp_path / "repo"
-    (scratch / "tools").mkdir(parents=True)
-    pkg = scratch / "horovod_tpu"
-    (pkg / "common").mkdir(parents=True)
-    (pkg / "common" / "faults.py").write_text(
-        "import time\ntime.sleep(1)  # the allowed home\n")
-    (pkg / "sneaky.py").write_text(
-        "import time\n"
-        "while True:\n"
-        "    time.sleep(0.5)\n")
-    shutil.copy(RETRY_SCRIPT, scratch / "tools" / "lint_retry.sh")
-    r = subprocess.run(["bash", str(scratch / "tools" / "lint_retry.sh")],
-                       capture_output=True, text=True, timeout=120)
+def test_retry_check_catches_a_sleep_loop(tmp_path):
+    root = _scratch_tree(tmp_path, {"sneaky.py": """\
+        import time
+        while True:
+            time.sleep(0.5)
+        """})
+    r = _hvdlint("--check", "retry-discipline", root)
     assert r.returncode == 1, r.stdout + r.stderr
     assert "sneaky.py" in r.stdout
-    # Allowlisted files that are absent (or sleep-free) must not produce
-    # shell arithmetic noise — grep -c's exit-1-on-zero-matches trap.
-    assert "integer expression" not in r.stderr, r.stderr
+
+
+def test_retry_check_allows_one_shot_sleep(tmp_path):
+    """The old per-file budgets are gone: a one-shot grace sleep
+    anywhere is fine, only sleep-in-loop is the defect."""
+    root = _scratch_tree(tmp_path, {"grace.py": """\
+        import time
+
+        def pause():
+            time.sleep(2)
+        """})
+    r = _hvdlint("--check", "retry-discipline", root)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_deprecated_wrappers_delegate(tmp_path):
+    """The shell lints survive one release as thin wrappers: clean tree
+    -> 0 with a deprecation note; violation tree -> 1."""
+    for wrapper in (COMPAT_WRAPPER, RETRY_WRAPPER):
+        r = subprocess.run(["bash", wrapper], capture_output=True,
+                           text=True, timeout=300)
+        assert r.returncode == 0, wrapper + ":\n" + r.stdout + r.stderr
+        assert "DEPRECATED" in r.stderr, wrapper
+    bad = _scratch_tree(tmp_path, {"bad.py": """\
+        import jax as j
+        f = j.shard_map(lambda x: x)
+        """})
+    r = subprocess.run(["bash", COMPAT_WRAPPER, bad], capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "bad.py" in r.stdout

@@ -1,0 +1,395 @@
+"""hvdlint (tools/hvdlint): every project-invariant check must flag its
+seeded violation fixtures and pass its compliant ones, suppressions must
+be honored (and reason-less ones reported), the JSON report schema must
+hold, and — the check that matters — the analyzer must run clean on
+HEAD with the committed env-var registry in sync.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.hvdlint.checks import ALL_CHECKS  # noqa: E402
+from tools.hvdlint.cli import main  # noqa: E402
+from tools.hvdlint.core import Project, run_checks  # noqa: E402
+from tools.hvdlint.registry import extract, render_markdown  # noqa: E402
+
+MINIMAL_FAULTS = 'CATALOG = ()\n'
+
+
+def make_tree(tmp_path, files, faults=MINIMAL_FAULTS, tests=None):
+    """A scratch repo shaped the way hvdlint scans: ``files`` maps
+    package-relative paths to sources (common/faults.py is always
+    present so the fault-registry check has its single source of
+    truth); ``tests`` maps tests/-relative paths for the seam-coverage
+    direction."""
+    root = tmp_path / "repo"
+    pkg = root / "horovod_tpu"
+    (pkg / "common").mkdir(parents=True)
+    (pkg / "common" / "faults.py").write_text(faults)
+    for rel, text in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    for rel, text in (tests or {}).items():
+        p = root / "tests" / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return str(root)
+
+
+def findings_of(root, check_id=None, active_only=True):
+    fs = run_checks(Project(root), ALL_CHECKS)
+    if active_only:
+        fs = [f for f in fs if not f.suppressed]
+    if check_id is not None:
+        fs = [f for f in fs if f.check == check_id]
+    return fs
+
+
+# ---------------------------------------------------------------------------
+# 1. env-discipline
+# ---------------------------------------------------------------------------
+
+def test_env_discipline_flags_raw_reads(tmp_path):
+    root = make_tree(tmp_path, {"bad.py": """\
+        import os
+        from os import environ, getenv
+        a = os.environ.get("HOROVOD_RANK")
+        b = os.getenv("HOROVOD_SIZE", "1")
+        c = os.environ["HOROVOD_ELASTIC"]
+        d = environ.get("HOROVOD_CYCLE_TIME")   # aliased module
+        e = getenv("HOROVOD_TIMELINE")          # aliased function
+        f = os.environ.setdefault("HOROVOD_NATIVE", "0")
+        g = "HOROVOD_ELASTIC" in os.environ       # presence test
+        h = "HOROVOD_TIMELINE" not in os.environ  # negated presence test
+        """})
+    hits = findings_of(root, "env-discipline")
+    assert len(hits) == 8, [f.render() for f in hits]
+    assert {f.line for f in hits} == {3, 4, 5, 6, 7, 8, 9, 10}
+
+
+def test_env_discipline_allows_config_and_foreign_keys(tmp_path):
+    root = make_tree(tmp_path, {
+        "common/config.py": """\
+            import os
+            v = os.environ.get("HOROVOD_RANK")  # the accessor layer
+            """,
+        "ok.py": """\
+            import os
+            p = os.environ.get("PATH")          # not a HOROVOD_ knob
+            q = os.environ.copy()               # wholesale, no key read
+            os.environ["HOROVOD_RANK"] = "3"    # a WRITE (launcher) is fine
+            r = "PATH" in os.environ            # foreign-key presence test
+            """})
+    assert findings_of(root, "env-discipline") == []
+
+
+# ---------------------------------------------------------------------------
+# 2. compat-discipline
+# ---------------------------------------------------------------------------
+
+def test_compat_discipline_sees_through_aliases(tmp_path):
+    root = make_tree(tmp_path, {"bad.py": """\
+        import jax as j
+        from jax import shard_map as sm
+        from jax.experimental.shard_map import shard_map
+        f = j.shard_map(lambda x: x)
+        g = j.lax.axis_size
+        h = j.distributed.is_initialized()
+        """})
+    hits = findings_of(root, "compat-discipline")
+    # 2 banned imports (lines 2, 3) + attribute uses through the alias
+    # (shard_map, axis_size, is_initialized).
+    assert {f.line for f in hits} == {2, 3, 4, 5, 6}, \
+        [f.render() for f in hits]
+
+
+def test_compat_discipline_literal_and_config_key(tmp_path):
+    root = make_tree(tmp_path, {"bad.py": """\
+        import jax
+        jax.config.update("jax_num_cpu_devices", 8)
+        p = jax.experimental.pallas.tpu.CompilerParams()
+        """})
+    hits = findings_of(root, "compat-discipline")
+    assert {f.line for f in hits} == {2, 3}, [f.render() for f in hits]
+
+
+def test_compat_discipline_allows_compat_and_old_apis(tmp_path):
+    root = make_tree(tmp_path, {
+        "common/compat.py": """\
+            import jax
+            sm = getattr(jax, "shard_map", None)
+            """,
+        "ok.py": """\
+            import jax
+            import jax.numpy as jnp
+            y = jax.jit(lambda x: jnp.sum(x))
+            """})
+    assert findings_of(root, "compat-discipline") == []
+
+
+# ---------------------------------------------------------------------------
+# 3. retry-discipline
+# ---------------------------------------------------------------------------
+
+def test_retry_discipline_flags_sleep_in_loops(tmp_path):
+    root = make_tree(tmp_path, {"bad.py": """\
+        import time
+        from time import sleep
+
+        def poll():
+            while True:
+                time.sleep(0.5)
+
+        def scan(xs):
+            for _ in xs:
+                sleep(1)
+        """})
+    hits = findings_of(root, "retry-discipline")
+    assert {f.line for f in hits} == {6, 10}, [f.render() for f in hits]
+
+
+def test_retry_discipline_allows_one_shot_and_nested_defs(tmp_path):
+    root = make_tree(tmp_path, {
+        "common/faults.py": """\
+            import time
+            CATALOG = ()
+
+            def retrier():
+                while True:
+                    time.sleep(0.1)  # the one allowed home
+            """,
+        "ok.py": """\
+            import time
+
+            def grace():
+                time.sleep(2)  # one-shot grace sleep: fine
+
+            def build():
+                for _ in range(3):
+                    def cb():
+                        time.sleep(1)  # runs on its own schedule
+            """})
+    assert findings_of(root, "retry-discipline") == []
+
+
+# ---------------------------------------------------------------------------
+# 4. fault-registry
+# ---------------------------------------------------------------------------
+
+FAULTS_WITH_CATALOG = 'CATALOG = ("ring.exec", "checkpoint.write")\n'
+
+
+def test_fault_registry_flags_unregistered_and_dynamic(tmp_path):
+    root = make_tree(tmp_path, {"bad.py": """\
+        from .common import faults
+        faults.point("not.registered")
+        name = "ring.exec"
+        faults.point(name)  # dynamic: statically uncheckable
+        """}, faults=FAULTS_WITH_CATALOG,
+        tests={"test_ok.py": "# ring.exec checkpoint.write\n"})
+    hits = findings_of(root, "fault-registry")
+    assert {f.line for f in hits} == {2, 4}, [f.render() for f in hits]
+
+
+def test_fault_registry_flags_unreferenced_seam(tmp_path):
+    root = make_tree(tmp_path, {"ok.py": """\
+        from .common import faults
+        faults.point("ring.exec")
+        faults.point("checkpoint.write")
+        """}, faults=FAULTS_WITH_CATALOG,
+        tests={"test_ok.py": "# exercises ring.exec only\n"})
+    hits = findings_of(root, "fault-registry")
+    assert len(hits) == 1 and "checkpoint.write" in hits[0].message, \
+        [f.render() for f in hits]
+
+
+def test_fault_registry_requires_catalog(tmp_path):
+    root = make_tree(tmp_path, {}, faults="POINTS = []\n")
+    hits = findings_of(root, "fault-registry")
+    assert len(hits) == 1 and "CATALOG" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# 5. exception-discipline
+# ---------------------------------------------------------------------------
+
+def test_exception_discipline_flags_bare_and_swallowed(tmp_path):
+    root = make_tree(tmp_path, {
+        "anywhere.py": """\
+            try:
+                x = 1
+            except:
+                pass
+            """,
+        "ops/collective.py": """\
+            def run(op):
+                try:
+                    op()
+                except Exception:
+                    return None  # swallows HorovodInternalError
+            """})
+    bare = findings_of(root, "exception-discipline")
+    assert len(bare) == 2, [f.render() for f in bare]
+    assert {(f.path, f.line) for f in bare} == {
+        ("horovod_tpu/anywhere.py", 3),
+        ("horovod_tpu/ops/collective.py", 4)}
+
+
+def test_exception_discipline_compliant_handlers(tmp_path):
+    root = make_tree(tmp_path, {
+        "ops/ok.py": """\
+            def reraises(op):
+                try:
+                    op()
+                except Exception:
+                    raise
+
+            def arm_first(op):
+                try:
+                    op()
+                except HorovodInternalError:
+                    raise
+                except Exception:
+                    return None
+            """,
+        "spark/outside.py": """\
+            def tolerant(op):
+                try:
+                    op()
+                except Exception:
+                    return None  # not a collective/elastic path
+            """})
+    assert findings_of(root, "exception-discipline") == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+def test_suppression_trailing_and_block_above(tmp_path):
+    root = make_tree(tmp_path, {"s.py": """\
+        import os
+        a = os.environ.get("HOROVOD_RANK")  # hvdlint: ignore[env-discipline] -- launcher re-export
+        # hvdlint: ignore[env-discipline] -- second launcher
+        # re-export case with a wrapped reason
+        b = os.environ.get("HOROVOD_SIZE")
+        """})
+    assert findings_of(root, "env-discipline") == []
+    suppressed = findings_of(root, "env-discipline", active_only=False)
+    assert len(suppressed) == 2 and all(f.suppressed for f in suppressed)
+    assert all(f.suppress_reason for f in suppressed)
+
+
+def test_suppression_without_reason_is_itself_a_finding(tmp_path):
+    root = make_tree(tmp_path, {"s.py": """\
+        import os
+        a = os.environ.get("HOROVOD_RANK")  # hvdlint: ignore[env-discipline]
+        """})
+    bad = findings_of(root, "bad-suppression")
+    assert len(bad) == 1 and "reason" in bad[0].message
+    # The target finding is suppressed — but the run still fails via the
+    # bad-suppression finding, so reasons can't be omitted silently.
+    assert findings_of(root, "env-discipline") == []
+
+
+def test_suppression_is_check_scoped(tmp_path):
+    root = make_tree(tmp_path, {"s.py": """\
+        import os
+        a = os.environ.get("HOROVOD_RANK")  # hvdlint: ignore[retry-discipline] -- wrong id
+        """})
+    assert len(findings_of(root, "env-discipline")) == 1
+
+
+# ---------------------------------------------------------------------------
+# CLI: exit codes + JSON schema
+# ---------------------------------------------------------------------------
+
+def test_cli_exit_codes(tmp_path, capsys):
+    bad = make_tree(tmp_path, {"bad.py": """\
+        import os
+        a = os.environ.get("HOROVOD_RANK")
+        """})
+    assert main([bad]) == 1
+    clean = make_tree(tmp_path / "c", {"ok.py": "x = 1\n"})
+    assert main([clean]) == 0
+    assert main(["--check", "no-such-check", clean]) == 2
+    capsys.readouterr()
+
+
+def test_cli_json_schema(tmp_path, capsys):
+    root = make_tree(tmp_path, {"bad.py": """\
+        import os
+        a = os.environ.get("HOROVOD_RANK")
+        b = os.environ.get("HOROVOD_SIZE")  # hvdlint: ignore[env-discipline] -- schema fixture
+        """})
+    assert main(["--json", root]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["version"] == 1 and report["tool"] == "hvdlint"
+    assert {c["id"] for c in report["checks"]} >= {
+        "env-discipline", "compat-discipline", "retry-discipline",
+        "fault-registry", "exception-discipline"}
+    assert report["ok"] is False
+    assert report["counts"]["active"] == 1
+    assert report["counts"]["suppressed"] == 1
+    assert report["counts"]["total"] == 2
+    f = [x for x in report["findings"] if not x["suppressed"]][0]
+    assert set(f) >= {"check", "path", "line", "col", "message",
+                      "suppressed", "suppress_reason"}
+    assert f["path"] == "horovod_tpu/bad.py" and f["line"] == 2
+
+
+def test_parse_error_is_reported_not_fatal(tmp_path):
+    root = make_tree(tmp_path, {"broken.py": "def f(:\n"})
+    hits = findings_of(root, "parse-error")
+    assert len(hits) == 1
+
+
+# ---------------------------------------------------------------------------
+# the tree itself
+# ---------------------------------------------------------------------------
+
+def test_hvdlint_runs_clean_on_head():
+    """THE gate: `python -m tools.hvdlint` exits 0 on this repo, via the
+    same subprocess entry point tools/t1.sh uses."""
+    r = subprocess.run([sys.executable, "-m", "tools.hvdlint"], cwd=REPO,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_every_suppression_on_head_carries_a_reason():
+    fs = run_checks(Project(REPO), ALL_CHECKS)
+    assert [f for f in fs if f.check == "bad-suppression"] == []
+    for f in fs:
+        if f.suppressed:
+            assert f.suppress_reason, f.render()
+
+
+def test_env_registry_extraction_sees_the_real_knobs():
+    entries = {e.env_name: e for e in extract(Project(REPO))}
+    assert "HOROVOD_NATIVE" in entries
+    assert "native_enabled" in entries["HOROVOD_NATIVE"].accessors
+    assert entries["HOROVOD_NATIVE"].default != "—"
+    assert "HOROVOD_FUSION_THRESHOLD" in entries
+    # The cross-file consumer scan finds at least the native loader.
+    assert any("common/native.py" in c
+               for c in entries["HOROVOD_NATIVE"].consumers)
+
+
+def test_env_vars_doc_is_in_sync():
+    """docs/env-vars.md is generated (python -m tools.hvdlint
+    --registry); a drifted committed copy fails here."""
+    committed = os.path.join(REPO, "docs", "env-vars.md")
+    with open(committed, encoding="utf-8") as f:
+        on_disk = f.read()
+    assert on_disk == render_markdown(Project(REPO)), (
+        "docs/env-vars.md is stale: regenerate with "
+        "`python -m tools.hvdlint --registry > docs/env-vars.md`")

@@ -873,17 +873,52 @@ def test_message_codec_robustness(tmp_path):
     """Builds and runs the C++ wire-codec harness (tests/csrc/
     test_message.cc): round-trips, malformed counts rejecting the whole
     frame (round-3 advisor finding — no misaligned parsing past a bad
-    field), truncations, and a deterministic mutation fuzz loop."""
+    field), truncations, a deterministic mutation fuzz loop, and the
+    PR 4 cross_rank hello/endpoint-map frame contract.
+
+    Compiled on demand like common/native.py builds the runtime: skips
+    cleanly when no compiler is present, and runs under ASan+UBSan when
+    the toolchain supports them (a codec fuzz loop without ASan misses
+    the exact out-of-bounds reads it exists to catch)."""
+    import shutil
     import subprocess
 
+    cxx = shutil.which(os.environ.get("CXX", "g++"))
+    if cxx is None:
+        pytest.skip("no C++ compiler on PATH")
     src = os.path.join(TESTS_DIR, "csrc", "test_message.cc")
     msg_cc = os.path.join(REPO, "horovod_tpu", "csrc", "hvd", "message.cc")
     binary = tmp_path / "test_message"
-    subprocess.run(
-        ["g++", "-O1", "-std=c++17", "-Wall", src, msg_cc, "-o",
-         str(binary)],
-        check=True, timeout=120)
+    base = [cxx, "-O1", "-g", "-std=c++17", "-Wall", src, msg_cc, "-o",
+            str(binary)]
+    # Prefer the sanitized build; fall back to plain when the sanitizer
+    # runtimes are not installed (the codec checks still run).
+    # Generous compile timeouts: the ASan+UBSan compile takes minutes on
+    # small oversubscribed boxes when the rest of the suite is running.
+    r = subprocess.run(base + ["-fsanitize=address,undefined"],
+                       capture_output=True, text=True, timeout=600)
+    sanitized = r.returncode == 0
+    if not sanitized:
+        subprocess.run(base, check=True, capture_output=True, timeout=600)
+    env = {**os.environ, "ASAN_OPTIONS": "detect_leaks=0",
+           "UBSAN_OPTIONS": "halt_on_error=1 print_stacktrace=1"}
     r = subprocess.run([str(binary)], capture_output=True, text=True,
-                       timeout=60)
-    assert r.returncode == 0, r.stderr
-    assert "MESSAGE_CODEC_OK" in r.stdout
+                       timeout=240, env=env)
+    report = r.stdout + r.stderr
+    if sanitized and r.returncode != 0 and "FAIL:" not in report and \
+            "ERROR: AddressSanitizer:" not in report and \
+            "runtime error:" not in report:
+        # The ASan runtime itself failed to start (shadow-memory layout,
+        # restricted personality, ...) before the harness ran a single
+        # check: rerun the codec checks uninstrumented rather than fail
+        # a codec that was never exercised.
+        sanitized = False
+        subprocess.run(base, check=True, capture_output=True, timeout=600)
+        r = subprocess.run([str(binary)], capture_output=True, text=True,
+                           timeout=240)
+        report = r.stdout + r.stderr
+    assert r.returncode == 0, report[-4000:]
+    assert "MESSAGE_CODEC_OK" in r.stdout, report[-4000:]
+    if sanitized:
+        assert "ERROR: AddressSanitizer" not in report, report[-4000:]
+        assert "runtime error:" not in report, report[-4000:]

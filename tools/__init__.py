@@ -1,0 +1,2 @@
+# Makes tools/ importable so `python -m tools.hvdlint` works from the
+# repo root (the hvdlint CLI and the t1.sh pre-flight depend on it).

@@ -1,0 +1,13 @@
+"""hvdlint — AST-based project-invariant analyzer (docs/static-analysis.md).
+
+Programmatic use::
+
+    from tools.hvdlint import Project, run_checks, ALL_CHECKS
+    findings = run_checks(Project("/path/to/repo"), ALL_CHECKS)
+
+CLI: ``python -m tools.hvdlint [--json] [--check ID] [root]``.
+"""
+
+from .checks import ALL_CHECKS  # noqa: F401
+from .cli import main  # noqa: F401
+from .core import Finding, Module, Project, report_json, run_checks  # noqa: F401

@@ -1,0 +1,398 @@
+"""The project-invariant checks (docs/static-analysis.md has the catalog).
+
+Each check is a class with ``id``, ``description``, ``run(module) ->
+[Finding]`` and optionally ``finalize(project) -> [Finding]`` for
+cross-file invariants. Register new checks in ``ALL_CHECKS``; everything
+else (discovery, suppressions, JSON, exit codes) is framework.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .core import Finding, Module, Project
+
+CONFIG_PATH = "horovod_tpu/common/config.py"
+COMPAT_PATH = "horovod_tpu/common/compat.py"
+FAULTS_PATH = "horovod_tpu/common/faults.py"
+
+
+# ---------------------------------------------------------------------------
+# 1. env-discipline
+# ---------------------------------------------------------------------------
+
+class EnvDiscipline:
+    """Every ``HOROVOD_*`` env read goes through ``common/config.py``.
+
+    Raw ``os.environ`` / ``os.getenv`` reads scatter default values and
+    truthiness parsing (the "0"/"false"-only bugs PR 5 migrated away
+    from); the accessor layer keeps one default and one bool grammar per
+    knob, and makes the registry extractable (``--registry``)."""
+
+    id = "env-discipline"
+    description = ("HOROVOD_* env reads outside common/config.py "
+                   "(use a config accessor)")
+    # config.py owns the env layer. Extend ONLY for launcher code that
+    # must re-export a raw block verbatim (none today — launchers copy
+    # os.environ wholesale, which reads no specific key).
+    allowed = (CONFIG_PATH,)
+
+    def _key_env_name(self, node: ast.AST) -> Optional[str]:
+        """The HOROVOD_* env name a key expression denotes, if any."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value if node.value.startswith("HOROVOD_") else None
+        # _config.HOROVOD_X style: constants are NAMED for their env var
+        # (config.py convention), so the attribute name is the signal even
+        # when the value string differs (HOROVOD_RENDEZVOUS_ADDR).
+        if isinstance(node, ast.Attribute) and \
+                node.attr.startswith("HOROVOD_"):
+            return node.attr
+        if isinstance(node, ast.Name) and node.id.startswith("HOROVOD_"):
+            return node.id
+        return None
+
+    def run(self, mod: Module) -> List[Finding]:
+        if mod.path in self.allowed:
+            return []
+        out: List[Finding] = []
+
+        def flag(node, key):
+            out.append(Finding(
+                self.id, mod.path, node.lineno, node.col_offset,
+                f"raw read of {key}: route it through a common/config.py "
+                f"accessor (one default + one parse per knob)"))
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                d = mod.dotted(node.func)
+                if d in ("os.getenv", "os.environ.get",
+                         "os.environ.pop", "os.environ.setdefault"):
+                    if node.args:
+                        key = self._key_env_name(node.args[0])
+                        if key:
+                            flag(node, key)
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, ast.Load):
+                if mod.dotted(node.value) == "os.environ":
+                    key = self._key_env_name(node.slice)
+                    if key:
+                        flag(node, key)
+            elif isinstance(node, ast.Compare):
+                # "HOROVOD_X" in os.environ: a presence test is still a
+                # read — presence-as-boolean is exactly the truthiness
+                # drift the accessor layer exists to prevent.
+                operands = [node.left] + node.comparators
+                for i, op in enumerate(node.ops):
+                    if isinstance(op, (ast.In, ast.NotIn)) and \
+                            mod.dotted(operands[i + 1]) == "os.environ":
+                        key = self._key_env_name(operands[i])
+                        if key:
+                            flag(node, key)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# 2. compat-discipline
+# ---------------------------------------------------------------------------
+
+class CompatDiscipline:
+    """jax-0.4.37 compatibility: no raw new-jax API outside compat.py.
+
+    AST-aware successor of tools/lint_compat.sh: ``import jax as j;
+    j.shard_map`` and ``from jax import shard_map as sm`` are the same
+    violation as the literal spelling — the lint resolves import aliases
+    instead of grepping for one surface syntax."""
+
+    id = "compat-discipline"
+    description = ("raw new-jax APIs outside common/compat.py "
+                   "(use the compat shims)")
+    allowed = (COMPAT_PATH,)
+
+    # (exact dotted origin or prefix, shim to use instead)
+    EXACT = {
+        "jax.shard_map": "common.compat.shard_map",
+        "jax.lax.axis_size": "common.compat.axis_size",
+        "jax.distributed.is_initialized":
+            "common.compat.distributed_is_initialized",
+    }
+    PREFIXES = {
+        "jax.experimental.shard_map": "common.compat.shard_map",
+    }
+
+    def _banned(self, dotted: Optional[str]) -> Optional[str]:
+        if not dotted:
+            return None
+        if dotted in self.EXACT:
+            return self.EXACT[dotted]
+        for pref, shim in self.PREFIXES.items():
+            if dotted == pref or dotted.startswith(pref + "."):
+                return shim
+        # pallas CompilerParams: the 0.4.37 spelling is TPUCompilerParams
+        # (shimmed as compat.pallas_tpu_compiler_params).
+        if dotted.startswith("jax.") and \
+                dotted.endswith(".CompilerParams"):
+            return "common.compat.pallas_tpu_compiler_params"
+        return None
+
+    def run(self, mod: Module) -> List[Finding]:
+        if mod.path in self.allowed:
+            return []
+        out: List[Finding] = []
+
+        def flag(node, what, shim):
+            out.append(Finding(
+                self.id, mod.path, node.lineno, node.col_offset,
+                f"raw new-jax API {what} is not on jax 0.4.37; "
+                f"use {shim}"))
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                # Flag banned IMPORTS (any alias): the binding itself is
+                # the violation, wherever it is later called.
+                if isinstance(node, ast.Import):
+                    origins = [al.name for al in node.names]
+                else:
+                    base = node.module or ""
+                    origins = [f"{base}.{al.name}" if base else al.name
+                               for al in node.names]
+                for origin in origins:
+                    shim = self._banned(origin)
+                    if shim:
+                        flag(node, origin, shim)
+            elif isinstance(node, ast.Attribute):
+                d = mod.dotted(node)
+                shim = self._banned(d)
+                if shim:
+                    flag(node, d, shim)
+                elif node.attr == "jax_num_cpu_devices":
+                    flag(node, "jax_num_cpu_devices (config attr)",
+                         "common.compat.ensure_cpu_devices")
+            elif isinstance(node, ast.Constant) and \
+                    node.value == "jax_num_cpu_devices":
+                # config.update("jax_num_cpu_devices", n) raises
+                # AttributeError on 0.4.37 whatever the call shape.
+                flag(node, 'the "jax_num_cpu_devices" config key',
+                     "common.compat.ensure_cpu_devices")
+        return out
+
+
+# ---------------------------------------------------------------------------
+# 3. retry-discipline
+# ---------------------------------------------------------------------------
+
+class RetryDiscipline:
+    """No hand-rolled sleep loops: ``time.sleep`` inside a ``while``/
+    ``for`` outside common/faults.py is a retry/poll loop that bypasses
+    the shared Retrier (backoff, jitter, deadline, RETRY timeline
+    events — docs/fault-injection.md). Call-structure-aware successor of
+    tools/lint_retry.sh's per-file occurrence budgets: a one-shot grace
+    sleep is fine anywhere; a sleep *in a loop* is the defect."""
+
+    id = "retry-discipline"
+    description = ("time.sleep inside a loop outside common/faults.py "
+                   "(use faults.Retrier)")
+    allowed = (FAULTS_PATH,)
+
+    def run(self, mod: Module) -> List[Finding]:
+        if mod.path in self.allowed:
+            return []
+        out: List[Finding] = []
+
+        def is_sleep(call: ast.Call) -> bool:
+            d = mod.dotted(call.func)
+            return d is not None and (d == "time.sleep" or
+                                      d.endswith(".time.sleep"))
+
+        def visit(node: ast.AST, in_loop: bool) -> None:
+            if isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
+                in_loop = True
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Lambda)):
+                # A function defined inside a loop runs on its own
+                # schedule; only loops inside ITS body count.
+                in_loop = False
+            if in_loop and isinstance(node, ast.Call) and is_sleep(node):
+                out.append(Finding(
+                    self.id, mod.path, node.lineno, node.col_offset,
+                    "time.sleep inside a loop: route the retry/poll "
+                    "through common.faults.Retrier (backoff + jitter + "
+                    "deadline + observability)"))
+            for child in ast.iter_child_nodes(node):
+                visit(child, in_loop)
+
+        visit(mod.tree, False)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# 4. fault-registry
+# ---------------------------------------------------------------------------
+
+class FaultRegistry:
+    """``faults.point("name")`` literals must be registered in the
+    CATALOG tuple of common/faults.py (the single source of truth), and
+    every registered seam must be referenced by a test or doc — an
+    unexercised seam is a chaos hook nobody can trust."""
+
+    id = "fault-registry"
+    description = ("fault points must be in faults.CATALOG and every "
+                   "seam needs a test/doc reference")
+
+    def _catalog(self, project: Project) -> Optional[List[str]]:
+        mod = project.module(FAULTS_PATH)
+        if mod is None:
+            return None
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == "CATALOG":
+                        if isinstance(node.value, (ast.Tuple, ast.List)):
+                            return [e.value for e in node.value.elts
+                                    if isinstance(e, ast.Constant) and
+                                    isinstance(e.value, str)]
+        return None
+
+    def _point_calls(self, mod: Module):
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = mod.dotted(node.func)
+            if d is None:
+                continue
+            if d == "point" or d.endswith("faults.point"):
+                yield node
+
+    def run(self, mod: Module) -> List[Finding]:
+        return []  # all work happens in finalize (needs the catalog)
+
+    def finalize(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        catalog = self._catalog(project)
+        if catalog is None:
+            out.append(Finding(
+                self.id, FAULTS_PATH, 1, 0,
+                "no CATALOG tuple of string literals found in "
+                "common/faults.py — the fault-point registry needs its "
+                "single source of truth"))
+            return out
+        for mod in project.modules:
+            if mod.path == FAULTS_PATH:
+                continue
+            for call in self._point_calls(mod):
+                if not call.args:
+                    continue
+                arg = call.args[0]
+                if not (isinstance(arg, ast.Constant) and
+                        isinstance(arg.value, str)):
+                    out.append(Finding(
+                        self.id, mod.path, call.lineno, call.col_offset,
+                        "faults.point name must be a string literal so "
+                        "the seam registry stays statically checkable"))
+                elif arg.value not in catalog:
+                    out.append(Finding(
+                        self.id, mod.path, call.lineno, call.col_offset,
+                        f"fault point {arg.value!r} is not registered in "
+                        f"faults.CATALOG (known: {', '.join(catalog)})"))
+        # Reverse direction: every seam needs a test or doc reference.
+        refs = project.text_files(("tests", "docs"), (".py", ".md"))
+        faults_mod = project.module(FAULTS_PATH)
+        line = 1
+        if faults_mod is not None:
+            for node in faults_mod.tree.body:
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == "CATALOG"
+                        for t in node.targets):
+                    line = node.lineno
+        for seam in catalog:
+            if not any(seam in text for text in refs.values()):
+                out.append(Finding(
+                    self.id, FAULTS_PATH, line, 0,
+                    f"registered fault point {seam!r} has no reference "
+                    f"in tests/ or docs/ — add a chaos test or document "
+                    f"the seam (docs/fault-injection.md)"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# 5. exception-discipline
+# ---------------------------------------------------------------------------
+
+class ExceptionDiscipline:
+    """No bare ``except:`` anywhere; in collective/elastic paths an
+    ``except Exception`` must not swallow ``HorovodInternalError`` — the
+    signal the elastic retry loop exists to see. A handler is compliant
+    when it re-raises (any ``raise`` in its body) or when an earlier
+    handler of the same ``try`` catches HorovodInternalError
+    explicitly."""
+
+    id = "exception-discipline"
+    description = ("bare except / except Exception swallowing "
+                   "HorovodInternalError in collective or elastic paths")
+
+    PATH_PREFIXES = ("horovod_tpu/ops/", "horovod_tpu/elastic/",
+                     "horovod_tpu/run/elastic/")
+    PATH_FILES = ("horovod_tpu/common/host_world.py",
+                  "horovod_tpu/common/host_staging.py",
+                  "horovod_tpu/common/native.py",
+                  "horovod_tpu/common/state.py",
+                  "horovod_tpu/checkpoint.py")
+
+    BROAD = ("Exception", "BaseException")
+    INTERNAL = ("HorovodInternalError", "FaultInjected")
+
+    def _in_paths(self, path: str) -> bool:
+        return path in self.PATH_FILES or \
+            any(path.startswith(p) for p in self.PATH_PREFIXES)
+
+    def _names(self, type_node: Optional[ast.AST]) -> List[str]:
+        if type_node is None:
+            return []
+        elts = type_node.elts if isinstance(type_node, ast.Tuple) \
+            else [type_node]
+        out = []
+        for e in elts:
+            if isinstance(e, ast.Name):
+                out.append(e.id)
+            elif isinstance(e, ast.Attribute):
+                out.append(e.attr)
+        return out
+
+    def run(self, mod: Module) -> List[Finding]:
+        out: List[Finding] = []
+        guard_paths = self._in_paths(mod.path)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            seen_internal = False
+            for handler in node.handlers:
+                names = self._names(handler.type)
+                if handler.type is None:
+                    out.append(Finding(
+                        self.id, mod.path, handler.lineno,
+                        handler.col_offset,
+                        "bare 'except:' swallows SystemExit/"
+                        "KeyboardInterrupt too; name the exceptions"))
+                    continue
+                if any(n in self.INTERNAL for n in names):
+                    seen_internal = True
+                    continue
+                if not guard_paths:
+                    continue
+                if any(n in self.BROAD for n in names):
+                    reraises = any(isinstance(n, ast.Raise)
+                                   for n in ast.walk(handler))
+                    if not (reraises or seen_internal):
+                        out.append(Finding(
+                            self.id, mod.path, handler.lineno,
+                            handler.col_offset,
+                            "except Exception here swallows "
+                            "HorovodInternalError (the elastic retry "
+                            "signal); re-raise it, add an 'except "
+                            "HorovodInternalError: raise' arm first, or "
+                            "suppress with a reason"))
+        return out
+
+
+ALL_CHECKS = (EnvDiscipline(), CompatDiscipline(), RetryDiscipline(),
+              FaultRegistry(), ExceptionDiscipline())

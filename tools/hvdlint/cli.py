@@ -1,0 +1,90 @@
+"""hvdlint CLI: ``python -m tools.hvdlint [options] [root]``.
+
+Exit codes: 0 clean, 1 findings (or malformed suppressions), 2 usage.
+``--json`` prints the machine-readable report (schema in core.py);
+``--registry`` prints the generated docs/env-vars.md content instead of
+linting.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List
+
+from .checks import ALL_CHECKS
+from .core import Project, report_json, run_checks
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv: List[str] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.hvdlint",
+        description="AST-based project-invariant analyzer "
+                    "(docs/static-analysis.md)")
+    ap.add_argument("root", nargs="?", default=None,
+                    help="tree to scan (default: this repo)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable JSON report on stdout")
+    ap.add_argument("--check", action="append", default=None,
+                    metavar="ID", help="run only this check id "
+                    "(repeatable)")
+    ap.add_argument("--list-checks", action="store_true",
+                    help="list check ids and exit")
+    ap.add_argument("--registry", action="store_true",
+                    help="print the generated env-var registry "
+                    "(docs/env-vars.md content) and exit")
+    args = ap.parse_args(argv)
+
+    checks = list(ALL_CHECKS)
+    if args.list_checks:
+        for c in checks:
+            print(f"{c.id}: {c.description}")
+        return 0
+    if args.check:
+        known = {c.id for c in checks}
+        bad = [cid for cid in args.check if cid not in known]
+        if bad:
+            print(f"hvdlint: unknown check id(s): {', '.join(bad)} "
+                  f"(known: {', '.join(sorted(known))})", file=sys.stderr)
+            return 2
+        checks = [c for c in checks if c.id in set(args.check)]
+
+    root = args.root or _repo_root()
+    if not os.path.isdir(os.path.join(root, Project.PACKAGE_DIR)):
+        print(f"hvdlint: no {Project.PACKAGE_DIR}/ package under {root}",
+              file=sys.stderr)
+        return 2
+    project = Project(root)
+
+    if args.registry:
+        from .registry import render_markdown
+        sys.stdout.write(render_markdown(project))
+        return 0
+
+    findings = run_checks(project, checks)
+    active = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+    if args.json:
+        print(report_json(findings, checks))
+    else:
+        for f in active:
+            print(f.render())
+        if active:
+            print(f"hvdlint: {len(active)} finding(s) "
+                  f"({len(suppressed)} suppressed) across "
+                  f"{len(project.modules)} files")
+        else:
+            print(f"hvdlint: OK ({len(project.modules)} files, "
+                  f"{len(checks)} checks, {len(suppressed)} "
+                  f"suppression(s) honored)")
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

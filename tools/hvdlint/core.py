@@ -1,0 +1,264 @@
+"""hvdlint framework: findings, suppressions, module model, project walk.
+
+The pluggable AST analyzer behind ``python -m tools.hvdlint``
+(docs/static-analysis.md). Checks are small classes over a parsed
+``Module``; the framework owns everything generic — file discovery,
+import-alias resolution, the inline-suppression contract, and the JSON
+report — so adding a project invariant is ~30 lines in checks.py.
+
+Suppression syntax (one per line, reason REQUIRED)::
+
+    risky_call()  # hvdlint: ignore[check-id] -- why this is fine
+    # hvdlint: ignore[check-id,other-id] -- applies to the NEXT line
+
+A suppression without a ``-- reason`` is itself reported (check id
+``bad-suppression``): the whole point of forcing a reason is that "why
+is this exempt" survives the author leaving.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+SUPPRESS_RE = re.compile(
+    r"#\s*hvdlint:\s*ignore\[([A-Za-z0-9_,\- ]+)\]\s*(?:--\s*(\S.*))?")
+
+
+@dataclasses.dataclass
+class Finding:
+    check: str
+    path: str  # repo-root-relative, posix separators
+    line: int  # 1-based
+    col: int   # 0-based
+    message: str
+    suppressed: bool = False
+    suppress_reason: str = ""
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.check}] " \
+               f"{self.message}"
+
+
+class Module:
+    """One parsed Python file plus the lookups checks need."""
+
+    def __init__(self, root: str, path: str):
+        self.root = root
+        self.path = path  # relative, posix
+        with open(os.path.join(root, path), encoding="utf-8") as f:
+            self.source = f.read()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=path)
+        self._aliases: Optional[Dict[str, str]] = None
+        self._parents: Optional[Dict[ast.AST, ast.AST]] = None
+
+    # -- import alias resolution --------------------------------------------
+
+    @property
+    def aliases(self) -> Dict[str, str]:
+        """Local name -> dotted origin. ``import jax as j`` => j: jax;
+        ``from jax import lax as l`` => l: jax.lax; ``from time import
+        sleep`` => sleep: time.sleep. Conservative: the last binding of a
+        name wins, conditional imports are treated as bound."""
+        if self._aliases is None:
+            a: Dict[str, str] = {}
+            for node in ast.walk(self.tree):
+                if isinstance(node, ast.Import):
+                    for al in node.names:
+                        if al.asname:
+                            a[al.asname] = al.name
+                        else:
+                            # `import jax.lax` binds the TOP name `jax`.
+                            top = al.name.split(".")[0]
+                            a[top] = top
+                elif isinstance(node, ast.ImportFrom):
+                    if node.level or not node.module:
+                        # Relative imports resolve within this package —
+                        # record them with a leading "." marker so checks
+                        # can still match e.g. ".faults.point".
+                        mod = "." * (node.level or 0) + (node.module or "")
+                    else:
+                        mod = node.module
+                    for al in node.names:
+                        if al.name == "*":
+                            continue
+                        a[al.asname or al.name] = f"{mod}.{al.name}"
+            self._aliases = a
+        return self._aliases
+
+    def dotted(self, node: ast.AST) -> Optional[str]:
+        """Resolve an Attribute/Name chain to its dotted origin using the
+        module's import aliases; None when the root is not an imported
+        name (a local variable, a call result, ...)."""
+        parts: List[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        origin = self.aliases.get(cur.id)
+        if origin is None:
+            return None
+        parts.append(origin)
+        return ".".join(reversed(parts))
+
+    # -- structure ----------------------------------------------------------
+
+    @property
+    def parents(self) -> Dict[ast.AST, ast.AST]:
+        if self._parents is None:
+            p: Dict[ast.AST, ast.AST] = {}
+            for parent in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(parent):
+                    p[child] = parent
+            self._parents = p
+        return self._parents
+
+    # -- suppressions -------------------------------------------------------
+
+    def _suppress_lines(self, line: int):
+        """Candidate 1-based lines whose directive guards ``line``: the
+        line itself (trailing comment), then the contiguous block of
+        comment-only lines directly above it (a wrapped reason pushes the
+        directive more than one line up)."""
+        if 1 <= line <= len(self.lines):
+            yield line
+        ln = line - 1
+        while 1 <= ln <= len(self.lines) and \
+                self.lines[ln - 1].strip().startswith("#"):
+            yield ln
+            ln -= 1
+
+    def suppression_for(self, line: int, check: str
+                        ) -> Tuple[bool, str, Optional[Finding]]:
+        """(suppressed, reason, defect): whether ``check`` is suppressed at
+        1-based ``line`` — by a trailing comment on that line or a
+        directive anywhere in the comment block directly above. ``defect``
+        is a bad-suppression Finding when the matching directive is
+        missing its reason."""
+        for ln in self._suppress_lines(line):
+            m = SUPPRESS_RE.search(self.lines[ln - 1])
+            if not m:
+                continue
+            ids = [s.strip() for s in m.group(1).split(",") if s.strip()]
+            if check not in ids:
+                continue
+            reason = (m.group(2) or "").strip()
+            if not reason:
+                return True, "", Finding(
+                    "bad-suppression", self.path, ln, 0,
+                    f"hvdlint suppression of [{check}] has no "
+                    f"'-- reason'; every exemption must say why")
+            return True, reason, None
+        return False, "", None
+
+
+class Project:
+    """The scanned tree: parsed package modules + raw access to tests/docs
+    (for cross-file invariants like fault-point coverage)."""
+
+    PACKAGE_DIR = "horovod_tpu"
+
+    def __init__(self, root: str, paths: Optional[List[str]] = None):
+        self.root = os.path.abspath(root)
+        self.modules: List[Module] = []
+        self.parse_failures: List[Finding] = []
+        for rel in (paths if paths is not None
+                    else self._discover(self.root)):
+            try:
+                self.modules.append(Module(self.root, rel))
+            except SyntaxError as e:
+                self.parse_failures.append(Finding(
+                    "parse-error", rel, e.lineno or 0, e.offset or 0,
+                    f"cannot parse: {e.msg}"))
+
+    @classmethod
+    def _discover(cls, root: str) -> List[str]:
+        out: List[str] = []
+        pkg = os.path.join(root, cls.PACKAGE_DIR)
+        for dirpath, dirnames, filenames in os.walk(pkg):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                    out.append(rel.replace(os.sep, "/"))
+        return sorted(out)
+
+    def module(self, path: str) -> Optional[Module]:
+        for m in self.modules:
+            if m.path == path:
+                return m
+        return None
+
+    def text_files(self, reldirs: Tuple[str, ...],
+                   suffixes: Tuple[str, ...]) -> Dict[str, str]:
+        """{relpath: text} for reference-coverage scans (tests/, docs/)."""
+        out: Dict[str, str] = {}
+        for reldir in reldirs:
+            base = os.path.join(self.root, reldir)
+            if not os.path.isdir(base):
+                continue
+            for dirpath, dirnames, filenames in os.walk(base):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                for fn in sorted(filenames):
+                    if fn.endswith(suffixes):
+                        p = os.path.join(dirpath, fn)
+                        rel = os.path.relpath(p, self.root)
+                        try:
+                            with open(p, encoding="utf-8") as f:
+                                out[rel.replace(os.sep, "/")] = f.read()
+                        except OSError:
+                            pass
+        return out
+
+
+def run_checks(project: Project, checks) -> List[Finding]:
+    """Run checks over the project, apply suppressions, return every
+    finding (suppressed ones included, flagged) sorted by location."""
+    findings: List[Finding] = list(project.parse_failures)
+    for check in checks:
+        raw: List[Finding] = []
+        for mod in project.modules:
+            raw.extend(check.run(mod))
+        finalize = getattr(check, "finalize", None)
+        if finalize is not None:
+            raw.extend(finalize(project))
+        for f in raw:
+            mod = project.module(f.path)
+            if mod is not None:
+                suppressed, reason, defect = mod.suppression_for(
+                    f.line, f.check)
+                if suppressed:
+                    f.suppressed = True
+                    f.suppress_reason = reason
+                if defect is not None:
+                    findings.append(defect)
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.check))
+    return findings
+
+
+def report_json(findings: List[Finding], checks) -> str:
+    active = [f for f in findings if not f.suppressed]
+    return json.dumps({
+        "version": 1,
+        "tool": "hvdlint",
+        "checks": [{"id": c.id, "description": c.description}
+                   for c in checks],
+        "findings": [f.as_dict() for f in findings],
+        "counts": {
+            "total": len(findings),
+            "active": len(active),
+            "suppressed": len(findings) - len(active),
+        },
+        "ok": not active,
+    }, indent=2, sort_keys=True)

@@ -1,56 +1,19 @@
 #!/usr/bin/env bash
-# jax-0.4.37 compatibility lint: fail on raw new-jax API spellings in
-# horovod_tpu/ outside common/compat.py. The installed jax predates the
-# modern API; every such call must route through the compat shims
-# (horovod_tpu/common/compat.py), or the tree imports cleanly in review
-# and then dies on the tier-1 image. Run from anywhere; wired into
-# tools/t1.sh and tests/test_compat_lint.py so regressions fail fast.
+# DEPRECATED (kept as a thin wrapper for one release): the regex lint
+# was replaced by the AST-aware hvdlint compat-discipline check
+# (tools/hvdlint/, docs/static-analysis.md), which also catches aliased
+# spellings the grep never saw (`import jax as j; j.shard_map`,
+# `from jax import shard_map as sm`). This wrapper delegates verbatim —
+# call the analyzer directly:
 #
-# Exit code: 0 clean, 1 violations (printed as grep matches).
+#   python -m tools.hvdlint --check compat-discipline
+#
+# Exit code: 0 clean, 1 violations, 2 usage (hvdlint's contract).
 
-cd "$(dirname "$0")/.." || exit 1
-
-fail=0
-
-check() {
-  local pattern="$1" msg="$2"
-  # compat.py is the one place allowed to spell the raw API.
-  local hits
-  hits=$(grep -rnE "$pattern" horovod_tpu --include='*.py' \
-         | grep -v 'horovod_tpu/common/compat\.py')
-  if [ -n "$hits" ]; then
-    echo "lint_compat: $msg"
-    echo "$hits"
-    echo
-    fail=1
-  fi
-}
-
-# jax.shard_map / from jax import shard_map: pre-0.5 jax has neither —
-# use compat.shard_map (which also maps check_vma -> check_rep).
-check 'jax\.shard_map\(|from jax import shard_map|from jax\.experimental\.shard_map import' \
-      'raw shard_map spelling (use common.compat.shard_map)'
-
-# lax.axis_size: added after 0.4.37 — use compat.axis_size.
-check '(^|[^_.a-zA-Z])lax\.axis_size\(' \
-      'raw lax.axis_size (use common.compat.axis_size)'
-
-# jax.distributed.is_initialized: not on 0.4.37 — use
-# compat.distributed_is_initialized.
-check 'jax\.distributed\.is_initialized' \
-      'raw jax.distributed.is_initialized (use common.compat.distributed_is_initialized)'
-
-# jax_num_cpu_devices config key: raises AttributeError on 0.4.37 —
-# use compat.ensure_cpu_devices (XLA_FLAGS fallback).
-check 'jax_num_cpu_devices' \
-      'raw jax_num_cpu_devices config (use common.compat.ensure_cpu_devices)'
-
-# pltpu.CompilerParams: the old spelling is TPUCompilerParams — use
-# compat.pallas_tpu_compiler_params.
-check 'pltpu\.CompilerParams|pallas.*[^U]CompilerParams\(' \
-      'raw pallas CompilerParams (use common.compat.pallas_tpu_compiler_params)'
-
-if [ "$fail" -eq 0 ]; then
-  echo "lint_compat: OK (no raw new-jax APIs outside common/compat.py)"
-fi
-exit "$fail"
+# Stay in the caller's directory (a relative root argument must resolve
+# against it); import hvdlint from this repo via PYTHONPATH instead.
+repo="$(cd "$(dirname "$0")/.." && pwd)" || exit 1
+echo "lint_compat.sh: DEPRECATED — use" \
+     "'python -m tools.hvdlint --check compat-discipline'" >&2
+PYTHONPATH="$repo${PYTHONPATH:+:$PYTHONPATH}" \
+    exec python -m tools.hvdlint --check compat-discipline "$@"

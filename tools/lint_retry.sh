@@ -1,70 +1,20 @@
 #!/usr/bin/env bash
-# Retry-discipline lint: no bare `time.sleep(`-based retry/poll loops in
-# horovod_tpu/ outside common/faults.py (the shared Retrier owns backoff,
-# jitter, deadlines, and retry observability — docs/fault-injection.md).
-# A hand-rolled sleep loop has none of those and silently regresses the
-# chaos-test determinism story.
+# DEPRECATED (kept as a thin wrapper for one release): the per-file
+# sleep-occurrence budgets were replaced by the call-structure-aware
+# hvdlint retry-discipline check (tools/hvdlint/,
+# docs/static-analysis.md): a `time.sleep` *inside a loop* outside
+# common/faults.py is the defect; one-shot grace sleeps are fine
+# anywhere, so the allowlist budgets are gone. This wrapper delegates
+# verbatim — call the analyzer directly:
 #
-# Allowlisted sites (with their current per-file occurrence budget) are
-# the non-retry sleeps that are fine as-is:
-#   - safe_shell_exec.py: SIGTERM->SIGKILL grace poll on a process group
-#   - spark/exec.py: task-status poll cadence against Spark's own API
-# Adding a sleep to any other file — or another one to these — fails.
+#   python -m tools.hvdlint --check retry-discipline
 #
-# Exit code: 0 clean, 1 violations (printed as grep matches).
+# Exit code: 0 clean, 1 violations, 2 usage (hvdlint's contract).
 
-cd "$(dirname "$0")/.." || exit 1
-
-fail=0
-
-# file:max_occurrences
-ALLOW="
-horovod_tpu/common/faults.py:-1
-horovod_tpu/run/common/util/safe_shell_exec.py:1
-horovod_tpu/spark/exec.py:2
-"
-
-hits=$(grep -rn 'time\.sleep(' horovod_tpu --include='*.py')
-
-while IFS= read -r line; do
-  [ -z "$line" ] && continue
-  file=${line%%:*}
-  budget=""
-  for entry in $ALLOW; do
-    if [ "${entry%%:*}" = "$file" ]; then
-      budget=${entry##*:}
-      break
-    fi
-  done
-  if [ -z "$budget" ]; then
-    echo "lint_retry: bare time.sleep( outside common/faults.py:"
-    echo "$line"
-    echo "  -> route it through common.faults.Retrier (see" \
-         "docs/fault-injection.md), or allowlist it in tools/lint_retry.sh"
-    fail=1
-  fi
-done <<EOF
-$hits
-EOF
-
-# Per-file budgets: an allowlisted file must not grow new sleeps.
-for entry in $ALLOW; do
-  file=${entry%%:*}
-  budget=${entry##*:}
-  [ "$budget" = "-1" ] && continue
-  # No `|| echo 0`: grep -c already prints 0 (while exiting 1) on zero
-  # matches, and the fallback would yield "0\n0" — not an integer.
-  count=$(grep -c 'time\.sleep(' "$file" 2>/dev/null)
-  [ -z "$count" ] && count=0
-  if [ "$count" -gt "$budget" ]; then
-    echo "lint_retry: $file has $count time.sleep( calls" \
-         "(allowlisted budget: $budget) — new retry loops must use" \
-         "common.faults.Retrier"
-    fail=1
-  fi
-done
-
-if [ "$fail" -eq 0 ]; then
-  echo "lint_retry: OK (no bare retry sleeps outside common/faults.py)"
-fi
-exit "$fail"
+# Stay in the caller's directory (a relative root argument must resolve
+# against it); import hvdlint from this repo via PYTHONPATH instead.
+repo="$(cd "$(dirname "$0")/.." && pwd)" || exit 1
+echo "lint_retry.sh: DEPRECATED — use" \
+     "'python -m tools.hvdlint --check retry-discipline'" >&2
+PYTHONPATH="$repo${PYTHONPATH:+:$PYTHONPATH}" \
+    exec python -m tools.hvdlint --check retry-discipline "$@"

@@ -15,11 +15,11 @@
 cd "$(dirname "$0")/.." || exit 1
 T1LOG="${T1LOG:-$(mktemp /tmp/_t1.XXXXXX.log)}"
 
-# Fast pre-flights: the jax-0.4.37 compatibility lint and the retry
-# discipline lint (both also covered by tests/test_compat_lint.py inside
-# the pytest run below, but failing here costs seconds instead of a
-# suite timeout when the tree is badly broken).
-bash tools/lint_compat.sh || exit 1
-bash tools/lint_retry.sh || exit 1
+# Fast pre-flight: the hvdlint project-invariant analyzer (env/compat/
+# retry/fault-registry/exception discipline — docs/static-analysis.md;
+# also covered by tests/test_hvdlint.py + tests/test_compat_lint.py
+# inside the pytest run below, but failing here costs seconds instead
+# of a suite timeout when the tree is badly broken).
+python -m tools.hvdlint || exit 1
 
 set -o pipefail; rm -f "$T1LOG"; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee "$T1LOG"; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$T1LOG" | tr -cd . | wc -c); exit $rc

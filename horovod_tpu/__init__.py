@@ -176,6 +176,20 @@ def stall_report() -> str:
     return report
 
 
+def liveness_report() -> str:
+    """Drain and return the native liveness plane's accumulated events
+    (docs/liveness.md): ``SUSPECT``/``EVICT``/``DRAIN``/``RECOVER``
+    lines from the controller's heartbeat state machine, one per
+    transition. Empty when the plane is disabled
+    (``HOROVOD_HEARTBEAT_MS=0``, the default), when nothing happened,
+    when ``hvd.init()`` hasn't run, or when the native core is absent
+    (pure-XLA direct mode). Like ``stall_report()``, reading consumes."""
+    core = _native_core()
+    if core is None:
+        return ""
+    return core.liveness_report()
+
+
 def _native_core():
     """The process's live NativeCore: the XLA engine's when one runs,
     else the host (process-rank) world's. None in pure-direct mode."""

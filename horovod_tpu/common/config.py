@@ -55,6 +55,13 @@ HOROVOD_HOST_VIA_XLA = "HOROVOD_HOST_VIA_XLA"
 HOROVOD_HOST_VIA_XLA_THRESHOLD = "HOROVOD_HOST_VIA_XLA_THRESHOLD"
 DEFAULT_HOST_VIA_XLA_THRESHOLD = 1 << 20  # 1 MiB fused response
 HOROVOD_ELASTIC_REJOIN_GRACE = "HOROVOD_ELASTIC_REJOIN_GRACE"
+# Liveness plane: heartbeats, failure detection, graceful drain
+# (common/liveness.py, csrc/hvd/controller.cc; docs/liveness.md)
+HOROVOD_HEARTBEAT_MS = "HOROVOD_HEARTBEAT_MS"
+HOROVOD_LIVENESS_TIMEOUT_MS = "HOROVOD_LIVENESS_TIMEOUT_MS"
+HOROVOD_DRAIN_GRACE_MS = "HOROVOD_DRAIN_GRACE_MS"
+DEFAULT_LIVENESS_TIMEOUT_MS = 10000
+DEFAULT_DRAIN_GRACE_MS = 5000
 # Fault injection + retry/backoff + blacklist (common/faults.py;
 # docs/fault-injection.md)
 HOROVOD_FAULT_SPEC = "HOROVOD_FAULT_SPEC"
@@ -457,6 +464,32 @@ def retry_policy_from_env(scope: str = "", pinned=(),
             except ValueError:
                 continue
     return RetryPolicy(**kw)
+
+
+def heartbeat_ms() -> int:
+    """Liveness heartbeat interval in ms; 0 (the default) disables the
+    entire liveness plane — no heartbeat threads, no timed gathers, no
+    driver-side eviction: behavior is byte-identical to pre-liveness
+    builds (regression-tested). Must agree across ranks, like every
+    dispatch knob (docs/liveness.md)."""
+    return max(0, _get_int(HOROVOD_HEARTBEAT_MS, 0))
+
+
+def liveness_timeout_ms() -> int:
+    """Silence (no frame, no heartbeat) after which a rank is EVICTED;
+    SUSPECT fires at half of it. Only meaningful with heartbeats armed.
+    Must exceed the longest blocking host-plane collective or a busy
+    rank gets falsely evicted (docs/liveness.md has the sizing rule)."""
+    return max(1, _get_int(HOROVOD_LIVENESS_TIMEOUT_MS,
+                           DEFAULT_LIVENESS_TIMEOUT_MS))
+
+
+def drain_grace_ms() -> int:
+    """How long a preempted worker gets to finish its drain protocol
+    (commit + DRAIN farewell) before it force-exits; the drain-armed
+    watchdog makes "graceful" bounded so a wedged drain can't outlive
+    its host's preemption deadline (docs/liveness.md)."""
+    return max(1, _get_int(HOROVOD_DRAIN_GRACE_MS, DEFAULT_DRAIN_GRACE_MS))
 
 
 def blacklist_strikes() -> int:

@@ -33,6 +33,23 @@ class HostsUpdatedInterrupt(HorovodTpuError):
         self.skip_sync = skip_sync
 
 
+class PreemptionInterrupt(HostsUpdatedInterrupt):
+    """This worker's host received a preemption notice (TPU-VM
+    maintenance/SIGTERM) and must leave, gracefully.
+
+    Raised at the next ``state.commit()`` after the preemption signal
+    (``elastic.state.register_preemption_signal``). Unlike its parent —
+    "the world changed, re-init and keep training" — the elastic retry
+    loop answers this with the drain protocol (docs/liveness.md): commit
+    elastic state, send the DRAIN farewell frame, and exit cleanly so
+    the driver charges the departing host zero blacklist strikes.
+    """
+
+    def __init__(self):
+        # The doomed rank never syncs again; skip_sync documents that.
+        super().__init__(skip_sync=True)
+
+
 class NotInitializedError(HorovodTpuError):
     """An API requiring ``hvd.init()`` was called before initialization."""
 

@@ -47,6 +47,14 @@ CATALOG = (
     "xla.exec",              # eager engine executing an XLA-plane response
     "elastic.worker.start",  # driver-side worker launch (slot.rank)
     "checkpoint.write",      # CheckpointManager.save
+    "control.heartbeat",     # worker heartbeat KV put (docs/liveness.md);
+                             # kind=drop_conn drops a beat, kind=delay_ms
+                             # lands it late — the chaos inputs for the
+                             # miss/SUSPECT/EVICT escalation tests
+    "elastic.drain",         # preemption drain protocol, between the
+                             # DRAIN begin announcement and the state
+                             # commit — kill here = preemption deadline
+                             # beating the drain (charged as a crash)
 )
 
 # Injectable for tests (fake clock / no real sleeps in tier-1).

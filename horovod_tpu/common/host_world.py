@@ -399,7 +399,9 @@ class HostWorld:
             stall_warning_sec=cfg.stall_warning_seconds,
             stall_shutdown_sec=cfg.stall_shutdown_seconds,
             stall_check_enabled=not cfg.stall_check_disable,
-            exec_callback=reject_xla)
+            exec_callback=reject_xla,
+            heartbeat_ms=_config.heartbeat_ms(),
+            liveness_timeout_ms=_config.liveness_timeout_ms())
 
     def _init_own_core(self):
         core = _native.NativeCore()
@@ -415,6 +417,19 @@ class HostWorld:
                 f"{self.size}): coordinator unreachable or worker-connect "
                 f"timeout")
         return core
+
+    def drain(self):
+        """Graceful-drain farewell (docs/liveness.md): mark this rank's
+        departure as a clean DRAIN on the native controller, then shut
+        the world down. The coordinator's liveness stream records DRAIN
+        for this rank — the launcher charges zero blacklist strikes —
+        while survivors recover through the normal elastic retry path.
+        A no-op beyond shutdown when the native plane is absent."""
+        with self._lock:
+            if self.initialized and self._core is not None and \
+                    self._owns_core:
+                self._core.drain()
+        self.shutdown()
 
     def shutdown(self):
         with self._lock:

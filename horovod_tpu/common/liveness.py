@@ -1,0 +1,209 @@
+"""Liveness plane: heartbeat tracking and the miss → SUSPECT → EVICT
+state machine (docs/liveness.md).
+
+Horovod's runtime historically *noticed* a dead peer only when a
+collective broke or the stall inspector complained after the fact
+(reference ``stall_inspector.cc``); production fleets need active
+failure detection and clean preemption departures. This module is the
+Python half of that plane — the elastic driver tracks worker heartbeats
+(pushed into the rendezvous KV by ``run/elastic/worker.py``) through a
+``LivenessTracker`` here, while the native controller runs the same
+state machine over control-socket heartbeat frames in C++
+(``csrc/hvd/controller.cc``).
+
+Everything is deterministic under an injectable clock: the chaos
+acceptance ("survivors begin re-rendezvous within 2x
+``HOROVOD_LIVENESS_TIMEOUT_MS``") is asserted with a fake clock in
+tier-1, no real sleeping.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Hashable, List, Optional, Tuple
+
+from . import config as _config
+
+# Member states. DRAINING members are exempt from eviction for the drain
+# grace (they announced a clean departure and get to finish it); DRAINED
+# and EVICTED are terminal.
+ALIVE = "ALIVE"
+SUSPECT = "SUSPECT"
+EVICTED = "EVICTED"
+DRAINING = "DRAINING"
+DRAINED = "DRAINED"
+
+# Event kinds produced by LivenessTracker.check().
+MISS = "MISS"        # silence past 2x the heartbeat interval (informational)
+SUSPECT_EVENT = "SUSPECT"   # silence past half the liveness timeout
+EVICT = "EVICT"      # silence past the full liveness timeout
+RECOVER = "RECOVER"  # a SUSPECT member beat again before eviction
+
+
+class LivenessEvent:
+    """One escalation step for one member."""
+
+    __slots__ = ("kind", "member", "silence_ms")
+
+    def __init__(self, kind: str, member: Hashable, silence_ms: float):
+        self.kind = kind
+        self.member = member
+        self.silence_ms = silence_ms
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return (f"LivenessEvent({self.kind}, {self.member}, "
+                f"{self.silence_ms:.0f}ms)")
+
+
+class LivenessTracker:
+    """Per-member last-seen tracking with miss → SUSPECT → EVICT
+    escalation.
+
+    Thresholds (all from one ``liveness_timeout_ms``):
+
+    - ``MISS``     at ``2 * heartbeat_ms`` of silence (one beat lost plus
+      slack — scheduling jitter alone must not page anyone);
+    - ``SUSPECT``  at ``liveness_timeout_ms / 2``;
+    - ``EVICT``    at ``liveness_timeout_ms``.
+
+    ``clock`` returns seconds (``time.monotonic`` signature) and is
+    injectable so every transition is testable deterministically. The
+    tracker never sleeps and never spawns threads — callers poll
+    ``check()`` at their own cadence (the driver piggybacks on its 1 s
+    discovery loop; detection latency is bounded by timeout + one poll
+    tick, comfortably inside the 2x-timeout acceptance window).
+    """
+
+    def __init__(self, heartbeat_ms: Optional[int] = None,
+                 timeout_ms: Optional[int] = None,
+                 drain_grace_ms: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.heartbeat_ms = (heartbeat_ms if heartbeat_ms is not None
+                             else _config.heartbeat_ms())
+        self.timeout_ms = (timeout_ms if timeout_ms is not None
+                           else _config.liveness_timeout_ms())
+        self.drain_grace_ms = (drain_grace_ms if drain_grace_ms is not None
+                               else _config.drain_grace_ms())
+        self._clock = clock
+        self._last_seen: Dict[Hashable, float] = {}
+        self._state: Dict[Hashable, str] = {}
+        self._missed: Dict[Hashable, bool] = {}
+        self._drain_deadline: Dict[Hashable, float] = {}
+
+    # -- membership ----------------------------------------------------------
+
+    def watch(self, member: Hashable) -> None:
+        """Start tracking ``member`` (idempotent); the watch itself counts
+        as a beat — a rank must get a full quiet window from admission
+        before any escalation."""
+        if member not in self._state:
+            self._state[member] = ALIVE
+            self._last_seen[member] = self._clock()
+            self._missed[member] = False
+
+    def forget(self, member: Hashable) -> None:
+        self._state.pop(member, None)
+        self._last_seen.pop(member, None)
+        self._missed.pop(member, None)
+        self._drain_deadline.pop(member, None)
+
+    def members(self) -> List[Hashable]:
+        return list(self._state)
+
+    def state(self, member: Hashable) -> Optional[str]:
+        return self._state.get(member)
+
+    # -- signals -------------------------------------------------------------
+
+    def beat(self, member: Hashable) -> Optional[LivenessEvent]:
+        """Record a heartbeat. Returns a RECOVER event when it rescues a
+        SUSPECT member; terminal states (EVICTED/DRAINED) stay terminal —
+        a zombie's late beat must not resurrect its slot."""
+        self.watch(member)
+        st = self._state[member]
+        if st in (EVICTED, DRAINED):
+            return None
+        now = self._clock()
+        self._last_seen[member] = now
+        self._missed[member] = False
+        if st == SUSPECT:
+            self._state[member] = ALIVE
+            return LivenessEvent(RECOVER, member, 0.0)
+        return None
+
+    def mark_draining(self, member: Hashable) -> None:
+        """The member announced a graceful drain: exempt from eviction
+        while it finishes — but only for the drain grace (plus slack
+        for the announcement's own latency). A drain whose host died
+        outright mid-protocol (power loss: no commit marker, no exit)
+        must still be bounded, or the 'graceful' path would reintroduce
+        the unbounded hang this plane exists to kill."""
+        self.watch(member)
+        if self._state[member] not in (EVICTED, DRAINED, DRAINING):
+            self._state[member] = DRAINING
+            self._drain_deadline[member] = self._clock() + \
+                2.0 * self.drain_grace_ms / 1000.0
+
+    def mark_drained(self, member: Hashable) -> None:
+        self.watch(member)
+        self._state[member] = DRAINED
+
+    def suspect(self, member: Hashable,
+                silence_ms: float = 0.0) -> Optional[LivenessEvent]:
+        """Externally-sourced suspicion (the stall inspector's escalation
+        path): mark ``member`` SUSPECT through the same machine a
+        heartbeat miss uses."""
+        self.watch(member)
+        if self._state[member] != ALIVE:
+            return None
+        self._state[member] = SUSPECT
+        return LivenessEvent(SUSPECT_EVENT, member, silence_ms)
+
+    # -- escalation ----------------------------------------------------------
+
+    def check(self) -> List[LivenessEvent]:
+        """One escalation pass; returns the transitions it caused, in
+        deterministic member order. Call at any cadence."""
+        now = self._clock()
+        events: List[LivenessEvent] = []
+        for member in sorted(self._state, key=repr):
+            st = self._state[member]
+            if st == DRAINING:
+                deadline = self._drain_deadline.get(member, now)
+                if now >= deadline:
+                    # The drain outlived 2x its grace: the host died
+                    # mid-protocol. Evict — the exit-time commit-marker
+                    # check still wins if a commit actually landed.
+                    self._state[member] = EVICTED
+                    events.append(LivenessEvent(
+                        EVICT, member,
+                        (now - self._last_seen[member]) * 1000.0))
+                continue
+            if st in (EVICTED, DRAINED):
+                continue
+            silence_ms = (now - self._last_seen[member]) * 1000.0
+            if silence_ms >= self.timeout_ms:
+                self._state[member] = EVICTED
+                events.append(LivenessEvent(EVICT, member, silence_ms))
+                continue
+            if st == ALIVE and silence_ms >= self.timeout_ms / 2.0:
+                self._state[member] = SUSPECT
+                events.append(
+                    LivenessEvent(SUSPECT_EVENT, member, silence_ms))
+                continue
+            if (st == ALIVE and not self._missed[member]
+                    and self.heartbeat_ms > 0
+                    and silence_ms >= 2.0 * self.heartbeat_ms):
+                self._missed[member] = True
+                events.append(LivenessEvent(MISS, member, silence_ms))
+        return events
+
+
+def enabled() -> bool:
+    """Whether the liveness plane is armed in this process
+    (``HOROVOD_HEARTBEAT_MS`` > 0; default off — byte-identical to the
+    pre-liveness runtime when unset)."""
+    return _config.heartbeat_ms() > 0
+
+
+LivenessMember = Tuple[str, int]  # (hostname, local_rank) — a slot identity

@@ -124,7 +124,8 @@ def _bind_prototypes(lib):
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
         ctypes.c_char_p, ctypes.c_double, ctypes.c_longlong, ctypes.c_int,
-        ctypes.c_double, ctypes.c_double, ctypes.c_int,
+        ctypes.c_double, ctypes.c_double, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int,
     ]
     lib.hvd_shutdown.restype = None
     lib.hvd_enqueue.restype = ctypes.c_longlong
@@ -191,6 +192,11 @@ def _bind_prototypes(lib):
     lib.hvd_stall_report.restype = ctypes.c_int
     lib.hvd_stall_report.argtypes = [ctypes.POINTER(ctypes.c_char),
                                      ctypes.c_int]
+    lib.hvd_drain.restype = None
+    lib.hvd_drain.argtypes = []
+    lib.hvd_liveness_report.restype = ctypes.c_int
+    lib.hvd_liveness_report.argtypes = [ctypes.POINTER(ctypes.c_char),
+                                        ctypes.c_int]
     lib.hvd_set_record_negotiation.restype = None
     lib.hvd_set_record_negotiation.argtypes = [ctypes.c_int]
     lib.hvd_drain_negotiation.restype = ctypes.c_int
@@ -309,10 +315,13 @@ class NativeCore:
              coordinator_port: int, my_host: str, cycle_time_ms: float,
              fusion_threshold: int, cache_capacity: int,
              stall_warning_sec: float, stall_shutdown_sec: float,
-             stall_check_enabled: bool, exec_callback) -> bool:
+             stall_check_enabled: bool, exec_callback,
+             heartbeat_ms: int = 0, liveness_timeout_ms: int = 0) -> bool:
         """exec_callback(responses: List[NativeResponse], response_id: int)
         is invoked from the native background thread; it must be quick
-        (push to an executor queue)."""
+        (push to an executor queue). ``heartbeat_ms=0`` (the default)
+        keeps the controller's pre-liveness blocking protocol; > 0 arms
+        heartbeat frames + the timed gather (docs/liveness.md)."""
         if not self.available:
             return False
         self.register_exec_callback(exec_callback)
@@ -321,7 +330,8 @@ class NativeCore:
             coordinator_addr.encode(), coordinator_port, my_host.encode(),
             cycle_time_ms, fusion_threshold, cache_capacity,
             stall_warning_sec, stall_shutdown_sec,
-            1 if stall_check_enabled else 0)
+            1 if stall_check_enabled else 0, heartbeat_ms,
+            liveness_timeout_ms)
         return rc == 0
 
     def register_exec_callback(self, exec_callback) -> None:
@@ -375,6 +385,14 @@ class NativeCore:
     def shutdown(self):
         if self.available:
             self.lib.hvd_shutdown()
+
+    def drain(self):
+        """Mark this rank's departure as a graceful DRAIN (preemption):
+        the final controller frame sent during the following
+        ``shutdown()`` carries the drain flag, so the coordinator logs a
+        clean departure — zero blacklist strikes — instead of a crash."""
+        if self.available:
+            self.lib.hvd_drain()
 
     def enqueue(self, name: str, op: int, reduce_op: int, dtype_code: int,
                 shape: Tuple[int, ...], data_ptr: Optional[int] = None,
@@ -514,6 +532,22 @@ class NativeCore:
         parts = []
         while True:
             n = self.lib.hvd_stall_report(buf, len(buf))
+            if n <= 0:
+                break
+            parts.append(buf.raw[:n].decode(errors="replace"))
+            if n < len(buf) - 1:
+                break
+        return "".join(parts)
+
+    def liveness_report(self) -> str:
+        """Accumulated liveness events (SUSPECT/EVICT/DRAIN/RECOVER lines
+        from the controller's liveness plane, docs/liveness.md); consumed
+        on read with the same no-lost-tail drain loop as the stall
+        report."""
+        buf = ctypes.create_string_buffer(65536)
+        parts = []
+        while True:
+            n = self.lib.hvd_liveness_report(buf, len(buf))
             if n <= 0:
                 break
             parts.append(buf.raw[:n].decode(errors="replace"))

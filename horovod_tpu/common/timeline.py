@@ -40,6 +40,15 @@ COMPILE = "COMPILE"
 RETRY = "RETRY"
 STALL_WARNING = "STALL_WARNING"
 HOST_BLACKLISTED = "HOST_BLACKLISTED"
+# Liveness-plane instants (docs/liveness.md), recorded in the
+# launcher-side `<timeline>.driver.json` alongside HOST_BLACKLISTED: the
+# heartbeat state machine's escalation steps and the two phases of a
+# preemption drain.
+HEARTBEAT_MISS = "HEARTBEAT_MISS"
+RANK_SUSPECT = "RANK_SUSPECT"
+RANK_EVICTED = "RANK_EVICTED"
+DRAIN_BEGIN = "DRAIN_BEGIN"
+DRAIN_COMMIT = "DRAIN_COMMIT"
 
 
 class Timeline:

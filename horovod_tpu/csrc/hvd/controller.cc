@@ -1,5 +1,7 @@
 #include "controller.h"
 
+#include <poll.h>
+
 #include <algorithm>
 #include <chrono>
 #include <cstdio>
@@ -7,7 +9,26 @@
 
 #include "message.h"
 
+// TSan-build detection (see tensor_queue.cc): GCC-10-era libtsan lacks
+// the pthread_cond_clockwait interceptor libstdc++ uses for steady_clock
+// cv waits, so the instrumented heartbeat thread must wait on the
+// intercepted system_clock path.
+#if defined(__SANITIZE_THREAD__)
+#define HVD_TSAN_BUILD 1
+#elif defined(__has_feature)
+#if __has_feature(thread_sanitizer)
+#define HVD_TSAN_BUILD 1
+#endif
+#endif
+
 namespace hvd {
+
+namespace {
+double MsSince(std::chrono::steady_clock::time_point then,
+               std::chrono::steady_clock::time_point now) {
+  return std::chrono::duration<double, std::milli>(now - then).count();
+}
+}  // namespace
 
 // ---- shared machinery ------------------------------------------------------
 
@@ -169,6 +190,19 @@ std::vector<Response> Controller::FuseResponses(std::vector<Response> singles,
   return fused;
 }
 
+void Controller::RecordLivenessEvent(const std::string& line) {
+  {
+    std::lock_guard<std::mutex> lk(liveness_mu_);
+    // Bounded like the negotiation buffer: a pathological churn loop must
+    // not grow the report without limit if nobody drains it.
+    if (liveness_report_.size() < (1u << 20)) {
+      liveness_report_ += line;
+      liveness_report_ += '\n';
+    }
+  }
+  std::fprintf(stderr, "[horovod_tpu liveness] %s\n", line.c_str());
+}
+
 void Controller::RecordNegotiationEvent(const std::string& name, int rank) {
   if (!record_negotiation_.load(std::memory_order_relaxed)) return;
   auto ns = std::chrono::duration_cast<std::chrono::nanoseconds>(
@@ -185,8 +219,10 @@ void Controller::RecordNegotiationEvent(const std::string& name, int rank) {
 
 std::vector<Response> LocalController::ComputeResponseList(
     std::vector<Request> reqs, bool this_rank_shutdown,
-    bool* world_shutdown) {
-  *world_shutdown = this_rank_shutdown;
+    bool this_rank_drain, bool* world_shutdown) {
+  // A single-process world draining IS the world shutting down; the
+  // distinction only matters to a coordinator accounting for peers.
+  *world_shutdown = this_rank_shutdown || this_rank_drain;
   // Single-rank world: the tuner's categorical hint has no broadcast to
   // ride; apply it at the same cycle boundary the TCP path would.
   int hier = hier_flags_hint();
@@ -221,6 +257,9 @@ Status TcpController::Initialize() {
   joined_ranks_.assign(cfg_.size, false);
   stall_.Configure(cfg_.stall_warning_sec, cfg_.stall_shutdown_sec,
                    cfg_.size, cfg_.stall_check_enabled);
+  liveness_on_ = cfg_.heartbeat_ms > 0 && cfg_.size > 1;
+  last_seen_.assign(cfg_.size, std::chrono::steady_clock::now());
+  peer_state_.assign(cfg_.size, kAlive);
   if (cfg_.rank == 0) {
     if (!listener_.Listen(cfg_.coordinator_port)) {
       return Status::Error(StatusType::UNKNOWN_ERROR,
@@ -359,8 +398,182 @@ Status TcpController::Initialize() {
       data_endpoints_.emplace_back(host, port);
       cross_ranks_[i] = r.i32();
     }
+    if (liveness_on_) StartHeartbeat();
   }
   return Status::OK();
+}
+
+// ---- liveness plane (docs/liveness.md) -------------------------------------
+
+void TcpController::StartHeartbeat() {
+  {
+    std::lock_guard<std::mutex> lk(hb_mu_);
+    hb_stop_ = false;
+  }
+  hb_thread_ = std::thread([this] {
+    const std::string hb = HeartbeatFrame();
+    const auto interval = std::chrono::milliseconds(cfg_.heartbeat_ms);
+    std::unique_lock<std::mutex> lk(hb_mu_);
+    while (!hb_stop_) {
+#ifdef HVD_TSAN_BUILD
+    // Intercepted system_clock wait under TSan (see the header comment);
+    // a stop notify still breaks it immediately.
+    bool stopped = hb_cv_.wait_until(
+        lk, std::chrono::system_clock::now() + interval,
+        [this] { return hb_stop_; });
+#else
+    bool stopped = hb_cv_.wait_for(lk, interval, [this] { return hb_stop_; });
+#endif
+      if (stopped) break;
+      lk.unlock();
+      bool ok;
+      {
+        std::lock_guard<std::mutex> slk(send_mu_);
+        ok = coord_sock_.valid() && coord_sock_.SendFrame(hb);
+      }
+      lk.lock();
+      // A dead coordinator connection ends the beat; the cycle thread
+      // notices the same failure on its own frame and tears down.
+      if (!ok) break;
+    }
+  });
+}
+
+void TcpController::StopHeartbeat() {
+  {
+    std::lock_guard<std::mutex> lk(hb_mu_);
+    hb_stop_ = true;
+  }
+  hb_cv_.notify_all();
+  if (hb_thread_.joinable()) hb_thread_.join();
+}
+
+void TcpController::MarkSuspect(int rank, const char* reason,
+                                double silence_ms) {
+  if (peer_state_[rank] != kAlive) return;
+  peer_state_[rank] = kSuspect;
+  RecordLivenessEvent("SUSPECT rank=" + std::to_string(rank) + " reason=" +
+                      reason + " silence_ms=" +
+                      std::to_string(static_cast<long long>(silence_ms)));
+}
+
+void TcpController::EvictRank(int rank, const char* reason,
+                              double silence_ms) {
+  shutdown_ranks_[rank] = true;
+  peer_state_[rank] = kEvicted;
+  // Close the socket: a wedged-but-alive peer errors out on its next
+  // frame instead of waiting for a response that will never come.
+  if (rank >= 1) worker_socks_[rank - 1].Close();
+  RecordLivenessEvent("EVICT rank=" + std::to_string(rank) + " reason=" +
+                      reason + " silence_ms=" +
+                      std::to_string(static_cast<long long>(silence_ms)));
+}
+
+void TcpController::GatherWithLiveness(
+    const std::function<void(int, const std::string&)>& ingest) {
+  // Liveness-mode gather: one request frame per live worker, but the
+  // wait is a poll over ALL pending sockets with per-rank eviction
+  // deadlines — a dead rank cannot park the coordinator on its socket
+  // while the others' deadlines rot (the serial blocking gather would).
+  // Heartbeat frames refresh last_seen and are skipped; a closed
+  // connection is an immediate crash-departure.
+  std::vector<int> pending;
+  for (int r = 1; r < cfg_.size; ++r) {
+    if (!shutdown_ranks_[r]) pending.push_back(r);
+  }
+  const double timeout_ms = static_cast<double>(cfg_.liveness_timeout_ms);
+  // First pass polls with a zero timeout: frames (heartbeats included)
+  // that queued in the kernel buffers while this loop was busy
+  // elsewhere — a long ring op, a backpressured broadcast — must
+  // refresh last_seen_ BEFORE any deadline is judged, or a merely-busy
+  // coordinator would evict every healthy worker off stale timestamps.
+  bool drained_once = false;
+  while (!pending.empty()) {
+    double min_wait_ms = timeout_ms;
+    if (drained_once) {
+      auto now = std::chrono::steady_clock::now();
+      // Escalate silence: SUSPECT at half the timeout, EVICT at the
+      // full timeout. Both measured from the last frame (request OR
+      // heartbeat).
+      for (auto it = pending.begin(); it != pending.end();) {
+        int r = *it;
+        double silence = MsSince(last_seen_[r], now);
+        if (silence >= timeout_ms) {
+          EvictRank(r, "heartbeat_timeout", silence);
+          it = pending.erase(it);
+          continue;
+        }
+        if (silence >= timeout_ms / 2) {
+          MarkSuspect(r, "heartbeat_miss", silence);
+        }
+        min_wait_ms = std::min(min_wait_ms, timeout_ms - silence);
+        ++it;
+      }
+      if (pending.empty()) break;
+    }
+    std::vector<struct pollfd> pfds;
+    pfds.reserve(pending.size());
+    for (int r : pending) {
+      struct pollfd p;
+      p.fd = worker_socks_[r - 1].fd();
+      p.events = POLLIN;
+      p.revents = 0;
+      pfds.push_back(p);
+    }
+    // Cap the tick so suspect transitions happen near their deadline
+    // even when no socket turns readable.
+    int wait = drained_once
+                   ? std::max(1, static_cast<int>(std::min(
+                                     min_wait_ms,
+                                     std::max(1.0, timeout_ms / 4))))
+                   : 0;
+    int pr = ::poll(pfds.data(), pfds.size(), wait);
+    if (pr < 0 && errno != EINTR) break;
+    if (pr > 0) {
+      // Snapshot the readable ranks first: handling one erases from
+      // `pending`, which would skew the pfd index mapping mid-walk.
+      // EVERY readable socket is drained before the next deadline
+      // sweep — a queued heartbeat must never sit unread through a
+      // sweep that could evict its sender.
+      std::vector<int> ready;
+      for (size_t i = 0; i < pfds.size(); ++i) {
+        if (pfds[i].revents & (POLLIN | POLLERR | POLLHUP)) {
+          ready.push_back(pending[i]);
+        }
+      }
+      for (int r : ready) {
+        if (std::find(pending.begin(), pending.end(), r) ==
+            pending.end()) {
+          continue;
+        }
+        // Drain every frame already deliverable on this socket; stop
+        // at the request frame (one per worker per cycle — extras stay
+        // buffered for the next cycle).
+        while (true) {
+          std::string bytes;
+          int rc = worker_socks_[r - 1].RecvFrameTimeout(&bytes, 0);
+          if (rc < 0) {
+            double silence =
+                MsSince(last_seen_[r], std::chrono::steady_clock::now());
+            EvictRank(r, "connection_closed", silence);
+            pending.erase(std::find(pending.begin(), pending.end(), r));
+            break;
+          }
+          if (rc == 0) break;
+          last_seen_[r] = std::chrono::steady_clock::now();
+          if (peer_state_[r] == kSuspect) {
+            peer_state_[r] = kAlive;
+            RecordLivenessEvent("RECOVER rank=" + std::to_string(r));
+          }
+          if (IsHeartbeatFrame(bytes)) continue;
+          ingest(r, bytes);
+          pending.erase(std::find(pending.begin(), pending.end(), r));
+          break;
+        }
+      }
+    }
+    drained_once = true;
+  }
 }
 
 void TcpController::CacheResponses(const std::vector<Response>& resps) {
@@ -391,16 +604,17 @@ void TcpController::CacheResponses(const std::vector<Response>& resps) {
 
 std::vector<Response> TcpController::ComputeResponseList(
     std::vector<Request> reqs, bool this_rank_shutdown,
-    bool* world_shutdown) {
+    bool this_rank_drain, bool* world_shutdown) {
   return cfg_.rank == 0
              ? CoordinatorCycle(std::move(reqs), this_rank_shutdown,
-                                world_shutdown)
+                                this_rank_drain, world_shutdown)
              : WorkerCycle(std::move(reqs), this_rank_shutdown,
-                           world_shutdown);
+                           this_rank_drain, world_shutdown);
 }
 
 std::vector<Response> TcpController::WorkerCycle(std::vector<Request> reqs,
                                                  bool my_shutdown,
+                                                 bool my_drain,
                                                  bool* world_shutdown) {
   *world_shutdown = false;
   // Split cache hits from novel requests.
@@ -416,12 +630,38 @@ std::vector<Response> TcpController::WorkerCycle(std::vector<Request> reqs,
   }
   cache_hits_.fetch_add(static_cast<int64_t>(hits.size()),
                         std::memory_order_relaxed);
-  if (!coord_sock_.SendFrame(SerializeRequestList(novel, hits, my_shutdown))) {
+  bool sent;
+  {
+    // Serialized against the heartbeat thread's frames (liveness mode);
+    // uncontended (and the heartbeat thread absent) otherwise.
+    std::lock_guard<std::mutex> slk(send_mu_);
+    sent = coord_sock_.SendFrame(
+        SerializeRequestList(novel, hits, my_shutdown, my_drain));
+  }
+  if (!sent) {
     *world_shutdown = true;
     return {};
   }
   std::string bytes;
-  if (!coord_sock_.RecvFrame(&bytes)) {
+  if (liveness_on_) {
+    // Liveness mode: a coordinator that went silent for 2x the liveness
+    // timeout is dead or partitioned — surface it as a world failure the
+    // elastic retry loop can recover, instead of blocking forever. 2x:
+    // the coordinator legitimately pauses up to one timeout while it
+    // waits out a dying peer's eviction deadline.
+    int rc = coord_sock_.RecvFrameTimeout(&bytes,
+                                          2 * cfg_.liveness_timeout_ms);
+    if (rc <= 0) {
+      if (rc == 0) {
+        RecordLivenessEvent(
+            "COORD_TIMEOUT rank=" + std::to_string(cfg_.rank) +
+            " silence_ms=" +
+            std::to_string(2LL * cfg_.liveness_timeout_ms));
+      }
+      *world_shutdown = true;
+      return {};
+    }
+  } else if (!coord_sock_.RecvFrame(&bytes)) {
     *world_shutdown = true;
     return {};
   }
@@ -458,9 +698,14 @@ std::vector<Response> TcpController::WorkerCycle(std::vector<Request> reqs,
 }
 
 std::vector<Response> TcpController::CoordinatorCycle(
-    std::vector<Request> my_reqs, bool my_shutdown, bool* world_shutdown) {
+    std::vector<Request> my_reqs, bool my_shutdown, bool my_drain,
+    bool* world_shutdown) {
   *world_shutdown = false;
-  shutdown_ranks_[0] = shutdown_ranks_[0] || my_shutdown;
+  shutdown_ranks_[0] = shutdown_ranks_[0] || my_shutdown || my_drain;
+  if (my_drain && peer_state_[0] != kDrained) {
+    peer_state_[0] = kDrained;
+    RecordLivenessEvent("DRAIN rank=0");
+  }
 
   auto ingest = [this](std::vector<Request>&& rs,
                        std::vector<uint32_t>&& ids, int default_rank) {
@@ -492,20 +737,36 @@ std::vector<Response> TcpController::CoordinatorCycle(
 
   ingest(std::move(my_reqs), {}, 0);
 
-  // Gather one frame from every live worker.
-  for (int r = 1; r < cfg_.size; ++r) {
-    if (shutdown_ranks_[r]) continue;
-    std::string bytes;
-    if (!worker_socks_[r - 1].RecvFrame(&bytes)) {
-      shutdown_ranks_[r] = true;  // treat a dead socket as departed
-      continue;
-    }
+  // One request frame from every live worker. The DRAIN flag marks a
+  // graceful farewell (clean preemption exit): the rank departs exactly
+  // like a shutdown, but the event stream lets the driver charge zero
+  // blacklist strikes for it.
+  auto ingest_frame = [&](int r, const std::string& bytes) {
     std::vector<Request> rs;
     std::vector<uint32_t> ids;
-    bool sd = false;
-    if (DeserializeRequestList(bytes, &rs, &ids, &sd)) {
-      if (sd) shutdown_ranks_[r] = true;
+    bool sd = false, dr = false;
+    if (DeserializeRequestList(bytes, &rs, &ids, &sd, &dr)) {
+      if (dr) {
+        shutdown_ranks_[r] = true;
+        peer_state_[r] = kDrained;
+        RecordLivenessEvent("DRAIN rank=" + std::to_string(r));
+      } else if (sd) {
+        shutdown_ranks_[r] = true;
+      }
       ingest(std::move(rs), std::move(ids), r);
+    }
+  };
+  if (liveness_on_) {
+    GatherWithLiveness(ingest_frame);
+  } else {
+    for (int r = 1; r < cfg_.size; ++r) {
+      if (shutdown_ranks_[r]) continue;
+      std::string bytes;
+      if (!worker_socks_[r - 1].RecvFrame(&bytes)) {
+        shutdown_ranks_[r] = true;  // treat a dead socket as departed
+        continue;
+      }
+      ingest_frame(r, bytes);
     }
   }
 
@@ -574,13 +835,39 @@ std::vector<Response> TcpController::CoordinatorCycle(
   }
 
   bool stall_shutdown = false;
-  std::string report = stall_.Check(&stall_shutdown);
+  std::vector<int> stalled_ranks;
+  std::string report =
+      stall_.Check(&stall_shutdown, liveness_on_ ? &stalled_ranks : nullptr);
   if (!report.empty()) {
     {
       std::lock_guard<std::mutex> lk(stall_report_mu_);
       stall_report_ += report;
     }
     std::fprintf(stderr, "[horovod_tpu coordinator] %s", report.c_str());
+  }
+  if (liveness_on_) {
+    // Stall escalation (docs/liveness.md): a rank stalled past the
+    // warning window enters the same miss -> SUSPECT -> EVICT machine a
+    // heartbeat miss does — its heartbeats prove the process is alive,
+    // but a submit-starved rank is still wedging the world. The hard
+    // stall window then EVICTS suspects instead of only logging.
+    auto now = std::chrono::steady_clock::now();
+    for (int r : stalled_ranks) {
+      // r >= 1: rank 0 is this coordinator — its last_seen_ never
+      // updates (no socket to itself) and no frame could ever RECOVER
+      // it, so marking it would wedge a permanent bogus SUSPECT with a
+      // run-age silence value in the report.
+      if (r >= 1 && r < cfg_.size && !shutdown_ranks_[r]) {
+        MarkSuspect(r, "stall", MsSince(last_seen_[r], now));
+      }
+    }
+    if (stall_shutdown) {
+      for (int r : stalled_ranks) {
+        if (r >= 1 && r < cfg_.size && !shutdown_ranks_[r]) {
+          EvictRank(r, "stall_hard_window", MsSince(last_seen_[r], now));
+        }
+      }
+    }
   }
 
   auto fused = FuseResponses(std::move(singles), fusion_threshold());
@@ -634,6 +921,9 @@ std::vector<Response> TcpController::CoordinatorCycle(
 }
 
 void TcpController::Finalize() {
+  // Stop the heartbeat thread BEFORE closing its socket: a beat racing
+  // the close would write a freed fd.
+  StopHeartbeat();
   for (auto& s : worker_socks_) s.Close();
   coord_sock_.Close();
   listener_.Close();

@@ -15,10 +15,15 @@
 
 #include <algorithm>
 #include <atomic>
+#include <chrono>
+#include <condition_variable>
 #include <cstdint>
+#include <functional>
 #include <map>
 #include <memory>
+#include <mutex>
 #include <string>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -47,6 +52,14 @@ struct ControllerConfig {
   // different key are rejected so concurrent jobs on one host can't
   // cross-connect through a shared default port.
   std::string job_key;
+  // Liveness plane (docs/liveness.md). heartbeat_ms > 0 arms it: worker
+  // ranks run a heartbeat thread interleaving one-byte frames with their
+  // request frames, and the coordinator's gather turns into a timed poll
+  // that tracks last_seen per rank and escalates silence through
+  // miss -> SUSPECT (half the timeout) -> EVICT (the full timeout).
+  // 0 (the default) keeps the pre-liveness blocking protocol bit-for-bit.
+  int heartbeat_ms = 0;
+  int liveness_timeout_ms = 10000;
 };
 
 class Controller {
@@ -100,12 +113,14 @@ class Controller {
   int TakeSyncedHierFlags() { return synced_hier_flags_.exchange(-1); }
 
   virtual Status Initialize() = 0;
-  // One negotiation cycle. `this_rank_shutdown` signals this rank wants out;
-  // returns responses to execute now; sets *world_shutdown once every rank
-  // has requested shutdown.
+  // One negotiation cycle. `this_rank_shutdown` signals this rank wants
+  // out; `this_rank_drain` marks the departure as a graceful DRAIN
+  // farewell (clean preemption exit — recorded distinctly from a crash);
+  // returns responses to execute now; sets *world_shutdown once the world
+  // must end.
   virtual std::vector<Response> ComputeResponseList(
       std::vector<Request> local_requests, bool this_rank_shutdown,
-      bool* world_shutdown) = 0;
+      bool this_rank_drain, bool* world_shutdown) = 0;
   virtual void Finalize() {}
 
   // Host data-plane endpoints (rank -> host:port), filled by Initialize for
@@ -137,6 +152,22 @@ class Controller {
   // requests directly).
   int64_t cache_hits() const {
     return cache_hits_.load(std::memory_order_relaxed);
+  }
+
+  // Accumulated liveness events (SUSPECT / EVICT / DRAIN /
+  // COORD_TIMEOUT lines; docs/liveness.md), drained like the stall
+  // report: consumes at most max_bytes of whole lines per call so a
+  // bounded caller buffer never silently drops the tail.
+  std::string TakeLivenessReport(size_t max_bytes = SIZE_MAX) {
+    std::lock_guard<std::mutex> lk(liveness_mu_);
+    if (liveness_report_.size() <= max_bytes) {
+      std::string r = std::move(liveness_report_);
+      liveness_report_.clear();
+      return r;
+    }
+    std::string r = liveness_report_.substr(0, max_bytes);
+    liveness_report_.erase(0, max_bytes);
+    return r;
   }
 
   // Per-rank negotiation ticks (reference Timeline::NegotiateRankReady,
@@ -178,6 +209,10 @@ class Controller {
                                              int64_t threshold_bytes);
   // Record a per-rank negotiation tick (no-op unless enabled).
   void RecordNegotiationEvent(const std::string& name, int rank);
+  // Append one liveness event line (newline added here) to the report
+  // buffer drained by hvd_liveness_report, and echo it to stderr so the
+  // launcher log shows membership churn even without a drain consumer.
+  void RecordLivenessEvent(const std::string& line);
 
   ControllerConfig cfg_;
   std::atomic<int64_t> fusion_threshold_bytes_;
@@ -193,6 +228,8 @@ class Controller {
   std::vector<std::pair<std::string, int>> data_endpoints_;
   std::vector<int> cross_ranks_;
   std::string stall_report_;
+  std::mutex liveness_mu_;
+  std::string liveness_report_;
 };
 
 // Single-process controller: the driving process sees every enqueue, so
@@ -203,6 +240,7 @@ class LocalController : public Controller {
   Status Initialize() override { return Status::OK(); }
   std::vector<Response> ComputeResponseList(std::vector<Request> reqs,
                                             bool this_rank_shutdown,
+                                            bool this_rank_drain,
                                             bool* world_shutdown) override;
 };
 
@@ -214,25 +252,52 @@ class TcpController : public Controller {
   TcpController(ControllerConfig cfg, int data_port, std::string my_host)
       : Controller(std::move(cfg)), data_port_(data_port),
         my_host_(std::move(my_host)) {}
+  ~TcpController() override { StopHeartbeat(); }
   Status Initialize() override;
   std::vector<Response> ComputeResponseList(std::vector<Request> reqs,
                                             bool this_rank_shutdown,
+                                            bool this_rank_drain,
                                             bool* world_shutdown) override;
   void Finalize() override;
 
+  // Liveness peer states (coordinator-side; docs/liveness.md).
+  enum PeerState { kAlive = 0, kSuspect = 1, kEvicted = 2, kDrained = 3 };
+
  private:
   std::vector<Response> CoordinatorCycle(std::vector<Request> my_reqs,
-                                         bool my_shutdown,
+                                         bool my_shutdown, bool my_drain,
                                          bool* world_shutdown);
   std::vector<Response> WorkerCycle(std::vector<Request> my_reqs,
-                                    bool my_shutdown, bool* world_shutdown);
+                                    bool my_shutdown, bool my_drain,
+                                    bool* world_shutdown);
   void CacheResponses(const std::vector<Response>& resps);
+  // Liveness helpers (all coordinator-side except the heartbeat pair).
+  void StartHeartbeat();
+  void StopHeartbeat();
+  // Gather one request frame per live worker, skipping heartbeat frames
+  // and escalating silence to eviction (liveness mode only). Ingests via
+  // `ingest(rank, bytes)`.
+  void GatherWithLiveness(
+      const std::function<void(int, const std::string&)>& ingest);
+  void EvictRank(int rank, const char* reason, double silence_ms);
+  void MarkSuspect(int rank, const char* reason, double silence_ms);
 
   int data_port_ = 0;
   std::string my_host_;
   Listener listener_;                 // coordinator only
   std::vector<Socket> worker_socks_;  // coordinator: index = rank-1
   Socket coord_sock_;                 // workers
+  // Liveness plane state. `liveness_on_` is fixed at Initialize.
+  bool liveness_on_ = false;
+  std::vector<std::chrono::steady_clock::time_point> last_seen_;
+  std::vector<int> peer_state_;
+  // Worker heartbeat thread: beats every heartbeat_ms on the control
+  // socket; send_mu_ serializes its frames against the cycle thread's.
+  std::thread hb_thread_;
+  std::mutex hb_mu_;
+  std::condition_variable hb_cv_;
+  bool hb_stop_ = false;
+  std::mutex send_mu_;
 
   // Coordinator negotiation state: name -> per-rank requests seen so far.
   std::unordered_map<std::string, std::vector<Request>> pending_;

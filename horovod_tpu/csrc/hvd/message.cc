@@ -7,6 +7,11 @@ namespace hvd {
 namespace {
 constexpr uint8_t kRequestMagic = 0xA1;
 constexpr uint8_t kResponseMagic = 0xA2;
+constexpr uint8_t kHeartbeatMagic = 0xA3;
+// Request-list flags byte (docs/liveness.md): the old bool shutdown byte
+// widened into a bitfield — old frames (0/1) parse identically.
+constexpr uint8_t kFlagShutdown = 1;
+constexpr uint8_t kFlagDrain = 2;
 }  // namespace
 
 void Reader::memcpy_(void* dst, size_t n) {
@@ -101,10 +106,11 @@ static Request ReadRequest(Reader* r) {
 
 std::string SerializeRequestList(const std::vector<Request>& reqs,
                                  const std::vector<uint32_t>& cached_ids,
-                                 bool shutdown) {
+                                 bool shutdown, bool drain) {
   Writer w;
   w.u8(kRequestMagic);
-  w.u8(shutdown ? 1 : 0);
+  w.u8(static_cast<uint8_t>((shutdown ? kFlagShutdown : 0) |
+                            (drain ? kFlagDrain : 0)));
   w.i32(static_cast<int32_t>(reqs.size()));
   for (const auto& q : reqs) WriteRequest(&w, q);
   w.i32(static_cast<int32_t>(cached_ids.size()));
@@ -115,10 +121,12 @@ std::string SerializeRequestList(const std::vector<Request>& reqs,
 bool DeserializeRequestList(const std::string& bytes,
                             std::vector<Request>* reqs,
                             std::vector<uint32_t>* cached_ids,
-                            bool* shutdown) {
+                            bool* shutdown, bool* drain) {
   Reader r(bytes);
   if (r.u8() != kRequestMagic) return false;
-  *shutdown = r.u8() != 0;
+  uint8_t flags = r.u8();
+  *shutdown = (flags & kFlagShutdown) != 0;
+  if (drain != nullptr) *drain = (flags & kFlagDrain) != 0;
   int32_t n = r.i32();
   if (n < 0 || n > (1 << 24)) return false;
   reqs->clear();
@@ -135,6 +143,15 @@ bool DeserializeRequestList(const std::string& bytes,
     cached_ids->push_back(static_cast<uint32_t>(r.i32()));
   }
   return r.ok();
+}
+
+std::string HeartbeatFrame() {
+  return std::string(1, static_cast<char>(kHeartbeatMagic));
+}
+
+bool IsHeartbeatFrame(const std::string& bytes) {
+  return bytes.size() == 1 &&
+         static_cast<uint8_t>(bytes[0]) == kHeartbeatMagic;
 }
 
 std::string SerializeResponseList(const std::vector<Response>& resps,

@@ -74,13 +74,25 @@ class Reader {
 // Request list <-> bytes. `cached_ids` carries response-cache hit ids so a
 // repeat submission costs 4 bytes instead of a full Request (the bandwidth
 // role of the reference's cache bitvector sync, response_cache.h:45-167).
+// The second byte is a flags field: bit0 = shutdown (this rank wants the
+// world down), bit1 = drain (a DRAIN farewell — the rank leaves cleanly at
+// a committed boundary, e.g. TPU-VM preemption; the driver must charge it
+// zero blacklist strikes, unlike a crash).
 std::string SerializeRequestList(const std::vector<Request>& reqs,
                                  const std::vector<uint32_t>& cached_ids,
-                                 bool shutdown);
+                                 bool shutdown, bool drain = false);
 bool DeserializeRequestList(const std::string& bytes,
                             std::vector<Request>* reqs,
                             std::vector<uint32_t>* cached_ids,
-                            bool* shutdown);
+                            bool* shutdown, bool* drain = nullptr);
+
+// Liveness heartbeat frame (docs/liveness.md): a one-byte frame a worker's
+// heartbeat thread interleaves with request frames on the control socket so
+// the coordinator can tell "alive but quiet" from "dead" without waiting
+// for a collective to wedge. Distinguished by magic from request frames, so
+// the coordinator's gather loop can skip any number of them.
+std::string HeartbeatFrame();
+bool IsHeartbeatFrame(const std::string& bytes);
 
 // cycle_time_ms / fusion_threshold / hier_flags piggyback the
 // coordinator's tuned parameters on the broadcast (reference

@@ -92,6 +92,10 @@ struct GlobalState {
   std::mutex init_mu;
   std::atomic<bool> initialized{false};
   std::atomic<bool> shutdown_requested{false};
+  // Graceful-drain farewell (docs/liveness.md): set by hvd_drain before
+  // hvd_shutdown so this rank's final frame carries the DRAIN flag — the
+  // coordinator records a clean departure instead of a crash.
+  std::atomic<bool> drain_requested{false};
   std::atomic<bool> loop_done{false};
 
   // Atomic: written by hvd_init (under init_mu) but read lock-free by the
@@ -437,10 +441,12 @@ bool RunLoopOnce(std::chrono::steady_clock::time_point& last_cycle) {
   last_cycle = std::chrono::steady_clock::now();
 
   bool want_shutdown = s->shutdown_requested.load();
+  bool want_drain = s->drain_requested.load();
   bool world_shutdown = false;
   auto requests = s->tensor_queue.PopMessages();
   auto responses = s->controller->ComputeResponseList(
-      std::move(requests), want_shutdown, &world_shutdown);
+      std::move(requests), want_shutdown || want_drain, want_drain,
+      &world_shutdown);
   // Worker ranks: adopt the coordinator's autotuned cycle time delivered on
   // the response broadcast (reference SynchronizeParameters applied inside
   // BackgroundThreadLoop, operations.cc:598-604).
@@ -482,7 +488,8 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
              int coordinator_port, const char* my_host, double cycle_time_ms,
              long long fusion_threshold, int cache_capacity,
              double stall_warning_sec, double stall_shutdown_sec,
-             int stall_check_enabled) {
+             int stall_check_enabled, int heartbeat_ms,
+             int liveness_timeout_ms) {
   auto* s = hvd::g();
   std::lock_guard<std::mutex> lk(s->init_mu);
   if (s->initialized.load()) {
@@ -504,6 +511,7 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   s->cross_size = cross_size;
   s->cycle_time_ms = cycle_time_ms;
   s->shutdown_requested.store(false);
+  s->drain_requested.store(false);
   s->loop_done.store(false);
   s->tensor_queue.Reopen();  // re-arm after a prior world's final drain
 
@@ -518,6 +526,8 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   cfg.stall_warning_sec = stall_warning_sec;
   cfg.stall_shutdown_sec = stall_shutdown_sec;
   cfg.stall_check_enabled = stall_check_enabled != 0;
+  cfg.heartbeat_ms = heartbeat_ms;
+  if (liveness_timeout_ms > 0) cfg.liveness_timeout_ms = liveness_timeout_ms;
   // Per-job isolation key (launcher-exported, same on every rank): guards
   // the shared default controller port against cross-job connections.
   // Hashed to a fixed hex token so any user-supplied charset/length works
@@ -662,6 +672,27 @@ int hvd_drain_negotiation(char* buf, int cap) {
   std::memcpy(buf, text.data(), text.size());
   buf[text.size()] = '\0';
   return static_cast<int>(text.size());
+}
+
+// Graceful-drain farewell (docs/liveness.md): mark this rank's departure
+// as a clean DRAIN before calling hvd_shutdown. The background loop's
+// final request frame then carries the drain flag, so the coordinator's
+// liveness stream records DRAIN (zero blacklist strikes) instead of a
+// crash eviction.
+void hvd_drain() { hvd::g()->drain_requested.store(true); }
+
+// Accumulated liveness events (SUSPECT/EVICT/DRAIN/RECOVER lines from
+// the controller's liveness plane). Same bounded-drain contract as
+// hvd_stall_report: consumes only what fits; the rest stays queued.
+int hvd_liveness_report(char* buf, int cap) {
+  auto* s = hvd::g();
+  std::lock_guard<std::mutex> lk(s->init_mu);
+  if (s->controller == nullptr || buf == nullptr || cap <= 0) return 0;
+  std::string r =
+      s->controller->TakeLivenessReport(static_cast<size_t>(cap - 1));
+  std::memcpy(buf, r.data(), r.size());
+  buf[r.size()] = '\0';
+  return static_cast<int>(r.size());
 }
 
 int hvd_stall_report(char* buf, int cap) {

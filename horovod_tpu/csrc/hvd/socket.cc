@@ -150,6 +150,60 @@ bool Socket::RecvFrame(std::string* payload) {
   return len == 0 || RecvAll(&(*payload)[0], len);
 }
 
+int Socket::RecvFrameTimeout(std::string* payload, int timeout_ms) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  while (true) {
+    // A complete frame already buffered? rbuf_/rpos_ double as the
+    // partial-frame accumulator, so a timed-out call never misaligns the
+    // stream for the next one (or for blocking RecvFrame).
+    size_t avail = rbuf_.size() - rpos_;
+    if (avail >= 4) {
+      uint32_t len = 0;
+      std::memcpy(&len, rbuf_.data() + rpos_, 4);
+      if (len > (1u << 30)) return -1;
+      if (avail >= 4 + static_cast<size_t>(len)) {
+        payload->assign(rbuf_.data() + rpos_ + 4, len);
+        rpos_ += 4 + len;
+        if (rpos_ == rbuf_.size()) {
+          rbuf_.clear();
+          rpos_ = 0;
+        }
+        return 1;
+      }
+    }
+    auto now = std::chrono::steady_clock::now();
+    auto remaining = std::chrono::duration_cast<std::chrono::milliseconds>(
+                         deadline - now)
+                         .count();
+    if (remaining < 0) remaining = 0;
+    struct pollfd pfd;
+    pfd.fd = fd_;
+    pfd.events = POLLIN;
+    pfd.revents = 0;
+    int pr = ::poll(&pfd, 1, static_cast<int>(remaining));
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    if (pr == 0) return 0;  // budget exhausted without a complete frame
+    // Compact the consumed prefix so the buffer only ever grows by what
+    // the incomplete frame still needs.
+    if (rpos_ > 0) {
+      rbuf_.erase(rbuf_.begin(), rbuf_.begin() + rpos_);
+      rpos_ = 0;
+    }
+    char tmp[kRecvBuf];
+    ssize_t r = ::recv(fd_, tmp, sizeof(tmp), 0);
+    if (r == 0) return -1;  // orderly close
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    rbuf_.insert(rbuf_.end(), tmp, tmp + r);
+  }
+}
+
 Socket Socket::Connect(const std::string& host, int port, int timeout_ms) {
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(timeout_ms);

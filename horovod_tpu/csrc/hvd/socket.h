@@ -41,6 +41,12 @@ class Socket {
   // (header + payload, often the NEXT frame too) costs one recv.
   bool SendFrame(const std::string& payload);
   bool RecvFrame(std::string* payload);
+  // Timed receive for the liveness plane (docs/liveness.md): returns 1
+  // with a complete frame, 0 on timeout (any partial frame stays buffered
+  // — a later call resumes it byte-exact), -1 when the peer closed or the
+  // socket errored. timeout_ms = 0 polls without blocking: it consumes
+  // only frames already deliverable.
+  int RecvFrameTimeout(std::string* payload, int timeout_ms);
 
   static Socket Connect(const std::string& host, int port,
                         int timeout_ms = 30000);

@@ -21,19 +21,26 @@ void StallInspector::Remove(const std::string& name) {
   pending_.erase(name);
 }
 
-std::string StallInspector::Check(bool* should_shutdown) {
+std::string StallInspector::Check(bool* should_shutdown,
+                                  std::vector<int>* stalled_ranks) {
   *should_shutdown = false;
   if (!enabled_) return "";
   std::lock_guard<std::mutex> lk(mu_);
   auto now = std::chrono::steady_clock::now();
   std::string report;
+  std::vector<bool> stalled(stalled_ranks != nullptr ? world_size_ : 0,
+                            false);
   for (auto& kv : pending_) {
     double waited =
         std::chrono::duration<double>(now - kv.second.first_seen).count();
-    if (waited < warning_sec_ || kv.second.warned) {
-      if (shutdown_sec_ > 0 && waited > shutdown_sec_) *should_shutdown = true;
-      continue;
+    if (waited < warning_sec_) continue;
+    if (shutdown_sec_ > 0 && waited > shutdown_sec_) *should_shutdown = true;
+    if (stalled_ranks != nullptr) {
+      for (int r = 0; r < world_size_; ++r) {
+        if (!kv.second.ranks[r]) stalled[r] = true;
+      }
     }
+    if (kv.second.warned) continue;
     kv.second.warned = true;
     std::string missing;
     for (int r = 0; r < world_size_; ++r) {
@@ -45,7 +52,12 @@ std::string StallInspector::Check(bool* should_shutdown) {
     report += "Stalled tensor '" + kv.first + "' waited " +
               std::to_string(static_cast<int>(waited)) +
               "s; missing ranks: [" + missing + "]\n";
-    if (shutdown_sec_ > 0 && waited > shutdown_sec_) *should_shutdown = true;
+  }
+  if (stalled_ranks != nullptr) {
+    stalled_ranks->clear();
+    for (int r = 0; r < world_size_; ++r) {
+      if (stalled[r]) stalled_ranks->push_back(r);
+    }
   }
   return report;
 }

@@ -30,7 +30,13 @@ class StallInspector {
 
   // Returns a human-readable stall report ("" if none) and sets
   // *should_shutdown when the hard limit passed. Call once per cycle.
-  std::string Check(bool* should_shutdown);
+  // `stalled_ranks` (optional) receives the deduplicated ranks missing
+  // from any tensor pending past the warning window — the liveness
+  // plane escalates them to SUSPECT through the same state machine as a
+  // heartbeat miss (docs/liveness.md) instead of their stall being a
+  // log line only.
+  std::string Check(bool* should_shutdown,
+                    std::vector<int>* stalled_ranks = nullptr);
 
  private:
   struct PendingInfo {

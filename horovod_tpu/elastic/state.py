@@ -17,52 +17,83 @@ from __future__ import annotations
 
 import copy
 import functools
+import os
 import queue
+import threading
 from typing import Callable, Dict, List
 
 from ..common import config as _config
+from ..common import faults as _faults
 from ..common import logging as _log
-from ..common.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from ..common.exceptions import (HorovodInternalError, HostsUpdatedInterrupt,
+                                 PreemptionInterrupt)
 
 
 class _HostUpdates:
     """Process-local mailbox for membership-change notifications.
 
     The launcher-side worker notification service (``horovod_tpu.run``)
-    posts here; TPU-VM preemption watchers post here too. Mirrors the role
-    of the reference's WorkerNotificationManager (``run/elastic/worker.py``).
+    posts here; TPU-VM preemption watchers post drain-flavored entries.
+    Mirrors the role of the reference's WorkerNotificationManager
+    (``run/elastic/worker.py``).
     """
 
     def __init__(self):
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
 
-    def post(self, timestamp: float = 0.0):
-        self._q.put(timestamp)
+    def post(self, timestamp: float = 0.0, drain: bool = False):
+        self._q.put((timestamp, drain))
 
-    def pending(self) -> bool:
-        drained = False
+    def pending(self):
+        """Drain the mailbox; returns ``None`` (nothing), ``"update"``
+        (membership change), or ``"drain"`` (preemption notice — wins
+        over any queued updates: this worker is leaving either way).
+        Truthiness matches the old bool contract."""
+        kind = None
         try:
             while True:
-                self._q.get_nowait()
-                drained = True
+                _, drain = self._q.get_nowait()
+                kind = "drain" if drain else (kind or "update")
         except queue.Empty:
             pass
-        return drained
+        return kind
 
 
 notification_mailbox = _HostUpdates()
 
 
+def _drain_watchdog(grace_ms: int) -> threading.Timer:
+    """Bound the drain protocol: a worker that cannot finish draining
+    within ``HOROVOD_DRAIN_GRACE_MS`` force-exits nonzero (= crash
+    accounting) — "graceful" must never outlive the host's preemption
+    deadline, and a wedged drain must not strand the survivors longer
+    than a crash would (docs/liveness.md). Armed by ``_graceful_drain``
+    (NOT the signal handler: a handler-armed timer would fire inside
+    perfectly healthy processes that merely registered the handler) and
+    cancelled when the protocol completes or aborts by exception — only
+    a truly wedged drain lets it fire."""
+
+    def fire():
+        os.write(2, b"[horovod_tpu] drain grace expired; force-exiting\n")
+        os._exit(1)
+
+    t = threading.Timer(grace_ms / 1000.0, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
 def register_preemption_signal(signum=None):
-    """Route a preemption signal into the elastic mailbox.
+    """Route a preemption signal into the graceful-drain protocol.
 
     TPU-VM maintenance/preemption notices arrive as a process signal
-    (SIGTERM by default). Installing this handler converts the signal into
-    a ``HostsUpdatedInterrupt`` at the next ``state.commit()``, so the
-    worker leaves at a committed boundary and the elastic driver
-    re-rendezvouses the remaining hosts — the TPU-native analog of the
-    reference's host-update notification (``run/elastic/worker.py``,
-    ``common/elastic.py:161``).
+    (SIGTERM by default). Installing this handler converts the signal
+    into a ``PreemptionInterrupt`` at the next ``state.commit()``: the
+    doomed worker leaves at a committed boundary, announces DRAIN to the
+    driver and the native controller (zero blacklist strikes, unlike a
+    crash), and exits cleanly while the elastic driver re-rendezvouses
+    the remaining hosts (docs/liveness.md). The drain protocol itself
+    is bounded by ``HOROVOD_DRAIN_GRACE_MS``.
 
     Opt-in: call explicitly, or set ``HOROVOD_ELASTIC_PREEMPT_SIGNAL``
     (e.g. ``SIGTERM``/``15``) to install during worker bring-up. Returns
@@ -77,9 +108,9 @@ def register_preemption_signal(signum=None):
 
     def _on_preempt(signo, frame):
         _log.warning(
-            f"preemption signal {signo} received; will re-rendezvous at "
-            "the next commit")
-        notification_mailbox.post()
+            f"preemption signal {signo} received; draining at the next "
+            "commit")
+        notification_mailbox.post(drain=True)
 
     return _signal.signal(signum, _on_preempt)
 
@@ -104,7 +135,10 @@ class State:
         self.check_host_updates()
 
     def check_host_updates(self):
-        if notification_mailbox.pending():
+        kind = notification_mailbox.pending()
+        if kind == "drain":
+            raise PreemptionInterrupt()
+        if kind:
             raise HostsUpdatedInterrupt(skip_sync=False)
 
     # subclass interface
@@ -307,6 +341,68 @@ def _reinitialize():
     _state.init()
 
 
+def _graceful_drain(state: "State") -> None:
+    """The preemption drain protocol (docs/liveness.md), run when a
+    ``PreemptionInterrupt`` surfaces in the retry loop:
+
+    1. announce ``DRAIN begin`` in the rendezvous KV (the driver emits
+       the ``DRAIN_BEGIN`` timeline instant and stops charging this
+       slot's exit as a failure once the commit marker follows);
+    2. commit elastic state — the drain boundary IS the last commit the
+       survivors resume from;
+    3. announce ``DRAIN commit``;
+    4. send the DRAIN farewell on the native controller and tear the
+       local world down (survivors see the departure as a recoverable
+       collective failure and re-rendezvous).
+
+    The caller exits 0 afterwards. A failure before the commit marker
+    propagates — an uncommitted drain is a crash and must be charged
+    like one. The watchdog bounds the protocol at
+    ``HOROVOD_DRAIN_GRACE_MS``; it is cancelled on completion or
+    exception, so only a truly wedged drain force-exits.
+    """
+    _log.warning("preemption drain: committing and leaving cleanly")
+    watchdog = _drain_watchdog(_config.drain_grace_ms())
+    try:
+        addr = _config.rendezvous_addr()
+        port = _config.rendezvous_port()
+        hostname = _config.hostname()
+        local_rank = _config.local_rank()
+        announce = addr is not None and port is not None and hostname
+        if announce:
+            from ..run.elastic.rendezvous import announce_drain
+
+            announce_drain(addr, port, hostname, local_rank, "begin")
+        # Chaos seam (faults.CATALOG): kill/delay the doomed rank
+        # mid-drain — a preemption deadline beating the drain.
+        _faults.point("elastic.drain")
+        state.save()
+        if announce:
+            announce_drain(addr, port, hostname, local_rank, "commit")
+        # Farewell + teardown are best-effort: the commit marker is
+        # already durable, so a world that collapses under us (the
+        # coordinator may be the one draining) must not turn the clean
+        # exit into a crash.
+        from ..common import host_world as _host_world
+        from ..common import state as _state
+
+        try:
+            _host_world.world().drain()
+        # hvdlint: ignore[exception-discipline] -- post-commit farewell:
+        # failures must not convert a committed drain into a crash exit
+        except Exception as e:
+            _log.warning(f"drain farewell (host world) failed: {e}")
+        try:
+            _state.shutdown()
+        # hvdlint: ignore[exception-discipline] -- same post-commit
+        # contract
+        except Exception as e:
+            _log.warning(f"drain teardown (XLA engine) failed: {e}")
+    finally:
+        watchdog.cancel()
+    _log.warning("preemption drain complete; exiting 0")
+
+
 # Consecutive re-init failures tolerated before giving up: a transient
 # race with the driver's next plan (rank 0 not yet published, world
 # re-shuffling mid-join) heals on retry; a dead driver does not, and
@@ -354,6 +450,13 @@ def retry_loop(func: Callable, reinitialize: Callable[[], None]) -> Callable:
                     "collective failure: restoring last committed state")
                 state.restore()
                 reset_required = True
+            except PreemptionInterrupt:
+                # This host is going away: drain (commit + DRAIN farewell)
+                # and leave with a clean exit code — the driver charges a
+                # drained departure zero blacklist strikes, unlike the
+                # crash path above (docs/liveness.md).
+                _graceful_drain(state)
+                raise SystemExit(0)
             except HostsUpdatedInterrupt as e:
                 _log.info("host membership changed: re-initializing")
                 reset_required = True

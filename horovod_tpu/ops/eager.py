@@ -130,7 +130,9 @@ class EagerEngine:
                 stall_warning_sec=cfg.stall_warning_seconds,
                 stall_shutdown_sec=cfg.stall_shutdown_seconds,
                 stall_check_enabled=not cfg.stall_check_disable,
-                exec_callback=self._on_responses)
+                exec_callback=self._on_responses,
+                heartbeat_ms=_hvd_config.heartbeat_ms(),
+                liveness_timeout_ms=_hvd_config.liveness_timeout_ms())
             if ok:
                 self._native = True
                 self._executor = threading.Thread(

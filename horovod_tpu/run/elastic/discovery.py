@@ -199,6 +199,38 @@ class HostManager:
         if cb is not None:
             cb(host, dict(info))
 
+    # Exclusion window for a drained (preempted) host: long enough that
+    # the driver never respawns onto a VM mid-teardown, short enough that
+    # a reborn host under the same name (autoscaler replacement) gets
+    # re-invited without operator action.
+    DRAIN_QUARANTINE_SECONDS = 300.0
+
+    def quarantine(self, host: str,
+                   seconds: Optional[float] = None) -> None:
+        """Exclude ``host`` WITHOUT a strike (docs/liveness.md): a
+        graceful preemption drain is the platform reclaiming the VM, not
+        the host misbehaving — it must not march toward a permanent
+        blacklist, and parole state is untouched. The exclusion shares
+        the cooldown bookkeeping so rank assignment and slot counting
+        treat it exactly like any other excluded host."""
+        if seconds is None:
+            seconds = self.DRAIN_QUARANTINE_SECONDS
+        with self._lock:
+            if self._blacklist.get(host, 0.0) > self._clock():
+                return  # already excluded
+            until = self._clock() + seconds
+            self._blacklist[host] = until
+            self._order = [h for h in self._order if h != host]
+            self._slots.pop(host, None)
+            info = {
+                "host": host, "strikes": self._strikes.get(host, 0),
+                "max_strikes": self._max_strikes, "permanent": False,
+                "until": until, "ts": self._clock(), "drained": True,
+            }
+            self._events.append(info)
+        _log.info(f"elastic: host {host} drained; quarantined for "
+                  f"{seconds:.0f}s with zero strikes")
+
     def is_blacklisted(self, host: str) -> bool:
         with self._lock:
             return self._blacklist.get(host, 0.0) > self._clock()

@@ -23,24 +23,31 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ...common import faults as _faults
+from ...common import liveness as _liveness
 from ...common import logging as _log
 from ...common import timeline as _timeline
 from ..common.util.hosts import HostInfo, SlotInfo, get_host_assignments
 from .discovery import HostManager
-from .registration import FAILURE, SUCCESS, WorkerStateRegistry
+from .registration import DRAINED, FAILURE, SUCCESS, WorkerStateRegistry
+from .rendezvous import DRAIN_SCOPE, HEARTBEAT_SCOPE
 
 DISCOVER_HOSTS_FREQUENCY_SECS = 1.0
 
 
 class _WorkerHandle:
-    """Per-worker shutdown event + removal mark (mutated under the
-    driver lock)."""
+    """Per-worker shutdown event + classification marks (mutated under
+    the driver lock): `removed` = slot left the plan (no accounting),
+    `evicted` = liveness plane gave up on it (failure accounting
+    regardless of exit code), `draining` = announced a graceful
+    preemption drain."""
 
-    __slots__ = ("event", "removed")
+    __slots__ = ("event", "removed", "evicted", "draining")
 
     def __init__(self):
         self.event = threading.Event()
         self.removed = False
+        self.evicted = False
+        self.draining = False
 
 
 class ElasticDriver:
@@ -78,6 +85,17 @@ class ElasticDriver:
         self._shutdown = threading.Event()
         self._host_change = threading.Event()
         self._workers_active: Dict[Tuple[str, int], _WorkerHandle] = {}
+        # Liveness plane (docs/liveness.md): armed by HOROVOD_HEARTBEAT_MS
+        # > 0 when the rendezvous store is readable driver-side. Workers
+        # push heartbeats into the KV; the discovery loop folds them into
+        # the tracker and escalates silence miss -> SUSPECT -> EVICT.
+        # Tracker state is guarded by self._lock.
+        self._liveness: Optional[_liveness.LivenessTracker] = None
+        if _liveness.enabled() and hasattr(rendezvous, "get"):
+            self._liveness = _liveness.LivenessTracker()
+        self._hb_seen: Dict[Tuple[str, int], bytes] = {}
+        # ((host, slot), phase) -> consumed marker
+        self._drain_seen: Dict[Tuple[Tuple[str, int], str], bytes] = {}
         self._requested_np = min_np
         self._round_failures = 0
         self._notify_client_factory = None  # injectable for tests
@@ -162,7 +180,157 @@ class ElasticDriver:
             # collective signal flows through the driver's discovery path
             except Exception as e:
                 _log.warning(f"host discovery failed: {e}")
+            if self._liveness is not None:
+                try:
+                    self._check_liveness()
+                # hvdlint: ignore[exception-discipline] -- the liveness
+                # sweep must never kill the discovery loop; a failed pass
+                # only delays detection by one tick
+                except Exception as e:
+                    _log.warning(f"liveness check failed: {e}")
             self._shutdown.wait(DISCOVER_HOSTS_FREQUENCY_SECS)
+
+    # -- liveness plane (docs/liveness.md) -----------------------------------
+
+    def _instant(self, name: str, args: dict) -> None:
+        if self._timeline is not None:
+            self._timeline.instant(name, args)
+
+    def _check_liveness(self):
+        """One liveness pass, piggybacked on the discovery tick: fold KV
+        heartbeats and drain markers into the tracker, escalate, act on
+        evictions. Detection latency is bounded by the liveness timeout
+        plus one tick — comfortably inside the 2x-timeout acceptance
+        window the chaos tests assert."""
+        to_evict = []
+        with self._lock:
+            tracker = self._liveness
+            active = dict(self._workers_active)
+            # A worker enters the tracker at its FIRST beat, not at
+            # spawn: liveness defends a previously-live worker against
+            # silent death; a worker still importing frameworks or
+            # loading a checkpoint has never beaten and is the elastic
+            # start-timeout's job — evicting it for slow startup would
+            # blacklist healthy hosts on oversubscribed machines.
+            for key in list(tracker.members()):
+                if key not in active:
+                    tracker.forget(key)
+                    self._hb_seen.pop(key, None)
+            for key, handle in active.items():
+                host, slot = key
+                kv_key = f"{host}:{slot}"
+                beat = self._rendezvous.get(HEARTBEAT_SCOPE, kv_key)
+                # Value-change detection, never clock comparison: the
+                # driver's clock and the workers' never meet, so a beat
+                # is "the counter moved", timed by the driver's own clock.
+                if beat is not None and beat != self._hb_seen.get(key):
+                    self._hb_seen[key] = beat
+                    tracker.beat(key)
+                for phase, name in (("begin", _timeline.DRAIN_BEGIN),
+                                    ("commit", _timeline.DRAIN_COMMIT)):
+                    marker = (key, phase)
+                    if marker in self._drain_seen:
+                        continue
+                    if self._rendezvous.get(DRAIN_SCOPE,
+                                            f"{kv_key}.{phase}") is None:
+                        continue
+                    self._drain_seen[marker] = b"1"
+                    handle.draining = True
+                    tracker.mark_draining(key)
+                    self._instant(name, {"host": host, "slot": slot,
+                                         "phase": phase})
+                    _log.info(
+                        f"elastic: worker {host}:{slot} drain {phase}")
+            for ev in tracker.check():
+                host, slot = ev.member
+                args = {"host": host, "slot": slot,
+                        "silence_ms": round(ev.silence_ms)}
+                if ev.kind == _liveness.MISS:
+                    self._instant(_timeline.HEARTBEAT_MISS, args)
+                    _log.debug(f"elastic: heartbeat miss from "
+                               f"{host}:{slot}")
+                elif ev.kind == _liveness.SUSPECT_EVENT:
+                    self._instant(_timeline.RANK_SUSPECT, args)
+                    _log.warning(
+                        f"elastic: worker {host}:{slot} is SUSPECT "
+                        f"({ev.silence_ms:.0f}ms silent)")
+                elif ev.kind == _liveness.EVICT:
+                    self._instant(_timeline.RANK_EVICTED, args)
+                    _log.warning(
+                        f"elastic: worker {host}:{slot} EVICTED after "
+                        f"{ev.silence_ms:.0f}ms of silence")
+                    handle = self._workers_active.get(ev.member)
+                    if handle is not None:
+                        handle.evicted = True
+                        to_evict.append(ev.member)
+        # Act outside the lock: terminating the worker and nudging the
+        # survivors both cross process/network boundaries.
+        for key in to_evict:
+            with self._lock:
+                handle = self._workers_active.get(key)
+            if handle is not None:
+                handle.event.set()  # terminate; exit routes to failure
+        if to_evict:
+            self._notify_survivors(exclude=set(to_evict))
+
+    def _notify_survivors(self, exclude=()):
+        """Membership-change nudge to every live worker NOT in
+        ``exclude`` — survivors raise ``HostsUpdatedInterrupt`` at their
+        next commit instead of wedging on a collective with the evicted
+        rank."""
+        factory = self._notify_client_factory
+        if factory is None:
+            return
+        ts = time.time()
+        with self._lock:
+            keys = [k for k in self._assignments if k not in set(exclude)]
+        for hostname, local_rank in keys:
+            try:
+                client = factory(hostname, local_rank)
+                if client is not None:
+                    client.notify_hosts_updated(ts)
+            # hvdlint: ignore[exception-discipline] -- best-effort nudge:
+            # an unreachable survivor learns of the change when its
+            # collective fails, exactly as before the liveness plane
+            except Exception as e:
+                _log.debug(f"could not notify {hostname}:{local_rank}: {e}")
+
+    def _consume_drain_marker(self, host: str, slot: int) -> bool:
+        """At worker exit: True when the worker completed its drain
+        protocol (commit marker present; begin alone is an uncommitted
+        drain = a crash). Consumes the markers so a re-staffed slot's
+        next life starts unmarked. A fast drain can finish between two
+        discovery ticks — any phase the liveness sweep never saw gets
+        its timeline instant emitted here, so DRAIN_BEGIN/DRAIN_COMMIT
+        are recorded deterministically, not only when the 1 s poll wins
+        the race."""
+        if not hasattr(self._rendezvous, "get"):
+            return False
+        kv_key = f"{host}:{slot}"
+        # Also retire the slot's heartbeat key: a re-staffed slot must
+        # not inherit its previous life's counter — the first liveness
+        # tick would read the stale value as a fresh beat and start the
+        # new worker's silence clock while it is still importing
+        # frameworks (exactly the slow-startup eviction the first-beat
+        # admission rule exists to prevent).
+        if hasattr(self._rendezvous, "delete"):
+            self._rendezvous.delete(HEARTBEAT_SCOPE, kv_key)
+        self._hb_seen.pop((host, slot), None)
+        committed = False
+        for phase, name in (("begin", _timeline.DRAIN_BEGIN),
+                            ("commit", _timeline.DRAIN_COMMIT)):
+            present = self._rendezvous.get(
+                DRAIN_SCOPE, f"{kv_key}.{phase}") is not None
+            if phase == "commit":
+                committed = present
+            if present and ((host, slot), phase) not in self._drain_seen:
+                self._instant(name, {"host": host, "slot": slot,
+                                     "phase": phase})
+                _log.info(f"elastic: worker {host}:{slot} drain {phase}")
+            if hasattr(self._rendezvous, "delete"):
+                self._rendezvous.delete(DRAIN_SCOPE, f"{kv_key}.{phase}")
+            self._drain_seen.pop(((host, slot), phase), None)
+        return committed
 
     def _on_hosts_updated(self):
         # Gate on the *plan* actually changing, not merely the host set: a
@@ -175,22 +343,7 @@ class ElasticDriver:
                        "and staffed; nothing to do")
             return
         _log.info("elastic: host set changed; notifying workers")
-        ts = time.time()
-        with self._lock:
-            keys = list(self._assignments.keys())
-        factory = self._notify_client_factory
-        if factory is not None:
-            for hostname, local_rank in keys:
-                try:
-                    client = factory(hostname, local_rank)
-                    if client is not None:
-                        client.notify_hosts_updated(ts)
-                # hvdlint: ignore[exception-discipline] -- best-effort
-                # nudge: an unreachable worker learns of the new plan at
-                # its next rendezvous anyway
-                except Exception as e:
-                    _log.debug(
-                        f"could not notify {hostname}:{local_rank}: {e}")
+        self._notify_survivors()
         # Regrow/shrink the plan so the rendezvous the interrupted workers
         # re-fetch reflects the new host set, and spawn workers on any new
         # slots (parity: driver.py:185-213 + _activate_workers on update).
@@ -336,16 +489,31 @@ class ElasticDriver:
                     f"launch: {e}")
                 code = 1
             host, lslot = slot.hostname, slot.local_rank
-            # Classify under the lock: `removed` is only honored while this
-            # worker's own handle is still the registered one (a respawned
-            # slot carries a fresh handle).
+            # Classify under the lock: `removed`/`evicted` are only
+            # honored while this worker's own handle is still the
+            # registered one (a respawned slot carries a fresh handle).
             with self._lock:
-                removed = handle.removed and \
-                    self._workers_active.get(key) is handle
+                current = self._workers_active.get(key) is handle
+                removed = handle.removed and current
+                evicted = handle.evicted and current
+            drained = self._consume_drain_marker(host, lslot)
             if removed:
                 # Deliberately terminated when its slot left the plan —
                 # neither a success nor a host-blacklisting failure.
                 self.on_worker_removed(host, lslot)
+            elif drained:
+                # Completed the preemption drain protocol (commit marker
+                # in the KV): clean departure, zero strikes, but the
+                # world still shrinks and re-activates. Checked before
+                # `evicted` — a drain whose farewell lost the race with
+                # the liveness eviction is still a clean drain.
+                self._worker_registry.record_drained(host, lslot)
+            elif evicted:
+                # The liveness plane gave up on this worker (silence past
+                # the timeout) and terminated it; its exit code is
+                # whatever the kill produced — the classification is
+                # failure regardless (docs/liveness.md).
+                self._worker_registry.record_failure(host, lslot)
             elif code == 0:
                 self._worker_registry.record_success(host, lslot)
             else:
@@ -393,7 +561,7 @@ class ElasticDriver:
         if still_active == 0:
             self._finish()
             return
-        if state == FAILURE:
+        if state in (FAILURE, DRAINED):
             # Try to resume on the remaining hosts with as many slots as
             # are available (up to the requested/max np); workers meanwhile
             # hit HorovodInternalError and wait in their retry loop for the

@@ -13,6 +13,12 @@ from typing import Dict, Tuple
 READY = "READY"
 SUCCESS = "SUCCESS"
 FAILURE = "FAILURE"
+# A graceful preemption departure (docs/liveness.md): the worker
+# committed its elastic state and announced DRAIN before leaving. The
+# driver re-activates the shrunk world like a failure, but the host is
+# quarantined WITHOUT a blacklist strike and the round's exit code stays
+# clean — preemption is the platform's fault, not the host's.
+DRAINED = "DRAINED"
 
 
 class WorkerStateRegistry:
@@ -41,6 +47,14 @@ class WorkerStateRegistry:
         # host set or the failed host lands back in the new plan.
         self._host_manager.blacklist(host)
         self._record(host, slot, FAILURE)
+
+    def record_drained(self, host: str, slot: int) -> None:
+        """A graceful preemption departure: quarantine the host (it is
+        going away — respawning onto it would race its death) with ZERO
+        blacklist strikes, then re-activate the shrunk world exactly
+        like the failure path does."""
+        self._host_manager.quarantine(host)
+        self._record(host, slot, DRAINED)
 
     def _record(self, host: str, slot: int, state: str) -> None:
         with self._lock:

@@ -23,8 +23,38 @@ from ..http.http_client import put_data_into_kvstore, read_data_from_kvstore
 
 RANK_SCOPE = "rank"
 CONTROLLER_SCOPE = "controller"
+# Liveness plane (docs/liveness.md): workers push heartbeats under
+# /heartbeat/<hostname>:<local_rank>; a draining worker writes its
+# protocol phase under /drain/<hostname>:<local_rank>.
+HEARTBEAT_SCOPE = "heartbeat"
+DRAIN_SCOPE = "drain"
 
 SlotLayout = Tuple[int, int, int, int, int, int]
+
+
+def put_heartbeat(addr: str, port: int, hostname: str, local_rank: int,
+                  seq: int) -> None:
+    """One worker heartbeat into the rendezvous KV. Short timeout: a
+    beat that cannot land within a fraction of the liveness window is
+    better dropped than queued — stale heartbeats defend nobody."""
+    put_data_into_kvstore(addr, port, HEARTBEAT_SCOPE,
+                          f"{hostname}:{local_rank}",
+                          str(seq).encode(), timeout=2.0)
+
+
+def announce_drain(addr: str, port: int, hostname: str, local_rank: int,
+                   phase: str) -> None:
+    """Publish this worker's drain-protocol phase ("begin" then
+    "commit"). Phase-keyed (``<host>:<slot>.<phase>``), not one mutable
+    value: the two phases land milliseconds apart and the driver polls
+    at ~1 s, so a single key would usually show only "commit" and the
+    DRAIN_BEGIN instant would be lost. The driver's liveness monitor
+    turns each into a timeline instant, and the commit marker at exit
+    reclassifies the departure as DRAINED — zero blacklist strikes
+    (docs/liveness.md)."""
+    put_data_into_kvstore(addr, port, DRAIN_SCOPE,
+                          f"{hostname}:{local_rank}.{phase}", b"1",
+                          timeout=2.0)
 
 
 def fetch_slot_info(addr: str, port: int, hostname: str, local_rank: int,

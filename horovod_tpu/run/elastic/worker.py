@@ -13,11 +13,58 @@ posts into the process-local elastic mailbox, which surfaces as
 from __future__ import annotations
 
 import pickle
+import threading
 from typing import List, Optional, Tuple
 
 from ...common import config as _config
+from ...common import faults as _faults
+from ...common import logging as _log
 from ..common.util import network, secret
 from ..http.http_client import put_data_into_kvstore, read_data_from_kvstore
+
+
+class _HeartbeatSender(threading.Thread):
+    """Worker-side liveness heartbeat (docs/liveness.md): one KV put per
+    ``HOROVOD_HEARTBEAT_MS`` under ``/heartbeat/<hostname>:<local_rank>``.
+    The driver's liveness monitor watches the value change and escalates
+    silence miss → SUSPECT → EVICT, so a dead or partitioned worker is
+    detected without waiting for a collective to wedge.
+
+    A failed beat is skipped, never fatal: heartbeats defend the world
+    against THIS process dying, so this thread dying on a transient KV
+    hiccup would be the tail wagging the dog. The ``control.heartbeat``
+    fault seam supports ``kind=drop_conn`` (a dropped beat) and
+    ``kind=delay_ms`` (a late beat) for the chaos tests.
+    """
+
+    def __init__(self, addr: str, port: int, hostname: str,
+                 local_rank: int, interval_ms: int):
+        super().__init__(daemon=True, name="hvd-heartbeat")
+        self._addr = addr
+        self._port = port
+        self._hostname = hostname
+        self._local_rank = local_rank
+        self._interval_s = max(interval_ms, 1) / 1000.0
+        self._stop_beating = threading.Event()
+
+    def run(self):
+        from .rendezvous import put_heartbeat
+
+        seq = 0
+        while not self._stop_beating.wait(self._interval_s):
+            seq += 1
+            try:
+                _faults.point("control.heartbeat")
+                put_heartbeat(self._addr, self._port, self._hostname,
+                              self._local_rank, seq)
+            except OSError:
+                # Includes the drop_conn fault's ConnectionResetError and
+                # real KV hiccups: drop the beat, keep beating. Persistent
+                # failure IS the signal — the driver sees the silence.
+                continue
+
+    def stop(self):
+        self._stop_beating.set()
 
 
 class HostsUpdatedRequest:
@@ -54,6 +101,7 @@ class WorkerNotificationManager:
 
     def __init__(self):
         self._service: Optional[WorkerNotificationService] = None
+        self._heartbeat: Optional[_HeartbeatSender] = None
 
     def init(self) -> None:
         if self._service is not None:
@@ -66,12 +114,11 @@ class WorkerNotificationManager:
         key = base64.b64decode(key_b64)
         self._service = WorkerNotificationService(key)
         if _config.preempt_signal_spec():
-            # Opt-in: convert TPU-VM preemption signals into graceful
-            # re-rendezvous at the next commit (see
+            # Opt-in: convert TPU-VM preemption signals into the graceful
+            # drain protocol at the next commit (see
             # elastic.state.register_preemption_signal). Signal handlers
             # can only be installed on the main thread; degrade to a
             # warning when init runs elsewhere rather than failing init.
-            from ...common import logging as _log
             from ...elastic.state import register_preemption_signal
 
             try:
@@ -92,8 +139,19 @@ class WorkerNotificationManager:
             put_data_into_kvstore(
                 addr, port, "workers", f"{hostname}:{local_rank}",
                 pickle.dumps(self._service.addresses()))
+            hb_ms = _config.heartbeat_ms()
+            if hb_ms > 0 and self._heartbeat is None:
+                # Liveness plane armed (HOROVOD_HEARTBEAT_MS > 0; default
+                # off — no thread, no KV traffic, byte-identical to the
+                # pre-liveness worker).
+                self._heartbeat = _HeartbeatSender(
+                    addr, port, hostname, local_rank, hb_ms)
+                self._heartbeat.start()
 
     def shutdown(self) -> None:
+        if self._heartbeat is not None:
+            self._heartbeat.stop()
+            self._heartbeat = None
         if self._service is not None:
             self._service.shutdown()
             self._service = None

@@ -126,6 +126,12 @@ class RendezvousServer:
         with self._server.kvstore_lock:
             return self._server.kvstore.get(scope, {}).get(key)
 
+    def delete(self, scope: str, key: str) -> None:
+        """Driver-side key removal (the liveness monitor consumes drain
+        markers so a re-staffed slot's next life starts unmarked)."""
+        with self._server.kvstore_lock:
+            self._server.kvstore.get(scope, {}).pop(key, None)
+
     def stop_server(self) -> None:
         if self._server is not None:
             self._server.shutdown()

@@ -53,7 +53,7 @@ from .mpi_ops import (  # noqa: F401
     size,
     synchronize,
 )
-from .. import ring_traffic, stall_report  # noqa: F401
+from .. import liveness_report, ring_traffic, stall_report  # noqa: F401
 from .compression import Compression  # noqa: F401
 from .functions import (  # noqa: F401
     allgather_object,

@@ -9,6 +9,13 @@ collective, sharding, and fusion path is exercised.
 
 import os
 
+# Orphan-sweep tag (see _orphan_world_sweep below): every subprocess this
+# test session spawns — proc_harness worlds, elastic launches, their
+# grandchildren — inherits this env var, so leaked workers are findable
+# by scanning /proc at session end. Set before anything forks.
+_WORLD_TAG = f"hvdtw-{os.getpid()}"
+os.environ["HVD_TEST_WORLD_TAG"] = _WORLD_TAG
+
 # The ambient environment may pin JAX_PLATFORMS to the real TPU plugin and
 # import jax at interpreter startup (sitecustomize), so setting env vars
 # here is too late; jax.config still works because backends initialize
@@ -119,3 +126,67 @@ def hvd():
     hvd.init()
     yield hvd
     hvd.shutdown()
+
+
+def _find_tagged_orphans():
+    """Processes (other than this one) whose environment carries this
+    session's world tag — i.e. test-spawned workers that outlived their
+    test. Returns [(pid, cmdline)]."""
+    needle = f"HVD_TEST_WORLD_TAG={_WORLD_TAG}".encode()
+    me = os.getpid()
+    orphans = []
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit() or int(entry) == me:
+            continue
+        try:
+            with open(f"/proc/{entry}/environ", "rb") as f:
+                if needle not in f.read():
+                    continue
+            with open(f"/proc/{entry}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode(
+                    errors="replace").strip()
+        except OSError:
+            continue  # raced an exit, or not ours to read
+        orphans.append((int(entry), cmd))
+    return orphans
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _orphan_world_sweep():
+    """Fail the session LOUDLY — listing PIDs — if chaos/elastic tests
+    leaked worker processes (docs/liveness.md; a known tier-1 killer on
+    shared boxes: an orphaned world squats its controller port and holds
+    CPU, wedging every later multi-process test). The leaked processes
+    are killed so one bad test doesn't poison the machine, but the
+    failure is still raised: a leak is a bug in the test's teardown, not
+    something to mop up silently."""
+    yield
+    import signal as _signal
+    import time as _time
+
+    orphans = _find_tagged_orphans()
+    if not orphans:
+        return
+    my_pgid = os.getpgid(0)
+    for pid, _ in orphans:
+        try:
+            pgid = os.getpgid(pid)
+        except OSError:
+            pgid = my_pgid  # already gone / unknowable: kill pid only
+        try:
+            if pgid != my_pgid:
+                os.killpg(pgid, _signal.SIGKILL)
+            else:
+                # The orphan shares pytest's own process group (a plain
+                # Popen child, no setsid): killpg here would SIGKILL the
+                # whole test session before this report ever surfaced.
+                os.kill(pid, _signal.SIGKILL)
+        except OSError:
+            pass
+    _time.sleep(0.2)
+    listing = "\n".join(f"  pid {pid}: {cmd}" for pid, cmd in orphans)
+    raise AssertionError(
+        f"orphaned test workers leaked by this session (now killed):\n"
+        f"{listing}\n"
+        "A chaos/elastic test failed to tear down its world — fix its "
+        "cleanup (see tests/proc_harness.py group teardown).")

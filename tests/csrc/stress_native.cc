@@ -33,6 +33,7 @@
 #include <thread>
 #include <vector>
 
+#include "../../horovod_tpu/csrc/hvd/controller.h"
 #include "../../horovod_tpu/csrc/hvd/ring_ops.h"
 
 // The extern "C" surface of operations.cc (no installed header — the
@@ -43,7 +44,10 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
              int coordinator_port, const char* my_host, double cycle_time_ms,
              long long fusion_threshold, int cache_capacity,
              double stall_warning_sec, double stall_shutdown_sec,
-             int stall_check_enabled);
+             int stall_check_enabled, int heartbeat_ms,
+             int liveness_timeout_ms);
+void hvd_drain();
+int hvd_liveness_report(char* buf, int cap);
 void hvd_shutdown();
 long long hvd_enqueue(const char* name, int op, int reduce_op, int dtype,
                       const long long* shape, int ndim, void* data,
@@ -135,6 +139,7 @@ void Monitor(std::atomic<bool>* stop) {
     sink += hvd_cross_rank() + hvd_cross_size();
     sink += hvd_last_joined();
     sink += hvd_stall_report(buf, sizeof(buf));
+    sink += hvd_liveness_report(buf, sizeof(buf));
   }
   (void)sink;
 }
@@ -162,7 +167,8 @@ void RunWorld(int world, int submitters, int iters) {
                     "127.0.0.1", /*port=*/0, "127.0.0.1",
                     /*cycle_time_ms=*/1.0, /*fusion_threshold=*/1 << 20,
                     /*cache_capacity=*/64, /*stall_warning_sec=*/60.0,
-                    /*stall_shutdown_sec=*/0.0, /*stall_check=*/0);
+                    /*stall_shutdown_sec=*/0.0, /*stall_check=*/0,
+                    /*heartbeat_ms=*/2, /*liveness_timeout_ms=*/500);
   CHECK(rc == 0, "hvd_init");
   if (rc != 0) return;
 
@@ -221,6 +227,72 @@ void RingPhase() {
   poll.join();
 }
 
+// Liveness plane under TSan (docs/liveness.md): a real in-process
+// 2-rank TcpController world with heartbeats armed — the worker's
+// heartbeat thread races the cycle thread's sends (shared send mutex),
+// the coordinator's poll-gather, and Finalize. Even rounds end with the
+// shutdown/drain handshake; odd rounds tear the worker down abruptly
+// mid-protocol so the coordinator exercises the connection-closed
+// eviction path while the heartbeat thread is still beating.
+void LivenessControllerPhase() {
+  for (int round = 0; round < 6 && failures == 0; ++round) {
+    int port = 0;
+    {
+      hvd::Listener probe;
+      if (!probe.Listen(0)) {
+        CHECK(false, "liveness phase: port probe");
+        return;
+      }
+      port = probe.port();
+    }  // closed: TcpController re-binds it (benign TOCTOU in a test)
+    hvd::ControllerConfig c0;
+    c0.rank = 0;
+    c0.size = 2;
+    c0.coordinator_port = port;
+    c0.heartbeat_ms = 1;
+    c0.liveness_timeout_ms = 2000;
+    hvd::ControllerConfig c1 = c0;
+    c1.rank = 1;
+    hvd::TcpController coord(c0, /*data_port=*/1, "127.0.0.1");
+    hvd::TcpController worker(c1, /*data_port=*/2, "127.0.0.1");
+    std::thread ct([&] {
+      if (!coord.Initialize().ok()) {
+        CHECK(false, "liveness phase: coordinator init");
+        return;
+      }
+      bool world_down = false;
+      for (int cyc = 0; cyc < 200 && !world_down; ++cyc) {
+        coord.ComputeResponseList({}, false, false, &world_down);
+      }
+      CHECK(world_down, "liveness phase: coordinator saw departure");
+      coord.Finalize();
+    });
+    std::thread wt([&] {
+      if (!worker.Initialize().ok()) {
+        CHECK(false, "liveness phase: worker init");
+        return;
+      }
+      bool world_down = false;
+      for (int cyc = 0; cyc < 10 && !world_down; ++cyc) {
+        worker.ComputeResponseList({}, false, false, &world_down);
+      }
+      if (round % 2 == 0 && !world_down) {
+        // Clean departure: drain on even rounds (the farewell frame
+        // races the heartbeat thread on send_mu_).
+        worker.ComputeResponseList({}, true, true, &world_down);
+      }
+      // Odd rounds: Finalize with no handshake — teardown races the
+      // heartbeat thread; the coordinator sees the close and evicts.
+      worker.Finalize();
+    });
+    wt.join();
+    ct.join();
+    // Drain the liveness streams so the buffers' locking runs too.
+    coord.TakeLivenessReport();
+    worker.TakeLivenessReport();
+  }
+}
+
 }  // namespace
 
 int main() {
@@ -228,6 +300,7 @@ int main() {
     RunWorld(world, /*submitters=*/3, /*iters=*/150);
   }
   if (failures == 0) RingPhase();
+  if (failures == 0) LivenessControllerPhase();
   if (failures) return 1;
   std::puts("STRESS_OK");
   return 0;

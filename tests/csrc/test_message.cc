@@ -235,6 +235,43 @@ int main() {
     }
   }
 
+  // 9. Liveness-plane wire contract (docs/liveness.md): the request
+  // list's flags byte carries shutdown (bit0) and drain (bit1)
+  // independently; a pre-liveness frame (bool 0/1) parses identically;
+  // heartbeat frames are recognized and never collide with request or
+  // response magic.
+  {
+    struct FlagCase {
+      bool shutdown, drain;
+    } fcases[] = {{false, false}, {true, false}, {false, true},
+                  {true, true}};
+    for (const auto& c : fcases) {
+      std::string fw = SerializeRequestList({MakeRequest(0)}, {},
+                                            c.shutdown, c.drain);
+      std::vector<Request> fr;
+      std::vector<uint32_t> ids;
+      bool sd = false, dr = false;
+      CHECK(DeserializeRequestList(fw, &fr, &ids, &sd, &dr),
+            "flags roundtrip parses");
+      CHECK(sd == c.shutdown, "shutdown flag roundtrip");
+      CHECK(dr == c.drain, "drain flag roundtrip");
+      // Drain-agnostic caller (nullptr) still reads shutdown right.
+      sd = !c.shutdown;
+      CHECK(DeserializeRequestList(fw, &fr, &ids, &sd) &&
+                sd == c.shutdown,
+            "drain-agnostic parse keeps shutdown");
+    }
+    std::string hb = HeartbeatFrame();
+    CHECK(IsHeartbeatFrame(hb), "heartbeat frame recognized");
+    CHECK(!IsHeartbeatFrame(wire), "request frame is not a heartbeat");
+    CHECK(!IsHeartbeatFrame(std::string()), "empty frame not heartbeat");
+    std::vector<Request> hr;
+    std::vector<uint32_t> hids;
+    bool hsd = false;
+    CHECK(!DeserializeRequestList(hb, &hr, &hids, &hsd),
+          "heartbeat frame is not a parsable request list");
+  }
+
   if (failures) return 1;
   std::puts("MESSAGE_CODEC_OK");
   return 0;

@@ -158,7 +158,7 @@ _ELASTIC_TRAIN = textwrap.dedent("""
 
 
 def _launch_elastic(tmp_path, hosts_text, env_extra, np_args,
-                    timeout=300):
+                    timeout=300, script_text=None):
     pytest.importorskip("torch")
     discover = tmp_path / "discover.sh"
     hosts = tmp_path / "hosts.txt"
@@ -167,7 +167,7 @@ def _launch_elastic(tmp_path, hosts_text, env_extra, np_args,
     discover.chmod(0o755)
     log = tmp_path / "chaos.log"
     script = tmp_path / "train.py"
-    script.write_text(_ELASTIC_TRAIN)
+    script.write_text(script_text or _ELASTIC_TRAIN)
 
     env = dict(os.environ)
     env["HVD_REPO"] = REPO
@@ -185,11 +185,19 @@ def _launch_elastic(tmp_path, hosts_text, env_extra, np_args,
 
 
 def test_chaos_kill_rank1_blacklists_host_and_completes(tmp_path):
-    """THE acceptance chaos run: HOROVOD_FAULT_SPEC hard-kills rank 1
-    mid-step (no hand-injected os._exit in the training script — the
-    fault plane does it). Deterministically: the survivors restore the
-    last committed state, the driver blacklists rank 1's host after N=1
-    strikes (permanent), and training completes with the shrunk world."""
+    """THE acceptance chaos run, doubling as liveness acceptance A
+    (docs/liveness.md): HOROVOD_FAULT_SPEC hard-kills rank 1 mid-step
+    (no hand-injected os._exit in the training script — the fault plane
+    does it) with heartbeats ARMED. Deterministically: the native
+    liveness plane records the eviction, the survivors restore the last
+    committed state, the driver blacklists rank 1's host after N=1
+    strikes (permanent), and training completes with the shrunk world.
+    (One launch covers both acceptances on purpose: each elastic e2e
+    costs ~40 s of tier-1 budget; the heartbeats-DISABLED e2e path keeps
+    its own coverage via test_chaos_hier_leader_death_recovers and every
+    other multi-process test in the suite. The deterministic 2x-timeout
+    eviction-latency bound lives in tests/test_liveness.py on the fake
+    clock.)"""
     proc, log = _launch_elastic(
         tmp_path,
         # Two distinct "hosts", both locally launchable: localhost is
@@ -201,6 +209,13 @@ def test_chaos_kill_rank1_blacklists_host_and_completes(tmp_path):
             "HOROVOD_FAULT_SPEC":
                 "host_world.enqueue:rank=1:step=8:kind=exit",
             "HOROVOD_ELASTIC_BLACKLIST_STRIKES": "1",
+            # Liveness plane armed (acceptance A). Generous timeout: on
+            # this oversubscribed box a healthy worker can stall for
+            # seconds; the kill is detected by the socket close (fast
+            # path), not the timeout, so the bound only guards against
+            # false eviction.
+            "HOROVOD_HEARTBEAT_MS": "100",
+            "HOROVOD_LIVENESS_TIMEOUT_MS": "30000",
             "CHAOS_TARGET": "10",
         },
         ["-np", "2", "--min-np", "1", "--max-np", "2"])
@@ -210,6 +225,9 @@ def test_chaos_kill_rank1_blacklists_host_and_completes(tmp_path):
     # Survivor finished every batch.
     assert "DONE RANK 0 BATCHES 10" in text, text
     assert "CHAOS_RANK_0_DONE_10" in proc.stdout, out
+    # The liveness plane observed the death: the coordinator's event
+    # stream records the eviction (connection closed by the hard kill).
+    assert "EVICT rank=1" in out, out
     # The dead host was struck out, permanently, after exactly N=1.
     assert "host 127.0.0.1 blacklisted (strike 1/1, permanent)" in out, out
     # Training spanned both worlds: size 2 before the kill, size 1 after.
@@ -288,6 +306,81 @@ def test_chaos_strike_two_lives_then_permanent(tmp_path):
     assert "host 127.0.0.1 blacklisted (strike 2/2, permanent)" in out, out
     assert "returns from blacklist cooldown on parole" in out, out
     assert "DONE RANK 1" not in text, text
+
+
+# ---- liveness plane acceptance (docs/liveness.md) --------------------------
+
+
+# NOTE: _ELASTIC_TRAIN is already dedented — the loop body sits at
+# 8 spaces, not the 12 it has in this file's source.
+_DRAIN_TRAIN = _ELASTIC_TRAIN.replace(
+    "        time.sleep(SLEEP)\n        state.commit()",
+    """        time.sleep(SLEEP)
+        if state.batch == 5 and hvd.rank() == 1 and \\
+                os.environ.get("CHAOS_SELF_PREEMPT"):
+            # The platform preempts this host: SIGTERM, as a TPU-VM
+            # maintenance notice arrives. The registered handler
+            # converts it into the drain protocol at this commit.
+            import signal as _signal
+            os.kill(os.getpid(), _signal.SIGTERM)
+        state.commit()""")
+assert "CHAOS_SELF_PREEMPT" in _DRAIN_TRAIN  # replace target must match
+
+
+def test_chaos_sigterm_graceful_drain_zero_strikes(tmp_path):
+    """Liveness acceptance B (preemption): SIGTERM to rank 1 mid-run
+    triggers the graceful drain — elastic state committed at the drain
+    boundary, DRAIN_BEGIN/DRAIN_COMMIT observed in the launcher-side
+    driver timeline, survivors resume from the drained commit and finish
+    every batch, and the departed host accrues ZERO blacklist strikes
+    (quarantined, not struck)."""
+    timeline = tmp_path / "tl.json"
+    proc, log = _launch_elastic(
+        tmp_path,
+        "localhost:1\n127.0.0.1:1\n",
+        {
+            "CHAOS_SELF_PREEMPT": "1",
+            "CHAOS_TARGET": "10",
+            "HOROVOD_ELASTIC_PREEMPT_SIGNAL": "SIGTERM",
+            "HOROVOD_HEARTBEAT_MS": "100",
+            # Generous timeout: this 2-core box stalls worker processes
+            # for seconds at a time under jax re-init; SUSPECT noise is
+            # fine, a false EVICT would flake the zero-strike assertion.
+            "HOROVOD_LIVENESS_TIMEOUT_MS": "60000",
+            # Generous grace: an oversubscribed CI box must not turn a
+            # clean drain into a watchdog force-exit.
+            "HOROVOD_DRAIN_GRACE_MS": "60000",
+            # Only ONE strike allowed — any accounting mistake (drain
+            # charged as a crash) would blacklist permanently and show
+            # up loudly in the assertions below.
+            "HOROVOD_ELASTIC_BLACKLIST_STRIKES": "1",
+            "HOROVOD_LOG_LEVEL": "info",
+            "HOROVOD_TIMELINE": str(timeline),
+        },
+        ["-np", "2", "--min-np", "1", "--max-np", "2"],
+        script_text=_DRAIN_TRAIN)
+    out = proc.stdout + proc.stderr
+    text = _read(log)
+    assert proc.returncode == 0, out + text
+    # Survivor resumed from the drained commit and finished everything.
+    assert "DONE RANK 0 BATCHES 10" in text, text
+    assert "SIZE 2" in text and "SIZE 1" in text, text
+    assert "DONE RANK 1" not in text, text
+    # The drain really ran: worker-side protocol + driver-side marker
+    # consumption, and zero strikes for the departed host.
+    assert "preemption drain complete; exiting 0" in out, out
+    assert "drained; quarantined" in out, out
+    assert "zero strikes" in out, out
+    assert "blacklisted (strike" not in out, out
+    # DRAIN frames landed in the launcher-side driver timeline.
+    import json as _json
+
+    driver_tl = tmp_path / "tl.json.driver.json"
+    assert driver_tl.exists(), list(tmp_path.iterdir())
+    names = [ev.get("name") for ev in _json.load(open(driver_tl))]
+    assert "DRAIN_BEGIN" in names, names
+    assert "DRAIN_COMMIT" in names, names
+    assert "RANK_EVICTED" not in names, names
 
 
 # ---- launcher-side cleanup proofs ------------------------------------------

@@ -1,0 +1,536 @@
+"""Liveness plane: deterministic fake-clock proofs (docs/liveness.md).
+
+The chaos acceptance — "survivors receive the eviction notice and begin
+re-rendezvous within 2x ``HOROVOD_LIVENESS_TIMEOUT_MS``" — is asserted
+HERE with an injectable clock and zero real sleeping; the real
+2-process worlds live in ``tests/test_chaos.py``. Also home to the
+driver-monitor unit proofs (timeline instants, eviction accounting,
+drain classification) and the disabled-by-default regression.
+"""
+
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.common import config as _config
+from horovod_tpu.common import liveness as _liveness
+from horovod_tpu.common import timeline as _timeline
+from horovod_tpu.common.exceptions import (HostsUpdatedInterrupt,
+                                           PreemptionInterrupt)
+from horovod_tpu.common.liveness import (ALIVE, DRAINED, DRAINING, EVICTED,
+                                         SUSPECT, LivenessTracker)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, seconds):
+        self.t += seconds
+
+
+# ---- the state machine, deterministically ----------------------------------
+
+
+def test_tracker_escalation_thresholds_exact():
+    """miss at 2x heartbeat, SUSPECT at timeout/2, EVICT at timeout —
+    each at its exact fake-clock boundary, nothing earlier."""
+    clk = FakeClock()
+    t = LivenessTracker(heartbeat_ms=100, timeout_ms=10000, clock=clk)
+    t.watch("w")
+    assert t.state("w") == ALIVE
+
+    clk.advance(0.199)  # just under 2 beats
+    assert t.check() == []
+    clk.advance(0.002)  # past 2 beats: one MISS, informational
+    events = t.check()
+    assert [e.kind for e in events] == [_liveness.MISS]
+    assert t.state("w") == ALIVE
+    assert t.check() == []  # MISS fires once per quiet spell
+
+    clk.advance(5.0 - 0.201 + 0.001)  # past timeout/2 (fp-safe margin)
+    events = t.check()
+    assert [e.kind for e in events] == [_liveness.SUSPECT_EVENT]
+    assert t.state("w") == SUSPECT
+
+    clk.advance(4.998)  # ~10.0s silent: not yet
+    assert t.check() == []
+    clk.advance(0.003)  # past the timeout
+    events = t.check()
+    assert [e.kind for e in events] == [_liveness.EVICT]
+    assert t.state("w") == EVICTED
+    # Terminal: no further events, and a zombie's late beat can't
+    # resurrect the slot.
+    assert t.check() == []
+    assert t.beat("w") is None
+    assert t.state("w") == EVICTED
+
+
+def test_eviction_within_two_timeouts():
+    """THE detection-latency contract: from the moment a rank goes
+    silent, eviction fires within 2x the liveness timeout even with a
+    sparse (1 s, the driver's discovery cadence) polling loop."""
+    clk = FakeClock()
+    t = LivenessTracker(heartbeat_ms=100, timeout_ms=3000, clock=clk)
+    t.watch("w")
+    t.beat("w")
+    silent_from = clk.t
+    evicted_at = None
+    while evicted_at is None:
+        clk.advance(1.0)  # driver tick
+        for ev in t.check():
+            if ev.kind == _liveness.EVICT:
+                evicted_at = clk.t
+        assert clk.t - silent_from < 10.0, "never evicted"
+    assert evicted_at - silent_from <= 2 * 3.0
+
+
+def test_beat_rescues_suspect_with_recover_event():
+    clk = FakeClock()
+    t = LivenessTracker(heartbeat_ms=100, timeout_ms=1000, clock=clk)
+    t.watch("w")
+    clk.advance(0.6)
+    assert [e.kind for e in t.check()] == [_liveness.SUSPECT_EVENT]
+    assert t.state("w") == SUSPECT
+    ev = t.beat("w")
+    assert ev is not None and ev.kind == _liveness.RECOVER
+    assert t.state("w") == ALIVE
+    # The quiet spell reset: escalation restarts from the new beat.
+    clk.advance(0.45)
+    kinds = [e.kind for e in t.check()]
+    assert _liveness.EVICT not in kinds and \
+        _liveness.SUSPECT_EVENT not in kinds
+
+
+def test_draining_exemption_is_bounded_by_drain_grace():
+    """A draining member is exempt from the liveness timeout — but only
+    for 2x the drain grace: a host that died outright mid-drain (no
+    commit, no exit) must not reintroduce the unbounded hang."""
+    clk = FakeClock()
+    t = LivenessTracker(heartbeat_ms=100, timeout_ms=1000,
+                        drain_grace_ms=5000, clock=clk)
+    t.watch("w")
+    t.mark_draining("w")
+    assert t.state("w") == DRAINING
+    clk.advance(9.9)  # way past the liveness timeout, inside 2x grace
+    assert t.check() == []
+    clk.advance(0.2)  # past 2x the drain grace: the drain itself died
+    assert [e.kind for e in t.check()] == [_liveness.EVICT]
+    assert t.state("w") == EVICTED
+    # A drain that COMPLETES is terminal and never evicts.
+    t2 = LivenessTracker(heartbeat_ms=100, timeout_ms=1000,
+                         drain_grace_ms=5000, clock=clk)
+    t2.watch("w")
+    t2.mark_draining("w")
+    t2.mark_drained("w")
+    assert t2.state("w") == DRAINED
+    clk.advance(60.0)
+    assert t2.check() == []
+
+
+def test_stall_suspicion_enters_same_machine():
+    """The stall inspector's escalation path: an externally-sourced
+    suspect marches to eviction on the same clockwork."""
+    clk = FakeClock()
+    t = LivenessTracker(heartbeat_ms=100, timeout_ms=1000, clock=clk)
+    t.watch("w")
+    ev = t.suspect("w", silence_ms=0.0)
+    assert ev is not None and ev.kind == _liveness.SUSPECT_EVENT
+    assert t.state("w") == SUSPECT
+    assert t.suspect("w") is None  # idempotent
+    clk.advance(1.001)
+    assert [e.kind for e in t.check()] == [_liveness.EVICT]
+
+
+def test_forget_and_multiple_members_deterministic_order():
+    clk = FakeClock()
+    t = LivenessTracker(heartbeat_ms=100, timeout_ms=1000, clock=clk)
+    for m in [("b", 1), ("a", 0)]:
+        t.watch(m)
+    clk.advance(2.0)
+    events = t.check()
+    assert [e.member for e in events] == [("a", 0), ("b", 1)]
+    t.forget(("a", 0))
+    assert t.members() == [("b", 1)]
+
+
+# ---- default-off regression ------------------------------------------------
+
+
+def test_liveness_disabled_by_default(monkeypatch):
+    monkeypatch.delenv(_config.HOROVOD_HEARTBEAT_MS, raising=False)
+    assert _config.heartbeat_ms() == 0
+    assert not _liveness.enabled()
+    # The driver arms no tracker without the knob.
+    from horovod_tpu.run.elastic.discovery import FixedHosts
+    from horovod_tpu.run.elastic.driver import ElasticDriver
+
+    class KV:
+        def put(self, *a):
+            pass
+
+        def get(self, *a):
+            return None
+
+        def init(self, *a, **k):
+            pass
+
+    driver = ElasticDriver(KV(), FixedHosts({"h": 1}), min_np=1)
+    assert driver._liveness is None
+
+
+def test_heartbeat_knob_arms_driver_tracker(monkeypatch):
+    monkeypatch.setenv(_config.HOROVOD_HEARTBEAT_MS, "50")
+    monkeypatch.setenv(_config.HOROVOD_LIVENESS_TIMEOUT_MS, "1234")
+    from horovod_tpu.run.elastic.discovery import FixedHosts
+    from horovod_tpu.run.elastic.driver import ElasticDriver
+
+    class KV:
+        def put(self, *a):
+            pass
+
+        def get(self, *a):
+            return None
+
+        def init(self, *a, **k):
+            pass
+
+    driver = ElasticDriver(KV(), FixedHosts({"h": 1}), min_np=1)
+    assert driver._liveness is not None
+    assert driver._liveness.heartbeat_ms == 50
+    assert driver._liveness.timeout_ms == 1234
+
+
+# ---- driver monitor: instants, eviction, drain classification --------------
+
+
+class _RecordingTimeline:
+    def __init__(self):
+        self.instants = []
+
+    def instant(self, name, args=None):
+        self.instants.append((name, dict(args or {})))
+
+
+class _DictKV:
+    """In-memory stand-in for the RendezvousServer KV surface."""
+
+    def __init__(self):
+        self.store = {}
+
+    def init(self, *a, **k):
+        pass
+
+    def put(self, scope, key, value):
+        self.store[(scope, key)] = value
+
+    def get(self, scope, key):
+        return self.store.get((scope, key))
+
+    def delete(self, scope, key):
+        self.store.pop((scope, key), None)
+
+
+def _monitor_driver(monkeypatch, clk):
+    """An ElasticDriver wired for liveness-unit testing: fake KV, fake
+    clock tracker, recording timeline, one active worker (h, 0)."""
+    monkeypatch.setenv(_config.HOROVOD_HEARTBEAT_MS, "100")
+    monkeypatch.setenv(_config.HOROVOD_LIVENESS_TIMEOUT_MS, "3000")
+    from horovod_tpu.run.common.util.hosts import SlotInfo
+    from horovod_tpu.run.elastic.discovery import FixedHosts
+    from horovod_tpu.run.elastic import driver as driver_mod
+
+    kv = _DictKV()
+    tl = _RecordingTimeline()
+    driver = driver_mod.ElasticDriver(kv, FixedHosts({"h": 1}), min_np=1,
+                                      timeline=tl)
+    driver._liveness = LivenessTracker(heartbeat_ms=100, timeout_ms=3000,
+                                       clock=clk)
+    slot = SlotInfo(hostname="h", rank=0, local_rank=0, cross_rank=0,
+                    size=1, local_size=1, cross_size=1)
+    handle = driver_mod._WorkerHandle()
+    driver._assignments = {("h", 0): slot}
+    driver._workers_active = {("h", 0): handle}
+    return driver, kv, tl, handle
+
+
+def test_monitor_emits_instants_and_evicts(monkeypatch):
+    clk = FakeClock()
+    driver, kv, tl, handle = _monitor_driver(monkeypatch, clk)
+    notified = []
+    driver.set_notify_client_factory(
+        lambda h, s: notified.append((h, s)) or None)
+
+    kv.put("heartbeat", "h:0", b"1")
+    driver._check_liveness()  # first sight: beat recorded
+    clk.advance(1.0)
+    kv.put("heartbeat", "h:0", b"2")
+    driver._check_liveness()  # value changed: beat
+    assert tl.instants == []
+
+    # Silence: tick the driver loop on the fake clock until eviction.
+    silent_from = clk.t
+    for _ in range(10):
+        clk.advance(1.0)
+        driver._check_liveness()
+        if handle.evicted:
+            break
+    assert handle.evicted and handle.event.is_set()
+    assert clk.t - silent_from <= 2 * 3.0  # the 2x-timeout contract
+    names = [n for n, _ in tl.instants]
+    assert _timeline.HEARTBEAT_MISS in names
+    assert _timeline.RANK_SUSPECT in names
+    assert _timeline.RANK_EVICTED in names
+    assert names.index(_timeline.RANK_SUSPECT) < \
+        names.index(_timeline.RANK_EVICTED)
+    for _, args in tl.instants:
+        assert args["host"] == "h" and args["slot"] == 0
+        assert isinstance(args["silence_ms"], int)
+    # Survivors (none other active here) were notified, excluding the
+    # evicted member itself.
+    assert ("h", 0) not in notified
+
+
+def test_monitor_drain_markers_emit_instants(monkeypatch):
+    clk = FakeClock()
+    driver, kv, tl, handle = _monitor_driver(monkeypatch, clk)
+    kv.put("drain", "h:0.begin", b"1")
+    driver._check_liveness()
+    kv.put("drain", "h:0.commit", b"1")
+    driver._check_liveness()
+    names = [n for n, _ in tl.instants]
+    assert names == [_timeline.DRAIN_BEGIN, _timeline.DRAIN_COMMIT]
+    assert handle.draining
+    # Draining exempts from eviction despite total silence — within the
+    # bounded 2x-drain-grace window (default grace 5 s => 10 s bound).
+    clk.advance(9.0)
+    driver._check_liveness()
+    assert not handle.evicted
+    # Exit classification consumes the marker: commit -> drained, and a
+    # re-staffed slot starts unmarked.
+    assert driver._consume_drain_marker("h", 0) is True
+    assert kv.get("drain", "h:0.begin") is None
+    assert kv.get("drain", "h:0.commit") is None
+    assert driver._consume_drain_marker("h", 0) is False
+
+
+def test_drain_begin_without_commit_is_not_drained(monkeypatch):
+    clk = FakeClock()
+    driver, kv, tl, handle = _monitor_driver(monkeypatch, clk)
+    kv.put("drain", "h:0.begin", b"1")
+    driver._check_liveness()
+    names = [n for n, _ in tl.instants]
+    assert names == [_timeline.DRAIN_BEGIN]
+    assert driver._consume_drain_marker("h", 0) is False  # crash, not drain
+
+
+# ---- drained-host accounting: zero strikes, quarantine, recovery -----------
+
+
+def test_quarantine_excludes_without_strikes():
+    from horovod_tpu.run.elastic.discovery import FixedHosts, HostManager
+
+    clk = FakeClock()
+    fixed = FixedHosts({"good": 1, "preempted": 1})
+    hm = HostManager(fixed, cooldown_range=(1, 2), max_strikes=3,
+                     parole_window=300.0, clock=clk)
+    hm.update_available_hosts()
+    hm.quarantine("preempted", seconds=30.0)
+    info = hm.blacklist_info()
+    assert info["preempted"]["blacklisted"]
+    assert info["preempted"]["strikes"] == 0
+    assert not info["preempted"]["permanent"]
+    assert hm.current_hosts == [("good", 1)]
+    # After the quarantine the host is welcome back, still strikeless.
+    clk.advance(31.0)
+    hm.update_available_hosts()
+    assert ("preempted", 1) in hm.current_hosts
+    assert hm.blacklist_info().get("preempted", {}).get("strikes", 0) == 0
+
+
+def test_record_drained_requarters_and_reactivates():
+    """record_drained routes through on_worker_exit(DRAINED): the world
+    re-activates (shrunk) but round_failures stays 0 — a drained round
+    still exits clean."""
+    from horovod_tpu.run.elastic.discovery import FixedHosts, HostManager
+    from horovod_tpu.run.elastic.registration import (DRAINED,
+                                                      WorkerStateRegistry)
+
+    calls = []
+
+    class DriverStub:
+        def on_worker_exit(self, host, slot, state):
+            calls.append((host, slot, state))
+
+    hm = HostManager(FixedHosts({"h": 1}))
+    hm.update_available_hosts()
+    reg = WorkerStateRegistry(DriverStub(), hm)
+    reg.record_drained("h", 0)
+    assert calls == [("h", 0, DRAINED)]
+    assert hm.blacklist_info()["h"]["strikes"] == 0
+    assert hm.is_blacklisted("h")
+
+
+# ---- worker heartbeat sender ----------------------------------------------
+
+
+def test_heartbeat_sender_beats_and_survives_drop_conn(monkeypatch):
+    """The sender puts monotonically advancing beats; a drop_conn fault
+    on control.heartbeat (the chaos input) skips beats WITHOUT killing
+    the thread — persistent silence is the driver's signal, a dead
+    sender thread would be a false positive."""
+    from horovod_tpu.common import faults
+    from horovod_tpu.run.elastic import worker as worker_mod
+
+    beats = []
+
+    def fake_put(addr, port, hostname, local_rank, seq):
+        beats.append(seq)
+
+    monkeypatch.setattr("horovod_tpu.run.elastic.rendezvous.put_heartbeat",
+                        fake_put)
+    sender = worker_mod._HeartbeatSender("127.0.0.1", 1, "h", 0,
+                                         interval_ms=5)
+    sender.start()
+    deadline = time.time() + 5.0
+    while len(beats) < 3 and time.time() < deadline:
+        time.sleep(0.01)
+    assert len(beats) >= 3, beats
+    assert beats[:3] == sorted(beats[:3])
+
+    # Arm drop_conn on every remaining beat: the KV put is never reached
+    # but the thread keeps running (seq keeps advancing underneath).
+    monkeypatch.setenv(_config.HOROVOD_FAULT_SPEC,
+                       "control.heartbeat:kind=drop_conn")
+    faults.refresh()
+    try:
+        seen = len(beats)
+        time.sleep(0.1)
+        assert len(beats) == seen  # beats dropped
+        assert sender.is_alive()  # thread survived
+        # Disarm: beats resume — proving the drop was the fault, not a
+        # dead thread.
+        monkeypatch.delenv(_config.HOROVOD_FAULT_SPEC)
+        faults.refresh()
+        deadline = time.time() + 5.0
+        while len(beats) == seen and time.time() < deadline:
+            time.sleep(0.01)
+        assert len(beats) > seen
+    finally:
+        sender.stop()
+        sender.join(timeout=5.0)
+        faults.refresh()
+
+
+# ---- preemption interrupt + drain protocol --------------------------------
+
+
+def test_preemption_posts_drain_kind_and_interrupt():
+    from horovod_tpu.elastic.state import State, notification_mailbox
+
+    st = State()
+    st.save = lambda: None
+    # Plain membership change: HostsUpdatedInterrupt (but not the drain
+    # subclass).
+    notification_mailbox.post()
+    with pytest.raises(HostsUpdatedInterrupt) as ei:
+        st.commit()
+    assert not isinstance(ei.value, PreemptionInterrupt)
+    # Drain post wins over queued updates and raises the subclass.
+    notification_mailbox.post()
+    notification_mailbox.post(drain=True)
+    with pytest.raises(PreemptionInterrupt):
+        st.commit()
+    assert notification_mailbox.pending() is None
+
+
+def test_retry_loop_drain_exits_zero_after_commit(monkeypatch):
+    """The retry loop answers PreemptionInterrupt with the drain
+    protocol: state committed (save called again at the drain boundary),
+    drain announced begin->commit, then SystemExit(0) — never a rejoin."""
+    from horovod_tpu.elastic import state as estate
+
+    announced = []
+    monkeypatch.setattr(
+        "horovod_tpu.run.elastic.rendezvous.announce_drain",
+        lambda addr, port, hostname, lrank, phase: announced.append(phase))
+    monkeypatch.setenv(_config.HOROVOD_RENDEZVOUS_ADDR, "127.0.0.1")
+    monkeypatch.setenv(_config.HOROVOD_RENDEZVOUS_PORT, "12345")
+    monkeypatch.setenv(_config.HOROVOD_HOSTNAME, "h")
+
+    class S(estate.State):
+        def __init__(self):
+            super().__init__()
+            self.saves = 0
+            self.steps = 0
+
+        def save(self):
+            self.saves += 1
+
+        def restore(self):
+            raise AssertionError("drain must not restore")
+
+        def sync(self):
+            pass
+
+    s = S()
+
+    def train(state):
+        state.steps += 1
+        if state.steps == 2:
+            estate.notification_mailbox.post(drain=True)
+        state.commit()
+        if state.steps < 5:
+            raise HostsUpdatedInterrupt(skip_sync=True)  # keep looping
+        return "done"
+
+    looped = estate.retry_loop(train, reinitialize=lambda: None)
+    with pytest.raises(SystemExit) as ei:
+        looped(s)
+    assert ei.value.code == 0
+    assert announced == ["begin", "commit"]
+    assert s.steps == 2  # left at the drain, no rejoin
+    assert s.saves >= 3  # commits + the drain-boundary save
+
+
+def test_drain_fault_seam_fires_before_commit(monkeypatch):
+    """elastic.drain sits between the begin announcement and the commit:
+    a kind=raise fault there aborts the drain BEFORE the commit marker —
+    exactly the 'preemption deadline beat the drain' crash case."""
+    from horovod_tpu.common import faults
+    from horovod_tpu.elastic import state as estate
+
+    announced = []
+    monkeypatch.setattr(
+        "horovod_tpu.run.elastic.rendezvous.announce_drain",
+        lambda addr, port, hostname, lrank, phase: announced.append(phase))
+    monkeypatch.setenv(_config.HOROVOD_RENDEZVOUS_ADDR, "127.0.0.1")
+    monkeypatch.setenv(_config.HOROVOD_RENDEZVOUS_PORT, "12345")
+    monkeypatch.setenv(_config.HOROVOD_HOSTNAME, "h")
+    monkeypatch.setenv(_config.HOROVOD_FAULT_SPEC,
+                       "elastic.drain:kind=raise")
+    faults.refresh()
+    try:
+        st = estate.State()
+        st.save = lambda: None
+        with pytest.raises(faults.FaultInjected):
+            estate._graceful_drain(st)
+        assert announced == ["begin"]  # commit never landed
+    finally:
+        monkeypatch.delenv(_config.HOROVOD_FAULT_SPEC)
+        faults.refresh()
+
+
+def test_drain_watchdog_is_daemon_timer():
+    from horovod_tpu.elastic.state import _drain_watchdog
+
+    t = _drain_watchdog(grace_ms=3_600_000)  # far future; never fires
+    try:
+        assert isinstance(t, threading.Timer)
+        assert t.daemon
+    finally:
+        t.cancel()

@@ -104,6 +104,11 @@ def test_native_core_concurrency_is_tsan_clean(tmp_path):
     assert "WARNING: ThreadSanitizer" not in report, report[-4000:]
     assert r.returncode == 0, report[-4000:]
     assert "STRESS_OK" in r.stdout, report[-4000:]
+    # The liveness phase really ran: its in-process 2-rank controller
+    # worlds log DRAIN (even rounds) and connection-closed evictions
+    # (odd rounds) from the heartbeat-armed coordinator.
+    assert "DRAIN rank=1" in report, report[-4000:]
+    assert "EVICT rank=1" in report, report[-4000:]
 
 
 @pytest.mark.slow

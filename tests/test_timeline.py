@@ -80,3 +80,47 @@ def test_timeline_mark_cycles(tmp_path, monkeypatch):
     events = json.load(open(path))
     assert any(ev.get("name") == "CYCLE" or "cycle" in
                str(ev.get("name", "")).lower() for ev in events)
+
+
+def test_driver_liveness_instants_schema(tmp_path):
+    """The launcher-side `<timeline>.driver.json` liveness instants
+    (docs/liveness.md): every escalation/drain event is a valid Chrome
+    tracing instant ("ph": "i") with the documented names and args —
+    host + slot always, silence_ms on the escalation steps, phase on the
+    drain steps — alongside HOST_BLACKLISTED."""
+    import horovod_tpu.common.timeline as timeline_mod
+    from horovod_tpu.common.timeline import Timeline
+
+    path = str(tmp_path / "tl.json.driver.json")
+    tl = Timeline(path)
+    escalation = [timeline_mod.HEARTBEAT_MISS, timeline_mod.RANK_SUSPECT,
+                  timeline_mod.RANK_EVICTED]
+    for i, name in enumerate(escalation):
+        tl.instant(name, {"host": "10.0.0.7", "slot": 0,
+                          "silence_ms": 100 * (i + 1)})
+    tl.instant(timeline_mod.DRAIN_BEGIN,
+               {"host": "10.0.0.8", "slot": 1, "phase": "begin"})
+    tl.instant(timeline_mod.DRAIN_COMMIT,
+               {"host": "10.0.0.8", "slot": 1, "phase": "commit"})
+    tl.instant(timeline_mod.HOST_BLACKLISTED,
+               {"host": "10.0.0.7", "strikes": 1})
+    tl.close()
+
+    events = json.load(open(path))
+    by_name = {ev["name"]: ev for ev in events}
+    for name in escalation + [timeline_mod.DRAIN_BEGIN,
+                              timeline_mod.DRAIN_COMMIT,
+                              timeline_mod.HOST_BLACKLISTED]:
+        ev = by_name[name]
+        assert ev["ph"] == "i" and "ts" in ev and "args" in ev, ev
+    for name in escalation:
+        args = by_name[name]["args"]
+        assert set(args) == {"host", "slot", "silence_ms"}, args
+        assert isinstance(args["silence_ms"], (int, float))
+    for name in (timeline_mod.DRAIN_BEGIN, timeline_mod.DRAIN_COMMIT):
+        args = by_name[name]["args"]
+        assert set(args) == {"host", "slot", "phase"}, args
+    assert by_name[timeline_mod.DRAIN_BEGIN]["args"]["phase"] == "begin"
+    assert by_name[timeline_mod.DRAIN_COMMIT]["args"]["phase"] == "commit"
+    # The file parses as one JSON array (strict trace viewers).
+    assert isinstance(events, list) and len(events) == 6

@@ -438,6 +438,134 @@ def supervise(argv):
     return 1
 
 
+# ---- local-leg transport bench (--local-leg) -------------------------------
+#
+# Host-plane A/B: the SAME hierarchical world (2 simulated hosts x
+# local_size ranks, round-robin placement) timed over fused allreduces
+# with the intra-host legs on loopback TCP vs the shm transport
+# (docs/shm-transport.md). Emits one JSON line with us/MB per transport
+# so BENCH artifacts carry the shm-vs-loopback line; the traffic
+# counters prove which plane moved the bytes.
+
+def _local_leg_worker(argv):
+    rank, port, size, hosts, nbytes, iters = (int(a) for a in argv)
+    import numpy as np
+
+    from horovod_tpu.common import native as hn
+
+    core = hn.NativeCore()
+    assert core.available, "native runtime unavailable"
+    ok = core.init(rank=rank, size=size, local_rank=rank // hosts,
+                   local_size=size // hosts, cross_rank=rank % hosts,
+                   cross_size=hosts, coordinator_addr="127.0.0.1",
+                   coordinator_port=port, my_host="127.0.0.1",
+                   cycle_time_ms=1.0, fusion_threshold=64 << 20,
+                   cache_capacity=64, stall_warning_sec=120.0,
+                   stall_shutdown_sec=0.0, stall_check_enabled=False,
+                   exec_callback=lambda resp, rid: core.response_done(
+                       rid, False, "host-plane only"))
+    assert ok, "native init failed"
+    count = nbytes // 4
+    buf = np.zeros(count, np.float32)
+
+    def allreduce(name):
+        h = core.enqueue(name, hn.OP_ALLREDUCE, 1, 7, buf.shape,
+                         data_ptr=buf.ctypes.data,
+                         output_ptr=buf.ctypes.data, plane=hn.PLANE_HOST)
+        r, err = core.wait(h)
+        assert r == 1, err
+
+    if rank == 0:
+        core.set_hier_flags(3)
+    for i in range(3):
+        allreduce(f"warm.{i}")
+    t0 = time.perf_counter()
+    for i in range(iters):
+        allreduce(f"leg.{i}")
+    dt = time.perf_counter() - t0
+    traffic = {"seconds": dt, "shm": core.shm_active(),
+               "local_bytes": core.ring_local_bytes(),
+               "cross_bytes": core.ring_cross_bytes(),
+               "shm_bytes": core.ring_shm_bytes()}
+    print("LLBENCH " + json.dumps({"rank": rank, **traffic}), flush=True)
+    core.shutdown()
+    print(f"LLWORKER_{rank}_OK", flush=True)
+    return 0
+
+
+def _local_leg_world(size, hosts, nbytes, iters, shm):
+    import socket as _socket
+
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ, HOROVOD_SHM="1" if shm else "0",
+               JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--local-leg-worker",
+         str(r), str(port), str(size), str(hosts), str(nbytes),
+         str(iters)], env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True) for r in range(size)]
+    per_rank = []
+    try:
+        for r, p in enumerate(procs):
+            out, _ = p.communicate(timeout=300)
+            assert p.returncode == 0 and f"LLWORKER_{r}_OK" in out, \
+                f"local-leg rank {r} failed:\n{out}"
+            for line in out.splitlines():
+                if line.startswith("LLBENCH "):
+                    per_rank.append(json.loads(line[len("LLBENCH "):]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    seconds = max(d["seconds"] for d in per_rank)
+    agg = {k: sum(d[k] for d in per_rank)
+           for k in ("local_bytes", "cross_bytes", "shm_bytes")}
+    moved_mb = (agg["local_bytes"] + agg["shm_bytes"]) / 1e6
+    return {
+        "transport": "shm" if shm else "tcp",
+        "shm_active_ranks": sum(1 for d in per_rank if d["shm"]),
+        "seconds": round(seconds, 4),
+        "us_per_local_mb": (round(seconds * 1e6 / moved_mb, 2)
+                            if moved_mb > 0 else None),
+        **agg,
+    }
+
+
+def local_leg_bench(argv):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--size", type=int, default=4,
+                        help="world size (2 simulated hosts x size/2)")
+    parser.add_argument("--payload-mb", type=float, default=4.0,
+                        help="fused allreduce payload per iteration")
+    parser.add_argument("--num-iters", type=int, default=20)
+    args = parser.parse_args(argv)
+    size = max(4, args.size - args.size % 2)
+    nbytes = int(args.payload_mb * (1 << 20))
+    rows = [
+        _local_leg_world(size, 2, nbytes, args.num_iters, shm=False),
+        _local_leg_world(size, 2, nbytes, args.num_iters, shm=True),
+    ]
+    tcp, shm = rows
+    result = {
+        "metric": "local_leg_us_per_mb",
+        "value": shm["us_per_local_mb"],
+        "unit": "us/MB (intra-host leg, shm)",
+        "baseline_tcp_us_per_mb": tcp["us_per_local_mb"],
+        "speedup_vs_loopback_tcp": (
+            round(tcp["seconds"] / shm["seconds"], 3)
+            if shm["seconds"] > 0 else None),
+        "world": {"size": size, "hosts": 2, "payload_mb": args.payload_mb,
+                  "iters": args.num_iters},
+        "transports": rows,
+    }
+    print(json.dumps(result))
+    return 0
+
+
 def worker(argv):
     args = _build_parser().parse_args(argv)
     if args.image_size is None:
@@ -604,6 +732,11 @@ def worker(argv):
     traffic = hvd.ring_traffic()
     result["ring_local_bytes"] = traffic["local_bytes"]
     result["ring_cross_bytes"] = traffic["cross_bytes"]
+    result["ring_shm_bytes"] = traffic["shm_bytes"]
+    # The transport that carried the intra-host legs (docs/
+    # shm-transport.md): "shm" when this rank's segment was live, else
+    # the TCP PeerLink fallback/default.
+    result["local_transport"] = "shm" if traffic["shm"] else "tcp"
     result["host_hierarchical"] = {
         "allreduce": traffic["hierarchical_allreduce"],
         "allgather": traffic["hierarchical_allgather"],
@@ -625,4 +758,8 @@ def worker(argv):
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
         sys.exit(worker(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "--local-leg-worker":
+        sys.exit(_local_leg_worker(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "--local-leg":
+        sys.exit(local_leg_bench(sys.argv[2:]))
     sys.exit(supervise(sys.argv[1:]))

@@ -205,20 +205,27 @@ def _native_core():
 
 
 def ring_traffic() -> dict:
-    """Host data-plane traffic accounting with the local/cross split.
+    """Host data-plane traffic accounting with the local/cross/shm split.
 
     Returns a dict with ``bytes_sent`` (every payload byte this process
-    put on the host TCP plane), ``local_bytes`` (to same-host peers —
-    the loopback legs of the hierarchical collectives), ``cross_bytes``
-    (to peers on other hosts: the scarce budget the two-level paths
-    minimize; see ``docs/hierarchical.md``), the effective
-    ``hierarchical_allreduce``/``hierarchical_allgather`` host-plane
-    dispatch (autotuner-synced value when present, else the env config),
-    and ``tuned`` (True once an autotuner decision reached this rank).
-    All zeros/False before init or in pure-XLA direct mode."""
+    moved on the host data plane, TCP and shm), ``local_bytes`` (TCP to
+    same-host peers — the loopback legs of the hierarchical collectives
+    when the shm transport is off or fell back), ``cross_bytes`` (to
+    peers on other hosts: the scarce budget the two-level paths
+    minimize; see ``docs/hierarchical.md``), ``shm_bytes`` (payload
+    moved through the shared-memory transport's rings with zero socket
+    syscalls — with shm active the local leg lives here and
+    ``local_bytes`` collapses to ~0; ``docs/shm-transport.md``),
+    ``shm`` (True when this rank's shm transport is live — the
+    transport choice), the effective ``hierarchical_allreduce``/
+    ``hierarchical_allgather`` host-plane dispatch (autotuner-synced
+    value when present, else the env config), and ``tuned`` (True once
+    an autotuner decision reached this rank). All zeros/False before
+    init or in pure-XLA direct mode."""
     core = _native_core()
     if core is None:
         return {"bytes_sent": 0, "local_bytes": 0, "cross_bytes": 0,
+                "shm_bytes": 0, "shm": False,
                 "hierarchical_allreduce": False,
                 "hierarchical_allgather": False, "tuned": False}
     flags = core.host_hier_flags()
@@ -226,6 +233,8 @@ def ring_traffic() -> dict:
         "bytes_sent": core.ring_bytes_sent(),
         "local_bytes": core.ring_local_bytes(),
         "cross_bytes": core.ring_cross_bytes(),
+        "shm_bytes": core.ring_shm_bytes(),
+        "shm": core.shm_active(),
         "hierarchical_allreduce": bool(flags & 1),
         "hierarchical_allgather": bool(flags & 2),
         "tuned": core.get_hier_flags() >= 0,

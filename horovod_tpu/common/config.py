@@ -55,6 +55,11 @@ HOROVOD_HOST_VIA_XLA = "HOROVOD_HOST_VIA_XLA"
 HOROVOD_HOST_VIA_XLA_THRESHOLD = "HOROVOD_HOST_VIA_XLA_THRESHOLD"
 DEFAULT_HOST_VIA_XLA_THRESHOLD = 1 << 20  # 1 MiB fused response
 HOROVOD_ELASTIC_REJOIN_GRACE = "HOROVOD_ELASTIC_REJOIN_GRACE"
+# Shared-memory intra-host transport (csrc/hvd/shm_transport.cc behind
+# the op_manager registry; docs/shm-transport.md)
+HOROVOD_SHM = "HOROVOD_SHM"
+HOROVOD_SHM_SLOT_BYTES = "HOROVOD_SHM_SLOT_BYTES"
+HOROVOD_SHM_FALLBACK = "HOROVOD_SHM_FALLBACK"
 # Liveness plane: heartbeats, failure detection, graceful drain
 # (common/liveness.py, csrc/hvd/controller.cc; docs/liveness.md)
 HOROVOD_HEARTBEAT_MS = "HOROVOD_HEARTBEAT_MS"
@@ -464,6 +469,42 @@ def retry_policy_from_env(scope: str = "", pinned=(),
             except ValueError:
                 continue
     return RetryPolicy(**kw)
+
+
+def shm_enabled() -> bool:
+    """Whether the shared-memory intra-host transport is on (default
+    off): the hierarchical collectives' local legs then move bytes
+    through cross-process-mmap'd shm rings with zero socket syscalls,
+    TCP PeerLink staying the registered fallback
+    (docs/shm-transport.md). A dispatch knob: must agree across ranks.
+    The native core parses the same variable with its EnvFlag mirror of
+    ``_get_bool``."""
+    return _get_bool(HOROVOD_SHM)
+
+
+def shm_slot_bytes():
+    """Operator override for the shm ring-buffer slot size in bytes,
+    ``None`` when unset (the native core then derives the slot from the
+    fusion cap, clamped to [64 KiB, 256 MiB] — one fused response per
+    slot write). Must agree across ranks: segment layout is part of the
+    attach validation."""
+    v = os.environ.get(HOROVOD_SHM_SLOT_BYTES)
+    if not v:
+        return None
+    try:
+        n = int(v)
+    except ValueError:
+        return None
+    return n if n > 0 else None
+
+
+def shm_fallback_enabled() -> bool:
+    """Whether a failed shm attach (or a poisoned channel mid-world)
+    falls through to the TCP leg (default on; results are byte-identical
+    either way). Disabled, transport failures surface as hard collective
+    errors — for deployments that would rather fail fast than silently
+    ride loopback TCP."""
+    return _get_bool(HOROVOD_SHM_FALLBACK, default=True)
 
 
 def heartbeat_ms() -> int:

@@ -44,6 +44,17 @@ CATALOG = (
     "ring.hier.cross",       # same seam, armed only on a local leader of a
                              # hierarchical multi-host world — kills/delays
                              # the rank carrying the cross-host leg
+    "ring.shm.attach",       # shm-transport attach at world init
+                             # (docs/shm-transport.md): kind=raise makes
+                             # THIS rank's native shm attaches fail, so
+                             # the registered TCP fallback carries its
+                             # local legs — byte-identical results, the
+                             # fallback path under test (the one seam
+                             # whose raise is absorbed, not propagated)
+    "ring.shm.exec",         # blocking wait on a collective in a world
+                             # with the shm transport active — the shm
+                             # analog of ring.exec for kills/delays/
+                             # raises while bytes ride the shm rings
     "xla.exec",              # eager engine executing an XLA-plane response
     "elastic.worker.start",  # driver-side worker launch (slot.rank)
     "checkpoint.write",      # CheckpointManager.save

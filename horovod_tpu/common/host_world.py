@@ -113,6 +113,10 @@ class HostWorld:
         # cross-host leg of the two-level collectives. Gates the
         # ring.hier.cross fault point (chaos-testing leader death).
         self._hier_cross_seam = False
+        # True when the shm transport is armed for this world
+        # (HOROVOD_SHM on, same-host peers exist). Gates the
+        # ring.shm.exec fault point (docs/shm-transport.md).
+        self._shm_seam = False
         # (addr, port) fetched from the elastic rendezvous KV this round;
         # overrides the launch-time HOROVOD_CONTROLLER_ADDR/PORT env, which
         # goes stale once rank 0 migrates to a different host.
@@ -158,6 +162,28 @@ class HostWorld:
                 self.size = len(comm)
                 self.rank = sorted(comm).index(self.rank)
 
+            # The forced-failure hook is scoped to ONE world: clear any
+            # previous world's arming so an exhausted step-targeted
+            # ring.shm.attach spec doesn't keep a re-initialized
+            # (elastic-recovered) world off shm forever.
+            os.environ.pop("HVD_SHM_FORCE_ATTACH_FAIL", None)
+            if _config.shm_enabled() and self.size > 1 and \
+                    self.local_size > 1:
+                try:
+                    _faults.point("ring.shm.attach", rank=self.rank)
+                except _faults.FaultInjected as e:
+                    # The one absorbed raise in the catalog: a raise here
+                    # SIMULATES an shm attach failure — this rank's
+                    # native attaches are forced to fail, so the
+                    # registered TCP backend carries its local legs,
+                    # byte-identically (docs/shm-transport.md). The
+                    # FALLBACK is the path under test; kind=exit/delay
+                    # keep their usual semantics.
+                    os.environ["HVD_SHM_FORCE_ATTACH_FAIL"] = "1"
+                    _log.warning(
+                        f"ring.shm.attach fault armed: forcing shm "
+                        f"attach failure; TCP carries the local legs "
+                        f"({e})")
             core = self._borrow_engine_core()
             if core is not None:
                 self._core, self._owns_core = core, False
@@ -180,6 +206,8 @@ class HostWorld:
                 and self.local_rank == 0
                 and (cfg.hierarchical_allreduce or
                      cfg.hierarchical_allgather))
+            self._shm_seam = (_config.shm_enabled() and self.size > 1
+                              and self.local_size > 1)
             if self._core is not None:
                 from . import host_staging
 
@@ -444,6 +472,7 @@ class HostWorld:
             self._staging = None
             self._elastic_controller = None
             self._hier_cross_seam = False
+            self._shm_seam = False
             self.initialized = False
             self.rank, self.size = 0, 1
             self.local_rank, self.local_size = 0, 1
@@ -502,6 +531,11 @@ class HostWorld:
             # here is "the leader died mid cross-exchange" — the
             # highest-blast-radius death the two-level path adds.
             _faults.point("ring.hier.cross", rank=self.rank)
+        if self._shm_seam:
+            # Shm-transport world: a kill/delay/raise here lands while
+            # bytes may be mid-flight in the shm rings — the shm analog
+            # of ring.exec (docs/shm-transport.md).
+            _faults.point("ring.shm.exec", rank=self.rank)
         return core.wait(handle)
 
     # -- small helper collectives (numpy, blocking) --------------------------

@@ -209,6 +209,10 @@ def _bind_prototypes(lib):
     lib.hvd_ring_local_bytes.argtypes = []
     lib.hvd_ring_cross_bytes.restype = ctypes.c_longlong
     lib.hvd_ring_cross_bytes.argtypes = []
+    lib.hvd_ring_shm_bytes.restype = ctypes.c_longlong
+    lib.hvd_ring_shm_bytes.argtypes = []
+    lib.hvd_shm_active.restype = ctypes.c_int
+    lib.hvd_shm_active.argtypes = []
     lib.hvd_host_hier_flags.restype = ctypes.c_int
     lib.hvd_host_hier_flags.argtypes = []
     _lib = lib
@@ -494,6 +498,21 @@ class NativeCore:
         """Host-plane bytes this rank sent to peers on OTHER hosts — the
         scarce cross-host budget the hierarchical paths minimize."""
         return int(self.lib.hvd_ring_cross_bytes())
+
+    def ring_shm_bytes(self) -> int:
+        """Payload bytes this rank moved over the shared-memory
+        transport (the zero-socket-syscall intra-host legs,
+        docs/shm-transport.md). With shm active the local TCP counter
+        collapses to ~0 and this one carries the entire local leg."""
+        return int(self.lib.hvd_ring_shm_bytes())
+
+    def shm_active(self) -> bool:
+        """True when this rank's shm transport is plausibly carrying
+        traffic: its segment is live and not every peer attach has
+        failed (the transport choice bench.py records). False with
+        HOROVOD_SHM off, on init failure, in a world with no same-host
+        peers, or once all attaches fell back to TCP."""
+        return bool(self.lib.hvd_shm_active())
 
     def host_hier_flags(self) -> int:
         """The EFFECTIVE host-plane hierarchical dispatch (bit0 =

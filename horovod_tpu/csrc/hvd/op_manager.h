@@ -1,0 +1,120 @@
+// Transport registry for the host data plane's intra-host legs.
+//
+// The role of the reference's OperationManager (ops/operation_manager.cc):
+// a priority-ordered list of backends per collective leg, dispatched to
+// the first enabled one, with per-op fallthrough when a backend cannot
+// carry a transfer. The reference orders whole collective engines
+// (MPI/NCCL/Gloo); here the engines are point-to-point *transports* for
+// the intra-host legs of the two-level collectives (ring_ops.cc
+// HierAllreduce/HierAllgatherv): shared memory first (shm_transport.cc,
+// zero socket syscalls), the TCP PeerLink loopback path as the registered
+// fallback. Future backends (RDMA verbs, an ICI proxy) slot into the same
+// lists without touching the collective algorithms.
+//
+// Fallthrough is LOCK-STEP: a sender that abandons a backend for a peer
+// first poisons that backend's channel (so the blocked receiver's Recv
+// reports a soft fall-through instead of data), then announces the new
+// choice on the control channel (a TCP PeerLink frame) before the first
+// payload rides the new backend. Both sides therefore switch at the same
+// message boundary and results are byte-identical to a TCP-only world.
+
+#ifndef HVD_OP_MANAGER_H_
+#define HVD_OP_MANAGER_H_
+
+#include <cstddef>
+#include <functional>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace hvd {
+
+// The intra-host legs of the two-level collectives: member->leader
+// reduce, member->leader gather, leader->member broadcast/fan-out. Each
+// leg owns its own priority list (today they register the same backends;
+// the split is the scaffolding for leg-specific ones).
+enum class TransportLeg : int {
+  LOCAL_REDUCE = 0,
+  LOCAL_GATHER = 1,
+  LOCAL_BCAST = 2,
+};
+constexpr int kNumTransportLegs = 3;
+
+// Send/Recv return codes (see OperationManager dispatch).
+constexpr int kTransportOk = 1;
+// The backend cannot carry this transfer but left the channel in a
+// clean state (nothing consumed/produced): the manager falls through to
+// the next backend in priority order.
+constexpr int kTransportFellThrough = 0;
+// Hard failure (partial transfer, timeout with a wedged peer): no
+// fallthrough is safe; the collective aborts like a TCP failure would.
+constexpr int kTransportError = -1;
+
+class TransportBackend {
+ public:
+  virtual ~TransportBackend() = default;
+  virtual const char* Name() const = 0;
+  // Capability probe, taken at registration time and before every
+  // negotiation: a disabled backend is skipped by every dispatch.
+  virtual bool Enabled() const = 0;
+  // One-time sender-side channel setup toward `peer` (e.g. mapping the
+  // peer's shared-memory segment). false = this backend cannot reach
+  // the peer; the negotiation moves down the priority list.
+  virtual bool Prepare(int peer) {
+    (void)peer;
+    return true;
+  }
+  virtual int Send(int peer, const void* buf, size_t nbytes) = 0;
+  virtual int Recv(int peer, void* buf, size_t nbytes) = 0;
+};
+
+class OperationManager {
+ public:
+  // The control channel carries the one-time per-(leg, direction)
+  // agreement frames and every mid-world fallthrough announcement —
+  // in this runtime: the Ring's TCP PeerLink frames, whose per-pair
+  // FIFO ordering the lock-step switch protocol relies on.
+  struct ControlChannel {
+    std::function<bool(int peer, const std::string&)> send;
+    std::function<bool(int peer, std::string*)> recv;
+  };
+
+  OperationManager(ControlChannel ctl, bool allow_fallthrough)
+      : ctl_(std::move(ctl)), allow_fallthrough_(allow_fallthrough) {}
+
+  // Register `b` for `leg`; earlier registrations win the negotiation.
+  // The global backend id (`RegisterBackend`'s insertion index) is the
+  // value exchanged on the control channel, so every rank must register
+  // the same backends in the same order (they do: one code path).
+  int RegisterBackend(TransportBackend* b);  // -> global backend id
+  void RegisterForLeg(TransportLeg leg, int backend_id);
+
+  // Transfer `nbytes` to/from a same-host peer on the agreed backend,
+  // negotiating on first contact and falling through on soft failure.
+  // Returns the global backend id that carried the payload, or -1 on a
+  // hard error.
+  int Send(TransportLeg leg, int peer, const void* buf, size_t nbytes);
+  int Recv(TransportLeg leg, int peer, void* buf, size_t nbytes);
+
+  // Observability: the backend currently agreed for (leg, peer) sends,
+  // -1 before first contact.
+  int AgreedSend(TransportLeg leg, int peer) const;
+  const char* BackendName(int backend_id) const;
+
+ private:
+  int Negotiate(TransportLeg leg, int peer, int below);
+
+  ControlChannel ctl_;
+  bool allow_fallthrough_;
+  std::vector<TransportBackend*> backends_;
+  std::vector<std::vector<int>> per_leg_{
+      std::vector<std::vector<int>>(kNumTransportLegs)};
+  // (leg, peer) -> agreed global backend id. Touched only by the
+  // background cycle thread (all hier legs run there), so no lock.
+  std::map<std::pair<int, int>, int> agreed_send_;
+  std::map<std::pair<int, int>, int> agreed_recv_;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_OP_MANAGER_H_

@@ -155,18 +155,36 @@ GlobalState* g() {
   return state;
 }
 
-bool EnvFlag(const char* name) {
+bool EnvFlag(const char* name, bool dflt = false) {
   // Mirrors common/config.py _get_bool: only an explicit true-ish value
   // enables the flag, so "False"/"no"/"off" mean the same thing to the
   // host plane as to every Python-side consumer of the same variable.
+  // `dflt` is returned when the variable is unset (the _get_bool default
+  // parameter) — set values always parse through the shared grammar.
   const char* v = std::getenv(name);
-  if (v == nullptr) return false;
+  if (v == nullptr) return dflt;
   std::string s(v);
   size_t b = s.find_first_not_of(" \t");
   size_t e = s.find_last_not_of(" \t");
   s = (b == std::string::npos) ? "" : s.substr(b, e - b + 1);
   for (auto& c : s) c = static_cast<char>(std::tolower(c));
   return s == "1" || s == "true" || s == "yes" || s == "on";
+}
+
+// Shm ring-buffer slot size: HOROVOD_SHM_SLOT_BYTES when set (mirrors
+// config.shm_slot_bytes), else derived from the fusion cap so a fused
+// response usually streams in one slot write. Clamped to sane bounds
+// either way (a one-byte slot would still be correct, just silly).
+long long ShmSlotBytes(long long fusion_threshold) {
+  long long v = -1;
+  if (const char* e = std::getenv("HOROVOD_SHM_SLOT_BYTES")) {
+    char* end = nullptr;
+    long long n = std::strtoll(e, &end, 10);
+    if (end != nullptr && *end == 0 && n > 0) v = n;
+  }
+  if (v < 0) v = fusion_threshold;
+  const long long kMin = 64 << 10, kMax = 256LL << 20;
+  return std::max(kMin, std::min(kMax, v));
 }
 
 // Effective hierarchical-dispatch bit for the host plane: the tuner's
@@ -571,6 +589,22 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
     // Host topology from the controller's exchanged table: enables the
     // two-level hierarchical paths and the local/cross traffic split.
     s->ring->SetTopology(s->controller->cross_ranks());
+    // Intra-host transport registry (op_manager.h): shm data plane when
+    // HOROVOD_SHM is on (must agree across ranks, like every dispatch
+    // env), TCP PeerLink as the registered fallback. The fallback
+    // toggle (HOROVOD_SHM_FALLBACK, default on) turns attach/exec
+    // failures into hard errors when disabled — for deployments that
+    // would rather fail fast than silently ride loopback TCP. With
+    // heartbeats armed, shm waits are bounded by ~2x the liveness
+    // timeout so a wedged peer cannot park an shm leg past the
+    // eviction the liveness plane delivers on the TCP side.
+    long long shm_wait_ms =
+        heartbeat_ms > 0 ? 2LL * cfg.liveness_timeout_ms : 120000;
+    s->ring->ConfigureTransports(
+        hvd::EnvFlag("HOROVOD_SHM"),
+        hvd::ShmSlotBytes(static_cast<long long>(fusion_threshold)),
+        hvd::EnvFlag("HOROVOD_SHM_FALLBACK", /*dflt=*/true),
+        shm_wait_ms);
   }
   s->background = std::thread(hvd::BackgroundLoop);
   s->initialized.store(true);
@@ -902,6 +936,24 @@ long long hvd_ring_cross_bytes() {
   auto* s = hvd::g();
   std::lock_guard<std::mutex> lk(s->init_mu);
   return s->ring ? s->ring->cross_bytes_sent() : 0;
+}
+
+// Payload bytes moved over the shared-memory transport (the zero-
+// socket-syscall intra-host legs, docs/shm-transport.md). With shm
+// active, local TCP bytes collapse to ~0 and this counter carries the
+// entire local leg: bytes_sent == local + cross + shm.
+long long hvd_ring_shm_bytes() {
+  auto* s = hvd::g();
+  std::lock_guard<std::mutex> lk(s->init_mu);
+  return s->ring ? s->ring->shm_bytes_sent() : 0;
+}
+
+// 1 when this rank's shm segment is live (transport registered and
+// enabled) — the transport choice bench.py records.
+int hvd_shm_active() {
+  auto* s = hvd::g();
+  std::lock_guard<std::mutex> lk(s->init_mu);
+  return (s->ring && s->ring->shm_active()) ? 1 : 0;
 }
 
 // The EFFECTIVE host-plane hierarchical dispatch flags this process would

@@ -179,6 +179,110 @@ long long TreeThresholdBytes() {
 
 }  // namespace
 
+// TCP adapter for the transport registry: wraps the lazily-established
+// PeerLink sockets so the registered fallback keeps both the existing
+// framing (4-byte length prefix, exact-size validation) and the split
+// local/cross traffic accounting.
+class Ring::TcpPeerBackend : public TransportBackend {
+ public:
+  explicit TcpPeerBackend(Ring* ring) : ring_(ring) {}
+  const char* Name() const override { return "tcp"; }
+  bool Enabled() const override { return true; }
+  int Send(int peer, const void* buf, size_t nbytes) override {
+    Socket* s = ring_->PeerLink(peer);
+    // Copy-free (ptr, len) frame: the old code staged a std::string of
+    // the whole payload per member — 3x the buffer per broadcast on a
+    // 4-local-rank host.
+    if (s == nullptr || !s->SendFrame(buf, nbytes)) {
+      return kTransportError;
+    }
+    ring_->AddSent(peer, nbytes);
+    return kTransportOk;
+  }
+  int Recv(int peer, void* buf, size_t nbytes) override {
+    // Copy-free, like Send: straight into the caller's buffer.
+    Socket* s = ring_->PeerLink(peer);
+    if (s == nullptr || !s->RecvFrameInto(buf, nbytes)) {
+      return kTransportError;
+    }
+    return kTransportOk;
+  }
+
+ private:
+  Ring* ring_;
+};
+
+void Ring::ConfigureTransports(bool use_shm, long long slot_bytes,
+                               bool allow_fallthrough,
+                               long long shm_wait_timeout_ms) {
+  OperationManager::ControlChannel ctl;
+  // Control frames ride the PeerLink sockets (FIFO per direction, like
+  // every payload fallback frame) and stay off the traffic counters:
+  // they are negotiation, not payload.
+  ctl.send = [this](int peer, const std::string& frame) {
+    Socket* s = PeerLink(peer);
+    return s != nullptr && s->SendFrame(frame);
+  };
+  ctl.recv = [this](int peer, std::string* frame) {
+    Socket* s = PeerLink(peer);
+    return s != nullptr && s->RecvFrame(frame);
+  };
+  op_mgr_ = std::make_unique<OperationManager>(ctl, allow_fallthrough);
+  tcp_backend_ = std::make_unique<TcpPeerBackend>(this);
+  shm_ = std::make_unique<ShmTransport>();
+  if (use_shm && group_.size() > 1) {
+    std::vector<int> ports(size_);
+    for (int r = 0; r < size_; ++r) ports[r] = endpoints_[r].second;
+    if (!shm_->Init(rank_, group_, ports, slot_bytes,
+                    shm_wait_timeout_ms)) {
+      std::fprintf(stderr,
+                   "[horovod_tpu] shm transport init failed at rank %d; "
+                   "TCP carries the intra-host legs\n",
+                   rank_);
+    }
+  }
+  // Backend ids are the values exchanged in control frames, so the
+  // registration ORDER must be identical on every rank: the shm backend
+  // is registered even when disabled on this rank (env off, init
+  // failure) — Enabled()/Prepare() keep it out of every negotiation,
+  // while the id table stays globally consistent.
+  shm_backend_id_ = op_mgr_->RegisterBackend(shm_.get());
+  int tcp_id = op_mgr_->RegisterBackend(tcp_backend_.get());
+  for (int leg = 0; leg < kNumTransportLegs; ++leg) {
+    op_mgr_->RegisterForLeg(static_cast<TransportLeg>(leg),
+                            shm_backend_id_);
+    op_mgr_->RegisterForLeg(static_cast<TransportLeg>(leg), tcp_id);
+  }
+}
+
+bool Ring::LocalSend(TransportLeg leg, int peer, const void* buf,
+                     size_t nbytes) {
+  if (op_mgr_ == nullptr) {
+    // Registry never configured (standalone rings in unit tests): the
+    // pre-registry direct TCP frame.
+    Socket* s = PeerLink(peer);
+    if (s == nullptr || !s->SendFrame(buf, nbytes)) return false;
+    AddSent(peer, nbytes);
+    return true;
+  }
+  int id = op_mgr_->Send(leg, peer, buf, nbytes);
+  if (id < 0) return false;
+  if (id == shm_backend_id_) {
+    // TCP sends account inside CountedSendFrame; shm payload counts
+    // into the total here (and into the shm counter in the backend).
+    bytes_sent_.fetch_add(static_cast<long long>(nbytes));
+  }
+  return true;
+}
+
+bool Ring::LocalRecv(TransportLeg leg, int peer, void* buf, size_t nbytes) {
+  if (op_mgr_ == nullptr) {
+    Socket* s = PeerLink(peer);
+    return s != nullptr && s->RecvFrameInto(buf, nbytes);
+  }
+  return op_mgr_->Recv(leg, peer, buf, nbytes) >= 0;
+}
+
 void Ring::SetTopology(const std::vector<int>& cross_ranks) {
   if (static_cast<int>(cross_ranks.size()) != size_) return;
   cross_ranks_ = cross_ranks;
@@ -281,6 +385,8 @@ bool Ring::SendRecvStep(const void* sbuf, size_t sbytes, void* rbuf,
   return SendRecvDuplex(&next_, (rank_ + 1) % size_, sbuf, sbytes, &prev_,
                         rbuf, rbytes);
 }
+
+Ring::Ring() = default;
 
 Ring::~Ring() {
   if (sender_.joinable()) {
@@ -541,49 +647,42 @@ Status Ring::HierAllreduce(void* data, void* output, int64_t count,
   if (output != data) std::memcpy(output, data, count * es);
   ScaleBuffer(output, count, dtype, prescale);
   int leader = group_.front();
-  // Phase 1: intra-host reduce to the local leader over loopback links
-  // (deterministic ascending-member order, so every run sums in the same
-  // order). The reference's NCCLReduce-to-local-root leg
+  // Phase 1: intra-host reduce to the local leader through the
+  // transport registry — shm rings when attached (zero socket
+  // syscalls), loopback TCP PeerLink frames as the registered fallback.
+  // Deterministic ascending-member order, so every run sums in the same
+  // order. The reference's NCCLReduce-to-local-root leg
   // (nccl_operations.cc:164-357).
   if (rank_ != leader) {
-    Socket* s = PeerLink(leader);
-    if (s == nullptr ||
-        !CountedSendFrame(*s, leader, std::string(
-            static_cast<const char*>(output), nbytes))) {
+    if (!LocalSend(TransportLeg::LOCAL_REDUCE, leader, output, nbytes)) {
       return Status::Aborted("hier intra-host reduce send failed");
     }
   } else {
+    std::vector<char> member_buf(nbytes);
     for (int m : group_) {
       if (m == rank_) continue;
-      Socket* s = PeerLink(m);
-      std::string frame;
-      if (s == nullptr || !s->RecvFrame(&frame) ||
-          frame.size() != nbytes) {
+      if (!LocalRecv(TransportLeg::LOCAL_REDUCE, m, member_buf.data(),
+                     nbytes)) {
         return Status::Aborted("hier intra-host reduce recv failed");
       }
-      Accumulate(output, frame.data(), count, dtype, op);
+      Accumulate(output, member_buf.data(), count, dtype, op);
     }
     // Phase 2: cross-host leg among leaders only — every byte that
     // crosses the slow links is paid once per host, not once per rank.
     Status st = SubRingAllreduce(output, count, dtype, op, leaders_);
     if (!st.ok()) return st;
     // Phase 3: intra-host broadcast of the reduced result.
-    std::string result(static_cast<const char*>(output), nbytes);
     for (int m : group_) {
       if (m == rank_) continue;
-      Socket* s = PeerLink(m);
-      if (s == nullptr || !CountedSendFrame(*s, m, result)) {
+      if (!LocalSend(TransportLeg::LOCAL_BCAST, m, output, nbytes)) {
         return Status::Aborted("hier intra-host bcast send failed");
       }
     }
   }
   if (rank_ != leader) {
-    Socket* s = PeerLink(leader);
-    std::string frame;
-    if (s == nullptr || !s->RecvFrame(&frame) || frame.size() != nbytes) {
+    if (!LocalRecv(TransportLeg::LOCAL_BCAST, leader, output, nbytes)) {
       return Status::Aborted("hier intra-host bcast recv failed");
     }
-    std::memcpy(output, frame.data(), nbytes);
   }
   if (op == ReduceOp::AVERAGE) {
     ScaleBuffer(output, count, dtype, 1.0 / size_);
@@ -611,33 +710,26 @@ Status Ring::HierAllgatherv(const void* data, void* output,
   size_t total = static_cast<size_t>(disp[size_]);
   if (rank_ != leader) {
     // Phase 1: hand my block to the leader; phase 3: receive the fully
-    // assembled result. Both legs are loopback.
-    Socket* s = PeerLink(leader);
-    if (s == nullptr) {
-      return Status::Aborted("hier allgather leader link failed");
-    }
+    // assembled result. Both legs are intra-host: shm when attached,
+    // loopback TCP as the registered fallback. Zero-count blocks are
+    // skipped symmetrically on both sides.
     if (counts[rank_] > 0 &&
-        !CountedSendFrame(*s, leader, std::string(
-            out + disp[rank_], counts[rank_] * es))) {
+        !LocalSend(TransportLeg::LOCAL_GATHER, leader, out + disp[rank_],
+                   counts[rank_] * es)) {
       return Status::Aborted("hier allgather gather send failed");
     }
-    std::string frame;
-    if (!s->RecvFrame(&frame) || frame.size() != total) {
+    if (!LocalRecv(TransportLeg::LOCAL_BCAST, leader, out, total)) {
       return Status::Aborted("hier allgather result recv failed");
     }
-    std::memcpy(out, frame.data(), total);
     return Status::OK();
   }
   // Leader: collect the host's blocks into place.
   for (int m : group_) {
     if (m == rank_ || counts[m] == 0) continue;
-    Socket* s = PeerLink(m);
-    std::string frame;
-    if (s == nullptr || !s->RecvFrame(&frame) ||
-        frame.size() != static_cast<size_t>(counts[m] * es)) {
+    if (!LocalRecv(TransportLeg::LOCAL_GATHER, m, out + disp[m],
+                   counts[m] * es)) {
       return Status::Aborted("hier allgather gather recv failed");
     }
-    std::memcpy(out + disp[m], frame.data(), frame.size());
   }
   // Phase 2: ring the per-host bundles around the leaders. A bundle is
   // the host's rank blocks concatenated in rank order — hosts need not
@@ -681,11 +773,9 @@ Status Ring::HierAllgatherv(const void* data, void* output,
     unpack(recv_g, rbuf);
   }
   // Phase 3: hand the assembled result to every local member.
-  std::string result(out, total);
   for (int m : group_) {
     if (m == rank_) continue;
-    Socket* s = PeerLink(m);
-    if (s == nullptr || !CountedSendFrame(*s, m, result)) {
+    if (!LocalSend(TransportLeg::LOCAL_BCAST, m, out, total)) {
       return Status::Aborted("hier allgather result send failed");
     }
   }

@@ -26,12 +26,17 @@
 #include <vector>
 
 #include "common.h"
+#include "op_manager.h"
+#include "shm_transport.h"
 #include "socket.h"
 
 namespace hvd {
 
 class Ring {
  public:
+  // Out-of-line (ring_ops.cc): the transport members are unique_ptrs to
+  // types incomplete in this header (nested TcpPeerBackend).
+  Ring();
   ~Ring();
   // Establish neighbor connections. `endpoints[rank] = (host, port)`;
   // `listener` must already be listening on endpoints[rank].second.
@@ -44,6 +49,19 @@ class Ring {
   // hierarchical paths; without it every send is accounted cross-host
   // (the conservative pre-topology behavior: one process per host).
   void SetTopology(const std::vector<int>& cross_ranks);
+  // Build the intra-host transport registry (op_manager.h): the shm
+  // backend (created when `use_shm`, from HOROVOD_SHM) ahead of the TCP
+  // PeerLink fallback, per collective leg. `slot_bytes` sizes the shm
+  // ring-buffer slots (derived from the fusion cap / env);
+  // `allow_fallthrough` = false (HOROVOD_SHM_FALLBACK=0) turns transport
+  // failures into hard collective errors instead of a silent TCP leg;
+  // `shm_wait_timeout_ms` bounds the shm data-plane waits (liveness-
+  // derived when heartbeats are armed — see operations.cc).
+  // Call after Connect + SetTopology; without it the hierarchical legs
+  // use direct TCP PeerLink frames (pre-registry behavior).
+  void ConfigureTransports(bool use_shm, long long slot_bytes,
+                           bool allow_fallthrough,
+                           long long shm_wait_timeout_ms = 120000);
 
   Status Allreduce(void* data, void* output, int64_t count, DataType dtype,
                    ReduceOp op, double prescale, double postscale);
@@ -91,6 +109,19 @@ class Ring {
   // installed; without one every byte is accounted cross.
   long long local_bytes_sent() const { return local_bytes_sent_.load(); }
   long long cross_bytes_sent() const { return cross_bytes_sent_.load(); }
+  // Payload bytes moved over the shared-memory transport (the zero-
+  // socket-syscall intra-host legs; shm_transport.h). Counted separately
+  // from local_bytes_sent (which stays TCP-only) so the proof surface is
+  // direct: with shm active, local TCP bytes collapse to ~0 while
+  // shm_bytes carries the entire local leg. bytes_sent() includes them.
+  long long shm_bytes_sent() const {
+    return shm_ ? shm_->bytes_sent() : 0;
+  }
+  // True when this rank's shm transport is plausibly carrying traffic:
+  // segment live AND not every peer attach failed (a rank riding the
+  // TCP fallback for every leg must not report shm as its transport
+  // choice) — what bench.py records.
+  bool shm_active() const { return shm_ != nullptr && shm_->Active(); }
 
  private:
   // Full-duplex step: send on `sock` while receiving from `recv_sock`,
@@ -126,6 +157,14 @@ class Ring {
   // arriving out of order are stashed by rank). nullptr on failure.
   Socket* PeerLink(int peer);
 
+  // Intra-host point-to-point transfer through the transport registry
+  // (shm first, TCP fallback). Falls back to a direct TCP PeerLink
+  // frame when ConfigureTransports was never called (standalone rings
+  // in tests).
+  bool LocalSend(TransportLeg leg, int peer, const void* buf,
+                 size_t nbytes);
+  bool LocalRecv(TransportLeg leg, int peer, void* buf, size_t nbytes);
+
   // Per-tensor pairwise Adasum combine: a (mine) and b (partner's) are
   // fragments laid out per `counts` in `work_dt` storage (fp32, or the
   // caller's 16-bit float — then fp32 math with per-level rounding);
@@ -158,6 +197,15 @@ class Ring {
   std::atomic<long long> bytes_sent_{0};
   std::atomic<long long> local_bytes_sent_{0};
   std::atomic<long long> cross_bytes_sent_{0};
+
+  // Intra-host transport registry (ConfigureTransports). The TCP
+  // adapter wraps PeerLink/CountedSendFrame so the fallback keeps the
+  // split local/cross accounting; the shm backend counts its own bytes.
+  class TcpPeerBackend;
+  std::unique_ptr<TcpPeerBackend> tcp_backend_;
+  std::unique_ptr<ShmTransport> shm_;
+  std::unique_ptr<OperationManager> op_mgr_;
+  int shm_backend_id_ = -1;
 
   std::thread sender_;
   std::mutex send_mu_;

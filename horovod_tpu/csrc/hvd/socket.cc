@@ -108,19 +108,26 @@ bool Socket::RecvAll(void* p, size_t n) {
 }
 
 bool Socket::SendFrame(const std::string& payload) {
-  uint32_t len = static_cast<uint32_t>(payload.size());
+  return SendFrame(payload.data(), payload.size());
+}
+
+bool Socket::SendFrame(const void* payload, size_t nbytes) {
+  uint32_t len = static_cast<uint32_t>(nbytes);
+  const char* p = static_cast<const char*>(payload);
   // One writev for header + payload (one syscall for the common short
-  // frame); fall back to SendAll for partial writes.
+  // frame); fall back to SendAll for partial writes. The (ptr, len)
+  // form exists so large transfers (the transport registry's intra-host
+  // legs) never pay a std::string copy of the payload.
   struct iovec iov[2];
   iov[0].iov_base = &len;
   iov[0].iov_len = 4;
-  iov[1].iov_base = const_cast<char*>(payload.data());
-  iov[1].iov_len = payload.size();
+  iov[1].iov_base = const_cast<char*>(p);
+  iov[1].iov_len = nbytes;
   struct msghdr msg;
   std::memset(&msg, 0, sizeof(msg));
   msg.msg_iov = iov;
   msg.msg_iovlen = 2;
-  size_t total = 4 + payload.size();
+  size_t total = 4 + nbytes;
   while (true) {
     // sendmsg, not writev: a dying peer must surface as an error, not a
     // process-killing SIGPIPE (MSG_NOSIGNAL — the chaos tests kill ranks
@@ -135,10 +142,9 @@ bool Socket::SendFrame(const std::string& payload) {
     // Partial write: finish byte-precise via SendAll.
     if (sent < 4) {
       const char* h = reinterpret_cast<const char*>(&len);
-      return SendAll(h + sent, 4 - sent) &&
-             SendAll(payload.data(), payload.size());
+      return SendAll(h + sent, 4 - sent) && SendAll(p, nbytes);
     }
-    return SendAll(payload.data() + (sent - 4), payload.size() - (sent - 4));
+    return SendAll(p + (sent - 4), nbytes - (sent - 4));
   }
 }
 
@@ -148,6 +154,13 @@ bool Socket::RecvFrame(std::string* payload) {
   if (len > (1u << 30)) return false;
   payload->resize(len);
   return len == 0 || RecvAll(&(*payload)[0], len);
+}
+
+bool Socket::RecvFrameInto(void* payload, size_t nbytes) {
+  uint32_t len = 0;
+  if (!RecvAll(&len, 4)) return false;
+  if (len != nbytes) return false;  // desync: caller aborts
+  return len == 0 || RecvAll(payload, len);
 }
 
 int Socket::RecvFrameTimeout(std::string* payload, int timeout_ms) {

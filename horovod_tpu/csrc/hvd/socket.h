@@ -40,6 +40,13 @@ class Socket {
   // kernel buffer through a small user-space buffer so a short frame
   // (header + payload, often the NEXT frame too) costs one recv.
   bool SendFrame(const std::string& payload);
+  // Copy-free forms for large payloads (the transport registry's
+  // intra-host legs): same frames on the wire, no std::string staging.
+  // RecvFrameInto expects EXACTLY nbytes — a differently-sized frame
+  // fails (the stream is then desynced; callers abort, as they do on
+  // any size-mismatched frame today).
+  bool SendFrame(const void* payload, size_t nbytes);
+  bool RecvFrameInto(void* payload, size_t nbytes);
   bool RecvFrame(std::string* payload);
   // Timed receive for the liveness plane (docs/liveness.md): returns 1
   // with a complete frame, 0 on timeout (any partial frame stays buffered

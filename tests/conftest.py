@@ -147,8 +147,33 @@ def _find_tagged_orphans():
                     errors="replace").strip()
         except OSError:
             continue  # raced an exit, or not ours to read
+        if "multiprocessing.resource_tracker" in cmd or \
+                "multiprocessing.semaphore_tracker" in cmd:
+            # Python's own tracker daemon, started in THIS interpreter
+            # the first time a test touches multiprocessing (e.g.
+            # test_spark_run's spawn-context pools). It inherits the
+            # session tag and legitimately outlives session teardown —
+            # it dies with the interpreter, not with a test world.
+            continue
         orphans.append((int(entry), cmd))
     return orphans
+
+
+def tagged_shm_segments(tag=None):
+    """Leaked /dev/shm segments from this session's worlds: the native
+    shm transport tags every segment name with HVD_TEST_WORLD_TAG
+    (sanitized exactly like csrc/hvd/shm_transport.cc NameTag — alnum
+    only, max 12 chars). THE one copy of that name rule on the Python
+    side; test modules import this instead of re-deriving it."""
+    tag = "".join(c for c in (tag if tag is not None else _WORLD_TAG)
+                  if c.isalnum())[:12]
+    if not tag or not os.path.isdir("/dev/shm"):
+        return []
+    return [n for n in os.listdir("/dev/shm")
+            if n.startswith(f"hvdshm_{tag}_")]
+
+
+_find_tagged_shm_segments = tagged_shm_segments
 
 
 @pytest.fixture(scope="session", autouse=True)
@@ -159,13 +184,28 @@ def _orphan_world_sweep():
     CPU, wedging every later multi-process test). The leaked processes
     are killed so one bad test doesn't poison the machine, but the
     failure is still raised: a leak is a bug in the test's teardown, not
-    something to mop up silently."""
+    something to mop up silently. Same contract for leaked /dev/shm
+    segments (docs/shm-transport.md): swept, then reported as a
+    failure."""
     yield
     import signal as _signal
     import time as _time
 
     orphans = _find_tagged_orphans()
     if not orphans:
+        leaked_shm = _find_tagged_shm_segments()
+        if leaked_shm:
+            for name in leaked_shm:
+                try:
+                    os.unlink(os.path.join("/dev/shm", name))
+                except OSError:
+                    pass
+            raise AssertionError(
+                f"orphaned shm segments leaked by this session (now "
+                f"unlinked): {leaked_shm}\n"
+                "A world with the shm transport active failed to tear "
+                "down (see csrc/hvd/shm_transport.cc lifecycle and "
+                "docs/shm-transport.md).")
         return
     my_pgid = os.getpgid(0)
     for pid, _ in orphans:
@@ -184,6 +224,13 @@ def _orphan_world_sweep():
         except OSError:
             pass
     _time.sleep(0.2)
+    # The killed workers can no longer unlink their segments; mop those
+    # up too before reporting (the process leak is the headline).
+    for name in _find_tagged_shm_segments():
+        try:
+            os.unlink(os.path.join("/dev/shm", name))
+        except OSError:
+            pass
     listing = "\n".join(f"  pid {pid}: {cmd}" for pid, cmd in orphans)
     raise AssertionError(
         f"orphaned test workers leaked by this session (now killed):\n"

@@ -26,8 +26,12 @@
 // prints STRESS_OK when all phases complete; any TSan report fails the
 // run via TSAN_OPTIONS=exitcode=66 (set by the pytest driver).
 
+#include <unistd.h>
+
 #include <atomic>
+#include <chrono>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 #include <thread>
@@ -35,6 +39,7 @@
 
 #include "../../horovod_tpu/csrc/hvd/controller.h"
 #include "../../horovod_tpu/csrc/hvd/ring_ops.h"
+#include "../../horovod_tpu/csrc/hvd/shm_transport.h"
 
 // The extern "C" surface of operations.cc (no installed header — the
 // Python side binds by symbol, and so does this harness).
@@ -68,6 +73,8 @@ long long hvd_cache_hits();
 long long hvd_ring_bytes_sent();
 long long hvd_ring_local_bytes();
 long long hvd_ring_cross_bytes();
+long long hvd_ring_shm_bytes();
+int hvd_shm_active();
 int hvd_host_hier_flags();
 int hvd_get_hier_flags();
 void hvd_set_hier_flags(int flags);
@@ -129,6 +136,8 @@ void Monitor(std::atomic<bool>* stop) {
     sink += hvd_ring_bytes_sent();
     sink += hvd_ring_local_bytes();
     sink += hvd_ring_cross_bytes();
+    sink += hvd_ring_shm_bytes();
+    sink += hvd_shm_active();
     sink += hvd_host_hier_flags();
     sink += hvd_get_hier_flags();
     sink += static_cast<long long>(hvd_get_cycle_time_ms());
@@ -293,6 +302,98 @@ void LivenessControllerPhase() {
   }
 }
 
+// Shared-memory transport under the sanitizers (docs/shm-transport.md):
+// two in-process "ranks" of one host group stream messages both ways
+// through the SPSC rings concurrently (0-byte, sub-slot, exact-slot and
+// chunked sizes) while a poller hammers the byte counters; then the
+// mid-world teardown interleaving — a receiver parked on an empty ring
+// must unblock via the peer's teardown poison, never touch freed pages —
+// and the forced-attach-failure path. Segment lifecycle is asserted by
+// the pytest driver: no /dev/shm orphans after this process exits.
+void ShmPhase() {
+  // Fake world-unique "ports" (they only feed segment names; the
+  // session tag in the name isolates concurrent test sessions).
+  int base = 60000 + static_cast<int>(getpid() % 5000);
+  std::vector<int> ports = {base, base + 5000};
+  std::vector<int> group = {0, 1};
+  constexpr size_t kSlot = 8192;
+  const size_t kSizes[] = {0, 1, 100, kSlot, kSlot * 3 + 17};
+  constexpr int kIters = 200;
+  {
+    hvd::ShmTransport t0, t1;
+    CHECK(t0.Init(0, group, ports, kSlot), "shm init rank0");
+    CHECK(t1.Init(1, group, ports, kSlot), "shm init rank1");
+    if (failures) return;
+    CHECK(t0.Prepare(1), "shm attach 0->1");
+    CHECK(t1.Prepare(0), "shm attach 1->0");
+    std::atomic<bool> stop{false};
+    std::thread poll([&] {
+      volatile long long sink = 0;
+      while (!stop.load()) sink += t0.bytes_sent() + t1.bytes_sent();
+      (void)sink;
+    });
+    auto sender = [&](hvd::ShmTransport* t, int peer, unsigned seed) {
+      for (int i = 0; i < kIters; ++i) {
+        size_t n = kSizes[i % 5];
+        std::vector<char> buf(n);
+        for (size_t k = 0; k < n; ++k) {
+          buf[k] = static_cast<char>((seed + i + k) & 0xff);
+        }
+        CHECK(t->Send(peer, buf.data(), n) == hvd::kTransportOk,
+              "shm send");
+      }
+    };
+    auto receiver = [&](hvd::ShmTransport* t, int peer, unsigned seed) {
+      for (int i = 0; i < kIters; ++i) {
+        size_t n = kSizes[i % 5];
+        std::vector<char> buf(n, 0);
+        CHECK(t->Recv(peer, buf.data(), n) == hvd::kTransportOk,
+              "shm recv");
+        for (size_t k = 0; k < n; ++k) {
+          if (buf[k] != static_cast<char>((seed + i + k) & 0xff)) {
+            CHECK(false, "shm payload mismatch");
+            break;
+          }
+        }
+      }
+    };
+    std::thread s01(sender, &t0, 1, 7u), r01(receiver, &t1, 0, 7u);
+    std::thread s10(sender, &t1, 0, 99u), r10(receiver, &t0, 1, 99u);
+    s01.join();
+    r01.join();
+    s10.join();
+    r10.join();
+    // Mid-world teardown: r is parked on t1's empty inbox from rank 0;
+    // t0's Teardown poisons that channel (it lives in t1's segment, so
+    // nothing r touches is unmapped) and the wait must end in a
+    // non-success return, not a hang or a read of freed memory.
+    std::thread blocked([&] {
+      char b[16];
+      CHECK(t1.Recv(0, b, sizeof(b)) != hvd::kTransportOk,
+            "teardown recv must not succeed");
+    });
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    t0.Teardown();
+    blocked.join();
+    t1.Teardown();
+    stop.store(true);
+    poll.join();
+  }
+  // Forced attach failure (the ring.shm.attach seam's native half):
+  // Prepare must report unusable and leave both sides clean.
+  setenv("HVD_SHM_FORCE_ATTACH_FAIL", "1", 1);
+  {
+    std::vector<int> ports2 = {base + 1, base + 5001};
+    hvd::ShmTransport t0, t1;
+    CHECK(t0.Init(0, group, ports2, kSlot), "shm init rank0 (forced)");
+    CHECK(t1.Init(1, group, ports2, kSlot), "shm init rank1 (forced)");
+    CHECK(!t0.Prepare(1), "forced attach must fail");
+    t0.Teardown();
+    t1.Teardown();
+  }
+  unsetenv("HVD_SHM_FORCE_ATTACH_FAIL");
+}
+
 }  // namespace
 
 int main() {
@@ -300,6 +401,7 @@ int main() {
     RunWorld(world, /*submitters=*/3, /*iters=*/150);
   }
   if (failures == 0) RingPhase();
+  if (failures == 0) ShmPhase();
   if (failures == 0) LivenessControllerPhase();
   if (failures) return 1;
   std::puts("STRESS_OK");

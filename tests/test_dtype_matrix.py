@@ -144,6 +144,27 @@ def test_dtype_op_matrix_hierarchical_four_process(tmp_path):
     run_world(tmp_path, _HIER_WORKER, "DTMATRIX", size=4)
 
 
+# The hierarchical matrix again with the intra-host legs on the
+# SHARED-MEMORY transport (HOROVOD_SHM=1, docs/shm-transport.md): every
+# dtype's bytes must survive the shm slot chunking and handshake exactly
+# as they survive the TCP frames — same exact expected values, proven
+# end-to-end through the torch binding.
+_SHM_WORKER = _HIER_WORKER.replace(
+    'HOROVOD_HIERARCHICAL_ALLGATHER="1",',
+    'HOROVOD_HIERARCHICAL_ALLGATHER="1",\n'
+    '                  HOROVOD_SHM="1",')
+assert 'HOROVOD_SHM="1"' in _SHM_WORKER, \
+    "env-block replace failed; the shm matrix would silently test TCP"
+
+
+@pytest.mark.full
+def test_dtype_op_matrix_shm_four_process(tmp_path):
+    pytest.importorskip("torch")
+    from proc_harness import run_world
+
+    run_world(tmp_path, _SHM_WORKER, "DTMATRIX", size=4)
+
+
 # ---- XLA-plane dtype matrix through the bucketed (tensor-fusion v2) path ---
 #
 # grouped_allreduce with bucket_cap_bytes set must keep every per-dtype

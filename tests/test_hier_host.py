@@ -326,6 +326,7 @@ def test_ring_traffic_empty_safe(monkeypatch):
     monkeypatch.setattr(_hw, "_world", _hw.HostWorld())
     assert hvd.ring_traffic() == {
         "bytes_sent": 0, "local_bytes": 0, "cross_bytes": 0,
+        "shm_bytes": 0, "shm": False,
         "hierarchical_allreduce": False, "hierarchical_allgather": False,
         "tuned": False}
 
@@ -339,10 +340,16 @@ def test_ring_traffic_reads_engine_core_and_decodes_flags(monkeypatch):
             return 700
 
         def ring_local_bytes(self):
-            return 500
+            return 400
 
         def ring_cross_bytes(self):
             return 200
+
+        def ring_shm_bytes(self):
+            return 100
+
+        def shm_active(self):
+            return True
 
         def host_hier_flags(self):
             return 2  # allgather bit only
@@ -357,7 +364,8 @@ def test_ring_traffic_reads_engine_core_and_decodes_flags(monkeypatch):
     monkeypatch.setattr(st, "initialized", True)
     monkeypatch.setattr(st, "engine", _Engine())
     assert hvd.ring_traffic() == {
-        "bytes_sent": 700, "local_bytes": 500, "cross_bytes": 200,
+        "bytes_sent": 700, "local_bytes": 400, "cross_bytes": 200,
+        "shm_bytes": 100, "shm": True,
         "hierarchical_allreduce": False, "hierarchical_allgather": True,
         "tuned": True}
 

@@ -30,8 +30,8 @@ STRESS_SRC = os.path.join(TESTS_DIR, "csrc", "stress_native.cc")
 
 HVD_SRCS = [os.path.join(CSRC, f) for f in (
     "message.cc", "tensor_queue.cc", "socket.cc", "controller.cc",
-    "response_cache.cc", "stall_inspector.cc", "ring_ops.cc",
-    "operations.cc")]
+    "response_cache.cc", "stall_inspector.cc", "op_manager.cc",
+    "shm_transport.cc", "ring_ops.cc", "operations.cc")]
 
 # A minimal, unambiguously-correct concurrent program: contended mutex
 # with RAII critical sections. Any sanitizer report on THIS is a broken
@@ -68,12 +68,25 @@ def _build(tmp_path, out_name, sources, san_flag):
     if cxx is None:
         pytest.skip("no C++ compiler on PATH")
     binary = tmp_path / out_name
+    # -lrt: shm_open/shm_unlink (shm_transport.cc) on pre-2.34 glibc.
     cmd = [cxx, "-O1", "-g", "-std=c++17", "-pthread", san_flag,
-           *sources, "-o", str(binary)]
+           *sources, "-o", str(binary), "-lrt"]
     r = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
     if r.returncode != 0:
         pytest.skip(f"{san_flag} build unavailable: {r.stderr[-500:]}")
     return binary
+
+
+def _assert_no_shm_orphans():
+    """The stress harness's ShmPhase creates real /dev/shm segments
+    (session-tagged names); its teardown paths must leave none behind —
+    the same leak contract the conftest session sweep enforces (the
+    name rule lives in ONE place: conftest.tagged_shm_segments)."""
+    from conftest import tagged_shm_segments
+
+    leaked = tagged_shm_segments(
+        os.environ.get("HVD_TEST_WORLD_TAG", ""))
+    assert not leaked, f"stress harness leaked shm segments: {leaked}"
 
 
 def _probe_tsan(tmp_path):
@@ -109,6 +122,10 @@ def test_native_core_concurrency_is_tsan_clean(tmp_path):
     # (odd rounds) from the heartbeat-armed coordinator.
     assert "DRAIN rank=1" in report, report[-4000:]
     assert "EVICT rank=1" in report, report[-4000:]
+    # The shm phase's forced-attach leg logs its fallback warning, and
+    # its segments are all unlinked by the teardown paths.
+    assert "force-failed" in report, report[-4000:]
+    _assert_no_shm_orphans()
 
 
 @pytest.mark.slow
@@ -130,3 +147,5 @@ def test_native_core_concurrency_is_asan_clean(tmp_path):
     assert "runtime error:" not in report, report[-4000:]
     assert r.returncode == 0, report[-4000:]
     assert "STRESS_OK" in r.stdout, report[-4000:]
+    assert "force-failed" in report, report[-4000:]
+    _assert_no_shm_orphans()

@@ -1,0 +1,469 @@
+"""Shared-memory intra-host transport (csrc/hvd/shm_transport.cc behind
+the op_manager registry; docs/shm-transport.md).
+
+THE acceptance world: 8 ranks as 2 hosts x 4 local with ROUND-ROBIN
+placement and ``HOROVOD_SHM=1``. The flat baseline runs first (hier
+flags off — the flat ring has no intra-host legs, so shm stays idle),
+then the tuner flips the two-level dispatch and the SAME collectives
+rerun with the local legs on the shm rings: results are byte-identical
+(uint32 views), ``shm_bytes`` carries the entire local leg (local TCP
+collapses to the handful of PeerLink hello bytes), and the cross-host
+budget keeps the exact (N-1)/(H-1) hierarchical shape — the transport
+changed, the traffic model did not.
+
+Also here: the deterministic fallthrough ladder — forced attach failure
+(``ring.shm.attach`` seam → TCP carries the legs, byte-identical),
+mid-world channel poisoning (``HVD_SHM_POISON_AT`` → lock-step
+shm→TCP switch inside one world), strict mode
+(``HOROVOD_SHM_FALLBACK=0`` → hard error instead of silent TCP), the
+``ring.shm.exec`` chaos seam, and the killed-rank segment sweep (no
+orphaned ``/dev/shm`` entries).
+"""
+
+import os
+import textwrap
+
+from proc_harness import run_world
+
+# 8 ranks = 2 hosts x 4 local, round-robin placement: host(r) = r % 2.
+# Group members {0,2,4,6} / {1,3,5,7}; leaders are ranks 0 and 1.
+_ACCEPTANCE_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    os.environ["HOROVOD_SHM"] = "1"
+    from horovod_tpu.common import native as hn
+
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    SIZE, HOSTS, LOCAL = 8, 2, 4
+    core = hn.NativeCore()
+    assert core.available
+    ok = core.init(rank=rank, size=SIZE, local_rank=rank // HOSTS,
+                   local_size=LOCAL, cross_rank=rank % HOSTS,
+                   cross_size=HOSTS, coordinator_addr="127.0.0.1",
+                   coordinator_port=port, my_host="127.0.0.1",
+                   cycle_time_ms=1.0, fusion_threshold=64 << 20,
+                   cache_capacity=64, stall_warning_sec=60.0,
+                   stall_shutdown_sec=0.0, stall_check_enabled=True,
+                   exec_callback=lambda resp, rid: core.response_done(
+                       rid, False, "host-plane only"))
+    assert ok, "native init failed"
+    assert core.shm_active(), "shm transport should be live"
+    is_leader = rank in (0, 1)
+
+    ES = 4  # fp32
+    COUNT = 1 << 16  # 256 KiB: well above the small-payload tree cutoff
+    # PeerLink hellos ("vhdd <rank>") are the only local TCP bytes a
+    # fully-shm world pays: a few bytes per dialed link.
+    HELLO_SLACK = 64
+
+    def traffic():
+        return (core.ring_local_bytes(), core.ring_cross_bytes(),
+                core.ring_shm_bytes())
+
+    def run_allreduce(name):
+        buf = (np.arange(COUNT, dtype=np.float32) % 13) + rank
+        l0, c0, s0 = traffic()
+        h = core.enqueue(name, hn.OP_ALLREDUCE, 1, 7, buf.shape,
+                         data_ptr=buf.ctypes.data,
+                         output_ptr=buf.ctypes.data, plane=hn.PLANE_HOST)
+        r, err = core.wait(h); assert r == 1, err
+        l1, c1, s1 = traffic()
+        return buf, l1 - l0, c1 - c0, s1 - s0
+
+    def run_allgather(name):
+        blk = (np.arange(4096, dtype=np.float32) % 7) * (rank + 1)
+        out = np.zeros(4096 * SIZE, np.float32)
+        h = core.enqueue(name, hn.OP_ALLGATHER, 1, 7, blk.shape,
+                         data_ptr=blk.ctypes.data,
+                         output_ptr=out.ctypes.data, plane=hn.PLANE_HOST)
+        r, err = core.wait(h); assert r == 1, err
+        return out
+
+    def run_allgatherv(name):
+        # Ragged WITH a zero-count rank: rank 3 contributes nothing.
+        rows = 0 if rank == 3 else rank % 3 + 1
+        blk = np.full((rows, 8), rank + 1, np.int32)
+        h = core.enqueue(name, hn.OP_ALLGATHER, 1, 4, blk.shape,
+                         data_ptr=blk.ctypes.data, output_ptr=0,
+                         plane=hn.PLANE_HOST)
+        r, err = core.wait(h); assert r == 1, err
+        raw, dims = core.result_fetch(h)
+        exp = tuple(0 if rr == 3 else rr % 3 + 1 for rr in range(SIZE))
+        assert dims == exp, (dims, exp)
+        return np.frombuffer(raw, np.int32).reshape(-1, 8)
+
+    def run_small(name):
+        buf = np.full(8, float(rank + 1), np.float32)
+        h = core.enqueue(name, hn.OP_ALLREDUCE, 1, 7, buf.shape,
+                         data_ptr=buf.ctypes.data,
+                         output_ptr=buf.ctypes.data, plane=hn.PLANE_HOST)
+        r, err = core.wait(h); assert r == 1, err
+        return buf
+
+    # ---- flat TCP baseline: no intra-host legs, shm stays idle ----
+    assert core.host_hier_flags() == 0
+    flat_ar, fl_l, fl_c, fl_s = run_allreduce("flat.ar")
+    flat_ag = run_allgather("flat.ag")
+    flat_agv = run_allgatherv("flat.agv")
+    flat_small = run_small("flat.small")
+    assert fl_s == 0, ("flat path must not touch shm", fl_s)
+    assert fl_l == 0 and fl_c > 0, (fl_l, fl_c)
+
+    # ---- flip the two-level dispatch (deterministic barrier sync) ----
+    if rank == 0:
+        core.set_hier_flags(3)
+    z = np.zeros(1, np.uint8)
+    h = core.enqueue("sync.flip", hn.OP_BARRIER, 1, 0, z.shape,
+                     data_ptr=z.ctypes.data, output_ptr=z.ctypes.data,
+                     plane=hn.PLANE_HOST)
+    r, err = core.wait(h); assert r == 1, err
+    assert core.host_hier_flags() == 3
+
+    # ---- hier + shm rerun: identical bytes, local leg on shm ----
+    hier_ar, hr_l, hr_c, hr_s = run_allreduce("hier.ar")
+    hier_ag = run_allgather("hier.ag")
+    hier_agv = run_allgatherv("hier.agv")
+    hier_small = run_small("hier.small")
+    assert np.array_equal(flat_ar.view(np.uint32),
+                          hier_ar.view(np.uint32)), "allreduce diverged"
+    assert np.array_equal(flat_ag.view(np.uint32),
+                          hier_ag.view(np.uint32)), "allgather diverged"
+    assert np.array_equal(flat_agv, hier_agv), "allgatherv diverged"
+    assert np.array_equal(flat_small, hier_small), "small path diverged"
+
+    # shm_bytes accounts the ENTIRE local leg of the fused allreduce:
+    # members hand their block to the leader over shm (count elements),
+    # leaders broadcast the result to 3 members (3x count); local TCP
+    # stays at hello noise on every rank.
+    assert hr_l < HELLO_SLACK, ("local TCP should be ~0", hr_l)
+    if is_leader:
+        assert hr_s >= 3 * COUNT * ES, (hr_s, 3 * COUNT * ES)
+        assert hr_c > 0, hr_c
+        assert abs(hr_c - COUNT * ES) <= COUNT * ES // 4, (hr_c,
+                                                           COUNT * ES)
+    else:
+        assert hr_s >= COUNT * ES, (hr_s, COUNT * ES)
+        assert hr_c == 0, ("members never touch the cross budget", hr_c)
+
+    # Aggregate acceptance shape: cross bytes unchanged from the PR 4
+    # traffic model — summed over ranks, the hier allreduce's cross
+    # budget still drops >= local_size x vs the flat ring (exactly
+    # (N-1)/(H-1) = 7x here), with the local leg now on shm.
+    report = np.asarray([fl_c, hr_c, hr_s], np.int64)
+    gathered = np.zeros((SIZE, 3), np.int64)
+    h = core.enqueue("tr.report", hn.OP_ALLGATHER, 1, 5, report.shape,
+                     data_ptr=report.ctypes.data,
+                     output_ptr=gathered.ctypes.data, plane=hn.PLANE_HOST)
+    r, err = core.wait(h); assert r == 1, err
+    tot = gathered.sum(axis=0)
+    assert tot[0] >= LOCAL * tot[1], ("allreduce cross drop", tot)
+    assert tot[2] > 0, ("world-aggregate shm bytes", tot)
+
+    core.shutdown()
+    print(f"SHMACC_{rank}_OK")
+""")
+
+
+def test_shm_acceptance_8rank_byte_identity_and_counters(tmp_path):
+    """THE acceptance world: 8-rank 2x4 hier topology with shm enabled
+    produces byte-identical AR/AG/ragged-AGV (incl. a zero-count rank)
+    results vs flat TCP; shm_bytes accounts the entire local leg (local
+    TCP ~ 0), cross bytes keep the (N-1)/(H-1) hierarchical shape."""
+    run_world(tmp_path, _ACCEPTANCE_WORKER, "SHMACC", size=8, timeout=300)
+    _assert_no_tagged_segments()
+
+
+def _assert_no_tagged_segments():
+    """Worlds must not leave /dev/shm entries behind (teardown unlinks,
+    survivors sweep dead owners). Session-tagged names make the check
+    exact (conftest's sweep enforces the same at session end)."""
+    from conftest import tagged_shm_segments
+
+    leaked = tagged_shm_segments(
+        os.environ.get("HVD_TEST_WORLD_TAG", ""))
+    assert not leaked, f"orphaned shm segments: {leaked}"
+
+
+# ---- forced attach failure -> TCP fallback (ring.shm.attach seam) ----------
+
+_ATTACH_FAULT_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    os.environ.update(HOROVOD_RANK=str(rank), HOROVOD_SIZE="4",
+                      HOROVOD_LOCAL_RANK=str(rank % 2),
+                      HOROVOD_LOCAL_SIZE="2",
+                      HOROVOD_CROSS_RANK=str(rank // 2),
+                      HOROVOD_CROSS_SIZE="2",
+                      HOROVOD_CONTROLLER_ADDR="127.0.0.1",
+                      HOROVOD_CONTROLLER_PORT=str(port),
+                      HOROVOD_CYCLE_TIME="1.0",
+                      HOROVOD_HIERARCHICAL_ALLREDUCE="1",
+                      HOROVOD_HIERARCHICAL_ALLGATHER="1",
+                      HOROVOD_SHM="1",
+                      JAX_PLATFORMS="cpu")
+    # Every rank's attach "fails": the seam absorbs the raise and forces
+    # the native attaches down, so the registered TCP backend carries
+    # every local leg — results identical, shm counter untouched.
+    os.environ["HOROVOD_FAULT_SPEC"] = "ring.shm.attach:kind=raise"
+    from horovod_tpu.common.host_world import world
+
+    w = world()
+    w.init()
+    assert os.environ.get("HVD_SHM_FORCE_ATTACH_FAIL") == "1", \\
+        "attach seam did not arm the forced failure"
+    core = w._core
+    # Deltas from here: ring-neighbor connect hellos are already paid.
+    l0, c0 = core.ring_local_bytes(), core.ring_cross_bytes()
+    out = w.allgather_np(np.asarray([float(rank)]), "af.0")
+    np.testing.assert_allclose(out.ravel(), [0.0, 1.0, 2.0, 3.0])
+    big = np.full(1 << 15, float(rank + 1), np.float32)
+    out2 = w.allgather_np(big, "af.big")
+    for rr in range(4):
+        assert np.all(out2[rr] == rr + 1), (rr, out2[rr][:3])
+    assert core.ring_shm_bytes() == 0, core.ring_shm_bytes()
+    # The transport-choice surface must not claim shm when every attach
+    # fell back (bench's local_transport reads exactly this).
+    assert core.shm_active() is False, "shm_active must report TCP"
+    # The local legs really ran — on TCP (gather legs to leaders).
+    if rank in (1, 3):  # members (leaders are 0 and 2, block layout)
+        assert core.ring_local_bytes() - l0 > 0, core.ring_local_bytes()
+        assert core.ring_cross_bytes() - c0 == 0, core.ring_cross_bytes()
+    w.barrier("af.done")
+    w.shutdown()
+    print(f"SHMAF_{rank}_OK")
+""")
+
+
+def test_attach_failure_falls_back_to_tcp(tmp_path):
+    """faults.point('ring.shm.attach') kind=raise is absorbed: the
+    native shm attaches are forced to fail, the TCP backend carries the
+    local legs byte-identically, and shm_bytes stays zero."""
+    run_world(tmp_path, _ATTACH_FAULT_WORKER, "SHMAF", size=4)
+    _assert_no_tagged_segments()
+
+
+# ---- mid-world poison -> lock-step shm->TCP fallthrough --------------------
+
+_POISON_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    os.environ["HOROVOD_SHM"] = "1"
+    os.environ["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+    if int(sys.argv[1]) in (2, 3):
+        # Both fallthrough directions at their SECOND shm message, in
+        # one world: rank 2 is the LEADER of host {2,3} (block layout),
+        # so its poisoned message is a LOCAL_BCAST fan-out; rank 3 is
+        # its member, so its poisoned message is a LOCAL_REDUCE hand-in.
+        # Message 0 of each rides shm, message 1 falls through to TCP
+        # mid-world — the lock-step switch under test on both legs.
+        os.environ["HVD_SHM_POISON_AT"] = "1"
+    from horovod_tpu.common import native as hn
+
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    SIZE, LOCAL = 4, 2
+    core = hn.NativeCore()
+    ok = core.init(rank=rank, size=SIZE, local_rank=rank % LOCAL,
+                   local_size=LOCAL, cross_rank=rank // LOCAL,
+                   cross_size=SIZE // LOCAL,
+                   coordinator_addr="127.0.0.1", coordinator_port=port,
+                   my_host="127.0.0.1", cycle_time_ms=1.0,
+                   fusion_threshold=64 << 20, cache_capacity=64,
+                   stall_warning_sec=60.0, stall_shutdown_sec=0.0,
+                   stall_check_enabled=True,
+                   exec_callback=lambda resp, rid: core.response_done(
+                       rid, False, "host-plane only"))
+    assert ok, "native init failed"
+
+    COUNT = 1 << 15
+    expect = ((np.arange(COUNT) % 11) * sum(
+        rr + 1 for rr in range(SIZE))).astype(np.float32)
+    results = []
+    for i in range(3):
+        buf = (np.arange(COUNT, dtype=np.float32) % 11) * (rank + 1)
+        h = core.enqueue(f"po.{i}", hn.OP_ALLREDUCE, 1, 7, buf.shape,
+                         data_ptr=buf.ctypes.data,
+                         output_ptr=buf.ctypes.data, plane=hn.PLANE_HOST)
+        r, err = core.wait(h); assert r == 1, err
+        np.testing.assert_array_equal(buf, expect)
+        results.append(buf)
+    if rank in (2, 3):
+        # Message 0 rode shm, the poisoned message fell through: both
+        # transports carried payload within ONE world, on the bcast leg
+        # (leader 2) AND the reduce leg (member 3).
+        assert core.ring_shm_bytes() == COUNT * 4, core.ring_shm_bytes()
+        assert core.ring_local_bytes() >= 2 * COUNT * 4, \\
+            core.ring_local_bytes()
+    core.shutdown()
+    print(f"SHMPO_{rank}_OK")
+""")
+
+
+def test_mid_world_poison_falls_through_lock_step(tmp_path):
+    """HVD_SHM_POISON_AT: one rank abandons shm between two collectives
+    of the SAME world; the receiver follows via the poisoned-channel +
+    control-frame protocol and every result stays exact — per-op
+    fallthrough, not world-restart fallback."""
+    run_world(tmp_path, _POISON_WORKER, "SHMPO", size=4)
+    _assert_no_tagged_segments()
+
+
+# ---- strict mode: fallback disabled -> hard error --------------------------
+
+_STRICT_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    os.environ.update(HOROVOD_SHM="1", HOROVOD_SHM_FALLBACK="0",
+                      HOROVOD_HIERARCHICAL_ALLREDUCE="1",
+                      HVD_SHM_FORCE_ATTACH_FAIL="1",
+                      HVD_SHM_TIMEOUT_MS="5000")
+    from horovod_tpu.common import native as hn
+
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    SIZE, LOCAL = 4, 2
+    core = hn.NativeCore()
+    ok = core.init(rank=rank, size=SIZE, local_rank=rank % LOCAL,
+                   local_size=LOCAL, cross_rank=rank // LOCAL,
+                   cross_size=SIZE // LOCAL,
+                   coordinator_addr="127.0.0.1", coordinator_port=port,
+                   my_host="127.0.0.1", cycle_time_ms=1.0,
+                   fusion_threshold=64 << 20, cache_capacity=64,
+                   stall_warning_sec=60.0, stall_shutdown_sec=0.0,
+                   stall_check_enabled=True,
+                   exec_callback=lambda resp, rid: core.response_done(
+                       rid, False, "host-plane only"))
+    assert ok, "native init failed"
+    buf = np.ones(1 << 15, np.float32)
+    h = core.enqueue("st.ar", hn.OP_ALLREDUCE, 1, 7, buf.shape,
+                     data_ptr=buf.ctypes.data, output_ptr=buf.ctypes.data,
+                     plane=hn.PLANE_HOST)
+    r, err = core.wait(h)
+    # Fallback disabled: the attach failure is a hard collective error
+    # on every rank (members fail the send; leaders fail the recv once
+    # the members' teardown closes the links) — never a silent TCP leg.
+    assert r < 0, "strict mode must not silently ride TCP"
+    assert core.ring_shm_bytes() == 0
+    core.shutdown()
+    print(f"SHMST_{rank}_OK")
+""")
+
+
+def test_strict_mode_attach_failure_is_hard_error(tmp_path):
+    """HOROVOD_SHM_FALLBACK=0: an attach failure aborts the collective
+    (fail-fast deployments) instead of silently riding loopback TCP."""
+    run_world(tmp_path, _STRICT_WORKER, "SHMST", size=4)
+    _assert_no_tagged_segments()
+
+
+# ---- ring.shm.exec chaos seam ----------------------------------------------
+
+_EXEC_SEAM_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    os.environ.update(HOROVOD_RANK=str(rank), HOROVOD_SIZE="4",
+                      HOROVOD_LOCAL_RANK=str(rank % 2),
+                      HOROVOD_LOCAL_SIZE="2",
+                      HOROVOD_CROSS_RANK=str(rank // 2),
+                      HOROVOD_CROSS_SIZE="2",
+                      HOROVOD_CONTROLLER_ADDR="127.0.0.1",
+                      HOROVOD_CONTROLLER_PORT=str(port),
+                      HOROVOD_CYCLE_TIME="1.0",
+                      HOROVOD_HIERARCHICAL_ALLREDUCE="1",
+                      HOROVOD_SHM="1",
+                      JAX_PLATFORMS="cpu")
+    # Rank 1 raises at its SECOND pass through the shm exec seam.
+    os.environ["HOROVOD_FAULT_SPEC"] = \\
+        "ring.shm.exec:rank=1:step=1:kind=raise"
+    from horovod_tpu.common import faults
+    from horovod_tpu.common.exceptions import HorovodInternalError
+    from horovod_tpu.common.host_world import world
+
+    w = world()
+    w.init()
+    assert w._shm_seam, "shm world must arm the ring.shm.exec seam"
+    out = w.allgather_np(np.asarray([float(rank)]), "se.0")
+    np.testing.assert_allclose(out.ravel(), [0.0, 1.0, 2.0, 3.0])
+    if rank == 1:
+        try:
+            w.allgather_np(np.asarray([9.0]), "se.poisoned")
+            raise AssertionError("shm exec fault did not fire")
+        except faults.FaultInjected as e:
+            # IS-A HorovodInternalError: the elastic retry loop treats
+            # it exactly like a real collective failure.
+            assert isinstance(e, HorovodInternalError)
+            assert "ring.shm.exec" in str(e), e
+    else:
+        out = w.allgather_np(np.asarray([9.0 + rank]), "se.poisoned")
+        assert out.shape[0] == 4
+    w.barrier("se.done")
+    w.shutdown()
+    print(f"SHMEX_{rank}_OK")
+""")
+
+
+def test_shm_exec_seam_raises_internal_error(tmp_path):
+    """faults.point('ring.shm.exec'): armed on every rank of an
+    shm-transport world; kind=raise surfaces as HorovodInternalError
+    deterministically on the exact rank + hit."""
+    run_world(tmp_path, _EXEC_SEAM_WORKER, "SHMEX", size=4)
+    _assert_no_tagged_segments()
+
+
+# ---- killed rank: survivors sweep the orphaned segment ---------------------
+
+_KILL_SWEEP_WORKER = textwrap.dedent("""
+    import os, sys, time
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    os.environ["HOROVOD_SHM"] = "1"
+    os.environ["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+    os.environ["HVD_SHM_TIMEOUT_MS"] = "5000"
+    from horovod_tpu.common import native as hn
+
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    SIZE, LOCAL = 4, 2
+    core = hn.NativeCore()
+    ok = core.init(rank=rank, size=SIZE, local_rank=rank % LOCAL,
+                   local_size=LOCAL, cross_rank=rank // LOCAL,
+                   cross_size=SIZE // LOCAL,
+                   coordinator_addr="127.0.0.1", coordinator_port=port,
+                   my_host="127.0.0.1", cycle_time_ms=1.0,
+                   fusion_threshold=64 << 20, cache_capacity=64,
+                   stall_warning_sec=5.0, stall_shutdown_sec=8.0,
+                   stall_check_enabled=True,
+                   exec_callback=lambda resp, rid: core.response_done(
+                       rid, False, "host-plane only"))
+    assert ok, "native init failed"
+    buf = np.ones(1 << 14, np.float32)
+    h = core.enqueue("ks.0", hn.OP_ALLREDUCE, 1, 7, buf.shape,
+                     data_ptr=buf.ctypes.data, output_ptr=buf.ctypes.data,
+                     plane=hn.PLANE_HOST)
+    r, err = core.wait(h); assert r == 1, err
+    if rank == 3:
+        # Hard death mid-world (OOM-kill shape): no teardown, no unlink
+        # — this rank's segment becomes the orphan under test. The
+        # sentinel goes out first: the harness only needs the death to
+        # skip teardown, not to look like a failure.
+        print(f"SHMKS_{rank}_OK", flush=True)
+        os._exit(0)
+    # Survivors: wait out rank 3's death, then tear down — Teardown
+    # unlinks their own segments AND sweeps the dead rank's (its owner
+    # pid no longer exists).
+    time.sleep(1.5)
+    core.shutdown()
+    print(f"SHMKS_{rank}_OK")
+""")
+
+
+def test_killed_rank_leaves_no_orphaned_segments(tmp_path):
+    """A rank dying hard (no teardown) leaves its segment in /dev/shm;
+    the survivors' shutdown sweep reaps it — no orphans after the world
+    ends (the acceptance criterion the conftest sweep also enforces)."""
+    run_world(tmp_path, _KILL_SWEEP_WORKER, "SHMKS", size=4, timeout=120)
+    _assert_no_tagged_segments()

@@ -65,16 +65,30 @@ def _stats(samples_ms):
 
 
 def worker(rank: int, size: int, port: int, iters: int,
-           cycle_ms: float) -> int:
+           cycle_ms: float, hier: bool = False) -> int:
     import numpy as np
 
     sys.path.insert(0, REPO)
     from horovod_tpu.common import native as hn
 
+    if hier:
+        # 2 simulated hosts x size/2 local, round-robin placement, with
+        # the two-level allreduce dispatched from the env: the RTT rows
+        # then include the intra-host legs, whose transport (loopback
+        # TCP vs shm when HOROVOD_SHM=1 is exported to this bench) is
+        # recorded per rank — the local-leg proof surface
+        # (docs/shm-transport.md).
+        os.environ["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+        local_rank, local_size = rank // 2, size // 2
+        cross_rank, cross_size = rank % 2, 2
+    else:
+        local_rank, local_size = 0, 1
+        cross_rank, cross_size = rank, size
     core = hn.NativeCore()
     assert core.available, "native core unavailable"
-    ok = core.init(rank=rank, size=size, local_rank=0, local_size=1,
-                   cross_rank=rank, cross_size=size,
+    ok = core.init(rank=rank, size=size, local_rank=local_rank,
+                   local_size=local_size, cross_rank=cross_rank,
+                   cross_size=cross_size,
                    coordinator_addr="127.0.0.1", coordinator_port=port,
                    my_host="127.0.0.1", cycle_time_ms=cycle_ms,
                    fusion_threshold=64 << 20, cache_capacity=1024,
@@ -109,8 +123,14 @@ def worker(rank: int, size: int, port: int, iters: int,
     # so id-fast-path hits are counted on worker ranks only.
     hits_seen = core.cache_hits()
 
+    traffic = {"local_bytes": core.ring_local_bytes(),
+               "cross_bytes": core.ring_cross_bytes(),
+               "shm_bytes": core.ring_shm_bytes(),
+               "shm": core.shm_active()}
     core.shutdown()
     print(f"WORKER_CACHE {rank} {int(hits_seen)}", flush=True)
+    print("WORKER_TRAFFIC " + json.dumps({"rank": rank, **traffic}),
+          flush=True)
     if rank == 0:
         print("WORKER_RESULT " + json.dumps({
             "size": size,
@@ -131,17 +151,20 @@ _PORT_CLASH_MARKERS = (
 
 
 def run_size(size: int, iters: int, cycle_ms: float, timeout: float,
-             attempts: int = 3):
+             attempts: int = 3, hier: bool = False):
     last_blob = ""
     for attempt in range(attempts):
         port = _free_port()
         procs = [subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--worker",
-             str(r), str(size), str(port), str(iters), str(cycle_ms)],
+             str(r), str(size), str(port), str(iters), str(cycle_ms),
+             "1" if hier else "0"],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             cwd=REPO) for r in range(size)]
         result = None
         cache_hits = 0
+        traffic = {"local_bytes": 0, "cross_bytes": 0, "shm_bytes": 0}
+        shm_ranks = 0
         failed = None
         try:
             for r, p in enumerate(procs):
@@ -154,6 +177,11 @@ def run_size(size: int, iters: int, cycle_ms: float, timeout: float,
                         result = json.loads(line[len("WORKER_RESULT "):])
                     elif line.startswith("WORKER_CACHE "):
                         cache_hits += int(line.split()[2])
+                    elif line.startswith("WORKER_TRAFFIC "):
+                        t = json.loads(line[len("WORKER_TRAFFIC "):])
+                        for k in traffic:
+                            traffic[k] += t[k]
+                        shm_ranks += 1 if t["shm"] else 0
         finally:
             for p in procs:
                 if p.poll() is None:
@@ -162,6 +190,9 @@ def run_size(size: int, iters: int, cycle_ms: float, timeout: float,
         if failed is None and result is not None:
             # Worker ranks resubmitting "hit" rode the id fast path.
             result["cache_hits_worker_ranks"] = cache_hits
+            # World-aggregate data-plane split: with --hier (and
+            # HOROVOD_SHM exported) this is the local-leg proof line.
+            result["traffic"] = {**traffic, "shm_active_ranks": shm_ranks}
             return result
         if attempt + 1 < attempts and any(
                 m in last_blob for m in _PORT_CLASH_MARKERS):
@@ -196,6 +227,14 @@ def main(argv=None):
                         "sleep itself, so 1.0 isolates the actual "
                         "negotiation+wire work")
     p.add_argument("--timeout", type=float, default=300.0)
+    p.add_argument("--hier", action="store_true",
+                   help="shape each world as 2 simulated hosts x size/2 "
+                        "with the two-level allreduce dispatched, so "
+                        "the rows include the intra-host legs and the "
+                        "aggregated `traffic` split records which "
+                        "transport carried them (export HOROVOD_SHM=1 "
+                        "for the shm-vs-loopback line; "
+                        "docs/shm-transport.md)")
     p.add_argument("--out", default=None,
                    help="also write the JSON to this path")
     args = p.parse_args(argv)
@@ -207,7 +246,7 @@ def main(argv=None):
         per_size = {}
         for size in sizes:
             per_size[str(size)] = run_size(size, args.iters, cycle_ms,
-                                           args.timeout)
+                                           args.timeout, hier=args.hier)
             print(f"controller_bench: cycle {cycle_ms} ms, size {size} "
                   f"done (hit p50 "
                   f"{per_size[str(size)]['hit_ms']['p50']} ms, miss p50 "
@@ -250,5 +289,6 @@ if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
         sys.exit(worker(int(sys.argv[2]), int(sys.argv[3]),
                         int(sys.argv[4]), int(sys.argv[5]),
-                        float(sys.argv[6])))
+                        float(sys.argv[6]),
+                        len(sys.argv) > 7 and sys.argv[7] == "1"))
     sys.exit(main())

@@ -566,6 +566,196 @@ def local_leg_bench(argv):
     return 0
 
 
+# ---- cross-leg transport bench (--cross-leg) -------------------------------
+#
+# Host-plane A/B, the --local-leg sibling for the OTHER half of the
+# traffic model: the SAME hierarchical world (2 simulated hosts x
+# local_size ranks, round-robin placement, two-level dispatch on) timed
+# over fused allreduces with the cross-host leader leg on a single
+# blocking TCP socket vs striped multi-socket + pipelined chunking
+# (docs/cross-transport.md). Emits one JSON line with us/MB of cross
+# traffic per mode; the counters prove cross_bytes is byte-identical
+# across modes and a per-rank CRC proves the collective results are
+# bitwise equal (uint32-view identity) — striping changes the carrier,
+# never the math.
+
+def _cross_leg_worker(argv):
+    rank, port, size, hosts, nbytes, iters = (int(a) for a in argv)
+    import zlib
+
+    import numpy as np
+
+    from horovod_tpu.common import native as hn
+
+    core = hn.NativeCore()
+    assert core.available, "native runtime unavailable"
+    ok = core.init(rank=rank, size=size, local_rank=rank // hosts,
+                   local_size=size // hosts, cross_rank=rank % hosts,
+                   cross_size=hosts, coordinator_addr="127.0.0.1",
+                   coordinator_port=port, my_host="127.0.0.1",
+                   cycle_time_ms=1.0, fusion_threshold=64 << 20,
+                   cache_capacity=64, stall_warning_sec=120.0,
+                   stall_shutdown_sec=0.0, stall_check_enabled=False,
+                   exec_callback=lambda resp, rid: core.response_done(
+                       rid, False, "host-plane only"))
+    assert ok, "native init failed"
+    count = nbytes // 4
+    # Deterministic small-int inputs, exactly representable in fp32: the
+    # reduction is exact, so the CRC must agree bit-for-bit across
+    # transports AND across runs.
+    base = (np.arange(count) % 13).astype(np.float32)
+
+    def allreduce(name):
+        buf = base * (rank + 1)
+        h = core.enqueue(name, hn.OP_ALLREDUCE, 1, 7, buf.shape,
+                         data_ptr=buf.ctypes.data,
+                         output_ptr=buf.ctypes.data, plane=hn.PLANE_HOST)
+        r, err = core.wait(h)
+        assert r == 1, err
+        return buf
+
+    if rank == 0:
+        core.set_hier_flags(3)
+    for i in range(3):
+        out = allreduce(f"warm.{i}")
+    c0 = core.ring_cross_bytes()
+    s0 = core.ring_stripe_bytes()
+    n0 = core.ring_cross_ns()
+    t0 = time.perf_counter()
+    for i in range(iters):
+        out = allreduce(f"leg.{i}")
+    dt = time.perf_counter() - t0
+    row = {"rank": rank, "seconds": dt,
+           "cross_bytes": core.ring_cross_bytes() - c0,
+           "stripe_bytes": core.ring_stripe_bytes() - s0,
+           # Leg-local clock: time inside the leader exchanges alone —
+           # the honest A/B on a box where end-to-end iteration time is
+           # dominated by fusion copies and idle members' yield-spins.
+           "cross_leg_ns": core.ring_cross_ns() - n0,
+           "stripes": core.ring_stripe_count(),
+           "result_crc": zlib.crc32(out.tobytes())}
+    print("CLBENCH " + json.dumps(row), flush=True)
+    core.shutdown()
+    print(f"CLWORKER_{rank}_OK", flush=True)
+    return 0
+
+
+def _cross_leg_world(size, hosts, nbytes, iters, stripes, chunk_bytes):
+    import socket as _socket
+
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    # Both modes ride the shm local legs (docs/shm-transport.md): the
+    # post-PR 7 production shape, where every remaining wire byte is
+    # cross-host — so the A/B isolates the leader leg under test
+    # instead of measuring loopback-TCP member traffic.
+    env = dict(os.environ, HOROVOD_STRIPES=str(stripes),
+               HOROVOD_CHUNK_BYTES=str(chunk_bytes),
+               HOROVOD_SHM="1", JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--cross-leg-worker",
+         str(r), str(port), str(size), str(hosts), str(nbytes),
+         str(iters)], env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True) for r in range(size)]
+    per_rank = []
+    try:
+        for r, p in enumerate(procs):
+            out, _ = p.communicate(timeout=300)
+            assert p.returncode == 0 and f"CLWORKER_{r}_OK" in out, \
+                f"cross-leg rank {r} failed:\n{out}"
+            for line in out.splitlines():
+                if line.startswith("CLBENCH "):
+                    per_rank.append(json.loads(line[len("CLBENCH "):]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    seconds = max(d["seconds"] for d in per_rank)
+    cross = sum(d["cross_bytes"] for d in per_rank)
+    stripe = sum(d["stripe_bytes"] for d in per_rank)
+    # The leg metric sums over the leaders (members contribute 0 ns and
+    # 0 cross bytes): total leader-leg time per MB of cross payload.
+    leg_s = sum(d["cross_leg_ns"] for d in per_rank) / 1e9
+    cross_mb = cross / 1e6
+    return {
+        "transport": "striped" if stripes > 1 else "single-socket",
+        "stripes": max(d["stripes"] for d in per_rank),
+        "seconds": round(seconds, 4),
+        "cross_leg_seconds": round(leg_s, 4),
+        "us_per_cross_mb": (round(leg_s * 1e6 / cross_mb, 2)
+                            if cross_mb > 0 else None),
+        "cross_bytes": cross,
+        "stripe_bytes": stripe,
+        "result_crcs": {str(d["rank"]): d["result_crc"]
+                        for d in per_rank},
+    }
+
+
+def cross_leg_bench(argv):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--size", type=int, default=4,
+                        help="world size (2 simulated hosts x size/2)")
+    parser.add_argument("--payload-mb", type=float, default=8.0,
+                        help="fused allreduce payload per iteration "
+                             "(8 MB+ keeps the leader leg well above "
+                             "the tree cutoff and long enough to "
+                             "pipeline)")
+    parser.add_argument("--num-iters", type=int, default=10)
+    parser.add_argument("--stripes", type=int, default=8,
+                        help="stripe count for the striped mode "
+                             "(HOROVOD_STRIPES)")
+    parser.add_argument("--chunk-kb", type=int, default=1024,
+                        help="pipeline chunk (HOROVOD_CHUNK_BYTES) for "
+                             "both modes; 1 MiB won the sweep on this "
+                             "box (loopback pays per-piece syscalls; "
+                             "real NICs may prefer smaller chunks for "
+                             "deeper pipelining)")
+    args = parser.parse_args(argv)
+    size = max(4, args.size - args.size % 2)
+    nbytes = int(args.payload_mb * (1 << 20))
+    chunk = args.chunk_kb * 1024
+    rows = [
+        _cross_leg_world(size, 2, nbytes, args.num_iters, stripes=1,
+                         chunk_bytes=chunk),
+        _cross_leg_world(size, 2, nbytes, args.num_iters,
+                         stripes=args.stripes, chunk_bytes=chunk),
+    ]
+    single, striped = rows
+    result = {
+        "metric": "cross_leg_us_per_mb",
+        "value": striped["us_per_cross_mb"],
+        "unit": "us/MB (cross-host leader leg, striped+pipelined)",
+        "baseline_single_socket_us_per_mb": single["us_per_cross_mb"],
+        # Leg-over-leg: time INSIDE the leader exchanges, single-socket
+        # vs striped+pipelined — what the transport change actually
+        # touches. End-to-end wall clock rides along per transport row.
+        "speedup_vs_single_socket": (
+            round(single["cross_leg_seconds"] /
+                  striped["cross_leg_seconds"], 3)
+            if striped["cross_leg_seconds"] > 0 else None),
+        "wall_clock_speedup": (
+            round(single["seconds"] / striped["seconds"], 3)
+            if striped["seconds"] > 0 else None),
+        # The acceptance invariants, recorded so a BENCH artifact can
+        # never silently carry a divergent run: payload accounting is
+        # carrier-independent, and the reduced tensors are bitwise
+        # equal on every rank.
+        "cross_bytes_match": single["cross_bytes"] ==
+        striped["cross_bytes"],
+        "results_match": single["result_crcs"] == striped["result_crcs"],
+        "world": {"size": size, "hosts": 2,
+                  "payload_mb": args.payload_mb,
+                  "iters": args.num_iters, "stripes": args.stripes,
+                  "chunk_bytes": chunk, "local_transport": "shm"},
+        "transports": rows,
+    }
+    print(json.dumps(result))
+    return 0
+
+
 def worker(argv):
     args = _build_parser().parse_args(argv)
     if args.image_size is None:
@@ -762,4 +952,8 @@ if __name__ == "__main__":
         sys.exit(_local_leg_worker(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == "--local-leg":
         sys.exit(local_leg_bench(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "--cross-leg-worker":
+        sys.exit(_cross_leg_worker(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "--cross-leg":
+        sys.exit(cross_leg_bench(sys.argv[2:]))
     sys.exit(supervise(sys.argv[1:]))

@@ -217,15 +217,22 @@ def ring_traffic() -> dict:
     syscalls — with shm active the local leg lives here and
     ``local_bytes`` collapses to ~0; ``docs/shm-transport.md``),
     ``shm`` (True when this rank's shm transport is live — the
-    transport choice), the effective ``hierarchical_allreduce``/
-    ``hierarchical_allgather`` host-plane dispatch (autotuner-synced
-    value when present, else the env config), and ``tuned`` (True once
-    an autotuner decision reached this rank). All zeros/False before
-    init or in pure-XLA direct mode."""
+    transport choice), ``stripe_bytes`` (payload that rode the striped
+    cross-host transport — a subset of ``cross_bytes``, which stays
+    byte-identical to the single-socket path; see
+    ``docs/cross-transport.md``), ``stripes`` (the stripe count in
+    active use: K once a leader pair carries striped traffic, 0 with
+    striping off or fully fallen back), the effective
+    ``hierarchical_allreduce``/``hierarchical_allgather`` host-plane
+    dispatch (autotuner-synced value when present, else the env
+    config), and ``tuned`` (True once an autotuner decision reached
+    this rank). All zeros/False before init or in pure-XLA direct
+    mode."""
     core = _native_core()
     if core is None:
         return {"bytes_sent": 0, "local_bytes": 0, "cross_bytes": 0,
                 "shm_bytes": 0, "shm": False,
+                "stripe_bytes": 0, "stripes": 0,
                 "hierarchical_allreduce": False,
                 "hierarchical_allgather": False, "tuned": False}
     flags = core.host_hier_flags()
@@ -235,6 +242,8 @@ def ring_traffic() -> dict:
         "cross_bytes": core.ring_cross_bytes(),
         "shm_bytes": core.ring_shm_bytes(),
         "shm": core.shm_active(),
+        "stripe_bytes": core.ring_stripe_bytes(),
+        "stripes": core.ring_stripe_count(),
         "hierarchical_allreduce": bool(flags & 1),
         "hierarchical_allgather": bool(flags & 2),
         "tuned": core.get_hier_flags() >= 0,

@@ -60,6 +60,11 @@ HOROVOD_ELASTIC_REJOIN_GRACE = "HOROVOD_ELASTIC_REJOIN_GRACE"
 HOROVOD_SHM = "HOROVOD_SHM"
 HOROVOD_SHM_SLOT_BYTES = "HOROVOD_SHM_SLOT_BYTES"
 HOROVOD_SHM_FALLBACK = "HOROVOD_SHM_FALLBACK"
+# Striped multi-socket cross-host transport (csrc/hvd/stripe_transport.cc
+# behind the op_manager registry; docs/cross-transport.md)
+HOROVOD_STRIPES = "HOROVOD_STRIPES"
+HOROVOD_CHUNK_BYTES = "HOROVOD_CHUNK_BYTES"
+HOROVOD_STRIPE_FALLBACK = "HOROVOD_STRIPE_FALLBACK"
 # Liveness plane: heartbeats, failure detection, graceful drain
 # (common/liveness.py, csrc/hvd/controller.cc; docs/liveness.md)
 HOROVOD_HEARTBEAT_MS = "HOROVOD_HEARTBEAT_MS"
@@ -505,6 +510,46 @@ def shm_fallback_enabled() -> bool:
     errors — for deployments that would rather fail fast than silently
     ride loopback TCP."""
     return _get_bool(HOROVOD_SHM_FALLBACK, default=True)
+
+
+def stripes() -> int:
+    """Parallel TCP connections per cross-host leader pair (default 1 =
+    the single-socket path, zero registry overhead). K > 1 registers the
+    striped backend (csrc/hvd/stripe_transport.cc) ahead of single-socket
+    TCP for the cross legs: chunks round-robin across the K connections
+    with per-piece sequence headers, the standard fix for one TCP window
+    not filling a fat NIC (docs/cross-transport.md). A dispatch knob:
+    must agree across ranks. The native core parses the same variable
+    (clamped to [1, 32], matching its poll set)."""
+    return max(1, min(32, _get_int(HOROVOD_STRIPES, 1)))
+
+
+def chunk_bytes():
+    """Operator override for the striped transport's pipeline chunk in
+    bytes, ``None`` when unset (the native core then uses 256 KiB). The
+    unit round-robined across stripes and handed to the pipelined ring
+    step's per-piece accumulate hook; the native parse clamps to
+    [4 KiB, 16 MiB] and rounds to a 64-byte multiple so piece boundaries
+    never split an element. Like ``HOROVOD_STRIPES``, must agree across
+    ranks: the receiver derives piece spans from its own value, so a
+    mismatch desyncs the stripe streams and aborts the collective."""
+    v = os.environ.get(HOROVOD_CHUNK_BYTES)
+    if not v:
+        return None
+    try:
+        n = int(v)
+    except ValueError:
+        return None
+    return n if n > 0 else None
+
+
+def stripe_fallback_enabled() -> bool:
+    """Whether a stripe connect failure falls through to single-socket
+    TCP in lock-step (default on; results are byte-identical either
+    way). Disabled, the failure is a hard collective error — for
+    deployments that would rather fail fast than silently lose the
+    striped bandwidth (the stripe sibling of ``shm_fallback_enabled``)."""
+    return _get_bool(HOROVOD_STRIPE_FALLBACK, default=True)
 
 
 def heartbeat_ms() -> int:

@@ -55,6 +55,21 @@ CATALOG = (
                              # with the shm transport active — the shm
                              # analog of ring.exec for kills/delays/
                              # raises while bytes ride the shm rings
+    "ring.stripe.connect",   # striped cross-host transport connect at
+                             # world init (docs/cross-transport.md):
+                             # kind=raise is ABSORBED like
+                             # ring.shm.attach — it forces THIS rank's
+                             # native stripe dials to fail, so the
+                             # negotiation falls through to single-
+                             # socket TCP in lock-step (strict mode
+                             # HOROVOD_STRIPE_FALLBACK=0 hard-errors
+                             # instead); kind=exit/delay keep their
+                             # usual semantics
+    "ring.stripe.exec",      # blocking wait on a collective in a world
+                             # with the striped cross transport armed —
+                             # the stripe analog of ring.exec for
+                             # kills/delays/raises while chunks are
+                             # mid-flight across the stripe sockets
     "xla.exec",              # eager engine executing an XLA-plane response
     "elastic.worker.start",  # driver-side worker launch (slot.rank)
     "checkpoint.write",      # CheckpointManager.save

@@ -117,6 +117,10 @@ class HostWorld:
         # (HOROVOD_SHM on, same-host peers exist). Gates the
         # ring.shm.exec fault point (docs/shm-transport.md).
         self._shm_seam = False
+        # True when the striped cross-host transport is armed
+        # (HOROVOD_STRIPES > 1, cross-host leader pairs exist). Gates
+        # the ring.stripe.exec fault point (docs/cross-transport.md).
+        self._stripe_seam = False
         # (addr, port) fetched from the elastic rendezvous KV this round;
         # overrides the launch-time HOROVOD_CONTROLLER_ADDR/PORT env, which
         # goes stale once rank 0 migrates to a different host.
@@ -162,28 +166,46 @@ class HostWorld:
                 self.size = len(comm)
                 self.rank = sorted(comm).index(self.rank)
 
-            # The forced-failure hook is scoped to ONE world: clear any
+            # The forced-failure hooks are scoped to ONE world: clear any
             # previous world's arming so an exhausted step-targeted
-            # ring.shm.attach spec doesn't keep a re-initialized
-            # (elastic-recovered) world off shm forever.
+            # ring.shm.attach / ring.stripe.connect spec doesn't keep a
+            # re-initialized (elastic-recovered) world degraded forever.
             os.environ.pop("HVD_SHM_FORCE_ATTACH_FAIL", None)
+            os.environ.pop("HVD_STRIPE_FORCE_CONNECT_FAIL", None)
             if _config.shm_enabled() and self.size > 1 and \
                     self.local_size > 1:
                 try:
                     _faults.point("ring.shm.attach", rank=self.rank)
                 except _faults.FaultInjected as e:
-                    # The one absorbed raise in the catalog: a raise here
-                    # SIMULATES an shm attach failure — this rank's
-                    # native attaches are forced to fail, so the
-                    # registered TCP backend carries its local legs,
-                    # byte-identically (docs/shm-transport.md). The
-                    # FALLBACK is the path under test; kind=exit/delay
-                    # keep their usual semantics.
+                    # An absorbed raise (see also ring.stripe.connect):
+                    # a raise here SIMULATES an shm attach failure —
+                    # this rank's native attaches are forced to fail,
+                    # so the registered TCP backend carries its local
+                    # legs, byte-identically (docs/shm-transport.md).
+                    # The FALLBACK is the path under test;
+                    # kind=exit/delay keep their usual semantics.
                     os.environ["HVD_SHM_FORCE_ATTACH_FAIL"] = "1"
                     _log.warning(
                         f"ring.shm.attach fault armed: forcing shm "
                         f"attach failure; TCP carries the local legs "
                         f"({e})")
+            if _config.stripes() > 1 and self.size > 1 and \
+                    self.cross_size > 1:
+                try:
+                    _faults.point("ring.stripe.connect", rank=self.rank)
+                except _faults.FaultInjected as e:
+                    # The stripe sibling of ring.shm.attach's absorbed
+                    # raise: force THIS rank's native stripe dials to
+                    # fail, so the cross legs negotiate down to
+                    # single-socket TCP in lock-step, byte-identically
+                    # (docs/cross-transport.md). Under strict mode
+                    # (HOROVOD_STRIPE_FALLBACK=0) the failed dial is a
+                    # hard collective error instead.
+                    os.environ["HVD_STRIPE_FORCE_CONNECT_FAIL"] = "1"
+                    _log.warning(
+                        f"ring.stripe.connect fault armed: forcing "
+                        f"stripe connect failure; single-socket TCP "
+                        f"carries the cross legs ({e})")
             core = self._borrow_engine_core()
             if core is not None:
                 self._core, self._owns_core = core, False
@@ -208,6 +230,8 @@ class HostWorld:
                      cfg.hierarchical_allgather))
             self._shm_seam = (_config.shm_enabled() and self.size > 1
                               and self.local_size > 1)
+            self._stripe_seam = (_config.stripes() > 1 and self.size > 1
+                                 and self.cross_size > 1)
             if self._core is not None:
                 from . import host_staging
 
@@ -473,6 +497,7 @@ class HostWorld:
             self._elastic_controller = None
             self._hier_cross_seam = False
             self._shm_seam = False
+            self._stripe_seam = False
             self.initialized = False
             self.rank, self.size = 0, 1
             self.local_rank, self.local_size = 0, 1
@@ -536,6 +561,12 @@ class HostWorld:
             # bytes may be mid-flight in the shm rings — the shm analog
             # of ring.exec (docs/shm-transport.md).
             _faults.point("ring.shm.exec", rank=self.rank)
+        if self._stripe_seam:
+            # Striped cross-transport world: a kill/delay/raise here
+            # lands while chunks may be mid-flight across the stripe
+            # sockets — the stripe analog of ring.exec
+            # (docs/cross-transport.md).
+            _faults.point("ring.stripe.exec", rank=self.rank)
         return core.wait(handle)
 
     # -- small helper collectives (numpy, blocking) --------------------------

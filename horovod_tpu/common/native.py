@@ -213,6 +213,14 @@ def _bind_prototypes(lib):
     lib.hvd_ring_shm_bytes.argtypes = []
     lib.hvd_shm_active.restype = ctypes.c_int
     lib.hvd_shm_active.argtypes = []
+    lib.hvd_ring_stripe_bytes.restype = ctypes.c_longlong
+    lib.hvd_ring_stripe_bytes.argtypes = []
+    lib.hvd_ring_cross_ns.restype = ctypes.c_longlong
+    lib.hvd_ring_cross_ns.argtypes = []
+    lib.hvd_ring_stripe_count.restype = ctypes.c_int
+    lib.hvd_ring_stripe_count.argtypes = []
+    lib.hvd_set_stripes.restype = None
+    lib.hvd_set_stripes.argtypes = [ctypes.c_int]
     lib.hvd_host_hier_flags.restype = ctypes.c_int
     lib.hvd_host_hier_flags.argtypes = []
     _lib = lib
@@ -239,6 +247,10 @@ class NativeResponse:
     # autotuned hierarchical-dispatch flags stamped into this frame
     # (bit0 = allreduce, bit1 = allgather; -1 = untuned -> env config)
     hier_flags: int = -1
+    # autotuned cross-host stripe count riding the same piggyback
+    # (-1 = untuned; consumed by the native cycle loop, carried here so
+    # the parse stays a faithful mirror of the wire layout)
+    stripes: int = -1
 
 
 class _Cursor:
@@ -285,12 +297,13 @@ def parse_response_list(data: bytes) -> List[NativeResponse]:
     c.f64()
     c.i64()
     hier_flags = c.i32()
+    stripes = c.i32()
     out = []
     for _ in range(c.i32()):
         r = NativeResponse(op=c.u8(), reduce_op=c.u8(), dtype=c.u8(),
                            plane=c.u8(), root_rank=c.i32(), error=c.s(),
                            prescale=c.f64(), postscale=c.f64(),
-                           hier_flags=hier_flags)
+                           hier_flags=hier_flags, stripes=stripes)
         for _ in range(c.i32()):
             r.names.append(c.s())
             ndim = c.i32()
@@ -513,6 +526,34 @@ class NativeCore:
         HOROVOD_SHM off, on init failure, in a world with no same-host
         peers, or once all attaches fell back to TCP."""
         return bool(self.lib.hvd_shm_active())
+
+    def ring_stripe_bytes(self) -> int:
+        """Payload bytes this rank moved over the striped cross-host
+        transport (docs/cross-transport.md) — a subset of
+        ``ring_cross_bytes``, which stays byte-identical to the
+        single-socket path (stripe headers ride no counter)."""
+        return int(self.lib.hvd_ring_stripe_bytes())
+
+    def ring_cross_ns(self) -> int:
+        """Wall-clock nanoseconds this rank spent inside cross-host
+        leader-leg exchanges (send + receive + pipelined accumulate,
+        whichever transport carried them) — the leg-local timing the
+        ``--cross-leg`` A/B compares."""
+        return int(self.lib.hvd_ring_cross_ns())
+
+    def ring_stripe_count(self) -> int:
+        """The stripe count in ACTIVE use: K once at least one leader
+        pair carries striped traffic, 0 with striping off
+        (HOROVOD_STRIPES unset/1) or once every pair fell back to
+        single-socket TCP (the transport choice bench.py records)."""
+        return int(self.lib.hvd_ring_stripe_count())
+
+    def set_stripes(self, stripes: int) -> None:
+        """Autotuner hook (coordinator): propose a cross-host stripe
+        count; it rides the next response broadcast and every rank
+        applies it at that frame boundary, so both sides of every
+        leader pair renegotiate their cross transport in lock-step."""
+        self.lib.hvd_set_stripes(stripes)
 
     def host_hier_flags(self) -> int:
         """The EFFECTIVE host-plane hierarchical dispatch (bit0 =

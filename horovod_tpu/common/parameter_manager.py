@@ -41,7 +41,8 @@ class ParameterManager:
                  tune_hierarchical: bool = False,
                  xla_cap_setter=None,
                  compression_setter=None,
-                 compression_candidates=()):
+                 compression_candidates=(),
+                 stripe_candidates=()):
         self._core = core
         # Tensor-fusion v2 hook: the tuned fusion threshold also governs
         # the XLA plane's bucket cap (common/fusion.resolve_bucket_cap
@@ -74,6 +75,20 @@ class ParameterManager:
         self._cat_combos = [0, 1, 2, 3] if tune_hierarchical else []
         self._cat_scores: dict = {}
         self._cat_best: Optional[int] = None
+        # Stripe phase (docs/cross-transport.md): a categorical grid
+        # over the cross-host stripe counts — typically (1, K_env),
+        # i.e. "does the striping the user configured actually pay on
+        # this fabric?" — scored like the hierarchical combos and
+        # pinned via core.set_stripes (the frame-synced apply both
+        # sides of every leader pair honor at the same boundary, so
+        # the candidates are real lock-step A/Bs mid-world). Only
+        # populated when the user opted in (HOROVOD_STRIPES > 1) and
+        # the hierarchy spans hosts; runs after the hierarchical grid,
+        # before compression.
+        self._stripe_candidates = (list(stripe_candidates)
+                                   if tune_hierarchical else [])
+        self._stripe_scores: dict = {}
+        self._stripe_best: Optional[int] = None
         # Compression phase (tensor-fusion v2's wire-compression sibling):
         # a categorical grid over the on-wire compression modes —
         # typically ("none", <the configured mode>), i.e. "does the
@@ -145,6 +160,34 @@ class ParameterManager:
             _log.info(f"autotune: hierarchical flags pinned to "
                       f"{self._cat_best:#04b} "
                       f"({self._cat_scores[self._cat_best] / MB:.1f} MB/s)")
+            if self._cat_best == 0:
+                # No hierarchical leg won: there is no cross leader leg
+                # for stripes to carry, so the stripe grid would score
+                # noise against noise.
+                self._stripe_candidates = []
+            if self._stripe_candidates:
+                self._apply_stripes(self._stripe_candidates[0])
+            elif self._comp_candidates:
+                self._apply_compression(self._comp_candidates[0])
+            return
+        # Phase 1a': grid over the cross-host stripe counts, pin the
+        # winner (each candidate is applied frame-synced on every rank,
+        # so both sides of every leader pair renegotiate in lock-step
+        # before the sample is scored).
+        if self._stripe_candidates:
+            k = self._stripe_candidates.pop(0)
+            self._stripe_scores[k] = score
+            if self._stripe_candidates:
+                self._apply_stripes(self._stripe_candidates[0])
+                return
+            self._stripe_best = max(self._stripe_scores,
+                                    key=self._stripe_scores.get)
+            self._apply_stripes(self._stripe_best)
+            _log.info(
+                f"autotune: cross-host stripes pinned to "
+                f"{self._stripe_best} "
+                f"({self._stripe_scores[self._stripe_best] / MB:.1f} "
+                f"MB/s)")
             if self._comp_candidates:
                 self._apply_compression(self._comp_candidates[0])
             return
@@ -202,6 +245,10 @@ class ParameterManager:
         if self._core is not None:
             self._core.set_hier_flags(int(flags))
 
+    def _apply_stripes(self, stripes: int) -> None:
+        if self._core is not None:
+            self._core.set_stripes(int(stripes))
+
     def _apply_compression(self, mode: str) -> None:
         self._current_compression = mode
         if self._compression_setter is not None:
@@ -220,6 +267,12 @@ class ParameterManager:
     def hier_flags(self) -> Optional[int]:
         """The pinned categorical decision (None before phase 1 ends)."""
         return self._cat_best
+
+    @property
+    def stripes(self) -> Optional[int]:
+        """The pinned cross-host stripe count (None before the stripe
+        grid ends or when it never ran)."""
+        return self._stripe_best
 
     @property
     def compression(self) -> Optional[str]:

@@ -238,6 +238,17 @@ def init(comm=None, devices=None):
             if cfg.compression_explicit and cfg.compression != "none":
                 comp_candidates = ("none", cfg.compression)
 
+            # Stripe grid (docs/cross-transport.md): only when the user
+            # opted in (HOROVOD_STRIPES > 1) — the tuner then answers
+            # "does the configured striping actually pay on this
+            # fabric?" by A/B-ing single-socket vs K stripes through
+            # the frame-synced set_stripes apply. The hierarchy gate
+            # below (tune_hierarchical) keeps it off worlds with no
+            # cross leader leg to stripe.
+            stripe_candidates = ()
+            if _config.stripes() > 1:
+                stripe_candidates = (1, _config.stripes())
+
             if _state.process_count > 1:
                 _log.debug(
                     "autotune: XLA bucket-cap/compression publish "
@@ -267,7 +278,8 @@ def init(comm=None, devices=None):
                 xla_cap_setter=_publish_xla_cap,
                 compression_setter=(_publish_compression
                                     if comp_candidates else None),
-                compression_candidates=comp_candidates)
+                compression_candidates=comp_candidates,
+                stripe_candidates=stripe_candidates)
 
         _state.initialized = True
         _log.info(

@@ -223,11 +223,15 @@ std::vector<Response> LocalController::ComputeResponseList(
   // A single-process world draining IS the world shutting down; the
   // distinction only matters to a coordinator accounting for peers.
   *world_shutdown = this_rank_shutdown || this_rank_drain;
-  // Single-rank world: the tuner's categorical hint has no broadcast to
-  // ride; apply it at the same cycle boundary the TCP path would.
+  // Single-rank world: the tuner's categorical hints have no broadcast
+  // to ride; apply them at the same cycle boundary the TCP path would.
   int hier = hier_flags_hint();
   if (hier >= 0) {
     synced_hier_flags_.store(hier, std::memory_order_relaxed);
+  }
+  int stripes = stripe_hint();
+  if (stripes >= 0) {
+    synced_stripes_.store(stripes, std::memory_order_relaxed);
   }
   std::vector<Response> singles;
   singles.reserve(reqs.size());
@@ -673,8 +677,10 @@ std::vector<Response> TcpController::WorkerCycle(std::vector<Request> reqs,
   double synced_cycle = -1.0;
   int64_t synced_fusion = -1;
   int synced_hier = -1;
+  int synced_stripes = -1;
   if (!DeserializeResponseList(bytes, &resps, &synced_cycle,
-                               &synced_fusion, &synced_hier)) {
+                               &synced_fusion, &synced_hier,
+                               &synced_stripes)) {
     *world_shutdown = true;
     return {};
   }
@@ -692,6 +698,9 @@ std::vector<Response> TcpController::WorkerCycle(std::vector<Request> reqs,
   }
   if (synced_hier >= 0) {
     synced_hier_flags_.store(synced_hier, std::memory_order_relaxed);
+  }
+  if (synced_stripes >= 0) {
+    synced_stripes_.store(synced_stripes, std::memory_order_relaxed);
   }
   CacheResponses(resps);
   return resps;
@@ -904,8 +913,10 @@ std::vector<Response> TcpController::CoordinatorCycle(
   }
 
   int hier = hier_flags_hint();
+  int stripes = stripe_hint();
   std::string bytes = SerializeResponseList(fused, cycle_hint_ms(),
-                                            fusion_threshold(), hier);
+                                            fusion_threshold(), hier,
+                                            stripes);
   for (int r = 1; r < cfg_.size; ++r) {
     if (!shutdown_ranks_[r] && worker_socks_[r - 1].valid()) {
       worker_socks_[r - 1].SendFrame(bytes);
@@ -913,9 +924,13 @@ std::vector<Response> TcpController::CoordinatorCycle(
   }
   // The coordinator applies the flags at the same frame boundary it
   // broadcast them (workers apply on receive), so no rank ever executes
-  // this frame's responses under a different dispatch.
+  // this frame's responses under a different dispatch — nor moves a
+  // cross-host byte under a different stripe agreement.
   if (hier >= 0) {
     synced_hier_flags_.store(hier, std::memory_order_relaxed);
+  }
+  if (stripes >= 0) {
+    synced_stripes_.store(stripes, std::memory_order_relaxed);
   }
   return fused;
 }

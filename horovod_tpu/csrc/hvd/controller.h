@@ -112,6 +112,19 @@ class Controller {
   }
   int TakeSyncedHierFlags() { return synced_hier_flags_.exchange(-1); }
 
+  // Tuned cross-host stripe count (docs/cross-transport.md; -1 =
+  // untuned). Rides the response broadcast exactly like the hier flags
+  // and is applied at the same frame boundary on every rank
+  // (Ring::ApplyStripeCount), so both sides of every leader pair
+  // renegotiate their cross transport in lock-step.
+  void set_stripe_hint(int stripes) {
+    stripe_hint_.store(stripes, std::memory_order_relaxed);
+  }
+  int stripe_hint() const {
+    return stripe_hint_.load(std::memory_order_relaxed);
+  }
+  int TakeSyncedStripes() { return synced_stripes_.exchange(-1); }
+
   virtual Status Initialize() = 0;
   // One negotiation cycle. `this_rank_shutdown` signals this rank wants
   // out; `this_rank_drain` marks the departure as a graceful DRAIN
@@ -220,6 +233,8 @@ class Controller {
   std::atomic<double> synced_cycle_ms_{-1.0};
   std::atomic<int> hier_flags_hint_{-1};
   std::atomic<int> synced_hier_flags_{-1};
+  std::atomic<int> stripe_hint_{-1};
+  std::atomic<int> synced_stripes_{-1};
   std::atomic<int64_t> cache_hits_{0};
   std::mutex stall_report_mu_;
   std::atomic<bool> record_negotiation_{false};

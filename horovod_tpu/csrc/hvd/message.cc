@@ -157,16 +157,17 @@ bool IsHeartbeatFrame(const std::string& bytes) {
 std::string SerializeResponseList(const std::vector<Response>& resps,
                                   double cycle_time_ms,
                                   int64_t fusion_threshold,
-                                  int hier_flags) {
+                                  int hier_flags, int stripes) {
   Writer w;
   w.u8(kResponseMagic);
   // Tuned-parameter piggyback (reference SynchronizeParameters,
   // controller.cc:33-47): the coordinator's current cycle time, fusion
-  // threshold, and categorical hierarchical-dispatch flags ride every
-  // response broadcast; -1 = no hint.
+  // threshold, categorical hierarchical-dispatch flags, and cross-host
+  // stripe count ride every response broadcast; -1 = no hint.
   w.f64(cycle_time_ms);
   w.i64(fusion_threshold);
   w.i32(hier_flags);
+  w.i32(stripes);
   w.i32(static_cast<int32_t>(resps.size()));
   for (const auto& p : resps) {
     w.u8(static_cast<uint8_t>(p.op));
@@ -195,15 +196,17 @@ bool DeserializeResponseList(const std::string& bytes,
                              std::vector<Response>* resps,
                              double* cycle_time_ms,
                              int64_t* fusion_threshold,
-                             int* hier_flags) {
+                             int* hier_flags, int* stripes) {
   Reader r(bytes);
   if (r.u8() != kResponseMagic) return false;
   double cyc = r.f64();
   int64_t fus = r.i64();
   int32_t hf = r.i32();
+  int32_t st = r.i32();
   if (cycle_time_ms != nullptr) *cycle_time_ms = cyc;
   if (fusion_threshold != nullptr) *fusion_threshold = fus;
   if (hier_flags != nullptr) *hier_flags = hf;
+  if (stripes != nullptr) *stripes = st;
   int32_t n = r.i32();
   if (n < 0 || n > (1 << 24)) return false;
   resps->clear();
@@ -238,6 +241,40 @@ bool DeserializeResponseList(const std::string& bytes,
     if (!r.ok()) return false;  // same bail as the request loop
   }
   return r.ok();
+}
+
+void EncodeStripeHdr(uint32_t seq, uint32_t len, char out[kStripeHdrBytes]) {
+  uint32_t magic = kStripeMagic;
+  std::memcpy(out, &magic, 4);
+  std::memcpy(out + 4, &seq, 4);
+  std::memcpy(out + 8, &len, 4);
+}
+
+bool DecodeStripeHdr(const char* p, size_t n, uint32_t* seq, uint32_t* len) {
+  if (n < kStripeHdrBytes) return false;  // truncated header: abort
+  uint32_t magic = 0;
+  std::memcpy(&magic, p, 4);
+  if (magic != kStripeMagic) return false;  // desynced stream: abort
+  std::memcpy(seq, p + 4, 4);
+  std::memcpy(len, p + 8, 4);
+  return true;
+}
+
+uint32_t StripePieceCount(size_t total, size_t chunk_bytes) {
+  if (total == 0) return 1;  // an empty piece still unblocks the receiver
+  return static_cast<uint32_t>((total + chunk_bytes - 1) / chunk_bytes);
+}
+
+void StripePieceSpan(uint32_t idx, size_t total, size_t chunk_bytes,
+                     size_t* off, size_t* len) {
+  *off = static_cast<size_t>(idx) * chunk_bytes;
+  if (*off >= total) {
+    *len = 0;
+    *off = total;
+    return;
+  }
+  size_t rest = total - *off;
+  *len = rest < chunk_bytes ? rest : chunk_bytes;
 }
 
 }  // namespace hvd

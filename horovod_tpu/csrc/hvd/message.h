@@ -94,20 +94,56 @@ bool DeserializeRequestList(const std::string& bytes,
 std::string HeartbeatFrame();
 bool IsHeartbeatFrame(const std::string& bytes);
 
-// cycle_time_ms / fusion_threshold / hier_flags piggyback the
+// cycle_time_ms / fusion_threshold / hier_flags / stripes piggyback the
 // coordinator's tuned parameters on the broadcast (reference
 // Controller::SynchronizeParameters, controller.cc:33-47); -1 = no hint.
 // hier_flags: bit0 = hierarchical allreduce, bit1 = hierarchical
-// allgather (the tuner's categorical dimensions).
+// allgather; stripes: the cross-host transport's connection count per
+// leader pair (the tuner's categorical dimensions — every rank applies
+// a synced stripe count at the same frame boundary so both sides of
+// every pair renegotiate their cross transport in lock-step).
 std::string SerializeResponseList(const std::vector<Response>& resps,
                                   double cycle_time_ms = -1.0,
                                   int64_t fusion_threshold = -1,
-                                  int hier_flags = -1);
+                                  int hier_flags = -1, int stripes = -1);
 bool DeserializeResponseList(const std::string& bytes,
                              std::vector<Response>* resps,
                              double* cycle_time_ms = nullptr,
                              int64_t* fusion_threshold = nullptr,
-                             int* hier_flags = nullptr);
+                             int* hier_flags = nullptr,
+                             int* stripes = nullptr);
+
+// ---- striped cross-host transport wire contract ---------------------------
+//
+// The striped backend (stripe_transport.cc behind the op_manager registry;
+// docs/cross-transport.md) splits each logical message into pieces of at
+// most HOROVOD_CHUNK_BYTES and round-robins them across K parallel TCP
+// connections. Every piece carries a fixed 12-byte header so reassembly is
+// order-proof: the sequence number alone places a piece, regardless of the
+// order stripes deliver. The piece <-> span math is deterministic from
+// (total bytes, chunk bytes) alone — both sides derive it independently,
+// so no per-message metadata rides the wire beyond the headers.
+
+constexpr uint32_t kStripeMagic = 0x54535648u;  // "HVST" little-endian
+constexpr size_t kStripeHdrBytes = 12;          // magic + seq + len (u32 LE)
+
+void EncodeStripeHdr(uint32_t seq, uint32_t len, char out[kStripeHdrBytes]);
+// False on truncation (n < 12) or a magic mismatch — a desynced stripe
+// stream must abort, never guess.
+bool DecodeStripeHdr(const char* p, size_t n, uint32_t* seq, uint32_t* len);
+
+// Number of pieces a `total`-byte message splits into (a 0-byte message
+// is one empty piece, so the receiver still unblocks on something).
+uint32_t StripePieceCount(size_t total, size_t chunk_bytes);
+// Byte span [*off, *off + *len) of piece `idx` (0-based within the
+// message); len of the final piece is the remainder.
+void StripePieceSpan(uint32_t idx, size_t total, size_t chunk_bytes,
+                     size_t* off, size_t* len);
+// The stripe a piece rides: its global sequence number modulo the stripe
+// count (the round-robin assignment both sides derive).
+inline int StripeOfSeq(uint32_t seq, int stripes) {
+  return static_cast<int>(seq % static_cast<uint32_t>(stripes));
+}
 
 }  // namespace hvd
 

@@ -28,6 +28,7 @@
 #include <unordered_map>
 
 #include "controller.h"
+#include "env_util.h"
 #include "message.h"
 #include "ring_ops.h"
 #include "tensor_queue.h"
@@ -185,6 +186,30 @@ long long ShmSlotBytes(long long fusion_threshold) {
   if (v < 0) v = fusion_threshold;
   const long long kMin = 64 << 10, kMax = 256LL << 20;
   return std::max(kMin, std::min(kMax, v));
+}
+
+// HOROVOD_STRIPES: parallel TCP connections per cross-host leader pair
+// (docs/cross-transport.md). 1 (the default) keeps the single-socket
+// path with zero registry overhead; clamped to the stripe engine's
+// 32-fd poll set. A dispatch knob: must agree across ranks.
+int StripesFromEnv() {
+  long long v = EnvLL("HOROVOD_STRIPES", 1);
+  if (v < 1) v = 1;
+  if (v > StripeTransport::kMaxStripes) v = StripeTransport::kMaxStripes;
+  return static_cast<int>(v);
+}
+
+// HOROVOD_CHUNK_BYTES: the striped transport's pipeline chunk — the
+// unit round-robined across stripes and handed to the per-piece
+// accumulate hook. Clamped sane ([4 KiB, 16 MiB]) and rounded to a
+// 64-byte multiple so piece boundaries never split an element of any
+// supported dtype.
+long long ChunkBytesFromEnv() {
+  long long v = EnvLL("HOROVOD_CHUNK_BYTES", 256 << 10);
+  const long long kMin = 4096, kMax = 16LL << 20;
+  if (v < kMin) v = kMin;
+  if (v > kMax) v = kMax;
+  return v & ~63LL;
 }
 
 // Effective hierarchical-dispatch bit for the host plane: the tuner's
@@ -472,6 +497,14 @@ bool RunLoopOnce(std::chrono::steady_clock::time_point& last_cycle) {
   if (synced > 0) s->cycle_time_ms.store(synced);
   int synced_hier = s->controller->TakeSyncedHierFlags();
   if (synced_hier >= 0) s->hier_flags.store(synced_hier);
+  // Stripe-count sync applies BEFORE this frame's responses run, on
+  // every rank at the same boundary, so both sides of every leader pair
+  // renegotiate their cross transport in lock-step
+  // (docs/cross-transport.md).
+  int synced_stripes = s->controller->TakeSyncedStripes();
+  if (synced_stripes >= 1 && s->ring) {
+    s->ring->ApplyStripeCount(synced_stripes);
+  }
   for (const auto& r : responses) PerformOperation(r);
   return !world_shutdown;
 }
@@ -600,11 +633,16 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
     // eviction the liveness plane delivers on the TCP side.
     long long shm_wait_ms =
         heartbeat_ms > 0 ? 2LL * cfg.liveness_timeout_ms : 120000;
+    // Cross-host leader legs: striped multi-socket TCP when
+    // HOROVOD_STRIPES > 1 (must agree across ranks, like every dispatch
+    // env); HOROVOD_STRIPE_FALLBACK=0 makes a stripe connect failure a
+    // hard error instead of a lock-step slide to single-socket TCP.
     s->ring->ConfigureTransports(
         hvd::EnvFlag("HOROVOD_SHM"),
         hvd::ShmSlotBytes(static_cast<long long>(fusion_threshold)),
         hvd::EnvFlag("HOROVOD_SHM_FALLBACK", /*dflt=*/true),
-        shm_wait_ms);
+        shm_wait_ms, hvd::StripesFromEnv(), hvd::ChunkBytesFromEnv(),
+        hvd::EnvFlag("HOROVOD_STRIPE_FALLBACK", /*dflt=*/true));
   }
   s->background = std::thread(hvd::BackgroundLoop);
   s->initialized.store(true);
@@ -954,6 +992,47 @@ int hvd_shm_active() {
   auto* s = hvd::g();
   std::lock_guard<std::mutex> lk(s->init_mu);
   return (s->ring && s->ring->shm_active()) ? 1 : 0;
+}
+
+// Striped cross-host transport observability (docs/cross-transport.md).
+// Payload bytes that rode the stripes — a subset of cross_bytes, which
+// stays byte-identical to the single-socket path (headers off every
+// counter).
+long long hvd_ring_stripe_bytes() {
+  auto* s = hvd::g();
+  std::lock_guard<std::mutex> lk(s->init_mu);
+  return s->ring ? s->ring->stripe_bytes_sent() : 0;
+}
+
+// The stripe count in ACTIVE use: K once at least one leader pair
+// carries striped traffic, 0 when striping is off or every pair fell
+// back to single-socket TCP (what hvd.ring_traffic() / bench.py
+// record).
+int hvd_ring_stripe_count() {
+  auto* s = hvd::g();
+  std::lock_guard<std::mutex> lk(s->init_mu);
+  return s->ring ? s->ring->stripe_count() : 0;
+}
+
+// Wall-clock nanoseconds spent inside cross-host leader-leg exchanges —
+// the leg-local timing the --cross-leg A/B compares (end-to-end
+// iteration time on an oversubscribed box is dominated by fusion copies
+// and idle members' yield-spins, which the leg never touches).
+long long hvd_ring_cross_ns() {
+  auto* s = hvd::g();
+  std::lock_guard<std::mutex> lk(s->init_mu);
+  return s->ring ? s->ring->cross_leg_ns() : 0;
+}
+
+// Coordinator autotuner: propose a tuned cross-host stripe count. It
+// rides the next response broadcast and applies on every rank at that
+// frame boundary (both sides of every pair renegotiate in lock-step).
+void hvd_set_stripes(int stripes) {
+  auto* s = hvd::g();
+  // init_mu guards hvd_shutdown's controller.reset() — same race as
+  // hvd_set_parameters (a tuner update vs a concurrent shutdown).
+  std::lock_guard<std::mutex> lk(s->init_mu);
+  if (s->controller) s->controller->set_stripe_hint(stripes);
 }
 
 // The EFFECTIVE host-plane hierarchical dispatch flags this process would

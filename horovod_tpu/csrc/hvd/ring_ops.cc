@@ -2,7 +2,9 @@
 
 #include <algorithm>
 #include <cfloat>
+#include <chrono>
 #include <cmath>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <thread>
@@ -214,7 +216,9 @@ class Ring::TcpPeerBackend : public TransportBackend {
 
 void Ring::ConfigureTransports(bool use_shm, long long slot_bytes,
                                bool allow_fallthrough,
-                               long long shm_wait_timeout_ms) {
+                               long long shm_wait_timeout_ms, int stripes,
+                               long long chunk_bytes,
+                               bool stripe_fallthrough) {
   OperationManager::ControlChannel ctl;
   // Control frames ride the PeerLink sockets (FIFO per direction, like
   // every payload fallback frame) and stay off the traffic counters:
@@ -227,9 +231,10 @@ void Ring::ConfigureTransports(bool use_shm, long long slot_bytes,
     Socket* s = PeerLink(peer);
     return s != nullptr && s->RecvFrame(frame);
   };
-  op_mgr_ = std::make_unique<OperationManager>(ctl, allow_fallthrough);
+  op_mgr_ = std::make_unique<OperationManager>(ctl);
   tcp_backend_ = std::make_unique<TcpPeerBackend>(this);
   shm_ = std::make_unique<ShmTransport>();
+  shm_->set_allow_fallthrough(allow_fallthrough);
   if (use_shm && group_.size() > 1) {
     std::vector<int> ports(size_);
     for (int r = 0; r < size_; ++r) ports[r] = endpoints_[r].second;
@@ -241,18 +246,52 @@ void Ring::ConfigureTransports(bool use_shm, long long slot_bytes,
                    rank_);
     }
   }
+  stripe_ = std::make_unique<StripeTransport>();
+  stripe_->Init(rank_, endpoints_, stripes, chunk_bytes,
+                stripe_fallthrough,
+                [this](int peer) { return PumpStripeAccepts(peer); });
+  // The CROSS legs only route through the registry when striping is
+  // configured: with K <= 1 they keep the direct PeerLink duplex — no
+  // negotiation frames, bit-for-bit the pre-stripe path. K > 1 worlds
+  // pay one control frame per (leg, direction, pair) first contact.
+  cross_registry_ = stripes > 1;
   // Backend ids are the values exchanged in control frames, so the
-  // registration ORDER must be identical on every rank: the shm backend
-  // is registered even when disabled on this rank (env off, init
-  // failure) — Enabled()/Prepare() keep it out of every negotiation,
+  // registration ORDER must be identical on every rank: shm and stripe
+  // are registered even when disabled on this rank (env off, init
+  // failure) — Enabled()/Prepare() keep them out of every negotiation,
   // while the id table stays globally consistent.
   shm_backend_id_ = op_mgr_->RegisterBackend(shm_.get());
+  stripe_backend_id_ = op_mgr_->RegisterBackend(stripe_.get());
   int tcp_id = op_mgr_->RegisterBackend(tcp_backend_.get());
   for (int leg = 0; leg < kNumTransportLegs; ++leg) {
-    op_mgr_->RegisterForLeg(static_cast<TransportLeg>(leg),
-                            shm_backend_id_);
-    op_mgr_->RegisterForLeg(static_cast<TransportLeg>(leg), tcp_id);
+    auto l = static_cast<TransportLeg>(leg);
+    if (l == TransportLeg::CROSS_SEND || l == TransportLeg::CROSS_RECV) {
+      op_mgr_->RegisterForLeg(l, stripe_backend_id_);
+    } else {
+      op_mgr_->RegisterForLeg(l, shm_backend_id_);
+    }
+    op_mgr_->RegisterForLeg(l, tcp_id);
   }
+}
+
+void Ring::ApplyStripeCount(int stripes) {
+  if (stripe_ == nullptr || op_mgr_ == nullptr) return;
+  // Clamp exactly like StripesFromEnv: the tuner hint arrives here on
+  // every rank with the same wire value, so an identical clamp keeps the
+  // lock-step agreement while protecting RecvPieces' fixed poll set from
+  // an out-of-range hvd_set_stripes.
+  if (stripes < 1) stripes = 1;
+  if (stripes > StripeTransport::kMaxStripes)
+    stripes = StripeTransport::kMaxStripes;
+  if (stripes == stripe_->stripes()) return;
+  // Frame-synced on every rank (RunLoopOnce applies the broadcast value
+  // before executing the frame's responses), so both sides of every
+  // leader pair drop their agreements and connections at the same
+  // message boundary and the next cross transfer renegotiates cleanly.
+  op_mgr_->ResetLeg(TransportLeg::CROSS_SEND);
+  op_mgr_->ResetLeg(TransportLeg::CROSS_RECV);
+  stripe_->SetStripes(stripes);
+  cross_registry_ = stripes > 1;
 }
 
 bool Ring::LocalSend(TransportLeg leg, int peer, const void* buf,
@@ -335,9 +374,22 @@ void Ring::SenderLoop() {
     size_t n = send_bytes_;
     Socket* sock = send_sock_;
     int peer = send_peer_;
+    SendKind kind = send_kind_;
     lk.unlock();
-    std::string payload(static_cast<const char*>(buf), n);
-    bool ok = sock->SendFrame(payload);
+    bool ok;
+    if (kind == SendKind::kStripe) {
+      // Striped cross-leg send: pieces round-robin across the pair's
+      // stripe sockets while the posting thread receives — the send of
+      // chunk i drains here as the receive of chunk i+1 progresses
+      // there. The stripe backend counts its own bytes; AddSent keeps
+      // cross_bytes byte-identical to the single-socket path.
+      ok = stripe_->Send(peer, buf, n) == kTransportOk;
+    } else {
+      // Copy-free (ptr, len) frame: `buf` stays valid until send_done_,
+      // so the old std::string staging (a full payload copy per ring
+      // step) is pure waste.
+      ok = sock->SendFrame(buf, n);
+    }
     if (ok) AddSent(peer, n);
     lk.lock();
     send_buf_ = nullptr;
@@ -363,6 +415,7 @@ bool Ring::SendRecvDuplex(Socket* send_sock, int send_peer,
   if (sbuf == nullptr) sbuf = &kEmpty;
   {
     std::lock_guard<std::mutex> lk(send_mu_);
+    send_kind_ = SendKind::kTcpFrame;
     send_sock_ = send_sock;
     send_peer_ = send_peer;
     send_buf_ = sbuf;
@@ -378,6 +431,107 @@ bool Ring::SendRecvDuplex(Socket* send_sock, int send_peer,
     if (recv_ok && rbytes > 0) std::memcpy(rbuf, rframe.data(), rbytes);
     return send_ok_ && recv_ok;
   }
+}
+
+bool Ring::MaybeAdoptStripeHello(const std::string& hello, Socket& s) {
+  if (hello.rfind("stripe ", 0) != 0) return false;
+  int pr = -1, idx = -1;
+  if (stripe_ != nullptr &&
+      std::sscanf(hello.c_str(), "stripe %d %d", &pr, &idx) == 2) {
+    stripe_->Adopt(pr, idx, std::move(s));
+  }
+  return true;
+}
+
+bool Ring::PumpStripeAccepts(int peer) {
+  // Accept until every stripe `peer` dialed toward this rank is
+  // adopted. Stray hellos are stashed exactly as PeerLink's loop does:
+  // "vhdd <r>" dials into peers_, other peers' stripe dials into the
+  // stripe backend. Bounded so garbage hellos can't spin forever.
+  if (listener_ == nullptr || stripe_ == nullptr) return false;
+  for (int tries = 0; !stripe_->HasAllStripes(peer) && tries < 256;
+       ++tries) {
+    Socket s = listener_->Accept(120000);
+    if (!s.valid()) return false;
+    std::string hello;
+    if (!s.RecvFrame(&hello)) continue;
+    if (hello.rfind("vhdd ", 0) == 0) {
+      peers_[std::atoi(hello.c_str() + 5)] = std::move(s);
+      continue;
+    }
+    MaybeAdoptStripeHello(hello, s);
+  }
+  return stripe_->HasAllStripes(peer);
+}
+
+bool Ring::CrossSendRecv(int next, const void* sbuf, size_t sbytes,
+                         int prev, void* rbuf, size_t rbytes,
+                         const std::function<void(size_t, size_t)>&
+                             on_piece) {
+  // Leg-local timing (cross_leg_ns): the one honest clock for a
+  // transport A/B — everything inside here IS the leader leg.
+  struct LegTimer {
+    std::atomic<long long>& acc;
+    std::chrono::steady_clock::time_point t0 =
+        std::chrono::steady_clock::now();
+    ~LegTimer() {
+      acc.fetch_add(std::chrono::duration_cast<std::chrono::nanoseconds>(
+                        std::chrono::steady_clock::now() - t0)
+                        .count());
+    }
+  } timer{cross_ns_};
+  if (!cross_registry_ || op_mgr_ == nullptr) {
+    // Striping off: the direct PeerLink duplex, bit-for-bit the
+    // pre-stripe path (no negotiation frames).
+    Socket* snext = PeerLink(next);
+    Socket* sprev = PeerLink(prev);
+    if (snext == nullptr || sprev == nullptr) return false;
+    if (!SendRecvDuplex(snext, next, sbuf, sbytes, sprev, rbuf, rbytes)) {
+      return false;
+    }
+    if (on_piece) on_piece(0, rbytes);
+    return true;
+  }
+  // Pin both directions' backends before any payload moves: the sender
+  // side owns each choice and announces it on the PeerLink control
+  // channel, so both ends of every pair switch at the same message
+  // boundary (mixed pairs — striped one way, single-socket the other —
+  // are fine; each direction is its own agreement).
+  int sid = op_mgr_->AgreeSend(TransportLeg::CROSS_SEND, next);
+  int rid = op_mgr_->AgreeRecv(TransportLeg::CROSS_RECV, prev);
+  if (sid < 0 || rid < 0) return false;
+  static const char kEmpty = 0;
+  if (sbuf == nullptr) sbuf = &kEmpty;
+  Socket* snext = nullptr;
+  if (sid != stripe_backend_id_) {
+    snext = PeerLink(next);
+    if (snext == nullptr) return false;
+  }
+  {
+    std::lock_guard<std::mutex> lk(send_mu_);
+    send_kind_ = sid == stripe_backend_id_ ? SendKind::kStripe
+                                           : SendKind::kTcpFrame;
+    send_sock_ = snext;
+    send_peer_ = next;
+    send_buf_ = sbuf;
+    send_bytes_ = sbytes;
+    send_done_ = false;
+  }
+  send_cv_.notify_all();
+  bool recv_ok;
+  if (rid == stripe_backend_id_) {
+    // Poll across prev's stripe fds; each completed pipeline chunk is
+    // handed to the caller while later chunks are still in flight.
+    recv_ok = stripe_->RecvPieces(prev, rbuf, rbytes, on_piece) ==
+              kTransportOk;
+  } else {
+    Socket* sprev = PeerLink(prev);
+    recv_ok = sprev != nullptr && sprev->RecvFrameInto(rbuf, rbytes);
+    if (recv_ok && on_piece) on_piece(0, rbytes);
+  }
+  std::unique_lock<std::mutex> lk(send_mu_);
+  send_cv_.wait(lk, [&] { return send_done_; });
+  return send_ok_ && recv_ok;
 }
 
 bool Ring::SendRecvStep(const void* sbuf, size_t sbytes, void* rbuf,
@@ -419,7 +573,8 @@ Status Ring::Connect(int rank, const std::vector<std::pair<std::string, int>>&
   int prev_rank = (rank_ - 1 + size_) % size_;
   auto answer = [&]() -> bool {
     // Accept until the peer introducing itself as prev arrives; stash
-    // early VHDD peer dials instead of mistaking them for prev.
+    // early VHDD peer dials (and stripe dials) instead of mistaking
+    // them for prev.
     for (int tries = 0; tries < 64; ++tries) {
       Socket s = listener->Accept(120000);
       if (!s.valid()) return false;
@@ -430,6 +585,7 @@ Status Ring::Connect(int rank, const std::vector<std::pair<std::string, int>>&
         peers_[pr] = std::move(s);
         continue;
       }
+      if (MaybeAdoptStripeHello(hello, s)) continue;
       if (std::atoi(hello.c_str()) != prev_rank) continue;
       prev_ = std::move(s);
       return true;
@@ -592,11 +748,6 @@ Status Ring::SubRingAllreduce(void* buf, int64_t count, DataType dtype,
   }
   int next = ranks[(idx + 1) % n];
   int prev = ranks[(idx - 1 + n) % n];
-  Socket* snext = PeerLink(next);
-  Socket* sprev = PeerLink(prev);
-  if (snext == nullptr || sprev == nullptr) {
-    return Status::Aborted("sub-ring peer link failed");
-  }
   int es = DataTypeSize(dtype);
   std::vector<int64_t> offs(n + 1);
   for (int i = 0; i <= n; ++i) offs[i] = count * i / n;
@@ -610,23 +761,52 @@ Status Ring::SubRingAllreduce(void* buf, int64_t count, DataType dtype,
   for (int step = 0; step < n - 1; ++step) {
     int send_c = ((idx - step) % n + n) % n;
     int recv_c = ((idx - step - 1) % n + n) % n;
-    if (!SendRecvDuplex(snext, next, chunk_ptr(send_c), chunk_n(send_c) * es,
-                        sprev, recv_buf.data(), chunk_n(recv_c) * es)) {
+    // Pipelined reduce-scatter step: each received pipeline chunk is
+    // accumulated the moment it completes, overlapping the reduction
+    // with the chunks still in flight (and with this step's outgoing
+    // send draining on the sender thread). Pieces cover disjoint,
+    // element-aligned spans, so piecewise accumulation is bitwise the
+    // whole-buffer accumulation — the transport never touches the
+    // chunk math.
+    char* dst = chunk_ptr(recv_c);
+    auto acc_piece = [&](size_t off, size_t len) {
+      Accumulate(dst + off, recv_buf.data() + off,
+                 static_cast<int64_t>(len / es), dtype, op);
+    };
+    if (!CrossSendRecv(next, chunk_ptr(send_c), chunk_n(send_c) * es,
+                       prev, recv_buf.data(), chunk_n(recv_c) * es,
+                       acc_piece)) {
       return Status::Aborted("sub-ring reduce-scatter failure");
     }
-    Accumulate(chunk_ptr(recv_c), recv_buf.data(), chunk_n(recv_c), dtype,
-               op);
   }
   for (int step = 0; step < n - 1; ++step) {
     int send_c = ((idx + 1 - step) % n + n) % n;
     int recv_c = ((idx - step) % n + n) % n;
-    if (!SendRecvDuplex(snext, next, chunk_ptr(send_c), chunk_n(send_c) * es,
-                        sprev, recv_buf.data(), chunk_n(recv_c) * es)) {
+    // Allgather steps land in place: the incoming chunk IS the final
+    // bytes, so the striped path writes pieces straight into the output
+    // (the single-socket path keeps its one bounce copy).
+    if (!CrossSendRecv(next, chunk_ptr(send_c), chunk_n(send_c) * es,
+                       prev, chunk_ptr(recv_c), chunk_n(recv_c) * es)) {
       return Status::Aborted("sub-ring allgather failure");
     }
-    std::memcpy(chunk_ptr(recv_c), recv_buf.data(), chunk_n(recv_c) * es);
   }
   return Status::OK();
+}
+
+void Ring::AbortLocalWaiters() {
+  // A leader failing mid-collective (cross leg aborted, strict-mode
+  // stripe/shm refusal, gather recv error) must not leave its members
+  // parked on the phase-3 bcast receive until liveness eviction: a
+  // 0-byte frame on the LOCAL_BCAST channel fails their size-checked
+  // receive immediately (TCP: RecvFrameInto length mismatch; shm:
+  // chunk-length mismatch), so the whole host errors together and the
+  // elastic retry loop takes over. Best-effort by design — the
+  // collective is already failing.
+  static const char kZero = 0;
+  for (int m : group_) {
+    if (m == rank_) continue;
+    LocalSend(TransportLeg::LOCAL_BCAST, m, &kZero, 0);
+  }
 }
 
 Status Ring::HierAllreduce(void* data, void* output, int64_t count,
@@ -663,6 +843,7 @@ Status Ring::HierAllreduce(void* data, void* output, int64_t count,
       if (m == rank_) continue;
       if (!LocalRecv(TransportLeg::LOCAL_REDUCE, m, member_buf.data(),
                      nbytes)) {
+        AbortLocalWaiters();
         return Status::Aborted("hier intra-host reduce recv failed");
       }
       Accumulate(output, member_buf.data(), count, dtype, op);
@@ -670,11 +851,17 @@ Status Ring::HierAllreduce(void* data, void* output, int64_t count,
     // Phase 2: cross-host leg among leaders only — every byte that
     // crosses the slow links is paid once per host, not once per rank.
     Status st = SubRingAllreduce(output, count, dtype, op, leaders_);
-    if (!st.ok()) return st;
-    // Phase 3: intra-host broadcast of the reduced result.
+    if (!st.ok()) {
+      AbortLocalWaiters();
+      return st;
+    }
+    // Phase 3: intra-host broadcast of the reduced result. A failed
+    // send still aborts the waiters: members later in group_ have not
+    // been served yet and would otherwise park until liveness eviction.
     for (int m : group_) {
       if (m == rank_) continue;
       if (!LocalSend(TransportLeg::LOCAL_BCAST, m, output, nbytes)) {
+        AbortLocalWaiters();
         return Status::Aborted("hier intra-host bcast send failed");
       }
     }
@@ -728,6 +915,7 @@ Status Ring::HierAllgatherv(const void* data, void* output,
     if (m == rank_ || counts[m] == 0) continue;
     if (!LocalRecv(TransportLeg::LOCAL_GATHER, m, out + disp[m],
                    counts[m] * es)) {
+      AbortLocalWaiters();
       return Status::Aborted("hier allgather gather recv failed");
     }
   }
@@ -756,26 +944,28 @@ Status Ring::HierAllgatherv(const void* data, void* output,
   };
   int next = leaders_[(group_idx_ + 1) % H];
   int prev = leaders_[(group_idx_ - 1 + H) % H];
-  Socket* snext = PeerLink(next);
-  Socket* sprev = PeerLink(prev);
-  if (snext == nullptr || sprev == nullptr) {
-    return Status::Aborted("hier allgather leader ring link failed");
-  }
   for (int step = 0; step < H - 1; ++step) {
     int send_g = ((group_idx_ - step) % H + H) % H;
     int recv_g = ((group_idx_ - step - 1) % H + H) % H;
     std::string sbuf = pack(send_g);
     std::string rbuf(bundle_bytes(recv_g), 0);
-    if (!SendRecvDuplex(snext, next, sbuf.data(), sbuf.size(), sprev,
-                        rbuf.empty() ? nullptr : &rbuf[0], rbuf.size())) {
+    // Leader bundle exchange through the cross registry: striped +
+    // pipelined when negotiated, single-socket otherwise (the bundle is
+    // (de)serialized against the displacement map either way, so the
+    // per-piece hook is unused — unpack needs the whole bundle).
+    if (!CrossSendRecv(next, sbuf.data(), sbuf.size(), prev,
+                       rbuf.empty() ? nullptr : &rbuf[0], rbuf.size())) {
+      AbortLocalWaiters();
       return Status::Aborted("hier allgather leader ring failure");
     }
     unpack(recv_g, rbuf);
   }
-  // Phase 3: hand the assembled result to every local member.
+  // Phase 3: hand the assembled result to every local member. As in
+  // HierAllreduce, a failed send aborts the not-yet-served waiters.
   for (int m : group_) {
     if (m == rank_) continue;
     if (!LocalSend(TransportLeg::LOCAL_BCAST, m, out, total)) {
+      AbortLocalWaiters();
       return Status::Aborted("hier allgather result send failed");
     }
   }
@@ -852,8 +1042,9 @@ Socket* Ring::PeerLink(int peer) {
   } else {
     // Higher rank accepts. Dials from *other* lower peers can arrive
     // first (ranks progress through VHDD levels at different speeds);
-    // stash them by rank instead of mis-assigning. Bounded like
-    // Connect's answer loop so garbage hellos can't spin forever.
+    // stash them by rank instead of mis-assigning. Stripe dials landing
+    // here are stashed for the stripe backend's PrepareRecv. Bounded
+    // like Connect's answer loop so garbage hellos can't spin forever.
     for (int tries = 0;
          peers_.find(peer) == peers_.end() && tries < 64; ++tries) {
       if (listener_ == nullptr) return nullptr;
@@ -861,6 +1052,7 @@ Socket* Ring::PeerLink(int peer) {
       if (!s.valid()) return nullptr;
       std::string hello;
       if (!s.RecvFrame(&hello)) continue;
+      if (MaybeAdoptStripeHello(hello, s)) continue;
       if (hello.rfind("vhdd ", 0) != 0) continue;
       int pr = std::atoi(hello.c_str() + 5);
       peers_[pr] = std::move(s);

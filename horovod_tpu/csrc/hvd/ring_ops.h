@@ -25,10 +25,13 @@
 #include <thread>
 #include <vector>
 
+#include <functional>
+
 #include "common.h"
 #include "op_manager.h"
 #include "shm_transport.h"
 #include "socket.h"
+#include "stripe_transport.h"
 
 namespace hvd {
 
@@ -49,19 +52,33 @@ class Ring {
   // hierarchical paths; without it every send is accounted cross-host
   // (the conservative pre-topology behavior: one process per host).
   void SetTopology(const std::vector<int>& cross_ranks);
-  // Build the intra-host transport registry (op_manager.h): the shm
-  // backend (created when `use_shm`, from HOROVOD_SHM) ahead of the TCP
-  // PeerLink fallback, per collective leg. `slot_bytes` sizes the shm
-  // ring-buffer slots (derived from the fusion cap / env);
-  // `allow_fallthrough` = false (HOROVOD_SHM_FALLBACK=0) turns transport
-  // failures into hard collective errors instead of a silent TCP leg;
-  // `shm_wait_timeout_ms` bounds the shm data-plane waits (liveness-
-  // derived when heartbeats are armed — see operations.cc).
+  // Build the transport registry (op_manager.h). Intra-host legs: the
+  // shm backend (created when `use_shm`, from HOROVOD_SHM) ahead of the
+  // TCP PeerLink fallback; `slot_bytes` sizes the shm ring-buffer slots
+  // (derived from the fusion cap / env); `allow_fallthrough` = false
+  // (HOROVOD_SHM_FALLBACK=0) turns shm failures into hard collective
+  // errors instead of a silent TCP leg; `shm_wait_timeout_ms` bounds
+  // the shm data-plane waits (liveness-derived when heartbeats are
+  // armed — see operations.cc). Cross-host leader legs: the striped
+  // multi-socket backend (stripe_transport.h) when `stripes` > 1
+  // (HOROVOD_STRIPES), chunked at `chunk_bytes` (HOROVOD_CHUNK_BYTES,
+  // clamped), with `stripe_fallthrough` = false
+  // (HOROVOD_STRIPE_FALLBACK=0) making a stripe connect failure a hard
+  // error; with `stripes` <= 1 the cross legs keep the direct
+  // single-socket path with zero registry overhead.
   // Call after Connect + SetTopology; without it the hierarchical legs
   // use direct TCP PeerLink frames (pre-registry behavior).
   void ConfigureTransports(bool use_shm, long long slot_bytes,
                            bool allow_fallthrough,
-                           long long shm_wait_timeout_ms = 120000);
+                           long long shm_wait_timeout_ms = 120000,
+                           int stripes = 1, long long chunk_bytes = 256 << 10,
+                           bool stripe_fallthrough = true);
+  // Frame-synced stripe-count apply (autotuner categorical dimension):
+  // close the stripe connections, forget the CROSS-leg agreements, and
+  // install the new count. Every rank calls this at the same response
+  // boundary (RunLoopOnce), so both sides of every leader pair
+  // renegotiate their cross transport in lock-step.
+  void ApplyStripeCount(int stripes);
 
   Status Allreduce(void* data, void* output, int64_t count, DataType dtype,
                    ReduceOp op, double prescale, double postscale);
@@ -122,6 +139,27 @@ class Ring {
   // TCP fallback for every leg must not report shm as its transport
   // choice) — what bench.py records.
   bool shm_active() const { return shm_ != nullptr && shm_->Active(); }
+  // Payload bytes that rode the striped cross-host transport (a subset
+  // of cross_bytes_sent — striping changes the carrier, never the
+  // accounting: stripe piece headers stay off every counter, so
+  // cross_bytes is byte-identical to the single-socket path).
+  long long stripe_bytes_sent() const {
+    return stripe_ ? stripe_->bytes_sent() : 0;
+  }
+  // The stripe count in ACTIVE use: K once at least one leader pair
+  // carries striped traffic, 0 when striping is off or every pair fell
+  // back to single-socket TCP (the transport-choice surface
+  // hvd.ring_traffic() / bench.py record).
+  int stripe_count() const {
+    return stripe_ ? stripe_->active_stripes() : 0;
+  }
+  // Wall-clock nanoseconds this rank spent inside cross-host leader-leg
+  // exchanges (CrossSendRecv: duplex send+recv+pipelined accumulate,
+  // whichever backend carried it). The leg-local timing bench.py's
+  // --cross-leg A/B compares — end-to-end iteration time on an
+  // oversubscribed box is dominated by fusion copies and idle members'
+  // yield-spins, which the leg never touches.
+  long long cross_leg_ns() const { return cross_ns_.load(); }
 
  private:
   // Full-duplex step: send on `sock` while receiving from `recv_sock`,
@@ -134,6 +172,38 @@ class Ring {
                       size_t rbytes);
   bool SendRecvStep(const void* sbuf, size_t sbytes, void* rbuf,
                     size_t rbytes);
+  // Full-duplex CROSS-leg step through the transport registry: send
+  // `sbuf` to leader `next` while receiving `rbuf` from leader `prev`,
+  // each direction on its negotiated backend (striped multi-socket or
+  // single-socket TCP, mixed pairs allowed). The send drains on the
+  // sender thread while this thread receives; with the striped backend
+  // the receive polls across the stripe fds and fires `on_piece`
+  // (byte offset, length — disjoint spans, any completion order) as
+  // each pipeline chunk completes, so the caller can accumulate chunk i
+  // while chunk i+1 is still in flight — the streaming the Patarasuk &
+  // Yuan ring needs to be bandwidth-optimal in practice. Falls back to
+  // the direct PeerLink duplex (then one whole-buffer `on_piece`) when
+  // the cross registry is off. Results are byte-identical across every
+  // path: transport changes, chunk math never does.
+  bool CrossSendRecv(int next, const void* sbuf, size_t sbytes, int prev,
+                     void* rbuf, size_t rbytes,
+                     const std::function<void(size_t, size_t)>& on_piece =
+                         nullptr);
+  // Accept-loop pump for the striped backend: accept from the shared
+  // data listener — stashing stray "vhdd" hellos exactly like
+  // PeerLink's loop — until every stripe `peer` dialed is adopted.
+  bool PumpStripeAccepts(int peer);
+  // Shared stray-hello stash for every accept loop (PumpStripeAccepts,
+  // Connect's answer loop, PeerLink's accept loop): true when `hello`
+  // was a stripe dial — the socket has been adopted into the stripe
+  // backend (or dropped if malformed/backend absent) and the caller
+  // must `continue`; false leaves `s` untouched for the caller.
+  bool MaybeAdoptStripeHello(const std::string& hello, Socket& s);
+  // Error propagation for a leader failing mid-collective: a 0-byte
+  // frame on each member's LOCAL_BCAST channel fails their size-checked
+  // phase-3 receive immediately, so the host errors together instead of
+  // members wedging until liveness eviction.
+  void AbortLocalWaiters();
   void SenderLoop();
   bool CountedSendFrame(Socket& sock, int peer, const std::string& payload);
   void AddSent(int peer, size_t nbytes);
@@ -197,19 +267,27 @@ class Ring {
   std::atomic<long long> bytes_sent_{0};
   std::atomic<long long> local_bytes_sent_{0};
   std::atomic<long long> cross_bytes_sent_{0};
+  std::atomic<long long> cross_ns_{0};
 
-  // Intra-host transport registry (ConfigureTransports). The TCP
-  // adapter wraps PeerLink/CountedSendFrame so the fallback keeps the
-  // split local/cross accounting; the shm backend counts its own bytes.
+  // Transport registry (ConfigureTransports). The TCP adapter wraps
+  // PeerLink/CountedSendFrame so the fallback keeps the split
+  // local/cross accounting; the shm and stripe backends count their own
+  // bytes. `cross_registry_` gates the CROSS legs: with striping off
+  // they keep the direct PeerLink duplex, zero negotiation overhead.
   class TcpPeerBackend;
   std::unique_ptr<TcpPeerBackend> tcp_backend_;
   std::unique_ptr<ShmTransport> shm_;
+  std::unique_ptr<StripeTransport> stripe_;
   std::unique_ptr<OperationManager> op_mgr_;
   int shm_backend_id_ = -1;
+  int stripe_backend_id_ = -1;
+  bool cross_registry_ = false;
 
   std::thread sender_;
   std::mutex send_mu_;
   std::condition_variable send_cv_;
+  enum class SendKind { kTcpFrame, kStripe };
+  SendKind send_kind_ = SendKind::kTcpFrame;
   Socket* send_sock_ = nullptr;     // socket for the pending send
   int send_peer_ = -1;              // destination rank of the pending send
   const void* send_buf_ = nullptr;  // pending send request (one at a time)

@@ -16,6 +16,8 @@
 #include <cstring>
 #include <thread>
 
+#include "env_util.h"
+
 namespace hvd {
 
 namespace {
@@ -74,14 +76,6 @@ size_t ChannelBytes(int64_t slot_bytes, uint32_t nslots) {
 char* SlotAt(Channel* ch, uint32_t nslots, int64_t slot_bytes, uint64_t seq) {
   return reinterpret_cast<char*>(ch) + sizeof(Channel) +
          (seq % nslots) * SlotStride(slot_bytes);
-}
-
-long long EnvMs(const char* name, long long dflt) {
-  const char* e = std::getenv(name);
-  if (e == nullptr || *e == 0) return dflt;
-  char* end = nullptr;
-  long long v = std::strtoll(e, &end, 10);
-  return (end != nullptr && *end == 0 && v > 0) ? v : dflt;
 }
 
 bool PidAlive(pid_t pid);  // defined below

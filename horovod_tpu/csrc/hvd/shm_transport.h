@@ -65,6 +65,11 @@ class ShmTransport : public TransportBackend {
 
   const char* Name() const override { return "shm"; }
   bool Enabled() const override { return enabled_; }
+  // HOROVOD_SHM_FALLBACK: false = strict mode — an attach failure or a
+  // poisoned channel is a hard collective error, never a silent TCP leg
+  // (the per-backend knob the op_manager consults on every failure).
+  bool FallthroughAllowed() const override { return allow_fallthrough_; }
+  void set_allow_fallthrough(bool v) { allow_fallthrough_ = v; }
   // Whether this backend is plausibly carrying traffic: the segment is
   // live AND the attach record is not "every attempt failed" (a rank
   // whose attaches all fell back to TCP must not report shm as its
@@ -105,6 +110,7 @@ class ShmTransport : public TransportBackend {
   size_t SegmentBytes() const;
 
   bool enabled_ = false;
+  bool allow_fallthrough_ = true;
   int rank_ = -1;
   int my_index_ = -1;  // my slot in the (sorted) group
   std::vector<int> group_;

@@ -148,6 +148,62 @@ bool Socket::SendFrame(const void* payload, size_t nbytes) {
   }
 }
 
+bool Socket::SendVec(const struct iovec* iov, int iovcnt) {
+  struct msghdr msg;
+  std::memset(&msg, 0, sizeof(msg));
+  // sendmsg mutates nothing, but partial writes need a mutable copy to
+  // advance; bound the vector at the two entries the stripe path uses.
+  struct iovec local[8];
+  if (iovcnt < 1 || iovcnt > 8) return false;
+  std::memcpy(local, iov, iovcnt * sizeof(struct iovec));
+  int first = 0;
+  msg.msg_iov = local;
+  msg.msg_iovlen = iovcnt;
+  while (first < iovcnt) {
+    msg.msg_iov = local + first;
+    msg.msg_iovlen = iovcnt - first;
+    ssize_t w = ::sendmsg(fd_, &msg, MSG_NOSIGNAL);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    size_t sent = static_cast<size_t>(w);
+    while (first < iovcnt && sent >= local[first].iov_len) {
+      sent -= local[first].iov_len;
+      ++first;
+    }
+    if (first < iovcnt) {
+      local[first].iov_base = static_cast<char*>(local[first].iov_base) +
+                              sent;
+      local[first].iov_len -= sent;
+    }
+  }
+  return true;
+}
+
+long Socket::RecvSome(void* p, size_t n, bool nonblock) {
+  if (n == 0) return 0;
+  size_t buffered = rbuf_.size() - rpos_;
+  if (buffered > 0) {
+    size_t take = buffered < n ? buffered : n;
+    std::memcpy(p, rbuf_.data() + rpos_, take);
+    rpos_ += take;
+    if (rpos_ == rbuf_.size()) {
+      rbuf_.clear();
+      rpos_ = 0;
+    }
+    return static_cast<long>(take);
+  }
+  while (true) {
+    ssize_t r = ::recv(fd_, p, n, nonblock ? MSG_DONTWAIT : 0);
+    if (r > 0) return static_cast<long>(r);
+    if (r == 0) return -1;  // orderly close
+    if (errno == EINTR) continue;
+    if (nonblock && (errno == EAGAIN || errno == EWOULDBLOCK)) return 0;
+    return -1;
+  }
+}
+
 bool Socket::RecvFrame(std::string* payload) {
   uint32_t len = 0;
   if (!RecvAll(&len, 4)) return false;

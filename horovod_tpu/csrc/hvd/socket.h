@@ -9,6 +9,8 @@
 #ifndef HVD_SOCKET_H_
 #define HVD_SOCKET_H_
 
+#include <sys/uio.h>
+
 #include <cstdint>
 #include <string>
 #include <vector>
@@ -48,6 +50,17 @@ class Socket {
   bool SendFrame(const void* payload, size_t nbytes);
   bool RecvFrameInto(void* payload, size_t nbytes);
   bool RecvFrame(std::string* payload);
+  // Scatter-gather send for the striped cross-host transport
+  // (stripe_transport.cc): header + payload slice in ONE sendmsg, no
+  // staging copy and no frame length prefix — the stripe piece header
+  // is the framing. Blocking; loops partial writes byte-precise.
+  bool SendVec(const struct iovec* iov, int iovcnt);
+  // One bounded read for the striped receive engine: drains the
+  // internal buffer first (a hello's over-read must not strand bytes),
+  // else a single recv — MSG_DONTWAIT when `nonblock`. Returns bytes
+  // read (> 0), 0 when nonblocking and nothing is available, -1 on
+  // error or orderly close.
+  long RecvSome(void* p, size_t n, bool nonblock);
   // Timed receive for the liveness plane (docs/liveness.md): returns 1
   // with a complete frame, 0 on timeout (any partial frame stays buffered
   // — a later call resumes it byte-exact), -1 when the peer closed or the

@@ -38,8 +38,10 @@
 #include <vector>
 
 #include "../../horovod_tpu/csrc/hvd/controller.h"
+#include "../../horovod_tpu/csrc/hvd/message.h"
 #include "../../horovod_tpu/csrc/hvd/ring_ops.h"
 #include "../../horovod_tpu/csrc/hvd/shm_transport.h"
+#include "../../horovod_tpu/csrc/hvd/stripe_transport.h"
 
 // The extern "C" surface of operations.cc (no installed header — the
 // Python side binds by symbol, and so does this harness).
@@ -75,6 +77,10 @@ long long hvd_ring_local_bytes();
 long long hvd_ring_cross_bytes();
 long long hvd_ring_shm_bytes();
 int hvd_shm_active();
+long long hvd_ring_stripe_bytes();
+int hvd_ring_stripe_count();
+long long hvd_ring_cross_ns();
+void hvd_set_stripes(int stripes);
 int hvd_host_hier_flags();
 int hvd_get_hier_flags();
 void hvd_set_hier_flags(int flags);
@@ -138,6 +144,9 @@ void Monitor(std::atomic<bool>* stop) {
     sink += hvd_ring_cross_bytes();
     sink += hvd_ring_shm_bytes();
     sink += hvd_shm_active();
+    sink += hvd_ring_stripe_bytes();
+    sink += hvd_ring_stripe_count();
+    sink += hvd_ring_cross_ns();
     sink += hvd_host_hier_flags();
     sink += hvd_get_hier_flags();
     sink += static_cast<long long>(hvd_get_cycle_time_ms());
@@ -161,6 +170,7 @@ void Tuner(std::atomic<bool>* stop) {
     ++k;
     hvd_set_parameters(1.0 + (k % 3), 1 << 20);
     hvd_set_hier_flags(k % 4);
+    hvd_set_stripes(1 + (k % 4));
     hvd_set_host_via_xla(k % 2 ? -1 : (1 << 30));
     hvd_set_record_negotiation(k % 2);
     hvd_drain_negotiation(buf, sizeof(buf));
@@ -394,6 +404,177 @@ void ShmPhase() {
   unsetenv("HVD_SHM_FORCE_ATTACH_FAIL");
 }
 
+// Striped cross-host transport under the sanitizers
+// (docs/cross-transport.md): two in-process "leaders" exchange striped
+// messages BOTH ways concurrently (0-byte, sub-chunk, exact-chunk and
+// multi-piece sizes) while a poller hammers the per-stripe counters —
+// the PR 5/7 getter-race class re-checked on the new surface. Then the
+// order-proof receive: pieces hand-written into the stripe sockets with
+// whole stripes delivered out of order must reassemble byte-exact, with
+// the per-piece pipeline hook covering disjoint spans exactly once.
+// Finally the forced-connect-failure leg (the ring.stripe.connect
+// seam's native half) must refuse cleanly.
+void StripePhase() {
+  hvd::Listener l0, l1;
+  if (!l0.Listen(0) || !l1.Listen(0)) {
+    CHECK(false, "stripe phase: listen");
+    return;
+  }
+  std::vector<std::pair<std::string, int>> eps = {
+      {"127.0.0.1", l0.port()}, {"127.0.0.1", l1.port()}};
+  auto pump = [](hvd::Listener* l, hvd::StripeTransport* t) {
+    return [l, t](int peer) {
+      for (int tries = 0; !t->HasAllStripes(peer) && tries < 64;
+           ++tries) {
+        hvd::Socket s = l->Accept(15000);
+        if (!s.valid()) return false;
+        std::string hello;
+        if (!s.RecvFrame(&hello)) continue;
+        int pr = -1, idx = -1;
+        if (std::sscanf(hello.c_str(), "stripe %d %d", &pr, &idx) == 2) {
+          t->Adopt(pr, idx, std::move(s));
+        }
+      }
+      return t->HasAllStripes(peer);
+    };
+  };
+  constexpr int kStripes = 3;
+  constexpr long long kChunk = 4096;
+  {
+    hvd::StripeTransport t0, t1;
+    t0.Init(0, eps, kStripes, kChunk, true, pump(&l0, &t0));
+    t1.Init(1, eps, kStripes, kChunk, true, pump(&l1, &t1));
+    CHECK(t0.Prepare(1), "stripe dial 0->1");
+    CHECK(t1.PrepareRecv(0), "stripe accept at 1");
+    CHECK(t1.Prepare(0), "stripe dial 1->0");
+    CHECK(t0.PrepareRecv(1), "stripe accept at 0");
+    if (failures) return;
+    CHECK(t0.active_stripes() == kStripes, "active stripe count");
+
+    std::atomic<bool> stop{false};
+    std::thread poll([&] {
+      volatile long long sink = 0;
+      while (!stop.load()) {
+        sink += t0.bytes_sent() + t1.bytes_sent() + t0.active_stripes() +
+                t1.active_stripes();
+      }
+      (void)sink;
+    });
+    const size_t kSizes[] = {0, 1, 100, kChunk, kChunk * 5 + 17};
+    constexpr int kIters = 150;
+    auto sender = [&](hvd::StripeTransport* t, int peer, unsigned seed) {
+      for (int i = 0; i < kIters; ++i) {
+        size_t n = kSizes[i % 5];
+        std::vector<char> buf(n);
+        for (size_t k = 0; k < n; ++k) {
+          buf[k] = static_cast<char>((seed + i + k) & 0xff);
+        }
+        CHECK(t->Send(peer, buf.data(), n) == hvd::kTransportOk,
+              "stripe send");
+      }
+    };
+    auto receiver = [&](hvd::StripeTransport* t, int peer,
+                        unsigned seed) {
+      for (int i = 0; i < kIters; ++i) {
+        size_t n = kSizes[i % 5];
+        std::vector<char> buf(n, 0);
+        CHECK(t->Recv(peer, buf.data(), n) == hvd::kTransportOk,
+              "stripe recv");
+        for (size_t k = 0; k < n; ++k) {
+          if (buf[k] != static_cast<char>((seed + i + k) & 0xff)) {
+            CHECK(false, "stripe payload mismatch");
+            break;
+          }
+        }
+      }
+    };
+    std::thread s01(sender, &t0, 1, 3u), r01(receiver, &t1, 0, 3u);
+    std::thread s10(sender, &t1, 0, 77u), r10(receiver, &t0, 1, 77u);
+    s01.join();
+    r01.join();
+    s10.join();
+    r10.join();
+    stop.store(true);
+    poll.join();
+  }
+  // Order-proof receive: dial a fresh receiver by hand, write the
+  // pieces with whole stripes out of order (stripe 2 first, stripe 0
+  // reversed-last), and check RecvPieces reassembles byte-exact with
+  // the pipeline hook covering each span exactly once.
+  {
+    hvd::Listener lr;
+    if (!lr.Listen(0)) {
+      CHECK(false, "stripe phase: reorder listen");
+      return;
+    }
+    std::vector<std::pair<std::string, int>> eps2 = {
+        {"127.0.0.1", 1}, {"127.0.0.1", lr.port()}};
+    hvd::StripeTransport tr;
+    tr.Init(1, eps2, kStripes, kChunk, true, pump(&lr, &tr));
+    std::vector<hvd::Socket> dials;
+    for (int i = 0; i < kStripes; ++i) {
+      hvd::Socket s = hvd::Socket::Connect("127.0.0.1", lr.port(), 5000);
+      CHECK(s.valid() &&
+                s.SendFrame("stripe 0 " + std::to_string(i)),
+            "reorder dial");
+      dials.push_back(std::move(s));
+    }
+    if (failures) return;
+    const size_t total = kChunk * 4 + 123;  // 5 pieces over 3 stripes
+    std::string src(total, 0);
+    for (size_t i = 0; i < total; ++i) {
+      src[i] = static_cast<char>((i * 13 + 5) & 0xff);
+    }
+    uint32_t pieces = hvd::StripePieceCount(total, kChunk);
+    // Whole-stripe delivery order: 2, then 1, then 0 — every piece
+    // arrives "late" relative to round-robin order.
+    for (int s = kStripes - 1; s >= 0; --s) {
+      for (uint32_t i = 0; i < pieces; ++i) {
+        if (hvd::StripeOfSeq(i, kStripes) != s) continue;
+        size_t off = 0, len = 0;
+        hvd::StripePieceSpan(i, total, kChunk, &off, &len);
+        char hdr[hvd::kStripeHdrBytes];
+        hvd::EncodeStripeHdr(i, static_cast<uint32_t>(len), hdr);
+        // Raw stream bytes: header then slice (no frame prefix).
+        struct iovec iov[2];
+        iov[0].iov_base = hdr;
+        iov[0].iov_len = sizeof(hdr);
+        iov[1].iov_base = &src[off];
+        iov[1].iov_len = len;
+        CHECK(dials[s].SendVec(iov, len > 0 ? 2 : 1), "reorder write");
+      }
+    }
+    CHECK(tr.PrepareRecv(0), "reorder accept");
+    if (failures) return;
+    std::string dst(total, 1);
+    std::vector<char> seen(pieces, 0);
+    size_t covered = 0;
+    int rc = tr.RecvPieces(0, &dst[0], total,
+                           [&](size_t off, size_t len) {
+                             uint32_t i = static_cast<uint32_t>(
+                                 off / kChunk);
+                             CHECK(i < pieces && !seen[i],
+                                   "piece hook fires once per span");
+                             if (i < pieces) seen[i] = 1;
+                             covered += len;
+                           });
+    CHECK(rc == hvd::kTransportOk, "reorder recv ok");
+    CHECK(covered == total, "piece hooks cover the payload");
+    CHECK(dst == src, "out-of-order stripes reassemble byte-exact");
+  }
+  // Forced connect failure (the ring.stripe.connect seam's native
+  // half): Prepare must refuse without dialing, leaving the
+  // negotiation to fall through to single-socket TCP.
+  setenv("HVD_STRIPE_FORCE_CONNECT_FAIL", "1", 1);
+  {
+    hvd::StripeTransport tf;
+    tf.Init(0, eps, kStripes, kChunk, true, nullptr);
+    CHECK(!tf.Prepare(1), "forced stripe connect must fail");
+    CHECK(tf.active_stripes() == 0, "failed pair is not active");
+  }
+  unsetenv("HVD_STRIPE_FORCE_CONNECT_FAIL");
+}
+
 }  // namespace
 
 int main() {
@@ -402,6 +583,7 @@ int main() {
   }
   if (failures == 0) RingPhase();
   if (failures == 0) ShmPhase();
+  if (failures == 0) StripePhase();
   if (failures == 0) LivenessControllerPhase();
   if (failures) return 1;
   std::puts("STRESS_OK");

@@ -272,6 +272,93 @@ int main() {
           "heartbeat frame is not a parsable request list");
   }
 
+  // 10. Striped cross-host transport wire contract
+  // (docs/cross-transport.md): the 12-byte piece header round-trips,
+  // every truncation and a stomped magic are REJECTED (a desynced
+  // stripe stream must abort, never guess), the deterministic
+  // piece-span math tiles the message exactly, and reassembly is
+  // order-proof — pieces placed by sequence number alone reconstruct
+  // the payload under ANY cross-stripe arrival order.
+  {
+    char hdr[kStripeHdrBytes];
+    EncodeStripeHdr(/*seq=*/0x01020304u, /*len=*/0xAABBCCu, hdr);
+    uint32_t seq = 0, len = 0;
+    CHECK(DecodeStripeHdr(hdr, sizeof(hdr), &seq, &len),
+          "stripe header roundtrip");
+    CHECK(seq == 0x01020304u && len == 0xAABBCCu,
+          "stripe header fields");
+    for (size_t n = 0; n < kStripeHdrBytes; ++n) {
+      CHECK(!DecodeStripeHdr(hdr, n, &seq, &len),
+            "truncated stripe header rejected");
+    }
+    char bad[kStripeHdrBytes];
+    std::memcpy(bad, hdr, sizeof(hdr));
+    bad[0] ^= 0x5A;  // stomp the magic
+    CHECK(!DecodeStripeHdr(bad, sizeof(bad), &seq, &len),
+          "bad stripe magic rejected");
+
+    // Piece math tiles exactly: spans are contiguous, chunk-sized except
+    // the final remainder, and a 0-byte message is one empty piece.
+    const size_t kChunk = 64;
+    const size_t totals[] = {0, 1, 63, 64, 65, 1000, 64 * 7};
+    for (size_t total : totals) {
+      uint32_t pieces = StripePieceCount(total, kChunk);
+      CHECK(pieces >= 1, "at least one piece");
+      size_t covered = 0;
+      for (uint32_t i = 0; i < pieces; ++i) {
+        size_t off = 0, plen = 0;
+        StripePieceSpan(i, total, kChunk, &off, &plen);
+        CHECK(off == covered, "piece spans contiguous");
+        CHECK(i + 1 < pieces ? plen == kChunk : plen <= kChunk,
+              "non-final pieces are chunk-sized");
+        covered += plen;
+      }
+      CHECK(covered == total, "piece spans tile the message");
+    }
+
+    // Order-proof reassembly: scatter a payload into (seq, span) pieces
+    // across 3 stripes, deliver them in a deterministic shuffle (whole
+    // stripes out of order AND interleaved), place each by seq alone.
+    const size_t total = 1000;
+    const int kStripes = 3;
+    std::string src(total, 0);
+    for (size_t i = 0; i < total; ++i) {
+      src[i] = static_cast<char>((i * 31 + 7) & 0xFF);
+    }
+    uint32_t pieces = StripePieceCount(total, kChunk);
+    const uint32_t base_seq = 12345;  // mid-stream: seq need not be 0
+    std::vector<uint32_t> order;
+    // Stripe 2's pieces first, then stripe 0's reversed, then stripe 1.
+    for (uint32_t i = 0; i < pieces; ++i) {
+      if (StripeOfSeq(base_seq + i, kStripes) == 2) order.push_back(i);
+    }
+    for (uint32_t i = pieces; i-- > 0;) {
+      if (StripeOfSeq(base_seq + i, kStripes) == 0) order.push_back(i);
+    }
+    for (uint32_t i = 0; i < pieces; ++i) {
+      if (StripeOfSeq(base_seq + i, kStripes) == 1) order.push_back(i);
+    }
+    CHECK(order.size() == pieces, "shuffle covers every piece");
+    std::string dst(total, 0);
+    for (uint32_t i : order) {
+      char ph[kStripeHdrBytes];
+      size_t off = 0, plen = 0;
+      StripePieceSpan(i, total, kChunk, &off, &plen);
+      EncodeStripeHdr(base_seq + i, static_cast<uint32_t>(plen), ph);
+      uint32_t pseq = 0, got_len = 0;
+      CHECK(DecodeStripeHdr(ph, sizeof(ph), &pseq, &got_len),
+            "piece header decodes");
+      // Placement by seq alone (the receiver's rule): local index =
+      // seq - base, span derived from it — arrival order irrelevant.
+      size_t roff = 0, rlen = 0;
+      StripePieceSpan(pseq - base_seq, total, kChunk, &roff, &rlen);
+      CHECK(roff == off && rlen == plen && rlen == got_len,
+            "seq-derived span matches");
+      dst.replace(roff, rlen, src, roff, rlen);
+    }
+    CHECK(dst == src, "out-of-order reassembly is byte-exact");
+  }
+
   if (failures) return 1;
   std::puts("MESSAGE_CODEC_OK");
   return 0;

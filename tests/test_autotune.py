@@ -44,12 +44,16 @@ class _FakeCore:
     def __init__(self):
         self.applied = []
         self.hier_applied = []
+        self.stripes_applied = []
 
     def set_parameters(self, cycle_time_ms=-1.0, fusion_threshold=-1):
         self.applied.append((cycle_time_ms, fusion_threshold))
 
     def set_hier_flags(self, flags):
         self.hier_applied.append(flags)
+
+    def set_stripes(self, stripes):
+        self.stripes_applied.append(stripes)
 
 
 def test_parameter_manager_warmup_then_tunes_then_pins():
@@ -105,6 +109,53 @@ def test_parameter_manager_categorical_hier_phase():
     pm.update(MB)
     assert not pm.active          # GP phase converged (max_samples=2)
     assert pm.hier_flags == 2     # pinned decision survives convergence
+
+
+def test_parameter_manager_stripe_phase_after_hier():
+    """The cross-host stripe count joins the categorical grid
+    (docs/cross-transport.md): after the hierarchical grid pins a
+    hier-on combo, the stripe candidates are A/B'd via the frame-synced
+    set_stripes apply and the winner pinned."""
+    core = _FakeCore()
+    pm = ParameterManager(core, warmup_samples=0, steps_per_sample=1,
+                          max_samples=2, tune_hierarchical=True,
+                          stripe_candidates=(1, 4))
+    # Hier grid: combo 3 wins (hier AR + AG — stripes have a leg to
+    # carry); its pin starts the stripe grid at candidate 1.
+    for combo, score in ((0, MB), (1, 2 * MB), (2, 3 * MB), (3, 9 * MB)):
+        pm.update(score)
+    assert pm.hier_flags == 3
+    assert core.stripes_applied == [1]  # stripe phase started
+    pm.update(2 * MB)   # stripes=1 sample
+    pm.update(8 * MB)   # stripes=4 sample -> 4 wins, pinned
+    assert pm.stripes == 4
+    assert core.stripes_applied[-1] == 4
+    assert pm.active  # numeric GP phase still running
+    pm.update(MB)
+    pm.update(MB)
+    assert not pm.active
+    assert pm.stripes == 4  # pinned decision survives convergence
+
+
+def test_parameter_manager_stripe_phase_skipped_when_flat_wins():
+    """hier_flags == 0 means no cross leader leg exists for stripes to
+    carry: the stripe grid must be skipped, not scored against noise."""
+    core = _FakeCore()
+    pm = ParameterManager(core, warmup_samples=0, steps_per_sample=1,
+                          max_samples=2, tune_hierarchical=True,
+                          stripe_candidates=(1, 4))
+    # Huge margin: the score is bytes/elapsed and the FIRST sample's
+    # window includes construction overhead, so a small margin could
+    # flip on timing noise (the other grid tests dodge this by never
+    # crowning combo 0).
+    for combo, score in ((0, 100000 * MB), (1, MB), (2, MB), (3, MB)):
+        pm.update(score)
+    assert pm.hier_flags == 0
+    assert core.stripes_applied == []  # never started
+    pm.update(MB)
+    pm.update(MB)
+    assert not pm.active
+    assert pm.stripes is None
 
 
 def test_hier_flags_frame_sync_native():

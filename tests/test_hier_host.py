@@ -326,7 +326,7 @@ def test_ring_traffic_empty_safe(monkeypatch):
     monkeypatch.setattr(_hw, "_world", _hw.HostWorld())
     assert hvd.ring_traffic() == {
         "bytes_sent": 0, "local_bytes": 0, "cross_bytes": 0,
-        "shm_bytes": 0, "shm": False,
+        "shm_bytes": 0, "shm": False, "stripe_bytes": 0, "stripes": 0,
         "hierarchical_allreduce": False, "hierarchical_allgather": False,
         "tuned": False}
 
@@ -351,6 +351,12 @@ def test_ring_traffic_reads_engine_core_and_decodes_flags(monkeypatch):
         def shm_active(self):
             return True
 
+        def ring_stripe_bytes(self):
+            return 150
+
+        def ring_stripe_count(self):
+            return 4
+
         def host_hier_flags(self):
             return 2  # allgather bit only
 
@@ -365,7 +371,7 @@ def test_ring_traffic_reads_engine_core_and_decodes_flags(monkeypatch):
     monkeypatch.setattr(st, "engine", _Engine())
     assert hvd.ring_traffic() == {
         "bytes_sent": 700, "local_bytes": 400, "cross_bytes": 200,
-        "shm_bytes": 100, "shm": True,
+        "shm_bytes": 100, "shm": True, "stripe_bytes": 150, "stripes": 4,
         "hierarchical_allreduce": False, "hierarchical_allgather": True,
         "tuned": True}
 

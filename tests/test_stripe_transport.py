@@ -1,0 +1,405 @@
+"""Striped multi-socket cross-host transport
+(csrc/hvd/stripe_transport.cc behind the op_manager registry;
+docs/cross-transport.md).
+
+THE acceptance world: 8 ranks as 2 hosts x 4 local with ROUND-ROBIN
+placement and ``HOROVOD_STRIPES=4``. The flat baseline runs first (hier
+flags off — the flat ring has no leader legs, so the stripes stay
+idle), then the tuner flips the two-level dispatch and the SAME
+collectives rerun with the leader legs striped + pipelined; then the
+frame-synced stripe apply flips the world to single-socket (stripes=1)
+and back (stripes=4) MID-WORLD, proving the lock-step renegotiation.
+Results are byte-identical (uint32 views) across every mode, and the
+leaders' ``cross_bytes`` are EXACTLY equal striped vs single-socket —
+striping changes the carrier, never the chunk math or the accounting.
+
+Also here: the forced connect-failure fallback (``ring.stripe.connect``
+seam -> lock-step fallthrough to single-socket TCP), strict mode
+(``HOROVOD_STRIPE_FALLBACK=0`` -> hard error), the ``ring.stripe.exec``
+chaos seam, and the knob accessors.
+"""
+
+import os
+import textwrap
+
+import pytest
+
+from proc_harness import run_world
+
+# 8 ranks = 2 hosts x 4 local, round-robin placement: host(r) = r % 2.
+# Group members {0,2,4,6} / {1,3,5,7}; leaders are ranks 0 and 1.
+_ACCEPTANCE_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    os.environ["HOROVOD_STRIPES"] = "4"
+    # Small pipeline chunk so a 256 KiB leader chunk splits into many
+    # pieces across the 4 stripes — real striping, real reassembly.
+    os.environ["HOROVOD_CHUNK_BYTES"] = "16384"
+    from horovod_tpu.common import native as hn
+
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    SIZE, HOSTS, LOCAL = 8, 2, 4
+    core = hn.NativeCore()
+    assert core.available
+    ok = core.init(rank=rank, size=SIZE, local_rank=rank // HOSTS,
+                   local_size=LOCAL, cross_rank=rank % HOSTS,
+                   cross_size=HOSTS, coordinator_addr="127.0.0.1",
+                   coordinator_port=port, my_host="127.0.0.1",
+                   cycle_time_ms=1.0, fusion_threshold=64 << 20,
+                   cache_capacity=64, stall_warning_sec=60.0,
+                   stall_shutdown_sec=0.0, stall_check_enabled=True,
+                   exec_callback=lambda resp, rid: core.response_done(
+                       rid, False, "host-plane only"))
+    assert ok, "native init failed"
+    is_leader = rank in (0, 1)
+
+    ES = 4  # fp32
+    COUNT = 1 << 16  # 256 KiB: well above the small-payload tree cutoff
+
+    def counters():
+        return (core.ring_cross_bytes(), core.ring_stripe_bytes())
+
+    def run_allreduce(name):
+        buf = (np.arange(COUNT, dtype=np.float32) % 13) + rank
+        h = core.enqueue(name, hn.OP_ALLREDUCE, 1, 7, buf.shape,
+                         data_ptr=buf.ctypes.data,
+                         output_ptr=buf.ctypes.data, plane=hn.PLANE_HOST)
+        r, err = core.wait(h); assert r == 1, err
+        return buf
+
+    def run_allgather(name):
+        blk = (np.arange(4096, dtype=np.float32) % 7) * (rank + 1)
+        out = np.zeros(4096 * SIZE, np.float32)
+        h = core.enqueue(name, hn.OP_ALLGATHER, 1, 7, blk.shape,
+                         data_ptr=blk.ctypes.data,
+                         output_ptr=out.ctypes.data, plane=hn.PLANE_HOST)
+        r, err = core.wait(h); assert r == 1, err
+        return out
+
+    def run_allgatherv(name):
+        # Ragged WITH a zero-count rank: rank 3 contributes nothing.
+        rows = 0 if rank == 3 else rank % 3 + 1
+        blk = np.full((rows, 8), rank + 1, np.int32)
+        h = core.enqueue(name, hn.OP_ALLGATHER, 1, 4, blk.shape,
+                         data_ptr=blk.ctypes.data, output_ptr=0,
+                         plane=hn.PLANE_HOST)
+        r, err = core.wait(h); assert r == 1, err
+        raw, dims = core.result_fetch(h)
+        exp = tuple(0 if rr == 3 else rr % 3 + 1 for rr in range(SIZE))
+        assert dims == exp, (dims, exp)
+        return np.frombuffer(raw, np.int32).reshape(-1, 8)
+
+    def run_small(name):
+        # Under the tree threshold: stays on the latency tree path in
+        # every mode (stripes never touch it) but must keep flowing
+        # through a striped world.
+        buf = np.full(8, float(rank + 1), np.float32)
+        h = core.enqueue(name, hn.OP_ALLREDUCE, 1, 7, buf.shape,
+                         data_ptr=buf.ctypes.data,
+                         output_ptr=buf.ctypes.data, plane=hn.PLANE_HOST)
+        r, err = core.wait(h); assert r == 1, err
+        return buf
+
+    def run_suite(tag):
+        c0, s0 = counters()
+        ar = run_allreduce(f"{tag}.ar")
+        ag = run_allgather(f"{tag}.ag")
+        agv = run_allgatherv(f"{tag}.agv")
+        small = run_small(f"{tag}.small")
+        c1, s1 = counters()
+        return (ar, ag, agv, small), c1 - c0, s1 - s0
+
+    def sync(name):
+        z = np.zeros(1, np.uint8)
+        h = core.enqueue(name, hn.OP_BARRIER, 1, 0, z.shape,
+                         data_ptr=z.ctypes.data, output_ptr=z.ctypes.data,
+                         plane=hn.PLANE_HOST)
+        r, err = core.wait(h); assert r == 1, err
+
+    def assert_identical(a, b, what):
+        for x, y, nm in zip(a, b, ("ar", "ag", "agv", "small")):
+            if x.dtype == np.float32:
+                same = np.array_equal(x.view(np.uint32), y.view(np.uint32))
+            else:
+                same = np.array_equal(x, y)
+            assert same, f"{what}: {nm} diverged"
+
+    # ---- A: flat TCP baseline (no leader legs, stripes idle) ----
+    assert core.host_hier_flags() == 0
+    flat, _, fa_s = run_suite("flat")
+    assert fa_s == 0, ("flat path must not touch the stripes", fa_s)
+
+    # ---- flip two-level dispatch (deterministic barrier sync) ----
+    if rank == 0:
+        core.set_hier_flags(3)
+    sync("sync.hier")
+    assert core.host_hier_flags() == 3
+
+    # ---- B: hier with the leader legs striped (HOROVOD_STRIPES=4) ----
+    hier_st, b_cross, b_stripe = run_suite("hst")
+    assert_identical(flat, hier_st, "striped vs flat")
+    if is_leader:
+        # The bulk cross legs (AR chunks, AG/AGV bundles) ride the
+        # stripes; only the tiny tree-path frames stay single-socket.
+        assert b_stripe >= COUNT * ES, (b_stripe, COUNT * ES)
+        assert b_stripe <= b_cross, (b_stripe, b_cross)
+        assert core.ring_stripe_count() == 4, core.ring_stripe_count()
+    else:
+        assert b_stripe == 0, ("members never stripe", b_stripe)
+        assert b_cross == 0, ("members never touch cross", b_cross)
+
+    # ---- frame-synced flip to single-socket (stripes=1) mid-world ----
+    if rank == 0:
+        core.set_stripes(1)
+    sync("sync.s1")
+    assert core.ring_stripe_count() == 0, core.ring_stripe_count()
+
+    # ---- C: hier single-socket — same results, SAME cross bytes ----
+    hier_ss, c_cross, c_stripe = run_suite("hss")
+    assert_identical(flat, hier_ss, "single-socket vs flat")
+    assert c_stripe == 0, ("single-socket mode must not stripe", c_stripe)
+    # The acceptance invariant: cross_bytes is byte-identical striped vs
+    # single-socket — stripe piece headers ride no counter, payload
+    # accounting never changes with the carrier.
+    assert b_cross == c_cross, ("cross bytes diverged across transports",
+                                b_cross, c_cross)
+
+    # ---- frame-synced flip BACK to 4 stripes: lock-step re-dial ----
+    if rank == 0:
+        core.set_stripes(4)
+    sync("sync.s4")
+    d0_c, d0_s = counters()
+    re_ar = run_allreduce("re.ar")
+    assert np.array_equal(flat[0].view(np.uint32), re_ar.view(np.uint32))
+    d1_c, d1_s = counters()
+    if is_leader:
+        assert d1_s - d0_s >= COUNT * ES, (d1_s - d0_s)
+        assert core.ring_stripe_count() == 4
+
+    core.shutdown()
+    print(f"STRACC_{rank}_OK")
+""")
+
+
+def test_stripe_acceptance_8rank_byte_identity_and_counters(tmp_path):
+    """THE acceptance world: 8-rank 2x4 hier topology with 4 stripes
+    produces byte-identical AR/AG/ragged-AGV (incl. a zero-count rank)
+    vs flat and vs single-socket, cross_bytes is EXACTLY equal striped
+    vs single-socket, and the frame-synced stripe apply renegotiates
+    mid-world in lock-step (4 -> 1 -> 4)."""
+    run_world(tmp_path, _ACCEPTANCE_WORKER, "STRACC", size=8, timeout=300)
+
+
+# ---- forced connect failure -> single-socket fallback ----------------------
+
+_CONNECT_FAULT_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    os.environ.update(HOROVOD_RANK=str(rank), HOROVOD_SIZE="4",
+                      HOROVOD_LOCAL_RANK=str(rank % 2),
+                      HOROVOD_LOCAL_SIZE="2",
+                      HOROVOD_CROSS_RANK=str(rank // 2),
+                      HOROVOD_CROSS_SIZE="2",
+                      HOROVOD_CONTROLLER_ADDR="127.0.0.1",
+                      HOROVOD_CONTROLLER_PORT=str(port),
+                      HOROVOD_CYCLE_TIME="1.0",
+                      HOROVOD_HIERARCHICAL_ALLREDUCE="1",
+                      HOROVOD_HIERARCHICAL_ALLGATHER="1",
+                      HOROVOD_STRIPES="2",
+                      JAX_PLATFORMS="cpu")
+    # Every rank's stripe connect "fails": the seam absorbs the raise
+    # and forces the native dials down, so the cross legs negotiate to
+    # single-socket TCP in lock-step — results identical, stripe
+    # counters untouched.
+    os.environ["HOROVOD_FAULT_SPEC"] = "ring.stripe.connect:kind=raise"
+    from horovod_tpu.common.host_world import world
+
+    w = world()
+    w.init()
+    assert os.environ.get("HVD_STRIPE_FORCE_CONNECT_FAIL") == "1", \\
+        "connect seam did not arm the forced failure"
+    assert w._stripe_seam, "stripe world must arm the exec seam too"
+    core = w._core
+    out = w.allgather_np(np.asarray([float(rank)]), "cf.0")
+    np.testing.assert_allclose(out.ravel(), [0.0, 1.0, 2.0, 3.0])
+    big = np.full(1 << 15, float(rank + 1), np.float32)
+    out2 = w.allgather_np(big, "cf.big")
+    for rr in range(4):
+        assert np.all(out2[rr] == rr + 1), (rr, out2[rr][:3])
+    # The fallback carried everything: no stripe payload, and the
+    # transport-choice surface must not claim striping.
+    assert core.ring_stripe_bytes() == 0, core.ring_stripe_bytes()
+    assert core.ring_stripe_count() == 0, core.ring_stripe_count()
+    if rank in (0, 2):  # leaders (block layout)
+        assert core.ring_cross_bytes() > 0
+    w.barrier("cf.done")
+    w.shutdown()
+    print(f"STRCF_{rank}_OK")
+""")
+
+
+def test_connect_failure_falls_back_to_single_socket(tmp_path):
+    """faults.point('ring.stripe.connect') kind=raise is absorbed: the
+    native stripe dials are forced to fail, the negotiation falls
+    through to single-socket TCP in lock-step, the world completes with
+    exact results, and the stripe counters stay zero."""
+    run_world(tmp_path, _CONNECT_FAULT_WORKER, "STRCF", size=4)
+
+
+# ---- strict mode: fallback disabled -> hard error --------------------------
+
+_STRICT_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    os.environ.update(HOROVOD_STRIPES="2", HOROVOD_STRIPE_FALLBACK="0",
+                      HOROVOD_HIERARCHICAL_ALLREDUCE="1",
+                      HVD_STRIPE_FORCE_CONNECT_FAIL="1",
+                      HVD_STRIPE_TIMEOUT_MS="5000")
+    from horovod_tpu.common import native as hn
+
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    SIZE, LOCAL = 4, 2
+    core = hn.NativeCore()
+    ok = core.init(rank=rank, size=SIZE, local_rank=rank % LOCAL,
+                   local_size=LOCAL, cross_rank=rank // LOCAL,
+                   cross_size=SIZE // LOCAL,
+                   coordinator_addr="127.0.0.1", coordinator_port=port,
+                   my_host="127.0.0.1", cycle_time_ms=1.0,
+                   fusion_threshold=64 << 20, cache_capacity=64,
+                   stall_warning_sec=60.0, stall_shutdown_sec=0.0,
+                   stall_check_enabled=True,
+                   exec_callback=lambda resp, rid: core.response_done(
+                       rid, False, "host-plane only"))
+    assert ok, "native init failed"
+    buf = np.ones(1 << 15, np.float32)
+    h = core.enqueue("st.ar", hn.OP_ALLREDUCE, 1, 7, buf.shape,
+                     data_ptr=buf.ctypes.data, output_ptr=buf.ctypes.data,
+                     plane=hn.PLANE_HOST)
+    r, err = core.wait(h)
+    # Fallback disabled: the connect failure is a hard collective error
+    # on the leaders (the abort control frame fails the receiving leader
+    # too); members fail once the leaders' teardown closes the links —
+    # never a silent single-socket leg.
+    assert r < 0, "strict mode must not silently ride single-socket TCP"
+    assert core.ring_stripe_bytes() == 0
+    core.shutdown()
+    print(f"STRST_{rank}_OK")
+""")
+
+
+@pytest.mark.slow
+def test_strict_mode_connect_failure_is_hard_error(tmp_path):
+    """HOROVOD_STRIPE_FALLBACK=0: a stripe connect failure aborts the
+    collective (fail-fast deployments) instead of silently riding
+    single-socket TCP."""
+    run_world(tmp_path, _STRICT_WORKER, "STRST", size=4)
+
+
+# ---- ring.stripe.exec chaos seam -------------------------------------------
+
+_EXEC_SEAM_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    os.environ.update(HOROVOD_RANK=str(rank), HOROVOD_SIZE="4",
+                      HOROVOD_LOCAL_RANK=str(rank % 2),
+                      HOROVOD_LOCAL_SIZE="2",
+                      HOROVOD_CROSS_RANK=str(rank // 2),
+                      HOROVOD_CROSS_SIZE="2",
+                      HOROVOD_CONTROLLER_ADDR="127.0.0.1",
+                      HOROVOD_CONTROLLER_PORT=str(port),
+                      HOROVOD_CYCLE_TIME="1.0",
+                      HOROVOD_HIERARCHICAL_ALLREDUCE="1",
+                      HOROVOD_STRIPES="2",
+                      JAX_PLATFORMS="cpu")
+    # Rank 1 raises at its SECOND pass through the stripe exec seam.
+    os.environ["HOROVOD_FAULT_SPEC"] = \\
+        "ring.stripe.exec:rank=1:step=1:kind=raise"
+    from horovod_tpu.common import faults
+    from horovod_tpu.common.exceptions import HorovodInternalError
+    from horovod_tpu.common.host_world import world
+
+    w = world()
+    w.init()
+    assert w._stripe_seam, "stripe world must arm the ring.stripe.exec seam"
+    out = w.allgather_np(np.asarray([float(rank)]), "se.0")
+    np.testing.assert_allclose(out.ravel(), [0.0, 1.0, 2.0, 3.0])
+    if rank == 1:
+        try:
+            w.allgather_np(np.asarray([9.0]), "se.poisoned")
+            raise AssertionError("stripe exec fault did not fire")
+        except faults.FaultInjected as e:
+            # IS-A HorovodInternalError: the elastic retry loop treats
+            # it exactly like a real collective failure.
+            assert isinstance(e, HorovodInternalError)
+            assert "ring.stripe.exec" in str(e), e
+    else:
+        out = w.allgather_np(np.asarray([9.0 + rank]), "se.poisoned")
+        assert out.shape[0] == 4
+    w.barrier("se.done")
+    w.shutdown()
+    print(f"STREX_{rank}_OK")
+""")
+
+
+@pytest.mark.slow
+def test_stripe_exec_seam_raises_internal_error(tmp_path):
+    """faults.point('ring.stripe.exec'): armed on every rank of a
+    striped cross-transport world; kind=raise surfaces as
+    HorovodInternalError deterministically on the exact rank + hit."""
+    run_world(tmp_path, _EXEC_SEAM_WORKER, "STREX", size=4)
+
+
+# ---- knob accessors (fast, no worlds) --------------------------------------
+
+
+def test_stripes_accessor_clamps(monkeypatch):
+    from horovod_tpu.common import config
+
+    monkeypatch.delenv(config.HOROVOD_STRIPES, raising=False)
+    assert config.stripes() == 1
+    monkeypatch.setenv(config.HOROVOD_STRIPES, "4")
+    assert config.stripes() == 4
+    monkeypatch.setenv(config.HOROVOD_STRIPES, "0")
+    assert config.stripes() == 1
+    monkeypatch.setenv(config.HOROVOD_STRIPES, "999")
+    assert config.stripes() == 32  # the native poll-set clamp
+    monkeypatch.setenv(config.HOROVOD_STRIPES, "garbage")
+    assert config.stripes() == 1
+
+
+def test_chunk_bytes_accessor(monkeypatch):
+    from horovod_tpu.common import config
+
+    monkeypatch.delenv(config.HOROVOD_CHUNK_BYTES, raising=False)
+    assert config.chunk_bytes() is None
+    monkeypatch.setenv(config.HOROVOD_CHUNK_BYTES, "65536")
+    assert config.chunk_bytes() == 65536
+    monkeypatch.setenv(config.HOROVOD_CHUNK_BYTES, "-3")
+    assert config.chunk_bytes() is None
+    monkeypatch.setenv(config.HOROVOD_CHUNK_BYTES, "nope")
+    assert config.chunk_bytes() is None
+
+
+def test_stripe_fallback_accessor(monkeypatch):
+    from horovod_tpu.common import config
+
+    monkeypatch.delenv(config.HOROVOD_STRIPE_FALLBACK, raising=False)
+    assert config.stripe_fallback_enabled() is True
+    for off in ("0", "false", "no", "off"):
+        monkeypatch.setenv(config.HOROVOD_STRIPE_FALLBACK, off)
+        assert config.stripe_fallback_enabled() is False, off
+    monkeypatch.setenv(config.HOROVOD_STRIPE_FALLBACK, "1")
+    assert config.stripe_fallback_enabled() is True
+
+
+def test_stripe_seams_registered_in_catalog():
+    from horovod_tpu.common import faults
+
+    assert "ring.stripe.connect" in faults.CATALOG
+    assert "ring.stripe.exec" in faults.CATALOG

@@ -65,12 +65,15 @@ def _stats(samples_ms):
 
 
 def worker(rank: int, size: int, port: int, iters: int,
-           cycle_ms: float, hier: bool = False) -> int:
+           cycle_ms: float, hier: bool = False,
+           stripes: int = 0) -> int:
     import numpy as np
 
     sys.path.insert(0, REPO)
     from horovod_tpu.common import native as hn
 
+    if stripes > 0:
+        os.environ["HOROVOD_STRIPES"] = str(stripes)
     if hier:
         # 2 simulated hosts x size/2 local, round-robin placement, with
         # the two-level allreduce dispatched from the env: the RTT rows
@@ -123,21 +126,47 @@ def worker(rank: int, size: int, port: int, iters: int,
     # so id-fast-path hits are counted on worker ranks only.
     hits_seen = core.cache_hits()
 
+    # --stripes soak rows: a few bulk allreduces above the tree cutoff
+    # so the striped leader leg actually engages (the latency rows' tiny
+    # tensors stay on the binomial tree in every mode) — the scale soaks
+    # then cover the new cross path without bloating the fast profile.
+    bulk = []
+    if stripes > 0 and hier:
+        big = np.ones(1 << 16, np.float32)
+
+        def bulk_rtt(name):
+            t0 = time.perf_counter()
+            h = core.enqueue(name, hn.OP_ALLREDUCE, 1, 7, big.shape,
+                             data_ptr=big.ctypes.data,
+                             output_ptr=big.ctypes.data,
+                             plane=hn.PLANE_HOST)
+            r, err = core.wait(h)
+            assert r == 1, err
+            return (time.perf_counter() - t0) * 1e3
+
+        bulk = [bulk_rtt(f"bulk.{i}") for i in range(10)]
+
     traffic = {"local_bytes": core.ring_local_bytes(),
                "cross_bytes": core.ring_cross_bytes(),
                "shm_bytes": core.ring_shm_bytes(),
-               "shm": core.shm_active()}
+               "shm": core.shm_active(),
+               "stripe_bytes": core.ring_stripe_bytes(),
+               "stripes": core.ring_stripe_count()}
     core.shutdown()
     print(f"WORKER_CACHE {rank} {int(hits_seen)}", flush=True)
     print("WORKER_TRAFFIC " + json.dumps({"rank": rank, **traffic}),
           flush=True)
     if rank == 0:
-        print("WORKER_RESULT " + json.dumps({
+        row = {
             "size": size,
             "cycle_time_ms": cycle_ms,
             "miss_ms": _stats(miss),
             "hit_ms": _stats(hit),
-        }), flush=True)
+        }
+        if bulk:
+            row["bulk_ms"] = _stats(bulk)
+            row["bulk_payload_bytes"] = int(big.nbytes)
+        print("WORKER_RESULT " + json.dumps(row), flush=True)
     return 0
 
 
@@ -151,19 +180,21 @@ _PORT_CLASH_MARKERS = (
 
 
 def run_size(size: int, iters: int, cycle_ms: float, timeout: float,
-             attempts: int = 3, hier: bool = False):
+             attempts: int = 3, hier: bool = False, stripes: int = 0):
     last_blob = ""
     for attempt in range(attempts):
         port = _free_port()
         procs = [subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--worker",
              str(r), str(size), str(port), str(iters), str(cycle_ms),
-             "1" if hier else "0"],
+             "1" if hier else "0", str(stripes)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             cwd=REPO) for r in range(size)]
         result = None
         cache_hits = 0
-        traffic = {"local_bytes": 0, "cross_bytes": 0, "shm_bytes": 0}
+        traffic = {"local_bytes": 0, "cross_bytes": 0, "shm_bytes": 0,
+                   "stripe_bytes": 0}
+        stripe_ranks = 0
         shm_ranks = 0
         failed = None
         try:
@@ -180,8 +211,9 @@ def run_size(size: int, iters: int, cycle_ms: float, timeout: float,
                     elif line.startswith("WORKER_TRAFFIC "):
                         t = json.loads(line[len("WORKER_TRAFFIC "):])
                         for k in traffic:
-                            traffic[k] += t[k]
+                            traffic[k] += t.get(k, 0)
                         shm_ranks += 1 if t["shm"] else 0
+                        stripe_ranks += 1 if t.get("stripes") else 0
         finally:
             for p in procs:
                 if p.poll() is None:
@@ -191,8 +223,10 @@ def run_size(size: int, iters: int, cycle_ms: float, timeout: float,
             # Worker ranks resubmitting "hit" rode the id fast path.
             result["cache_hits_worker_ranks"] = cache_hits
             # World-aggregate data-plane split: with --hier (and
-            # HOROVOD_SHM exported) this is the local-leg proof line.
-            result["traffic"] = {**traffic, "shm_active_ranks": shm_ranks}
+            # HOROVOD_SHM exported) this is the local-leg proof line;
+            # with --stripes the stripe column is the cross-leg one.
+            result["traffic"] = {**traffic, "shm_active_ranks": shm_ranks,
+                                 "stripe_active_ranks": stripe_ranks}
             return result
         if attempt + 1 < attempts and any(
                 m in last_blob for m in _PORT_CLASH_MARKERS):
@@ -235,6 +269,14 @@ def main(argv=None):
                         "transport carried them (export HOROVOD_SHM=1 "
                         "for the shm-vs-loopback line; "
                         "docs/shm-transport.md)")
+    p.add_argument("--stripes", type=int, default=0,
+                   help="with --hier: stripe the cross-host leader leg "
+                        "with this many connections per pair "
+                        "(HOROVOD_STRIPES) and add a bulk_ms column of "
+                        "256 KiB allreduces so the scale soaks cover "
+                        "the striped path; the traffic split gains "
+                        "stripe_bytes/stripe_active_ranks "
+                        "(docs/cross-transport.md)")
     p.add_argument("--out", default=None,
                    help="also write the JSON to this path")
     args = p.parse_args(argv)
@@ -246,7 +288,8 @@ def main(argv=None):
         per_size = {}
         for size in sizes:
             per_size[str(size)] = run_size(size, args.iters, cycle_ms,
-                                           args.timeout, hier=args.hier)
+                                           args.timeout, hier=args.hier,
+                                           stripes=args.stripes)
             print(f"controller_bench: cycle {cycle_ms} ms, size {size} "
                   f"done (hit p50 "
                   f"{per_size[str(size)]['hit_ms']['p50']} ms, miss p50 "
@@ -290,5 +333,6 @@ if __name__ == "__main__":
         sys.exit(worker(int(sys.argv[2]), int(sys.argv[3]),
                         int(sys.argv[4]), int(sys.argv[5]),
                         float(sys.argv[6]),
-                        len(sys.argv) > 7 and sys.argv[7] == "1"))
+                        len(sys.argv) > 7 and sys.argv[7] == "1",
+                        int(sys.argv[8]) if len(sys.argv) > 8 else 0))
     sys.exit(main())

@@ -932,6 +932,12 @@ def worker(argv):
         "allgather": traffic["hierarchical_allgather"],
         "tuned": traffic["tuned"],
     }
+    # The FULL unified metrics snapshot (docs/metrics.md): python-plane
+    # counters + the native registry (latency histograms, straggler
+    # state). Read after the timed loop, like the traffic split, so the
+    # BENCH artifact carries the run's whole latency distribution —
+    # not just the throughput headline.
+    result["metrics"] = hvd.metrics()
     if step_times:
         # Per-step rates + a 95% CI (the reference benchmark's
         # mean +- 1.96*std protocol, pytorch_synthetic_benchmark.py:115).

@@ -157,8 +157,10 @@ def stall_report() -> str:
     """Drain and return the native stall inspector's accumulated warnings
     (reference ``stall_inspector.cc``: the coordinator reports tensors
     some ranks submitted and others never did — the classic desync
-    signature). Empty string when nothing stalled, when ``hvd.init()``
-    hasn't run, or when the native core is absent (pure-XLA direct mode).
+    signature). ALWAYS returns ``str``: the empty string — never None,
+    never an exception — when nothing stalled, when ``hvd.init()``
+    hasn't run, or when the native core is absent (pure-XLA direct
+    mode); the shape is pinned by tests/test_metrics.py.
 
     Consuming a non-empty report also records a ``STALL_WARNING`` instant
     in the timeline (when one is active), so stalls line up with the
@@ -180,10 +182,13 @@ def liveness_report() -> str:
     """Drain and return the native liveness plane's accumulated events
     (docs/liveness.md): ``SUSPECT``/``EVICT``/``DRAIN``/``RECOVER``
     lines from the controller's heartbeat state machine, one per
-    transition. Empty when the plane is disabled
+    transition. ALWAYS returns ``str``: the empty string — never None,
+    never an exception — when the plane is disabled
     (``HOROVOD_HEARTBEAT_MS=0``, the default), when nothing happened,
     when ``hvd.init()`` hasn't run, or when the native core is absent
-    (pure-XLA direct mode). Like ``stall_report()``, reading consumes."""
+    (pure-XLA direct mode); the shape is pinned by
+    tests/test_metrics.py. Like ``stall_report()``, reading consumes —
+    the drain rides the unified metrics snapshot (docs/metrics.md)."""
     core = _native_core()
     if core is None:
         return ""
@@ -192,16 +197,43 @@ def liveness_report() -> str:
 
 def _native_core():
     """The process's live NativeCore: the XLA engine's when one runs,
-    else the host (process-rank) world's. None in pure-direct mode."""
-    st = _global_state()
-    if st.initialized and st.engine is not None:
-        core = getattr(st.engine, "native_core", None)
-        if core is not None:
-            return core
-    from .common import host_world as _host_world
+    else the host (process-rank) world's. None in pure-direct mode.
+    (One rule, owned by common/metrics.py — every observability surface
+    resolves the core identically.)"""
+    from .common import metrics as _metrics
 
-    world = _host_world.world()
-    return world._core if world.initialized else None
+    return _metrics.live_native_core()
+
+
+def metrics() -> dict:
+    """The unified metrics snapshot (docs/metrics.md):
+    ``{"python": {...}, "native": {...} | None}``.
+
+    ``python`` holds the Python-plane counters (Retrier retries, fault
+    injections, shm/stripe fallback armings, elastic evictions/drains);
+    ``native`` is the registry snapshot from the single
+    ``hvd_metrics_snapshot`` getter — traffic/control counters, the
+    log2 latency histograms (enqueue→negotiated→executed per op class,
+    background-cycle duration, coordinator per-rank gather wait,
+    cross/shm/stripe leg timings, per-step rank skew), and the
+    straggler detector's state — or None before init / in pure-XLA
+    direct mode. Reading drains pending STRAGGLER_WARNING events into
+    ``native["straggler"]["events"]`` and mirrors them as timeline
+    instants when a timeline is active; counters and histograms are
+    cumulative for the world and unaffected by reads."""
+    from .common import metrics as _metrics
+
+    return _metrics.snapshot()
+
+
+def metrics_report() -> str:
+    """Human-readable rendering of :func:`metrics` — counters, each
+    non-empty histogram with approximate p50/p99 (log2 buckets), and
+    the straggler state. Empty-safe: always returns a string, with or
+    without a native core."""
+    from .common import metrics as _metrics
+
+    return _metrics.report_text()
 
 
 def ring_traffic() -> dict:
@@ -229,24 +261,33 @@ def ring_traffic() -> dict:
     this rank). All zeros/False before init or in pure-XLA direct
     mode."""
     core = _native_core()
+    empty = {"bytes_sent": 0, "local_bytes": 0, "cross_bytes": 0,
+             "shm_bytes": 0, "shm": False,
+             "stripe_bytes": 0, "stripes": 0,
+             "hierarchical_allreduce": False,
+             "hierarchical_allgather": False, "tuned": False}
     if core is None:
-        return {"bytes_sent": 0, "local_bytes": 0, "cross_bytes": 0,
-                "shm_bytes": 0, "shm": False,
-                "stripe_bytes": 0, "stripes": 0,
-                "hierarchical_allreduce": False,
-                "hierarchical_allgather": False, "tuned": False}
-    flags = core.host_hier_flags()
+        return empty
+    # One native call through the unified snapshot (docs/metrics.md)
+    # instead of nine per-counter getters — the consistency invariant
+    # (bytes_sent == local + cross + shm) is asserted against this same
+    # document in tests/test_metrics.py.
+    snap = core.metrics_snapshot()
+    if not snap:
+        return empty
+    c = snap.get("counters", {})
+    flags = int(c.get("host_hier_flags", 0))
     return {
-        "bytes_sent": core.ring_bytes_sent(),
-        "local_bytes": core.ring_local_bytes(),
-        "cross_bytes": core.ring_cross_bytes(),
-        "shm_bytes": core.ring_shm_bytes(),
-        "shm": core.shm_active(),
-        "stripe_bytes": core.ring_stripe_bytes(),
-        "stripes": core.ring_stripe_count(),
+        "bytes_sent": int(c.get("bytes_sent", 0)),
+        "local_bytes": int(c.get("local_bytes", 0)),
+        "cross_bytes": int(c.get("cross_bytes", 0)),
+        "shm_bytes": int(c.get("shm_bytes", 0)),
+        "shm": bool(c.get("shm_active", 0)),
+        "stripe_bytes": int(c.get("stripe_bytes", 0)),
+        "stripes": int(c.get("stripes", 0)),
         "hierarchical_allreduce": bool(flags & 1),
         "hierarchical_allgather": bool(flags & 2),
-        "tuned": core.get_hier_flags() >= 0,
+        "tuned": int(c.get("tuned_hier_flags", -1)) >= 0,
     }
 
 

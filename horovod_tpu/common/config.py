@@ -65,6 +65,15 @@ HOROVOD_SHM_FALLBACK = "HOROVOD_SHM_FALLBACK"
 HOROVOD_STRIPES = "HOROVOD_STRIPES"
 HOROVOD_CHUNK_BYTES = "HOROVOD_CHUNK_BYTES"
 HOROVOD_STRIPE_FALLBACK = "HOROVOD_STRIPE_FALLBACK"
+# Unified metrics plane (common/metrics.py, csrc/hvd/metrics.cc;
+# docs/metrics.md)
+HOROVOD_METRICS_EXPORT = "HOROVOD_METRICS_EXPORT"
+HOROVOD_METRICS_INTERVAL_MS = "HOROVOD_METRICS_INTERVAL_MS"
+HOROVOD_STRAGGLER_MS = "HOROVOD_STRAGGLER_MS"
+HOROVOD_STRAGGLER_PATIENCE = "HOROVOD_STRAGGLER_PATIENCE"
+DEFAULT_METRICS_INTERVAL_MS = 5000
+DEFAULT_STRAGGLER_MS = 100
+DEFAULT_STRAGGLER_PATIENCE = 3
 # Liveness plane: heartbeats, failure detection, graceful drain
 # (common/liveness.py, csrc/hvd/controller.cc; docs/liveness.md)
 HOROVOD_HEARTBEAT_MS = "HOROVOD_HEARTBEAT_MS"
@@ -550,6 +559,42 @@ def stripe_fallback_enabled() -> bool:
     deployments that would rather fail fast than silently lose the
     striped bandwidth (the stripe sibling of ``shm_fallback_enabled``)."""
     return _get_bool(HOROVOD_STRIPE_FALLBACK, default=True)
+
+
+def metrics_export_path():
+    """Prometheus-textfile exporter target (docs/metrics.md), ``None``
+    when unset/empty — the default, under which NO exporter thread
+    starts, no file is written, and no timeline counter events are
+    emitted: programs are byte-identical to pre-metrics builds
+    (regression-tested). Set to a file path to have rank 0's exporter
+    thread atomically rewrite it every ``HOROVOD_METRICS_INTERVAL_MS``
+    in node-exporter textfile format."""
+    return os.environ.get(HOROVOD_METRICS_EXPORT) or None
+
+
+def metrics_interval_ms() -> int:
+    """How often the metrics exporter thread snapshots and publishes
+    (textfile rewrite + timeline counter events). Only meaningful with
+    ``HOROVOD_METRICS_EXPORT`` set."""
+    return max(100, _get_int(HOROVOD_METRICS_INTERVAL_MS,
+                             DEFAULT_METRICS_INTERVAL_MS))
+
+
+def straggler_ms() -> int:
+    """EWMA lag (ms behind the ready group's fastest rank) at which the
+    coordinator's straggler detector fires a STRAGGLER_WARNING naming
+    the rank (docs/metrics.md has the sizing rule). The native core
+    parses the same variable via EnvLL at world init."""
+    return max(1, _get_int(HOROVOD_STRAGGLER_MS, DEFAULT_STRAGGLER_MS))
+
+
+def straggler_patience() -> int:
+    """How many CONSECUTIVE ready groups a rank must arrive last before
+    a warning can fire — one slow step is noise, `patience` slow steps
+    in a row with the threshold-crossing EWMA is attribution. The
+    native core parses the same variable via EnvLL at world init."""
+    return max(1, _get_int(HOROVOD_STRAGGLER_PATIENCE,
+                           DEFAULT_STRAGGLER_PATIENCE))
 
 
 def heartbeat_ms() -> int:

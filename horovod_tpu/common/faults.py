@@ -173,6 +173,9 @@ def _fire(spec: _config.FaultSpec, name: str, rank: int, hit: int) -> None:
     desc = f"fault injected at {name} (rank={rank} hit={hit} " \
            f"kind={spec.kind})"
     _log.warning(desc)
+    from . import metrics as _metrics
+
+    _metrics.inc("faults.injected")
     if spec.kind == "delay_ms":
         _sleep(spec.ms / 1000.0)
         return
@@ -200,15 +203,21 @@ def _timeline_instant(name: str, args: dict) -> None:
     except Exception:
         return
     if timeline is not None:
+        # hvdlint: ignore[timeline-instant-registry] -- generic relay:
+        # the one call site passes the RETRY catalog constant through
         timeline.instant(name, args)
 
 
 def default_on_retry(name: str, attempt: int, delay: float,
                      err: Optional[BaseException]) -> None:
-    """Log + timeline-record one retry (the Retrier default)."""
+    """Log + timeline-record + metrics-count one retry (the Retrier
+    default)."""
     why = f" ({err})" if err is not None else ""
     _log.warning(f"{name}: attempt {attempt + 1} failed{why}; "
                  f"retrying in {delay:.2f}s")
+    from . import metrics as _metrics
+
+    _metrics.inc("retrier.retries")
     from . import timeline as _timeline
 
     _timeline_instant(_timeline.RETRY, {

@@ -185,6 +185,9 @@ class HostWorld:
                     # The FALLBACK is the path under test;
                     # kind=exit/delay keep their usual semantics.
                     os.environ["HVD_SHM_FORCE_ATTACH_FAIL"] = "1"
+                    from . import metrics as _metrics
+
+                    _metrics.inc("shm.attach_fallback")
                     _log.warning(
                         f"ring.shm.attach fault armed: forcing shm "
                         f"attach failure; TCP carries the local legs "
@@ -202,6 +205,9 @@ class HostWorld:
                     # (HOROVOD_STRIPE_FALLBACK=0) the failed dial is a
                     # hard collective error instead.
                     os.environ["HVD_STRIPE_FORCE_CONNECT_FAIL"] = "1"
+                    from . import metrics as _metrics
+
+                    _metrics.inc("stripe.connect_fallback")
                     _log.warning(
                         f"ring.stripe.connect fault armed: forcing "
                         f"stripe connect failure; single-socket TCP "

@@ -223,6 +223,9 @@ def _bind_prototypes(lib):
     lib.hvd_set_stripes.argtypes = [ctypes.c_int]
     lib.hvd_host_hier_flags.restype = ctypes.c_int
     lib.hvd_host_hier_flags.argtypes = []
+    lib.hvd_metrics_snapshot.restype = ctypes.c_int
+    lib.hvd_metrics_snapshot.argtypes = [ctypes.POINTER(ctypes.c_char),
+                                         ctypes.c_int, ctypes.c_int]
     _lib = lib
     return _lib
 
@@ -493,9 +496,39 @@ class NativeCore:
         return (float(self.lib.hvd_get_cycle_time_ms()),
                 int(self.lib.hvd_get_fusion_threshold()))
 
+    # Drain flags for ``metrics_snapshot`` (mirror of
+    # hvd_metrics_snapshot's contract in csrc/hvd/operations.cc).
+    METRICS_DRAIN_LIVENESS = 1
+    METRICS_DRAIN_STRAGGLER = 2
+
+    def metrics_snapshot(self, drain_flags: int = 0) -> dict:
+        """THE unified native metrics read (docs/metrics.md): every
+        counter and histogram as one parsed JSON document —
+        ``{"counters": {...}, "histograms": {...}, "straggler": {...}}``
+        (+ ``"reports"`` when a drain flag consumed one). New native
+        measurements appear here; they do not grow new getters. A
+        too-small buffer is retried at the size the native side reports,
+        with drained reports restored in between — nothing is lost."""
+        import json as _json
+
+        cap = 1 << 16
+        for _ in range(4):
+            buf = ctypes.create_string_buffer(cap)
+            n = int(self.lib.hvd_metrics_snapshot(buf, cap, drain_flags))
+            if n >= 0:
+                if n == 0:
+                    return {}
+                return _json.loads(buf.raw[:n].decode(errors="replace"))
+            cap = -n + 1
+        return {}
+
     def cache_hits(self) -> int:
-        """Requests this rank sent as 4-byte cache ids (fast path)."""
-        return int(self.lib.hvd_cache_hits())
+        """Requests this rank sent as 4-byte cache ids (fast path).
+        Routed through the unified snapshot — the single native
+        observability path; the legacy ``hvd_cache_hits`` symbol stays
+        bound (and exported) for out-of-tree callers only."""
+        snap = self.metrics_snapshot()
+        return int(snap.get("counters", {}).get("cache_hits", 0))
 
     def ring_bytes_sent(self) -> int:
         """Payload bytes this rank has sent on the host data plane (ring
@@ -602,15 +635,11 @@ class NativeCore:
     def liveness_report(self) -> str:
         """Accumulated liveness events (SUSPECT/EVICT/DRAIN/RECOVER lines
         from the controller's liveness plane, docs/liveness.md); consumed
-        on read with the same no-lost-tail drain loop as the stall
-        report."""
-        buf = ctypes.create_string_buffer(65536)
-        parts = []
-        while True:
-            n = self.lib.hvd_liveness_report(buf, len(buf))
-            if n <= 0:
-                break
-            parts.append(buf.raw[:n].decode(errors="replace"))
-            if n < len(buf) - 1:
-                break
-        return "".join(parts)
+        on read. Routed through the unified snapshot's drain flag — the
+        single native observability path; the snapshot's retry contract
+        restores an undelivered drain, so no tail is ever lost. (The
+        legacy ``hvd_liveness_report`` symbol stays bound, for
+        out-of-tree callers only: a .so missing the snapshot symbol
+        never binds at all.)"""
+        snap = self.metrics_snapshot(self.METRICS_DRAIN_LIVENESS)
+        return str(snap.get("reports", {}).get("liveness", ""))

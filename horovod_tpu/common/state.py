@@ -282,6 +282,15 @@ def init(comm=None, devices=None):
                 stripe_candidates=stripe_candidates)
 
         _state.initialized = True
+
+        # Metrics exporter (docs/metrics.md): ONLY when the operator set
+        # HOROVOD_METRICS_EXPORT — unset keeps init byte-identical to
+        # pre-metrics builds (no thread, no file, no timeline counter
+        # events; regression-tested).
+        from . import metrics as _metrics
+
+        _metrics.maybe_start_pump()
+
         _log.info(
             f"horovod_tpu initialized: size={_state.size} "
             f"local_size={_state.local_size} cross_size={_state.cross_size} "
@@ -294,6 +303,11 @@ def shutdown():
     with _state.lock:
         if not _state.initialized:
             return
+        from . import metrics as _metrics
+
+        # Stop the exporter BEFORE the engine/timeline go away: the
+        # final flush still sees a live core and an open timeline.
+        _metrics.stop_pump()
         if _state.engine is not None:
             _state.engine.shutdown()
         if _state.timeline is not None:

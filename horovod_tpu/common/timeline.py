@@ -49,6 +49,32 @@ RANK_SUSPECT = "RANK_SUSPECT"
 RANK_EVICTED = "RANK_EVICTED"
 DRAIN_BEGIN = "DRAIN_BEGIN"
 DRAIN_COMMIT = "DRAIN_COMMIT"
+# Metrics-plane instants (docs/metrics.md): the coordinator's straggler
+# detector naming the rank whose EWMA lag behind the group's fastest
+# crossed the threshold (args: rank, lag_ms), and the cycle marker
+# emitted by mark_cycle.
+STRAGGLER_WARNING = "STRAGGLER_WARNING"
+CYCLE = "CYCLE"
+
+# Single source of truth for timeline instant names — the same
+# registry discipline as ``faults.CATALOG``: every ``timeline.instant``
+# call site must pass one of these module constants (enforced by
+# hvdlint's ``timeline-instant-registry`` check; a genuinely dynamic
+# relay needs a reasoned suppression). Tooling that consumes traces
+# keys off these strings, so a name used ad hoc at a call site is an
+# event no dashboard will ever find.
+INSTANT_CATALOG = (
+    RETRY,
+    STALL_WARNING,
+    HOST_BLACKLISTED,
+    HEARTBEAT_MISS,
+    RANK_SUSPECT,
+    RANK_EVICTED,
+    DRAIN_BEGIN,
+    DRAIN_COMMIT,
+    STRAGGLER_WARNING,
+    CYCLE,
+)
 
 
 class Timeline:
@@ -151,9 +177,25 @@ class Timeline:
             }
         )
 
+    def counter(self, name: str, values: dict):
+        """Chrome-tracing counter event ("C" phase): ``values`` maps
+        series name -> number, rendered by trace viewers as stacked
+        counter tracks. The metrics exporter emits these periodically
+        (docs/metrics.md) so byte counters and cache hits line up with
+        the collectives on the same time axis."""
+        self._emit(
+            {
+                "name": name,
+                "ph": "C",
+                "pid": self._pid,
+                "ts": self._ts_us(),
+                "args": values,
+            }
+        )
+
     def mark_cycle(self):
         if self._mark_cycles:
-            self.instant("CYCLE")
+            self.instant(CYCLE)
 
     # -- writer thread -------------------------------------------------------
 

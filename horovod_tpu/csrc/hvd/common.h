@@ -164,6 +164,10 @@ struct Request {
   // Lets per-chip ragged gathers negotiate; the response publishes the
   // rank-major concatenation (one entry per CHIP) in first_dims.
   std::vector<int64_t> chip_dims;
+  // Coordinator-side only (never serialized): steady-clock ns when this
+  // request was ingested. Feeds the per-step rank-skew histogram and the
+  // straggler detector (metrics.h) — 0 until the coordinator stamps it.
+  int64_t arrive_ns = 0;
 };
 
 // Coordinator -> ranks (reference: message.h Response). One response may
@@ -205,6 +209,12 @@ struct TensorTableEntry {
   void* output = nullptr;
   int64_t handle = -1;
   StatusCallback callback;
+  // Metrics plane (metrics.h): steady-clock ns at enqueue, and at the
+  // moment the negotiated response reached PerformOperation. Together
+  // they split a collective's latency into negotiation wait vs
+  // execution (enqueue→negotiated→executed per op class).
+  int64_t enqueue_ns = 0;
+  int64_t negotiated_ns = 0;
 };
 
 }  // namespace hvd

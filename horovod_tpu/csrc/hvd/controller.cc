@@ -8,6 +8,7 @@
 #include <cstdlib>
 
 #include "message.h"
+#include "metrics.h"
 
 // TSan-build detection (see tensor_queue.cc): GCC-10-era libtsan lacks
 // the pthread_cond_clockwait interceptor libstdc++ uses for steady_clock
@@ -718,6 +719,9 @@ std::vector<Response> TcpController::CoordinatorCycle(
 
   auto ingest = [this](std::vector<Request>&& rs,
                        std::vector<uint32_t>&& ids, int default_rank) {
+    // Per-rank ready timestamp (metrics.h): the arrival stamp feeds the
+    // rank-skew histogram + straggler detector once the group fires.
+    int64_t now_ns = metrics::MonoNs();
     for (auto& q : rs) {
       if (q.rank < 0 || q.rank >= cfg_.size) q.rank = default_rank;
       if (q.op == CollectiveOp::JOIN) {
@@ -727,6 +731,7 @@ std::vector<Response> TcpController::CoordinatorCycle(
         }
         continue;
       }
+      q.arrive_ns = now_ns;
       stall_.RecordRank(q.name, q.rank);
       RecordNegotiationEvent(q.name, q.rank);
       auto& group = pending_[q.name];
@@ -736,6 +741,7 @@ std::vector<Response> TcpController::CoordinatorCycle(
       Request q;
       if (cache_.Get(id, &q)) {
         q.rank = default_rank;
+        q.arrive_ns = now_ns;
         stall_.RecordRank(q.name, q.rank);
         RecordNegotiationEvent(q.name, q.rank);
         auto& group = pending_[q.name];
@@ -744,6 +750,7 @@ std::vector<Response> TcpController::CoordinatorCycle(
     }
   };
 
+  auto gather_start = std::chrono::steady_clock::now();
   ingest(std::move(my_reqs), {}, 0);
 
   // One request frame from every live worker. The DRAIN flag marks a
@@ -751,6 +758,15 @@ std::vector<Response> TcpController::CoordinatorCycle(
   // like a shutdown, but the event stream lets the driver charge zero
   // blacklist strikes for it.
   auto ingest_frame = [&](int r, const std::string& bytes) {
+    // Per-rank gather wait: how long this cycle's gather waited for
+    // rank r's frame — the coordinator-scaling signal controller_bench
+    // reports percentiles of (ROADMAP item 3).
+    (void)r;
+    metrics::Record(
+        metrics::kGatherWaitUs,
+        std::chrono::duration_cast<std::chrono::microseconds>(
+            std::chrono::steady_clock::now() - gather_start)
+            .count());
     std::vector<Request> rs;
     std::vector<uint32_t> ids;
     bool sd = false, dr = false;
@@ -813,6 +829,29 @@ std::vector<Response> TcpController::CoordinatorCycle(
                    cfg_.size, active, kv.first.c_str(), ranks.c_str());
     }
     if (active > 0 && all_active_submitted(kv.second)) {
+      // Per-step rank skew (metrics.h): arrival spread inside the ready
+      // group, and the per-rank lags behind the earliest arrival — the
+      // straggler detector's food. Stamps can span cycles: a rank whose
+      // submission arrived a cycle late shows its true lag.
+      int64_t first_ns = 0, last_ns = 0;
+      int stamped = 0;
+      for (const auto& q : kv.second) {
+        if (q.arrive_ns <= 0) continue;
+        ++stamped;
+        if (first_ns == 0 || q.arrive_ns < first_ns) first_ns = q.arrive_ns;
+        if (q.arrive_ns > last_ns) last_ns = q.arrive_ns;
+      }
+      if (stamped >= 2) {
+        metrics::Record(metrics::kRankSkewUs, (last_ns - first_ns) / 1000);
+        std::vector<std::pair<int, double>> lags;
+        lags.reserve(kv.second.size());
+        for (const auto& q : kv.second) {
+          if (q.arrive_ns > 0) {
+            lags.emplace_back(q.rank, (q.arrive_ns - first_ns) / 1e6);
+          }
+        }
+        metrics::Registry::Get().straggler().ObserveGroup(lags);
+      }
       Response resp;
       ValidateGroup(kv.first, kv.second, cfg_.size, &resp);
       if (joined > 0 && resp.error_reason.empty() &&

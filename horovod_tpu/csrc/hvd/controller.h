@@ -183,6 +183,16 @@ class Controller {
     return r;
   }
 
+  // Put back a drained liveness report that could not be delivered
+  // (hvd_metrics_snapshot drains it into the JSON, but a too-small
+  // caller buffer must not lose events — same no-silent-truncation rule
+  // as the negotiation-event requeue).
+  void RestoreLivenessReport(std::string undelivered) {
+    std::lock_guard<std::mutex> lk(liveness_mu_);
+    undelivered += liveness_report_;
+    liveness_report_ = std::move(undelivered);
+  }
+
   // Per-rank negotiation ticks (reference Timeline::NegotiateRankReady,
   // controller.cc:797-809): when enabled, the coordinator records the
   // monotonic time each rank's submission arrives, so the timeline can

@@ -30,6 +30,7 @@
 #include "controller.h"
 #include "env_util.h"
 #include "message.h"
+#include "metrics.h"
 #include "ring_ops.h"
 #include "tensor_queue.h"
 
@@ -223,6 +224,206 @@ bool HostHierBit(int bit) {
   return ((flags >> bit) & 1) != 0;
 }
 
+// ---- metrics plumbing (metrics.h; docs/metrics.md) -------------------------
+
+metrics::HistId EnqHistFor(CollectiveOp op) {
+  switch (op) {
+    case CollectiveOp::ALLREDUCE: return metrics::kEnqToNegAllreduceUs;
+    case CollectiveOp::ALLGATHER: return metrics::kEnqToNegAllgatherUs;
+    case CollectiveOp::BROADCAST: return metrics::kEnqToNegBroadcastUs;
+    default: return metrics::kEnqToNegOtherUs;
+  }
+}
+
+metrics::HistId DoneHistFor(CollectiveOp op) {
+  switch (op) {
+    case CollectiveOp::ALLREDUCE: return metrics::kNegToDoneAllreduceUs;
+    case CollectiveOp::ALLGATHER: return metrics::kNegToDoneAllgatherUs;
+    case CollectiveOp::BROADCAST: return metrics::kNegToDoneBroadcastUs;
+    default: return metrics::kNegToDoneOtherUs;
+  }
+}
+
+// The response for this entry arrived: close the negotiation-latency
+// span and open the execution one.
+void MarkEntryNegotiated(TensorTableEntry& e) {
+  e.negotiated_ns = metrics::MonoNs();
+  if (e.enqueue_ns > 0) {
+    metrics::Record(EnqHistFor(e.request.op),
+                    (e.negotiated_ns - e.enqueue_ns) / 1000);
+  }
+}
+
+// The entry's handle resolved (ring executed, or the XLA executor
+// reported back): close the execution-latency span.
+void RecordEntryDone(const TensorTableEntry& e) {
+  if (e.negotiated_ns > 0) {
+    metrics::Record(DoneHistFor(e.request.op),
+                    (metrics::MonoNs() - e.negotiated_ns) / 1000);
+  }
+}
+
+// ---- unified snapshot (docs/metrics.md) ------------------------------------
+//
+// ONE JSON document for every native counter and histogram, assembled
+// under init_mu (the ring/controller pointers it reads are the ones
+// hvd_shutdown resets — the PR 5/7/8 getter-race class, guarded once
+// here instead of once per getter). This is the single growth path for
+// native observability: new measurements join the registry and appear
+// here; they do not get their own extern "C" symbol.
+
+void JsonEscapeInto(std::string& out, const std::string& s) {
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char esc[8];
+          std::snprintf(esc, sizeof(esc), "\\u%04x", c);
+          out += esc;
+        } else {
+          out += c;
+        }
+    }
+  }
+}
+
+void AppendKV(std::string& out, const char* key, long long v,
+              bool* first) {
+  if (!*first) out += ',';
+  *first = false;
+  out += '"';
+  out += key;
+  out += "\":";
+  out += std::to_string(v);
+}
+
+void AppendKVD(std::string& out, const char* key, double v, bool* first) {
+  char num[64];
+  std::snprintf(num, sizeof(num), "%.3f", v);
+  if (!*first) out += ',';
+  *first = false;
+  out += '"';
+  out += key;
+  out += "\":";
+  out += num;
+}
+
+// Caller holds init_mu.
+std::string BuildMetricsJsonLocked(GlobalState* s,
+                                   const std::string& liveness,
+                                   bool with_liveness,
+                                   const std::vector<metrics::StragglerEvent>&
+                                       events,
+                                   bool with_events) {
+  auto& reg = metrics::Registry::Get();
+  std::string out;
+  out.reserve(4096);
+  out += "{\"counters\":{";
+  bool first = true;
+  AppendKV(out, "initialized", s->initialized.load() ? 1 : 0, &first);
+  AppendKV(out, "rank", s->rank.load(), &first);
+  AppendKV(out, "size", s->size.load(), &first);
+  AppendKV(out, "cycles", reg.cycles(), &first);
+  AppendKV(out, "pending", static_cast<long long>(
+                               s->tensor_queue.PendingCount()), &first);
+  AppendKVD(out, "cycle_time_ms", s->cycle_time_ms.load(), &first);
+  AppendKV(out, "cache_hits",
+           s->controller ? static_cast<long long>(s->controller->cache_hits())
+                         : 0,
+           &first);
+  AppendKV(out, "fusion_threshold",
+           s->controller
+               ? static_cast<long long>(s->controller->fusion_threshold())
+               : -1,
+           &first);
+  AppendKV(out, "bytes_sent", s->ring ? s->ring->bytes_sent() : 0, &first);
+  AppendKV(out, "local_bytes", s->ring ? s->ring->local_bytes_sent() : 0,
+           &first);
+  AppendKV(out, "cross_bytes", s->ring ? s->ring->cross_bytes_sent() : 0,
+           &first);
+  AppendKV(out, "shm_bytes", s->ring ? s->ring->shm_bytes_sent() : 0,
+           &first);
+  AppendKV(out, "stripe_bytes", s->ring ? s->ring->stripe_bytes_sent() : 0,
+           &first);
+  AppendKV(out, "shm_active",
+           (s->ring && s->ring->shm_active()) ? 1 : 0, &first);
+  AppendKV(out, "stripes", s->ring ? s->ring->stripe_count() : 0, &first);
+  AppendKV(out, "cross_leg_ns", s->ring ? s->ring->cross_leg_ns() : 0,
+           &first);
+  {
+    int hf = s->hier_flags.load();
+    AppendKV(out, "host_hier_flags",
+             hf >= 0 ? hf : s->hier_env_flags.load(), &first);
+    AppendKV(out, "tuned_hier_flags", hf, &first);
+  }
+  out += "},\"histograms\":{";
+  for (int i = 0; i < metrics::kNumHistograms; ++i) {
+    const auto& h = reg.hist(i);
+    if (i) out += ',';
+    out += '"';
+    out += metrics::HistName(i);
+    out += "\":{\"count\":";
+    out += std::to_string(h.count());
+    out += ",\"sum\":";
+    out += std::to_string(h.sum());
+    out += ",\"max\":";
+    out += std::to_string(h.max());
+    out += ",\"buckets\":[";
+    bool fb = true;
+    for (int b = 0; b < metrics::Log2Histogram::kBuckets; ++b) {
+      long long c = h.bucket(b);
+      if (c == 0) continue;  // sparse: [bucket_index, count] pairs
+      if (!fb) out += ',';
+      fb = false;
+      out += '[';
+      out += std::to_string(b);
+      out += ',';
+      out += std::to_string(c);
+      out += ']';
+    }
+    out += "]}";
+  }
+  out += "},\"straggler\":{";
+  auto& det = reg.straggler();
+  first = true;
+  AppendKV(out, "warnings", det.warnings(), &first);
+  AppendKV(out, "last_rank", det.last_rank(), &first);
+  AppendKVD(out, "last_lag_ms", det.last_lag_ms(), &first);
+  out += ",\"ewma_ms\":[";
+  {
+    auto ewma = det.EwmaMs();
+    for (size_t i = 0; i < ewma.size(); ++i) {
+      if (i) out += ',';
+      char num[64];
+      std::snprintf(num, sizeof(num), "%.3f", ewma[i]);
+      out += num;
+    }
+  }
+  out += "],\"events\":[";
+  if (with_events) {
+    for (size_t i = 0; i < events.size(); ++i) {
+      if (i) out += ',';
+      char ev[96];
+      std::snprintf(ev, sizeof(ev), "{\"rank\":%d,\"lag_ms\":%.3f}",
+                    events[i].rank, events[i].lag_ms);
+      out += ev;
+    }
+  }
+  out += "]}";
+  if (with_liveness) {
+    out += ",\"reports\":{\"liveness\":\"";
+    JsonEscapeInto(out, liveness);
+    out += "\"}";
+  }
+  out += '}';
+  return out;
+}
+
 void ExecuteHostResponse(const Response& resp,
                          std::vector<TensorTableEntry>& entries) {
   // Fuse host entries into one flat buffer, run the ring op, scatter back —
@@ -369,6 +570,7 @@ void ExecuteHostResponse(const Response& resp,
       st = Status::InvalidArgument("unsupported host-plane op");
   }
   for (auto& e : entries) {
+    RecordEntryDone(e);
     s->handles.MarkDone(e.handle, st);
     if (e.callback) e.callback(st);
   }
@@ -404,6 +606,7 @@ void PerformOperation(const Response& resp) {
   // tensor_queue.cc:88-113 AllocateZeros path. Both executors zero-fill
   // missing slots from the response's canonical layout.
   if (entries.empty() && !s->joined.load()) return;
+  for (auto& e : entries) MarkEntryNegotiated(e);
   if (resp.plane == DevicePlane::HOST) {
     // Large fused allreduces and broadcasts may opt into the XLA-plane
     // staging executor (hvd_set_host_via_xla); everything else runs on
@@ -483,6 +686,11 @@ bool RunLoopOnce(std::chrono::steady_clock::time_point& last_cycle) {
   }
   last_cycle = std::chrono::steady_clock::now();
 
+  // Background-cycle duration (metrics.h): the ACTIVE portion of a
+  // cycle — negotiation plus response execution — not the idle wait
+  // above, so the histogram answers "how long does one round of work
+  // take", the number the cycle-time knob is tuned against.
+  auto cycle_start = std::chrono::steady_clock::now();
   bool want_shutdown = s->shutdown_requested.load();
   bool want_drain = s->drain_requested.load();
   bool world_shutdown = false;
@@ -506,6 +714,11 @@ bool RunLoopOnce(std::chrono::steady_clock::time_point& last_cycle) {
     s->ring->ApplyStripeCount(synced_stripes);
   }
   for (const auto& r : responses) PerformOperation(r);
+  metrics::Registry::Get().IncCycles();
+  metrics::Record(metrics::kCycleUs,
+                  std::chrono::duration_cast<std::chrono::microseconds>(
+                      std::chrono::steady_clock::now() - cycle_start)
+                      .count());
   return !world_shutdown;
 }
 
@@ -548,6 +761,11 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
     // caller bug that must not be silently ignored.
     return (rank == s->rank && size == s->size) ? 0 : -2;
   }
+  // Fresh-world metrics baseline (metrics.h): histograms and straggler
+  // state are world-scoped like the ring traffic counters — a previous
+  // (elastic) world's rank identities and timings must not pollute this
+  // one. Also re-reads the HOROVOD_STRAGGLER_* knobs.
+  hvd::metrics::Registry::Get().ResetForWorld(size);
   // A fresh world starts from the env config; a previous world's tuned
   // dispatch flags must not leak through re-init.
   s->hier_flags.store(-1);
@@ -837,6 +1055,7 @@ static long long EnqueueImpl(const char* name, int op, int reduce_op,
   e.request.shape = hvd::TensorShape(std::move(dims));
   e.data = data;
   e.output = output;
+  e.enqueue_ns = hvd::metrics::MonoNs();
   e.handle = s->handles.NewHandle();
   long long h = e.handle;
   if (done != nullptr) {
@@ -1046,6 +1265,47 @@ int hvd_host_hier_flags() {
   return hf >= 0 ? hf : s->hier_env_flags.load();
 }
 
+// THE unified metrics getter (docs/metrics.md): every native counter
+// and histogram as one JSON document. `drain_flags` bit0 additionally
+// drains the liveness report into reports.liveness (consume-on-read,
+// like hvd_liveness_report); bit1 drains the straggler warning events
+// (the Python plane turns them into STRAGGLER_WARNING timeline
+// instants). Returns the JSON length and writes it NUL-terminated when
+// it fits in `cap`; otherwise restores anything drained and returns
+// -(needed bytes) so the caller can retry with a bigger buffer — a
+// too-small buffer never silently loses events.
+int hvd_metrics_snapshot(char* buf, int cap, int drain_flags) {
+  auto* s = hvd::g();
+  std::lock_guard<std::mutex> lk(s->init_mu);
+  std::string liveness;
+  bool with_liveness = false;
+  if ((drain_flags & 1) && s->controller) {
+    liveness = s->controller->TakeLivenessReport();
+    with_liveness = true;
+  }
+  std::vector<hvd::metrics::StragglerEvent> events;
+  bool with_events = (drain_flags & 2) != 0;
+  if (with_events) {
+    events = hvd::metrics::Registry::Get().straggler().DrainEvents();
+  }
+  std::string js = hvd::BuildMetricsJsonLocked(s, liveness, with_liveness,
+                                               events, with_events);
+  if (buf == nullptr || cap <= 0 ||
+      js.size() > static_cast<size_t>(cap - 1)) {
+    if (with_liveness && !liveness.empty()) {
+      s->controller->RestoreLivenessReport(std::move(liveness));
+    }
+    if (with_events && !events.empty()) {
+      hvd::metrics::Registry::Get().straggler().RestoreEvents(
+          std::move(events));
+    }
+    return -static_cast<int>(js.size() + 1);
+  }
+  std::memcpy(buf, js.data(), js.size());
+  buf[js.size()] = '\0';
+  return static_cast<int>(js.size());
+}
+
 // Poll: 0 pending, 1 done-ok, -1 done-error.
 int hvd_test(long long handle, char* err, int errlen) {
   std::string reason;
@@ -1089,6 +1349,7 @@ void hvd_response_done(long response_id, int ok, const char* error) {
     for (auto& e : entries) s->results.erase(e.handle);
   }
   for (auto& e : entries) {
+    hvd::RecordEntryDone(e);
     s->handles.MarkDone(e.handle, st);
     if (e.callback) e.callback(st);
   }

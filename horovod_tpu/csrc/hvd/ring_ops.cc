@@ -10,6 +10,7 @@
 #include <thread>
 
 #include "half.h"
+#include "metrics.h"
 
 namespace hvd {
 
@@ -304,12 +305,17 @@ bool Ring::LocalSend(TransportLeg leg, int peer, const void* buf,
     AddSent(peer, nbytes);
     return true;
   }
+  auto t0 = std::chrono::steady_clock::now();
   int id = op_mgr_->Send(leg, peer, buf, nbytes);
   if (id < 0) return false;
   if (id == shm_backend_id_) {
     // TCP sends account inside CountedSendFrame; shm payload counts
     // into the total here (and into the shm counter in the backend).
     bytes_sent_.fetch_add(static_cast<long long>(nbytes));
+    metrics::Record(metrics::kShmLegUs,
+                    std::chrono::duration_cast<std::chrono::microseconds>(
+                        std::chrono::steady_clock::now() - t0)
+                        .count());
   }
   return true;
 }
@@ -319,7 +325,16 @@ bool Ring::LocalRecv(TransportLeg leg, int peer, void* buf, size_t nbytes) {
     Socket* s = PeerLink(peer);
     return s != nullptr && s->RecvFrameInto(buf, nbytes);
   }
-  return op_mgr_->Recv(leg, peer, buf, nbytes) >= 0;
+  auto t0 = std::chrono::steady_clock::now();
+  int id = op_mgr_->Recv(leg, peer, buf, nbytes);
+  if (id < 0) return false;
+  if (id == shm_backend_id_) {
+    metrics::Record(metrics::kShmLegUs,
+                    std::chrono::duration_cast<std::chrono::microseconds>(
+                        std::chrono::steady_clock::now() - t0)
+                        .count());
+  }
+  return true;
 }
 
 void Ring::SetTopology(const std::vector<int>& cross_ranks) {
@@ -469,17 +484,25 @@ bool Ring::CrossSendRecv(int next, const void* sbuf, size_t sbytes,
                          const std::function<void(size_t, size_t)>&
                              on_piece) {
   // Leg-local timing (cross_leg_ns): the one honest clock for a
-  // transport A/B — everything inside here IS the leader leg.
+  // transport A/B — everything inside here IS the leader leg. The same
+  // duration also lands in the metrics histograms (cross always, stripe
+  // when the striped carrier is in active use) so the snapshot shows
+  // the leg's latency distribution, not just its total.
   struct LegTimer {
     std::atomic<long long>& acc;
+    bool striped;
     std::chrono::steady_clock::time_point t0 =
         std::chrono::steady_clock::now();
     ~LegTimer() {
-      acc.fetch_add(std::chrono::duration_cast<std::chrono::nanoseconds>(
-                        std::chrono::steady_clock::now() - t0)
-                        .count());
+      long long ns =
+          std::chrono::duration_cast<std::chrono::nanoseconds>(
+              std::chrono::steady_clock::now() - t0)
+              .count();
+      acc.fetch_add(ns);
+      metrics::Record(metrics::kCrossLegUs, ns / 1000);
+      if (striped) metrics::Record(metrics::kStripeLegUs, ns / 1000);
     }
-  } timer{cross_ns_};
+  } timer{cross_ns_, stripe_ != nullptr && stripe_->active_stripes() > 0};
   if (!cross_registry_ || op_mgr_ == nullptr) {
     // Striping off: the direct PeerLink duplex, bit-for-bit the
     // pre-stripe path (no negotiation frames).

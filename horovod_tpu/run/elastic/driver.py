@@ -194,6 +194,8 @@ class ElasticDriver:
 
     def _instant(self, name: str, args: dict) -> None:
         if self._timeline is not None:
+            # hvdlint: ignore[timeline-instant-registry] -- generic
+            # relay: every call site passes a catalog constant through
             self._timeline.instant(name, args)
 
     def _check_liveness(self):
@@ -256,6 +258,9 @@ class ElasticDriver:
                         f"({ev.silence_ms:.0f}ms silent)")
                 elif ev.kind == _liveness.EVICT:
                     self._instant(_timeline.RANK_EVICTED, args)
+                    from ...common import metrics as _metrics
+
+                    _metrics.inc("elastic.evictions")
                     _log.warning(
                         f"elastic: worker {host}:{slot} EVICTED after "
                         f"{ev.silence_ms:.0f}ms of silence")
@@ -507,6 +512,9 @@ class ElasticDriver:
                 # world still shrinks and re-activates. Checked before
                 # `evicted` — a drain whose farewell lost the race with
                 # the liveness eviction is still a clean drain.
+                from ...common import metrics as _metrics
+
+                _metrics.inc("elastic.drains")
                 self._worker_registry.record_drained(host, lslot)
             elif evicted:
                 # The liveness plane gave up on this worker (silence past

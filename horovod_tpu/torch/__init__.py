@@ -53,7 +53,13 @@ from .mpi_ops import (  # noqa: F401
     size,
     synchronize,
 )
-from .. import liveness_report, ring_traffic, stall_report  # noqa: F401
+from .. import (  # noqa: F401
+    liveness_report,
+    metrics,
+    metrics_report,
+    ring_traffic,
+    stall_report,
+)
 from .compression import Compression  # noqa: F401
 from .functions import (  # noqa: F401
     allgather_object,

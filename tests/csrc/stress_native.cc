@@ -91,6 +91,7 @@ void hvd_set_host_via_xla(long long threshold);
 void hvd_set_record_negotiation(int enabled);
 int hvd_drain_negotiation(char* buf, int cap);
 int hvd_stall_report(char* buf, int cap);
+int hvd_metrics_snapshot(char* buf, int cap, int drain_flags);
 }
 
 namespace {
@@ -136,8 +137,21 @@ void Submitter(int id, int iters) {
 // ring/controller).
 void Monitor(std::atomic<bool>* stop) {
   char buf[4096];
+  // Unified-snapshot hammer (the PR 5/7/8 getter-race class,
+  // pre-empted this time): the JSON assembly walks the ring, the
+  // controller, and the metrics registry under init_mu while
+  // submitters enqueue and RunWorld tears worlds down — and the drain
+  // flags cycle so the liveness-drain/restore and straggler-event
+  // paths race shutdown too. A 4 KiB buffer is deliberately sometimes
+  // too small: the negative-return restore path is part of the
+  // surface.
+  static char snap[16384];
+  int k = 0;
   volatile long long sink = 0;  // keep loads observable
   while (!stop->load()) {
+    ++k;
+    sink += hvd_metrics_snapshot(snap, (k % 3) ? sizeof(snap) : 64,
+                                 k % 4);
     sink += hvd_cache_hits();
     sink += hvd_ring_bytes_sent();
     sink += hvd_ring_local_bytes();

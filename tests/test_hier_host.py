@@ -336,32 +336,18 @@ def test_ring_traffic_reads_engine_core_and_decodes_flags(monkeypatch):
     from horovod_tpu.common import state as _state
 
     class _Core:
-        def ring_bytes_sent(self):
-            return 700
-
-        def ring_local_bytes(self):
-            return 400
-
-        def ring_cross_bytes(self):
-            return 200
-
-        def ring_shm_bytes(self):
-            return 100
-
-        def shm_active(self):
-            return True
-
-        def ring_stripe_bytes(self):
-            return 150
-
-        def ring_stripe_count(self):
-            return 4
-
-        def host_hier_flags(self):
-            return 2  # allgather bit only
-
-        def get_hier_flags(self):
-            return 2  # >= 0: an autotuner decision reached this rank
+        # ring_traffic() rides the unified metrics snapshot
+        # (docs/metrics.md) — ONE native call — instead of nine
+        # per-counter getters; the fake fakes that single surface.
+        def metrics_snapshot(self, drain_flags=0):
+            return {"counters": {
+                "bytes_sent": 700, "local_bytes": 400,
+                "cross_bytes": 200, "shm_bytes": 100, "shm_active": 1,
+                "stripe_bytes": 150, "stripes": 4,
+                # allgather bit only; tuned >= 0: an autotuner decision
+                # reached this rank
+                "host_hier_flags": 2, "tuned_hier_flags": 2,
+            }}
 
     class _Engine:
         native_core = _Core()

@@ -275,6 +275,72 @@ def test_exception_discipline_compliant_handlers(tmp_path):
 # suppressions
 # ---------------------------------------------------------------------------
 
+# ---------------------------------------------------------------------------
+# 6. timeline-instant-registry
+# ---------------------------------------------------------------------------
+
+TIMELINE_FIXTURE = """\
+RETRY = "RETRY"
+STALL_WARNING = "STALL_WARNING"
+INSTANT_CATALOG = (RETRY, STALL_WARNING)
+"""
+
+
+def test_timeline_instant_registry_flags_uncataloged(tmp_path):
+    root = make_tree(tmp_path, {
+        "common/timeline.py": TIMELINE_FIXTURE,
+        "bad.py": """\
+            from horovod_tpu.common import timeline as _timeline
+
+
+            def f(tl, dynamic):
+                tl.instant("AD_HOC_NAME", {})         # literal, uncataloged
+                tl.instant(_timeline.NOT_LISTED, {})  # constant, uncataloged
+                tl.instant(dynamic, {})               # dynamic name
+                tl.instant("x".upper(), {})           # computed expression
+            """})
+    hits = findings_of(root, "timeline-instant-registry")
+    assert len(hits) == 4, [f.render() for f in hits]
+    assert {f.line for f in hits} == {5, 6, 7, 8}
+
+
+def test_timeline_instant_registry_allows_catalog_and_suppressed(tmp_path):
+    root = make_tree(tmp_path, {
+        "common/timeline.py": TIMELINE_FIXTURE,
+        "ok.py": """\
+            from horovod_tpu.common import timeline as _timeline
+            from horovod_tpu.common.timeline import RETRY
+
+
+            def f(tl, name):
+                tl.instant(_timeline.RETRY, {})   # attribute constant
+                tl.instant(RETRY, {})             # imported constant
+                tl.instant("STALL_WARNING", {})   # literal IN the catalog
+                # hvdlint: ignore[timeline-instant-registry] -- relay
+                # helper fixture: call sites pass catalog constants
+                tl.instant(name, {})
+            """})
+    assert findings_of(root, "timeline-instant-registry") == []
+
+
+def test_timeline_instant_registry_requires_catalog(tmp_path):
+    # timeline.py present WITHOUT the catalog tuple = the defect.
+    root = make_tree(tmp_path,
+                     {"common/timeline.py": 'RETRY = "RETRY"\n'})
+    hits = findings_of(root, "timeline-instant-registry")
+    assert len(hits) == 1 and "INSTANT_CATALOG" in hits[0].message
+
+
+def test_timeline_instant_registry_skips_scratch_trees(tmp_path):
+    # No timeline.py at all (every other check's fixture tree): nothing
+    # to verify against, so the check stays silent.
+    root = make_tree(tmp_path, {"ok.py": """\
+        def f(tl):
+            tl.instant("WHATEVER", {})
+        """})
+    assert findings_of(root, "timeline-instant-registry") == []
+
+
 def test_suppression_trailing_and_block_above(tmp_path):
     root = make_tree(tmp_path, {"s.py": """\
         import os

@@ -32,7 +32,7 @@ HVD_SRCS = [os.path.join(CSRC, f) for f in (
     "message.cc", "tensor_queue.cc", "socket.cc", "controller.cc",
     "response_cache.cc", "stall_inspector.cc", "op_manager.cc",
     "shm_transport.cc", "stripe_transport.cc", "ring_ops.cc",
-    "operations.cc")]
+    "metrics.cc", "operations.cc")]
 
 # A minimal, unambiguously-correct concurrent program: contended mutex
 # with RAII critical sections. Any sanitizer report on THIS is a broken

@@ -152,6 +152,21 @@ def worker(rank: int, size: int, port: int, iters: int,
                "shm": core.shm_active(),
                "stripe_bytes": core.ring_stripe_bytes(),
                "stripes": core.ring_stripe_count()}
+    # Coordinator-side gather-wait distribution (docs/metrics.md): how
+    # long each cycle's gather waited per worker frame — the O(N)
+    # coordinator cost ROADMAP item 3 (256-rank scale-out) must drive
+    # down, now measured per world size instead of inferred from RTTs.
+    gather_wait = None
+    if rank == 0:
+        from horovod_tpu.common.metrics import percentiles
+
+        gw = core.metrics_snapshot().get("histograms", {}).get(
+            "gather_wait_us", {})
+        gather_wait = {
+            "n": int(gw.get("count", 0)),
+            **{k: round(v / 1000.0, 3)
+               for k, v in percentiles(gw, (50, 90, 99)).items()},
+        }
     core.shutdown()
     print(f"WORKER_CACHE {rank} {int(hits_seen)}", flush=True)
     print("WORKER_TRAFFIC " + json.dumps({"rank": rank, **traffic}),
@@ -163,6 +178,12 @@ def worker(rank: int, size: int, port: int, iters: int,
             "miss_ms": _stats(miss),
             "hit_ms": _stats(hit),
         }
+        if gather_wait is not None:
+            # Approximate percentiles (log2-bucket upper bounds, ms):
+            # the per-rank gather-wait histogram from the metrics
+            # snapshot, the coordinator-scaling row ROADMAP item 3
+            # gates on.
+            row["gather_wait_ms"] = gather_wait
         if bulk:
             row["bulk_ms"] = _stats(bulk)
             row["bulk_payload_bytes"] = int(big.nbytes)

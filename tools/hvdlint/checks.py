@@ -16,6 +16,7 @@ from .core import Finding, Module, Project
 CONFIG_PATH = "horovod_tpu/common/config.py"
 COMPAT_PATH = "horovod_tpu/common/compat.py"
 FAULTS_PATH = "horovod_tpu/common/faults.py"
+TIMELINE_PATH = "horovod_tpu/common/timeline.py"
 
 
 # ---------------------------------------------------------------------------
@@ -394,5 +395,106 @@ class ExceptionDiscipline:
         return out
 
 
+# ---------------------------------------------------------------------------
+# 6. timeline-instant-registry
+# ---------------------------------------------------------------------------
+
+class TimelineInstantRegistry:
+    """Timeline instant names must be string constants declared in
+    ``common/timeline.py``'s ``INSTANT_CATALOG`` — the same
+    single-source-of-truth discipline as ``faults.CATALOG``. An ad-hoc
+    literal at a call site is an event no trace tooling will ever key
+    on; a dynamic name (a variable) defeats static auditing and needs a
+    reasoned suppression (the relay-helper escape hatch)."""
+
+    id = "timeline-instant-registry"
+    description = ("timeline.instant() names must be catalog constants "
+                   "from common/timeline.py INSTANT_CATALOG")
+    allowed = (TIMELINE_PATH,)
+
+    def _catalog(self, project: Project):
+        """(constant names, string values) of INSTANT_CATALOG, or None
+        when timeline.py is absent (scratch trees: nothing to check) /
+        'missing' when present without a catalog (the defect)."""
+        mod = project.module(TIMELINE_PATH)
+        if mod is None:
+            return None
+        consts = {}
+        names = None
+        for node in mod.tree.body:
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            target = node.targets[0].id
+            if isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, str):
+                consts[target] = node.value.value
+            elif target == "INSTANT_CATALOG" and \
+                    isinstance(node.value, (ast.Tuple, ast.List)):
+                names = [e.id for e in node.value.elts
+                         if isinstance(e, ast.Name)]
+        if names is None:
+            return "missing"
+        return (set(names),
+                {consts[n] for n in names if n in consts})
+
+    def run(self, mod: Module) -> List[Finding]:
+        return []  # all work happens in finalize (needs the catalog)
+
+    def finalize(self, project: Project) -> List[Finding]:
+        catalog = self._catalog(project)
+        if catalog is None:
+            return []
+        if catalog == "missing":
+            return [Finding(
+                self.id, TIMELINE_PATH, 1, 0,
+                "no INSTANT_CATALOG tuple of constants found in "
+                "common/timeline.py — the instant-name registry needs "
+                "its single source of truth")]
+        names, values = catalog
+        out: List[Finding] = []
+        for mod in project.modules:
+            if mod.path in self.allowed:
+                continue
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Call) and
+                        isinstance(node.func, ast.Attribute) and
+                        node.func.attr == "instant" and node.args):
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str):
+                    if arg.value not in values:
+                        out.append(Finding(
+                            self.id, mod.path, node.lineno,
+                            node.col_offset,
+                            f"instant name literal {arg.value!r} is not "
+                            f"in timeline.INSTANT_CATALOG — declare the "
+                            f"constant there and pass it"))
+                elif isinstance(arg, ast.Attribute):
+                    if arg.attr not in names:
+                        out.append(Finding(
+                            self.id, mod.path, node.lineno,
+                            node.col_offset,
+                            f"instant name constant {arg.attr!r} is not "
+                            f"in timeline.INSTANT_CATALOG"))
+                elif isinstance(arg, ast.Name):
+                    if arg.id not in names:
+                        out.append(Finding(
+                            self.id, mod.path, node.lineno,
+                            node.col_offset,
+                            f"instant name {arg.id!r} is not a "
+                            f"timeline.INSTANT_CATALOG constant; a "
+                            f"generic relay needs a reasoned "
+                            f"suppression"))
+                else:
+                    out.append(Finding(
+                        self.id, mod.path, node.lineno, node.col_offset,
+                        "instant name must be a timeline.INSTANT_CATALOG "
+                        "constant, not a computed expression"))
+        return out
+
+
 ALL_CHECKS = (EnvDiscipline(), CompatDiscipline(), RetryDiscipline(),
-              FaultRegistry(), ExceptionDiscipline())
+              FaultRegistry(), ExceptionDiscipline(),
+              TimelineInstantRegistry())

@@ -81,6 +81,13 @@ HOROVOD_LIVENESS_TIMEOUT_MS = "HOROVOD_LIVENESS_TIMEOUT_MS"
 HOROVOD_DRAIN_GRACE_MS = "HOROVOD_DRAIN_GRACE_MS"
 DEFAULT_LIVENESS_TIMEOUT_MS = 10000
 DEFAULT_DRAIN_GRACE_MS = 5000
+# Native-core-consumed knobs with no Python-side reader: registered
+# here anyway so the knob surface stays ONE table (docs/env-vars.md;
+# hvdlint's native-knob-discipline check fails an unregistered C++
+# read). The launchers WRITE the job key; csrc reads both.
+HOROVOD_JOB_KEY = "HOROVOD_JOB_KEY"
+HOROVOD_RING_TREE_THRESHOLD = "HOROVOD_RING_TREE_THRESHOLD"
+DEFAULT_RING_TREE_THRESHOLD = 16384  # csrc/hvd/ring_ops.cc TreeThresholdBytes
 # Fault injection + retry/backoff + blacklist (common/faults.py;
 # docs/fault-injection.md)
 HOROVOD_FAULT_SPEC = "HOROVOD_FAULT_SPEC"
@@ -519,6 +526,27 @@ def shm_fallback_enabled() -> bool:
     errors — for deployments that would rather fail fast than silently
     ride loopback TCP."""
     return _get_bool(HOROVOD_SHM_FALLBACK, default=True)
+
+
+def job_key() -> str:
+    """The per-job isolation token ("" when unset). The LAUNCHERS set it
+    (run/launch.py and run/elastic/runner.py default it to a random hex
+    token in every worker's env); the native controller consumes it —
+    hellos carrying a different key are rejected, so two jobs sharing
+    one host cannot cross-connect through the default controller port
+    (csrc/hvd/operations.cc hashes it FNV-1a into the hello)."""
+    return os.environ.get(HOROVOD_JOB_KEY, "")
+
+
+def ring_tree_threshold() -> int:
+    """Small-payload routing threshold for the host ring, in wire bytes
+    (default 16 KiB): allreduces at or under it take the binomial-tree
+    latency path instead of the chunked bandwidth-optimal ring
+    (docs/hierarchical.md). Consumed by the native core
+    (csrc/hvd/ring_ops.cc, read once per process); a dispatch knob —
+    must agree across ranks."""
+    v = _get_int(HOROVOD_RING_TREE_THRESHOLD, DEFAULT_RING_TREE_THRESHOLD)
+    return v if v >= 0 else DEFAULT_RING_TREE_THRESHOLD
 
 
 def stripes() -> int:

@@ -61,6 +61,10 @@ PLANE_HOST = 1
 
 _EXEC_CB_TYPE = ctypes.CFUNCTYPE(None, ctypes.POINTER(ctypes.c_char),
                                  ctypes.c_int, ctypes.c_long)
+# hvd_enqueue_cb's per-handle completion callback:
+# done(done_arg, handle, ok, reason)
+_DONE_CB_TYPE = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_longlong,
+                                 ctypes.c_int, ctypes.c_char_p)
 
 
 def _build_library() -> bool:
@@ -226,6 +230,35 @@ def _bind_prototypes(lib):
     lib.hvd_metrics_snapshot.restype = ctypes.c_int
     lib.hvd_metrics_snapshot.argtypes = [ctypes.POINTER(ctypes.c_char),
                                          ctypes.c_int, ctypes.c_int]
+    # Contract-only bindings: no NativeCore wrapper uses these yet (the
+    # topology getters are served by Python-side state; the callback
+    # enqueue is reached through hvd_enqueue), but declaring
+    # restype/argtypes for EVERY extern "C" export keeps the ctypes
+    # surface in lock-step with operations.cc — hvdlint's
+    # binding-contract check cross-checks existence and arity both ways,
+    # so a renamed export or drifted signature fails the lint, not a
+    # 3 a.m. load.
+    lib.hvd_initialized.restype = ctypes.c_int
+    lib.hvd_initialized.argtypes = []
+    lib.hvd_rank.restype = ctypes.c_int
+    lib.hvd_rank.argtypes = []
+    lib.hvd_size.restype = ctypes.c_int
+    lib.hvd_size.argtypes = []
+    lib.hvd_local_rank.restype = ctypes.c_int
+    lib.hvd_local_rank.argtypes = []
+    lib.hvd_local_size.restype = ctypes.c_int
+    lib.hvd_local_size.argtypes = []
+    lib.hvd_cross_rank.restype = ctypes.c_int
+    lib.hvd_cross_rank.argtypes = []
+    lib.hvd_cross_size.restype = ctypes.c_int
+    lib.hvd_cross_size.argtypes = []
+    lib.hvd_enqueue_cb.restype = ctypes.c_longlong
+    lib.hvd_enqueue_cb.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_double, ctypes.c_double,
+        ctypes.c_int, _DONE_CB_TYPE, ctypes.c_void_p,
+    ]
     _lib = lib
     return _lib
 

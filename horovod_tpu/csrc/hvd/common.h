@@ -6,6 +6,12 @@
 // metadata only; device buffers never cross this layer (the XLA executor
 // owns them), while host buffers may ride the native data plane.
 
+// Thread posture (thread_annotations.h has the checked vocabulary):
+// everything in this header is a VALUE type — Status, TensorShape,
+// Request/Response, TensorTableEntry own their data and are confined to
+// one thread at a time (handed off by move through internally-locked
+// containers like TensorQueue). Nothing here carries a capability.
+//
 #ifndef HVD_COMMON_H_
 #define HVD_COMMON_H_
 

@@ -193,7 +193,7 @@ std::vector<Response> Controller::FuseResponses(std::vector<Response> singles,
 
 void Controller::RecordLivenessEvent(const std::string& line) {
   {
-    std::lock_guard<std::mutex> lk(liveness_mu_);
+    MutexLock lk(liveness_mu_);
     // Bounded like the negotiation buffer: a pathological churn loop must
     // not grow the report without limit if nobody drains it.
     if (liveness_report_.size() < (1u << 20)) {
@@ -209,7 +209,7 @@ void Controller::RecordNegotiationEvent(const std::string& name, int rank) {
   auto ns = std::chrono::duration_cast<std::chrono::nanoseconds>(
                 std::chrono::steady_clock::now().time_since_epoch())
                 .count();
-  std::lock_guard<std::mutex> lk(events_mu_);
+  MutexLock lk(events_mu_);
   if (events_.size() >= 65536) {
     events_.erase(events_.begin(), events_.begin() + 32768);
   }
@@ -412,28 +412,32 @@ Status TcpController::Initialize() {
 
 void TcpController::StartHeartbeat() {
   {
-    std::lock_guard<std::mutex> lk(hb_mu_);
+    MutexLock lk(hb_mu_);
     hb_stop_ = false;
   }
   hb_thread_ = std::thread([this] {
     const std::string hb = HeartbeatFrame();
     const auto interval = std::chrono::milliseconds(cfg_.heartbeat_ms);
-    std::unique_lock<std::mutex> lk(hb_mu_);
+    UniqueLock lk(hb_mu_);
     while (!hb_stop_) {
+      // Written-out wait loop (no predicate lambda — see
+      // thread_annotations.h): wake at the deadline OR on a stop
+      // notify, whichever comes first.
 #ifdef HVD_TSAN_BUILD
-    // Intercepted system_clock wait under TSan (see the header comment);
-    // a stop notify still breaks it immediately.
-    bool stopped = hb_cv_.wait_until(
-        lk, std::chrono::system_clock::now() + interval,
-        [this] { return hb_stop_; });
+      // Intercepted system_clock wait under TSan (see the header
+      // comment); a stop notify still breaks it immediately.
+      auto deadline = std::chrono::system_clock::now() + interval;
 #else
-    bool stopped = hb_cv_.wait_for(lk, interval, [this] { return hb_stop_; });
+      auto deadline = std::chrono::steady_clock::now() + interval;
 #endif
-      if (stopped) break;
+      while (!hb_stop_ &&
+             hb_cv_.wait_until(lk, deadline) != std::cv_status::timeout) {
+      }
+      if (hb_stop_) break;
       lk.unlock();
       bool ok;
       {
-        std::lock_guard<std::mutex> slk(send_mu_);
+        MutexLock slk(send_mu_);
         ok = coord_sock_.valid() && coord_sock_.SendFrame(hb);
       }
       lk.lock();
@@ -446,7 +450,7 @@ void TcpController::StartHeartbeat() {
 
 void TcpController::StopHeartbeat() {
   {
-    std::lock_guard<std::mutex> lk(hb_mu_);
+    MutexLock lk(hb_mu_);
     hb_stop_ = true;
   }
   hb_cv_.notify_all();
@@ -639,7 +643,7 @@ std::vector<Response> TcpController::WorkerCycle(std::vector<Request> reqs,
   {
     // Serialized against the heartbeat thread's frames (liveness mode);
     // uncontended (and the heartbeat thread absent) otherwise.
-    std::lock_guard<std::mutex> slk(send_mu_);
+    MutexLock slk(send_mu_);
     sent = coord_sock_.SendFrame(
         SerializeRequestList(novel, hits, my_shutdown, my_drain));
   }
@@ -888,7 +892,7 @@ std::vector<Response> TcpController::CoordinatorCycle(
       stall_.Check(&stall_shutdown, liveness_on_ ? &stalled_ranks : nullptr);
   if (!report.empty()) {
     {
-      std::lock_guard<std::mutex> lk(stall_report_mu_);
+      MutexLock lk(stall_report_mu_);
       stall_report_ += report;
     }
     std::fprintf(stderr, "[horovod_tpu coordinator] %s", report.c_str());

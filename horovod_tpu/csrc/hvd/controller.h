@@ -16,12 +16,10 @@
 #include <algorithm>
 #include <atomic>
 #include <chrono>
-#include <condition_variable>
 #include <cstdint>
 #include <functional>
 #include <map>
 #include <memory>
-#include <mutex>
 #include <string>
 #include <thread>
 #include <unordered_map>
@@ -31,6 +29,7 @@
 #include "response_cache.h"
 #include "socket.h"
 #include "stall_inspector.h"
+#include "thread_annotations.h"
 
 namespace hvd {
 
@@ -149,8 +148,9 @@ class Controller {
   // returns at most max_bytes so a bounded caller buffer never silently
   // drops the tail; callers loop until empty. Called from API threads
   // while the background loop appends.
-  std::string TakeStallReport(size_t max_bytes = SIZE_MAX) {
-    std::lock_guard<std::mutex> lk(stall_report_mu_);
+  std::string TakeStallReport(size_t max_bytes = SIZE_MAX)
+      EXCLUDES(stall_report_mu_) {
+    MutexLock lk(stall_report_mu_);
     if (stall_report_.size() <= max_bytes) {
       std::string r = std::move(stall_report_);
       stall_report_.clear();
@@ -171,8 +171,9 @@ class Controller {
   // COORD_TIMEOUT lines; docs/liveness.md), drained like the stall
   // report: consumes at most max_bytes of whole lines per call so a
   // bounded caller buffer never silently drops the tail.
-  std::string TakeLivenessReport(size_t max_bytes = SIZE_MAX) {
-    std::lock_guard<std::mutex> lk(liveness_mu_);
+  std::string TakeLivenessReport(size_t max_bytes = SIZE_MAX)
+      EXCLUDES(liveness_mu_) {
+    MutexLock lk(liveness_mu_);
     if (liveness_report_.size() <= max_bytes) {
       std::string r = std::move(liveness_report_);
       liveness_report_.clear();
@@ -187,8 +188,9 @@ class Controller {
   // (hvd_metrics_snapshot drains it into the JSON, but a too-small
   // caller buffer must not lose events — same no-silent-truncation rule
   // as the negotiation-event requeue).
-  void RestoreLivenessReport(std::string undelivered) {
-    std::lock_guard<std::mutex> lk(liveness_mu_);
+  void RestoreLivenessReport(std::string undelivered)
+      EXCLUDES(liveness_mu_) {
+    MutexLock lk(liveness_mu_);
     undelivered += liveness_report_;
     liveness_report_ = std::move(undelivered);
   }
@@ -205,15 +207,17 @@ class Controller {
     int rank;
     int64_t mono_ns;
   };
-  std::vector<NegotiationEvent> DrainNegotiationEvents() {
-    std::lock_guard<std::mutex> lk(events_mu_);
+  std::vector<NegotiationEvent> DrainNegotiationEvents()
+      EXCLUDES(events_mu_) {
+    MutexLock lk(events_mu_);
     std::vector<NegotiationEvent> out;
     out.swap(events_);
     return out;
   }
   // Put back events a bounded drain could not deliver (oldest first).
-  void RequeueNegotiationEvents(std::vector<NegotiationEvent> undelivered) {
-    std::lock_guard<std::mutex> lk(events_mu_);
+  void RequeueNegotiationEvents(std::vector<NegotiationEvent> undelivered)
+      EXCLUDES(events_mu_) {
+    MutexLock lk(events_mu_);
     undelivered.insert(undelivered.end(),
                        std::make_move_iterator(events_.begin()),
                        std::make_move_iterator(events_.end()));
@@ -235,7 +239,8 @@ class Controller {
   // Append one liveness event line (newline added here) to the report
   // buffer drained by hvd_liveness_report, and echo it to stderr so the
   // launcher log shows membership churn even without a drain consumer.
-  void RecordLivenessEvent(const std::string& line);
+  void RecordLivenessEvent(const std::string& line)
+      EXCLUDES(liveness_mu_);
 
   ControllerConfig cfg_;
   std::atomic<int64_t> fusion_threshold_bytes_;
@@ -246,15 +251,16 @@ class Controller {
   std::atomic<int> stripe_hint_{-1};
   std::atomic<int> synced_stripes_{-1};
   std::atomic<int64_t> cache_hits_{0};
-  std::mutex stall_report_mu_;
+  Mutex stall_report_mu_;
   std::atomic<bool> record_negotiation_{false};
-  std::mutex events_mu_;
-  std::vector<NegotiationEvent> events_;
+  Mutex events_mu_;
+  std::vector<NegotiationEvent> events_ GUARDED_BY(events_mu_);
+  // Filled by Initialize before any other thread exists; read-only after.
   std::vector<std::pair<std::string, int>> data_endpoints_;
   std::vector<int> cross_ranks_;
-  std::string stall_report_;
-  std::mutex liveness_mu_;
-  std::string liveness_report_;
+  std::string stall_report_ GUARDED_BY(stall_report_mu_);
+  Mutex liveness_mu_;
+  std::string liveness_report_ GUARDED_BY(liveness_mu_);
 };
 
 // Single-process controller: the driving process sees every enqueue, so
@@ -297,8 +303,8 @@ class TcpController : public Controller {
                                     bool* world_shutdown);
   void CacheResponses(const std::vector<Response>& resps);
   // Liveness helpers (all coordinator-side except the heartbeat pair).
-  void StartHeartbeat();
-  void StopHeartbeat();
+  void StartHeartbeat() EXCLUDES(hb_mu_);
+  void StopHeartbeat() EXCLUDES(hb_mu_);
   // Gather one request frame per live worker, skipping heartbeat frames
   // and escalating silence to eviction (liveness mode only). Ingests via
   // `ingest(rank, bytes)`.
@@ -318,11 +324,16 @@ class TcpController : public Controller {
   std::vector<int> peer_state_;
   // Worker heartbeat thread: beats every heartbeat_ms on the control
   // socket; send_mu_ serializes its frames against the cycle thread's.
+  // coord_sock_ itself stays unannotated: its SENDS are guarded by
+  // send_mu_ but its receives are cycle-thread-only — a split the
+  // capability system cannot express on one object (the discipline is
+  // "every SendFrame on it holds send_mu_", enforced by review; the
+  // receive side has exactly one caller thread by construction).
   std::thread hb_thread_;
-  std::mutex hb_mu_;
-  std::condition_variable hb_cv_;
-  bool hb_stop_ = false;
-  std::mutex send_mu_;
+  Mutex hb_mu_;
+  CondVar hb_cv_;
+  bool hb_stop_ GUARDED_BY(hb_mu_) = false;
+  Mutex send_mu_;
 
   // Coordinator negotiation state: name -> per-rank requests seen so far.
   std::unordered_map<std::string, std::vector<Request>> pending_;

@@ -3,6 +3,9 @@
 // coded default — never a prefix parse. (Boolean knobs go through
 // operations.cc's EnvFlag, which mirrors common/config.py's _get_bool.)
 
+// Thread posture: getenv-only readers, called during init paths before
+// worker threads exist (the env itself is never mutated by the core).
+//
 #ifndef HVD_ENV_UTIL_H_
 #define HVD_ENV_UTIL_H_
 

@@ -5,6 +5,8 @@
 // first-class 16-bit type on TPU (a simple truncation of float32), fp16 is
 // kept for capability parity with frameworks that produce it.
 
+// Thread posture: pure conversion functions, no shared state.
+//
 #ifndef HVD_HALF_H_
 #define HVD_HALF_H_
 

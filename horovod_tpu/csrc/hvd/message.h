@@ -6,6 +6,9 @@
 // little-endian layout, versioned with a leading magic byte so future
 // revisions can evolve.
 
+// Thread posture: Writer/Reader and the (de)serializers are value types
+// confined to their calling thread; no shared state, no capabilities.
+//
 #ifndef HVD_MESSAGE_H_
 #define HVD_MESSAGE_H_
 

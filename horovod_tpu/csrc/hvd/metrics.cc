@@ -44,7 +44,12 @@ int64_t MonoNs() {
 
 void StragglerDetector::Configure(int world_size, double threshold_ms,
                                   int patience) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
+  ConfigureLocked(world_size, threshold_ms, patience);
+}
+
+void StragglerDetector::ConfigureLocked(int world_size, double threshold_ms,
+                                        int patience) {
   threshold_ms_ = threshold_ms > 0 ? threshold_ms : 100.0;
   patience_ = patience > 0 ? patience : 3;
   ewma_ms_.assign(world_size > 0 ? world_size : 0, 0.0);
@@ -56,7 +61,13 @@ void StragglerDetector::Configure(int world_size, double threshold_ms,
   last_lag_ms_.store(0.0, std::memory_order_relaxed);
 }
 
-void StragglerDetector::Reset() { Configure(0, threshold_ms_, patience_); }
+void StragglerDetector::Reset() {
+  // First violation the -Wthread-safety pass surfaced: the old body
+  // passed threshold_ms_/patience_ to Configure() by value, reading the
+  // GUARDED_BY(mu_) fields lock-free against ObserveGroup's writes.
+  MutexLock lk(mu_);
+  ConfigureLocked(0, threshold_ms_, patience_);
+}
 
 void StragglerDetector::ObserveGroup(
     const std::vector<std::pair<int, double>>& lags_ms) {
@@ -64,7 +75,7 @@ void StragglerDetector::ObserveGroup(
   // arrival minus the group's earliest. Needs >= 2 distinct ranks to say
   // anything about skew.
   if (lags_ms.size() < 2) return;
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   int worst = -1;
   double worst_lag = -1.0;
   for (const auto& rl : lags_ms) {
@@ -104,12 +115,12 @@ void StragglerDetector::ObserveGroup(
 }
 
 std::vector<double> StragglerDetector::EwmaMs() const {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   return ewma_ms_;
 }
 
 std::vector<StragglerEvent> StragglerDetector::DrainEvents() {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   std::vector<StragglerEvent> out;
   out.swap(events_);
   return out;
@@ -117,7 +128,7 @@ std::vector<StragglerEvent> StragglerDetector::DrainEvents() {
 
 void StragglerDetector::RestoreEvents(
     std::vector<StragglerEvent> undelivered) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   undelivered.insert(undelivered.end(), events_.begin(), events_.end());
   events_ = std::move(undelivered);
   if (events_.size() > kMaxEvents) events_.resize(kMaxEvents);

@@ -29,9 +29,10 @@
 
 #include <atomic>
 #include <cstdint>
-#include <mutex>
 #include <string>
 #include <vector>
+
+#include "thread_annotations.h"
 
 namespace hvd {
 namespace metrics {
@@ -129,12 +130,14 @@ struct StragglerEvent {
 // straggler re-fires every `patience` groups instead of spamming.
 class StragglerDetector {
  public:
-  void Configure(int world_size, double threshold_ms, int patience);
-  void Reset();
+  void Configure(int world_size, double threshold_ms, int patience)
+      EXCLUDES(mu_);
+  void Reset() EXCLUDES(mu_);
   // One ready group: (rank, lag_ms) per submitting rank, lag measured
   // from the group's earliest arrival. Called once per ready tensor
   // group on the coordinator's cycle thread.
-  void ObserveGroup(const std::vector<std::pair<int, double>>& lags_ms);
+  void ObserveGroup(const std::vector<std::pair<int, double>>& lags_ms)
+      EXCLUDES(mu_);
 
   // Snapshot accessors (events are drained separately; see Registry).
   long long warnings() const {
@@ -146,19 +149,26 @@ class StragglerDetector {
   double last_lag_ms() const {
     return last_lag_ms_.load(std::memory_order_relaxed);
   }
-  std::vector<double> EwmaMs() const;
-  std::vector<StragglerEvent> DrainEvents();
-  void RestoreEvents(std::vector<StragglerEvent> undelivered);
+  std::vector<double> EwmaMs() const EXCLUDES(mu_);
+  std::vector<StragglerEvent> DrainEvents() EXCLUDES(mu_);
+  void RestoreEvents(std::vector<StragglerEvent> undelivered)
+      EXCLUDES(mu_);
 
  private:
-  mutable std::mutex mu_;
-  double threshold_ms_ = 100.0;
-  int patience_ = 3;
-  double alpha_ = 0.3;
-  std::vector<double> ewma_ms_;
-  int last_ = -1;           // rank that arrived last in the previous group
-  int consecutive_ = 0;     // how many consecutive groups `last_` was last
-  std::vector<StragglerEvent> events_;  // bounded, drained by snapshot
+  void ConfigureLocked(int world_size, double threshold_ms, int patience)
+      REQUIRES(mu_);
+
+  mutable Mutex mu_;
+  double threshold_ms_ GUARDED_BY(mu_) = 100.0;
+  int patience_ GUARDED_BY(mu_) = 3;
+  double alpha_ GUARDED_BY(mu_) = 0.3;
+  std::vector<double> ewma_ms_ GUARDED_BY(mu_);
+  // rank that arrived last in the previous group
+  int last_ GUARDED_BY(mu_) = -1;
+  // how many consecutive groups `last_` was last
+  int consecutive_ GUARDED_BY(mu_) = 0;
+  // bounded, drained by snapshot
+  std::vector<StragglerEvent> events_ GUARDED_BY(mu_);
   std::atomic<long long> warnings_{0};
   std::atomic<int> last_rank_{-1};
   std::atomic<double> last_lag_ms_{0.0};

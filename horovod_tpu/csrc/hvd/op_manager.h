@@ -21,6 +21,12 @@
 // payload rides the new backend. Both sides therefore switch at the same
 // message boundary and results are byte-identical to a TCP-only world.
 
+// Thread posture: the manager and its agreement tables are confined to
+// the background cycle thread (every hierarchical leg runs there — see
+// the member comments), so they carry no capabilities; the backends it
+// dispatches to publish their counters through std::atomic for the
+// lock-free observability getters.
+//
 #ifndef HVD_OP_MANAGER_H_
 #define HVD_OP_MANAGER_H_
 

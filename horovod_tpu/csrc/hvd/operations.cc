@@ -18,12 +18,10 @@
 #include <atomic>
 #include <cctype>
 #include <chrono>
-#include <condition_variable>
 #include <cstdlib>
 #include <cstring>
 #include <functional>
 #include <memory>
-#include <mutex>
 #include <thread>
 #include <unordered_map>
 
@@ -33,6 +31,7 @@
 #include "metrics.h"
 #include "ring_ops.h"
 #include "tensor_queue.h"
+#include "thread_annotations.h"
 
 namespace hvd {
 namespace {
@@ -41,41 +40,41 @@ using ExecCallback = void (*)(const char* response_bytes, int len,
                               long response_id);
 
 struct HandleTable {
-  std::mutex mu;
-  std::condition_variable cv;
-  std::unordered_map<int64_t, Status> done;
-  int64_t next = 0;
+  Mutex mu;
+  CondVar cv;
+  std::unordered_map<int64_t, Status> done GUARDED_BY(mu);
+  int64_t next GUARDED_BY(mu) = 0;
 
-  int64_t NewHandle() {
-    std::lock_guard<std::mutex> lk(mu);
+  int64_t NewHandle() EXCLUDES(mu) {
+    MutexLock lk(mu);
     return next++;
   }
-  void MarkDone(int64_t h, const Status& s) {
+  void MarkDone(int64_t h, const Status& s) EXCLUDES(mu) {
     {
-      std::lock_guard<std::mutex> lk(mu);
+      MutexLock lk(mu);
       done[h] = s;
     }
     cv.notify_all();
   }
   // 0 = pending, 1 = ok, -1 = error (reason copied out)
-  int Test(int64_t h, std::string* reason) {
-    std::lock_guard<std::mutex> lk(mu);
+  int Test(int64_t h, std::string* reason) EXCLUDES(mu) {
+    MutexLock lk(mu);
     auto it = done.find(h);
     if (it == done.end()) return 0;
     if (it->second.ok()) return 1;
     if (reason) *reason = it->second.reason();
     return -1;
   }
-  int Wait(int64_t h, std::string* reason) {
-    std::unique_lock<std::mutex> lk(mu);
-    cv.wait(lk, [&] { return done.count(h) != 0; });
+  int Wait(int64_t h, std::string* reason) EXCLUDES(mu) {
+    UniqueLock lk(mu);
+    while (done.count(h) == 0) cv.wait(lk);
     const Status& s = done[h];
     if (s.ok()) return 1;
     if (reason) *reason = s.reason();
     return -1;
   }
-  void Erase(int64_t h) {
-    std::lock_guard<std::mutex> lk(mu);
+  void Erase(int64_t h) EXCLUDES(mu) {
+    MutexLock lk(mu);
     done.erase(h);
   }
 };
@@ -91,7 +90,7 @@ struct ResultBuffer {
 };
 
 struct GlobalState {
-  std::mutex init_mu;
+  Mutex init_mu;
   std::atomic<bool> initialized{false};
   std::atomic<bool> shutdown_requested{false};
   // Graceful-drain farewell (docs/liveness.md): set by hvd_drain before
@@ -112,19 +111,27 @@ struct GlobalState {
   std::atomic<bool> joined{false};
   std::atomic<int> last_joined{-1};
 
-  std::unique_ptr<Controller> controller;
-  std::unique_ptr<Ring> ring;
-  Listener data_listener;
+  // Lifecycle state guarded by init_mu: hvd_shutdown resets these while
+  // arbitrary API/monitor threads poll the getters — the PR 5/7/8/9
+  // use-after-free class, now a compile error instead of a TSan lottery.
+  // The background cycle thread does NOT reach through these fields: it
+  // receives raw Controller*/Ring* captured under init_mu at thread
+  // start (BackgroundLoop's parameters), and hvd_shutdown joins it
+  // before the reset — the happens-before is structural.
+  std::unique_ptr<Controller> controller GUARDED_BY(init_mu);
+  std::unique_ptr<Ring> ring GUARDED_BY(init_mu);
+  Listener data_listener GUARDED_BY(init_mu);
   TensorQueue tensor_queue;
   HandleTable handles;
-  std::thread background;
+  std::thread background GUARDED_BY(init_mu);
 
   // Atomic: re-registered at runtime (host staging replaces the host
   // world's placeholder) while the cycle thread reads it.
   std::atomic<ExecCallback> exec_cb{nullptr};
   // responses handed to the XLA executor, keyed by response id
-  std::mutex inflight_mu;
-  std::unordered_map<long, std::vector<TensorTableEntry>> inflight;
+  Mutex inflight_mu;
+  std::unordered_map<long, std::vector<TensorTableEntry>> inflight
+      GUARDED_BY(inflight_mu);
   std::atomic<long> next_response_id{1};
 
   // >= 0: fused host-plane allreduces of at least this many bytes are
@@ -148,8 +155,8 @@ struct GlobalState {
   std::atomic<int> hier_env_flags{0};
 
   // executor-allocated results, keyed by handle (fetched then erased)
-  std::mutex results_mu;
-  std::unordered_map<int64_t, ResultBuffer> results;
+  Mutex results_mu;
+  std::unordered_map<int64_t, ResultBuffer> results GUARDED_BY(results_mu);
 };
 
 GlobalState* g() {
@@ -313,13 +320,13 @@ void AppendKVD(std::string& out, const char* key, double v, bool* first) {
   out += num;
 }
 
-// Caller holds init_mu.
 std::string BuildMetricsJsonLocked(GlobalState* s,
                                    const std::string& liveness,
                                    bool with_liveness,
                                    const std::vector<metrics::StragglerEvent>&
                                        events,
-                                   bool with_events) {
+                                   bool with_events)
+    REQUIRES(s->init_mu) {
   auto& reg = metrics::Registry::Get();
   std::string out;
   out.reserve(4096);
@@ -424,7 +431,10 @@ std::string BuildMetricsJsonLocked(GlobalState* s,
   return out;
 }
 
-void ExecuteHostResponse(const Response& resp,
+// `ring` is the background thread's stable pointer (captured under
+// init_mu at thread start; outlives the thread by join-before-reset) —
+// this function never reads the GUARDED_BY(init_mu) global field.
+void ExecuteHostResponse(Ring* ring, const Response& resp,
                          std::vector<TensorTableEntry>& entries) {
   // Fuse host entries into one flat buffer, run the ring op, scatter back —
   // MemcpyInFusionBuffer / MemcpyOutFusionBuffer parity
@@ -464,17 +474,17 @@ void ExecuteHostResponse(const Response& resp,
         for (const auto& sh : resp.shapes) {
           tensor_counts.push_back(sh.num_elements());
         }
-        st = s->ring->AdasumAllreduce(fusion.data(), fusion.data(),
+        st = ring->AdasumAllreduce(fusion.data(), fusion.data(),
                                       tensor_counts, resp.dtype,
                                       resp.prescale, resp.postscale);
       } else if (hier_ar) {
         // Two-level local-leader route (tuned bit0 / env default): the
         // fused buffer crosses hosts once per host, not once per rank.
-        st = s->ring->HierAllreduce(fusion.data(), fusion.data(), total,
+        st = ring->HierAllreduce(fusion.data(), fusion.data(), total,
                                     resp.dtype, resp.reduce_op,
                                     resp.prescale, resp.postscale);
       } else {
-        st = s->ring->Allreduce(fusion.data(), fusion.data(), total,
+        st = ring->Allreduce(fusion.data(), fusion.data(), total,
                                 resp.dtype, resp.reduce_op, resp.prescale,
                                 resp.postscale);
       }
@@ -515,14 +525,14 @@ void ExecuteHostResponse(const Response& resp,
           counts.reserve(fd->size());
           for (auto d : *fd) counts.push_back(d * trailing);
         } else {
-          counts.assign(s->ring->size(), sh.num_elements());
+          counts.assign(ring->size(), sh.num_elements());
         }
         if (e.output != nullptr) {
           // Caller-preallocated output (equal-shape fast path).
           st = hier_ag
-                   ? s->ring->HierAllgatherv(e.data, e.output, counts,
+                   ? ring->HierAllgatherv(e.data, e.output, counts,
                                              resp.dtype)
-                   : s->ring->Allgatherv(e.data, e.output, counts,
+                   : ring->Allgatherv(e.data, e.output, counts,
                                          resp.dtype);
         } else {
           // Ragged path: executor allocates; caller fetches by handle
@@ -537,12 +547,12 @@ void ExecuteHostResponse(const Response& resp,
                   : std::vector<int64_t>(counts.size(),
                                          sh.ndim() > 0 ? sh.dim(0) : 1);
           st = hier_ag
-                   ? s->ring->HierAllgatherv(e.data, rb.bytes.data(),
+                   ? ring->HierAllgatherv(e.data, rb.bytes.data(),
                                              counts, resp.dtype)
-                   : s->ring->Allgatherv(e.data, rb.bytes.data(), counts,
+                   : ring->Allgatherv(e.data, rb.bytes.data(), counts,
                                          resp.dtype);
           if (st.ok()) {
-            std::lock_guard<std::mutex> lk(s->results_mu);
+            MutexLock lk(s->results_mu);
             s->results[e.handle] = std::move(rb);
           }
         }
@@ -558,7 +568,7 @@ void ExecuteHostResponse(const Response& resp,
                       e.request.shape.num_elements() * es);
         }
         void* buf = e.output ? e.output : e.data;
-        st = s->ring->Broadcast(buf, e.request.shape.num_elements(),
+        st = ring->Broadcast(buf, e.request.shape.num_elements(),
                                 resp.dtype, resp.root_rank);
         if (!st.ok()) break;
       }
@@ -576,7 +586,7 @@ void ExecuteHostResponse(const Response& resp,
   }
 }
 
-void PerformOperation(const Response& resp) {
+void PerformOperation(Ring* ring, const Response& resp) {
   auto* s = g();
   if (resp.op == CollectiveOp::JOIN) {
     // All ranks have joined: resolve this rank's join sentinel and reset
@@ -638,7 +648,7 @@ void PerformOperation(const Response& resp) {
       }
     }
     if (!stage) {
-      ExecuteHostResponse(resp, entries);
+      ExecuteHostResponse(ring, resp, entries);
       return;
     }
   }
@@ -656,7 +666,7 @@ void PerformOperation(const Response& resp) {
   }
   long id = s->next_response_id++;
   {
-    std::lock_guard<std::mutex> lk(s->inflight_mu);
+    MutexLock lk(s->inflight_mu);
     s->inflight[id] = std::move(entries);
   }
   std::string bytes =
@@ -664,7 +674,12 @@ void PerformOperation(const Response& resp) {
   cb(bytes.data(), static_cast<int>(bytes.size()), id);
 }
 
-bool RunLoopOnce(std::chrono::steady_clock::time_point& last_cycle) {
+// `ctl`/`ring` are the background thread's stable pointers (captured
+// under init_mu at thread start): the loop never dereferences the
+// GUARDED_BY(init_mu) global fields, so the analysis proves every
+// remaining access to them is under the lock.
+bool RunLoopOnce(Controller* ctl, Ring* ring,
+                 std::chrono::steady_clock::time_point& last_cycle) {
   auto* s = g();
   auto now = std::chrono::steady_clock::now();
   auto target = last_cycle + std::chrono::duration_cast<
@@ -695,25 +710,25 @@ bool RunLoopOnce(std::chrono::steady_clock::time_point& last_cycle) {
   bool want_drain = s->drain_requested.load();
   bool world_shutdown = false;
   auto requests = s->tensor_queue.PopMessages();
-  auto responses = s->controller->ComputeResponseList(
+  auto responses = ctl->ComputeResponseList(
       std::move(requests), want_shutdown || want_drain, want_drain,
       &world_shutdown);
   // Worker ranks: adopt the coordinator's autotuned cycle time delivered on
   // the response broadcast (reference SynchronizeParameters applied inside
   // BackgroundThreadLoop, operations.cc:598-604).
-  double synced = s->controller->TakeSyncedCycleMs();
+  double synced = ctl->TakeSyncedCycleMs();
   if (synced > 0) s->cycle_time_ms.store(synced);
-  int synced_hier = s->controller->TakeSyncedHierFlags();
+  int synced_hier = ctl->TakeSyncedHierFlags();
   if (synced_hier >= 0) s->hier_flags.store(synced_hier);
   // Stripe-count sync applies BEFORE this frame's responses run, on
   // every rank at the same boundary, so both sides of every leader pair
   // renegotiate their cross transport in lock-step
   // (docs/cross-transport.md).
-  int synced_stripes = s->controller->TakeSyncedStripes();
-  if (synced_stripes >= 1 && s->ring) {
-    s->ring->ApplyStripeCount(synced_stripes);
+  int synced_stripes = ctl->TakeSyncedStripes();
+  if (synced_stripes >= 1 && ring != nullptr) {
+    ring->ApplyStripeCount(synced_stripes);
   }
-  for (const auto& r : responses) PerformOperation(r);
+  for (const auto& r : responses) PerformOperation(ring, r);
   metrics::Registry::Get().IncCycles();
   metrics::Record(metrics::kCycleUs,
                   std::chrono::duration_cast<std::chrono::microseconds>(
@@ -722,9 +737,9 @@ bool RunLoopOnce(std::chrono::steady_clock::time_point& last_cycle) {
   return !world_shutdown;
 }
 
-void BackgroundLoop() {
+void BackgroundLoop(Controller* ctl, Ring* ring) {
   auto last = std::chrono::steady_clock::now();
-  while (RunLoopOnce(last)) {
+  while (RunLoopOnce(ctl, ring, last)) {
   }
   auto* s = g();
   // Resolve every still-queued handle so no waiter blocks forever when a
@@ -734,7 +749,7 @@ void BackgroundLoop() {
     s->handles.MarkDone(e.handle, aborted);
     if (e.callback) e.callback(aborted);
   }
-  s->controller->Finalize();
+  ctl->Finalize();
   s->loop_done.store(true);
 }
 
@@ -755,7 +770,7 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
              int stall_check_enabled, int heartbeat_ms,
              int liveness_timeout_ms) {
   auto* s = hvd::g();
-  std::lock_guard<std::mutex> lk(s->init_mu);
+  hvd::MutexLock lk(s->init_mu);
   if (s->initialized.load()) {
     // Re-init with an identical world is a no-op; a different world is a
     // caller bug that must not be silently ignored.
@@ -862,14 +877,18 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
         shm_wait_ms, hvd::StripesFromEnv(), hvd::ChunkBytesFromEnv(),
         hvd::EnvFlag("HOROVOD_STRIPE_FALLBACK", /*dflt=*/true));
   }
-  s->background = std::thread(hvd::BackgroundLoop);
+  // The background thread gets stable raw pointers captured here, under
+  // init_mu — it must never reach through the GUARDED_BY(init_mu)
+  // fields itself (hvd_shutdown joins it before resetting them).
+  s->background = std::thread(hvd::BackgroundLoop, s->controller.get(),
+                              s->ring.get());
   s->initialized.store(true);
   return 0;
 }
 
 void hvd_shutdown() {
   auto* s = hvd::g();
-  std::lock_guard<std::mutex> lk(s->init_mu);
+  hvd::MutexLock lk(s->init_mu);
   if (!s->initialized.load()) return;
   s->shutdown_requested.store(true);
   if (s->background.joinable()) s->background.join();
@@ -880,7 +899,7 @@ void hvd_shutdown() {
   {
     // Resolve any responses still parked at the XLA executor so waiters
     // never hang across shutdown.
-    std::lock_guard<std::mutex> ilk(s->inflight_mu);
+    hvd::MutexLock ilk(s->inflight_mu);
     hvd::Status aborted =
         hvd::Status::Aborted("horovod_tpu runtime has been shut down");
     for (auto& kv : s->inflight) {
@@ -892,7 +911,7 @@ void hvd_shutdown() {
     s->inflight.clear();
   }
   {
-    std::lock_guard<std::mutex> rlk(s->results_mu);
+    hvd::MutexLock rlk(s->results_mu);
     s->results.clear();
   }
 }
@@ -904,7 +923,7 @@ void hvd_set_parameters(double cycle_time_ms, long long fusion_threshold) {
   auto* s = hvd::g();
   // init_mu also guards hvd_shutdown's controller.reset(): without it a
   // tuner update racing shutdown could dereference a freed controller.
-  std::lock_guard<std::mutex> lk(s->init_mu);
+  hvd::MutexLock lk(s->init_mu);
   if (cycle_time_ms > 0) {
     s->cycle_time_ms.store(cycle_time_ms);
     // Stage the new cycle for the next response broadcast so worker ranks
@@ -924,7 +943,7 @@ double hvd_get_cycle_time_ms() { return hvd::g()->cycle_time_ms.load(); }
 // both are queryable so tests and users can assert on them directly).
 long long hvd_cache_hits() {
   auto* s = hvd::g();
-  std::lock_guard<std::mutex> lk(s->init_mu);
+  hvd::MutexLock lk(s->init_mu);
   return s->controller ? static_cast<long long>(s->controller->cache_hits())
                        : 0;
 }
@@ -934,13 +953,13 @@ long long hvd_cache_hits() {
 // periodically: each line is "<rank> <steady-clock ns> <tensor name>".
 void hvd_set_record_negotiation(int enabled) {
   auto* s = hvd::g();
-  std::lock_guard<std::mutex> lk(s->init_mu);
+  hvd::MutexLock lk(s->init_mu);
   if (s->controller) s->controller->set_record_negotiation(enabled != 0);
 }
 
 int hvd_drain_negotiation(char* buf, int cap) {
   auto* s = hvd::g();
-  std::lock_guard<std::mutex> lk(s->init_mu);
+  hvd::MutexLock lk(s->init_mu);
   if (s->controller == nullptr || buf == nullptr || cap <= 0) return 0;
   // Consume only whole events that fit; the rest stay queued for the next
   // call (same no-silent-truncation rule as hvd_stall_report).
@@ -976,7 +995,7 @@ void hvd_drain() { hvd::g()->drain_requested.store(true); }
 // hvd_stall_report: consumes only what fits; the rest stays queued.
 int hvd_liveness_report(char* buf, int cap) {
   auto* s = hvd::g();
-  std::lock_guard<std::mutex> lk(s->init_mu);
+  hvd::MutexLock lk(s->init_mu);
   if (s->controller == nullptr || buf == nullptr || cap <= 0) return 0;
   std::string r =
       s->controller->TakeLivenessReport(static_cast<size_t>(cap - 1));
@@ -987,7 +1006,7 @@ int hvd_liveness_report(char* buf, int cap) {
 
 int hvd_stall_report(char* buf, int cap) {
   auto* s = hvd::g();
-  std::lock_guard<std::mutex> lk(s->init_mu);
+  hvd::MutexLock lk(s->init_mu);
   if (s->controller == nullptr || buf == nullptr || cap <= 0) return 0;
   // Consumes only what fits; unread report text stays queued for the next
   // call, so a bounded buffer never loses warnings.
@@ -1000,7 +1019,7 @@ int hvd_stall_report(char* buf, int cap) {
 
 long long hvd_get_fusion_threshold() {
   auto* s = hvd::g();
-  std::lock_guard<std::mutex> lk(s->init_mu);
+  hvd::MutexLock lk(s->init_mu);
   return s->controller ? static_cast<long long>(
                              s->controller->fusion_threshold())
                        : -1;
@@ -1110,7 +1129,7 @@ long long hvd_enqueue_chips(const char* name, int op, int reduce_op,
 // payload are fetched here. hvd_result_fetch erases the stored buffer.
 long long hvd_result_bytes(long long handle) {
   auto* s = hvd::g();
-  std::lock_guard<std::mutex> lk(s->results_mu);
+  hvd::MutexLock lk(s->results_mu);
   auto it = s->results.find(handle);
   return it == s->results.end()
              ? -1
@@ -1119,7 +1138,7 @@ long long hvd_result_bytes(long long handle) {
 
 int hvd_result_dims(long long handle, long long* dims, int cap) {
   auto* s = hvd::g();
-  std::lock_guard<std::mutex> lk(s->results_mu);
+  hvd::MutexLock lk(s->results_mu);
   auto it = s->results.find(handle);
   if (it == s->results.end()) return -1;
   int n = static_cast<int>(it->second.first_dims.size());
@@ -1131,7 +1150,7 @@ int hvd_result_dims(long long handle, long long* dims, int cap) {
 
 int hvd_result_fetch(long long handle, void* dst, long long cap) {
   auto* s = hvd::g();
-  std::lock_guard<std::mutex> lk(s->results_mu);
+  hvd::MutexLock lk(s->results_mu);
   auto it = s->results.find(handle);
   if (it == s->results.end()) return -1;
   if (static_cast<long long>(it->second.bytes.size()) > cap) return -2;
@@ -1175,7 +1194,7 @@ long long hvd_ring_bytes_sent() {
   // init_mu also guards hvd_shutdown's ring.reset(): a monitor thread
   // polling traffic counters across shutdown must not dereference a ring
   // being freed (same race family as hvd_set_parameters vs shutdown).
-  std::lock_guard<std::mutex> lk(s->init_mu);
+  hvd::MutexLock lk(s->init_mu);
   return s->ring ? s->ring->bytes_sent() : 0;
 }
 
@@ -1185,13 +1204,13 @@ long long hvd_ring_bytes_sent() {
 // accounted cross (one process per host presumed).
 long long hvd_ring_local_bytes() {
   auto* s = hvd::g();
-  std::lock_guard<std::mutex> lk(s->init_mu);
+  hvd::MutexLock lk(s->init_mu);
   return s->ring ? s->ring->local_bytes_sent() : 0;
 }
 
 long long hvd_ring_cross_bytes() {
   auto* s = hvd::g();
-  std::lock_guard<std::mutex> lk(s->init_mu);
+  hvd::MutexLock lk(s->init_mu);
   return s->ring ? s->ring->cross_bytes_sent() : 0;
 }
 
@@ -1201,7 +1220,7 @@ long long hvd_ring_cross_bytes() {
 // entire local leg: bytes_sent == local + cross + shm.
 long long hvd_ring_shm_bytes() {
   auto* s = hvd::g();
-  std::lock_guard<std::mutex> lk(s->init_mu);
+  hvd::MutexLock lk(s->init_mu);
   return s->ring ? s->ring->shm_bytes_sent() : 0;
 }
 
@@ -1209,7 +1228,7 @@ long long hvd_ring_shm_bytes() {
 // enabled) — the transport choice bench.py records.
 int hvd_shm_active() {
   auto* s = hvd::g();
-  std::lock_guard<std::mutex> lk(s->init_mu);
+  hvd::MutexLock lk(s->init_mu);
   return (s->ring && s->ring->shm_active()) ? 1 : 0;
 }
 
@@ -1219,7 +1238,7 @@ int hvd_shm_active() {
 // counter).
 long long hvd_ring_stripe_bytes() {
   auto* s = hvd::g();
-  std::lock_guard<std::mutex> lk(s->init_mu);
+  hvd::MutexLock lk(s->init_mu);
   return s->ring ? s->ring->stripe_bytes_sent() : 0;
 }
 
@@ -1229,7 +1248,7 @@ long long hvd_ring_stripe_bytes() {
 // record).
 int hvd_ring_stripe_count() {
   auto* s = hvd::g();
-  std::lock_guard<std::mutex> lk(s->init_mu);
+  hvd::MutexLock lk(s->init_mu);
   return s->ring ? s->ring->stripe_count() : 0;
 }
 
@@ -1239,7 +1258,7 @@ int hvd_ring_stripe_count() {
 // and idle members' yield-spins, which the leg never touches).
 long long hvd_ring_cross_ns() {
   auto* s = hvd::g();
-  std::lock_guard<std::mutex> lk(s->init_mu);
+  hvd::MutexLock lk(s->init_mu);
   return s->ring ? s->ring->cross_leg_ns() : 0;
 }
 
@@ -1250,7 +1269,7 @@ void hvd_set_stripes(int stripes) {
   auto* s = hvd::g();
   // init_mu guards hvd_shutdown's controller.reset() — same race as
   // hvd_set_parameters (a tuner update vs a concurrent shutdown).
-  std::lock_guard<std::mutex> lk(s->init_mu);
+  hvd::MutexLock lk(s->init_mu);
   if (s->controller) s->controller->set_stripe_hint(stripes);
 }
 
@@ -1276,7 +1295,7 @@ int hvd_host_hier_flags() {
 // too-small buffer never silently loses events.
 int hvd_metrics_snapshot(char* buf, int cap, int drain_flags) {
   auto* s = hvd::g();
-  std::lock_guard<std::mutex> lk(s->init_mu);
+  hvd::MutexLock lk(s->init_mu);
   std::string liveness;
   bool with_liveness = false;
   if ((drain_flags & 1) && s->controller) {
@@ -1333,7 +1352,7 @@ void hvd_response_done(long response_id, int ok, const char* error) {
   auto* s = hvd::g();
   std::vector<hvd::TensorTableEntry> entries;
   {
-    std::lock_guard<std::mutex> lk(s->inflight_mu);
+    hvd::MutexLock lk(s->inflight_mu);
     auto it = s->inflight.find(response_id);
     if (it == s->inflight.end()) return;
     entries = std::move(it->second);
@@ -1345,7 +1364,7 @@ void hvd_response_done(long response_id, int ok, const char* error) {
     // Erroring callers never reach hvd_result_fetch (the only consumer
     // that erases stored results), so results already deposited for this
     // response's handles would strand until shutdown — drop them here.
-    std::lock_guard<std::mutex> lk(s->results_mu);
+    hvd::MutexLock lk(s->results_mu);
     for (auto& e : entries) s->results.erase(e.handle);
   }
   for (auto& e : entries) {
@@ -1372,7 +1391,7 @@ void hvd_set_hier_flags(int flags) {
   auto* s = hvd::g();
   // init_mu guards hvd_shutdown's controller.reset() — same race as
   // hvd_set_parameters (a tuner update vs a concurrent shutdown).
-  std::lock_guard<std::mutex> lk(s->init_mu);
+  hvd::MutexLock lk(s->init_mu);
   if (s->controller) s->controller->set_hier_flags_hint(flags);
 }
 
@@ -1384,7 +1403,7 @@ int hvd_get_hier_flags() { return hvd::g()->hier_flags.load(); }
 int hvd_inflight_ptrs(long response_id, const char* name, void** data,
                       void** output) {
   auto* s = hvd::g();
-  std::lock_guard<std::mutex> lk(s->inflight_mu);
+  hvd::MutexLock lk(s->inflight_mu);
   auto it = s->inflight.find(response_id);
   if (it == s->inflight.end()) return -1;
   for (auto& e : it->second) {
@@ -1402,7 +1421,7 @@ int hvd_inflight_ptrs(long response_id, const char* name, void** data,
 // executor-allocated outputs (staged ragged allgather).
 long long hvd_inflight_handle(long response_id, const char* name) {
   auto* s = hvd::g();
-  std::lock_guard<std::mutex> lk(s->inflight_mu);
+  hvd::MutexLock lk(s->inflight_mu);
   auto it = s->inflight.find(response_id);
   if (it == s->inflight.end()) return -1;
   for (auto& e : it->second) {
@@ -1417,7 +1436,7 @@ long long hvd_inflight_handle(long response_id, const char* name) {
 int hvd_store_result(long long handle, const void* data, long long nbytes,
                      const long long* dims, int ndims) {
   auto* s = hvd::g();
-  std::lock_guard<std::mutex> lk(s->results_mu);
+  hvd::MutexLock lk(s->results_mu);
   auto& rb = s->results[handle];
   rb.bytes.assign(static_cast<const char*>(data),
                   static_cast<const char*>(data) + nbytes);

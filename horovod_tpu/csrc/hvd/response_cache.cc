@@ -59,7 +59,7 @@ std::string ResponseCache::Key(const Request& req) {
 }
 
 uint32_t ResponseCache::Lookup(const Request& req) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   auto it = by_key_.find(Key(req));
   if (it == by_key_.end()) return kInvalid;
   // No recency refresh: eviction must stay deterministic across ranks
@@ -68,7 +68,7 @@ uint32_t ResponseCache::Lookup(const Request& req) {
 }
 
 uint32_t ResponseCache::Put(const Request& req) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   std::string key = Key(req);
   auto it = by_key_.find(key);
   if (it != by_key_.end()) return it->second.id;
@@ -90,7 +90,7 @@ uint32_t ResponseCache::Put(const Request& req) {
 }
 
 bool ResponseCache::Get(uint32_t id, Request* out) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   auto it = by_id_.find(id);
   if (it == by_id_.end()) return false;
   auto e = by_key_.find(it->second);
@@ -100,7 +100,7 @@ bool ResponseCache::Get(uint32_t id, Request* out) {
 }
 
 void ResponseCache::Erase(const std::string& name) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   for (auto it = by_key_.begin(); it != by_key_.end();) {
     if (it->second.req.name == name) {
       by_id_.erase(it->second.id);
@@ -113,14 +113,14 @@ void ResponseCache::Erase(const std::string& name) {
 }
 
 void ResponseCache::Clear() {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   by_key_.clear();
   by_id_.clear();
   lru_.clear();
 }
 
 size_t ResponseCache::size() {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   return by_key_.size();
 }
 

@@ -18,11 +18,11 @@
 
 #include <cstdint>
 #include <list>
-#include <mutex>
 #include <string>
 #include <unordered_map>
 
 #include "common.h"
+#include "thread_annotations.h"
 
 namespace hvd {
 
@@ -34,17 +34,17 @@ class ResponseCache {
 
   // Returns the cache id for a request identical to a previously completed
   // one, or kInvalid.
-  uint32_t Lookup(const Request& req);
+  uint32_t Lookup(const Request& req) EXCLUDES(mu_);
 
   // Records a completed single-tensor request; returns its id.
-  uint32_t Put(const Request& req);
+  uint32_t Put(const Request& req) EXCLUDES(mu_);
 
   // Rebuilds the request for a cache id (coordinator side).
-  bool Get(uint32_t id, Request* out);
+  bool Get(uint32_t id, Request* out) EXCLUDES(mu_);
 
-  void Erase(const std::string& name);
-  void Clear();
-  size_t size();
+  void Erase(const std::string& name) EXCLUDES(mu_);
+  void Clear() EXCLUDES(mu_);
+  size_t size() EXCLUDES(mu_);
 
  private:
   static std::string Key(const Request& req);
@@ -55,12 +55,12 @@ class ResponseCache {
     std::list<uint32_t>::iterator lru_it;
   };
 
-  std::mutex mu_;
-  size_t capacity_;
-  uint32_t next_id_ = 1;
-  std::unordered_map<std::string, Entry> by_key_;
-  std::unordered_map<uint32_t, std::string> by_id_;
-  std::list<uint32_t> lru_;  // front = most recent
+  Mutex mu_;
+  size_t capacity_;  // ctor-set, never written after; read under mu_
+  uint32_t next_id_ GUARDED_BY(mu_) = 1;
+  std::unordered_map<std::string, Entry> by_key_ GUARDED_BY(mu_);
+  std::unordered_map<uint32_t, std::string> by_id_ GUARDED_BY(mu_);
+  std::list<uint32_t> lru_ GUARDED_BY(mu_);  // front = most recent
 };
 
 }  // namespace hvd

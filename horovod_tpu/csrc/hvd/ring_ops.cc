@@ -381,9 +381,11 @@ void Ring::AddSent(int peer, size_t nbytes) {
 }
 
 void Ring::SenderLoop() {
-  std::unique_lock<std::mutex> lk(send_mu_);
+  UniqueLock lk(send_mu_);
   while (true) {
-    send_cv_.wait(lk, [&] { return send_buf_ != nullptr || sender_exit_; });
+    // Written-out wait loop (no predicate lambda): the guarded reads
+    // stay in this body, where the analysis tracks the UniqueLock.
+    while (send_buf_ == nullptr && !sender_exit_) send_cv_.wait(lk);
     if (sender_exit_) return;
     const void* buf = send_buf_;
     size_t n = send_bytes_;
@@ -429,7 +431,7 @@ bool Ring::SendRecvDuplex(Socket* send_sock, int send_peer,
   // pending send" to the sender loop's wakeup predicate.
   if (sbuf == nullptr) sbuf = &kEmpty;
   {
-    std::lock_guard<std::mutex> lk(send_mu_);
+    MutexLock lk(send_mu_);
     send_kind_ = SendKind::kTcpFrame;
     send_sock_ = send_sock;
     send_peer_ = send_peer;
@@ -441,8 +443,8 @@ bool Ring::SendRecvDuplex(Socket* send_sock, int send_peer,
   std::string rframe;
   bool recv_ok = recv_sock->RecvFrame(&rframe) && rframe.size() == rbytes;
   {
-    std::unique_lock<std::mutex> lk(send_mu_);
-    send_cv_.wait(lk, [&] { return send_done_; });
+    UniqueLock lk(send_mu_);
+    while (!send_done_) send_cv_.wait(lk);
     if (recv_ok && rbytes > 0) std::memcpy(rbuf, rframe.data(), rbytes);
     return send_ok_ && recv_ok;
   }
@@ -531,7 +533,7 @@ bool Ring::CrossSendRecv(int next, const void* sbuf, size_t sbytes,
     if (snext == nullptr) return false;
   }
   {
-    std::lock_guard<std::mutex> lk(send_mu_);
+    MutexLock lk(send_mu_);
     send_kind_ = sid == stripe_backend_id_ ? SendKind::kStripe
                                            : SendKind::kTcpFrame;
     send_sock_ = snext;
@@ -552,8 +554,8 @@ bool Ring::CrossSendRecv(int next, const void* sbuf, size_t sbytes,
     recv_ok = sprev != nullptr && sprev->RecvFrameInto(rbuf, rbytes);
     if (recv_ok && on_piece) on_piece(0, rbytes);
   }
-  std::unique_lock<std::mutex> lk(send_mu_);
-  send_cv_.wait(lk, [&] { return send_done_; });
+  UniqueLock lk(send_mu_);
+  while (!send_done_) send_cv_.wait(lk);
   return send_ok_ && recv_ok;
 }
 
@@ -568,7 +570,7 @@ Ring::Ring() = default;
 Ring::~Ring() {
   if (sender_.joinable()) {
     {
-      std::lock_guard<std::mutex> lk(send_mu_);
+      MutexLock lk(send_mu_);
       sender_exit_ = true;
     }
     send_cv_.notify_all();

@@ -17,10 +17,8 @@
 #define HVD_RING_OPS_H_
 
 #include <atomic>
-#include <condition_variable>
 #include <map>
 #include <memory>
-#include <mutex>
 #include <string>
 #include <thread>
 #include <vector>
@@ -32,6 +30,7 @@
 #include "shm_transport.h"
 #include "socket.h"
 #include "stripe_transport.h"
+#include "thread_annotations.h"
 
 namespace hvd {
 
@@ -283,18 +282,29 @@ class Ring {
   int stripe_backend_id_ = -1;
   bool cross_registry_ = false;
 
+  // One-slot send mailbox between the posting (background) thread and
+  // the persistent sender thread. Every field of the handoff is
+  // GUARDED_BY(send_mu_): the posting side fills the slot under the
+  // lock and notifies; the sender snapshots it under the lock, drains
+  // the send unlocked, then reports completion under the lock. The
+  // pointed-to payload/socket stay valid until send_done_ — the lock
+  // acquisition chain is the happens-before that makes the unlocked
+  // send safe.
   std::thread sender_;
-  std::mutex send_mu_;
-  std::condition_variable send_cv_;
+  Mutex send_mu_;
+  CondVar send_cv_;
   enum class SendKind { kTcpFrame, kStripe };
-  SendKind send_kind_ = SendKind::kTcpFrame;
-  Socket* send_sock_ = nullptr;     // socket for the pending send
-  int send_peer_ = -1;              // destination rank of the pending send
-  const void* send_buf_ = nullptr;  // pending send request (one at a time)
-  size_t send_bytes_ = 0;
-  bool send_done_ = true;
-  bool send_ok_ = true;
-  bool sender_exit_ = false;
+  // socket for the pending send
+  SendKind send_kind_ GUARDED_BY(send_mu_) = SendKind::kTcpFrame;
+  Socket* send_sock_ GUARDED_BY(send_mu_) = nullptr;
+  // destination rank of the pending send
+  int send_peer_ GUARDED_BY(send_mu_) = -1;
+  // pending send request (one at a time)
+  const void* send_buf_ GUARDED_BY(send_mu_) = nullptr;
+  size_t send_bytes_ GUARDED_BY(send_mu_) = 0;
+  bool send_done_ GUARDED_BY(send_mu_) = true;
+  bool send_ok_ GUARDED_BY(send_mu_) = true;
+  bool sender_exit_ GUARDED_BY(send_mu_) = false;
 };
 
 }  // namespace hvd

@@ -23,6 +23,14 @@
 // rank's segment is reaped by any surviving rank's next init or
 // teardown sweep.
 
+// Thread posture: configuration and the attach table are background-
+// cycle-thread confined; the cross-thread observability surface
+// (attach_ok_/attach_fail_/bytes_sent_) is std::atomic — the GUARDED_BY
+// vs atomic rule of thread_annotations.h, atomic side (independent
+// scalars polled lock-free through hvd.ring_traffic()). The inter-
+// PROCESS ring-buffer handshake lives in shared memory and is ordered
+// by acquire/release atomics, outside any one process's lock analysis.
+//
 #ifndef HVD_SHM_TRANSPORT_H_
 #define HVD_SHM_TRANSPORT_H_
 

@@ -6,6 +6,16 @@
 // across hosts over DCN/ethernet; plain TCP with frame framing is
 // sufficient for the control plane and the host-tensor data plane.
 
+// Thread posture: a Socket is SINGLE-OWNER state (fd + receive buffer)
+// with a split-use contract the capability system cannot express on one
+// object — e.g. the ring neighbor sockets are sent to by the sender
+// thread while the posting thread receives, and the controller socket's
+// sends are serialized by TcpController::send_mu_ while its receives
+// are cycle-thread-only. The invariants that make this safe (exactly
+// one reader thread per socket, sends serialized or single-threaded)
+// are owned by the callers and documented at each member; this class
+// itself carries no locks and no annotations.
+//
 #ifndef HVD_SOCKET_H_
 #define HVD_SOCKET_H_
 

@@ -4,7 +4,7 @@ namespace hvd {
 
 void StallInspector::RecordRank(const std::string& name, int rank) {
   if (!enabled_) return;
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   auto it = pending_.find(name);
   if (it == pending_.end()) {
     PendingInfo info;
@@ -17,7 +17,7 @@ void StallInspector::RecordRank(const std::string& name, int rank) {
 
 void StallInspector::Remove(const std::string& name) {
   if (!enabled_) return;
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   pending_.erase(name);
 }
 
@@ -25,7 +25,7 @@ std::string StallInspector::Check(bool* should_shutdown,
                                   std::vector<int>* stalled_ranks) {
   *should_shutdown = false;
   if (!enabled_) return "";
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   auto now = std::chrono::steady_clock::now();
   std::string report;
   std::vector<bool> stalled(stalled_ranks != nullptr ? world_size_ : 0,

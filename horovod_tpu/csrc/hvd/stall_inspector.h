@@ -5,10 +5,11 @@
 #define HVD_STALL_INSPECTOR_H_
 
 #include <chrono>
-#include <mutex>
 #include <string>
 #include <unordered_map>
 #include <vector>
+
+#include "thread_annotations.h"
 
 namespace hvd {
 
@@ -23,10 +24,10 @@ class StallInspector {
   }
 
   // Record that `rank` submitted `name` (coordinator side).
-  void RecordRank(const std::string& name, int rank);
+  void RecordRank(const std::string& name, int rank) EXCLUDES(mu_);
 
   // Tensor completed: forget it.
-  void Remove(const std::string& name);
+  void Remove(const std::string& name) EXCLUDES(mu_);
 
   // Returns a human-readable stall report ("" if none) and sets
   // *should_shutdown when the hard limit passed. Call once per cycle.
@@ -36,7 +37,8 @@ class StallInspector {
   // heartbeat miss (docs/liveness.md) instead of their stall being a
   // log line only.
   std::string Check(bool* should_shutdown,
-                    std::vector<int>* stalled_ranks = nullptr);
+                    std::vector<int>* stalled_ranks = nullptr)
+      EXCLUDES(mu_);
 
  private:
   struct PendingInfo {
@@ -45,12 +47,15 @@ class StallInspector {
     bool warned = false;
   };
 
-  std::mutex mu_;
+  Mutex mu_;
+  // Configure() runs before the cycle thread exists (controller
+  // Initialize); the thresholds are read-only afterwards, so they carry
+  // no guard. The pending table is the shared state.
   double warning_sec_ = 60.0;
   double shutdown_sec_ = 0.0;
   int world_size_ = 1;
   bool enabled_ = true;
-  std::unordered_map<std::string, PendingInfo> pending_;
+  std::unordered_map<std::string, PendingInfo> pending_ GUARDED_BY(mu_);
 };
 
 }  // namespace hvd

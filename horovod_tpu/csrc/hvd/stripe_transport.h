@@ -31,6 +31,13 @@
 // no accept need be pending), and the receiver adopts them when the
 // control frame announces the choice.
 
+// Thread posture: pair state is background-cycle-thread confined except
+// the established sockets, which the sender thread uses after the
+// send-mailbox handoff (Ring::send_mu_ is the happens-before); the
+// observability counters (bytes_sent_/pairs_live_/stripes_) are
+// std::atomic for lock-free getters — the GUARDED_BY vs atomic rule of
+// thread_annotations.h, atomic side.
+//
 #ifndef HVD_STRIPE_TRANSPORT_H_
 #define HVD_STRIPE_TRANSPORT_H_
 

@@ -13,7 +13,7 @@
 namespace hvd {
 
 Status TensorQueue::AddToTensorQueue(TensorTableEntry entry) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   if (closed_) {
     // The background loop has exited (world abort or shutdown) and will
     // never drain this queue again; accepting the entry would strand the
@@ -35,7 +35,7 @@ Status TensorQueue::AddToTensorQueue(TensorTableEntry entry) {
 }
 
 std::vector<Request> TensorQueue::PopMessages() {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   std::vector<Request> out(queue_.begin(), queue_.end());
   queue_.clear();
   return out;
@@ -43,7 +43,7 @@ std::vector<Request> TensorQueue::PopMessages() {
 
 std::vector<TensorTableEntry> TensorQueue::GetTensorEntries(
     const std::vector<std::string>& names, bool remove) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   std::vector<TensorTableEntry> out;
   out.reserve(names.size());
   for (const auto& n : names) {
@@ -57,23 +57,23 @@ std::vector<TensorTableEntry> TensorQueue::GetTensorEntries(
 }
 
 void TensorQueue::RemoveTensorEntry(const std::string& name) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   table_.erase(name);
 }
 
 bool TensorQueue::Contains(const std::string& name) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   return table_.count(name) != 0;
 }
 
 size_t TensorQueue::PendingCount() {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   return table_.size();
 }
 
 void TensorQueue::WaitForMessages(
     std::chrono::steady_clock::time_point deadline) {
-  std::unique_lock<std::mutex> lk(mu_);
+  UniqueLock lk(mu_);
 #ifdef HVD_TSAN_BUILD
   // libstdc++ implements steady_clock cv waits via pthread_cond_clockwait,
   // which GCC-10-era libtsan does NOT intercept: TSan misses the
@@ -83,20 +83,26 @@ void TensorQueue::WaitForMessages(
   // therefore waits on the intercepted system_clock path. The clock
   // conversion is bounded by one cycle (ms) and an enqueue's notify
   // still breaks the wait, so instrumented behavior stays equivalent.
+  // Written-out wait loop (no predicate lambda): the guarded reads of
+  // queue_/closed_ stay in THIS function body, where the analysis knows
+  // the UniqueLock holds mu_ (thread_annotations.h).
   auto sys_deadline =
       std::chrono::system_clock::now() +
       std::chrono::duration_cast<std::chrono::system_clock::duration>(
           deadline - std::chrono::steady_clock::now());
-  cv_.wait_until(lk, sys_deadline,
-                 [&] { return !queue_.empty() || closed_; });
+  while (queue_.empty() && !closed_) {
+    if (cv_.wait_until(lk, sys_deadline) == std::cv_status::timeout) break;
+  }
 #else
-  cv_.wait_until(lk, deadline, [&] { return !queue_.empty() || closed_; });
+  while (queue_.empty() && !closed_) {
+    if (cv_.wait_until(lk, deadline) == std::cv_status::timeout) break;
+  }
 #endif
 }
 
 std::vector<TensorTableEntry> TensorQueue::DrainAll() {
   std::vector<TensorTableEntry> entries;
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   closed_ = true;  // refuse post-drain enqueues; see AddToTensorQueue
   for (auto& kv : table_) entries.push_back(std::move(kv.second));
   table_.clear();
@@ -106,7 +112,7 @@ std::vector<TensorTableEntry> TensorQueue::DrainAll() {
 }
 
 void TensorQueue::Reopen() {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   closed_ = false;
 }
 

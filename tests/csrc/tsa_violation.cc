@@ -1,0 +1,40 @@
+// Planted -Wthread-safety violation: proves the `tsa` gate
+// (`make -C horovod_tpu/csrc tsa`, docs/static-analysis.md) actually
+// FAILS on an unguarded read of a GUARDED_BY field — a vacuously-green
+// analysis (macros silently expanding to nothing under a clang, a
+// dropped -Wthread-safety flag) would pass HEAD and this file alike.
+// tests/test_native_tsa.py compiles this translation unit with the same
+// flags the tsa target uses and asserts the compile FAILS, and that it
+// SUCCEEDS with the analysis off (so the failure is the planted
+// violation, not a build-environment problem).
+//
+// This file is intentionally NOT in the Makefile's SRCS: it never
+// builds into any artifact.
+
+#include "thread_annotations.h"
+
+namespace {
+
+class Counter {
+ public:
+  void Inc() {
+    hvd::MutexLock lk(mu_);
+    ++value_;
+  }
+  // THE violation: reads value_ without holding mu_ — the exact shape
+  // of the PR 5/7/8/9 extern-C getter races (a monitor thread polling a
+  // counter while another thread mutates it under the lock).
+  long long Read() const { return value_; }
+
+ private:
+  mutable hvd::Mutex mu_;
+  long long value_ GUARDED_BY(mu_) = 0;
+};
+
+}  // namespace
+
+int main() {
+  Counter c;
+  c.Inc();
+  return c.Read() == 1 ? 0 : 1;
+}

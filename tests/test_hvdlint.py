@@ -24,12 +24,14 @@ from tools.hvdlint.registry import extract, render_markdown  # noqa: E402
 MINIMAL_FAULTS = 'CATALOG = ()\n'
 
 
-def make_tree(tmp_path, files, faults=MINIMAL_FAULTS, tests=None):
+def make_tree(tmp_path, files, faults=MINIMAL_FAULTS, tests=None,
+              root_files=None):
     """A scratch repo shaped the way hvdlint scans: ``files`` maps
     package-relative paths to sources (common/faults.py is always
     present so the fault-registry check has its single source of
     truth); ``tests`` maps tests/-relative paths for the seam-coverage
-    direction."""
+    direction; ``root_files`` maps repo-root-relative paths for the
+    cross-language fixtures (horovod_tpu/csrc/..., docs/...)."""
     root = tmp_path / "repo"
     pkg = root / "horovod_tpu"
     (pkg / "common").mkdir(parents=True)
@@ -40,6 +42,10 @@ def make_tree(tmp_path, files, faults=MINIMAL_FAULTS, tests=None):
         p.write_text(textwrap.dedent(text))
     for rel, text in (tests or {}).items():
         p = root / "tests" / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    for rel, text in (root_files or {}).items():
+        p = root / rel
         p.parent.mkdir(parents=True, exist_ok=True)
         p.write_text(textwrap.dedent(text))
     return str(root)
@@ -272,6 +278,305 @@ def test_exception_discipline_compliant_handlers(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# 7. binding-contract
+# ---------------------------------------------------------------------------
+
+CLEAN_OPERATIONS_CC = """\
+namespace hvd { int helper(); }
+
+extern "C" {
+
+int hvd_add(int a, int b) { return a + b; }
+
+// hvd_add(9, 9) in a comment is neither a call nor a definition
+long long hvd_apply(const char* name, int n,
+                    void (*done)(void*, long long, int),
+                    void* arg) {
+  (void)name; (void)arg; (void)done;
+  return hvd_add(n, n);  /* a CALL: must not count as a definition */
+}
+
+int hvd_ping() { return hvd::helper(); }
+
+}  // extern "C"
+"""
+
+CLEAN_NATIVE_PY = """\
+import ctypes
+
+_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_longlong,
+                       ctypes.c_int)
+
+
+def bind(lib):
+    lib.hvd_add.restype = ctypes.c_int
+    lib.hvd_add.argtypes = [ctypes.c_int, ctypes.c_int]
+    lib.hvd_apply.restype = ctypes.c_longlong
+    lib.hvd_apply.argtypes = [ctypes.c_char_p, ctypes.c_int, _CB,
+                              ctypes.c_void_p]
+    lib.hvd_ping.restype = ctypes.c_int
+    lib.hvd_ping.argtypes = []
+    return lib
+"""
+
+
+def test_binding_contract_clean_fixture(tmp_path):
+    root = make_tree(tmp_path, {"common/native.py": CLEAN_NATIVE_PY},
+                     root_files={
+                         "horovod_tpu/csrc/hvd/operations.cc":
+                             CLEAN_OPERATIONS_CC})
+    assert findings_of(root, "binding-contract") == []
+
+
+def test_binding_contract_flags_bound_but_undefined(tmp_path):
+    native = CLEAN_NATIVE_PY + """\
+
+def bind_more(lib):
+    lib.hvd_gone.restype = ctypes.c_int  # no extern "C" definition
+"""
+    root = make_tree(tmp_path, {"common/native.py": native},
+                     root_files={
+                         "horovod_tpu/csrc/hvd/operations.cc":
+                             CLEAN_OPERATIONS_CC})
+    hits = findings_of(root, "binding-contract")
+    assert len(hits) == 1 and "hvd_gone" in hits[0].message, \
+        [f.render() for f in hits]
+    assert hits[0].severity == "error"
+    assert hits[0].path == "horovod_tpu/common/native.py"
+
+
+def test_binding_contract_flags_argtypes_arity_mismatch(tmp_path):
+    native = CLEAN_NATIVE_PY.replace(
+        "lib.hvd_add.argtypes = [ctypes.c_int, ctypes.c_int]",
+        "lib.hvd_add.argtypes = [ctypes.c_int]")
+    root = make_tree(tmp_path, {"common/native.py": native},
+                     root_files={
+                         "horovod_tpu/csrc/hvd/operations.cc":
+                             CLEAN_OPERATIONS_CC})
+    hits = findings_of(root, "binding-contract")
+    assert len(hits) == 1, [f.render() for f in hits]
+    assert "hvd_add" in hits[0].message and "1" in hits[0].message \
+        and "2" in hits[0].message
+    assert hits[0].severity == "error"
+
+
+def test_binding_contract_unbound_export_is_nonfailing_warning(
+        tmp_path, capsys):
+    cc = CLEAN_OPERATIONS_CC + """\
+
+extern "C" {
+int hvd_orphan(int x) { return x; }
+}
+"""
+    root = make_tree(tmp_path, {"common/native.py": CLEAN_NATIVE_PY},
+                     root_files={
+                         "horovod_tpu/csrc/hvd/operations.cc": cc})
+    hits = findings_of(root, "binding-contract")
+    assert len(hits) == 1 and "hvd_orphan" in hits[0].message
+    assert hits[0].severity == "warning"
+    assert hits[0].path == "horovod_tpu/csrc/hvd/operations.cc"
+    # Warnings surface but never fail the run.
+    assert main([root]) == 0
+    assert "hvd_orphan" in capsys.readouterr().out
+
+
+def test_binding_contract_ignores_commented_extern_c_block(tmp_path):
+    # A commented-out `extern "C" {` must not open a bogus span that
+    # corrupts the export map (dropping real exports / leaking calls).
+    cc = '// extern "C" { old block, kept for reference\n' + \
+        CLEAN_OPERATIONS_CC
+    root = make_tree(tmp_path, {"common/native.py": CLEAN_NATIVE_PY},
+                     root_files={
+                         "horovod_tpu/csrc/hvd/operations.cc": cc})
+    assert findings_of(root, "binding-contract") == []
+
+
+def test_binding_contract_lexer_handles_tricky_literals(tmp_path):
+    # Digit separators must not open a bogus char literal, and an
+    # encoding-prefixed char literal (L'"') must still lex as a literal
+    # — either corruption would swallow the following export.
+    cc = '''\
+extern "C" {
+int hvd_sep() { return 1'000'000; }
+char hvd_quote() { return L'"'; }
+int hvd_after(int x) { return x; }
+}
+'''
+    native = '''\
+import ctypes
+
+
+def bind(lib):
+    lib.hvd_sep.restype = ctypes.c_int
+    lib.hvd_sep.argtypes = []
+    lib.hvd_quote.restype = ctypes.c_char
+    lib.hvd_quote.argtypes = []
+    lib.hvd_after.restype = ctypes.c_int
+    lib.hvd_after.argtypes = [ctypes.c_int]
+'''
+    root = make_tree(tmp_path, {"common/native.py": native},
+                     root_files={
+                         "horovod_tpu/csrc/hvd/operations.cc": cc})
+    assert findings_of(root, "binding-contract") == []
+
+
+def test_binding_contract_skips_scratch_trees(tmp_path):
+    # No csrc side (every other check's fixture tree): nothing to
+    # cross-check, so the check stays silent.
+    root = make_tree(tmp_path, {"common/native.py": CLEAN_NATIVE_PY})
+    assert findings_of(root, "binding-contract") == []
+
+
+# ---------------------------------------------------------------------------
+# 8. native-knob-discipline
+# ---------------------------------------------------------------------------
+
+KNOB_CONFIG_PY = """\
+import os
+
+HOROVOD_TEST_KNOB = "HOROVOD_TEST_KNOB"
+
+
+def test_knob():
+    return int(os.environ.get(HOROVOD_TEST_KNOB, 5))
+"""
+
+KNOB_ENV_DOC = """\
+| `HOROVOD_TEST_KNOB` | `test_knob` | `5` | — |
+"""
+
+
+def test_native_knob_discipline_clean_fixture(tmp_path):
+    root = make_tree(
+        tmp_path, {"common/config.py": KNOB_CONFIG_PY},
+        root_files={
+            "horovod_tpu/csrc/hvd/env.cc": """\
+                static long long a = EnvLL("HOROVOD_TEST_KNOB", 5);
+                // EnvLL("HOROVOD_COMMENTED_KNOB", 1): comments never count
+                static const char* s = "EnvFlag(\\"HOROVOD_IN_STRING\\")";
+                """,
+            "docs/env-vars.md": KNOB_ENV_DOC})
+    assert findings_of(root, "native-knob-discipline") == []
+
+
+def test_native_knob_discipline_flags_unregistered_read(tmp_path):
+    root = make_tree(
+        tmp_path, {"common/config.py": KNOB_CONFIG_PY},
+        root_files={
+            "horovod_tpu/csrc/hvd/env.cc": """\
+                static long long a = EnvLL("HOROVOD_TEST_KNOB", 5);
+                static bool b = EnvFlag("HOROVOD_MYSTERY_KNOB");
+                """,
+            "docs/env-vars.md": KNOB_ENV_DOC})
+    hits = findings_of(root, "native-knob-discipline")
+    assert len(hits) == 1, [f.render() for f in hits]
+    assert "HOROVOD_MYSTERY_KNOB" in hits[0].message
+    assert "config.py" in hits[0].message
+    assert "env-vars.md" in hits[0].message
+    assert hits[0].path == "horovod_tpu/csrc/hvd/env.cc"
+    assert hits[0].line == 2
+
+
+def test_native_knob_discipline_flags_doc_only_drift(tmp_path):
+    # Accessor exists but the committed registry lacks the row: the
+    # doc-sync half alone must flag.
+    root = make_tree(
+        tmp_path, {"common/config.py": KNOB_CONFIG_PY},
+        root_files={
+            "horovod_tpu/csrc/hvd/env.cc":
+                'static long long a = EnvLL("HOROVOD_TEST_KNOB", 5);\n',
+            "docs/env-vars.md": "| nothing here |\n"})
+    hits = findings_of(root, "native-knob-discipline")
+    assert len(hits) == 1 and "registry row" in hits[0].message
+    assert "constant/accessor" not in hits[0].message
+
+
+def test_native_knob_discipline_doc_match_is_token_not_substring(tmp_path):
+    # A missing `HOROVOD_SHORT` row must flag even when a prefix-aliased
+    # sibling (`HOROVOD_SHORT_EXTRA`) has one — raw substring matching
+    # would pass vacuously off the sibling's row.
+    cfg = """\
+        import os
+
+        HOROVOD_SHORT = "HOROVOD_SHORT"
+        HOROVOD_SHORT_EXTRA = "HOROVOD_SHORT_EXTRA"
+
+
+        def short():
+            return os.environ.get(HOROVOD_SHORT, "")
+
+
+        def short_extra():
+            return os.environ.get(HOROVOD_SHORT_EXTRA, "")
+        """
+    root = make_tree(
+        tmp_path, {"common/config.py": cfg},
+        root_files={
+            "horovod_tpu/csrc/hvd/env.cc":
+                'static bool a = EnvFlag("HOROVOD_SHORT");\n',
+            "docs/env-vars.md":
+                "| `HOROVOD_SHORT_EXTRA` | `short_extra` | `''` | — |\n"})
+    hits = findings_of(root, "native-knob-discipline")
+    assert len(hits) == 1 and "registry row" in hits[0].message, \
+        [f.render() for f in hits]
+    assert "HOROVOD_SHORT " in hits[0].message + " "
+
+
+# ---------------------------------------------------------------------------
+# fault-registry: native seam-arming direction
+# ---------------------------------------------------------------------------
+
+def test_fault_registry_native_seam_consumed_is_clean(tmp_path):
+    root = make_tree(
+        tmp_path, {"common/host_world.py": """\
+            import os
+            os.environ["HVD_TEST_FORCE_FAIL"] = "1"
+            """},
+        root_files={
+            "horovod_tpu/csrc/hvd/backend.cc":
+                'static bool f = std::getenv("HVD_TEST_FORCE_FAIL");\n'})
+    assert findings_of(root, "fault-registry") == []
+
+
+def test_fault_registry_flags_vacuous_native_seam(tmp_path):
+    root = make_tree(
+        tmp_path, {"common/host_world.py": """\
+            import os
+            os.environ["HVD_TEST_FORCE_FAIL"] = "1"
+            os.environ.pop("HVD_POPPED_FORCE_X", None)  # a pop never arms
+            """},
+        root_files={
+            "horovod_tpu/csrc/hvd/backend.cc":
+                "// nothing consumes the seam token\n"})
+    hits = findings_of(root, "fault-registry")
+    assert len(hits) == 1, [f.render() for f in hits]
+    assert "HVD_TEST_FORCE_FAIL" in hits[0].message
+    assert hits[0].path == "horovod_tpu/common/host_world.py"
+    assert hits[0].line == 2
+
+
+def test_fault_registry_native_seam_needs_a_real_read(tmp_path):
+    # A comment/log-string mention or a prefix-extended rename of the
+    # consumer must NOT satisfy the check — only an actual env read of
+    # the exact token does (a renamed C++ seam is the vacuous-test bug
+    # this direction exists to catch).
+    root = make_tree(
+        tmp_path, {"common/host_world.py": """\
+            import os
+            os.environ["HVD_TEST_FORCE_FAIL"] = "1"
+            """},
+        root_files={
+            "horovod_tpu/csrc/hvd/backend.cc": """\
+                // HVD_TEST_FORCE_FAIL documented here only
+                static const char* msg = "set HVD_TEST_FORCE_FAIL";
+                static bool f = std::getenv("HVD_TEST_FORCE_FAILURE");
+                """})
+    hits = findings_of(root, "fault-registry")
+    assert len(hits) == 1 and "HVD_TEST_FORCE_FAIL" in hits[0].message, \
+        [f.render() for f in hits]
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -428,6 +733,17 @@ def test_hvdlint_runs_clean_on_head():
     same subprocess entry point tools/t1.sh uses."""
     r = subprocess.run([sys.executable, "-m", "tools.hvdlint"], cwd=REPO,
                        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cross_language_checks_clean_on_head():
+    """The tools/t1.sh cross-language gate, verbatim: the ctypes binding
+    contract and the native knob registry hold on this repo (and the
+    comma-separated --check form parses)."""
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.hvdlint", "--check",
+         "binding-contract,native-knob-discipline"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
     assert r.returncode == 0, r.stdout + r.stderr
 
 

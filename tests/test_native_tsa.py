@@ -1,0 +1,79 @@
+"""The clang -Wthread-safety gate over the annotated native core.
+
+`make -C horovod_tpu/csrc tsa` runs clang's thread-safety capability
+analysis (csrc/hvd/thread_annotations.h; docs/static-analysis.md) as a
+syntax-only compile with -Werror: the locking discipline of the native
+core is a CHECKED contract, not a review convention. Two directions:
+
+- HEAD must be clean: every GUARDED_BY/REQUIRES/EXCLUDES contract in
+  csrc/hvd holds.
+- The gate must have teeth: the planted violation in
+  tests/csrc/tsa_violation.cc (an unguarded read of a GUARDED_BY field
+  — the extern-C getter-race shape PRs 5/7/8/9 kept re-fixing) must
+  FAIL the same flags, and compile fine with the analysis off.
+
+Skips — not passes — without a clang++ on PATH (the analysis is
+clang-only; g++ builds compile the annotations away), mirroring the
+probe pattern of tests/test_native_tsan.py: a toolchain that cannot
+run the analysis must never report it green.
+"""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CSRC = os.path.join(REPO, "horovod_tpu", "csrc")
+HVD_DIR = os.path.join(CSRC, "hvd")
+FIXTURE = os.path.join(REPO, "tests", "csrc", "tsa_violation.cc")
+
+TSA_FLAGS = ["-std=c++17", "-fsyntax-only", "-Wthread-safety", "-Werror"]
+
+
+def _clangxx():
+    """The clang++ the tsa target would use; skip when absent or when it
+    cannot run the analysis on a trivial TU (a broken install must skip,
+    never pass vacuously)."""
+    cxx = shutil.which(os.environ.get("CLANGXX", "clang++"))
+    if cxx is None:
+        pytest.skip("no clang++ on PATH (-Wthread-safety is clang-only)")
+    r = subprocess.run(
+        [cxx, "-x", "c++", *TSA_FLAGS, "-"],
+        input="int main() { return 0; }", capture_output=True, text=True,
+        timeout=120)
+    if r.returncode != 0:
+        pytest.skip(f"clang++ cannot run -Wthread-safety here: "
+                    f"{r.stderr[-300:]}")
+    return cxx
+
+
+def test_tsa_gate_is_clean_on_head():
+    """THE acceptance run: `make -C horovod_tpu/csrc tsa` exits 0 — the
+    whole native core satisfies its annotated locking contracts."""
+    cxx = _clangxx()
+    r = subprocess.run(["make", "-C", CSRC, "tsa", f"CLANGXX={cxx}"],
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_tsa_gate_fails_on_planted_violation(tmp_path):
+    """The planted unguarded read must fail the exact tsa flags — the
+    proof the gate is not vacuously green."""
+    cxx = _clangxx()
+    r = subprocess.run(
+        [cxx, *TSA_FLAGS, f"-I{HVD_DIR}", FIXTURE],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode != 0, (
+        "tsa flags accepted the planted GUARDED_BY violation — the "
+        "analysis is not running:\n" + r.stdout + r.stderr)
+    assert "thread-safety" in (r.stdout + r.stderr).lower(), \
+        r.stdout + r.stderr
+    # ... and the failure is the analysis, not a broken fixture: the
+    # same TU compiles clean with -Wthread-safety off.
+    r2 = subprocess.run(
+        [cxx, "-std=c++17", "-fsyntax-only", "-Werror", f"-I{HVD_DIR}",
+         FIXTURE],
+        capture_output=True, text=True, timeout=300)
+    assert r2.returncode == 0, r2.stdout + r2.stderr

@@ -9,7 +9,8 @@ else (discovery, suppressions, JSON, exit codes) is framework.
 from __future__ import annotations
 
 import ast
-from typing import List, Optional
+import re
+from typing import Dict, List, Optional, Tuple
 
 from .core import Finding, Module, Project
 
@@ -17,6 +18,167 @@ CONFIG_PATH = "horovod_tpu/common/config.py"
 COMPAT_PATH = "horovod_tpu/common/compat.py"
 FAULTS_PATH = "horovod_tpu/common/faults.py"
 TIMELINE_PATH = "horovod_tpu/common/timeline.py"
+NATIVE_PATH = "horovod_tpu/common/native.py"
+HOST_WORLD_PATH = "horovod_tpu/common/host_world.py"
+CSRC_DIR = "horovod_tpu/csrc"
+OPERATIONS_CC = "horovod_tpu/csrc/hvd/operations.cc"
+ENV_VARS_DOC = "docs/env-vars.md"
+
+
+# ---------------------------------------------------------------------------
+# lightweight C++ lexing (no libclang): shared by the cross-language
+# checks. Good enough on purpose — the native core is plain C++ with one
+# extern "C" block; these helpers strip comments/strings preserving line
+# numbers, then pattern-match identifiers with balanced-paren scanning.
+# ---------------------------------------------------------------------------
+
+def _strip_c_comments(src: str) -> str:
+    """C++ source with comments and string/char literals blanked out,
+    byte-for-byte the same length and newlines (so offsets still map to
+    line numbers). String CONTENTS are blanked too; callers that need a
+    quoted literal (the env-read scans) match the ORIGINAL source and
+    validate the callee position against this stripped text. An
+    apostrophe BETWEEN DIGITS is a C++14 digit separator (1'000'000),
+    not a char-literal opener — the between-digits rule deliberately
+    stays narrow so encoding-prefixed char literals (L'"', u8'"') keep
+    lexing as literals. Known limitations: raw string literals
+    (R"(...)") and hex digit separators whose neighbor groups start
+    with a-f (0xAB'CD) would mis-lex — neither exists in csrc/, and
+    both corrupt toward spurious findings on the error side, never a
+    silent pass of the binding direction (a swallowed definition
+    surfaces as a bound-but-undefined ERROR on a clean tree)."""
+    out = []
+    i, n = 0, len(src)
+    while i < n:
+        c = src[i]
+        nxt = src[i + 1] if i + 1 < n else ""
+        if c == "'" and i > 0 and src[i - 1].isdigit() and nxt.isdigit():
+            # digit separator: not a char-literal opener.
+            out.append(c)
+            i += 1
+            continue
+        if c == "/" and nxt == "/":
+            while i < n and src[i] != "\n":
+                out.append(" ")
+                i += 1
+        elif c == "/" and nxt == "*":
+            out.append("  ")
+            i += 2
+            while i < n and not (src[i] == "*" and i + 1 < n and
+                                 src[i + 1] == "/"):
+                out.append("\n" if src[i] == "\n" else " ")
+                i += 1
+            if i < n:
+                out.append("  ")
+                i += 2
+        elif c in "\"'":
+            quote = c
+            out.append(c)
+            i += 1
+            while i < n and src[i] != quote:
+                if src[i] == "\\" and i + 1 < n:
+                    out.append("  ")
+                    i += 2
+                else:
+                    out.append("\n" if src[i] == "\n" else " ")
+                    i += 1
+            if i < n:
+                out.append(quote)
+                i += 1
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _line_of(text: str, pos: int) -> int:
+    return text.count("\n", 0, pos) + 1
+
+
+def _count_c_params(params: str) -> int:
+    """Top-level parameter count of a C parameter list (commas inside
+    nested parens — function-pointer parameters — do not split)."""
+    params = params.strip()
+    if not params or params == "void":
+        return 0
+    depth = 0
+    count = 1
+    for ch in params:
+        if ch in "([":
+            depth += 1
+        elif ch in ")]":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            count += 1
+    return count
+
+
+def _extern_c_functions(src: str) -> Dict[str, Tuple[int, int]]:
+    """{name: (line, n_params)} for every function DEFINED inside an
+    ``extern "C" { ... }`` block of ``src`` whose name starts with
+    ``hvd_``. Calls (followed by ``;``/operators) are not definitions;
+    only a name whose balanced parameter list is followed by ``{``
+    counts."""
+    code = _strip_c_comments(src)
+    spans = []
+    # Span detection runs over the STRIPPED text like every other
+    # helper here (a commented-out `extern "C" {` must not open a bogus
+    # span); stripping blanks string contents, so the C inside the
+    # quotes may read as a space.
+    for m in re.finditer(r'extern\s+"(?:C| )"\s*\{', code):
+        depth = 1
+        i = m.end()
+        while i < len(code) and depth:
+            if code[i] == "{":
+                depth += 1
+            elif code[i] == "}":
+                depth -= 1
+            i += 1
+        spans.append((m.end(), i))
+    out: Dict[str, Tuple[int, int]] = {}
+    for m in re.finditer(r"\b(hvd_\w+)\s*\(", code):
+        if not any(b <= m.start() < e for b, e in spans):
+            continue
+        i = m.end()
+        depth = 1
+        while i < len(code) and depth:
+            if code[i] == "(":
+                depth += 1
+            elif code[i] == ")":
+                depth -= 1
+            i += 1
+        params = code[m.end():i - 1]
+        j = i
+        while j < len(code) and code[j] in " \t\r\n":
+            j += 1
+        if j < len(code) and code[j] == "{" and m.group(1) not in out:
+            out[m.group(1)] = (_line_of(code, m.start()),
+                               _count_c_params(params))
+    return out
+
+
+# Env reads the native core performs: EnvFlag/EnvLL/EnvMs (the shared
+# parsers) and raw (std::)getenv. The pattern runs over the ORIGINAL
+# source so the quoted env name is readable, but candidate positions are
+# validated against the comment-stripped text so a name inside a comment
+# or log string never counts as a read.
+_C_ENV_READ_RE = re.compile(
+    r'\b(?:EnvFlag|EnvLL|EnvMs|getenv)\s*\(\s*"([A-Za-z_][A-Za-z0-9_]*)"')
+
+
+def _c_env_reads(src: str, prefix: str = "HOROVOD_") -> List[Tuple[str,
+                                                                   int]]:
+    code = _strip_c_comments(src)
+    out = []
+    for m in _C_ENV_READ_RE.finditer(src):
+        if not m.group(1).startswith(prefix):
+            continue
+        # The call token must survive comment stripping (the quoted name
+        # itself is blanked there, so match on the callee position).
+        if code[m.start():m.start() + 3] != src[m.start():m.start() + 3]:
+            continue
+        out.append((m.group(1), _line_of(src, m.start())))
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -312,6 +474,56 @@ class FaultRegistry:
                     f"registered fault point {seam!r} has no reference "
                     f"in tests/ or docs/ — add a chaos test or document "
                     f"the seam (docs/fault-injection.md)"))
+        out.extend(self._native_seams(project))
+        return out
+
+    # Native side of the registry: the absorbed-raise seams
+    # (ring.shm.attach, ring.stripe.connect) arm a forced-failure env
+    # var that the C++ backend greps for. A renamed C++ token silently
+    # turns the fault test vacuous — the Python side still sets the
+    # var, the native side never reads it, the "fallback is exercised"
+    # proof passes without exercising anything. Every HVD_*FORCE* var
+    # SET in faults.py/host_world.py must therefore be a greppable
+    # token somewhere in csrc/.
+    _FORCE_RE = re.compile(r"HVD_\w*FORCE\w*")
+
+    def _native_seams(self, project: Project) -> List[Finding]:
+        csrc = project.text_files((CSRC_DIR,), (".cc", ".h"))
+        if not csrc:
+            return []  # scratch tree without a native side
+        # What the native side actually READS (EnvFlag/EnvLL/EnvMs/
+        # getenv with the exact quoted name, comment/string mentions
+        # excluded) — a log line naming the var, or a prefix-extended
+        # rename (..._FAILURE), must not satisfy the check.
+        consumed = set()
+        for text in csrc.values():
+            for name, _ in _c_env_reads(text, prefix="HVD_"):
+                consumed.add(name)
+        out: List[Finding] = []
+        for mod in project.modules:
+            if mod.path not in (FAULTS_PATH, HOST_WORLD_PATH):
+                continue
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for t in node.targets:
+                    if not (isinstance(t, ast.Subscript) and
+                            mod.dotted(t.value) == "os.environ" and
+                            isinstance(t.slice, ast.Constant) and
+                            isinstance(t.slice.value, str)):
+                        continue
+                    key = t.slice.value
+                    if not self._FORCE_RE.fullmatch(key):
+                        continue
+                    if key not in consumed:
+                        out.append(Finding(
+                            self.id, mod.path, node.lineno,
+                            node.col_offset,
+                            f"seam-arming env var {key!r} is set here "
+                            f"but consumed nowhere in csrc/ — the "
+                            f"native half of the fault seam is gone "
+                            f"(renamed?) and its fault tests are "
+                            f"vacuous"))
         return out
 
 
@@ -495,6 +707,154 @@ class TimelineInstantRegistry:
         return out
 
 
+# ---------------------------------------------------------------------------
+# 7. binding-contract
+# ---------------------------------------------------------------------------
+
+class BindingContract:
+    """The ctypes surface of ``common/native.py`` and the ``extern "C"``
+    surface of ``csrc/hvd/operations.cc`` must agree — in BOTH
+    directions, with argument counts cross-checked against the declared
+    ``argtypes``.
+
+    A bound-but-undefined symbol is a load-time AttributeError on the
+    next .so rebuild (error); a defined-but-unbound export is drift
+    worth surfacing but breaks nothing (warning); an argtypes arity
+    mismatch is silent stack corruption on some ABIs (error)."""
+
+    id = "binding-contract"
+    description = ("ctypes bindings in common/native.py must match "
+                   "operations.cc's extern \"C\" surface (existence "
+                   "both ways + argtypes arity)")
+
+    def run(self, mod: Module) -> List[Finding]:
+        return []  # cross-language: all work happens in finalize
+
+    def _bindings(self, native: Module):
+        """(bound, arities): every ``lib.hvd_*`` attribute referenced
+        (first line seen), and ``lib.hvd_*.argtypes = [...]`` lengths."""
+        bound: Dict[str, int] = {}
+        arities: Dict[str, Tuple[int, int]] = {}
+        for node in ast.walk(native.tree):
+            if isinstance(node, ast.Attribute) and \
+                    node.attr.startswith("hvd_"):
+                base = node.value
+                base_name = None
+                if isinstance(base, ast.Name):
+                    base_name = base.id
+                elif isinstance(base, ast.Attribute):
+                    base_name = base.attr
+                if base_name == "lib":
+                    if node.attr not in bound or \
+                            node.lineno < bound[node.attr]:
+                        bound[node.attr] = node.lineno
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Attribute) and \
+                        t.attr == "argtypes" and \
+                        isinstance(t.value, ast.Attribute) and \
+                        t.value.attr.startswith("hvd_") and \
+                        isinstance(node.value, (ast.List, ast.Tuple)):
+                    arities[t.value.attr] = (node.lineno,
+                                             len(node.value.elts))
+        return bound, arities
+
+    def finalize(self, project: Project) -> List[Finding]:
+        native = project.module(NATIVE_PATH)
+        src = project.text_files((CSRC_DIR,), (".cc",)).get(OPERATIONS_CC)
+        if native is None or src is None:
+            return []  # scratch tree without both sides: nothing to check
+        exports = _extern_c_functions(src)
+        bound, arities = self._bindings(native)
+        out: List[Finding] = []
+        for name in sorted(bound):
+            if name not in exports:
+                out.append(Finding(
+                    self.id, NATIVE_PATH, bound[name], 0,
+                    f"ctypes binding {name} has no extern \"C\" "
+                    f"definition in {OPERATIONS_CC} — a renamed/removed "
+                    f"export would fail at library load"))
+        for name in sorted(arities):
+            line, declared = arities[name]
+            if name in exports and exports[name][1] != declared:
+                out.append(Finding(
+                    self.id, NATIVE_PATH, line, 0,
+                    f"{name}.argtypes declares {declared} argument(s) "
+                    f"but the extern \"C\" definition takes "
+                    f"{exports[name][1]} "
+                    f"({OPERATIONS_CC}:{exports[name][0]}) — an arity "
+                    f"drift is silent stack corruption on some ABIs"))
+        for name in sorted(exports):
+            if name not in bound:
+                out.append(Finding(
+                    self.id, OPERATIONS_CC, exports[name][0], 0,
+                    f"extern \"C\" export {name} has no ctypes binding "
+                    f"in {NATIVE_PATH}; declare restype/argtypes (even "
+                    f"contract-only) so the ABI surface stays auditable",
+                    severity="warning"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# 8. native-knob-discipline
+# ---------------------------------------------------------------------------
+
+class NativeKnobDiscipline:
+    """Every ``HOROVOD_*`` env var the native core reads (``EnvFlag`` /
+    ``EnvLL`` / ``EnvMs`` / raw ``getenv`` in ``csrc/``) must be part of
+    the registered knob surface: a named constant in
+    ``common/config.py`` (which gives it an accessor and a coded
+    default) and a row in the generated ``docs/env-vars.md``. Closes
+    the env-discipline gap for C++ reads, which the Python AST check
+    cannot see — an undocumented native knob is a dispatch switch users
+    can set but no registry or doc admits exists."""
+
+    id = "native-knob-discipline"
+    description = ("HOROVOD_* env reads in csrc/ must have a "
+                   "common/config.py constant and a docs/env-vars.md "
+                   "registry row")
+
+    def run(self, mod: Module) -> List[Finding]:
+        return []  # cross-language: all work happens in finalize
+
+    def finalize(self, project: Project) -> List[Finding]:
+        cc = project.text_files((CSRC_DIR,), (".cc", ".h"))
+        cfg = project.module(CONFIG_PATH)
+        if not cc or cfg is None:
+            return []  # scratch tree without a native side / config
+        # Local import: registry.py imports this module's CONFIG_PATH.
+        from .registry import extract
+        entries = {e.env_name: e for e in extract(project)}
+        registered = {env for env, e in entries.items() if e.accessors}
+        doc = project.text_files(("docs",), (".md",)).get(ENV_VARS_DOC, "")
+        out: List[Finding] = []
+        seen = set()
+        for path in sorted(cc):
+            for env, line in _c_env_reads(cc[path]):
+                if env in seen:
+                    continue
+                seen.add(env)
+                missing = []
+                if env not in registered:
+                    missing.append("a common/config.py constant/accessor")
+                # The registry row renders the env name backticked
+                # (`HOROVOD_X`); matching the delimited token (not a raw
+                # substring) keeps a prefix-aliased knob (HOROVOD_SHM vs
+                # HOROVOD_SHM_FALLBACK) from passing vacuously off its
+                # siblings' rows or a prose mention.
+                if f"`{env}`" not in doc:
+                    missing.append(f"a {ENV_VARS_DOC} registry row")
+                if missing:
+                    out.append(Finding(
+                        self.id, path, line, 0,
+                        f"native env read of {env} is missing "
+                        f"{' and '.join(missing)} — register the knob "
+                        f"(accessor in config.py, then regenerate the "
+                        f"registry with --registry)"))
+        return out
+
+
 ALL_CHECKS = (EnvDiscipline(), CompatDiscipline(), RetryDiscipline(),
               FaultRegistry(), ExceptionDiscipline(),
-              TimelineInstantRegistry())
+              TimelineInstantRegistry(), BindingContract(),
+              NativeKnobDiscipline())

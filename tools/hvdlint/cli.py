@@ -33,7 +33,8 @@ def main(argv: List[str] = None) -> int:
                     help="machine-readable JSON report on stdout")
     ap.add_argument("--check", action="append", default=None,
                     metavar="ID", help="run only this check id "
-                    "(repeatable)")
+                    "(repeatable; comma-separated lists accepted, e.g. "
+                    "--check binding-contract,native-knob-discipline)")
     ap.add_argument("--list-checks", action="store_true",
                     help="list check ids and exit")
     ap.add_argument("--registry", action="store_true",
@@ -47,13 +48,14 @@ def main(argv: List[str] = None) -> int:
             print(f"{c.id}: {c.description}")
         return 0
     if args.check:
+        wanted = [cid for v in args.check for cid in v.split(",") if cid]
         known = {c.id for c in checks}
-        bad = [cid for cid in args.check if cid not in known]
+        bad = [cid for cid in wanted if cid not in known]
         if bad:
             print(f"hvdlint: unknown check id(s): {', '.join(bad)} "
                   f"(known: {', '.join(sorted(known))})", file=sys.stderr)
             return 2
-        checks = [c for c in checks if c.id in set(args.check)]
+        checks = [c for c in checks if c.id in set(wanted)]
 
     root = args.root or _repo_root()
     if not os.path.isdir(os.path.join(root, Project.PACKAGE_DIR)):
@@ -69,21 +71,24 @@ def main(argv: List[str] = None) -> int:
 
     findings = run_checks(project, checks)
     active = [f for f in findings if not f.suppressed]
+    errors = [f for f in active if f.severity != "warning"]
+    warnings = [f for f in active if f.severity == "warning"]
     suppressed = [f for f in findings if f.suppressed]
     if args.json:
         print(report_json(findings, checks))
     else:
         for f in active:
             print(f.render())
-        if active:
-            print(f"hvdlint: {len(active)} finding(s) "
-                  f"({len(suppressed)} suppressed) across "
-                  f"{len(project.modules)} files")
+        if errors:
+            print(f"hvdlint: {len(errors)} finding(s) "
+                  f"({len(warnings)} warning(s), {len(suppressed)} "
+                  f"suppressed) across {len(project.modules)} files")
         else:
             print(f"hvdlint: OK ({len(project.modules)} files, "
-                  f"{len(checks)} checks, {len(suppressed)} "
-                  f"suppression(s) honored)")
-    return 1 if active else 0
+                  f"{len(checks)} checks, {len(warnings)} warning(s), "
+                  f"{len(suppressed)} suppression(s) honored)")
+    # Warnings are surfaced but never fail the run (Finding.severity).
+    return 1 if errors else 0
 
 
 if __name__ == "__main__":

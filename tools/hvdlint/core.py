@@ -38,13 +38,19 @@ class Finding:
     message: str
     suppressed: bool = False
     suppress_reason: str = ""
+    # "error" findings fail the run (exit 1); "warning" findings are
+    # printed and reported in the JSON but never fail it — the
+    # binding-contract check uses this for unbound extern "C" exports
+    # (drift worth surfacing, not worth breaking CI over).
+    severity: str = "error"
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
 
     def render(self) -> str:
+        tag = "warning: " if self.severity == "warning" else ""
         return f"{self.path}:{self.line}:{self.col}: [{self.check}] " \
-               f"{self.message}"
+               f"{tag}{self.message}"
 
 
 class Module:
@@ -172,6 +178,7 @@ class Project:
         self.root = os.path.abspath(root)
         self.modules: List[Module] = []
         self.parse_failures: List[Finding] = []
+        self._text_cache: Dict[tuple, Dict[str, str]] = {}
         for rel in (paths if paths is not None
                     else self._discover(self.root)):
             try:
@@ -201,7 +208,13 @@ class Project:
 
     def text_files(self, reldirs: Tuple[str, ...],
                    suffixes: Tuple[str, ...]) -> Dict[str, str]:
-        """{relpath: text} for reference-coverage scans (tests/, docs/)."""
+        """{relpath: text} for reference-coverage scans (tests/, docs/,
+        csrc/). Memoized per (reldirs, suffixes): several cross-language
+        checks scan the same trees, and one walk per run is enough."""
+        key = (reldirs, suffixes)
+        cached = self._text_cache.get(key)
+        if cached is not None:
+            return cached
         out: Dict[str, str] = {}
         for reldir in reldirs:
             base = os.path.join(self.root, reldir)
@@ -218,6 +231,7 @@ class Project:
                                 out[rel.replace(os.sep, "/")] = f.read()
                         except OSError:
                             pass
+        self._text_cache[key] = out
         return out
 
 
@@ -249,6 +263,7 @@ def run_checks(project: Project, checks) -> List[Finding]:
 
 def report_json(findings: List[Finding], checks) -> str:
     active = [f for f in findings if not f.suppressed]
+    errors = [f for f in active if f.severity != "warning"]
     return json.dumps({
         "version": 1,
         "tool": "hvdlint",
@@ -258,7 +273,11 @@ def report_json(findings: List[Finding], checks) -> str:
         "counts": {
             "total": len(findings),
             "active": len(active),
+            "errors": len(errors),
+            "warnings": len(active) - len(errors),
             "suppressed": len(findings) - len(active),
         },
-        "ok": not active,
+        # Warnings never fail the run (see Finding.severity), so ok
+        # tracks active ERRORS only.
+        "ok": not errors,
     }, indent=2, sort_keys=True)

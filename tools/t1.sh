@@ -22,4 +22,30 @@ T1LOG="${T1LOG:-$(mktemp /tmp/_t1.XXXXXX.log)}"
 # of a suite timeout when the tree is badly broken).
 python -m tools.hvdlint || exit 1
 
+# Cross-language pre-flight (docs/static-analysis.md): the ctypes
+# binding contract (common/native.py vs operations.cc's extern "C"
+# surface, arity-checked) and the native knob registry (every HOROVOD_*
+# read in csrc/ must have a config.py accessor + env-vars.md row).
+# Already part of the full run above; repeated here by explicit id so a
+# cross-language drift names itself in the gate's first line.
+python -m tools.hvdlint --check binding-contract,native-knob-discipline \
+  || exit 1
+
+# Compile-time concurrency contracts: clang's -Wthread-safety capability
+# analysis over the annotated native core (csrc/hvd/thread_annotations.h
+# — the GUARDED_BY/REQUIRES/EXCLUDES locking contracts). SKIP — not
+# pass — when no clang is installed (the analysis is clang-only; g++
+# builds compile the annotations away), mirroring the unsound-runtime
+# probe pattern of tests/test_native_tsan.py: a toolchain that cannot
+# run the gate must never report it green. tests/test_native_tsa.py
+# re-runs this gate wherever clang exists and additionally proves it
+# FAILS on the planted violation fixture.
+TSA_CLANGXX="${CLANGXX:-clang++}"
+if command -v "$TSA_CLANGXX" >/dev/null 2>&1; then
+  make -C horovod_tpu/csrc tsa CLANGXX="$TSA_CLANGXX" || exit 1
+else
+  echo "t1: no clang++ on PATH — skipping the -Wthread-safety gate" \
+       "(make -C horovod_tpu/csrc tsa)"
+fi
+
 set -o pipefail; rm -f "$T1LOG"; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee "$T1LOG"; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$T1LOG" | tr -cd . | wc -c); exit $rc

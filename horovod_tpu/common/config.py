@@ -88,6 +88,8 @@ DEFAULT_DRAIN_GRACE_MS = 5000
 HOROVOD_JOB_KEY = "HOROVOD_JOB_KEY"
 HOROVOD_RING_TREE_THRESHOLD = "HOROVOD_RING_TREE_THRESHOLD"
 DEFAULT_RING_TREE_THRESHOLD = 16384  # csrc/hvd/ring_ops.cc TreeThresholdBytes
+HOROVOD_MAX_FRAME_BYTES = "HOROVOD_MAX_FRAME_BYTES"
+DEFAULT_MAX_FRAME_BYTES = 1073741824  # 1 GiB; csrc/hvd/socket.cc MaxFrameBytes
 # Fault injection + retry/backoff + blacklist (common/faults.py;
 # docs/fault-injection.md)
 HOROVOD_FAULT_SPEC = "HOROVOD_FAULT_SPEC"
@@ -547,6 +549,21 @@ def ring_tree_threshold() -> int:
     must agree across ranks."""
     v = _get_int(HOROVOD_RING_TREE_THRESHOLD, DEFAULT_RING_TREE_THRESHOLD)
     return v if v >= 0 else DEFAULT_RING_TREE_THRESHOLD
+
+
+def max_frame_bytes() -> int:
+    """Upper bound, in bytes, on any length-prefixed control/data frame a
+    peer can make this process allocate (default 1 GiB — the historical
+    hard-coded cap). Consumed by the native socket layer
+    (``csrc/hvd/socket.cc`` ``Socket::RecvFrame*``): a frame header
+    announcing more than this is rejected and the connection aborted, so
+    one corrupt or hostile peer byte can never drive a multi-GiB
+    allocation (docs/protocol-models.md, codec-audit section). Clamped to
+    [64 KiB, 1 GiB]; must comfortably exceed the fusion threshold plus
+    framing overhead or legitimate fused responses are rejected as
+    oversized."""
+    v = _get_int(HOROVOD_MAX_FRAME_BYTES, DEFAULT_MAX_FRAME_BYTES)
+    return max(64 * 1024, min(DEFAULT_MAX_FRAME_BYTES, v))
 
 
 def stripes() -> int:

@@ -289,41 +289,73 @@ class NativeResponse:
     stripes: int = -1
 
 
+class FrameRejected(ValueError):
+    """A structurally invalid response frame (truncated, bad magic, or a
+    count/length field outside the wire contract). The mirror of the C++
+    ``DeserializeResponseList`` returning false: the two codecs must
+    accept and reject IDENTICALLY — the differential fuzzer in
+    tests/test_hvdmc.py holds them to it."""
+
+
 class _Cursor:
+    """Bounds-checked little-endian reader — the Python twin of
+    ``hvd::Reader`` (csrc/hvd/message.h). Every read past the end and
+    every out-of-range count raises ``FrameRejected`` instead of
+    ``struct.error``/``IndexError``, and count-driven loops are bounded
+    by the bytes actually present, so a hostile length field can never
+    drive a huge allocation or a multi-million-iteration spin."""
+
     def __init__(self, data: bytes):
         self.d = data
         self.o = 0
 
+    def _take(self, n: int) -> int:
+        o = self.o
+        if o + n > len(self.d):
+            raise FrameRejected(f"truncated frame: {n} bytes needed at "
+                                f"offset {o} of {len(self.d)}")
+        self.o = o + n
+        return o
+
+    def remaining(self) -> int:
+        return len(self.d) - self.o
+
     def u8(self):
-        v = self.d[self.o]
-        self.o += 1
-        return v
+        return self.d[self._take(1)]
 
     def i32(self):
-        v = struct.unpack_from("<i", self.d, self.o)[0]
-        self.o += 4
-        return v
+        return struct.unpack_from("<i", self.d, self._take(4))[0]
 
     def i64(self):
-        v = struct.unpack_from("<q", self.d, self.o)[0]
-        self.o += 8
-        return v
+        return struct.unpack_from("<q", self.d, self._take(8))[0]
 
     def f64(self):
-        v = struct.unpack_from("<d", self.d, self.o)[0]
-        self.o += 8
-        return v
+        return struct.unpack_from("<d", self.d, self._take(8))[0]
 
     def s(self):
         n = self.i32()
-        v = self.d[self.o: self.o + n].decode()
-        self.o += n
-        return v
+        if n < 0 or n > self.remaining():
+            raise FrameRejected(f"bad string length {n} at offset "
+                                f"{self.o}")
+        return self.d[self._take(n): self.o].decode(errors="replace")
+
+    def count(self, limit: int = 1 << 24) -> int:
+        """A count-prefixed list header: mirror of the C++
+        ``n < 0 || n > (1 << 24)`` rejections."""
+        n = self.i32()
+        if n < 0 or n > limit:
+            raise FrameRejected(f"count {n} outside [0, {limit}]")
+        return n
 
 
 def parse_response_list(data: bytes) -> List[NativeResponse]:
+    """Parse one response broadcast frame; raises ``FrameRejected`` on
+    any structurally invalid input — byte-for-byte the same accept/
+    reject verdicts as the C++ ``DeserializeResponseList`` (asserted by
+    the differential codec fuzzer, docs/protocol-models.md)."""
     c = _Cursor(data)
-    assert c.u8() == 0xA2, "bad response magic"
+    if c.u8() != 0xA2:
+        raise FrameRejected("bad response magic")
     # Tuned-parameter piggyback (mirror of SerializeResponseList):
     # cycle/fusion hints ride every response frame and are applied in the
     # C++ worker cycle; the hierarchical-dispatch flags are stamped into
@@ -335,17 +367,21 @@ def parse_response_list(data: bytes) -> List[NativeResponse]:
     hier_flags = c.i32()
     stripes = c.i32()
     out = []
-    for _ in range(c.i32()):
+    for _ in range(c.count()):
         r = NativeResponse(op=c.u8(), reduce_op=c.u8(), dtype=c.u8(),
                            plane=c.u8(), root_rank=c.i32(), error=c.s(),
                            prescale=c.f64(), postscale=c.f64(),
                            hier_flags=hier_flags, stripes=stripes)
-        for _ in range(c.i32()):
+        for _ in range(c.count()):
             r.names.append(c.s())
             ndim = c.i32()
+            if ndim < 0 or ndim >= 256:
+                # Mirror of ReadShape: out-of-range rank rejects the
+                # frame (skipping would misalign every later field).
+                raise FrameRejected(f"shape rank {ndim} outside [0, 256)")
             r.shapes.append(tuple(c.i64() for _ in range(ndim)))
-        for _ in range(c.i32()):
-            nr = c.i32()
+        for _ in range(c.count()):
+            nr = c.count()
             r.first_dims.append(tuple(c.i64() for _ in range(nr)))
         out.append(r)
     return out

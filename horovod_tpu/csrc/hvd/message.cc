@@ -1,5 +1,6 @@
 #include "message.h"
 
+#include <algorithm>
 #include <cstring>
 
 namespace hvd {
@@ -99,10 +100,25 @@ static Request ReadRequest(Reader* r) {
     r->fail();
     return q;
   }
-  q.chip_dims.reserve(nc);
-  for (int32_t i = 0; i < nc; ++i) q.chip_dims.push_back(r->i64());
+  // Allocation bound: a chip-dim count can only cost what the frame
+  // actually carries (8 bytes per entry), and a failed read ends the
+  // loop instead of spinning out the full count on zeros.
+  q.chip_dims.reserve(
+      std::min<size_t>(nc, r->remaining() / 8 + 1));
+  for (int32_t i = 0; i < nc && r->ok(); ++i) {
+    q.chip_dims.push_back(r->i64());
+  }
   return q;
 }
+
+namespace {
+// Minimum serialized sizes (all fixed fields, empty strings/vectors):
+// the reserve() clamp for count-prefixed lists — a 100-byte frame
+// announcing 2^24 requests reserves for the 2 that could actually fit,
+// not 16M * sizeof(Request).
+constexpr size_t kMinRequestWire = 4 + 1 + 1 + 1 + 1 + 4 + 4 + 4 + 8 + 8 + 4;
+constexpr size_t kMinResponseWire = 1 + 1 + 1 + 1 + 4 + 4 + 8 + 8 + 4 + 4;
+}  // namespace
 
 std::string SerializeRequestList(const std::vector<Request>& reqs,
                                  const std::vector<uint32_t>& cached_ids,
@@ -130,7 +146,7 @@ bool DeserializeRequestList(const std::string& bytes,
   int32_t n = r.i32();
   if (n < 0 || n > (1 << 24)) return false;
   reqs->clear();
-  reqs->reserve(n);
+  reqs->reserve(std::min<size_t>(n, r.remaining() / kMinRequestWire + 1));
   for (int i = 0; i < n; ++i) {
     reqs->push_back(ReadRequest(&r));
     if (!r.ok()) return false;  // don't accumulate garbage past a bad frame
@@ -138,8 +154,8 @@ bool DeserializeRequestList(const std::string& bytes,
   int32_t nc = r.i32();
   if (nc < 0 || nc > (1 << 24)) return false;
   cached_ids->clear();
-  cached_ids->reserve(nc);
-  for (int i = 0; i < nc; ++i) {
+  cached_ids->reserve(std::min<size_t>(nc, r.remaining() / 4 + 1));
+  for (int i = 0; i < nc && r.ok(); ++i) {
     cached_ids->push_back(static_cast<uint32_t>(r.i32()));
   }
   return r.ok();
@@ -210,7 +226,7 @@ bool DeserializeResponseList(const std::string& bytes,
   int32_t n = r.i32();
   if (n < 0 || n > (1 << 24)) return false;
   resps->clear();
-  resps->reserve(n);
+  resps->reserve(std::min<size_t>(n, r.remaining() / kMinResponseWire + 1));
   for (int i = 0; i < n; ++i) {
     Response p;
     p.op = static_cast<CollectiveOp>(r.u8());
@@ -223,18 +239,21 @@ bool DeserializeResponseList(const std::string& bytes,
     p.postscale = r.f64();
     int32_t nt = r.i32();
     if (nt < 0 || nt > (1 << 24)) return false;
-    for (int t = 0; t < nt; ++t) {
+    // Failed reads end every count-driven loop immediately: a stomped
+    // count must never spin out millions of iterations accumulating
+    // zero-filled entries the final ok() check then throws away.
+    for (int t = 0; t < nt && r.ok(); ++t) {
       p.tensor_names.push_back(r.str());
       p.shapes.push_back(ReadShape(&r));
     }
     int32_t nf = r.i32();
     if (nf < 0 || nf > (1 << 24)) return false;
-    for (int f = 0; f < nf; ++f) {
+    for (int f = 0; f < nf && r.ok(); ++f) {
       int32_t nr = r.i32();
       if (nr < 0 || nr > (1 << 24)) return false;
       std::vector<int64_t> fd;
-      fd.reserve(nr);
-      for (int k = 0; k < nr; ++k) fd.push_back(r.i64());
+      fd.reserve(std::min<size_t>(nr, r.remaining() / 8 + 1));
+      for (int k = 0; k < nr && r.ok(); ++k) fd.push_back(r.i64());
       p.first_dims.push_back(std::move(fd));
     }
     resps->push_back(std::move(p));

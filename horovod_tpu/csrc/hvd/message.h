@@ -49,6 +49,11 @@ class Reader {
   // the reader misaligned and every later field parsing as garbage.
   void fail() { ok_ = false; }
   uint8_t u8() { return static_cast<uint8_t>(*take(1)); }
+  // Bytes left unconsumed — the deserializers bound every count-driven
+  // reserve()/loop by it, so a hostile count field can cost at most the
+  // frame's own size in allocation, never a count * sizeof(T) product
+  // (docs/protocol-models.md, codec-audit section).
+  size_t remaining() const { return static_cast<size_t>(end_ - p_); }
   int32_t i32() { int32_t v = 0; memcpy_(&v, 4); return v; }
   int64_t i64() { int64_t v = 0; memcpy_(&v, 8); return v; }
   double f64() { double v = 0; memcpy_(&v, 8); return v; }

@@ -14,12 +14,29 @@
 #include <cstring>
 #include <thread>
 
+#include "env_util.h"
+
 namespace hvd {
 
 namespace {
 // Over-read size for the buffered receive path (covers a frame header +
 // a small payload — the controller's cached-id frames — in one recv).
 constexpr size_t kRecvBuf = 4096;
+
+// Upper bound on any length-prefixed frame a peer can make this process
+// allocate (HOROVOD_MAX_FRAME_BYTES, default the historical 1 GiB cap,
+// clamped to [64 KiB, 1 GiB] like config.max_frame_bytes()). A header
+// announcing more is a desynced or hostile stream: reject the frame —
+// never resize() a payload buffer to an attacker-chosen size first.
+uint32_t MaxFrameBytes() {
+  static const uint32_t cap = [] {
+    long long v = EnvLL("HOROVOD_MAX_FRAME_BYTES", 1LL << 30);
+    if (v < (64LL << 10)) v = 64LL << 10;
+    if (v > (1LL << 30)) v = 1LL << 30;
+    return static_cast<uint32_t>(v);
+  }();
+  return cap;
+}
 }  // namespace
 
 Socket& Socket::operator=(Socket&& o) noexcept {
@@ -207,7 +224,7 @@ long Socket::RecvSome(void* p, size_t n, bool nonblock) {
 bool Socket::RecvFrame(std::string* payload) {
   uint32_t len = 0;
   if (!RecvAll(&len, 4)) return false;
-  if (len > (1u << 30)) return false;
+  if (len > MaxFrameBytes()) return false;
   payload->resize(len);
   return len == 0 || RecvAll(&(*payload)[0], len);
 }
@@ -230,7 +247,7 @@ int Socket::RecvFrameTimeout(std::string* payload, int timeout_ms) {
     if (avail >= 4) {
       uint32_t len = 0;
       std::memcpy(&len, rbuf_.data() + rpos_, 4);
-      if (len > (1u << 30)) return -1;
+      if (len > MaxFrameBytes()) return -1;
       if (avail >= 4 + static_cast<size_t>(len)) {
         payload->assign(rbuf_.data() + rpos_ + 4, len);
         rpos_ += 4 + len;

@@ -1,20 +1,38 @@
 // Wire-codec robustness harness (built and run by
-// tests/test_native.py::test_message_codec_robustness).
+// tests/test_native.py::test_message_codec_robustness and the
+// differential fuzz/golden drivers in tests/test_hvdmc.py).
 //
 // Exercises the compact codec the way the reference's FlatBuffers schema
 // is implicitly exercised by its verifier: round-trips, structurally
 // malformed frames (out-of-range counts must REJECT the frame, not skip
 // payload bytes and parse the rest misaligned — the round-3 advisor
-// finding), truncations at every length, and a deterministic mutation
-// fuzz loop. Exits 0 when every property holds.
+// finding), truncations at every length, a deterministic mutation
+// fuzz loop, hostile-length allocation clamps, and the
+// HOROVOD_MAX_FRAME_BYTES socket-layer cap. Exits 0 when every property
+// holds.
+//
+// Modes (docs/protocol-models.md):
+//   (no args)          self-checks, prints MESSAGE_CODEC_OK
+//   --golden           hex-dump one canonical instance of every frame
+//                      family ("GOLDEN <name> <hex>" lines); the driver
+//                      pins them against tests/golden_wire.json so the
+//                      C++/Python wire contract cannot drift silently
+//   --fuzz <corpus>    read length-prefixed frames, print per-frame
+//                      accept/reject verdicts ("V <i> req=<b> resp=<b>")
+//                      for BOTH deserializers — the C++ half of the
+//                      differential codec fuzzer
+
+#include <sys/socket.h>
 
 #include <cassert>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 #include <vector>
 
 #include "../../horovod_tpu/csrc/hvd/message.h"
+#include "../../horovod_tpu/csrc/hvd/socket.h"
 
 using namespace hvd;
 
@@ -57,9 +75,134 @@ int failures = 0;
     }                                                             \
   } while (0)
 
+// ---- golden wire vectors (tests/golden_wire.json) --------------------------
+//
+// ONE canonical instance per frame family, every field pinned to an
+// exactly-representable value. The driver compares the hex against the
+// checked-in JSON: any byte-level change to a serializer — field order,
+// widths, magic, flags — is a red diff on both codecs, by construction.
+
+Request GoldenRequest() {
+  Request q;
+  q.rank = 2;
+  q.op = CollectiveOp::ALLGATHER;
+  q.reduce_op = ReduceOp::SUM;
+  q.dtype = DataType::HVD_FLOAT32;
+  q.plane = DevicePlane::HOST;
+  q.root_rank = -1;
+  q.name = "golden/t0";
+  q.shape = TensorShape({4, 3});
+  q.prescale = 0.5;
+  q.postscale = 2.0;
+  q.chip_dims = {4};
+  return q;
+}
+
+Response GoldenResponse() {
+  Response p;
+  p.op = CollectiveOp::ALLGATHER;
+  p.reduce_op = ReduceOp::SUM;
+  p.dtype = DataType::HVD_FLOAT32;
+  p.plane = DevicePlane::HOST;
+  p.root_rank = -1;
+  p.error_reason = "";
+  p.prescale = 0.5;
+  p.postscale = 2.0;
+  p.tensor_names = {"golden/t0", "golden/t1"};
+  p.shapes = {TensorShape({4, 3}), TensorShape({2})};
+  p.first_dims = {{4, 4}, {2, 2}};
+  return p;
+}
+
+std::string GoldenRequestFrame() {
+  // drain=true exercises the PR 6 flags bitfield; two cache hits ride
+  // along so the cached-ids block is covered.
+  return SerializeRequestList({GoldenRequest()}, {7u, 9u},
+                              /*shutdown=*/false, /*drain=*/true);
+}
+
+std::string GoldenResponseFrame() {
+  // Every piggyback hint pinned: cycle 2.5 ms, fusion 1 MiB,
+  // hier_flags 3, stripes 4.
+  return SerializeResponseList({GoldenResponse()}, 2.5, 1 << 20, 3, 4);
+}
+
+std::string GoldenStripeHdr() {
+  char hdr[kStripeHdrBytes];
+  EncodeStripeHdr(/*seq=*/0x01020304u, /*len=*/0x000A0B0Cu, hdr);
+  return std::string(hdr, sizeof(hdr));
+}
+
+// The hello line is a whitespace-delimited string, not a Writer frame —
+// pinned anyway: controller.cc's sscanf contract is part of the wire.
+const char kGoldenHello[] = "2 10.0.0.7 41000 ab12cd 1";
+
+void PrintHex(const char* name, const std::string& bytes) {
+  std::printf("GOLDEN %s ", name);
+  for (unsigned char c : bytes) std::printf("%02x", c);
+  std::printf("\n");
+}
+
+int GoldenMain() {
+  PrintHex("request", GoldenRequestFrame());
+  PrintHex("response", GoldenResponseFrame());
+  PrintHex("heartbeat", HeartbeatFrame());
+  PrintHex("hello", std::string(kGoldenHello));
+  PrintHex("stripe_hdr", GoldenStripeHdr());
+  return 0;
+}
+
+// ---- differential fuzz verdicts --------------------------------------------
+
+int FuzzMain(const char* corpus_path) {
+  std::FILE* f = std::fopen(corpus_path, "rb");
+  if (f == nullptr) {
+    std::fprintf(stderr, "cannot open corpus %s\n", corpus_path);
+    return 2;
+  }
+  uint32_t count = 0;
+  if (std::fread(&count, 4, 1, f) != 1) {
+    std::fclose(f);
+    return 2;
+  }
+  for (uint32_t i = 0; i < count; ++i) {
+    uint32_t len = 0;
+    if (std::fread(&len, 4, 1, f) != 1 || len > (64u << 20)) {
+      std::fclose(f);
+      return 2;
+    }
+    std::string bytes(len, 0);
+    if (len > 0 && std::fread(&bytes[0], 1, len, f) != len) {
+      std::fclose(f);
+      return 2;
+    }
+    std::vector<Request> reqs;
+    std::vector<uint32_t> ids;
+    bool sd = false, dr = false;
+    bool req_ok = DeserializeRequestList(bytes, &reqs, &ids, &sd, &dr);
+    std::vector<Response> resps;
+    double cyc;
+    int64_t fus;
+    int hf, st;
+    bool resp_ok =
+        DeserializeResponseList(bytes, &resps, &cyc, &fus, &hf, &st);
+    std::printf("V %u req=%d resp=%d\n", i, req_ok ? 1 : 0,
+                resp_ok ? 1 : 0);
+  }
+  std::fclose(f);
+  std::puts("FUZZ_DONE");
+  return 0;
+}
+
 }  // namespace
 
-int main() {
+int main(int argc, char** argv) {
+  if (argc >= 2 && std::strcmp(argv[1], "--golden") == 0) {
+    return GoldenMain();
+  }
+  if (argc >= 3 && std::strcmp(argv[1], "--fuzz") == 0) {
+    return FuzzMain(argv[2]);
+  }
   // 1. Round trip.
   std::string wire = Serialize(3);
   std::vector<Request> reqs;
@@ -357,6 +500,132 @@ int main() {
       dst.replace(roff, rlen, src, roff, rlen);
     }
     CHECK(dst == src, "out-of-order reassembly is byte-exact");
+  }
+
+  // 11. Hostile length fields must not drive allocations: a tiny frame
+  // announcing 2^24 entries is rejected AND the output vectors'
+  // capacity stays bounded by what the frame could actually carry
+  // (docs/protocol-models.md, codec-audit section — the regression
+  // fixtures for the reserve() clamps).
+  {
+    // Response frame: magic + piggyback header + count 2^24, no bodies.
+    Writer w;
+    w.u8(0xA2);
+    w.f64(-1.0);
+    w.i64(-1);
+    w.i32(-1);
+    w.i32(-1);
+    w.i32(1 << 24);
+    std::vector<Response> rs;
+    double cyc; int64_t fus; int hf;
+    CHECK(!DeserializeResponseList(w.data(), &rs, &cyc, &fus, &hf),
+          "hostile response count rejects frame");
+    CHECK(rs.capacity() < 4096, "hostile response count allocation clamped");
+
+    // Request frame: magic + flags + count 2^24.
+    Writer rw;
+    rw.u8(0xA1);
+    rw.u8(0);
+    rw.i32(1 << 24);
+    std::vector<Request> rq;
+    std::vector<uint32_t> ids;
+    bool sd = false;
+    CHECK(!DeserializeRequestList(rw.data(), &rq, &ids, &sd),
+          "hostile request count rejects frame");
+    CHECK(rq.capacity() < 4096, "hostile request count allocation clamped");
+
+    // Cached-ids block: zero requests, id count 2^24.
+    Writer cw;
+    cw.u8(0xA1);
+    cw.u8(0);
+    cw.i32(0);
+    cw.i32(1 << 24);
+    std::vector<Request> cq;
+    std::vector<uint32_t> cids;
+    CHECK(!DeserializeRequestList(cw.data(), &cq, &cids, &sd),
+          "hostile cached-id count rejects frame");
+    CHECK(cids.capacity() < 4096, "hostile cached-id allocation clamped");
+
+    // Inner first-dims count inside an otherwise-valid response: the
+    // per-entry reserve is clamped and the loop stops at the first
+    // failed read instead of spinning out 2^24 iterations.
+    Response p;
+    p.tensor_names = {"x"};
+    p.shapes = {TensorShape({2})};
+    std::string good = SerializeResponseList({p}, -1.0, -1, -1, -1);
+    // first_dims count is the final i32 (p.first_dims is empty).
+    std::string mut = good;
+    int32_t huge = 1 << 24;
+    std::memcpy(&mut[mut.size() - 4], &huge, 4);
+    std::vector<Response> r2;
+    CHECK(!DeserializeResponseList(mut, &r2, &cyc, &fus, &hf),
+          "hostile first-dims count rejects frame");
+  }
+
+  // 12. Socket-layer frame cap (HOROVOD_MAX_FRAME_BYTES): a peer header
+  // announcing more than the registered cap is rejected before any
+  // payload allocation — one corrupt byte can no longer drive a
+  // multi-GiB resize. setenv lands before the first RecvFrame* call in
+  // this process, so the knob's one-shot read sees it.
+  {
+    setenv("HOROVOD_MAX_FRAME_BYTES", "65536", 1);
+    int sv[2];
+    CHECK(::socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0, "socketpair");
+    {
+      Socket a(sv[0]), b(sv[1]);
+      uint32_t over = 100000;  // > knob, < the old hard 1 GiB cap
+      CHECK(::send(sv[0], &over, 4, 0) == 4, "oversize header sent");
+      std::string payload;
+      CHECK(!b.RecvFrame(&payload), "oversize frame rejected by knob");
+    }
+    int sv2[2];
+    CHECK(::socketpair(AF_UNIX, SOCK_STREAM, 0, sv2) == 0, "socketpair2");
+    {
+      Socket a(sv2[0]), b(sv2[1]);
+      uint32_t huge = 0x80000000u;  // 2 GiB: over every cap
+      CHECK(::send(sv2[0], &huge, 4, 0) == 4, "huge header sent");
+      std::string payload;
+      CHECK(b.RecvFrameTimeout(&payload, 50) == -1,
+            "huge frame rejected on the timed path");
+    }
+    int sv3[2];
+    CHECK(::socketpair(AF_UNIX, SOCK_STREAM, 0, sv3) == 0, "socketpair3");
+    {
+      Socket a(sv3[0]), b(sv3[1]);
+      CHECK(a.SendFrame(std::string("hello under the cap")),
+            "normal frame sent");
+      std::string payload;
+      CHECK(b.RecvFrame(&payload) && payload == "hello under the cap",
+            "normal frame still accepted with the knob set");
+    }
+  }
+
+  // 13. Golden vectors round-trip in-binary (byte-exactness against the
+  // checked-in hex is the driver's job — tests/test_hvdmc.py): the
+  // canonical instances must at least survive their own codec.
+  {
+    std::vector<Request> gr;
+    std::vector<uint32_t> gids;
+    bool gsd = false, gdr = false;
+    CHECK(DeserializeRequestList(GoldenRequestFrame(), &gr, &gids, &gsd,
+                                 &gdr),
+          "golden request parses");
+    CHECK(gr.size() == 1 && gr[0].name == "golden/t0" && gdr && !gsd,
+          "golden request content");
+    CHECK(gids == std::vector<uint32_t>({7u, 9u}), "golden cached ids");
+    std::vector<Response> gp;
+    double gcyc; int64_t gfus; int ghf, gst;
+    CHECK(DeserializeResponseList(GoldenResponseFrame(), &gp, &gcyc,
+                                  &gfus, &ghf, &gst),
+          "golden response parses");
+    CHECK(gp.size() == 1 && gp[0].tensor_names.size() == 2 &&
+              gcyc == 2.5 && gfus == (1 << 20) && ghf == 3 && gst == 4,
+          "golden response content");
+    uint32_t gseq = 0, glen = 0;
+    CHECK(DecodeStripeHdr(GoldenStripeHdr().data(), kStripeHdrBytes,
+                          &gseq, &glen) &&
+              gseq == 0x01020304u && glen == 0x000A0B0Cu,
+          "golden stripe header parses");
   }
 
   if (failures) return 1;

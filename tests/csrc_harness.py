@@ -1,0 +1,97 @@
+"""Shared compile-on-demand cache for the C++ test harnesses.
+
+One builder for every test that compiles a tests/csrc/ harness against
+in-tree native sources (the codec robustness checks in test_native.py,
+the differential fuzz/golden drivers in test_hvdmc.py), so the
+content-hash build cache — the fix for the ~60 s ASan compile dominating
+tier-1 — stays in one place and every driver shares one cached binary
+per source digest.
+"""
+
+import hashlib
+import os
+import shutil
+import subprocess
+import tempfile
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TESTS_DIR)
+HVD_DIR = os.path.join(REPO, "horovod_tpu", "csrc", "hvd")
+
+# The message-codec harness and everything its verdicts depend on: any
+# edit to these rebuilds; identical trees reuse the cached binary.
+CODEC_SOURCES = (
+    os.path.join(TESTS_DIR, "csrc", "test_message.cc"),
+    os.path.join(HVD_DIR, "message.cc"),
+    os.path.join(HVD_DIR, "socket.cc"),
+)
+CODEC_HEADERS = (
+    os.path.join(HVD_DIR, "message.h"),
+    os.path.join(HVD_DIR, "socket.h"),
+    os.path.join(HVD_DIR, "common.h"),
+    os.path.join(HVD_DIR, "env_util.h"),
+)
+
+SANITIZER_ENV = {"ASAN_OPTIONS": "detect_leaks=0",
+                 "UBSAN_OPTIONS": "halt_on_error=1 print_stacktrace=1"}
+
+
+def compiler():
+    """The C++ compiler to use, or None (callers skip)."""
+    return shutil.which(os.environ.get("CXX", "g++"))
+
+
+def build_codec_harness(tmp_path, sanitize=True):
+    """Build (or fetch from the content-hash cache) the codec harness.
+
+    Returns ``(binary_path, sanitized)``; ``sanitized`` is False when
+    the toolchain lacks the ASan/UBSan runtimes (the checks still run
+    uninstrumented). Raises ``RuntimeError`` when no compiler exists —
+    callers turn that into a pytest skip.
+    """
+    cxx = compiler()
+    if cxx is None:
+        raise RuntimeError("no C++ compiler on PATH")
+    digest = hashlib.sha256()
+    for path in CODEC_SOURCES + CODEC_HEADERS:
+        with open(path, "rb") as f:
+            digest.update(f.read())
+    digest.update(b"sanitize" if sanitize else b"plain")
+    cache_dir = os.path.join(tempfile.gettempdir(),
+                             f"hvd_codec_cache_{os.getuid()}")
+    os.makedirs(cache_dir, exist_ok=True)
+    cached = os.path.join(cache_dir, f"test_message_{digest.hexdigest()}")
+    binary = os.path.join(str(tmp_path), "test_message")
+    if os.path.exists(cached):
+        shutil.copy2(cached, binary)
+        os.chmod(binary, 0o755)
+        return binary, sanitize and os.path.exists(cached + ".san")
+    base = [cxx, "-O1", "-g", "-std=c++17", "-Wall", *CODEC_SOURCES,
+            "-o", binary]
+    # Prefer the sanitized build; fall back to plain when the sanitizer
+    # runtimes are not installed. Generous compile timeouts: the
+    # ASan+UBSan compile takes minutes on small oversubscribed boxes
+    # when the rest of the suite is running.
+    sanitized = False
+    if sanitize:
+        r = subprocess.run(base + ["-fsanitize=address,undefined"],
+                           capture_output=True, text=True, timeout=600)
+        sanitized = r.returncode == 0
+    if not sanitized:
+        subprocess.run(base, check=True, capture_output=True, timeout=600)
+    staged = f"{cached}.tmp.{os.getpid()}"
+    shutil.copy2(binary, staged)
+    os.replace(staged, cached)  # atomic: concurrent runs can't tear
+    if sanitized:
+        open(cached + ".san", "w").close()
+    return binary, sanitized
+
+
+def sanitizer_report_broken(returncode, report):
+    """True when a nonzero exit looks like the ASan runtime failing to
+    START (shadow-memory layout, restricted personality, ...) rather
+    than the harness failing a check — callers rerun uninstrumented
+    instead of failing a codec that was never exercised."""
+    return (returncode != 0 and "FAIL:" not in report and
+            "ERROR: AddressSanitizer:" not in report and
+            "runtime error:" not in report)

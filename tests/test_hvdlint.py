@@ -718,6 +718,32 @@ def test_cli_json_schema(tmp_path, capsys):
     assert f["path"] == "horovod_tpu/bad.py" and f["line"] == 2
 
 
+def test_cli_gh_format_annotations(tmp_path, capsys):
+    """``--format gh`` prints one severity-tagged GitHub workflow-command
+    annotation per ACTIVE finding (suppressed ones excluded), with the
+    file/line/col payload CI needs to render it inline; the summary goes
+    to stderr so stdout stays pure annotations."""
+    root = make_tree(tmp_path, {"bad.py": """\
+        import os
+        a = os.environ.get("HOROVOD_RANK")
+        b = os.environ.get("HOROVOD_SIZE")  # hvdlint: ignore[env-discipline] -- gh fixture
+        """})
+    assert main(["--format", "gh", root]) == 1
+    out, err = capsys.readouterr()
+    lines = [ln for ln in out.splitlines() if ln]
+    assert len(lines) == 1, out  # the suppressed finding emits nothing
+    assert lines[0].startswith("::error file=horovod_tpu/bad.py,line=2,")
+    assert "title=hvdlint env-discipline" in lines[0]
+    assert "::[env-discipline] " in lines[0]
+    assert "hvdlint: 1 error(s)" in err
+    # Warnings map to ::warning and do not fail the run (exit 0) — same
+    # severity semantics as the default renderer.
+    clean = make_tree(tmp_path / "c", {"ok.py": "x = 1\n"})
+    assert main(["--format", "gh", clean]) == 0
+    out, err = capsys.readouterr()
+    assert out.strip() == ""
+
+
 def test_parse_error_is_reported_not_fatal(tmp_path):
     root = make_tree(tmp_path, {"broken.py": "def f(:\n"})
     hits = findings_of(root, "parse-error")

@@ -1,0 +1,576 @@
+"""Protocol conformance plane (tools/hvdmc + docs/protocol-models.md).
+
+Four proof surfaces:
+
+1. **Exhaustive model exploration** — the negotiation, liveness, and
+   elastic models fully explored at tier-1 scale with zero safety
+   violations, zero deadlocks, zero livelocks; planted mutations
+   (premature fire, EVICT->RECOVER, early drain eviction, strike on
+   drain) MUST be caught, or the checker itself is the bug.
+2. **Trace conformance** — event streams from the REAL implementation
+   (a fake-clock LivenessTracker run; a real 2-rank native chaos world's
+   liveness report; a real world's negotiation ticks) replay cleanly
+   against the model, and the planted EVICT->RECOVER mutation is
+   REJECTED by replaying the same chaos trace.
+3. **Golden wire vectors** — tests/golden_wire.json pins the canonical
+   bytes of every frame family; the C++ serializer must produce them
+   byte-exactly and the Python parser must accept them with the pinned
+   structure.
+4. **Differential codec fuzzing** — structure-aware mutants of the
+   golden frames run through the C++ deserializers (ASan+UBSan when
+   available) AND common.native.parse_response_list; accept/reject
+   verdicts must be identical and neither side may crash or
+   over-allocate.
+"""
+
+import json
+import os
+import struct
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.hvdmc import trace as mtrace  # noqa: E402
+from tools.hvdmc.__main__ import main as hvdmc_main  # noqa: E402
+from tools.hvdmc.mc import explore  # noqa: E402
+from tools.hvdmc.models import (ElasticModel, LivenessModel,  # noqa: E402
+                                NegotiationModel)
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+GOLDEN = os.path.join(TESTS_DIR, "golden_wire.json")
+
+
+def _golden_frames():
+    with open(GOLDEN) as f:
+        return {name: bytes.fromhex(hexstr)
+                for name, hexstr in json.load(f)["frames"].items()}
+
+
+# ---------------------------------------------------------------------------
+# 1. exhaustive exploration
+# ---------------------------------------------------------------------------
+
+
+def test_negotiation_two_rank_exhaustive():
+    """The 2-rank negotiation model (2 tensors x 2 steps — the cache-hit
+    path included) explores EVERY schedule with zero violations."""
+    res = explore(NegotiationModel(ranks=2, tensors=("a", "b"), steps=2))
+    assert res.complete, "exploration must exhaust the graph"
+    assert res.ok, "\n".join(v.render() for v in res.violations)
+    assert res.states > 100 and res.quiescent_states > 0
+
+
+def test_negotiation_two_rank_death_chaos():
+    """Worker death at ANY point (frame in flight included) never wedges
+    the model and never fires an unagreed response."""
+    res = explore(NegotiationModel(ranks=2, tensors=("a", "b"), steps=1,
+                                   deaths=1))
+    assert res.complete and res.ok, \
+        "\n".join(v.render() for v in res.violations)
+
+
+def test_negotiation_premature_fire_is_caught():
+    """Teeth: a coordinator that fires on ANY submission (instead of
+    all-active agreement) must be flagged on BOTH sides — the
+    coordinator's agreement check and the worker executing a tensor it
+    never submitted."""
+    res = explore(NegotiationModel(ranks=2, tensors=("a",), steps=1,
+                                   mutations=("premature_fire",)))
+    assert not res.ok
+    msgs = "\n".join(v.message for v in res.violations)
+    assert "fired without agreement" in msgs
+    assert "never submitted" in msgs
+
+
+def test_liveness_lossy_exhaustive():
+    """Arbitrary beat delay/drop + one death + one drain: eviction stays
+    monotonic, DRAINING is exempt until its deadline, and every schedule
+    reaches quiescence with the dead member evicted."""
+    res = explore(LivenessModel(members=1, lossy=True, deaths=1, drains=1,
+                                timeout=4, horizon=8))
+    assert res.complete and res.ok, \
+        "\n".join(v.render() for v in res.violations)
+
+
+def test_liveness_healthy_profile_never_escalates():
+    """With beats every interval and delivery within one tick (the
+    documented sizing ratio timeout=6x), NO schedule reaches SUSPECT —
+    scheduling jitter alone must never page anyone."""
+    res = explore(LivenessModel(members=1, lossy=False, deaths=0,
+                                drains=0))
+    assert res.complete and res.ok, \
+        "\n".join(v.render() for v in res.violations)
+
+
+def test_liveness_evict_recover_mutation_caught_by_exploration():
+    """Teeth (THE acceptance mutation): allowing EVICT -> RECOVER makes
+    eviction non-monotonic on some schedule; exploration must find it."""
+    res = explore(LivenessModel(members=1, lossy=True, deaths=1,
+                                timeout=4, horizon=8,
+                                mutations=("allow_evict_recover",)))
+    assert not res.ok
+    assert any("eviction is not monotonic" in v.message
+               for v in res.violations)
+
+
+def test_elastic_exhaustive_and_drain_never_strikes():
+    """The retry/drain loop always terminates (completed or aborted) and
+    a commit-marked exit never charges a strike; the strike_on_drain
+    mutation is caught."""
+    res = explore(ElasticModel(slots=2, min_np=1, max_restarts=2))
+    assert res.complete and res.ok, \
+        "\n".join(v.render() for v in res.violations)
+    bad = explore(ElasticModel(slots=2, min_np=1,
+                               mutations=("strike_on_drain",)))
+    assert not bad.ok
+    assert any("never strike" in v.message for v in bad.violations)
+
+
+def test_cli_fast_profile_green():
+    """``python -m tools.hvdmc`` (the tools/t1.sh gate) exits 0 with
+    every model exhaustive and every planted mutation caught."""
+    assert hvdmc_main([]) == 0
+
+
+@pytest.mark.slow
+def test_cli_deep_profile_green():
+    """3-4 rank negotiation worlds and the 2-member liveness machine,
+    fully exhausted (the wide lane for ROADMAP item 3's hierarchical
+    rewrite to extend)."""
+    assert hvdmc_main(["--profile", "deep"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# 2. trace conformance
+# ---------------------------------------------------------------------------
+
+
+def test_tracker_trace_replays_against_machine():
+    """A deterministic fake-clock LivenessTracker run — miss, suspect,
+    recover, re-suspect, evict, plus a bounded drain — replays cleanly
+    against the machine's transition table."""
+    from horovod_tpu.common import liveness as hl
+
+    t = [0.0]
+    tr = hl.LivenessTracker(heartbeat_ms=100, timeout_ms=1000,
+                            drain_grace_ms=500, clock=lambda: t[0])
+    events = []
+    tr.watch("w0")
+    tr.watch("w1")
+    t[0] = 0.3
+    events += tr.check()            # w0,w1 MISS
+    t[0] = 0.6
+    events += tr.check()            # SUSPECT both
+    ev = tr.beat("w0")              # RECOVER w0
+    assert ev is not None
+    events.append(ev)
+    # w1 stays silent -> EVICT at the timeout; w0 drains cleanly.
+    tr.mark_draining("w0")
+    events.append(hl.LivenessEvent(mtrace.DRAIN_BEGIN, "w0", 0.0))
+    t[0] = 1.2
+    events += tr.check()            # EVICT w1 (w0 DRAINING exempt)
+    tr.mark_drained("w0")
+    events.append(hl.LivenessEvent(mtrace.DRAIN_DONE, "w0", 0.0))
+
+    final = mtrace.LivenessMachine().replay(mtrace.tracker_events(events))
+    assert final["w1"] == mtrace.EVICTED
+    assert final["w0"] == mtrace.DRAINED
+    # Zombie-proofing is implementation-side too: the tracker emits no
+    # event for a post-eviction beat, so the trace stays legal.
+    assert tr.beat("w1") is None
+
+
+def test_draining_timeout_trace_is_legal():
+    """A drain whose host died mid-protocol evicts at the deadline —
+    (DRAINING, EVICT) is a legal machine transition."""
+    from horovod_tpu.common import liveness as hl
+
+    t = [0.0]
+    tr = hl.LivenessTracker(heartbeat_ms=100, timeout_ms=1000,
+                            drain_grace_ms=200, clock=lambda: t[0])
+    tr.watch("w0")
+    tr.mark_draining("w0")
+    events = [hl.LivenessEvent(mtrace.DRAIN_BEGIN, "w0", 0.0)]
+    t[0] = 5.0
+    events += tr.check()
+    final = mtrace.LivenessMachine().replay(mtrace.tracker_events(events))
+    assert final["w0"] == mtrace.EVICTED
+
+
+def test_mutated_machine_rejects_tracker_trace():
+    """Teeth: the same tracker trace replayed under the
+    allow_evict_recover mutation is REJECTED — the EVICT event lands in
+    a terminal state that is no longer closed."""
+    events = [("SUSPECT", 1), ("EVICT", 1)]
+    mtrace.LivenessMachine().replay(events)  # sane machine: fine
+    with pytest.raises(mtrace.ConformanceError, match="terminal"):
+        mtrace.LivenessMachine(
+            mutations=("allow_evict_recover",)).replay(events)
+
+
+def test_parse_liveness_report_lines():
+    text = textwrap.dedent("""\
+        SUSPECT rank=1 reason=heartbeat_miss silence_ms=312
+        RECOVER rank=1
+        SUSPECT rank=1 reason=stall silence_ms=99
+        EVICT rank=1 reason=heartbeat_timeout silence_ms=624
+        DRAIN rank=0
+        COORD_TIMEOUT rank=2 silence_ms=4000
+        some unrelated log line
+    """)
+    events = mtrace.parse_liveness_report(text)
+    assert events == [("SUSPECT", 1), ("RECOVER", 1), ("SUSPECT", 1),
+                      ("EVICT", 1), ("DRAIN", 0)]
+    final = mtrace.LivenessMachine().replay(events)
+    assert final == {1: mtrace.EVICTED, 0: mtrace.DRAINED}
+
+
+def test_negotiation_tick_checker():
+    ticks = [(0, 10, "a"), (1, 12, "a"), (1, 14, "b"), (0, 15, "b"),
+             (0, 20, "a"), (1, 21, "a")]  # two rounds of 'a', one of 'b'
+    assert mtrace.check_negotiation_ticks(ticks, 2) == 3
+    with pytest.raises(mtrace.ConformanceError, match="partial"):
+        mtrace.check_negotiation_ticks([(0, 1, "a")], 2)
+    with pytest.raises(mtrace.ConformanceError, match="twice"):
+        mtrace.check_negotiation_ticks([(0, 1, "a"), (0, 2, "a")], 2)
+    with pytest.raises(mtrace.ConformanceError, match="outside"):
+        mtrace.check_negotiation_ticks([(5, 1, "a")], 2)
+
+
+# ---------------------------------------------------------------------------
+# real-world trace capture (2-rank native worlds)
+# ---------------------------------------------------------------------------
+
+_LIVENESS_TRACE_WORKER = textwrap.dedent("""
+    import os, sys, time
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    from horovod_tpu.common import native as hn
+
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    trace_path = sys.argv[3]
+    core = hn.NativeCore()
+    assert core.available
+    if rank == 0:
+        # Coordinator: liveness armed (hb 80 ms, timeout 400 ms).
+        ok = core.init(rank=0, size=2, local_rank=0, local_size=1,
+                       cross_rank=0, cross_size=2,
+                       coordinator_addr="127.0.0.1",
+                       coordinator_port=port, my_host="127.0.0.1",
+                       cycle_time_ms=5.0, fusion_threshold=64 << 20,
+                       cache_capacity=64, stall_warning_sec=60.0,
+                       stall_shutdown_sec=0.0, stall_check_enabled=True,
+                       exec_callback=lambda resp, rid: core.response_done(
+                           rid, False, "host plane only"),
+                       heartbeat_ms=80, liveness_timeout_ms=400)
+        assert ok, "native init failed"
+        a = np.ones(16, np.float32)
+        h = core.enqueue("lt.a", hn.OP_ALLREDUCE, 1, 7, a.shape,
+                         data_ptr=a.ctypes.data,
+                         output_ptr=a.ctypes.data, plane=hn.PLANE_HOST)
+        r, err = core.wait(h)
+        # Rank 1 never submits: the gather escalates SUSPECT -> EVICT
+        # and the world ends — the wait MUST fail, not hang.
+        assert r == -1, (r, err)
+        report = core.liveness_report()
+        assert "SUSPECT rank=1" in report, report
+        assert "EVICT rank=1" in report, report
+        with open(trace_path, "w") as f:
+            f.write(report)
+        core.shutdown()
+    else:
+        # Silent-but-alive worker: no heartbeat thread (hb 0) and a
+        # 2.5 s cycle, so it joins the world then goes quiet with its
+        # socket OPEN — the SUSPECT path, not connection_closed.
+        ok = core.init(rank=1, size=2, local_rank=0, local_size=1,
+                       cross_rank=1, cross_size=2,
+                       coordinator_addr="127.0.0.1",
+                       coordinator_port=port, my_host="127.0.0.1",
+                       cycle_time_ms=2500.0, fusion_threshold=64 << 20,
+                       cache_capacity=64, stall_warning_sec=60.0,
+                       stall_shutdown_sec=0.0, stall_check_enabled=True,
+                       exec_callback=lambda resp, rid: core.response_done(
+                           rid, False, "host plane only"),
+                       heartbeat_ms=0, liveness_timeout_ms=0)
+        assert ok, "native init failed"
+        time.sleep(3.0)
+        core.shutdown()
+    print(f"LTRACE_{rank}_OK")
+""")
+
+
+def test_native_chaos_trace_conforms_and_mutation_rejected(tmp_path):
+    """THE acceptance check: a REAL 2-rank native world with a silent
+    worker produces the coordinator's SUSPECT -> EVICT liveness trace;
+    the trace replays cleanly against the machine, and the planted
+    EVICT->RECOVER mutation is REJECTED by replaying the same trace."""
+    from proc_harness import run_world
+
+    trace_path = tmp_path / "liveness_trace.txt"
+    run_world(tmp_path, _LIVENESS_TRACE_WORKER, "LTRACE", size=2,
+              timeout=120,
+              args_for_rank=lambda rank, port: [port, str(trace_path)])
+    events = mtrace.parse_liveness_report(trace_path.read_text())
+    assert ("SUSPECT", 1) in events and ("EVICT", 1) in events, events
+
+    final = mtrace.LivenessMachine().replay(events)
+    assert final[1] == mtrace.EVICTED
+    with pytest.raises(mtrace.ConformanceError, match="terminal"):
+        mtrace.LivenessMachine(
+            mutations=("allow_evict_recover",)).replay(events)
+
+
+_NEGOTIATION_TRACE_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    from horovod_tpu.common import native as hn
+
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    trace_path = sys.argv[3]
+    core = hn.NativeCore()
+    assert core.available
+    ok = core.init(rank=rank, size=2, local_rank=0, local_size=1,
+                   cross_rank=rank, cross_size=2,
+                   coordinator_addr="127.0.0.1", coordinator_port=port,
+                   my_host="127.0.0.1", cycle_time_ms=1.0,
+                   fusion_threshold=64 << 20, cache_capacity=64,
+                   stall_warning_sec=60.0, stall_shutdown_sec=0.0,
+                   stall_check_enabled=True,
+                   exec_callback=lambda resp, rid: core.response_done(
+                       rid, False, "host plane only"))
+    assert ok, "native init failed"
+    core.set_record_negotiation(True)
+    # Four rounds: nt.x twice (the second is a response-cache hit on
+    # both ranks), nt.y and nt.z once — sequential waits so rounds
+    # cannot overlap.
+    for name in ("nt.x", "nt.y", "nt.x", "nt.z"):
+        a = np.full(8, float(rank + 1), np.float32)
+        h = core.enqueue(name, hn.OP_ALLREDUCE, 1, 7, a.shape,
+                         data_ptr=a.ctypes.data,
+                         output_ptr=a.ctypes.data, plane=hn.PLANE_HOST)
+        r, err = core.wait(h)
+        assert r == 1, err
+        assert np.allclose(a, 3.0), a[:4]
+    if rank == 0:
+        ticks = core.drain_negotiation()
+        assert ticks, "coordinator recorded no negotiation ticks"
+        with open(trace_path, "w") as f:
+            for tick_rank, ns, name in ticks:
+                f.write(f"{tick_rank} {ns} {name}\\n")
+    core.shutdown()
+    print(f"NTRACE_{rank}_OK")
+""")
+
+
+def test_negotiation_trace_from_real_world_conforms(tmp_path):
+    """The coordinator's negotiation ticks from a REAL 2-rank world
+    (cache-hit round included) replay against the agreement rule: every
+    fired group was submitted by both ranks, no leftovers."""
+    from proc_harness import run_world
+
+    trace_path = tmp_path / "negotiation_trace.txt"
+    run_world(tmp_path, _NEGOTIATION_TRACE_WORKER, "NTRACE", size=2,
+              timeout=120,
+              args_for_rank=lambda rank, port: [port, str(trace_path)])
+    ticks = []
+    for line in trace_path.read_text().splitlines():
+        rank_s, ns_s, name = line.split(" ", 2)
+        ticks.append((int(rank_s), int(ns_s), name))
+    # 4 rounds x 2 ranks = 8 submissions -> 4 fired groups.
+    fired = mtrace.check_negotiation_ticks(ticks, world_size=2)
+    assert fired == 4, (fired, ticks)
+
+
+# ---------------------------------------------------------------------------
+# 3. golden wire vectors
+# ---------------------------------------------------------------------------
+
+
+def _codec_binary(tmp_path):
+    import csrc_harness
+
+    if csrc_harness.compiler() is None:
+        pytest.skip("no C++ compiler on PATH")
+    return csrc_harness.build_codec_harness(tmp_path)
+
+
+def test_golden_vectors_pin_cpp_serializers(tmp_path):
+    """The C++ serializers must reproduce tests/golden_wire.json
+    byte-exactly — a red diff here IS a wire-format change."""
+    binary, _ = _codec_binary(tmp_path)
+    r = subprocess.run([binary, "--golden"], capture_output=True,
+                       text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    produced = {}
+    for line in r.stdout.splitlines():
+        if line.startswith("GOLDEN "):
+            _, name, hexstr = line.split(" ", 2)
+            produced[name] = hexstr.strip()
+    with open(GOLDEN) as f:
+        expected = json.load(f)["frames"]
+    assert produced == expected, (
+        "C++ wire bytes drifted from tests/golden_wire.json — if the "
+        "change is deliberate, update the goldens AND the Python "
+        "parser together")
+
+
+def test_golden_response_parses_in_python_with_pinned_structure():
+    from horovod_tpu.common import native as hn
+
+    frames = _golden_frames()
+    rs = hn.parse_response_list(frames["response"])
+    assert len(rs) == 1
+    r = rs[0]
+    assert r.op == hn.OP_ALLGATHER and r.reduce_op == 1
+    assert r.dtype == hn.DTYPE_CODES["float32"] and r.plane == hn.PLANE_HOST
+    assert r.root_rank == -1 and r.error == ""
+    assert r.prescale == 0.5 and r.postscale == 2.0
+    assert r.names == ["golden/t0", "golden/t1"]
+    assert r.shapes == [(4, 3), (2,)]
+    assert r.first_dims == [(4, 4), (2, 2)]
+    assert r.hier_flags == 3 and r.stripes == 4
+    # The other families' pinned bytes stay sanity-checked from Python.
+    assert frames["heartbeat"] == b"\xa3"
+    assert frames["hello"].decode() == "2 10.0.0.7 41000 ab12cd 1"
+    assert frames["stripe_hdr"][:4] == b"HVST"
+    assert frames["request"][0] == 0xA1 and frames["request"][1] == 0x02
+
+
+def test_python_parser_rejects_hostile_frames_fast():
+    """The hostile-length clamp, Python side: a tiny frame announcing
+    2^24 entries (or a huge inner count) is rejected via FrameRejected
+    — no multi-GB allocation, no struct.error/IndexError leak."""
+    from horovod_tpu.common import native as hn
+
+    header = b"\xa2" + struct.pack("<dqii", -1.0, -1, -1, -1)
+    hostile = header + struct.pack("<i", 1 << 24)
+    with pytest.raises(hn.FrameRejected):
+        hn.parse_response_list(hostile)
+    with pytest.raises(hn.FrameRejected):
+        hn.parse_response_list(header + struct.pack("<i", -7))
+    # Valid-but-truncated golden: every prefix rejects cleanly.
+    golden = _golden_frames()["response"]
+    for cut in range(len(golden)):
+        with pytest.raises(hn.FrameRejected):
+            hn.parse_response_list(golden[:cut])
+    # A hostile string length inside an otherwise valid frame.
+    mut = bytearray(golden)
+    name_off = golden.index(b"golden/t0") - 4
+    struct.pack_into("<i", mut, name_off, 1 << 30)
+    with pytest.raises(hn.FrameRejected):
+        hn.parse_response_list(bytes(mut))
+
+
+# ---------------------------------------------------------------------------
+# 4. differential codec fuzzing
+# ---------------------------------------------------------------------------
+
+_INTERESTING_I32 = (-1, 0, 1, 255, 256, 1 << 16, (1 << 24) - 1, 1 << 24,
+                    (1 << 24) + 1, 1 << 30, -(1 << 31), 0x7FFFFFFF)
+
+
+def _mutants(rng, seeds, count):
+    """Structure-aware mutation corpus: byte stomps, 4-byte integer
+    stomps with boundary values, truncations, extensions, and splices —
+    deterministic from the rng seed."""
+    out = []
+    for _ in range(count):
+        base = bytearray(seeds[rng.randrange(len(seeds))])
+        kind = rng.randrange(5)
+        if kind == 0 and base:
+            for _ in range(rng.randrange(1, 4)):
+                base[rng.randrange(len(base))] = rng.randrange(256)
+        elif kind == 1 and len(base) >= 4:
+            off = rng.randrange(len(base) - 3)
+            struct.pack_into("<i", base, off,
+                             rng.choice(_INTERESTING_I32))
+        elif kind == 2:
+            base = base[:rng.randrange(len(base) + 1)]
+        elif kind == 3:
+            base += bytes(rng.randrange(256)
+                          for _ in range(rng.randrange(1, 16)))
+        else:
+            other = seeds[rng.randrange(len(seeds))]
+            cut_a = rng.randrange(len(base) + 1)
+            cut_b = rng.randrange(len(other) + 1)
+            base = base[:cut_a] + other[cut_b:]
+        out.append(bytes(base))
+    return out
+
+
+def _run_differential(tmp_path, iterations):
+    import random
+
+    import csrc_harness
+
+    binary, sanitized = _codec_binary(tmp_path)
+    seeds = list(_golden_frames().values())
+    rng = random.Random(0xC0DEC + iterations)
+    frames = list(seeds) + _mutants(rng, seeds, iterations)
+
+    corpus = os.path.join(str(tmp_path), "corpus.bin")
+    with open(corpus, "wb") as f:
+        f.write(struct.pack("<I", len(frames)))
+        for fr in frames:
+            f.write(struct.pack("<I", len(fr)))
+            f.write(fr)
+
+    env = {**os.environ, **csrc_harness.SANITIZER_ENV}
+    r = subprocess.run([binary, "--fuzz", corpus], capture_output=True,
+                       text=True, timeout=600, env=env)
+    report = r.stdout + r.stderr
+    if sanitized and csrc_harness.sanitizer_report_broken(r.returncode,
+                                                          report):
+        binary, sanitized = csrc_harness.build_codec_harness(
+            tmp_path, sanitize=False)
+        r = subprocess.run([binary, "--fuzz", corpus],
+                           capture_output=True, text=True, timeout=600)
+        report = r.stdout + r.stderr
+    assert r.returncode == 0, report[-4000:]
+    assert "FUZZ_DONE" in r.stdout, report[-4000:]
+    if sanitized:
+        assert "ERROR: AddressSanitizer" not in report, report[-4000:]
+        assert "runtime error:" not in report, report[-4000:]
+
+    cpp_resp = {}
+    for line in r.stdout.splitlines():
+        if line.startswith("V "):
+            _, idx, req, resp = line.split()
+            cpp_resp[int(idx)] = int(resp.split("=")[1])
+    assert len(cpp_resp) == len(frames), "verdict lines missing"
+
+    from horovod_tpu.common import native as hn
+
+    mismatches = []
+    for i, fr in enumerate(frames):
+        try:
+            hn.parse_response_list(fr)
+            py = 1
+        except hn.FrameRejected:
+            py = 0
+        if py != cpp_resp[i]:
+            mismatches.append((i, py, cpp_resp[i], fr[:64].hex()))
+    assert not mismatches, (
+        f"{len(mismatches)} differential verdict mismatch(es) between "
+        f"the C++ and Python response codecs (first 5): {mismatches[:5]}")
+    # The C++ verdicts for the unmutated golden seeds must be accepts
+    # for their own family.
+    assert cpp_resp[seeds.index(_golden_frames()['response'])] == 1
+
+
+def test_codec_differential_fuzz_smoke(tmp_path):
+    """200-mutant tier-1 smoke: C++ and Python verdicts identical,
+    sanitizers clean."""
+    _run_differential(tmp_path, 200)
+
+
+@pytest.mark.slow
+def test_codec_differential_fuzz_deep(tmp_path):
+    """The >=10k-mutant acceptance run (slow lane)."""
+    _run_differential(tmp_path, 12000)

@@ -873,75 +873,37 @@ def test_message_codec_robustness(tmp_path):
     """Builds and runs the C++ wire-codec harness (tests/csrc/
     test_message.cc): round-trips, malformed counts rejecting the whole
     frame (round-3 advisor finding — no misaligned parsing past a bad
-    field), truncations, a deterministic mutation fuzz loop, and the
-    PR 4 cross_rank hello/endpoint-map frame contract.
+    field), truncations, a deterministic mutation fuzz loop, the PR 4
+    cross_rank hello/endpoint-map frame contract, the hostile-length
+    allocation clamps, and the HOROVOD_MAX_FRAME_BYTES socket cap.
 
-    Compiled on demand like common/native.py builds the runtime: skips
-    cleanly when no compiler is present, and runs under ASan+UBSan when
-    the toolchain supports them (a codec fuzz loop without ASan misses
-    the exact out-of-bounds reads it exists to catch)."""
-    import shutil
+    Compiled on demand through the shared content-hash cache
+    (tests/csrc_harness.py — the fuzz/golden drivers in test_hvdmc.py
+    reuse the same binary): skips cleanly when no compiler is present,
+    and runs under ASan+UBSan when the toolchain supports them (a codec
+    fuzz loop without ASan misses the exact out-of-bounds reads it
+    exists to catch)."""
     import subprocess
-    import tempfile
 
-    cxx = shutil.which(os.environ.get("CXX", "g++"))
-    if cxx is None:
+    import csrc_harness
+
+    if csrc_harness.compiler() is None:
         pytest.skip("no C++ compiler on PATH")
-    src = os.path.join(TESTS_DIR, "csrc", "test_message.cc")
-    msg_cc = os.path.join(REPO, "horovod_tpu", "csrc", "hvd", "message.cc")
-    msg_h = os.path.join(REPO, "horovod_tpu", "csrc", "hvd", "message.h")
-    # Content-hash build cache: this ~60 s ASan compile dominated the
-    # test on the tier-1 box while its inputs change maybe once per PR —
-    # identical sources reuse the cached binary, any edit rebuilds.
-    import hashlib
-
-    digest = hashlib.sha256()
-    for path in (src, msg_cc, msg_h):
-        with open(path, "rb") as f:
-            digest.update(f.read())
-    cache_dir = os.path.join(tempfile.gettempdir(),
-                             f"hvd_codec_cache_{os.getuid()}")
-    os.makedirs(cache_dir, exist_ok=True)
-    cached = os.path.join(cache_dir, f"test_message_{digest.hexdigest()}")
-    binary = tmp_path / "test_message"
-    if os.path.exists(cached):
-        shutil.copy2(cached, binary)
-        os.chmod(binary, 0o755)
-        sanitized = os.path.exists(cached + ".san")
-    else:
-        base = [cxx, "-O1", "-g", "-std=c++17", "-Wall", src, msg_cc,
-                "-o", str(binary)]
-        # Prefer the sanitized build; fall back to plain when the
-        # sanitizer runtimes are not installed (the codec checks still
-        # run). Generous compile timeouts: the ASan+UBSan compile takes
-        # minutes on small oversubscribed boxes when the rest of the
-        # suite is running.
-        r = subprocess.run(base + ["-fsanitize=address,undefined"],
-                           capture_output=True, text=True, timeout=600)
-        sanitized = r.returncode == 0
-        if not sanitized:
-            subprocess.run(base, check=True, capture_output=True,
-                           timeout=600)
-        staged = f"{cached}.tmp.{os.getpid()}"
-        shutil.copy2(binary, staged)
-        os.replace(staged, cached)  # atomic: concurrent runs can't tear
-        if sanitized:
-            open(cached + ".san", "w").close()
-    env = {**os.environ, "ASAN_OPTIONS": "detect_leaks=0",
-           "UBSAN_OPTIONS": "halt_on_error=1 print_stacktrace=1"}
-    r = subprocess.run([str(binary)], capture_output=True, text=True,
+    binary, sanitized = csrc_harness.build_codec_harness(tmp_path)
+    env = {**os.environ, **csrc_harness.SANITIZER_ENV}
+    r = subprocess.run([binary], capture_output=True, text=True,
                        timeout=240, env=env)
     report = r.stdout + r.stderr
-    if sanitized and r.returncode != 0 and "FAIL:" not in report and \
-            "ERROR: AddressSanitizer:" not in report and \
-            "runtime error:" not in report:
+    if sanitized and csrc_harness.sanitizer_report_broken(r.returncode,
+                                                          report):
         # The ASan runtime itself failed to start (shadow-memory layout,
         # restricted personality, ...) before the harness ran a single
         # check: rerun the codec checks uninstrumented rather than fail
         # a codec that was never exercised.
         sanitized = False
-        subprocess.run(base, check=True, capture_output=True, timeout=600)
-        r = subprocess.run([str(binary)], capture_output=True, text=True,
+        binary, _ = csrc_harness.build_codec_harness(tmp_path,
+                                                     sanitize=False)
+        r = subprocess.run([binary], capture_output=True, text=True,
                            timeout=240)
         report = r.stdout + r.stderr
     assert r.returncode == 0, report[-4000:]

@@ -1,9 +1,11 @@
 """hvdlint CLI: ``python -m tools.hvdlint [options] [root]``.
 
 Exit codes: 0 clean, 1 findings (or malformed suppressions), 2 usage.
-``--json`` prints the machine-readable report (schema in core.py);
-``--registry`` prints the generated docs/env-vars.md content instead of
-linting.
+``--format json`` (alias ``--json``) prints the machine-readable report
+(schema in core.py); ``--format gh`` prints one severity-tagged GitHub
+workflow-command line per finding (``::error file=F,line=L,...``) so CI
+renders findings as inline annotations; ``--registry`` prints the
+generated docs/env-vars.md content instead of linting.
 """
 
 from __future__ import annotations
@@ -30,7 +32,14 @@ def main(argv: List[str] = None) -> int:
     ap.add_argument("root", nargs="?", default=None,
                     help="tree to scan (default: this repo)")
     ap.add_argument("--json", action="store_true",
-                    help="machine-readable JSON report on stdout")
+                    help="machine-readable JSON report on stdout "
+                    "(alias for --format json)")
+    ap.add_argument("--format", choices=("text", "json", "gh"),
+                    default=None,
+                    help="output mode: text (default), json (the "
+                    "machine-readable report), gh (one GitHub "
+                    "workflow-command annotation per finding, "
+                    "severity-tagged — for CI annotation rendering)")
     ap.add_argument("--check", action="append", default=None,
                     metavar="ID", help="run only this check id "
                     "(repeatable; comma-separated lists accepted, e.g. "
@@ -69,13 +78,26 @@ def main(argv: List[str] = None) -> int:
         sys.stdout.write(render_markdown(project))
         return 0
 
+    fmt = args.format or ("json" if args.json else "text")
     findings = run_checks(project, checks)
     active = [f for f in findings if not f.suppressed]
     errors = [f for f in active if f.severity != "warning"]
     warnings = [f for f in active if f.severity == "warning"]
     suppressed = [f for f in findings if f.suppressed]
-    if args.json:
+    if fmt == "json":
         print(report_json(findings, checks))
+    elif fmt == "gh":
+        # GitHub workflow commands: one annotation per active finding,
+        # severity mapped to the command level. The summary goes to
+        # stderr so stdout stays pure annotations for the log parser.
+        for f in active:
+            level = "warning" if f.severity == "warning" else "error"
+            print(f"::{level} file={f.path},line={f.line},"
+                  f"col={f.col + 1},title=hvdlint {f.check}::"
+                  f"[{f.check}] {f.message}")
+        print(f"hvdlint: {len(errors)} error(s), {len(warnings)} "
+              f"warning(s), {len(suppressed)} suppressed across "
+              f"{len(project.modules)} files", file=sys.stderr)
     else:
         for f in active:
             print(f.render())

@@ -1,0 +1,21 @@
+"""hvdmc — the protocol conformance plane (docs/protocol-models.md).
+
+Executable state-machine models of the three finite-state protocols
+whose bugs manifest as distributed hangs instead of stack traces — the
+controller negotiation cycle (``csrc/hvd/controller.cc``), the liveness
+escalation machine (``common/liveness.py`` + the native twin), and the
+elastic retry/drain loop (``run/elastic/driver.py``) — plus:
+
+- ``mc``      an exhaustive explicit-state interleaving explorer
+              (safety + quiescence-reachability over every admissible
+              schedule, with counterexample schedules);
+- ``models``  the three models, each a pure-Python mirror small enough
+              to exhaust at 2–4 ranks;
+- ``trace``   conformance replay: event streams captured from REAL
+              worlds (liveness reports, negotiation ticks) are replayed
+              against the models, so the implementation cannot drift
+              from its model silently.
+
+Pure stdlib, no deps; ``python -m tools.hvdmc`` runs the fast profile
+as a CI gate (wired into ``tools/t1.sh``).
+"""

@@ -1,0 +1,124 @@
+"""hvdmc CLI: ``python -m tools.hvdmc [--profile fast|deep] [...]``.
+
+The fast profile is the tier-1 gate (tools/t1.sh): exhaustive
+exploration of the 2-rank negotiation model (clean + one-death chaos),
+the 1-member liveness machine (lossy + healthy + one drain), and the
+2-slot elastic retry/drain loop — every reported state graph fully
+explored, zero safety violations, zero deadlocks/livelocks — plus a
+TEETH self-check: each model re-explored under its planted mutation
+(``premature_fire``, ``allow_evict_recover``, ``evict_draining_early``,
+``strike_on_drain``) MUST produce violations; a checker that cannot
+catch a planted protocol bug fails the gate itself.
+
+The deep profile widens to 3-4 rank worlds, 2 tensors x 2 steps, and
+2-member liveness (the ``slow``-marked CI lane).
+
+Exit codes: 0 clean, 1 violations (or a toothless checker), 2 usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Tuple
+
+from .mc import Model, explore
+from .models import ElasticModel, LivenessModel, NegotiationModel
+
+
+def _fast_models() -> List[Model]:
+    # Thresholds scaled down for the CI lane (timeout 4, horizon 8):
+    # the machine shape is identical, only the silence windows shrink —
+    # full exploration in ~2 s instead of ~15.
+    return [
+        NegotiationModel(ranks=2, tensors=("a", "b"), steps=2, deaths=0),
+        NegotiationModel(ranks=2, tensors=("a", "b"), steps=1, deaths=1),
+        LivenessModel(members=1, lossy=True, deaths=1, drains=0,
+                      timeout=4, horizon=8),
+        LivenessModel(members=1, lossy=True, deaths=1, drains=1,
+                      timeout=4, horizon=8),
+        # The healthy profile keeps the documented sizing ratio
+        # (timeout >= 6 beats): at timeout=4 the model itself proves one
+        # in-flight beat plus one tick of jitter reaches the SUSPECT
+        # threshold — the sizing rule in docs/liveness.md, discovered
+        # (not assumed) by this checker.
+        LivenessModel(members=1, lossy=False, deaths=0, drains=0),
+        ElasticModel(slots=2, min_np=1, max_restarts=2),
+    ]
+
+
+def _deep_models() -> List[Model]:
+    return _fast_models() + [
+        NegotiationModel(ranks=3, tensors=("a", "b"), steps=2, deaths=0),
+        NegotiationModel(ranks=3, tensors=("a", "b"), steps=1, deaths=1),
+        NegotiationModel(ranks=4, tensors=("a",), steps=1, deaths=1),
+        LivenessModel(members=2, lossy=True, deaths=1, drains=1,
+                      timeout=4, horizon=7),
+        ElasticModel(slots=3, min_np=2, max_restarts=2),
+    ]
+
+
+def _mutants() -> List[Tuple[str, Model]]:
+    """(expected-to-be-caught bug, mutated model) pairs: the checker's
+    teeth. Every one must yield at least one violation."""
+    return [
+        ("premature response fire",
+         NegotiationModel(ranks=2, tensors=("a",), steps=1,
+                          mutations=("premature_fire",))),
+        ("eviction not monotonic (EVICT -> RECOVER allowed)",
+         LivenessModel(members=1, lossy=True, deaths=1, timeout=4,
+                       horizon=8, mutations=("allow_evict_recover",))),
+        ("drain exemption ignored",
+         LivenessModel(members=1, lossy=True, deaths=1, drains=1,
+                       timeout=4, horizon=8,
+                       mutations=("evict_draining_early",))),
+        ("drained rank charged a strike",
+         ElasticModel(slots=2, min_np=1,
+                      mutations=("strike_on_drain",))),
+    ]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.hvdmc",
+        description="protocol model checker (docs/protocol-models.md)")
+    ap.add_argument("--profile", choices=("fast", "deep"), default="fast")
+    ap.add_argument("--max-states", type=int, default=2_000_000,
+                    help="exploration bound per model (trips => the "
+                    "result is reported incomplete and fails the gate)")
+    ap.add_argument("--skip-teeth", action="store_true",
+                    help="skip the planted-mutation self-check")
+    args = ap.parse_args(argv)
+
+    models = _fast_models() if args.profile == "fast" else _deep_models()
+    rc = 0
+    for model in models:
+        res = explore(model, max_states=args.max_states)
+        print(res.render())
+        if not res.ok:
+            rc = 1
+        if not res.complete:
+            print(f"{model.name}: exploration BOUNDED at "
+                  f"{args.max_states} states — the gate requires the "
+                  f"full graph; raise --max-states or shrink the model")
+            rc = 1
+
+    if not args.skip_teeth:
+        for bug, mutant in _mutants():
+            res = explore(mutant, max_states=args.max_states)
+            if res.ok:
+                print(f"TEETH FAILURE: planted bug '{bug}' was NOT "
+                      f"caught by {mutant.name} — the checker is "
+                      f"toothless")
+                rc = 1
+            else:
+                print(f"teeth: '{bug}' caught "
+                      f"({len(res.violations)} violation(s), e.g. "
+                      f"{res.violations[0].message.splitlines()[0]})")
+
+    print("hvdmc:", "OK" if rc == 0 else "FAILED")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,182 @@
+"""Explicit-state model checker: exhaustive interleaving exploration.
+
+The TLA+-style discipline (model the protocol, exhaust the schedules,
+check the implementation's traces against the model) without the
+toolchain dependency: models are plain Python objects exposing an
+initial state, an enabled-action relation, a safety predicate, and a
+quiescence predicate; the explorer enumerates EVERY reachable state
+over EVERY admissible schedule (message interleavings, delays, drops,
+rank deaths — whatever the model's actions encode) and reports:
+
+- **safety violations** — a reachable state where an invariant fails,
+  with the exact schedule (action-label path) that reaches it;
+- **deadlocks** — a reachable non-quiescent state with no enabled
+  action (a wedged world: the bug class this plane exists to catch);
+- **livelocks** — a reachable state from which NO quiescent state is
+  reachable (the world can keep stepping but can never finish); sound
+  because exploration is exhaustive over the finite model.
+
+States must be hashable values (tuples of tuples); the explorer never
+mutates them. A ``max_states`` bound keeps the fast CI profile cheap —
+when the bound trips the result says so (``complete=False``) and the
+livelock check is skipped (it is only sound over the full graph).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, Hashable, List, Optional, Tuple
+
+State = Hashable
+Action = Tuple[str, State]  # (label, successor)
+
+
+class Model:
+    """Interface the explorer drives. Subclasses define the protocol."""
+
+    name = "model"
+
+    def initial(self) -> State:
+        raise NotImplementedError
+
+    def actions(self, state: State) -> List[Action]:
+        """Every enabled action: (label, successor-state) pairs. The
+        scheduler's nondeterminism IS this list — deliveries, delays,
+        drops, and deaths are all actions."""
+        raise NotImplementedError
+
+    def safety(self, state: State) -> List[str]:
+        """Invariant violations in ``state`` (empty = fine)."""
+        return []
+
+    def is_quiescent(self, state: State) -> bool:
+        """A finished state: the protocol ran to completion (or shut the
+        world down cleanly). Non-quiescent states must have enabled
+        actions, or the model deadlocked."""
+        raise NotImplementedError
+
+
+@dataclass
+class Violation:
+    kind: str          # "safety" | "deadlock" | "livelock"
+    message: str
+    schedule: Tuple[str, ...]  # action labels from the initial state
+
+    def render(self) -> str:
+        sched = " -> ".join(self.schedule) if self.schedule else "(initial)"
+        return f"[{self.kind}] {self.message}\n  schedule: {sched}"
+
+
+@dataclass
+class Result:
+    model: str
+    states: int
+    transitions: int
+    complete: bool               # full graph explored (bound not hit)
+    quiescent_states: int
+    violations: List[Violation] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def render(self) -> str:
+        status = "OK" if self.ok else f"{len(self.violations)} violation(s)"
+        scope = "exhaustive" if self.complete else "BOUNDED (incomplete)"
+        lines = [f"{self.model}: {status} — {self.states} states, "
+                 f"{self.transitions} transitions, "
+                 f"{self.quiescent_states} quiescent ({scope})"]
+        for v in self.violations[:10]:
+            lines.append(v.render())
+        if len(self.violations) > 10:
+            lines.append(f"  ... and {len(self.violations) - 10} more")
+        return "\n".join(lines)
+
+
+def explore(model: Model, max_states: int = 200_000,
+            max_violations: int = 25) -> Result:
+    """BFS over the model's reachable state graph.
+
+    BFS (not DFS) so counterexample schedules are minimal-length — a
+    human reads "deliver(1) -> die(0) -> respond" far better than a
+    200-step depth-first meander to the same state.
+    """
+    init = model.initial()
+    # state -> (predecessor state, action label); init maps to None.
+    parent: Dict[State, Optional[Tuple[State, str]]] = {init: None}
+    succs: Dict[State, List[State]] = {}
+    queue = deque([init])
+    violations: List[Violation] = []
+    transitions = 0
+    complete = True
+    quiescent: List[State] = []
+
+    def schedule_to(state: State) -> Tuple[str, ...]:
+        labels: List[str] = []
+        cur: Optional[State] = state
+        while True:
+            entry = parent[cur]
+            if entry is None:
+                break
+            cur, label = entry
+            labels.append(label)
+        return tuple(reversed(labels))
+
+    while queue:
+        state = queue.popleft()
+        for msg in model.safety(state):
+            if len(violations) < max_violations:
+                violations.append(
+                    Violation("safety", msg, schedule_to(state)))
+        acts = model.actions(state)
+        quiet = model.is_quiescent(state)
+        if quiet:
+            quiescent.append(state)
+        if not acts and not quiet:
+            if len(violations) < max_violations:
+                violations.append(Violation(
+                    "deadlock",
+                    "non-quiescent state with no enabled action "
+                    f"(wedged): {state!r}", schedule_to(state)))
+        nxt: List[State] = []
+        for label, succ in acts:
+            transitions += 1
+            nxt.append(succ)
+            if succ not in parent:
+                if len(parent) >= max_states:
+                    complete = False
+                    continue
+                parent[succ] = (state, label)
+                queue.append(succ)
+        succs[state] = nxt
+
+    if complete:
+        # Livelock: states from which no quiescent state is reachable.
+        # Sound only over the full graph — reverse-reach from every
+        # quiescent state, then any explored state left unmarked can
+        # step forever without finishing.
+        preds: Dict[State, List[State]] = {}
+        for s, ns in succs.items():
+            for n in ns:
+                preds.setdefault(n, []).append(s)
+        can_finish = set(quiescent)
+        stack = list(quiescent)
+        while stack:
+            s = stack.pop()
+            for p in preds.get(s, ()):
+                if p not in can_finish:
+                    can_finish.add(p)
+                    stack.append(p)
+        for s in succs:
+            if s not in can_finish and succs[s]:
+                if len(violations) < max_violations:
+                    violations.append(Violation(
+                        "livelock",
+                        f"no quiescent state reachable from: {s!r}",
+                        schedule_to(s)))
+
+    return Result(model=model.name, states=len(parent),
+                  transitions=transitions, complete=complete,
+                  quiescent_states=len(quiescent),
+                  violations=violations)

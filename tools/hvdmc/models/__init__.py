@@ -1,0 +1,24 @@
+"""Executable protocol models (docs/protocol-models.md).
+
+Each module mirrors ONE implementation protocol at the frame/event
+level, small enough to exhaust every interleaving at 2-4 ranks:
+
+- ``negotiation`` — the controller cycle (csrc/hvd/controller.cc):
+  enqueue -> per-rank ready gather -> response-cache hit/miss ->
+  fused-response fan-out -> execute, plus worker death;
+- ``liveness``    — the heartbeat escalation machine
+  (common/liveness.py + the native twin): HB -> MISS -> SUSPECT ->
+  EVICT, DRAIN exemption, zombie-proof terminal states;
+- ``elastic``     — the retry/drain loop (run/elastic/driver.py):
+  failure/preemption -> classify DRAINED-vs-crash -> strike/quarantine
+  -> shrink/grow -> commit/restore.
+
+Every model accepts ``mutations=(...)`` — named, deliberately-wrong
+transition rules (e.g. ``allow_evict_recover``) used by the CI teeth
+checks: a checker that cannot catch a planted protocol bug is itself
+the red line.
+"""
+
+from .negotiation import NegotiationModel  # noqa: F401
+from .liveness import LivenessModel        # noqa: F401
+from .elastic import ElasticModel          # noqa: F401

@@ -1,0 +1,183 @@
+"""Executable model of the elastic retry/drain loop.
+
+Mirrors ``run/elastic/driver.py`` + ``run/elastic/discovery.py`` at the
+classification level: workers run; a worker may crash
+(``HorovodInternalError`` world failure), finish, or be preempted into
+the drain protocol (begin -> state commit -> farewell exit — or die
+mid-drain, the deadline beating the grace). The driver observes each
+departure and classifies it: a commit-marked exit is DRAINED
+(quarantine, ZERO blacklist strikes); anything else is a crash (one
+strike, blacklist at the strike limit). Survivors hit the retry loop;
+the driver shrinks to the remaining hosts (never below ``min_np``) and
+re-activates from the last commit, bounded by a restart budget.
+
+Safety invariants:
+- **drained never strikes**: a host's strike count equals its crash
+  classifications exactly — a DRAINED classification adds none;
+- **no under-min worlds**: a world never re-activates with fewer than
+  ``min_np`` hosts;
+- **restore monotonic**: the restore counter never exceeds the restart
+  budget.
+
+Liveness: every schedule ends completed or aborted (no wedged driver).
+
+Mutations (teeth checks): ``strike_on_drain`` charges a strike for a
+commit-marked exit — the planted misclassification the checker must
+flag.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Tuple
+
+from ..mc import Action, Model
+
+RUNNING = "RUNNING"
+CRASHED = "CRASHED"          # exited without a commit marker
+DRAIN_BEGIN = "DRAIN_BEGIN"
+DRAIN_COMMIT = "DRAIN_COMMIT"
+EXITED_OK = "EXITED_OK"      # commit-marked farewell exit
+DONE = "DONE"                # finished its share of the job
+GONE = "GONE"                # observed + classified by the driver
+
+
+class HostS(NamedTuple):
+    strikes: int
+    crashes_classified: int
+    drains_classified: int
+    excluded: bool           # blacklisted (strikes) or quarantined (drain)
+
+
+class EWorld(NamedTuple):
+    workers: Tuple[str, ...]      # status per slot (one host per slot)
+    member: Tuple[bool, ...]      # slot staffed in the ACTIVE world
+    hosts: Tuple[HostS, ...]
+    restarts: int
+    world_active: bool
+    completed: bool
+    aborted: bool
+    alerts: Tuple[str, ...]
+
+
+class ElasticModel(Model):
+    def __init__(self, slots: int = 2, min_np: int = 1,
+                 strike_limit: int = 2, max_restarts: int = 2,
+                 mutations: Tuple[str, ...] = ()):
+        self.slots = slots
+        self.min_np = min_np
+        self.strike_limit = strike_limit
+        self.max_restarts = max_restarts
+        self.mutations = tuple(mutations)
+        self.name = (f"elastic(slots={slots}, min_np={min_np}, "
+                     f"restarts={max_restarts}"
+                     + (f", mutations={self.mutations}" if mutations else "")
+                     + ")")
+
+    def initial(self) -> EWorld:
+        return EWorld(workers=(RUNNING,) * self.slots,
+                      member=(True,) * self.slots,
+                      hosts=(HostS(0, 0, 0, False),) * self.slots,
+                      restarts=0, world_active=True, completed=False,
+                      aborted=False, alerts=())
+
+    # -- transition relation --------------------------------------------------
+
+    def actions(self, s: EWorld) -> List[Action]:
+        acts: List[Action] = []
+        if s.completed or s.aborted:
+            return acts
+        for i, st in enumerate(s.workers):
+            if not s.world_active and st in (RUNNING, DONE):
+                # Survivors of a failed world sit in the retry loop;
+                # their own finish/crash choices wait for re-activation.
+                continue
+            if st == RUNNING:
+                acts.append((f"finish({i})", self._set(s, i, DONE)))
+                acts.append((f"crash({i})", self._set(s, i, CRASHED)))
+                acts.append((f"preempt({i})",
+                             self._set(s, i, DRAIN_BEGIN)))
+            elif st == DRAIN_BEGIN:
+                acts.append((f"drain_commit({i})",
+                             self._set(s, i, DRAIN_COMMIT)))
+                # The preemption deadline beats the drain: no commit
+                # marker lands — charged as a crash.
+                acts.append((f"drain_killed({i})",
+                             self._set(s, i, CRASHED)))
+            elif st == DRAIN_COMMIT:
+                acts.append((f"drain_exit({i})",
+                             self._set(s, i, EXITED_OK)))
+        for i, st in enumerate(s.workers):
+            if st in (CRASHED, EXITED_OK):
+                acts.append((f"observe({i})", self._observe(s, i)))
+        if s.world_active and all(
+                st == DONE for i, st in enumerate(s.workers)
+                if s.member[i]):
+            acts.append(("complete", s._replace(completed=True)))
+        if not s.world_active and not any(
+                st in (CRASHED, EXITED_OK) for st in s.workers):
+            acts.append(("restart", self._restart(s)))
+        return acts
+
+    @staticmethod
+    def _set(s: EWorld, i: int, st: str) -> EWorld:
+        workers = s.workers[:i] + (st,) + s.workers[i + 1:]
+        # Any departure aborts the survivors' collectives
+        # (HorovodInternalError) and deactivates the world.
+        active = s.world_active and st not in (CRASHED, DRAIN_BEGIN,
+                                               DRAIN_COMMIT, EXITED_OK)
+        return s._replace(workers=workers, world_active=active)
+
+    def _observe(self, s: EWorld, i: int) -> EWorld:
+        st = s.workers[i]
+        h = s.hosts[i]
+        alerts = s.alerts
+        if st == EXITED_OK:
+            # Commit marker present: classified DRAINED — quarantine
+            # with ZERO strikes.
+            strikes = h.strikes
+            if "strike_on_drain" in self.mutations:
+                strikes += 1
+            h = h._replace(strikes=strikes,
+                           drains_classified=h.drains_classified + 1,
+                           excluded=True)
+        else:
+            strikes = h.strikes + 1
+            h = h._replace(strikes=strikes,
+                           crashes_classified=h.crashes_classified + 1,
+                           excluded=strikes >= self.strike_limit or
+                           h.excluded)
+        return s._replace(
+            workers=s.workers[:i] + (GONE,) + s.workers[i + 1:],
+            member=s.member[:i] + (False,) + s.member[i + 1:],
+            hosts=s.hosts[:i] + (h,) + s.hosts[i + 1:], alerts=alerts)
+
+    def _restart(self, s: EWorld) -> EWorld:
+        # Shrink/grow: re-staff every non-excluded host (a struck-but-
+        # under-limit host returns from cooldown; quarantined/blacklisted
+        # ones never do) and restore everyone from the last commit.
+        live = [i for i, h in enumerate(s.hosts) if not h.excluded]
+        if len(live) < self.min_np or s.restarts >= self.max_restarts:
+            return s._replace(aborted=True)
+        workers = tuple(RUNNING if i in live else st
+                        for i, st in enumerate(s.workers))
+        member = tuple(i in live for i in range(self.slots))
+        return s._replace(workers=workers, member=member,
+                          restarts=s.restarts + 1, world_active=True)
+
+    # -- properties -----------------------------------------------------------
+
+    def safety(self, s: EWorld) -> List[str]:
+        out = list(s.alerts)
+        for i, h in enumerate(s.hosts):
+            if h.strikes != h.crashes_classified:
+                out.append(
+                    f"host {i} has {h.strikes} strikes for "
+                    f"{h.crashes_classified} crashes "
+                    f"({h.drains_classified} drains) — a drained rank "
+                    f"must never strike")
+        if s.restarts > self.max_restarts:
+            out.append(f"restore count {s.restarts} exceeds the budget")
+        return out
+
+    def is_quiescent(self, s: EWorld) -> bool:
+        return s.completed or s.aborted

@@ -1,0 +1,243 @@
+"""Executable model of the liveness escalation machine.
+
+Mirrors ``common/liveness.py``'s ``LivenessTracker`` (and the native
+twin in ``csrc/hvd/controller.cc``) over discrete time: members beat,
+beats travel with scheduler-chosen delay (or get dropped in the lossy
+profile), the tracker escalates silence MISS -> SUSPECT -> EVICT,
+RECOVER rescues a SUSPECT, DRAINING members are exempt until 2x the
+drain grace, and EVICTED/DRAINED are zombie-proof terminal states.
+
+Time unit = one heartbeat interval. Default thresholds mirror the
+sizing rule in docs/liveness.md: MISS at 2 beats of silence, SUSPECT at
+``timeout/2`` = 3, EVICT at ``timeout`` = 6, drain deadline at
+``2 * grace`` = 4.
+
+Profiles:
+- ``lossy=True`` (default): beats may be dropped or delayed without
+  bound — the safety net is that a dead/silent member is EVICTED by the
+  horizon (liveness) while eviction stays monotonic and a
+  drained/draining member is never struck early (safety);
+- ``lossy=False`` (healthy): every alive member beats every tick and
+  every beat is delivered within one tick — the checker proves NO
+  member is ever suspected or evicted (scheduling jitter alone must
+  never page anyone).
+
+Mutations (teeth checks): ``allow_evict_recover`` lets a late beat
+resurrect an EVICTED member — exhaustive exploration must flag the
+eviction-monotonicity violation, and the trace-conformance replay
+(tools/hvdmc/trace.py) must reject any real trace containing an EVICT.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Tuple
+
+from ..mc import Action, Model
+
+ALIVE = "ALIVE"
+SUSPECT = "SUSPECT"
+EVICTED = "EVICTED"
+DRAINING = "DRAINING"
+DRAINED = "DRAINED"
+
+TERMINAL = (EVICTED, DRAINED)
+
+
+class MemberS(NamedTuple):
+    state: str
+    last_seen: int       # tracker-side timestamp of the last beat
+    last_sent: int       # member-side timestamp of the last beat sent
+    drain_deadline: int  # valid while DRAINING
+    process_alive: bool  # ground truth (the tracker can't see it)
+    evicted_ever: bool
+    drained_ever: bool
+
+
+class LWorld(NamedTuple):
+    now: int
+    members: Tuple[MemberS, ...]
+    beats: Tuple[Tuple[int, int], ...]  # in-flight (member, send_time)
+    alerts: Tuple[str, ...] = ()        # invariant breaches at transitions
+
+
+class LivenessModel(Model):
+    def __init__(self, members: int = 1, timeout: int = 6, grace: int = 2,
+                 horizon: int = 12, lossy: bool = True,
+                 drains: int = 0, deaths: int = 1, max_delay: int = 1,
+                 mutations: Tuple[str, ...] = ()):
+        self.m = members
+        self.timeout = timeout
+        self.grace = grace
+        self.horizon = horizon
+        self.lossy = lossy
+        self.drains = drains
+        self.deaths = deaths
+        # Beats older than max_delay ticks can only be dropped, never
+        # delivered — without a delivery bound, a pre-death beat landing
+        # just before the horizon would make "dead => evicted by the
+        # horizon" unprovable (the network may delay, not time-travel).
+        self.max_delay = max_delay
+        self.mutations = tuple(mutations)
+        # Deaths/drains must leave room for the full escalation before
+        # the horizon, or "dead => evicted at quiescence" is unprovable.
+        self.last_event_time = horizon - timeout - max_delay - 1
+        assert self.last_event_time >= 0
+        self.name = (f"liveness(members={members}, "
+                     f"{'lossy' if lossy else 'healthy'}, deaths={deaths}, "
+                     f"drains={drains}"
+                     + (f", mutations={self.mutations}" if mutations else "")
+                     + ")")
+
+    def initial(self) -> LWorld:
+        return LWorld(now=0, members=tuple(
+            MemberS(state=ALIVE, last_seen=0, last_sent=0, drain_deadline=0,
+                    process_alive=True, evicted_ever=False,
+                    drained_ever=False)
+            for _ in range(self.m)), beats=())
+
+    # -- transition relation --------------------------------------------------
+
+    def actions(self, s: LWorld) -> List[Action]:
+        acts: List[Action] = []
+        deaths_used = sum(0 if mm.process_alive else 1 for mm in s.members)
+        drains_used = sum(1 if mm.drained_ever or mm.state == DRAINING
+                          else 0 for mm in s.members)
+
+        for i, mm in enumerate(s.members):
+            beating = (mm.process_alive and
+                       mm.state not in (EVICTED, DRAINED))
+            if beating and mm.last_sent < s.now:
+                acts.append((f"beat({i})", self._beat(s, i)))
+            if (mm.process_alive and
+                    mm.state in (ALIVE, SUSPECT, DRAINING) and
+                    deaths_used < self.deaths and
+                    s.now <= self.last_event_time):
+                acts.append((f"die({i})", self._die(s, i)))
+            if (mm.process_alive and mm.state in (ALIVE, SUSPECT) and
+                    drains_used < self.drains and
+                    s.now <= self.last_event_time):
+                acts.append((f"drain({i})", self._drain(s, i)))
+            if mm.process_alive and mm.state == DRAINING:
+                acts.append((f"drain_done({i})", self._drain_done(s, i)))
+
+        for bi, (i, sent) in enumerate(s.beats):
+            if s.now - sent <= self.max_delay:
+                acts.append((f"deliver_beat({i}@{sent})",
+                             self._deliver(s, bi)))
+            if self.lossy:
+                acts.append((f"drop_beat({i}@{sent})", self._drop(s, bi)))
+
+        if s.now < self.horizon and self._tick_allowed(s):
+            acts.append(("tick", self._tick(s)))
+        return acts
+
+    def _tick_allowed(self, s: LWorld) -> bool:
+        if self.lossy:
+            return True
+        # Healthy profile: beats are mandatory every tick and deliveries
+        # land within one tick — jitter bounded by one interval.
+        for mm in s.members:
+            if (mm.process_alive and mm.state not in (EVICTED, DRAINED)
+                    and mm.last_sent < s.now):
+                return False
+        return all(s.now - sent < 1 for _, sent in s.beats)
+
+    def _beat(self, s: LWorld, i: int) -> LWorld:
+        mm = s.members[i]._replace(last_sent=s.now)
+        return s._replace(
+            members=s.members[:i] + (mm,) + s.members[i + 1:],
+            beats=tuple(sorted(s.beats + ((i, s.now),))))
+
+    def _die(self, s: LWorld, i: int) -> LWorld:
+        mm = s.members[i]._replace(process_alive=False)
+        return s._replace(members=s.members[:i] + (mm,) + s.members[i + 1:])
+
+    def _drain(self, s: LWorld, i: int) -> LWorld:
+        mm = s.members[i]._replace(state=DRAINING,
+                                   drain_deadline=s.now + 2 * self.grace)
+        return s._replace(members=s.members[:i] + (mm,) + s.members[i + 1:])
+
+    def _drain_done(self, s: LWorld, i: int) -> LWorld:
+        mm = s.members[i]._replace(state=DRAINED, drained_ever=True)
+        return s._replace(members=s.members[:i] + (mm,) + s.members[i + 1:])
+
+    def _deliver(self, s: LWorld, bi: int) -> LWorld:
+        i, _sent = s.beats[bi]
+        beats = s.beats[:bi] + s.beats[bi + 1:]
+        mm = s.members[i]
+        if mm.state in TERMINAL and \
+                "allow_evict_recover" not in self.mutations:
+            # Zombie-proof: a late beat never resurrects a terminal slot.
+            return s._replace(beats=beats)
+        st = mm.state
+        if st == SUSPECT or (st == EVICTED and
+                             "allow_evict_recover" in self.mutations):
+            st = ALIVE
+        mm = mm._replace(state=st, last_seen=s.now)
+        return s._replace(
+            members=s.members[:i] + (mm,) + s.members[i + 1:], beats=beats)
+
+    def _drop(self, s: LWorld, bi: int) -> LWorld:
+        return s._replace(beats=s.beats[:bi] + s.beats[bi + 1:])
+
+    def _tick(self, s: LWorld) -> LWorld:
+        """Advance time one interval, then run one escalation pass —
+        the tracker's ``check()`` at its poll cadence."""
+        now = s.now + 1
+        members = []
+        alerts = s.alerts
+        for i, mm in enumerate(s.members):
+            escalates = mm.state in (ALIVE, SUSPECT)
+            if mm.state == DRAINING:
+                if now >= mm.drain_deadline:
+                    # The drain outlived 2x its grace: the host died
+                    # mid-protocol; evict.
+                    mm = mm._replace(state=EVICTED, evicted_ever=True)
+                elif "evict_draining_early" in self.mutations:
+                    # Planted bug: the drain exemption ignored — the
+                    # silence escalation applies to a DRAINING member.
+                    escalates = True
+            if escalates:
+                silence = now - mm.last_seen
+                if silence >= self.timeout:
+                    if mm.state == DRAINING:
+                        alerts = alerts + (
+                            f"DRAINING member {i} evicted at t={now} "
+                            f"before its drain deadline "
+                            f"{mm.drain_deadline} (exemption violated)",)
+                    mm = mm._replace(state=EVICTED, evicted_ever=True)
+                elif silence >= self.timeout // 2 and mm.state == ALIVE:
+                    mm = mm._replace(state=SUSPECT)
+            members.append(mm)
+        return s._replace(now=now, members=tuple(members), alerts=alerts)
+
+    # -- properties -----------------------------------------------------------
+
+    def safety(self, s: LWorld) -> List[str]:
+        out: List[str] = list(s.alerts)
+        for i, mm in enumerate(s.members):
+            if mm.evicted_ever and mm.state != EVICTED:
+                out.append(f"eviction is not monotonic: member {i} left "
+                           f"EVICTED for {mm.state}")
+            if mm.drained_ever and mm.state != DRAINED:
+                out.append(f"member {i} left terminal DRAINED for "
+                           f"{mm.state}")
+            if (mm.state == EVICTED and not mm.evicted_ever):
+                out.append(f"member {i} EVICTED without the flag (model "
+                           f"bug)")
+            if (not self.lossy and mm.process_alive and
+                    mm.state in (SUSPECT, EVICTED)):
+                out.append(f"healthy member {i} escalated to {mm.state} "
+                           f"despite timely beats")
+        return out
+
+    def is_quiescent(self, s: LWorld) -> bool:
+        if s.now < self.horizon or s.beats:
+            return False
+        for mm in s.members:
+            if not mm.process_alive and mm.state != EVICTED:
+                # Liveness: a dead member must be evicted by the horizon.
+                return False
+            if mm.state == DRAINING:
+                return False
+        return True

@@ -1,0 +1,355 @@
+"""Executable model of the controller negotiation cycle.
+
+Mirrors ``csrc/hvd/controller.cc`` at the frame level: rank 0 is the
+coordinator; every worker cycle sends ONE request frame (novel requests
+as names, repeat submissions as response-cache ids) then blocks for the
+response broadcast; the coordinator gathers one frame per live worker,
+fires every tensor group that EVERY active rank has submitted (sorted
+by name — the deterministic fuse order), caches fired tensors in
+broadcast order on all ranks, and any departed rank ends the world
+(reference RunLoopOnce-exits-on-DONE semantics; survivors abort into
+the elastic retry loop, modeled as clean termination here).
+
+Scheduler nondeterminism = the action list: enqueue timing per rank
+(ranks enqueue the same tensors in rotated orders, so submissions split
+across cycles), frame arrival interleavings, empty keep-alive cycles,
+and worker death at any point (with or without a frame in flight).
+
+Safety invariants checked:
+- **agreement**: a response never fires unless every active rank
+  submitted it, and no rank ever executes a tensor it did not submit
+  ("no rank executes a response another rank never agreed to");
+- **cache coherence**: a cache id resolves to the same tensor on the
+  sender and the coordinator (insert order is broadcast order);
+- **execution order**: any two ranks' executed sequences are
+  prefix-consistent (responses apply in broadcast order everywhere).
+
+Liveness: every admissible schedule reaches quiescence — all tensors
+executed everywhere, or the world ended after a death. A model state
+that can wedge is a red CI line.
+
+Out of scope (documented, deliberate): Join/Barrier, shape-mismatch
+error responses, the tuned-parameter piggyback — none change the
+agreement structure this model guards.
+
+Mutations (teeth checks): ``premature_fire`` fires a group as soon as
+ANY rank submitted it — the checker must flag both the coordinator-side
+agreement violation and the worker-side foreign-execute.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Tuple, Union
+
+from ..mc import Action, Model, State
+
+SHUTDOWN = "SHUTDOWN"
+
+
+class RankS(NamedTuple):
+    script: Tuple[str, ...]    # remaining enqueue order
+    outbox: Tuple[str, ...]    # enqueued, not yet sent (sorted)
+    pending: Tuple[str, ...]   # sent, not yet executed (sorted)
+    awaiting: bool             # worker blocked on the response broadcast
+    cache: Tuple[str, ...]     # response-cache insert order
+    executed: Tuple[str, ...]  # execution order (broadcast order)
+    alive: bool
+    ended: bool
+
+
+class Frame(NamedTuple):
+    full: Tuple[str, ...]      # novel requests (names)
+    hits: Tuple[int, ...]      # response-cache ids
+
+
+class World(NamedTuple):
+    ranks: Tuple[RankS, ...]
+    groups: Tuple[Tuple[str, Tuple[int, ...]], ...]  # name -> submitters
+    gathered: Tuple[int, ...]        # workers ingested this cycle
+    inbox: Tuple[Optional[Frame], ...]               # per worker (rank-1)
+    resp: Tuple[Union[Tuple[str, ...], str, None], ...]  # per worker
+    departed: Tuple[int, ...]        # deaths the coordinator noticed
+    world_ended: bool
+    alerts: Tuple[str, ...]          # safety alerts raised by transitions
+
+
+def _sorted(t) -> Tuple:
+    return tuple(sorted(t))
+
+
+class NegotiationModel(Model):
+    def __init__(self, ranks: int = 2, tensors: Tuple[str, ...] = ("a", "b"),
+                 steps: int = 1, deaths: int = 0,
+                 mutations: Tuple[str, ...] = ()):
+        assert ranks >= 2
+        self.n = ranks
+        self.tensors = tuple(tensors)
+        self.steps = steps
+        self.deaths = deaths
+        self.mutations = tuple(mutations)
+        self.name = (f"negotiation(ranks={ranks}, tensors={len(tensors)}, "
+                     f"steps={steps}, deaths={deaths}"
+                     + (f", mutations={self.mutations}" if mutations else "")
+                     + ")")
+
+    # -- state construction ---------------------------------------------------
+
+    def initial(self) -> State:
+        ranks = []
+        for r in range(self.n):
+            # Rotated per-rank enqueue order: rank r starts at tensor r,
+            # so submissions split across cycles in some schedules.
+            rot = self.tensors[r % len(self.tensors):] + \
+                self.tensors[:r % len(self.tensors)]
+            script = rot * self.steps
+            ranks.append(RankS(script=script, outbox=(), pending=(),
+                               awaiting=False, cache=(), executed=(),
+                               alive=True, ended=False))
+        w = self.n - 1
+        return World(ranks=tuple(ranks), groups=(), gathered=(),
+                     inbox=(None,) * w, resp=(None,) * w, departed=(),
+                     world_ended=False, alerts=())
+
+    # -- helpers --------------------------------------------------------------
+
+    @staticmethod
+    def _group_add(groups, name: str, rank: int):
+        out = dict(groups)
+        subs = set(out.get(name, ()))
+        subs.add(rank)
+        out[name] = _sorted(subs)
+        return tuple(sorted(out.items()))
+
+    def _deaths_used(self, s: World) -> int:
+        return sum(0 if r.alive else 1 for r in s.ranks)
+
+    # -- transition relation --------------------------------------------------
+
+    def actions(self, s: World) -> List[Action]:
+        acts: List[Action] = []
+        if s.world_ended:
+            # Only survivors consuming the SHUTDOWN broadcast remain.
+            for w in range(self.n - 1):
+                r = w + 1
+                rk = s.ranks[r]
+                if rk.alive and not rk.ended and s.resp[w] is not None:
+                    acts.append((f"recv_shutdown({r})",
+                                 self._recv(s, r)))
+            return acts
+
+        for r in range(self.n):
+            rk = s.ranks[r]
+            if not rk.alive or rk.ended:
+                continue
+            # enqueue: the app thread hands the next scripted tensor to
+            # the background loop (duplicate names can't be in flight —
+            # the DuplicateTensorNameError contract).
+            if rk.script:
+                t = rk.script[0]
+                if t not in rk.outbox and t not in rk.pending:
+                    acts.append((f"enqueue({r},{t})", self._enqueue(s, r)))
+            if r >= 1:
+                w = r - 1
+                # send: one frame per cycle, empty keep-alive frames
+                # included (an idle worker still unblocks the gather).
+                if not rk.awaiting and s.inbox[w] is None:
+                    acts.append((f"send({r})", self._send(s, r)))
+                # recv: consume the response broadcast.
+                if rk.awaiting and s.resp[w] is not None:
+                    acts.append((f"recv({r})", self._recv(s, r)))
+                # death: the process disappears mid-protocol (possibly
+                # with a frame already on the wire).
+                if self._deaths_used(s) < self.deaths:
+                    acts.append((f"die({r})", self._die(s, r)))
+
+        # coordinator-side deliveries and death notices
+        for w in range(self.n - 1):
+            r = w + 1
+            if r in s.departed:
+                continue
+            if s.inbox[w] is not None and r not in s.gathered:
+                acts.append((f"deliver({r})", self._deliver(s, r)))
+            if (not s.ranks[r].alive and s.inbox[w] is None):
+                acts.append((f"notice_death({r})",
+                             self._notice_death(s, r)))
+
+        # respond: the gather holds one frame from every live worker the
+        # coordinator still believes in.
+        expected = [r for r in range(1, self.n) if r not in s.departed]
+        if all(r in s.gathered for r in expected) and not s.ranks[0].ended:
+            acts.append(("respond", self._respond(s)))
+        return acts
+
+    def _enqueue(self, s: World, r: int) -> World:
+        rk = s.ranks[r]
+        t = rk.script[0]
+        nk = rk._replace(script=rk.script[1:],
+                         outbox=_sorted(rk.outbox + (t,)))
+        return s._replace(ranks=s.ranks[:r] + (nk,) + s.ranks[r + 1:])
+
+    def _send(self, s: World, r: int) -> World:
+        rk = s.ranks[r]
+        full = tuple(t for t in rk.outbox if t not in rk.cache)
+        hits = tuple(rk.cache.index(t) for t in rk.outbox
+                     if t in rk.cache)
+        frame = Frame(full=full, hits=hits)
+        nk = rk._replace(outbox=(),
+                         pending=_sorted(rk.pending + rk.outbox),
+                         awaiting=True)
+        w = r - 1
+        return s._replace(
+            ranks=s.ranks[:r] + (nk,) + s.ranks[r + 1:],
+            inbox=s.inbox[:w] + (frame,) + s.inbox[w + 1:])
+
+    def _die(self, s: World, r: int) -> World:
+        rk = s.ranks[r]._replace(alive=False)
+        return s._replace(ranks=s.ranks[:r] + (rk,) + s.ranks[r + 1:])
+
+    def _notice_death(self, s: World, r: int) -> World:
+        return s._replace(departed=_sorted(s.departed + (r,)))
+
+    def _deliver(self, s: World, r: int) -> World:
+        w = r - 1
+        frame = s.inbox[w]
+        groups = s.groups
+        alerts = s.alerts
+        for t in frame.full:
+            groups = self._group_add(groups, t, r)
+        coord = s.ranks[0]
+        sender = s.ranks[r]
+        for hid in frame.hits:
+            # Cache coherence: the id must resolve to the same tensor on
+            # both ends (insert order is broadcast order on every rank).
+            if hid >= len(coord.cache):
+                alerts = alerts + (
+                    f"cache id {hid} from rank {r} out of range on the "
+                    f"coordinator (len {len(coord.cache)})",)
+                continue
+            name_c = coord.cache[hid]
+            name_s = sender.cache[hid]
+            if name_c != name_s:
+                alerts = alerts + (
+                    f"cache id {hid} resolves to '{name_c}' on the "
+                    f"coordinator but '{name_s}' on rank {r}",)
+            groups = self._group_add(groups, name_c, r)
+        return s._replace(groups=groups, alerts=alerts,
+                          gathered=_sorted(s.gathered + (r,)),
+                          inbox=s.inbox[:w] + (None,) + s.inbox[w + 1:])
+
+    def _respond(self, s: World) -> World:
+        if s.departed:
+            # Any departure ends the whole world (reference semantics):
+            # nothing fires this cycle; survivors get SHUTDOWN.
+            resp = list(s.resp)
+            for w in range(self.n - 1):
+                if (w + 1) not in s.departed:
+                    resp[w] = SHUTDOWN
+            coord = s.ranks[0]._replace(ended=True)
+            return s._replace(ranks=(coord,) + s.ranks[1:],
+                              resp=tuple(resp), world_ended=True,
+                              gathered=())
+
+        # Ingest the coordinator's own outbox (CoordinatorCycle ingests
+        # my_reqs at cycle start; cycle boundaries don't change group
+        # contents).
+        coord = s.ranks[0]
+        groups = s.groups
+        for t in coord.outbox:
+            groups = self._group_add(groups, t, 0)
+        coord = coord._replace(outbox=(),
+                               pending=_sorted(coord.pending +
+                                               s.ranks[0].outbox))
+
+        active = _sorted(set(range(self.n)) - set(s.departed))
+        alerts = s.alerts
+        fired: List[str] = []
+        rest = []
+        for name, subs in groups:
+            ready = set(subs) >= set(active)
+            if "premature_fire" in self.mutations:
+                ready = len(subs) > 0
+            if ready:
+                fired.append(name)
+                if not set(subs) >= set(active):
+                    alerts = alerts + (
+                        f"response for '{name}' fired without agreement: "
+                        f"submitted by {subs}, active {active}",)
+            else:
+                rest.append((name, subs))
+        fired.sort()  # deterministic fuse/broadcast order
+
+        # Cache insert in broadcast order; coordinator executes its own
+        # broadcast immediately (PerformOperation on the cycle thread).
+        cache = coord.cache
+        for t in fired:
+            if t not in cache:
+                cache = cache + (t,)
+        coord, alert = self._execute(coord, tuple(fired))
+        if alert:
+            alerts = alerts + (alert.format(rank=0),)
+        coord = coord._replace(cache=cache)
+
+        resp = tuple(tuple(fired) for _ in range(self.n - 1))
+        return s._replace(ranks=(coord,) + s.ranks[1:],
+                          groups=tuple(sorted(rest)), gathered=(),
+                          resp=resp, alerts=alerts)
+
+    @staticmethod
+    def _execute(rk: RankS, fired: Tuple[str, ...]):
+        """Apply a response on one rank; returns (new rank state, alert)
+        — the alert fires when the rank executes a tensor it never
+        submitted (the agreement safety property, worker side)."""
+        alert = None
+        foreign = [t for t in fired if t not in rk.pending]
+        if foreign:
+            alert = ("rank {rank} executed " + repr(foreign) +
+                     " it never submitted")
+        return rk._replace(
+            executed=rk.executed + fired,
+            pending=tuple(t for t in rk.pending if t not in fired)), alert
+
+    def _recv(self, s: World, r: int) -> World:
+        w = r - 1
+        payload = s.resp[w]
+        rk = s.ranks[r]
+        alerts = s.alerts
+        if payload == SHUTDOWN:
+            rk = rk._replace(awaiting=False, ended=True)
+        else:
+            cache = rk.cache
+            for t in payload:
+                if t not in cache:
+                    cache = cache + (t,)
+            rk, alert = self._execute(rk, payload)
+            if alert:
+                alerts = alerts + (alert.format(rank=r),)
+            rk = rk._replace(awaiting=False, cache=cache)
+        return s._replace(
+            ranks=s.ranks[:r] + (rk,) + s.ranks[r + 1:],
+            resp=s.resp[:w] + (None,) + s.resp[w + 1:], alerts=alerts)
+
+    # -- properties -----------------------------------------------------------
+
+    def safety(self, s: World) -> List[str]:
+        out = list(s.alerts)
+        # Execution order: prefix-consistent across every pair of ranks
+        # (responses apply in broadcast order everywhere).
+        for i in range(self.n):
+            for j in range(i + 1, self.n):
+                a, b = s.ranks[i].executed, s.ranks[j].executed
+                k = min(len(a), len(b))
+                if a[:k] != b[:k]:
+                    out.append(f"execution order diverged between rank "
+                               f"{i} {a} and rank {j} {b}")
+        return out
+
+    def is_quiescent(self, s: World) -> bool:
+        if s.world_ended:
+            return all(rk.ended or not rk.alive for rk in s.ranks)
+        total = len(self.tensors) * self.steps
+        return (all(rk.alive and not rk.script and not rk.outbox and
+                    not rk.pending and len(rk.executed) == total
+                    for rk in s.ranks) and
+                not s.groups and
+                all(f is None for f in s.inbox) and
+                all(p is None for p in s.resp))

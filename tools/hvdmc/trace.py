@@ -1,0 +1,169 @@
+"""Trace conformance: replay real-world event streams against the models.
+
+The models in ``tools/hvdmc/models`` are only worth their CI line if the
+implementation cannot drift from them silently. This module closes the
+loop: event streams captured from REAL worlds — the native controller's
+liveness report (``hvd.liveness_report()`` lines), the Python
+``LivenessTracker``'s event objects, the coordinator's negotiation
+ticks (``NativeCore.drain_negotiation()``) — are replayed, event by
+event, against the model's transition relation. An event the model does
+not allow, or a model state that stops being terminal-closed, rejects
+the trace with the exact position; the planted-mutation CI check
+(``allow_evict_recover``) proves the rejection has teeth.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Hashable, List, Sequence, Tuple
+
+ALIVE = "ALIVE"
+SUSPECT = "SUSPECT"
+EVICTED = "EVICTED"
+DRAINING = "DRAINING"
+DRAINED = "DRAINED"
+
+# Event kinds (union of the native report lines and the Python
+# tracker's LivenessEvent kinds).
+MISS = "MISS"
+SUSPECT_EVENT = "SUSPECT"
+EVICT = "EVICT"
+RECOVER = "RECOVER"
+DRAIN = "DRAIN"              # native: direct clean-departure mark
+DRAIN_BEGIN = "DRAIN_BEGIN"  # python tracker: mark_draining
+DRAIN_DONE = "DRAIN_DONE"    # python tracker: mark_drained
+
+
+class ConformanceError(AssertionError):
+    """A trace event the model forbids (implementation drifted from the
+    model, or the model was mutated and lost an invariant)."""
+
+
+class LivenessMachine:
+    """The liveness state machine as an explicit transition table —
+    the single source both the trace replay and the model mutation
+    tests share. Terminal states (EVICTED, DRAINED) must be CLOSED:
+    replay re-validates closure at every step it lands in one, so a
+    mutation that re-opens a terminal state (``allow_evict_recover``)
+    is caught by any trace that reaches it."""
+
+    TERMINAL = (EVICTED, DRAINED)
+
+    def __init__(self, mutations: Sequence[str] = ()):
+        t: Dict[str, Dict[str, str]] = {
+            ALIVE: {
+                MISS: ALIVE,
+                SUSPECT_EVENT: SUSPECT,
+                # Direct eviction: connection_closed / tracker timeout
+                # with a coarse poll tick — legal from ALIVE.
+                EVICT: EVICTED,
+                DRAIN: DRAINED,
+                DRAIN_BEGIN: DRAINING,
+            },
+            SUSPECT: {
+                RECOVER: ALIVE,
+                EVICT: EVICTED,
+                DRAIN: DRAINED,
+                DRAIN_BEGIN: DRAINING,
+            },
+            DRAINING: {
+                DRAIN_DONE: DRAINED,
+                # The drain outlived 2x its grace (host died
+                # mid-protocol): eviction is legal again.
+                EVICT: EVICTED,
+            },
+            EVICTED: {},
+            DRAINED: {},
+        }
+        if "allow_evict_recover" in mutations:
+            t[EVICTED] = dict(t[EVICTED])
+            t[EVICTED][RECOVER] = ALIVE
+        self.table = t
+
+    def allowed(self, state: str) -> Dict[str, str]:
+        return self.table[state]
+
+    def replay(self, events: Sequence[Tuple[str, Hashable]],
+               initial_state: str = ALIVE) -> Dict[Hashable, str]:
+        """Replay ``(kind, member)`` events; returns the final state per
+        member, or raises ``ConformanceError`` at the first event the
+        machine forbids — or the first terminal state that is not
+        closed (the mutation-catching invariant)."""
+        state: Dict[Hashable, str] = {}
+        for pos, (kind, member) in enumerate(events):
+            st = state.get(member, initial_state)
+            nxt = self.allowed(st).get(kind)
+            if nxt is None:
+                raise ConformanceError(
+                    f"trace event {pos} ({kind} for {member!r}) is not a "
+                    f"legal transition from {st}: the machine allows "
+                    f"{sorted(self.allowed(st)) or 'nothing (terminal)'}")
+            if nxt in self.TERMINAL and self.allowed(nxt):
+                raise ConformanceError(
+                    f"trace event {pos} ({kind} for {member!r}) reaches "
+                    f"terminal state {nxt}, but the machine allows "
+                    f"{sorted(self.allowed(nxt))} out of it — terminal "
+                    f"states must be closed (model mutated?)")
+            state[member] = nxt
+        return state
+
+
+_NATIVE_LINE = re.compile(
+    r"^(SUSPECT|EVICT|RECOVER|DRAIN|COORD_TIMEOUT)\s+rank=(\d+)")
+
+
+def parse_liveness_report(text: str) -> List[Tuple[str, int]]:
+    """Native liveness report lines -> (kind, rank) events, in order.
+
+    ``COORD_TIMEOUT`` is a world-level departure record (the worker
+    bounding its own wait on a dead coordinator), not a member
+    transition — skipped. Unknown lines are skipped too: the report is
+    an append-only human log first."""
+    events: List[Tuple[str, int]] = []
+    for line in text.splitlines():
+        m = _NATIVE_LINE.match(line.strip())
+        if not m or m.group(1) == "COORD_TIMEOUT":
+            continue
+        events.append((m.group(1), int(m.group(2))))
+    return events
+
+
+def tracker_events(events) -> List[Tuple[str, Hashable]]:
+    """``common.liveness.LivenessTracker`` LivenessEvent objects ->
+    (kind, member) pairs for replay."""
+    return [(e.kind, e.member) for e in events]
+
+
+def check_negotiation_ticks(ticks: Sequence[Tuple[int, int, str]],
+                            world_size: int) -> int:
+    """Replay the coordinator's negotiation ticks
+    (``NativeCore.drain_negotiation()``: (rank, mono_ns, tensor)) against
+    the negotiation model's agreement rule: a tensor group fires exactly
+    when EVERY rank has submitted it, and submissions per (rank, tensor)
+    stay balanced — a group left partial at end-of-trace, an over-count,
+    or an out-of-range rank is a divergence. Returns the number of
+    fired groups."""
+    pending: Dict[str, set] = {}
+    fired = 0
+    for pos, (rank, _ns, name) in enumerate(
+            sorted(ticks, key=lambda t: (t[1], t[0]))):
+        if not (0 <= rank < world_size):
+            raise ConformanceError(
+                f"tick {pos}: rank {rank} outside world of {world_size}")
+        subs = pending.setdefault(name, set())
+        if rank in subs:
+            raise ConformanceError(
+                f"tick {pos}: rank {rank} submitted '{name}' twice "
+                f"within one negotiation round (duplicate in-flight "
+                f"submission)")
+        subs.add(rank)
+        if len(subs) == world_size:
+            pending.pop(name)  # the group fires; a new round may start
+            fired += 1
+    leftovers = {name: sorted(subs) for name, subs in pending.items()}
+    if leftovers:
+        raise ConformanceError(
+            f"trace ended with partial negotiation groups (a response "
+            f"fired without full agreement, or submissions were lost): "
+            f"{leftovers}")
+    return fired

@@ -15,12 +15,17 @@
 cd "$(dirname "$0")/.." || exit 1
 T1LOG="${T1LOG:-$(mktemp /tmp/_t1.XXXXXX.log)}"
 
+# Under GitHub Actions, hvdlint findings render as inline annotations
+# (--format gh prints workflow commands); everywhere else, plain text.
+HVDLINT_FMT=()
+[ -n "${GITHUB_ACTIONS:-}" ] && HVDLINT_FMT=(--format gh)
+
 # Fast pre-flight: the hvdlint project-invariant analyzer (env/compat/
 # retry/fault-registry/exception discipline — docs/static-analysis.md;
 # also covered by tests/test_hvdlint.py + tests/test_compat_lint.py
 # inside the pytest run below, but failing here costs seconds instead
 # of a suite timeout when the tree is badly broken).
-python -m tools.hvdlint || exit 1
+python -m tools.hvdlint "${HVDLINT_FMT[@]}" || exit 1
 
 # Cross-language pre-flight (docs/static-analysis.md): the ctypes
 # binding contract (common/native.py vs operations.cc's extern "C"
@@ -28,8 +33,17 @@ python -m tools.hvdlint || exit 1
 # read in csrc/ must have a config.py accessor + env-vars.md row).
 # Already part of the full run above; repeated here by explicit id so a
 # cross-language drift names itself in the gate's first line.
-python -m tools.hvdlint --check binding-contract,native-knob-discipline \
-  || exit 1
+python -m tools.hvdlint "${HVDLINT_FMT[@]}" \
+  --check binding-contract,native-knob-discipline || exit 1
+
+# Protocol conformance pre-flight (docs/protocol-models.md): exhaustive
+# exploration of the 2-rank negotiation, liveness, and elastic models
+# (safety + quiescence over EVERY schedule, ~0.5 s) plus the planted-
+# mutation teeth check — a protocol-model violation or a toothless
+# checker fails the gate before the suite spends a minute booting.
+# Full-depth 3-4 rank worlds run behind the `slow` marker
+# (tests/test_hvdmc.py::test_cli_deep_profile_green).
+python -m tools.hvdmc || exit 1
 
 # Compile-time concurrency contracts: clang's -Wthread-safety capability
 # analysis over the annotated native core (csrc/hvd/thread_annotations.h

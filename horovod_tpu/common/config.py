@@ -74,6 +74,9 @@ HOROVOD_STRAGGLER_PATIENCE = "HOROVOD_STRAGGLER_PATIENCE"
 DEFAULT_METRICS_INTERVAL_MS = 5000
 DEFAULT_STRAGGLER_MS = 100
 DEFAULT_STRAGGLER_PATIENCE = 3
+# Hierarchical control plane: per-host leader negotiation + delta-first
+# wire protocol (csrc/hvd/controller.cc; docs/control-plane.md)
+HOROVOD_HIER_CONTROL = "HOROVOD_HIER_CONTROL"
 # Liveness plane: heartbeats, failure detection, graceful drain
 # (common/liveness.py, csrc/hvd/controller.cc; docs/liveness.md)
 HOROVOD_HEARTBEAT_MS = "HOROVOD_HEARTBEAT_MS"
@@ -492,6 +495,18 @@ def retry_policy_from_env(scope: str = "", pinned=(),
             except ValueError:
                 continue
     return RetryPolicy(**kw)
+
+
+def hier_control_enabled() -> bool:
+    """Whether negotiation runs the hierarchical control plane (default
+    off): per-host leaders aggregate their members' request frames and
+    speak for the group, so the coordinator does O(hosts) socket work
+    per cycle instead of O(ranks), and fully-cached cycles ride compact
+    cache-id delta frames (docs/control-plane.md). Off, the flat TCP
+    star is byte-identical to previous releases. A dispatch knob: must
+    agree across ranks. The native core parses the same variable with
+    its EnvFlag mirror of ``_get_bool``."""
+    return _get_bool(HOROVOD_HIER_CONTROL)
 
 
 def shm_enabled() -> bool:

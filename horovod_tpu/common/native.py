@@ -387,6 +387,84 @@ def parse_response_list(data: bytes) -> List[NativeResponse]:
     return out
 
 
+@dataclass
+class NativeDelta:
+    """One parsed delta control frame (hierarchical control plane,
+    docs/control-plane.md): a fully-cached cycle's submissions as a
+    response-cache-id bitset."""
+    rank: int
+    cached_ids: Tuple[int, ...]
+    shutdown: bool
+    drain: bool
+
+
+@dataclass
+class NativeAggMember:
+    rank: int
+    kind: int  # 0 = request-list body, 1 = delta body
+    body: bytes
+
+
+@dataclass
+class NativeAggregate:
+    """One parsed leader->coordinator aggregate frame: every member's
+    control frame embedded verbatim as a length-prefixed body."""
+    members: List[NativeAggMember]
+    shutdown: bool
+    drain: bool
+
+
+def parse_delta_frame(data: bytes) -> NativeDelta:
+    """Parse one delta control frame; raises ``FrameRejected`` on any
+    structurally invalid input — verdict-identical to the C++
+    ``DeserializeDeltaFrame`` (held to it by the differential fuzzer)."""
+    c = _Cursor(data)
+    if c.u8() != 0xA5:
+        raise FrameRejected("bad delta magic")
+    flags = c.u8()
+    rank = c.i32()
+    base = c.i32()
+    nbits = c.i32()
+    if rank < 0 or base < 0 or nbits < 0 or nbits > (1 << 24):
+        raise FrameRejected(f"delta header out of range: rank {rank}, "
+                            f"base {base}, span {nbits}")
+    nbytes = (nbits + 7) // 8
+    if c.remaining() < nbytes:
+        raise FrameRejected(f"truncated delta bitset: {nbytes} bytes "
+                            f"needed, {c.remaining()} present")
+    bits = c.d[c.o:c.o + nbytes]
+    ids = tuple(base + i for i in range(nbits)
+                if bits[i // 8] & (1 << (i % 8)))
+    return NativeDelta(rank=rank, cached_ids=ids,
+                       shutdown=bool(flags & 1), drain=bool(flags & 2))
+
+
+def parse_aggregate_frame(data: bytes) -> NativeAggregate:
+    """Parse one aggregate control frame; raises ``FrameRejected`` on
+    any structurally invalid input — verdict-identical to the C++
+    ``DeserializeAggregateFrame``."""
+    c = _Cursor(data)
+    if c.u8() != 0xA4:
+        raise FrameRejected("bad aggregate magic")
+    flags = c.u8()
+    members = []
+    # Same clamp family as the C++ side: a host holds at most a few
+    # hundred ranks, 2^16 members in one aggregate is hostile.
+    for _ in range(c.count(limit=1 << 16)):
+        rank = c.i32()
+        kind = c.u8()
+        n = c.i32()
+        if n < 0 or n > c.remaining():
+            raise FrameRejected(f"bad aggregate body length {n}")
+        body = c.d[c._take(n): c.o]
+        if rank < 0 or kind not in (0, 1):
+            raise FrameRejected(f"bad aggregate member: rank {rank}, "
+                                f"kind {kind}")
+        members.append(NativeAggMember(rank=rank, kind=kind, body=body))
+    return NativeAggregate(members=members, shutdown=bool(flags & 1),
+                           drain=bool(flags & 2))
+
+
 # ---- high-level wrapper ----------------------------------------------------
 
 

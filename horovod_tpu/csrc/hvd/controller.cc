@@ -7,6 +7,7 @@
 #include <cstdio>
 #include <cstdlib>
 
+#include "env_util.h"
 #include "message.h"
 #include "metrics.h"
 
@@ -291,8 +292,14 @@ Status TcpController::Initialize() {
     // instead of being adopted into the wrong world. A wall-clock
     // deadline spans the WHOLE loop — rejected/garbage connections retry
     // the slot but cannot extend the wait forever.
-    auto deadline =
-        std::chrono::steady_clock::now() + std::chrono::milliseconds(120000);
+    // HVD_JOIN_TIMEOUT_MS is an internal test/bench seam (like
+    // HVD_STRIPE_TIMEOUT_MS): on an oversubscribed box, hundreds of
+    // worker interpreters can take longer than 120 s just to start
+    // (the 256-rank controller_bench rung serializes ~256 numpy
+    // imports on however many cores exist).
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(
+                        EnvMs("HVD_JOIN_TIMEOUT_MS", 120000));
     for (int i = 0; i < cfg_.size - 1; ++i) {
       auto remaining = std::chrono::duration_cast<std::chrono::milliseconds>(
           deadline - std::chrono::steady_clock::now());
@@ -363,8 +370,9 @@ Status TcpController::Initialize() {
       }
     }
   } else {
-    coord_sock_ = Socket::Connect(cfg_.coordinator_addr,
-                                  cfg_.coordinator_port, 120000);
+    coord_sock_ = Socket::Connect(
+        cfg_.coordinator_addr, cfg_.coordinator_port,
+        static_cast<int>(EnvMs("HVD_JOIN_TIMEOUT_MS", 120000)));
     if (!coord_sock_.valid()) {
       return Status::Error(StatusType::UNKNOWN_ERROR,
                            "worker failed to reach coordinator at " +
@@ -406,6 +414,33 @@ Status TcpController::Initialize() {
     if (liveness_on_) StartHeartbeat();
   }
   return Status::OK();
+}
+
+// ---- hierarchical control plane (docs/control-plane.md) --------------------
+
+void TcpController::EnableHierControl(CtrlChannel ch) {
+  ctrl_ = std::move(ch);
+  // Same grouping as Ring::SetTopology: host groups keyed by
+  // cross_rank, leader = each group's lowest rank. Ranks whose hello
+  // omitted the cross field sit on the sentinel groups (size + r) and
+  // become single-member leaders — the protocol degrades to flat shape
+  // (every rank speaks to the coordinator) instead of misgrouping.
+  std::map<int, std::vector<int>> by_host;
+  for (int r = 0; r < cfg_.size; ++r) by_host[cross_ranks_[r]].push_back(r);
+  leader_of_.assign(cfg_.size, -1);
+  leader_rank_.assign(cfg_.size, false);
+  my_members_.clear();
+  for (auto& kv : by_host) {
+    int lead = kv.second.front();
+    leader_rank_[lead] = true;
+    for (int r : kv.second) leader_of_[r] = lead;
+    if (lead == cfg_.rank) {
+      for (int r : kv.second) {
+        if (r != cfg_.rank) my_members_.push_back(r);
+      }
+    }
+  }
+  hier_on_ = true;
 }
 
 // ---- liveness plane (docs/liveness.md) -------------------------------------
@@ -479,16 +514,29 @@ void TcpController::EvictRank(int rank, const char* reason,
 }
 
 void TcpController::GatherWithLiveness(
-    const std::function<void(int, const std::string&)>& ingest) {
-  // Liveness-mode gather: one request frame per live worker, but the
+    const std::function<void(int, const std::string&)>& ingest,
+    const std::vector<bool>* expect_frame) {
+  // Liveness-mode gather: one request frame per awaited worker, but the
   // wait is a poll over ALL pending sockets with per-rank eviction
   // deadlines — a dead rank cannot park the coordinator on its socket
   // while the others' deadlines rot (the serial blocking gather would).
   // Heartbeat frames refresh last_seen and are skipped; a closed
-  // connection is an immediate crash-departure.
+  // connection is an immediate crash-departure. In hier mode only the
+  // per-host leaders are awaited (O(H) request frames per cycle), but
+  // every live worker stays polled: member heartbeats ride their direct
+  // coordinator sockets, so the SUSPECT/EVICT machine keeps covering
+  // the whole world, leaders and members alike.
   std::vector<int> pending;
+  std::vector<bool> awaiting(cfg_.size, false);
+  int nawait = 0;
   for (int r = 1; r < cfg_.size; ++r) {
-    if (!shutdown_ranks_[r]) pending.push_back(r);
+    if (!shutdown_ranks_[r]) {
+      pending.push_back(r);
+      if (expect_frame == nullptr || (*expect_frame)[r]) {
+        awaiting[r] = true;
+        ++nawait;
+      }
+    }
   }
   const double timeout_ms = static_cast<double>(cfg_.liveness_timeout_ms);
   // First pass polls with a zero timeout: frames (heartbeats included)
@@ -497,7 +545,7 @@ void TcpController::GatherWithLiveness(
   // refresh last_seen_ BEFORE any deadline is judged, or a merely-busy
   // coordinator would evict every healthy worker off stale timestamps.
   bool drained_once = false;
-  while (!pending.empty()) {
+  while (nawait > 0) {
     double min_wait_ms = timeout_ms;
     if (drained_once) {
       auto now = std::chrono::steady_clock::now();
@@ -509,6 +557,10 @@ void TcpController::GatherWithLiveness(
         double silence = MsSince(last_seen_[r], now);
         if (silence >= timeout_ms) {
           EvictRank(r, "heartbeat_timeout", silence);
+          if (awaiting[r]) {
+            awaiting[r] = false;
+            --nawait;
+          }
           it = pending.erase(it);
           continue;
         }
@@ -518,7 +570,7 @@ void TcpController::GatherWithLiveness(
         min_wait_ms = std::min(min_wait_ms, timeout_ms - silence);
         ++it;
       }
-      if (pending.empty()) break;
+      if (nawait <= 0) break;
     }
     std::vector<struct pollfd> pfds;
     pfds.reserve(pending.size());
@@ -565,6 +617,10 @@ void TcpController::GatherWithLiveness(
             double silence =
                 MsSince(last_seen_[r], std::chrono::steady_clock::now());
             EvictRank(r, "connection_closed", silence);
+            if (awaiting[r]) {
+              awaiting[r] = false;
+              --nawait;
+            }
             pending.erase(std::find(pending.begin(), pending.end(), r));
             break;
           }
@@ -576,6 +632,10 @@ void TcpController::GatherWithLiveness(
           }
           if (IsHeartbeatFrame(bytes)) continue;
           ingest(r, bytes);
+          if (awaiting[r]) {
+            awaiting[r] = false;
+            --nawait;
+          }
           pending.erase(std::find(pending.begin(), pending.end(), r));
           break;
         }
@@ -614,18 +674,24 @@ void TcpController::CacheResponses(const std::vector<Response>& resps) {
 std::vector<Response> TcpController::ComputeResponseList(
     std::vector<Request> reqs, bool this_rank_shutdown,
     bool this_rank_drain, bool* world_shutdown) {
-  return cfg_.rank == 0
-             ? CoordinatorCycle(std::move(reqs), this_rank_shutdown,
-                                this_rank_drain, world_shutdown)
-             : WorkerCycle(std::move(reqs), this_rank_shutdown,
-                           this_rank_drain, world_shutdown);
+  if (cfg_.rank == 0) {
+    return CoordinatorCycle(std::move(reqs), this_rank_shutdown,
+                            this_rank_drain, world_shutdown);
+  }
+  if (hier_on_) {
+    return leader_rank_[cfg_.rank]
+               ? LeaderCycle(std::move(reqs), this_rank_shutdown,
+                             this_rank_drain, world_shutdown)
+               : MemberCycle(std::move(reqs), this_rank_shutdown,
+                             this_rank_drain, world_shutdown);
+  }
+  return WorkerCycle(std::move(reqs), this_rank_shutdown, this_rank_drain,
+                     world_shutdown);
 }
 
-std::vector<Response> TcpController::WorkerCycle(std::vector<Request> reqs,
-                                                 bool my_shutdown,
-                                                 bool my_drain,
-                                                 bool* world_shutdown) {
-  *world_shutdown = false;
+std::string TcpController::BuildRequestFrame(std::vector<Request> reqs,
+                                             bool my_shutdown,
+                                             bool my_drain) {
   // Split cache hits from novel requests.
   std::vector<Request> novel;
   std::vector<uint32_t> hits;
@@ -639,26 +705,25 @@ std::vector<Response> TcpController::WorkerCycle(std::vector<Request> reqs,
   }
   cache_hits_.fetch_add(static_cast<int64_t>(hits.size()),
                         std::memory_order_relaxed);
-  bool sent;
-  {
-    // Serialized against the heartbeat thread's frames (liveness mode);
-    // uncontended (and the heartbeat thread absent) otherwise.
-    MutexLock slk(send_mu_);
-    sent = coord_sock_.SendFrame(
-        SerializeRequestList(novel, hits, my_shutdown, my_drain));
+  // Delta-first (hier mode): a cycle with no novel requests — the
+  // steady-state training loop, all hits (or idle) — ships the compact
+  // cache-id bitset frame instead of repeating names. The flat protocol
+  // keeps the request-list frame everywhere so a pre-delta coordinator
+  // never sees a magic it cannot parse.
+  if (hier_on_ && novel.empty()) {
+    return SerializeDeltaFrame(cfg_.rank, hits, my_shutdown, my_drain);
   }
-  if (!sent) {
-    *world_shutdown = true;
-    return {};
-  }
-  std::string bytes;
+  return SerializeRequestList(novel, hits, my_shutdown, my_drain);
+}
+
+bool TcpController::RecvFromCoordinator(std::string* bytes) {
   if (liveness_on_) {
     // Liveness mode: a coordinator that went silent for 2x the liveness
     // timeout is dead or partitioned — surface it as a world failure the
     // elastic retry loop can recover, instead of blocking forever. 2x:
     // the coordinator legitimately pauses up to one timeout while it
     // waits out a dying peer's eviction deadline.
-    int rc = coord_sock_.RecvFrameTimeout(&bytes,
+    int rc = coord_sock_.RecvFrameTimeout(bytes,
                                           2 * cfg_.liveness_timeout_ms);
     if (rc <= 0) {
       if (rc == 0) {
@@ -667,10 +732,32 @@ std::vector<Response> TcpController::WorkerCycle(std::vector<Request> reqs,
             " silence_ms=" +
             std::to_string(2LL * cfg_.liveness_timeout_ms));
       }
-      *world_shutdown = true;
-      return {};
+      return false;
     }
-  } else if (!coord_sock_.RecvFrame(&bytes)) {
+    return true;
+  }
+  return coord_sock_.RecvFrame(bytes);
+}
+
+std::vector<Response> TcpController::WorkerCycle(std::vector<Request> reqs,
+                                                 bool my_shutdown,
+                                                 bool my_drain,
+                                                 bool* world_shutdown) {
+  *world_shutdown = false;
+  bool sent;
+  {
+    // Serialized against the heartbeat thread's frames (liveness mode);
+    // uncontended (and the heartbeat thread absent) otherwise.
+    MutexLock slk(send_mu_);
+    sent = coord_sock_.SendFrame(
+        BuildRequestFrame(std::move(reqs), my_shutdown, my_drain));
+  }
+  if (!sent) {
+    *world_shutdown = true;
+    return {};
+  }
+  std::string bytes;
+  if (!RecvFromCoordinator(&bytes)) {
     *world_shutdown = true;
     return {};
   }
@@ -678,6 +765,11 @@ std::vector<Response> TcpController::WorkerCycle(std::vector<Request> reqs,
     *world_shutdown = true;
     return {};
   }
+  return ApplyResponseBytes(bytes, world_shutdown);
+}
+
+std::vector<Response> TcpController::ApplyResponseBytes(
+    const std::string& bytes, bool* world_shutdown) {
   std::vector<Response> resps;
   double synced_cycle = -1.0;
   int64_t synced_fusion = -1;
@@ -709,6 +801,107 @@ std::vector<Response> TcpController::WorkerCycle(std::vector<Request> reqs,
   }
   CacheResponses(resps);
   return resps;
+}
+
+std::vector<Response> TcpController::MemberCycle(std::vector<Request> reqs,
+                                                 bool my_shutdown,
+                                                 bool my_drain,
+                                                 bool* world_shutdown) {
+  *world_shutdown = false;
+  // One ctrl frame to my leader (delta-first), one response frame back.
+  // No send_mu_: heartbeats ride the direct coordinator TCP socket, the
+  // ctrl channel belongs to this cycle thread alone.
+  int leader = leader_of_[cfg_.rank];
+  if (!ctrl_.send(leader,
+                  BuildRequestFrame(std::move(reqs), my_shutdown,
+                                    my_drain))) {
+    *world_shutdown = true;
+    return {};
+  }
+  std::string bytes;
+  if (!ctrl_.recv(leader, &bytes)) {
+    // Dead leader: the ctrl transport fails (PeerLink close on process
+    // death; shm waits are liveness-bounded) — surface a world failure
+    // for the elastic retry loop, mirroring a dead coordinator socket.
+    RecordLivenessEvent("LEADER_LOST rank=" + std::to_string(cfg_.rank) +
+                        " leader=" + std::to_string(leader));
+    *world_shutdown = true;
+    return {};
+  }
+  if (bytes == "SHUTDOWN") {
+    *world_shutdown = true;
+    return {};
+  }
+  return ApplyResponseBytes(bytes, world_shutdown);
+}
+
+std::vector<Response> TcpController::LeaderCycle(std::vector<Request> reqs,
+                                                 bool my_shutdown,
+                                                 bool my_drain,
+                                                 bool* world_shutdown) {
+  *world_shutdown = false;
+  auto agg_start = std::chrono::steady_clock::now();
+  // My own entry first (lowest rank of the group), then each member's
+  // ctrl frame embedded VERBATIM — the coordinator re-parses each body
+  // with its own codec, so aggregation adds framing, never semantics.
+  std::vector<AggMember> agg;
+  agg.reserve(1 + my_members_.size());
+  AggMember me;
+  me.rank = cfg_.rank;
+  me.body = BuildRequestFrame(std::move(reqs), my_shutdown, my_drain);
+  me.kind = IsDeltaFrame(me.body) ? 1 : 0;
+  agg.push_back(std::move(me));
+  for (int m : my_members_) {
+    std::string frame;
+    if (!ctrl_.recv(m, &frame) || frame.empty()) {
+      // A dead member wedges its whole host: end this rank's world and
+      // let the coordinator's liveness machine evict the silent ranks.
+      RecordLivenessEvent("MEMBER_LOST rank=" + std::to_string(cfg_.rank) +
+                          " member=" + std::to_string(m));
+      *world_shutdown = true;
+      return {};
+    }
+    AggMember am;
+    am.rank = m;
+    am.kind = IsDeltaFrame(frame) ? 1 : 0;
+    am.body = std::move(frame);
+    agg.push_back(std::move(am));
+  }
+  std::string frame = SerializeAggregateFrame(agg, my_shutdown, my_drain);
+  metrics::Record(metrics::kLeaderAggUs,
+                  std::chrono::duration_cast<std::chrono::microseconds>(
+                      std::chrono::steady_clock::now() - agg_start)
+                      .count());
+  bool sent;
+  {
+    MutexLock slk(send_mu_);
+    sent = coord_sock_.SendFrame(frame);
+  }
+  if (!sent) {
+    *world_shutdown = true;
+    return {};
+  }
+  std::string bytes;
+  if (!RecvFromCoordinator(&bytes)) {
+    *world_shutdown = true;
+    return {};
+  }
+  // Relay the response bytes VERBATIM (SHUTDOWN included) before
+  // applying them locally: members decode the exact frame the
+  // coordinator built, so hier and flat worlds execute byte-identical
+  // response lists. A failed relay send is the member's problem to
+  // surface (its next ctrl recv fails); the survivors must not wedge.
+  auto fan_start = std::chrono::steady_clock::now();
+  for (int m : my_members_) ctrl_.send(m, bytes);
+  metrics::Record(metrics::kFanoutUs,
+                  std::chrono::duration_cast<std::chrono::microseconds>(
+                      std::chrono::steady_clock::now() - fan_start)
+                      .count());
+  if (bytes == "SHUTDOWN") {
+    *world_shutdown = true;
+    return {};
+  }
+  return ApplyResponseBytes(bytes, world_shutdown);
 }
 
 std::vector<Response> TcpController::CoordinatorCycle(
@@ -755,38 +948,108 @@ std::vector<Response> TcpController::CoordinatorCycle(
   };
 
   auto gather_start = std::chrono::steady_clock::now();
-  ingest(std::move(my_reqs), {}, 0);
 
-  // One request frame from every live worker. The DRAIN flag marks a
+  // One control body (request-list or delta frame) attributed to rank r
+  // — the unit a TCP frame carries directly (flat mode) or an aggregate
+  // frame embeds per member (hier mode). The DRAIN flag marks a
   // graceful farewell (clean preemption exit): the rank departs exactly
   // like a shutdown, but the event stream lets the driver charge zero
   // blacklist strikes for it.
+  auto ingest_body = [&](int r, const std::string& bytes) {
+    std::vector<Request> rs;
+    std::vector<uint32_t> ids;
+    bool sd = false, dr = false;
+    bool ok;
+    if (IsDeltaFrame(bytes)) {
+      // The sender identity comes from the socket/aggregate slot `r`,
+      // not the frame's embedded rank field — the coordinator never
+      // lets a frame impersonate another rank's submissions.
+      int frame_rank = -1;
+      ok = DeserializeDeltaFrame(bytes, &frame_rank, &ids, &sd, &dr);
+    } else {
+      ok = DeserializeRequestList(bytes, &rs, &ids, &sd, &dr);
+    }
+    if (!ok) return;
+    if (dr) {
+      shutdown_ranks_[r] = true;
+      peer_state_[r] = kDrained;
+      RecordLivenessEvent("DRAIN rank=" + std::to_string(r));
+    } else if (sd) {
+      shutdown_ranks_[r] = true;
+    }
+    ingest(std::move(rs), std::move(ids), r);
+  };
+
+  // One TCP frame from every awaited worker (hier mode: from every
+  // leader, each carrying its whole host group).
   auto ingest_frame = [&](int r, const std::string& bytes) {
-    // Per-rank gather wait: how long this cycle's gather waited for
-    // rank r's frame — the coordinator-scaling signal controller_bench
-    // reports percentiles of (ROADMAP item 3).
-    (void)r;
+    // Per-frame gather wait: how long this cycle's gather waited for
+    // this frame — the coordinator-scaling signal controller_bench
+    // reports percentiles of (ROADMAP item 3). Recorded once per TCP
+    // frame, so count/cycles measures the coordinator's per-cycle frame
+    // fan-in: O(size) flat, O(hosts) hier (asserted in tests).
     metrics::Record(
         metrics::kGatherWaitUs,
         std::chrono::duration_cast<std::chrono::microseconds>(
             std::chrono::steady_clock::now() - gather_start)
             .count());
-    std::vector<Request> rs;
-    std::vector<uint32_t> ids;
-    bool sd = false, dr = false;
-    if (DeserializeRequestList(bytes, &rs, &ids, &sd, &dr)) {
-      if (dr) {
-        shutdown_ranks_[r] = true;
-        peer_state_[r] = kDrained;
-        RecordLivenessEvent("DRAIN rank=" + std::to_string(r));
-      } else if (sd) {
-        shutdown_ranks_[r] = true;
+    if (IsAggregateFrame(bytes)) {
+      std::vector<AggMember> members;
+      bool agg_sd = false, agg_dr = false;
+      if (!DeserializeAggregateFrame(bytes, &members, &agg_sd, &agg_dr)) {
+        return;
       }
-      ingest(std::move(rs), std::move(ids), r);
+      for (auto& m : members) {
+        // Leaders vouch only for their own host group: a body naming a
+        // rank outside the sender's group is dropped, so a buggy leader
+        // cannot submit on a foreign rank's behalf.
+        if (m.rank < 0 || m.rank >= cfg_.size) continue;
+        if (hier_on_ && leader_of_[m.rank] != r) continue;
+        ingest_body(m.rank, m.body);
+      }
+      return;
     }
+    ingest_body(r, bytes);
   };
+
+  // Hier mode: this coordinator is also host 0's leader — drain my own
+  // members' ctrl frames first (they are local and arrive at memory
+  // speed; the TCP gather below then waits only on the other leaders).
+  if (hier_on_ && !my_members_.empty()) {
+    for (int m : my_members_) {
+      if (shutdown_ranks_[m]) continue;
+      std::string frame;
+      if (!ctrl_.recv(m, &frame)) {
+        // Dead member: the ctrl transport fails (PeerLink close on
+        // process death; shm waits are liveness-bounded). Evict so the
+        // departure is recorded and the world winds down this cycle.
+        EvictRank(m, "ctrl_channel_closed",
+                  MsSince(last_seen_[m], std::chrono::steady_clock::now()));
+        continue;
+      }
+      ingest_body(m, frame);
+    }
+    metrics::Record(metrics::kLeaderAggUs,
+                    std::chrono::duration_cast<std::chrono::microseconds>(
+                        std::chrono::steady_clock::now() - gather_start)
+                        .count());
+  }
+  ingest(std::move(my_reqs), {}, 0);
+
   if (liveness_on_) {
-    GatherWithLiveness(ingest_frame);
+    GatherWithLiveness(ingest_frame, hier_on_ ? &leader_rank_ : nullptr);
+  } else if (hier_on_) {
+    // Blocking serial gather over the leaders only — the O(H) frame
+    // fan-in the hier protocol exists for.
+    for (int r = 1; r < cfg_.size; ++r) {
+      if (!leader_rank_[r] || shutdown_ranks_[r]) continue;
+      std::string bytes;
+      if (!worker_socks_[r - 1].RecvFrame(&bytes)) {
+        shutdown_ranks_[r] = true;  // treat a dead socket as departed
+        continue;
+      }
+      ingest_frame(r, bytes);
+    }
   } else {
     for (int r = 1; r < cfg_.size; ++r) {
       if (shutdown_ranks_[r]) continue;
@@ -946,9 +1209,19 @@ std::vector<Response> TcpController::CoordinatorCycle(
     any_down = any_down || shutdown_ranks_[r];
   }
   if (any_down || stall_shutdown) {
+    // Hier mode: SHUTDOWN rides the same two-level fan-out as every
+    // response — leaders relay it verbatim to their members; this
+    // coordinator delivers host 0's members over ctrl directly (except
+    // evicted ones, whose ctrl transport may be dead).
     for (int r = 1; r < cfg_.size; ++r) {
+      if (hier_on_ && !leader_rank_[r]) continue;
       if (worker_socks_[r - 1].valid()) {
         worker_socks_[r - 1].SendFrame("SHUTDOWN");
+      }
+    }
+    if (hier_on_) {
+      for (int m : my_members_) {
+        if (peer_state_[m] != kEvicted) ctrl_.send(m, "SHUTDOWN");
       }
     }
     *world_shutdown = true;
@@ -961,9 +1234,20 @@ std::vector<Response> TcpController::CoordinatorCycle(
                                             fusion_threshold(), hier,
                                             stripes);
   for (int r = 1; r < cfg_.size; ++r) {
+    if (hier_on_ && !leader_rank_[r]) continue;
     if (!shutdown_ranks_[r] && worker_socks_[r - 1].valid()) {
       worker_socks_[r - 1].SendFrame(bytes);
     }
+  }
+  if (hier_on_ && !my_members_.empty()) {
+    auto fan_start = std::chrono::steady_clock::now();
+    for (int m : my_members_) {
+      if (!shutdown_ranks_[m]) ctrl_.send(m, bytes);
+    }
+    metrics::Record(metrics::kFanoutUs,
+                    std::chrono::duration_cast<std::chrono::microseconds>(
+                        std::chrono::steady_clock::now() - fan_start)
+                        .count());
   }
   // The coordinator applies the flags at the same frame boundary it
   // broadcast them (workers apply on receive), so no rank ever executes

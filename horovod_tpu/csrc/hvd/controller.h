@@ -294,6 +294,26 @@ class TcpController : public Controller {
   // Liveness peer states (coordinator-side; docs/liveness.md).
   enum PeerState { kAlive = 0, kSuspect = 1, kEvicted = 2, kDrained = 3 };
 
+  // Hierarchical control plane (docs/control-plane.md). The channel
+  // carries the intra-host member<->leader hops (in this runtime:
+  // Ring::CtrlSendFrame/CtrlRecvFrame over the LOCAL_CTRL registry
+  // leg). EnableHierControl derives the per-host leader topology from
+  // the exchanged cross_ranks table (leader = lowest rank of each host
+  // group — the same derivation Ring::SetTopology uses, so control and
+  // data planes always agree) and switches every subsequent cycle to
+  // the two-level protocol: members speak to their leader, leaders
+  // aggregate and speak to the coordinator, the coordinator does O(H)
+  // socket work per cycle and fans responses back through leaders.
+  // Must be called after Initialize (the table) and before the
+  // background loop starts (the fields are unguarded, like
+  // data_endpoints_: written once pre-thread, read-only after).
+  struct CtrlChannel {
+    std::function<bool(int peer, const std::string&)> send;
+    std::function<bool(int peer, std::string*)> recv;
+  };
+  void EnableHierControl(CtrlChannel ch);
+  bool hier_control() const { return hier_on_; }
+
  private:
   std::vector<Response> CoordinatorCycle(std::vector<Request> my_reqs,
                                          bool my_shutdown, bool my_drain,
@@ -301,6 +321,31 @@ class TcpController : public Controller {
   std::vector<Response> WorkerCycle(std::vector<Request> my_reqs,
                                     bool my_shutdown, bool my_drain,
                                     bool* world_shutdown);
+  // Hier-mode worker cycles (docs/control-plane.md): a member speaks
+  // only to its leader over the ctrl channel; a non-coordinator leader
+  // gathers its members, sends one aggregate TCP frame, and relays the
+  // response bytes VERBATIM back (so hier and flat worlds execute
+  // byte-identical response frames).
+  std::vector<Response> MemberCycle(std::vector<Request> my_reqs,
+                                    bool my_shutdown, bool my_drain,
+                                    bool* world_shutdown);
+  std::vector<Response> LeaderCycle(std::vector<Request> my_reqs,
+                                    bool my_shutdown, bool my_drain,
+                                    bool* world_shutdown);
+  // Split this rank's requests into novel ones and response-cache hits
+  // (counting the hits), then build the wire frame: delta-first — a
+  // cycle with no novel requests ships the compact cache-id bitset
+  // frame instead of names.
+  std::string BuildRequestFrame(std::vector<Request> reqs, bool my_shutdown,
+                                bool my_drain);
+  // Worker-side response application shared by the flat and hier paths:
+  // deserialize, adopt synced parameters, cache, return responses.
+  std::vector<Response> ApplyResponseBytes(const std::string& bytes,
+                                           bool* world_shutdown);
+  // Receive one coordinator frame on coord_sock_ with the liveness
+  // timeout discipline (COORD_TIMEOUT surfacing) shared by the flat
+  // worker and hier leader paths.
+  bool RecvFromCoordinator(std::string* bytes);
   void CacheResponses(const std::vector<Response>& resps);
   // Liveness helpers (all coordinator-side except the heartbeat pair).
   void StartHeartbeat() EXCLUDES(hb_mu_);
@@ -308,8 +353,14 @@ class TcpController : public Controller {
   // Gather one request frame per live worker, skipping heartbeat frames
   // and escalating silence to eviction (liveness mode only). Ingests via
   // `ingest(rank, bytes)`.
+  // `expect_frame` (hier mode) restricts which ranks' request frames
+  // the gather WAITS for (the per-host leaders); every live worker is
+  // still polled so member heartbeats keep refreshing last_seen_ and
+  // the SUSPECT/EVICT machine covers members and leaders alike.
+  // nullptr = every live worker (the flat protocol).
   void GatherWithLiveness(
-      const std::function<void(int, const std::string&)>& ingest);
+      const std::function<void(int, const std::string&)>& ingest,
+      const std::vector<bool>* expect_frame = nullptr);
   void EvictRank(int rank, const char* reason, double silence_ms);
   void MarkSuspect(int rank, const char* reason, double silence_ms);
 
@@ -346,6 +397,15 @@ class TcpController : public Controller {
   int last_joined_ = -1;
   StallInspector stall_;
   ResponseCache cache_;  // symmetric ids on all ranks (see CacheResponses)
+
+  // Hierarchical control plane (EnableHierControl). Written once before
+  // the background thread exists; read-only after — no guards, same
+  // posture as data_endpoints_.
+  bool hier_on_ = false;
+  CtrlChannel ctrl_;
+  std::vector<int> leader_of_;      // rank -> its host group's leader
+  std::vector<bool> leader_rank_;   // rank -> is a per-host leader
+  std::vector<int> my_members_;     // leaders: my group minus myself
 };
 
 // Canonical name of the join sentinel entry (reference JOIN_TENSOR_NAME).

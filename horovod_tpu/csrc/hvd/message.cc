@@ -9,6 +9,8 @@ namespace {
 constexpr uint8_t kRequestMagic = 0xA1;
 constexpr uint8_t kResponseMagic = 0xA2;
 constexpr uint8_t kHeartbeatMagic = 0xA3;
+constexpr uint8_t kAggregateMagic = 0xA4;
+constexpr uint8_t kDeltaMagic = 0xA5;
 // Request-list flags byte (docs/liveness.md): the old bool shutdown byte
 // widened into a bitfield — old frames (0/1) parse identically.
 constexpr uint8_t kFlagShutdown = 1;
@@ -161,6 +163,110 @@ bool DeserializeRequestList(const std::string& bytes,
   return r.ok();
 }
 
+std::string SerializeDeltaFrame(int rank,
+                                const std::vector<uint32_t>& cached_ids,
+                                bool shutdown, bool drain) {
+  Writer w;
+  w.u8(kDeltaMagic);
+  w.u8(static_cast<uint8_t>((shutdown ? kFlagShutdown : 0) |
+                            (drain ? kFlagDrain : 0)));
+  w.i32(rank);
+  uint32_t base = 0, nbits = 0;
+  if (!cached_ids.empty()) {
+    uint32_t lo = cached_ids[0], hi = cached_ids[0];
+    for (auto id : cached_ids) {
+      lo = std::min(lo, id);
+      hi = std::max(hi, id);
+    }
+    base = lo;
+    nbits = hi - lo + 1;
+  }
+  w.i32(static_cast<int32_t>(base));
+  w.i32(static_cast<int32_t>(nbits));
+  std::string bits((nbits + 7) / 8, '\0');
+  for (auto id : cached_ids) {
+    uint32_t i = id - base;
+    bits[i / 8] |= static_cast<char>(1u << (i % 8));
+  }
+  w.raw(bits.data(), bits.size());
+  return w.data();
+}
+
+bool DeserializeDeltaFrame(const std::string& bytes, int* rank,
+                           std::vector<uint32_t>* cached_ids,
+                           bool* shutdown, bool* drain) {
+  Reader r(bytes);
+  if (r.u8() != kDeltaMagic) return false;
+  uint8_t flags = r.u8();
+  *shutdown = (flags & kFlagShutdown) != 0;
+  if (drain != nullptr) *drain = (flags & kFlagDrain) != 0;
+  *rank = r.i32();
+  int32_t base = r.i32();
+  int32_t nbits = r.i32();
+  // A cache-id bitset wider than the id clamp (or a negative span) is a
+  // malformed frame — the bitset bytes that follow would misalign.
+  if (*rank < 0 || base < 0 || nbits < 0 || nbits > (1 << 24)) return false;
+  size_t nbytes = (static_cast<size_t>(nbits) + 7) / 8;
+  if (r.remaining() < nbytes) return false;  // truncated bitset
+  const char* bits = bytes.data() + (bytes.size() - r.remaining());
+  cached_ids->clear();
+  for (int32_t i = 0; i < nbits; ++i) {
+    if (static_cast<uint8_t>(bits[i / 8]) & (1u << (i % 8))) {
+      cached_ids->push_back(static_cast<uint32_t>(base + i));
+    }
+  }
+  return r.ok();
+}
+
+namespace {
+// Fixed per-member overhead in an aggregate frame (rank + kind + body
+// length prefix): the reserve() clamp for the member-count loop.
+constexpr size_t kMinAggMemberWire = 4 + 1 + 4;
+}  // namespace
+
+std::string SerializeAggregateFrame(const std::vector<AggMember>& members,
+                                    bool shutdown, bool drain) {
+  Writer w;
+  w.u8(kAggregateMagic);
+  w.u8(static_cast<uint8_t>((shutdown ? kFlagShutdown : 0) |
+                            (drain ? kFlagDrain : 0)));
+  w.i32(static_cast<int32_t>(members.size()));
+  for (const auto& m : members) {
+    w.i32(m.rank);
+    w.u8(m.kind);
+    w.str(m.body);
+  }
+  return w.data();
+}
+
+bool DeserializeAggregateFrame(const std::string& bytes,
+                               std::vector<AggMember>* members,
+                               bool* shutdown, bool* drain) {
+  Reader r(bytes);
+  if (r.u8() != kAggregateMagic) return false;
+  uint8_t flags = r.u8();
+  *shutdown = (flags & kFlagShutdown) != 0;
+  if (drain != nullptr) *drain = (flags & kFlagDrain) != 0;
+  int32_t n = r.i32();
+  // A host holds at most a few hundred ranks; 2^16 members in one
+  // aggregate is hostile, same clamp family as the chip-dim count.
+  if (n < 0 || n > (1 << 16)) return false;
+  members->clear();
+  members->reserve(std::min<size_t>(n, r.remaining() / kMinAggMemberWire + 1));
+  for (int i = 0; i < n && r.ok(); ++i) {
+    AggMember m;
+    m.rank = r.i32();
+    m.kind = r.u8();
+    m.body = r.str();
+    // Only the two defined body kinds exist; anything else means the
+    // sender and receiver disagree about the frame layout — reject,
+    // don't guess at the body's framing.
+    if (m.rank < 0 || (m.kind != 0 && m.kind != 1)) return false;
+    members->push_back(std::move(m));
+  }
+  return r.ok();
+}
+
 std::string HeartbeatFrame() {
   return std::string(1, static_cast<char>(kHeartbeatMagic));
 }
@@ -168,6 +274,14 @@ std::string HeartbeatFrame() {
 bool IsHeartbeatFrame(const std::string& bytes) {
   return bytes.size() == 1 &&
          static_cast<uint8_t>(bytes[0]) == kHeartbeatMagic;
+}
+
+bool IsDeltaFrame(const std::string& bytes) {
+  return !bytes.empty() && static_cast<uint8_t>(bytes[0]) == kDeltaMagic;
+}
+
+bool IsAggregateFrame(const std::string& bytes) {
+  return !bytes.empty() && static_cast<uint8_t>(bytes[0]) == kAggregateMagic;
 }
 
 std::string SerializeResponseList(const std::vector<Response>& resps,

@@ -94,6 +94,43 @@ bool DeserializeRequestList(const std::string& bytes,
                             std::vector<uint32_t>* cached_ids,
                             bool* shutdown, bool* drain = nullptr);
 
+// ---- hierarchical control-plane frames (docs/control-plane.md) ------------
+//
+// Under HOROVOD_HIER_CONTROL=1 negotiation is two-level: members speak to
+// their host leader, leaders speak for the group. Two frame kinds carry
+// that traffic; both keep the request-frame flag semantics (bit0 shutdown,
+// bit1 drain) so liveness intent survives aggregation.
+
+// Delta frame: a fully-cached cycle's submissions as a response-cache-id
+// bitset instead of a name list — the id set {base + i : bit i of the
+// bitset}, LSB-first within each byte. A repeat-submission cycle costs
+// O(id-range/8) bytes on the wire instead of a full Request per tensor
+// (the delta-first encoding; ids are the PR 6 symmetric response-cache
+// ids, insert order == broadcast order on every rank).
+std::string SerializeDeltaFrame(int rank,
+                                const std::vector<uint32_t>& cached_ids,
+                                bool shutdown, bool drain = false);
+bool DeserializeDeltaFrame(const std::string& bytes, int* rank,
+                           std::vector<uint32_t>* cached_ids,
+                           bool* shutdown, bool* drain = nullptr);
+
+// Aggregate frame: one leader->coordinator frame carrying every member's
+// control frame verbatim as a length-prefixed body — kind 0 embeds a full
+// request-list frame, kind 1 a delta frame. The leader does no semantic
+// merging on the hot path (the coordinator already owns group bookkeeping);
+// the top-level flags byte is the OR of member flags so the coordinator
+// can check shutdown/drain intent without parsing every body.
+struct AggMember {
+  int rank = 0;
+  uint8_t kind = 0;  // 0 = request-list body, 1 = delta body
+  std::string body;  // embedded frame bytes, parsed by its own codec
+};
+std::string SerializeAggregateFrame(const std::vector<AggMember>& members,
+                                    bool shutdown, bool drain = false);
+bool DeserializeAggregateFrame(const std::string& bytes,
+                               std::vector<AggMember>* members,
+                               bool* shutdown, bool* drain = nullptr);
+
 // Liveness heartbeat frame (docs/liveness.md): a one-byte frame a worker's
 // heartbeat thread interleaves with request frames on the control socket so
 // the coordinator can tell "alive but quiet" from "dead" without waiting
@@ -101,6 +138,11 @@ bool DeserializeRequestList(const std::string& bytes,
 // the coordinator's gather loop can skip any number of them.
 std::string HeartbeatFrame();
 bool IsHeartbeatFrame(const std::string& bytes);
+
+// Magic peeks for the coordinator's gather dispatch (hier mode accepts
+// request, delta, and aggregate frames on the same socket).
+bool IsDeltaFrame(const std::string& bytes);
+bool IsAggregateFrame(const std::string& bytes);
 
 // cycle_time_ms / fusion_threshold / hier_flags / stripes piggyback the
 // coordinator's tuned parameters on the broadcast (reference

@@ -26,6 +26,8 @@ const char* kHistNames[kNumHistograms] = {
     "cross_leg_us",
     "shm_leg_us",
     "stripe_leg_us",
+    "leader_agg_us",
+    "fanout_us",
 };
 constexpr size_t kMaxEvents = 64;
 }  // namespace

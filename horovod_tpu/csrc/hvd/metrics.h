@@ -109,6 +109,11 @@ enum HistId {
   kCrossLegUs,
   kShmLegUs,
   kStripeLegUs,
+  // hierarchical control plane (docs/control-plane.md): a leader's
+  // member-frame gather + aggregate build, and its response fan-out
+  // relay (the coordinator records both for its own host-0 group)
+  kLeaderAggUs,
+  kFanoutUs,
   kNumHistograms,
 };
 

@@ -54,8 +54,14 @@ enum class TransportLeg : int {
   LOCAL_BCAST = 2,
   CROSS_SEND = 3,
   CROSS_RECV = 4,
+  // Hierarchical control plane (docs/control-plane.md): member->leader
+  // request/delta frames and the leader->member response relay. Always
+  // intra-host, so it registers like the LOCAL data legs (shm first,
+  // TCP PeerLink fallback) — negotiation frames must not pay socket
+  // syscalls when the data plane already proved shm works on this pair.
+  LOCAL_CTRL = 5,
 };
-constexpr int kNumTransportLegs = 5;
+constexpr int kNumTransportLegs = 6;
 
 // Send/Recv return codes (see OperationManager dispatch).
 constexpr int kTransportOk = 1;

@@ -876,6 +876,28 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
         hvd::EnvFlag("HOROVOD_SHM_FALLBACK", /*dflt=*/true),
         shm_wait_ms, hvd::StripesFromEnv(), hvd::ChunkBytesFromEnv(),
         hvd::EnvFlag("HOROVOD_STRIPE_FALLBACK", /*dflt=*/true));
+    // Hierarchical control plane (docs/control-plane.md): per-host
+    // leaders aggregate their members' negotiation frames so the
+    // coordinator does O(hosts) socket work per cycle instead of
+    // O(ranks). Off by default — the flat star is byte-identical to
+    // previous releases. A dispatch knob: must agree across ranks,
+    // like every routing env. Member<->leader hops ride the ring's
+    // LOCAL_CTRL registry leg (shm first, TCP PeerLink fallthrough),
+    // wired here because the ring's transports must exist before the
+    // first hier cycle — and the background thread starts only below.
+    if (hvd::EnvFlag("HOROVOD_HIER_CONTROL")) {
+      auto* tcp_ctl =
+          static_cast<hvd::TcpController*>(s->controller.get());
+      hvd::Ring* ring = s->ring.get();
+      hvd::TcpController::CtrlChannel ch;
+      ch.send = [ring](int peer, const std::string& frame) {
+        return ring->CtrlSendFrame(peer, frame);
+      };
+      ch.recv = [ring](int peer, std::string* frame) {
+        return ring->CtrlRecvFrame(peer, frame);
+      };
+      tcp_ctl->EnableHierControl(std::move(ch));
+    }
   }
   // The background thread gets stable raw pointers captured here, under
   // init_mu — it must never reach through the GUARDED_BY(init_mu)

@@ -337,6 +337,33 @@ bool Ring::LocalRecv(TransportLeg leg, int peer, void* buf, size_t nbytes) {
   return true;
 }
 
+bool Ring::CtrlSendFrame(int peer, const std::string& payload) {
+  // Length-prefixed so the receiver — whose LocalRecv needs an exact
+  // byte count — can size the payload read. Two registry transfers per
+  // frame; control frames are tens of bytes, so the second slot write
+  // is noise next to the socket syscalls this leg exists to avoid.
+  uint32_t len = static_cast<uint32_t>(payload.size());
+  char hdr[4];
+  std::memcpy(hdr, &len, 4);
+  if (!LocalSend(TransportLeg::LOCAL_CTRL, peer, hdr, 4)) return false;
+  if (len == 0) return true;
+  return LocalSend(TransportLeg::LOCAL_CTRL, peer, payload.data(), len);
+}
+
+bool Ring::CtrlRecvFrame(int peer, std::string* payload) {
+  char hdr[4];
+  if (!LocalRecv(TransportLeg::LOCAL_CTRL, peer, hdr, 4)) return false;
+  uint32_t len = 0;
+  std::memcpy(&len, hdr, 4);
+  // Control frames are negotiation metadata, never tensor payloads: a
+  // length past this clamp is a corrupt or misrouted frame, not a big
+  // message — fail hard like any transport error.
+  if (len > (256u << 20)) return false;
+  payload->assign(len, '\0');
+  if (len == 0) return true;
+  return LocalRecv(TransportLeg::LOCAL_CTRL, peer, &(*payload)[0], len);
+}
+
 void Ring::SetTopology(const std::vector<int>& cross_ranks) {
   if (static_cast<int>(cross_ranks.size()) != size_) return;
   cross_ranks_ = cross_ranks;

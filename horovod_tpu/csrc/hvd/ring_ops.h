@@ -72,6 +72,16 @@ class Ring {
                            long long shm_wait_timeout_ms = 120000,
                            int stripes = 1, long long chunk_bytes = 256 << 10,
                            bool stripe_fallthrough = true);
+  // Variable-length control frames over the intra-host LOCAL_CTRL leg
+  // (docs/control-plane.md): a 4-byte little-endian length then the
+  // payload, each moved through the transport registry (shm first, TCP
+  // PeerLink fallthrough — lock-step, like every LOCAL leg). The
+  // hierarchical controller's member<->leader hops ride these so a
+  // cache-hit negotiation cycle costs zero socket syscalls when shm is
+  // on. Both return false on a hard transport failure (dead peer).
+  bool CtrlSendFrame(int peer, const std::string& payload);
+  bool CtrlRecvFrame(int peer, std::string* payload);
+
   // Frame-synced stripe-count apply (autotuner categorical dimension):
   // close the stripe connections, forget the CROSS-leg agreements, and
   // install the new count. Every rank calls this at the same response

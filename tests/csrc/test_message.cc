@@ -127,6 +127,27 @@ std::string GoldenResponseFrame() {
   return SerializeResponseList({GoldenResponse()}, 2.5, 1 << 20, 3, 4);
 }
 
+std::string GoldenDeltaFrame() {
+  // rank 3, drain=true, ids {7, 9, 10}: base 7, span 4 bits, bitset
+  // 0b1101 = 0x0d — every encoding rule (min base, LSB-first) pinned.
+  return SerializeDeltaFrame(3, {7u, 9u, 10u}, /*shutdown=*/false,
+                             /*drain=*/true);
+}
+
+std::string GoldenAggregateFrame() {
+  // One delta member and one full-request member, so both body kinds
+  // (and the recursive embedding) are pinned byte-exactly.
+  std::vector<AggMember> members(2);
+  members[0].rank = 1;
+  members[0].kind = 1;
+  members[0].body = GoldenDeltaFrame();
+  members[1].rank = 2;
+  members[1].kind = 0;
+  members[1].body = GoldenRequestFrame();
+  return SerializeAggregateFrame(members, /*shutdown=*/false,
+                                 /*drain=*/true);
+}
+
 std::string GoldenStripeHdr() {
   char hdr[kStripeHdrBytes];
   EncodeStripeHdr(/*seq=*/0x01020304u, /*len=*/0x000A0B0Cu, hdr);
@@ -149,6 +170,8 @@ int GoldenMain() {
   PrintHex("heartbeat", HeartbeatFrame());
   PrintHex("hello", std::string(kGoldenHello));
   PrintHex("stripe_hdr", GoldenStripeHdr());
+  PrintHex("delta", GoldenDeltaFrame());
+  PrintHex("aggregate", GoldenAggregateFrame());
   return 0;
 }
 
@@ -186,8 +209,16 @@ int FuzzMain(const char* corpus_path) {
     int hf, st;
     bool resp_ok =
         DeserializeResponseList(bytes, &resps, &cyc, &fus, &hf, &st);
-    std::printf("V %u req=%d resp=%d\n", i, req_ok ? 1 : 0,
-                resp_ok ? 1 : 0);
+    std::vector<AggMember> ams;
+    bool asd = false, adr = false;
+    bool agg_ok = DeserializeAggregateFrame(bytes, &ams, &asd, &adr);
+    int drank = 0;
+    std::vector<uint32_t> dids;
+    bool dsd = false, ddr = false;
+    bool delta_ok = DeserializeDeltaFrame(bytes, &drank, &dids, &dsd, &ddr);
+    std::printf("V %u req=%d resp=%d agg=%d delta=%d\n", i,
+                req_ok ? 1 : 0, resp_ok ? 1 : 0, agg_ok ? 1 : 0,
+                delta_ok ? 1 : 0);
   }
   std::fclose(f);
   std::puts("FUZZ_DONE");
@@ -600,7 +631,132 @@ int main(int argc, char** argv) {
     }
   }
 
-  // 13. Golden vectors round-trip in-binary (byte-exactness against the
+  // 13. Hierarchical control frames (docs/control-plane.md): delta
+  // bitset round-trips (empty, sparse, non-zero base, every flag
+  // combination), every truncation rejects, hostile bit spans reject
+  // without driving the decode loop past the frame's own bytes, and the
+  // aggregate container round-trips both body kinds, rejects unknown
+  // kinds, truncations, and hostile member counts with the same
+  // reserve() clamp discipline as the flat codecs.
+  {
+    struct DCase {
+      std::vector<uint32_t> ids;
+      bool shutdown, drain;
+    } dcases[] = {
+        {{}, false, false},
+        {{0u}, true, false},
+        {{5u, 6u, 900u}, false, true},
+        {{7u, 9u, 10u}, true, true},
+    };
+    for (const auto& c : dcases) {
+      std::string dw = SerializeDeltaFrame(4, c.ids, c.shutdown, c.drain);
+      int drank = 0;
+      std::vector<uint32_t> dids;
+      bool dsd = false, ddr = false;
+      CHECK(DeserializeDeltaFrame(dw, &drank, &dids, &dsd, &ddr),
+            "delta roundtrip parses");
+      CHECK(drank == 4 && dids == c.ids, "delta roundtrip ids");
+      CHECK(dsd == c.shutdown && ddr == c.drain, "delta flags roundtrip");
+      for (size_t len = 0; len < dw.size(); ++len) {
+        CHECK(!DeserializeDeltaFrame(dw.substr(0, len), &drank, &dids,
+                                     &dsd),
+              "truncated delta rejected");
+        if (failures) break;
+      }
+    }
+    // Hostile bit span: a 14-byte frame announcing 2^24+1 bits (over the
+    // clamp) or 2^24 bits (missing its 2 MiB bitset) must reject.
+    {
+      Writer w;
+      w.u8(0xA5);
+      w.u8(0);
+      w.i32(1);
+      w.i32(0);
+      w.i32((1 << 24) + 1);
+      int drank = 0;
+      std::vector<uint32_t> dids;
+      bool dsd = false;
+      CHECK(!DeserializeDeltaFrame(w.data(), &drank, &dids, &dsd),
+            "over-clamp delta span rejected");
+      Writer w2;
+      w2.u8(0xA5);
+      w2.u8(0);
+      w2.i32(1);
+      w2.i32(0);
+      w2.i32(1 << 24);
+      CHECK(!DeserializeDeltaFrame(w2.data(), &drank, &dids, &dsd),
+            "delta span without bitset bytes rejected");
+      Writer w3;  // negative base misaligns every id: reject
+      w3.u8(0xA5);
+      w3.u8(0);
+      w3.i32(1);
+      w3.i32(-4);
+      w3.i32(0);
+      CHECK(!DeserializeDeltaFrame(w3.data(), &drank, &dids, &dsd),
+            "negative delta base rejected");
+    }
+    // Aggregate container: both body kinds round-trip verbatim and the
+    // embedded bodies still parse with their own codecs.
+    {
+      std::vector<AggMember> in(2);
+      in[0].rank = 1;
+      in[0].kind = 1;
+      in[0].body = SerializeDeltaFrame(1, {2u, 3u}, false, false);
+      in[1].rank = 2;
+      in[1].kind = 0;
+      in[1].body = Serialize(1);
+      std::string aw = SerializeAggregateFrame(in, true, false);
+      std::vector<AggMember> out;
+      bool asd = false, adr = false;
+      CHECK(DeserializeAggregateFrame(aw, &out, &asd, &adr),
+            "aggregate roundtrip parses");
+      CHECK(out.size() == 2 && out[0].rank == 1 && out[1].rank == 2,
+            "aggregate member ranks");
+      CHECK(out[0].kind == 1 && out[1].kind == 0, "aggregate kinds");
+      CHECK(asd && !adr, "aggregate flags roundtrip");
+      CHECK(out[0].body == in[0].body && out[1].body == in[1].body,
+            "aggregate bodies verbatim");
+      int drank = 0;
+      std::vector<uint32_t> dids;
+      bool dsd = false;
+      CHECK(DeserializeDeltaFrame(out[0].body, &drank, &dids, &dsd) &&
+                dids == std::vector<uint32_t>({2u, 3u}),
+            "embedded delta body parses");
+      std::vector<Request> rq;
+      CHECK(Parse(out[1].body, &rq) && rq.size() == 1,
+            "embedded request body parses");
+      for (size_t len = 0; len < aw.size(); ++len) {
+        CHECK(!DeserializeAggregateFrame(aw.substr(0, len), &out, &asd),
+              "truncated aggregate rejected");
+        if (failures) break;
+      }
+      // Unknown body kind: layout disagreement, reject — don't guess.
+      std::string mut = aw;
+      size_t kind_off = 2 + 4 + 4;  // magic + flags + count + rank
+      mut[kind_off] = 2;
+      CHECK(!DeserializeAggregateFrame(mut, &out, &asd),
+            "unknown aggregate body kind rejected");
+      // Hostile member count: reject + clamp the reserve.
+      Writer hw;
+      hw.u8(0xA4);
+      hw.u8(0);
+      hw.i32(1 << 17);
+      std::vector<AggMember> hout;
+      CHECK(!DeserializeAggregateFrame(hw.data(), &hout, &asd),
+            "hostile aggregate member count rejected");
+      Writer hw2;
+      hw2.u8(0xA4);
+      hw2.u8(0);
+      hw2.i32(1 << 16);  // inside the clamp, but nothing follows
+      std::vector<AggMember> hout2;
+      CHECK(!DeserializeAggregateFrame(hw2.data(), &hout2, &asd),
+            "truncated aggregate members rejected");
+      CHECK(hout2.capacity() < 4096,
+            "hostile aggregate count allocation clamped");
+    }
+  }
+
+  // 14. Golden vectors round-trip in-binary (byte-exactness against the
   // checked-in hex is the driver's job — tests/test_hvdmc.py): the
   // canonical instances must at least survive their own codec.
   {
@@ -626,6 +782,24 @@ int main(int argc, char** argv) {
                           &gseq, &glen) &&
               gseq == 0x01020304u && glen == 0x000A0B0Cu,
           "golden stripe header parses");
+    int gdrank = 0;
+    std::vector<uint32_t> gdids;
+    bool gdsd = false, gddr = false;
+    CHECK(DeserializeDeltaFrame(GoldenDeltaFrame(), &gdrank, &gdids,
+                                &gdsd, &gddr),
+          "golden delta parses");
+    CHECK(gdrank == 3 && gdids == std::vector<uint32_t>({7u, 9u, 10u}) &&
+              !gdsd && gddr,
+          "golden delta content");
+    std::vector<AggMember> gam;
+    bool gasd = false, gadr = false;
+    CHECK(DeserializeAggregateFrame(GoldenAggregateFrame(), &gam, &gasd,
+                                    &gadr),
+          "golden aggregate parses");
+    CHECK(gam.size() == 2 && gam[0].kind == 1 && gam[1].kind == 0 &&
+              gam[0].body == GoldenDeltaFrame() &&
+              gam[1].body == GoldenRequestFrame() && !gasd && gadr,
+          "golden aggregate content");
   }
 
   if (failures) return 1;

@@ -274,6 +274,110 @@ def test_chaos_hier_leader_death_recovers(tmp_path):
     assert "DONE RANK 1" not in text, text
 
 
+# Variant of _ELASTIC_TRAIN that drains the liveness plane the moment a
+# collective fails: the eviction explaining the failure must already be
+# in ``hvd.liveness_report()`` AT CATCH TIME, before @elastic.run tears
+# the old world down and re-inits (which would reset the native core the
+# report lives in). Only the coordinator accumulates events; other
+# survivors log an empty report, which is fine — the assertion targets
+# rank 0's line.
+_HIER_CTRL_TRAIN = textwrap.dedent("""
+    import os, sys, time
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    import torch
+    import horovod_tpu.torch as hvd
+    import horovod_tpu.torch.elastic as elastic
+
+    LOG = os.environ["CHAOS_LOG"]
+    TARGET = int(os.environ.get("CHAOS_TARGET", "10"))
+
+    def log_line(text):
+        with open(LOG, "a") as f:
+            f.write(text + "\\n")
+
+    hvd.init()
+    model = torch.nn.Linear(4, 1)
+    opt = torch.optim.SGD(model.parameters(), lr=0.05)
+    state = elastic.TorchState(model=model, optimizer=opt, batch=0)
+
+    @elastic.run
+    def train(state):
+        while state.batch < TARGET:
+            x = torch.ones(2, 4) * (hvd.rank() + 1)
+            loss = model(x).sum()
+            opt.zero_grad()
+            loss.backward()
+            try:
+                grad = hvd.allreduce(model.weight.grad, op=hvd.Average,
+                                     name=f"grad.b{state.batch}")
+            except hvd.HorovodInternalError:
+                log_line("LIVENESS RANK " + str(hvd.rank()) + " "
+                         + hvd.liveness_report().replace("\\n", " | "))
+                raise
+            model.weight.grad.copy_(grad)
+            opt.step()
+            state.batch += 1
+            log_line(f"BATCH {state.batch} RANK {hvd.rank()} "
+                     f"SIZE {hvd.size()}")
+            time.sleep(0.05)
+            state.commit()
+        return state.batch
+
+    batches = train(state)
+    log_line(f"DONE RANK {hvd.rank()} BATCHES {batches}")
+    print(f"CHAOS_RANK_{hvd.rank()}_DONE_{batches}")
+""")
+
+
+def test_chaos_hier_control_leader_death_evicts_and_completes(tmp_path):
+    """Leader death under the two-level CONTROL plane
+    (docs/control-plane.md): a 4-rank 2x2 world runs with
+    HOROVOD_HIER_CONTROL=1 and heartbeats armed, and the fault plane
+    hard-kills rank 2 — the LEADER of the second host group, the rank
+    relaying its member's ctrl frames to the coordinator — mid-step.
+    The liveness plane (which learned the leader topology) evicts it,
+    the survivors see the failure rather than hanging on the dead
+    leader's aggregate frame, the driver blacklists its host (taking
+    the orphaned member down with it), and training completes on the
+    shrunk 2-rank world. The training script drains
+    ``hvd.liveness_report()`` inside the except handler, pinning that
+    the eviction is visible to user code at recovery time."""
+    proc, log = _launch_elastic(
+        tmp_path,
+        # Two "hosts" x 2 slots: ranks {0,1} on localhost, {2,3} on
+        # 127.0.0.1. Leaders are the min rank of each group: 0 and 2.
+        "localhost:2\n127.0.0.1:2\n",
+        {
+            # Rank 2's 8th host-plane enqueue dies as if OOM-killed —
+            # a leader loss, not a plain member loss.
+            "HOROVOD_FAULT_SPEC":
+                "host_world.enqueue:rank=2:step=8:kind=exit",
+            "HOROVOD_HIER_CONTROL": "1",
+            "HOROVOD_ELASTIC_BLACKLIST_STRIKES": "1",
+            "HOROVOD_HEARTBEAT_MS": "100",
+            "HOROVOD_LIVENESS_TIMEOUT_MS": "30000",
+            "CHAOS_TARGET": "10",
+        },
+        ["-np", "4", "--min-np", "2", "--max-np", "4"],
+        script_text=_HIER_CTRL_TRAIN)
+    out = proc.stdout + proc.stderr
+    text = _read(log)
+    assert proc.returncode == 0, out + text
+    # Survivor finished every batch on the shrunk world.
+    assert "DONE RANK 0 BATCHES 10" in text, text
+    assert "CHAOS_RANK_0_DONE_10" in proc.stdout, out
+    # The coordinator's liveness plane evicted the dead LEADER...
+    assert "EVICT rank=2" in out, out
+    # ...and that eviction was already drained into user-visible
+    # liveness_report() inside rank 0's except handler.
+    assert any("LIVENESS RANK 0" in ln and "EVICT rank=2" in ln
+               for ln in text.splitlines()), text
+    assert "host 127.0.0.1 blacklisted (strike 1/1, permanent)" in out, out
+    # Training spanned both worlds: 4 before the kill, 2 after.
+    assert "SIZE 4" in text and "SIZE 2" in text, text
+    assert "DONE RANK 2" not in text, text
+
+
 @pytest.mark.full
 def test_chaos_strike_two_lives_then_permanent(tmp_path):
     """Strike/parole composition under repeated deterministic failure:

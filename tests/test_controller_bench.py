@@ -5,9 +5,10 @@ reference's 5 ms cycle budget on the cached path, and the response
 cache's id fast path must actually engage.
 
 The committed evidence artifact is docs/controller_bench.json
-(tools/controller_bench.py --sizes 2,4,8 --iters 200); this test reruns
-a small configuration live so regressions fail CI, with a margin above
-the budget because CI machines are shared."""
+(tools/controller_bench.py --sizes 2,4,8,32,64,128,256 --iters 200
+--hier-control); this test reruns a small configuration live so
+regressions fail CI, with a margin above the budget because CI machines
+are shared."""
 
 import json
 import os
@@ -77,16 +78,25 @@ def test_cached_rtt_beats_cycle_budget(tmp_path):
 def test_committed_artifact_matches_schema():
     """docs/controller_bench.json stays parseable and under budget —
     the judge-facing evidence can't silently go stale-invalid. The
-    like-for-like ladder (2/4/8) gates at the 5 ms budget; the 32-rank
-    scale-soak row gates at 2x, the documented allowance for 16x core
-    oversubscription on the 2-core capture machine (the headline `value`
-    excludes soak rows for trajectory comparability)."""
+    like-for-like ladder (2/4/8) gates at the 5 ms budget; the soak
+    rungs (32/64/128/256) gate at budget * max(2, size/16) — the
+    documented allowance for timesharing N ranks over the capture
+    machine's cores, so the ladder's shape (not its absolute wall
+    clock) is what regressions trip. The headline `value` excludes
+    soak rows for trajectory comparability. The committed artifact is
+    captured with --hier-control (the two-level plane is the scaling
+    story), so every rank-0 row also carries the gather_wait/
+    leader_agg/fanout split histograms."""
     path = os.path.join(REPO, "docs", "controller_bench.json")
     with open(path) as f:
         data = json.load(f)
     assert data["metric"] == "controller_cached_rtt_ms"
     assert data["value"] < BUDGET_MS
-    assert set(data["sizes"]) >= {"2", "4", "8", "32"}
+    assert data["hier_control"] is True
+    assert set(data["sizes"]) >= {"2", "4", "8", "32", "64", "128", "256"}
     for size, row in data["sizes"].items():
-        limit = BUDGET_MS if int(size) <= 8 else 2 * BUDGET_MS
+        limit = BUDGET_MS if int(size) <= 8 \
+            else BUDGET_MS * max(2, int(size) // 16)
         assert row["hit_ms"]["p50"] < limit, (size, row["hit_ms"])
+        for hist in ("gather_wait_ms", "leader_agg_ms", "fanout_ms"):
+            assert {"n", "p50", "p90", "p99"} <= set(row[hist]), (size, hist)

@@ -1,0 +1,194 @@
+"""Hierarchical control plane (docs/control-plane.md): per-host leader
+negotiation over the LOCAL_CTRL registry leg with delta-first wire
+frames, behind ``HOROVOD_HIER_CONTROL``.
+
+THE acceptance world: 8 ranks as 2 hosts x 4 local with ROUND-ROBIN
+placement (host(r) = r % 2; leaders 0 and 1), run twice in the same
+processes — flat star first, then the SAME collectives under the
+two-level plane — asserting:
+
+- results are byte-identical flat vs hierarchical (uint32 views), and
+  the response-cache id fast path counts identically (the delta frames
+  change the carrier, never the cache semantics);
+- the coordinator's awaited TCP frame count is O(hosts), not O(ranks):
+  ~(H-1) = 1 gather-wait record per cycle under the hierarchy vs
+  ~(N-1) = 7 on the flat star (asserted from the metrics snapshot's
+  ``gather_wait_us.count`` / ``counters.cycles``, same process, same
+  suite);
+- the leader-side split histograms (``leader_agg_us``/``fanout_us``)
+  engage exactly when the hierarchy is on.
+
+The leader-death chaos run lives in tests/test_chaos.py
+(test_chaos_hier_control_leader_death_evicts_and_completes) beside the
+other elastic e2e worlds; the protocol's interleaving-level safety is
+tools/hvdmc's ``negotiation_hier`` model (docs/protocol-models.md).
+"""
+
+import textwrap
+
+from proc_harness import run_world
+
+# 8 ranks = 2 hosts x 4 local, round-robin placement: host(r) = r % 2.
+# Group members {0,2,4,6} / {1,3,5,7}; leaders are ranks 0 and 1.
+_ACCEPTANCE_WORKER = textwrap.dedent("""
+    import os, sys, time
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    from horovod_tpu.common import native as hn
+
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    SIZE, HOSTS, LOCAL = 8, 2, 4
+    # Bootstrap wall time scales with the host scheduler, not the
+    # protocol: on an oversubscribed box the default 120 s join
+    # deadline is a startup-speed assumption (see the matching seam in
+    # controller.cc / controller_bench.py).
+    os.environ.setdefault("HVD_JOIN_TIMEOUT_MS", "300000")
+    core = hn.NativeCore()
+    assert core.available
+
+    def boot():
+        # Phase-2 re-init races the coordinator's phase-1 teardown: a
+        # worker that dials while the OLD listener is still up lands in
+        # its backlog and is reset when it closes. The reset surfaces
+        # as a failed init; redialing then reaches the fresh listener.
+        for attempt in range(8):
+            ok = core.init(rank=rank, size=SIZE, local_rank=rank // HOSTS,
+                           local_size=LOCAL, cross_rank=rank % HOSTS,
+                           cross_size=HOSTS, coordinator_addr="127.0.0.1",
+                           coordinator_port=port, my_host="127.0.0.1",
+                           cycle_time_ms=1.0, fusion_threshold=64 << 20,
+                           cache_capacity=64, stall_warning_sec=60.0,
+                           stall_shutdown_sec=0.0, stall_check_enabled=True,
+                           exec_callback=lambda resp, rid: core.response_done(
+                               rid, False, "host-plane only"))
+            if ok:
+                return
+            if rank == 0:
+                break  # the coordinator's bind/accept is not racy
+            time.sleep(1.0)
+        assert False, "native init failed"
+
+    COUNT = 1 << 14  # 64 KiB fp32: above the tree cutoff -> ring path
+
+    def run_allreduce(name):
+        # Exact in fp32 at any summation order -> both control planes
+        # must produce identical BYTES (the data plane is untouched;
+        # this guards against a control-plane reordering bug).
+        buf = (np.arange(COUNT, dtype=np.float32) % 13) + rank
+        h = core.enqueue(name, hn.OP_ALLREDUCE, 1, 7, buf.shape,
+                         data_ptr=buf.ctypes.data,
+                         output_ptr=buf.ctypes.data, plane=hn.PLANE_HOST)
+        r, err = core.wait(h); assert r == 1, err
+        return buf
+
+    def run_allgather(name):
+        blk = (np.arange(1024, dtype=np.float32) % 7) * (rank + 1)
+        out = np.zeros(1024 * SIZE, np.float32)
+        h = core.enqueue(name, hn.OP_ALLGATHER, 1, 7, blk.shape,
+                         data_ptr=blk.ctypes.data,
+                         output_ptr=out.ctypes.data, plane=hn.PLANE_HOST)
+        r, err = core.wait(h); assert r == 1, err
+        return out
+
+    def run_small(name):
+        buf = np.full(8, float(rank + 1), np.float32)
+        h = core.enqueue(name, hn.OP_ALLREDUCE, 1, 7, buf.shape,
+                         data_ptr=buf.ctypes.data,
+                         output_ptr=buf.ctypes.data, plane=hn.PLANE_HOST)
+        r, err = core.wait(h); assert r == 1, err
+        return buf
+
+    HITS = 10
+
+    def suite(tag):
+        ar = run_allreduce(f"{tag}.ar")
+        ag = run_allgather(f"{tag}.ag")
+        small = run_small(f"{tag}.small")
+        # Same name every step: after the first submission the request
+        # rides the cache id fast path — under the hierarchy, as a
+        # delta (bitset) frame to the leader.
+        hits = [run_small(f"{tag}.hit") for _ in range(HITS + 1)]
+        for h in hits[1:]:
+            assert np.array_equal(h, hits[0]), "cached resubmit diverged"
+        snap = core.metrics_snapshot() if rank == 0 else {}
+        stats = {
+            "cache_hits": int(core.cache_hits()),
+            "cycles": int(snap.get("counters", {}).get("cycles", 0)),
+            "gather_n": int(snap.get("histograms", {})
+                            .get("gather_wait_us", {}).get("count", 0)),
+            "agg_n": int(snap.get("histograms", {})
+                         .get("leader_agg_us", {}).get("count", 0)),
+            "fanout_n": int(snap.get("histograms", {})
+                            .get("fanout_us", {}).get("count", 0)),
+        }
+        core.shutdown()
+        return (ar, ag, small, hits[0]), stats
+
+    # ---- phase 1: flat star (env off) ----
+    boot()
+    flat, flat_stats = suite("p1")
+
+    # ---- phase 2: the SAME world under the two-level plane ----
+    # Same port on purpose (SO_REUSEADDR + worker connect retries): the
+    # re-init exercises a fresh bootstrap with the hierarchy armed.
+    os.environ["HOROVOD_HIER_CONTROL"] = "1"
+    boot()
+    hier, hier_stats = suite("p2")
+
+    for f, h, nm in zip(flat, hier, ("ar", "ag", "small", "hit")):
+        assert np.array_equal(f.view(np.uint32), h.view(np.uint32)), \\
+            f"{nm} diverged flat vs hier"
+
+    # Cache semantics unchanged by the delta carrier: worker ranks count
+    # the same id-fast-path hits in both phases (coordinator counts 0).
+    assert hier_stats["cache_hits"] == flat_stats["cache_hits"], \\
+        (flat_stats, hier_stats)
+    if rank != 0:
+        assert hier_stats["cache_hits"] >= HITS, hier_stats
+
+    if rank == 0:
+        # O(hosts) vs O(ranks), from ONE process running BOTH planes
+        # over the identical suite: the flat coordinator awaits a frame
+        # from every worker every cycle (~N-1 = 7 gather-wait records
+        # per cycle); the hierarchical one awaits leaders only
+        # (~H-1 = 1), its own host group riding the ctrl channel into
+        # leader_agg_us instead.
+        fc, hc = flat_stats["cycles"], hier_stats["cycles"]
+        assert fc > 0 and hc > 0, (flat_stats, hier_stats)
+        flat_ratio = flat_stats["gather_n"] / fc
+        hier_ratio = hier_stats["gather_n"] / hc
+        assert flat_ratio >= 4.0, (flat_stats, flat_ratio)
+        assert hier_ratio <= 2.0, (hier_stats, hier_ratio)
+        assert flat_stats["gather_n"] >= 3 * hier_stats["gather_n"], \\
+            (flat_stats, hier_stats)
+        # The leader split engages exactly with the hierarchy.
+        assert flat_stats["agg_n"] == 0 and flat_stats["fanout_n"] == 0, \\
+            flat_stats
+        assert hier_stats["agg_n"] > 0 and hier_stats["fanout_n"] > 0, \\
+            hier_stats
+
+    print(f"HCTL_{rank}_OK")
+""")
+
+
+def test_hier_control_8rank_byte_identity_and_o_hosts_gather(tmp_path):
+    """THE acceptance world: 8 ranks as 2 hosts x 4 local (round-robin
+    placement), flat star then HOROVOD_HIER_CONTROL=1 in the same
+    processes. Byte-identical results, identical cache-hit counts, the
+    coordinator's awaited frame count drops from ~N-1 to ~H-1 per cycle,
+    and the leader aggregate/fan-out histograms engage only under the
+    hierarchy."""
+    run_world(tmp_path, _ACCEPTANCE_WORKER, "HCTL", size=8, timeout=300)
+
+
+def test_hier_control_knob_accessor(monkeypatch):
+    from horovod_tpu.common import config
+
+    monkeypatch.delenv(config.HOROVOD_HIER_CONTROL, raising=False)
+    assert config.hier_control_enabled() is False
+    for on in ("1", "true", "yes", "on"):
+        monkeypatch.setenv(config.HOROVOD_HIER_CONTROL, on)
+        assert config.hier_control_enabled() is True, on
+    for off in ("0", "false", "no", "off"):
+        monkeypatch.setenv(config.HOROVOD_HIER_CONTROL, off)
+        assert config.hier_control_enabled() is False, off

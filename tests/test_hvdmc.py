@@ -38,8 +38,8 @@ sys.path.insert(0, REPO)
 from tools.hvdmc import trace as mtrace  # noqa: E402
 from tools.hvdmc.__main__ import main as hvdmc_main  # noqa: E402
 from tools.hvdmc.mc import explore  # noqa: E402
-from tools.hvdmc.models import (ElasticModel, LivenessModel,  # noqa: E402
-                                NegotiationModel)
+from tools.hvdmc.models import (ElasticModel, HierNegotiationModel,  # noqa: E402
+                                LivenessModel, NegotiationModel)
 
 TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
 GOLDEN = os.path.join(TESTS_DIR, "golden_wire.json")
@@ -85,6 +85,40 @@ def test_negotiation_premature_fire_is_caught():
     msgs = "\n".join(v.message for v in res.violations)
     assert "fired without agreement" in msgs
     assert "never submitted" in msgs
+
+
+def test_hier_negotiation_death_chaos():
+    """Leader OR member death at ANY point in the hierarchical cycle
+    (frames in flight on either hop included) never wedges the model:
+    every schedule ends the world with every member of every host ended
+    — the leader-death-ends-group invariant."""
+    res = explore(HierNegotiationModel(hosts=2, members=2, tensors=("a",),
+                                       steps=1, deaths=1))
+    assert res.complete and res.ok, \
+        "\n".join(v.render() for v in res.violations)
+
+
+def test_hier_leader_fire_mutation_caught():
+    """Teeth: a leader that fires a group its own members agreed on
+    WITHOUT the coordinator must be flagged — other hosts never
+    submitted."""
+    res = explore(HierNegotiationModel(
+        hosts=2, members=2, tensors=("a",), steps=1,
+        mutations=("leader_fires_without_coordinator",)))
+    assert not res.ok
+    assert any("fired without agreement" in v.message
+               for v in res.violations)
+
+
+def test_hier_stale_delta_mutation_caught():
+    """Teeth: a leader that swallows a member eviction and keeps
+    replaying its stale delta leaves the world unable to finish — the
+    checker must flag the livelock/deadlock."""
+    res = explore(HierNegotiationModel(
+        hosts=2, members=2, tensors=("a",), steps=1, deaths=1,
+        mutations=("stale_delta_after_evict",)))
+    assert not res.ok
+    assert any(v.kind in ("livelock", "deadlock") for v in res.violations)
 
 
 def test_liveness_lossy_exhaustive():
@@ -442,6 +476,60 @@ def test_golden_response_parses_in_python_with_pinned_structure():
     assert frames["request"][0] == 0xA1 and frames["request"][1] == 0x02
 
 
+def test_golden_hier_frames_parse_in_python_with_pinned_structure():
+    """The delta/aggregate control frames (docs/control-plane.md) parse
+    in Python with the pinned structure, and the aggregate's embedded
+    bodies are the OTHER pinned frames verbatim — the recursive
+    embedding is part of the wire contract."""
+    from horovod_tpu.common import native as hn
+
+    frames = _golden_frames()
+    d = hn.parse_delta_frame(frames["delta"])
+    assert d.rank == 3 and d.cached_ids == (7, 9, 10)
+    assert not d.shutdown and d.drain
+
+    a = hn.parse_aggregate_frame(frames["aggregate"])
+    assert not a.shutdown and a.drain
+    assert [(m.rank, m.kind) for m in a.members] == [(1, 1), (2, 0)]
+    assert a.members[0].body == frames["delta"]
+    assert a.members[1].body == frames["request"]
+    # The embedded delta body parses on its own.
+    inner = hn.parse_delta_frame(a.members[0].body)
+    assert inner.cached_ids == (7, 9, 10)
+
+
+def test_python_hier_parsers_reject_hostile_frames():
+    """Hostile hierarchical frames reject via FrameRejected with the
+    same clamps as the C++ side: oversized bit spans, bitsets the frame
+    doesn't carry, hostile member counts, unknown body kinds, and every
+    truncation of both goldens."""
+    from horovod_tpu.common import native as hn
+
+    frames = _golden_frames()
+    for name, parse in (("delta", hn.parse_delta_frame),
+                        ("aggregate", hn.parse_aggregate_frame)):
+        golden = frames[name]
+        for cut in range(len(golden)):
+            with pytest.raises(hn.FrameRejected):
+                parse(golden[:cut])
+    # Span over the clamp / bitset bytes missing.
+    hdr = b"\xa5\x00" + struct.pack("<iii", 1, 0, (1 << 24) + 1)
+    with pytest.raises(hn.FrameRejected):
+        hn.parse_delta_frame(hdr)
+    hdr = b"\xa5\x00" + struct.pack("<iii", 1, 0, 1 << 24)
+    with pytest.raises(hn.FrameRejected):
+        hn.parse_delta_frame(hdr)
+    with pytest.raises(hn.FrameRejected):
+        hn.parse_delta_frame(b"\xa5\x00" + struct.pack("<iii", 1, -4, 0))
+    # Hostile member count; body kind disagreement.
+    with pytest.raises(hn.FrameRejected):
+        hn.parse_aggregate_frame(b"\xa4\x00" + struct.pack("<i", 1 << 17))
+    mut = bytearray(frames["aggregate"])
+    mut[2 + 4 + 4] = 2  # magic + flags + count + rank -> kind byte
+    with pytest.raises(hn.FrameRejected):
+        hn.parse_aggregate_frame(bytes(mut))
+
+
 def test_python_parser_rejects_hostile_frames_fast():
     """The hostile-length clamp, Python side: a tiny frame announcing
     2^24 entries (or a huge inner count) is rejected via FrameRejected
@@ -538,30 +626,39 @@ def _run_differential(tmp_path, iterations):
         assert "ERROR: AddressSanitizer" not in report, report[-4000:]
         assert "runtime error:" not in report, report[-4000:]
 
-    cpp_resp = {}
+    cpp = {}
     for line in r.stdout.splitlines():
         if line.startswith("V "):
-            _, idx, req, resp = line.split()
-            cpp_resp[int(idx)] = int(resp.split("=")[1])
-    assert len(cpp_resp) == len(frames), "verdict lines missing"
+            _, idx, _req, resp, agg, delta = line.split()
+            cpp[int(idx)] = {"resp": int(resp.split("=")[1]),
+                             "agg": int(agg.split("=")[1]),
+                             "delta": int(delta.split("=")[1])}
+    assert len(cpp) == len(frames), "verdict lines missing"
 
     from horovod_tpu.common import native as hn
 
+    parsers = {"resp": hn.parse_response_list,
+               "agg": hn.parse_aggregate_frame,
+               "delta": hn.parse_delta_frame}
     mismatches = []
     for i, fr in enumerate(frames):
-        try:
-            hn.parse_response_list(fr)
-            py = 1
-        except hn.FrameRejected:
-            py = 0
-        if py != cpp_resp[i]:
-            mismatches.append((i, py, cpp_resp[i], fr[:64].hex()))
+        for fam, parse in parsers.items():
+            try:
+                parse(fr)
+                py = 1
+            except hn.FrameRejected:
+                py = 0
+            if py != cpp[i][fam]:
+                mismatches.append((i, fam, py, cpp[i][fam], fr[:64].hex()))
     assert not mismatches, (
         f"{len(mismatches)} differential verdict mismatch(es) between "
-        f"the C++ and Python response codecs (first 5): {mismatches[:5]}")
+        f"the C++ and Python codecs (first 5): {mismatches[:5]}")
     # The C++ verdicts for the unmutated golden seeds must be accepts
     # for their own family.
-    assert cpp_resp[seeds.index(_golden_frames()['response'])] == 1
+    golden = _golden_frames()
+    assert cpp[seeds.index(golden['response'])]["resp"] == 1
+    assert cpp[seeds.index(golden['aggregate'])]["agg"] == 1
+    assert cpp[seeds.index(golden['delta'])]["delta"] == 1
 
 
 def test_codec_differential_fuzz_smoke(tmp_path):

@@ -23,7 +23,8 @@ import sys
 from typing import List, Tuple
 
 from .mc import Model, explore
-from .models import ElasticModel, LivenessModel, NegotiationModel
+from .models import (ElasticModel, HierNegotiationModel, LivenessModel,
+                     NegotiationModel)
 
 
 def _fast_models() -> List[Model]:
@@ -33,6 +34,13 @@ def _fast_models() -> List[Model]:
     return [
         NegotiationModel(ranks=2, tensors=("a", "b"), steps=2, deaths=0),
         NegotiationModel(ranks=2, tensors=("a", "b"), steps=1, deaths=1),
+        # Hierarchical control plane, exhaustive at 2 hosts x 2 members
+        # (the ISSUE 17 shape): clean run plus a one-death chaos run —
+        # leader or member, with or without frames in flight.
+        HierNegotiationModel(hosts=2, members=2, tensors=("a", "b"),
+                             steps=1, deaths=0),
+        HierNegotiationModel(hosts=2, members=2, tensors=("a",),
+                             steps=1, deaths=1),
         LivenessModel(members=1, lossy=True, deaths=1, drains=0,
                       timeout=4, horizon=8),
         LivenessModel(members=1, lossy=True, deaths=1, drains=1,
@@ -52,6 +60,13 @@ def _deep_models() -> List[Model]:
         NegotiationModel(ranks=3, tensors=("a", "b"), steps=2, deaths=0),
         NegotiationModel(ranks=3, tensors=("a", "b"), steps=1, deaths=1),
         NegotiationModel(ranks=4, tensors=("a",), steps=1, deaths=1),
+        HierNegotiationModel(hosts=2, members=2, tensors=("a", "b"),
+                             steps=2, deaths=0),
+        # hosts=3 exercises the leader-count scaling clean; the death
+        # interleavings are covered exhaustively at hosts=2 (fast
+        # profile) — adding deaths here blows the 2M-state bound.
+        HierNegotiationModel(hosts=3, members=2, tensors=("a",),
+                             steps=1, deaths=0),
         LivenessModel(members=2, lossy=True, deaths=1, drains=1,
                       timeout=4, horizon=7),
         ElasticModel(slots=3, min_np=2, max_restarts=2),
@@ -75,6 +90,14 @@ def _mutants() -> List[Tuple[str, Model]]:
         ("drained rank charged a strike",
          ElasticModel(slots=2, min_np=1,
                       mutations=("strike_on_drain",))),
+        ("leader fires without coordinator agreement",
+         HierNegotiationModel(hosts=2, members=2, tensors=("a",),
+                              steps=1,
+                              mutations=("leader_fires_without_coordinator",))),
+        ("stale delta replayed after evict",
+         HierNegotiationModel(hosts=2, members=2, tensors=("a",),
+                              steps=1, deaths=1,
+                              mutations=("stale_delta_after_evict",))),
     ]
 
 

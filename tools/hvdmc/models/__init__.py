@@ -6,6 +6,9 @@ level, small enough to exhaust every interleaving at 2-4 ranks:
 - ``negotiation`` — the controller cycle (csrc/hvd/controller.cc):
   enqueue -> per-rank ready gather -> response-cache hit/miss ->
   fused-response fan-out -> execute, plus worker death;
+- ``negotiation_hier`` — the hierarchical cycle (HOROVOD_HIER_CONTROL):
+  member -> leader CTRL aggregate -> leader -> coordinator delta frame
+  -> O(H) gather -> fan-out relay, plus leader/member death;
 - ``liveness``    — the heartbeat escalation machine
   (common/liveness.py + the native twin): HB -> MISS -> SUSPECT ->
   EVICT, DRAIN exemption, zombie-proof terminal states;
@@ -19,6 +22,7 @@ checks: a checker that cannot catch a planted protocol bug is itself
 the red line.
 """
 
-from .negotiation import NegotiationModel  # noqa: F401
-from .liveness import LivenessModel        # noqa: F401
-from .elastic import ElasticModel          # noqa: F401
+from .negotiation import NegotiationModel          # noqa: F401
+from .negotiation_hier import HierNegotiationModel  # noqa: F401
+from .liveness import LivenessModel                # noqa: F401
+from .elastic import ElasticModel                  # noqa: F401

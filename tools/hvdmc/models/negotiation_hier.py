@@ -1,0 +1,591 @@
+"""Executable model of the HIERARCHICAL controller negotiation cycle.
+
+Mirrors ``csrc/hvd/controller.cc`` under ``HOROVOD_HIER_CONTROL=1`` at
+the frame level: ranks are grouped into hosts; the lowest rank of each
+host is its *leader*; rank 0 is both the leader of host 0 and the
+global coordinator.  One cycle is three hops:
+
+1. **member -> leader** — every non-leader rank sends ONE control frame
+   to its host leader over the CTRL transport leg (novel requests as
+   names, repeat submissions as response-cache ids — the delta-first
+   encoding) then blocks for the leader's fan-out;
+2. **leader -> coordinator** — once every live member's frame is in,
+   the leader folds in its own submissions and forwards ONE aggregate
+   frame upstream; names already in the leader's response cache travel
+   as cache ids (the second delta hop), so a fully-cached cycle puts no
+   tensor names on the cross-host wire at all;
+3. **coordinator -> fan-out** — the coordinator gathers H-1 aggregates
+   (O(H), not O(N)), fires every tensor group that EVERY active rank
+   submitted (sorted by name — the deterministic fuse order), caches
+   fired tensors in broadcast order, and fans the response back out
+   through the leaders, who relay it to their members VERBATIM (the
+   byte-identical-to-flat guarantee).
+
+Scheduler nondeterminism = the action list: enqueue timing per rank,
+frame arrival interleavings on both hops, empty keep-alive cycles, and
+rank death at any point — member or leader, with or without a frame in
+flight.
+
+Safety invariants checked (the flat model's set, plus the leader ones):
+- **agreement**: a response never fires unless every active rank
+  submitted it, and no rank ever executes a tensor it did not submit;
+- **cache coherence**: a cache id resolves to the same tensor on the
+  sender and the receiver, on BOTH delta hops (insert order is
+  broadcast order on every rank);
+- **execution order**: any two ranks' executed sequences are
+  prefix-consistent;
+- **leader-death-ends-group**: a dead leader strands its members —
+  quiescence requires every member of a dead leader's host to have
+  ended (their CTRL waits fail), and the coordinator's existing
+  poll/SUSPECT/EVICT machine must end the world.  A schedule where a
+  death is swallowed and the world keeps cycling is a livelock — a red
+  CI line, same as a wedged gather.
+
+Mutations (teeth checks):
+- ``leader_fires_without_coordinator`` — a leader fires any group all
+  of its OWN members submitted straight back down to them, skipping
+  the coordinator: the checker must flag the agreement violation
+  (other hosts never submitted);
+- ``stale_delta_after_evict`` — a leader that notices a member's death
+  keeps replaying the member's stale (empty) delta instead of
+  propagating the departure: the world never shuts down and the dead
+  rank's tensors can never fire — caught as a livelock/deadlock.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Tuple, Union
+
+from ..mc import Action, Model, State
+
+SHUTDOWN = "SHUTDOWN"
+
+
+class RankS(NamedTuple):
+    script: Tuple[str, ...]    # remaining enqueue order
+    outbox: Tuple[str, ...]    # enqueued, not yet sent (sorted)
+    pending: Tuple[str, ...]   # sent, not yet executed (sorted)
+    awaiting: bool             # blocked on the fan-out (or the agg ack)
+    cache: Tuple[str, ...]     # response-cache insert order
+    executed: Tuple[str, ...]  # execution order (broadcast order)
+    alive: bool
+    ended: bool
+
+
+class Frame(NamedTuple):
+    """member -> leader control frame (delta-first)."""
+    full: Tuple[str, ...]      # novel requests (names)
+    hits: Tuple[int, ...]      # response-cache ids
+
+
+class Agg(NamedTuple):
+    """leader -> coordinator aggregate frame (delta-first)."""
+    full: Tuple[Tuple[str, Tuple[int, ...]], ...]  # (name, submitters)
+    hits: Tuple[Tuple[int, Tuple[int, ...]], ...]  # (cache id, submitters)
+
+
+Resp = Union[Tuple[str, ...], str, None]
+
+
+class World(NamedTuple):
+    ranks: Tuple[RankS, ...]
+    # per host: the leader's gathered groups this cycle (name -> subs)
+    lgroups: Tuple[Tuple[Tuple[str, Tuple[int, ...]], ...], ...]
+    lgathered: Tuple[Tuple[int, ...], ...]  # per host: members ingested
+    mframes: Tuple[Optional[Frame], ...]    # per rank: frame to leader
+    agg: Tuple[Optional[Agg], ...]          # per host: agg to coord
+    cgathered: Tuple[int, ...]              # hosts the coord ingested
+    groups: Tuple[Tuple[str, Tuple[int, ...]], ...]  # coordinator view
+    fanout: Tuple[Resp, ...]                # per host: coord -> leader
+    resp: Tuple[Resp, ...]                  # per rank: leader -> member
+    departed: Tuple[int, ...]               # deaths the protocol noticed
+    world_ended: bool
+    alerts: Tuple[str, ...]
+
+
+def _sorted(t) -> Tuple:
+    return tuple(sorted(t))
+
+
+class HierNegotiationModel(Model):
+    def __init__(self, hosts: int = 2, members: int = 2,
+                 tensors: Tuple[str, ...] = ("a", "b"), steps: int = 1,
+                 deaths: int = 0, mutations: Tuple[str, ...] = ()):
+        assert hosts >= 2 and members >= 1
+        self.hosts = hosts
+        self.members = members
+        self.n = hosts * members
+        self.tensors = tuple(tensors)
+        self.steps = steps
+        self.deaths = deaths
+        self.mutations = tuple(mutations)
+        self.name = (f"negotiation_hier(hosts={hosts}, members={members}, "
+                     f"tensors={len(tensors)}, steps={steps}, "
+                     f"deaths={deaths}"
+                     + (f", mutations={self.mutations}" if mutations else "")
+                     + ")")
+
+    # -- topology -------------------------------------------------------------
+
+    def _host(self, r: int) -> int:
+        return r // self.members
+
+    def _leader(self, h: int) -> int:
+        return h * self.members
+
+    def _is_leader(self, r: int) -> bool:
+        return r % self.members == 0
+
+    def _members_of(self, h: int) -> Tuple[int, ...]:
+        lead = self._leader(h)
+        return tuple(range(lead + 1, lead + self.members))
+
+    # -- state construction ---------------------------------------------------
+
+    def initial(self) -> State:
+        ranks = []
+        for r in range(self.n):
+            rot = self.tensors[r % len(self.tensors):] + \
+                self.tensors[:r % len(self.tensors)]
+            script = rot * self.steps
+            ranks.append(RankS(script=script, outbox=(), pending=(),
+                               awaiting=False, cache=(), executed=(),
+                               alive=True, ended=False))
+        return World(ranks=tuple(ranks),
+                     lgroups=((),) * self.hosts,
+                     lgathered=((),) * self.hosts,
+                     mframes=(None,) * self.n,
+                     agg=(None,) * self.hosts,
+                     cgathered=(), groups=(),
+                     fanout=(None,) * self.hosts,
+                     resp=(None,) * self.n,
+                     departed=(), world_ended=False, alerts=())
+
+    # -- helpers --------------------------------------------------------------
+
+    @staticmethod
+    def _group_add(groups, name: str, ranks: Tuple[int, ...]):
+        out = dict(groups)
+        subs = set(out.get(name, ()))
+        subs.update(ranks)
+        out[name] = _sorted(subs)
+        return tuple(sorted(out.items()))
+
+    def _deaths_used(self, s: World) -> int:
+        return sum(0 if r.alive else 1 for r in s.ranks)
+
+    def _set_rank(self, s: World, r: int, rk: RankS) -> World:
+        return s._replace(ranks=s.ranks[:r] + (rk,) + s.ranks[r + 1:])
+
+    @staticmethod
+    def _execute(rk: RankS, fired: Tuple[str, ...]):
+        """Apply a response on one rank; alert = the rank executed a
+        tensor it never submitted (agreement, worker side)."""
+        alert = None
+        foreign = [t for t in fired if t not in rk.pending]
+        if foreign:
+            alert = ("rank {rank} executed " + repr(foreign) +
+                     " it never submitted")
+        return rk._replace(
+            executed=rk.executed + fired,
+            pending=tuple(t for t in rk.pending if t not in fired)), alert
+
+    def _apply_resp(self, s: World, r: int, fired: Tuple[str, ...]) -> World:
+        """Verbatim response application: cache insert in broadcast
+        order, then execute — identical on leaders and members."""
+        rk = s.ranks[r]
+        cache = rk.cache
+        for t in fired:
+            if t not in cache:
+                cache = cache + (t,)
+        rk, alert = self._execute(rk._replace(cache=cache), fired)
+        alerts = s.alerts
+        if alert:
+            alerts = alerts + (alert.format(rank=r),)
+        return self._set_rank(s, r, rk)._replace(alerts=alerts)
+
+    # -- transition relation --------------------------------------------------
+
+    def actions(self, s: World) -> List[Action]:
+        acts: List[Action] = []
+        if s.world_ended:
+            for h in range(1, self.hosts):
+                lead = self._leader(h)
+                lk = s.ranks[lead]
+                if lk.alive and not lk.ended and s.fanout[h] is not None:
+                    acts.append((f"leader_recv_shutdown({lead})",
+                                 self._leader_recv(s, h)))
+            for r in range(self.n):
+                rk = s.ranks[r]
+                if self._is_leader(r) or not rk.alive or rk.ended:
+                    continue
+                if s.resp[r] is not None:
+                    acts.append((f"recv_shutdown({r})", self._recv(s, r)))
+                elif not s.ranks[self._leader(self._host(r))].alive:
+                    acts.append((f"leader_lost({r})",
+                                 self._leader_lost(s, r)))
+            return acts
+
+        for r in range(self.n):
+            rk = s.ranks[r]
+            if not rk.alive or rk.ended:
+                continue
+            if rk.script:
+                t = rk.script[0]
+                if t not in rk.outbox and t not in rk.pending:
+                    acts.append((f"enqueue({r},{t})", self._enqueue(s, r)))
+            if not self._is_leader(r):
+                lead = self._leader(self._host(r))
+                # send: one CTRL frame per cycle to the host leader,
+                # empty keep-alives included.
+                if not rk.awaiting and s.mframes[r] is None:
+                    acts.append((f"send({r})", self._send(s, r)))
+                # recv: consume the leader's verbatim fan-out relay.
+                if rk.awaiting and s.resp[r] is not None:
+                    acts.append((f"recv({r})", self._recv(s, r)))
+                # CTRL wait failure: the leader process died.
+                if not s.ranks[lead].alive and s.resp[r] is None:
+                    acts.append((f"leader_lost({r})",
+                                 self._leader_lost(s, r)))
+            if r >= 1 and self._deaths_used(s) < self.deaths:
+                acts.append((f"die({r})", self._die(s, r)))
+
+        # leader-side deliveries, death notices, and aggregation
+        for h in range(self.hosts):
+            lead = self._leader(h)
+            lk = s.ranks[lead]
+            if lead in s.departed or not lk.alive or lk.ended:
+                continue
+            for m in self._members_of(h):
+                if s.mframes[m] is not None and m not in s.lgathered[h]:
+                    acts.append((f"deliver({m})", self._deliver(s, m)))
+                if not s.ranks[m].alive and s.mframes[m] is None:
+                    if "stale_delta_after_evict" in self.mutations:
+                        # BUG (planted): the leader keeps replaying the
+                        # evicted member's stale empty delta instead of
+                        # propagating the departure.
+                        if m not in s.lgathered[h] and m not in s.departed:
+                            acts.append((f"ghost_gather({m})",
+                                         self._ghost_gather(s, m)))
+                    elif m not in s.departed:
+                        acts.append((f"notice_death({m})",
+                                     self._notice_death(s, m)))
+            expected = [m for m in self._members_of(h)
+                        if m not in s.departed]
+            if all(m in s.lgathered[h] for m in expected):
+                if h == 0:
+                    if 0 not in s.cgathered:
+                        acts.append(("coord_ingest_own",
+                                     self._coord_ingest_own(s)))
+                elif not lk.awaiting and s.agg[h] is None:
+                    acts.append((f"aggregate({lead})",
+                                 self._aggregate(s, h)))
+
+        # coordinator-side aggregate deliveries and leader death notices
+        coord = s.ranks[0]
+        for h in range(1, self.hosts):
+            lead = self._leader(h)
+            if lead in s.departed:
+                continue
+            if s.agg[h] is not None and h not in s.cgathered:
+                acts.append((f"deliver_agg({lead})",
+                             self._deliver_agg(s, h)))
+            if not s.ranks[lead].alive and s.agg[h] is None:
+                acts.append((f"notice_death({lead})",
+                             self._notice_death(s, lead)))
+
+        # respond: one aggregate from every host whose leader the
+        # coordinator still believes in.
+        expected_hosts = [h for h in range(self.hosts)
+                          if self._leader(h) not in s.departed]
+        if (all(h in s.cgathered for h in expected_hosts)
+                and not coord.ended):
+            acts.append(("respond", self._respond(s)))
+
+        # non-coordinator leaders consume the fan-out
+        for h in range(1, self.hosts):
+            lead = self._leader(h)
+            lk = s.ranks[lead]
+            if lk.alive and not lk.ended and s.fanout[h] is not None:
+                acts.append((f"leader_recv({lead})",
+                             self._leader_recv(s, h)))
+        return acts
+
+    def _enqueue(self, s: World, r: int) -> World:
+        rk = s.ranks[r]
+        t = rk.script[0]
+        nk = rk._replace(script=rk.script[1:],
+                         outbox=_sorted(rk.outbox + (t,)))
+        return self._set_rank(s, r, nk)
+
+    def _send(self, s: World, r: int) -> World:
+        rk = s.ranks[r]
+        full = tuple(t for t in rk.outbox if t not in rk.cache)
+        hits = tuple(rk.cache.index(t) for t in rk.outbox if t in rk.cache)
+        frame = Frame(full=full, hits=hits)
+        nk = rk._replace(outbox=(), pending=_sorted(rk.pending + rk.outbox),
+                         awaiting=True)
+        return self._set_rank(s, r, nk)._replace(
+            mframes=s.mframes[:r] + (frame,) + s.mframes[r + 1:])
+
+    def _die(self, s: World, r: int) -> World:
+        rk = s.ranks[r]._replace(alive=False)
+        return self._set_rank(s, r, rk)
+
+    def _notice_death(self, s: World, r: int) -> World:
+        return s._replace(departed=_sorted(s.departed + (r,)))
+
+    def _ghost_gather(self, s: World, m: int) -> World:
+        # stale_delta_after_evict: the dead member is "gathered" with a
+        # replay of its stale (empty) delta; the departure is swallowed.
+        h = self._host(m)
+        lg = s.lgathered[h] + (m,)
+        return s._replace(lgathered=s.lgathered[:h] + (_sorted(lg),) +
+                          s.lgathered[h + 1:])
+
+    def _leader_lost(self, s: World, r: int) -> World:
+        rk = s.ranks[r]._replace(awaiting=False, ended=True)
+        return self._set_rank(s, r, rk)
+
+    def _deliver(self, s: World, m: int) -> World:
+        """Leader ingests one member CTRL frame, resolving delta ids
+        against its own response cache (hop-1 coherence check)."""
+        h = self._host(m)
+        lead = self._leader(h)
+        frame = s.mframes[m]
+        groups = s.lgroups[h]
+        alerts = s.alerts
+        for t in frame.full:
+            groups = self._group_add(groups, t, (m,))
+        lk = s.ranks[lead]
+        sender = s.ranks[m]
+        for hid in frame.hits:
+            if hid >= len(lk.cache):
+                alerts = alerts + (
+                    f"cache id {hid} from rank {m} out of range on "
+                    f"leader {lead} (len {len(lk.cache)})",)
+                continue
+            name_l = lk.cache[hid]
+            name_m = sender.cache[hid]
+            if name_l != name_m:
+                alerts = alerts + (
+                    f"cache id {hid} resolves to '{name_l}' on leader "
+                    f"{lead} but '{name_m}' on rank {m}",)
+            groups = self._group_add(groups, name_l, (m,))
+        return s._replace(
+            lgroups=s.lgroups[:h] + (groups,) + s.lgroups[h + 1:],
+            lgathered=s.lgathered[:h] + (_sorted(s.lgathered[h] + (m,)),)
+            + s.lgathered[h + 1:],
+            mframes=s.mframes[:m] + (None,) + s.mframes[m + 1:],
+            alerts=alerts)
+
+    def _fold_own(self, s: World, h: int):
+        """Fold the leader's own outbox into its gathered groups;
+        returns (new leader RankS, groups)."""
+        lead = self._leader(h)
+        lk = s.ranks[lead]
+        groups = s.lgroups[h]
+        for t in lk.outbox:
+            groups = self._group_add(groups, t, (lead,))
+        lk = lk._replace(outbox=(),
+                         pending=_sorted(lk.pending + lk.outbox))
+        return lk, groups
+
+    def _aggregate(self, s: World, h: int) -> World:
+        lead = self._leader(h)
+        lk, groups = self._fold_own(s, h)
+        s = self._set_rank(s, lead, lk)._replace(
+            lgroups=s.lgroups[:h] + ((),) + s.lgroups[h + 1:],
+            lgathered=s.lgathered[:h] + ((),) + s.lgathered[h + 1:])
+
+        if "leader_fires_without_coordinator" in self.mutations:
+            # BUG (planted): the leader fires any group all of ITS OWN
+            # members submitted straight back down, skipping the
+            # coordinator — other hosts never agreed.
+            active = _sorted(set(range(self.n)) - set(s.departed))
+            host_ranks = set((lead,) + self._members_of(h)) - \
+                set(s.departed)
+            fired = []
+            rest = []
+            alerts = s.alerts
+            for name, subs in groups:
+                if set(subs) >= host_ranks:
+                    fired.append(name)
+                    if not set(subs) >= set(active):
+                        alerts = alerts + (
+                            f"response for '{name}' fired without "
+                            f"agreement: submitted by {subs}, active "
+                            f"{active}",)
+                else:
+                    rest.append((name, subs))
+            fired.sort()
+            groups = tuple(sorted(rest))
+            resp = list(s.resp)
+            for m in self._members_of(h):
+                if m not in s.departed:
+                    resp[m] = tuple(fired)
+            s = s._replace(alerts=alerts, resp=tuple(resp))
+            s = self._apply_resp(s, lead, tuple(fired))
+
+        # Delta-first upstream encoding: names already in the leader's
+        # response cache travel as cache ids (hop-2 delta).
+        lk = s.ranks[lead]
+        full = []
+        hits = []
+        for name, subs in groups:
+            if name in lk.cache:
+                hits.append((lk.cache.index(name), subs))
+            else:
+                full.append((name, subs))
+        frame = Agg(full=tuple(full), hits=tuple(hits))
+        lk = lk._replace(awaiting=True)
+        return self._set_rank(s, lead, lk)._replace(
+            agg=s.agg[:h] + (frame,) + s.agg[h + 1:])
+
+    def _coord_ingest_own(self, s: World) -> World:
+        """Host 0's 'aggregate' is local: the coordinator folds its own
+        members' groups (and its own outbox) straight into the global
+        gather — no wire hop, no delta re-encoding."""
+        lk, groups = self._fold_own(s, 0)
+        cgroups = s.groups
+        for name, subs in groups:
+            cgroups = self._group_add(cgroups, name, subs)
+        return self._set_rank(s, 0, lk)._replace(
+            lgroups=((),) + s.lgroups[1:],
+            lgathered=((),) + s.lgathered[1:],
+            groups=cgroups, cgathered=_sorted(s.cgathered + (0,)))
+
+    def _deliver_agg(self, s: World, h: int) -> World:
+        """Coordinator ingests one leader aggregate, resolving delta
+        ids against its own response cache (hop-2 coherence check)."""
+        lead = self._leader(h)
+        frame = s.agg[h]
+        groups = s.groups
+        alerts = s.alerts
+        coord = s.ranks[0]
+        sender = s.ranks[lead]
+        for name, subs in frame.full:
+            groups = self._group_add(groups, name, subs)
+        for hid, subs in frame.hits:
+            if hid >= len(coord.cache):
+                alerts = alerts + (
+                    f"cache id {hid} from leader {lead} out of range on "
+                    f"the coordinator (len {len(coord.cache)})",)
+                continue
+            name_c = coord.cache[hid]
+            name_l = sender.cache[hid] if hid < len(sender.cache) else None
+            if name_c != name_l:
+                alerts = alerts + (
+                    f"cache id {hid} resolves to '{name_c}' on the "
+                    f"coordinator but '{name_l}' on leader {lead}",)
+            groups = self._group_add(groups, name_c, subs)
+        return s._replace(
+            groups=groups, alerts=alerts,
+            cgathered=_sorted(s.cgathered + (h,)),
+            agg=s.agg[:h] + (None,) + s.agg[h + 1:])
+
+    def _respond(self, s: World) -> World:
+        if s.departed:
+            # Any departure ends the whole world (reference semantics):
+            # nothing fires; SHUTDOWN fans out through the leaders.
+            fanout = list(s.fanout)
+            for h in range(1, self.hosts):
+                if self._leader(h) not in s.departed:
+                    fanout[h] = SHUTDOWN
+            resp = list(s.resp)
+            for m in self._members_of(0):
+                if m not in s.departed:
+                    resp[m] = SHUTDOWN
+            coord = s.ranks[0]._replace(ended=True)
+            return s._replace(ranks=(coord,) + s.ranks[1:],
+                              fanout=tuple(fanout), resp=tuple(resp),
+                              world_ended=True, cgathered=())
+
+        active = _sorted(set(range(self.n)) - set(s.departed))
+        alerts = s.alerts
+        fired: List[str] = []
+        rest = []
+        for name, subs in s.groups:
+            ready = set(subs) >= set(active)
+            if ready:
+                fired.append(name)
+                if not set(subs) >= set(active):
+                    alerts = alerts + (
+                        f"response for '{name}' fired without agreement: "
+                        f"submitted by {subs}, active {active}",)
+            else:
+                rest.append((name, subs))
+        fired.sort()  # deterministic fuse/broadcast order
+
+        s2 = s._replace(alerts=alerts)
+        s2 = self._apply_resp(s2, 0, tuple(fired))
+
+        fanout = tuple(tuple(fired) for _ in range(self.hosts))
+        fanout = (None,) + fanout[1:]  # host 0 is local
+        resp = list(s2.resp)
+        for m in self._members_of(0):
+            if m not in s.departed:
+                resp[m] = tuple(fired)
+        return s2._replace(groups=tuple(sorted(rest)), cgathered=(),
+                           fanout=fanout, resp=tuple(resp))
+
+    def _leader_recv(self, s: World, h: int) -> World:
+        lead = self._leader(h)
+        payload = s.fanout[h]
+        s2 = s._replace(fanout=s.fanout[:h] + (None,) + s.fanout[h + 1:])
+        if payload == SHUTDOWN:
+            lk = s2.ranks[lead]._replace(awaiting=False, ended=True)
+            s2 = self._set_rank(s2, lead, lk)
+            relay: Resp = SHUTDOWN
+        else:
+            s2 = self._apply_resp(s2, lead, payload)
+            lk = s2.ranks[lead]._replace(awaiting=False)
+            s2 = self._set_rank(s2, lead, lk)
+            relay = payload
+        # Verbatim relay to every member the leader still believes in.
+        resp = list(s2.resp)
+        for m in self._members_of(h):
+            if m not in s.departed:
+                resp[m] = relay
+        return s2._replace(resp=tuple(resp))
+
+    def _recv(self, s: World, r: int) -> World:
+        payload = s.resp[r]
+        s2 = s._replace(resp=s.resp[:r] + (None,) + s.resp[r + 1:])
+        rk = s2.ranks[r]
+        if payload == SHUTDOWN:
+            rk = rk._replace(awaiting=False, ended=True)
+            return self._set_rank(s2, r, rk)
+        s2 = self._apply_resp(s2, r, payload)
+        rk = s2.ranks[r]._replace(awaiting=False)
+        return self._set_rank(s2, r, rk)
+
+    # -- properties -----------------------------------------------------------
+
+    def safety(self, s: World) -> List[str]:
+        out = list(s.alerts)
+        for i in range(self.n):
+            for j in range(i + 1, self.n):
+                a, b = s.ranks[i].executed, s.ranks[j].executed
+                k = min(len(a), len(b))
+                if a[:k] != b[:k]:
+                    out.append(f"execution order diverged between rank "
+                               f"{i} {a} and rank {j} {b}")
+        return out
+
+    def is_quiescent(self, s: World) -> bool:
+        if s.world_ended:
+            # leader-death-ends-group: members of a dead leader's host
+            # must have ended too, not just the ranks the coordinator
+            # spoke to directly.
+            return all(rk.ended or not rk.alive for rk in s.ranks)
+        total = len(self.tensors) * self.steps
+        return (all(rk.alive and not rk.script and not rk.outbox and
+                    not rk.pending and len(rk.executed) == total
+                    for rk in s.ranks) and
+                not s.groups and
+                all(not g for g in s.lgroups) and
+                all(f is None for f in s.mframes) and
+                all(a is None for a in s.agg) and
+                all(f is None for f in s.fanout) and
+                all(p is None for p in s.resp))

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import random
 
 # ---- knob names (reference: common.h:62-88) --------------------------------
 HOROVOD_FUSION_THRESHOLD = "HOROVOD_FUSION_THRESHOLD"
@@ -101,6 +102,19 @@ HOROVOD_ELASTIC_BLACKLIST_STRIKES = "HOROVOD_ELASTIC_BLACKLIST_STRIKES"
 HOROVOD_ELASTIC_PAROLE_WINDOW = "HOROVOD_ELASTIC_PAROLE_WINDOW"
 DEFAULT_BLACKLIST_STRIKES = 3
 DEFAULT_PAROLE_WINDOW_SECONDS = 300.0
+# Self-healing data plane: epoch-fenced in-place link reconnection
+# (csrc/hvd/ring_ops.cc HealCrossStep; docs/self-healing.md) + the
+# seeded multi-fault chaos scheduler (tools/chaos_sched.py)
+HOROVOD_LINK_RETRY_ATTEMPTS = "HOROVOD_LINK_RETRY_ATTEMPTS"
+HOROVOD_LINK_RETRY_BACKOFF_MS = "HOROVOD_LINK_RETRY_BACKOFF_MS"
+HOROVOD_LINK_RETRY_DEADLINE_MS = "HOROVOD_LINK_RETRY_DEADLINE_MS"
+HOROVOD_CHAOS_SPEC = "HOROVOD_CHAOS_SPEC"
+DEFAULT_LINK_RETRY_ATTEMPTS = 3
+DEFAULT_LINK_RETRY_BACKOFF_MS = 100
+# Sized well below DEFAULT_LIVENESS_TIMEOUT_MS on purpose: healing must
+# surface a truly dead peer to the evict path inside the liveness
+# window, never mask it (docs/self-healing.md sizing rule).
+DEFAULT_LINK_RETRY_DEADLINE_MS = 3000
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # reference operations.cc:423
 DEFAULT_CYCLE_TIME_MS = 5.0  # reference operations.cc:431
@@ -436,6 +450,109 @@ def parse_fault_spec_env() -> tuple:
     return parse_fault_spec(text) if text else ()
 
 
+# ---- seeded chaos schedules (tools/chaos_sched.py CLI; docs/self-healing.md)
+#
+# HOROVOD_CHAOS_SPEC grammar:  key=value(,key=value)*
+#   seed   (int, REQUIRED)  rng seed — the whole schedule is a pure
+#                           function of the spec string, so one string
+#                           reproduces the same faults every run/rank
+#   n      (int, REQUIRED)  number of faults to draw
+#   kinds  (a|b|...)        draw pool (default "drop_conn|delay_ms" — the
+#                           non-fatal kinds a healing world must absorb;
+#                           "exit" must be opted into)
+#   points (p|q|...)        fault-point pool (default
+#                           "ring.exec|ring.hier.cross")
+#   ranks  (0|1|...)        rank pool (default: every rank, 0..size-1)
+#   steps  (lo-hi)          inclusive hit-index window per fault
+#                           (default 0-10)
+#   ms     (float)          delay for drawn kind=delay_ms faults
+#                           (default 50)
+#   code   (int)            exit status for drawn kind=exit faults
+#                           (default 13)
+#
+# e.g. HOROVOD_CHAOS_SPEC="seed=42,n=5,kinds=drop_conn|delay_ms,steps=0-8"
+# Parsing is strict like parse_fault_spec: malformed raises, never
+# silently injects nothing.
+
+CHAOS_DEFAULT_KINDS = "drop_conn|delay_ms"
+CHAOS_DEFAULT_POINTS = "ring.exec|ring.hier.cross"
+
+
+def parse_chaos_spec(text: str, size: int = 0) -> tuple:
+    """Compile a ``HOROVOD_CHAOS_SPEC`` string into concrete
+    ``FaultSpec`` tuples, deterministically from its seed.
+
+    Draw order per fault is fixed (point, rank, step, kind), so the
+    schedule is stable across runs, ranks, and Python versions. ``size``
+    bounds the default rank pool; 0 falls back to the launch-time
+    ``HOROVOD_SIZE``. Every compiled fault is one-shot (``times=1``) —
+    n faults means at most n firings. Raises ``ValueError`` on any
+    malformed or unknown field (loud-by-design, like
+    ``parse_fault_spec``)."""
+    fields = {}
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"chaos spec field {part!r} is not key=value")
+        key, _, val = part.partition("=")
+        fields[key.strip()] = val.strip()
+    try:
+        seed = int(fields.pop("seed"))
+        n = int(fields.pop("n"))
+    except KeyError as e:
+        raise ValueError(f"chaos spec {text!r}: missing required "
+                         f"field {e.args[0]}") from None
+    if n < 0:
+        raise ValueError(f"chaos spec {text!r}: n must be >= 0")
+    kinds = tuple(k.strip() for k in
+                  fields.pop("kinds", CHAOS_DEFAULT_KINDS).split("|"))
+    for k in kinds:
+        if k not in FAULT_KINDS:
+            raise ValueError(f"chaos spec {text!r}: unknown kind {k!r} "
+                             f"(choices: {', '.join(FAULT_KINDS)})")
+    points = tuple(p.strip() for p in
+                   fields.pop("points", CHAOS_DEFAULT_POINTS).split("|"))
+    ranks_txt = fields.pop("ranks", "")
+    if ranks_txt:
+        ranks = tuple(int(r) for r in ranks_txt.split("|"))
+    else:
+        world = size if size > 0 else max(1, _get_int(HOROVOD_SIZE, 1))
+        ranks = tuple(range(world))
+    steps_txt = fields.pop("steps", "0-10")
+    lo, sep, hi = steps_txt.partition("-")
+    if not sep:
+        raise ValueError(f"chaos spec {text!r}: steps must be lo-hi")
+    step_lo, step_hi = int(lo), int(hi)
+    if step_lo < 0 or step_hi < step_lo:
+        raise ValueError(f"chaos spec {text!r}: bad steps window "
+                         f"{steps_txt!r}")
+    ms = float(fields.pop("ms", 50.0))
+    code = int(fields.pop("code", 13))
+    if fields:
+        raise ValueError(f"chaos spec {text!r}: unknown key(s) "
+                         f"{', '.join(sorted(fields))}")
+    rng = random.Random(seed)
+    specs = []
+    for _ in range(n):
+        point = rng.choice(points)
+        rank = rng.choice(ranks)
+        step = rng.randint(step_lo, step_hi)
+        kind = rng.choice(kinds)
+        specs.append(FaultSpec(point=point, rank=rank, step=step,
+                               kind=kind, ms=ms, code=code, times=1))
+    return tuple(specs)
+
+
+def parse_chaos_spec_env(size: int = 0) -> tuple:
+    """The compiled chaos schedule from ``HOROVOD_CHAOS_SPEC`` (empty
+    tuple when unset — the zero-cost-disabled case)."""
+    text = chaos_spec()
+    return parse_chaos_spec(text, size=size) if text else ()
+
+
 # ---- shared retry/backoff policy (common/faults.py Retrier) ----------------
 
 @dataclasses.dataclass(frozen=True)
@@ -619,6 +736,47 @@ def stripe_fallback_enabled() -> bool:
     deployments that would rather fail fast than silently lose the
     striped bandwidth (the stripe sibling of ``shm_fallback_enabled``)."""
     return _get_bool(HOROVOD_STRIPE_FALLBACK, default=True)
+
+
+def link_retry_attempts() -> int:
+    """How many times a failed cross-host data link redials in place
+    before the failure escalates (csrc/hvd/ring_ops.cc ``HealCrossStep``;
+    docs/self-healing.md). 0 disables healing entirely — every link
+    failure is the pre-healing hard error. The native core parses the
+    same variable with the same default."""
+    return max(0, _get_int(HOROVOD_LINK_RETRY_ATTEMPTS,
+                           DEFAULT_LINK_RETRY_ATTEMPTS))
+
+
+def link_retry_backoff_ms() -> int:
+    """Sleep between in-place link redial attempts, in ms. Flat (not
+    exponential) on purpose: the whole ladder must fit inside
+    ``link_retry_deadline_ms``, which is itself a fraction of the
+    liveness window."""
+    return max(1, _get_int(HOROVOD_LINK_RETRY_BACKOFF_MS,
+                           DEFAULT_LINK_RETRY_BACKOFF_MS))
+
+
+def link_retry_deadline_ms() -> int:
+    """Overall wall-clock budget for healing one link failure, in ms.
+    SIZE IT WELL BELOW ``HOROVOD_LIVENESS_TIMEOUT_MS`` (default 3000 vs
+    10000): a peer that cannot be redialed inside this budget surfaces
+    as exactly the pre-healing transport error, so the liveness evict /
+    elastic path fires on schedule — healing must never mask a real
+    death past the liveness window (docs/self-healing.md sizing rule)."""
+    return max(1, _get_int(HOROVOD_LINK_RETRY_DEADLINE_MS,
+                           DEFAULT_LINK_RETRY_DEADLINE_MS))
+
+
+def chaos_spec() -> str:
+    """The seeded chaos schedule (tools/chaos_sched.py grammar:
+    ``seed=<int>,n=<int>[,kinds=a|b][,points=p|q][,ranks=0|1]
+    [,steps=lo-hi][,ms=<float>]``), empty string when unset — the
+    zero-cost-disabled case. Compiled deterministically from the seed
+    into concrete ``FaultSpec`` entries at ``faults`` arm time, so one
+    spec string reproduces the exact same multi-fault schedule on every
+    run and every rank (docs/self-healing.md, chaos-spec section)."""
+    return os.environ.get(HOROVOD_CHAOS_SPEC, "").strip()
 
 
 def metrics_export_path():

@@ -98,16 +98,25 @@ _specs: Tuple[_config.FaultSpec, ...] = ()
 _hits: Dict[str, int] = {}
 _fired: Dict[int, int] = {}  # spec index -> fire count
 _loaded = False
+# Index into _specs where the compiled chaos schedule begins: specs at
+# [_chaos_base:] came from HOROVOD_CHAOS_SPEC and additionally count
+# metrics "chaos.injected" when they fire.
+_chaos_base = 0
 
 
 def refresh() -> None:
-    """(Re-)read ``HOROVOD_FAULT_SPEC`` and reset all hit/fire counters.
+    """(Re-)read ``HOROVOD_FAULT_SPEC`` + ``HOROVOD_CHAOS_SPEC`` and
+    reset all hit/fire counters.
 
     Called lazily on the first ``point()`` of a process; call explicitly
-    after mutating the env in-process (tests)."""
-    global _specs, _hits, _fired, _loaded
+    after mutating the env in-process (tests). The chaos spec compiles
+    deterministically from its seed (config.parse_chaos_spec), so the
+    same spec string arms the same schedule in every process."""
+    global _specs, _hits, _fired, _loaded, _chaos_base
     with _lock:
         _specs = _config.parse_fault_spec_env()
+        _chaos_base = len(_specs)
+        _specs = _specs + _config.parse_chaos_spec_env()
         _hits = {}
         _fired = {}
         _loaded = True
@@ -152,6 +161,7 @@ def point(name: str, rank: Optional[int] = None) -> None:
         if rank is None:
             rank = _default_rank()
         to_fire = None
+        chaos = False
         for i, spec in enumerate(_specs):
             if spec.point != name:
                 continue
@@ -163,19 +173,26 @@ def point(name: str, rank: Optional[int] = None) -> None:
                 continue
             _fired[i] = _fired.get(i, 0) + 1
             to_fire = spec
+            chaos = i >= _chaos_base
             break
     if to_fire is None:
         return
-    _fire(to_fire, name, rank, hit)
+    _fire(to_fire, name, rank, hit, chaos=chaos)
 
 
-def _fire(spec: _config.FaultSpec, name: str, rank: int, hit: int) -> None:
+def _fire(spec: _config.FaultSpec, name: str, rank: int, hit: int,
+          chaos: bool = False) -> None:
     desc = f"fault injected at {name} (rank={rank} hit={hit} " \
            f"kind={spec.kind})"
     _log.warning(desc)
     from . import metrics as _metrics
 
     _metrics.inc("faults.injected")
+    if chaos:
+        # The chaos scheduler's own tally, split from hand-armed faults
+        # so a soak's bench JSON can assert the drawn schedule actually
+        # fired (docs/self-healing.md, chaos-spec section).
+        _metrics.inc("chaos.injected")
     if spec.kind == "delay_ms":
         _sleep(spec.ms / 1000.0)
         return

@@ -31,11 +31,18 @@ from . import logging as _log
 # dotted names mirroring the subsystem that owns them:
 #   retrier.retries        every Retrier backoff taken (faults.py)
 #   faults.injected        every fault point that fired (faults.py)
+#   chaos.injected         the subset of faults.injected drawn by the
+#                          seeded chaos scheduler, HOROVOD_CHAOS_SPEC
+#                          (faults.py; docs/self-healing.md)
 #   shm.attach_fallback    ring.shm.attach seam armed a forced TCP
 #                          fallback for this world (host_world.py)
 #   stripe.connect_fallback  the stripe sibling (host_world.py)
 #   elastic.evictions      driver-side liveness evictions (driver.py)
 #   elastic.drains         commit-marked graceful drains (driver.py)
+#
+# The native snapshot carries the self-healing counters alongside these
+# (link.reconnects / link.resume_chunks_discarded /
+# link.stale_epoch_rejected / epoch — csrc/hvd/operations.cc).
 
 _lock = threading.Lock()
 _counters: dict = {}
@@ -260,6 +267,10 @@ class MetricsPump(threading.Thread):
         # (CPython's tstate cleanup calls it) — shadowing it with an
         # Event breaks Thread.join on 3.10.
         self._stop_evt = threading.Event()
+        # Last observed native link.reconnects value: a growth between
+        # publishes becomes a LINK_RECONNECT timeline instant (the pump
+        # is the only reader, so plain int is fine).
+        self._last_reconnects = 0
 
     def stop(self):
         self._stop_evt.set()
@@ -290,6 +301,13 @@ class MetricsPump(threading.Thread):
                 "cycles": c.get("cycles", 0),
                 "pending": c.get("pending", 0),
             })
+            reconnects = int(c.get("link.reconnects", 0))
+            if reconnects > self._last_reconnects:
+                from . import timeline as _timeline
+
+                timeline.instant(_timeline.LINK_RECONNECT,
+                                 {"reconnects": reconnects})
+            self._last_reconnects = reconnects
 
     def run(self):
         while not self._stop_evt.wait(self._interval_s):

@@ -287,6 +287,10 @@ class NativeResponse:
     # (-1 = untuned; consumed by the native cycle loop, carried here so
     # the parse stays a faithful mirror of the wire layout)
     stripes: int = -1
+    # world incarnation the coordinator stamped (docs/self-healing.md);
+    # a worker holding a different epoch is split-brained and shuts
+    # down. -1 = no hint.
+    epoch: int = -1
 
 
 class FrameRejected(ValueError):
@@ -366,12 +370,14 @@ def parse_response_list(data: bytes) -> List[NativeResponse]:
     c.i64()
     hier_flags = c.i32()
     stripes = c.i32()
+    epoch = c.i64()
     out = []
     for _ in range(c.count()):
         r = NativeResponse(op=c.u8(), reduce_op=c.u8(), dtype=c.u8(),
                            plane=c.u8(), root_rank=c.i32(), error=c.s(),
                            prescale=c.f64(), postscale=c.f64(),
-                           hier_flags=hier_flags, stripes=stripes)
+                           hier_flags=hier_flags, stripes=stripes,
+                           epoch=epoch)
         for _ in range(c.count()):
             r.names.append(c.s())
             ndim = c.i32()
@@ -463,6 +469,37 @@ def parse_aggregate_frame(data: bytes) -> NativeAggregate:
         members.append(NativeAggMember(rank=rank, kind=kind, body=body))
     return NativeAggregate(members=members, shutdown=bool(flags & 1),
                            drain=bool(flags & 2))
+
+
+@dataclass
+class NativeResume:
+    """One parsed link resume frame (docs/self-healing.md): after a
+    cross-host data link redials in place, each end announces its world
+    epoch and how many frames it has sent/received, so both sides agree
+    which in-flight chunk to replay and which to discard."""
+    epoch: int
+    rank: int
+    send_seq: int
+    recv_seq: int
+
+
+def parse_resume_frame(data: bytes) -> NativeResume:
+    """Parse one link resume frame; raises ``FrameRejected`` on any
+    structurally invalid input — verdict-identical to the C++
+    ``DeserializeResume`` (a negative rank or seq rejects: counters only
+    ever grow from zero, so a negative one is a desynced stream)."""
+    c = _Cursor(data)
+    if c.u8() != 0xA6:
+        raise FrameRejected("bad resume magic")
+    epoch = c.i64()
+    rank = c.i32()
+    send_seq = c.i64()
+    recv_seq = c.i64()
+    if rank < 0 or send_seq < 0 or recv_seq < 0:
+        raise FrameRejected(f"resume fields out of range: rank {rank}, "
+                            f"send_seq {send_seq}, recv_seq {recv_seq}")
+    return NativeResume(epoch=epoch, rank=rank, send_seq=send_seq,
+                        recv_seq=recv_seq)
 
 
 # ---- high-level wrapper ----------------------------------------------------

@@ -55,6 +55,10 @@ DRAIN_COMMIT = "DRAIN_COMMIT"
 # emitted by mark_cycle.
 STRAGGLER_WARNING = "STRAGGLER_WARNING"
 CYCLE = "CYCLE"
+# Self-healing-plane instant (docs/self-healing.md): a cross-host data
+# link was redialed in place mid-collective (args: reconnects — the
+# native link.reconnects counter after the heal).
+LINK_RECONNECT = "LINK_RECONNECT"
 
 # Single source of truth for timeline instant names — the same
 # registry discipline as ``faults.CATALOG``: every ``timeline.instant``
@@ -74,6 +78,7 @@ INSTANT_CATALOG = (
     DRAIN_COMMIT,
     STRAGGLER_WARNING,
     CYCLE,
+    LINK_RECONNECT,
 )
 
 

@@ -322,11 +322,18 @@ Status TcpController::Initialize() {
         continue;
       }
       int rank = 0, port = 0, cross = -1;
+      long long peer_epoch = -1;
       char host[256] = {0};
       char key[256] = {0};
+      // Field 6 (optional): the worker's local incarnation counter
+      // (docs/self-healing.md). Informational only — epochs are
+      // per-process counters until the coordinator's broadcast value is
+      // adopted, so they are not comparable here; the authoritative
+      // stamp rides the endpoint map below. Parsed so the hello format
+      // is forward-settled and old 5-field hellos stay accepted.
       int fields =
-          std::sscanf(hello.c_str(), "%d %255s %d %255s %d", &rank, host,
-                      &port, key, &cross);
+          std::sscanf(hello.c_str(), "%d %255s %d %255s %d %lld", &rank,
+                      host, &port, key, &cross, &peer_epoch);
       if (fields < 3 || rank <= 0 || rank >= cfg_.size) {
         std::fprintf(stderr,
                      "[horovod_tpu coordinator] ignoring malformed hello "
@@ -356,6 +363,10 @@ Status TcpController::Initialize() {
     // Broadcast the endpoint map with the host-topology column: every
     // rank ends up with the same rank -> (host, port, cross_rank) table,
     // so the ring's hierarchical grouping needs no further exchange.
+    // The coordinator's world epoch trails the table (workers with the
+    // old map layout would stop reading before it — the same tolerant
+    // tail-extension style as the hello's optional fields).
+    epoch_ = cfg_.epoch;
     Writer w;
     w.i32(cfg_.size);
     for (int r = 0; r < cfg_.size; ++r) {
@@ -363,12 +374,21 @@ Status TcpController::Initialize() {
       w.i32(data_endpoints_[r].second);
       w.i32(cross_ranks_[r]);
     }
+    w.i64(static_cast<int64_t>(cfg_.epoch));
     for (auto& s : worker_socks_) {
       if (!s.SendFrame(w.data())) {
         return Status::Error(StatusType::UNKNOWN_ERROR,
                              "failed to send endpoint map");
       }
     }
+    // Bootstrap is over: every worker socket is established, so the
+    // listener has no further accepts to serve. Closing it NOW (not at
+    // Finalize) removes the stale-listener teardown race PR 12's
+    // acceptance world absorbed with re-init retries: a worker re-init
+    // that dials early gets connection-refused (never a backlog slot on
+    // a dying listener) and Socket::Connect's retry loop waits for the
+    // successor world's fresh listener deterministically.
+    listener_.Close();
   } else {
     coord_sock_ = Socket::Connect(
         cfg_.coordinator_addr, cfg_.coordinator_port,
@@ -382,7 +402,8 @@ Status TcpController::Initialize() {
     std::string hello = std::to_string(cfg_.rank) + " " + my_host_ + " " +
                         std::to_string(data_port_) + " " +
                         (cfg_.job_key.empty() ? "-" : cfg_.job_key) + " " +
-                        std::to_string(cfg_.cross_rank);
+                        std::to_string(cfg_.cross_rank) + " " +
+                        std::to_string(cfg_.epoch);
     if (!coord_sock_.SendFrame(hello)) {
       return Status::Error(StatusType::UNKNOWN_ERROR, "hello send failed");
     }
@@ -411,6 +432,13 @@ Status TcpController::Initialize() {
       data_endpoints_.emplace_back(host, port);
       cross_ranks_[i] = r.i32();
     }
+    // Adopt the coordinator's world epoch (the authoritative stamp —
+    // local counters are per-process and not comparable across ranks).
+    // A map without the trailing i64 comes from a pre-epoch
+    // coordinator: keep the local counter so fencing degrades to
+    // per-process behavior instead of failing the bootstrap.
+    epoch_ = r.remaining() >= 8 ? static_cast<long long>(r.i64())
+                                : cfg_.epoch;
     if (liveness_on_) StartHeartbeat();
   }
   return Status::OK();
@@ -775,9 +803,23 @@ std::vector<Response> TcpController::ApplyResponseBytes(
   int64_t synced_fusion = -1;
   int synced_hier = -1;
   int synced_stripes = -1;
+  long long synced_epoch = -1;
   if (!DeserializeResponseList(bytes, &resps, &synced_cycle,
                                &synced_fusion, &synced_hier,
-                               &synced_stripes)) {
+                               &synced_stripes, &synced_epoch)) {
+    *world_shutdown = true;
+    return {};
+  }
+  if (synced_epoch >= 0 && synced_epoch != epoch_) {
+    // Split brain: this worker bootstrapped against a different world
+    // incarnation than the coordinator now broadcasting to it (an
+    // evicted-but-alive rank whose socket outlived the teardown, or a
+    // crossed wire from a stale listener). Executing the frame would
+    // inject this rank's data into a world it no longer belongs to —
+    // end this rank's world instead (docs/self-healing.md).
+    RecordLivenessEvent("EPOCH_MISMATCH rank=" + std::to_string(cfg_.rank) +
+                        " ours=" + std::to_string(epoch_) +
+                        " theirs=" + std::to_string(synced_epoch));
     *world_shutdown = true;
     return {};
   }
@@ -1232,7 +1274,7 @@ std::vector<Response> TcpController::CoordinatorCycle(
   int stripes = stripe_hint();
   std::string bytes = SerializeResponseList(fused, cycle_hint_ms(),
                                             fusion_threshold(), hier,
-                                            stripes);
+                                            stripes, epoch_);
   for (int r = 1; r < cfg_.size; ++r) {
     if (hier_on_ && !leader_rank_[r]) continue;
     if (!shutdown_ranks_[r] && worker_socks_[r - 1].valid()) {

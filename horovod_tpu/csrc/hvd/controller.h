@@ -59,6 +59,12 @@ struct ControllerConfig {
   // 0 (the default) keeps the pre-liveness blocking protocol bit-for-bit.
   int heartbeat_ms = 0;
   int liveness_timeout_ms = 10000;
+  // World incarnation (docs/self-healing.md): bumped per hvd_init in the
+  // owning process. The coordinator stamps its value on the endpoint-map
+  // broadcast and every response frame; workers ADOPT the coordinator's
+  // value at bootstrap, so one world always agrees on one epoch and a
+  // frame from a torn-down predecessor world is rejectable everywhere.
+  long long epoch = 0;
 };
 
 class Controller {
@@ -72,6 +78,9 @@ class Controller {
     if (cfg_.rank >= 0 && cfg_.rank < cfg_.size) {
       cross_ranks_[cfg_.rank] = cfg_.cross_rank;
     }
+    // Local default: own incarnation counter. TCP workers overwrite it
+    // with the coordinator's broadcast value at Initialize.
+    epoch_ = cfg_.epoch;
   }
   virtual ~Controller() = default;
 
@@ -167,6 +176,13 @@ class Controller {
     return cache_hits_.load(std::memory_order_relaxed);
   }
 
+  // The world epoch this controller settled on at Initialize: the
+  // coordinator's cfg_.epoch, adopted by workers from the endpoint-map
+  // broadcast. The data plane stamps it into every link hello and the
+  // resume handshake (docs/self-healing.md). Written once at Initialize
+  // before the background thread exists; read-only after.
+  long long epoch() const { return epoch_; }
+
   // Accumulated liveness events (SUSPECT / EVICT / DRAIN /
   // COORD_TIMEOUT lines; docs/liveness.md), drained like the stall
   // report: consumes at most max_bytes of whole lines per call so a
@@ -258,6 +274,7 @@ class Controller {
   // Filled by Initialize before any other thread exists; read-only after.
   std::vector<std::pair<std::string, int>> data_endpoints_;
   std::vector<int> cross_ranks_;
+  long long epoch_ = 0;
   std::string stall_report_ GUARDED_BY(stall_report_mu_);
   Mutex liveness_mu_;
   std::string liveness_report_ GUARDED_BY(liveness_mu_);

@@ -11,6 +11,7 @@ constexpr uint8_t kResponseMagic = 0xA2;
 constexpr uint8_t kHeartbeatMagic = 0xA3;
 constexpr uint8_t kAggregateMagic = 0xA4;
 constexpr uint8_t kDeltaMagic = 0xA5;
+constexpr uint8_t kResumeMagic = 0xA6;
 // Request-list flags byte (docs/liveness.md): the old bool shutdown byte
 // widened into a bitfield — old frames (0/1) parse identically.
 constexpr uint8_t kFlagShutdown = 1;
@@ -287,17 +288,20 @@ bool IsAggregateFrame(const std::string& bytes) {
 std::string SerializeResponseList(const std::vector<Response>& resps,
                                   double cycle_time_ms,
                                   int64_t fusion_threshold,
-                                  int hier_flags, int stripes) {
+                                  int hier_flags, int stripes,
+                                  long long epoch) {
   Writer w;
   w.u8(kResponseMagic);
   // Tuned-parameter piggyback (reference SynchronizeParameters,
   // controller.cc:33-47): the coordinator's current cycle time, fusion
-  // threshold, categorical hierarchical-dispatch flags, and cross-host
-  // stripe count ride every response broadcast; -1 = no hint.
+  // threshold, categorical hierarchical-dispatch flags, cross-host
+  // stripe count, and world epoch ride every response broadcast; -1 =
+  // no hint.
   w.f64(cycle_time_ms);
   w.i64(fusion_threshold);
   w.i32(hier_flags);
   w.i32(stripes);
+  w.i64(static_cast<int64_t>(epoch));
   w.i32(static_cast<int32_t>(resps.size()));
   for (const auto& p : resps) {
     w.u8(static_cast<uint8_t>(p.op));
@@ -326,17 +330,20 @@ bool DeserializeResponseList(const std::string& bytes,
                              std::vector<Response>* resps,
                              double* cycle_time_ms,
                              int64_t* fusion_threshold,
-                             int* hier_flags, int* stripes) {
+                             int* hier_flags, int* stripes,
+                             long long* epoch) {
   Reader r(bytes);
   if (r.u8() != kResponseMagic) return false;
   double cyc = r.f64();
   int64_t fus = r.i64();
   int32_t hf = r.i32();
   int32_t st = r.i32();
+  long long ep = static_cast<long long>(r.i64());
   if (cycle_time_ms != nullptr) *cycle_time_ms = cyc;
   if (fusion_threshold != nullptr) *fusion_threshold = fus;
   if (hier_flags != nullptr) *hier_flags = hf;
   if (stripes != nullptr) *stripes = st;
+  if (epoch != nullptr) *epoch = ep;
   int32_t n = r.i32();
   if (n < 0 || n > (1 << 24)) return false;
   resps->clear();
@@ -374,6 +381,40 @@ bool DeserializeResponseList(const std::string& bytes,
     if (!r.ok()) return false;  // same bail as the request loop
   }
   return r.ok();
+}
+
+std::string SerializeResume(long long epoch, int rank, long long send_seq,
+                            long long recv_seq) {
+  Writer w;
+  w.u8(kResumeMagic);
+  w.i64(static_cast<int64_t>(epoch));
+  w.i32(rank);
+  w.i64(static_cast<int64_t>(send_seq));
+  w.i64(static_cast<int64_t>(recv_seq));
+  return w.data();
+}
+
+bool DeserializeResume(const std::string& bytes, long long* epoch,
+                       int* rank, long long* send_seq, long long* recv_seq) {
+  Reader r(bytes);
+  if (r.u8() != kResumeMagic) return false;
+  long long ep = static_cast<long long>(r.i64());
+  int32_t rk = r.i32();
+  long long ss = static_cast<long long>(r.i64());
+  long long rs = static_cast<long long>(r.i64());
+  // Negative counters or an out-of-range rank cannot be produced by a
+  // healthy sender — a corrupted resume must abort the redial, never
+  // seed the seq reconciliation with garbage.
+  if (!r.ok() || rk < 0 || ss < 0 || rs < 0) return false;
+  if (epoch != nullptr) *epoch = ep;
+  if (rank != nullptr) *rank = rk;
+  if (send_seq != nullptr) *send_seq = ss;
+  if (recv_seq != nullptr) *recv_seq = rs;
+  return true;
+}
+
+bool IsResumeFrame(const std::string& bytes) {
+  return !bytes.empty() && static_cast<uint8_t>(bytes[0]) == kResumeMagic;
 }
 
 void EncodeStripeHdr(uint32_t seq, uint32_t len, char out[kStripeHdrBytes]) {

@@ -152,16 +152,38 @@ bool IsAggregateFrame(const std::string& bytes);
 // leader pair (the tuner's categorical dimensions — every rank applies
 // a synced stripe count at the same frame boundary so both sides of
 // every pair renegotiate their cross transport in lock-step).
+// epoch: the world incarnation the coordinator stamped at bootstrap
+// (docs/self-healing.md) — a worker holding a different epoch is talking
+// to the wrong world's coordinator (split brain) and must shut down; -1
+// = no hint (legacy frames).
 std::string SerializeResponseList(const std::vector<Response>& resps,
                                   double cycle_time_ms = -1.0,
                                   int64_t fusion_threshold = -1,
-                                  int hier_flags = -1, int stripes = -1);
+                                  int hier_flags = -1, int stripes = -1,
+                                  long long epoch = -1);
 bool DeserializeResponseList(const std::string& bytes,
                              std::vector<Response>* resps,
                              double* cycle_time_ms = nullptr,
                              int64_t* fusion_threshold = nullptr,
                              int* hier_flags = nullptr,
-                             int* stripes = nullptr);
+                             int* stripes = nullptr,
+                             long long* epoch = nullptr);
+
+// ---- link resume handshake (docs/self-healing.md) -------------------------
+//
+// After a cross-host data link drops and is redialed in place, both ends
+// exchange one resume frame over the fresh socket before any payload:
+// "I am <rank> in world <epoch>; I have sent you send_seq frames and
+// received recv_seq frames." Each side compares the peer's recv_seq with
+// its own send_seq to decide whether the in-flight frame must be replayed
+// (peer never got it) or suppressed (peer got it before the cut —
+// replaying would double-apply). A mismatched epoch means one end belongs
+// to a torn-down world: reject, never resume across incarnations.
+std::string SerializeResume(long long epoch, int rank, long long send_seq,
+                            long long recv_seq);
+bool DeserializeResume(const std::string& bytes, long long* epoch,
+                       int* rank, long long* send_seq, long long* recv_seq);
+bool IsResumeFrame(const std::string& bytes);
 
 // ---- striped cross-host transport wire contract ---------------------------
 //

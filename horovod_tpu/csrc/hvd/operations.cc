@@ -118,6 +118,13 @@ struct GlobalState {
   // receives raw Controller*/Ring* captured under init_mu at thread
   // start (BackgroundLoop's parameters), and hvd_shutdown joins it
   // before the reset — the happens-before is structural.
+  // World incarnation counter (docs/self-healing.md): bumped by every
+  // successful hvd_init in this process, stamped by the coordinator into
+  // the endpoint-map broadcast and every response frame, and carried in
+  // every data-plane hello so stale-world traffic is rejectable. Guarded
+  // like the controller it feeds (written under init_mu; the snapshot
+  // reads it under the same lock).
+  long long world_epoch GUARDED_BY(init_mu) = 0;
   std::unique_ptr<Controller> controller GUARDED_BY(init_mu);
   std::unique_ptr<Ring> ring GUARDED_BY(init_mu);
   Listener data_listener GUARDED_BY(init_mu);
@@ -368,6 +375,17 @@ std::string BuildMetricsJsonLocked(GlobalState* s,
              hf >= 0 ? hf : s->hier_env_flags.load(), &first);
     AppendKV(out, "tuned_hier_flags", hf, &first);
   }
+  // Self-healing plane (docs/self-healing.md): world incarnation plus
+  // the link-heal counters — a healed transient shows up here (and in
+  // the LINK_RECONNECT timeline instant), never as an eviction.
+  AppendKV(out, "epoch",
+           s->controller ? s->controller->epoch() : s->world_epoch, &first);
+  AppendKV(out, "link.reconnects",
+           s->ring ? s->ring->link_reconnects() : 0, &first);
+  AppendKV(out, "link.resume_chunks_discarded",
+           s->ring ? s->ring->resume_chunks_discarded() : 0, &first);
+  AppendKV(out, "link.stale_epoch_rejected",
+           s->ring ? s->ring->stale_epoch_rejected() : 0, &first);
   out += "},\"histograms\":{";
   for (int i = 0; i < metrics::kNumHistograms; ++i) {
     const auto& h = reg.hist(i);
@@ -799,10 +817,18 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   s->loop_done.store(false);
   s->tensor_queue.Reopen();  // re-arm after a prior world's final drain
 
+  // New world incarnation: every successful init (first boot or elastic
+  // re-init) gets a fresh epoch. Rank 0's value is authoritative — the
+  // controller broadcasts it with the endpoint map and every rank's data
+  // plane stamps the adopted value into its hellos, fencing off traffic
+  // from any torn-down predecessor world (docs/self-healing.md).
+  s->world_epoch += 1;
+
   hvd::ControllerConfig cfg;
   cfg.rank = rank;
   cfg.size = size;
   cfg.cross_rank = cross_rank;
+  cfg.epoch = s->world_epoch;
   cfg.coordinator_addr = coordinator_addr ? coordinator_addr : "127.0.0.1";
   cfg.coordinator_port = coordinator_port;
   cfg.fusion_threshold_bytes = static_cast<int64_t>(fusion_threshold);
@@ -845,6 +871,10 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   }
   if (size > 1) {
     s->ring = std::make_unique<hvd::Ring>();
+    // The data plane stamps the ADOPTED epoch (the coordinator's, not
+    // this process's counter) into every hello and resume frame — set
+    // before Connect so even the bootstrap dials are fenced.
+    s->ring->set_epoch(s->controller->epoch());
     st = s->ring->Connect(rank, s->controller->data_endpoints(),
                           &s->data_listener);
     if (!st.ok()) {

@@ -9,12 +9,36 @@
 #include <cstring>
 #include <thread>
 
+#include "env_util.h"
 #include "half.h"
+#include "message.h"
 #include "metrics.h"
 
 namespace hvd {
 
 namespace {
+
+// ---- self-healing link policy (docs/self-healing.md) ----------------------
+// Bounded in-place reconnect knobs. The deadline default sits well below
+// the liveness timeout default (HOROVOD_LIVENESS_TIMEOUT_MS = 10000) on
+// purpose: a link that cannot heal in time must surface as exactly the
+// pre-healing transport error so the evict/elastic path fires — healing
+// must never mask a real death past the liveness window.
+int LinkRetryAttempts() {
+  return static_cast<int>(EnvLL("HOROVOD_LINK_RETRY_ATTEMPTS", 3));
+}
+long long LinkRetryBackoffMs() {
+  return EnvMs("HOROVOD_LINK_RETRY_BACKOFF_MS", 100);
+}
+long long LinkRetryDeadlineMs() {
+  return EnvMs("HOROVOD_LINK_RETRY_DEADLINE_MS", 3000);
+}
+
+long long SteadyNowMs() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
 
 // ---- dtype-generic float view ---------------------------------------------
 // All reductions accumulate in double-width host arithmetic: fp32 for
@@ -250,7 +274,8 @@ void Ring::ConfigureTransports(bool use_shm, long long slot_bytes,
   stripe_ = std::make_unique<StripeTransport>();
   stripe_->Init(rank_, endpoints_, stripes, chunk_bytes,
                 stripe_fallthrough,
-                [this](int peer) { return PumpStripeAccepts(peer); });
+                [this](int peer) { return PumpStripeAccepts(peer); },
+                epoch_);
   // The CROSS legs only route through the registry when striping is
   // configured: with K <= 1 they keep the direct PeerLink duplex — no
   // negotiation frames, bit-for-bit the pre-stripe path. K > 1 worlds
@@ -453,6 +478,15 @@ bool Ring::CountedSendFrame(Socket& sock, int peer,
 bool Ring::SendRecvDuplex(Socket* send_sock, int send_peer,
                           const void* sbuf, size_t sbytes,
                           Socket* recv_sock, void* rbuf, size_t rbytes) {
+  bool send_ok = false, recv_ok = false;
+  DuplexSplit(send_sock, send_peer, sbuf, sbytes, recv_sock, rbuf, rbytes,
+              &send_ok, &recv_ok);
+  return send_ok && recv_ok;
+}
+
+void Ring::DuplexSplit(Socket* send_sock, int send_peer, const void* sbuf,
+                       size_t sbytes, Socket* recv_sock, void* rbuf,
+                       size_t rbytes, bool* send_ok_out, bool* recv_ok_out) {
   static const char kEmpty = 0;
   // A null sbuf (legal for 0-byte fragments) must not look like "no
   // pending send" to the sender loop's wakeup predicate.
@@ -473,17 +507,38 @@ bool Ring::SendRecvDuplex(Socket* send_sock, int send_peer,
     UniqueLock lk(send_mu_);
     while (!send_done_) send_cv_.wait(lk);
     if (recv_ok && rbytes > 0) std::memcpy(rbuf, rframe.data(), rbytes);
-    return send_ok_ && recv_ok;
+    *send_ok_out = send_ok_;
+    *recv_ok_out = recv_ok;
   }
 }
 
 bool Ring::MaybeAdoptStripeHello(const std::string& hello, Socket& s) {
   if (hello.rfind("stripe ", 0) != 0) return false;
   int pr = -1, idx = -1;
-  if (stripe_ != nullptr &&
-      std::sscanf(hello.c_str(), "stripe %d %d", &pr, &idx) == 2) {
+  long long ep = -1;
+  int fields =
+      std::sscanf(hello.c_str(), "stripe %d %d %lld", &pr, &idx, &ep);
+  if (fields >= 3 && ep >= 0 && ep != epoch_) {
+    // A stripe dial from a different world incarnation: never adopt it
+    // — its pieces would interleave into this world's streams. The
+    // socket dies with the caller's scope.
+    stale_epoch_rejected_.fetch_add(1);
+    return true;
+  }
+  if (stripe_ != nullptr && fields >= 2) {
     stripe_->Adopt(pr, idx, std::move(s));
   }
+  return true;
+}
+
+bool Ring::ParsePeerHello(const std::string& hello, int* peer, bool* stale) {
+  if (hello.rfind("vhdd ", 0) != 0) return false;
+  int pr = -1;
+  long long ep = -1;
+  int fields = std::sscanf(hello.c_str(), "vhdd %d %lld", &pr, &ep);
+  if (fields < 1) return false;
+  *peer = pr;
+  *stale = fields >= 2 && ep >= 0 && ep != epoch_;
   return true;
 }
 
@@ -499,8 +554,14 @@ bool Ring::PumpStripeAccepts(int peer) {
     if (!s.valid()) return false;
     std::string hello;
     if (!s.RecvFrame(&hello)) continue;
-    if (hello.rfind("vhdd ", 0) == 0) {
-      peers_[std::atoi(hello.c_str() + 5)] = std::move(s);
+    int pr = -1;
+    bool stale = false;
+    if (ParsePeerHello(hello, &pr, &stale)) {
+      if (stale) {
+        stale_epoch_rejected_.fetch_add(1);
+        continue;
+      }
+      peers_[pr] = std::move(s);
       continue;
     }
     MaybeAdoptStripeHello(hello, s);
@@ -534,12 +595,35 @@ bool Ring::CrossSendRecv(int next, const void* sbuf, size_t sbytes,
   } timer{cross_ns_, stripe_ != nullptr && stripe_->active_stripes() > 0};
   if (!cross_registry_ || op_mgr_ == nullptr) {
     // Striping off: the direct PeerLink duplex, bit-for-bit the
-    // pre-stripe path (no negotiation frames).
+    // pre-stripe path (no negotiation frames) — plus the self-healing
+    // wrap (docs/self-healing.md): a lost leg redials in place and
+    // resumes at the exact frame boundary instead of failing the
+    // collective outright.
     Socket* snext = PeerLink(next);
     Socket* sprev = PeerLink(prev);
     if (snext == nullptr || sprev == nullptr) return false;
-    if (!SendRecvDuplex(snext, next, sbuf, sbytes, sprev, rbuf, rbytes)) {
-      return false;
+    if (cross_drop_at_ > 0 && ++cross_duplex_n_ == cross_drop_at_) {
+      // HVD_FAULT_CROSS_DROP seam: cut the outbound cross link right
+      // before this step's payload moves — both ends see a dead stream
+      // mid-collective, the exact shape the healer must absorb.
+      std::fprintf(stderr,
+                   "[hvd fault] rank %d dropping cross link to %d before "
+                   "duplex %lld\n",
+                   rank_, next, cross_duplex_n_);
+      snext->ShutdownBoth();
+    }
+    const long long base_send = cross_send_seq_[next];
+    const long long base_recv = cross_recv_seq_[prev];
+    bool send_ok = false, recv_ok = false;
+    DuplexSplit(snext, next, sbuf, sbytes, sprev, rbuf, rbytes, &send_ok,
+                &recv_ok);
+    if (send_ok) cross_send_seq_[next] = base_send + 1;
+    if (recv_ok) cross_recv_seq_[prev] = base_recv + 1;
+    if (!send_ok || !recv_ok) {
+      if (!HealCrossStep(next, sbuf, sbytes, prev, rbuf, rbytes, base_send,
+                         base_recv)) {
+        return false;
+      }
     }
     if (on_piece) on_piece(0, rbytes);
     return true;
@@ -586,6 +670,165 @@ bool Ring::CrossSendRecv(int next, const void* sbuf, size_t sbytes,
   return send_ok_ && recv_ok;
 }
 
+bool Ring::HealPeerLink(int peer, long long deadline_ms,
+                        long long* peer_send_seq, long long* peer_recv_seq) {
+  // Drop the dead link first: erasing closes the fd, which also fails
+  // the peer's half fast if it hasn't noticed the cut yet.
+  peers_.erase(peer);
+  long long remain = deadline_ms - SteadyNowMs();
+  if (remain < 1) return false;
+  Socket fresh;
+  if (rank_ < peer) {
+    // Same deterministic dial rule as PeerLink, bounded by the retry
+    // deadline instead of the bootstrap timeout.
+    fresh = Socket::Connect(endpoints_[peer].first, endpoints_[peer].second,
+                            static_cast<int>(remain));
+    if (!fresh.valid()) return false;
+    if (!fresh.SendFrame("vhdd " + std::to_string(rank_) + " " +
+                         std::to_string(epoch_))) {
+      return false;
+    }
+  } else {
+    for (int tries = 0; tries < 64 && !fresh.valid(); ++tries) {
+      remain = deadline_ms - SteadyNowMs();
+      if (remain < 1 || listener_ == nullptr) return false;
+      Socket s = listener_->Accept(static_cast<int>(remain));
+      if (!s.valid()) return false;
+      std::string hello;
+      if (!s.RecvFrame(&hello)) continue;
+      if (MaybeAdoptStripeHello(hello, s)) continue;
+      int pr = -1;
+      bool stale = false;
+      if (!ParsePeerHello(hello, &pr, &stale)) continue;
+      if (stale) {
+        stale_epoch_rejected_.fetch_add(1);
+        continue;
+      }
+      if (pr == peer) {
+        fresh = std::move(s);
+      } else {
+        peers_[pr] = std::move(s);
+      }
+    }
+    if (!fresh.valid()) return false;
+  }
+  // Resume exchange over the fresh socket, before any payload. Dialer
+  // speaks first — deterministic like the dial rule itself, so the two
+  // ends never cross frames.
+  std::string mine = SerializeResume(epoch_, rank_, cross_send_seq_[peer],
+                                     cross_recv_seq_[peer]);
+  std::string theirs;
+  bool moved = rank_ < peer
+                   ? fresh.SendFrame(mine) &&
+                         fresh.RecvFrameTimeout(
+                             &theirs,
+                             static_cast<int>(
+                                 std::max<long long>(
+                                     1, deadline_ms - SteadyNowMs()))) == 1
+                   : fresh.RecvFrameTimeout(
+                         &theirs,
+                         static_cast<int>(std::max<long long>(
+                             1, deadline_ms - SteadyNowMs()))) == 1 &&
+                         fresh.SendFrame(mine);
+  if (!moved) return false;
+  long long pep = -1, pss = -1, prs = -1;
+  int prk = -1;
+  if (!DeserializeResume(theirs, &pep, &prk, &pss, &prs) || prk != peer) {
+    return false;
+  }
+  if (pep != epoch_) {
+    // The far end belongs to a different world incarnation: resuming
+    // would splice two worlds' byte streams. Reject and count.
+    stale_epoch_rejected_.fetch_add(1);
+    return false;
+  }
+  peers_[peer] = std::move(fresh);
+  link_reconnects_.fetch_add(1);
+  *peer_send_seq = pss;
+  *peer_recv_seq = prs;
+  return true;
+}
+
+bool Ring::HealCrossStep(int next, const void* sbuf, size_t sbytes,
+                         int prev, void* rbuf, size_t rbytes,
+                         long long base_send, long long base_recv) {
+  const int attempts = LinkRetryAttempts();
+  const long long backoff = LinkRetryBackoffMs();
+  const long long deadline = SteadyNowMs() + LinkRetryDeadlineMs();
+  for (int a = 0; a < attempts; ++a) {
+    if (a > 0) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(backoff));
+    }
+    if (SteadyNowMs() >= deadline) break;
+    bool need_send = cross_send_seq_[next] == base_send;
+    bool need_recv = cross_recv_seq_[prev] == base_recv;
+    if (!need_send && !need_recv) return true;
+    // Redial every link with a pending leg; one redial + one resume
+    // exchange covers both directions when next == prev (the two-host
+    // leader pair, where a single socket is full-duplex).
+    long long p_send = -1, p_recv = -1;
+    if (need_send || (next == prev && need_recv)) {
+      if (!HealPeerLink(next, deadline, &p_send, &p_recv)) continue;
+      if (need_send) {
+        if (p_recv == base_send + 1) {
+          // The in-flight frame crossed before the cut: replaying it
+          // would double-apply, so suppress it and count.
+          resume_chunks_discarded_.fetch_add(1);
+          cross_send_seq_[next] = base_send + 1;
+          need_send = false;
+        } else if (p_recv != base_send) {
+          // More than one frame adrift — impossible under lock-step
+          // duplex unless streams desynced. Unrecoverable in place.
+          return false;
+        }
+      } else if (p_recv == base_send) {
+        // Our send "succeeded" only into the dying socket's buffer: the
+        // peer's resume says it is still waiting on THIS step's frame
+        // (the model's resume_skips_chunk tooth, tools/hvdmc). The
+        // caller buffer is live — same duplex step — so rewind the seq
+        // and replay.
+        cross_send_seq_[next] = base_send;
+        need_send = true;
+      } else if (p_recv != base_send + 1) {
+        return false;
+      }
+      if (next == prev && need_recv && p_send != base_recv &&
+          p_send != base_recv + 1) {
+        return false;
+      }
+    }
+    if (next != prev && need_recv) {
+      if (!HealPeerLink(prev, deadline, &p_send, &p_recv)) continue;
+      // p_send == base_recv + 1 is fine: the peer thinks it sent the
+      // frame we never got; our resume told it our recv_seq, so it
+      // rewinds and replays (its caller buffer is still live — it is
+      // inside the same duplex step).
+      if (p_send != base_recv && p_send != base_recv + 1) return false;
+    }
+    // Replay exactly the pending legs on the fresh link(s).
+    Socket* snext = need_send ? PeerLink(next) : nullptr;
+    Socket* sprev = need_recv ? PeerLink(prev) : nullptr;
+    if ((need_send && snext == nullptr) ||
+        (need_recv && sprev == nullptr)) {
+      continue;
+    }
+    bool sok = !need_send, rok = !need_recv;
+    if (need_send && need_recv) {
+      DuplexSplit(snext, next, sbuf, sbytes, sprev, rbuf, rbytes, &sok,
+                  &rok);
+    } else if (need_send) {
+      sok = snext->SendFrame(sbuf, sbytes);
+      if (sok) AddSent(next, sbytes);
+    } else if (need_recv) {
+      rok = sprev->RecvFrameInto(rbuf, rbytes);
+    }
+    if (sok) cross_send_seq_[next] = base_send + 1;
+    if (rok) cross_recv_seq_[prev] = base_recv + 1;
+    if (sok && rok) return true;
+  }
+  return false;
+}
+
 bool Ring::SendRecvStep(const void* sbuf, size_t sbytes, void* rbuf,
                         size_t rbytes) {
   return SendRecvDuplex(&next_, (rank_ + 1) % size_, sbuf, sbytes, &prev_,
@@ -612,6 +855,16 @@ Status Ring::Connect(int rank, const std::vector<std::pair<std::string, int>>&
   size_ = static_cast<int>(endpoints.size());
   endpoints_ = endpoints;
   listener_ = listener;
+  if (const char* spec = std::getenv("HVD_FAULT_CROSS_DROP")) {
+    // Fault seam (docs/fault-injection.md): "rank:n" — on that rank, cut
+    // the cross link right before its n-th cross duplex step.
+    int fr = -1;
+    long long fn = -1;
+    if (std::sscanf(spec, "%d:%lld", &fr, &fn) == 2 && fr == rank_ &&
+        fn > 0) {
+      cross_drop_at_ = fn;
+    }
+  }
   if (size_ == 1) return Status::OK();
   int next_rank = (rank_ + 1) % size_;
   // Even ranks connect first then accept; odd ranks accept first — avoids
@@ -620,24 +873,38 @@ Status Ring::Connect(int rank, const std::vector<std::pair<std::string, int>>&
     next_ = Socket::Connect(endpoints[next_rank].first,
                             endpoints[next_rank].second, 120000);
     if (!next_.valid()) return false;
-    return CountedSendFrame(next_, next_rank, std::to_string(rank_));
+    return CountedSendFrame(next_, next_rank,
+                            std::to_string(rank_) + " " +
+                                std::to_string(epoch_));
   };
   int prev_rank = (rank_ - 1 + size_) % size_;
   auto answer = [&]() -> bool {
     // Accept until the peer introducing itself as prev arrives; stash
     // early VHDD peer dials (and stripe dials) instead of mistaking
-    // them for prev.
+    // them for prev. Any hello carrying a foreign world epoch is
+    // rejected outright (docs/self-healing.md).
     for (int tries = 0; tries < 64; ++tries) {
       Socket s = listener->Accept(120000);
       if (!s.valid()) return false;
       std::string hello;
       if (!s.RecvFrame(&hello)) continue;
-      if (hello.rfind("vhdd ", 0) == 0) {
-        int pr = std::atoi(hello.c_str() + 5);
+      int pr = -1;
+      bool stale = false;
+      if (ParsePeerHello(hello, &pr, &stale)) {
+        if (stale) {
+          stale_epoch_rejected_.fetch_add(1);
+          continue;
+        }
         peers_[pr] = std::move(s);
         continue;
       }
       if (MaybeAdoptStripeHello(hello, s)) continue;
+      long long ep = -1;
+      if (std::sscanf(hello.c_str(), "%d %lld", &pr, &ep) >= 2 &&
+          ep >= 0 && ep != epoch_) {
+        stale_epoch_rejected_.fetch_add(1);
+        continue;
+      }
       if (std::atoi(hello.c_str()) != prev_rank) continue;
       prev_ = std::move(s);
       return true;
@@ -1084,11 +1351,29 @@ Socket* Ring::PeerLink(int peer) {
   if (it != peers_.end()) return &it->second;
   if (peer < 0 || peer >= size_ || peer == rank_) return nullptr;
   if (rank_ < peer) {
+    if (!stale_hello_fired_) {
+      const char* e = std::getenv("HVD_TEST_STALE_HELLO");
+      if (e != nullptr && *e != 0 && std::strcmp(e, "0") != 0) {
+        // Fencing seam (tests/test_selfheal.py): before the real dial,
+        // burn one throwaway connection introducing itself with LAST
+        // world's epoch. The peer's accept loop must reject it (counted
+        // in its stale_epoch_rejected) and still adopt the real dial.
+        stale_hello_fired_ = true;
+        Socket stale = Socket::Connect(endpoints_[peer].first,
+                                       endpoints_[peer].second, 120000);
+        if (stale.valid()) {
+          stale.SendFrame("vhdd " + std::to_string(rank_) + " " +
+                          std::to_string(epoch_ - 1));
+        }
+      }
+    }
     // Lower rank dials; deterministic on both sides, so no crossed dials.
     Socket s = Socket::Connect(endpoints_[peer].first,
                                endpoints_[peer].second, 120000);
     if (!s.valid()) return nullptr;
-    if (!CountedSendFrame(s, peer, "vhdd " + std::to_string(rank_)))
+    if (!CountedSendFrame(s, peer,
+                          "vhdd " + std::to_string(rank_) + " " +
+                              std::to_string(epoch_)))
       return nullptr;
     peers_[peer] = std::move(s);
   } else {
@@ -1105,8 +1390,13 @@ Socket* Ring::PeerLink(int peer) {
       std::string hello;
       if (!s.RecvFrame(&hello)) continue;
       if (MaybeAdoptStripeHello(hello, s)) continue;
-      if (hello.rfind("vhdd ", 0) != 0) continue;
-      int pr = std::atoi(hello.c_str() + 5);
+      int pr = -1;
+      bool stale = false;
+      if (!ParsePeerHello(hello, &pr, &stale)) continue;
+      if (stale) {
+        stale_epoch_rejected_.fetch_add(1);
+        continue;
+      }
       peers_[pr] = std::move(s);
     }
     if (peers_.find(peer) == peers_.end()) return nullptr;

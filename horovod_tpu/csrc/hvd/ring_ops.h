@@ -170,6 +170,25 @@ class Ring {
   // yield-spins, which the leg never touches.
   long long cross_leg_ns() const { return cross_ns_.load(); }
 
+  // World-epoch fencing (docs/self-healing.md): the controller hands the
+  // coordinator-stamped incarnation down before Connect; every data-plane
+  // hello (ring neighbor, vhdd peer link, stripe dial) carries it and
+  // every accept loop rejects a mismatch — a frame from a torn-down
+  // world's rank must never be adopted into this one.
+  void set_epoch(long long e) { epoch_ = e; }
+  long long epoch() const { return epoch_; }
+  // Self-healing counters (hvd_metrics_snapshot keys of the same names):
+  // links redialed in place after a mid-collective cut, in-flight chunks
+  // suppressed at resume because the peer had them before the cut, and
+  // hellos/resumes rejected for carrying a stale world epoch.
+  long long link_reconnects() const { return link_reconnects_.load(); }
+  long long resume_chunks_discarded() const {
+    return resume_chunks_discarded_.load();
+  }
+  long long stale_epoch_rejected() const {
+    return stale_epoch_rejected_.load();
+  }
+
  private:
   // Full-duplex step: send on `sock` while receiving from `recv_sock`,
   // using one persistent sender thread (no per-step thread spawn on the
@@ -179,6 +198,12 @@ class Ring {
   bool SendRecvDuplex(Socket* send_sock, int send_peer, const void* sbuf,
                       size_t sbytes, Socket* recv_sock, void* rbuf,
                       size_t rbytes);
+  // SendRecvDuplex with the per-leg outcomes split out, so the healing
+  // path can tell "my frame left but theirs never arrived" from a dead
+  // link in both directions and replay only what is actually pending.
+  void DuplexSplit(Socket* send_sock, int send_peer, const void* sbuf,
+                   size_t sbytes, Socket* recv_sock, void* rbuf,
+                   size_t rbytes, bool* send_ok_out, bool* recv_ok_out);
   bool SendRecvStep(const void* sbuf, size_t sbytes, void* rbuf,
                     size_t rbytes);
   // Full-duplex CROSS-leg step through the transport registry: send
@@ -208,6 +233,29 @@ class Ring {
   // backend (or dropped if malformed/backend absent) and the caller
   // must `continue`; false leaves `s` untouched for the caller.
   bool MaybeAdoptStripeHello(const std::string& hello, Socket& s);
+  // Parse a "vhdd <rank> [<epoch>]" data hello. True when it IS a peer
+  // hello (rank in *peer); *stale set when it carries a world epoch that
+  // is not ours — the caller must drop the socket and count it, never
+  // stash it. A missing epoch field is tolerated (pre-epoch dialers).
+  bool ParsePeerHello(const std::string& hello, int* peer, bool* stale);
+  // Bounded in-place recovery for one cross duplex step that lost a leg
+  // (docs/self-healing.md): under HOROVOD_LINK_RETRY_*, redial the dead
+  // link(s), exchange epoch+seq resume frames, reconcile which of the
+  // two in-flight frames actually crossed before the cut, and replay
+  // exactly the pending ones. base_send/base_recv are the step's frame
+  // indices (the seq counters on entry). False = retries exhausted or
+  // the peer is more than one frame adrift — the caller raises exactly
+  // the pre-healing error into the evict/elastic path.
+  bool HealCrossStep(int next, const void* sbuf, size_t sbytes, int prev,
+                     void* rbuf, size_t rbytes, long long base_send,
+                     long long base_recv);
+  // One link redial + resume handshake: drop the dead peers_ entry,
+  // re-establish under PeerLink's deterministic dial rule (bounded by
+  // `deadline_ms`, an absolute steady-clock ms), exchange resume frames
+  // (dialer speaks first), fence the peer's epoch. On success the fresh
+  // socket is back in peers_ and the peer's counters are returned.
+  bool HealPeerLink(int peer, long long deadline_ms,
+                    long long* peer_send_seq, long long* peer_recv_seq);
   // Error propagation for a leader failing mid-collective: a 0-byte
   // frame on each member's LOCAL_BCAST channel fails their size-checked
   // phase-3 receive immediately, so the host errors together instead of
@@ -277,6 +325,23 @@ class Ring {
   std::atomic<long long> local_bytes_sent_{0};
   std::atomic<long long> cross_bytes_sent_{0};
   std::atomic<long long> cross_ns_{0};
+  std::atomic<long long> link_reconnects_{0};
+  std::atomic<long long> resume_chunks_discarded_{0};
+  std::atomic<long long> stale_epoch_rejected_{0};
+
+  // Self-healing state, all confined to the posting (background) thread
+  // like peers_ itself. The seq maps count frames fully moved per peer
+  // on the healed cross-duplex path — what the resume handshake
+  // reconciles; lock-step duplex bounds the possible divergence to one
+  // frame per direction. cross_drop_at_/cross_duplex_n_ are the
+  // HVD_FAULT_CROSS_DROP seam (fire a link cut before the n-th cross
+  // duplex); stale_hello_fired_ the one-shot HVD_TEST_STALE_HELLO seam.
+  long long epoch_ = 0;
+  std::map<int, long long> cross_send_seq_;
+  std::map<int, long long> cross_recv_seq_;
+  long long cross_drop_at_ = -1;
+  long long cross_duplex_n_ = 0;
+  bool stale_hello_fired_ = false;
 
   // Transport registry (ConfigureTransports). The TCP adapter wraps
   // PeerLink/CountedSendFrame so the fallback keeps the split

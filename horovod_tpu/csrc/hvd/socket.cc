@@ -62,6 +62,10 @@ void Socket::Close() {
   rpos_ = 0;
 }
 
+void Socket::ShutdownBoth() {
+  if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);
+}
+
 bool Socket::SendAll(const void* p, size_t n) {
   const char* c = static_cast<const char*>(p);
   while (n > 0) {

@@ -44,6 +44,12 @@ class Socket {
   bool valid() const { return fd_ >= 0; }
   int fd() const { return fd_; }
   void Close();
+  // Half of a fault seam (docs/fault-injection.md): tears down both
+  // directions of the TCP stream without releasing the fd, so every
+  // later send/recv on either end fails deterministically — the shape a
+  // mid-step connection drop presents to the self-healing data plane
+  // (docs/self-healing.md). Never called outside injected faults.
+  void ShutdownBoth();
 
   // Frame IO: 4-byte little-endian length + payload. Syscall-lean on
   // purpose — this runs under sandboxed kernels (gVisor-class) where a

@@ -30,8 +30,9 @@ bool ForceConnectFail() {
 void StripeTransport::Init(
     int rank, const std::vector<std::pair<std::string, int>>& endpoints,
     int stripes, long long chunk_bytes, bool allow_fallthrough,
-    AcceptPump pump) {
+    AcceptPump pump, long long epoch) {
   rank_ = rank;
+  epoch_ = epoch;
   endpoints_ = endpoints;
   stripes_.store(stripes > 1 ? stripes : 1);
   chunk_bytes_ = chunk_bytes;
@@ -72,7 +73,7 @@ bool StripeTransport::Prepare(int peer) {
     // connect needs no pending accept.
     if (!s.valid() ||
         !s.SendFrame("stripe " + std::to_string(rank_) + " " +
-                     std::to_string(i))) {
+                     std::to_string(i) + " " + std::to_string(epoch_))) {
       std::fprintf(stderr,
                    "[horovod_tpu] stripe: dial %d/%d to rank %d failed; "
                    "single-socket TCP carries this leg\n",

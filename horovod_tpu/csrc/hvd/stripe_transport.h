@@ -78,10 +78,13 @@ class StripeTransport : public TransportBackend {
   // `endpoints[r]` = rank r's data-plane (host, listener port) — where
   // stripe dials go. `stripes` <= 1 leaves the backend disabled (the
   // single-socket path needs no registry hop).
+  // `epoch` is the world incarnation stamped into every stripe dial
+  // hello ("stripe <rank> <idx> <epoch>") so the receiver's accept loop
+  // can fence dials from a torn-down world (docs/self-healing.md).
   void Init(int rank,
             const std::vector<std::pair<std::string, int>>& endpoints,
             int stripes, long long chunk_bytes, bool allow_fallthrough,
-            AcceptPump pump);
+            AcceptPump pump, long long epoch = 0);
 
   const char* Name() const override { return "stripe"; }
   bool Enabled() const override { return stripes_.load() > 1; }
@@ -129,6 +132,7 @@ class StripeTransport : public TransportBackend {
   };
 
   int rank_ = -1;
+  long long epoch_ = 0;
   std::vector<std::pair<std::string, int>> endpoints_;
   std::atomic<int> stripes_{1};
   long long chunk_bytes_ = 256 << 10;

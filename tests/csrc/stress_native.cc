@@ -589,6 +589,96 @@ void StripePhase() {
   unsetenv("HVD_STRIPE_FORCE_CONNECT_FAIL");
 }
 
+// Self-healing reconnect under the sanitizers (docs/self-healing.md): a
+// real 4-ring world (2 hosts x 2 ranks, leaders 0 and 1) runs
+// hierarchical allreduces concurrently while HVD_FAULT_CROSS_DROP cuts
+// leader 0's cross PeerLink mid-duplex. Both leaders' HealCrossStep /
+// HealPeerLink (redial + resume handshake + replay) race each other,
+// the members' local PeerLink legs, and a poller hammering the healing
+// counters — the getter-vs-heal interleaving the Python worlds cannot
+// observe races in. Every iteration's result must stay byte-exact
+// across the cut.
+void ReconnectPhase() {
+  constexpr int kRanks = 4;
+  constexpr int kIters = 6;
+  constexpr int64_t kCount = 16384;  // 64 KiB fp32: ring cross path
+  // Armed before any Connect (single-threaded), cleared after the
+  // joins: only the rank-0 ring matches the spec. Duplex 5 is the 3rd
+  // allreduce's reduce-scatter step (2 cross duplexes per H=2
+  // allreduce) — mid-run, link warm, later iterations prove the healed
+  // socket is a first-class peer link.
+  setenv("HVD_FAULT_CROSS_DROP", "0:5", 1);
+  hvd::Listener listeners[kRanks];
+  std::vector<std::pair<std::string, int>> eps;
+  for (int r = 0; r < kRanks; ++r) {
+    if (!listeners[r].Listen(0)) {
+      CHECK(false, "reconnect phase: listen");
+      unsetenv("HVD_FAULT_CROSS_DROP");
+      return;
+    }
+    eps.emplace_back("127.0.0.1", listeners[r].port());
+  }
+  hvd::Ring rings[kRanks];
+  std::atomic<bool> stop{false};
+  std::thread poll([&] {
+    volatile long long sink = 0;
+    while (!stop.load()) {
+      for (int r = 0; r < kRanks; ++r) {
+        sink += rings[r].link_reconnects() +
+                rings[r].resume_chunks_discarded() +
+                rings[r].stale_epoch_rejected() +
+                rings[r].cross_bytes_sent() + rings[r].cross_leg_ns();
+      }
+    }
+    (void)sink;
+  });
+  std::vector<std::thread> workers;
+  for (int r = 0; r < kRanks; ++r) {
+    workers.emplace_back([&, r] {
+      if (!rings[r].Connect(r, eps, &listeners[r]).ok()) {
+        CHECK(false, "reconnect phase: ring connect");
+        return;
+      }
+      rings[r].SetTopology({0, 1, 0, 1});  // round-robin, leaders 0+1
+      std::vector<float> buf(kCount);
+      for (int it = 0; it < kIters; ++it) {
+        for (int64_t i = 0; i < kCount; ++i) {
+          buf[i] = static_cast<float>((i % 13) + r);
+        }
+        hvd::Status st = rings[r].HierAllreduce(
+            buf.data(), buf.data(), kCount, hvd::DataType::HVD_FLOAT32,
+            hvd::ReduceOp::SUM, 1.0, 1.0);
+        CHECK(st.ok(), "reconnect phase: hier allreduce across the cut");
+        if (!st.ok()) return;
+        // Small integers: exact in fp32 at any summation order, so the
+        // healed iteration must equal the closed form exactly.
+        for (int64_t i = 0; i < kCount; ++i) {
+          if (buf[i] != static_cast<float>((i % 13) * kRanks + 6)) {
+            CHECK(false, "reconnect phase: payload diverged");
+            break;
+          }
+        }
+      }
+    });
+  }
+  for (auto& w : workers) w.join();
+  stop.store(true);
+  poll.join();
+  unsetenv("HVD_FAULT_CROSS_DROP");
+  if (failures) return;
+  // Both ends of the cut leg healed in place; nobody else did, and no
+  // stale-epoch frame ever appeared (all rings share epoch 0).
+  CHECK(rings[0].link_reconnects() >= 1, "leader 0 counted its heal");
+  CHECK(rings[1].link_reconnects() >= 1, "leader 1 counted its heal");
+  CHECK(rings[2].link_reconnects() == 0 &&
+            rings[3].link_reconnects() == 0,
+        "members never heal");
+  for (int r = 0; r < kRanks; ++r) {
+    CHECK(rings[r].stale_epoch_rejected() == 0,
+          "no stale epochs in a single-incarnation world");
+  }
+}
+
 }  // namespace
 
 int main() {
@@ -598,6 +688,7 @@ int main() {
   if (failures == 0) RingPhase();
   if (failures == 0) ShmPhase();
   if (failures == 0) StripePhase();
+  if (failures == 0) ReconnectPhase();
   if (failures == 0) LivenessControllerPhase();
   if (failures) return 1;
   std::puts("STRESS_OK");

@@ -123,8 +123,15 @@ std::string GoldenRequestFrame() {
 
 std::string GoldenResponseFrame() {
   // Every piggyback hint pinned: cycle 2.5 ms, fusion 1 MiB,
-  // hier_flags 3, stripes 4.
-  return SerializeResponseList({GoldenResponse()}, 2.5, 1 << 20, 3, 4);
+  // hier_flags 3, stripes 4, world epoch 5.
+  return SerializeResponseList({GoldenResponse()}, 2.5, 1 << 20, 3, 4, 5);
+}
+
+std::string GoldenResumeFrame() {
+  // Link resume handshake (docs/self-healing.md): epoch 5, rank 2,
+  // 7 frames sent / 9 received at the cut.
+  return SerializeResume(/*epoch=*/5, /*rank=*/2, /*send_seq=*/7,
+                         /*recv_seq=*/9);
 }
 
 std::string GoldenDeltaFrame() {
@@ -156,7 +163,8 @@ std::string GoldenStripeHdr() {
 
 // The hello line is a whitespace-delimited string, not a Writer frame —
 // pinned anyway: controller.cc's sscanf contract is part of the wire.
-const char kGoldenHello[] = "2 10.0.0.7 41000 ab12cd 1";
+// Field 6 is the worker's local incarnation counter (docs/self-healing.md).
+const char kGoldenHello[] = "2 10.0.0.7 41000 ab12cd 1 5";
 
 void PrintHex(const char* name, const std::string& bytes) {
   std::printf("GOLDEN %s ", name);
@@ -172,6 +180,7 @@ int GoldenMain() {
   PrintHex("stripe_hdr", GoldenStripeHdr());
   PrintHex("delta", GoldenDeltaFrame());
   PrintHex("aggregate", GoldenAggregateFrame());
+  PrintHex("resume", GoldenResumeFrame());
   return 0;
 }
 
@@ -216,9 +225,12 @@ int FuzzMain(const char* corpus_path) {
     std::vector<uint32_t> dids;
     bool dsd = false, ddr = false;
     bool delta_ok = DeserializeDeltaFrame(bytes, &drank, &dids, &dsd, &ddr);
-    std::printf("V %u req=%d resp=%d agg=%d delta=%d\n", i,
+    long long rep, rss, rrs;
+    int rrk;
+    bool resume_ok = DeserializeResume(bytes, &rep, &rrk, &rss, &rrs);
+    std::printf("V %u req=%d resp=%d agg=%d delta=%d resume=%d\n", i,
                 req_ok ? 1 : 0, resp_ok ? 1 : 0, agg_ok ? 1 : 0,
-                delta_ok ? 1 : 0);
+                delta_ok ? 1 : 0, resume_ok ? 1 : 0);
   }
   std::fclose(f);
   std::puts("FUZZ_DONE");
@@ -377,28 +389,32 @@ int main(int argc, char** argv) {
   }
 
   // 8. Hello-line contract (controller.cc:277 sscanf shape): the
-  // whitespace-delimited "rank host data_port job_key cross_rank" must
-  // parse field-position-stably — a 4-field (pre-PR 4) hello yields
-  // fields==4 and leaves cross at its -1 sentinel, so old workers are
-  // grouped by the coordinator's collision-free default instead of
-  // being folded into host 0.
+  // whitespace-delimited "rank host data_port job_key cross_rank epoch"
+  // must parse field-position-stably — a 4-field (pre-PR 4) hello
+  // yields fields==4 and leaves cross at its -1 sentinel, so old
+  // workers are grouped by the coordinator's collision-free default
+  // instead of being folded into host 0; a 5-field (pre-self-healing)
+  // hello leaves epoch at its -1 sentinel.
   {
     struct Case {
       const char* hello;
       int want_fields, want_rank, want_port, want_cross;
+      long long want_epoch;
     } cases[] = {
-        {"2 10.0.0.7 41000 ab12cd 1", 5, 2, 41000, 1},
-        {"2 10.0.0.7 41000 - 0", 5, 2, 41000, 0},   // empty job key
-        {"2 10.0.0.7 41000 ab12cd", 4, 2, 41000, -1},  // pre-PR4 hello
-        {"2 10.0.0.7 41000", 3, 2, 41000, -1},
-        {"garbage", 0, 0, 0, -1},
+        {"2 10.0.0.7 41000 ab12cd 1 5", 6, 2, 41000, 1, 5},
+        {"2 10.0.0.7 41000 ab12cd 1", 5, 2, 41000, 1, -1},
+        {"2 10.0.0.7 41000 - 0 0", 6, 2, 41000, 0, 0},  // empty job key
+        {"2 10.0.0.7 41000 ab12cd", 4, 2, 41000, -1, -1},  // pre-PR4
+        {"2 10.0.0.7 41000", 3, 2, 41000, -1, -1},
+        {"garbage", 0, 0, 0, -1, -1},
     };
     for (const auto& c : cases) {
       int rank = 0, port = 0, cross = -1;
+      long long epoch = -1;
       char host[256] = {0};
       char key[256] = {0};
-      int fields = std::sscanf(c.hello, "%d %255s %d %255s %d", &rank,
-                               host, &port, key, &cross);
+      int fields = std::sscanf(c.hello, "%d %255s %d %255s %d %lld",
+                               &rank, host, &port, key, &cross, &epoch);
       if (fields < 0) fields = 0;  // EOF on no-conversion
       CHECK(fields == c.want_fields, "hello field count");
       if (fields >= 3) {
@@ -406,6 +422,7 @@ int main(int argc, char** argv) {
         CHECK(port == c.want_port, "hello port");
       }
       CHECK(cross == c.want_cross, "hello cross_rank");
+      CHECK(epoch == c.want_epoch, "hello epoch");
     }
   }
 
@@ -546,6 +563,7 @@ int main(int argc, char** argv) {
     w.i64(-1);
     w.i32(-1);
     w.i32(-1);
+    w.i64(-1);  // epoch piggyback
     w.i32(1 << 24);
     std::vector<Response> rs;
     double cyc; int64_t fus; int hf;
@@ -756,7 +774,48 @@ int main(int argc, char** argv) {
     }
   }
 
-  // 14. Golden vectors round-trip in-binary (byte-exactness against the
+  // 14. Link resume handshake frame (docs/self-healing.md): round trip,
+  // magic discrimination against every other family, every truncation
+  // rejected, hostile negative rank/seqs rejected (epoch may be any
+  // value — the FENCE comparison is the receiver's job), trailing bytes
+  // tolerated (tail-extension style, like the response piggyback).
+  {
+    std::string rf = SerializeResume(3, 1, 42, 41);
+    long long ep, ss, rs;
+    int rk;
+    CHECK(IsResumeFrame(rf), "resume magic recognized");
+    CHECK(!IsResumeFrame(GoldenRequestFrame()) &&
+              !IsResumeFrame(GoldenResponseFrame()) &&
+              !IsResumeFrame(GoldenDeltaFrame()) &&
+              !IsResumeFrame(HeartbeatFrame()) &&
+              !IsResumeFrame(std::string()),
+          "resume magic collides with no other family");
+    CHECK(DeserializeResume(rf, &ep, &rk, &ss, &rs), "resume roundtrip");
+    CHECK(ep == 3 && rk == 1 && ss == 42 && rs == 41,
+          "resume roundtrip content");
+    for (size_t len = 0; len < rf.size(); ++len) {
+      CHECK(!DeserializeResume(rf.substr(0, len), &ep, &rk, &ss, &rs),
+            "truncated resume rejected");
+      if (failures) break;
+    }
+    CHECK(!DeserializeResume(SerializeResume(3, -1, 0, 0), &ep, &rk, &ss,
+                             &rs),
+          "negative resume rank rejected");
+    CHECK(!DeserializeResume(SerializeResume(3, 1, -2, 0), &ep, &rk, &ss,
+                             &rs),
+          "negative resume send_seq rejected");
+    CHECK(!DeserializeResume(SerializeResume(3, 1, 0, -2), &ep, &rk, &ss,
+                             &rs),
+          "negative resume recv_seq rejected");
+    CHECK(DeserializeResume(SerializeResume(-7, 1, 0, 0), &ep, &rk, &ss,
+                            &rs) &&
+              ep == -7,
+          "any epoch value parses (fencing is the receiver's compare)");
+    CHECK(DeserializeResume(rf + std::string("xx"), &ep, &rk, &ss, &rs),
+          "resume trailing bytes tolerated");
+  }
+
+  // 15. Golden vectors round-trip in-binary (byte-exactness against the
   // checked-in hex is the driver's job — tests/test_hvdmc.py): the
   // canonical instances must at least survive their own codec.
   {
@@ -771,12 +830,21 @@ int main(int argc, char** argv) {
     CHECK(gids == std::vector<uint32_t>({7u, 9u}), "golden cached ids");
     std::vector<Response> gp;
     double gcyc; int64_t gfus; int ghf, gst;
+    long long gep = -1;
     CHECK(DeserializeResponseList(GoldenResponseFrame(), &gp, &gcyc,
-                                  &gfus, &ghf, &gst),
+                                  &gfus, &ghf, &gst, &gep),
           "golden response parses");
     CHECK(gp.size() == 1 && gp[0].tensor_names.size() == 2 &&
-              gcyc == 2.5 && gfus == (1 << 20) && ghf == 3 && gst == 4,
+              gcyc == 2.5 && gfus == (1 << 20) && ghf == 3 && gst == 4 &&
+              gep == 5,
           "golden response content");
+    long long grep, grss, grrs;
+    int grrk;
+    CHECK(DeserializeResume(GoldenResumeFrame(), &grep, &grrk, &grss,
+                            &grrs),
+          "golden resume parses");
+    CHECK(grep == 5 && grrk == 2 && grss == 7 && grrs == 9,
+          "golden resume content");
     uint32_t gseq = 0, glen = 0;
     CHECK(DecodeStripeHdr(GoldenStripeHdr().data(), kStripeHdrBytes,
                           &gseq, &glen) &&

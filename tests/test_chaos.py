@@ -261,6 +261,14 @@ def test_chaos_hier_leader_death_recovers(tmp_path):
                 "ring.hier.cross:rank=1:step=15:kind=exit",
             "HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
             "HOROVOD_ELASTIC_BLACKLIST_STRIKES": "1",
+            # Escalation boundary (docs/self-healing.md): the survivor's
+            # healer redials the DEAD leader, exhausts these (pinned
+            # tight for determinism), and must surface exactly the
+            # pre-healing transport error — every assertion below is
+            # unchanged from before in-place reconnection existed.
+            "HOROVOD_LINK_RETRY_ATTEMPTS": "2",
+            "HOROVOD_LINK_RETRY_BACKOFF_MS": "50",
+            "HOROVOD_LINK_RETRY_DEADLINE_MS": "500",
             "CHAOS_TARGET": "30",
         },
         ["-np", "2", "--min-np", "1", "--max-np", "2"])

@@ -31,7 +31,7 @@ from proc_harness import run_world
 # 8 ranks = 2 hosts x 4 local, round-robin placement: host(r) = r % 2.
 # Group members {0,2,4,6} / {1,3,5,7}; leaders are ranks 0 and 1.
 _ACCEPTANCE_WORKER = textwrap.dedent("""
-    import os, sys, time
+    import os, sys
     import numpy as np
     sys.path.insert(0, os.environ["HVD_REPO"])
     from horovod_tpu.common import native as hn
@@ -47,26 +47,20 @@ _ACCEPTANCE_WORKER = textwrap.dedent("""
     assert core.available
 
     def boot():
-        # Phase-2 re-init races the coordinator's phase-1 teardown: a
-        # worker that dials while the OLD listener is still up lands in
-        # its backlog and is reset when it closes. The reset surfaces
-        # as a failed init; redialing then reaches the fresh listener.
-        for attempt in range(8):
-            ok = core.init(rank=rank, size=SIZE, local_rank=rank // HOSTS,
-                           local_size=LOCAL, cross_rank=rank % HOSTS,
-                           cross_size=HOSTS, coordinator_addr="127.0.0.1",
-                           coordinator_port=port, my_host="127.0.0.1",
-                           cycle_time_ms=1.0, fusion_threshold=64 << 20,
-                           cache_capacity=64, stall_warning_sec=60.0,
-                           stall_shutdown_sec=0.0, stall_check_enabled=True,
-                           exec_callback=lambda resp, rid: core.response_done(
-                               rid, False, "host-plane only"))
-            if ok:
-                return
-            if rank == 0:
-                break  # the coordinator's bind/accept is not racy
-            time.sleep(1.0)
-        assert False, "native init failed"
+        # One shot, no retries: the coordinator closes its bootstrap
+        # listener as soon as the endpoint map is broadcast
+        # (controller.cc), so a phase-2 dial can never land in a stale
+        # phase-1 backlog. A failed init here is a real bug again.
+        ok = core.init(rank=rank, size=SIZE, local_rank=rank // HOSTS,
+                       local_size=LOCAL, cross_rank=rank % HOSTS,
+                       cross_size=HOSTS, coordinator_addr="127.0.0.1",
+                       coordinator_port=port, my_host="127.0.0.1",
+                       cycle_time_ms=1.0, fusion_threshold=64 << 20,
+                       cache_capacity=64, stall_warning_sec=60.0,
+                       stall_shutdown_sec=0.0, stall_check_enabled=True,
+                       exec_callback=lambda resp, rid: core.response_done(
+                           rid, False, "host-plane only"))
+        assert ok, "native init failed"
 
     COUNT = 1 << 14  # 64 KiB fp32: above the tree cutoff -> ring path
 

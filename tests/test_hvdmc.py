@@ -2,11 +2,12 @@
 
 Four proof surfaces:
 
-1. **Exhaustive model exploration** — the negotiation, liveness, and
-   elastic models fully explored at tier-1 scale with zero safety
-   violations, zero deadlocks, zero livelocks; planted mutations
-   (premature fire, EVICT->RECOVER, early drain eviction, strike on
-   drain) MUST be caught, or the checker itself is the bug.
+1. **Exhaustive model exploration** — the negotiation, liveness,
+   elastic, and reconnect models fully explored at tier-1 scale with
+   zero safety violations, zero deadlocks, zero livelocks; planted
+   mutations (premature fire, EVICT->RECOVER, early drain eviction,
+   strike on drain, stale-epoch resume accepted, resume skipping the
+   lost chunk) MUST be caught, or the checker itself is the bug.
 2. **Trace conformance** — event streams from the REAL implementation
    (a fake-clock LivenessTracker run; a real 2-rank native chaos world's
    liveness report; a real world's negotiation ticks) replay cleanly
@@ -39,7 +40,8 @@ from tools.hvdmc import trace as mtrace  # noqa: E402
 from tools.hvdmc.__main__ import main as hvdmc_main  # noqa: E402
 from tools.hvdmc.mc import explore  # noqa: E402
 from tools.hvdmc.models import (ElasticModel, HierNegotiationModel,  # noqa: E402
-                                LivenessModel, NegotiationModel)
+                                LivenessModel, NegotiationModel,
+                                ReconnectModel)
 
 TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
 GOLDEN = os.path.join(TESTS_DIR, "golden_wire.json")
@@ -163,6 +165,38 @@ def test_elastic_exhaustive_and_drain_never_strikes():
                                mutations=("strike_on_drain",)))
     assert not bad.ok
     assert any("never strike" in v.message for v in bad.violations)
+
+
+def test_reconnect_exhaustive():
+    """The self-healing reconnect/resume handshake (ISSUE 18): two cuts
+    racing the chunk deliveries, bounded redials, one stale-epoch resume
+    replay, sender death mid-resume — every schedule either completes the
+    stream byte-identically (applied == 0..n-1, duplicates suppressed) or
+    escalates into the evict path; never a wedge, never corruption."""
+    res = explore(ReconnectModel(chunks=2, cuts=2, attempts=2, deaths=1))
+    assert res.complete, "exploration must exhaust the graph"
+    assert res.ok, "\n".join(v.render() for v in res.violations)
+    assert res.quiescent_states > 0
+
+
+def test_reconnect_stale_epoch_mutation_caught():
+    """Teeth: dropping the resume-frame epoch fence lets a previous
+    incarnation's frame drive reconciliation — some schedule replays a
+    chunk the receiver already applied (duplicate corruption)."""
+    res = explore(ReconnectModel(chunks=2, cuts=2, attempts=2, deaths=0,
+                                 mutations=("stale_epoch_accepted",)))
+    assert not res.ok
+    assert any("applied twice" in v.message for v in res.violations)
+
+
+def test_reconnect_skip_chunk_mutation_caught():
+    """Teeth: an off-by-one in the resume reconciliation (peer_recv ==
+    send base treated as delivered) silently drops the in-flight chunk —
+    the skip corruption must be flagged."""
+    res = explore(ReconnectModel(chunks=2, cuts=2, attempts=2, deaths=0,
+                                 mutations=("resume_skips_chunk",)))
+    assert not res.ok
+    assert any("never replayed" in v.message for v in res.violations)
 
 
 def test_cli_fast_profile_green():
@@ -469,11 +503,17 @@ def test_golden_response_parses_in_python_with_pinned_structure():
     assert r.shapes == [(4, 3), (2,)]
     assert r.first_dims == [(4, 4), (2, 2)]
     assert r.hier_flags == 3 and r.stripes == 4
+    assert r.epoch == 5
+    # The resume handshake frame (docs/self-healing.md) parses with its
+    # pinned structure.
+    res = hn.parse_resume_frame(frames["resume"])
+    assert (res.epoch, res.rank, res.send_seq, res.recv_seq) == (5, 2, 7, 9)
     # The other families' pinned bytes stay sanity-checked from Python.
     assert frames["heartbeat"] == b"\xa3"
-    assert frames["hello"].decode() == "2 10.0.0.7 41000 ab12cd 1"
+    assert frames["hello"].decode() == "2 10.0.0.7 41000 ab12cd 1 5"
     assert frames["stripe_hdr"][:4] == b"HVST"
     assert frames["request"][0] == 0xA1 and frames["request"][1] == 0x02
+    assert frames["resume"][0] == 0xA6
 
 
 def test_golden_hier_frames_parse_in_python_with_pinned_structure():
@@ -536,7 +576,7 @@ def test_python_parser_rejects_hostile_frames_fast():
     — no multi-GB allocation, no struct.error/IndexError leak."""
     from horovod_tpu.common import native as hn
 
-    header = b"\xa2" + struct.pack("<dqii", -1.0, -1, -1, -1)
+    header = b"\xa2" + struct.pack("<dqiiq", -1.0, -1, -1, -1, -1)
     hostile = header + struct.pack("<i", 1 << 24)
     with pytest.raises(hn.FrameRejected):
         hn.parse_response_list(hostile)
@@ -629,17 +669,19 @@ def _run_differential(tmp_path, iterations):
     cpp = {}
     for line in r.stdout.splitlines():
         if line.startswith("V "):
-            _, idx, _req, resp, agg, delta = line.split()
+            _, idx, _req, resp, agg, delta, resume = line.split()
             cpp[int(idx)] = {"resp": int(resp.split("=")[1]),
                              "agg": int(agg.split("=")[1]),
-                             "delta": int(delta.split("=")[1])}
+                             "delta": int(delta.split("=")[1]),
+                             "resume": int(resume.split("=")[1])}
     assert len(cpp) == len(frames), "verdict lines missing"
 
     from horovod_tpu.common import native as hn
 
     parsers = {"resp": hn.parse_response_list,
                "agg": hn.parse_aggregate_frame,
-               "delta": hn.parse_delta_frame}
+               "delta": hn.parse_delta_frame,
+               "resume": hn.parse_resume_frame}
     mismatches = []
     for i, fr in enumerate(frames):
         for fam, parse in parsers.items():
@@ -659,6 +701,7 @@ def _run_differential(tmp_path, iterations):
     assert cpp[seeds.index(golden['response'])]["resp"] == 1
     assert cpp[seeds.index(golden['aggregate'])]["agg"] == 1
     assert cpp[seeds.index(golden['delta'])]["delta"] == 1
+    assert cpp[seeds.index(golden['resume'])]["resume"] == 1
 
 
 def test_codec_differential_fuzz_smoke(tmp_path):

@@ -1,0 +1,502 @@
+"""Self-healing data plane (docs/self-healing.md): world-epoch fencing,
+bounded in-place link reconnection, and the seeded chaos scheduler.
+
+Three real worlds plus the pure-Python surfaces:
+
+- THE acceptance chaos world: 8 ranks as 2 hosts x 4 local (round-robin
+  placement, leaders 0 and 1) under HOROVOD_HIERARCHICAL_ALLREDUCE=1.
+  ``HVD_FAULT_CROSS_DROP`` cuts leader 0's cross leg right before its
+  3rd cross duplex — mid-collective, both ends mid-duplex. The world
+  must heal IN PLACE: no elastic restart, no eviction, every later
+  allreduce/allgather byte-identical to the closed-form expectation,
+  and BOTH leaders' native snapshots count ``link.reconnects`` >= 1.
+- The fencing world: a stale-epoch hello (``HVD_TEST_STALE_HELLO``) is
+  rejected and counted by the accepting peer, never adopted, results
+  stay correct — and a re-init bumps the world epoch monotonically.
+- The escalation boundary: ``HOROVOD_LINK_RETRY_ATTEMPTS=0`` disables
+  healing, so the SAME injected cut surfaces as today's collective
+  failure on every rank of the host (``AbortLocalWaiters``) — the
+  exact error the PR 6 elastic/evict path consumes. The e2e half (a
+  truly-dead peer exhausting the retries and landing in the evict /
+  blacklist path with unchanged outcomes) is
+  tests/test_chaos.py::test_chaos_hier_leader_death_recovers, which
+  pins the retry knobs tight for determinism.
+
+Plus: the HOROVOD_LINK_RETRY_* / HOROVOD_CHAOS_SPEC knob accessors,
+chaos-spec compilation (deterministic from the seed, strict on
+malformed input), the tools/chaos_sched round-trips, the
+``chaos.injected`` counter split, and the LINK_RECONNECT timeline
+instant the metrics pump derives from the native counter.
+"""
+
+import textwrap
+
+import pytest
+
+from proc_harness import run_world
+
+# ---------------------------------------------------------------------------
+# THE acceptance chaos world: heal a cut cross leg in place
+# ---------------------------------------------------------------------------
+
+# 8 ranks = 2 hosts x 4 local, round-robin placement: host(r) = r % 2.
+# Leaders (local_rank 0) are ranks 0 and 1; the cross ring is the
+# two-host leader pair, one full-duplex PeerLink socket (the
+# next == prev case of HealCrossStep).
+_HEAL_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    from horovod_tpu.common import native as hn
+
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    SIZE, HOSTS, LOCAL = 8, 2, 4
+    os.environ.setdefault("HVD_JOIN_TIMEOUT_MS", "300000")
+    os.environ["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+    if rank == 0:
+        # Cut leader 0's cross link right before its 3rd cross duplex:
+        # with H=2 each hier allreduce is exactly 2 duplexes
+        # (1 reduce-scatter + 1 allgather step), so duplex 3 is the
+        # SECOND allreduce's reduce-scatter — mid-collective, link warm.
+        os.environ["HVD_FAULT_CROSS_DROP"] = "0:3"
+    core = hn.NativeCore()
+    assert core.available
+    ok = core.init(rank=rank, size=SIZE, local_rank=rank // HOSTS,
+                   local_size=LOCAL, cross_rank=rank % HOSTS,
+                   cross_size=HOSTS, coordinator_addr="127.0.0.1",
+                   coordinator_port=port, my_host="127.0.0.1",
+                   cycle_time_ms=1.0, fusion_threshold=64 << 20,
+                   cache_capacity=64, stall_warning_sec=60.0,
+                   stall_shutdown_sec=0.0, stall_check_enabled=True,
+                   exec_callback=lambda resp, rid: core.response_done(
+                       rid, False, "host-plane only"))
+    assert ok, "native init failed"
+
+    COUNT = 1 << 14  # 64 KiB fp32: above the tree cutoff -> ring cross
+
+    def allreduce(name):
+        buf = (np.arange(COUNT, dtype=np.float32) % 13) + rank
+        h = core.enqueue(name, hn.OP_ALLREDUCE, 1, 7, buf.shape,
+                         data_ptr=buf.ctypes.data,
+                         output_ptr=buf.ctypes.data, plane=hn.PLANE_HOST)
+        r, err = core.wait(h); assert r == 1, err
+        return buf
+
+    # Small integers only: exact in fp32 at ANY summation order, so a
+    # healed run must be BYTE-identical to the closed-form sum.
+    expect = ((np.arange(COUNT, dtype=np.float32) % 13) * SIZE
+              + SIZE * (SIZE - 1) // 2).astype(np.float32)
+
+    for i in range(4):
+        out = allreduce(f"heal.ar{i}")
+        assert np.array_equal(out.view(np.uint32), expect.view(np.uint32)), \\
+            f"allreduce {i} diverged across the heal"
+
+    # The healed link must be a first-class PeerLink, not a one-op
+    # patch: a hier allgather rides the same cross duplex path.
+    blk = (np.arange(1024, dtype=np.float32) % 7) * (rank + 1)
+    out = np.zeros(1024 * SIZE, np.float32)
+    h = core.enqueue("heal.ag", hn.OP_ALLGATHER, 1, 7, blk.shape,
+                     data_ptr=blk.ctypes.data,
+                     output_ptr=out.ctypes.data, plane=hn.PLANE_HOST)
+    r, err = core.wait(h); assert r == 1, err
+    exp_ag = np.concatenate([
+        (np.arange(1024, dtype=np.float32) % 7) * (rr + 1)
+        for rr in range(SIZE)])
+    assert np.array_equal(out.view(np.uint32), exp_ag.view(np.uint32)), \\
+        "allgather diverged on the healed link"
+
+    c = core.metrics_snapshot().get("counters", {})
+    rec = int(c.get("link.reconnects", 0))
+    if rank in (0, 1):
+        # Both ends of the cut leg redialed + resumed in place (the
+        # faulting rank dials, its peer accepts — each counts its own).
+        assert rec >= 1, (rank, c)
+    else:
+        assert rec == 0, (rank, c)
+    # In-place healing means ZERO escalations: no stale frames, and the
+    # world completed without any rank erroring (run_world would have
+    # seen a dead rank otherwise).
+    assert int(c.get("link.stale_epoch_rejected", 0)) == 0, c
+    assert int(c.get("epoch", 0)) == 1, c
+    core.shutdown()
+    print(f"HEAL_{rank}_OK")
+""")
+
+
+def test_selfheal_cross_drop_heals_in_place(tmp_path):
+    """THE acceptance chaos world: drop_conn on leader 0's cross leg
+    mid-step in the 8-rank 2x4 hierarchical world. The collective (and
+    three more, plus an allgather) completes byte-identically with zero
+    elastic restarts/evictions, and both leaders count
+    ``link.reconnects`` >= 1."""
+    run_world(tmp_path, _HEAL_WORKER, "HEAL", size=8, timeout=300)
+
+
+# ---------------------------------------------------------------------------
+# the fencing world: stale-epoch hellos are rejected, epochs are monotonic
+# ---------------------------------------------------------------------------
+
+_FENCE_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    from horovod_tpu.common import native as hn
+
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    os.environ.setdefault("HVD_JOIN_TIMEOUT_MS", "300000")
+    if rank == 0:
+        # Before rank 0's first real PeerLink dial, burn one throwaway
+        # connection introducing itself with LAST world's epoch
+        # (ring_ops.cc fencing seam). Rank 1's accept loop must reject
+        # it — counted, never adopted — and still take the real dial.
+        os.environ["HVD_TEST_STALE_HELLO"] = "1"
+    core = hn.NativeCore()
+    assert core.available
+
+    def boot():
+        ok = core.init(rank=rank, size=2, local_rank=rank, local_size=2,
+                       cross_rank=0, cross_size=1,
+                       coordinator_addr="127.0.0.1",
+                       coordinator_port=port, my_host="127.0.0.1",
+                       cycle_time_ms=1.0, fusion_threshold=64 << 20,
+                       cache_capacity=64, stall_warning_sec=60.0,
+                       stall_shutdown_sec=0.0, stall_check_enabled=True,
+                       exec_callback=lambda resp, rid: core.response_done(
+                           rid, False, "host-plane only"))
+        assert ok, "native init failed"
+
+    def small_allreduce(name):
+        # 8 fp32 = 32 bytes: under the tree cutoff, so the collective
+        # routes through TreeAllreduce's PeerLink legs — the path the
+        # stale-hello seam targets.
+        buf = np.full(8, float(rank + 1), np.float32)
+        h = core.enqueue(name, hn.OP_ALLREDUCE, 1, 7, buf.shape,
+                         data_ptr=buf.ctypes.data,
+                         output_ptr=buf.ctypes.data, plane=hn.PLANE_HOST)
+        r, err = core.wait(h); assert r == 1, err
+        return buf
+
+    def phase(tag, want_epoch):
+        boot()
+        out = small_allreduce(f"{tag}.ar")
+        assert np.array_equal(out, np.full(8, 3.0, np.float32)), out
+        c = core.metrics_snapshot().get("counters", {})
+        stale = int(c.get("link.stale_epoch_rejected", 0))
+        if rank == 1:
+            # The acceptor saw (and fenced) the stale dial.
+            assert stale >= 1, (tag, c)
+        else:
+            assert stale == 0, (tag, c)
+        # Fencing is rejection, not healing: no link was ever cut.
+        assert int(c.get("link.reconnects", 0)) == 0, (tag, c)
+        assert int(c.get("epoch", 0)) == want_epoch, (tag, c)
+        core.shutdown()
+
+    phase("p1", want_epoch=1)
+    # Same port on purpose (SO_REUSEADDR + connect retries): the
+    # re-init is a NEW world incarnation — the epoch must bump, and the
+    # fencing seam (fresh ring, fresh one-shot latch) fires again with
+    # the new last-world epoch.
+    phase("p2", want_epoch=2)
+    print(f"FENCE_{rank}_OK")
+""")
+
+
+def test_selfheal_stale_epoch_hello_rejected(tmp_path):
+    """World-epoch fencing: a hello frame carrying last world's epoch is
+    rejected at receive (counted in ``link.stale_epoch_rejected``, never
+    adopted as a peer link), results stay correct, and re-initializing
+    the world bumps the epoch monotonically — split-brain frames from a
+    previous incarnation cannot splice into the new one."""
+    run_world(tmp_path, _FENCE_WORKER, "FENCE", size=2, timeout=240)
+
+
+# ---------------------------------------------------------------------------
+# the escalation boundary: healing off => today's hard error, everywhere
+# ---------------------------------------------------------------------------
+
+# 4 ranks = 2 hosts x 2 local round-robin: leaders 0 and 1, members 2
+# and 3. The cut leg aborts the leaders' cross phase; AbortLocalWaiters
+# must fail the members' bcast recv immediately so the WHOLE host
+# errors together — the shape the elastic retry loop consumes.
+_ESCALATE_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    from horovod_tpu.common import native as hn
+
+    rank = int(sys.argv[1]); port = int(sys.argv[2])
+    SIZE, HOSTS, LOCAL = 4, 2, 2
+    os.environ.setdefault("HVD_JOIN_TIMEOUT_MS", "300000")
+    os.environ["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+    # Healing disabled: the boundary contract is that the failure below
+    # is EXACTLY the pre-healing hard error (same error path the PR 6
+    # evict/elastic plane consumes), not a new failure mode.
+    os.environ["HOROVOD_LINK_RETRY_ATTEMPTS"] = "0"
+    if rank == 0:
+        os.environ["HVD_FAULT_CROSS_DROP"] = "0:1"
+    core = hn.NativeCore()
+    assert core.available
+    ok = core.init(rank=rank, size=SIZE, local_rank=rank // HOSTS,
+                   local_size=LOCAL, cross_rank=rank % HOSTS,
+                   cross_size=HOSTS, coordinator_addr="127.0.0.1",
+                   coordinator_port=port, my_host="127.0.0.1",
+                   cycle_time_ms=1.0, fusion_threshold=64 << 20,
+                   cache_capacity=64, stall_warning_sec=60.0,
+                   stall_shutdown_sec=0.0, stall_check_enabled=True,
+                   exec_callback=lambda resp, rid: core.response_done(
+                       rid, False, "host-plane only"))
+    assert ok, "native init failed"
+
+    COUNT = 1 << 14
+    buf = (np.arange(COUNT, dtype=np.float32) % 13) + rank
+    h = core.enqueue("esc.ar", hn.OP_ALLREDUCE, 1, 7, buf.shape,
+                     data_ptr=buf.ctypes.data, output_ptr=buf.ctypes.data,
+                     plane=hn.PLANE_HOST)
+    r, err = core.wait(h)
+    assert r != 1, \\
+        "collective unexpectedly succeeded with healing disabled"
+    c = core.metrics_snapshot().get("counters", {})
+    assert int(c.get("link.reconnects", 0)) == 0, c
+    core.shutdown()
+    print(f"ESC_{rank}_OK")
+""")
+
+
+def test_selfheal_retry_disabled_is_todays_hard_error(tmp_path):
+    """HOROVOD_LINK_RETRY_ATTEMPTS=0 turns healing off entirely: the
+    same injected cross-leg cut surfaces as a hard collective failure on
+    every rank of the host — no hang, no partial success, zero
+    reconnects counted. This pins the escalation boundary the elastic
+    plane builds on (the truly-dead-peer e2e lives in test_chaos.py)."""
+    run_world(tmp_path, _ESCALATE_WORKER, "ESC", size=4, timeout=240)
+
+
+# ---------------------------------------------------------------------------
+# knob accessors
+# ---------------------------------------------------------------------------
+
+def test_link_retry_knob_accessors(monkeypatch):
+    from horovod_tpu.common import config
+
+    for var in (config.HOROVOD_LINK_RETRY_ATTEMPTS,
+                config.HOROVOD_LINK_RETRY_BACKOFF_MS,
+                config.HOROVOD_LINK_RETRY_DEADLINE_MS):
+        monkeypatch.delenv(var, raising=False)
+    # Defaults mirror the native parse (ring_ops.cc LinkRetry*); the
+    # deadline sits WELL below the 10 s liveness default by design.
+    assert config.link_retry_attempts() == 3
+    assert config.link_retry_backoff_ms() == 100
+    assert config.link_retry_deadline_ms() == 3000
+    assert config.link_retry_deadline_ms() < 10000
+
+    monkeypatch.setenv(config.HOROVOD_LINK_RETRY_ATTEMPTS, "5")
+    monkeypatch.setenv(config.HOROVOD_LINK_RETRY_BACKOFF_MS, "7")
+    monkeypatch.setenv(config.HOROVOD_LINK_RETRY_DEADLINE_MS, "1234")
+    assert config.link_retry_attempts() == 5
+    assert config.link_retry_backoff_ms() == 7
+    assert config.link_retry_deadline_ms() == 1234
+
+    # Floors: attempts may be 0 (healing off), but backoff/deadline
+    # never degenerate to a busy-dial loop.
+    monkeypatch.setenv(config.HOROVOD_LINK_RETRY_ATTEMPTS, "-2")
+    monkeypatch.setenv(config.HOROVOD_LINK_RETRY_BACKOFF_MS, "0")
+    monkeypatch.setenv(config.HOROVOD_LINK_RETRY_DEADLINE_MS, "-1")
+    assert config.link_retry_attempts() == 0
+    assert config.link_retry_backoff_ms() == 1
+    assert config.link_retry_deadline_ms() == 1
+
+
+def test_chaos_spec_accessor(monkeypatch):
+    from horovod_tpu.common import config
+
+    monkeypatch.delenv(config.HOROVOD_CHAOS_SPEC, raising=False)
+    assert config.chaos_spec() == ""
+    assert config.parse_chaos_spec_env() == ()
+    monkeypatch.setenv(config.HOROVOD_CHAOS_SPEC, " seed=1,n=0 ")
+    assert config.chaos_spec() == "seed=1,n=0"
+    assert config.parse_chaos_spec_env() == ()
+
+
+# ---------------------------------------------------------------------------
+# chaos-spec compilation: deterministic from the seed, strict on garbage
+# ---------------------------------------------------------------------------
+
+def test_chaos_spec_deterministic_from_seed():
+    from horovod_tpu.common import config
+
+    spec = "seed=42,n=6,steps=0-8"
+    a = config.parse_chaos_spec(spec, size=8)
+    b = config.parse_chaos_spec(spec, size=8)
+    assert a == b and len(a) == 6
+    # Every draw honors the pools and is one-shot.
+    for f in a:
+        assert f.point in ("ring.exec", "ring.hier.cross")
+        assert f.kind in ("drop_conn", "delay_ms")
+        assert 0 <= f.rank < 8
+        assert 0 <= f.step <= 8
+        assert f.times == 1
+    # A different seed draws a different schedule (6 draws over the
+    # default pools collide with negligible probability).
+    assert config.parse_chaos_spec("seed=43,n=6,steps=0-8", size=8) != a
+
+
+def test_chaos_spec_pools_and_args():
+    from horovod_tpu.common import config
+
+    faults = config.parse_chaos_spec(
+        "seed=7,n=5,kinds=exit,points=ring.exec,ranks=2|5,steps=3-3,"
+        "code=77", size=8)
+    assert len(faults) == 5
+    for f in faults:
+        assert f.point == "ring.exec"
+        assert f.kind == "exit"
+        assert f.rank in (2, 5)
+        assert f.step == 3
+        assert f.code == 77
+
+
+@pytest.mark.parametrize("bad", [
+    "n=3",                          # missing seed
+    "seed=1",                       # missing n
+    "seed=1,n=-1",                  # negative draw count
+    "seed=1,n=1,kinds=segfault",    # unknown kind
+    "seed=1,n=1,steps=5",           # malformed window
+    "seed=1,n=1,steps=7-3",         # inverted window
+    "seed=1,n=1,bogus=1",           # unknown key
+    "seed=1,n=1,notkv",             # not key=value
+])
+def test_chaos_spec_malformed_raises(bad):
+    from horovod_tpu.common import config
+
+    with pytest.raises(ValueError):
+        config.parse_chaos_spec(bad, size=4)
+
+
+# ---------------------------------------------------------------------------
+# tools/chaos_sched: schedule records and the fault-spec round-trip
+# ---------------------------------------------------------------------------
+
+def test_chaos_sched_record_and_roundtrip():
+    from horovod_tpu.common import config
+    from tools import chaos_sched
+
+    spec = "seed=11,n=4,steps=0-6,ms=25"
+    rec = chaos_sched.schedule_record(spec, size=8)
+    assert rec["spec"] == spec and rec["size"] == 8 and rec["n"] == 4
+    assert len(rec["faults"]) == 4
+    for row in rec["faults"]:
+        assert set(row) >= {"point", "rank", "step", "kind"}
+        if row["kind"] == "delay_ms":
+            assert row["ms"] == 25.0
+
+    # The rendered HOROVOD_FAULT_SPEC string replays the EXACT drawn
+    # schedule through the plain fault plane: parse it back and compare
+    # field-for-field with the compiled chaos schedule.
+    rendered = chaos_sched.to_fault_spec(spec, size=8)
+    replay = config.parse_fault_spec(rendered)
+    compiled = config.parse_chaos_spec(spec, size=8)
+    assert len(replay) == len(compiled)
+    for r, c in zip(replay, compiled):
+        assert (r.point, r.rank, r.step, r.kind, r.times) == \
+            (c.point, c.rank, c.step, c.kind, c.times)
+        if c.kind == "delay_ms":
+            assert r.ms == c.ms
+        if c.kind == "exit":
+            assert r.code == c.code
+
+
+def test_chaos_sched_cli(capsys, monkeypatch):
+    import json
+
+    from tools import chaos_sched
+
+    assert chaos_sched.main(["--spec", "seed=5,n=2", "--size", "4"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["n"] == 2 and len(rec["faults"]) == 2
+
+    # env fallback + fault-spec format
+    monkeypatch.setenv("HOROVOD_CHAOS_SPEC", "seed=5,n=1,kinds=drop_conn")
+    assert chaos_sched.main(["--size", "4",
+                             "--format", "fault-spec"]) == 0
+    out = capsys.readouterr().out.strip()
+    assert ":kind=drop_conn:times=1" in out
+
+    # strict parse: malformed spec is rc 2, error on stderr
+    assert chaos_sched.main(["--spec", "seed=1,n=1,kinds=nope"]) == 2
+    assert "chaos_sched" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# the chaos.injected counter split
+# ---------------------------------------------------------------------------
+
+def test_chaos_injected_counter_split(monkeypatch):
+    from horovod_tpu.common import config, faults, metrics
+
+    # A chaos-drawn fault counts BOTH faults.injected and chaos.injected.
+    monkeypatch.delenv(config.HOROVOD_FAULT_SPEC, raising=False)
+    monkeypatch.setenv(config.HOROVOD_CHAOS_SPEC,
+                       "seed=3,n=1,kinds=delay_ms,points=ring.exec,"
+                       "ranks=0,steps=0-0,ms=1")
+    faults.refresh()
+    metrics.reset()
+    faults.point("ring.exec", rank=0)
+    c = metrics.counters()
+    assert c.get("faults.injected") == 1, c
+    assert c.get("chaos.injected") == 1, c
+
+    # A hand-armed fault counts faults.injected ONLY.
+    monkeypatch.delenv(config.HOROVOD_CHAOS_SPEC, raising=False)
+    monkeypatch.setenv(config.HOROVOD_FAULT_SPEC,
+                       "ring.exec:rank=0:step=0:kind=delay_ms:ms=1")
+    faults.refresh()
+    metrics.reset()
+    faults.point("ring.exec", rank=0)
+    c = metrics.counters()
+    assert c.get("faults.injected") == 1, c
+    assert "chaos.injected" not in c, c
+
+    # Leave the process disarmed for later tests.
+    monkeypatch.delenv(config.HOROVOD_FAULT_SPEC, raising=False)
+    faults.refresh()
+    metrics.reset()
+
+
+# ---------------------------------------------------------------------------
+# the LINK_RECONNECT timeline instant
+# ---------------------------------------------------------------------------
+
+def test_pump_emits_link_reconnect_instant(tmp_path, monkeypatch):
+    from horovod_tpu.common import metrics as hmetrics
+    from horovod_tpu.common import timeline as htimeline
+
+    assert htimeline.LINK_RECONNECT in htimeline.INSTANT_CATALOG
+
+    events = []
+
+    class FakeTimeline:
+        def counter(self, name, values):
+            pass
+
+        def instant(self, name, args=None):
+            events.append((name, args))
+
+    ft = FakeTimeline()
+    snaps = [
+        {"python": {}, "native": {"counters": {"link.reconnects": 0}}},
+        {"python": {}, "native": {"counters": {"link.reconnects": 2}}},
+        {"python": {}, "native": {"counters": {"link.reconnects": 2}}},
+    ]
+    monkeypatch.setattr(hmetrics, "snapshot",
+                        lambda drain=True: snaps.pop(0))
+    monkeypatch.setattr(hmetrics, "prometheus_text", lambda snap: "")
+    monkeypatch.setattr(hmetrics, "_active_timeline", lambda: ft)
+    pump = hmetrics.MetricsPump(str(tmp_path / "m.prom"), 60000)
+    pump.publish_once()  # baseline 0: no instant
+    pump.publish_once()  # growth 0 -> 2: exactly one instant
+    pump.publish_once()  # steady 2: no repeat
+    reconnects = [e for e in events if e[0] == htimeline.LINK_RECONNECT]
+    assert reconnects == \
+        [(htimeline.LINK_RECONNECT, {"reconnects": 2})], events

@@ -412,6 +412,15 @@ def main(argv=None):
         "by_cycle_ms": by_cycle,
         "sizes": tightest,
     }
+    # A chaos soak must be reproducible from the artifact alone: log the
+    # spec AND the concrete schedule its seed draws (docs/self-healing.md).
+    chaos = os.environ.get("HOROVOD_CHAOS_SPEC", "")
+    if chaos:
+        if REPO not in sys.path:
+            sys.path.insert(0, REPO)
+        from tools import chaos_sched
+        result["chaos"] = chaos_sched.schedule_record(chaos,
+                                                      size=max(sizes))
     print(json.dumps(result))
     if args.out:
         with open(args.out, "w") as f:

@@ -2,13 +2,16 @@
 
 The fast profile is the tier-1 gate (tools/t1.sh): exhaustive
 exploration of the 2-rank negotiation model (clean + one-death chaos),
-the 1-member liveness machine (lossy + healthy + one drain), and the
-2-slot elastic retry/drain loop — every reported state graph fully
-explored, zero safety violations, zero deadlocks/livelocks — plus a
-TEETH self-check: each model re-explored under its planted mutation
-(``premature_fire``, ``allow_evict_recover``, ``evict_draining_early``,
-``strike_on_drain``) MUST produce violations; a checker that cannot
-catch a planted protocol bug fails the gate itself.
+the 1-member liveness machine (lossy + healthy + one drain), the
+2-slot elastic retry/drain loop, and the self-healing reconnect/resume
+handshake (two cuts, bounded redials, stale-epoch replay, sender death
+mid-resume) — every reported state graph fully explored, zero safety
+violations, zero deadlocks/livelocks — plus a TEETH self-check: each
+model re-explored under its planted mutation (``premature_fire``,
+``allow_evict_recover``, ``evict_draining_early``, ``strike_on_drain``,
+``stale_epoch_accepted``, ``resume_skips_chunk``) MUST produce
+violations; a checker that cannot catch a planted protocol bug fails
+the gate itself.
 
 The deep profile widens to 3-4 rank worlds, 2 tensors x 2 steps, and
 2-member liveness (the ``slow``-marked CI lane).
@@ -24,7 +27,7 @@ from typing import List, Tuple
 
 from .mc import Model, explore
 from .models import (ElasticModel, HierNegotiationModel, LivenessModel,
-                     NegotiationModel)
+                     NegotiationModel, ReconnectModel)
 
 
 def _fast_models() -> List[Model]:
@@ -52,6 +55,10 @@ def _fast_models() -> List[Model]:
         # (not assumed) by this checker.
         LivenessModel(members=1, lossy=False, deaths=0, drains=0),
         ElasticModel(slots=2, min_np=1, max_restarts=2),
+        # Self-healing reconnect/resume handshake (ISSUE 18): two chunks,
+        # up to two cuts racing the deliveries, bounded redials, sender
+        # death mid-resume, one stale-epoch resume replay — exhaustive.
+        ReconnectModel(chunks=2, cuts=2, attempts=2, deaths=1),
     ]
 
 
@@ -70,6 +77,7 @@ def _deep_models() -> List[Model]:
         LivenessModel(members=2, lossy=True, deaths=1, drains=1,
                       timeout=4, horizon=7),
         ElasticModel(slots=3, min_np=2, max_restarts=2),
+        ReconnectModel(chunks=3, cuts=3, attempts=3, deaths=1),
     ]
 
 
@@ -98,6 +106,12 @@ def _mutants() -> List[Tuple[str, Model]]:
          HierNegotiationModel(hosts=2, members=2, tensors=("a",),
                               steps=1, deaths=1,
                               mutations=("stale_delta_after_evict",))),
+        ("stale-epoch resume frame accepted (fence dropped)",
+         ReconnectModel(chunks=2, cuts=2, attempts=2, deaths=0,
+                        mutations=("stale_epoch_accepted",))),
+        ("resume reconciliation skips the lost chunk",
+         ReconnectModel(chunks=2, cuts=2, attempts=2, deaths=0,
+                        mutations=("resume_skips_chunk",))),
     ]
 
 

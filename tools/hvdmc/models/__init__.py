@@ -14,7 +14,12 @@ level, small enough to exhaust every interleaving at 2-4 ranks:
   EVICT, DRAIN exemption, zombie-proof terminal states;
 - ``elastic``     — the retry/drain loop (run/elastic/driver.py):
   failure/preemption -> classify DRAINED-vs-crash -> strike/quarantine
-  -> shrink/grow -> commit/restore.
+  -> shrink/grow -> commit/restore;
+- ``reconnect``   — the self-healing data plane
+  (csrc/hvd/ring_ops.cc HealCrossStep/HealPeerLink): cut mid-step ->
+  bounded redial -> epoch-fenced resume reconciliation
+  (suppress/replay/escalate), sender death mid-resume, stale-epoch
+  replay, duplicate-chunk races.
 
 Every model accepts ``mutations=(...)`` — named, deliberately-wrong
 transition rules (e.g. ``allow_evict_recover``) used by the CI teeth
@@ -26,3 +31,4 @@ from .negotiation import NegotiationModel          # noqa: F401
 from .negotiation_hier import HierNegotiationModel  # noqa: F401
 from .liveness import LivenessModel                # noqa: F401
 from .elastic import ElasticModel                  # noqa: F401
+from .reconnect import ReconnectModel              # noqa: F401

@@ -1,0 +1,241 @@
+"""Executable model of the self-healing data-plane reconnect protocol.
+
+Mirrors ``csrc/hvd/ring_ops.cc``'s ``HealCrossStep``/``HealPeerLink``
+(docs/self-healing.md) at the frame level, one cross-host link, one
+direction (the duplex is two of these back to back): the sender streams
+chunks over a fenced connection; the link may cut mid-step AFTER the
+chunk was written but BEFORE the step completed — the sender cannot
+know whether the bytes landed. A bounded redial re-establishes the
+socket and the peers exchange resume frames carrying the receiver's
+applied count; the sender reconciles:
+
+- ``peer_recv == inflight + 1`` — the cut raced the delivery and lost:
+  the chunk landed; replay is suppressed (``resume_chunks_discarded``);
+- ``peer_recv == inflight``     — the chunk died on the wire: replay it;
+- anything else                 — more than one frame adrift: the link
+  is unrecoverable in place; raise exactly today's error into the
+  evict/elastic path (``escalate``).
+
+Resume frames are epoch-fenced: a replayed frame from a previous world
+incarnation must be rejected (``stale_epoch_rejected``), never used for
+reconciliation. Data frames carry no epoch — the fence lives at
+connection establishment, so only a fenced socket ever carries chunks
+(the model's ``seq`` tag on data frames is the corruption detector the
+real byte stream doesn't have).
+
+The receiver applies whatever the fenced socket delivers, blindly —
+raw bytes have no sequence numbers — so the safety invariant is the
+paper-thin one that matters: the applied stream must be exactly
+``0, 1, 2, ...``. A duplicate means a replay the reconciliation should
+have suppressed; a skip means a replay it wrongly suppressed.
+
+Scenarios exhausted: cut-before-delivery, cut-after-delivery
+(duplicate-chunk race), sender death mid-resume, stale-epoch resume
+replay, redial exhaustion (must escalate, never wedge).
+
+Mutations (teeth checks):
+- ``stale_epoch_accepted`` — the resume fence dropped: a stale frame's
+  ancient ``peer_recv`` drives reconciliation, replaying chunks the
+  receiver already applied (duplicate corruption);
+- ``resume_skips_chunk``   — reconciliation off by one: ``peer_recv ==
+  inflight`` treated as delivered, the lost chunk never replayed (skip
+  corruption).
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Tuple
+
+from ..mc import Action, Model
+
+UP = "UP"            # fenced socket live, chunks flow
+DOWN = "DOWN"        # cut; redial attempts remain
+RESUMING = "RESUMING"  # redialed; resume exchange in progress
+
+# Stale frames carry the previous incarnation's epoch.
+EPOCH = 1
+STALE_EPOCH = 0
+
+
+class RWorld(NamedTuple):
+    link: str
+    send_next: int                    # chunks the sender KNOWS completed
+    inflight: int                     # seq mid-step, -1 = between steps
+    applied: Tuple[int, ...]          # seqs the receiver applied, in order
+    wire: Tuple[Tuple, ...]           # ("data", seq) | ("resume", epoch, n)
+    cuts_used: int
+    redials: int                      # attempts burned on the CURRENT cut
+    discarded: int                    # replays suppressed at resume
+    stale_rejected: int
+    sender_alive: bool
+    stale_injected: bool
+    deaths_used: int
+    escalated: bool                   # today's error -> evict path
+
+
+class ReconnectModel(Model):
+    def __init__(self, chunks: int = 2, cuts: int = 2, attempts: int = 2,
+                 deaths: int = 1, mutations: Tuple[str, ...] = ()):
+        self.n = chunks
+        self.cuts = cuts
+        self.attempts = attempts  # HOROVOD_LINK_RETRY_ATTEMPTS analogue
+        self.deaths = deaths
+        self.mutations = tuple(mutations)
+        self.name = (f"reconnect(chunks={chunks}, cuts={cuts}, "
+                     f"attempts={attempts}, deaths={deaths}"
+                     + (f", mutations={self.mutations}" if mutations else "")
+                     + ")")
+
+    def initial(self) -> RWorld:
+        return RWorld(link=UP, send_next=0, inflight=-1, applied=(),
+                      wire=(), cuts_used=0, redials=0, discarded=0,
+                      stale_rejected=0, sender_alive=True,
+                      stale_injected=False, deaths_used=0, escalated=False)
+
+    # -- transition relation --------------------------------------------------
+
+    def actions(self, s: RWorld) -> List[Action]:
+        acts: List[Action] = []
+        if s.escalated:
+            return acts
+
+        if s.link == UP and s.sender_alive:
+            if s.inflight < 0 and s.send_next < self.n:
+                acts.append((f"send({s.send_next})", self._send(s)))
+            if s.inflight >= 0 and len(s.applied) > s.inflight:
+                # Both legs of the step moved: the duplex returns.
+                acts.append((f"step_done({s.inflight})",
+                             self._step_done(s)))
+            # The cut races the in-flight chunk: the scheduler orders
+            # deliver-then-cut (duplicate-chunk scenario) and
+            # cut-then-deliver (lost-chunk scenario) explicitly.
+            if s.inflight >= 0 and s.cuts_used < self.cuts:
+                acts.append((f"cut({s.inflight})", self._cut(s)))
+
+        for fi, frame in enumerate(s.wire):
+            if frame[0] == "data" and s.link == UP:
+                acts.append((f"deliver({frame[1]})", self._deliver(s, fi)))
+            if (frame[0] == "resume" and s.link == RESUMING
+                    and s.sender_alive):
+                acts.append((f"recv_resume(e{frame[1]},n{frame[2]})",
+                             self._recv_resume(s, fi)))
+
+        if s.link == DOWN and s.sender_alive:
+            if s.redials < self.attempts:
+                acts.append(("redial_ok", self._redial_ok(s)))
+                acts.append(("redial_fail", self._redial_fail(s)))
+            else:
+                # HOROVOD_LINK_RETRY_* exhausted: exactly today's error,
+                # into the evict/elastic path — never a wedge.
+                acts.append(("escalate(retries_exhausted)",
+                             self._escalate(s)))
+
+        if s.link == RESUMING:
+            if not s.stale_injected:
+                # A previous incarnation's resume frame replayed onto
+                # the fresh socket (stale-epoch replay scenario).
+                acts.append(("replay_stale_resume",
+                             self._inject_stale(s)))
+            if s.sender_alive and s.deaths_used < self.deaths:
+                acts.append(("die_mid_resume", self._die(s)))
+            if not s.sender_alive:
+                acts.append(("escalate(peer_dead)", self._escalate(s)))
+
+        return acts
+
+    def _send(self, s: RWorld) -> RWorld:
+        return s._replace(inflight=s.send_next,
+                          wire=s.wire + (("data", s.send_next),))
+
+    def _step_done(self, s: RWorld) -> RWorld:
+        return s._replace(send_next=s.inflight + 1, inflight=-1)
+
+    def _cut(self, s: RWorld) -> RWorld:
+        # The socket dies; in-flight data frames die with it. Whether
+        # the chunk was applied first is the scheduler's choice.
+        wire = tuple(f for f in s.wire if f[0] != "data")
+        return s._replace(link=DOWN, wire=wire, cuts_used=s.cuts_used + 1,
+                          redials=0)
+
+    def _deliver(self, s: RWorld, fi: int) -> RWorld:
+        frame = s.wire[fi]
+        return s._replace(applied=s.applied + (frame[1],),
+                          wire=s.wire[:fi] + s.wire[fi + 1:])
+
+    def _redial_ok(self, s: RWorld) -> RWorld:
+        # Fresh fenced socket; the receiver's resume frame reports how
+        # many chunks it has applied (its cross_recv_seq).
+        return s._replace(link=RESUMING, redials=s.redials + 1,
+                          wire=s.wire + (("resume", EPOCH, len(s.applied)),))
+
+    def _redial_fail(self, s: RWorld) -> RWorld:
+        return s._replace(redials=s.redials + 1)
+
+    def _inject_stale(self, s: RWorld) -> RWorld:
+        return s._replace(stale_injected=True,
+                          wire=s.wire + (("resume", STALE_EPOCH, 0),))
+
+    def _die(self, s: RWorld) -> RWorld:
+        return s._replace(sender_alive=False,
+                          deaths_used=s.deaths_used + 1)
+
+    def _escalate(self, s: RWorld) -> RWorld:
+        return s._replace(escalated=True, wire=())
+
+    def _recv_resume(self, s: RWorld, fi: int) -> RWorld:
+        _, epoch, peer_recv = s.wire[fi]
+        if epoch != EPOCH and "stale_epoch_accepted" not in self.mutations:
+            # The fence: a stale-incarnation frame is dropped, counted,
+            # and the exchange keeps waiting for the genuine one.
+            return s._replace(stale_rejected=s.stale_rejected + 1,
+                              wire=s.wire[:fi] + s.wire[fi + 1:])
+        # Reconciliation; the fresh socket supersedes the old exchange,
+        # so any remaining resume frames die with it.
+        wire = tuple(f for f in s.wire if f[0] != "resume")
+        if peer_recv == s.inflight + 1:
+            # Delivered before the cut: suppress the replay.
+            return s._replace(link=UP, wire=wire,
+                              send_next=s.inflight + 1, inflight=-1,
+                              discarded=s.discarded + 1)
+        if peer_recv == s.inflight:
+            if "resume_skips_chunk" in self.mutations:
+                # Planted off-by-one: the lost chunk declared delivered.
+                return s._replace(link=UP, wire=wire,
+                                  send_next=s.inflight + 1, inflight=-1)
+            # Died on the wire: replay the exact chunk boundary.
+            return s._replace(link=UP,
+                              wire=wire + (("data", s.inflight),))
+        # More than one frame adrift: unrecoverable in place.
+        return s._replace(escalated=True, wire=())
+
+    # -- properties -----------------------------------------------------------
+
+    def safety(self, s: RWorld) -> List[str]:
+        out: List[str] = []
+        for i, seq in enumerate(s.applied):
+            if seq == i:
+                continue
+            if seq < i:
+                out.append(
+                    f"chunk {seq} applied twice (position {i}): a replay "
+                    f"the resume reconciliation should have suppressed "
+                    f"(stale resume accepted, or discard missed)")
+            else:
+                out.append(
+                    f"chunk stream skipped to {seq} at position {i}: a "
+                    f"lost chunk was never replayed (resume declared it "
+                    f"delivered)")
+            break  # first corruption point tells the story
+        if (not s.escalated and s.send_next == self.n and s.inflight < 0
+                and len(s.applied) < self.n):
+            out.append(
+                f"sender believes all {self.n} chunks completed but the "
+                f"receiver applied only {len(s.applied)}")
+        return out
+
+    def is_quiescent(self, s: RWorld) -> bool:
+        if s.escalated:
+            # Today's error raised into the evict path: a clean terminal.
+            return True
+        return (s.link == UP and s.send_next == self.n and s.inflight < 0
+                and len(s.applied) == self.n and not s.wire)

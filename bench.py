@@ -222,6 +222,24 @@ def _run_worker(extra_args, env, timeout_s):
 
 def _build_parser():
     parser = argparse.ArgumentParser()
+    parser.add_argument("--workload", default="resnet",
+                        choices=["resnet", "zero"],
+                        help="'resnet': the headline synthetic-throughput "
+                             "benchmark (default). 'zero': the ZeRO "
+                             "stage-1/2/3 memory+throughput A/B "
+                             "(docs/zero.md) — per-device live-buffer "
+                             "bytes by jax.live_arrays accounting, "
+                             "analytic wire bytes/step, steps/sec, one "
+                             "subprocess per stage")
+    parser.add_argument("--zero-stage", type=int, default=None,
+                        choices=[1, 2, 3],
+                        help="with --workload zero: bench only this "
+                             "stage (default: all three, the stage "
+                             "1->3 memory curve)")
+    parser.add_argument("--zero-devices", type=int, default=4,
+                        help="with --workload zero: data-parallel world "
+                             "size d (CPU-virtual devices; the compiled "
+                             "SPMD programs match a d-chip world)")
     parser.add_argument("--model", default="resnet50",
                         choices=sorted(MODELS),
                         help="benchmark model (the reference's headline "
@@ -281,6 +299,8 @@ def _build_parser():
 
 def supervise(argv):
     args = _build_parser().parse_args(argv)
+    if args.workload == "zero":
+        return zero_bench(args)
     if args.image_size is None:
         args.image_size = MODELS[args.model]["size"]
 
@@ -951,9 +971,175 @@ def worker(argv):
     return 0
 
 
+# ---- ZeRO stage memory/throughput bench (--workload zero) ------------------
+#
+# Stage-1 -> 2 -> 3 A/B on a CPU-virtual data-parallel world (one process
+# per stage, d virtual devices — the compiled SPMD programs are identical
+# to a d-chip TPU world; only the transport differs). Reports, per stage:
+#
+#  - live_bytes_per_device_peak: jax.live_arrays() accounting on device 0,
+#    sampled at every eager boundary (post-init and after each step) —
+#    the persistent watermark the stages actually move. Stage 1's extra
+#    full-gradient buffer is a *transient inside* the compiled program
+#    (invisible to live_arrays); it is reported analytically as
+#    transient_full_grad_bytes and proven structurally by the jaxpr tests
+#    (tests/test_zero.py: stage 2 has no full-size psum output).
+#  - state_bytes_per_device: the ZeroTrainState leaves alone (the
+#    params+grads+state curve docs/zero.md tabulates; with the f32 SGD
+#    workload stage3/stage1 -> 1/(d+1)).
+#  - wire_bytes_per_step_per_device: analytic ring model — stage 1 pays
+#    an allreduce (2(d-1)/d) + gather, stage 2 a reduce-scatter + gather
+#    ((d-1)/d each), stage 3 a reduce-scatter + TWO gathers (forward +
+#    backward re-gather).
+#  - steps_per_sec over the timed iterations.
+#
+# The BENCH_r10 artifact is this JSON line for the 4-device world.
+
+def _zero_worker(argv):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--stage", type=int, required=True)
+    parser.add_argument("--devices", type=int, default=4)
+    parser.add_argument("--hidden", type=int, default=1024)
+    parser.add_argument("--layers", type=int, default=4)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-warmup", type=int, default=2)
+    parser.add_argument("--num-iters", type=int, default=10)
+    args = parser.parse_args(argv)
+
+    import jax
+    import numpy as np
+    import optax
+    import flax.linen as nn
+    from jax.sharding import Mesh
+
+    from horovod_tpu.common.state import AXIS_GLOBAL
+    from horovod_tpu.zero import init_zero_train_state, make_zero_train_step
+
+    devs = jax.devices()[:args.devices]
+    d = len(devs)
+    assert d == args.devices, f"only {d} devices (wanted {args.devices})"
+    mesh = Mesh(np.array(devs), (AXIS_GLOBAL,))
+
+    hidden, layers = args.hidden, args.layers
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            for _ in range(layers):
+                x = nn.relu(nn.Dense(hidden)(x))
+            return nn.Dense(16)(x)
+
+    model = MLP()
+    # Plain f32 SGD keeps the memory model crisp: no optimizer moments,
+    # so per-device state is exactly params(+masters) and the
+    # stage3/stage1 ratio lands at 1/(d+1) (docs/zero.md memory table).
+    optimizer = optax.sgd(1e-3)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(jax.random.PRNGKey(1),
+                          (args.batch_size, hidden))
+    y = jax.random.randint(jax.random.PRNGKey(2), (args.batch_size,),
+                           0, 16)
+
+    dev0 = devs[0]
+
+    def dev_bytes(arrs):
+        total = 0
+        for a in arrs:
+            try:
+                shards = a.addressable_shards
+            except Exception:
+                continue
+            for s in shards:
+                if s.device == dev0:
+                    total += int(s.data.size) * s.data.dtype.itemsize
+        return total
+
+    state = init_zero_train_state(model, optimizer, rng, x[:1], mesh,
+                                  zero_stage=args.stage)
+    step = make_zero_train_step(model, optimizer, mesh,
+                                zero_stage=args.stage)
+    peak = dev_bytes(jax.live_arrays())
+    for _ in range(args.num_warmup):
+        state, loss = step(state, x, y)
+        loss.block_until_ready()
+        peak = max(peak, dev_bytes(jax.live_arrays()))
+    t0 = time.perf_counter()
+    for _ in range(args.num_iters):
+        state, loss = step(state, x, y)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+    peak = max(peak, dev_bytes(jax.live_arrays()))
+
+    state_bytes = dev_bytes(
+        [l for l in jax.tree_util.tree_leaves(state)
+         if isinstance(l, jax.Array)])
+    padded = int(state.pshard.shape[0])
+    ring = (d - 1) / d
+    payload = padded * 4  # fp32 wire, uncompressed
+    reduce_leg = payload * ring * (2 if args.stage == 1 else 1)
+    gather_leg = payload * ring * (2 if args.stage == 3 else 1)
+    print(json.dumps({
+        "stage": args.stage,
+        "live_bytes_per_device_peak": peak,
+        "state_bytes_per_device": state_bytes,
+        "transient_full_grad_bytes": (payload if args.stage == 1
+                                      else payload // d),
+        "wire_bytes_per_step_per_device": int(reduce_leg + gather_leg),
+        "steps_per_sec": round(args.num_iters / dt, 3),
+        "params_padded_elems": padded,
+        "loss": round(float(loss), 6),
+    }), flush=True)
+    return 0
+
+
+def zero_bench(args):
+    stages = [args.zero_stage] if args.zero_stage else [1, 2, 3]
+    d = args.zero_devices
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "") +
+                          f" --xla_force_host_platform_device_count={d}"))
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    rows = []
+    for s in stages:
+        cmd = [sys.executable, os.path.abspath(__file__), "--zero-worker",
+               "--stage", str(s), "--devices", str(d),
+               "--batch-size", str(args.batch_size),
+               "--num-warmup", str(args.num_warmup),
+               "--num-iters", str(args.num_iters)]
+        r = subprocess.run(cmd, stdout=subprocess.PIPE,
+                           stderr=None, text=True, timeout=600, env=env)
+        row = None
+        for line in reversed(r.stdout.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                row = json.loads(line)
+                break
+        assert r.returncode == 0 and row is not None, \
+            f"zero-bench stage {s} worker failed (rc={r.returncode})"
+        rows.append(row)
+    by = {row["stage"]: row for row in rows}
+    ratio = None
+    if 1 in by and 3 in by and by[1]["state_bytes_per_device"]:
+        ratio = round(by[3]["state_bytes_per_device"]
+                      / by[1]["state_bytes_per_device"], 4)
+    result = {
+        "metric": "zero_stage3_vs_stage1_state_bytes",
+        "value": ratio,
+        "unit": "per-device live param+grad+state bytes, stage3/stage1",
+        "expected_ratio": round(1.0 / (d + 1), 4),
+        "world": {"devices": d, "batch_size": args.batch_size,
+                  "warmup": args.num_warmup, "iters": args.num_iters},
+        "stages": rows,
+    }
+    print(json.dumps(result))
+    return 0
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
         sys.exit(worker(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "--zero-worker":
+        sys.exit(_zero_worker(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == "--local-leg-worker":
         sys.exit(_local_leg_worker(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == "--local-leg":

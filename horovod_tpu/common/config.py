@@ -109,6 +109,13 @@ HOROVOD_LINK_RETRY_ATTEMPTS = "HOROVOD_LINK_RETRY_ATTEMPTS"
 HOROVOD_LINK_RETRY_BACKOFF_MS = "HOROVOD_LINK_RETRY_BACKOFF_MS"
 HOROVOD_LINK_RETRY_DEADLINE_MS = "HOROVOD_LINK_RETRY_DEADLINE_MS"
 HOROVOD_CHAOS_SPEC = "HOROVOD_CHAOS_SPEC"
+# ZeRO partitioning plane (zero.py; docs/zero.md): which tensors are
+# partitioned 1/d across the mesh, and how far ahead the stage-3
+# parameter gathers may run.
+HOROVOD_ZERO_STAGE = "HOROVOD_ZERO_STAGE"
+HOROVOD_ZERO_PREFETCH = "HOROVOD_ZERO_PREFETCH"
+DEFAULT_ZERO_STAGE = 2
+DEFAULT_ZERO_PREFETCH = 1
 DEFAULT_LINK_RETRY_ATTEMPTS = 3
 DEFAULT_LINK_RETRY_BACKOFF_MS = 100
 # Sized well below DEFAULT_LIVENESS_TIMEOUT_MS on purpose: healing must
@@ -738,6 +745,37 @@ def stripe_fallback_enabled() -> bool:
     return _get_bool(HOROVOD_STRIPE_FALLBACK, default=True)
 
 
+def zero_stage() -> int:
+    """ZeRO partitioning stage for ``zero.py`` states built with
+    ``zero_stage="auto"`` (docs/zero.md): 1 shards only optimizer state
+    (gradients mean-reduced in full, the classic stage-1 memory shape),
+    2 additionally partitions gradients (per-bucket reduce-scatter lands
+    each gradient directly in its owning rank's shard — the layout this
+    module has always compiled, hence the default), 3 additionally
+    partitions parameters (persisted only as the 1/d fp32 master shard;
+    the forward pass all-gathers each fusion bucket just in time).
+    Clamped to [1, 3]. The stage is stamped into the ``ZeroTrainState``
+    at init — a step resolving a different stage is rejected, so this
+    knob can never silently flip a live state's layout."""
+    return max(1, min(3, _get_int(HOROVOD_ZERO_STAGE, DEFAULT_ZERO_STAGE)))
+
+
+def zero_prefetch_env():
+    """(depth, explicit) for the stage-3 gather prefetch depth
+    (docs/zero.md): how many parameter all-gathers beyond the bucket
+    currently being consumed may be in flight. 0 fully serializes the
+    gathers (bucket i+1's gather waits on bucket i's); depth p chains
+    each gather to the gather p+1 buckets earlier, bounding transient
+    gathered-parameter memory at ~(p+1) buckets while leaving
+    consecutive gathers dataflow-independent for the latency-hiding
+    scheduler to overlap with compute. Clamped to [0, 8]. The raw-env
+    half of ``fusion.resolve_prefetch_depth("auto")`` — the live config
+    (autotuner-pinned value) takes precedence."""
+    v, explicit = _get_int_explicit(HOROVOD_ZERO_PREFETCH,
+                                    DEFAULT_ZERO_PREFETCH)
+    return max(0, min(8, v)), explicit
+
+
 def link_retry_attempts() -> int:
     """How many times a failed cross-host data link redials in place
     before the failure escalates (csrc/hvd/ring_ops.cc ``HealCrossStep``;
@@ -875,6 +913,11 @@ class RuntimeConfig:
     # the uncompressed path (same contract as the fusion threshold).
     compression: str = "none"
     compression_explicit: bool = False
+    # Stage-3 gather prefetch depth (zero.py; docs/zero.md). Explicit
+    # means env-set or autotuner-pinned; resolve_prefetch_depth("auto")
+    # prefers this over the raw env exactly like the fusion threshold.
+    zero_prefetch: int = DEFAULT_ZERO_PREFETCH
+    zero_prefetch_explicit: bool = False
     cycle_time_ms: float = DEFAULT_CYCLE_TIME_MS
     cache_capacity: int = DEFAULT_CACHE_CAPACITY
     timeline_filename: str = ""
@@ -900,11 +943,14 @@ class RuntimeConfig:
             HOROVOD_FUSION_THRESHOLD, DEFAULT_FUSION_THRESHOLD_BYTES)
         compression, compression_explicit = _get_choice_explicit(
             HOROVOD_COMPRESSION, COMPRESSION_CHOICES, "none")
+        prefetch, prefetch_explicit = zero_prefetch_env()
         return cls(
             fusion_threshold_bytes=fusion_bytes,
             fusion_threshold_explicit=fusion_explicit,
             compression=compression,
             compression_explicit=compression_explicit,
+            zero_prefetch=prefetch,
+            zero_prefetch_explicit=prefetch_explicit,
             cycle_time_ms=_get_float(HOROVOD_CYCLE_TIME, DEFAULT_CYCLE_TIME_MS),
             cache_capacity=_get_int(HOROVOD_CACHE_CAPACITY, DEFAULT_CACHE_CAPACITY),
             timeline_filename=os.environ.get(HOROVOD_TIMELINE, ""),

@@ -71,6 +71,14 @@ CATALOG = (
                              # kills/delays/raises while chunks are
                              # mid-flight across the stripe sockets
     "xla.exec",              # eager engine executing an XLA-plane response
+    "zero.gather",           # ZeRO stage-3 parameter-gather leg
+                             # (zero.py step dispatch; docs/zero.md):
+                             # armed on the host side as a stage-3 step
+                             # launches its gather-bearing program, so
+                             # kind=raise surfaces HorovodInternalError
+                             # to the elastic retry loop exactly where a
+                             # real gather failure would — the partition
+                             # plane's chaos hook
     "elastic.worker.start",  # driver-side worker launch (slot.rank)
     "checkpoint.write",      # CheckpointManager.save
     "control.heartbeat",     # worker heartbeat KV put (docs/liveness.md);

@@ -41,8 +41,10 @@ __all__ = [
     "Bucket",
     "plan_buckets",
     "plan_buckets_for",
+    "forward_bucket_order",
     "leaf_nbytes",
     "resolve_bucket_cap",
+    "resolve_prefetch_depth",
     "describe_plan",
 ]
 
@@ -167,6 +169,53 @@ def plan_buckets_for(leaves: Sequence[Any],
     plane."""
     return plan_buckets([leaf_wire_nbytes(l, compression) for l in leaves],
                         [l.dtype for l in leaves], bucket_cap_bytes)
+
+
+def forward_bucket_order(buckets: Sequence[Bucket]) -> Tuple[int, ...]:
+    """The backward-order plan, run FORWARD: bucket indices ordered by
+    their smallest leaf index, i.e. the order the forward pass consumes
+    parameters. ``plan_buckets`` emits buckets in reverse parameter
+    order (backward-production order, for gradient collectives); the
+    ZeRO stage-3 parameter gathers walk the *same* buckets in this
+    order, so the first bucket gathered is the first one the forward
+    compute needs and a depth-p prefetch chain keeps at most p+1
+    buckets' params gathered ahead of the compute front (docs/zero.md).
+    For the monolithic per-dtype plan (no cap) this is first-seen dtype
+    order — already forward order."""
+    return tuple(sorted(range(len(buckets)),
+                        key=lambda j: min(buckets[j].indices)
+                        if buckets[j].indices else 0))
+
+
+def resolve_prefetch_depth(depth="auto") -> int:
+    """Resolve the stage-3 gather prefetch depth to a concrete int
+    (clamped to [0, 8]).
+
+    - ``"auto"`` (the plumbing default): the autotuned/explicit
+      ``HOROVOD_ZERO_PREFETCH`` when one is in force — the live runtime
+      config first (the autotuner pins its grid winner there), else the
+      raw env — otherwise the default depth 1 (one bucket gathered
+      ahead: overlap without unbounded gather memory).
+    - an int: that depth (0 = fully serialized gathers).
+
+    Unlike the bucket cap, depth never changes results — only the
+    dataflow chain between gathers — so "auto" always yields a depth
+    (there is no "unset disables the feature" case; stage 3 itself is
+    the opt-in)."""
+    if not isinstance(depth, str):
+        return max(0, min(8, int(depth)))
+    if depth != "auto":
+        raise ValueError(
+            f"prefetch depth must be an int or 'auto'; got {depth!r}")
+    from . import config as _config
+    from .state import global_state
+
+    st = global_state()
+    if (st.initialized and st.config is not None
+            and getattr(st.config, "zero_prefetch_explicit", False)):
+        return max(0, min(8, int(st.config.zero_prefetch)))
+    v, _ = _config.zero_prefetch_env()
+    return v
 
 
 def _dtype_key(dtype: Any) -> str:

@@ -42,7 +42,9 @@ class ParameterManager:
                  xla_cap_setter=None,
                  compression_setter=None,
                  compression_candidates=(),
-                 stripe_candidates=()):
+                 stripe_candidates=(),
+                 zero_prefetch_setter=None,
+                 zero_prefetch_candidates=()):
         self._core = core
         # Tensor-fusion v2 hook: the tuned fusion threshold also governs
         # the XLA plane's bucket cap (common/fusion.resolve_bucket_cap
@@ -107,11 +109,28 @@ class ParameterManager:
         # log column shows "-" rather than claiming a mode this tuner
         # has not applied yet.
         self._current_compression: Optional[str] = None
+        # Prefetch phase (ZeRO stage-3's gather-overlap depth, zero.py;
+        # docs/zero.md): a categorical grid over HOROVOD_ZERO_PREFETCH
+        # depths, scored like the other grids and pinned via
+        # zero_prefetch_setter (publishes into the live RuntimeConfig,
+        # which "auto"-built stage-3 steps re-resolve each call — a
+        # changed depth is a new compile, not a drift). Opt-in like the
+        # stripe grid: only populated on single-controller worlds where
+        # stage 3 is in force. Depth never changes numerics — only the
+        # dataflow chain between gathers — so every candidate is a safe
+        # A/B. Runs last among the categoricals, before the numeric GP.
+        self._zero_prefetch_setter = zero_prefetch_setter
+        self._pf_candidates = (list(zero_prefetch_candidates)
+                               if zero_prefetch_setter else [])
+        self._pf_scores: dict = {}
+        self._pf_best: Optional[int] = None
         self._log_rows = 0
         if self._cat_combos:
             self._apply_hier(self._cat_combos[0])
         elif self._comp_candidates:
             self._apply_compression(self._comp_candidates[0])
+        elif self._pf_candidates:
+            self._apply_zero_prefetch(self._pf_candidates[0])
         if log_file:
             with open(log_file, "w") as f:
                 f.write("sample,fusion_mb,cycle_ms,hier_flags,compression,"
@@ -169,6 +188,8 @@ class ParameterManager:
                 self._apply_stripes(self._stripe_candidates[0])
             elif self._comp_candidates:
                 self._apply_compression(self._comp_candidates[0])
+            elif self._pf_candidates:
+                self._apply_zero_prefetch(self._pf_candidates[0])
             return
         # Phase 1a': grid over the cross-host stripe counts, pin the
         # winner (each candidate is applied frame-synced on every rank,
@@ -190,6 +211,8 @@ class ParameterManager:
                 f"MB/s)")
             if self._comp_candidates:
                 self._apply_compression(self._comp_candidates[0])
+            elif self._pf_candidates:
+                self._apply_zero_prefetch(self._pf_candidates[0])
             return
         # Phase 1b: grid over the compression modes, pin the winner.
         if self._comp_candidates:
@@ -204,6 +227,24 @@ class ParameterManager:
             _log.info(
                 f"autotune: compression pinned to {self._comp_best!r} "
                 f"({self._comp_scores[self._comp_best] / MB:.1f} MB/s)")
+            if self._pf_candidates:
+                self._apply_zero_prefetch(self._pf_candidates[0])
+            return
+        # Phase 1c: grid over the ZeRO stage-3 gather prefetch depths,
+        # pin the winner.
+        if self._pf_candidates:
+            depth = self._pf_candidates.pop(0)
+            self._pf_scores[depth] = score
+            if self._pf_candidates:
+                self._apply_zero_prefetch(self._pf_candidates[0])
+                return
+            self._pf_best = max(self._pf_scores,
+                                key=self._pf_scores.get)
+            self._apply_zero_prefetch(self._pf_best)
+            _log.info(
+                f"autotune: zero-3 prefetch depth pinned to "
+                f"{self._pf_best} "
+                f"({self._pf_scores[self._pf_best] / MB:.1f} MB/s)")
             return
         # Phase 2: numeric GP over (fusion, cycle).
         self._bayes.add_sample([fusion_mb, cycle_ms], score)
@@ -254,6 +295,10 @@ class ParameterManager:
         if self._compression_setter is not None:
             self._compression_setter(mode)
 
+    def _apply_zero_prefetch(self, depth: int) -> None:
+        if self._zero_prefetch_setter is not None:
+            self._zero_prefetch_setter(int(depth))
+
     # introspection
     @property
     def current(self):
@@ -278,3 +323,9 @@ class ParameterManager:
     def compression(self) -> Optional[str]:
         """The pinned compression mode (None before phase 1b ends)."""
         return self._comp_best
+
+    @property
+    def zero_prefetch(self) -> Optional[int]:
+        """The pinned stage-3 gather prefetch depth (None before the
+        prefetch grid ends or when it never ran)."""
+        return self._pf_best

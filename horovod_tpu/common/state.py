@@ -249,16 +249,40 @@ def init(comm=None, devices=None):
             if _config.stripes() > 1:
                 stripe_candidates = (1, _config.stripes())
 
+            def _publish_zero_prefetch(depth: int) -> None:
+                # Same live-config publish as the bucket cap, for the
+                # stage-3 gather prefetch depth:
+                # fusion.resolve_prefetch_depth("auto") reads it, so
+                # "auto"-built stage-3 steps re-resolve and recompile at
+                # the new depth on their next call. SINGLE-CONTROLLER
+                # ONLY (same divergence argument as the cap). Depth
+                # never changes numerics — only the gather dataflow
+                # chain — so the tuner may pick freely.
+                cfg.zero_prefetch = int(depth)
+                cfg.zero_prefetch_explicit = True
+
+            # Prefetch grid (docs/zero.md): only when ZeRO stage 3 is in
+            # force — on stage-1/2 worlds there are no forward gathers
+            # to pace, and the grid would score noise against noise.
+            # Depths 0 (serialized), 1 (default), 2: the marginal win of
+            # deeper in-flight windows decays fast while the gathered-
+            # buffer watermark grows linearly.
+            zero_prefetch_candidates = ()
+            if _config.zero_stage() == 3:
+                zero_prefetch_candidates = (0, 1, 2)
+
             if _state.process_count > 1:
                 _log.debug(
-                    "autotune: XLA bucket-cap/compression publish "
-                    "disabled in multi-process worlds (set "
-                    "HOROVOD_FUSION_THRESHOLD / HOROVOD_COMPRESSION "
-                    "explicitly — same env everywhere — to govern the "
-                    "compiled path)")
+                    "autotune: XLA bucket-cap/compression/prefetch "
+                    "publish disabled in multi-process worlds (set "
+                    "HOROVOD_FUSION_THRESHOLD / HOROVOD_COMPRESSION / "
+                    "HOROVOD_ZERO_PREFETCH explicitly — same env "
+                    "everywhere — to govern the compiled path)")
                 _publish_xla_cap = None
                 _publish_compression = None
                 comp_candidates = ()
+                _publish_zero_prefetch = None
+                zero_prefetch_candidates = ()
 
             core = _state.engine.native_core
             _state.autotuner = ParameterManager(
@@ -279,7 +303,10 @@ def init(comm=None, devices=None):
                 compression_setter=(_publish_compression
                                     if comp_candidates else None),
                 compression_candidates=comp_candidates,
-                stripe_candidates=stripe_candidates)
+                stripe_candidates=stripe_candidates,
+                zero_prefetch_setter=(_publish_zero_prefetch
+                                      if zero_prefetch_candidates else None),
+                zero_prefetch_candidates=zero_prefetch_candidates)
 
         _state.initialized = True
 

@@ -406,3 +406,52 @@ def alltoall(tensor, axis_name: str = AXIS_GLOBAL):
 def barrier(axis_name: str = AXIS_GLOBAL):
     """A minimal synchronizing collective."""
     return lax.psum(jnp.ones((), dtype=jnp.int32), axis_name)
+
+
+# ---- ZeRO partitioning legs (zero.py; docs/zero.md) -------------------------
+#
+# The named collective legs of the ZeRO step, kept here so the partition
+# plane speaks the same op vocabulary as the data plane: one place owns
+# the fp32-accumulation-window discipline for the scatter leg and the
+# prefetch-chaining trick for the gather leg.
+
+
+def zero_reducescatter(flat, axis_name: str = AXIS_GLOBAL, wire_dtype=None):
+    """The gradient-partitioning leg: reduce-scatter one padded fp32
+    bucket flat, each rank keeping its own 1/d shard of the sum.
+
+    With ``wire_dtype`` (fp16/bf16 compression) the payload travels — and
+    the ring accumulates — at the 16-bit wire dtype, and the reduced
+    shard is upcast to fp32 before any averaging happens on it: fp32
+    accumulation on the reduced value, the same window discipline as
+    ``allreduce``. Callers average (``/ d``) outside, at fp32."""
+    payload = flat.astype(wire_dtype) if wire_dtype is not None else flat
+    seg = lax.psum_scatter(payload, axis_name, tiled=True)
+    return seg.astype(jnp.float32) if wire_dtype is not None else seg
+
+
+def zero_allgather(seg, axis_name: str = AXIS_GLOBAL, gather_dtype=None,
+                   anchor=None):
+    """The parameter-(re)assembly leg: all-gather one 1/d shard segment
+    into the full padded bucket flat, optionally at a narrower
+    ``gather_dtype`` (uniform-dtype models gather at the model dtype —
+    half the wire bytes of fp32 for bf16 params).
+
+    ``anchor`` is the prefetch chain (docs/zero.md): when given, the
+    gather takes a dataflow dependence on it through an
+    ``optimization_barrier`` — zero bytes of real data (callers pass a
+    zero-length slice of an earlier gather's output), but a real edge in
+    the program, so a gather chained to the gather p+1 buckets earlier
+    cannot be hoisted arbitrarily far ahead of the compute front. The
+    barrier bounds how many gathered bucket flats can be in flight at
+    ~(p+1) without serializing consecutive gathers against compute —
+    exactly the shape the latency-hiding scheduler overlaps. NOTE:
+    ``optimization_barrier`` has no differentiation rule; inside a
+    differentiated step this helper must be called from a
+    ``custom_vjp`` forward (zero.py does), never from open AD-traced
+    code."""
+    if anchor is not None:
+        seg, _ = lax.optimization_barrier((seg, anchor))
+    if gather_dtype is not None:
+        seg = seg.astype(gather_dtype)
+    return lax.all_gather(seg, axis_name, tiled=True)

@@ -13,6 +13,12 @@ of compilation rather than a background fusion buffer.
 reference ``torch/optimizer.py:46``) is supported via
 ``optax.MultiSteps``-style accumulation handled by the caller or the
 ``accumulate`` knob here.
+
+This wrapper keeps params, grads, and optimizer state fully replicated —
+the right trade when memory is not the constraint. When it is, the ZeRO
+plane (``zero.py``, ``HOROVOD_ZERO_STAGE={1,2,3}``) shards state, then
+gradients, then parameters 1/d across the mesh while keeping this
+module's compression and fusion semantics (docs/zero.md).
 """
 
 from __future__ import annotations

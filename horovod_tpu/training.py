@@ -6,6 +6,12 @@ gradients combined across the mesh inside one compiled program. Gradient
 allreduce compiles to fused XLA AllReduces over ICI — communication overlaps
 backprop automatically, subsuming the reference's background-thread fusion
 cycle for the static-graph fast path (SURVEY §7 design stance).
+
+Memory-partitioned training (ZeRO stages 1-3: sharded optimizer state,
+scattered gradients, gathered-on-demand parameters) lives in ``zero.py``
+and is re-exported here — ``make_zero_train_step`` is the drop-in
+alternative to ``make_train_step`` when per-device memory, not compute,
+bounds the model (``HOROVOD_ZERO_STAGE``; docs/zero.md).
 """
 
 from __future__ import annotations
@@ -22,6 +28,12 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .common.compat import shard_map as _shard_map
 from .common.state import AXIS_GLOBAL
 from .opt import DistributedOptimizer
+from .zero import (  # noqa: F401  (re-export: the ZeRO step builders)
+    ZeroTrainState,
+    gather_params,
+    init_zero_train_state,
+    make_zero_train_step,
+)
 
 
 class TrainState(NamedTuple):

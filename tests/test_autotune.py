@@ -223,3 +223,67 @@ def test_autotune_end_to_end_engine():
         for k in list(os.environ):
             if k.startswith("HOROVOD_AUTOTUNE"):
                 del os.environ[k]
+
+
+# ---- phase 1c: the ZeRO stage-3 gather prefetch depth ----------------------
+
+
+def test_parameter_manager_zero_prefetch_phase():
+    """The stage-3 prefetch depth joins the categorical grid (phase 1c,
+    after compression): candidates are A/B'd via the setter, the winner
+    is pinned, and the pin survives numeric-GP convergence."""
+    applied = []
+    pm = ParameterManager(_FakeCore(), warmup_samples=0, steps_per_sample=1,
+                          max_samples=2,
+                          zero_prefetch_setter=applied.append,
+                          zero_prefetch_candidates=(0, 1, 2))
+    assert applied == [0]  # grid starts on the first candidate
+    pm.update(MB)       # depth 0 sample
+    pm.update(9 * MB)   # depth 1 sample -> wins
+    pm.update(2 * MB)   # depth 2 sample; grid done, winner re-applied
+    assert pm.zero_prefetch == 1
+    assert applied[-1] == 1
+    assert pm.active  # numeric GP phase still running
+    pm.update(MB)
+    pm.update(MB)
+    assert not pm.active
+    assert pm.zero_prefetch == 1  # pinned decision survives convergence
+
+
+def test_parameter_manager_prefetch_runs_after_compression():
+    """Phase ordering: compression's grid completes (and pins) before a
+    single prefetch candidate is scored."""
+    applied = []
+    comp_applied = []
+    pm = ParameterManager(_FakeCore(), warmup_samples=0, steps_per_sample=1,
+                          max_samples=2,
+                          compression_setter=comp_applied.append,
+                          compression_candidates=("none", "fp16"),
+                          zero_prefetch_setter=applied.append,
+                          zero_prefetch_candidates=(0, 1))
+    assert comp_applied == ["none"] and applied == []
+    pm.update(MB)       # compression "none"
+    pm.update(8 * MB)   # compression "fp16" -> pinned; prefetch starts
+    assert comp_applied[-1] == "fp16"
+    assert applied == [0]
+    pm.update(7 * MB)   # depth 0 -> wins over...
+    pm.update(MB)       # ...depth 1; pinned
+    assert pm.zero_prefetch == 0
+    assert applied[-1] == 0
+
+
+def test_resolve_prefetch_depth_env_and_pin(monkeypatch):
+    """fusion.resolve_prefetch_depth: explicit ints clamp to [0, 8];
+    "auto" follows HOROVOD_ZERO_PREFETCH, defaulting to depth 1."""
+    from horovod_tpu.common import config as _config
+    from horovod_tpu.common.fusion import resolve_prefetch_depth
+
+    assert resolve_prefetch_depth(3) == 3
+    assert resolve_prefetch_depth(-5) == 0
+    assert resolve_prefetch_depth(99) == 8
+    monkeypatch.delenv(_config.HOROVOD_ZERO_PREFETCH, raising=False)
+    assert resolve_prefetch_depth("auto") == _config.DEFAULT_ZERO_PREFETCH
+    monkeypatch.setenv(_config.HOROVOD_ZERO_PREFETCH, "4")
+    assert resolve_prefetch_depth("auto") == 4
+    with pytest.raises(ValueError, match="prefetch depth"):
+        resolve_prefetch_depth("fast")

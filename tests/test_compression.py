@@ -391,7 +391,7 @@ def test_zero_compressed_scatter_element_type(hvd):
     zstep, zstate, imgs, lbls = _zero_problem(hvd, "fp16")
     zstate2, _ = zstep(zstate, imgs, lbls)
     prog = next(iter(zstep.cache.values()))
-    hlo = prog.lower(zstate._replace(bucket_cap=None), imgs,
+    hlo = prog.lower(zstate._replace(bucket_cap=None, stage=None), imgs,
                      lbls).compile().as_text()
     rs = [l for l in hlo.splitlines() if "reduce-scatter(" in l]
     assert rs, "no reduce-scatter in compiled ZeRO step"

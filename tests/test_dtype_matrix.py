@@ -362,3 +362,80 @@ def test_bucketed_mixed_dtype_pytree(hvd):
         for row in np.asarray(ob.astype(jnp.float64)):
             np.testing.assert_array_equal(row, expect)
         np.testing.assert_array_equal(np.asarray(om), np.asarray(ob))
+
+
+# ---- ZeRO stage x model-dtype matrix ---------------------------------------
+#
+# The stage ladder (zero.py) against each parameter dtype: fp32 masters
+# always carry the update; gathers run at the model dtype for uniform
+# trees (stage 1/2 re-gather after the update, stage 3 just-in-time in
+# the forward), and both partitioned stages must track stage 1 — exactly
+# for fp32, within a cast-rounding tolerance for bf16/fp16 params.
+
+
+@pytest.mark.parametrize("np_dtype", [np.float32, "bfloat16", np.float16])
+@pytest.mark.parametrize("stage", [2, 3])
+def test_zero_stage_dtype_matrix(hvd, np_dtype, stage):
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.training import shard_batch
+    from horovod_tpu.zero import (
+        gather_params, init_zero_train_state, make_zero_train_step)
+
+    dtype = jnp.bfloat16 if np_dtype == "bfloat16" else jnp.dtype(np_dtype)
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(16, param_dtype=dtype, dtype=dtype)(x))
+            return nn.Dense(4, param_dtype=dtype, dtype=dtype)(x)
+
+    mesh = hvd.mesh()
+    d = hvd.size()
+    model = MLP()
+    opt = optax.sgd(0.1)
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.zeros((1, 8), jnp.float32)
+    # Identical per-rank micro-batches: cross-rank sums are d*g (an
+    # exponent shift) — order-independent, so the stages compare
+    # exactly, per the matrix discipline above.
+    base_i = np.random.RandomState(0).rand(2, 8).astype(np.float32)
+    base_l = np.random.RandomState(1).randint(0, 4, 2).astype(np.int32)
+    imgs, lbls = shard_batch(
+        (jnp.asarray(np.tile(base_i, (d, 1))),
+         jnp.asarray(np.tile(base_l, d))), mesh)
+
+    states, steps = {}, {}
+    for s in (1, stage):
+        states[s] = init_zero_train_state(
+            model, opt, rng, sample, mesh, zero_stage=s,
+            bucket_cap_bytes=TINY_CAP)
+        steps[s] = make_zero_train_step(
+            model, opt, mesh, donate=False, zero_stage=s,
+            bucket_cap_bytes=TINY_CAP)
+
+    for _ in range(2):
+        for s in (1, stage):
+            states[s], loss = states[s], None
+            states[s], loss_s = steps[s](states[s], imgs, lbls)
+            if s == 1:
+                loss1 = loss_s
+        np.testing.assert_allclose(float(loss1), float(loss_s), rtol=1e-6)
+
+    # Masters are fp32 at every stage; the trajectories agree on them.
+    assert states[stage].pshard.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(states[1].pshard),
+                               np.asarray(states[stage].pshard),
+                               rtol=1e-6, atol=1e-7)
+    # Model-dtype params land identically (stage 3 via gather_params).
+    p_other = (gather_params(states[stage], mesh) if stage == 3
+               else states[stage].params)
+    for a, b in zip(jax.tree_util.tree_leaves(states[1].params),
+                    jax.tree_util.tree_leaves(p_other)):
+        assert a.dtype == dtype and b.dtype == dtype
+        np.testing.assert_array_equal(np.asarray(a.astype(jnp.float32)),
+                                      np.asarray(b.astype(jnp.float32)))

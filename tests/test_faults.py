@@ -20,6 +20,11 @@ def _clean_fault_env(monkeypatch):
     monkeypatch.delenv(_config.HOROVOD_FAULT_SPEC, raising=False)
     faults.refresh()
     yield
+    # monkeypatch's own teardown (which restores the env) runs AFTER this
+    # fixture's, so drop the spec here before re-reading — otherwise the
+    # last test's armed spec survives the refresh and leaks, counters
+    # freshly reset, into every later test module that calls point().
+    os.environ.pop(_config.HOROVOD_FAULT_SPEC, None)
     faults.refresh()
 
 
@@ -554,3 +559,110 @@ def test_stall_report_drains_core_and_records_timeline(monkeypatch):
     report = hvd.stall_report()
     assert "grad.b3" in report
     assert events == [(_timeline.STALL_WARNING, {"report": report})]
+
+
+# ---- the zero.gather seam: ZeRO stage-3 partition plane --------------------
+#
+# The "zero.gather" catalog point arms in the stage-3 step dispatch as a
+# gather-bearing program launches (zero.py; docs/zero.md): kind=raise
+# must surface as HorovodInternalError OUT of the train step — the
+# partition plane composes with the elastic retry loop like every other
+# data-plane seam, not as a new failure domain.
+
+
+def _zero3_step(hvd):
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.training import shard_batch
+    from horovod_tpu.zero import init_zero_train_state, make_zero_train_step
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            return nn.Dense(4)(x.reshape((x.shape[0], -1)))
+
+    mesh = hvd.mesh()
+    model = Tiny()
+    opt = optax.sgd(0.1)
+    state = init_zero_train_state(model, opt, jax.random.PRNGKey(0),
+                                  jnp.zeros((1, 8), jnp.float32), mesh,
+                                  zero_stage=3)
+    step = make_zero_train_step(model, opt, mesh, donate=False,
+                                zero_stage=3)
+    import numpy as np
+    imgs, lbls = shard_batch(
+        (jnp.asarray(np.random.RandomState(0).rand(8, 8).astype("float32")),
+         jnp.asarray(np.random.RandomState(1).randint(0, 4, 8)
+                     .astype("int32"))), mesh)
+    return state, step, imgs, lbls
+
+
+def test_zero_gather_raise_surfaces_internal_error(hvd, monkeypatch):
+    """kind=raise at zero.gather escapes the stage-3 step as
+    HorovodInternalError (retryable), and the seam is OUTSIDE the
+    stage-1/2 path — the same spec leaves a stage-2 step untouched."""
+    state, step, imgs, lbls = _zero3_step(hvd)
+    state, _ = step(state, imgs, lbls)  # warm the program, unarmed
+    _arm(monkeypatch, "zero.gather:kind=raise")
+    with pytest.raises(HorovodInternalError):
+        step(state, imgs, lbls)
+
+    # Stage 2 never reaches the gather seam: same armed spec, clean step.
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.zero import init_zero_train_state, make_zero_train_step
+    import flax.linen as nn
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            return nn.Dense(4)(x.reshape((x.shape[0], -1)))
+
+    mesh = hvd.mesh()
+    model, opt = Tiny(), optax.sgd(0.1)
+    s2 = init_zero_train_state(model, opt, jax.random.PRNGKey(0),
+                               jnp.zeros((1, 8), jnp.float32), mesh,
+                               zero_stage=2)
+    step2 = make_zero_train_step(model, opt, mesh, donate=False,
+                                 zero_stage=2)
+    s2, _ = step2(s2, imgs, lbls)  # does not raise
+
+
+def test_zero_gather_fault_reaches_retry_loop(hvd, monkeypatch):
+    """The full elastic story: a one-shot gather fault fails the armed
+    step, retry_loop catches the HorovodInternalError, reinitializes,
+    restores the last committed snapshot, and the re-run batch
+    completes — the stage-3 partition plane rides the same recovery
+    rail as the host-plane collectives."""
+    from horovod_tpu.elastic.state import ObjectState, retry_loop
+
+    zstate, zstep, imgs, lbls = _zero3_step(hvd)
+    zstep(zstate, imgs, lbls)  # warm the program, unarmed
+
+    state = ObjectState(bcast_object=lambda obj, root_rank=0: obj, batch=0)
+    reinits = []
+
+    def reinitialize():
+        reinits.append(True)
+
+    log = []
+
+    def train(state):
+        while state.batch < 3:
+            zs, _ = zstep(zstate, imgs, lbls)  # hit 0 fires once armed
+            state.batch += 1
+            log.append(state.batch)
+            state.commit()
+        return state.batch
+
+    # step=0 + kind=raise: fires on the FIRST armed gather launch, once.
+    _arm(monkeypatch, "zero.gather:step=0:kind=raise")
+    assert retry_loop(train, reinitialize)(state) == 3
+    assert len(reinits) == 1
+    # Batch 1's step died pre-commit; after recovery it re-ran.
+    assert log == [1, 2, 3]

@@ -236,9 +236,10 @@ def test_zero_bucketed_scatter_structure_and_numerics(hvd):
     # exposed program cache (populated by the eager calls above).
     def reduce_scatter_count(zstep, zstate):
         prog = next(iter(zstep.cache.values()))
-        # The cached program takes the state with bucket_cap stripped
-        # (the cap array travels outside the compiled step).
-        lowered = prog.lower(zstate._replace(bucket_cap=None), imgs, lbls)
+        # The cached program takes the state with bucket_cap and stage
+        # stripped (those arrays travel outside the compiled step).
+        lowered = prog.lower(zstate._replace(bucket_cap=None, stage=None),
+                             imgs, lbls)
         return lowered.as_text().count("reduce_scatter")
 
     n_mono = reduce_scatter_count(zstep_m, zstate_m)
@@ -286,3 +287,138 @@ def test_zero_auto_step_follows_state_layout(hvd):
     for a, b in zip(jax.tree_util.tree_leaves(s1.params),
                     jax.tree_util.tree_leaves(s2.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---- the ZeRO stage-3 gather prefetch chain --------------------------------
+#
+# Stage 3 all-gathers each bucket's params just-in-time in the forward
+# pass. The overlap contract (zero.py `_build_step_fn`): gather i's ONLY
+# dependence on earlier gathers is a zero-length anchor on gather
+# i-(p+1), so (a) up to p+1 gathers are in flight at once and (b) no
+# gather waits on compute — its operand cone must contain no
+# dot_general. The backward must RE-gather (remat, not saved buffers):
+# total all_gather count is exactly 2x the bucket count.
+
+
+def _all_bodies(jaxpr, acc):
+    """Every (sub-)jaxpr body reachable through eqn params."""
+    acc.append(jaxpr)
+    for eqn in jaxpr.eqns:
+        for v in eqn.params.values():
+            for w in (v if isinstance(v, (list, tuple)) else (v,)):
+                sub = getattr(w, "jaxpr", w)
+                if hasattr(sub, "eqns"):
+                    _all_bodies(sub, acc)
+    return acc
+
+
+def _count_prim(jaxpr, name):
+    c = 0
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == name:
+            c += 1
+        for v in eqn.params.values():
+            for w in (v if isinstance(v, (list, tuple)) else (v,)):
+                sub = getattr(w, "jaxpr", w)
+                if hasattr(sub, "eqns"):
+                    c += _count_prim(sub, name)
+    return c
+
+
+def _zero3_problem(hvd, bucket_cap, prefetch):
+    mesh = hvd.mesh()
+    model = MLP8()
+    opt = optax.sgd(0.1, momentum=0.9)
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.zeros((1, 16), jnp.float32)
+    zstate = init_zero_train_state(model, opt, rng, sample, mesh,
+                                   bucket_cap_bytes=bucket_cap,
+                                   zero_stage=3)
+    imgs = jnp.asarray(
+        np.random.RandomState(0).rand(16, 16).astype(np.float32))
+    lbls = jnp.asarray(
+        np.random.RandomState(1).randint(0, 10, 16).astype(np.int32))
+    imgs, lbls = shard_batch((imgs, lbls), mesh)
+    zstep = make_zero_train_step(model, opt, mesh, donate=False,
+                                 bucket_cap_bytes=bucket_cap,
+                                 prefetch=prefetch)
+    return zstep, zstate, imgs, lbls
+
+
+def _zero3_gather_bodies(zstep, zstate, imgs, lbls):
+    """[(body, [gather eqn idxs])] for every body holding the per-bucket
+    gather chain (>= 2 direct all_gather eqns): the forward pass and its
+    remat replay in the backward."""
+    prog = next(iter(zstep.cache.values()))
+    inp = zstate._replace(bucket_cap=None, stage=None, params=None)
+    jaxpr = jax.make_jaxpr(prog)(inp, imgs, lbls)
+    out = []
+    for body in _all_bodies(jaxpr.jaxpr, []):
+        sites = [i for i, e in enumerate(body.eqns)
+                 if e.primitive.name == "all_gather"]
+        if len(sites) >= 2:
+            out.append((body, sites))
+    assert out, "no body with a multi-bucket gather chain found"
+    return jaxpr, out
+
+
+def test_zero3_prefetch_gathers_overlap_independent(hvd):
+    """Depth 1: consecutive gathers are mutually cone-independent (both
+    may be in flight), the anchor chain bites at distance p+1 = 2, and
+    NO gather depends on any matmul — the structure XLA's latency-hiding
+    scheduler needs to hoist gathers over compute."""
+    zstep, zstate, imgs, lbls = _zero3_problem(hvd, BUCKET_CAP, prefetch=1)
+    zstep(zstate, imgs, lbls)  # populate the program cache
+    jaxpr, gather_bodies = _zero3_gather_bodies(zstep, zstate, imgs, lbls)
+
+    nb = len(gather_bodies[0][1])
+    assert nb >= 2, "BUCKET_CAP failed to split MLP8 into >= 2 buckets"
+    for body, sites in gather_bodies:
+        assert len(sites) == nb, (len(sites), nb)
+        cones = {i: _cone(body, i) for i in sites}
+        dots = [i for i, e in enumerate(body.eqns)
+                if e.primitive.name == "dot_general"]
+        for a, b in zip(sites, sites[1:]):
+            # Neither consecutive gather is in the other's operand cone.
+            assert a not in cones[b] and b not in cones[a], (a, b)
+        for a, b in zip(sites, sites[2:]):
+            # ...but the zero-length anchor serializes at distance 2:
+            # bounded prefetch, not an unbounded gather flood.
+            assert a in cones[b], (a, b)
+        for s in sites:
+            assert not any(d in cones[s] for d in dots), \
+                f"gather at eqn {s} depends on compute (dot_general)"
+
+    # The backward re-gathers every bucket (checkpoint_name +
+    # save_any_names_but_these policy): 2x nb gathers total, and the
+    # gradient exchange is one reduce-scatter per bucket (the gather
+    # VJP), never a full-gradient collective.
+    assert _count_prim(jaxpr.jaxpr, "all_gather") == 2 * nb
+    assert _count_prim(jaxpr.jaxpr, "reduce_scatter") == nb
+
+
+def test_zero3_prefetch_depth_zero_serializes_gathers(hvd):
+    """Depth 0 is the bounded-memory extreme: every gather's cone
+    contains its predecessor (one in flight at a time). Same numerics,
+    different dataflow chain — which is why depth is autotunable."""
+    zstep, zstate, imgs, lbls = _zero3_problem(hvd, BUCKET_CAP, prefetch=0)
+    zstep(zstate, imgs, lbls)
+    _, gather_bodies = _zero3_gather_bodies(zstep, zstate, imgs, lbls)
+    for body, sites in gather_bodies:
+        cones = {i: _cone(body, i) for i in sites}
+        for a, b in zip(sites, sites[1:]):
+            assert a in cones[b], (a, b)
+
+
+def test_zero3_prefetch_depth_changes_chain_not_results(hvd):
+    """Depths 0/1/2 must agree BITWISE: the anchor is a zero-length
+    slice — pure scheduling, zero data bytes."""
+    results = []
+    for pf in (0, 1, 2):
+        zstep, zstate, imgs, lbls = _zero3_problem(hvd, BUCKET_CAP, pf)
+        for _ in range(2):
+            zstate, loss = zstep(zstate, imgs, lbls)
+        results.append((float(loss), np.asarray(zstate.pshard)))
+    for loss, pshard in results[1:]:
+        assert loss == results[0][0]
+        np.testing.assert_array_equal(pshard, results[0][1])

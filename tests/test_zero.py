@@ -1,5 +1,8 @@
-"""ZeRO-1 sharded optimizer (``horovod_tpu/zero.py``): numerics match the
-replicated-optimizer step, and the optimizer state is genuinely sharded."""
+"""ZeRO sharded training (``horovod_tpu/zero.py``): numerics match the
+replicated-optimizer step, the optimizer state is genuinely sharded, and
+the stage ladder holds — stage 2 (scattered gradients) is bitwise stage 1,
+stage 3 (partitioned params) matches within float tolerance while holding
+zero replicated parameter bytes."""
 
 import numpy as np
 import pytest
@@ -15,7 +18,7 @@ from horovod_tpu.models.resnet import ResNet18  # noqa: E402
 from horovod_tpu.training import (  # noqa: E402
     init_train_state, make_train_step, replicate_state, shard_batch)
 from horovod_tpu.zero import (  # noqa: E402
-    init_zero_train_state, make_zero_train_step)
+    gather_params, init_zero_train_state, make_zero_train_step)
 
 
 @pytest.fixture(scope="module")
@@ -204,3 +207,262 @@ def test_zero_model_surgery_stale_state_errors(setup):
     stale = zstate._replace(params=surgered)
     with pytest.raises(ValueError, match="rebuild the state"):
         zstep(stale, imgs, lbls)
+
+
+# ---- the stage ladder (HOROVOD_ZERO_STAGE = 1 / 2 / 3) ---------------------
+
+
+def _mlp(hidden=32):
+    import flax.linen as nn
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(hidden)(x))
+            return nn.Dense(10)(x)
+
+    return MLP()
+
+
+def _tiled_batch(mesh, d):
+    """Every rank gets the IDENTICAL micro-batch, so cross-rank gradient
+    sums are d * g — an exponent shift for d a power of two, exact under
+    ANY reduction order. This is what makes psum-then-slice (stage 1)
+    vs psum_scatter (stage 2) comparable bitwise, not just closely."""
+    base_i = np.random.RandomState(0).rand(2, 8, 8, 3).astype(np.float32)
+    base_l = np.random.RandomState(1).randint(0, 10, 2).astype(np.int32)
+    imgs = np.tile(base_i, (d, 1, 1, 1))
+    lbls = np.tile(base_l, d)
+    return shard_batch((jnp.asarray(imgs), jnp.asarray(lbls)), mesh)
+
+
+def _stage_problem(setup, stage, bucket_cap_bytes=None, compression="auto",
+                   accumulate_steps=1, prefetch="auto"):
+    mesh = setup.mesh()
+    model = _mlp()
+    opt = optax.sgd(0.1, momentum=0.9)
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.zeros((1, 8, 8, 3), jnp.float32)
+    zstate = init_zero_train_state(model, opt, rng, sample, mesh,
+                                   bucket_cap_bytes=bucket_cap_bytes,
+                                   compression=compression,
+                                   accumulate_steps=accumulate_steps,
+                                   zero_stage=stage)
+    zstep = make_zero_train_step(model, opt, mesh, donate=False,
+                                 bucket_cap_bytes=bucket_cap_bytes,
+                                 compression=compression,
+                                 accumulate_steps=accumulate_steps,
+                                 zero_stage=stage, prefetch=prefetch)
+    return zstate, zstep, mesh
+
+
+def test_zero_stage2_matches_stage1_bitwise(setup):
+    """Gradient partitioning must be invisible to the math: stage 1
+    (psum the full bucket, slice your shard) and stage 2 (psum_scatter)
+    apply the same reduction to the same operands. On exactly-summable
+    inputs the trajectories are BITWISE equal — rtol 0."""
+    hvd = setup
+    s1, step1, mesh = _stage_problem(setup, 1)
+    s2, step2, _ = _stage_problem(setup, 2)
+    imgs, lbls = _tiled_batch(mesh, hvd.size())
+    for _ in range(3):
+        s1, l1 = step1(s1, imgs, lbls)
+        s2, l2 = step2(s2, imgs, lbls)
+        assert float(l1) == float(l2)
+    np.testing.assert_array_equal(np.asarray(s1.pshard),
+                                  np.asarray(s2.pshard))
+    for a, b in zip(jax.tree_util.tree_leaves(s1.params),
+                    jax.tree_util.tree_leaves(s2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_zero_stage3_matches_stage2(setup):
+    """Parameter partitioning changes WHERE params live, not what they
+    are: the stage-3 trajectory (gather-just-in-time + VJP
+    reduce-scatter) tracks stage 2, and gather_params reconstructs the
+    full pytree from the master shards."""
+    s2, step2, mesh = _stage_problem(setup, 2, bucket_cap_bytes=1024)
+    s3, step3, _ = _stage_problem(setup, 3, bucket_cap_bytes=1024)
+    imgs, lbls = _batch(mesh, hw=8, classes=10)
+    for _ in range(3):
+        s2, l2 = step2(s2, imgs, lbls)
+        s3, l3 = step3(s3, imgs, lbls)
+        np.testing.assert_allclose(float(l2), float(l3), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(s2.pshard), np.asarray(s3.pshard),
+                               rtol=1e-6, atol=1e-7)
+    gathered = gather_params(s3, mesh)
+    for a, b in zip(jax.tree_util.tree_leaves(s2.params),
+                    jax.tree_util.tree_leaves(gathered)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_zero_stage3_state_holds_no_param_bytes(setup):
+    """The stage-3 contract in state shape: params are a zero-byte
+    ShapeDtypeStruct template (preserved across steps), the fp32 master
+    shard is the only parameter storage, and the stage stamp rides the
+    state."""
+    hvd = setup
+    s3, step3, mesh = _stage_problem(setup, 3)
+    leaves = jax.tree_util.tree_leaves(s3.params)
+    assert leaves and all(isinstance(l, jax.ShapeDtypeStruct)
+                          for l in leaves)
+    assert int(np.asarray(s3.stage)) == 3
+    assert s3.pshard.sharding.spec == P(AXIS_GLOBAL)
+    d = hvd.size()
+    padded = int(s3.pshard.shape[0])
+    shard_shapes = {s.data.shape for s in s3.pshard.addressable_shards}
+    assert shard_shapes == {(padded // d,)}
+
+    imgs, lbls = _batch(mesh, hw=8, classes=10)
+    s3, _ = step3(s3, imgs, lbls)
+    assert all(isinstance(l, jax.ShapeDtypeStruct)
+               for l in jax.tree_util.tree_leaves(s3.params))
+    assert int(np.asarray(s3.stage)) == 3
+    assert int(s3.step) == 1
+
+
+def test_zero_stage_mismatch_rejected(setup):
+    """State-owns-the-stage: an explicit zero_stage argument that
+    disagrees with the state's stamp fails loudly, and a state with the
+    stamp stripped (hand-built / pre-stage checkpoint) gets the
+    descriptive rebuild error."""
+    s3, _, mesh = _stage_problem(setup, 3)
+    imgs, lbls = _batch(mesh, hw=8, classes=10)
+    model = _mlp()
+    opt = optax.sgd(0.1, momentum=0.9)
+    step2 = make_zero_train_step(model, opt, mesh, donate=False,
+                                 zero_stage=2)
+    with pytest.raises(ValueError, match="stage mismatch"):
+        step2(s3, imgs, lbls)
+
+    s2, step_auto, _ = _stage_problem(setup, 2)
+    with pytest.raises(ValueError, match="stage stamp"):
+        step_auto(s2._replace(stage=None), imgs, lbls)
+
+
+def test_zero_stage_template_forgery_rejected(setup):
+    """The stamp and the physical layout must agree in BOTH directions:
+    a stage-2 state whose stamp is forged to 3 still carries concrete
+    params (no template), and a stage-3 state forged to 2 carries no
+    replicated params — each is rejected, never silently run."""
+    s2, _, mesh = _stage_problem(setup, 2)
+    s3, _, _ = _stage_problem(setup, 3)
+    imgs, lbls = _batch(mesh, hw=8, classes=10)
+    # An "auto" step follows the state's stamp — so only the physical
+    # layout check can catch the forgery.
+    step_auto = make_zero_train_step(_mlp(), optax.sgd(0.1, momentum=0.9),
+                                     mesh, donate=False)
+    forged3 = s2._replace(stage=jnp.asarray(3, jnp.int32))
+    with pytest.raises(ValueError, match="shape template"):
+        step_auto(forged3, imgs, lbls)
+    forged2 = s3._replace(stage=jnp.asarray(2, jnp.int32))
+    with pytest.raises(ValueError, match="replicated params"):
+        step_auto(forged2, imgs, lbls)
+
+
+def test_zero_stage2_never_materializes_full_gradient(setup):
+    """The stage-2 point: the gradient collective is a reduce-scatter
+    (output 1/d), never a full-size all-reduce. Stage 1's program keeps
+    the classic full-gradient psum. Both re-gather updated params."""
+    s1, step1, mesh = _stage_problem(setup, 1)
+    s2, step2, _ = _stage_problem(setup, 2)
+    imgs, lbls = _batch(mesh, hw=8, classes=10)
+    step1(s1, imgs, lbls)
+    step2(s2, imgs, lbls)
+
+    def lowered_text(step, state):
+        prog = next(iter(step.cache.values()))
+        return prog.lower(state._replace(bucket_cap=None, stage=None),
+                          imgs, lbls).as_text()
+
+    t1 = lowered_text(step1, s1)
+    t2 = lowered_text(step2, s2)
+    assert t1.count("reduce_scatter") == 0
+    assert t2.count("reduce_scatter") >= 1
+    assert t1.count("all_gather") >= 1 and t2.count("all_gather") >= 1
+
+
+def test_zero_stage3_ef16_error_feedback_composes(setup):
+    """ef16 at stage 3 runs inside the gather VJP (residual injection +
+    compressed reduce-scatter) and must match the stage-2 ef16 step
+    exactly on order-independent inputs; residuals are sharded and
+    nonzero (the f16 wire genuinely rounds)."""
+    hvd = setup
+    s2, step2, mesh = _stage_problem(setup, 2, compression="ef16")
+    s3, step3, _ = _stage_problem(setup, 3, compression="ef16")
+    imgs, lbls = _tiled_batch(mesh, hvd.size())
+    for _ in range(3):
+        s2, l2 = step2(s2, imgs, lbls)
+        s3, l3 = step3(s3, imgs, lbls)
+        assert float(l2) == float(l3)
+    np.testing.assert_array_equal(np.asarray(s2.pshard),
+                                  np.asarray(s3.pshard))
+    np.testing.assert_array_equal(np.asarray(s2.residual),
+                                  np.asarray(s3.residual))
+    assert s3.residual.sharding.spec == P(AXIS_GLOBAL)
+    assert np.any(np.asarray(s3.residual) != 0.0)
+
+
+def test_zero_stage3_gradient_accumulation(setup):
+    """accumulate_steps composes with parameter partitioning: k
+    identical micro-batches at stage 3 land exactly where one plain
+    stage-3 update lands (same mean gradient), params stay a template
+    on skipped micro-steps."""
+    k = 2
+    sa, stepa, mesh = _stage_problem(setup, 3, accumulate_steps=k)
+    sb, stepb, _ = _stage_problem(setup, 3)
+    imgs, lbls = _batch(mesh, hw=8, classes=10)
+    for _ in range(k):
+        sa, _ = stepa(sa, imgs, lbls)
+    sb, _ = stepb(sb, imgs, lbls)
+    np.testing.assert_allclose(np.asarray(sa.pshard), np.asarray(sb.pshard),
+                               atol=1e-6)
+    assert sa.gaccum.sharding.spec == P(AXIS_GLOBAL)
+
+
+@pytest.mark.slow
+def test_zero_stage3_heavy_world(setup):
+    """Heavy stage-3 soak: a wide MLP with bucketed gathers, prefetch
+    depth 2, ef16 compression, and gradient accumulation — the full
+    composition — trains (loss decreases) and tracks the stage-2
+    trajectory."""
+    import flax.linen as nn
+
+    hvd = setup
+    mesh = hvd.mesh()
+
+    class Wide(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape((x.shape[0], -1))
+            for _ in range(4):
+                x = nn.relu(nn.Dense(512)(x))
+            return nn.Dense(10)(x)
+
+    model = Wide()
+    opt = optax.adam(1e-3)
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.zeros((1, 8, 8, 3), jnp.float32)
+    kw = dict(bucket_cap_bytes=256 * 1024, compression="ef16",
+              accumulate_steps=2)
+    s2 = init_zero_train_state(model, opt, rng, sample, mesh,
+                               zero_stage=2, **kw)
+    s3 = init_zero_train_state(model, opt, rng, sample, mesh,
+                               zero_stage=3, **kw)
+    step2 = make_zero_train_step(model, opt, mesh, donate=False,
+                                 zero_stage=2, **kw)
+    step3 = make_zero_train_step(model, opt, mesh, donate=False,
+                                 zero_stage=3, prefetch=2, **kw)
+    imgs, lbls = _batch(mesh, hw=8, classes=10)
+    losses2, losses3 = [], []
+    for _ in range(6):
+        s2, l2 = step2(s2, imgs, lbls)
+        s3, l3 = step3(s3, imgs, lbls)
+        losses2.append(float(l2))
+        losses3.append(float(l3))
+    assert losses3[-1] < losses3[0], losses3
+    np.testing.assert_allclose(losses2, losses3, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(s2.pshard), np.asarray(s3.pshard),
+                               rtol=1e-5, atol=1e-6)

@@ -313,16 +313,15 @@ class MetricsPump(threading.Thread):
         while not self._stop_evt.wait(self._interval_s):
             try:
                 self.publish_once()
-            # hvdlint: ignore[exception-discipline] -- the exporter is
-            # best-effort by contract: a transient write/snapshot error
-            # must not kill the pump (or the training job)
+            # The exporter is best-effort by contract: a transient
+            # write/snapshot error must not kill the pump (or the
+            # training job).
             except Exception as e:
                 _log.warning(f"metrics export failed: {e}")
         # Final publish so short jobs still leave a file behind.
         try:
             self.publish_once()
-        # hvdlint: ignore[exception-discipline] -- same best-effort
-        # contract on the shutdown flush
+        # Same best-effort contract on the shutdown flush.
         except Exception as e:
             _log.debug(f"final metrics export failed: {e}")
 

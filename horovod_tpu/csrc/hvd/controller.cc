@@ -501,6 +501,11 @@ void TcpController::StartHeartbeat() {
       bool ok;
       {
         MutexLock slk(send_mu_);
+        // hvdlint: ignore[blocking-under-lock] -- the heartbeat and
+        // cycle threads share coord_sock_, and send_mu_ is the lock
+        // that keeps their frames from interleaving; bound: one
+        // ~20-byte pre-built heartbeat frame per interval, so the
+        // cycle thread waits at most one tiny kernel write.
         ok = coord_sock_.valid() && coord_sock_.SendFrame(hb);
       }
       lk.lock();
@@ -772,13 +777,23 @@ std::vector<Response> TcpController::WorkerCycle(std::vector<Request> reqs,
                                                  bool my_drain,
                                                  bool* world_shutdown) {
   *world_shutdown = false;
+  // Frame assembly (serialization + response-cache bookkeeping) runs
+  // BEFORE the send lock: only the socket write itself needs to be
+  // serialized against the heartbeat thread, and byte-assembly under
+  // send_mu_ would stall heartbeats for the whole encode
+  // (blocking-under-lock, docs/static-analysis.md).
+  const std::string frame =
+      BuildRequestFrame(std::move(reqs), my_shutdown, my_drain);
   bool sent;
   {
     // Serialized against the heartbeat thread's frames (liveness mode);
     // uncontended (and the heartbeat thread absent) otherwise.
     MutexLock slk(send_mu_);
-    sent = coord_sock_.SendFrame(
-        BuildRequestFrame(std::move(reqs), my_shutdown, my_drain));
+    // hvdlint: ignore[blocking-under-lock] -- send_mu_ exists to
+    // serialize exactly this write against heartbeat frames on the
+    // shared coordinator socket; bound: one pre-built request frame,
+    // drained by the coordinator's cycle loop within its poll budget.
+    sent = coord_sock_.SendFrame(frame);
   }
   if (!sent) {
     *world_shutdown = true;
@@ -917,6 +932,10 @@ std::vector<Response> TcpController::LeaderCycle(std::vector<Request> reqs,
   bool sent;
   {
     MutexLock slk(send_mu_);
+    // hvdlint: ignore[blocking-under-lock] -- aggregate frame is fully
+    // built above, outside the lock; only the write is serialized
+    // against heartbeat frames on the shared coordinator socket.
+    // Bound: one frame per negotiation cycle.
     sent = coord_sock_.SendFrame(frame);
   }
   if (!sent) {

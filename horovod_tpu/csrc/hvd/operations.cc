@@ -863,6 +863,11 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
     s->controller = std::make_unique<hvd::TcpController>(
         cfg, s->data_listener.port(), my_host ? my_host : "127.0.0.1");
   }
+  // hvdlint: ignore[blocking-under-lock] -- bootstrap by design:
+  // init_mu IS the lifecycle lock, and the controller handshake
+  // (accept/connect) must finish before any getter may observe the
+  // world as initialized; bound: the 120 s accept/30 s connect
+  // timeouts, paid once per (re)init, never on a hot path.
   hvd::Status st = s->controller->Initialize();
   if (!st.ok()) {
     std::fprintf(stderr, "[horovod_tpu] init failed: %s\n",
@@ -875,6 +880,10 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
     // this process's counter) into every hello and resume frame — set
     // before Connect so even the bootstrap dials are fenced.
     s->ring->set_epoch(s->controller->epoch());
+    // hvdlint: ignore[blocking-under-lock] -- same bootstrap contract
+    // as Initialize above: the data-plane dial must complete under
+    // init_mu before initialized flips true; bound: the ring's
+    // connect/accept timeouts, once per (re)init.
     st = s->ring->Connect(rank, s->controller->data_endpoints(),
                           &s->data_listener);
     if (!st.ok()) {
@@ -900,6 +909,11 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
     // HOROVOD_STRIPES > 1 (must agree across ranks, like every dispatch
     // env); HOROVOD_STRIPE_FALLBACK=0 makes a stripe connect failure a
     // hard error instead of a lock-step slide to single-socket TCP.
+    // hvdlint: ignore[blocking-under-lock] -- transport bring-up (shm
+    // attach + stripe dials, which may lazily PeerLink-accept) is part
+    // of the same once-per-init bootstrap under the lifecycle lock;
+    // bound: the transport connect timeouts, never a steady-state
+    // path.
     s->ring->ConfigureTransports(
         hvd::EnvFlag("HOROVOD_SHM"),
         hvd::ShmSlotBytes(static_cast<long long>(fusion_threshold)),

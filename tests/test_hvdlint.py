@@ -751,6 +751,504 @@ def test_parse_error_is_reported_not_fatal(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# concurrency-flow plane: lock-order-discipline (C++)
+# ---------------------------------------------------------------------------
+
+def _cxx_tree(tmp_path, name, source):
+    return make_tree(tmp_path, {}, root_files={
+        f"horovod_tpu/csrc/hvd/{name}": source})
+
+
+def test_lock_order_flags_two_mutex_cycle(tmp_path):
+    root = _cxx_tree(tmp_path, "pair.cc", """\
+        namespace hvd {
+        class Pair {
+         public:
+          void AB();
+          void BA();
+         private:
+          Mutex a_;
+          Mutex b_;
+        };
+        void Pair::AB() {
+          MutexLock la(a_);
+          MutexLock lb(b_);
+        }
+        void Pair::BA() {
+          MutexLock lb(b_);
+          MutexLock la(a_);
+        }
+        }  // namespace hvd
+        """)
+    hits = findings_of(root, "lock-order-discipline")
+    assert len(hits) == 1, [f.render() for f in hits]
+    msg = hits[0].message
+    assert "Pair::a_" in msg and "Pair::b_" in msg
+    # The evidence chain names both acquisition sites by file:line.
+    assert msg.count("pair.cc:") >= 2, msg
+
+
+def test_lock_order_consistent_order_is_clean(tmp_path):
+    root = _cxx_tree(tmp_path, "pair.cc", """\
+        namespace hvd {
+        class Pair {
+         public:
+          void AB();
+          void AlsoAB();
+         private:
+          Mutex a_;
+          Mutex b_;
+        };
+        void Pair::AB() {
+          MutexLock la(a_);
+          MutexLock lb(b_);
+        }
+        void Pair::AlsoAB() {
+          MutexLock la(a_);
+          MutexLock lb(b_);
+        }
+        }  // namespace hvd
+        """)
+    assert findings_of(root, "lock-order-discipline") == []
+
+
+def test_lock_order_cycle_through_helper_call(tmp_path):
+    """The interprocedural direction: BA() never touches a_ directly —
+    the back edge appears only through the helper it calls while
+    holding b_."""
+    root = _cxx_tree(tmp_path, "pair.cc", """\
+        namespace hvd {
+        class Pair {
+         public:
+          void AB();
+          void BA();
+         private:
+          void TakeA();
+          Mutex a_;
+          Mutex b_;
+        };
+        void Pair::TakeA() { MutexLock la(a_); }
+        void Pair::AB() {
+          MutexLock la(a_);
+          MutexLock lb(b_);
+        }
+        void Pair::BA() {
+          MutexLock lb(b_);
+          TakeA();
+        }
+        }  // namespace hvd
+        """)
+    hits = findings_of(root, "lock-order-discipline")
+    assert len(hits) == 1, [f.render() for f in hits]
+
+
+def test_same_field_name_in_two_classes_is_not_a_cycle(tmp_path):
+    """Lock identity is class-qualified: two classes both naming a
+    field mu_ must not merge into one acquired-before node."""
+    root = _cxx_tree(tmp_path, "two.cc", """\
+        namespace hvd {
+        class A {
+         public:
+          void F();
+         private:
+          Mutex mu_;
+          Mutex other_;
+        };
+        class B {
+         public:
+          void G();
+         private:
+          Mutex mu_;
+          Mutex other_;
+        };
+        void A::F() {
+          MutexLock l1(mu_);
+          MutexLock l2(other_);
+        }
+        void B::G() {
+          MutexLock l2(other_);
+          MutexLock l1(mu_);
+        }
+        }  // namespace hvd
+        """)
+    assert findings_of(root, "lock-order-discipline") == []
+
+
+# ---------------------------------------------------------------------------
+# concurrency-flow plane: blocking-under-lock (C++)
+# ---------------------------------------------------------------------------
+
+def test_blocking_under_lock_transitive_through_helper(tmp_path):
+    root = _cxx_tree(tmp_path, "chan.cc", """\
+        namespace hvd {
+        class Chan {
+         public:
+          void Publish();
+         private:
+          void Push();
+          Mutex mu_;
+          int fd_ = -1;
+        };
+        void Chan::Push() { send(fd_, 0, 0, 0); }
+        void Chan::Publish() {
+          MutexLock lk(mu_);
+          Push();
+        }
+        }  // namespace hvd
+        """)
+    hits = findings_of(root, "blocking-under-lock")
+    assert len(hits) == 1, [f.render() for f in hits]
+    msg = hits[0].message
+    # Anchored at the call site inside the lock-holding function, with
+    # the chain down to the primitive and the held mutex named.
+    assert hits[0].path.endswith("chan.cc") and hits[0].line == 13
+    assert "Chan::mu_" in msg and "send" in msg and "Chan::Push" in msg
+
+
+def test_blocking_under_lock_requires_annotation_counts(tmp_path):
+    """REQUIRES(mu) means held-on-entry: a blocking call in the body is
+    under the lock even with no acquisition in sight."""
+    root = _cxx_tree(tmp_path, "chan.cc", """\
+        namespace hvd {
+        class Chan {
+         public:
+          void PushLocked() REQUIRES(mu_);
+         private:
+          Mutex mu_;
+          int fd_ = -1;
+        };
+        void Chan::PushLocked() REQUIRES(mu_) { send(fd_, 0, 0, 0); }
+        }  // namespace hvd
+        """)
+    hits = findings_of(root, "blocking-under-lock")
+    assert len(hits) == 1 and "Chan::mu_" in hits[0].message
+
+
+def test_unlock_before_send_and_own_cv_wait_are_clean(tmp_path):
+    """The two idioms the model must not flag: the sender-loop pattern
+    (fill state under the lock, DROP it, do the I/O, retake it) and a
+    cv-wait on the mutex its own lock argument releases."""
+    root = _cxx_tree(tmp_path, "chan.cc", """\
+        namespace hvd {
+        class Chan {
+         public:
+          void Publish();
+          void WaitReady();
+         private:
+          void Push();
+          Mutex mu_;
+          CondVar cv_;
+          bool ready_ = false;
+          int fd_ = -1;
+        };
+        void Chan::Push() { send(fd_, 0, 0, 0); }
+        void Chan::Publish() {
+          UniqueLock lk(mu_);
+          ready_ = true;
+          lk.unlock();
+          Push();
+          lk.lock();
+          ready_ = false;
+        }
+        void Chan::WaitReady() {
+          UniqueLock lk(mu_);
+          while (!ready_) cv_.wait(lk);
+        }
+        }  // namespace hvd
+        """)
+    assert findings_of(root, "blocking-under-lock") == []
+
+
+def test_cv_wait_under_a_different_mutex_is_flagged(tmp_path):
+    root = _cxx_tree(tmp_path, "chan.cc", """\
+        namespace hvd {
+        class Chan {
+         public:
+          void Bad();
+         private:
+          Mutex mu_;
+          Mutex reg_mu_;
+          CondVar cv_;
+          bool ready_ = false;
+        };
+        void Chan::Bad() {
+          MutexLock g(reg_mu_);
+          UniqueLock lk(mu_);
+          while (!ready_) cv_.wait(lk);
+        }
+        }  // namespace hvd
+        """)
+    hits = findings_of(root, "blocking-under-lock")
+    assert len(hits) == 1, [f.render() for f in hits]
+    # The wait's OWN mutex is exempt; the extra one is the offense.
+    assert "Chan::reg_mu_" in hits[0].message
+    assert "Chan::mu_ (" not in hits[0].message
+
+
+def test_deferred_lambda_does_not_inherit_enclosing_lock(tmp_path):
+    """A lambda built under a lock runs later on another thread: its
+    body must not inherit the registration lock into the held-set (the
+    CtrlChannel pattern in hvd_init). Locks taken INSIDE the lambda
+    still count."""
+    root = _cxx_tree(tmp_path, "chan.cc", """\
+        namespace hvd {
+        class Chan {
+         public:
+          void Register();
+          void Beat();
+         private:
+          void Push();
+          Mutex mu_;
+          Mutex send_mu_;
+          std::function<void()> cb_;
+          int fd_ = -1;
+        };
+        void Chan::Push() { send(fd_, 0, 0, 0); }
+        void Chan::Register() {
+          MutexLock lk(mu_);
+          cb_ = [this] { Push(); };
+        }
+        void Chan::Beat() {
+          cb_ = [this] {
+            MutexLock slk(send_mu_);
+            Push();
+          };
+        }
+        }  // namespace hvd
+        """)
+    hits = findings_of(root, "blocking-under-lock")
+    assert len(hits) == 1, [f.render() for f in hits]
+    assert "Chan::send_mu_" in hits[0].message
+
+
+def test_cxx_suppression_via_slash_comments(tmp_path):
+    """C++ findings honor the same directive grammar behind ``//`` —
+    trailing or in the comment block above — and a reason-less
+    directive is itself a finding."""
+    root = _cxx_tree(tmp_path, "chan.cc", """\
+        namespace hvd {
+        class Chan {
+         public:
+          void Publish();
+         private:
+          void Push();
+          Mutex mu_;
+          int fd_ = -1;
+        };
+        void Chan::Push() { send(fd_, 0, 0, 0); }
+        void Chan::Publish() {
+          MutexLock lk(mu_);
+          // hvdlint: ignore[blocking-under-lock] -- bound: one frame,
+          // drained by the peer's cycle loop
+          Push();
+        }
+        }  // namespace hvd
+        """)
+    assert findings_of(root, "blocking-under-lock") == []
+    supp = findings_of(root, "blocking-under-lock", active_only=False)
+    assert len(supp) == 1 and supp[0].suppressed
+    assert "bound" in supp[0].suppress_reason
+
+    bad = _cxx_tree(tmp_path / "b", "chan.cc", """\
+        namespace hvd {
+        class Chan {
+         public:
+          void Publish();
+         private:
+          void Push();
+          Mutex mu_;
+          int fd_ = -1;
+        };
+        void Chan::Push() { send(fd_, 0, 0, 0); }
+        void Chan::Publish() {
+          MutexLock lk(mu_);
+          Push();  // hvdlint: ignore[blocking-under-lock]
+        }
+        }  // namespace hvd
+        """)
+    defects = findings_of(bad, "bad-suppression")
+    assert len(defects) == 1 and defects[0].path.endswith("chan.cc")
+
+
+# ---------------------------------------------------------------------------
+# concurrency-flow plane: collective-symmetry (Python)
+# ---------------------------------------------------------------------------
+
+def test_collective_symmetry_flags_rank_conditional_allreduce(tmp_path):
+    root = make_tree(tmp_path, {"step.py": """\
+        import horovod_tpu as hvd
+
+        def step(x):
+            if hvd.rank() == 0:
+                return hvd.allreduce(x)
+            return x
+        """})
+    hits = findings_of(root, "collective-symmetry")
+    assert len(hits) == 1, [f.render() for f in hits]
+    assert "allreduce" in hits[0].message
+    assert "rank-conditioned branch" in hits[0].message
+
+
+def test_collective_symmetry_flags_except_handler_collective(tmp_path):
+    root = make_tree(tmp_path, {"step.py": """\
+        import horovod_tpu as hvd
+
+        def step(x):
+            try:
+                y = hvd.allreduce(x)
+            except RuntimeError:
+                y = hvd.broadcast(x, 0)
+            return y
+        """})
+    hits = findings_of(root, "collective-symmetry")
+    assert len(hits) == 1, [f.render() for f in hits]
+    assert "broadcast" in hits[0].message
+    assert "except handler" in hits[0].message
+
+
+def test_collective_symmetry_flags_rank_early_exit(tmp_path):
+    root = make_tree(tmp_path, {"step.py": """\
+        import horovod_tpu as hvd
+
+        def gather_on_leaders(x):
+            if hvd.local_rank() != 0:
+                return x
+            return hvd.allgather(x)
+        """})
+    hits = findings_of(root, "collective-symmetry")
+    assert len(hits) == 1, [f.render() for f in hits]
+    assert "early exit" in hits[0].message
+
+
+def test_collective_symmetry_clean_and_shape_rank_guard(tmp_path):
+    """Symmetric code is clean even when rank is read for non-collective
+    work, and ``x.shape.rank`` (array dimensionality) is not a process
+    rank."""
+    root = make_tree(tmp_path, {"step.py": """\
+        import horovod_tpu as hvd
+
+        def step(x):
+            y = hvd.allreduce(x)
+            if hvd.rank() == 0:
+                print(y)
+            return y
+
+        def pad(x):
+            if x.shape.rank == 2:
+                return hvd.allreduce(x)
+            return x
+        """})
+    assert findings_of(root, "collective-symmetry") == []
+
+
+def test_collective_symmetry_suppression_honored(tmp_path):
+    root = make_tree(tmp_path, {"step.py": """\
+        import horovod_tpu as hvd
+
+        def seed_params(x):
+            if hvd.rank() == 0:
+                # hvdlint: ignore[collective-symmetry] -- rank 0 is the
+                # broadcast ROOT; non-roots enter the same collective
+                # from the recv path inside broadcast itself
+                hvd.broadcast(x, 0)
+            return x
+        """})
+    assert findings_of(root, "collective-symmetry") == []
+    supp = findings_of(root, "collective-symmetry", active_only=False)
+    assert len(supp) == 1 and supp[0].suppress_reason
+
+
+# ---------------------------------------------------------------------------
+# CLI: SARIF output + stale-suppression audit
+# ---------------------------------------------------------------------------
+
+def test_cli_sarif_schema(tmp_path, capsys):
+    root = make_tree(tmp_path, {"bad.py": """\
+        import os
+        a = os.environ.get("HOROVOD_RANK")
+        b = os.environ.get("HOROVOD_SIZE")  # hvdlint: ignore[env-discipline] -- sarif fixture
+        """})
+    assert main(["--format", "sarif", root]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in doc["$schema"]
+    run = doc["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "hvdlint"
+    rule_ids = [r["id"] for r in driver["rules"]]
+    assert "env-discipline" in rule_ids
+    assert all(r["shortDescription"]["text"] for r in driver["rules"])
+    active = [r for r in run["results"] if "suppressions" not in r]
+    supp = [r for r in run["results"] if "suppressions" in r]
+    assert len(active) == 1 and len(supp) == 1
+    res = active[0]
+    assert res["ruleId"] == "env-discipline"
+    assert res["level"] == "error"
+    assert driver["rules"][res["ruleIndex"]]["id"] == "env-discipline"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "horovod_tpu/bad.py"
+    assert loc["region"]["startLine"] == 2
+    assert loc["region"]["startColumn"] >= 1
+    assert supp[0]["suppressions"][0]["kind"] == "inSource"
+    assert supp[0]["suppressions"][0]["justification"]
+
+
+def test_stale_suppression_flags_rotten_directive(tmp_path, capsys):
+    root = make_tree(tmp_path, {"s.py": """\
+        import os
+        a = 1  # hvdlint: ignore[env-discipline] -- nothing left to exempt
+        """})
+    # Suppression rot is a warning: surfaced, never a failed run.
+    assert main(["--stale-suppressions", root]) == 0
+    out = capsys.readouterr().out
+    assert "stale-suppression" in out and "env-discipline" in out
+
+
+def test_stale_suppression_live_directive_is_quiet(tmp_path, capsys):
+    root = make_tree(tmp_path, {"s.py": """\
+        import os
+        a = os.environ.get("HOROVOD_RANK")  # hvdlint: ignore[env-discipline] -- launcher re-export
+        """})
+    assert main(["--stale-suppressions", root]) == 0
+    assert "stale-suppression" not in capsys.readouterr().out
+
+
+def test_stale_suppression_scoped_to_run_checks(tmp_path, capsys):
+    """A filtered --check run cannot judge other checks' directives:
+    the rotten env-discipline directive is NOT reported when only
+    retry-discipline ran."""
+    root = make_tree(tmp_path, {"s.py": """\
+        import os
+        a = 1  # hvdlint: ignore[env-discipline] -- judged only by full runs
+        """})
+    assert main(["--stale-suppressions", "--check", "retry-discipline",
+                 root]) == 0
+    assert "stale-suppression" not in capsys.readouterr().out
+
+
+def test_stale_suppression_unknown_check_id(tmp_path, capsys):
+    root = make_tree(tmp_path, {"s.py": """\
+        import os
+        a = 1  # hvdlint: ignore[no-such-check] -- typo'd id
+        """})
+    assert main(["--stale-suppressions", root]) == 0
+    out = capsys.readouterr().out
+    assert "unknown check id" in out and "no-such-check" in out
+
+
+def test_stale_suppression_covers_csrc_directives(tmp_path, capsys):
+    root = _cxx_tree(tmp_path, "chan.cc", """\
+        namespace hvd {
+        // hvdlint: ignore[blocking-under-lock] -- nothing blocking here
+        inline int Twice(int x) { return x + x; }
+        }  // namespace hvd
+        """)
+    assert main(["--stale-suppressions", root]) == 0
+    out = capsys.readouterr().out
+    assert "stale-suppression" in out and "chan.cc" in out
+
+
+# ---------------------------------------------------------------------------
 # the tree itself
 # ---------------------------------------------------------------------------
 
@@ -771,6 +1269,28 @@ def test_cross_language_checks_clean_on_head():
          "binding-contract,native-knob-discipline"],
         cwd=REPO, capture_output=True, text=True, timeout=300)
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_flow_checks_clean_on_head():
+    """The tools/t1.sh concurrency-flow gate, verbatim: the acquired-
+    before graph is acyclic, every blocking-under-lock site is either
+    restructured or carries a reasoned bound, and no collective sits in
+    a rank-divergent context on this repo."""
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.hvdlint", "--check",
+         "lock-order-discipline,blocking-under-lock,collective-symmetry"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_stale_suppressions_clean_on_head():
+    """The full t1 pre-flight with the rot audit on: every ignore[...]
+    directive in the tree still suppresses a live finding."""
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.hvdlint", "--stale-suppressions"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "stale-suppression" not in r.stdout, r.stdout
 
 
 def test_every_suppression_on_head_carries_a_reason():

@@ -854,7 +854,12 @@ class NativeKnobDiscipline:
         return out
 
 
+# Imported at the bottom: flow.py builds on this module's C++ lexer
+# (lazily, so the registration import stays one-directional at load
+# time).
+from .flow import FLOW_CHECKS  # noqa: E402
+
 ALL_CHECKS = (EnvDiscipline(), CompatDiscipline(), RetryDiscipline(),
               FaultRegistry(), ExceptionDiscipline(),
               TimelineInstantRegistry(), BindingContract(),
-              NativeKnobDiscipline())
+              NativeKnobDiscipline()) + FLOW_CHECKS

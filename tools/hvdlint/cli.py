@@ -4,8 +4,12 @@ Exit codes: 0 clean, 1 findings (or malformed suppressions), 2 usage.
 ``--format json`` (alias ``--json``) prints the machine-readable report
 (schema in core.py); ``--format gh`` prints one severity-tagged GitHub
 workflow-command line per finding (``::error file=F,line=L,...``) so CI
-renders findings as inline annotations; ``--registry`` prints the
-generated docs/env-vars.md content instead of linting.
+renders findings as inline annotations; ``--format sarif`` prints a
+SARIF 2.1.0 report for GitHub code scanning upload; ``--registry``
+prints the generated docs/env-vars.md content instead of linting;
+``--stale-suppressions`` additionally audits every ``ignore[...]``
+directive and warns on ones that no longer suppress anything
+(suppression rot).
 """
 
 from __future__ import annotations
@@ -16,7 +20,8 @@ import sys
 from typing import List
 
 from .checks import ALL_CHECKS
-from .core import Project, report_json, run_checks
+from .core import (Project, audit_stale_suppressions, report_json,
+                   report_sarif, run_checks)
 
 
 def _repo_root() -> str:
@@ -34,12 +39,20 @@ def main(argv: List[str] = None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="machine-readable JSON report on stdout "
                     "(alias for --format json)")
-    ap.add_argument("--format", choices=("text", "json", "gh"),
+    ap.add_argument("--format", choices=("text", "json", "gh", "sarif"),
                     default=None,
                     help="output mode: text (default), json (the "
                     "machine-readable report), gh (one GitHub "
                     "workflow-command annotation per finding, "
-                    "severity-tagged — for CI annotation rendering)")
+                    "severity-tagged — for CI annotation rendering), "
+                    "sarif (SARIF 2.1.0 for GitHub code scanning "
+                    "upload; suppressed findings carry inSource "
+                    "suppressions)")
+    ap.add_argument("--stale-suppressions", action="store_true",
+                    help="also audit suppression directives: an "
+                    "ignore[check-id] that no longer suppresses any "
+                    "finding is reported as a warning (suppression "
+                    "rot)")
     ap.add_argument("--check", action="append", default=None,
                     metavar="ID", help="run only this check id "
                     "(repeatable; comma-separated lists accepted, e.g. "
@@ -80,12 +93,18 @@ def main(argv: List[str] = None) -> int:
 
     fmt = args.format or ("json" if args.json else "text")
     findings = run_checks(project, checks)
+    if args.stale_suppressions:
+        findings.extend(audit_stale_suppressions(
+            project, checks, known_ids={c.id for c in ALL_CHECKS}))
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.check))
     active = [f for f in findings if not f.suppressed]
     errors = [f for f in active if f.severity != "warning"]
     warnings = [f for f in active if f.severity == "warning"]
     suppressed = [f for f in findings if f.suppressed]
     if fmt == "json":
         print(report_json(findings, checks))
+    elif fmt == "sarif":
+        print(report_sarif(findings, checks))
     elif fmt == "gh":
         # GitHub workflow commands: one annotation per active finding,
         # severity mapped to the command level. The summary goes to

@@ -11,6 +11,12 @@ Suppression syntax (one per line, reason REQUIRED)::
     risky_call()  # hvdlint: ignore[check-id] -- why this is fine
     # hvdlint: ignore[check-id,other-id] -- applies to the NEXT line
 
+C++ sources use the same directive behind ``//`` — the flow checks
+(flow.py) report into ``horovod_tpu/csrc`` and their suppressions live
+next to the finding, exactly like the Python plane::
+
+    ok = sock_.SendFrame(hb);  // hvdlint: ignore[blocking-under-lock] -- bound: one frame
+
 A suppression without a ``-- reason`` is itself reported (check id
 ``bad-suppression``): the whole point of forcing a reason is that "why
 is this exempt" survives the author leaving.
@@ -23,10 +29,10 @@ import dataclasses
 import json
 import os
 import re
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 SUPPRESS_RE = re.compile(
-    r"#\s*hvdlint:\s*ignore\[([A-Za-z0-9_,\- ]+)\]\s*(?:--\s*(\S.*))?")
+    r"(?:#|//)\s*hvdlint:\s*ignore\[([A-Za-z0-9_,\- ]+)\]\s*(?:--\s*(\S.*))?")
 
 
 @dataclasses.dataclass
@@ -132,17 +138,7 @@ class Module:
     # -- suppressions -------------------------------------------------------
 
     def _suppress_lines(self, line: int):
-        """Candidate 1-based lines whose directive guards ``line``: the
-        line itself (trailing comment), then the contiguous block of
-        comment-only lines directly above it (a wrapped reason pushes the
-        directive more than one line up)."""
-        if 1 <= line <= len(self.lines):
-            yield line
-        ln = line - 1
-        while 1 <= ln <= len(self.lines) and \
-                self.lines[ln - 1].strip().startswith("#"):
-            yield ln
-            ln -= 1
+        return _suppress_lines(self.lines, line)
 
     def suppression_for(self, line: int, check: str
                         ) -> Tuple[bool, str, Optional[Finding]]:
@@ -151,21 +147,70 @@ class Module:
         directive anywhere in the comment block directly above. ``defect``
         is a bad-suppression Finding when the matching directive is
         missing its reason."""
-        for ln in self._suppress_lines(line):
-            m = SUPPRESS_RE.search(self.lines[ln - 1])
-            if not m:
-                continue
-            ids = [s.strip() for s in m.group(1).split(",") if s.strip()]
-            if check not in ids:
-                continue
-            reason = (m.group(2) or "").strip()
-            if not reason:
-                return True, "", Finding(
-                    "bad-suppression", self.path, ln, 0,
-                    f"hvdlint suppression of [{check}] has no "
-                    f"'-- reason'; every exemption must say why")
-            return True, reason, None
-        return False, "", None
+        return _suppression_for(self.lines, self.path, line, check)
+
+
+def _suppress_lines(lines: List[str], line: int):
+    """Candidate 1-based lines whose directive guards ``line``: the
+    line itself (trailing comment), then the contiguous block of
+    comment-only lines directly above it (a wrapped reason pushes the
+    directive more than one line up). Comment-only means ``#`` (Python)
+    or ``//`` (C++) — the directive grammar is shared across planes."""
+    if 1 <= line <= len(lines):
+        yield line
+    ln = line - 1
+    while 1 <= ln <= len(lines) and \
+            lines[ln - 1].strip().startswith(("#", "//")):
+        yield ln
+        ln -= 1
+
+
+def _suppression_with_line(lines: List[str], path: str, line: int,
+                           check: str
+                           ) -> Tuple[bool, str, Optional[Finding], int]:
+    """Like _suppression_for but also names the 1-based line holding the
+    matching directive (0 when none matched) — run_checks records it so
+    the stale-suppression audit knows which directives earned their keep."""
+    for ln in _suppress_lines(lines, line):
+        m = SUPPRESS_RE.search(lines[ln - 1])
+        if not m:
+            continue
+        ids = [s.strip() for s in m.group(1).split(",") if s.strip()]
+        if check not in ids:
+            continue
+        reason = (m.group(2) or "").strip()
+        if not reason:
+            return True, "", Finding(
+                "bad-suppression", path, ln, 0,
+                f"hvdlint suppression of [{check}] has no "
+                f"'-- reason'; every exemption must say why"), ln
+        return True, reason, None, ln
+    return False, "", None, 0
+
+
+def _suppression_for(lines: List[str], path: str, line: int, check: str
+                     ) -> Tuple[bool, str, Optional[Finding]]:
+    sup, reason, defect, _ = _suppression_with_line(lines, path, line,
+                                                    check)
+    return sup, reason, defect
+
+
+class TextSource:
+    """A non-Python source (C++, shell, ...) that participates in the
+    suppression contract: same directive grammar, ``//`` comments
+    accepted. Built lazily by Project.text_source for findings that
+    flow checks report into csrc."""
+
+    def __init__(self, root: str, path: str):
+        self.root = root
+        self.path = path  # relative, posix
+        with open(os.path.join(root, path), encoding="utf-8") as f:
+            self.source = f.read()
+        self.lines = self.source.splitlines()
+
+    def suppression_for(self, line: int, check: str
+                        ) -> Tuple[bool, str, Optional[Finding]]:
+        return _suppression_for(self.lines, self.path, line, check)
 
 
 class Project:
@@ -179,6 +224,11 @@ class Project:
         self.modules: List[Module] = []
         self.parse_failures: List[Finding] = []
         self._text_cache: Dict[tuple, Dict[str, str]] = {}
+        self._source_cache: Dict[str, Optional[TextSource]] = {}
+        # (path, directive line, check id) triples that actually
+        # suppressed a finding in the last run_checks over this project —
+        # the ground truth the --stale-suppressions audit diffs against.
+        self.used_suppressions: Set[Tuple[str, int, str]] = set()
         for rel in (paths if paths is not None
                     else self._discover(self.root)):
             try:
@@ -205,6 +255,23 @@ class Project:
             if m.path == path:
                 return m
         return None
+
+    def text_source(self, path: str) -> Optional[TextSource]:
+        """Suppression-capable view of a non-Python file (memoized).
+        Returns None when the file does not exist or cannot be read —
+        findings there simply cannot be suppressed in-source."""
+        cached = self._source_cache.get(path)
+        if cached is not None or path in self._source_cache:
+            return cached
+        src: Optional[TextSource] = None
+        full = os.path.join(self.root, path)
+        if os.path.isfile(full):
+            try:
+                src = TextSource(self.root, path)
+            except (OSError, UnicodeDecodeError):
+                src = None
+        self._source_cache[path] = src
+        return src
 
     def text_files(self, reldirs: Tuple[str, ...],
                    suffixes: Tuple[str, ...]) -> Dict[str, str]:
@@ -237,8 +304,14 @@ class Project:
 
 def run_checks(project: Project, checks) -> List[Finding]:
     """Run checks over the project, apply suppressions, return every
-    finding (suppressed ones included, flagged) sorted by location."""
+    finding (suppressed ones included, flagged) sorted by location.
+
+    Suppressions resolve through the Python module model when the
+    finding lands in a parsed module, and through the TextSource
+    fallback otherwise — so C++ findings from the flow checks honor the
+    same ``hvdlint: ignore[...] -- reason`` contract behind ``//``."""
     findings: List[Finding] = list(project.parse_failures)
+    project.used_suppressions = set()
     for check in checks:
         raw: List[Finding] = []
         for mod in project.modules:
@@ -247,18 +320,71 @@ def run_checks(project: Project, checks) -> List[Finding]:
         if finalize is not None:
             raw.extend(finalize(project))
         for f in raw:
-            mod = project.module(f.path)
-            if mod is not None:
-                suppressed, reason, defect = mod.suppression_for(
-                    f.line, f.check)
+            src = project.module(f.path) or project.text_source(f.path)
+            if src is not None:
+                suppressed, reason, defect, dln = _suppression_with_line(
+                    src.lines, f.path, f.line, f.check)
                 if suppressed:
                     f.suppressed = True
                     f.suppress_reason = reason
+                    project.used_suppressions.add((f.path, dln, f.check))
                 if defect is not None:
                     findings.append(defect)
             findings.append(f)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.check))
     return findings
+
+
+def audit_stale_suppressions(project: Project, checks,
+                             known_ids: Optional[Set[str]] = None
+                             ) -> List[Finding]:
+    """Suppression-rot audit (``--stale-suppressions``): every
+    ``ignore[check-id]`` directive in the package or csrc that did NOT
+    suppress a finding in the run that just completed is itself a
+    warning — it documents an exemption that no longer exists, and dead
+    directives are how real ones stop being read. Must run after
+    run_checks (diffs against project.used_suppressions).
+
+    Only ids belonging to checks in this run are judged (a filtered
+    ``--check`` run cannot call other checks' directives stale); ids
+    known to no registered check are always flagged when ``known_ids``
+    (the full registry) is provided."""
+    run_ids = {c.id for c in checks}
+    # Framework findings are suppressible too, and always "run".
+    run_ids |= {"bad-suppression", "parse-error"}
+    sources: List[Tuple[str, List[str]]] = [
+        (m.path, m.lines) for m in project.modules]
+    for rel, text in sorted(project.text_files(
+            ("horovod_tpu/csrc",), (".cc", ".h")).items()):
+        sources.append((rel, text.splitlines()))
+    out: List[Finding] = []
+    for path, lines in sources:
+        for idx, line in enumerate(lines, start=1):
+            m = SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            for cid in (s.strip() for s in m.group(1).split(",")):
+                if not cid:
+                    continue
+                if known_ids is not None and cid not in known_ids \
+                        and cid not in run_ids:
+                    out.append(Finding(
+                        "stale-suppression", path, idx, 0,
+                        f"suppression names unknown check id [{cid}] — "
+                        f"it can never match a finding",
+                        severity="warning"))
+                    continue
+                if cid not in run_ids:
+                    continue  # not judged by this (filtered) run
+                if (path, idx, cid) not in project.used_suppressions:
+                    out.append(Finding(
+                        "stale-suppression", path, idx, 0,
+                        f"suppression of [{cid}] no longer matches any "
+                        f"finding — the exemption it documents is gone; "
+                        f"delete the directive",
+                        severity="warning"))
+    out.sort(key=lambda f: (f.path, f.line, f.col))
+    return out
 
 
 def report_json(findings: List[Finding], checks) -> str:
@@ -280,4 +406,51 @@ def report_json(findings: List[Finding], checks) -> str:
         # Warnings never fail the run (see Finding.severity), so ok
         # tracks active ERRORS only.
         "ok": not errors,
+    }, indent=2, sort_keys=True)
+
+
+def report_sarif(findings: List[Finding], checks) -> str:
+    """SARIF 2.1.0 report (``--format sarif``) for GitHub code scanning
+    upload. Suppressed findings are included with an ``inSource``
+    suppression carrying the reason — code scanning then shows them as
+    dismissed instead of dropping the history."""
+    rules = [{"id": c.id,
+              "shortDescription": {"text": c.description}}
+             for c in checks]
+    rule_index = {c.id: i for i, c in enumerate(checks)}
+    results = []
+    for f in findings:
+        res = {
+            "ruleId": f.check,
+            "level": "warning" if f.severity == "warning" else "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(f.line, 1),
+                               "startColumn": f.col + 1},
+                },
+            }],
+        }
+        if f.check in rule_index:
+            res["ruleIndex"] = rule_index[f.check]
+        if f.suppressed:
+            res["suppressions"] = [{
+                "kind": "inSource",
+                "justification": f.suppress_reason,
+            }]
+        results.append(res)
+    return json.dumps({
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "hvdlint",
+                "rules": rules,
+            }},
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "results": results,
+        }],
     }, indent=2, sort_keys=True)

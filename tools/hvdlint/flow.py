@@ -1,0 +1,845 @@
+"""Interprocedural concurrency-flow analysis (docs/static-analysis.md).
+
+The flow layer behind three checks the per-function Clang thread-safety
+annotations (PR 10) and the protocol models (PR 11) cannot express:
+
+- ``lock-order-discipline``: a global acquired-before graph over every
+  mutex acquisition in ``horovod_tpu/csrc/hvd`` — direct AND reached
+  through calls. Any cycle is a potential deadlock and is reported as a
+  minimal evidence chain of file:line acquisition sites.
+- ``blocking-under-lock``: a blocking primitive (send/recv/poll/
+  connect/accept/sleep/cv-wait...) reached — transitively, through the
+  call graph — while a named mutex is held. A cv-wait is exempt with
+  respect to the mutex its own lock argument releases, and only that
+  one.
+- ``collective-symmetry``: the Python plane's SPMD divergence lint —
+  calls into the collective surface under rank-conditioned branches,
+  inside ``except`` handlers, or after a rank-conditioned early exit.
+  The static form of the stall class the stall inspector catches at
+  runtime (one rank issuing a different collective sequence wedges the
+  world — the motivating Horovod failure mode, arXiv:1802.05799).
+
+Pure stdlib, built on the PR 10 lexer in checks.py: the C++ side is a
+heuristic function scanner (balanced-brace bodies over comment/string-
+stripped text), per-function summaries (locks acquired via ``MutexLock``
+/``UniqueLock``/``REQUIRES``, calls made, blocking primitives reached),
+and a shortest-chain fixpoint over the call graph. ``UniqueLock``
+``.unlock()``/``.lock()`` toggles are modeled, so the sender-loop idiom
+(fill mailbox under the lock, drop it, do the socket I/O, retake it)
+comes out clean. The whole analysis runs once per Project and is
+memoized — both C++ checks read the same summaries.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, Module, Project
+
+CSRC_HVD = "horovod_tpu/csrc"
+
+# The lock implementation layer: scanning it would turn the Mutex
+# wrapper's own internal std::mutex calls into phantom acquisitions.
+SKIP_FILES = ("thread_annotations.h",)
+
+# Blocking primitives by terminal callee name: the syscall layer plus
+# the std sleep/wait surface. Condition-variable waits are handled
+# separately (they release their own mutex while blocked).
+BLOCKING_CALLS = frozenset({
+    "send", "recv", "sendmsg", "recvmsg", "sendto", "recvfrom",
+    "poll", "ppoll", "select", "epoll_wait",
+    "connect", "accept", "accept4",
+    "sleep", "usleep", "nanosleep", "sleep_for", "sleep_until",
+    "readv", "writev",
+})
+
+CV_WAITS = frozenset({"wait", "wait_for", "wait_until"})
+
+# Identifiers that look like calls but are not.
+_NON_CALLS = frozenset({
+    "if", "for", "while", "switch", "catch", "return", "sizeof",
+    "new", "delete", "throw", "case", "do", "else", "goto",
+    "alignof", "decltype", "static_assert", "assert", "using",
+    "typedef", "operator", "noexcept", "defined", "alignas",
+    # thread-safety annotation macros (thread_annotations.h)
+    "GUARDED_BY", "PT_GUARDED_BY", "REQUIRES", "REQUIRES_SHARED",
+    "EXCLUDES", "ACQUIRE", "ACQUIRE_SHARED", "RELEASE",
+    "RELEASE_SHARED", "TRY_ACQUIRE", "ACQUIRED_BEFORE",
+    "ACQUIRED_AFTER", "RETURN_CAPABILITY", "CAPABILITY",
+    "SCOPED_CAPABILITY", "NO_THREAD_SAFETY_ANALYSIS",
+    "ASSERT_CAPABILITY",
+})
+
+_ANNOT_TRAILERS = frozenset({
+    "REQUIRES", "REQUIRES_SHARED", "EXCLUDES", "ACQUIRE",
+    "ACQUIRE_SHARED", "RELEASE", "RELEASE_SHARED", "TRY_ACQUIRE",
+    "ACQUIRED_BEFORE", "ACQUIRED_AFTER", "RETURN_CAPABILITY",
+    "NO_THREAD_SAFETY_ANALYSIS", "ASSERT_CAPABILITY",
+})
+
+_WORD_TRAILERS = frozenset({"const", "noexcept", "override", "final",
+                            "mutable", "volatile", "&", "&&"})
+
+_CLASS_RE = re.compile(
+    r"(?<!enum\s)\b(?:class|struct)\s+([A-Za-z_]\w*)\s*(?::[^;{]*)?\{")
+_MUTEX_DECL_RE = re.compile(
+    r"\b(?:hvd::|std::)?(?:Mutex|mutex)\s+([A-Za-z_]\w*)\s*;")
+_DEF_RE = re.compile(
+    r"(~?[A-Za-z_]\w*(?:\s*::\s*~?[A-Za-z_]\w*)*)\s*\(")
+_LOCK_DECL_RE = re.compile(
+    r"\b(?:hvd::|std::)?"
+    r"(MutexLock|UniqueLock|lock_guard\s*<[^;{}>]*>|"
+    r"unique_lock\s*<[^;{}>]*>|scoped_lock\s*<[^;{}>]*>)"
+    r"\s+([A-Za-z_]\w*)\s*\(")
+_CALL_RE = re.compile(
+    r"((?:[A-Za-z_]\w*\s*(?:::|\.|->)\s*)*)(~?[A-Za-z_]\w*)\s*\(")
+_LAMBDA_RE = re.compile(
+    r"\[[^\[\]]*\]\s*(?:\([^()]*\))?\s*(?:mutable\b\s*)?"
+    r"(?:noexcept\b\s*)?(?:->[^{;]*?)?\{")
+
+
+def _lexer():
+    # Lazy: checks.py imports this module to build ALL_CHECKS; a
+    # top-level back-import would make the import order load-bearing.
+    from . import checks
+    return checks._strip_c_comments, checks._line_of
+
+
+def _balanced(text: str, i: int, op: str, cl: str) -> int:
+    """Index one past the ``cl`` matching the ``op`` at ``i``; len(text)
+    when unbalanced (truncated file) — callers treat that as scan end."""
+    depth = 0
+    n = len(text)
+    while i < n:
+        c = text[i]
+        if c == op:
+            depth += 1
+        elif c == cl:
+            depth -= 1
+            if depth == 0:
+                return i + 1
+        i += 1
+    return n
+
+
+def _class_spans(text: str) -> List[Tuple[str, int, int]]:
+    """(name, body_start, body_end) for every class/struct body."""
+    out = []
+    for m in _CLASS_RE.finditer(text):
+        b0 = m.end() - 1
+        out.append((m.group(1), b0, _balanced(text, b0, "{", "}")))
+    return out
+
+
+def _innermost_class(spans, pos: int) -> Optional[str]:
+    best = None
+    best_len = None
+    for name, b0, b1 in spans:
+        if b0 < pos < b1 and (best_len is None or b1 - b0 < best_len):
+            best, best_len = name, b1 - b0
+    return best
+
+
+class _Fn:
+    """One C++ function definition plus its concurrency summary."""
+
+    def __init__(self, qual: str, cls: Optional[str], path: str,
+                 line: int):
+        self.qual = qual          # e.g. "TcpController::WorkerCycle"
+        self.base = qual.rsplit("::", 1)[-1]
+        self.cls = cls
+        self.path = path
+        self.line = line
+        self.requires: List[str] = []
+        # (mutex key, line, held-before snapshot of (key, acq line))
+        self.acq_events: List[Tuple[str, int, Tuple]] = []
+        # (callee base, class filter, line, held snapshot)
+        self.call_events: List[Tuple[str, Optional[str], int, Tuple]] = []
+        # (kind, line, held snapshot, waited mutex key or None)
+        self.block_events: List[Tuple[str, int, Tuple,
+                                      Optional[str]]] = []
+
+
+class _Held:
+    __slots__ = ("var", "key", "depth", "engaged", "line", "span")
+
+    def __init__(self, var, key, depth, line, span=None):
+        self.var = var
+        self.key = key
+        self.depth = depth
+        self.engaged = True
+        self.line = line
+        # Innermost lambda body the lock was acquired in (None =
+        # the function's own frame). A lambda body is a DEFERRED
+        # execution context — the thread that eventually runs it does
+        # not hold the locks the enclosing function held at the
+        # definition site, so held-sets never cross a lambda boundary
+        # in either direction.
+        self.span = span
+
+
+def _split_top_args(s: str) -> List[str]:
+    out, depth, cur = [], 0, []
+    for c in s:
+        if c in "(<[{":
+            depth += 1
+        elif c in ")>]}":
+            depth -= 1
+        if c == "," and depth == 0:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(c)
+    if cur:
+        out.append("".join(cur))
+    return [a.strip() for a in out]
+
+
+class _CxxAnalysis:
+    """Project-wide summaries + the two C++ flow checks' findings."""
+
+    def __init__(self, project: Project):
+        strip, line_of = _lexer()
+        self._line_of = line_of
+        self.functions: List[_Fn] = []
+        self.by_base: Dict[str, List[_Fn]] = {}
+        # bare mutex name -> owning classes (from field declarations)
+        self.mutex_owners: Dict[str, Set[str]] = {}
+        files = sorted(project.text_files(
+            (CSRC_HVD,), (".cc", ".h")).items())
+        files = [(p, t) for p, t in files
+                 if not p.endswith(SKIP_FILES)]
+        stripped = [(p, strip(t)) for p, t in files]
+        for path, text in stripped:
+            spans = _class_spans(text)
+            for m in _MUTEX_DECL_RE.finditer(text):
+                owner = _innermost_class(spans, m.start())
+                if owner:
+                    self.mutex_owners.setdefault(
+                        m.group(1), set()).add(owner)
+        for path, text in stripped:
+            self._scan_file(path, text)
+        for fn in self.functions:
+            self.by_base.setdefault(fn.base, []).append(fn)
+        self._propagate()
+
+    # -- mutex identity ------------------------------------------------
+
+    def _mutex_key(self, expr: str, cls: Optional[str]) -> str:
+        """Stable identity for a lock expression. Bare member names are
+        class-qualified (two classes both naming a field ``send_mu_``
+        must not merge into one graph node and fake a cycle); the owning
+        class comes from the field declaration when it is unambiguous,
+        else from the enclosing method's class."""
+        e = re.sub(r"\s+", "", expr).lstrip("&*")
+        if not e:
+            return "<unknown>"
+        last = re.split(r"->|\.|::", e)[-1]
+        owners = self.mutex_owners.get(last, set())
+        if cls and cls in owners:
+            return f"{cls}::{last}"
+        if len(owners) == 1:
+            return f"{next(iter(owners))}::{last}"
+        if owners:
+            return e
+        if e == last and cls:
+            return f"{cls}::{e}"
+        return e
+
+    # -- file scan -----------------------------------------------------
+
+    def _scan_file(self, path: str, text: str) -> None:
+        spans = _class_spans(text)
+        n = len(text)
+        pos = 0
+        while True:
+            m = _DEF_RE.search(text, pos)
+            if not m:
+                break
+            name = re.sub(r"\s+", "", m.group(1))
+            base = name.rsplit("::", 1)[-1].lstrip("~")
+            if base in _NON_CALLS or name.lstrip("~") in _NON_CALLS:
+                pos = m.end()
+                continue
+            params_end = _balanced(text, m.end() - 1, "(", ")")
+            body = self._body_start(text, params_end)
+            if body is None:
+                pos = m.end()
+                continue
+            b0, requires_raw = body
+            b1 = _balanced(text, b0, "{", "}")
+            cls = None
+            if "::" in name:
+                cls = name.rsplit("::", 2)[-2]
+            else:
+                cls = _innermost_class(spans, m.start())
+            qual = name if "::" in name else (
+                f"{cls}::{name}" if cls else name)
+            fn = _Fn(qual, cls, path, self._line_of(text, m.start()))
+            fn.requires = [self._mutex_key(a, cls)
+                           for r in requires_raw
+                           for a in _split_top_args(r) if a]
+            self._scan_body(fn, text, b0 + 1, b1 - 1)
+            self.functions.append(fn)
+            pos = b1
+        return
+
+    def _body_start(self, text: str, i: int):
+        """After a parameter list: skip declaration trailers (const,
+        noexcept, annotation macros, ctor init lists, trailing return
+        types). Returns (index of body '{', [REQUIRES arg strings]) or
+        None when this was a declaration/call, not a definition."""
+        requires: List[str] = []
+        n = len(text)
+        while i < n:
+            while i < n and text[i].isspace():
+                i += 1
+            if i >= n:
+                return None
+            c = text[i]
+            if c == "{":
+                return i, requires
+            if c in ";=,)":
+                return None
+            if c == ":":
+                j = self._skip_ctor_inits(text, i + 1)
+                if j is None:
+                    return None
+                return j, requires
+            if c == "-" and text[i:i + 2] == "->":
+                # trailing return type: consume to the body/terminator
+                j = i + 2
+                while j < n and text[j] not in "{;":
+                    j += 1
+                i = j
+                continue
+            wm = re.match(r"[A-Za-z_]\w*", text[i:])
+            if not wm:
+                return None
+            word = wm.group(0)
+            i += len(word)
+            while i < n and text[i].isspace():
+                i += 1
+            if i < n and text[i] == "(":
+                j = _balanced(text, i, "(", ")")
+                if word in _ANNOT_TRAILERS:
+                    if word in ("REQUIRES", "REQUIRES_SHARED"):
+                        requires.append(text[i + 1:j - 1])
+                elif word not in ("noexcept",):
+                    return None
+                i = j
+                continue
+            if word not in _WORD_TRAILERS and \
+                    word not in _ANNOT_TRAILERS:
+                return None
+        return None
+
+    def _skip_ctor_inits(self, text: str, i: int):
+        n = len(text)
+        while i < n:
+            while i < n and text[i].isspace():
+                i += 1
+            wm = re.match(r"[A-Za-z_][\w:<>, ]*", text[i:])
+            if not wm:
+                return None
+            i += len(wm.group(0))
+            while i < n and text[i].isspace():
+                i += 1
+            if i >= n or text[i] not in "({":
+                return None
+            i = _balanced(text, i, text[i], ")" if text[i] == "(" else "}")
+            while i < n and text[i].isspace():
+                i += 1
+            if i < n and text[i] == ",":
+                i += 1
+                continue
+            if i < n and text[i] == "{":
+                return i
+            return None
+        return None
+
+    # -- body scan -----------------------------------------------------
+
+    def _scan_body(self, fn: _Fn, text: str, b0: int, b1: int) -> None:
+        # Lambda body spans: each is its own execution context (see
+        # _Held.span) — the CtrlChannel-style deferred callbacks built
+        # under init_mu must not inherit init_mu into their held-set.
+        lambdas: List[Tuple[int, int]] = []
+        for m in _LAMBDA_RE.finditer(text, b0, b1):
+            lb0 = m.end() - 1
+            lambdas.append((lb0, _balanced(text, lb0, "{", "}")))
+
+        def span_of(pos: int):
+            best = None
+            for s, e in lambdas:
+                if s < pos < e and (best is None or
+                                    e - s < best[1] - best[0]):
+                    best = (s, e)
+            return best
+
+        events = []  # (pos, kind, payload)
+        for i in range(b0, b1):
+            if text[i] in "{}":
+                events.append((i, "brace", text[i]))
+        claimed: List[Tuple[int, int]] = []
+        for m in _LOCK_DECL_RE.finditer(text, b0, b1):
+            p_open = m.end() - 1
+            p_close = _balanced(text, p_open, "(", ")")
+            args = _split_top_args(text[p_open + 1:p_close - 1])
+            events.append((m.start(), "lockdecl",
+                           (m.group(2), args[0] if args else "")))
+            claimed.append((m.start(), p_close))
+        for m in _CALL_RE.finditer(text, b0, b1):
+            if any(s <= m.start() < e for s, e in claimed):
+                continue
+            prefix = re.sub(r"\s+", "", m.group(1))
+            base = m.group(2)
+            if base in _NON_CALLS or base.startswith("~"):
+                continue
+            events.append((m.start(), "call",
+                           (prefix, base, m.end() - 1)))
+        events.sort(key=lambda e: e[0])
+
+        depth = 0
+        held: List[_Held] = [
+            _Held(None, k, -1, fn.line) for k in fn.requires]
+
+        def snapshot(span, exclude=None):
+            return tuple((h.key, h.line) for h in held
+                         if h.engaged and h.span == span
+                         and h is not exclude)
+
+        def find_var(name):
+            for h in reversed(held):
+                if h.var == name:
+                    return h
+            return None
+
+        for pos, kind, payload in events:
+            line = self._line_of(text, pos)
+            if kind == "brace":
+                if payload == "{":
+                    depth += 1
+                else:
+                    depth -= 1
+                    held[:] = [h for h in held if h.depth <= depth]
+                continue
+            sp = span_of(pos)
+            if kind == "lockdecl":
+                var, expr = payload
+                key = self._mutex_key(expr, fn.cls)
+                fn.acq_events.append((key, line, snapshot(sp)))
+                held.append(_Held(var, key, depth, line, sp))
+                continue
+            prefix, base, paren = payload
+            obj = re.sub(r"(::|\.|->)$", "", prefix)
+            if base in ("lock", "unlock") and prefix:
+                h = find_var(obj)
+                if h is not None:
+                    if base == "lock" and not h.engaged:
+                        h.engaged = True
+                        h.line = line
+                        fn.acq_events.append(
+                            (h.key, line, snapshot(sp, exclude=h)))
+                    elif base == "unlock":
+                        h.engaged = False
+                elif base == "lock":
+                    key = self._mutex_key(obj, fn.cls)
+                    fn.acq_events.append((key, line, snapshot(sp)))
+                    held.append(_Held(obj, key, depth, line, sp))
+                else:
+                    key = self._mutex_key(obj, fn.cls)
+                    for h2 in reversed(held):
+                        if h2.key == key and h2.engaged:
+                            h2.engaged = False
+                            break
+                continue
+            if base in CV_WAITS and prefix:
+                close = _balanced(text, paren, "(", ")")
+                args = _split_top_args(text[paren + 1:close - 1])
+                waited = None
+                if args:
+                    h = find_var(args[0])
+                    if h is not None:
+                        waited = h.key
+                fn.block_events.append(
+                    ("cv-wait", line, snapshot(sp), waited))
+                continue
+            if base in BLOCKING_CALLS:
+                fn.block_events.append((base, line, snapshot(sp),
+                                        None))
+                continue
+            cflt = None
+            if prefix.endswith("::"):
+                parts = [p for p in prefix.split("::") if p]
+                if parts:
+                    cflt = parts[-1]
+            fn.call_events.append((base, cflt, line, snapshot(sp)))
+
+    # -- interprocedural fixpoint --------------------------------------
+
+    def _resolve(self, caller: _Fn, base: str,
+                 cflt: Optional[str]) -> List[_Fn]:
+        cands = self.by_base.get(base, [])
+        if not cands:
+            return []
+        if cflt:
+            narrowed = [f for f in cands if f.cls == cflt]
+            if narrowed:
+                return narrowed
+        if caller.cls:
+            same = [f for f in cands if f.cls == caller.cls]
+            if same:
+                return same
+        return cands
+
+    def _propagate(self) -> None:
+        # reach_block[qual]: {(kind, waited): (path, line, chain)} where
+        # chain is a tuple of "Qual (path:line)" call hops, outermost
+        # first. Shortest chain wins, so the fixpoint terminates and the
+        # evidence stays minimal.
+        self.reach_block: Dict[str, Dict] = {}
+        self.reach_acq: Dict[str, Dict] = {}
+        for fn in self.functions:
+            rb = self.reach_block.setdefault(fn.qual, {})
+            for kind, line, _snap, waited in fn.block_events:
+                rb.setdefault((kind, waited), (fn.path, line, ()))
+            ra = self.reach_acq.setdefault(fn.qual, {})
+            for key, line, _snap in fn.acq_events:
+                ra.setdefault(key, (fn.path, line, ()))
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.functions:
+                rb = self.reach_block[fn.qual]
+                ra = self.reach_acq[fn.qual]
+                for base, cflt, line, _snap in fn.call_events:
+                    for callee in self._resolve(fn, base, cflt):
+                        if callee.qual == fn.qual:
+                            continue
+                        hop = (f"{callee.qual} ({fn.path}:{line})",)
+                        for bk, (bp, bl, bc) in \
+                                self.reach_block[callee.qual].items():
+                            cand = (bp, bl, hop + bc)
+                            cur = rb.get(bk)
+                            if cur is None or \
+                                    len(cand[2]) < len(cur[2]):
+                                rb[bk] = cand
+                                changed = True
+                        for mk, (ap, al, ac) in \
+                                self.reach_acq[callee.qual].items():
+                            cand = (ap, al, hop + ac)
+                            cur = ra.get(mk)
+                            if cur is None or \
+                                    len(cand[2]) < len(cur[2]):
+                                ra[mk] = cand
+                                changed = True
+
+    # -- findings ------------------------------------------------------
+
+    def blocking_findings(self) -> List[Finding]:
+        out: Dict[Tuple[str, int], Finding] = {}
+
+        def report(fn, line, kind, offenders, chain, prim_at):
+            key = (fn.path, line)
+            if key in out:
+                return
+            locks = ", ".join(
+                f"{k} (acquired {fn.path}:{al})" for k, al in offenders)
+            via = ""
+            if chain:
+                via = " via " + " -> ".join(chain)
+            prim = kind if not prim_at else f"{kind} at {prim_at}"
+            out[key] = Finding(
+                "blocking-under-lock", fn.path, line, 0,
+                f"{fn.qual} reaches blocking {prim}{via} while holding "
+                f"{locks}; move the I/O out of the critical section or "
+                f"suppress with the latency bound")
+
+        for fn in self.functions:
+            for kind, line, snap, waited in fn.block_events:
+                off = [(k, al) for k, al in snap if k != waited]
+                if off:
+                    report(fn, line, kind, off, (), "")
+            for base, cflt, line, snap in fn.call_events:
+                if not snap:
+                    continue
+                for callee in self._resolve(fn, base, cflt):
+                    if callee.qual == fn.qual:
+                        continue
+                    for (kind, waited), (bp, bl, bc) in sorted(
+                            self.reach_block[callee.qual].items()):
+                        off = [(k, al) for k, al in snap if k != waited]
+                        if not off:
+                            continue
+                        hop = (f"{callee.qual} ({fn.path}:{line})",)
+                        report(fn, line, kind, off, hop + bc,
+                               f"{bp}:{bl}")
+                        break
+        return sorted(out.values(), key=lambda f: (f.path, f.line))
+
+    def lock_order_findings(self) -> List[Finding]:
+        # acquired-before digraph: edge a -> b = "b acquired while a
+        # held", with one witness site per edge.
+        edges: Dict[str, Dict[str, Tuple[str, int, str]]] = {}
+
+        def add(a, b, path, line, desc):
+            if a == b:
+                return
+            edges.setdefault(a, {}).setdefault(b, (path, line, desc))
+
+        for fn in self.functions:
+            for key, line, snap in fn.acq_events:
+                for h, hl in snap:
+                    add(h, key, fn.path, line,
+                        f"{key} acquired at {fn.path}:{line} while "
+                        f"holding {h} (from {fn.path}:{hl}) in {fn.qual}")
+            for base, cflt, line, snap in fn.call_events:
+                if not snap:
+                    continue
+                for callee in self._resolve(fn, base, cflt):
+                    if callee.qual == fn.qual:
+                        continue
+                    for mk, (ap, al, chain) in \
+                            self.reach_acq[callee.qual].items():
+                        for h, hl in snap:
+                            via = (" via " + " -> ".join(chain)
+                                   if chain else "")
+                            add(h, mk, ap, al,
+                                f"{mk} acquired at {ap}:{al} (reached "
+                                f"from {fn.qual} at {fn.path}:{line}"
+                                f"{via}) while holding {h} (from "
+                                f"{fn.path}:{hl})")
+
+        # DFS cycle detection; each cycle reported once, canonicalized
+        # by its minimal rotation.
+        findings: List[Finding] = []
+        seen_cycles: Set[Tuple[str, ...]] = set()
+        WHITE, GRAY, BLACK = 0, 1, 2
+        color: Dict[str, int] = {}
+        stack: List[str] = []
+
+        def dfs(node):
+            color[node] = GRAY
+            stack.append(node)
+            for nxt in sorted(edges.get(node, {})):
+                c = color.get(nxt, WHITE)
+                if c == GRAY:
+                    cyc = stack[stack.index(nxt):]
+                    i = cyc.index(min(cyc))
+                    canon = tuple(cyc[i:] + cyc[:i])
+                    if canon in seen_cycles:
+                        continue
+                    seen_cycles.add(canon)
+                    hops = []
+                    ring = list(canon) + [canon[0]]
+                    for a, b in zip(ring, ring[1:]):
+                        hops.append(edges[a][b][2])
+                    path, line, _ = edges[ring[0]][ring[1]]
+                    findings.append(Finding(
+                        "lock-order-discipline", path, line, 0,
+                        "lock-order cycle (potential deadlock): "
+                        + " -> ".join(canon + (canon[0],))
+                        + "; evidence: " + "; ".join(hops)))
+                elif c == WHITE:
+                    dfs(nxt)
+            stack.pop()
+            color[node] = BLACK
+
+        for node in sorted(edges):
+            if color.get(node, WHITE) == WHITE:
+                dfs(node)
+        findings.sort(key=lambda f: (f.path, f.line))
+        return findings
+
+
+def _cxx(project: Project) -> _CxxAnalysis:
+    an = getattr(project, "_flow_cxx", None)
+    if an is None:
+        an = _CxxAnalysis(project)
+        project._flow_cxx = an
+    return an
+
+
+class LockOrderDiscipline:
+    id = "lock-order-discipline"
+    description = ("global acquired-before graph over csrc/hvd mutex "
+                   "acquisitions (interprocedural) must be acyclic — "
+                   "any cycle is a potential deadlock, reported as a "
+                   "file:line evidence chain")
+
+    def run(self, module: Module) -> List[Finding]:
+        return []
+
+    def finalize(self, project: Project) -> List[Finding]:
+        return _cxx(project).lock_order_findings()
+
+
+class BlockingUnderLock:
+    id = "blocking-under-lock"
+    description = ("no blocking primitive (send/recv/poll/connect/"
+                   "accept/sleep/cv-wait-on-another-mutex) reached — "
+                   "transitively through the call graph — while a "
+                   "csrc/hvd mutex is held, unless suppressed with the "
+                   "latency bound")
+
+    def run(self, module: Module) -> List[Finding]:
+        return []
+
+    def finalize(self, project: Project) -> List[Finding]:
+        return _cxx(project).blocking_findings()
+
+
+# ---------------------------------------------------------------------------
+# collective-symmetry (Python plane)
+# ---------------------------------------------------------------------------
+
+# The collective surface by terminal callee name (ops/xla.py,
+# ops/adasum.py, ops/eager.py, zero.py, opt.py wrappers). Names generic
+# enough to collide with non-collective APIs (join, poll, synchronize)
+# are deliberately absent — this lint must stay near-zero-FP.
+COLLECTIVE_NAMES = frozenset({
+    "allreduce", "grouped_allreduce", "hierarchical_allreduce",
+    "grouped_hierarchical_allreduce", "allgather",
+    "hierarchical_allgather", "broadcast", "reducescatter",
+    "alltoall", "barrier", "zero_reducescatter", "zero_allgather",
+    "adasum_allreduce", "grouped_adasum_allreduce",
+    "hierarchical_adasum_allreduce",
+    "grouped_hierarchical_adasum_allreduce",
+    "allreduce_async", "grouped_allreduce_async", "allgather_async",
+    "broadcast_async", "reducescatter_async", "alltoall_async",
+})
+
+RANK_NAMES = frozenset({"rank", "local_rank", "cross_rank",
+                        "node_rank"})
+
+
+def _terminal_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _is_rank_test(test: ast.AST) -> bool:
+    """Does this branch condition read a process-rank identity? Calls
+    (hvd.rank(), self.local_rank(), ...) and bare/attr reads compared in
+    the test both count; tensor-shape chains (``x.shape.rank``) do not —
+    an array's dimensionality is not a process rank."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            name = _terminal_name(node.func)
+            if name in RANK_NAMES:
+                return True
+        elif isinstance(node, ast.Attribute) and node.attr in RANK_NAMES:
+            chain_has_shape = False
+            cur = node.value
+            while isinstance(cur, ast.Attribute):
+                if cur.attr in ("shape", "ndim"):
+                    chain_has_shape = True
+                cur = cur.value
+            if isinstance(cur, ast.Name) and cur.id in ("shape",):
+                chain_has_shape = True
+            if not chain_has_shape:
+                return True
+        elif isinstance(node, ast.Name) and node.id in RANK_NAMES:
+            return True
+    return False
+
+
+def _exits(stmts: List[ast.stmt]) -> bool:
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+
+
+class CollectiveSymmetry:
+    id = "collective-symmetry"
+    description = ("SPMD divergence lint: collective calls under "
+                   "rank-conditioned branches, inside except handlers, "
+                   "or after a rank-conditioned early exit issue "
+                   "different collective sequences on different ranks "
+                   "— the static form of the runtime stall class")
+
+    def run(self, module: Module) -> List[Finding]:
+        out: List[Finding] = []
+
+        def flag(call: ast.Call, why: str) -> None:
+            name = _terminal_name(call.func)
+            out.append(Finding(
+                self.id, module.path, call.lineno, call.col_offset,
+                f"collective {name}() {why} — ranks issue divergent "
+                f"collective sequences and the world stalls "
+                f"(restructure so every rank reaches the same "
+                f"collectives in the same order, or suppress with why "
+                f"the divergence is safe)"))
+
+        def shallow_calls(node: ast.AST):
+            """Collective Call nodes in this statement's expressions,
+            not descending into nested statement lists or defs."""
+            stack = list(ast.iter_child_nodes(node))
+            while stack:
+                n = stack.pop()
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)) or isinstance(
+                        n, ast.stmt):
+                    continue
+                if isinstance(n, ast.Call) and \
+                        _terminal_name(n.func) in COLLECTIVE_NAMES:
+                    yield n
+                stack.extend(ast.iter_child_nodes(n))
+
+        def scan(stmts: List[ast.stmt], ctx: Optional[str]) -> None:
+            local_ctx = ctx
+            for stmt in stmts:
+                for call in shallow_calls(stmt):
+                    if local_ctx:
+                        flag(call, local_ctx)
+                if isinstance(stmt, ast.If):
+                    ranky = _is_rank_test(stmt.test)
+                    branch_ctx = local_ctx
+                    if ranky and branch_ctx is None:
+                        branch_ctx = (
+                            f"under a rank-conditioned branch "
+                            f"(test at line {stmt.lineno})")
+                    scan(stmt.body, branch_ctx)
+                    scan(stmt.orelse, branch_ctx)
+                    if ranky and local_ctx is None and (
+                            _exits(stmt.body) or _exits(stmt.orelse)):
+                        local_ctx = (
+                            f"after a rank-conditioned early exit "
+                            f"(line {stmt.lineno})")
+                elif isinstance(stmt, ast.Try):
+                    scan(stmt.body, local_ctx)
+                    for h in stmt.handlers:
+                        scan(h.body, local_ctx or
+                             f"inside an except handler (line "
+                             f"{h.lineno}): only ranks that hit the "
+                             f"exception issue it")
+                    scan(stmt.orelse, local_ctx)
+                    scan(stmt.finalbody, local_ctx)
+                elif isinstance(stmt, (ast.While, ast.For,
+                                       ast.AsyncFor)):
+                    body_ctx = local_ctx
+                    if isinstance(stmt, ast.While) and \
+                            body_ctx is None and \
+                            _is_rank_test(stmt.test):
+                        body_ctx = (f"under a rank-conditioned loop "
+                                    f"(test at line {stmt.lineno})")
+                    scan(stmt.body, body_ctx)
+                    scan(stmt.orelse, body_ctx)
+                elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    scan(stmt.body, local_ctx)
+                elif isinstance(stmt, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    scan(stmt.body, None)
+                elif isinstance(stmt, ast.ClassDef):
+                    scan(stmt.body, None)
+        scan(module.tree.body, None)
+        return out
+
+
+FLOW_CHECKS = (LockOrderDiscipline(), BlockingUnderLock(),
+               CollectiveSymmetry())

@@ -24,8 +24,22 @@ HVDLINT_FMT=()
 # retry/fault-registry/exception discipline — docs/static-analysis.md;
 # also covered by tests/test_hvdlint.py + tests/test_compat_lint.py
 # inside the pytest run below, but failing here costs seconds instead
-# of a suite timeout when the tree is badly broken).
-python -m tools.hvdlint "${HVDLINT_FMT[@]}" || exit 1
+# of a suite timeout when the tree is badly broken). The full run
+# includes the concurrency-flow plane (lock-order-discipline,
+# blocking-under-lock, collective-symmetry); --stale-suppressions keeps
+# the ignore[...] directives honest (rot is a warning, surfaced here).
+python -m tools.hvdlint "${HVDLINT_FMT[@]}" --stale-suppressions \
+  || exit 1
+
+# Concurrency-flow pre-flight by explicit id (docs/static-analysis.md):
+# the interprocedural acquired-before graph must stay acyclic and no
+# blocking primitive may be reached under a csrc mutex without a
+# reasoned latency bound; the Python plane's collective-symmetry lint
+# guards the SPMD divergence stall class. Repeated out of the full run
+# so a concurrency regression names itself in the gate's first line.
+python -m tools.hvdlint "${HVDLINT_FMT[@]}" \
+  --check lock-order-discipline,blocking-under-lock,collective-symmetry \
+  || exit 1
 
 # Cross-language pre-flight (docs/static-analysis.md): the ctypes
 # binding contract (common/native.py vs operations.cc's extern "C"
